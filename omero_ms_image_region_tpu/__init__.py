@@ -1,0 +1,20 @@
+"""TPU-native image-region rendering framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability surface of
+omero-ms-image-region (reference: /root/reference, a Java 8 / Vert.x
+microservice).  The per-tile pixel pipeline (raw read -> per-channel window
+quantization -> LUT/color -> RGB composite -> projection -> crop/flip ->
+encode) runs as batched, jit-compiled JAX kernels on TPU; the protocol layer
+(HTTP routes, sessions, caches, ACL, metadata) is asyncio host code.
+
+Layer map (mirrors SURVEY.md section 1, rebuilt TPU-first):
+  server/   - HTTP/API + request contexts + orchestration  (ref L5-L2)
+  ops/      - JAX render kernels                           (ref L1 Renderer)
+  models/   - rendering metadata value objects             (ref ome.model.*)
+  io/       - pixel sources / pyramid access               (ref PixelBuffer)
+  codecs/   - JPEG/PNG/TIFF encode stage                   (ref LocalCompress)
+  parallel/ - micro-batching + device-mesh sharding        (ref worker pool)
+  utils/    - hashing, colors, config, tracing
+"""
+
+__version__ = "0.1.0"

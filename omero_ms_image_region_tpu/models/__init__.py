@@ -1,0 +1,33 @@
+"""Rendering metadata value objects.
+
+These replace the Java-serialized ``ome.model.*`` objects the reference ships
+over its event bus (SURVEY.md section 2b; reference call sites
+``ImageRegionRequestHandler.java:258-300``, ``:353-356``).  They are plain
+dataclasses: JSON/msgpack-friendly, hashable where useful, and free of any
+ORM/session machinery.
+"""
+
+from .pixels import PixelsType, Pixels, PIXELS_TYPES, pixels_type_range
+from .rendering import (
+    Family,
+    RenderingModel,
+    QuantumDef,
+    ChannelBinding,
+    RenderingDef,
+    default_rendering_def,
+)
+from .mask import Mask
+
+__all__ = [
+    "PixelsType",
+    "Pixels",
+    "PIXELS_TYPES",
+    "pixels_type_range",
+    "Family",
+    "RenderingModel",
+    "QuantumDef",
+    "ChannelBinding",
+    "RenderingDef",
+    "default_rendering_def",
+    "Mask",
+]

@@ -1,0 +1,32 @@
+"""JAX compute kernels — the TPU replacement for the reference's L1 pixel
+layer (``omeis.providers.re.Renderer`` and friends; SURVEY.md section 2b).
+
+Everything in this package is pure, jittable, and batch-friendly:
+
+  quantum.py     per-channel window + family quantization to the 8-bit
+                 codomain (= QuantumFactory strategies)
+  lut.py         .lut file parsing -> (256,3) tables (= LutReader)
+  render.py      the fused render kernel: quantize -> per-channel 256x3
+                 table gather -> additive composite (= Renderer.renderAsPackedInt)
+  flip.py        horizontal/vertical flip (= ImageRegionRequestHandler.flip)
+  projection.py  max/mean/sum Z-projection (= ProjectionService)
+  maskops.py     1-bit mask expansion + palette rasterization
+                 (= ShapeMaskRequestHandler render path)
+"""
+
+from .quantum import quantize
+from .render import build_channel_tables, render_tile, render_tile_batch
+from .flip import flip_image
+from .projection import project_stack
+from .maskops import unpack_mask_bits, rasterize_mask
+
+__all__ = [
+    "quantize",
+    "build_channel_tables",
+    "render_tile",
+    "render_tile_batch",
+    "flip_image",
+    "project_stack",
+    "unpack_mask_bits",
+    "rasterize_mask",
+]

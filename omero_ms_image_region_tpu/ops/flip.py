@@ -1,0 +1,40 @@
+"""Image flip op.
+
+Replaces ``ImageRegionRequestHandler.flip`` (``:616-642``) — the reference's
+O(w*h) per-pixel CPU loop — with ``jnp.flip`` on device, where it fuses into
+the render kernel's output write instead of being a second pass over memory.
+
+Validation semantics match the reference: flipping a null or zero-sized image
+raises; no-op when neither flag is set.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("flip_horizontal",
+                                             "flip_vertical"))
+def _flip_jit(img, flip_horizontal: bool, flip_vertical: bool):
+    axes = []
+    if flip_vertical:
+        axes.append(0)  # rows
+    if flip_horizontal:
+        axes.append(1)  # columns
+    return jnp.flip(img, axis=axes)
+
+
+def flip_image(img, flip_horizontal: bool = False,
+               flip_vertical: bool = False):
+    """Flip an [H, W, ...] image. Mirrors the reference's argument checks
+    (``ImageRegionRequestHandler.java:619-627``)."""
+    if not flip_horizontal and not flip_vertical:
+        return img
+    if img is None:
+        raise ValueError("Attempted to flip null image")
+    if img.shape[0] == 0 or img.shape[1] == 0:
+        raise ValueError("Attempted to flip image with 0 size")
+    return _flip_jit(img, flip_horizontal, flip_vertical)

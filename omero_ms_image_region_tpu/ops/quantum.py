@@ -1,0 +1,133 @@
+"""Window + family quantization to the 8-bit codomain.
+
+TPU-native reconstruction of the quantization semantics of
+``omeis.providers.re.quantum.QuantumFactory`` / ``QuantumStrategy`` as
+consumed by the reference (``ImageRegionRequestHandler.java:259,273-276,433``
+builds an 8-bit quantum over [cdStart, cdEnd] = [0, 255];
+``ImageRegionVerticle.java:72-76`` enumerates the four families).
+
+The mapping, for a pixel value ``v``, window ``[ws, we]``, family transform
+``F`` with curve coefficient ``k``:
+
+    q(v) = round(cd_start + (cd_end - cd_start) *
+                 (F(clamp(v, ws, we)) - F(ws)) / (F(we) - F(ws)))
+
+with family transforms (omeis.providers.re.quantum value mappers):
+
+    linear       F(x) = x
+    polynomial   F(x) = sign(x) * |x|**k     (monotone extension of x**k so
+                                              signed pixel types stay defined)
+    logarithmic  F(x) = log(max(x, 1))       (<=0 guarded as in LogarithmicMap)
+    exponential  F(x) = exp(x**k)            (evaluated in shifted form
+                                              exp(F - F(we)) so float32 never
+                                              overflows; identical ratio)
+
+All four are computed branchlessly and selected per channel, so a mixed batch
+of channels with different families stays one fused XLA kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+FAMILY_LINEAR = 0
+FAMILY_POLYNOMIAL = 1
+FAMILY_LOGARITHMIC = 2
+FAMILY_EXPONENTIAL = 3
+
+_EPS = 1e-12
+
+
+def _signed_pow(x, k):
+    return jnp.sign(x) * jnp.power(jnp.abs(x), k)
+
+
+def _safe_log(x):
+    return jnp.log(jnp.maximum(x, 1.0))
+
+
+def _ratio(x, x_raw, ws, we, family, k):
+    """Normalized position of x in the window under the family curve.
+
+    ``x`` is already clamped to [ws, we]; ``x_raw`` is the unclamped value
+    (needed for the degenerate ws == we step function).  Shapes: x is
+    [..., H, W] with ws/we/family/k broadcastable against the leading dims.
+    """
+    # linear
+    den_lin = we - ws
+    r_lin = (x - ws) / jnp.where(jnp.abs(den_lin) < _EPS, 1.0, den_lin)
+
+    # polynomial
+    ps, pe, px = _signed_pow(ws, k), _signed_pow(we, k), _signed_pow(x, k)
+    den_poly = pe - ps
+    r_poly = (px - ps) / jnp.where(jnp.abs(den_poly) < _EPS, 1.0, den_poly)
+
+    # logarithmic
+    ls, le, lx = _safe_log(ws), _safe_log(we), _safe_log(x)
+    den_log = le - ls
+    r_log = (lx - ls) / jnp.where(jnp.abs(den_log) < _EPS, 1.0, den_log)
+
+    # exponential, shifted by F(we) so every exponent is <= 0:
+    #   (e^{F(x)} - e^{F(ws)}) / (e^{F(we)} - e^{F(ws)})
+    # = (e^{F(x)-F(we)} - e^{F(ws)-F(we)}) / (1 - e^{F(ws)-F(we)})
+    es = jnp.exp(jnp.minimum(ps - pe, 0.0))
+    ex = jnp.exp(jnp.minimum(px - pe, 0.0))
+    den_exp = 1.0 - es
+    r_exp = (ex - es) / jnp.where(jnp.abs(den_exp) < _EPS, 1.0, den_exp)
+
+    r = jnp.where(
+        family == FAMILY_LINEAR, r_lin,
+        jnp.where(
+            family == FAMILY_POLYNOMIAL, r_poly,
+            jnp.where(family == FAMILY_LOGARITHMIC, r_log, r_exp),
+        ),
+    )
+    # A window degenerate under the selected family transform (ws == we, or
+    # both endpoints collapsing under F, e.g. log over [0, 1]) becomes an
+    # all-or-nothing step on the unclamped value.
+    den_sel = jnp.where(
+        family == FAMILY_LINEAR, den_lin,
+        jnp.where(
+            family == FAMILY_POLYNOMIAL, den_poly,
+            jnp.where(family == FAMILY_LOGARITHMIC, den_log, den_exp),
+        ),
+    )
+    degenerate = jnp.abs(den_sel) < _EPS
+    r_deg = jnp.where(x_raw >= we, 1.0, 0.0)
+    return jnp.where(degenerate, r_deg, r)
+
+
+def quantize(
+    raw,
+    window_start,
+    window_end,
+    family,
+    coefficient,
+    cd_start=0,
+    cd_end=255,
+):
+    """Quantize raw channel planes into the 8-bit codomain.
+
+    Args:
+      raw:           f32[C, H, W] raw pixel values (already cast from the
+                     source dtype).
+      window_start:  f32[C] per-channel window start.
+      window_end:    f32[C] per-channel window end.
+      family:        i32[C] family id (FAMILY_* above).
+      coefficient:   f32[C] family curve coefficient.
+      cd_start/end:  codomain interval (QuantumDef; default [0, 255]).
+
+    Returns:
+      i32[C, H, W] quantized values in [cd_start, cd_end].
+    """
+    ws = window_start[:, None, None].astype(jnp.float32)
+    we = window_end[:, None, None].astype(jnp.float32)
+    fam = family[:, None, None]
+    k = coefficient[:, None, None].astype(jnp.float32)
+
+    x_raw = raw.astype(jnp.float32)
+    x = jnp.clip(x_raw, jnp.minimum(ws, we), jnp.maximum(ws, we))
+    r = jnp.clip(_ratio(x, x_raw, ws, we, fam, k), 0.0, 1.0)
+    q = jnp.round(cd_start + (cd_end - cd_start) * r)
+    return q.astype(jnp.int32)

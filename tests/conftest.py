@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Tests must not depend on TPU availability; the multi-chip sharding tests run
+on XLA's host-platform device virtualization, as the driver's
+``dryrun_multichip`` does.
+"""
+
+import os
+
+# Override (not setdefault): the shell may pin JAX_PLATFORMS to the real
+# TPU tunnel, which tests must never touch.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

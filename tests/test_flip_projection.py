@@ -1,0 +1,120 @@
+"""Flip and Z-projection ops vs the reference-semantics CPU implementation.
+
+Flip geometries mirror ImageRegionRequestHandlerTest.java:107-200 (exhaustive
+h/v/both incl. 1xN, Nx1, 1x1 and error cases).
+"""
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.models.rendering import Projection
+from omero_ms_image_region_tpu.ops.flip import flip_image
+from omero_ms_image_region_tpu.ops.projection import (
+    check_projection_bounds,
+    project_stack,
+)
+from omero_ms_image_region_tpu.refimpl import flip_ref, project_ref
+
+
+@pytest.mark.parametrize("h,w", [(4, 6), (1, 5), (5, 1), (1, 1), (3, 3)])
+@pytest.mark.parametrize(
+    "fh,fv", [(True, False), (False, True), (True, True), (False, False)]
+)
+def test_flip_matches_reference(h, w, fh, fv):
+    src = np.arange(h * w * 4, dtype=np.uint8).reshape(h, w, 4)
+    got = np.asarray(flip_image(src, fh, fv))
+    want = flip_ref(src, fh, fv)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_flip_horizontal_golden():
+    src = np.array([[1, 2, 3], [4, 5, 6]], dtype=np.int32)
+    got = np.asarray(flip_image(src, True, False))
+    np.testing.assert_array_equal(got, [[3, 2, 1], [6, 5, 4]])
+
+
+def test_flip_vertical_golden():
+    src = np.array([[1, 2, 3], [4, 5, 6]], dtype=np.int32)
+    got = np.asarray(flip_image(src, False, True))
+    np.testing.assert_array_equal(got, [[4, 5, 6], [1, 2, 3]])
+
+
+def test_flip_null_raises():
+    with pytest.raises(ValueError, match="null"):
+        flip_image(None, True, False)
+
+
+def test_flip_zero_size_raises():
+    with pytest.raises(ValueError, match="0 size"):
+        flip_image(np.zeros((0, 4)), True, False)
+
+
+def test_flip_noop_returns_same():
+    src = np.ones((2, 2))
+    assert flip_image(src, False, False) is src
+
+
+# ---------------------------------------------------------------- projection
+
+def _stack(Z=8, H=4, W=4, seed=0, lo=0, hi=65535):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(lo, hi, size=(Z, H, W)).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "alg",
+    [Projection.MAXIMUM_INTENSITY, Projection.MEAN_INTENSITY,
+     Projection.SUM_INTENSITY],
+)
+@pytest.mark.parametrize("start,end,step", [(0, 7, 1), (2, 5, 1), (0, 7, 2),
+                                            (3, 3, 1)])
+def test_projection_matches_reference(alg, start, end, step):
+    stack = _stack()
+    got = np.asarray(
+        project_stack(stack, alg, start, end, step, type_max=65535.0)
+    )
+    want = project_ref(stack, alg, start, end, step, type_max=65535.0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=0.51)
+
+
+def test_max_is_inclusive_mean_exclusive_of_end():
+    # Plane values = z index; start=0, end=3.
+    stack = np.stack([np.full((2, 2), z, np.float32) for z in range(5)])
+    mx = np.asarray(
+        project_stack(stack, Projection.MAXIMUM_INTENSITY, 0, 3, 1, 65535.0)
+    )
+    assert mx[0, 0] == 3  # inclusive of end plane
+    mean = np.asarray(
+        project_stack(stack, Projection.MEAN_INTENSITY, 0, 3, 1, 65535.0)
+    )
+    assert mean[0, 0] == pytest.approx(1.0)  # planes 0,1,2 only
+
+
+def test_max_clamps_negative_to_zero():
+    # Reference accumulator starts at 0 (ProjectionService.java:183).
+    stack = np.full((3, 2, 2), -7.0, np.float32)
+    mx = np.asarray(
+        project_stack(stack, Projection.MAXIMUM_INTENSITY, 0, 2, 1, 65535.0)
+    )
+    assert (mx == 0).all()
+
+
+def test_sum_clamps_to_type_max():
+    stack = np.full((4, 2, 2), 60000.0, np.float32)
+    s = np.asarray(
+        project_stack(stack, Projection.SUM_INTENSITY, 0, 4, 1, 65535.0)
+    )
+    assert (s == 65535.0).all()
+
+
+def test_projection_bounds_checks():
+    with pytest.raises(ValueError, match="negative"):
+        check_projection_bounds(-1, 3, 1, 0, 0, 8, 3, 1)
+    with pytest.raises(ValueError, match=">= 8"):
+        check_projection_bounds(0, 8, 1, 0, 0, 8, 3, 1)
+    with pytest.raises(ValueError, match="stepping"):
+        check_projection_bounds(0, 3, 0, 0, 0, 8, 3, 1)
+    with pytest.raises(ValueError, match="timepoint must be"):
+        check_projection_bounds(0, 3, 1, 0, 5, 8, 3, 1)
+    with pytest.raises(ValueError, match="channel index"):
+        check_projection_bounds(0, 3, 1, 7, 0, 8, 3, 1)

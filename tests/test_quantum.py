@@ -1,0 +1,117 @@
+"""Quantization kernel vs the CPU reference implementation and hand-computed
+golden values."""
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.models.rendering import Family
+from omero_ms_image_region_tpu.ops.quantum import (
+    FAMILY_EXPONENTIAL,
+    FAMILY_LINEAR,
+    FAMILY_LOGARITHMIC,
+    FAMILY_POLYNOMIAL,
+    quantize,
+)
+from omero_ms_image_region_tpu.refimpl import quantize_ref
+
+
+def _run_quantize(raw, ws, we, family, k):
+    C = raw.shape[0]
+    return np.asarray(
+        quantize(
+            raw.astype(np.float32),
+            np.full(C, ws, np.float32),
+            np.full(C, we, np.float32),
+            np.full(C, family, np.int32),
+            np.full(C, k, np.float32),
+        )
+    )
+
+
+def test_linear_golden():
+    raw = np.array([[[0, 100, 200, 255, 300]]], dtype=np.float32)
+    q = _run_quantize(raw, 0, 255, FAMILY_LINEAR, 1.0)
+    assert q.tolist() == [[[0, 100, 200, 255, 255]]]
+
+
+def test_linear_window_scales():
+    raw = np.array([[[1000, 2000, 3000]]], dtype=np.float32)
+    q = _run_quantize(raw, 1000, 3000, FAMILY_LINEAR, 1.0)
+    assert q.tolist() == [[[0, 128, 255]]]
+
+
+def test_below_window_clamps_to_zero():
+    raw = np.array([[[-50, 0, 10]]], dtype=np.float32)
+    q = _run_quantize(raw, 10, 20, FAMILY_LINEAR, 1.0)
+    assert q.tolist() == [[[0, 0, 0]]]
+
+
+def test_degenerate_window_is_step_function():
+    raw = np.array([[[5, 10, 15]]], dtype=np.float32)
+    q = _run_quantize(raw, 10, 10, FAMILY_LINEAR, 1.0)
+    assert q.tolist() == [[[0, 255, 255]]]
+
+
+@pytest.mark.parametrize(
+    "family,jfam,k",
+    [
+        (Family.LINEAR, FAMILY_LINEAR, 1.0),
+        (Family.POLYNOMIAL, FAMILY_POLYNOMIAL, 2.0),
+        (Family.POLYNOMIAL, FAMILY_POLYNOMIAL, 0.5),
+        (Family.LOGARITHMIC, FAMILY_LOGARITHMIC, 1.0),
+        (Family.EXPONENTIAL, FAMILY_EXPONENTIAL, 1.0),
+    ],
+)
+def test_matches_cpu_reference(family, jfam, k):
+    rng = np.random.default_rng(42)
+    raw = rng.uniform(0, 65535, size=(1, 16, 16)).astype(np.float32)
+    ws, we = 256.0, 60000.0
+    got = _run_quantize(raw, ws, we, jfam, k)[0]
+    want = quantize_ref(raw[0], ws, we, family, k)
+    # float32 vs float64 rounding can differ by 1 at bin edges
+    assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
+
+
+def test_signed_window_linear():
+    raw = np.array([[[-32768, 0, 32767]]], dtype=np.float32)
+    q = _run_quantize(raw, -32768, 32767, FAMILY_LINEAR, 1.0)
+    assert q[0, 0, 0] == 0
+    assert q[0, 0, 2] == 255
+    assert abs(int(q[0, 0, 1]) - 128) <= 1
+
+
+def test_exponential_monotone_no_overflow():
+    raw = np.linspace(0, 65535, 64, dtype=np.float32)[None, None, :]
+    q = _run_quantize(raw, 0, 65535, FAMILY_EXPONENTIAL, 1.0)[0, 0]
+    assert np.all(np.diff(q) >= 0)
+    assert np.isfinite(q).all()
+    assert q[0] == 0 and q[-1] == 255
+
+
+def test_mixed_families_one_call():
+    raw = np.tile(np.linspace(0, 255, 8, dtype=np.float32), (4, 1))[
+        :, None, :
+    ]
+    q = np.asarray(
+        quantize(
+            raw,
+            np.zeros(4, np.float32),
+            np.full(4, 255, np.float32),
+            np.array(
+                [
+                    FAMILY_LINEAR,
+                    FAMILY_POLYNOMIAL,
+                    FAMILY_LOGARITHMIC,
+                    FAMILY_EXPONENTIAL,
+                ],
+                np.int32,
+            ),
+            np.ones(4, np.float32),
+        )
+    )
+    for c, fam in enumerate(
+        [Family.LINEAR, Family.POLYNOMIAL, Family.LOGARITHMIC,
+         Family.EXPONENTIAL]
+    ):
+        want = quantize_ref(raw[c], 0.0, 255.0, fam, 1.0)
+        assert np.abs(q[c].astype(int) - want.astype(int)).max() <= 1

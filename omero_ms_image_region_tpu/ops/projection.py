@@ -94,6 +94,15 @@ def project_stack(stack, algorithm, start: int, end: int,
         Projection.SUM_INTENSITY,
     ):
         raise ValueError(f"Unknown algorithm: {algorithm}")
+    # Z-interval validation (= zIntervalBoundsCheck at the projectStack
+    # entry, ProjectionService.java:52-54); channel/timepoint bounds are the
+    # caller's (check_projection_bounds) since only it knows those sizes.
+    if start < 0 or end < 0:
+        raise ValueError("Z interval value cannot be negative.")
+    if start >= stack.shape[0] or end >= stack.shape[0]:
+        raise ValueError(f"Z interval value cannot be >= {stack.shape[0]}")
+    if stepping <= 0:
+        raise ValueError(f"stepping: {stepping} <= 0")
     return _project(
         stack,
         jnp.asarray(start, jnp.int32),

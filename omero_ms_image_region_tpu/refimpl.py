@@ -29,8 +29,9 @@ def _family_transform(x: np.ndarray, family: Family, k: float) -> np.ndarray:
     if family == Family.LOGARITHMIC:
         return np.log(np.maximum(x, 1.0))
     if family == Family.EXPONENTIAL:
-        # Shifted evaluation, same ratio as exp(x**k) (see ops/quantum.py).
-        return np.power(x, k)
+        # Unreachable from quantize_ref, which evaluates the exponential
+        # family in shifted form to avoid overflow; see its branch below.
+        raise ValueError("exponential family is handled in quantize_ref")
     raise ValueError(family)
 
 

@@ -1,0 +1,6 @@
+"""Protocol layer: HTTP routes, request contexts, region math, orchestration.
+
+Replaces the reference's L5-L2 (SURVEY.md section 1): the Vert.x verticles
+and request handlers become asyncio host code; the only thing that leaves
+this layer for the device is a raw tile plus packed settings.
+"""

@@ -1,0 +1,6 @@
+"""Host utilities: hashing, colors, config, tracing."""
+
+from .siphash import siphash24, guava_siphash24_hex
+from .color import split_html_color
+
+__all__ = ["siphash24", "guava_siphash24_hex", "split_html_color"]

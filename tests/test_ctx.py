@@ -1,0 +1,217 @@
+"""Request-context parsing vs ImageRegionCtxTest.java:121-394, plus the
+JSON wire round-trip the reference locks via Jackson."""
+
+import pytest
+
+from omero_ms_image_region_tpu.models.rendering import Projection
+from omero_ms_image_region_tpu.server.ctx import (
+    BadRequestError,
+    ImageRegionCtx,
+    ShapeMaskCtx,
+)
+
+BASE = {"imageId": "123", "theZ": "0", "theT": "1"}
+
+
+def _params(**extra):
+    p = dict(BASE)
+    p.update(extra)
+    return p
+
+
+def _roundtrip(ctx: ImageRegionCtx) -> ImageRegionCtx:
+    return ImageRegionCtx.from_json(ctx.to_json())
+
+
+# ------------------------------------------------------- required params
+
+@pytest.mark.parametrize("missing", ["imageId", "theZ", "theT"])
+def test_missing_required_param(missing):
+    p = dict(BASE)
+    del p[missing]
+    with pytest.raises(BadRequestError, match=f"Missing parameter '{missing}'"):
+        ImageRegionCtx.from_params(p)
+
+
+@pytest.mark.parametrize("key", ["imageId", "theZ", "theT"])
+def test_bad_number_format(key):
+    with pytest.raises(BadRequestError, match="Incorrect format"):
+        ImageRegionCtx.from_params(_params(**{key: "abc"}))
+
+
+def test_region_format_error():
+    with pytest.raises(BadRequestError):
+        ImageRegionCtx.from_params(_params(region="1,2,3"))
+
+
+def test_channel_format_error():
+    with pytest.raises(BadRequestError, match="Failed to parse channel"):
+        ImageRegionCtx.from_params(_params(c="a|0:100$FF0000"))
+
+
+def test_channel_range_format_error():
+    with pytest.raises(BadRequestError, match="Failed to parse channel"):
+        ImageRegionCtx.from_params(_params(c="1|a:100$FF0000"))
+
+
+def test_quality_format_error():
+    with pytest.raises(BadRequestError, match="Incorrect format"):
+        ImageRegionCtx.from_params(_params(q="a"))
+
+
+# --------------------------------------------------------------- tile
+
+def test_tile_short_form():
+    ctx = _roundtrip(ImageRegionCtx.from_params(_params(tile="1,2,3")))
+    assert ctx.resolution == 1
+    assert ctx.tile.x == 2 and ctx.tile.y == 3
+    assert ctx.tile.width == 0 and ctx.tile.height == 0
+
+
+def test_tile_long_form():
+    ctx = _roundtrip(
+        ImageRegionCtx.from_params(_params(tile="0,1,2,1024,2048")))
+    assert ctx.resolution == 0
+    assert ctx.tile.as_tuple() == (1, 2, 1024, 2048)
+
+
+def test_region_parse():
+    ctx = _roundtrip(ImageRegionCtx.from_params(_params(region="1,2,3,4")))
+    assert ctx.region.as_tuple() == (1, 2, 3, 4)
+
+
+# ------------------------------------------------------------- channels
+
+def test_channel_parse_full():
+    ctx = _roundtrip(ImageRegionCtx.from_params(
+        _params(c="-1|0:65535$0000FF,2|1755:51199$00FF00,3|3218:26623$FF0000")
+    ))
+    assert ctx.channels == [-1, 2, 3]
+    assert ctx.windows == [(0.0, 65535.0), (1755.0, 51199.0),
+                           (3218.0, 26623.0)]
+    assert ctx.colors == ["0000FF", "00FF00", "FF0000"]
+
+
+def test_channel_active_only():
+    ctx = ImageRegionCtx.from_params(_params(c="1,2,-3"))
+    assert ctx.channels == [1, 2, -3]
+    assert ctx.windows == [(None, None)] * 3
+    assert ctx.colors == [None] * 3
+
+
+def test_channel_window_without_color_rejected():
+    # Reference quirk: a "|" clause without "$color" NPEs into a 400.
+    with pytest.raises(BadRequestError):
+        ImageRegionCtx.from_params(_params(c="1|0:65535"))
+
+
+# ------------------------------------------------------------ projection
+
+def test_projection_intmax():
+    ctx = _roundtrip(ImageRegionCtx.from_params(_params(p="intmax")))
+    assert ctx.projection == int(Projection.MAXIMUM_INTENSITY)
+    assert ctx.projection_start is None and ctx.projection_end is None
+
+
+def test_projection_intmean():
+    ctx = ImageRegionCtx.from_params(_params(p="intmean"))
+    assert ctx.projection == int(Projection.MEAN_INTENSITY)
+
+
+def test_projection_intsum():
+    ctx = ImageRegionCtx.from_params(_params(p="intsum"))
+    assert ctx.projection == int(Projection.SUM_INTENSITY)
+
+
+def test_projection_normal_ignored():
+    ctx = ImageRegionCtx.from_params(_params(p="normal"))
+    assert ctx.projection is None
+
+
+def test_projection_with_range():
+    ctx = _roundtrip(ImageRegionCtx.from_params(_params(p="intmean|0:31")))
+    assert ctx.projection == int(Projection.MEAN_INTENSITY)
+    assert ctx.projection_start == 0 and ctx.projection_end == 31
+
+
+def test_projection_malformed_range_tolerated():
+    ctx = ImageRegionCtx.from_params(_params(p="intmean|a:31"))
+    assert ctx.projection == int(Projection.MEAN_INTENSITY)
+    assert ctx.projection_start is None and ctx.projection_end is None
+
+
+# --------------------------------------------------------------- misc
+
+def test_codomain_maps():
+    ctx = _roundtrip(ImageRegionCtx.from_params(
+        _params(maps='[{"reverse": {"enabled": true}}, null]')))
+    assert ctx.maps[0]["reverse"]["enabled"] is True
+    assert ctx.maps[1] is None
+
+
+def test_malformed_maps_rejected():
+    with pytest.raises(BadRequestError):
+        ImageRegionCtx.from_params(_params(maps="{not json"))
+
+
+def test_color_model():
+    assert ImageRegionCtx.from_params(_params(m="g")).m == "greyscale"
+    assert ImageRegionCtx.from_params(_params(m="c")).m == "rgb"
+    assert ImageRegionCtx.from_params(_params(m="x")).m is None
+    assert ImageRegionCtx.from_params(_params()).m is None
+
+
+def test_flip_flags():
+    ctx = ImageRegionCtx.from_params(_params(flip="HV"))
+    assert ctx.flip_horizontal and ctx.flip_vertical
+    ctx = ImageRegionCtx.from_params(_params())
+    assert not ctx.flip_horizontal and not ctx.flip_vertical
+
+
+def test_format_defaults_to_jpeg():
+    assert ImageRegionCtx.from_params(_params()).format == "jpeg"
+    assert ImageRegionCtx.from_params(_params(format="png")).format == "png"
+
+
+# ------------------------------------------------------------ cache key
+
+def test_cache_key_order_insensitivity():
+    a = ImageRegionCtx.from_params(
+        {"imageId": "1", "theZ": "0", "theT": "0", "c": "1|0:255$FF0000"})
+    b = ImageRegionCtx.from_params(
+        {"c": "1|0:255$FF0000", "theT": "0", "theZ": "0", "imageId": "1"})
+    assert a.cache_key == b.cache_key
+    assert len(a.cache_key) == 16  # 64-bit hex
+
+
+def test_cache_key_differs_on_params():
+    a = ImageRegionCtx.from_params(_params())
+    b = ImageRegionCtx.from_params(_params(theT="2"))
+    assert a.cache_key != b.cache_key
+
+
+def test_pixels_metadata_cache_key():
+    assert (ImageRegionCtx.pixels_metadata_cache_key(7)
+            == "ome.model.core.Pixels:Image:7")
+
+
+# ------------------------------------------------------------ shape mask
+
+def test_shape_mask_ctx():
+    ctx = ShapeMaskCtx.from_params(
+        {"shapeId": "42", "color": "FF0000", "flip": "h"})
+    assert ctx.shape_id == 42
+    assert ctx.color == "FF0000"
+    assert ctx.flip_horizontal and not ctx.flip_vertical
+    assert ctx.cache_key() == "ome.model.roi.Mask:42:FF0000"
+
+
+def test_shape_mask_ctx_no_color():
+    ctx = ShapeMaskCtx.from_params({"shapeId": "42"})
+    assert ctx.cache_key() == "ome.model.roi.Mask:42:null"
+    assert ShapeMaskCtx.from_json(ctx.to_json()) == ctx
+
+
+def test_shape_mask_missing_id():
+    with pytest.raises(BadRequestError):
+        ShapeMaskCtx.from_params({})

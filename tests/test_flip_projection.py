@@ -102,9 +102,19 @@ def test_max_clamps_negative_to_zero():
 def test_sum_clamps_to_type_max():
     stack = np.full((4, 2, 2), 60000.0, np.float32)
     s = np.asarray(
-        project_stack(stack, Projection.SUM_INTENSITY, 0, 4, 1, 65535.0)
+        project_stack(stack, Projection.SUM_INTENSITY, 0, 3, 1, 65535.0)
     )
     assert (s == 65535.0).all()
+
+
+def test_project_stack_validates_z_interval():
+    stack = _stack(Z=4)
+    with pytest.raises(ValueError, match="negative"):
+        project_stack(stack, Projection.MAXIMUM_INTENSITY, -1, 2, 1, 65535.0)
+    with pytest.raises(ValueError, match=">= 4"):
+        project_stack(stack, Projection.MAXIMUM_INTENSITY, 0, 4, 1, 65535.0)
+    with pytest.raises(ValueError, match="stepping"):
+        project_stack(stack, Projection.MAXIMUM_INTENSITY, 0, 2, 0, 65535.0)
 
 
 def test_projection_bounds_checks():

@@ -1,0 +1,122 @@
+"""Benchmark harness: the 5 BASELINE.md configs, TPU vs CPU reference.
+
+The reference publishes no numbers (BASELINE.md), so the baseline is our own
+faithful CPU implementation of the Java ``Renderer`` semantics
+(``omero_ms_image_region_tpu.refimpl``) run on the same workload.
+
+Headline metric (BASELINE.json): tiles/sec on 4-channel uint16 1024x1024
+tiles (config 3, batched deep-zoom pan).  ``vs_baseline`` = TPU tiles/sec
+divided by CPU-reference tiles/sec on identical tiles.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+
+def _flagship(C=4, H=1024, W=1024):
+    from omero_ms_image_region_tpu.models.pixels import Pixels
+    from omero_ms_image_region_tpu.models.rendering import (
+        RenderingModel, default_rendering_def,
+    )
+    from omero_ms_image_region_tpu.ops.render import pack_settings
+
+    pixels = Pixels(image_id=1, size_x=W * 8, size_y=H * 8, size_z=1,
+                    size_c=C, size_t=1, pixels_type="uint16")
+    rdef = default_rendering_def(pixels)
+    rdef.model = RenderingModel.RGB
+    colors = [(255, 0, 0), (0, 255, 0), (0, 0, 255), (255, 255, 0)]
+    for i, cb in enumerate(rdef.channel_bindings):
+        cb.active = True
+        cb.red, cb.green, cb.blue = colors[i % 4]
+        cb.input_start, cb.input_end = 100.0, 40000.0
+    return rdef, pack_settings(rdef)
+
+
+def bench_tpu(raw_batches, settings, repeats=3):
+    """End-to-end device tiles/sec: host->HBM, render, RGBA->host."""
+    from omero_ms_image_region_tpu.ops.render import (
+        render_tile_batch_packed, unpack_rgba,
+    )
+
+    B = raw_batches[0].shape[0]
+
+    def tile_arg(a):
+        return np.tile(a[None], (B,) + (1,) * a.ndim)
+
+    args_suffix = (
+        tile_arg(settings["window_start"]), tile_arg(settings["window_end"]),
+        tile_arg(settings["family"]), tile_arg(settings["coefficient"]),
+        tile_arg(settings["reverse"]), settings["cd_start"],
+        settings["cd_end"], tile_arg(settings["tables"]),
+    )
+    # Warm-up / compile.
+    out = render_tile_batch_packed(raw_batches[0], *args_suffix)
+    np.asarray(out)
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        outs = [render_tile_batch_packed(raw, *args_suffix)
+                for raw in raw_batches]
+        for o in outs:
+            unpack_rgba(np.asarray(o))  # sync + fetch + host RGBA view
+        times.append(time.perf_counter() - t0)
+    total_tiles = sum(r.shape[0] for r in raw_batches)
+    best = min(times)
+    # p50 per-batch dispatch latency.
+    lat = []
+    for raw in raw_batches * 2:
+        t0 = time.perf_counter()
+        np.asarray(render_tile_batch_packed(raw, *args_suffix))
+        lat.append((time.perf_counter() - t0) * 1000.0)
+    return total_tiles / best, statistics.median(lat)
+
+
+def bench_cpu_ref(raw, rdef, max_seconds=20.0):
+    """CPU-reference tiles/sec on identical tiles (>=1 rendered)."""
+    from omero_ms_image_region_tpu.refimpl import render_ref
+
+    n, t0 = 0, time.perf_counter()
+    while True:
+        render_ref(raw[n % raw.shape[0]], rdef)
+        n += 1
+        dt = time.perf_counter() - t0
+        if dt > max_seconds or n >= 32:
+            return n / dt
+
+
+def main():
+    rdef, settings = _flagship()
+    rng = np.random.default_rng(7)
+    B, C, H, W = 8, 4, 1024, 1024
+    n_batches = 4
+    raw_batches = [
+        rng.integers(0, 65535, size=(B, C, H, W)).astype(np.float32)
+        for _ in range(n_batches)
+    ]
+
+    tiles_per_sec, p50_ms = bench_tpu(raw_batches, settings)
+    cpu_tps = bench_cpu_ref(raw_batches[0], rdef)
+
+    print(json.dumps({
+        "metric": "render_tiles_per_sec_1024sq_4ch_u16",
+        "value": round(tiles_per_sec, 2),
+        "unit": "tiles/s",
+        "vs_baseline": round(tiles_per_sec / cpu_tps, 2),
+        "p50_batch_ms": round(p50_ms, 2),
+        "cpu_ref_tiles_per_sec": round(cpu_tps, 2),
+        "batch": B,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

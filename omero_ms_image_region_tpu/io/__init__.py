@@ -1,0 +1,17 @@
+"""Pixel I/O layer: the TPU build's ``ome.io.nio`` equivalent.
+
+Re-provides the PixelBuffer/PixelsService surface the reference consumes
+(``ImageRegionRequestHandler.java:302-309, 444-455, 789-832``;
+``ProjectionService.java:72``) as a Python protocol plus two backends:
+
+  * :class:`~.memory.InMemoryPixelSource` — ndarray-backed (tests, projection
+    re-render; ≙ ``InMemoryPlanarPixelBuffer``).
+  * :class:`~.store.ChunkedPyramidStore` — an on-disk chunked, multi-
+    resolution format (memmap reads, no external deps) standing in for the
+    OMERO binary repository + Bio-Formats pyramid.
+"""
+
+from .pixelsource import PixelSource, TileRead  # noqa: F401
+from .memory import InMemoryPixelSource  # noqa: F401
+from .store import ChunkedPyramidStore, build_pyramid  # noqa: F401
+from .service import PixelsService  # noqa: F401

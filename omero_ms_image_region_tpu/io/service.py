@@ -1,0 +1,49 @@
+"""PixelsService: image id -> PixelSource (≙ ``ome.io.nio.PixelsService``,
+consumed at ``ImageRegionRequestHandler.java:302-309``).
+
+The reference resolves an image through the OMERO DB + binary repository;
+here a data directory holds one chunked pyramid per image
+(``<data_dir>/<image_id>/meta.json``), mirroring the reference's
+``omero.data.dir`` layout role (``config.yaml:19-20``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from .pixelsource import PixelSource
+from .store import ChunkedPyramidStore
+
+
+class PixelsService:
+    """Opens pixel sources from a data directory, with a handle cache."""
+
+    def __init__(self, data_dir: str):
+        self.data_dir = data_dir
+        self._open: Dict[int, ChunkedPyramidStore] = {}
+
+    def image_dir(self, image_id: int) -> str:
+        return os.path.join(self.data_dir, str(image_id))
+
+    def exists(self, image_id: int) -> bool:
+        return os.path.exists(os.path.join(self.image_dir(image_id),
+                                           "meta.json"))
+
+    def get_pixel_source(self, image_id: int) -> PixelSource:
+        """≙ ``PixelsService.getPixelBuffer(pixels, false)``."""
+        src = self._open.get(image_id)
+        if src is None:
+            if not self.exists(image_id):
+                raise FileNotFoundError(
+                    f"no pixel data for image {image_id} under "
+                    f"{self.data_dir}"
+                )
+            src = ChunkedPyramidStore(self.image_dir(image_id))
+            self._open[image_id] = src
+        return src
+
+    def close(self) -> None:
+        for src in self._open.values():
+            src.close()
+        self._open.clear()

@@ -85,25 +85,78 @@ def build_channel_tables(
     return tables
 
 
-def _render_tile_impl(raw, window_start, window_end, family, coefficient,
-                      reverse, cd_start, cd_end, tables):
-    q = quantize(raw, window_start, window_end, family, coefficient,
-                 cd_start, cd_end)  # [C,H,W] in [cd_start, cd_end]
+def composite_packed(q, tables):
+    """Table lookup + additive composite + ABGR pack, TPU-layout-native.
+
+    ``q`` [..., C, H, W] quantized values, ``tables`` [..., C, 256, 3]
+    folded color tables sharing the same leading dims.
+
+    Two deliberate layout decisions (both forced by the TPU memory tiling,
+    where the minor-most dim is padded to 128 lanes):
+
+      * The lookup runs as three flat shared-operand gathers — one per color
+        component — over a ``[prod(lead)*256]`` vector, with each plane's
+        indices offset into its own 256-entry block.  A vmapped per-plane
+        ``table[q]`` becomes a batched gather that XLA expands into a
+        one-hot contraction (OOM), and any big ``[..., 3]`` intermediate
+        pads 3 -> 128 lanes (observed: 42.7x HBM expansion, 20 GB for an
+        8x4x1024x1024 batch).
+
+      * The result is the reference's packed-int form
+        (``Renderer.renderAsPackedInt``, ``ImageRegionRequestHandler.java:559``):
+        u32[..., H, W] with bytes R|G<<8|B<<16|A<<24, i.e. little-endian
+        memory order R,G,B,A — so the host gets RGBA by ``.view(uint8)``
+        with zero copies and the device never materializes a
+        4-wide minor axis.
+    """
+    lead = q.shape[:-2]          # (..., C)
+    n_planes = 1
+    for d in lead:
+        n_planes *= d
+    flat = tables.reshape(n_planes * 256, 3)
+    idx = q + (jnp.arange(n_planes, dtype=q.dtype) * 256).reshape(
+        lead + (1, 1)
+    )
+    out = []
+    for comp in range(3):
+        v = jnp.take(flat[:, comp], idx, axis=0)     # f32 [..., C, H, W]
+        v = jnp.sum(v, axis=-3)                      # composite over C
+        v = jnp.clip(jnp.round(v), 0.0, 255.0).astype(jnp.uint32)
+        out.append(v)
+    r, g, b = out
+    return r | (g << 8) | (b << 16) | jnp.uint32(0xFF000000)
+
+
+def _render_packed_impl(raw, window_start, window_end, family, coefficient,
+                        reverse, cd_start, cd_end, tables):
+    """Shared impl over arbitrary leading dims: raw [..., C, H, W]."""
+    shape = raw.shape
+    H, W = shape[-2:]
+    n_planes = 1
+    for d in shape[:-2]:
+        n_planes *= d
+    q = quantize(
+        raw.reshape(n_planes, H, W),
+        window_start.reshape(n_planes),
+        window_end.reshape(n_planes),
+        family.reshape(n_planes),
+        coefficient.reshape(n_planes),
+        cd_start,
+        cd_end,
+    )
     # Reverse-intensity codomain op (ReverseIntensityContext,
     # ImageRegionRequestHandler.java:717-730): mirror within the codomain.
-    q = jnp.where(reverse[:, None, None] != 0, cd_start + cd_end - q, q)
-    # Per-channel gather of the folded color tables, then additive composite.
-    contrib = jax.vmap(lambda table, qc: table[qc])(tables, q)  # [C,H,W,3]
-    rgb = jnp.clip(jnp.round(jnp.sum(contrib, axis=0)), 0.0, 255.0)
-    rgb = rgb.astype(jnp.uint8)
-    alpha = jnp.full(rgb.shape[:2] + (1,), 255, dtype=jnp.uint8)
-    return jnp.concatenate([rgb, alpha], axis=-1)
+    q = jnp.where(
+        reverse.reshape(n_planes)[:, None, None] != 0,
+        cd_start + cd_end - q, q,
+    ).reshape(shape)
+    return composite_packed(q, tables)
 
 
 @jax.jit
-def render_tile(raw, window_start, window_end, family, coefficient,
-                reverse, cd_start, cd_end, tables):
-    """Render one raw multi-channel tile to RGBA.
+def render_tile_packed(raw, window_start, window_end, family, coefficient,
+                       reverse, cd_start, cd_end, tables):
+    """Render one raw multi-channel tile to packed RGBA ints.
 
     Args:
       raw:          f32[C, H, W] raw channel planes.
@@ -118,17 +171,18 @@ def render_tile(raw, window_start, window_end, family, coefficient,
                     :func:`build_channel_tables`.
 
     Returns:
-      u8[H, W, 4] RGBA tile (alpha fully opaque, as the reference's packed
-      ARGB output renders).
+      u32[H, W] packed pixels, little-endian byte order R,G,B,A with alpha
+      fully opaque (the reference's packed ARGB analogue).
     """
-    return _render_tile_impl(raw, window_start, window_end, family,
-                             coefficient, reverse, cd_start, cd_end, tables)
+    return _render_packed_impl(raw, window_start, window_end, family,
+                               coefficient, reverse, cd_start, cd_end,
+                               tables)
 
 
 @jax.jit
-def render_tile_batch(raw, window_start, window_end, family, coefficient,
-                      reverse, cd_start, cd_end, tables):
-    """Batched render: per-tile args gain a leading batch dim B.
+def render_tile_batch_packed(raw, window_start, window_end, family,
+                             coefficient, reverse, cd_start, cd_end, tables):
+    """Batched render to packed ints: per-tile args gain a leading dim B.
 
     This is the micro-batched hot path (SURVEY.md section 7 step 5): the
     worker coalesces concurrent tile requests of one bucket shape into a
@@ -137,15 +191,38 @@ def render_tile_batch(raw, window_start, window_end, family, coefficient,
     Args:
       raw:    f32[B, C, H, W]
       cd_start/cd_end: scalars, shared across the batch.
-      others: as :func:`render_tile` with a leading B axis.
+      others: as :func:`render_tile_packed` with a leading B axis.
     Returns:
-      u8[B, H, W, 4]
+      u32[B, H, W]
     """
-    return jax.vmap(
-        lambda r, ws, we, f, k, rev, t: _render_tile_impl(
-            r, ws, we, f, k, rev, cd_start, cd_end, t
-        )
-    )(raw, window_start, window_end, family, coefficient, reverse, tables)
+    return _render_packed_impl(raw, window_start, window_end, family,
+                               coefficient, reverse, cd_start, cd_end,
+                               tables)
+
+
+def unpack_rgba(packed: np.ndarray) -> np.ndarray:
+    """u32[..., H, W] packed pixels -> u8[..., H, W, 4] RGBA, zero-copy."""
+    packed = np.ascontiguousarray(np.asarray(packed))
+    le = packed.astype("<u4", copy=False)
+    return le.view(np.uint8).reshape(packed.shape + (4,))
+
+
+def render_tile(raw, window_start, window_end, family, coefficient,
+                reverse, cd_start, cd_end, tables):
+    """Host-convenience single-tile render -> u8[H, W, 4] RGBA numpy."""
+    return unpack_rgba(render_tile_packed(
+        raw, window_start, window_end, family, coefficient, reverse,
+        cd_start, cd_end, tables,
+    ))
+
+
+def render_tile_batch(raw, window_start, window_end, family, coefficient,
+                      reverse, cd_start, cd_end, tables):
+    """Host-convenience batched render -> u8[B, H, W, 4] RGBA numpy."""
+    return unpack_rgba(render_tile_batch_packed(
+        raw, window_start, window_end, family, coefficient, reverse,
+        cd_start, cd_end, tables,
+    ))
 
 
 def pack_settings(rdef: RenderingDef, lut_provider=None):

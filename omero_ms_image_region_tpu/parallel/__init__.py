@@ -1,0 +1,15 @@
+"""Device-mesh parallelism for the render pipeline.
+
+The reference scales out with Vert.x worker verticles + a Hazelcast-clustered
+event bus (SURVEY.md section 2c).  The TPU-native analogue is a
+``jax.sharding.Mesh``: tile batches are data-parallel over the ``data`` axis
+and the per-channel quantize/LUT/composite pipeline is tensor-parallel over
+the ``chan`` axis, with the additive composite expressed as a ``psum``
+collective riding ICI.
+"""
+
+from .mesh import (  # noqa: F401
+    make_mesh,
+    render_step_sharded,
+    shard_batch,
+)

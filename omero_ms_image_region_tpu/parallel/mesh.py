@@ -1,0 +1,158 @@
+"""Mesh-sharded render step (data-parallel tiles x tensor-parallel channels).
+
+The reference's concurrency model is request-level data parallelism over
+worker verticles plus cluster scale-out over a Hazelcast event bus
+(``ImageRegionMicroserviceVerticle.java:148-165``, SURVEY.md section 2c).
+Here that becomes a 2-D ``jax.sharding.Mesh``:
+
+  * ``data`` axis — concurrent tile requests (the micro-batch) are sharded
+    across devices: pure DP, no communication.
+  * ``chan`` axis — the per-channel pipeline (window/family quantize + LUT
+    gather + alpha-weighted contribution) is sharded across channels: each
+    device renders its local channel slice and the additive RGB composite
+    (``Renderer.renderAsPackedInt``'s sum over active channels) becomes a
+    single ``jax.lax.psum`` over the ``chan`` axis — the collective rides
+    ICI, replacing the reference's in-JVM accumulation loop.
+
+Everything is expressed with ``shard_map`` so the collective is explicit and
+XLA never has to guess the partitioning of the composite.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.quantum import quantize
+
+
+def make_mesh(n_devices: int | None = None, chan_parallel: int = 1,
+              devices=None) -> Mesh:
+    """Build a ``(data, chan)`` mesh over the available devices.
+
+    ``chan_parallel`` devices cooperate on one tile's channels; the rest of
+    the devices replicate that group over the batch.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = np.asarray(devices[:n_devices])
+    if n_devices % chan_parallel != 0:
+        raise ValueError(
+            f"n_devices={n_devices} not divisible by "
+            f"chan_parallel={chan_parallel}"
+        )
+    grid = devices.reshape(n_devices // chan_parallel, chan_parallel)
+    return Mesh(grid, ("data", "chan"))
+
+
+def _local_render(raw, window_start, window_end, family, coefficient,
+                  reverse, cd_start, cd_end, tables):
+    """Per-device block: quantize + gather local channels, partial composite.
+
+    Block shapes (local to one device): raw f32[Bl, Cl, H, W], params [Cl],
+    tables f32[Cl, 256, 3].  Returns the *partial* per-component RGB sum
+    f32[3, Bl, H, W] (component axis leading — a trailing 3 would pad to
+    128 lanes on TPU); the caller psums it over the ``chan`` axis.
+    """
+    q = quantize(
+        raw.reshape((-1,) + raw.shape[-2:]),
+        jnp.tile(window_start, raw.shape[0]),
+        jnp.tile(window_end, raw.shape[0]),
+        jnp.tile(family, raw.shape[0]),
+        jnp.tile(coefficient, raw.shape[0]),
+        cd_start,
+        cd_end,
+    ).reshape(raw.shape)  # i32[Bl, Cl, H, W]
+    q = jnp.where(
+        reverse[None, :, None, None] != 0, cd_start + cd_end - q, q
+    )
+    # Per-component flat shared-operand gather with per-channel block
+    # offsets (see ops.render.composite_packed for why not table[q]).
+    Cl = tables.shape[0]
+    flat = tables.reshape(Cl * 256, 3)
+    idx = q + (jnp.arange(Cl, dtype=q.dtype) * 256)[None, :, None, None]
+    comps = [
+        jnp.sum(jnp.take(flat[:, comp], idx, axis=0), axis=1)  # [Bl, H, W]
+        for comp in range(3)
+    ]
+    return jnp.stack(comps, axis=0)                # [3, Bl, H, W]
+
+
+def render_step_sharded(mesh: Mesh):
+    """Build the jitted mesh-sharded batched render step.
+
+    Returns a function ``step(raw, window_start, window_end, family,
+    coefficient, reverse, cd_start, cd_end, tables) -> u32[B, H, W]``
+    (packed little-endian R,G,B,A as in ``ops.render.render_tile_packed``)
+    with ``raw`` f32[B, C, H, W] sharded ``P('data', 'chan')`` and
+    per-channel arrays sharded ``P('chan')``; output sharded ``P('data')``.
+    """
+
+    def step(raw, window_start, window_end, family, coefficient, reverse,
+             cd_start, cd_end, tables):
+        partial_rgb = _local_render(
+            raw, window_start, window_end, family, coefficient, reverse,
+            cd_start, cd_end, tables,
+        )                                          # f32 [3, Bl, H, W]
+        # The additive composite across channel shards: ICI collective.
+        rgb = jax.lax.psum(partial_rgb, axis_name="chan")
+        rgb = jnp.clip(jnp.round(rgb), 0.0, 255.0).astype(jnp.uint32)
+        r, g, b = rgb[0], rgb[1], rgb[2]
+        return r | (g << 8) | (b << 16) | jnp.uint32(0xFF000000)
+
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            P("data", "chan"),   # raw [B, C, H, W]
+            P("chan"),           # window_start [C]
+            P("chan"),           # window_end [C]
+            P("chan"),           # family [C]
+            P("chan"),           # coefficient [C]
+            P("chan"),           # reverse [C]
+            P(),                 # cd_start scalar
+            P(),                 # cd_end scalar
+            P("chan"),           # tables [C, 256, 3]
+        ),
+        out_specs=P("data"),
+    )
+    return jax.jit(sharded)
+
+
+def shard_batch(mesh: Mesh, raw, settings):
+    """Device-put a host batch + packed settings onto the mesh layout.
+
+    ``settings`` is the dict from ``ops.render.pack_settings`` (with a
+    possible channel pad so C divides the chan axis).
+    """
+    put = partial(jax.device_put)
+    args = (
+        put(raw, NamedSharding(mesh, P("data", "chan"))),
+        put(settings["window_start"], NamedSharding(mesh, P("chan"))),
+        put(settings["window_end"], NamedSharding(mesh, P("chan"))),
+        put(settings["family"], NamedSharding(mesh, P("chan"))),
+        put(settings["coefficient"], NamedSharding(mesh, P("chan"))),
+        put(settings["reverse"], NamedSharding(mesh, P("chan"))),
+        jnp.int32(settings["cd_start"]),
+        jnp.int32(settings["cd_end"]),
+        put(settings["tables"], NamedSharding(mesh, P("chan"))),
+    )
+    return args

@@ -1,0 +1,93 @@
+"""Pixel store tests: chunked pyramid reads vs the source array.
+
+Covers the consumed PixelBuffer surface (SURVEY.md section 2b): region reads
+at every level, edge/unaligned regions, stack reads, level enumeration.
+"""
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.io import (
+    ChunkedPyramidStore, InMemoryPixelSource, PixelsService, build_pyramid,
+)
+from omero_ms_image_region_tpu.server.region import RegionDef
+
+
+@pytest.fixture()
+def planes():
+    rng = np.random.default_rng(1)
+    # Deliberately non-chunk-aligned: 300x500, 2 channels, 3 z.
+    return rng.integers(0, 65535, size=(2, 3, 300, 500), dtype=np.uint16)
+
+
+def test_pyramid_roundtrip_full_plane(tmp_path, planes):
+    store = build_pyramid(planes, str(tmp_path / "img"), chunk=(128, 128),
+                          n_levels=1)
+    got = store.get_region(z=1, c=1, t=0, region=RegionDef(0, 0, 500, 300))
+    np.testing.assert_array_equal(got, planes[1, 1])
+
+
+@pytest.mark.parametrize("region", [
+    (0, 0, 128, 128),        # aligned chunk
+    (100, 50, 130, 64),      # straddles chunks
+    (400, 200, 100, 100),    # touches right/bottom edge
+    (499, 299, 1, 1),        # last pixel
+    (7, 3, 1, 5),            # sliver
+])
+def test_pyramid_region_reads(tmp_path, planes, region):
+    store = build_pyramid(planes, str(tmp_path / "img"), chunk=(128, 128),
+                          n_levels=1)
+    x, y, w, h = region
+    got = store.get_region(z=0, c=0, t=0, region=RegionDef(x, y, w, h))
+    np.testing.assert_array_equal(got, planes[0, 0, y:y + h, x:x + w])
+
+
+def test_pyramid_levels_downsample(tmp_path, planes):
+    store = build_pyramid(planes, str(tmp_path / "img"), chunk=(64, 64),
+                          n_levels=3)
+    assert store.resolution_levels() == 3
+    descs = store.resolution_descriptions()
+    assert descs[0] == (500, 300)
+    assert descs[1] == (250, 150)
+    assert descs[2] == (125, 75)
+    # Level 1 equals the mean-pool of level 0.
+    lv1 = store.get_region(0, 0, 0, RegionDef(0, 0, 250, 150), level=1)
+    src = planes[0, 0, :300, :500].astype(np.float64)
+    want = np.round(
+        src.reshape(150, 2, 250, 2).mean(axis=(1, 3))
+    ).astype(np.uint16)
+    np.testing.assert_array_equal(lv1, want)
+
+
+def test_pyramid_out_of_bounds_rejected(tmp_path, planes):
+    store = build_pyramid(planes, str(tmp_path / "img"), n_levels=1)
+    with pytest.raises(ValueError):
+        store.get_region(0, 0, 0, RegionDef(400, 0, 200, 10))
+
+
+def test_get_stack(tmp_path, planes):
+    store = build_pyramid(planes, str(tmp_path / "img"), chunk=(128, 128),
+                          n_levels=1)
+    np.testing.assert_array_equal(store.get_stack(c=1, t=0), planes[1])
+
+
+def test_pixels_service_registry(tmp_path, planes):
+    build_pyramid(planes, str(tmp_path / "7"), n_levels=1)
+    svc = PixelsService(str(tmp_path))
+    assert svc.exists(7)
+    assert not svc.exists(8)
+    src = svc.get_pixel_source(7)
+    assert src is svc.get_pixel_source(7)  # handle cache
+    with pytest.raises(FileNotFoundError):
+        svc.get_pixel_source(8)
+    svc.close()
+
+
+def test_in_memory_source_matches_store(tmp_path, planes):
+    mem = InMemoryPixelSource(planes, pyramid_levels=2)
+    store = build_pyramid(planes, str(tmp_path / "img"), n_levels=2)
+    region = RegionDef(33, 41, 77, 55)
+    np.testing.assert_array_equal(
+        mem.get_region(2, 1, 0, region), store.get_region(2, 1, 0, region)
+    )
+    assert mem.resolution_descriptions() == store.resolution_descriptions()

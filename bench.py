@@ -22,42 +22,14 @@ import time
 import numpy as np
 
 
-def _flagship(C=4, H=1024, W=1024):
-    from omero_ms_image_region_tpu.models.pixels import Pixels
-    from omero_ms_image_region_tpu.models.rendering import (
-        RenderingModel, default_rendering_def,
-    )
-    from omero_ms_image_region_tpu.ops.render import pack_settings
-
-    pixels = Pixels(image_id=1, size_x=W * 8, size_y=H * 8, size_z=1,
-                    size_c=C, size_t=1, pixels_type="uint16")
-    rdef = default_rendering_def(pixels)
-    rdef.model = RenderingModel.RGB
-    colors = [(255, 0, 0), (0, 255, 0), (0, 0, 255), (255, 255, 0)]
-    for i, cb in enumerate(rdef.channel_bindings):
-        cb.active = True
-        cb.red, cb.green, cb.blue = colors[i % 4]
-        cb.input_start, cb.input_end = 100.0, 40000.0
-    return rdef, pack_settings(rdef)
-
-
 def bench_tpu(raw_batches, settings, repeats=3):
     """End-to-end device tiles/sec: host->HBM, render, RGBA->host."""
+    from omero_ms_image_region_tpu.flagship import batched_args
     from omero_ms_image_region_tpu.ops.render import (
         render_tile_batch_packed, unpack_rgba,
     )
 
-    B = raw_batches[0].shape[0]
-
-    def tile_arg(a):
-        return np.tile(a[None], (B,) + (1,) * a.ndim)
-
-    args_suffix = (
-        tile_arg(settings["window_start"]), tile_arg(settings["window_end"]),
-        tile_arg(settings["family"]), tile_arg(settings["coefficient"]),
-        tile_arg(settings["reverse"]), settings["cd_start"],
-        settings["cd_end"], tile_arg(settings["tables"]),
-    )
+    args_suffix = batched_args(settings, raw_batches[0])[1:]
     # Warm-up / compile.
     out = render_tile_batch_packed(raw_batches[0], *args_suffix)
     np.asarray(out)
@@ -95,7 +67,9 @@ def bench_cpu_ref(raw, rdef, max_seconds=20.0):
 
 
 def main():
-    rdef, settings = _flagship()
+    from omero_ms_image_region_tpu.flagship import flagship_settings
+
+    rdef, settings = flagship_settings()
     rng = np.random.default_rng(7)
     B, C, H, W = 8, 4, 1024, 1024
     n_batches = 4

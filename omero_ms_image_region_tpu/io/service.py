@@ -10,18 +10,26 @@ here a data directory holds one chunked pyramid per image
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional
+import threading
+from collections import OrderedDict
+from typing import Optional
 
 from .pixelsource import PixelSource
 from .store import ChunkedPyramidStore
 
+DEFAULT_MAX_OPEN = 128
+
 
 class PixelsService:
-    """Opens pixel sources from a data directory, with a handle cache."""
+    """Opens pixel sources from a data directory, with a bounded LRU handle
+    cache (each open store holds live memmaps, so the bound caps fds and
+    address space on long-running servers)."""
 
-    def __init__(self, data_dir: str):
+    def __init__(self, data_dir: str, max_open: int = DEFAULT_MAX_OPEN):
         self.data_dir = data_dir
-        self._open: Dict[int, ChunkedPyramidStore] = {}
+        self.max_open = max_open
+        self._lock = threading.Lock()
+        self._open: "OrderedDict[int, ChunkedPyramidStore]" = OrderedDict()
 
     def image_dir(self, image_id: int) -> str:
         return os.path.join(self.data_dir, str(image_id))
@@ -32,18 +40,26 @@ class PixelsService:
 
     def get_pixel_source(self, image_id: int) -> PixelSource:
         """≙ ``PixelsService.getPixelBuffer(pixels, false)``."""
-        src = self._open.get(image_id)
-        if src is None:
-            if not self.exists(image_id):
-                raise FileNotFoundError(
-                    f"no pixel data for image {image_id} under "
-                    f"{self.data_dir}"
-                )
-            src = ChunkedPyramidStore(self.image_dir(image_id))
+        with self._lock:
+            src = self._open.get(image_id)
+            if src is not None:
+                self._open.move_to_end(image_id)
+                return src
+        if not self.exists(image_id):
+            raise FileNotFoundError(
+                f"no pixel data for image {image_id} under "
+                f"{self.data_dir}"
+            )
+        src = ChunkedPyramidStore(self.image_dir(image_id))
+        with self._lock:
             self._open[image_id] = src
+            while len(self._open) > self.max_open:
+                _, evicted = self._open.popitem(last=False)
+                evicted.close()
         return src
 
     def close(self) -> None:
-        for src in self._open.values():
-            src.close()
-        self._open.clear()
+        with self._lock:
+            for src in self._open.values():
+                src.close()
+            self._open.clear()

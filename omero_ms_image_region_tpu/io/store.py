@@ -97,8 +97,8 @@ class ChunkedPyramidStore:
         mm = self._map_level(level)
         out = np.empty((region.height, region.width), dtype=self._dtype)
         ch, cw = self.chunk_h, self.chunk_w
-        for gy in range(y0 // ch, -(-y1 // ch) if y1 else 0):
-            for gx in range(x0 // cw, -(-x1 // cw) if x1 else 0):
+        for gy in range(y0 // ch, -(-y1 // ch)):
+            for gx in range(x0 // cw, -(-x1 // cw)):
                 cy0, cx0 = gy * ch, gx * cw
                 ix0, ix1 = max(x0, cx0), min(x1, cx0 + cw)
                 iy0, iy1 = max(y0, cy0), min(y1, cy0 + ch)

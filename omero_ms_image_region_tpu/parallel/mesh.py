@@ -20,7 +20,7 @@ XLA never has to guess the partitioning of the composite.
 
 from __future__ import annotations
 
-from functools import partial
+
 
 import jax
 import jax.numpy as jnp
@@ -143,7 +143,7 @@ def shard_batch(mesh: Mesh, raw, settings):
     ``settings`` is the dict from ``ops.render.pack_settings`` (with a
     possible channel pad so C divides the chan axis).
     """
-    put = partial(jax.device_put)
+    put = jax.device_put
     args = (
         put(raw, NamedSharding(mesh, P("data", "chan"))),
         put(settings["window_start"], NamedSharding(mesh, P("chan"))),

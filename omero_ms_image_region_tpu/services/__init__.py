@@ -1,0 +1,11 @@
+"""Host-side services: caches, metadata/ACL, session stores.
+
+The analogue of the reference's L0 infrastructure (omero-ms-core Redis cache
+verticle, OMERO backbone metadata/ACL event-bus services, OMERO.web session
+stores; SURVEY.md §2b) — re-expressed as asyncio-friendly Python services
+with pluggable backends.
+"""
+
+from .cache import CacheConfig, CacheStack, MemoryLRUCache, make_cache
+from .metadata import CanReadMemo, LocalMetadataService, MetadataService
+from .sessions import SessionStore, StaticSessionStore

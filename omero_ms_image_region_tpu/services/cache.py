@@ -1,0 +1,161 @@
+"""Byte caches for rendered regions, masks, and pixels metadata.
+
+Replaces the reference's ``RedisCacheVerticle`` get/set events
+(``ImageRegionRequestHandler.java:214-249, 469-477``; ``ShapeMaskVerticle
+.java:82-90, 140-148``) and the per-cache enable flags
+(``config.yaml:53-60``).
+
+Tiering: a process-local LRU in front of an optional shared Redis, the same
+shape as the reference's Hazelcast-memo-in-front-of-Redis layering.  The
+local tier prefers the native C++ cache (``native/``) when its shared
+library is built, else a pure-Python LRU.  Redis is gated on the ``redis``
+package being importable — absent in this image, so deployments without it
+still get the local tier.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol
+
+
+class CacheTier(Protocol):
+    async def get(self, key: str) -> Optional[bytes]: ...
+    async def set(self, key: str, value: bytes) -> None: ...
+
+
+class MemoryLRUCache:
+    """Thread-safe size-bounded LRU over bytes values.
+
+    The async face is non-blocking (pure in-memory ops), so ``get``/``set``
+    complete synchronously inside the event loop.
+    """
+
+    def __init__(self, max_bytes: int = 256 * 1024 * 1024):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._data: "OrderedDict[str, bytes]" = OrderedDict()
+        self._size = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get_sync(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            value = self._data.get(key)
+            if value is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return value
+
+    def set_sync(self, key: str, value: bytes) -> None:
+        with self._lock:
+            old = self._data.pop(key, None)
+            if old is not None:
+                self._size -= len(old)
+            self._data[key] = value
+            self._size += len(value)
+            while self._size > self.max_bytes and self._data:
+                _, evicted = self._data.popitem(last=False)
+                self._size -= len(evicted)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        return self.get_sync(key)
+
+    async def set(self, key: str, value: bytes) -> None:
+        self.set_sync(key, value)
+
+
+def _native_cache(max_bytes: int):
+    """Native C++ LRU tier if the shared library is available, else None."""
+    try:
+        from ..native import NativeLRUCache  # noqa: PLC0415
+        return NativeLRUCache(max_bytes)
+    except Exception:
+        return None
+
+
+class RedisCache:
+    """Shared Redis byte cache (≙ RedisCacheVerticle). Gated: constructing
+    raises ImportError when the ``redis`` package is unavailable."""
+
+    def __init__(self, uri: str):
+        import redis.asyncio as aioredis  # noqa: PLC0415
+        self._client = aioredis.from_url(uri)
+
+    async def get(self, key: str) -> Optional[bytes]:
+        return await self._client.get(key)
+
+    async def set(self, key: str, value: bytes) -> None:
+        await self._client.set(key, value)
+
+
+class CacheStack:
+    """Read-through tier stack: first hit wins and back-fills upper tiers."""
+
+    def __init__(self, tiers: List[CacheTier], enabled: bool = True):
+        self.tiers = tiers
+        self.enabled = enabled
+
+    async def get(self, key: str) -> Optional[bytes]:
+        if not self.enabled:
+            return None
+        for i, tier in enumerate(self.tiers):
+            value = await tier.get(key)
+            if value is not None:
+                for upper in self.tiers[:i]:
+                    await upper.set(key, value)
+                return value
+        return None
+
+    async def set(self, key: str, value: bytes) -> None:
+        if not self.enabled:
+            return
+        await asyncio.gather(*(t.set(key, value) for t in self.tiers))
+
+
+@dataclass
+class CacheConfig:
+    """Per-cache enable flags + sizing (≙ ``config.yaml:47-60``)."""
+
+    redis_uri: Optional[str] = None
+    local_max_bytes: int = 256 * 1024 * 1024
+    # Enable flags, named after the reference's config keys.
+    image_region: bool = True          # cache.image-region.enabled
+    pixels_metadata: bool = True       # cache.pixels-metadata.enabled
+    shape_mask: bool = True            # cache.shape-mask.enabled
+
+
+def make_cache(config: CacheConfig, enabled: bool) -> CacheStack:
+    """Build one named cache's tier stack from config."""
+    tiers: List[CacheTier] = []
+    native = _native_cache(config.local_max_bytes)
+    tiers.append(native if native is not None
+                 else MemoryLRUCache(config.local_max_bytes))
+    if config.redis_uri:
+        try:
+            tiers.append(RedisCache(config.redis_uri))
+        except ImportError:
+            pass
+    return CacheStack(tiers, enabled=enabled)
+
+
+@dataclass
+class Caches:
+    """The three named caches the reference runs (``config.yaml:53-60``)."""
+
+    image_region: CacheStack
+    pixels_metadata: CacheStack
+    shape_mask: CacheStack
+
+    @classmethod
+    def from_config(cls, config: CacheConfig) -> "Caches":
+        return cls(
+            image_region=make_cache(config, config.image_region),
+            pixels_metadata=make_cache(config, config.pixels_metadata),
+            shape_mask=make_cache(config, config.shape_mask),
+        )

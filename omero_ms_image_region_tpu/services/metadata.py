@@ -1,0 +1,167 @@
+"""Metadata + ACL service (≙ the OMERO backbone event-bus services).
+
+The reference fetches ``Pixels`` metadata, ``Mask`` objects, and read-ACL
+decisions from the OMERO server JVM over the clustered event bus
+(addresses ``omero.get_pixels_description`` / ``omero.get_object`` /
+``omero.can_read``; ``ImageRegionRequestHandler.java:80-84, 316-427``,
+``ShapeMaskRequestHandler.java:223-277``).  Here the same three calls are an
+async protocol with a local filesystem-backed implementation; a remote
+(gRPC/DB) implementation can slot in without touching the handlers.
+
+ACL model: each image/mask directory may carry an ``acl.json`` —
+``{"public": true}`` or ``{"sessions": ["key", ...]}``.  Absent file =
+public (the standalone dev posture).  ``CanReadMemo`` mirrors the
+Hazelcast distributed ``canRead`` memo map keyed by
+``(session, type, id)`` (``ImageRegionVerticle.java:59-60, 107-111``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional, Protocol, Tuple
+
+from ..models.mask import Mask
+from ..models.pixels import Pixels
+
+
+class MetadataService(Protocol):
+    async def get_pixels_description(self, image_id: int,
+                                     session_key: Optional[str]
+                                     ) -> Optional[Pixels]: ...
+
+    async def can_read(self, object_type: str, object_id: int,
+                       session_key: Optional[str]) -> bool: ...
+
+    async def get_mask(self, shape_id: int,
+                       session_key: Optional[str]) -> Optional[Mask]: ...
+
+
+def _check_acl(path: str, session_key: Optional[str]) -> bool:
+    acl_file = os.path.join(path, "acl.json")
+    if not os.path.exists(acl_file):
+        return True
+    with open(acl_file) as f:
+        acl = json.load(f)
+    if acl.get("public"):
+        return True
+    return session_key is not None and session_key in acl.get("sessions", [])
+
+
+class LocalMetadataService:
+    """Filesystem-backed metadata: ``<data_dir>/<image_id>/meta.json`` for
+    pixels, ``<data_dir>/masks/<shape_id>.json`` (+ ``.bin`` packed bits)
+    for masks."""
+
+    def __init__(self, data_dir: str):
+        self.data_dir = data_dir
+
+    def _image_dir(self, image_id: int) -> str:
+        return os.path.join(self.data_dir, str(image_id))
+
+    def _mask_base(self, shape_id: int) -> str:
+        return os.path.join(self.data_dir, "masks", str(shape_id))
+
+    async def get_pixels_description(self, image_id: int,
+                                     session_key: Optional[str]
+                                     ) -> Optional[Pixels]:
+        meta_path = os.path.join(self._image_dir(image_id), "meta.json")
+        if not os.path.exists(meta_path):
+            return None
+        with open(meta_path) as f:
+            m = json.load(f)
+        return Pixels(
+            image_id=image_id,
+            pixels_type=m.get("pixels_type", m["dtype"]),
+            size_x=m["levels"][0]["size_x"],
+            size_y=m["levels"][0]["size_y"],
+            size_z=m["size_z"],
+            size_c=m["size_c"],
+            size_t=m["size_t"],
+        )
+
+    async def can_read(self, object_type: str, object_id: int,
+                       session_key: Optional[str]) -> bool:
+        if object_type == "Image":
+            path = self._image_dir(object_id)
+        else:
+            path = self._mask_base(object_id)
+            # Mask ACLs live next to the mask json as <id>.acl.json.
+            acl = path + ".acl.json"
+            if os.path.exists(acl):
+                with open(acl) as f:
+                    a = json.load(f)
+                if a.get("public"):
+                    return True
+                return (session_key is not None
+                        and session_key in a.get("sessions", []))
+            return os.path.exists(path + ".json")
+        if not os.path.exists(path):
+            return False
+        return _check_acl(path, session_key)
+
+    async def get_mask(self, shape_id: int,
+                       session_key: Optional[str]) -> Optional[Mask]:
+        base = self._mask_base(shape_id)
+        if not os.path.exists(base + ".json"):
+            return None
+        with open(base + ".json") as f:
+            m = json.load(f)
+        with open(base + ".bin", "rb") as f:
+            bits = f.read()
+        fill = m.get("fill_color")
+        return Mask(
+            shape_id=shape_id,
+            width=m["width"],
+            height=m["height"],
+            bytes_=bits,
+            fill_color=None if fill is None else tuple(fill),
+        )
+
+
+def write_mask(data_dir: str, mask: Mask) -> None:
+    """Persist a mask in the layout ``LocalMetadataService`` reads."""
+    os.makedirs(os.path.join(data_dir, "masks"), exist_ok=True)
+    base = os.path.join(data_dir, "masks", str(mask.shape_id))
+    with open(base + ".json", "w") as f:
+        json.dump({
+            "width": mask.width,
+            "height": mask.height,
+            "fill_color": (None if mask.fill_color is None
+                           else list(mask.fill_color)),
+        }, f)
+    with open(base + ".bin", "wb") as f:
+        f.write(mask.bytes_)
+
+
+class CanReadMemo:
+    """TTL memo of ACL decisions keyed by (session, type, id)
+    (≙ the Hazelcast ``canRead`` map the workers share)."""
+
+    def __init__(self, ttl_seconds: float = 60.0):
+        self.ttl = ttl_seconds
+        self._lock = threading.Lock()
+        self._memo: Dict[Tuple[Optional[str], str, int],
+                         Tuple[bool, float]] = {}
+
+    def get(self, session_key: Optional[str], object_type: str,
+            object_id: int) -> Optional[bool]:
+        key = (session_key, object_type, object_id)
+        with self._lock:
+            hit = self._memo.get(key)
+            if hit is None:
+                return None
+            value, expires = hit
+            if time.monotonic() > expires:
+                del self._memo[key]
+                return None
+            return value
+
+    def put(self, session_key: Optional[str], object_type: str,
+            object_id: int, value: bool) -> None:
+        with self._lock:
+            self._memo[(session_key, object_type, object_id)] = (
+                value, time.monotonic() + self.ttl,
+            )

@@ -1,0 +1,100 @@
+"""OMERO.web session middleware (≙ omero-ms-core session stores).
+
+The reference decodes the OMERO.web Django session cookie and resolves it
+to an ``omero.session_key`` request attribute through a Redis or Postgres
+session store (``ImageRegionMicroserviceVerticle.java:194-212``,
+``config.yaml:29-42``).  Requests without a resolvable session still flow —
+ACL checks decide what they may see.
+
+Here: a ``SessionStore`` protocol with
+
+* :class:`StaticSessionStore` — fixed mapping / accept-all, the standalone
+  and test posture;
+* :class:`DjangoRedisSessionStore` — reads ``:1:django.contrib.sessions.
+  cache<sid>`` entries the way OMERO.web writes them (gated on the
+  ``redis`` package, absent in this image).
+
+The resolved key travels with the request ctx exactly like the reference's
+``omero.session_key`` attribute.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import pickle  # noqa: S403 — Django session payloads; trusted store only.
+from typing import Mapping, Optional, Protocol
+
+DEFAULT_COOKIE = "sessionid"  # config.yaml:29-30 session-cookie-name
+
+
+class SessionStore(Protocol):
+    async def get_session_key(self, session_id: str) -> Optional[str]: ...
+
+
+class StaticSessionStore:
+    """Fixed cookie->session-key mapping; ``accept_all`` passes the cookie
+    value through as the session key (dev/standalone)."""
+
+    def __init__(self, mapping: Optional[Mapping[str, str]] = None,
+                 accept_all: bool = False):
+        self.mapping = dict(mapping or {})
+        self.accept_all = accept_all
+
+    async def get_session_key(self, session_id: str) -> Optional[str]:
+        if session_id in self.mapping:
+            return self.mapping[session_id]
+        return session_id if self.accept_all else None
+
+
+def decode_django_session(payload: bytes) -> Optional[str]:
+    """Extract ``omero.session_key`` ('connector' session key) from a
+    Django session payload (base64(hmac:pickle) or JSON serializer)."""
+    try:
+        raw = base64.b64decode(payload)
+        _, _, pickled = raw.partition(b":")
+        data = pickle.loads(pickled)  # noqa: S301
+    except Exception:
+        try:
+            data = json.loads(payload)
+        except Exception:
+            return None
+    if not isinstance(data, dict):
+        return None
+    connector = data.get("connector")
+    if isinstance(connector, dict):
+        key = connector.get("omero_session_key")
+        if key:
+            return str(key)
+    key = data.get("omero_session_key")
+    return str(key) if key else None
+
+
+class DjangoRedisSessionStore:
+    """OMERO.web sessions out of Redis (≙ OmeroWebRedisSessionStore).
+    Construction raises ImportError without the ``redis`` package."""
+
+    def __init__(self, uri: str,
+                 key_format: str = ":1:django.contrib.sessions.cache{0}"):
+        import redis.asyncio as aioredis  # noqa: PLC0415
+        self._client = aioredis.from_url(uri)
+        self.key_format = key_format
+
+    async def get_session_key(self, session_id: str) -> Optional[str]:
+        payload = await self._client.get(self.key_format.format(session_id))
+        if payload is None:
+            return None
+        return decode_django_session(payload)
+
+
+async def resolve_session_key(store: Optional[SessionStore],
+                              cookies: Mapping[str, str],
+                              cookie_name: str = DEFAULT_COOKIE
+                              ) -> Optional[str]:
+    """Cookie jar -> omero session key (None when unresolvable)."""
+    if store is None:
+        return None
+    session_id = cookies.get(cookie_name)
+    if not session_id:
+        return None
+    return await store.get_session_key(session_id)

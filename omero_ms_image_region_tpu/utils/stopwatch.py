@@ -1,0 +1,89 @@
+"""Stage timing spans (≙ perf4j ``Slf4JStopWatch``).
+
+The reference wraps every pipeline stage in a named stopwatch whose
+start/elapsed pairs double as latency metrics in the logs (SURVEY.md §5:
+``ImageRegionVerticle.java:148``, ``ImageRegionRequestHandler.java:189,303,
+343,502,522``).  The span names are kept verbatim so dashboards built on the
+Java service's logs keep working against this one.
+
+Spans log at debug level and feed an in-process aggregator that the OPTIONS
+endpoint / tests can read back (count, total, p50-ish via ring buffer).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict
+
+log = logging.getLogger("omero_ms_image_region_tpu.perf")
+
+_RING = 256
+
+
+class SpanStats:
+    __slots__ = ("count", "total_ms", "recent")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self.recent = deque(maxlen=_RING)
+
+    def add(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        self.recent.append(ms)
+
+    def p50(self) -> float:
+        if not self.recent:
+            return 0.0
+        return sorted(self.recent)[len(self.recent) // 2]
+
+    def as_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "total_ms": round(self.total_ms, 3),
+            "mean_ms": round(self.total_ms / self.count, 3)
+            if self.count else 0.0,
+            "p50_ms": round(self.p50(), 3),
+        }
+
+
+class StopWatchRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: Dict[str, SpanStats] = {}
+
+    def record(self, name: str, ms: float) -> None:
+        with self._lock:
+            stats = self._spans.get(name)
+            if stats is None:
+                stats = self._spans[name] = SpanStats()
+            stats.add(ms)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {name: s.as_dict() for name, s in self._spans.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+REGISTRY = StopWatchRegistry()
+
+
+@contextmanager
+def stopwatch(name: str, registry: StopWatchRegistry = REGISTRY):
+    """Time a stage under a reference span name, e.g.
+    ``Renderer.renderAsPackedInt`` or ``ProjectionService.projectStack``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        ms = (time.perf_counter() - t0) * 1000.0
+        registry.record(name, ms)
+        log.debug("time[%s] = %.3f ms", name, ms)

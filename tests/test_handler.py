@@ -1,0 +1,186 @@
+"""End-to-end handler flows over a synthetic on-disk pyramid: cache-first
+ordering, ACL gating, projection, flip, mask caching rules."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu import codecs
+from omero_ms_image_region_tpu.io.service import PixelsService
+from omero_ms_image_region_tpu.io.store import build_pyramid
+from omero_ms_image_region_tpu.models.mask import Mask
+from omero_ms_image_region_tpu.ops.lut import LutProvider
+from omero_ms_image_region_tpu.server.ctx import (
+    BadRequestError, ImageRegionCtx, ShapeMaskCtx,
+)
+from omero_ms_image_region_tpu.server.handler import (
+    ImageRegionHandler, ImageRegionServices, NotFoundError, Renderer,
+    ShapeMaskHandler,
+)
+from omero_ms_image_region_tpu.services.cache import CacheConfig, Caches
+from omero_ms_image_region_tpu.services.metadata import (
+    CanReadMemo, LocalMetadataService, write_mask,
+)
+
+IMG = 7
+MASK = 5
+W = H = 64
+Z = 4
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("data")
+    rng = np.random.default_rng(3)
+    planes = rng.integers(0, 60000, size=(2, Z, H, W)).astype(np.uint16)
+    build_pyramid(planes, str(root / str(IMG)), chunk=(32, 32), n_levels=2)
+    bits = np.zeros(H * W, np.uint8)
+    bits[: H * W // 2] = 1
+    write_mask(str(root), Mask(
+        shape_id=MASK, width=W, height=H,
+        bytes_=np.packbits(bits).tobytes(), fill_color=None))
+    return str(root)
+
+
+@pytest.fixture()
+def services(data_dir):
+    return ImageRegionServices(
+        pixels_service=PixelsService(data_dir),
+        metadata=LocalMetadataService(data_dir),
+        caches=Caches.from_config(CacheConfig()),
+        can_read_memo=CanReadMemo(),
+        renderer=Renderer(),
+        lut_provider=LutProvider(),
+    )
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _ctx(**params):
+    base = {"imageId": str(IMG), "theZ": "0", "theT": "0"}
+    base.update(params)
+    return ImageRegionCtx.from_params(base)
+
+
+class TestImageRegionHandler:
+    def test_full_plane_png(self, services):
+        handler = ImageRegionHandler(services)
+        data = run(handler.render_image_region(_ctx(format="png")))
+        rgba = codecs.decode_to_rgba(data)
+        assert rgba.shape == (H, W, 4)
+
+    def test_tile_and_region_shapes(self, services):
+        handler = ImageRegionHandler(services)
+        tile = run(handler.render_image_region(
+            _ctx(tile="0,1,1,16,16", format="png")))
+        assert codecs.decode_to_rgba(tile).shape == (16, 16, 4)
+        region = run(handler.render_image_region(
+            _ctx(region="8,8,24,20", format="png")))
+        assert codecs.decode_to_rgba(region).shape == (20, 24, 4)
+
+    def test_second_request_hits_cache(self, services):
+        handler = ImageRegionHandler(services)
+        ctx = _ctx(format="png", tile="0,0,0,16,16")
+        first = run(handler.render_image_region(ctx))
+        tier = services.caches.image_region.tiers[0]
+        hits_before = getattr(tier, "hits", None)
+        second = run(handler.render_image_region(ctx))
+        assert first == second
+        if hits_before is not None:
+            assert tier.hits > hits_before
+
+    def test_cache_hit_still_requires_acl(self, services, data_dir):
+        import os
+        handler = ImageRegionHandler(services)
+        ctx = _ctx(format="png")
+        run(handler.render_image_region(ctx))          # populate cache
+        acl = os.path.join(data_dir, str(IMG), "acl.json")
+        with open(acl, "w") as f:
+            json.dump({"sessions": ["allowed"]}, f)
+        try:
+            services.can_read_memo._memo.clear()
+            with pytest.raises(NotFoundError):
+                run(handler.render_image_region(ctx))
+        finally:
+            os.remove(acl)
+
+    def test_missing_image_404(self, services):
+        handler = ImageRegionHandler(services)
+        with pytest.raises(NotFoundError):
+            run(handler.render_image_region(_ctx(imageId="999")))
+
+    def test_z_out_of_bounds_400(self, services):
+        handler = ImageRegionHandler(services)
+        with pytest.raises(BadRequestError):
+            run(handler.render_image_region(_ctx(theZ=str(Z))))
+
+    def test_flip_matches_unflipped_mirror(self, services):
+        handler = ImageRegionHandler(services)
+        plain = codecs.decode_to_rgba(run(handler.render_image_region(
+            _ctx(format="png"))))
+        flipped = codecs.decode_to_rgba(run(handler.render_image_region(
+            _ctx(format="png", flip="h"))))
+        np.testing.assert_array_equal(flipped, plain[:, ::-1])
+
+    def test_projection_intmax(self, services, data_dir):
+        handler = ImageRegionHandler(services)
+        data = run(handler.render_image_region(
+            _ctx(format="png", p="intmax|0:3",
+                 c="1|0:60000$FF0000,-2|0:60000$00FF00")))
+        rgba = codecs.decode_to_rgba(data)
+        assert rgba.shape == (H, W, 4)
+        # Projection of the max over Z must be >= any single plane render.
+        single = codecs.decode_to_rgba(run(handler.render_image_region(
+            _ctx(format="png", c="1|0:60000$FF0000,-2|0:60000$00FF00"))))
+        assert (rgba[..., 0].astype(int) >= single[..., 0].astype(int)).all()
+
+    def test_greyscale_model(self, services):
+        handler = ImageRegionHandler(services)
+        data = run(handler.render_image_region(
+            _ctx(format="png", m="g",
+                 c="1|0:60000$FF0000,2|0:60000$00FF00")))
+        rgba = codecs.decode_to_rgba(data)
+        # grey: r == g == b everywhere
+        np.testing.assert_array_equal(rgba[..., 0], rgba[..., 1])
+        np.testing.assert_array_equal(rgba[..., 1], rgba[..., 2])
+
+    def test_resolution_level(self, services):
+        handler = ImageRegionHandler(services)
+        # res index 0 = smallest level (OMERO inversion); 2 levels here.
+        small = run(handler.render_image_region(
+            _ctx(format="png", tile="0,0,0")))
+        assert codecs.decode_to_rgba(small).shape == (H // 2, W // 2, 4)
+
+
+class TestShapeMaskHandler:
+    def test_mask_png_and_cache_rules(self, services):
+        handler = ShapeMaskHandler(services)
+        ctx = ShapeMaskCtx.from_params({"shapeId": str(MASK)})
+        png = run(handler.render_shape_mask(ctx))
+        rgba = codecs.decode_to_rgba(png)
+        assert rgba.shape == (H, W, 4)
+        # top half filled with default yellow, bottom transparent
+        assert tuple(rgba[0, 0]) == (255, 255, 0, 255)
+        assert rgba[H - 1, 0, 3] == 0
+        # no color param => not cached
+        assert run(services.caches.shape_mask.get(ctx.cache_key())) is None
+
+        colored = ShapeMaskCtx.from_params(
+            {"shapeId": str(MASK), "color": "FF0000"})
+        png2 = run(handler.render_shape_mask(colored))
+        assert run(services.caches.shape_mask.get(
+            colored.cache_key())) == png2
+
+    def test_missing_mask_404(self, services):
+        handler = ShapeMaskHandler(services)
+        with pytest.raises(NotFoundError):
+            run(handler.render_shape_mask(
+                ShapeMaskCtx.from_params({"shapeId": "999"})))

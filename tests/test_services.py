@@ -1,0 +1,144 @@
+"""Cache tiers, metadata/ACL service, session decoding."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.io.store import build_pyramid
+from omero_ms_image_region_tpu.models.mask import Mask
+from omero_ms_image_region_tpu.services.cache import (
+    CacheConfig, Caches, CacheStack, MemoryLRUCache,
+)
+from omero_ms_image_region_tpu.services.metadata import (
+    CanReadMemo, LocalMetadataService, write_mask,
+)
+from omero_ms_image_region_tpu.services.sessions import (
+    StaticSessionStore, decode_django_session, resolve_session_key,
+)
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+class TestMemoryLRU:
+    def test_get_set_evict(self):
+        cache = MemoryLRUCache(max_bytes=100)
+        cache.set_sync("a", b"x" * 60)
+        cache.set_sync("b", b"y" * 60)          # evicts a
+        assert cache.get_sync("a") is None
+        assert cache.get_sync("b") == b"y" * 60
+
+    def test_lru_order(self):
+        cache = MemoryLRUCache(max_bytes=100)
+        cache.set_sync("a", b"x" * 40)
+        cache.set_sync("b", b"y" * 40)
+        cache.get_sync("a")                      # a now most-recent
+        cache.set_sync("c", b"z" * 40)           # evicts b
+        assert cache.get_sync("a") is not None
+        assert cache.get_sync("b") is None
+
+    def test_overwrite_accounts_size(self):
+        cache = MemoryLRUCache(max_bytes=100)
+        cache.set_sync("a", b"x" * 90)
+        cache.set_sync("a", b"y" * 10)
+        cache.set_sync("b", b"z" * 80)
+        assert cache.get_sync("a") == b"y" * 10
+
+
+class TestCacheStack:
+    def test_backfill_upper_tiers(self):
+        upper, lower = MemoryLRUCache(), MemoryLRUCache()
+        stack = CacheStack([upper, lower])
+        lower.set_sync("k", b"v")
+        assert run(stack.get("k")) == b"v"
+        assert upper.get_sync("k") == b"v"
+
+    def test_disabled_is_a_noop(self):
+        tier = MemoryLRUCache()
+        stack = CacheStack([tier], enabled=False)
+        run(stack.set("k", b"v"))
+        assert run(stack.get("k")) is None
+        assert tier.get_sync("k") is None
+
+    def test_caches_from_config_flags(self):
+        caches = Caches.from_config(CacheConfig(image_region=False))
+        assert caches.image_region.enabled is False
+        assert caches.pixels_metadata.enabled is True
+
+
+class TestLocalMetadata:
+    @pytest.fixture
+    def data_dir(self, tmp_path):
+        planes = np.arange(2 * 1 * 32 * 32, dtype=np.uint16).reshape(
+            2, 1, 32, 32)
+        build_pyramid(planes, str(tmp_path / "7"), chunk=(16, 16),
+                      n_levels=1)
+        write_mask(str(tmp_path), Mask(
+            shape_id=5, width=8, height=4, bytes_=bytes(4),
+            fill_color=(1, 2, 3, 4)))
+        return str(tmp_path)
+
+    def test_pixels_description(self, data_dir):
+        svc = LocalMetadataService(data_dir)
+        pixels = run(svc.get_pixels_description(7, None))
+        assert (pixels.size_x, pixels.size_y, pixels.size_c) == (32, 32, 2)
+        assert pixels.pixels_type == "uint16"
+        assert run(svc.get_pixels_description(404, None)) is None
+
+    def test_mask_round_trip(self, data_dir):
+        svc = LocalMetadataService(data_dir)
+        mask = run(svc.get_mask(5, None))
+        assert (mask.width, mask.height) == (8, 4)
+        assert mask.fill_color == (1, 2, 3, 4)
+        assert run(svc.get_mask(404, None)) is None
+
+    def test_acl_default_public(self, data_dir):
+        svc = LocalMetadataService(data_dir)
+        assert run(svc.can_read("Image", 7, None)) is True
+        assert run(svc.can_read("Image", 404, None)) is False
+        assert run(svc.can_read("Mask", 5, None)) is True
+        assert run(svc.can_read("Mask", 404, None)) is False
+
+    def test_acl_session_restricted(self, data_dir):
+        with open(os.path.join(data_dir, "7", "acl.json"), "w") as f:
+            json.dump({"sessions": ["good-key"]}, f)
+        svc = LocalMetadataService(data_dir)
+        assert run(svc.can_read("Image", 7, "good-key")) is True
+        assert run(svc.can_read("Image", 7, "bad-key")) is False
+        assert run(svc.can_read("Image", 7, None)) is False
+
+
+class TestCanReadMemo:
+    def test_memo_and_ttl(self):
+        memo = CanReadMemo(ttl_seconds=1000)
+        assert memo.get("s", "Image", 1) is None
+        memo.put("s", "Image", 1, True)
+        assert memo.get("s", "Image", 1) is True
+        expired = CanReadMemo(ttl_seconds=-1)
+        expired.put("s", "Image", 1, True)
+        assert expired.get("s", "Image", 1) is None
+
+
+class TestSessions:
+    def test_static_store(self):
+        store = StaticSessionStore({"cookie1": "omero-key-1"})
+        assert run(store.get_session_key("cookie1")) == "omero-key-1"
+        assert run(store.get_session_key("other")) is None
+        assert run(StaticSessionStore(accept_all=True)
+                   .get_session_key("x")) == "x"
+
+    def test_resolve_from_cookies(self):
+        store = StaticSessionStore({"sid": "key"})
+        assert run(resolve_session_key(store, {"sessionid": "sid"})) == "key"
+        assert run(resolve_session_key(store, {})) is None
+        assert run(resolve_session_key(None, {"sessionid": "sid"})) is None
+
+    def test_decode_django_json_session(self):
+        payload = json.dumps(
+            {"connector": {"omero_session_key": "abc123"}}).encode()
+        assert decode_django_session(payload) == "abc123"
+        assert decode_django_session(b"garbage!!") is None

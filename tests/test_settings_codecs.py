@@ -1,0 +1,121 @@
+"""Settings application (updateSettings semantics) + codec round-trips."""
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu import codecs
+from omero_ms_image_region_tpu.models.pixels import Pixels
+from omero_ms_image_region_tpu.models.rendering import (
+    RenderingModel, default_rendering_def,
+)
+from omero_ms_image_region_tpu.server.ctx import (
+    BadRequestError, ImageRegionCtx,
+)
+from omero_ms_image_region_tpu.server.settings import update_settings
+
+
+def _ctx(**params):
+    base = {"imageId": "1", "theZ": "0", "theT": "0"}
+    base.update(params)
+    return ImageRegionCtx.from_params(base)
+
+
+def _pixels(C=3):
+    return Pixels(image_id=1, pixels_type="uint16", size_x=64, size_y=64,
+                  size_c=C)
+
+
+class TestUpdateSettings:
+    def test_active_channels_signed_one_based(self):
+        # c=1 on, c=-2 off, c=3 on (ImageRegionRequestHandler.java:694-696)
+        ctx = _ctx(c="1|0:100$FF0000,-2|0:100$00FF00,3|0:100$0000FF")
+        rdef = update_settings(default_rendering_def(_pixels()), ctx)
+        assert [cb.active for cb in rdef.channel_bindings] == [
+            True, False, True]
+
+    def test_windows_and_colors_applied(self):
+        ctx = _ctx(c="1|5:500$00FF00,2|7:700$FF0000,-3|0:1$0000FF")
+        rdef = update_settings(default_rendering_def(_pixels()), ctx)
+        cb0, cb1, _ = rdef.channel_bindings
+        assert (cb0.input_start, cb0.input_end) == (5.0, 500.0)
+        assert (cb0.red, cb0.green, cb0.blue) == (0, 255, 0)
+        assert (cb1.input_start, cb1.input_end) == (7.0, 700.0)
+        assert (cb1.red, cb1.green, cb1.blue) == (255, 0, 0)
+
+    def test_lut_color_selects_lut(self):
+        ctx = _ctx(c="1|0:100$cool.lut")
+        rdef = update_settings(default_rendering_def(_pixels()), ctx)
+        assert rdef.channel_bindings[0].lut == "cool.lut"
+
+    def test_invalid_color_raises_400(self):
+        ctx = _ctx(c="1|0:100$XYZ")
+        with pytest.raises(BadRequestError):
+            update_settings(default_rendering_def(_pixels()), ctx)
+
+    def test_maps_reverse_enabled(self):
+        # maps[c]["reverse"]["enabled"] (:717-730)
+        ctx = _ctx(
+            c="1|0:100$FF0000,2|0:100$00FF00",
+            maps='[{"reverse": {"enabled": true}}, '
+                 '{"reverse": {"enabled": false}}]',
+        )
+        rdef = update_settings(default_rendering_def(_pixels()), ctx)
+        assert rdef.channel_bindings[0].reverse_intensity is True
+        assert rdef.channel_bindings[1].reverse_intensity is False
+
+    def test_model_switch(self):
+        assert update_settings(
+            default_rendering_def(_pixels()), _ctx(m="g")
+        ).model == RenderingModel.GREYSCALE
+        assert update_settings(
+            default_rendering_def(_pixels()), _ctx(m="c")
+        ).model == RenderingModel.RGB
+
+    def test_no_channels_leaves_defaults(self):
+        rdef = update_settings(default_rendering_def(_pixels(4)), _ctx())
+        # default_rendering_def: first three channels active
+        assert [cb.active for cb in rdef.channel_bindings] == [
+            True, True, True, False]
+
+    def test_original_rdef_not_mutated(self):
+        original = default_rendering_def(_pixels())
+        update_settings(original, _ctx(c="-1,-2,-3"))
+        assert original.channel_bindings[0].active is True
+
+
+class TestCodecs:
+    def _rgba(self, h=16, w=24):
+        rng = np.random.default_rng(0)
+        rgba = rng.integers(0, 255, size=(h, w, 4)).astype(np.uint8)
+        rgba[..., 3] = 255
+        return rgba
+
+    @pytest.mark.parametrize("fmt", ["jpeg", "png", "tif"])
+    def test_round_trip_dimensions(self, fmt):
+        rgba = self._rgba()
+        out = codecs.decode_to_rgba(codecs.encode_rgba(rgba, fmt))
+        assert out.shape == rgba.shape
+
+    def test_png_lossless(self):
+        rgba = self._rgba()
+        out = codecs.decode_to_rgba(codecs.encode_rgba(rgba, "png"))
+        np.testing.assert_array_equal(out[..., :3], rgba[..., :3])
+
+    def test_jpeg_quality_monotone(self):
+        rgba = self._rgba(64, 64)
+        low = codecs.encode_rgba(rgba, "jpeg", quality=0.1)
+        high = codecs.encode_rgba(rgba, "jpeg", quality=1.0)
+        assert len(high) > len(low)
+
+    def test_unknown_format(self):
+        with pytest.raises(codecs.UnknownFormatError):
+            codecs.encode_rgba(self._rgba(), "gif")
+
+    def test_mask_png_palette_transparency(self):
+        grid = np.zeros((8, 8), np.uint8)
+        grid[2:6, 2:6] = 1
+        png = codecs.encode_mask_png(grid, (255, 0, 0, 200))
+        out = codecs.decode_to_rgba(png)
+        assert out.shape == (8, 8, 4)
+        assert tuple(out[0, 0]) == (0, 0, 0, 0)           # transparent
+        assert tuple(out[3, 3]) == (255, 0, 0, 200)        # fill w/ alpha

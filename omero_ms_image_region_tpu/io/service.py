@@ -52,6 +52,13 @@ class PixelsService:
             )
         src = ChunkedPyramidStore(self.image_dir(image_id))
         with self._lock:
+            # Double-check: a concurrent opener may have won the race;
+            # keep theirs and drop ours so no store leaks its memmaps.
+            existing = self._open.get(image_id)
+            if existing is not None:
+                self._open.move_to_end(image_id)
+                src.close()
+                return existing
             self._open[image_id] = src
             while len(self._open) > self.max_open:
                 _, evicted = self._open.popitem(last=False)

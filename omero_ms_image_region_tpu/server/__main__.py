@@ -1,0 +1,6 @@
+"""``python -m omero_ms_image_region_tpu.server`` — service launcher
+(≙ the Vert.x ``io.vertx.core.Launcher`` main class, ``build.gradle:10``)."""
+
+from .app import main
+
+main()

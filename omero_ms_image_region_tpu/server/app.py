@@ -1,0 +1,192 @@
+"""The HTTP service (≙ ``ImageRegionMicroserviceVerticle``).
+
+Routes, response mapping and the OPTIONS feature document mirror the
+reference exactly (``ImageRegionMicroserviceVerticle.java:186-231`` routes,
+``:263-284`` details, ``:294-352`` image responses, ``:362-400`` masks):
+
+  OPTIONS *                                                  -> details JSON
+  GET /webgateway/render_image_region/{imageId}/{theZ}/{theT}
+  GET /webgateway/render_image/{imageId}/{theZ}/{theT}
+  GET /webclient/render_image_region/{imageId}/{theZ}/{theT}
+  GET /webclient/render_image/{imageId}/{theZ}/{theT}
+  GET /webgateway/render_shape_mask/{shapeId}
+
+Status mapping: parameter errors 400 with the message as body, missing or
+unreadable objects 404 (empty body), anything else 500 (empty body) — the
+reference's ReplyException failure-code propagation
+(``ImageRegionVerticle.java:163-188``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from .. import __version__, codecs
+from ..io.service import PixelsService
+from ..ops.lut import LutProvider
+from ..services.cache import Caches
+from ..services.metadata import CanReadMemo, LocalMetadataService
+from ..services.sessions import (DjangoRedisSessionStore, SessionStore,
+                                 StaticSessionStore, resolve_session_key)
+from .batcher import BatchingRenderer
+from .config import AppConfig
+from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
+from .handler import (ImageRegionHandler, ImageRegionServices, NotFoundError,
+                      Renderer, ShapeMaskHandler)
+
+log = logging.getLogger("omero_ms_image_region_tpu.server")
+
+PROVIDER = "ImageRegionMicroservice"
+FEATURES = ["flip", "mask-color", "png-tiles"]
+
+SERVICES_KEY = web.AppKey("services", object)
+CONFIG_KEY = web.AppKey("config", object)
+
+
+def _make_session_store(config: AppConfig) -> Optional[SessionStore]:
+    if config.session_store_type == "redis" and config.session_store_uri:
+        try:
+            return DjangoRedisSessionStore(config.session_store_uri)
+        except ImportError:
+            log.warning("redis package unavailable; sessions disabled")
+            return None
+    if config.session_store_type == "static":
+        return StaticSessionStore(accept_all=True)
+    if config.session_store_type == "postgres":
+        log.warning("postgres session store not wired in this build; "
+                    "sessions disabled")
+    return None
+
+
+def create_app(config: Optional[AppConfig] = None,
+               services: Optional[ImageRegionServices] = None
+               ) -> web.Application:
+    """Build the application; ``services`` injection is the test seam."""
+    config = config or AppConfig()
+
+    if services is None:
+        renderer = (BatchingRenderer(
+            max_batch=config.batcher.max_batch,
+            linger_ms=config.batcher.linger_ms)
+            if config.batcher.enabled else Renderer())
+        services = ImageRegionServices(
+            pixels_service=PixelsService(config.data_dir),
+            metadata=LocalMetadataService(config.data_dir),
+            caches=Caches.from_config(config.caches),
+            can_read_memo=CanReadMemo(),
+            renderer=renderer,
+            lut_provider=LutProvider(config.lut_root),
+            max_tile_length=config.max_tile_length,
+        )
+
+    image_handler = ImageRegionHandler(services)
+    mask_handler = ShapeMaskHandler(services)
+    session_store = _make_session_store(config)
+
+    async def session_key(request: web.Request) -> Optional[str]:
+        return await resolve_session_key(
+            session_store, request.cookies, config.session_cookie_name)
+
+    def _status_of(e: Exception) -> web.Response:
+        """Failure-code mapping with the reference's empty 404/500 bodies
+        (``ImageRegionMicroserviceVerticle.java:314-323``)."""
+        if isinstance(e, BadRequestError):
+            return web.Response(status=400, text=str(e))
+        if isinstance(e, (NotFoundError, FileNotFoundError)):
+            return web.Response(status=404)
+        log.exception("render failed")
+        return web.Response(status=500)
+
+    async def render_image_region(request: web.Request) -> web.Response:
+        params = dict(request.query)
+        params.update(request.match_info)
+        try:
+            ctx = ImageRegionCtx.from_params(
+                params, await session_key(request))
+        except BadRequestError as e:
+            # Parse errors return the message body (the reference's 400
+            # path, ImageRegionMicroserviceVerticle.java:300-305).
+            return web.Response(status=400, text=str(e))
+        try:
+            body = await image_handler.render_image_region(ctx)
+        except Exception as e:
+            return _status_of(e)
+        headers = {
+            "Content-Type": codecs.CONTENT_TYPES.get(
+                ctx.format, "application/octet-stream"),
+        }
+        if config.cache_control_header:
+            headers["Cache-Control"] = config.cache_control_header
+        return web.Response(body=body, headers=headers)
+
+    async def render_shape_mask(request: web.Request) -> web.Response:
+        params = dict(request.query)
+        params.update(request.match_info)
+        try:
+            ctx = ShapeMaskCtx.from_params(
+                params, await session_key(request))
+        except BadRequestError as e:
+            return web.Response(status=400, text=str(e))
+        try:
+            body = await mask_handler.render_shape_mask(ctx)
+        except Exception as e:
+            return _status_of(e)
+        return web.Response(body=body, headers={"Content-Type": "image/png"})
+
+    async def details(request: web.Request) -> web.Response:
+        doc = {
+            "provider": PROVIDER,
+            "version": __version__,
+            "features": FEATURES,
+            "options": {"maxTileLength": services.max_tile_length},
+        }
+        if config.cache_control_header:
+            doc["options"]["cacheControl"] = config.cache_control_header
+        return web.json_response(doc)
+
+    app = web.Application()
+    for prefix in ("webgateway", "webclient"):
+        for route in ("render_image_region", "render_image"):
+            app.router.add_get(
+                f"/{prefix}/{route}/{{imageId}}/{{theZ}}/{{theT}}",
+                render_image_region)
+    app.router.add_get("/webgateway/render_shape_mask/{shapeId}",
+                       render_shape_mask)
+    app.router.add_route("OPTIONS", "/{tail:.*}", details)
+
+    async def on_cleanup(app):
+        if isinstance(services.renderer, BatchingRenderer):
+            await services.renderer.close()
+        services.pixels_service.close()
+
+    app.on_cleanup.append(on_cleanup)
+    app[SERVICES_KEY] = services
+    app[CONFIG_KEY] = config
+    return app
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="TPU image-region service")
+    parser.add_argument("--config", help="YAML config path")
+    parser.add_argument("--port", type=int)
+    parser.add_argument("--data-dir")
+    args = parser.parse_args(argv)
+
+    config = (AppConfig.from_yaml(args.config) if args.config
+              else AppConfig())
+    if args.port is not None:
+        config.port = args.port
+    if args.data_dir is not None:
+        config.data_dir = args.data_dir
+
+    logging.basicConfig(level=logging.INFO)
+    web.run_app(create_app(config), port=config.port)
+
+
+if __name__ == "__main__":
+    main()

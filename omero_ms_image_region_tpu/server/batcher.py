@@ -1,0 +1,179 @@
+"""Micro-batching renderer: coalesce concurrent tile requests into
+fixed-shape device dispatches.
+
+This is the TPU-native replacement for the reference's worker-verticle data
+parallelism (N=2x cores blocking render threads,
+``ImageRegionMicroserviceVerticle.java:83-85,148-165``): instead of N CPU
+threads each rendering one tile, concurrent requests are stacked into one
+``vmap``-batched kernel call (SURVEY.md §2c, §7 step 5).
+
+Fixed shapes are everything on TPU — each distinct (B, C, H, W) costs an
+XLA compile — so two quantizations bound the executable set:
+
+  * spatial buckets: a tile pads up (zeros) to the smallest configured
+    bucket that fits, and the result is cropped back;
+  * batch sizes: the collected group pads up (repeating the last tile) to
+    the next power of two <= max_batch.
+
+Requests with differing per-channel settings still share a batch: window,
+family, reverse and the folded color tables are per-tile *data*, not
+compile-time constants.  Only channel count, bucket shape and the codomain
+scalars key the group.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.render import render_tile_batch_packed
+from ..utils.stopwatch import stopwatch
+
+DEFAULT_BUCKETS = ((256, 256), (512, 512), (1024, 1024), (2048, 2048))
+
+
+def pick_bucket(h: int, w: int,
+                buckets=DEFAULT_BUCKETS) -> Tuple[int, int]:
+    """Smallest bucket covering (h, w); oversize falls through to the exact
+    shape (a one-off compile beats failing the request)."""
+    for bh, bw in buckets:
+        if h <= bh and w <= bw:
+            return bh, bw
+    return h, w
+
+
+def _pad_batch_size(n: int, max_batch: int) -> int:
+    size = 1
+    while size < n:
+        size *= 2
+    return min(size, max_batch)
+
+
+@dataclass
+class _Pending:
+    raw: np.ndarray               # f32[C, bh, bw] padded
+    settings: dict
+    h: int
+    w: int
+    future: asyncio.Future = None  # type: ignore[assignment]
+
+
+class BatchingRenderer:
+    """Drop-in for ``handler.Renderer`` with request coalescing.
+
+    One dispatcher task per group key drains its queue: it waits up to
+    ``linger_ms`` for co-arrivals, stacks up to ``max_batch`` tiles, runs
+    the batched kernel in a worker thread (keeping the event loop free),
+    and resolves each request's future with its cropped result.
+    """
+
+    def __init__(self, max_batch: int = 8, linger_ms: float = 2.0,
+                 buckets=DEFAULT_BUCKETS):
+        self.max_batch = max_batch
+        self.linger_ms = linger_ms
+        self.buckets = tuple(buckets)
+        self._queues: Dict[tuple, Deque[_Pending]] = {}
+        self._dispatchers: Dict[tuple, asyncio.Task] = {}
+        self._wakeups: Dict[tuple, asyncio.Event] = {}
+        self.batches_dispatched = 0
+        self.tiles_rendered = 0
+
+    # ------------------------------------------------------------- public
+
+    async def render(self, raw: np.ndarray, settings: dict) -> np.ndarray:
+        """f32[C, H, W] + packed settings -> u32[H, W] packed RGBA."""
+        C, h, w = raw.shape
+        bh, bw = pick_bucket(h, w, self.buckets)
+        if (h, w) != (bh, bw):
+            padded = np.zeros((C, bh, bw), np.float32)
+            padded[:, :h, :w] = raw
+            raw = padded
+        key = (C, bh, bw, int(settings["cd_start"]),
+               int(settings["cd_end"]))
+
+        pending = _Pending(raw=raw, settings=settings, h=h, w=w,
+                           future=asyncio.get_running_loop().create_future())
+        queue = self._queues.get(key)
+        if queue is None:
+            queue = self._queues[key] = collections.deque()
+            self._wakeups[key] = asyncio.Event()
+            self._dispatchers[key] = asyncio.create_task(
+                self._dispatch_loop(key))
+        queue.append(pending)
+        self._wakeups[key].set()
+        return await pending.future
+
+    async def close(self) -> None:
+        for task in self._dispatchers.values():
+            task.cancel()
+        await asyncio.gather(*self._dispatchers.values(),
+                             return_exceptions=True)
+        # Fail any requests still queued so their awaiters don't hang
+        # across shutdown.
+        for queue in self._queues.values():
+            while queue:
+                pending = queue.popleft()
+                if not pending.future.done():
+                    pending.future.set_exception(
+                        asyncio.CancelledError("renderer shut down"))
+        self._dispatchers.clear()
+        self._queues.clear()
+        self._wakeups.clear()
+
+    # --------------------------------------------------------- dispatcher
+
+    async def _dispatch_loop(self, key: tuple) -> None:
+        queue = self._queues[key]
+        wakeup = self._wakeups[key]
+        while True:
+            if not queue:
+                wakeup.clear()
+                await wakeup.wait()
+            # Linger briefly so co-arriving tiles share the dispatch —
+            # but never linger when a full batch is already waiting.
+            if len(queue) < self.max_batch and self.linger_ms > 0:
+                await asyncio.sleep(self.linger_ms / 1000.0)
+            group: List[_Pending] = []
+            while queue and len(group) < self.max_batch:
+                group.append(queue.popleft())
+            if not group:
+                continue
+            try:
+                results = await asyncio.to_thread(
+                    self._render_group, group)
+            except Exception as e:  # propagate to every waiter
+                for p in group:
+                    if not p.future.done():
+                        p.future.set_exception(e)
+                continue
+            for p, out in zip(group, results):
+                if not p.future.done():
+                    p.future.set_result(out)
+
+    def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:
+        n = len(group)
+        B = _pad_batch_size(n, self.max_batch)
+        # Pad the batch by repeating the last tile; extras are discarded.
+        padded = group + [group[-1]] * (B - n)
+
+        raw = np.stack([p.raw for p in padded])
+
+        def stack(name):
+            return np.stack([p.settings[name] for p in padded])
+
+        s0 = group[0].settings
+        with stopwatch("Renderer.renderAsPackedInt.batch"):
+            out = render_tile_batch_packed(
+                raw, stack("window_start"), stack("window_end"),
+                stack("family"), stack("coefficient"), stack("reverse"),
+                s0["cd_start"], s0["cd_end"], stack("tables"),
+            )
+            host = np.asarray(out)
+        self.batches_dispatched += 1
+        self.tiles_rendered += n
+        return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]

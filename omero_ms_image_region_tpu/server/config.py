@@ -1,0 +1,83 @@
+"""Service configuration (≙ ``src/dist/conf/config.yaml`` + the Vert.x
+ConfigRetriever, ``ImageRegionMicroserviceVerticle.java:98-118``).
+
+YAML keys keep the reference's names where a setting has a direct analogue
+(``port``, ``cache-control-header``, ``omero.web.session_cookie_name``,
+``session-store``, ``redis-cache``, per-cache ``enabled`` flags,
+``omero.server.omero.pixeldata.max_tile_length``) so an existing deployment
+file ports by deleting the Java-only blocks and adding ``data-dir``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import yaml
+
+from ..services.cache import CacheConfig
+
+
+@dataclass
+class BatcherConfig:
+    enabled: bool = True
+    max_batch: int = 8
+    linger_ms: float = 2.0
+
+
+@dataclass
+class AppConfig:
+    port: int = 8080
+    data_dir: str = "./data"
+    max_tile_length: int = 2048            # omero.pixeldata.max_tile_length
+    cache_control_header: str = ""         # cache-control-header
+    session_cookie_name: str = "sessionid"  # omero.web.session_cookie_name
+    session_store_type: Optional[str] = None   # redis | postgres | static
+    session_store_uri: Optional[str] = None
+    lut_root: Optional[str] = None         # omero.script_repo_root analogue
+    caches: CacheConfig = field(default_factory=CacheConfig)
+    batcher: BatcherConfig = field(default_factory=BatcherConfig)
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "AppConfig":
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "AppConfig":
+        cfg = cls()
+        cfg.port = int(raw.get("port", cfg.port))
+        cfg.data_dir = raw.get("data-dir", cfg.data_dir)
+        server_block = raw.get("omero.server", {}) or {}
+        cfg.max_tile_length = int(server_block.get(
+            "omero.pixeldata.max_tile_length", cfg.max_tile_length))
+        cfg.lut_root = server_block.get("omero.script_repo_root",
+                                        cfg.lut_root)
+        cfg.cache_control_header = raw.get("cache-control-header",
+                                           cfg.cache_control_header)
+        web = raw.get("omero.web", {}) or {}
+        cfg.session_cookie_name = web.get("session_cookie_name",
+                                          cfg.session_cookie_name)
+        store = raw.get("session-store", {}) or {}
+        cfg.session_store_type = store.get("type")
+        cfg.session_store_uri = store.get("uri")
+
+        redis_cache = raw.get("redis-cache", {}) or {}
+        cfg.caches = CacheConfig(
+            redis_uri=redis_cache.get("uri"),
+            image_region=bool((raw.get("image-region-cache") or {})
+                              .get("enabled", False)),
+            pixels_metadata=bool((raw.get("pixels-metadata-cache") or {})
+                                 .get("enabled", False)),
+            shape_mask=bool((raw.get("shape-mask-cache") or {})
+                            .get("enabled", False)),
+        )
+        batcher = raw.get("batcher", {}) or {}
+        cfg.batcher = BatcherConfig(
+            enabled=bool(batcher.get("enabled", True)),
+            max_batch=int(batcher.get("max-batch", 8)),
+            linger_ms=float(batcher.get("linger-ms", 2.0)),
+        )
+        return cfg

@@ -35,8 +35,7 @@ from ..services.metadata import CanReadMemo, MetadataService
 from ..utils.color import split_html_color
 from ..utils.stopwatch import stopwatch
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
-from .region import (RegionDef, clamp_region_to_plane, get_region_def,
-                     select_resolution_level)
+from .region import RegionDef, clamp_region_to_plane, get_region_def
 from .settings import update_settings
 
 DEFAULT_MAX_TILE_LENGTH = 2048  # beanRefContext.xml:63-66
@@ -184,7 +183,14 @@ class ImageRegionHandler:
             levels, ctx.resolution, ctx.tile, ctx.region, src.tile_size(),
             self.s.max_tile_length, ctx.flip_horizontal, ctx.flip_vertical,
         )
-        level = select_resolution_level(len(levels), ctx.resolution)
+        # The request resolution indexes the largest-first descriptions
+        # list directly (the reference's getRegionDef/checkPlaneDef do the
+        # same, and its testSelectResolution locks it in).  The reference's
+        # extra ``n - res - 1`` inversion (setResolutionLevel, ``:845-852``)
+        # exists only because OMERO's PixelBuffer numbers levels
+        # smallest-first; our PixelSource numbers them largest-first like
+        # the descriptions, so the read level IS the resolution index.
+        level = ctx.resolution or 0
         clamp_region_to_plane(levels, ctx.resolution, region)
         if region.width <= 0 or region.height <= 0:
             raise BadRequestError(

@@ -95,7 +95,14 @@ class RedisCache:
 
 
 class CacheStack:
-    """Read-through tier stack: first hit wins and back-fills upper tiers."""
+    """Read-through tier stack: first hit wins and back-fills upper tiers.
+
+    Tier failures degrade, never fail the request: a broken tier (e.g. a
+    Redis outage) reads as a miss and writes are dropped — the render path
+    must keep serving uncached rather than turning every request into a
+    500 (the reference likewise treats cache errors as misses, replying to
+    the Redis-get event with null on failure).
+    """
 
     def __init__(self, tiers: List[CacheTier], enabled: bool = True):
         self.tiers = tiers
@@ -105,29 +112,46 @@ class CacheStack:
         if not self.enabled:
             return None
         for i, tier in enumerate(self.tiers):
-            value = await tier.get(key)
+            try:
+                value = await tier.get(key)
+            except Exception:
+                continue
             if value is not None:
                 for upper in self.tiers[:i]:
-                    await upper.set(key, value)
+                    try:
+                        await upper.set(key, value)
+                    except Exception:
+                        pass
                 return value
         return None
 
     async def set(self, key: str, value: bytes) -> None:
         if not self.enabled:
             return
-        await asyncio.gather(*(t.set(key, value) for t in self.tiers))
+        await asyncio.gather(*(t.set(key, value) for t in self.tiers),
+                             return_exceptions=True)
 
 
 @dataclass
 class CacheConfig:
-    """Per-cache enable flags + sizing (≙ ``config.yaml:47-60``)."""
+    """Per-cache enable flags + sizing (≙ ``config.yaml:47-60``).
+
+    Flags default to disabled like the reference's shipped config
+    (``config.yaml:53-60``); ``enabled_all`` is the one-liner for tests
+    and standalone deployments.
+    """
 
     redis_uri: Optional[str] = None
     local_max_bytes: int = 256 * 1024 * 1024
     # Enable flags, named after the reference's config keys.
-    image_region: bool = True          # cache.image-region.enabled
-    pixels_metadata: bool = True       # cache.pixels-metadata.enabled
-    shape_mask: bool = True            # cache.shape-mask.enabled
+    image_region: bool = False         # image-region-cache.enabled
+    pixels_metadata: bool = False      # pixels-metadata-cache.enabled
+    shape_mask: bool = False           # shape-mask-cache.enabled
+
+    @classmethod
+    def enabled_all(cls, **kwargs) -> "CacheConfig":
+        return cls(image_region=True, pixels_metadata=True,
+                   shape_mask=True, **kwargs)
 
 
 def make_cache(config: CacheConfig, enabled: bool) -> CacheStack:

@@ -1,0 +1,166 @@
+"""End-to-end HTTP tests: routes, status mapping, headers, OPTIONS doc."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_image_region_tpu import codecs
+from omero_ms_image_region_tpu.io.store import build_pyramid
+from omero_ms_image_region_tpu.models.mask import Mask
+from omero_ms_image_region_tpu.server.app import create_app
+from omero_ms_image_region_tpu.server.config import AppConfig, BatcherConfig
+from omero_ms_image_region_tpu.services.metadata import write_mask
+
+IMG, MASK = 7, 5
+H = W = 64
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("appdata")
+    rng = np.random.default_rng(11)
+    planes = rng.integers(0, 60000, size=(2, 2, H, W)).astype(np.uint16)
+    build_pyramid(planes, str(root / str(IMG)), chunk=(32, 32), n_levels=1)
+    grid = np.zeros(H * W, np.uint8)
+    grid[:256] = 1
+    write_mask(str(root), Mask(shape_id=MASK, width=W, height=H,
+                               bytes_=np.packbits(grid).tobytes()))
+    return str(root)
+
+
+def client_fetch(data_dir, *requests, config=None):
+    """Run GET/OPTIONS requests against a fresh app; returns
+    [(status, headers, body)]."""
+    config = config or AppConfig(
+        data_dir=data_dir, cache_control_header="private, max-age=3600")
+    config.data_dir = data_dir
+
+    async def main():
+        app = create_app(config)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        out = []
+        try:
+            for method, path in requests:
+                resp = await client.request(method, path)
+                out.append((resp.status, dict(resp.headers),
+                            await resp.read()))
+        finally:
+            await client.close()
+        return out
+
+    return asyncio.run(main())
+
+
+class TestRoutes:
+    def test_render_image_region_jpeg(self, data_dir):
+        [(status, headers, body)] = client_fetch(
+            data_dir,
+            ("GET", f"/webgateway/render_image_region/{IMG}/0/0"
+                    "?c=1|0:60000$FF0000&m=c"))
+        assert status == 200
+        assert headers["Content-Type"] == "image/jpeg"
+        assert headers["Cache-Control"] == "private, max-age=3600"
+        assert body[:2] == b"\xff\xd8"
+
+    def test_all_four_image_routes(self, data_dir):
+        reqs = [("GET", f"/{p}/{r}/{IMG}/0/0?format=png&m=c")
+                for p in ("webgateway", "webclient")
+                for r in ("render_image_region", "render_image")]
+        for status, headers, body in client_fetch(data_dir, *reqs):
+            assert status == 200
+            assert headers["Content-Type"] == "image/png"
+            assert codecs.decode_to_rgba(body).shape == (H, W, 4)
+
+    def test_tile_param_png(self, data_dir):
+        [(status, _, body)] = client_fetch(
+            data_dir,
+            ("GET", f"/webgateway/render_image_region/{IMG}/0/0"
+                    "?tile=0,0,0,16,16&format=png&m=c"))
+        assert status == 200
+        assert codecs.decode_to_rgba(body).shape == (16, 16, 4)
+
+    def test_shape_mask_route(self, data_dir):
+        [(status, headers, body)] = client_fetch(
+            data_dir,
+            ("GET", f"/webgateway/render_shape_mask/{MASK}?color=FF0000"))
+        assert status == 200
+        assert headers["Content-Type"] == "image/png"
+        rgba = codecs.decode_to_rgba(body)
+        assert tuple(rgba[0, 0]) == (255, 0, 0, 255)
+
+    def test_options_feature_document(self, data_dir):
+        [(status, headers, body)] = client_fetch(
+            data_dir, ("OPTIONS", "/"))
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["provider"] == "ImageRegionMicroservice"
+        assert set(doc["features"]) == {"flip", "mask-color", "png-tiles"}
+        assert doc["options"]["maxTileLength"] == 2048
+        assert doc["options"]["cacheControl"] == "private, max-age=3600"
+
+
+class TestStatusMapping:
+    def test_bad_param_400_with_message(self, data_dir):
+        [(status, _, body)] = client_fetch(
+            data_dir,
+            ("GET", f"/webgateway/render_image_region/{IMG}/0/0"
+                    "?tile=bogus"))
+        assert status == 400
+        assert b"tile" in body
+
+    def test_missing_image_404(self, data_dir):
+        [(status, _, body)] = client_fetch(
+            data_dir, ("GET", "/webgateway/render_image_region/999/0/0"))
+        assert status == 404
+        assert body == b""
+
+    def test_z_out_of_bounds_400(self, data_dir):
+        [(status, _, _)] = client_fetch(
+            data_dir, ("GET", f"/webgateway/render_image_region/{IMG}/9/0"))
+        assert status == 400
+
+    def test_missing_mask_404(self, data_dir):
+        [(status, _, _)] = client_fetch(
+            data_dir, ("GET", "/webgateway/render_shape_mask/999"))
+        assert status == 404
+
+    def test_non_numeric_image_id_400(self, data_dir):
+        [(status, _, _)] = client_fetch(
+            data_dir, ("GET", "/webgateway/render_image_region/abc/0/0"))
+        assert status == 400
+
+
+class TestBatchedApp:
+    def test_batching_renderer_serves_requests(self, data_dir):
+        config = AppConfig(data_dir=data_dir,
+                           batcher=BatcherConfig(enabled=True,
+                                                 linger_ms=5.0))
+
+        async def main():
+            app = create_app(config)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resps = await asyncio.gather(*(
+                    client.get(
+                        f"/webgateway/render_image_region/{IMG}/0/0"
+                        f"?tile=0,0,0,16,16&format=png&m=c&"
+                        f"c=1|0:{(i + 1) * 10000}$FF0000")
+                    for i in range(6)))
+                bodies = [await r.read() for r in resps]
+                assert all(r.status == 200 for r in resps)
+                from omero_ms_image_region_tpu.server.app import SERVICES_KEY
+                return bodies, app[SERVICES_KEY].renderer
+            finally:
+                await client.close()
+
+        bodies, renderer = asyncio.run(main())
+        # different windows -> different images, all decoded fine
+        shapes = {codecs.decode_to_rgba(b).shape for b in bodies}
+        assert shapes == {(16, 16, 4)}
+        assert renderer.tiles_rendered == 6
+        assert renderer.batches_dispatched <= 6

@@ -1,0 +1,144 @@
+"""Micro-batcher: correctness vs the direct path, coalescing, ragged pads."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.flagship import flagship_settings
+from omero_ms_image_region_tpu.models.rendering import (
+    RenderingModel, default_rendering_def,
+)
+from omero_ms_image_region_tpu.models.pixels import Pixels
+from omero_ms_image_region_tpu.ops.render import pack_settings
+from omero_ms_image_region_tpu.server.batcher import (
+    BatchingRenderer, pick_bucket,
+)
+from omero_ms_image_region_tpu.server.handler import Renderer
+
+
+def _settings(C=3):
+    pixels = Pixels(image_id=1, pixels_type="uint16", size_x=64, size_y=64,
+                    size_c=C)
+    rdef = default_rendering_def(pixels)
+    rdef.model = RenderingModel.RGB
+    colors = [(255, 0, 0), (0, 255, 0), (0, 0, 255)]
+    for c, cb in enumerate(rdef.channel_bindings):
+        cb.red, cb.green, cb.blue = colors[c % 3]
+        cb.input_start, cb.input_end = 0.0, 60000.0
+    return pack_settings(rdef)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class TestPickBucket:
+    def test_rounds_up(self):
+        assert pick_bucket(100, 200) == (256, 256)
+        assert pick_bucket(256, 257) == (512, 512)
+        assert pick_bucket(1, 1) == (256, 256)
+
+    def test_oversize_passthrough(self):
+        assert pick_bucket(5000, 100) == (5000, 100)
+
+
+class TestBatchingRenderer:
+    def test_matches_direct_renderer(self):
+        rng = np.random.default_rng(0)
+        settings = _settings()
+        raw = rng.integers(0, 60000, size=(3, 40, 56)).astype(np.float32)
+
+        async def main():
+            batcher = BatchingRenderer(linger_ms=0.5)
+            try:
+                direct = await Renderer().render(raw, settings)
+                batched = await batcher.render(raw, settings)
+                return direct, batched
+            finally:
+                await batcher.close()
+
+        direct, batched = run(main())
+        assert batched.shape == (40, 56)       # cropped back from 256 pad
+        np.testing.assert_array_equal(direct, batched)
+
+    def test_concurrent_requests_coalesce(self):
+        rng = np.random.default_rng(1)
+        settings = _settings()
+        raws = [rng.integers(0, 60000, size=(3, 32, 32)).astype(np.float32)
+                for _ in range(8)]
+
+        async def main():
+            batcher = BatchingRenderer(max_batch=8, linger_ms=20.0)
+            try:
+                outs = await asyncio.gather(*(
+                    batcher.render(r, settings) for r in raws))
+                return outs, batcher.batches_dispatched
+            finally:
+                await batcher.close()
+
+        outs, n_batches = run(main())
+        assert n_batches < len(raws)           # actually coalesced
+        direct = Renderer()
+        for raw, out in zip(raws, outs):
+            expected = run(direct.render(raw, settings))
+            np.testing.assert_array_equal(out, expected)
+
+    def test_mixed_settings_share_batch(self):
+        """Different windows/colors must still produce per-tile results."""
+        rng = np.random.default_rng(2)
+        raw = rng.integers(0, 60000, size=(3, 16, 16)).astype(np.float32)
+        s1, s2 = _settings(), _settings()
+        s2["window_start"] = s2["window_start"] + 1000.0
+        s2["tables"] = s2["tables"][:, :, ::-1].copy()   # swap rgb
+
+        async def main():
+            batcher = BatchingRenderer(max_batch=4, linger_ms=20.0)
+            try:
+                return await asyncio.gather(
+                    batcher.render(raw, s1), batcher.render(raw, s2))
+            finally:
+                await batcher.close()
+
+        out1, out2 = run(main())
+        exp1 = run(Renderer().render(raw, s1))
+        exp2 = run(Renderer().render(raw, s2))
+        np.testing.assert_array_equal(out1, exp1)
+        np.testing.assert_array_equal(out2, exp2)
+        assert not np.array_equal(out1, out2)
+
+    def test_different_channel_counts_do_not_mix(self):
+        rng = np.random.default_rng(3)
+        raw3 = rng.integers(0, 60000, size=(3, 16, 16)).astype(np.float32)
+        raw4 = rng.integers(0, 60000, size=(4, 16, 16)).astype(np.float32)
+        _, s4 = flagship_settings(4)
+
+        async def main():
+            batcher = BatchingRenderer(linger_ms=5.0)
+            try:
+                return await asyncio.gather(
+                    batcher.render(raw3, _settings(3)),
+                    batcher.render(raw4, s4))
+            finally:
+                await batcher.close()
+
+        out3, out4 = run(main())
+        assert out3.shape == out4.shape == (16, 16)
+
+    def test_render_error_propagates(self):
+        settings = _settings()
+        bad = np.zeros((2, 16, 16), np.float32)   # C mismatch vs settings
+
+        async def main():
+            batcher = BatchingRenderer(linger_ms=0.5)
+            try:
+                with pytest.raises(Exception):
+                    await batcher.render(bad, settings)
+            finally:
+                await batcher.close()
+
+        run(main())

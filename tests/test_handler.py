@@ -49,7 +49,7 @@ def services(data_dir):
     return ImageRegionServices(
         pixels_service=PixelsService(data_dir),
         metadata=LocalMetadataService(data_dir),
-        caches=Caches.from_config(CacheConfig()),
+        caches=Caches.from_config(CacheConfig.enabled_all()),
         can_read_memo=CanReadMemo(),
         renderer=Renderer(),
         lut_provider=LutProvider(),
@@ -153,11 +153,24 @@ class TestImageRegionHandler:
         np.testing.assert_array_equal(rgba[..., 1], rgba[..., 2])
 
     def test_resolution_level(self, services):
+        """Resolution indexes the largest-first level list directly, as the
+        reference's testSelectResolution pins (largest at index 0)."""
         handler = ImageRegionHandler(services)
-        # res index 0 = smallest level (OMERO inversion); 2 levels here.
+        # res 0, 32x32 tile at origin == the full-res top-left quadrant ==
+        # the same region requested without any resolution at all.
+        quad_res0 = run(handler.render_image_region(
+            _ctx(format="png", tile="0,0,0,32,32")))
+        quad_plain = run(handler.render_image_region(
+            _ctx(format="png", region="0,0,32,32")))
+        np.testing.assert_array_equal(
+            codecs.decode_to_rgba(quad_res0), codecs.decode_to_rgba(quad_plain))
+        # res 1 == the downsampled 32x32 level: same shape, different pixels.
         small = run(handler.render_image_region(
-            _ctx(format="png", tile="0,0,0")))
-        assert codecs.decode_to_rgba(small).shape == (H // 2, W // 2, 4)
+            _ctx(format="png", tile="1,0,0,32,32")))
+        small_rgba = codecs.decode_to_rgba(small)
+        assert small_rgba.shape == (H // 2, W // 2, 4)
+        assert not np.array_equal(small_rgba,
+                                  codecs.decode_to_rgba(quad_res0))
 
 
 class TestShapeMaskHandler:

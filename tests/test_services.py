@@ -65,7 +65,7 @@ class TestCacheStack:
         assert tier.get_sync("k") is None
 
     def test_caches_from_config_flags(self):
-        caches = Caches.from_config(CacheConfig(image_region=False))
+        caches = Caches.from_config(CacheConfig(pixels_metadata=True))
         assert caches.image_region.enabled is False
         assert caches.pixels_metadata.enabled is True
 

@@ -1,0 +1,155 @@
+"""Native runtime pieces: sharded LRU tile cache + pixel bit ops.
+
+C++ with a plain C ABI, loaded through ctypes (no pybind11 in this image).
+The shared library is compiled on first import with g++ into
+``_build/libtilecache.so`` next to this file; if no toolchain is available
+the import raises ImportError and callers fall back to pure Python
+(``services.cache.make_cache`` does exactly that).
+
+ctypes calls release the GIL, so cache traffic from render worker threads
+runs concurrently across shards — the point of having this tier in C++.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_SOURCE = os.path.join(_HERE, "tilecache.cpp")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libtilecache.so")
+_BUILD_LOCK = threading.Lock()
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _compile() -> None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", _LIB_PATH + ".tmp", _SOURCE,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _BUILD_LOCK:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SOURCE)):
+            try:
+                _compile()
+            except (OSError, subprocess.CalledProcessError) as e:
+                raise ImportError(f"native tilecache unavailable: {e}")
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.tc_create.restype = ctypes.c_void_p
+        lib.tc_create.argtypes = [ctypes.c_size_t, ctypes.c_uint]
+        lib.tc_destroy.argtypes = [ctypes.c_void_p]
+        lib.tc_put.restype = ctypes.c_int
+        lib.tc_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_size_t, ctypes.c_char_p,
+                               ctypes.c_size_t]
+        lib.tc_get.restype = ctypes.c_longlong
+        lib.tc_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_size_t,
+                               ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.tc_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        for fn in ("tc_hits", "tc_misses", "tc_size_bytes"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.bits_unpack_msb.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                        ctypes.c_char_p]
+        lib.flip_u32.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_int, ctypes.c_int,
+                                 ctypes.c_int, ctypes.c_int]
+        _lib = lib
+        return lib
+
+
+class NativeLRUCache:
+    """CacheTier over the C++ sharded LRU (drop-in for MemoryLRUCache)."""
+
+    def __init__(self, max_bytes: int = 256 * 1024 * 1024,
+                 shards: int = 16):
+        lib = _load()
+        self._lib = lib
+        self._handle = lib.tc_create(max_bytes, shards)
+        if not self._handle:
+            raise MemoryError("tc_create failed")
+        self.max_bytes = max_bytes
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.tc_destroy(handle)
+            self._handle = None
+
+    # -- sync face (executor threads; GIL released inside the C calls) ----
+
+    def get_sync(self, key: str) -> Optional[bytes]:
+        kb = key.encode()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.tc_get(self._handle, kb, len(kb), ctypes.byref(out))
+        if n < 0:
+            return None
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.tc_free(out)
+
+    def set_sync(self, key: str, value: bytes) -> None:
+        kb = key.encode()
+        self._lib.tc_put(self._handle, kb, len(kb), value, len(value))
+
+    # -- async face (CacheTier protocol) ----------------------------------
+
+    async def get(self, key: str) -> Optional[bytes]:
+        return self.get_sync(key)
+
+    async def set(self, key: str, value: bytes) -> None:
+        self.set_sync(key, value)
+
+    # -- stats ------------------------------------------------------------
+
+    @property
+    def hits(self) -> int:
+        return int(self._lib.tc_hits(self._handle))
+
+    @property
+    def misses(self) -> int:
+        return int(self._lib.tc_misses(self._handle))
+
+    @property
+    def size_bytes(self) -> int:
+        return int(self._lib.tc_size_bytes(self._handle))
+
+
+def unpack_bits_msb(data: bytes, n_bits: int):
+    """MSB-first 1-bit unpack to a u8 0/1 array (native fast path)."""
+    import numpy as np
+    lib = _load()
+    out = np.empty(n_bits, dtype=np.uint8)
+    lib.bits_unpack_msb(data, n_bits,
+                        out.ctypes.data_as(ctypes.c_char_p))
+    return out
+
+
+def flip_u32(packed, flip_horizontal: bool, flip_vertical: bool):
+    """Native single-pass flip of a u32[H, W] packed image."""
+    import numpy as np
+    lib = _load()
+    src = np.ascontiguousarray(packed, dtype=np.uint32)
+    h, w = src.shape
+    dst = np.empty_like(src)
+    lib.flip_u32(src.ctypes.data, dst.ctypes.data, h, w,
+                 int(flip_horizontal), int(flip_vertical))
+    return dst

@@ -1,0 +1,180 @@
+// Sharded LRU byte cache — the process-local tile-cache tier.
+//
+// Native analogue of the reference's shared byte cache role (omero-ms-core
+// RedisCacheVerticle + Hazelcast memo maps; SURVEY.md §2b).  The render
+// path calls this from Python worker threads through ctypes, which drops
+// the GIL for the duration of the call: gets/puts of megabyte tile bodies
+// run concurrently across shards instead of serializing on the interpreter
+// lock the way a pure-Python LRU does.
+//
+// C ABI only (no pybind11 in this image); every function is
+// exception-free.  Values are copied in and out — the cache owns its
+// memory, callers own theirs, and tc_free releases buffers returned by
+// tc_get.
+
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Entry {
+    std::string key;
+    std::vector<uint8_t> value;
+};
+
+class Shard {
+  public:
+    // list front = most recent; map points into the list.
+    std::mutex mu;
+    std::list<Entry> lru;
+    std::unordered_map<std::string, std::list<Entry>::iterator> index;
+    size_t bytes = 0;
+    uint64_t hits = 0, misses = 0;
+};
+
+class TileCache {
+  public:
+    TileCache(size_t max_bytes, unsigned n_shards)
+        : max_bytes_(max_bytes),
+          shards_(n_shards ? n_shards : 1) {}
+
+    Shard& shard_for(const std::string& key) {
+        return shards_[hasher_(key) % shards_.size()];
+    }
+
+    size_t shard_budget() const { return max_bytes_ / shards_.size(); }
+
+    size_t max_bytes_;
+    std::vector<Shard> shards_;
+    std::hash<std::string> hasher_;
+};
+
+void evict_to_budget(Shard& s, size_t budget) {
+    while (s.bytes > budget && !s.lru.empty()) {
+        Entry& victim = s.lru.back();
+        s.bytes -= victim.value.size();
+        s.index.erase(victim.key);
+        s.lru.pop_back();
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tc_create(size_t max_bytes, unsigned n_shards) {
+    return new (std::nothrow) TileCache(max_bytes, n_shards);
+}
+
+void tc_destroy(void* handle) {
+    delete static_cast<TileCache*>(handle);
+}
+
+int tc_put(void* handle, const char* key_data, size_t key_len,
+           const uint8_t* value, size_t value_len) {
+    auto* cache = static_cast<TileCache*>(handle);
+    if (!cache || !key_data) return -1;
+    std::string key(key_data, key_len);
+    Shard& s = cache->shard_for(key);
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.index.find(key);
+    if (it != s.index.end()) {
+        s.bytes -= it->second->value.size();
+        s.lru.erase(it->second);
+        s.index.erase(it);
+    }
+    s.lru.push_front(Entry{key, {value, value + value_len}});
+    s.index[key] = s.lru.begin();
+    s.bytes += value_len;
+    evict_to_budget(s, cache->shard_budget());
+    return 0;
+}
+
+// Returns value length and a malloc'd copy in *out (caller frees with
+// tc_free), or -1 on miss.
+long long tc_get(void* handle, const char* key_data, size_t key_len,
+                 uint8_t** out) {
+    auto* cache = static_cast<TileCache*>(handle);
+    if (!cache || !key_data || !out) return -1;
+    std::string key(key_data, key_len);
+    Shard& s = cache->shard_for(key);
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.index.find(key);
+    if (it == s.index.end()) {
+        ++s.misses;
+        return -1;
+    }
+    ++s.hits;
+    s.lru.splice(s.lru.begin(), s.lru, it->second);  // mark most-recent
+    const std::vector<uint8_t>& v = it->second->value;
+    uint8_t* copy = static_cast<uint8_t*>(malloc(v.size() ? v.size() : 1));
+    if (!copy) return -1;
+    if (!v.empty()) memcpy(copy, v.data(), v.size());
+    *out = copy;
+    return static_cast<long long>(v.size());
+}
+
+void tc_free(uint8_t* p) { free(p); }
+
+uint64_t tc_hits(void* handle) {
+    auto* cache = static_cast<TileCache*>(handle);
+    uint64_t n = 0;
+    for (Shard& s : cache->shards_) {
+        std::lock_guard<std::mutex> lock(s.mu);
+        n += s.hits;
+    }
+    return n;
+}
+
+uint64_t tc_misses(void* handle) {
+    auto* cache = static_cast<TileCache*>(handle);
+    uint64_t n = 0;
+    for (Shard& s : cache->shards_) {
+        std::lock_guard<std::mutex> lock(s.mu);
+        n += s.misses;
+    }
+    return n;
+}
+
+uint64_t tc_size_bytes(void* handle) {
+    auto* cache = static_cast<TileCache*>(handle);
+    uint64_t n = 0;
+    for (Shard& s : cache->shards_) {
+        std::lock_guard<std::mutex> lock(s.mu);
+        n += s.bytes;
+    }
+    return n;
+}
+
+// ---------------------------------------------------------------- bit ops
+
+// MSB-first 1-bit unpack (ome.util.PixelData "bit" order): n output bytes
+// of 0/1 from ceil(n/8) packed input bytes.
+void bits_unpack_msb(const uint8_t* src, size_t n_bits, uint8_t* dst) {
+    for (size_t i = 0; i < n_bits; ++i) {
+        dst[i] = (src[i >> 3] >> (7 - (i & 7))) & 1;
+    }
+}
+
+// Flip a packed u32 image in place-free form (the reference's CPU flip,
+// ImageRegionRequestHandler.java:616-642, as a single native pass).
+void flip_u32(const uint32_t* src, uint32_t* dst, int height, int width,
+              int flip_horizontal, int flip_vertical) {
+    for (int y = 0; y < height; ++y) {
+        int sy = flip_vertical ? height - 1 - y : y;
+        const uint32_t* row = src + static_cast<size_t>(sy) * width;
+        uint32_t* out = dst + static_cast<size_t>(y) * width;
+        if (flip_horizontal) {
+            for (int x = 0; x < width; ++x) out[x] = row[width - 1 - x];
+        } else {
+            memcpy(out, row, static_cast<size_t>(width) * 4);
+        }
+    }
+}
+
+}  // extern "C"

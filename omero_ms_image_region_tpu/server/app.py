@@ -47,7 +47,11 @@ CONFIG_KEY = web.AppKey("config", object)
 
 
 def _make_session_store(config: AppConfig) -> Optional[SessionStore]:
-    if config.session_store_type == "redis" and config.session_store_uri:
+    if config.session_store_type == "redis":
+        if not config.session_store_uri:
+            log.warning("session-store.type is 'redis' but no uri is "
+                        "configured; sessions disabled")
+            return None
         try:
             return DjangoRedisSessionStore(config.session_store_uri)
         except ImportError:

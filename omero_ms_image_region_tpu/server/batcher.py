@@ -146,6 +146,15 @@ class BatchingRenderer:
             try:
                 results = await asyncio.to_thread(
                     self._render_group, group)
+            except asyncio.CancelledError:
+                # close() cancelled us mid-dispatch: the group is already
+                # popped, so the queue drain in close() can't see it —
+                # fail its futures here before propagating.
+                for p in group:
+                    if not p.future.done():
+                        p.future.set_exception(
+                            asyncio.CancelledError("renderer shut down"))
+                raise
             except Exception as e:  # propagate to every waiter
                 for p in group:
                     if not p.future.done():

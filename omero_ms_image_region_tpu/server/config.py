@@ -75,9 +75,10 @@ class AppConfig:
                             .get("enabled", False)),
         )
         batcher = raw.get("batcher", {}) or {}
+        defaults = BatcherConfig()
         cfg.batcher = BatcherConfig(
-            enabled=bool(batcher.get("enabled", True)),
-            max_batch=int(batcher.get("max-batch", 8)),
-            linger_ms=float(batcher.get("linger-ms", 2.0)),
+            enabled=bool(batcher.get("enabled", defaults.enabled)),
+            max_batch=int(batcher.get("max-batch", defaults.max_batch)),
+            linger_ms=float(batcher.get("linger-ms", defaults.linger_ms)),
         )
         return cfg

@@ -178,6 +178,10 @@ class ImageRegionHandler:
                 list(d) for d in src.resolution_descriptions()]
         else:
             levels = [[pixels.size_x, pixels.size_y]]
+        if ctx.resolution is not None and not (
+                0 <= ctx.resolution < len(levels)):
+            raise BadRequestError(
+                f"Resolution {ctx.resolution} not within [0, {len(levels)})")
 
         region = get_region_def(
             levels, ctx.resolution, ctx.tile, ctx.region, src.tile_size(),

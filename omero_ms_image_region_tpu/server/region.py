@@ -115,11 +115,9 @@ def get_region_def(
     return out
 
 
-def select_resolution_level(n_levels: int,
-                            resolution: Optional[int]) -> Optional[int]:
-    """Invert the request's resolution index into the pyramid's level order
-    (= setResolutionLevel, ``:845-852``: OMERO requests count 0 = smallest,
-    buffers count 0 = largest)."""
-    if resolution is None:
-        return None
-    return n_levels - resolution - 1
+# NOTE: the reference's setResolutionLevel inversion (``level = n - res - 1``,
+# ``:845-852``) is deliberately NOT reproduced here: it converts between the
+# largest-first descriptions order and OMERO's smallest-first PixelBuffer
+# level order.  Our PixelSource numbers levels largest-first like the
+# descriptions, so the request resolution IS the read level (see
+# ImageRegionHandler._get_region).

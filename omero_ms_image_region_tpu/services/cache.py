@@ -16,10 +16,18 @@ still get the local tier.
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol
+
+log = logging.getLogger("omero_ms_image_region_tpu.cache")
+
+# Rate limit for tier-failure warnings (one per tier per interval) so an
+# outage is visible in logs without flooding them at request rate.
+_WARN_INTERVAL_S = 30.0
 
 
 class CacheTier(Protocol):
@@ -107,6 +115,14 @@ class CacheStack:
     def __init__(self, tiers: List[CacheTier], enabled: bool = True):
         self.tiers = tiers
         self.enabled = enabled
+        self._last_warn: Dict[int, float] = {}
+
+    def _warn_tier(self, i: int, op: str, e: Exception) -> None:
+        now = time.monotonic()
+        if now - self._last_warn.get(i, 0.0) >= _WARN_INTERVAL_S:
+            self._last_warn[i] = now
+            log.warning("cache tier %d (%s) %s failed, degrading: %s",
+                        i, type(self.tiers[i]).__name__, op, e)
 
     async def get(self, key: str) -> Optional[bytes]:
         if not self.enabled:
@@ -114,22 +130,27 @@ class CacheStack:
         for i, tier in enumerate(self.tiers):
             try:
                 value = await tier.get(key)
-            except Exception:
+            except Exception as e:
+                self._warn_tier(i, "get", e)
                 continue
             if value is not None:
                 for upper in self.tiers[:i]:
                     try:
                         await upper.set(key, value)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        self._warn_tier(self.tiers.index(upper), "set", e)
                 return value
         return None
 
     async def set(self, key: str, value: bytes) -> None:
         if not self.enabled:
             return
-        await asyncio.gather(*(t.set(key, value) for t in self.tiers),
-                             return_exceptions=True)
+        results = await asyncio.gather(
+            *(t.set(key, value) for t in self.tiers),
+            return_exceptions=True)
+        for i, r in enumerate(results):
+            if isinstance(r, Exception):
+                self._warn_tier(i, "set", r)
 
 
 @dataclass

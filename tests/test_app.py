@@ -128,6 +128,14 @@ class TestStatusMapping:
             data_dir, ("GET", "/webgateway/render_shape_mask/999"))
         assert status == 404
 
+    def test_resolution_out_of_range_400(self, data_dir):
+        for res in (-1, 9):
+            [(status, _, _)] = client_fetch(
+                data_dir,
+                ("GET", f"/webgateway/render_image_region/{IMG}/0/0"
+                        f"?tile={res},0,0"))
+            assert status == 400
+
     def test_non_numeric_image_id_400(self, data_dir):
         [(status, _, _)] = client_fetch(
             data_dir, ("GET", "/webgateway/render_image_region/abc/0/0"))

@@ -1,0 +1,105 @@
+"""Native C++ tier: LRU cache semantics, bit ops, flip parity.
+
+Skipped wholesale when no g++ toolchain can build the shared library.
+"""
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip(
+    "omero_ms_image_region_tpu.native",
+    reason="native toolchain unavailable")
+
+
+class TestNativeLRUCache:
+    def test_round_trip(self):
+        cache = native.NativeLRUCache(max_bytes=1 << 20, shards=4)
+        assert cache.get_sync("missing") is None
+        cache.set_sync("k", b"hello world")
+        assert cache.get_sync("k") == b"hello world"
+        assert cache.hits == 1
+        assert cache.misses == 1
+
+    def test_overwrite(self):
+        cache = native.NativeLRUCache(max_bytes=1 << 20)
+        cache.set_sync("k", b"a" * 100)
+        cache.set_sync("k", b"b")
+        assert cache.get_sync("k") == b"b"
+
+    def test_eviction_under_budget(self):
+        # Single shard so the LRU order is deterministic.
+        cache = native.NativeLRUCache(max_bytes=1000, shards=1)
+        for i in range(100):
+            cache.set_sync(f"k{i}", b"x" * 100)
+        assert cache.size_bytes <= 1000
+        assert cache.get_sync("k99") == b"x" * 100
+        assert cache.get_sync("k0") is None
+
+    def test_lru_recency(self):
+        cache = native.NativeLRUCache(max_bytes=300, shards=1)
+        cache.set_sync("a", b"x" * 100)
+        cache.set_sync("b", b"y" * 100)
+        cache.get_sync("a")                   # a most-recent
+        cache.set_sync("c", b"z" * 150)       # evicts b, not a
+        assert cache.get_sync("a") is not None
+        assert cache.get_sync("b") is None
+
+    def test_empty_value(self):
+        cache = native.NativeLRUCache()
+        cache.set_sync("empty", b"")
+        assert cache.get_sync("empty") == b""
+
+    def test_many_shards_consistent(self):
+        cache = native.NativeLRUCache(max_bytes=1 << 22, shards=16)
+        blobs = {f"key-{i}": bytes([i % 256]) * (i + 1) for i in range(500)}
+        for k, v in blobs.items():
+            cache.set_sync(k, v)
+        for k, v in blobs.items():
+            assert cache.get_sync(k) == v
+
+    def test_concurrent_access(self):
+        import threading
+        cache = native.NativeLRUCache(max_bytes=1 << 22, shards=8)
+        errors = []
+
+        def worker(tid):
+            try:
+                for i in range(200):
+                    key = f"t{tid}-{i}"
+                    cache.set_sync(key, key.encode() * 50)
+                    got = cache.get_sync(key)
+                    assert got == key.encode() * 50
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+class TestNativeBitOps:
+    def test_unpack_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, size=100, dtype=np.uint8).tobytes()
+        for n_bits in (1, 7, 8, 9, 640, 799):
+            expected = np.unpackbits(
+                np.frombuffer(data, np.uint8))[:n_bits]
+            got = native.unpack_bits_msb(data, n_bits)
+            np.testing.assert_array_equal(got, expected)
+
+    def test_flip_u32_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        img = rng.integers(0, 2**32, size=(33, 57), dtype=np.uint32)
+        for fh in (False, True):
+            for fv in (False, True):
+                expected = img
+                if fv:
+                    expected = expected[::-1]
+                if fh:
+                    expected = expected[:, ::-1]
+                np.testing.assert_array_equal(
+                    native.flip_u32(img, fh, fv), expected)

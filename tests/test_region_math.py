@@ -8,7 +8,6 @@ from omero_ms_image_region_tpu.server.region import (
     clamp_region_to_plane,
     flip_region,
     get_region_def,
-    select_resolution_level,
     truncate_region,
 )
 
@@ -114,12 +113,15 @@ def test_flip_mirror_y_edge_non_aligned():
     assert rd.as_tuple() == (0, 0, 512, 256)
 
 
-def test_select_resolution_inversion():
-    # testSelectResolution: request res counts from smallest; buffer level
-    # counts from largest: level = n - res - 1.
-    assert select_resolution_level(6, 2) == 3
-    assert select_resolution_level(1, 0) == 0
-    assert select_resolution_level(6, None) is None
+def test_region_def_indexes_levels_largest_first():
+    # The reference's testSelectResolution: a largest-first level list is
+    # indexed directly by the request resolution (its n-res-1 inversion is
+    # buffer-order-specific and intentionally absent here; see
+    # server.region NOTE).
+    levels = [[1024, 1024], [256, 512]]
+    rd = get_region_def(levels, 1, None, RegionDef(100, 200, 400, 500),
+                        (800, 800), MAX_TILE)
+    assert rd.as_tuple() == (100, 200, 256 - 100, 512 - 200)
 
 
 def test_clamp_region_to_plane():

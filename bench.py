@@ -6,7 +6,8 @@ faithful CPU implementation of the Java ``Renderer`` semantics
 
 Headline metric (BASELINE.json): tiles/sec on 4-channel uint16 1024x1024
 tiles (config 3, batched deep-zoom pan).  ``vs_baseline`` = TPU tiles/sec
-divided by CPU-reference tiles/sec on identical tiles.
+divided by CPU-reference tiles/sec on identical tiles.  The other four
+configs report as extras in the same JSON line.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
@@ -22,73 +23,201 @@ import time
 import numpy as np
 
 
-def bench_tpu(raw_batches, settings, repeats=3):
-    """End-to-end device tiles/sec: host->HBM, render, RGBA->host."""
-    from omero_ms_image_region_tpu.flagship import batched_args
-    from omero_ms_image_region_tpu.ops.render import (
-        render_tile_batch_packed, unpack_rgba,
+def _settings_for(C, ptype="uint16", window=(100.0, 40000.0), model="rgb"):
+    from omero_ms_image_region_tpu.flagship import FLAGSHIP_COLORS
+    from omero_ms_image_region_tpu.models.pixels import Pixels
+    from omero_ms_image_region_tpu.models.rendering import (
+        RenderingModel, default_rendering_def,
     )
+    from omero_ms_image_region_tpu.ops.render import pack_settings
 
-    args_suffix = batched_args(settings, raw_batches[0])[1:]
-    # Warm-up / compile.
-    out = render_tile_batch_packed(raw_batches[0], *args_suffix)
-    np.asarray(out)
+    pixels = Pixels(image_id=1, pixels_type=ptype, size_x=8192, size_y=8192,
+                    size_c=C)
+    rdef = default_rendering_def(pixels)
+    rdef.model = (RenderingModel.RGB if model == "rgb"
+                  else RenderingModel.GREYSCALE)
+    for i, cb in enumerate(rdef.channel_bindings):
+        cb.active = True
+        cb.red, cb.green, cb.blue = FLAGSHIP_COLORS[i % len(FLAGSHIP_COLORS)]
+        cb.input_start, cb.input_end = window
+    return rdef, pack_settings(rdef)
 
+
+def _timed(fn, *args, repeats=3, warmup=True):
+    """Best-of-N wall time for fn(*args) with one warm-up call."""
+    if warmup:
+        fn(*args)
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        outs = [render_tile_batch_packed(raw, *args_suffix)
-                for raw in raw_batches]
-        for o in outs:
-            unpack_rgba(np.asarray(o))  # sync + fetch + host RGBA view
+        fn(*args)
         times.append(time.perf_counter() - t0)
-    total_tiles = sum(r.shape[0] for r in raw_batches)
-    best = min(times)
-    # p50 per-batch dispatch latency.
-    lat = []
-    for raw in raw_batches * 2:
-        t0 = time.perf_counter()
-        np.asarray(render_tile_batch_packed(raw, *args_suffix))
-        lat.append((time.perf_counter() - t0) * 1000.0)
-    return total_tiles / best, statistics.median(lat)
+    return min(times)
 
 
-def bench_cpu_ref(raw, rdef, max_seconds=20.0):
-    """CPU-reference tiles/sec on identical tiles (>=1 rendered)."""
+# ----------------------------------------------------------- config 3 (HEAD)
+
+def bench_flagship(rng):
+    """4-ch uint16 1024^2 batched pan: tiles/sec TPU vs CPU ref + p50."""
+    from omero_ms_image_region_tpu.flagship import (
+        batched_args, flagship_settings,
+    )
+    from omero_ms_image_region_tpu.ops.render import (
+        render_tile_batch_packed, unpack_rgba,
+    )
     from omero_ms_image_region_tpu.refimpl import render_ref
 
-    n, t0 = 0, time.perf_counter()
-    while True:
-        render_ref(raw[n % raw.shape[0]], rdef)
-        n += 1
-        dt = time.perf_counter() - t0
-        if dt > max_seconds or n >= 32:
-            return n / dt
-
-
-def main():
-    from omero_ms_image_region_tpu.flagship import flagship_settings
-
     rdef, settings = flagship_settings()
-    rng = np.random.default_rng(7)
     B, C, H, W = 8, 4, 1024, 1024
     n_batches = 4
     raw_batches = [
         rng.integers(0, 65535, size=(B, C, H, W)).astype(np.float32)
         for _ in range(n_batches)
     ]
+    args_suffix = batched_args(settings, raw_batches[0])[1:]
+    np.asarray(render_tile_batch_packed(raw_batches[0], *args_suffix))
 
-    tiles_per_sec, p50_ms = bench_tpu(raw_batches, settings)
-    cpu_tps = bench_cpu_ref(raw_batches[0], rdef)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [render_tile_batch_packed(raw, *args_suffix)
+                for raw in raw_batches]
+        for o in outs:
+            unpack_rgba(np.asarray(o))  # sync + fetch + host RGBA view
+        times.append(time.perf_counter() - t0)
+    tiles_per_sec = (B * n_batches) / min(times)
+
+    lat = []
+    for raw in raw_batches * 2:
+        t0 = time.perf_counter()
+        np.asarray(render_tile_batch_packed(raw, *args_suffix))
+        lat.append((time.perf_counter() - t0) * 1000.0)
+    p50_batch_ms = statistics.median(lat)
+
+    # CPU reference on identical tiles (>=1 tile, capped wall time).
+    n, t0 = 0, time.perf_counter()
+    while True:
+        render_ref(raw_batches[0][n % B], rdef)
+        n += 1
+        dt = time.perf_counter() - t0
+        if dt > 15.0 or n >= 32:
+            break
+    cpu_tps = n / dt
+    return tiles_per_sec, p50_batch_ms, cpu_tps
+
+
+# -------------------------------------------------------------- config 1
+
+def bench_config1(rng):
+    """1-ch uint8 256^2 linear tile: single-tile renders/sec, both paths."""
+    from omero_ms_image_region_tpu.ops.render import render_tile_packed
+    from omero_ms_image_region_tpu.refimpl import render_ref
+
+    rdef, s = _settings_for(1, ptype="uint8", window=(0.0, 255.0),
+                            model="greyscale")
+    raw = rng.integers(0, 255, size=(1, 256, 256)).astype(np.float32)
+
+    def tpu():
+        np.asarray(render_tile_packed(
+            raw, s["window_start"], s["window_end"], s["family"],
+            s["coefficient"], s["reverse"], s["cd_start"], s["cd_end"],
+            s["tables"]))
+
+    t_tpu = _timed(tpu, repeats=20)
+    t_cpu = _timed(lambda: render_ref(raw, rdef), repeats=5)
+    return 1.0 / t_tpu, 1.0 / t_cpu
+
+
+# -------------------------------------------------------------- config 2
+
+def bench_config2(rng):
+    """3-ch uint16 full plane (2048^2) window+color composite."""
+    from omero_ms_image_region_tpu.ops.render import render_tile_packed
+
+    _, s = _settings_for(3)
+    raw = rng.integers(0, 65535, size=(3, 2048, 2048)).astype(np.float32)
+
+    def tpu():
+        np.asarray(render_tile_packed(
+            raw, s["window_start"], s["window_end"], s["family"],
+            s["coefficient"], s["reverse"], s["cd_start"], s["cd_end"],
+            s["tables"]))
+
+    return 1.0 / _timed(tpu, repeats=5)
+
+
+# -------------------------------------------------------------- config 4
+
+def bench_config4(rng):
+    """intmax Z-projection over a 32-plane 3-ch 512^2 stack + render."""
+    from omero_ms_image_region_tpu.models.rendering import Projection
+    from omero_ms_image_region_tpu.ops.projection import project_stack
+    from omero_ms_image_region_tpu.ops.render import render_tile_packed
+
+    _, s = _settings_for(3)
+    stacks = rng.integers(0, 65535, size=(3, 32, 512, 512)).astype(
+        np.float32)
+
+    def run():
+        planes = [project_stack(stacks[c], Projection.MAXIMUM_INTENSITY,
+                                0, 31, 1, 65535.0) for c in range(3)]
+        raw = np.stack([np.asarray(p) for p in planes])
+        np.asarray(render_tile_packed(
+            raw, s["window_start"], s["window_end"], s["family"],
+            s["coefficient"], s["reverse"], s["cd_start"], s["cd_end"],
+            s["tables"]))
+
+    return 1.0 / _timed(run, repeats=5)
+
+
+# -------------------------------------------------------------- config 5
+
+def bench_config5(rng):
+    """Batched mask rasterize + alpha overlay over rendered tiles."""
+    from omero_ms_image_region_tpu.models.mask import Mask
+    from omero_ms_image_region_tpu.ops.maskops import (
+        overlay_masks_batch, unpack_mask_bits,
+    )
+
+    B, H, W = 16, 512, 512
+    masks = [
+        Mask(shape_id=i, width=W, height=H,
+             bytes_=np.packbits(
+                 rng.integers(0, 2, size=H * W).astype(np.uint8)).tobytes())
+        for i in range(B)
+    ]
+    base = rng.integers(0, 255, size=(B, H, W, 4)).astype(np.uint8)
+    fills = rng.integers(0, 255, size=(B, 4)).astype(np.uint8)
+
+    def run():
+        grids = np.stack([unpack_mask_bits(m.bytes_, W, H) for m in masks])
+        overlay_masks_batch(base, grids, fills)
+
+    return B / _timed(run, repeats=3)
+
+
+def main():
+    rng = np.random.default_rng(7)
+
+    tiles_per_sec, p50_batch_ms, cpu_tps = bench_flagship(rng)
+    c1_tpu, c1_cpu = bench_config1(rng)
+    c2_planes = bench_config2(rng)
+    c4_projections = bench_config4(rng)
+    c5_masks = bench_config5(rng)
 
     print(json.dumps({
         "metric": "render_tiles_per_sec_1024sq_4ch_u16",
         "value": round(tiles_per_sec, 2),
         "unit": "tiles/s",
         "vs_baseline": round(tiles_per_sec / cpu_tps, 2),
-        "p50_batch_ms": round(p50_ms, 2),
+        "p50_batch_ms": round(p50_batch_ms, 2),
         "cpu_ref_tiles_per_sec": round(cpu_tps, 2),
-        "batch": B,
+        "batch": 8,
+        "config1_tile256_u8_per_sec": round(c1_tpu, 2),
+        "config1_cpu_ref_per_sec": round(c1_cpu, 2),
+        "config2_fullplane_2048_3ch_per_sec": round(c2_planes, 2),
+        "config4_zproj32_3ch_512_per_sec": round(c4_projections, 2),
+        "config5_mask_overlay_512_per_sec": round(c5_masks, 2),
     }))
 
 

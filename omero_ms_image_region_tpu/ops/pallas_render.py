@@ -1,0 +1,147 @@
+"""Pallas TPU kernel for the fused tile render.
+
+Alternative device path to ``ops.render``'s XLA-fused gather: the whole
+pipeline — per-channel window/family quantization, reverse-intensity, color
+table application, additive composite, u32 pack — runs in one pallas kernel
+per (batch, row-block) grid step, with the color lookup expressed as a
+**one-hot contraction on the MXU** instead of a gather:
+
+    onehot(q)[N, 256] @ table[256, 3]  ==  table[q]
+
+The VPU builds the one-hot by comparing q against a [256]-iota; the MXU
+contracts it with the channel's 256x3 table.  At 256 classes that is
+256x2 FLOPs per pixel-component — trivial against the MXU's throughput —
+and it avoids dynamic-index gathers, which TPUs have no vector unit for.
+
+Everything stays in VMEM for a row block: raw f32[C, bh, W], tables
+f32[C*256, 3 padded], out u32[bh, W].  Settings are per-channel scalars
+prefetched to SMEM.
+
+Used when ``jax.default_backend() == "tpu"`` (interpret mode covers CPU
+tests); ``ops.render`` remains the portable reference path.  Replaces the
+same reference surface (``Renderer.renderAsPackedInt``,
+``ImageRegionRequestHandler.java:559``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Row-block height per grid step; W is never blocked (tiles are <= 2048
+# wide and a full row keeps the lane dim dense).
+_BLOCK_H = 256
+
+
+def _render_kernel(ws_ref, we_ref, fam_ref, coef_ref, rev_ref, cd_ref,
+                   raw_ref, tables_ref, out_ref):
+    """One (batch, row-block) grid step.
+
+    raw_ref:    f32[C, bh, W]       (VMEM)
+    tables_ref: f32[C, 256, 128]    (VMEM; only cols 0..2 are live)
+    out_ref:    u32[bh, W]          (VMEM)
+    scalars (SMEM, prefetched): ws/we/fam/coef/rev f32|i32[C], cd i32[2]
+    """
+    C, bh, W = raw_ref.shape
+    cd_start = cd_ref[0]
+    cd_end = cd_ref[1]
+    k_max = (cd_end - cd_start).astype(jnp.float32)
+
+    acc_r = jnp.zeros((bh, W), jnp.float32)
+    acc_g = jnp.zeros((bh, W), jnp.float32)
+    acc_b = jnp.zeros((bh, W), jnp.float32)
+
+    for c in range(C):  # C is a static block dim: unrolled at trace time
+        x = raw_ref[c]
+        ws = ws_ref[c]
+        we = we_ref[c]
+        fam = fam_ref[c]
+        k = coef_ref[c]
+
+        # Window normalize (clamped), then the family curve — the same
+        # closed forms as ops.quantum.quantize.
+        denom = jnp.where(we - ws == 0.0, 1.0, we - ws)
+        ratio = jnp.clip((x - ws) / denom, 0.0, 1.0)
+        poly = jnp.sign(ratio) * jnp.power(jnp.abs(ratio), k)
+        log_r = jnp.log1p(ratio * (jnp.e - 1.0))           # maps [0,1]->[0,1]
+        expo = jnp.power(jnp.exp(jnp.power(ratio, k)) - 1.0,
+                         1.0) / (jnp.e - 1.0)
+        curved = jnp.where(
+            fam == 0, ratio,
+            jnp.where(fam == 1, poly,
+                      jnp.where(fam == 2, log_r, expo)))
+        q = cd_start.astype(jnp.float32) + k_max * curved
+        q = jnp.round(q)
+        # Reverse-intensity codomain op.
+        q = jnp.where(rev_ref[c] != 0,
+                      (cd_start + cd_end).astype(jnp.float32) - q, q)
+        q = jnp.clip(q, 0.0, 255.0)
+
+        # One-hot contraction on the MXU: [bh*W, 256] @ [256, 128].
+        qi = q.reshape(bh * W, 1)
+        classes = jax.lax.broadcasted_iota(jnp.float32, (1, 256), 1)
+        onehot = (qi == classes).astype(jnp.float32)
+        rgb = jnp.dot(onehot, tables_ref[c],
+                      preferred_element_type=jnp.float32)
+        acc_r += rgb[:, 0].reshape(bh, W)
+        acc_g += rgb[:, 1].reshape(bh, W)
+        acc_b += rgb[:, 2].reshape(bh, W)
+
+    r = jnp.clip(jnp.round(acc_r), 0.0, 255.0).astype(jnp.uint32)
+    g = jnp.clip(jnp.round(acc_g), 0.0, 255.0).astype(jnp.uint32)
+    b = jnp.clip(jnp.round(acc_b), 0.0, 255.0).astype(jnp.uint32)
+    out_ref[:] = r | (g << 8) | (b << 16) | jnp.uint32(0xFF000000)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def render_tile_batch_packed_pallas(raw, window_start, window_end, family,
+                                    coefficient, reverse, cd_start, cd_end,
+                                    tables, *, interpret=False):
+    """Pallas fused batched render: f32[B, C, H, W] -> u32[B, H, W].
+
+    Same contract as ``ops.render.render_tile_batch_packed`` except the
+    per-channel settings are shared across the batch (the batcher keys
+    groups by settings when using this path), so they arrive unbatched:
+    window_start/window_end/coefficient f32[C], family/reverse i32[C],
+    tables f32[C, 256, 3].
+    """
+    B, C, H, W = raw.shape
+    bh = min(_BLOCK_H, H)
+    assert H % bh == 0, (H, bh)
+
+    # Pad table color axis 3 -> 128 so the MXU contraction output is
+    # lane-aligned; dead columns contract to zeros.
+    tables_padded = jnp.zeros((C, 256, 128), jnp.float32)
+    tables_padded = tables_padded.at[:, :, :3].set(
+        tables.astype(jnp.float32))
+    cd = jnp.stack([jnp.asarray(cd_start, jnp.int32),
+                    jnp.asarray(cd_end, jnp.int32)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(B, H // bh),
+        in_specs=[
+            pl.BlockSpec((1, C, bh, W), lambda b, h, *_: (b, 0, h, 0)),
+            pl.BlockSpec((C, 256, 128), lambda b, h, *_: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bh, W), lambda b, h, *_: (b, h, 0)),
+    )
+
+    def kernel(ws, we, fam, coef, rev, cdv, raw_blk, tab_blk, out_blk):
+        _render_kernel(ws, we, fam, coef, rev, cdv,
+                       raw_blk[0], tab_blk, out_blk[0])
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, W), jnp.uint32),
+        interpret=interpret,
+    )(window_start.astype(jnp.float32), window_end.astype(jnp.float32),
+      family.astype(jnp.int32), coefficient.astype(jnp.float32),
+      reverse.astype(jnp.int32), cd,
+      raw.astype(jnp.float32), tables_padded)

@@ -1,0 +1,44 @@
+"""YAML config loading: reference key names, defaults, example file."""
+
+import os
+
+from omero_ms_image_region_tpu.server.config import AppConfig, BatcherConfig
+
+EXAMPLE = os.path.join(os.path.dirname(__file__), "..", "conf",
+                       "config.example.yaml")
+
+
+class TestAppConfig:
+    def test_example_file_loads(self):
+        cfg = AppConfig.from_yaml(EXAMPLE)
+        assert cfg.port == 8080
+        assert cfg.data_dir == "./data"
+        assert cfg.max_tile_length == 2048
+        assert cfg.lut_root == "/opt/omero/lib/scripts"
+        assert cfg.session_cookie_name == "sessionid"
+        assert cfg.session_store_type == "static"
+        assert cfg.cache_control_header == "private, max-age=3600"
+        assert cfg.caches.image_region is True
+        assert cfg.caches.pixels_metadata is True
+        assert cfg.caches.shape_mask is True
+        assert cfg.batcher.enabled is True
+        assert cfg.batcher.max_batch == 8
+
+    def test_minimal_dict_gets_defaults(self):
+        cfg = AppConfig.from_dict({"port": 9999})
+        assert cfg.port == 9999
+        defaults = BatcherConfig()
+        assert cfg.batcher.max_batch == defaults.max_batch
+        assert cfg.batcher.linger_ms == defaults.linger_ms
+        # Reference ships caches disabled.
+        assert cfg.caches.image_region is False
+        assert cfg.caches.pixels_metadata is False
+
+    def test_cache_flags_and_redis_uri(self):
+        cfg = AppConfig.from_dict({
+            "redis-cache": {"uri": "redis://x:1/0"},
+            "image-region-cache": {"enabled": True},
+        })
+        assert cfg.caches.redis_uri == "redis://x:1/0"
+        assert cfg.caches.image_region is True
+        assert cfg.caches.shape_mask is False

@@ -20,7 +20,7 @@ XLA never has to guess the partitioning of the composite.
 
 from __future__ import annotations
 
-
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.quantum import quantize
 
+logger = logging.getLogger(__name__)
+
+
+def resolve_devices(n_devices: int | None = None):
+    """Devices for an ``n_devices``-wide mesh, falling back to the host mesh.
+
+    The default platform may be a single real TPU chip while a virtual
+    host-platform mesh (``xla_force_host_platform_device_count``) carries the
+    requested width — e.g. the driver's multi-chip dryrun, or test runs where
+    a TPU plugin wins the default platform slot.  The fallback is logged:
+    a CPU mesh run where a real accelerator mesh was expected should be
+    visible in the logs, not silent.
+    """
+    devices = jax.devices()
+    if n_devices is not None and len(devices) < n_devices:
+        try:
+            cpu_devices = jax.devices("cpu")
+        except RuntimeError:
+            cpu_devices = []
+        if len(cpu_devices) >= n_devices:
+            logger.warning(
+                "make_mesh: default platform %r has %d device(s) < %d "
+                "requested; using the %d-device virtual host (CPU) mesh",
+                devices[0].platform if devices else "?", len(devices),
+                n_devices, len(cpu_devices),
+            )
+            devices = cpu_devices
+    return devices
+
 
 def make_mesh(n_devices: int | None = None, chan_parallel: int = 1,
               devices=None) -> Mesh:
@@ -50,9 +79,14 @@ def make_mesh(n_devices: int | None = None, chan_parallel: int = 1,
     the devices replicate that group over the batch.
     """
     if devices is None:
-        devices = jax.devices()
+        devices = resolve_devices(n_devices)
     if n_devices is None:
         n_devices = len(devices)
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"requested a {n_devices}-device mesh but only "
+            f"{len(devices)} device(s) are available"
+        )
     devices = np.asarray(devices[:n_devices])
     if n_devices % chan_parallel != 0:
         raise ValueError(

@@ -42,6 +42,30 @@ def flagship_settings(n_channels: int = 4) -> Tuple[RenderingDef, dict]:
     return rdef, pack_settings(rdef)
 
 
+def synthetic_wsi_tiles(rng: np.random.Generator, B: int, C: int,
+                        H: int, W: int, blobs: int = 12) -> np.ndarray:
+    """Synthetic microscopy-like uint16 tiles: cell blobs + sensor noise.
+
+    Gaussian blobs (separable outer products, so generation stays cheap at
+    1024^2) over a dim background with additive read noise — the content
+    class the 4-ch WSI benchmark config describes, rather than uniform
+    random noise, which no microscope produces and which no codec or cache
+    behaves representatively on.
+    """
+    cy = rng.uniform(0, H, size=(B, C, blobs, 1))
+    cx = rng.uniform(0, W, size=(B, C, blobs, 1))
+    s = rng.uniform(H / 40, H / 8, size=(B, C, blobs, 1))
+    amp = rng.uniform(5_000, 35_000, size=(B, C, blobs))
+    ys = np.exp(-((np.arange(H)[None, None, None, :] - cy) ** 2)
+                / (2 * s * s)).astype(np.float32)
+    xs = np.exp(-((np.arange(W)[None, None, None, :] - cx) ** 2)
+                / (2 * s * s)).astype(np.float32)
+    img = np.einsum("bcky,bckx,bck->bcyx", ys, xs,
+                    amp.astype(np.float32), optimize=True)
+    img += 200.0 + rng.normal(0, 300.0, size=img.shape)
+    return np.clip(img, 0, 65535).astype(np.uint16)
+
+
 def batched_args(settings: dict, raw: np.ndarray) -> tuple:
     """Splat packed settings into ``render_tile_batch_packed`` argument
     order, tiling per-channel settings across the batch dim of ``raw``."""

@@ -1,0 +1,303 @@
+"""Baseline JFIF entropy coder over device-produced JPEG coefficients.
+
+Pure-Python reference implementation of the serial half of baseline JPEG:
+per-image optimal Huffman table construction (ITU T.81 Annex K.2), DC
+prediction, AC run-length coding, bit packing with 0xFF byte stuffing, and
+JFIF/DQT/SOF0/DHT/SOS framing.  The native fast path
+(``native/jpegenc.cpp``) implements the identical deterministic algorithm;
+tests assert byte-for-byte equality between the two.
+
+Input contract (from :mod:`.ops.jpegenc`): zigzagged int16 coefficient
+blocks in raster order for one image — ``y[(H16*2)*(W16*2), 64]``,
+``cb[H16*W16, 64]``, ``cr[H16*W16, 64]`` where ``H16 = ceil(H/16)`` —
+assembled here into 4:2:0 interleaved MCUs (per T.81 A.2.3 the Y blocks of
+an MCU scan 2x2 left-to-right, top-to-bottom, then Cb, then Cr).
+
+The reference microservice's JPEG stage is CPU-side ``LocalCompress``
+(``ImageRegionRequestHandler.java:457-460,580-582``); this module plus the
+device DCT kernel replace it end to end.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .ops.jpegenc import quant_tables, zigzag_order
+
+
+# ------------------------------------------------------- huffman (K.2)
+
+def _code_sizes(freq: np.ndarray) -> np.ndarray:
+    """T.81 K.2 figure K.1: code length per symbol from frequencies.
+
+    ``freq`` has 257 entries; index 256 is the reserved pseudo-symbol with
+    frequency 1 guaranteeing no real symbol gets the all-ones code.
+    """
+    freq = freq.astype(np.int64).copy()
+    codesize = np.zeros(257, dtype=np.int32)
+    others = np.full(257, -1, dtype=np.int32)
+    while True:
+        nz = np.nonzero(freq > 0)[0]
+        if len(nz) < 2:
+            break
+        # v1 = least-frequency symbol, ties -> largest symbol value.
+        f = freq[nz]
+        v1 = nz[np.flatnonzero(f == f.min())[-1]]
+        rest = nz[nz != v1]
+        f2 = freq[rest]
+        v2 = rest[np.flatnonzero(f2 == f2.min())[-1]]
+        freq[v1] += freq[v2]
+        freq[v2] = 0
+        codesize[v1] += 1
+        while others[v1] != -1:
+            v1 = others[v1]
+            codesize[v1] += 1
+        others[v1] = v2
+        codesize[v2] += 1
+        while others[v2] != -1:
+            v2 = others[v2]
+            codesize[v2] += 1
+    return codesize
+
+
+def _limit_to_16(bits: np.ndarray) -> np.ndarray:
+    """T.81 K.2 figure K.3 ADJUST_BITS: cap code lengths at 16."""
+    bits = bits.copy()
+    i = len(bits) - 1
+    while i > 16:
+        if bits[i] > 0:
+            j = i - 2
+            while bits[j] == 0:
+                j -= 1
+            bits[i] -= 2
+            bits[i - 1] += 1
+            bits[j + 1] += 2
+            bits[j] -= 1
+        else:
+            i -= 1
+    # Remove the reserved pseudo-symbol's code (largest value, so it owns
+    # the longest code; K.2 figure K.3 final step).
+    i = 16
+    while bits[i] == 0:
+        i -= 1
+    bits[i] -= 1
+    return bits
+
+
+def build_huffman_table(freq256: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Optimal baseline Huffman table -> (BITS[1..16], HUFFVAL).
+
+    Returns ``bits`` i32[17] (index 0 unused) and the symbol list ordered
+    by (code length, symbol value) — the canonical DHT payload.
+    """
+    freq = np.zeros(257, dtype=np.int64)
+    freq[:256] = freq256
+    freq[256] = 1
+    codesize = _code_sizes(freq)
+    bits = np.zeros(33, dtype=np.int32)
+    for size in codesize[codesize > 0]:
+        bits[size] += 1
+    bits = _limit_to_16(bits)[:17]
+    order = np.argsort(codesize[:256] * 256 + np.arange(256), kind="stable")
+    huffval = np.array(
+        [s for s in order if codesize[s] > 0], dtype=np.uint8
+    )
+    return bits, huffval
+
+
+def _codes_from_table(bits: np.ndarray, huffval: np.ndarray):
+    """Canonical code assignment -> (code[symbol], length[symbol])."""
+    code_of = np.zeros(256, dtype=np.uint32)
+    len_of = np.zeros(256, dtype=np.int32)
+    code = 0
+    k = 0
+    for length in range(1, 17):
+        for _ in range(int(bits[length])):
+            code_of[huffval[k]] = code
+            len_of[huffval[k]] = length
+            code += 1
+            k += 1
+        code <<= 1
+    return code_of, len_of
+
+
+# ------------------------------------------------------- symbol stream
+
+def _category(v: int) -> int:
+    return int(v).bit_length() if v > 0 else int(-v).bit_length()
+
+
+def _mcu_block_indices(h16: int, w16: int):
+    """Per-MCU raster-order block index lists (y_blocks, chroma_index)."""
+    yw = w16 * 2
+    out = []
+    for my in range(h16):
+        for mx in range(w16):
+            ys = [
+                (2 * my) * yw + 2 * mx, (2 * my) * yw + 2 * mx + 1,
+                (2 * my + 1) * yw + 2 * mx, (2 * my + 1) * yw + 2 * mx + 1,
+            ]
+            out.append((ys, my * w16 + mx))
+    return out
+
+
+def _block_symbols(block: np.ndarray, pred: int):
+    """One zigzagged block -> (dc_symbol, dc_val, [(ac_symbol, val)...])."""
+    dc_diff = int(block[0]) - pred
+    acs = []
+    run = 0
+    nz = np.nonzero(block[1:])[0]
+    last = -1
+    for idx in nz:
+        run = int(idx) - last - 1
+        last = int(idx)
+        while run >= 16:
+            acs.append((0xF0, 0))
+            run -= 16
+        v = int(block[1 + idx])
+        acs.append(((run << 4) | _category(v), v))
+    if last != 62:
+        acs.append((0x00, 0))  # EOB
+    return _category(dc_diff), dc_diff, acs
+
+
+class _BitWriter:
+    def __init__(self):
+        self.out = bytearray()
+        self._acc = 0
+        self._nbits = 0
+
+    def put(self, code: int, length: int) -> None:
+        if length == 0:
+            return
+        self._acc = (self._acc << length) | (code & ((1 << length) - 1))
+        self._nbits += length
+        while self._nbits >= 8:
+            self._nbits -= 8
+            byte = (self._acc >> self._nbits) & 0xFF
+            self.out.append(byte)
+            if byte == 0xFF:
+                self.out.append(0x00)  # byte stuffing
+        self._acc &= (1 << self._nbits) - 1
+
+    def flush(self) -> None:
+        if self._nbits:
+            pad = 8 - self._nbits
+            self.put((1 << pad) - 1, pad)
+
+
+def _amplitude_bits(v: int, size: int) -> int:
+    return v if v >= 0 else v + (1 << size) - 1
+
+
+# ------------------------------------------------------- the encoder
+
+def _component_symbols(blocks: Sequence[np.ndarray]):
+    """Scan-ordered blocks -> per-block symbol records + freq tables."""
+    dc_freq = np.zeros(256, dtype=np.int64)
+    ac_freq = np.zeros(256, dtype=np.int64)
+    records = []
+    pred = 0
+    for block in blocks:
+        dc_sym, dc_val, acs = _block_symbols(block, pred)
+        pred = int(block[0])
+        dc_freq[dc_sym] += 1
+        for sym, _ in acs:
+            ac_freq[sym] += 1
+        records.append((dc_sym, dc_val, acs))
+    return records, dc_freq, ac_freq
+
+
+def _marker(tag: int, payload: bytes) -> bytes:
+    return bytes([0xFF, tag]) + (len(payload) + 2).to_bytes(2, "big") + payload
+
+
+def _dht_payload(cls: int, ident: int, bits: np.ndarray,
+                 huffval: np.ndarray) -> bytes:
+    return (bytes([(cls << 4) | ident])
+            + bytes(int(bits[i]) for i in range(1, 17))
+            + huffval.tobytes())
+
+
+def encode_jfif(y: np.ndarray, cb: np.ndarray, cr: np.ndarray,
+                width: int, height: int, quality: int = 85) -> bytes:
+    """Entropy-encode one image's coefficient blocks into a JFIF stream.
+
+    ``width``/``height`` are the true (pre-MCU-padding) dimensions written
+    into SOF0; the coefficient arrays cover the padded 16-aligned frame.
+    """
+    h16 = (height + 15) // 16
+    w16 = (width + 15) // 16
+    if y.shape[0] != h16 * w16 * 4 or cb.shape[0] != h16 * w16:
+        raise ValueError(
+            f"coefficient block counts {y.shape[0]}/{cb.shape[0]} do not "
+            f"match a {w16}x{h16}-MCU frame"
+        )
+    mcus = _mcu_block_indices(h16, w16)
+    y_scan = [y[i] for m in mcus for i in m[0]]
+    cb_scan = [cb[m[1]] for m in mcus]
+    cr_scan = [cr[m[1]] for m in mcus]
+
+    y_rec, y_dcf, y_acf = _component_symbols(y_scan)
+    cb_rec, c_dcf, c_acf = _component_symbols(cb_scan)
+    cr_rec, c_dcf2, c_acf2 = _component_symbols(cr_scan)
+    c_dcf += c_dcf2
+    c_acf += c_acf2
+
+    tables = {
+        ("dc", 0): build_huffman_table(y_dcf),
+        ("ac", 0): build_huffman_table(y_acf),
+        ("dc", 1): build_huffman_table(c_dcf),
+        ("ac", 1): build_huffman_table(c_acf),
+    }
+    codes = {k: _codes_from_table(*v) for k, v in tables.items()}
+
+    qy, qc = quant_tables(quality)
+    zig = zigzag_order()
+
+    out = bytearray()
+    out += b"\xff\xd8"  # SOI
+    out += _marker(0xE0, b"JFIF\x00\x01\x01\x00\x00\x01\x00\x01\x00\x00")
+    out += _marker(0xDB, bytes([0]) + qy.reshape(-1)[zig].tobytes())
+    out += _marker(0xDB, bytes([1]) + qc.reshape(-1)[zig].tobytes())
+    out += _marker(0xC0, bytes([8])                       # SOF0: baseline
+                   + height.to_bytes(2, "big") + width.to_bytes(2, "big")
+                   + bytes([3,
+                            1, 0x22, 0,     # Y: 2x2 sampling, qtable 0
+                            2, 0x11, 1,     # Cb: 1x1, qtable 1
+                            3, 0x11, 1]))   # Cr
+    out += _marker(0xC4, _dht_payload(0, 0, *tables[("dc", 0)]))
+    out += _marker(0xC4, _dht_payload(1, 0, *tables[("ac", 0)]))
+    out += _marker(0xC4, _dht_payload(0, 1, *tables[("dc", 1)]))
+    out += _marker(0xC4, _dht_payload(1, 1, *tables[("ac", 1)]))
+    out += _marker(0xDA, bytes([3, 1, 0x00, 2, 0x11, 3, 0x11, 0, 63, 0]))
+
+    w = _BitWriter()
+
+    def put_block(rec, dc_codes, ac_codes):
+        dc_sym, dc_val, acs = rec
+        c, l = dc_codes
+        w.put(int(c[dc_sym]), int(l[dc_sym]))
+        if dc_sym:
+            w.put(_amplitude_bits(dc_val, dc_sym), dc_sym)
+        c, l = ac_codes
+        for sym, v in acs:
+            w.put(int(c[sym]), int(l[sym]))
+            size = sym & 0x0F
+            if size:
+                w.put(_amplitude_bits(v, size), size)
+
+    yi = iter(y_rec)
+    cbi = iter(cb_rec)
+    cri = iter(cr_rec)
+    for _ in mcus:
+        for _ in range(4):
+            put_block(next(yi), codes[("dc", 0)], codes[("ac", 0)])
+        put_block(next(cbi), codes[("dc", 1)], codes[("ac", 1)])
+        put_block(next(cri), codes[("dc", 1)], codes[("ac", 1)])
+    w.flush()
+    out += w.out
+    out += b"\xff\xd9"  # EOI
+    return bytes(out)

@@ -22,19 +22,26 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_HERE, "_build")
 _SOURCE = os.path.join(_HERE, "tilecache.cpp")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libtilecache.so")
+_JPEG_SOURCE = os.path.join(_HERE, "jpegenc.cpp")
+_JPEG_LIB_PATH = os.path.join(_BUILD_DIR, "libjpegenc.so")
 _BUILD_LOCK = threading.Lock()
 
 _lib: Optional[ctypes.CDLL] = None
+_jpeg_lib: Optional[ctypes.CDLL] = None
 
 
-def _compile() -> None:
+def _compile_lib(source: str, lib_path: str) -> None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-o", _LIB_PATH + ".tmp", _SOURCE,
+        "-o", lib_path + ".tmp", source,
     ]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+    os.replace(lib_path + ".tmp", lib_path)
+
+
+def _compile() -> None:
+    _compile_lib(_SOURCE, _LIB_PATH)
 
 
 def _load() -> ctypes.CDLL:
@@ -141,6 +148,121 @@ def unpack_bits_msb(data: bytes, n_bits: int):
     lib.bits_unpack_msb(data, n_bits,
                         out.ctypes.data_as(ctypes.c_char_p))
     return out
+
+
+def _load_jpeg() -> ctypes.CDLL:
+    global _jpeg_lib
+    if _jpeg_lib is not None:
+        return _jpeg_lib
+    with _BUILD_LOCK:
+        if _jpeg_lib is not None:
+            return _jpeg_lib
+        if (not os.path.exists(_JPEG_LIB_PATH)
+                or os.path.getmtime(_JPEG_LIB_PATH)
+                < os.path.getmtime(_JPEG_SOURCE)):
+            try:
+                _compile_lib(_JPEG_SOURCE, _JPEG_LIB_PATH)
+            except (OSError, subprocess.CalledProcessError) as e:
+                raise ImportError(f"native jpeg encoder unavailable: {e}")
+        lib = ctypes.CDLL(_JPEG_LIB_PATH)
+        lib.jpeg_encode.restype = ctypes.c_longlong
+        lib.jpeg_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.jpeg_encode_sparse.restype = ctypes.c_longlong
+        lib.jpeg_encode_sparse.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        _jpeg_lib = lib
+        return lib
+
+
+class SparseOverflowError(ValueError):
+    """The device wire buffer dropped entries (content denser than cap)."""
+
+
+def jpeg_native_available() -> bool:
+    """Eagerly probe (and build) the native encoder.
+
+    The module-level symbols exist whether or not a toolchain does —
+    compilation is deferred to first use — so ``import`` success is NOT a
+    native-availability signal.  Fallback decisions must call this.
+    """
+    try:
+        _load_jpeg()
+        return True
+    except ImportError:
+        return False
+
+
+def jpeg_encode_native(y, cb, cr, width: int, height: int,
+                       quality: int) -> bytes:
+    """Entropy-encode device JPEG coefficients to a JFIF stream (C++).
+
+    ``y``/``cb``/``cr`` are the int16 zigzagged raster-order block arrays of
+    :func:`..ops.jpegenc.packed_to_jpeg_coefficients` for ONE image.  The
+    GIL is released inside the call, so a thread pool encodes a whole tile
+    batch concurrently.
+    """
+    import numpy as np
+    lib = _load_jpeg()
+    y = np.ascontiguousarray(y, dtype=np.int16)
+    cb = np.ascontiguousarray(cb, dtype=np.int16)
+    cr = np.ascontiguousarray(cr, dtype=np.int16)
+    h16, w16 = (height + 15) // 16, (width + 15) // 16
+    if (y.size != h16 * w16 * 4 * 64 or cb.size != h16 * w16 * 64
+            or cr.size != cb.size):
+        raise ValueError(
+            f"coefficient sizes {y.size}/{cb.size}/{cr.size} do not match "
+            f"a {w16}x{h16}-MCU frame"
+        )
+    # emit_jfif buffers internally and returns -needed on a short cap, at
+    # the price of a full re-encode — so start at a safe worst case
+    # (~4 bytes/coefficient covers even max-entropy tiles).
+    cap = (y.size + cb.size + cr.size) * 4 + 4096
+    while True:
+        out = ctypes.create_string_buffer(cap)
+        n = lib.jpeg_encode(
+            y.ctypes.data, cb.ctypes.data, cr.ctypes.data,
+            width, height, quality, out, cap,
+        )
+        if n >= 0:
+            return out.raw[:n]
+        if n == -1:
+            raise ValueError("jpeg_encode: invalid arguments")
+        cap = -n
+
+
+def jpeg_encode_sparse_native(buf, width: int, height: int, quality: int,
+                              cap: int) -> bytes:
+    """JFIF-encode one tile straight from the device sparse wire buffer.
+
+    ``buf`` is the u8[...] row from ``ops.jpegenc.render_to_jpeg_sparse``.
+    Raises :class:`SparseOverflowError` when the tile's coefficient density
+    exceeded ``cap`` and the dense path must be taken instead.
+    """
+    import numpy as np
+    lib = _load_jpeg()
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    out_cap = buf.size * 4 + 65536
+    while True:
+        out = ctypes.create_string_buffer(out_cap)
+        n = lib.jpeg_encode_sparse(
+            buf.ctypes.data, buf.size, width, height, quality, cap,
+            out, out_cap,
+        )
+        if n >= 0:
+            return out.raw[:n]
+        if n == -2:
+            raise SparseOverflowError(
+                f"sparse buffer overflow (cap={cap})")
+        if n == -1:
+            raise ValueError("jpeg_encode_sparse: invalid arguments")
+        out_cap = -n
 
 
 def flip_u32(packed, flip_horizontal: bool, flip_vertical: bool):

@@ -1,0 +1,337 @@
+"""TPU-side JPEG front end: color transform + 8x8 DCT + quantization.
+
+The reference encodes JPEG on the CPU from the packed-int render output
+(``LocalCompress.compressToStream``, call site
+``ImageRegionRequestHandler.java:580-582``).  On TPU the economics invert:
+the rendered tile lives in HBM and the host link is the bottleneck, while
+the 8x8 block DCT is a pair of small matmuls — exactly what the MXU does
+best.  So the lossy half of baseline JPEG (BT.601 YCbCr conversion, 4:2:0
+chroma subsampling, blockwise DCT-II, quantization, zigzag) runs on device
+as one fused jitted kernel over the whole tile batch, and only the
+quantized coefficients — far smaller and far more wire-compressible than
+raw RGBA — cross to the host, where the serial entropy coding (Huffman,
+byte stuffing, JFIF framing) runs in native code (``native/jpegenc.cpp``)
+with a pure-Python fallback (:mod:`.jfif`).
+
+Coefficient layout contract with the entropy coder:
+  * ``y``  i16[B, (H/8)*(W/8),   64]  — luma blocks, raster order, zigzagged
+  * ``cb`` i16[B, (H/16)*(W/16), 64]  — subsampled chroma, raster, zigzagged
+  * ``cr`` i16[B, (H/16)*(W/16), 64]
+H and W must be multiples of 16 (one 4:2:0 MCU); callers pad odd tiles by
+edge replication before encode and patch the true size into the SOF0 header
+dimensions (the JPEG spec decodes only the declared WxH).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------- tables
+
+# Annex K base quantization tables (natural 8x8 order).
+BASE_LUMA_QUANT = np.array([
+    [16, 11, 10, 16, 24, 40, 51, 61],
+    [12, 12, 14, 19, 26, 58, 60, 55],
+    [14, 13, 16, 24, 40, 57, 69, 56],
+    [14, 17, 22, 29, 51, 87, 80, 62],
+    [18, 22, 37, 56, 68, 109, 103, 77],
+    [24, 35, 55, 64, 81, 104, 113, 92],
+    [49, 64, 78, 87, 103, 121, 120, 101],
+    [72, 92, 95, 98, 112, 100, 103, 99],
+], dtype=np.int32)
+
+BASE_CHROMA_QUANT = np.array([
+    [17, 18, 24, 47, 99, 99, 99, 99],
+    [18, 21, 26, 66, 99, 99, 99, 99],
+    [24, 26, 56, 99, 99, 99, 99, 99],
+    [47, 66, 99, 99, 99, 99, 99, 99],
+    [99, 99, 99, 99, 99, 99, 99, 99],
+    [99, 99, 99, 99, 99, 99, 99, 99],
+    [99, 99, 99, 99, 99, 99, 99, 99],
+    [99, 99, 99, 99, 99, 99, 99, 99],
+], dtype=np.int32)
+
+
+def quant_tables(quality: int) -> tuple[np.ndarray, np.ndarray]:
+    """IJG quality scaling of the Annex K tables -> (luma, chroma) u8[8,8]."""
+    quality = int(max(1, min(100, quality)))
+    scale = 5000 // quality if quality < 50 else 200 - 2 * quality
+    def scaled(base):
+        t = (base * scale + 50) // 100
+        return np.clip(t, 1, 255).astype(np.uint8)
+    return scaled(BASE_LUMA_QUANT), scaled(BASE_CHROMA_QUANT)
+
+
+@functools.lru_cache(maxsize=1)
+def zigzag_order() -> np.ndarray:
+    """Flat indices (into a row-major 8x8 block) in JPEG zigzag order."""
+    order = sorted(
+        ((r, c) for r in range(8) for c in range(8)),
+        key=lambda rc: (rc[0] + rc[1],
+                        rc[1] if (rc[0] + rc[1]) % 2 == 0 else rc[0]),
+    )
+    return np.array([r * 8 + c for r, c in order], dtype=np.int32)
+
+
+@functools.lru_cache(maxsize=1)
+def dct_matrix() -> np.ndarray:
+    """Orthonormal 8-point DCT-II matrix == the JPEG FDCT normalization."""
+    k = np.arange(8)
+    D = np.cos((2 * k[None, :] + 1) * k[:, None] * np.pi / 16) * 0.5
+    D[0] *= 1.0 / np.sqrt(2.0)
+    return D.astype(np.float32)
+
+
+# ---------------------------------------------------------------- kernel
+
+def _blockify(x):
+    """[B, H, W] -> [B, (H/8)*(W/8), 8, 8] in raster block order."""
+    Bq, H, W = x.shape
+    x = x.reshape(Bq, H // 8, 8, W // 8, 8)
+    return x.transpose(0, 1, 3, 2, 4).reshape(Bq, -1, 8, 8)
+
+
+def _dct_quant_zigzag(planes, qtable, zig, D):
+    """[B, H, W] level-shifted samples -> i16[B, nb, 64] zigzag coeffs."""
+    blocks = _blockify(planes)
+    coeffs = jnp.einsum("ux,bnxy,vy->bnuv", D, blocks, D,
+                        preferred_element_type=jnp.float32)
+    q = jnp.round(coeffs / qtable[None, None].astype(jnp.float32))
+    q = jnp.clip(q, -2047.0, 2047.0).astype(jnp.int16)
+    flat = q.reshape(q.shape[0], q.shape[1], 64)
+    return jnp.take(flat, zig, axis=-1)
+
+
+@jax.jit
+def packed_to_jpeg_coefficients(packed, qy, qc):
+    """Packed RGBA render output -> quantized zigzag JPEG coefficients.
+
+    Args:
+      packed: u32[B, H, W] little-endian R,G,B,A packed pixels (the render
+              kernel's native output; H, W multiples of 16).
+      qy:     i32[8, 8] luma quantization table (natural order).
+      qc:     i32[8, 8] chroma quantization table.
+
+    Returns:
+      (y, cb, cr) int16 coefficient arrays in the module-docstring layout.
+    """
+    r = (packed & 0xFF).astype(jnp.float32)
+    g = ((packed >> 8) & 0xFF).astype(jnp.float32)
+    b = ((packed >> 16) & 0xFF).astype(jnp.float32)
+
+    # BT.601 full-range YCbCr; the +128 chroma bias and the JPEG -128 level
+    # shift cancel, so only luma is shifted.
+    y = 0.299 * r + 0.587 * g + 0.114 * b - 128.0
+    cb = -0.168736 * r - 0.331264 * g + 0.5 * b
+    cr = 0.5 * r - 0.418688 * g - 0.081312 * b
+
+    # 4:2:0: 2x2 mean subsample of the chroma planes.
+    def sub(x):
+        Bq, H, W = x.shape
+        return x.reshape(Bq, H // 2, 2, W // 2, 2).mean(axis=(2, 4))
+
+    zig = jnp.asarray(zigzag_order())
+    D = jnp.asarray(dct_matrix())
+    return (
+        _dct_quant_zigzag(y, qy, zig, D),
+        _dct_quant_zigzag(sub(cb), qc, zig, D),
+        _dct_quant_zigzag(sub(cr), qc, zig, D),
+    )
+
+
+@jax.jit
+def rgb_to_jpeg_coefficients(rgb, qy, qc):
+    """u8/f32[B, H, W, 3] RGB -> coefficients (CPU-reference-path variant)."""
+    rgb = rgb.astype(jnp.uint32)
+    packed = (rgb[..., 0] | (rgb[..., 1] << 8) | (rgb[..., 2] << 16))
+    return packed_to_jpeg_coefficients(packed, qy, qc)
+
+
+@jax.jit
+def render_to_jpeg_coefficients(raw, window_start, window_end, family,
+                                coefficient, reverse, cd_start, cd_end,
+                                tables, qy, qc):
+    """Fused batched render + JPEG front end, one device dispatch.
+
+    The packed-RGBA intermediate stays in HBM; only the quantized
+    coefficients cross the host link.  Argument order matches
+    :func:`..ops.render.render_tile_batch_packed` plus the two quant tables.
+    """
+    from .render import _render_packed_impl
+
+    packed = _render_packed_impl(raw, window_start, window_end, family,
+                                 coefficient, reverse, cd_start, cd_end,
+                                 tables)
+    return packed_to_jpeg_coefficients(packed, qy, qc)
+
+
+def sparse_pack(y, cb, cr, cap: int):
+    """Compact nonzero coefficients into one u8 wire buffer per tile.
+
+    The host link, not compute, bounds this service's TPU throughput (the
+    tunnel moves ~15 MB/s device-to-host), so the device ships only the
+    entropy-bearing bytes: for each tile a buffer
+
+        [ total_entries i32 LE | per-block nonzero counts u8[nb] |
+          zigzag positions u8[cap] | values i16 LE[cap] ]
+
+    where entries appear in (block, zigzag) scan order — which makes the
+    sparse list exactly the run-length stream baseline JPEG entropy-codes,
+    so the host encoder (``jpeg_encode_sparse``) reads it directly.  Block
+    order is luma raster, then Cb raster, then Cr raster.  Entries beyond
+    ``cap`` are dropped (detected host-side via total_entries > cap; the
+    caller then falls back to the dense path).  The unused tail stays
+    zero, which the transport's wire compression collapses.
+    """
+    B = y.shape[0]
+    flat = jnp.concatenate(
+        [y.reshape(B, -1), cb.reshape(B, -1), cr.reshape(B, -1)], axis=1
+    )
+    N = flat.shape[1]
+    nb = N // 64
+    mask = flat != 0
+    counts = mask.reshape(B, nb, 64).sum(-1).astype(jnp.uint8)
+    wi = jnp.cumsum(mask, axis=1) - 1
+    total = (wi[:, -1] + 1).astype(jnp.int32)
+    pos = (jnp.arange(N, dtype=jnp.int32) % 64).astype(jnp.uint8)
+
+    def compact_one(m, w, v):
+        tgt = jnp.where(m & (w < cap), w, cap)   # index cap = discard slot
+        p = jnp.zeros(cap + 1, jnp.uint8).at[tgt].set(pos, mode="drop")
+        vv = jnp.zeros(cap + 1, jnp.int16).at[tgt].set(v, mode="drop")
+        return p[:cap], vv[:cap]
+
+    ps, vs = jax.vmap(compact_one)(mask, wi, flat)
+    vs_u8 = jax.lax.bitcast_convert_type(vs, jnp.uint8).reshape(B, -1)
+    tot_u8 = jax.lax.bitcast_convert_type(
+        total[:, None], jnp.uint8).reshape(B, -1)
+    return jnp.concatenate([tot_u8, counts, ps, vs_u8], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def render_to_jpeg_sparse(raw, window_start, window_end, family,
+                          coefficient, reverse, cd_start, cd_end, tables,
+                          qy, qc, cap: int):
+    """Fused render + JPEG front end + sparse wire packing, one dispatch."""
+    y, cb, cr = render_to_jpeg_coefficients(
+        raw, window_start, window_end, family, coefficient, reverse,
+        cd_start, cd_end, tables, qy, qc)
+    return sparse_pack(y, cb, cr, cap)
+
+
+def default_sparse_cap(H: int, W: int) -> int:
+    """Wire-buffer entry budget per tile: 1/8 of all coefficient slots.
+
+    Measured densities: synthetic WSI content ~3%, worst-case uniform
+    noise ~45% (which overflows and takes the dense fallback — by design).
+    """
+    nb = (H // 8) * (W // 8) + 2 * (H // 16) * (W // 16)
+    return nb * 8
+
+
+def sparse_to_dense(buf: np.ndarray, H: int, W: int, cap: int):
+    """Rebuild (y, cb, cr) dense coefficient blocks from one wire buffer.
+
+    Returns None if the buffer overflowed ``cap`` (entries were dropped).
+    Pure-numpy; used by tests and the Python fallback encoder.
+    """
+    nb_y = (H // 8) * (W // 8)
+    nb_c = (H // 16) * (W // 16)
+    nb = nb_y + 2 * nb_c
+    total = int(buf[:4].view(np.int32)[0])
+    if total > cap:
+        return None
+    counts = buf[4:4 + nb].astype(np.int64)
+    ps = buf[4 + nb:4 + nb + cap]
+    vs = buf[4 + nb + cap:4 + nb + cap * 3].view("<i2")
+    dense = np.zeros((nb, 64), np.int16)
+    block_ids = np.repeat(np.arange(nb), counts)
+    dense[block_ids, ps[:total]] = vs[:total]
+    return (dense[:nb_y].reshape(nb_y, 64),
+            dense[nb_y:nb_y + nb_c].reshape(nb_c, 64),
+            dense[nb_y + nb_c:].reshape(nb_c, 64))
+
+
+def encode_tiles_jpeg(packed, quality: int = 85, width: int | None = None,
+                      height: int | None = None, executor=None) -> list:
+    """Full TPU JPEG pipeline for a batch: packed RGBA -> JFIF bytes.
+
+    Device: color transform + DCT + quantize + zigzag.  Host: entropy code
+    each tile (native C++ when available, Python fallback), fanned out over
+    ``executor`` threads when given (the ctypes call releases the GIL).
+
+    ``packed`` is u32[B, H, W] with H, W multiples of 16; ``width``/
+    ``height`` override the SOF0 dimensions for MCU-padded tiles.
+    """
+    B, H, W = packed.shape
+    width = W if width is None else width
+    height = H if height is None else height
+    qy, qc = quant_tables(quality)
+    y, cb, cr = packed_to_jpeg_coefficients(
+        jnp.asarray(packed), qy.astype(np.int32), qc.astype(np.int32)
+    )
+    for a in (y, cb, cr):
+        a.copy_to_host_async()
+    y, cb, cr = np.asarray(y), np.asarray(cb), np.asarray(cr)
+
+    from ..native import jpeg_native_available
+    if jpeg_native_available():
+        from ..native import jpeg_encode_native as _encode
+    else:
+        from ..jfif import encode_jfif as _encode
+
+    def one(i):
+        return _encode(y[i], cb[i], cr[i], width, height, quality)
+
+    if executor is None:
+        return [one(i) for i in range(B)]
+    return list(executor.map(one, range(B)))
+
+
+def encode_sparse_buffers(bufs: np.ndarray, width: int, height: int,
+                          quality: int, cap: int, executor=None,
+                          dense_fallback=None) -> list:
+    """Entropy-encode a batch of fetched sparse wire buffers to JFIF.
+
+    ``bufs`` is the host u8[B, ...] array from :func:`render_to_jpeg_sparse`.
+    Tiles whose coefficient density overflowed ``cap`` are re-encoded via
+    ``dense_fallback(i) -> bytes`` when given (else ValueError propagates).
+    """
+    from ..native import SparseOverflowError, jpeg_native_available
+    if jpeg_native_available():
+        from ..native import jpeg_encode_sparse_native as _encode
+    else:
+        from ..jfif import encode_jfif
+
+        def _encode(buf, w, h, q, cap_):
+            dense = sparse_to_dense(buf, h, w, cap_)
+            if dense is None:
+                raise SparseOverflowError(f"overflow (cap={cap_})")
+            y, cb, cr = dense
+            return encode_jfif(y, cb, cr, w, h, q)
+
+    def one(i):
+        try:
+            return _encode(bufs[i], width, height, quality, cap)
+        except SparseOverflowError:
+            if dense_fallback is None:
+                raise
+            return dense_fallback(i)
+
+    if executor is None:
+        return [one(i) for i in range(bufs.shape[0])]
+    return list(executor.map(one, range(bufs.shape[0])))
+
+
+def pad_to_mcu(rgba: np.ndarray) -> np.ndarray:
+    """Edge-replicate u8[H, W, ...] so H and W are multiples of 16."""
+    H, W = rgba.shape[:2]
+    ph, pw = (-H) % 16, (-W) % 16
+    if ph == 0 and pw == 0:
+        return rgba
+    pad = [(0, ph), (0, pw)] + [(0, 0)] * (rgba.ndim - 2)
+    return np.pad(rgba, pad, mode="edge")

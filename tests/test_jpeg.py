@@ -1,0 +1,202 @@
+"""TPU JPEG front end + JFIF entropy coder.
+
+Covers the replacement for the reference's CPU JPEG stage
+(``LocalCompress.compressToStream``, ``ImageRegionRequestHandler.java:
+457-460,580-582``): device DCT/quantization kernel, Python entropy coder,
+native C++ entropy coder (byte-parity with Python), and decode validation
+through an independent decoder (PIL).
+"""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from omero_ms_image_region_tpu.jfif import build_huffman_table, encode_jfif
+from omero_ms_image_region_tpu.ops.jpegenc import (
+    dct_matrix, encode_tiles_jpeg, packed_to_jpeg_coefficients, pad_to_mcu,
+    quant_tables, sparse_pack, sparse_to_dense, zigzag_order,
+)
+
+from omero_ms_image_region_tpu.native import (
+    SparseOverflowError, jpeg_encode_native, jpeg_encode_sparse_native,
+    jpeg_native_available,
+)
+
+HAVE_NATIVE = jpeg_native_available()
+
+
+def blob_image(H, W, seed=0, noise=0.0):
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:H, 0:W]
+    img = np.zeros((H, W, 3), np.float32)
+    for _ in range(8):
+        cy, cx = rng.integers(0, H), rng.integers(0, W)
+        s = rng.uniform(4, max(5, min(H, W) / 4))
+        img += np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * s * s))[
+            ..., None] * rng.uniform(0, 255, 3)
+    if noise:
+        img += rng.normal(0, noise, img.shape)
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def pack(img):
+    return (img[..., 0].astype(np.uint32)
+            | (img[..., 1].astype(np.uint32) << 8)
+            | (img[..., 2].astype(np.uint32) << 16))
+
+
+def coeffs_for(img, quality):
+    qy, qc = quant_tables(quality)
+    y, cb, cr = packed_to_jpeg_coefficients(
+        pack(img)[None], qy.astype(np.int32), qc.astype(np.int32))
+    return np.asarray(y)[0], np.asarray(cb)[0], np.asarray(cr)[0]
+
+
+# ------------------------------------------------------------- tables
+
+def test_quant_tables_quality_scaling():
+    qy50, qc50 = quant_tables(50)
+    assert qy50[0, 0] == 16 and qc50[0, 0] == 17  # Annex K at q=50
+    qy100, _ = quant_tables(100)
+    assert (qy100 == 1).all()
+    qy10, _ = quant_tables(10)
+    assert (qy10.astype(int) >= qy50.astype(int)).all()
+
+
+def test_zigzag_is_the_jpeg_order():
+    z = zigzag_order()
+    assert sorted(z.tolist()) == list(range(64))
+    assert z[:10].tolist() == [0, 1, 8, 16, 9, 2, 3, 10, 17, 24]
+    assert z[-4:].tolist() == [47, 55, 62, 63]
+
+
+def test_dct_matrix_is_orthonormal():
+    D = dct_matrix()
+    np.testing.assert_allclose(D @ D.T, np.eye(8), atol=1e-6)
+
+
+def test_huffman_table_is_valid_and_optimalish():
+    freq = np.zeros(256, dtype=np.int64)
+    freq[0] = 1000
+    freq[1] = 500
+    freq[5] = 100
+    freq[0xF0] = 1
+    bits, huffval = build_huffman_table(freq)
+    assert bits[1:].sum() == 4 and len(huffval) == 4
+    assert huffval[0] == 0  # most frequent symbol gets the shortest code
+    assert (np.cumsum([0] + [int(b) for b in bits[1:]]) <= 2 ** np.arange(
+        17)).all()  # Kraft inequality at every length
+
+
+# ------------------------------------------------------------- encoder
+
+@pytest.mark.parametrize("H,W", [(64, 64), (32, 48), (16, 16)])
+def test_decode_matches_pil_quality(H, W):
+    img = blob_image(H, W, seed=H + W)
+    y, cb, cr = coeffs_for(img, 85)
+    data = encode_jfif(y, cb, cr, W, H, 85)
+    dec = np.asarray(
+        Image.open(io.BytesIO(data)).convert("RGB")).astype(np.float32)
+    assert dec.shape == (H, W, 3)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=85)
+    dec_pil = np.asarray(
+        Image.open(buf).convert("RGB")).astype(np.float32)
+    ours = np.abs(dec - img).mean()
+    pils = np.abs(dec_pil - img).mean()
+    assert ours <= pils * 1.3 + 0.5
+
+
+def test_uniform_image_is_tiny():
+    img = np.full((64, 64, 3), 130, np.uint8)
+    y, cb, cr = coeffs_for(img, 85)
+    data = encode_jfif(y, cb, cr, 64, 64, 85)
+    assert len(data) < 900
+    dec = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    assert np.abs(dec.astype(int) - 130).max() <= 2
+
+
+def test_non_mcu_aligned_size_via_padding():
+    img = blob_image(24, 40, seed=3)
+    padded = pad_to_mcu(img)
+    assert padded.shape == (32, 48, 3)
+    y, cb, cr = coeffs_for(padded, 85)
+    data = encode_jfif(y, cb, cr, 40, 24, 85)
+    dec = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    assert dec.shape == (24, 40, 3)
+    assert np.abs(dec.astype(np.float32) - img).mean() < 12.0
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no native toolchain")
+@pytest.mark.parametrize("seed,H,W,q", [(0, 64, 64, 85), (1, 32, 48, 50),
+                                        (2, 16, 32, 95)])
+def test_native_matches_python_bytes(seed, H, W, q):
+    img = blob_image(H, W, seed=seed, noise=4.0)
+    y, cb, cr = coeffs_for(img, q)
+    assert (jpeg_encode_native(y, cb, cr, W, H, q)
+            == encode_jfif(y, cb, cr, W, H, q))
+
+
+# ------------------------------------------------------------- sparse wire
+
+def test_sparse_pack_roundtrips_to_dense():
+    img = blob_image(32, 48, seed=9, noise=3.0)
+    y, cb, cr = coeffs_for(img, 85)
+    cap = 512
+    buf = np.asarray(sparse_pack(y[None], cb[None], cr[None], cap))[0]
+    got = sparse_to_dense(buf, 32, 48, cap)
+    assert got is not None
+    np.testing.assert_array_equal(got[0], y)
+    np.testing.assert_array_equal(got[1], cb)
+    np.testing.assert_array_equal(got[2], cr)
+
+
+def test_sparse_pack_overflow_detected():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)  # dense noise
+    y, cb, cr = coeffs_for(img, 95)
+    cap = 8
+    buf = np.asarray(sparse_pack(y[None], cb[None], cr[None], cap))[0]
+    assert sparse_to_dense(buf, 16, 16, cap) is None
+    if HAVE_NATIVE:
+        with pytest.raises(SparseOverflowError):
+            jpeg_encode_sparse_native(buf, 16, 16, 95, cap)
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no native toolchain")
+@pytest.mark.parametrize("seed,H,W,q", [(4, 64, 64, 85), (5, 32, 48, 75)])
+def test_sparse_native_matches_dense_native(seed, H, W, q):
+    img = blob_image(H, W, seed=seed, noise=2.0)
+    y, cb, cr = coeffs_for(img, q)
+    cap = (H // 8) * (W // 8) * 16
+    buf = np.asarray(sparse_pack(y[None], cb[None], cr[None], cap))[0]
+    assert (jpeg_encode_sparse_native(buf, W, H, q, cap)
+            == jpeg_encode_native(y, cb, cr, W, H, q))
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="no native toolchain")
+def test_sparse_native_rejects_malformed_buffer():
+    img = blob_image(16, 16, seed=6, noise=5.0)
+    y, cb, cr = coeffs_for(img, 85)
+    cap = 512
+    buf = np.array(sparse_pack(y[None], cb[None], cr[None], cap))[0].copy()
+    nb = 4 + 2  # 16x16 tile: 4 luma + 2 chroma blocks
+    counts = buf[4:4 + nb]
+    first = int(counts[0])
+    assert first >= 2
+    ps = buf[4 + nb:4 + nb + cap]
+    ps[0], ps[1] = ps[1], ps[0]  # non-ascending positions in block 0
+    with pytest.raises(ValueError):
+        jpeg_encode_sparse_native(buf, 16, 16, 85, cap)
+
+
+def test_encode_tiles_jpeg_batch():
+    imgs = np.stack([blob_image(32, 32, seed=s) for s in range(3)])
+    packed = pack(imgs)
+    outs = encode_tiles_jpeg(packed, quality=85)
+    assert len(outs) == 3
+    for img, data in zip(imgs, outs):
+        dec = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+        assert np.abs(dec.astype(np.float32) - img).mean() < 8.0

@@ -124,7 +124,15 @@ def bench_flagship(rng):
                               np.asarray(cr)[0], W, H, quality)
 
     def run_once():
-        """One full pan: all batches raw -> JPEG bytes; returns p50 ms."""
+        """One full pan: all batches raw -> JPEG bytes; returns p50 ms.
+
+        Device: fused render + JPEG front end + sparse wire packing (one
+        dispatch per batch).  Host: native entropy coder over the sparse
+        coefficient stream, on a thread pool.  (The fully-fused
+        device-Huffman path — TpuJpegEncoder — measures slower here: its
+        75M-update scatter-add costs more device time than the sparse
+        path's larger-but-compressible fetch costs wire time.)
+        """
         device_out = [
             render_to_jpeg_sparse(raw, *args_suffix, qy, qc, cap=cap)
             for raw in dev_raw

@@ -20,6 +20,7 @@ device DCT kernel replace it end to end.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Sequence, Tuple
 
 import numpy as np
@@ -107,6 +108,37 @@ def build_huffman_table(freq256: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return bits, huffval
 
 
+@functools.lru_cache(maxsize=1)
+def fixed_huffman_spec():
+    """Deterministic shared Huffman tables for one-pass (device) encoding.
+
+    Optimal per-image tables need a frequency pass; the device bit-packer
+    runs one pass with these fixed tables instead (a few percent larger
+    streams).  Built from a smoothed synthetic frequency profile — small
+    runs and small magnitudes dominate — with every legal symbol given a
+    nonzero count so every symbol has a code.  One DC and one AC table
+    serve all three components.
+
+    Returns ``(dc_bits, dc_vals, dc_code, dc_len, ac_bits, ac_vals,
+    ac_code, ac_len)`` where the code/len arrays are indexed by symbol.
+    """
+    dc_freq = np.zeros(256, dtype=np.int64)
+    for s in range(12):
+        dc_freq[s] = 1 + (1 << max(0, 14 - 2 * s))
+    ac_freq = np.zeros(256, dtype=np.int64)
+    for run in range(16):
+        for size in range(1, 11):
+            ac_freq[(run << 4) | size] = 1 + (1 << max(0, 18 - run - 2 * size))
+    ac_freq[0x00] = 1 << 17   # EOB
+    ac_freq[0xF0] = 1 << 8    # ZRL
+    dc_bits, dc_vals = build_huffman_table(dc_freq)
+    ac_bits, ac_vals = build_huffman_table(ac_freq)
+    dc_code, dc_len = _codes_from_table(dc_bits, dc_vals)
+    ac_code, ac_len = _codes_from_table(ac_bits, ac_vals)
+    return (dc_bits, dc_vals, dc_code, dc_len,
+            ac_bits, ac_vals, ac_code, ac_len)
+
+
 def _codes_from_table(bits: np.ndarray, huffval: np.ndarray):
     """Canonical code assignment -> (code[symbol], length[symbol])."""
     code_of = np.zeros(256, dtype=np.uint32)
@@ -130,17 +162,16 @@ def _category(v: int) -> int:
 
 
 def _mcu_block_indices(h16: int, w16: int):
-    """Per-MCU raster-order block index lists (y_blocks, chroma_index)."""
-    yw = w16 * 2
-    out = []
-    for my in range(h16):
-        for mx in range(w16):
-            ys = [
-                (2 * my) * yw + 2 * mx, (2 * my) * yw + 2 * mx + 1,
-                (2 * my + 1) * yw + 2 * mx, (2 * my + 1) * yw + 2 * mx + 1,
-            ]
-            out.append((ys, my * w16 + mx))
-    return out
+    """Per-MCU raster-order block index lists (y_blocks, chroma_index).
+
+    Derived from the single source of scan-order truth,
+    :func:`.ops.jpegenc._mcu_scan_index` (the device bit-packer's map), so
+    the two Python encoders cannot drift apart.
+    """
+    from .ops.jpegenc import _mcu_scan_index
+    nb_y = h16 * w16 * 4
+    scan = _mcu_scan_index(h16, w16)
+    return [(row[:4].tolist(), int(row[4]) - nb_y) for row in scan]
 
 
 def _block_symbols(block: np.ndarray, pred: int):
@@ -221,12 +252,70 @@ def _dht_payload(cls: int, ident: int, bits: np.ndarray,
             + huffval.tobytes())
 
 
+def _frame_markers(width: int, height: int, quality: int) -> bytes:
+    """SOI through SOF0 (everything before the Huffman tables)."""
+    qy, qc = quant_tables(quality)
+    zig = zigzag_order()
+    out = bytearray()
+    out += b"\xff\xd8"  # SOI
+    out += _marker(0xE0, b"JFIF\x00\x01\x01\x00\x00\x01\x00\x01\x00\x00")
+    out += _marker(0xDB, bytes([0]) + qy.reshape(-1)[zig].tobytes())
+    out += _marker(0xDB, bytes([1]) + qc.reshape(-1)[zig].tobytes())
+    out += _marker(0xC0, bytes([8])                       # SOF0: baseline
+                   + height.to_bytes(2, "big") + width.to_bytes(2, "big")
+                   + bytes([3,
+                            1, 0x22, 0,     # Y: 2x2 sampling, qtable 0
+                            2, 0x11, 1,     # Cb: 1x1, qtable 1
+                            3, 0x11, 1]))   # Cr
+    return bytes(out)
+
+
+@functools.lru_cache(maxsize=64)
+def fixed_header_bytes(width: int, height: int, quality: int) -> bytes:
+    """Full fixed-table header: SOI..SOF0 + shared DHTs + SOS.
+
+    The device bit-packer's stream drops straight in after this; all three
+    components reference DC/AC table 0.
+    """
+    dc_bits, dc_vals, _, _, ac_bits, ac_vals, _, _ = fixed_huffman_spec()
+    out = bytearray(_frame_markers(width, height, quality))
+    out += _marker(0xC4, _dht_payload(0, 0, dc_bits, dc_vals))
+    out += _marker(0xC4, _dht_payload(1, 0, ac_bits, ac_vals))
+    out += _marker(0xDA, bytes([3, 1, 0x00, 2, 0x00, 3, 0x00, 0, 63, 0]))
+    return bytes(out)
+
+
+def finish_fixed_stream(words: np.ndarray, total_bits: int,
+                        width: int, height: int,
+                        quality: int = 85) -> bytes:
+    """Wrap a device-packed bitstream into a complete JFIF file.
+
+    ``words`` is the u32 array from the device packer, stream bit 0 at the
+    MSB of word 0.  Host work is O(stream bytes): big-endian byte view,
+    truncate to ``total_bits``, 1-pad the final byte, 0xFF byte-stuffing,
+    header + EOI framing.
+    """
+    n_bytes = (int(total_bits) + 7) // 8
+    data = bytearray(np.ascontiguousarray(words).astype("<u4").byteswap()
+                     .tobytes()[:n_bytes])
+    pad = n_bytes * 8 - int(total_bits)
+    if n_bytes:
+        data[-1] |= (1 << pad) - 1
+    stuffed = bytes(data).replace(b"\xff", b"\xff\x00")
+    return (fixed_header_bytes(width, height, quality) + stuffed
+            + b"\xff\xd9")
+
+
 def encode_jfif(y: np.ndarray, cb: np.ndarray, cr: np.ndarray,
-                width: int, height: int, quality: int = 85) -> bytes:
+                width: int, height: int, quality: int = 85,
+                huffman: str = "optimal") -> bytes:
     """Entropy-encode one image's coefficient blocks into a JFIF stream.
 
     ``width``/``height`` are the true (pre-MCU-padding) dimensions written
     into SOF0; the coefficient arrays cover the padded 16-aligned frame.
+    ``huffman="fixed"`` uses the shared :func:`fixed_huffman_spec` tables
+    (one pass, the device packer's mode — byte-parity reference for it)
+    instead of per-image optimal tables.
     """
     h16 = (height + 15) // 16
     w16 = (width + 15) // 16
@@ -246,33 +335,27 @@ def encode_jfif(y: np.ndarray, cb: np.ndarray, cr: np.ndarray,
     c_dcf += c_dcf2
     c_acf += c_acf2
 
-    tables = {
-        ("dc", 0): build_huffman_table(y_dcf),
-        ("ac", 0): build_huffman_table(y_acf),
-        ("dc", 1): build_huffman_table(c_dcf),
-        ("ac", 1): build_huffman_table(c_acf),
-    }
-    codes = {k: _codes_from_table(*v) for k, v in tables.items()}
-
-    qy, qc = quant_tables(quality)
-    zig = zigzag_order()
-
-    out = bytearray()
-    out += b"\xff\xd8"  # SOI
-    out += _marker(0xE0, b"JFIF\x00\x01\x01\x00\x00\x01\x00\x01\x00\x00")
-    out += _marker(0xDB, bytes([0]) + qy.reshape(-1)[zig].tobytes())
-    out += _marker(0xDB, bytes([1]) + qc.reshape(-1)[zig].tobytes())
-    out += _marker(0xC0, bytes([8])                       # SOF0: baseline
-                   + height.to_bytes(2, "big") + width.to_bytes(2, "big")
-                   + bytes([3,
-                            1, 0x22, 0,     # Y: 2x2 sampling, qtable 0
-                            2, 0x11, 1,     # Cb: 1x1, qtable 1
-                            3, 0x11, 1]))   # Cr
-    out += _marker(0xC4, _dht_payload(0, 0, *tables[("dc", 0)]))
-    out += _marker(0xC4, _dht_payload(1, 0, *tables[("ac", 0)]))
-    out += _marker(0xC4, _dht_payload(0, 1, *tables[("dc", 1)]))
-    out += _marker(0xC4, _dht_payload(1, 1, *tables[("ac", 1)]))
-    out += _marker(0xDA, bytes([3, 1, 0x00, 2, 0x11, 3, 0x11, 0, 63, 0]))
+    if huffman == "fixed":
+        dc_bits, dc_vals, dc_code, dc_len, ac_bits, ac_vals, ac_code, \
+            ac_len = fixed_huffman_spec()
+        shared = {"dc": (dc_code, dc_len), "ac": (ac_code, ac_len)}
+        codes = {(kind, t): shared[kind]
+                 for kind in ("dc", "ac") for t in (0, 1)}
+        out = bytearray(fixed_header_bytes(width, height, quality))
+    else:
+        tables = {
+            ("dc", 0): build_huffman_table(y_dcf),
+            ("ac", 0): build_huffman_table(y_acf),
+            ("dc", 1): build_huffman_table(c_dcf),
+            ("ac", 1): build_huffman_table(c_acf),
+        }
+        codes = {k: _codes_from_table(*v) for k, v in tables.items()}
+        out = bytearray(_frame_markers(width, height, quality))
+        out += _marker(0xC4, _dht_payload(0, 0, *tables[("dc", 0)]))
+        out += _marker(0xC4, _dht_payload(1, 0, *tables[("ac", 0)]))
+        out += _marker(0xC4, _dht_payload(0, 1, *tables[("dc", 1)]))
+        out += _marker(0xC4, _dht_payload(1, 1, *tables[("ac", 1)]))
+        out += _marker(0xDA, bytes([3, 1, 0x00, 2, 0x11, 3, 0x11, 0, 63, 0]))
 
     w = _BitWriter()
 

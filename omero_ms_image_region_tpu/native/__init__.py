@@ -28,6 +28,10 @@ _BUILD_LOCK = threading.Lock()
 
 _lib: Optional[ctypes.CDLL] = None
 _jpeg_lib: Optional[ctypes.CDLL] = None
+# First failure is cached so hot paths that probe availability per batch
+# don't re-spawn a doomed g++ attempt every call.
+_lib_error: Optional[str] = None
+_jpeg_lib_error: Optional[str] = None
 
 
 def _compile_lib(source: str, lib_path: str) -> None:
@@ -45,18 +49,23 @@ def _compile() -> None:
 
 
 def _load() -> ctypes.CDLL:
-    global _lib
+    global _lib, _lib_error
     if _lib is not None:
         return _lib
+    if _lib_error is not None:
+        raise ImportError(_lib_error)
     with _BUILD_LOCK:
         if _lib is not None:
             return _lib
+        if _lib_error is not None:
+            raise ImportError(_lib_error)
         if (not os.path.exists(_LIB_PATH)
                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SOURCE)):
             try:
                 _compile()
             except (OSError, subprocess.CalledProcessError) as e:
-                raise ImportError(f"native tilecache unavailable: {e}")
+                _lib_error = f"native tilecache unavailable: {e}"
+                raise ImportError(_lib_error)
         lib = ctypes.CDLL(_LIB_PATH)
         lib.tc_create.restype = ctypes.c_void_p
         lib.tc_create.argtypes = [ctypes.c_size_t, ctypes.c_uint]
@@ -151,19 +160,24 @@ def unpack_bits_msb(data: bytes, n_bits: int):
 
 
 def _load_jpeg() -> ctypes.CDLL:
-    global _jpeg_lib
+    global _jpeg_lib, _jpeg_lib_error
     if _jpeg_lib is not None:
         return _jpeg_lib
+    if _jpeg_lib_error is not None:
+        raise ImportError(_jpeg_lib_error)
     with _BUILD_LOCK:
         if _jpeg_lib is not None:
             return _jpeg_lib
+        if _jpeg_lib_error is not None:
+            raise ImportError(_jpeg_lib_error)
         if (not os.path.exists(_JPEG_LIB_PATH)
                 or os.path.getmtime(_JPEG_LIB_PATH)
                 < os.path.getmtime(_JPEG_SOURCE)):
             try:
                 _compile_lib(_JPEG_SOURCE, _JPEG_LIB_PATH)
             except (OSError, subprocess.CalledProcessError) as e:
-                raise ImportError(f"native jpeg encoder unavailable: {e}")
+                _jpeg_lib_error = f"native jpeg encoder unavailable: {e}"
+                raise ImportError(_jpeg_lib_error)
         lib = ctypes.CDLL(_JPEG_LIB_PATH)
         lib.jpeg_encode.restype = ctypes.c_longlong
         lib.jpeg_encode.argtypes = [

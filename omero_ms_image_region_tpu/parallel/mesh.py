@@ -118,6 +118,15 @@ def _local_render(raw, window_start, window_end, family, coefficient,
     q = jnp.where(
         reverse[None, :, None, None] != 0, cd_start + cd_end - q, q
     )
+    if tables.ndim == 2:
+        # Ramp weights [Cl, 3]: arithmetic composite (ops.render
+        # .composite_ramp_packed) — no per-pixel gather.
+        qf = q.astype(jnp.float32)
+        comps = [
+            jnp.einsum("bchw,c->bhw", qf, tables[:, comp])
+            for comp in range(3)
+        ]
+        return jnp.stack(comps, axis=0)            # [3, Bl, H, W]
     # Per-component flat shared-operand gather with per-channel block
     # offsets (see ops.render.composite_packed for why not table[q]).
     Cl = tables.shape[0]

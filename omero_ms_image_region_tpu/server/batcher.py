@@ -93,8 +93,10 @@ class BatchingRenderer:
             padded = np.zeros((C, bh, bw), np.float32)
             padded[:, :h, :w] = raw
             raw = padded
+        # tables is either [C, 3] ramp weights or [C, 256, 3] LUT tables
+        # (ops.render.pack_settings); the two shapes cannot co-batch.
         key = (C, bh, bw, int(settings["cd_start"]),
-               int(settings["cd_end"]))
+               int(settings["cd_end"]), settings["tables"].ndim)
 
         pending = _Pending(raw=raw, settings=settings, h=h, w=w,
                            future=asyncio.get_running_loop().create_future())

@@ -94,7 +94,7 @@ class TestBatchingRenderer:
         raw = rng.integers(0, 60000, size=(3, 16, 16)).astype(np.float32)
         s1, s2 = _settings(), _settings()
         s2["window_start"] = s2["window_start"] + 1000.0
-        s2["tables"] = s2["tables"][:, :, ::-1].copy()   # swap rgb
+        s2["tables"] = s2["tables"][..., ::-1].copy()    # swap rgb
 
         async def main():
             batcher = BatchingRenderer(max_batch=4, linger_ms=20.0)

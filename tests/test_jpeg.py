@@ -192,6 +192,90 @@ def test_sparse_native_rejects_malformed_buffer():
         jpeg_encode_sparse_native(buf, 16, 16, 85, cap)
 
 
+# ------------------------------------------- device Huffman bit-packing
+
+def test_fixed_huffman_spec_is_complete_and_valid():
+    from omero_ms_image_region_tpu.jfif import fixed_huffman_spec
+    dc_bits, dc_vals, dc_code, dc_len, ac_bits, ac_vals, ac_code, ac_len = \
+        fixed_huffman_spec()
+    assert set(dc_vals.tolist()) == set(range(12))
+    legal_ac = {0x00, 0xF0} | {(r << 4) | s
+                               for r in range(16) for s in range(1, 11)}
+    assert set(ac_vals.tolist()) == legal_ac
+    assert all(dc_len[s] > 0 for s in range(12))
+    assert max(dc_len.max(), ac_len.max()) <= 16
+
+
+@pytest.mark.parametrize("seed,H,W,q", [(7, 64, 64, 85), (8, 32, 48, 75),
+                                        (9, 16, 16, 95)])
+def test_device_bitpack_matches_python_fixed(seed, H, W, q):
+    from omero_ms_image_region_tpu.flagship import batched_args
+    from omero_ms_image_region_tpu.models.pixels import Pixels
+    from omero_ms_image_region_tpu.models.rendering import (
+        RenderingModel, default_rendering_def,
+    )
+    from omero_ms_image_region_tpu.ops.jpegenc import TpuJpegEncoder
+    from omero_ms_image_region_tpu.ops.render import pack_settings
+
+    rng = np.random.default_rng(seed)
+    C = 3
+    pixels = Pixels(image_id=1, size_x=W, size_y=H, size_c=C,
+                    pixels_type="uint16")
+    rdef = default_rendering_def(pixels)
+    rdef.model = RenderingModel.RGB
+    for i, cb in enumerate(rdef.channel_bindings):
+        cb.active = True
+        cb.red, cb.green, cb.blue = [(255, 0, 0), (0, 255, 0),
+                                     (0, 0, 255)][i]
+        cb.input_start, cb.input_end = 0.0, 65535.0
+    settings = pack_settings(rdef)
+    raw = rng.integers(0, 65535, size=(2, C, H, W)).astype(np.uint16)
+    args = batched_args(settings, raw)[1:]
+
+    # Uniform-noise tiles exceed the realistic-content default cap.
+    enc = TpuJpegEncoder(H, W, quality=q, cap_bytes=H * W * 8)
+    got = enc.encode_batch(raw, *args)
+
+    from omero_ms_image_region_tpu.ops.render import render_tile_batch_packed
+    packed = np.asarray(render_tile_batch_packed(raw, *args))
+    qy, qc = quant_tables(q)
+    y, cb_, cr = [np.asarray(a) for a in packed_to_jpeg_coefficients(
+        packed, qy.astype(np.int32), qc.astype(np.int32))]
+    want = [encode_jfif(y[i], cb_[i], cr[i], W, H, q, huffman="fixed")
+            for i in range(2)]
+    assert got == want
+    dec = Image.open(io.BytesIO(got[0])).convert("RGB")
+    assert dec.size == (W, H)
+
+
+def test_bitpack_overflow_detected():
+    from omero_ms_image_region_tpu.flagship import batched_args
+    from omero_ms_image_region_tpu.ops.jpegenc import TpuJpegEncoder
+    from omero_ms_image_region_tpu.models.pixels import Pixels
+    from omero_ms_image_region_tpu.models.rendering import (
+        RenderingModel, default_rendering_def,
+    )
+    from omero_ms_image_region_tpu.ops.render import pack_settings
+
+    rng = np.random.default_rng(1)
+    pixels = Pixels(image_id=1, size_x=32, size_y=32, size_c=3,
+                    pixels_type="uint16")
+    rdef = default_rendering_def(pixels)
+    rdef.model = RenderingModel.RGB
+    for i, cb in enumerate(rdef.channel_bindings):
+        cb.active = True
+        cb.red, cb.green, cb.blue = [(255, 0, 0), (0, 255, 0),
+                                     (0, 0, 255)][i]
+        cb.input_start, cb.input_end = 0.0, 65535.0
+    raw = rng.integers(0, 65535, size=(1, 3, 32, 32)).astype(np.uint16)
+    args = batched_args(pack_settings(rdef), raw)[1:]
+    enc = TpuJpegEncoder(32, 32, quality=95, cap_bytes=64)
+    with pytest.raises(ValueError, match="overflow"):
+        enc.encode_batch(raw, *args)
+    fb = enc.encode_batch(raw, *args, dense_fallback=lambda i: b"\xff\xd8x")
+    assert fb == [b"\xff\xd8x"]
+
+
 def test_encode_tiles_jpeg_batch():
     imgs = np.stack([blob_image(32, 32, seed=s) for s in range(3)])
     packed = pack(imgs)

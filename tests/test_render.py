@@ -152,7 +152,7 @@ def test_batch_render_matches_single():
             np.tile(s["reverse"], (B, 1)),
             s["cd_start"],
             s["cd_end"],
-            np.tile(s["tables"], (B, 1, 1, 1)),
+            np.tile(s["tables"], (B,) + (1,) * s["tables"].ndim),
         )
     )
     for b in range(B):

@@ -33,6 +33,13 @@ class UnknownFormatError(ValueError):
     surfacing as a 404; ``ImageRegionRequestHandler.java:598-600``)."""
 
 
+def quality_percent(quality: Optional[float]) -> int:
+    """Request 0..1 float -> integer percent, with the LocalCompress
+    default; the single source for both the PIL and device JPEG paths."""
+    q = DEFAULT_JPEG_QUALITY if quality is None else quality
+    return max(1, min(100, round(q * 100)))
+
+
 def encode_rgba(rgba: np.ndarray, fmt: str,
                 quality: Optional[float] = None) -> bytes:
     """Encode an RGBA tile to ``jpeg`` / ``png`` / ``tif`` bytes.
@@ -47,8 +54,7 @@ def encode_rgba(rgba: np.ndarray, fmt: str,
     img = Image.fromarray(np.ascontiguousarray(rgba[..., :3]), mode="RGB")
     buf = io.BytesIO()
     if fmt == "jpeg":
-        q = DEFAULT_JPEG_QUALITY if quality is None else quality
-        img.save(buf, format="JPEG", quality=max(0, min(100, round(q * 100))))
+        img.save(buf, format="JPEG", quality=quality_percent(quality))
     elif fmt == "png":
         img.save(buf, format="PNG")
     else:

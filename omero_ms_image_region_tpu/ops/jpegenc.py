@@ -239,8 +239,12 @@ def sparse_to_dense(buf: np.ndarray, H: int, W: int, cap: int):
     Returns None if the buffer overflowed ``cap`` (entries were dropped).
     Pure-numpy; used by tests and the Python fallback encoder.
     """
-    nb_y = (H // 8) * (W // 8)
-    nb_c = (H // 16) * (W // 16)
+    # The wire buffer is packed for the 16-aligned (MCU-padded) grid, so
+    # block counts use ceil — H/W may be the tile's true, unaligned size
+    # (the native encoder does the same, jpegenc.cpp jpeg_encode_sparse).
+    h16, w16 = (H + 15) // 16, (W + 15) // 16
+    nb_y = h16 * w16 * 4
+    nb_c = h16 * w16
     nb = nb_y + 2 * nb_c
     total = int(buf[:4].view(np.int32)[0])
     if total > cap:
@@ -523,6 +527,29 @@ class TpuJpegEncoder:
             dense_fallback=dense_fallback, executor=executor)
 
 
+def sparse_encoder():
+    """The per-tile sparse entropy coder: native if available, else Python.
+
+    Returns ``encode(buf, width, height, quality, cap) -> bytes``, raising
+    ``native.SparseOverflowError`` when the buffer dropped entries.
+    """
+    from ..native import SparseOverflowError, jpeg_native_available
+    if jpeg_native_available():
+        from ..native import jpeg_encode_sparse_native
+        return jpeg_encode_sparse_native
+
+    from ..jfif import encode_jfif
+
+    def _encode(buf, w, h, q, cap_):
+        dense = sparse_to_dense(buf, h, w, cap_)
+        if dense is None:
+            raise SparseOverflowError(f"overflow (cap={cap_})")
+        y, cb, cr = dense
+        return encode_jfif(y, cb, cr, w, h, q)
+
+    return _encode
+
+
 def encode_sparse_buffers(bufs: np.ndarray, width: int, height: int,
                           quality: int, cap: int, executor=None,
                           dense_fallback=None) -> list:
@@ -532,18 +559,8 @@ def encode_sparse_buffers(bufs: np.ndarray, width: int, height: int,
     Tiles whose coefficient density overflowed ``cap`` are re-encoded via
     ``dense_fallback(i) -> bytes`` when given (else ValueError propagates).
     """
-    from ..native import SparseOverflowError, jpeg_native_available
-    if jpeg_native_available():
-        from ..native import jpeg_encode_sparse_native as _encode
-    else:
-        from ..jfif import encode_jfif
-
-        def _encode(buf, w, h, q, cap_):
-            dense = sparse_to_dense(buf, h, w, cap_)
-            if dense is None:
-                raise SparseOverflowError(f"overflow (cap={cap_})")
-            y, cb, cr = dense
-            return encode_jfif(y, cb, cr, w, h, q)
+    from ..native import SparseOverflowError
+    _encode = sparse_encoder()
 
     def one(i):
         try:
@@ -556,6 +573,56 @@ def encode_sparse_buffers(bufs: np.ndarray, width: int, height: int,
     if executor is None:
         return [one(i) for i in range(bufs.shape[0])]
     return list(executor.map(one, range(bufs.shape[0])))
+
+
+def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
+                         reverse, cd_start, cd_end, tables, quality: int,
+                         dims, cap: int | None = None) -> list:
+    """Serving-path helper: one batched device dispatch -> JFIF per tile.
+
+    ``raw`` is [B, C, H, W] with H, W multiples of 16 (callers edge-pad;
+    render is pointwise so padding commutes with it) and per-tile settings
+    stacked along B as in :func:`render_to_jpeg_sparse`.  ``dims`` gives
+    each tile's true ``(width, height)`` written into its SOF0 header —
+    the decoder crops the MCU padding away, so tiles of different true
+    sizes share a dispatch as long as their 16-aligned grids match.
+    Overflowing tiles re-run through the dense coefficient path.
+    """
+    from ..native import SparseOverflowError
+    B, C, H, W = raw.shape
+    if cap is None:
+        cap = default_sparse_cap(H, W)
+    qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
+    bufs = np.asarray(render_to_jpeg_sparse(
+        raw, window_start, window_end, family, coefficient, reverse,
+        cd_start, cd_end, tables, qy, qc, cap=cap))
+    _encode = sparse_encoder()
+
+    from ..native import jpeg_native_available
+    if jpeg_native_available():
+        from ..native import jpeg_encode_native as _dense_encode
+    else:
+        _dense_encode = None
+
+    out = []
+    for i, (w_, h_) in enumerate(dims):
+        try:
+            out.append(_encode(bufs[i], w_, h_, quality, cap))
+        except SparseOverflowError:
+            y, cb, cr = render_to_jpeg_coefficients(
+                raw[i:i + 1],
+                *(a[i:i + 1] if getattr(a, "ndim", 0) else a
+                  for a in (window_start, window_end, family, coefficient,
+                            reverse)),
+                cd_start, cd_end,
+                tables[i:i + 1], qy, qc)
+            y, cb, cr = np.asarray(y)[0], np.asarray(cb)[0], np.asarray(cr)[0]
+            if _dense_encode is not None:
+                out.append(_dense_encode(y, cb, cr, w_, h_, quality))
+            else:
+                from ..jfif import encode_jfif
+                out.append(encode_jfif(y, cb, cr, w_, h_, quality))
+    return out
 
 
 def pad_to_mcu(rgba: np.ndarray) -> np.ndarray:

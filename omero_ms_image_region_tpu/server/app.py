@@ -60,8 +60,17 @@ def _make_session_store(config: AppConfig) -> Optional[SessionStore]:
     if config.session_store_type == "static":
         return StaticSessionStore(accept_all=True)
     if config.session_store_type == "postgres":
-        log.warning("postgres session store not wired in this build; "
-                    "sessions disabled")
+        if not config.session_store_uri:
+            log.warning("session-store.type is 'postgres' but no uri is "
+                        "configured; sessions disabled")
+            return None
+        try:
+            from ..services.sessions import DjangoPostgresSessionStore
+            return DjangoPostgresSessionStore(config.session_store_uri)
+        except ImportError:
+            log.warning("no async postgres driver (asyncpg/psycopg) "
+                        "available; sessions disabled")
+            return None
     return None
 
 
@@ -76,11 +85,22 @@ def create_app(config: Optional[AppConfig] = None,
             max_batch=config.batcher.max_batch,
             linger_ms=config.batcher.linger_ms)
             if config.batcher.enabled else Renderer())
+        # The canRead memo's shared tier plays the reference's Hazelcast
+        # distributed-map role across service instances; it rides the same
+        # Redis the caches use (ImageRegionVerticle.java:107-111).
+        shared_memo = None
+        if config.caches.redis_uri:
+            try:
+                from ..services.cache import RedisCache
+                shared_memo = RedisCache(config.caches.redis_uri)
+            except ImportError:
+                log.warning("redis package unavailable; canRead memo "
+                            "stays instance-local")
         services = ImageRegionServices(
             pixels_service=PixelsService(config.data_dir),
             metadata=LocalMetadataService(config.data_dir),
             caches=Caches.from_config(config.caches),
-            can_read_memo=CanReadMemo(),
+            can_read_memo=CanReadMemo(shared=shared_memo),
             renderer=renderer,
             lut_provider=LutProvider(config.lut_root),
             max_tile_length=config.max_tile_length,
@@ -165,6 +185,11 @@ def create_app(config: Optional[AppConfig] = None,
         if isinstance(services.renderer, BatchingRenderer):
             await services.renderer.close()
         services.pixels_service.close()
+        for closable in (session_store,
+                         getattr(services.can_read_memo, "shared", None)):
+            close = getattr(closable, "close", None)
+            if close is not None:
+                await close()
 
     app.on_cleanup.append(on_cleanup)
     app[SERVICES_KEY] = services

@@ -60,6 +60,7 @@ class _Pending:
     settings: dict
     h: int
     w: int
+    quality: int = 0              # JPEG groups only
     future: asyncio.Future = None  # type: ignore[assignment]
 
 
@@ -100,6 +101,31 @@ class BatchingRenderer:
 
         pending = _Pending(raw=raw, settings=settings, h=h, w=w,
                            future=asyncio.get_running_loop().create_future())
+        return await self._enqueue(key, pending)
+
+    async def render_jpeg(self, raw: np.ndarray, settings: dict,
+                          quality: int, width: int, height: int) -> bytes:
+        """Batched fused render + device JPEG front end -> JFIF bytes.
+
+        JPEG groups bucket to the 16-aligned MCU grid (not the power-of-two
+        buckets): the per-tile SOF0 dimensions crop the padding away at
+        decode time, so tiles of different true sizes co-batch whenever
+        their MCU grids match.  Padding is edge-replicated to keep it out
+        of the boundary blocks' DCT energy.
+        """
+        C, h, w = raw.shape
+        gh, gw = h + (-h) % 16, w + (-w) % 16
+        if (h, w) != (gh, gw):
+            raw = np.pad(raw, ((0, 0), (0, gh - h), (0, gw - w)),
+                         mode="edge")
+        key = ("jpeg", C, gh, gw, int(settings["cd_start"]),
+               int(settings["cd_end"]), settings["tables"].ndim, quality)
+        pending = _Pending(raw=raw, settings=settings, h=height, w=width,
+                           quality=quality,
+                           future=asyncio.get_running_loop().create_future())
+        return await self._enqueue(key, pending)
+
+    async def _enqueue(self, key: tuple, pending: _Pending):
         queue = self._queues.get(key)
         if queue is None:
             queue = self._queues[key] = collections.deque()
@@ -146,8 +172,9 @@ class BatchingRenderer:
             if not group:
                 continue
             try:
-                results = await asyncio.to_thread(
-                    self._render_group, group)
+                render = (self._render_group_jpeg if key[0] == "jpeg"
+                          else self._render_group)
+                results = await asyncio.to_thread(render, group)
             except asyncio.CancelledError:
                 # close() cancelled us mid-dispatch: the group is already
                 # popped, so the queue drain in close() can't see it —
@@ -188,3 +215,27 @@ class BatchingRenderer:
         self.batches_dispatched += 1
         self.tiles_rendered += n
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
+
+    def _render_group_jpeg(self, group: List[_Pending]) -> List[bytes]:
+        from ..ops.jpegenc import render_batch_to_jpeg
+
+        n = len(group)
+        B = _pad_batch_size(n, self.max_batch)
+        padded = group + [group[-1]] * (B - n)
+        raw = np.stack([p.raw for p in padded])
+
+        def stack(name):
+            return np.stack([p.settings[name] for p in padded])
+
+        s0 = group[0].settings
+        with stopwatch("Renderer.renderAsPackedInt.batch"):
+            jpegs = render_batch_to_jpeg(
+                raw, stack("window_start"), stack("window_end"),
+                stack("family"), stack("coefficient"), stack("reverse"),
+                s0["cd_start"], s0["cd_end"], stack("tables"),
+                quality=group[0].quality,
+                dims=[(p.w, p.h) for p in group],  # pad tiles skip encode
+            )
+        self.batches_dispatched += 1
+        self.tiles_rendered += n
+        return jpegs

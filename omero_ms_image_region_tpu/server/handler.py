@@ -46,11 +46,25 @@ class NotFoundError(Exception):
     unrenderable outcomes; ``ImageRegionVerticle.java:163-188``)."""
 
 
+def pad_planes_to_mcu(raw: np.ndarray) -> np.ndarray:
+    """Edge-replicate [C, h, w] planes to the 16-aligned JPEG MCU grid.
+
+    Render is pointwise, so padding raw and rendering equals rendering and
+    edge-replicating the image; replication (not zeros) keeps the padding
+    out of the edge blocks' DCT energy.
+    """
+    h, w = raw.shape[-2:]
+    ph, pw = (-h) % 16, (-w) % 16
+    if ph == 0 and pw == 0:
+        return raw
+    return np.pad(raw, ((0, 0), (0, ph), (0, pw)), mode="edge")
+
+
 class Renderer:
     """Direct device render: one dispatch per request.
 
-    The micro-batcher (``server.batcher``) exposes the same ``render``
-    coroutine and substitutes transparently.
+    The micro-batcher (``server.batcher``) exposes the same ``render`` /
+    ``render_jpeg`` coroutines and substitutes transparently.
     """
 
     async def render(self, raw: np.ndarray, settings: dict) -> np.ndarray:
@@ -65,6 +79,27 @@ class Renderer:
             settings["tables"],
         )
         return np.asarray(out)
+
+    async def render_jpeg(self, raw: np.ndarray, settings: dict,
+                          quality: int, width: int, height: int) -> bytes:
+        """Fused render + device JPEG front end for one tile.
+
+        Only quantized coefficients cross the device-host link (the full
+        RGBA fetch is the serving bottleneck on tunnel-attached TPUs).
+        ``raw`` is f32[C, h, w] at the tile's true size; MCU padding and
+        the SOF0 crop are handled here.
+        """
+        return await asyncio.to_thread(
+            self._render_jpeg_sync, raw, settings, quality, width, height)
+
+    def _render_jpeg_sync(self, raw, settings, quality, width, height):
+        from ..flagship import batched_args
+        from ..ops.jpegenc import render_batch_to_jpeg
+
+        padded = pad_planes_to_mcu(np.ascontiguousarray(raw))[None]
+        args = batched_args(settings, padded)
+        return render_batch_to_jpeg(
+            *args, quality=quality, dims=[(width, height)])[0]
 
 
 @dataclass
@@ -109,13 +144,15 @@ class ImageRegionHandler:
 
     async def _can_read(self, object_type: str, object_id: int,
                         session_key: Optional[str]) -> bool:
-        memo = self.s.can_read_memo.get(session_key, object_type, object_id)
+        memo = await self.s.can_read_memo.get_async(
+            session_key, object_type, object_id)
         if memo is not None:
             return memo
         with stopwatch("canRead"):
             ok = await self.s.metadata.can_read(object_type, object_id,
                                                 session_key)
-        self.s.can_read_memo.put(session_key, object_type, object_id, ok)
+        await self.s.can_read_memo.put_async(
+            session_key, object_type, object_id, ok)
         return ok
 
     # ------------------------------------------------------- metadata
@@ -212,6 +249,20 @@ class ImageRegionHandler:
                 self._read_region, src, ctx, region, level or 0, active)
 
         settings = pack_settings(active_rdef, self.s.lut_provider)
+
+        if ctx.format == "jpeg":
+            # Device JPEG path: flips fold into the raw planes (render is
+            # pointwise), and only quantized coefficients leave the device.
+            if ctx.flip_vertical:
+                raw = raw[:, ::-1, :]
+            if ctx.flip_horizontal:
+                raw = raw[:, :, ::-1]
+            h, w = raw.shape[-2:]
+            with stopwatch("Renderer.renderAsPackedInt"):
+                return await self.s.renderer.render_jpeg(
+                    raw, settings,
+                    codecs.quality_percent(ctx.compression_quality), w, h)
+
         with stopwatch("Renderer.renderAsPackedInt"):
             packed = await self.s.renderer.render(raw, settings)
 
@@ -307,15 +358,15 @@ class ShapeMaskHandler:
         return png
 
     async def _can_read(self, ctx: ShapeMaskCtx) -> bool:
-        memo = self.s.can_read_memo.get(ctx.omero_session_key, "Mask",
-                                        ctx.shape_id)
+        memo = await self.s.can_read_memo.get_async(
+            ctx.omero_session_key, "Mask", ctx.shape_id)
         if memo is not None:
             return memo
         with stopwatch("canRead"):
             ok = await self.s.metadata.can_read("Mask", ctx.shape_id,
                                                 ctx.omero_session_key)
-        self.s.can_read_memo.put(ctx.omero_session_key, "Mask",
-                                 ctx.shape_id, ok)
+        await self.s.can_read_memo.put_async(
+            ctx.omero_session_key, "Mask", ctx.shape_id, ok)
         return ok
 
     def _render(self, mask, color, ctx: ShapeMaskCtx) -> bytes:

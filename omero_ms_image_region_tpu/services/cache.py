@@ -87,6 +87,13 @@ def _native_cache(max_bytes: int):
         return None
 
 
+class TTLCacheTier(Protocol):
+    """A tier that can expire entries (used by the shared canRead memo)."""
+
+    async def set_ttl(self, key: str, value: bytes,
+                      ttl_seconds: float) -> None: ...
+
+
 class RedisCache:
     """Shared Redis byte cache (≙ RedisCacheVerticle). Gated: constructing
     raises ImportError when the ``redis`` package is unavailable."""
@@ -100,6 +107,13 @@ class RedisCache:
 
     async def set(self, key: str, value: bytes) -> None:
         await self._client.set(key, value)
+
+    async def set_ttl(self, key: str, value: bytes,
+                      ttl_seconds: float) -> None:
+        await self._client.set(key, value, px=max(1, int(ttl_seconds * 1000)))
+
+    async def close(self) -> None:
+        await self._client.aclose()
 
 
 class CacheStack:

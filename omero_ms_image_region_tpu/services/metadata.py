@@ -138,13 +138,55 @@ def write_mask(data_dir: str, mask: Mask) -> None:
 
 class CanReadMemo:
     """TTL memo of ACL decisions keyed by (session, type, id)
-    (≙ the Hazelcast ``canRead`` map the workers share)."""
+    (≙ the Hazelcast ``canRead`` map the workers share,
+    ``ImageRegionVerticle.java:107-111``).
 
-    def __init__(self, ttl_seconds: float = 60.0):
+    Two tiers: an in-process TTL dict, plus an optional ``shared`` cache
+    tier (Redis in a multi-instance deployment) that plays the Hazelcast
+    distributed-map role — a decision memoized by one service instance is
+    visible to the rest.  The shared tier stores b"1"/b"0" with this
+    memo's TTL when it supports expiry (``set_ttl``); a tier without
+    expiry support is written through plain ``set`` and should only be
+    used where staleness is acceptable (ACL revocations would otherwise
+    never be re-checked).
+    """
+
+    def __init__(self, ttl_seconds: float = 60.0, shared=None):
         self.ttl = ttl_seconds
+        self.shared = shared
         self._lock = threading.Lock()
         self._memo: Dict[Tuple[Optional[str], str, int],
                          Tuple[bool, float]] = {}
+
+    @staticmethod
+    def _shared_key(session_key: Optional[str], object_type: str,
+                    object_id: int) -> str:
+        return f"canRead:{session_key or ''}:{object_type}:{object_id}"
+
+    async def get_async(self, session_key: Optional[str], object_type: str,
+                        object_id: int) -> Optional[bool]:
+        local = self.get(session_key, object_type, object_id)
+        if local is not None or self.shared is None:
+            return local
+        raw = await self.shared.get(
+            self._shared_key(session_key, object_type, object_id))
+        if raw is None:
+            return None
+        value = raw == b"1"
+        self.put(session_key, object_type, object_id, value)
+        return value
+
+    async def put_async(self, session_key: Optional[str], object_type: str,
+                        object_id: int, value: bool) -> None:
+        self.put(session_key, object_type, object_id, value)
+        if self.shared is not None:
+            key = self._shared_key(session_key, object_type, object_id)
+            payload = b"1" if value else b"0"
+            set_ttl = getattr(self.shared, "set_ttl", None)
+            if set_ttl is not None:
+                await set_ttl(key, payload, self.ttl)
+            else:
+                await self.shared.set(key, payload)
 
     def get(self, session_key: Optional[str], object_type: str,
             object_id: int) -> Optional[bool]:

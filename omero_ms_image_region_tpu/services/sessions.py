@@ -87,6 +87,78 @@ class DjangoRedisSessionStore:
         return decode_django_session(payload)
 
 
+class DjangoPostgresSessionStore:
+    """OMERO.web sessions out of the ``django_session`` table
+    (≙ omero-ms-core ``OmeroWebJDBCSessionStore``): looks up the cookie's
+    session key, honoring ``expire_date``, and decodes ``session_data``
+    the same way as the Redis store.  Construction raises ImportError
+    without an async Postgres driver (``asyncpg`` preferred, ``psycopg``
+    accepted); the app factory degrades to sessions-disabled then, as it
+    does for Redis.
+    """
+
+    _QUERY = ("SELECT session_data FROM django_session "
+              "WHERE session_key = {ph} AND expire_date > now()")
+
+    def __init__(self, dsn: str):
+        import asyncio  # noqa: PLC0415
+        try:
+            import asyncpg  # noqa: PLC0415
+            self._driver = "asyncpg"
+            self._asyncpg = asyncpg
+        except ImportError:
+            import psycopg  # noqa: PLC0415
+            self._driver = "psycopg"
+            self._psycopg = psycopg
+        self._dsn = dsn
+        self._pool = None
+        self._init_lock = asyncio.Lock()
+
+    async def _fetch(self, session_id: str) -> Optional[bytes]:
+        if self._driver == "asyncpg":
+            if self._pool is None:
+                async with self._init_lock:
+                    if self._pool is None:  # lock: no double create_pool
+                        self._pool = await self._asyncpg.create_pool(
+                            self._dsn, min_size=1, max_size=4)
+            row = await self._pool.fetchrow(
+                self._QUERY.format(ph="$1"), session_id)
+            return None if row is None else row[0]
+        # psycopg: one autocommit connection (read-only lookups must not
+        # sit idle-in-transaction on django_session), re-established after
+        # any failure — there is no pool to reconnect for us.
+        if self._pool is None:
+            async with self._init_lock:
+                if self._pool is None:
+                    self._pool = await self._psycopg.AsyncConnection.connect(
+                        self._dsn, autocommit=True)
+        try:
+            async with self._pool.cursor() as cur:
+                await cur.execute(self._QUERY.format(ph="%s"), (session_id,))
+                row = await cur.fetchone()
+        except Exception:
+            conn, self._pool = self._pool, None
+            try:
+                await conn.close()
+            except Exception:
+                pass
+            raise
+        return None if row is None else row[0]
+
+    async def get_session_key(self, session_id: str) -> Optional[str]:
+        payload = await self._fetch(session_id)
+        if payload is None:
+            return None
+        if isinstance(payload, str):
+            payload = payload.encode()
+        return decode_django_session(payload)
+
+    async def close(self) -> None:
+        if self._pool is not None:
+            await self._pool.close()
+            self._pool = None
+
+
 async def resolve_session_key(store: Optional[SessionStore],
                               cookies: Mapping[str, str],
                               cookie_name: str = DEFAULT_COOKIE

@@ -66,6 +66,52 @@ class TestBatchingRenderer:
         assert batched.shape == (40, 56)       # cropped back from 256 pad
         np.testing.assert_array_equal(direct, batched)
 
+    def test_jpeg_group_cobatches_same_mcu_grid(self):
+        """Different true sizes sharing one 16-aligned grid batch together;
+        each SOF0 carries its own dimensions."""
+        import io
+
+        from PIL import Image
+
+        rng = np.random.default_rng(4)
+        settings = _settings()
+        raw_a = rng.integers(0, 60000, size=(3, 20, 28)).astype(np.float32)
+        raw_b = rng.integers(0, 60000, size=(3, 32, 32)).astype(np.float32)
+
+        async def main():
+            batcher = BatchingRenderer(max_batch=4, linger_ms=20.0)
+            try:
+                outs = await asyncio.gather(
+                    batcher.render_jpeg(raw_a, settings, 85, 28, 20),
+                    batcher.render_jpeg(raw_b, settings, 85, 32, 32))
+                return outs, batcher.batches_dispatched
+            finally:
+                await batcher.close()
+
+        (a, b), dispatched = run(main())
+        assert dispatched == 1
+        assert Image.open(io.BytesIO(a)).size == (28, 20)
+        assert Image.open(io.BytesIO(b)).size == (32, 32)
+
+    def test_jpeg_matches_direct_renderer_jpeg(self):
+        rng = np.random.default_rng(5)
+        settings = _settings()
+        raw = rng.integers(0, 60000, size=(3, 48, 48)).astype(np.float32)
+
+        async def main():
+            batcher = BatchingRenderer(linger_ms=0.5)
+            try:
+                direct = await Renderer().render_jpeg(
+                    raw, settings, 85, 48, 48)
+                batched = await batcher.render_jpeg(
+                    raw, settings, 85, 48, 48)
+                return direct, batched
+            finally:
+                await batcher.close()
+
+        direct, batched = run(main())
+        assert direct == batched  # same kernel, same entropy coder
+
     def test_concurrent_requests_coalesce(self):
         rng = np.random.default_rng(1)
         settings = _settings()

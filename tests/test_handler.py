@@ -86,6 +86,36 @@ class TestImageRegionHandler:
             _ctx(region="8,8,24,20", format="png")))
         assert codecs.decode_to_rgba(region).shape == (20, 24, 4)
 
+    def test_jpeg_device_path_matches_png_render(self, services):
+        """format=jpeg routes through the fused device JPEG front end; the
+        decoded image must match the (lossless) PNG path within JPEG
+        tolerance."""
+        handler = ImageRegionHandler(services)
+        png = codecs.decode_to_rgba(
+            run(handler.render_image_region(_ctx(format="png"))))
+        jpg_bytes = run(handler.render_image_region(_ctx(format="jpeg")))
+        assert jpg_bytes[:2] == b"\xff\xd8"
+        jpg = codecs.decode_to_rgba(jpg_bytes)
+        assert jpg.shape == (H, W, 4)
+        err = np.abs(jpg[..., :3].astype(float) - png[..., :3].astype(float))
+        assert err.mean() < 8.0
+
+    def test_jpeg_odd_size_region_and_flip(self, services):
+        """Non-MCU-aligned regions pad on device and crop via SOF0 dims;
+        flips fold into the raw planes."""
+        handler = ImageRegionHandler(services)
+        jpg = codecs.decode_to_rgba(run(handler.render_image_region(
+            _ctx(region="3,5,30,18", format="jpeg"))))
+        assert jpg.shape == (18, 30, 4)
+
+        plain = codecs.decode_to_rgba(run(handler.render_image_region(
+            _ctx(format="jpeg"))))
+        flipped = codecs.decode_to_rgba(run(handler.render_image_region(
+            _ctx(format="jpeg", flip="h"))))
+        err = np.abs(flipped[:, ::-1, :3].astype(float)
+                     - plain[..., :3].astype(float))
+        assert err.mean() < 6.0  # JPEG noise only; geometry must mirror
+
     def test_second_request_hits_cache(self, services):
         handler = ImageRegionHandler(services)
         ctx = _ctx(format="png", tile="0,0,0,16,16")

@@ -153,6 +153,23 @@ def test_sparse_pack_roundtrips_to_dense():
     np.testing.assert_array_equal(got[2], cr)
 
 
+def test_sparse_to_dense_accepts_unaligned_true_dims():
+    """The wire buffer covers the 16-aligned grid; callers may pass the
+    tile's true (unaligned) dims — counts must use ceil, like the native
+    encoder."""
+    img = pad_to_mcu(blob_image(20, 28, seed=12))
+    assert img.shape == (32, 32, 3)
+    y, cb, cr = coeffs_for(img, 85)
+    cap = 1024
+    buf = np.asarray(sparse_pack(y[None], cb[None], cr[None], cap))[0]
+    got = sparse_to_dense(buf, 20, 28, cap)     # true dims, not padded
+    assert got is not None
+    np.testing.assert_array_equal(got[0], y)
+    data = encode_jfif(got[0], got[1], got[2], 28, 20, 85)
+    dec = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    assert dec.shape == (20, 28, 3)
+
+
 def test_sparse_pack_overflow_detected():
     rng = np.random.default_rng(0)
     img = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)  # dense noise

@@ -123,6 +123,67 @@ class TestCanReadMemo:
         assert expired.get("s", "Image", 1) is None
 
 
+class TestSharedCanReadMemo:
+    def test_shared_tier_is_visible_across_instances(self):
+        """The shared tier plays the Hazelcast distributed-map role: a
+        decision memoized by one service instance is seen by another."""
+        shared = MemoryLRUCache(max_bytes=1 << 20)
+        a = CanReadMemo(ttl_seconds=1000, shared=shared)
+        b = CanReadMemo(ttl_seconds=1000, shared=shared)
+        run(a.put_async("s", "Image", 9, False))
+        assert run(b.get_async("s", "Image", 9)) is False
+        assert b.get("s", "Image", 9) is False  # promoted to local tier
+
+    def test_without_shared_tier_stays_local(self):
+        a = CanReadMemo(ttl_seconds=1000)
+        b = CanReadMemo(ttl_seconds=1000)
+        run(a.put_async("s", "Image", 9, True))
+        assert run(b.get_async("s", "Image", 9)) is None
+
+
+class TestPostgresSessionStore:
+    def test_reads_django_session_table(self, monkeypatch):
+        """Exercises the asyncpg code path with a stub driver."""
+        import base64
+        import sys
+        import types
+
+        payload = base64.b64encode(
+            b"hmac:" + __import__("pickle").dumps(
+                {"connector": {"omero_session_key": "pgkey"}}))
+
+        class FakePool:
+            async def fetchrow(self, query, sid):
+                assert "django_session" in query and "$1" in query
+                return (payload,) if sid == "sid1" else None
+
+            async def close(self):
+                pass
+
+        fake = types.ModuleType("asyncpg")
+
+        async def create_pool(dsn, **kw):
+            return FakePool()
+
+        fake.create_pool = create_pool
+        monkeypatch.setitem(sys.modules, "asyncpg", fake)
+
+        from omero_ms_image_region_tpu.services.sessions import (
+            DjangoPostgresSessionStore,
+        )
+        store = DjangoPostgresSessionStore("postgresql://x/y")
+
+        async def main():
+            hit = await store.get_session_key("sid1")
+            miss = await store.get_session_key("other")
+            await store.close()
+            return hit, miss
+
+        hit, miss = run(main())
+        assert hit == "pgkey"
+        assert miss is None
+
+
 class TestSessions:
     def test_static_store(self):
         store = StaticSessionStore({"cookie1": "omero-key-1"})

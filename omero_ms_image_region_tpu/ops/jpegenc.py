@@ -584,8 +584,9 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
     render is pointwise so padding commutes with it) and per-tile settings
     stacked along B as in :func:`render_to_jpeg_sparse`.  ``dims`` gives
     each tile's true ``(width, height)`` written into its SOF0 header —
-    the decoder crops the MCU padding away, so tiles of different true
-    sizes share a dispatch as long as their 16-aligned grids match.
+    the decoder crops the MCU padding away.  A tile whose own ceil-16
+    grid is smaller than (H, W) (spatial bucketing bounding the compile
+    set) is entropy-coded from the top-left block subgrid on the host.
     Overflowing tiles re-run through the dense coefficient path.
     """
     from ..native import SparseOverflowError
@@ -602,26 +603,33 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
     if jpeg_native_available():
         from ..native import jpeg_encode_native as _dense_encode
     else:
-        _dense_encode = None
+        from ..jfif import encode_jfif as _dense_encode
+
+    def dense_coefficients(i):
+        y, cb, cr = render_to_jpeg_coefficients(
+            raw[i:i + 1],
+            *(a[i:i + 1] if getattr(a, "ndim", 0) else a
+              for a in (window_start, window_end, family, coefficient,
+                        reverse)),
+            cd_start, cd_end,
+            tables[i:i + 1], qy, qc)
+        return np.asarray(y)[0], np.asarray(cb)[0], np.asarray(cr)[0]
 
     out = []
     for i, (w_, h_) in enumerate(dims):
+        exact = ((h_ + 15) // 16 * 16 == H and (w_ + 15) // 16 * 16 == W)
         try:
-            out.append(_encode(bufs[i], w_, h_, quality, cap))
+            if exact:
+                out.append(_encode(bufs[i], w_, h_, quality, cap))
+                continue
+            dense = sparse_to_dense(bufs[i], H, W, cap)
+            if dense is None:
+                raise SparseOverflowError(f"overflow (cap={cap})")
         except SparseOverflowError:
-            y, cb, cr = render_to_jpeg_coefficients(
-                raw[i:i + 1],
-                *(a[i:i + 1] if getattr(a, "ndim", 0) else a
-                  for a in (window_start, window_end, family, coefficient,
-                            reverse)),
-                cd_start, cd_end,
-                tables[i:i + 1], qy, qc)
-            y, cb, cr = np.asarray(y)[0], np.asarray(cb)[0], np.asarray(cr)[0]
-            if _dense_encode is not None:
-                out.append(_dense_encode(y, cb, cr, w_, h_, quality))
-            else:
-                from ..jfif import encode_jfif
-                out.append(encode_jfif(y, cb, cr, w_, h_, quality))
+            dense = dense_coefficients(i)
+        y, cb, cr = slice_block_subgrid(*dense, H, W, w_, h_) \
+            if not exact else dense
+        out.append(_dense_encode(y, cb, cr, w_, h_, quality))
     return out
 
 
@@ -633,3 +641,41 @@ def pad_to_mcu(rgba: np.ndarray) -> np.ndarray:
         return rgba
     pad = [(0, ph), (0, pw)] + [(0, 0)] * (rgba.ndim - 2)
     return np.pad(rgba, pad, mode="edge")
+
+
+def pad_planes_to_mcu(raw: np.ndarray, target_h: int | None = None,
+                      target_w: int | None = None) -> np.ndarray:
+    """Edge-replicate [C, h, w] planes to a 16-aligned grid.
+
+    Render is pointwise, so padding raw and rendering equals rendering and
+    edge-replicating the image; replication (not zeros) keeps the padding
+    out of the edge blocks' DCT energy.  ``target_h``/``target_w`` pad to
+    a larger (bucketed) grid; default is the tile's own ceil-16 grid.
+    """
+    h, w = raw.shape[-2:]
+    th = target_h if target_h is not None else h + (-h) % 16
+    tw = target_w if target_w is not None else w + (-w) % 16
+    if th % 16 or tw % 16 or th < h or tw < w:
+        raise ValueError(f"bad MCU pad target ({th}, {tw}) for ({h}, {w})")
+    if (th, tw) == (h, w):
+        return raw
+    return np.pad(raw, ((0, 0), (0, th - h), (0, tw - w)), mode="edge")
+
+
+def slice_block_subgrid(y, cb, cr, grid_h: int, grid_w: int,
+                        width: int, height: int):
+    """Take the top-left ceil-16 subgrid of dense coefficient blocks.
+
+    The wire buffer may cover a bucketed (grid_h, grid_w) frame larger
+    than the tile; baseline JPEG decodes exactly ceil(h/16) x ceil(w/16)
+    MCUs from the SOF0 dims, so the surplus blocks must be dropped before
+    entropy coding.
+    """
+    gh16, gw16 = grid_h // 16, grid_w // 16
+    th16, tw16 = (height + 15) // 16, (width + 15) // 16
+    y = y.reshape(gh16 * 2, gw16 * 2, 64)[:th16 * 2, :tw16 * 2]
+    cb = cb.reshape(gh16, gw16, 64)[:th16, :tw16]
+    cr = cr.reshape(gh16, gw16, 64)[:th16, :tw16]
+    return (np.ascontiguousarray(y).reshape(-1, 64),
+            np.ascontiguousarray(cb).reshape(-1, 64),
+            np.ascontiguousarray(cr).reshape(-1, 64))

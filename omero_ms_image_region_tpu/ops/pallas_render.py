@@ -33,6 +33,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .quantum import _ratio as _quantum_ratio
+
 # Row-block height per grid step; W is never blocked (tiles are <= 2048
 # wide and a full row keeps the lane dim dense).
 _BLOCK_H = 256
@@ -42,9 +44,9 @@ def _render_kernel(ws_ref, we_ref, fam_ref, coef_ref, rev_ref, cd_ref,
                    raw_ref, tables_ref, out_ref):
     """One (batch, row-block) grid step.
 
-    raw_ref:    f32[C, bh, W]       (VMEM)
+    raw_ref:    f32[C, bh, W]       (VMEM; already loaded block)
     tables_ref: f32[C, 256, 128]    (VMEM; only cols 0..2 are live)
-    out_ref:    u32[bh, W]          (VMEM)
+    out_ref:    u32[1, bh, W]       (VMEM ref; leading block dim)
     scalars (SMEM, prefetched): ws/we/fam/coef/rev f32|i32[C], cd i32[2]
     """
     C, bh, W = raw_ref.shape
@@ -63,28 +65,22 @@ def _render_kernel(ws_ref, we_ref, fam_ref, coef_ref, rev_ref, cd_ref,
         fam = fam_ref[c]
         k = coef_ref[c]
 
-        # Window normalize (clamped), then the family curve — the same
-        # closed forms as ops.quantum.quantize.
-        denom = jnp.where(we - ws == 0.0, 1.0, we - ws)
-        ratio = jnp.clip((x - ws) / denom, 0.0, 1.0)
-        poly = jnp.sign(ratio) * jnp.power(jnp.abs(ratio), k)
-        log_r = jnp.log1p(ratio * (jnp.e - 1.0))           # maps [0,1]->[0,1]
-        expo = jnp.power(jnp.exp(jnp.power(ratio, k)) - 1.0,
-                         1.0) / (jnp.e - 1.0)
-        curved = jnp.where(
-            fam == 0, ratio,
-            jnp.where(fam == 1, poly,
-                      jnp.where(fam == 2, log_r, expo)))
-        q = cd_start.astype(jnp.float32) + k_max * curved
-        q = jnp.round(q)
+        # Window clamp + family curve: the exact closed forms the XLA
+        # kernel uses (ops.quantum._ratio), evaluated on VMEM blocks, so
+        # the two paths agree bit-for-bit for every family.
+        x_clamped = jnp.clip(x, jnp.minimum(ws, we), jnp.maximum(ws, we))
+        ratio = jnp.clip(
+            _quantum_ratio(x_clamped, x, ws, we, fam, k), 0.0, 1.0)
+        q = jnp.round(cd_start.astype(jnp.float32) + k_max * ratio)
         # Reverse-intensity codomain op.
         q = jnp.where(rev_ref[c] != 0,
                       (cd_start + cd_end).astype(jnp.float32) - q, q)
         q = jnp.clip(q, 0.0, 255.0)
 
         # One-hot contraction on the MXU: [bh*W, 256] @ [256, 128].
-        qi = q.reshape(bh * W, 1)
-        classes = jax.lax.broadcasted_iota(jnp.float32, (1, 256), 1)
+        # (Integer compare: Mosaic rejects float iota.)
+        qi = q.astype(jnp.int32).reshape(bh * W, 1)
+        classes = jax.lax.broadcasted_iota(jnp.int32, (1, 256), 1)
         onehot = (qi == classes).astype(jnp.float32)
         rgb = jnp.dot(onehot, tables_ref[c],
                       preferred_element_type=jnp.float32)
@@ -92,10 +88,12 @@ def _render_kernel(ws_ref, we_ref, fam_ref, coef_ref, rev_ref, cd_ref,
         acc_g += rgb[:, 1].reshape(bh, W)
         acc_b += rgb[:, 2].reshape(bh, W)
 
-    r = jnp.clip(jnp.round(acc_r), 0.0, 255.0).astype(jnp.uint32)
-    g = jnp.clip(jnp.round(acc_g), 0.0, 255.0).astype(jnp.uint32)
-    b = jnp.clip(jnp.round(acc_b), 0.0, 255.0).astype(jnp.uint32)
-    out_ref[:] = r | (g << 8) | (b << 16) | jnp.uint32(0xFF000000)
+    # Mosaic has no direct f32->u32 cast; go through i32 (values <= 255).
+    r = jnp.clip(jnp.round(acc_r), 0.0, 255.0).astype(jnp.int32)
+    g = jnp.clip(jnp.round(acc_g), 0.0, 255.0).astype(jnp.int32)
+    b = jnp.clip(jnp.round(acc_b), 0.0, 255.0).astype(jnp.int32)
+    packed = r | (g << 8) | (b << 16) | jnp.int32(-0x1000000)  # A=0xFF
+    out_ref[0] = jax.lax.bitcast_convert_type(packed, jnp.uint32)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -134,7 +132,7 @@ def render_tile_batch_packed_pallas(raw, window_start, window_end, family,
 
     def kernel(ws, we, fam, coef, rev, cdv, raw_blk, tab_blk, out_blk):
         _render_kernel(ws, we, fam, coef, rev, cdv,
-                       raw_blk[0], tab_blk, out_blk[0])
+                       raw_blk[0], tab_blk, out_blk)
 
     return pl.pallas_call(
         kernel,

@@ -85,22 +85,19 @@ def create_app(config: Optional[AppConfig] = None,
             max_batch=config.batcher.max_batch,
             linger_ms=config.batcher.linger_ms)
             if config.batcher.enabled else Renderer())
-        # The canRead memo's shared tier plays the reference's Hazelcast
-        # distributed-map role across service instances; it rides the same
-        # Redis the caches use (ImageRegionVerticle.java:107-111).
-        shared_memo = None
-        if config.caches.redis_uri:
-            try:
-                from ..services.cache import RedisCache
-                shared_memo = RedisCache(config.caches.redis_uri)
-            except ImportError:
-                log.warning("redis package unavailable; canRead memo "
-                            "stays instance-local")
+        caches = Caches.from_config(config.caches)
+        if config.caches.redis_uri and caches.redis is None:
+            log.warning("redis package unavailable; redis cache tier and "
+                        "shared canRead memo disabled")
         services = ImageRegionServices(
             pixels_service=PixelsService(config.data_dir),
             metadata=LocalMetadataService(config.data_dir),
-            caches=Caches.from_config(config.caches),
-            can_read_memo=CanReadMemo(shared=shared_memo),
+            caches=caches,
+            # The canRead memo's shared tier plays the reference's
+            # Hazelcast distributed-map role across service instances; it
+            # rides the caches' one Redis client
+            # (ImageRegionVerticle.java:107-111).
+            can_read_memo=CanReadMemo(shared=caches.redis),
             renderer=renderer,
             lut_provider=LutProvider(config.lut_root),
             max_tile_length=config.max_tile_length,
@@ -185,11 +182,12 @@ def create_app(config: Optional[AppConfig] = None,
         if isinstance(services.renderer, BatchingRenderer):
             await services.renderer.close()
         services.pixels_service.close()
-        for closable in (session_store,
-                         getattr(services.can_read_memo, "shared", None)):
-            close = getattr(closable, "close", None)
-            if close is not None:
-                await close()
+        close_caches = getattr(services.caches, "close", None)
+        if close_caches is not None:
+            await close_caches()  # the one shared Redis client (memo too)
+        close = getattr(session_store, "close", None)
+        if close is not None:
+            await close()
 
     app.on_cleanup.append(on_cleanup)
     app[SERVICES_KEY] = services

@@ -107,18 +107,21 @@ class BatchingRenderer:
                           quality: int, width: int, height: int) -> bytes:
         """Batched fused render + device JPEG front end -> JFIF bytes.
 
-        JPEG groups bucket to the 16-aligned MCU grid (not the power-of-two
-        buckets): the per-tile SOF0 dimensions crop the padding away at
-        decode time, so tiles of different true sizes co-batch whenever
-        their MCU grids match.  Padding is edge-replicated to keep it out
-        of the boundary blocks' DCT energy.
+        JPEG groups use the same spatial buckets as the packed path (all
+        16-aligned), bounding the compile set against client-controlled
+        region sizes; the per-tile SOF0 dimensions make decoders crop the
+        padding, and tiles whose own MCU grid is smaller than the bucket
+        are entropy-coded from the top-left block subgrid host-side
+        (``ops.jpegenc.render_batch_to_jpeg``).  Padding is
+        edge-replicated to keep it out of the boundary blocks' DCT energy.
         """
+        from ..ops.jpegenc import pad_planes_to_mcu
+
         C, h, w = raw.shape
         gh, gw = h + (-h) % 16, w + (-w) % 16
-        if (h, w) != (gh, gw):
-            raw = np.pad(raw, ((0, 0), (0, gh - h), (0, gw - w)),
-                         mode="edge")
-        key = ("jpeg", C, gh, gw, int(settings["cd_start"]),
+        bh, bw = pick_bucket(gh, gw, self.buckets)
+        raw = pad_planes_to_mcu(raw, bh, bw)
+        key = ("jpeg", C, bh, bw, int(settings["cd_start"]),
                int(settings["cd_end"]), settings["tables"].ndim, quality)
         pending = _Pending(raw=raw, settings=settings, h=height, w=width,
                            quality=quality,

@@ -46,20 +46,6 @@ class NotFoundError(Exception):
     unrenderable outcomes; ``ImageRegionVerticle.java:163-188``)."""
 
 
-def pad_planes_to_mcu(raw: np.ndarray) -> np.ndarray:
-    """Edge-replicate [C, h, w] planes to the 16-aligned JPEG MCU grid.
-
-    Render is pointwise, so padding raw and rendering equals rendering and
-    edge-replicating the image; replication (not zeros) keeps the padding
-    out of the edge blocks' DCT energy.
-    """
-    h, w = raw.shape[-2:]
-    ph, pw = (-h) % 16, (-w) % 16
-    if ph == 0 and pw == 0:
-        return raw
-    return np.pad(raw, ((0, 0), (0, ph), (0, pw)), mode="edge")
-
-
 class Renderer:
     """Direct device render: one dispatch per request.
 
@@ -94,7 +80,7 @@ class Renderer:
 
     def _render_jpeg_sync(self, raw, settings, quality, width, height):
         from ..flagship import batched_args
-        from ..ops.jpegenc import render_batch_to_jpeg
+        from ..ops.jpegenc import pad_planes_to_mcu, render_batch_to_jpeg
 
         padded = pad_planes_to_mcu(np.ascontiguousarray(raw))[None]
         args = batched_args(settings, padded)

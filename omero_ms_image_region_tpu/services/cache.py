@@ -189,32 +189,48 @@ class CacheConfig:
                    shape_mask=True, **kwargs)
 
 
-def make_cache(config: CacheConfig, enabled: bool) -> CacheStack:
-    """Build one named cache's tier stack from config."""
+def make_cache(config: CacheConfig, enabled: bool,
+               redis: Optional[RedisCache] = None) -> CacheStack:
+    """Build one named cache's tier stack from config.
+
+    ``redis`` is the deployment's one shared client (all stacks ride the
+    same connection pool, like the reference's single RedisCacheVerticle).
+    """
     tiers: List[CacheTier] = []
     native = _native_cache(config.local_max_bytes)
     tiers.append(native if native is not None
                  else MemoryLRUCache(config.local_max_bytes))
-    if config.redis_uri:
-        try:
-            tiers.append(RedisCache(config.redis_uri))
-        except ImportError:
-            pass
+    if redis is not None:
+        tiers.append(redis)
     return CacheStack(tiers, enabled=enabled)
 
 
 @dataclass
 class Caches:
-    """The three named caches the reference runs (``config.yaml:53-60``)."""
+    """The three named caches the reference runs (``config.yaml:53-60``),
+    plus the one shared Redis client they (and the canRead memo) ride."""
 
     image_region: CacheStack
     pixels_metadata: CacheStack
     shape_mask: CacheStack
+    redis: Optional[RedisCache] = None
 
     @classmethod
     def from_config(cls, config: CacheConfig) -> "Caches":
+        redis = None
+        if config.redis_uri:
+            try:
+                redis = RedisCache(config.redis_uri)
+            except ImportError:
+                pass
         return cls(
-            image_region=make_cache(config, config.image_region),
-            pixels_metadata=make_cache(config, config.pixels_metadata),
-            shape_mask=make_cache(config, config.shape_mask),
+            image_region=make_cache(config, config.image_region, redis),
+            pixels_metadata=make_cache(config, config.pixels_metadata,
+                                       redis),
+            shape_mask=make_cache(config, config.shape_mask, redis),
+            redis=redis,
         )
+
+    async def close(self) -> None:
+        if self.redis is not None:
+            await self.redis.close()

@@ -18,6 +18,7 @@ Hazelcast distributed ``canRead`` memo map keyed by
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -25,6 +26,8 @@ from typing import Dict, Optional, Protocol, Tuple
 
 from ..models.mask import Mask
 from ..models.pixels import Pixels
+
+logger = logging.getLogger(__name__)
 
 
 class MetadataService(Protocol):
@@ -168,8 +171,15 @@ class CanReadMemo:
         local = self.get(session_key, object_type, object_id)
         if local is not None or self.shared is None:
             return local
-        raw = await self.shared.get(
-            self._shared_key(session_key, object_type, object_id))
+        # A shared-tier failure is a miss, never a request failure (same
+        # degradation policy as CacheStack): the ACL service itself still
+        # answers.
+        try:
+            raw = await self.shared.get(
+                self._shared_key(session_key, object_type, object_id))
+        except Exception as e:
+            logger.warning("shared canRead memo get failed: %r", e)
+            return None
         if raw is None:
             return None
         value = raw == b"1"
@@ -182,11 +192,14 @@ class CanReadMemo:
         if self.shared is not None:
             key = self._shared_key(session_key, object_type, object_id)
             payload = b"1" if value else b"0"
-            set_ttl = getattr(self.shared, "set_ttl", None)
-            if set_ttl is not None:
-                await set_ttl(key, payload, self.ttl)
-            else:
-                await self.shared.set(key, payload)
+            try:
+                set_ttl = getattr(self.shared, "set_ttl", None)
+                if set_ttl is not None:
+                    await set_ttl(key, payload, self.ttl)
+                else:
+                    await self.shared.set(key, payload)
+            except Exception as e:
+                logger.warning("shared canRead memo set failed: %r", e)
 
     def get(self, session_key: Optional[str], object_type: str,
             object_id: int) -> Optional[bool]:

@@ -207,17 +207,23 @@ def bench_config1(rng):
 # -------------------------------------------------------------- config 2
 
 def bench_config2(rng):
-    """3-ch uint16 full plane (2048^2) window+color composite."""
-    from omero_ms_image_region_tpu.ops.render import render_tile_packed
+    """3-ch uint16 full plane (2048^2) -> JPEG bytes (device front end)."""
+    import jax
+
+    from omero_ms_image_region_tpu.flagship import (
+        batched_args, synthetic_wsi_tiles,
+    )
+    from omero_ms_image_region_tpu.ops.jpegenc import render_batch_to_jpeg
 
     _, s = _settings_for(3)
-    raw = rng.integers(0, 65535, size=(3, 2048, 2048)).astype(np.float32)
+    raw = jax.device_put(synthetic_wsi_tiles(rng, 1, 3, 2048, 2048))
+    jax.block_until_ready(raw)
+    args = batched_args(s, np.zeros((1, 3, 1, 1), np.float32))[1:]
 
     def tpu():
-        np.asarray(render_tile_packed(
-            raw, s["window_start"], s["window_end"], s["family"],
-            s["coefficient"], s["reverse"], s["cd_start"], s["cd_end"],
-            s["tables"]))
+        jpegs = render_batch_to_jpeg(raw, *args, quality=85,
+                                     dims=[(2048, 2048)])
+        assert jpegs[0][:2] == b"\xff\xd8"
 
     return 1.0 / _timed(tpu, repeats=5)
 
@@ -225,23 +231,45 @@ def bench_config2(rng):
 # -------------------------------------------------------------- config 4
 
 def bench_config4(rng):
-    """intmax Z-projection over a 32-plane 3-ch 512^2 stack + render."""
+    """intmax Z-projection over a 32-plane 3-ch 512^2 stack -> JPEG.
+
+    Projection + render + JPEG front end fuse into one device dispatch;
+    the stack stays resident (the projection source is device data in the
+    serving flow too, via the pixel-source read).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from omero_ms_image_region_tpu.flagship import (
+        batched_args, synthetic_wsi_tiles,
+    )
     from omero_ms_image_region_tpu.models.rendering import Projection
+    from omero_ms_image_region_tpu.ops.jpegenc import (
+        default_sparse_cap, encode_sparse_buffers, quant_tables,
+        render_to_jpeg_sparse,
+    )
     from omero_ms_image_region_tpu.ops.projection import project_stack
-    from omero_ms_image_region_tpu.ops.render import render_tile_packed
 
     _, s = _settings_for(3)
-    stacks = rng.integers(0, 65535, size=(3, 32, 512, 512)).astype(
-        np.float32)
+    stacks = jax.device_put(
+        synthetic_wsi_tiles(rng, 3, 32, 512, 512))  # [C=3, Z=32, H, W]
+    jax.block_until_ready(stacks)
+    args = batched_args(s, np.zeros((1, 3, 1, 1), np.float32))[1:]
+    qy, qc = (np.asarray(t, np.int32) for t in quant_tables(85))
+    cap = default_sparse_cap(512, 512)
+
+    @jax.jit
+    def project_render(stacks_):
+        planes = jax.vmap(
+            lambda st: project_stack(st, Projection.MAXIMUM_INTENSITY,
+                                     0, 31, 1, 65535.0)
+        )(stacks_.astype(jnp.float32))
+        return render_to_jpeg_sparse(planes[None], *args, qy, qc, cap=cap)
 
     def run():
-        planes = [project_stack(stacks[c], Projection.MAXIMUM_INTENSITY,
-                                0, 31, 1, 65535.0) for c in range(3)]
-        raw = np.stack([np.asarray(p) for p in planes])
-        np.asarray(render_tile_packed(
-            raw, s["window_start"], s["window_end"], s["family"],
-            s["coefficient"], s["reverse"], s["cd_start"], s["cd_end"],
-            s["tables"]))
+        buf = np.asarray(project_render(stacks))
+        jpegs = encode_sparse_buffers(buf, 512, 512, 85, cap)
+        assert jpegs[0][:2] == b"\xff\xd8"
 
     return 1.0 / _timed(run, repeats=5)
 

@@ -229,8 +229,14 @@ def default_sparse_cap(H: int, W: int) -> int:
     Measured densities: synthetic WSI content ~3%, worst-case uniform
     noise ~45% (which overflows and takes the dense fallback — by design).
     """
+    return max_sparse_cap(H, W) // 8
+
+
+def max_sparse_cap(H: int, W: int) -> int:
+    """Every coefficient slot of the (16-aligned) frame — the cap at which
+    no tile can overflow (tests and noise workloads)."""
     nb = (H // 8) * (W // 8) + 2 * (H // 16) * (W // 16)
-    return nb * 8
+    return nb * 64
 
 
 def sparse_to_dense(buf: np.ndarray, H: int, W: int, cap: int):

@@ -139,6 +139,30 @@ def _local_render(raw, window_start, window_end, family, coefficient,
     return jnp.stack(comps, axis=0)                # [3, Bl, H, W]
 
 
+# One spec per step argument: raw [B, C, H, W], five per-channel setting
+# arrays, the two codomain scalars, and tables/weights [C, ...].
+_STEP_IN_SPECS = (
+    P("data", "chan"), P("chan"), P("chan"), P("chan"), P("chan"),
+    P("chan"), P(), P(), P("chan"),
+)
+
+
+def _composite_step(raw, window_start, window_end, family, coefficient,
+                    reverse, cd_start, cd_end, tables):
+    """Per-shard render + cross-shard composite -> packed u32[Bl, H, W].
+
+    The additive composite across channel shards is the one collective
+    (``psum`` over ICI); the shared body of every sharded step variant.
+    """
+    partial_rgb = _local_render(
+        raw, window_start, window_end, family, coefficient, reverse,
+        cd_start, cd_end, tables,
+    )                                          # f32 [3, Bl, H, W]
+    rgb = jax.lax.psum(partial_rgb, axis_name="chan")
+    rgb = jnp.clip(jnp.round(rgb), 0.0, 255.0).astype(jnp.uint32)
+    return rgb[0] | (rgb[1] << 8) | (rgb[2] << 16) | jnp.uint32(0xFF000000)
+
+
 def render_step_sharded(mesh: Mesh):
     """Build the jitted mesh-sharded batched render step.
 
@@ -148,33 +172,49 @@ def render_step_sharded(mesh: Mesh):
     with ``raw`` f32[B, C, H, W] sharded ``P('data', 'chan')`` and
     per-channel arrays sharded ``P('chan')``; output sharded ``P('data')``.
     """
+    sharded = shard_map(
+        _composite_step,
+        mesh=mesh,
+        in_specs=_STEP_IN_SPECS,
+        out_specs=P("data"),
+    )
+    return jax.jit(sharded)
 
-    def step(raw, window_start, window_end, family, coefficient, reverse,
-             cd_start, cd_end, tables):
-        partial_rgb = _local_render(
-            raw, window_start, window_end, family, coefficient, reverse,
-            cd_start, cd_end, tables,
-        )                                          # f32 [3, Bl, H, W]
-        # The additive composite across channel shards: ICI collective.
-        rgb = jax.lax.psum(partial_rgb, axis_name="chan")
-        rgb = jnp.clip(jnp.round(rgb), 0.0, 255.0).astype(jnp.uint32)
-        r, g, b = rgb[0], rgb[1], rgb[2]
-        return r | (g << 8) | (b << 16) | jnp.uint32(0xFF000000)
+
+def render_jpeg_step_sharded(mesh: Mesh, quality: int = 85,
+                             cap: int | None = None):
+    """The full mesh-sharded serving step: raw tiles -> JPEG wire buffers.
+
+    Composes the sharded render (data-parallel tiles x channel-parallel
+    partial composites joined by ``psum``) with the device JPEG front end
+    (YCbCr, 4:2:0, blocked DCT, quantize, zigzag, sparse nonzero packing)
+    — everything the single-chip serving path runs, expressed over the
+    mesh, so a multi-host deployment shards whole requests end to end.
+    After the ``psum`` the packed image is replicated across the ``chan``
+    group, so the JPEG stage computes redundantly there and the output is
+    simply data-sharded.
+
+    Returns ``step(*shard_batch(...)) -> u8[B, wire_bytes]`` sparse
+    buffers (``ops.jpegenc.sparse_pack`` layout; finish host-side with
+    ``ops.jpegenc.encode_sparse_buffers``).
+    """
+    from ..ops.jpegenc import (default_sparse_cap, packed_to_jpeg_coefficients,
+                               quant_tables, sparse_pack)
+
+    qy, qc = (jnp.asarray(np.asarray(t, np.int32))
+              for t in quant_tables(quality))
+
+    def step(*args):
+        packed = _composite_step(*args)              # u32[Bl, H, W]
+        H, W = packed.shape[-2:]
+        local_cap = cap if cap is not None else default_sparse_cap(H, W)
+        y, cb, cr = packed_to_jpeg_coefficients(packed, qy, qc)
+        return sparse_pack(y, cb, cr, local_cap)
 
     sharded = shard_map(
         step,
         mesh=mesh,
-        in_specs=(
-            P("data", "chan"),   # raw [B, C, H, W]
-            P("chan"),           # window_start [C]
-            P("chan"),           # window_end [C]
-            P("chan"),           # family [C]
-            P("chan"),           # coefficient [C]
-            P("chan"),           # reverse [C]
-            P(),                 # cd_start scalar
-            P(),                 # cd_end scalar
-            P("chan"),           # tables [C, 256, 3]
-        ),
+        in_specs=_STEP_IN_SPECS,
         out_specs=P("data"),
     )
     return jax.jit(sharded)

@@ -65,6 +65,40 @@ def test_sharded_matches_single_device(n_devices, chan_parallel):
         np.testing.assert_array_equal(out[b], expect)
 
 
+def test_sharded_jpeg_step_matches_single_device():
+    """The full mesh-sharded serving step emits the same JFIF bytes as the
+    single-device sparse pipeline."""
+    if len(resolve_devices(8)) < 8:
+        pytest.skip("needs virtual device mesh")
+    from omero_ms_image_region_tpu.flagship import batched_args
+    from omero_ms_image_region_tpu.ops.jpegenc import (
+        encode_sparse_buffers, max_sparse_cap, quant_tables,
+        render_to_jpeg_sparse,
+    )
+    from omero_ms_image_region_tpu.parallel.mesh import (
+        render_jpeg_step_sharded,
+    )
+
+    C, B, H, W = 4, 8, 32, 32
+    cap = max_sparse_cap(H, W)
+    rng = np.random.default_rng(11)
+    raw = rng.integers(0, 65535, size=(B, C, H, W)).astype(np.float32)
+    rdef, settings = _settings(C)
+
+    mesh = make_mesh(8, chan_parallel=2)
+    bufs = np.asarray(render_jpeg_step_sharded(mesh, quality=80, cap=cap)(
+        *shard_batch(mesh, raw, settings)))
+    sharded_jpegs = encode_sparse_buffers(bufs, W, H, 80, cap)
+
+    ref_device = mesh.devices.flat[0]
+    qy, qc = (np.asarray(t, np.int32) for t in quant_tables(80))
+    args = batched_args(settings, raw)[1:]
+    single = np.asarray(render_to_jpeg_sparse(
+        jax.device_put(raw, ref_device), *args, qy, qc, cap=cap))
+    single_jpegs = encode_sparse_buffers(single, W, H, 80, cap)
+    assert sharded_jpegs == single_jpegs
+
+
 def test_make_mesh_rejects_indivisible():
     if len(resolve_devices(8)) < 8:
         pytest.skip("needs virtual device mesh")

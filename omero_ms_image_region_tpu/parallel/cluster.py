@@ -1,0 +1,86 @@
+"""Multi-host deployment: the distributed communication backend.
+
+The reference scales out by joining microservice JVMs into a Hazelcast
+cluster over the Vert.x event bus (``-cluster``; SURVEY.md §5 "distributed
+communication backend").  The TPU-native equivalent is JAX's distributed
+runtime: each host process joins a coordinator (DCN), after which
+``jax.devices()`` spans every chip in the slice and a single
+``jax.sharding.Mesh`` over the global device list makes the sharded
+serving steps (``parallel.mesh``) span hosts — collectives ride ICI
+within a slice, DCN across slices, with no application-level cluster
+protocol at all.  Cross-instance *state* (tile cache, canRead memo) rides
+Redis (``services.cache``), mirroring the reference's split between
+cluster transport and shared maps.
+
+Typical multi-host launch (one process per host, same command)::
+
+    from omero_ms_image_region_tpu.parallel import cluster
+    cluster.initialize()                 # env-driven (TPU pods: automatic)
+    mesh = cluster.global_mesh(chan_parallel=2)
+    step = render_jpeg_step_sharded(mesh)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .mesh import Mesh, make_mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the JAX distributed runtime (idempotent).
+
+    On Cloud TPU pods every argument is discovered from the environment;
+    elsewhere pass the coordinator explicitly.  Safe to call in
+    single-process deployments: with no coordinator configured anywhere it
+    leaves the process standalone.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        if coordinator_address is not None:
+            raise  # explicit cluster config that failed must be loud
+        # No cluster environment: standalone single-process service.
+
+
+def global_mesh(chan_parallel: int = 1) -> Mesh:
+    """A ``(data, chan)`` mesh over every device in the (multi-host) slice.
+
+    With ``jax.distributed`` initialized this spans all hosts; the sharded
+    steps built on it (``render_step_sharded`` /
+    ``render_jpeg_step_sharded``) then execute one program over the whole
+    slice, each host feeding its addressable shard of the batch.
+    """
+    devices = np.asarray(jax.devices())
+    return make_mesh(len(devices), chan_parallel=chan_parallel,
+                     devices=devices)
+
+
+def local_batch_slice(mesh: Mesh, global_batch: int) -> slice:
+    """This process's rows of the global batch (data-axis locality).
+
+    Hosts feed only their addressable shard; the slice maps a global
+    [B, ...] workload to the rows this process should stage.
+    """
+    data_size = mesh.shape["data"]
+    if global_batch % data_size:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by data axis "
+            f"{data_size}")
+    per_shard = global_batch // data_size
+    rows = [i for i, d in enumerate(mesh.devices[:, 0])
+            if d.process_index == jax.process_index()]
+    if not rows:
+        return slice(0, 0)
+    return slice(rows[0] * per_shard, (rows[-1] + 1) * per_shard)

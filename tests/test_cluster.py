@@ -1,0 +1,28 @@
+"""Multi-host bootstrap helpers (single-process semantics)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from omero_ms_image_region_tpu.parallel import cluster
+
+
+def test_initialize_standalone_is_noop():
+    cluster.initialize()  # no cluster env: must not raise
+    assert jax.process_count() >= 1
+
+
+def test_global_mesh_spans_devices():
+    mesh = cluster.global_mesh(chan_parallel=1)
+    assert mesh.size == len(jax.devices())
+    assert set(mesh.axis_names) == {"data", "chan"}
+
+
+def test_local_batch_slice_single_process_covers_all():
+    mesh = cluster.global_mesh(chan_parallel=1)
+    data = mesh.shape["data"]
+    sl = cluster.local_batch_slice(mesh, data * 3)
+    assert sl == slice(0, data * 3)
+    with pytest.raises(ValueError):
+        cluster.local_batch_slice(mesh, data * 3 + 1)

@@ -151,8 +151,10 @@ def bench_flagship(rng):
         return statistics.median(batch_ms)
 
     run_once()  # warm-up/compile
+    # The tunnel's throughput swings with relay congestion; best-of-5
+    # approximates the hardware's steady state rather than the noise.
     times, p50s = [], []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         p50s.append(run_once())
         times.append(time.perf_counter() - t0)

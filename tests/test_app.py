@@ -142,33 +142,56 @@ class TestStatusMapping:
         assert status == 400
 
 
+def _gather_requests(data_dir, paths):
+    """Boot the batched app, issue ``paths`` concurrently, return
+    (bodies, content_types, renderer)."""
+    config = AppConfig(data_dir=data_dir,
+                       batcher=BatcherConfig(enabled=True, linger_ms=5.0))
+
+    async def main():
+        app = create_app(config)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resps = await asyncio.gather(*(client.get(p) for p in paths))
+            bodies = [await r.read() for r in resps]
+            assert all(r.status == 200 for r in resps)
+            types = [r.headers["Content-Type"] for r in resps]
+            from omero_ms_image_region_tpu.server.app import SERVICES_KEY
+            return bodies, types, app[SERVICES_KEY].renderer
+        finally:
+            await client.close()
+
+    return asyncio.run(main())
+
+
 class TestBatchedApp:
     def test_batching_renderer_serves_requests(self, data_dir):
-        config = AppConfig(data_dir=data_dir,
-                           batcher=BatcherConfig(enabled=True,
-                                                 linger_ms=5.0))
-
-        async def main():
-            app = create_app(config)
-            client = TestClient(TestServer(app))
-            await client.start_server()
-            try:
-                resps = await asyncio.gather(*(
-                    client.get(
-                        f"/webgateway/render_image_region/{IMG}/0/0"
-                        f"?tile=0,0,0,16,16&format=png&m=c&"
-                        f"c=1|0:{(i + 1) * 10000}$FF0000")
-                    for i in range(6)))
-                bodies = [await r.read() for r in resps]
-                assert all(r.status == 200 for r in resps)
-                from omero_ms_image_region_tpu.server.app import SERVICES_KEY
-                return bodies, app[SERVICES_KEY].renderer
-            finally:
-                await client.close()
-
-        bodies, renderer = asyncio.run(main())
+        bodies, _, renderer = _gather_requests(data_dir, [
+            f"/webgateway/render_image_region/{IMG}/0/0"
+            f"?tile=0,0,0,16,16&format=png&m=c&"
+            f"c=1|0:{(i + 1) * 10000}$FF0000"
+            for i in range(6)
+        ])
         # different windows -> different images, all decoded fine
         shapes = {codecs.decode_to_rgba(b).shape for b in bodies}
         assert shapes == {(16, 16, 4)}
         assert renderer.tiles_rendered == 6
         assert renderer.batches_dispatched <= 6
+
+    def test_concurrent_jpeg_requests_through_batcher(self, data_dir):
+        """Concurrent mixed-size JPEG requests coalesce through the device
+        JPEG groups (all bucket to one MCU grid) and every response
+        decodes at its own size."""
+        sizes = [(16, 16), (20, 12), (32, 32), (8, 24)]
+        bodies, types, renderer = _gather_requests(data_dir, [
+            f"/webgateway/render_image_region/{IMG}/0/0"
+            f"?tile=0,0,0,{w},{h}&format=jpeg&m=c&"
+            f"c=1|0:60000$FF0000,2|0:60000$00FF00"
+            for w, h in sizes
+        ])
+        assert all(t == "image/jpeg" for t in types)
+        for (w, h), body in zip(sizes, bodies):
+            assert codecs.decode_to_rgba(body).shape == (h, w, 4)
+        # Same spatial bucket -> the device JPEG groups actually coalesce.
+        assert renderer.batches_dispatched < len(sizes)

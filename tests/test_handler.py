@@ -116,6 +116,20 @@ class TestImageRegionHandler:
                      - plain[..., :3].astype(float))
         assert err.mean() < 6.0  # JPEG noise only; geometry must mirror
 
+    def test_jpeg_with_lut_channel_uses_gather_tables(self, services):
+        """A channel bound to a LUT forces the [C,256,3] gather-table path
+        through the device JPEG pipeline."""
+        table = np.zeros((256, 3), np.uint8)
+        table[:, 1] = np.arange(256)          # green ramp LUT
+        services.lut_provider.add("green.lut", table)
+        handler = ImageRegionHandler(services)
+        jpg = codecs.decode_to_rgba(run(handler.render_image_region(_ctx(
+            c="1|0:60000$green.lut,-2", m="c", format="jpeg"))))
+        assert jpg.shape == (H, W, 4)
+        # Green must dominate: red/blue only via JPEG chroma noise.
+        assert jpg[..., 1].astype(int).sum() > 5 * jpg[..., 0].astype(
+            int).sum()
+
     def test_second_request_hits_cache(self, services):
         handler = ImageRegionHandler(services)
         ctx = _ctx(format="png", tile="0,0,0,16,16")

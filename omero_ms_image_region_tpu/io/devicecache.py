@@ -1,0 +1,76 @@
+"""Device-resident raw tile cache: HBM as the hot tier of the tile store.
+
+SURVEY.md §2b maps the reference's ``PixelBuffer`` to "a tile reader
+service with host-pinned staging -> HBM".  This is the HBM half: raw
+channel planes are settings-independent, and the interactive OMERO.web
+pattern is re-requesting the same tiles with different windows/colors/
+LUTs — so after the first read, a settings change costs zero host->device
+bytes (the dominant cost on link-constrained deployments; the encoded
+region cache above this one only covers byte-identical requests).
+
+Keyed by (image, z, t, level, region, channels); bounded by device bytes
+with LRU eviction (dropping the reference frees the HBM buffer).  Raw
+planes stay in their storage dtype (uint16 halves HBM vs float32); the
+render kernels cast on device.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Hashable, Tuple
+
+
+class DeviceRawCache:
+    """LRU of device-resident raw tile arrays.
+
+    ``get_or_load(key, loader)`` returns a ``jax.Array``; ``loader()``
+    supplies the host ndarray on miss.  Thread-safe (the render path runs
+    in worker threads); the device transfer happens outside the lock, and
+    concurrent misses on one key may both load — last write wins, which
+    is correct for immutable pixel data.
+    """
+
+    def __init__(self, max_bytes: int = 2 * 1024 * 1024 * 1024):
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Hashable, object]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_load(self, key: Hashable, loader: Callable):
+        with self._lock:
+            arr = self._entries.get(key)
+            if arr is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return arr
+            self.misses += 1
+        import jax
+        arr = jax.device_put(loader())
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = arr
+            self._bytes += arr.nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.nbytes
+        return arr
+
+    @property
+    def size_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+def region_key(image_id: int, z: int, t: int, level: int,
+               region: Tuple[int, int, int, int],
+               channels: Tuple[int, ...]) -> tuple:
+    """The raw-read identity: everything the pixel data depends on and
+    nothing the rendering settings touch."""
+    return (image_id, z, t, level, region, channels)

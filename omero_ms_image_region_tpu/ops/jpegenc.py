@@ -649,14 +649,15 @@ def pad_to_mcu(rgba: np.ndarray) -> np.ndarray:
     return np.pad(rgba, pad, mode="edge")
 
 
-def pad_planes_to_mcu(raw: np.ndarray, target_h: int | None = None,
-                      target_w: int | None = None) -> np.ndarray:
+def pad_planes_to_mcu(raw, target_h: int | None = None,
+                      target_w: int | None = None):
     """Edge-replicate [C, h, w] planes to a 16-aligned grid.
 
     Render is pointwise, so padding raw and rendering equals rendering and
     edge-replicating the image; replication (not zeros) keeps the padding
     out of the edge blocks' DCT energy.  ``target_h``/``target_w`` pad to
     a larger (bucketed) grid; default is the tile's own ceil-16 grid.
+    Device-resident input (the HBM raw-tile cache) pads on device.
     """
     h, w = raw.shape[-2:]
     th = target_h if target_h is not None else h + (-h) % 16
@@ -665,7 +666,8 @@ def pad_planes_to_mcu(raw: np.ndarray, target_h: int | None = None,
         raise ValueError(f"bad MCU pad target ({th}, {tw}) for ({h}, {w})")
     if (th, tw) == (h, w):
         return raw
-    return np.pad(raw, ((0, 0), (0, th - h), (0, tw - w)), mode="edge")
+    xp = np if isinstance(raw, np.ndarray) else jnp
+    return xp.pad(raw, ((0, 0), (0, th - h), (0, tw - w)), mode="edge")
 
 
 def slice_block_subgrid(y, cb, cr, grid_h: int, grid_w: int,

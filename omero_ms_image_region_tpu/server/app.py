@@ -25,6 +25,7 @@ from typing import Optional
 from aiohttp import web
 
 from .. import __version__, codecs
+from ..io.devicecache import DeviceRawCache
 from ..io.service import PixelsService
 from ..ops.lut import LutProvider
 from ..services.cache import Caches
@@ -101,6 +102,10 @@ def create_app(config: Optional[AppConfig] = None,
             renderer=renderer,
             lut_provider=LutProvider(config.lut_root),
             max_tile_length=config.max_tile_length,
+            # HBM-resident raw tile tier: settings changes re-render hot
+            # tiles without re-crossing the host link.
+            raw_cache=(DeviceRawCache(config.raw_cache.max_bytes)
+                       if config.raw_cache.enabled else None),
         )
 
     image_handler = ImageRegionHandler(services)
@@ -157,6 +162,60 @@ def create_app(config: Optional[AppConfig] = None,
             return _status_of(e)
         return web.Response(body=body, headers={"Content-Type": "image/png"})
 
+    async def metrics(request: web.Request) -> web.Response:
+        """Prometheus text exposition (≙ the reference's optional metrics
+        beans, ``beanRefContext.xml:36-46`` — Graphite there, a scrape
+        endpoint here).  Spans keep the perf4j names from the Java logs."""
+        from ..utils.stopwatch import REGISTRY
+
+        lines = [
+            "# TYPE imageregion_span_count counter",
+            "# TYPE imageregion_span_mean_ms gauge",
+            "# TYPE imageregion_span_p50_ms gauge",
+            "# TYPE imageregion_cache_hits counter",
+            "# TYPE imageregion_cache_misses counter",
+            "# TYPE imageregion_rawcache_hits counter",
+            "# TYPE imageregion_rawcache_misses counter",
+            "# TYPE imageregion_rawcache_bytes gauge",
+            "# TYPE imageregion_batches_dispatched counter",
+            "# TYPE imageregion_tiles_rendered counter",
+        ]
+        for name, s in sorted(REGISTRY.snapshot().items()):
+            label = f'{{span="{name}"}}'
+            lines += [
+                f"imageregion_span_count{label} {s['count']}",
+                f"imageregion_span_mean_ms{label} {s['mean_ms']}",
+                f"imageregion_span_p50_ms{label} {s['p50_ms']}",
+            ]
+        for cache_name in ("image_region", "pixels_metadata", "shape_mask"):
+            stack = getattr(services.caches, cache_name, None)
+            for i, tier in enumerate(getattr(stack, "tiers", ())):
+                hits, misses = (getattr(tier, "hits", None),
+                                getattr(tier, "misses", None))
+                if hits is None:
+                    continue
+                label = f'{{cache="{cache_name}",tier="{i}"}}'
+                lines += [
+                    f"imageregion_cache_hits{label} {hits}",
+                    f"imageregion_cache_misses{label} {misses}",
+                ]
+        raw_cache = services.raw_cache
+        if raw_cache is not None:
+            lines += [
+                f"imageregion_rawcache_hits {raw_cache.hits}",
+                f"imageregion_rawcache_misses {raw_cache.misses}",
+                f"imageregion_rawcache_bytes {raw_cache.size_bytes}",
+            ]
+        renderer = services.renderer
+        if hasattr(renderer, "batches_dispatched"):
+            lines += [
+                "imageregion_batches_dispatched "
+                f"{renderer.batches_dispatched}",
+                f"imageregion_tiles_rendered {renderer.tiles_rendered}",
+            ]
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
     async def details(request: web.Request) -> web.Response:
         doc = {
             "provider": PROVIDER,
@@ -176,6 +235,7 @@ def create_app(config: Optional[AppConfig] = None,
                 render_image_region)
     app.router.add_get("/webgateway/render_shape_mask/{shapeId}",
                        render_shape_mask)
+    app.router.add_get("/metrics", metrics)
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):

@@ -91,13 +91,20 @@ class BatchingRenderer:
         C, h, w = raw.shape
         bh, bw = pick_bucket(h, w, self.buckets)
         if (h, w) != (bh, bw):
-            padded = np.zeros((C, bh, bw), np.float32)
-            padded[:, :h, :w] = raw
-            raw = padded
+            if isinstance(raw, np.ndarray):
+                padded = np.zeros((C, bh, bw), raw.dtype)
+                padded[:, :h, :w] = raw
+                raw = padded
+            else:
+                # Device-resident raw (HBM tile cache): pad on device.
+                import jax.numpy as jnp
+                raw = jnp.pad(raw, ((0, 0), (0, bh - h), (0, bw - w)))
         # tables is either [C, 3] ramp weights or [C, 256, 3] LUT tables
-        # (ops.render.pack_settings); the two shapes cannot co-batch.
+        # (ops.render.pack_settings); the two shapes cannot co-batch, nor
+        # can raw dtypes (uint16 storage vs float32) mix in one stack.
         key = (C, bh, bw, int(settings["cd_start"]),
-               int(settings["cd_end"]), settings["tables"].ndim)
+               int(settings["cd_end"]), settings["tables"].ndim,
+               str(raw.dtype))
 
         pending = _Pending(raw=raw, settings=settings, h=h, w=w,
                            future=asyncio.get_running_loop().create_future())
@@ -122,7 +129,8 @@ class BatchingRenderer:
         bh, bw = pick_bucket(gh, gw, self.buckets)
         raw = pad_planes_to_mcu(raw, bh, bw)
         key = ("jpeg", C, bh, bw, int(settings["cd_start"]),
-               int(settings["cd_end"]), settings["tables"].ndim, quality)
+               int(settings["cd_end"]), settings["tables"].ndim, quality,
+               str(raw.dtype))
         pending = _Pending(raw=raw, settings=settings, h=height, w=width,
                            quality=quality,
                            future=asyncio.get_running_loop().create_future())
@@ -196,13 +204,22 @@ class BatchingRenderer:
                 if not p.future.done():
                     p.future.set_result(out)
 
+    @staticmethod
+    def _stack_raw(padded: List[_Pending]):
+        """Stack the group's tiles, staying on device when any member is
+        already resident there (the HBM raw tile cache)."""
+        if all(isinstance(p.raw, np.ndarray) for p in padded):
+            return np.stack([p.raw for p in padded])
+        import jax.numpy as jnp
+        return jnp.stack([p.raw for p in padded])
+
     def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:
         n = len(group)
         B = _pad_batch_size(n, self.max_batch)
         # Pad the batch by repeating the last tile; extras are discarded.
         padded = group + [group[-1]] * (B - n)
 
-        raw = np.stack([p.raw for p in padded])
+        raw = self._stack_raw(padded)
 
         def stack(name):
             return np.stack([p.settings[name] for p in padded])
@@ -225,7 +242,7 @@ class BatchingRenderer:
         n = len(group)
         B = _pad_batch_size(n, self.max_batch)
         padded = group + [group[-1]] * (B - n)
-        raw = np.stack([p.raw for p in padded])
+        raw = self._stack_raw(padded)
 
         def stack(name):
             return np.stack([p.settings[name] for p in padded])

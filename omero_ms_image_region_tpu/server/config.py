@@ -27,6 +27,14 @@ class BatcherConfig:
 
 
 @dataclass
+class RawCacheConfig:
+    """HBM-resident raw tile tier (io.devicecache.DeviceRawCache)."""
+
+    enabled: bool = True
+    max_bytes: int = 2 * 1024 * 1024 * 1024
+
+
+@dataclass
 class AppConfig:
     port: int = 8080
     data_dir: str = "./data"
@@ -38,6 +46,7 @@ class AppConfig:
     lut_root: Optional[str] = None         # omero.script_repo_root analogue
     caches: CacheConfig = field(default_factory=CacheConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
+    raw_cache: RawCacheConfig = field(default_factory=RawCacheConfig)
 
     @classmethod
     def from_yaml(cls, path: str) -> "AppConfig":
@@ -80,5 +89,11 @@ class AppConfig:
             enabled=bool(batcher.get("enabled", defaults.enabled)),
             max_batch=int(batcher.get("max-batch", defaults.max_batch)),
             linger_ms=float(batcher.get("linger-ms", defaults.linger_ms)),
+        )
+        rc = raw.get("raw-cache", {}) or {}
+        rc_defaults = RawCacheConfig()
+        cfg.raw_cache = RawCacheConfig(
+            enabled=bool(rc.get("enabled", rc_defaults.enabled)),
+            max_bytes=int(rc.get("max-bytes", rc_defaults.max_bytes)),
         )
         return cfg

@@ -82,7 +82,9 @@ class Renderer:
         from ..flagship import batched_args
         from ..ops.jpegenc import pad_planes_to_mcu, render_batch_to_jpeg
 
-        padded = pad_planes_to_mcu(np.ascontiguousarray(raw))[None]
+        if isinstance(raw, np.ndarray):
+            raw = np.ascontiguousarray(raw)
+        padded = pad_planes_to_mcu(raw)[None]
         args = batched_args(settings, padded)
         return render_batch_to_jpeg(
             *args, quality=quality, dims=[(width, height)])[0]
@@ -100,6 +102,7 @@ class ImageRegionServices:
     renderer: Renderer
     lut_provider: object = None       # ops.lut.LutProvider
     max_tile_length: int = DEFAULT_MAX_TILE_LENGTH
+    raw_cache: object = None          # io.devicecache.DeviceRawCache
 
 
 def _restrict_to_active(rdef: RenderingDef) -> Tuple[RenderingDef, List[int]]:
@@ -267,13 +270,29 @@ class ImageRegionHandler:
             raise NotFoundError(str(e))
 
     def _read_region(self, src, ctx: ImageRegionCtx, region: RegionDef,
-                     level: int, active: List[int]) -> np.ndarray:
-        """Raw f32[C_active, h, w] for the resolved region."""
-        planes = [
-            src.get_region(ctx.z, c, ctx.t, region, level)
-            for c in active
-        ]
-        return np.stack(planes).astype(np.float32)
+                     level: int, active: List[int]):
+        """Raw f32[C_active, h, w] for the resolved region.
+
+        With a device raw cache configured the result is an HBM-resident
+        ``jax.Array``: raw planes are settings-independent, so the
+        interactive re-window/re-color pattern re-renders without moving
+        a byte over the host link.
+        """
+        def load() -> np.ndarray:
+            planes = [
+                src.get_region(ctx.z, c, ctx.t, region, level)
+                for c in active
+            ]
+            # Storage dtype, not float32: the kernels cast on device, and
+            # uint16 sources take half the HBM/link bytes.
+            return np.stack(planes)
+
+        if self.s.raw_cache is None:
+            return load().astype(np.float32)
+        from ..io.devicecache import region_key
+        key = region_key(ctx.image_id, ctx.z, ctx.t, level,
+                         region.as_tuple(), tuple(active))
+        return self.s.raw_cache.get_or_load(key, load)
 
     async def _project(self, ctx: ImageRegionCtx, pixels: Pixels, src,
                        active: List[int]

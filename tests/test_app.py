@@ -103,6 +103,21 @@ class TestRoutes:
         assert doc["options"]["cacheControl"] == "private, max-age=3600"
 
 
+class TestMetrics:
+    def test_metrics_endpoint_exposes_spans_and_caches(self, data_dir):
+        [(s1, _, _), (status, _, body)] = client_fetch(
+            data_dir,
+            ("GET", f"/webgateway/render_image_region/{IMG}/0/0"
+                    "?format=png&m=c"),
+            ("GET", "/metrics"),
+        )
+        assert s1 == 200 and status == 200
+        text = body.decode()
+        assert 'imageregion_span_count{span="Renderer.renderAsPackedInt"}' \
+            in text
+        assert "imageregion_cache_hits" in text
+
+
 class TestStatusMapping:
     def test_bad_param_400_with_message(self, data_dir):
         [(status, _, body)] = client_fetch(

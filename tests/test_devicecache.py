@@ -1,0 +1,92 @@
+"""HBM-resident raw tile cache: identity, eviction, handler integration."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.io.devicecache import (
+    DeviceRawCache, region_key,
+)
+
+
+def test_same_key_loads_once_and_counts():
+    cache = DeviceRawCache(max_bytes=1 << 30)
+    calls = []
+
+    def loader():
+        calls.append(1)
+        return np.ones((2, 8, 8), np.float32)
+
+    key = region_key(1, 0, 0, 0, (0, 0, 8, 8), (0, 1))
+    a = cache.get_or_load(key, loader)
+    b = cache.get_or_load(key, loader)
+    assert len(calls) == 1
+    assert a is b
+    assert cache.hits == 1 and cache.misses == 1
+    np.testing.assert_array_equal(np.asarray(a), 1.0)
+
+
+def test_eviction_respects_byte_budget():
+    tile_bytes = 2 * 8 * 8 * 4
+    cache = DeviceRawCache(max_bytes=tile_bytes * 2)
+    for i in range(4):
+        cache.get_or_load(("k", i),
+                          lambda: np.zeros((2, 8, 8), np.float32))
+    assert len(cache) == 2                       # oldest two evicted
+    assert cache.size_bytes == tile_bytes * 2
+    # Oldest keys are gone: reloading key 0 is a miss.
+    misses = cache.misses
+    cache.get_or_load(("k", 0), lambda: np.zeros((2, 8, 8), np.float32))
+    assert cache.misses == misses + 1
+
+
+def test_settings_change_rerenders_from_device(tmp_path):
+    """Two requests for one tile with different windows: the raw read and
+    the host->device transfer happen once."""
+    from omero_ms_image_region_tpu.io.service import PixelsService
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.ops.lut import LutProvider
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.handler import (
+        ImageRegionHandler, ImageRegionServices, Renderer,
+    )
+    from omero_ms_image_region_tpu.services.cache import (
+        CacheConfig, Caches,
+    )
+    from omero_ms_image_region_tpu.services.metadata import (
+        CanReadMemo, LocalMetadataService,
+    )
+
+    rng = np.random.default_rng(0)
+    planes = rng.integers(0, 60000, size=(2, 1, 32, 32)).astype(np.uint16)
+    build_pyramid(planes, str(tmp_path / "3"), chunk=(16, 16), n_levels=1)
+    cache = DeviceRawCache()
+    services = ImageRegionServices(
+        pixels_service=PixelsService(str(tmp_path)),
+        metadata=LocalMetadataService(str(tmp_path)),
+        caches=Caches.from_config(CacheConfig.enabled_all()),
+        can_read_memo=CanReadMemo(),
+        renderer=Renderer(),
+        lut_provider=LutProvider(),
+        raw_cache=cache,
+    )
+    handler = ImageRegionHandler(services)
+
+    def ctx(window):
+        return ImageRegionCtx.from_params({
+            "imageId": "3", "theZ": "0", "theT": "0", "m": "c",
+            "c": f"1|0:{window}$FF0000", "format": "jpeg",
+        })
+
+    loop = asyncio.new_event_loop()
+    try:
+        first = loop.run_until_complete(
+            handler.render_image_region(ctx(60000)))
+        second = loop.run_until_complete(
+            handler.render_image_region(ctx(30000)))
+    finally:
+        loop.close()
+    assert first[:2] == second[:2] == b"\xff\xd8"
+    assert first != second                 # different windows, new render
+    assert cache.misses == 1 and cache.hits == 1

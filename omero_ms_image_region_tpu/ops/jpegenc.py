@@ -600,9 +600,12 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
     if cap is None:
         cap = default_sparse_cap(H, W)
     qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
-    bufs = np.asarray(render_to_jpeg_sparse(
+    bufs = render_to_jpeg_sparse(
         raw, window_start, window_end, family, coefficient, reverse,
-        cd_start, cd_end, tables, qy, qc, cap=cap))
+        cd_start, cd_end, tables, qy, qc, cap=cap)
+    if hasattr(bufs, "copy_to_host_async"):
+        bufs.copy_to_host_async()   # overlap the wire with dispatch
+    bufs = np.asarray(bufs)
     _encode = sparse_encoder()
 
     from ..native import jpeg_native_available

@@ -204,26 +204,27 @@ class BatchingRenderer:
                 if not p.future.done():
                     p.future.set_result(out)
 
-    @staticmethod
-    def _stack_raw(padded: List[_Pending]):
-        """Stack the group's tiles, staying on device when any member is
-        already resident there (the HBM raw tile cache)."""
+    def _group_arrays(self, group: List[_Pending]):
+        """Pad the batch to a power of two (repeating the last tile;
+        extras are discarded) and build the stacked kernel inputs.  Raw
+        stacking stays on device when any member is already resident
+        there (the HBM raw tile cache)."""
+        B = _pad_batch_size(len(group), self.max_batch)
+        padded = group + [group[-1]] * (B - len(group))
         if all(isinstance(p.raw, np.ndarray) for p in padded):
-            return np.stack([p.raw for p in padded])
-        import jax.numpy as jnp
-        return jnp.stack([p.raw for p in padded])
-
-    def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:
-        n = len(group)
-        B = _pad_batch_size(n, self.max_batch)
-        # Pad the batch by repeating the last tile; extras are discarded.
-        padded = group + [group[-1]] * (B - n)
-
-        raw = self._stack_raw(padded)
+            raw = np.stack([p.raw for p in padded])
+        else:
+            import jax.numpy as jnp
+            raw = jnp.stack([p.raw for p in padded])
 
         def stack(name):
             return np.stack([p.settings[name] for p in padded])
 
+        return raw, stack
+
+    def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:
+        n = len(group)
+        raw, stack = self._group_arrays(group)
         s0 = group[0].settings
         with stopwatch("Renderer.renderAsPackedInt.batch"):
             out = render_tile_batch_packed(
@@ -240,13 +241,7 @@ class BatchingRenderer:
         from ..ops.jpegenc import render_batch_to_jpeg
 
         n = len(group)
-        B = _pad_batch_size(n, self.max_batch)
-        padded = group + [group[-1]] * (B - n)
-        raw = self._stack_raw(padded)
-
-        def stack(name):
-            return np.stack([p.settings[name] for p in padded])
-
+        raw, stack = self._group_arrays(group)
         s0 = group[0].settings
         with stopwatch("Renderer.renderAsPackedInt.batch"):
             jpegs = render_batch_to_jpeg(

@@ -122,6 +122,23 @@ def _restrict_to_active(rdef: RenderingDef) -> Tuple[RenderingDef, List[int]]:
     return out, active
 
 
+async def check_can_read(services: ImageRegionServices, object_type: str,
+                         object_id: int,
+                         session_key: Optional[str]) -> bool:
+    """Memoized ACL check (memo -> metadata service -> memo write-back),
+    shared by the image and mask pipelines."""
+    memo = await services.can_read_memo.get_async(
+        session_key, object_type, object_id)
+    if memo is not None:
+        return memo
+    with stopwatch("canRead"):
+        ok = await services.metadata.can_read(object_type, object_id,
+                                              session_key)
+    await services.can_read_memo.put_async(
+        session_key, object_type, object_id, ok)
+    return ok
+
+
 class ImageRegionHandler:
     """One instance per service; per-request state stays on the stack
     (the reference builds a handler per request, this one is stateless)."""
@@ -133,16 +150,8 @@ class ImageRegionHandler:
 
     async def _can_read(self, object_type: str, object_id: int,
                         session_key: Optional[str]) -> bool:
-        memo = await self.s.can_read_memo.get_async(
-            session_key, object_type, object_id)
-        if memo is not None:
-            return memo
-        with stopwatch("canRead"):
-            ok = await self.s.metadata.can_read(object_type, object_id,
-                                                session_key)
-        await self.s.can_read_memo.put_async(
-            session_key, object_type, object_id, ok)
-        return ok
+        return await check_can_read(self.s, object_type, object_id,
+                                    session_key)
 
     # ------------------------------------------------------- metadata
 
@@ -363,16 +372,8 @@ class ShapeMaskHandler:
         return png
 
     async def _can_read(self, ctx: ShapeMaskCtx) -> bool:
-        memo = await self.s.can_read_memo.get_async(
-            ctx.omero_session_key, "Mask", ctx.shape_id)
-        if memo is not None:
-            return memo
-        with stopwatch("canRead"):
-            ok = await self.s.metadata.can_read("Mask", ctx.shape_id,
-                                                ctx.omero_session_key)
-        await self.s.can_read_memo.put_async(
-            ctx.omero_session_key, "Mask", ctx.shape_id, ok)
-        return ok
+        return await check_can_read(self.s, "Mask", ctx.shape_id,
+                                    ctx.omero_session_key)
 
     def _render(self, mask, color, ctx: ShapeMaskCtx) -> bytes:
         from ..ops.maskops import rasterize_mask

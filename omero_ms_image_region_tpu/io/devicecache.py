@@ -60,6 +60,11 @@ class DeviceRawCache:
                 self._bytes -= evicted.nbytes
         return arr
 
+    def __contains__(self, key: Hashable) -> bool:
+        """Residency probe without an LRU bump (prefetch skip check)."""
+        with self._lock:
+            return key in self._entries
+
     @property
     def size_bytes(self) -> int:
         return self._bytes

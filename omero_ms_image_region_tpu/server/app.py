@@ -107,6 +107,9 @@ def create_app(config: Optional[AppConfig] = None,
             raw_cache=(DeviceRawCache(config.raw_cache.max_bytes)
                        if config.raw_cache.enabled else None),
         )
+        if services.raw_cache is not None and config.raw_cache.prefetch:
+            from ..services.prefetch import TilePrefetcher
+            services.prefetcher = TilePrefetcher(services.raw_cache)
 
     image_handler = ImageRegionHandler(services)
     mask_handler = ShapeMaskHandler(services)
@@ -241,6 +244,10 @@ def create_app(config: Optional[AppConfig] = None,
     async def on_cleanup(app):
         if isinstance(services.renderer, BatchingRenderer):
             await services.renderer.close()
+        # Drain prefetch workers before the pixel stores close under them.
+        if services.prefetcher is not None:
+            services.prefetcher.flush(timeout=2.0)
+            services.prefetcher.close()
         services.pixels_service.close()
         close_caches = getattr(services.caches, "close", None)
         if close_caches is not None:

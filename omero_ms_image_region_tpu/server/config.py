@@ -32,6 +32,7 @@ class RawCacheConfig:
 
     enabled: bool = True
     max_bytes: int = 2 * 1024 * 1024 * 1024
+    prefetch: bool = True              # pan-ahead neighbor staging
 
 
 @dataclass
@@ -95,5 +96,6 @@ class AppConfig:
         cfg.raw_cache = RawCacheConfig(
             enabled=bool(rc.get("enabled", rc_defaults.enabled)),
             max_bytes=int(rc.get("max-bytes", rc_defaults.max_bytes)),
+            prefetch=bool(rc.get("prefetch", rc_defaults.prefetch)),
         )
         return cfg

@@ -103,6 +103,7 @@ class ImageRegionServices:
     lut_provider: object = None       # ops.lut.LutProvider
     max_tile_length: int = DEFAULT_MAX_TILE_LENGTH
     raw_cache: object = None          # io.devicecache.DeviceRawCache
+    prefetcher: object = None         # services.prefetch.TilePrefetcher
 
 
 def _restrict_to_active(rdef: RenderingDef) -> Tuple[RenderingDef, List[int]]:
@@ -245,6 +246,12 @@ class ImageRegionHandler:
         else:
             raw = await asyncio.to_thread(
                 self._read_region, src, ctx, region, level or 0, active)
+            if self.s.prefetcher is not None and ctx.tile is not None:
+                self.s.prefetcher.tile_served(
+                    src, ctx.image_id, ctx.z, ctx.t, ctx.resolution,
+                    levels, ctx.tile, src.tile_size(),
+                    self.s.max_tile_length, active,
+                    ctx.flip_horizontal, ctx.flip_vertical)
 
         settings = pack_settings(active_rdef, self.s.lut_provider)
 

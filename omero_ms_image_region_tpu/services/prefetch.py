@@ -1,0 +1,116 @@
+"""Pan-ahead tile prefetch into the HBM raw cache.
+
+SURVEY.md §2b maps the reference's ``PixelBuffer`` surface to "a tile
+reader service with host-pinned staging -> HBM, async prefetch"; this is
+the prefetch half.  Deep-zoom clients pan in steps of one tile, so after
+serving a tile the four lattice neighbors (same z/t/level/channels) are
+read and staged to device in background threads — the next pan step finds
+its raw planes already resident and pays only render + encode.
+
+Prefetch is strictly best-effort: failures are swallowed (the foreground
+path re-reads on demand), and nothing is scheduled when the region is not
+tile-shaped (full-plane and arbitrary-region requests don't pan).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..io.devicecache import DeviceRawCache, region_key
+
+logger = logging.getLogger(__name__)
+
+
+class TilePrefetcher:
+    """Stages neighbor tiles of each served tile into the device cache."""
+
+    def __init__(self, raw_cache: DeviceRawCache, max_workers: int = 2,
+                 max_pending: int = 16):
+        self.raw_cache = raw_cache
+        self.max_pending = max_pending
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="tile-prefetch")
+        self._lock = threading.Lock()
+        self._pending: set = set()
+        self._futures: set = set()
+        self.scheduled = 0
+
+    def tile_served(self, src, image_id: int, z: int, t: int,
+                    resolution, levels, tile, tile_size,
+                    max_tile_length: int, active: Sequence[int],
+                    flip_horizontal: bool = False,
+                    flip_vertical: bool = False) -> None:
+        """Schedule the four lattice neighbors of the served tile.
+
+        Neighbor regions resolve through the same ``get_region_def`` /
+        ``clamp_region_to_plane`` pipeline (flips included) as the
+        foreground read, so the cache keys are guaranteed identical to
+        the ones the next pan request will compute.
+        """
+        from ..server.region import (RegionDef, clamp_region_to_plane,
+                                     get_region_def)
+
+        if tile is None:
+            return
+        level = resolution or 0
+        for dx, dy in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+            ntile = RegionDef(x=tile.x + dx, y=tile.y + dy,
+                              width=tile.width, height=tile.height)
+            if ntile.x < 0 or ntile.y < 0:
+                continue
+            region = get_region_def(levels, resolution, ntile, None,
+                                    tile_size, max_tile_length,
+                                    flip_horizontal, flip_vertical)
+            clamp_region_to_plane(levels, resolution, region)
+            if region.width <= 0 or region.height <= 0:
+                continue
+            key = region_key(image_id, z, t, level, region.as_tuple(),
+                             tuple(active))
+            if key in self.raw_cache:
+                continue   # already resident: no pool churn
+            with self._lock:
+                if key in self._pending or len(
+                        self._pending) >= self.max_pending:
+                    continue
+                self._pending.add(key)
+            try:
+                future = self._pool.submit(self._load, src, key, z, t,
+                                           level, region, active)
+            except RuntimeError:   # pool shut down mid-request
+                with self._lock:
+                    self._pending.discard(key)
+                return
+            self.scheduled += 1
+            with self._lock:
+                self._futures.add(future)
+            future.add_done_callback(
+                lambda f: self._futures.discard(f))
+
+    def _load(self, src, key, z: int, t: int, level: int, region,
+              active: Sequence[int]) -> None:
+        try:
+            def loader() -> np.ndarray:
+                planes = [src.get_region(z, c, t, region, level)
+                          for c in active]
+                return np.stack(planes)
+
+            self.raw_cache.get_or_load(key, loader)
+        except Exception as e:  # best-effort: foreground re-reads on miss
+            logger.debug("prefetch failed for %s: %r", key, e)
+        finally:
+            with self._lock:
+                self._pending.discard(key)
+
+    def flush(self, timeout: float = 10.0) -> None:
+        """Wait for in-flight prefetches (tests/shutdown)."""
+        with self._lock:
+            outstanding = list(self._futures)
+        concurrent.futures.wait(outstanding, timeout=timeout)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -41,6 +41,69 @@ def test_eviction_respects_byte_budget():
     assert cache.misses == misses + 1
 
 
+def test_prefetcher_stages_neighbor_tiles(tmp_path):
+    """Serving one tile schedules its lattice neighbors into the device
+    cache, so the next pan step's raw planes are already resident."""
+    from omero_ms_image_region_tpu.io.service import PixelsService
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.ops.lut import LutProvider
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.handler import (
+        ImageRegionHandler, ImageRegionServices, Renderer,
+    )
+    from omero_ms_image_region_tpu.services.cache import (
+        CacheConfig, Caches,
+    )
+    from omero_ms_image_region_tpu.services.metadata import (
+        CanReadMemo, LocalMetadataService,
+    )
+    from omero_ms_image_region_tpu.services.prefetch import TilePrefetcher
+
+    rng = np.random.default_rng(1)
+    planes = rng.integers(0, 60000, size=(1, 1, 64, 64)).astype(np.uint16)
+    build_pyramid(planes, str(tmp_path / "4"), chunk=(16, 16), n_levels=1)
+    cache = DeviceRawCache()
+    prefetcher = TilePrefetcher(cache)
+    services = ImageRegionServices(
+        pixels_service=PixelsService(str(tmp_path)),
+        metadata=LocalMetadataService(str(tmp_path)),
+        caches=Caches.from_config(CacheConfig.enabled_all()),
+        can_read_memo=CanReadMemo(),
+        renderer=Renderer(),
+        lut_provider=LutProvider(),
+        raw_cache=cache,
+        prefetcher=prefetcher,
+    )
+    handler = ImageRegionHandler(services)
+    ctx = ImageRegionCtx.from_params({
+        "imageId": "4", "theZ": "0", "theT": "0", "m": "c",
+        "tile": "0,1,1,16,16", "c": "1|0:60000$FF0000", "format": "png",
+    })
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(handler.render_image_region(ctx))
+    finally:
+        loop.close()
+    prefetcher.flush()
+    # Interior tile: all four lattice neighbors staged + the tile itself.
+    assert prefetcher.scheduled == 4
+    assert len(cache) == 5
+    # Warm viewport: resident neighbors schedule no new pool work.
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(handler.render_image_region(
+            ImageRegionCtx.from_params({
+                "imageId": "4", "theZ": "0", "theT": "0", "m": "c",
+                "tile": "0,1,1,16,16", "c": "1|0:50000$FF0000",
+                "format": "png",
+            })))
+    finally:
+        loop.close()
+    prefetcher.flush()
+    assert prefetcher.scheduled == 4
+    prefetcher.close()
+
+
 def test_settings_change_rerenders_from_device(tmp_path):
     """Two requests for one tile with different windows: the raw read and
     the host->device transfer happen once."""

@@ -118,6 +118,29 @@ class TestMetrics:
         assert "imageregion_cache_hits" in text
 
 
+class TestConcurrencyTorture:
+    def test_many_mixed_concurrent_requests(self, data_dir):
+        """48 concurrent requests across formats, sizes, windows, flips
+        and masks — every one must complete correctly."""
+        paths = []
+        for i in range(16):
+            w, h = 8 + (i % 2) * 8, 8 + (i % 3) * 4   # stay inside 64x64
+            fmt = ("jpeg", "png")[i % 2]
+            flip = ("", "&flip=h", "&flip=v", "&flip=hv")[i % 4]
+            paths.append(
+                f"/webgateway/render_image_region/{IMG}/0/0"
+                f"?tile=0,{i % 3},{i % 2},{w},{h}&format={fmt}&m=c"
+                f"&c=1|0:{10000 + i * 2500}$FF0000,2|0:60000$00FF00{flip}")
+        paths = paths * 3
+        bodies, types, renderer = _gather_requests(data_dir, paths)
+        assert len(bodies) == 48
+        for p, t, b in zip(paths, types, bodies):
+            fmt = "jpeg" if "format=jpeg" in p else "png"
+            assert t == f"image/{fmt}"
+            assert codecs.decode_to_rgba(b).ndim == 3
+        assert renderer.tiles_rendered >= 16  # caches absorb repeats
+
+
 class TestStatusMapping:
     def test_bad_param_400_with_message(self, data_dir):
         [(status, _, body)] = client_fetch(

@@ -15,7 +15,6 @@ unit-testable host function.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from ..models.rendering import RenderingDef, RenderingModel
 from ..utils.color import split_html_color

@@ -20,7 +20,7 @@ import logging
 import threading
 import time
 from collections import OrderedDict
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Protocol
 
 log = logging.getLogger("omero_ms_image_region_tpu.cache")

@@ -17,7 +17,7 @@ from __future__ import annotations
 import concurrent.futures
 import logging
 import threading
-from typing import List, Sequence, Tuple
+from typing import Sequence
 
 import numpy as np
 

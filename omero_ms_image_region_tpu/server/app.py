@@ -102,6 +102,7 @@ def create_app(config: Optional[AppConfig] = None,
             renderer=renderer,
             lut_provider=LutProvider(config.lut_root),
             max_tile_length=config.max_tile_length,
+            cpu_fallback_max_px=config.renderer.cpu_fallback_max_px,
             # HBM-resident raw tile tier: settings changes re-render hot
             # tiles without re-crossing the host link.
             raw_cache=(DeviceRawCache(config.raw_cache.max_bytes)

@@ -36,6 +36,15 @@ class RawCacheConfig:
 
 
 @dataclass
+class RendererConfig:
+    """Render path selection knobs."""
+
+    # Renders of at most this many pixels take the CPU reference kernel
+    # (refimpl) instead of a device round trip.  0 disables.
+    cpu_fallback_max_px: int = 0
+
+
+@dataclass
 class AppConfig:
     port: int = 8080
     data_dir: str = "./data"
@@ -48,6 +57,7 @@ class AppConfig:
     caches: CacheConfig = field(default_factory=CacheConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
     raw_cache: RawCacheConfig = field(default_factory=RawCacheConfig)
+    renderer: RendererConfig = field(default_factory=RendererConfig)
 
     @classmethod
     def from_yaml(cls, path: str) -> "AppConfig":
@@ -97,5 +107,11 @@ class AppConfig:
             enabled=bool(rc.get("enabled", rc_defaults.enabled)),
             max_bytes=int(rc.get("max-bytes", rc_defaults.max_bytes)),
             prefetch=bool(rc.get("prefetch", rc_defaults.prefetch)),
+        )
+        rd = raw.get("renderer", {}) or {}
+        cfg.renderer = RendererConfig(
+            cpu_fallback_max_px=int(rd.get(
+                "cpu-fallback-max-px",
+                RendererConfig().cpu_fallback_max_px)),
         )
         return cfg

@@ -104,6 +104,11 @@ class ImageRegionServices:
     max_tile_length: int = DEFAULT_MAX_TILE_LENGTH
     raw_cache: object = None          # io.devicecache.DeviceRawCache
     prefetcher: object = None         # services.prefetch.TilePrefetcher
+    # Renders at or below this pixel count take the CPU reference kernel
+    # (refimpl) instead of a device round trip — the SURVEY north star's
+    # fallback path, and a latency win for tiny tiles anywhere the
+    # dispatch+fetch overhead exceeds host compute.  0 disables.
+    cpu_fallback_max_px: int = 0
 
 
 def _restrict_to_active(rdef: RenderingDef) -> Tuple[RenderingDef, List[int]]:
@@ -241,17 +246,28 @@ class ImageRegionHandler:
         if not active:
             raise BadRequestError("No active channels to render")
 
+        tiny = bool(
+            self.s.cpu_fallback_max_px
+            and region.width * region.height <= self.s.cpu_fallback_max_px
+            and ctx.projection is None)
+
         if ctx.projection is not None:
             raw, region = await self._project(ctx, pixels, src, active)
         else:
             raw = await asyncio.to_thread(
-                self._read_region, src, ctx, region, level or 0, active)
-            if self.s.prefetcher is not None and ctx.tile is not None:
+                self._read_region, src, ctx, region, level or 0, active,
+                not tiny)   # tiny renders stay host-side end to end
+            if (self.s.prefetcher is not None and ctx.tile is not None
+                    and not tiny):   # tiny neighbors never read the cache
                 self.s.prefetcher.tile_served(
                     src, ctx.image_id, ctx.z, ctx.t, ctx.resolution,
                     levels, ctx.tile, src.tile_size(),
                     self.s.max_tile_length, active,
                     ctx.flip_horizontal, ctx.flip_vertical)
+
+        if tiny:
+            return await asyncio.to_thread(
+                self._render_cpu, np.asarray(raw), active_rdef, ctx)
 
         settings = pack_settings(active_rdef, self.s.lut_provider)
 
@@ -277,22 +293,43 @@ class ImageRegionHandler:
             if ctx.flip_horizontal:
                 packed = packed[:, ::-1]
         rgba = unpack_rgba(np.ascontiguousarray(packed))
+        return await asyncio.to_thread(self._encode_rgba, rgba, ctx)
 
+    def _encode_rgba(self, rgba: np.ndarray, ctx: ImageRegionCtx) -> bytes:
+        """Shared encode tail (format dispatch + 404 on unknown format)."""
         try:
-            return await asyncio.to_thread(
-                codecs.encode_rgba, rgba, ctx.format,
-                ctx.compression_quality)
+            return codecs.encode_rgba(np.ascontiguousarray(rgba),
+                                      ctx.format, ctx.compression_quality)
         except codecs.UnknownFormatError as e:
             raise NotFoundError(str(e))
 
+    def _render_cpu(self, raw: np.ndarray, rdef: RenderingDef,
+                    ctx: ImageRegionCtx) -> bytes:
+        """CPU reference path for tiny renders (refimpl semantics).
+
+        Flips fold into the raw planes (render is pointwise), so the
+        encode tail is shared verbatim with the device path.
+        """
+        from ..refimpl import render_ref
+
+        if ctx.flip_vertical:
+            raw = raw[:, ::-1, :]
+        if ctx.flip_horizontal:
+            raw = raw[:, :, ::-1]
+        with stopwatch("Renderer.renderAsPackedInt.cpu"):
+            rgba = render_ref(raw.astype(np.float32), rdef,
+                              self.s.lut_provider)
+        return self._encode_rgba(rgba, ctx)
+
     def _read_region(self, src, ctx: ImageRegionCtx, region: RegionDef,
-                     level: int, active: List[int]):
+                     level: int, active: List[int],
+                     device_cache: bool = True):
         """Raw f32[C_active, h, w] for the resolved region.
 
-        With a device raw cache configured the result is an HBM-resident
-        ``jax.Array``: raw planes are settings-independent, so the
-        interactive re-window/re-color pattern re-renders without moving
-        a byte over the host link.
+        With a device raw cache configured (and ``device_cache`` true) the
+        result is an HBM-resident ``jax.Array``: raw planes are
+        settings-independent, so the interactive re-window/re-color
+        pattern re-renders without moving a byte over the host link.
         """
         def load() -> np.ndarray:
             planes = [
@@ -303,7 +340,7 @@ class ImageRegionHandler:
             # uint16 sources take half the HBM/link bytes.
             return np.stack(planes)
 
-        if self.s.raw_cache is None:
+        if self.s.raw_cache is None or not device_cache:
             return load().astype(np.float32)
         from ..io.devicecache import region_key
         key = region_key(ctx.image_id, ctx.z, ctx.t, level,

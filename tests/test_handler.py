@@ -130,6 +130,29 @@ class TestImageRegionHandler:
         assert jpg[..., 1].astype(int).sum() > 5 * jpg[..., 0].astype(
             int).sum()
 
+    def test_cpu_fallback_for_tiny_renders(self, services):
+        """Renders at or below cpu_fallback_max_px take the refimpl path
+        and must match the device path within codec tolerance."""
+        from dataclasses import replace
+        fast = replace(services, cpu_fallback_max_px=16 * 16,
+                       caches=Caches.from_config(CacheConfig.enabled_all()))
+        handler_cpu = ImageRegionHandler(fast)
+        handler_dev = ImageRegionHandler(services)
+        from omero_ms_image_region_tpu.utils.stopwatch import REGISTRY
+        before = REGISTRY.snapshot().get(
+            "Renderer.renderAsPackedInt.cpu", {}).get("count", 0)
+        ctx = {"tile": "0,0,0,16,16", "m": "c", "format": "png"}
+        cpu = codecs.decode_to_rgba(
+            run(handler_cpu.render_image_region(_ctx(**ctx))))
+        dev = codecs.decode_to_rgba(
+            run(handler_dev.render_image_region(_ctx(**ctx))))
+        # The CPU path must actually have run (not a vacuous device==device
+        # comparison).
+        assert REGISTRY.snapshot()["Renderer.renderAsPackedInt.cpu"][
+            "count"] == before + 1
+        assert cpu.shape == dev.shape == (16, 16, 4)
+        assert np.abs(cpu.astype(int) - dev.astype(int)).max() <= 2
+
     def test_second_request_hits_cache(self, services):
         handler = ImageRegionHandler(services)
         ctx = _ctx(format="png", tile="0,0,0,16,16")

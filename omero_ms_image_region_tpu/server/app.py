@@ -85,7 +85,8 @@ def create_app(config: Optional[AppConfig] = None,
         renderer = (BatchingRenderer(
             max_batch=config.batcher.max_batch,
             linger_ms=config.batcher.linger_ms)
-            if config.batcher.enabled else Renderer())
+            if config.batcher.enabled
+            else Renderer(jpeg_engine=config.renderer.jpeg_engine))
         caches = Caches.from_config(config.caches)
         if config.caches.redis_uri and caches.redis is None:
             log.warning("redis package unavailable; redis cache tier and "

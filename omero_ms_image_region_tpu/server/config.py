@@ -42,6 +42,9 @@ class RendererConfig:
     # Renders of at most this many pixels take the CPU reference kernel
     # (refimpl) instead of a device round trip.  0 disables.
     cpu_fallback_max_px: int = 0
+    # Device JPEG wire format: "sparse" (coefficients + host entropy
+    # coding) or "bitpack" (device-packed Huffman; fast-link deployments).
+    jpeg_engine: str = "sparse"
 
 
 @dataclass
@@ -109,9 +112,11 @@ class AppConfig:
             prefetch=bool(rc.get("prefetch", rc_defaults.prefetch)),
         )
         rd = raw.get("renderer", {}) or {}
+        rd_defaults = RendererConfig()
         cfg.renderer = RendererConfig(
             cpu_fallback_max_px=int(rd.get(
-                "cpu-fallback-max-px",
-                RendererConfig().cpu_fallback_max_px)),
+                "cpu-fallback-max-px", rd_defaults.cpu_fallback_max_px)),
+            jpeg_engine=str(rd.get("jpeg-engine",
+                                   rd_defaults.jpeg_engine)),
         )
         return cfg

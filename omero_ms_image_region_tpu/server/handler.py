@@ -51,7 +51,19 @@ class Renderer:
 
     The micro-batcher (``server.batcher``) exposes the same ``render`` /
     ``render_jpeg`` coroutines and substitutes transparently.
+
+    ``jpeg_engine`` selects the device JPEG wire format: ``"sparse"``
+    (default — sparse coefficients + host entropy coding; wins on
+    slow/compressible links) or ``"bitpack"`` (fully device-packed
+    Huffman bitstream, host only 0xFF-stuffs; wins where device compute
+    is cheap relative to the link — see README "Status and known gaps").
     """
+
+    def __init__(self, jpeg_engine: str = "sparse"):
+        if jpeg_engine not in ("sparse", "bitpack"):
+            raise ValueError(f"unknown jpeg engine {jpeg_engine!r}")
+        self.jpeg_engine = jpeg_engine
+        self._bitpack_encoders: dict = {}
 
     async def render(self, raw: np.ndarray, settings: dict) -> np.ndarray:
         """f32[C, H, W] + packed settings -> u32[H, W] packed RGBA."""
@@ -86,6 +98,23 @@ class Renderer:
             raw = np.ascontiguousarray(raw)
         padded = pad_planes_to_mcu(raw)[None]
         args = batched_args(settings, padded)
+        # The bitpack stream covers the full padded grid, so it serves
+        # only MCU-aligned tiles; others take the sparse path (whose SOF0
+        # crop handles padding).
+        if (self.jpeg_engine == "bitpack"
+                and width % 16 == 0 and height % 16 == 0):
+            from ..ops.jpegenc import TpuJpegEncoder
+            H, W = padded.shape[-2:]
+            enc = self._bitpack_encoders.get((H, W, quality))
+            if enc is None:
+                enc = self._bitpack_encoders[(H, W, quality)] = \
+                    TpuJpegEncoder(H, W, quality=quality)
+
+            def dense_fallback(i):
+                return render_batch_to_jpeg(
+                    *args, quality=quality, dims=[(width, height)])[0]
+            return enc.encode_batch(
+                *args, dense_fallback=dense_fallback)[0]
         return render_batch_to_jpeg(
             *args, quality=quality, dims=[(width, height)])[0]
 

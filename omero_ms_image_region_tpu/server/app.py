@@ -82,11 +82,16 @@ def create_app(config: Optional[AppConfig] = None,
     config = config or AppConfig()
 
     if services is None:
-        renderer = (BatchingRenderer(
-            max_batch=config.batcher.max_batch,
-            linger_ms=config.batcher.linger_ms)
-            if config.batcher.enabled
-            else Renderer(jpeg_engine=config.renderer.jpeg_engine))
+        if config.batcher.enabled:
+            if config.renderer.jpeg_engine != "sparse":
+                log.warning("renderer.jpeg-engine=%r applies only to the "
+                            "direct renderer; the batcher uses the sparse "
+                            "engine", config.renderer.jpeg_engine)
+            renderer = BatchingRenderer(
+                max_batch=config.batcher.max_batch,
+                linger_ms=config.batcher.linger_ms)
+        else:
+            renderer = Renderer(jpeg_engine=config.renderer.jpeg_engine)
         caches = Caches.from_config(config.caches)
         if config.caches.redis_uri and caches.redis is None:
             log.warning("redis package unavailable; redis cache tier and "

@@ -119,4 +119,8 @@ class AppConfig:
             jpeg_engine=str(rd.get("jpeg-engine",
                                    rd_defaults.jpeg_engine)),
         )
+        if cfg.renderer.jpeg_engine not in ("sparse", "bitpack"):
+            raise ValueError(
+                f"renderer.jpeg-engine must be 'sparse' or 'bitpack', "
+                f"got {cfg.renderer.jpeg_engine!r}")
         return cfg

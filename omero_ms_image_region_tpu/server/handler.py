@@ -59,11 +59,17 @@ class Renderer:
     is cheap relative to the link — see README "Status and known gaps").
     """
 
+    # Bitpack encoders hold device-resident tables and a compiled kernel
+    # per (shape, quality); shapes and quality are client-controlled, so
+    # the cache is a small LRU, not an unbounded dict.
+    _MAX_BITPACK_ENCODERS = 8
+
     def __init__(self, jpeg_engine: str = "sparse"):
         if jpeg_engine not in ("sparse", "bitpack"):
             raise ValueError(f"unknown jpeg engine {jpeg_engine!r}")
         self.jpeg_engine = jpeg_engine
-        self._bitpack_encoders: dict = {}
+        from collections import OrderedDict
+        self._bitpack_encoders: "OrderedDict" = OrderedDict()
 
     async def render(self, raw: np.ndarray, settings: dict) -> np.ndarray:
         """f32[C, H, W] + packed settings -> u32[H, W] packed RGBA."""
@@ -105,10 +111,16 @@ class Renderer:
                 and width % 16 == 0 and height % 16 == 0):
             from ..ops.jpegenc import TpuJpegEncoder
             H, W = padded.shape[-2:]
-            enc = self._bitpack_encoders.get((H, W, quality))
+            key = (H, W, quality)
+            enc = self._bitpack_encoders.get(key)
             if enc is None:
-                enc = self._bitpack_encoders[(H, W, quality)] = \
+                enc = self._bitpack_encoders[key] = \
                     TpuJpegEncoder(H, W, quality=quality)
+                while (len(self._bitpack_encoders)
+                       > self._MAX_BITPACK_ENCODERS):
+                    self._bitpack_encoders.popitem(last=False)
+            else:
+                self._bitpack_encoders.move_to_end(key)
 
             def dense_fallback(i):
                 return render_batch_to_jpeg(

@@ -151,13 +151,21 @@ def bench_flagship(rng):
         return statistics.median(batch_ms)
 
     run_once()  # warm-up/compile
-    # The tunnel's throughput swings with relay congestion; best-of-5
-    # approximates the hardware's steady state rather than the noise.
+    # The tunnel's throughput swings with multi-second relay congestion
+    # windows; keep sampling (up to 10 runs) until the best result stops
+    # improving so one bad window doesn't become the recorded number.
     times, p50s = [], []
-    for _ in range(5):
+    stale = 0
+    for _ in range(10):
         t0 = time.perf_counter()
         p50s.append(run_once())
         times.append(time.perf_counter() - t0)
+        if times[-1] <= min(times) * 1.02:
+            stale = 0
+        else:
+            stale += 1
+        if len(times) >= 4 and stale >= 3:
+            break
     tiles_per_sec = (B * n_batches) / min(times)
     p50_batch_ms = statistics.median(p50s)
 

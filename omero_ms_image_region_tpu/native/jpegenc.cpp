@@ -409,7 +409,10 @@ long long jpeg_encode(const int16_t* y, const int16_t* cb, const int16_t* cr,
 long long jpeg_encode_sparse(const uint8_t* buf, size_t buf_len,
                              int width, int height, int quality, int cap,
                              uint8_t* out_buf, size_t out_cap) {
-  if (!buf || !out_buf || width <= 0 || height <= 0 || cap <= 0) return -1;
+  // cap must be even: the i16 value array lives at offset 4 + nb + cap
+  // (nb is always even), so an odd cap would misalign every int16 load.
+  if (!buf || !out_buf || width <= 0 || height <= 0 || cap <= 0 ||
+      (cap & 1)) return -1;
   int h16 = (height + 15) / 16, w16 = (width + 15) / 16;
   int n_mcu = h16 * w16;
   int nb_y = n_mcu * 4, nb_c = n_mcu;

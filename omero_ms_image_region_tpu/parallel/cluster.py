@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from .mesh import Mesh, make_mesh
+from .mesh import Mesh, make_mesh, resolve_devices
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -54,15 +54,21 @@ def initialize(coordinator_address: Optional[str] = None,
         # No cluster environment: standalone single-process service.
 
 
-def global_mesh(chan_parallel: int = 1) -> Mesh:
+def global_mesh(chan_parallel: int = 1,
+                n_devices: Optional[int] = None) -> Mesh:
     """A ``(data, chan)`` mesh over every device in the (multi-host) slice.
 
     With ``jax.distributed`` initialized this spans all hosts; the sharded
     steps built on it (``render_step_sharded`` /
     ``render_jpeg_step_sharded``) then execute one program over the whole
     slice, each host feeding its addressable shard of the batch.
+
+    ``n_devices`` requests a minimum mesh width: when the default platform
+    is narrower (e.g. a single local chip during tests) this falls back to
+    the virtual host (CPU) mesh exactly like ``mesh.make_mesh`` does, so
+    mesh-shape-dependent code paths stay exercisable everywhere.
     """
-    devices = np.asarray(jax.devices())
+    devices = np.asarray(resolve_devices(n_devices))
     return make_mesh(len(devices), chan_parallel=chan_parallel,
                      devices=devices)
 
@@ -83,4 +89,10 @@ def local_batch_slice(mesh: Mesh, global_batch: int) -> slice:
             if d.process_index == jax.process_index()]
     if not rows:
         return slice(0, 0)
+    if rows != list(range(rows[0], rows[-1] + 1)):
+        raise ValueError(
+            "this process's data-axis rows are not contiguous "
+            f"({rows}); a single slice cannot describe its shard — "
+            "reorder the mesh so each process owns a contiguous run "
+            "of data rows")
     return slice(rows[0] * per_shard, (rows[-1] + 1) * per_shard)

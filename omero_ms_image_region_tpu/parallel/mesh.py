@@ -201,14 +201,18 @@ def render_jpeg_step_sharded(mesh: Mesh, quality: int = 85,
     from ..ops.jpegenc import (default_sparse_cap, packed_to_jpeg_coefficients,
                                quant_tables, sparse_pack)
 
-    qy, qc = (jnp.asarray(np.asarray(t, np.int32))
-              for t in quant_tables(quality))
+    # Keep the quant tables as host numpy and lift them to device constants
+    # only inside the traced step: an eager ``jnp.asarray`` here would land
+    # on the *default* platform, which may be a different (even broken)
+    # backend than the mesh the step runs on.
+    qy_h, qc_h = (np.asarray(t, np.int32) for t in quant_tables(quality))
 
     def step(*args):
         packed = _composite_step(*args)              # u32[Bl, H, W]
         H, W = packed.shape[-2:]
         local_cap = cap if cap is not None else default_sparse_cap(H, W)
-        y, cb, cr = packed_to_jpeg_coefficients(packed, qy, qc)
+        y, cb, cr = packed_to_jpeg_coefficients(
+            packed, jnp.asarray(qy_h), jnp.asarray(qc_h))
         return sparse_pack(y, cb, cr, local_cap)
 
     sharded = shard_map(
@@ -227,6 +231,10 @@ def shard_batch(mesh: Mesh, raw, settings):
     possible channel pad so C divides the chan axis).
     """
     put = jax.device_put
+    # Scalars are device_put with a replicated sharding over *this* mesh
+    # rather than built with ``jnp.int32`` — an eager jnp constant would be
+    # committed to the default platform, which need not be the mesh's.
+    rep = NamedSharding(mesh, P())
     args = (
         put(raw, NamedSharding(mesh, P("data", "chan"))),
         put(settings["window_start"], NamedSharding(mesh, P("chan"))),
@@ -234,8 +242,8 @@ def shard_batch(mesh: Mesh, raw, settings):
         put(settings["family"], NamedSharding(mesh, P("chan"))),
         put(settings["coefficient"], NamedSharding(mesh, P("chan"))),
         put(settings["reverse"], NamedSharding(mesh, P("chan"))),
-        jnp.int32(settings["cd_start"]),
-        jnp.int32(settings["cd_end"]),
+        put(np.int32(settings["cd_start"]), rep),
+        put(np.int32(settings["cd_end"]), rep),
         put(settings["tables"], NamedSharding(mesh, P("chan"))),
     )
     return args

@@ -238,6 +238,22 @@ def create_app(config: Optional[AppConfig] = None,
         return web.json_response(doc)
 
     app = web.Application()
+
+    async def on_startup(app):
+        # ≙ the reference's worker verticle pool sizing
+        # (``worker_pool_size``, default 2 x cores,
+        # ``ImageRegionMicroserviceVerticle.java:83-85``): every render
+        # offload (asyncio.to_thread) runs on the loop's default executor.
+        import asyncio
+        import concurrent.futures as cf
+        import os as _os
+
+        workers = config.worker_pool_size or 2 * (_os.cpu_count() or 4)
+        asyncio.get_running_loop().set_default_executor(
+            cf.ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="render-worker"))
+
+    app.on_startup.append(on_startup)
     for prefix in ("webgateway", "webclient"):
         for route in ("render_image_region", "render_image"):
             app.router.add_get(
@@ -269,6 +285,76 @@ def create_app(config: Optional[AppConfig] = None,
     return app
 
 
+def configure_logging(config: AppConfig) -> None:
+    """Console always; optional time-rolling file appender
+    (≙ ``logback.xml.example:1-26``'s STDOUT + RollingFileAppender)."""
+    import logging.handlers
+
+    level = getattr(logging, config.logging.level.upper(), logging.INFO)
+    fmt = logging.Formatter(
+        "%(asctime)s [%(threadName)s] %(levelname)-5s %(name)s - "
+        "%(message)s")
+    root = logging.getLogger()
+    root.setLevel(level)
+    console = logging.StreamHandler()
+    console.setFormatter(fmt)
+    root.addHandler(console)
+    if config.logging.file:
+        import os
+        os.makedirs(os.path.dirname(config.logging.file) or ".",
+                    exist_ok=True)
+        rolling = logging.handlers.TimedRotatingFileHandler(
+            config.logging.file, when=config.logging.when,
+            backupCount=config.logging.backup_count)
+        rolling.setFormatter(fmt)
+        root.addHandler(rolling)
+
+
+def run_app(app: web.Application, config: AppConfig) -> None:
+    """Serve with the configured HTTP parse limits.
+
+    ``web.run_app`` cannot forward protocol options, so this drives an
+    ``AppRunner`` directly; the kwargs reach ``RequestHandler`` (aiohttp's
+    ``max_line_size``/``max_field_size``/``max_headers`` ≙ the Vert.x
+    ``max-initial-line-length``/``max-header-size`` limits,
+    ``config.yaml:5-12``).
+    """
+    import asyncio
+    import signal
+
+    async def serve():
+        runner = web.AppRunner(
+            app,
+            max_line_size=config.http.max_initial_line_length,
+            max_field_size=config.http.max_header_size,
+            max_headers=config.http.max_headers,
+        )
+        await runner.setup()
+        site = web.TCPSite(runner, port=config.port)
+        await site.start()
+        log.info("serving on :%d", config.port)
+        # web.run_app would install these for us; a bare runner must do it
+        # itself or SIGTERM (docker/k8s stop) kills the process without
+        # running on_cleanup (renderer close, prefetcher drain, cache
+        # client shutdown).
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        try:
+            await stop.wait()
+        finally:
+            await runner.cleanup()
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -285,8 +371,8 @@ def main(argv=None) -> None:
     if args.data_dir is not None:
         config.data_dir = args.data_dir
 
-    logging.basicConfig(level=logging.INFO)
-    web.run_app(create_app(config), port=config.port)
+    configure_logging(config)
+    run_app(create_app(config), config)
 
 
 if __name__ == "__main__":

@@ -48,8 +48,34 @@ class RendererConfig:
 
 
 @dataclass
+class HttpConfig:
+    """Request parse limits (≙ ``config.yaml:5-12`` — the Vert.x
+    ``HttpServerOptions`` line/header limits, mapped onto aiohttp's
+    ``max_line_size`` / ``max_field_size`` / ``max_headers`` knobs)."""
+
+    max_initial_line_length: int = 4096    # max-initial-line-length
+    max_header_size: int = 8192            # max-header-size (per field)
+    max_headers: int = 32768               # header count bound
+
+
+@dataclass
+class LoggingConfig:
+    """≙ ``logback.xml.example:1-26``: console always; optional
+    time-rolling file appender; per-subsystem level."""
+
+    level: str = "INFO"
+    file: Optional[str] = None             # enables the rolling appender
+    when: str = "midnight"                 # TimedRotatingFileHandler unit
+    backup_count: int = 7
+
+
+@dataclass
 class AppConfig:
     port: int = 8080
+    # None = 2 x cores, the reference's worker verticle default
+    # (``config.yaml:3-4``, ``ImageRegionMicroserviceVerticle.java:83-85``);
+    # sizes the asyncio default executor every render offload runs on.
+    worker_pool_size: Optional[int] = None
     data_dir: str = "./data"
     max_tile_length: int = 2048            # omero.pixeldata.max_tile_length
     cache_control_header: str = ""         # cache-control-header
@@ -61,6 +87,8 @@ class AppConfig:
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
     raw_cache: RawCacheConfig = field(default_factory=RawCacheConfig)
     renderer: RendererConfig = field(default_factory=RendererConfig)
+    http: HttpConfig = field(default_factory=HttpConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
 
     @classmethod
     def from_yaml(cls, path: str) -> "AppConfig":
@@ -72,6 +100,29 @@ class AppConfig:
     def from_dict(cls, raw: dict) -> "AppConfig":
         cfg = cls()
         cfg.port = int(raw.get("port", cfg.port))
+        if raw.get("worker_pool_size") is not None:
+            cfg.worker_pool_size = int(raw["worker_pool_size"])
+            if cfg.worker_pool_size <= 0:
+                raise ValueError("worker_pool_size must be positive")
+        http_defaults = HttpConfig()
+        cfg.http = HttpConfig(
+            max_initial_line_length=int(raw.get(
+                "max-initial-line-length",
+                http_defaults.max_initial_line_length)),
+            max_header_size=int(raw.get(
+                "max-header-size", http_defaults.max_header_size)),
+            max_headers=int(raw.get(
+                "max-headers", http_defaults.max_headers)),
+        )
+        logging_block = raw.get("logging", {}) or {}
+        log_defaults = LoggingConfig()
+        cfg.logging = LoggingConfig(
+            level=str(logging_block.get("level", log_defaults.level)),
+            file=logging_block.get("file"),
+            when=str(logging_block.get("when", log_defaults.when)),
+            backup_count=int(logging_block.get(
+                "backup-count", log_defaults.backup_count)),
+        )
         cfg.data_dir = raw.get("data-dir", cfg.data_dir)
         server_block = raw.get("omero.server", {}) or {}
         cfg.max_tile_length = int(server_block.get(

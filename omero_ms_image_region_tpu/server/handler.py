@@ -68,8 +68,13 @@ class Renderer:
         if jpeg_engine not in ("sparse", "bitpack"):
             raise ValueError(f"unknown jpeg engine {jpeg_engine!r}")
         self.jpeg_engine = jpeg_engine
+        import threading
         from collections import OrderedDict
         self._bitpack_encoders: "OrderedDict" = OrderedDict()
+        # render_jpeg runs on asyncio worker threads; concurrent requests
+        # for the same (H, W, quality) must not race the LRU bookkeeping
+        # (duplicate encoders each recompile; popitem can race an insert).
+        self._bitpack_lock = threading.Lock()
 
     async def render(self, raw: np.ndarray, settings: dict) -> np.ndarray:
         """f32[C, H, W] + packed settings -> u32[H, W] packed RGBA."""
@@ -112,15 +117,21 @@ class Renderer:
             from ..ops.jpegenc import TpuJpegEncoder
             H, W = padded.shape[-2:]
             key = (H, W, quality)
-            enc = self._bitpack_encoders.get(key)
+            with self._bitpack_lock:
+                enc = self._bitpack_encoders.get(key)
+                if enc is not None:
+                    self._bitpack_encoders.move_to_end(key)
             if enc is None:
-                enc = self._bitpack_encoders[key] = \
-                    TpuJpegEncoder(H, W, quality=quality)
-                while (len(self._bitpack_encoders)
-                       > self._MAX_BITPACK_ENCODERS):
-                    self._bitpack_encoders.popitem(last=False)
-            else:
-                self._bitpack_encoders.move_to_end(key)
+                # Construct outside the lock (builds device tables);
+                # put-if-absent on completion so a racing thread's copy
+                # wins at most once.
+                built = TpuJpegEncoder(H, W, quality=quality)
+                with self._bitpack_lock:
+                    enc = self._bitpack_encoders.setdefault(key, built)
+                    self._bitpack_encoders.move_to_end(key)
+                    while (len(self._bitpack_encoders)
+                           > self._MAX_BITPACK_ENCODERS):
+                        self._bitpack_encoders.popitem(last=False)
 
             def dense_fallback(i):
                 return render_batch_to_jpeg(

@@ -1,0 +1,68 @@
+"""Stage and batch-size scaling of the device pipeline (diagnostic)."""
+
+import statistics
+import time
+
+import numpy as np
+
+from omero_ms_image_region_tpu.flagship import (
+    batched_args, flagship_settings, synthetic_wsi_tiles,
+)
+from omero_ms_image_region_tpu.ops.jpegenc import (
+    default_sparse_cap, packed_to_jpeg_coefficients, quant_tables,
+    render_to_jpeg_sparse, render_to_jpeg_coefficients, sparse_pack,
+)
+from omero_ms_image_region_tpu.ops.render import render_tile_batch_packed
+
+import jax
+import jax.numpy as jnp
+
+
+def sync(x):
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    np.asarray(leaf.ravel()[:1])
+
+
+def t(fn, n=5):
+    fn()
+    xs = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        xs.append((time.perf_counter() - t0) * 1e3)
+    return min(xs)
+
+
+def main():
+    rng = np.random.default_rng(7)
+    C, H, W = 4, 1024, 1024
+    quality = 85
+    cap = default_sparse_cap(H, W)
+    _, settings = flagship_settings()
+    qy, qc = (tt.astype(np.int32) for tt in quant_tables(quality))
+
+    for B in (8, 16, 32):
+        raw = synthetic_wsi_tiles(rng, B, C, H, W)
+        args_suffix = batched_args(settings, raw)[1:]
+        dev_raw = jax.device_put(raw)
+        sync(dev_raw)
+
+        render = jax.jit(render_tile_batch_packed)
+        ms_render = t(lambda: sync(render(dev_raw, *args_suffix)))
+        ms_coeff = t(lambda: sync(render_to_jpeg_coefficients(
+            dev_raw, *args_suffix, qy, qc)))
+        ms_sparse = t(lambda: sync(render_to_jpeg_sparse(
+            dev_raw, *args_suffix, qy, qc, cap=cap)))
+        print(f"B={B:3d}: render={ms_render:7.1f}ms  +dct={ms_coeff:7.1f}ms "
+              f" +sparse={ms_sparse:7.1f}ms  per-tile sparse="
+              f"{ms_sparse / B:5.1f}ms")
+
+    # empty dispatch: round-trip floor for a no-op jitted fn
+    f = jax.jit(lambda x: x + 1)
+    a = jax.device_put(np.zeros(8, np.float32))
+    sync(a)
+    print("noop dispatch+sync: %.1f ms" % t(lambda: sync(f(a))))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,7 @@ import pytest
 jax = pytest.importorskip("jax")
 
 from omero_ms_image_region_tpu.parallel import cluster
+from omero_ms_image_region_tpu.parallel.mesh import resolve_devices
 
 
 def test_initialize_standalone_is_noop():
@@ -24,5 +25,28 @@ def test_local_batch_slice_single_process_covers_all():
     data = mesh.shape["data"]
     sl = cluster.local_batch_slice(mesh, data * 3)
     assert sl == slice(0, data * 3)
+    if data == 1:
+        # Every batch divides a 1-row data axis; the indivisibility
+        # contract needs a wider mesh (covered by the n_devices=8 test).
+        pytest.skip("needs a multi-device data axis")
     with pytest.raises(ValueError):
         cluster.local_batch_slice(mesh, data * 3 + 1)
+
+
+def test_global_mesh_falls_back_to_virtual_host_mesh():
+    if len(resolve_devices(8)) < 8:
+        pytest.skip("no 8-wide device pool (real or virtual) available")
+    mesh = cluster.global_mesh(chan_parallel=2, n_devices=8)
+    assert mesh.size == 8
+    assert mesh.shape == {"data": 4, "chan": 2}
+
+
+def test_local_batch_slice_indivisible_raises_on_wide_mesh():
+    if len(resolve_devices(8)) < 8:
+        pytest.skip("no 8-wide device pool (real or virtual) available")
+    mesh = cluster.global_mesh(chan_parallel=1, n_devices=8)
+    assert mesh.shape["data"] == 8
+    sl = cluster.local_batch_slice(mesh, 16)
+    assert sl == slice(0, 16)  # single process owns every row
+    with pytest.raises(ValueError):
+        cluster.local_batch_slice(mesh, 17)

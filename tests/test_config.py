@@ -34,6 +34,62 @@ class TestAppConfig:
         assert cfg.caches.image_region is False
         assert cfg.caches.pixels_metadata is False
 
+    def test_worker_pool_and_http_limits(self):
+        cfg = AppConfig.from_dict({
+            "worker_pool_size": 4,
+            "max-initial-line-length": 2048,
+            "max-header-size": 4096,
+        })
+        assert cfg.worker_pool_size == 4
+        assert cfg.http.max_initial_line_length == 2048
+        assert cfg.http.max_header_size == 4096
+        # defaults mirror the reference's commented values
+        d = AppConfig.from_dict({})
+        assert d.worker_pool_size is None
+        assert d.http.max_initial_line_length == 4096
+        assert d.http.max_header_size == 8192
+
+    def test_worker_pool_size_must_be_positive(self):
+        import pytest
+        with pytest.raises(ValueError):
+            AppConfig.from_dict({"worker_pool_size": 0})
+
+    def test_logging_block(self):
+        cfg = AppConfig.from_dict({"logging": {
+            "level": "DEBUG", "file": "/tmp/oms.log", "when": "H",
+            "backup-count": 3,
+        }})
+        assert cfg.logging.level == "DEBUG"
+        assert cfg.logging.file == "/tmp/oms.log"
+        assert cfg.logging.when == "H"
+        assert cfg.logging.backup_count == 3
+        d = AppConfig.from_dict({})
+        assert d.logging.level == "INFO" and d.logging.file is None
+
+    def test_rolling_file_logging_writes(self, tmp_path):
+        import logging as _logging
+
+        from omero_ms_image_region_tpu.server.app import configure_logging
+
+        root = _logging.getLogger()
+        saved = root.handlers[:]
+        try:
+            root.handlers = []
+            cfg = AppConfig.from_dict({"logging": {
+                "file": str(tmp_path / "oms.log"), "backup-count": 1,
+            }})
+            configure_logging(cfg)
+            _logging.getLogger("omero_ms_image_region_tpu.test").info(
+                "hello rolling file")
+            for h in root.handlers:
+                h.flush()
+            assert "hello rolling file" in (tmp_path / "oms.log").read_text()
+        finally:
+            for h in root.handlers:
+                if h not in saved:
+                    h.close()
+            root.handlers = saved
+
     def test_cache_flags_and_redis_uri(self):
         cfg = AppConfig.from_dict({
             "redis-cache": {"uri": "redis://x:1/0"},

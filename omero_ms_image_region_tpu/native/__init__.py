@@ -262,11 +262,18 @@ def jpeg_encode_sparse_native(buf, width: int, height: int, quality: int,
     import numpy as np
     lib = _load_jpeg()
     buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    true_len = buf.size
+    # Pad so the decoder's 32-bit window reads at the 18-bit stream tail
+    # stay in bounds (jpegenc.cpp read_entry18); prefix fetches
+    # especially.  The TRUE length is what the decoder validates against
+    # — counting the pad would let a truncated buffer decode its last
+    # entry from zeros instead of erroring.
+    buf = np.pad(buf, (0, 4))
     out_cap = buf.size * 4 + 65536
     while True:
         out = ctypes.create_string_buffer(out_cap)
         n = lib.jpeg_encode_sparse(
-            buf.ctypes.data, buf.size, width, height, quality, cap,
+            buf.ctypes.data, true_len, width, height, quality, cap,
             out, out_cap,
         )
         if n >= 0:

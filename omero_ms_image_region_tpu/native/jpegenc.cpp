@@ -192,13 +192,37 @@ struct BlockSyms {
 // sparse_pack emits.  Positions absent from the list are zero.  Returns
 // false on a malformed buffer (n > 64, positions not strictly ascending
 // or > 63) rather than trusting wire data into fixed-size arrays.
-bool block_symbols_sparse(const uint8_t* pos, const int16_t* val, int n,
+// Read the 18-bit entry at index j of the packed stream (MSB-first at
+// bit 18j): 6-bit zigzag position << 12 | 12-bit two's-complement value.
+// `stream` must be readable for 4 bytes from byte (18j)/8 — the encoder
+// wrapper pads its host copy, so prefix fetches stay safe.
+static inline uint32_t read_entry18(const uint8_t* stream, long long j) {
+  long long bit = j * 18;
+  const uint8_t* p = stream + (bit >> 3);
+  int shift = static_cast<int>(bit & 7);
+  uint32_t window = (static_cast<uint32_t>(p[0]) << 24)
+                  | (static_cast<uint32_t>(p[1]) << 16)
+                  | (static_cast<uint32_t>(p[2]) << 8)
+                  | static_cast<uint32_t>(p[3]);
+  return (window >> (32 - 18 - shift)) & 0x3FFFF;
+}
+
+static inline int entry_val(uint32_t field) {
+  int v = static_cast<int>(field & 0xFFF);
+  return v >= 2048 ? v - 4096 : v;
+}
+
+bool block_symbols_sparse(const uint8_t* stream, long long first, int n,
                           int pred, BlockSyms* bs,
                           int64_t* dc_freq, int64_t* ac_freq) {
+  // Entries [first, first+n) of the 18-bit packed stream.
   if (n < 0 || n > 64) return false;
   int k = 0;
   int dc = 0;
-  if (n > 0 && pos[0] == 0) { dc = val[0]; k = 1; }
+  if (n > 0) {
+    uint32_t f = read_entry18(stream, first);
+    if ((f >> 12) == 0) { dc = entry_val(f); k = 1; }
+  }
   int dc_diff = dc - pred;
   bs->dc_sym = category(dc_diff);
   bs->dc_val = dc_diff;
@@ -207,7 +231,8 @@ bool block_symbols_sparse(const uint8_t* pos, const int16_t* val, int n,
   bs->n_ac = 0;
   int last = 0;
   for (; k < n; k++) {
-    int p = pos[k];
+    uint32_t f = read_entry18(stream, first + k);
+    int p = static_cast<int>(f >> 12);
     if (p <= last || p > 63) return false;
     int run = p - last - 1;
     last = p;
@@ -216,7 +241,7 @@ bool block_symbols_sparse(const uint8_t* pos, const int16_t* val, int n,
       ac_freq[0xF0]++;
       run -= 16;
     }
-    int v = val[k];
+    int v = entry_val(f);
     uint32_t sym = (static_cast<uint32_t>(run) << 4) | category(v);
     bs->ac[bs->n_ac++] = (sym << 16) | (static_cast<uint32_t>(v) & 0xFFFF);
     ac_freq[sym]++;
@@ -402,30 +427,31 @@ long long jpeg_encode(const int16_t* y, const int16_t* cb, const int16_t* cr,
 
 // Encode one image straight from the device's sparse wire buffer
 // (ops/jpegenc.py sparse_pack layout: [total i32 LE | counts u8[nb] |
-// pos u8[cap] | val i16 LE[cap]], blocks ordered luma raster, Cb raster,
-// Cr raster).  Returns bytes written, -needed if out_cap is short, -1 on
+// packed 18-bit (pos << 12 | val) entries], blocks ordered luma raster,
+// Cb raster, Cr raster).  `buf` may be a prefix fetch: any length >=
+// 4 + nb + ceil(18*total/8) decodes — the caller (ctypes wrapper) pads
+// its copy by 4 bytes so the 32-bit window reads at the tail stay in
+// bounds.  Returns bytes written, -needed if out_cap is short, -1 on
 // invalid arguments, -2 if the buffer overflowed `cap` (entries dropped;
 // caller must take the dense path).
 long long jpeg_encode_sparse(const uint8_t* buf, size_t buf_len,
                              int width, int height, int quality, int cap,
                              uint8_t* out_buf, size_t out_cap) {
-  // cap must be even: the i16 value array lives at offset 4 + nb + cap
-  // (nb is always even), so an odd cap would misalign every int16 load.
-  if (!buf || !out_buf || width <= 0 || height <= 0 || cap <= 0 ||
-      (cap & 1)) return -1;
+  if (!buf || !out_buf || width <= 0 || height <= 0 || cap <= 0) return -1;
   int h16 = (height + 15) / 16, w16 = (width + 15) / 16;
   int n_mcu = h16 * w16;
   int nb_y = n_mcu * 4, nb_c = n_mcu;
   int nb = nb_y + 2 * nb_c;
-  size_t need = 4 + static_cast<size_t>(nb) + static_cast<size_t>(cap) * 3;
-  if (buf_len < need) return -1;
+  if (buf_len < 4 + static_cast<size_t>(nb)) return -1;
 
   int32_t total;
   std::memcpy(&total, buf, 4);
   if (total > cap) return -2;
+  if (total < 0 ||
+      buf_len < 4 + static_cast<size_t>(nb) +
+                    (static_cast<size_t>(total) * 18 + 7) / 8) return -1;
   const uint8_t* counts = buf + 4;
-  const uint8_t* pos = buf + 4 + nb;
-  const int16_t* val = reinterpret_cast<const int16_t*>(buf + 4 + nb + cap);
+  const uint8_t* stream = buf + 4 + nb;
 
   // Per-block entry offsets (prefix sum of counts, flat block order).
   std::vector<int> start(nb + 1);
@@ -445,7 +471,7 @@ long long jpeg_encode_sparse(const uint8_t* buf, size_t buf_len,
           (2 * my + 1) * yw + 2 * mx, (2 * my + 1) * yw + 2 * mx + 1};
       for (int k = 0; k < 4; k++) {
         int b = yidx[k];
-        if (!block_symbols_sparse(pos + start[b], val + start[b],
+        if (!block_symbols_sparse(stream, start[b],
                                   start[b + 1] - start[b], ypred,
                                   &ysyms[yi++], y_dcf, y_acf))
           return -1;
@@ -453,13 +479,13 @@ long long jpeg_encode_sparse(const uint8_t* buf, size_t buf_len,
       }
       int ci = my * w16 + mx;
       int b = nb_y + ci;
-      if (!block_symbols_sparse(pos + start[b], val + start[b],
+      if (!block_symbols_sparse(stream, start[b],
                                 start[b + 1] - start[b], cbpred,
                                 &cbsyms[ci], c_dcf, c_acf))
         return -1;
       cbpred = cbsyms[ci].dc_abs;
       b = nb_y + nb_c + ci;
-      if (!block_symbols_sparse(pos + start[b], val + start[b],
+      if (!block_symbols_sparse(stream, start[b],
                                 start[b + 1] - start[b], crpred,
                                 &crsyms[ci], c_dcf, c_acf))
         return -1;

@@ -169,47 +169,96 @@ def render_to_jpeg_coefficients(raw, window_start, window_end, family,
     return packed_to_jpeg_coefficients(packed, qy, qc)
 
 
+ENTRY_BITS = 18      # 6-bit zigzag position + 12-bit value (two's compl.)
+
+
+def sparse_wire_width(H: int, W: int, cap: int) -> int:
+    """Total device wire-buffer bytes per tile (the static shape)."""
+    h16, w16 = (H + 15) // 16, (W + 15) // 16
+    nb = h16 * w16 * 6
+    return 4 + nb + (ENTRY_BITS * cap + 7) // 8
+
+
+def sparse_prefix_bytes(total: int, H: int, W: int) -> int:
+    """Bytes of a tile's wire buffer actually carrying data: the header,
+    the per-block counts, and ``total`` 18-bit entries."""
+    h16, w16 = (H + 15) // 16, (W + 15) // 16
+    nb = h16 * w16 * 6
+    return 4 + nb + (ENTRY_BITS * int(total) + 7) // 8
+
+
 def sparse_pack(y, cb, cr, cap: int):
     """Compact nonzero coefficients into one u8 wire buffer per tile.
 
     The host link, not compute, bounds this service's TPU throughput (the
-    tunnel moves ~15 MB/s device-to-host), so the device ships only the
+    tunnel moves ~15-30 MB/s device-to-host), so the device ships only the
     entropy-bearing bytes: for each tile a buffer
 
         [ total_entries i32 LE | per-block nonzero counts u8[nb] |
-          zigzag positions u8[cap] | values i16 LE[cap] ]
+          packed 18-bit entries u8[ceil(18*cap/8)] ]
 
-    where entries appear in (block, zigzag) scan order — which makes the
-    sparse list exactly the run-length stream baseline JPEG entropy-codes,
-    so the host encoder (``jpeg_encode_sparse``) reads it directly.  Block
-    order is luma raster, then Cb raster, then Cr raster.  Entries beyond
-    ``cap`` are dropped (detected host-side via total_entries > cap; the
-    caller then falls back to the dense path).  The unused tail stays
-    zero, which the transport's wire compression collapses.
+    where entry j (MSB-first at bit ``18*j``) is ``pos << 12 | val``:
+    the 6-bit zigzag position and the 12-bit two's-complement value (the
+    quantizer clips to ±2047, so 12 bits are exact) of the j-th nonzero
+    in (block, zigzag) scan order — exactly the run-length stream
+    baseline JPEG entropy-codes, so the host encoder
+    (``jpeg_encode_sparse``) reads it directly.  Block order is luma
+    raster, then Cb raster, then Cr raster.  Entries beyond ``cap`` are
+    dropped (detected host-side via total_entries > cap; the caller then
+    falls back to the dense path).
+
+    Layout and algorithm are both wire-aware:
+
+      * at 2.25 bytes/entry the used bytes are one contiguous prefix
+        (``sparse_prefix_bytes``), so the host fetches only that prefix —
+        comparable in size to the final JPEG itself — instead of the full
+        ``cap``-sized buffer (``SparseWireFetcher``);
+      * compaction is one set-scatter with unique, ascending targets
+        (out-of-bounds-dropped tails), which XLA lowers to plain stores —
+        measured ~3x faster than the equivalent non-unique scatter; the
+        18-bit bitstream is then assembled by a pure gather pass (each
+        output byte reads its ≤2 contributing entries arithmetically).
     """
     B = y.shape[0]
     flat = jnp.concatenate(
         [y.reshape(B, -1), cb.reshape(B, -1), cr.reshape(B, -1)], axis=1
-    )
+    ).astype(jnp.int32)
     N = flat.shape[1]
     nb = N // 64
     mask = flat != 0
     counts = mask.reshape(B, nb, 64).sum(-1).astype(jnp.uint8)
-    wi = jnp.cumsum(mask, axis=1) - 1
+    wi = jnp.cumsum(mask, axis=1) - 1                      # [B, N]
     total = (wi[:, -1] + 1).astype(jnp.int32)
-    pos = (jnp.arange(N, dtype=jnp.int32) % 64).astype(jnp.uint8)
+    pos = jnp.arange(N, dtype=jnp.int32) % 64
+    field = (pos << 12) | (flat & 0xFFF)                   # 18-bit entries
 
-    def compact_one(m, w, v):
-        tgt = jnp.where(m & (w < cap), w, cap)   # index cap = discard slot
-        p = jnp.zeros(cap + 1, jnp.uint8).at[tgt].set(pos, mode="drop")
-        vv = jnp.zeros(cap + 1, jnp.int16).at[tgt].set(v, mode="drop")
-        return p[:cap], vv[:cap]
+    def compact_one(m, w, f):
+        tgt = jnp.where(m & (w < cap), w, jnp.int32(1) << 30)
+        return jnp.zeros(cap, jnp.int32).at[tgt].set(
+            f, mode="drop", unique_indices=True)
 
-    ps, vs = jax.vmap(compact_one)(mask, wi, flat)
-    vs_u8 = jax.lax.bitcast_convert_type(vs, jnp.uint8).reshape(B, -1)
+    comp = jax.vmap(compact_one)(mask, wi, field)          # [B, cap]
+
+    # Assemble the 18-bit stream byte-by-byte: byte b covers bits
+    # [8b, 8b+8), which intersect entries e0 = (8b)//18 and possibly
+    # e0 + 1 (a field is 18 > 8 bits, so never more than two).
+    nbytes = (ENTRY_BITS * cap + 7) // 8
+    bitpos = jnp.arange(nbytes, dtype=jnp.int32) * 8
+    e0 = bitpos // ENTRY_BITS
+    off = bitpos - e0 * ENTRY_BITS                          # 0..17
+    compz = jnp.pad(comp, ((0, 0), (0, 1)))                 # e0+1 guard
+
+    def assemble_one(c_row):
+        f0 = c_row[e0]
+        f1 = c_row[e0 + 1]
+        part0 = ((f0 << off) & 0x3FFFF) >> 10
+        part1 = jnp.where(off > 10, f1 >> (28 - off), 0)
+        return ((part0 | part1) & 0xFF).astype(jnp.uint8)
+
+    stream = jax.vmap(assemble_one)(compz)                  # [B, nbytes]
     tot_u8 = jax.lax.bitcast_convert_type(
         total[:, None], jnp.uint8).reshape(B, -1)
-    return jnp.concatenate([tot_u8, counts, ps, vs_u8], axis=1)
+    return jnp.concatenate([tot_u8, counts, stream], axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("cap",))
@@ -221,6 +270,84 @@ def render_to_jpeg_sparse(raw, window_start, window_end, family,
         raw, window_start, window_end, family, coefficient, reverse,
         cd_start, cd_end, tables, qy, qc)
     return sparse_pack(y, cb, cr, cap)
+
+
+class SparseWireFetcher:
+    """Predictive prefix fetch of sparse wire buffers.
+
+    The wire buffer's used bytes are one contiguous prefix
+    (``sparse_prefix_bytes``), so on a slow host link only that prefix
+    need cross.  The fetcher predicts the next batch's prefix from the
+    largest tile seen so far (with headroom), rounds to a granule so the
+    device slice comes from a small, cached set of compiled shapes, and
+    completes any under-predicted row with a follow-up fetch.
+    """
+
+    GRANULE = 16 * 1024
+
+    def __init__(self, H: int, W: int, cap: int, headroom: float = 1.06):
+        h16, w16 = (H + 15) // 16, (W + 15) // 16
+        self.nb = h16 * w16 * 6
+        self.cap = cap
+        self.width = sparse_wire_width(H, W, cap)
+        self.headroom = headroom
+        # First fetch: a third of the worst case, floor one granule.
+        self._k = self._round(max(self.GRANULE, self.width // 3))
+
+    def _round(self, n: int) -> int:
+        g = self.GRANULE
+        return min(self.width, ((n + g - 1) // g) * g)
+
+    def start(self, buf):
+        """Slice the predicted prefix and start its async host copy.
+
+        ``buf`` is the device u8[B, width] array from
+        :func:`render_to_jpeg_sparse`.  Returns an opaque handle for
+        :meth:`finish`.
+        """
+        k = self._k
+        pre = buf if k >= self.width else buf[:, :k]
+        if hasattr(pre, "copy_to_host_async"):
+            pre.copy_to_host_async()
+        return pre, buf, k
+
+    def finish(self, handle) -> np.ndarray:
+        """Complete a fetch: host u8[B, >=prefix] rows, decodable by
+        ``jpeg_encode_sparse`` / ``sparse_to_dense``."""
+        pre, buf, k = handle
+        host = np.asarray(pre)
+        totals = host[:, :4].copy().view(np.int32).ravel()
+        # Overflowed tiles (total > cap) need only the header to be
+        # detected; clamp so prediction tracks real prefixes.
+        needed = (4 + self.nb
+                  + (ENTRY_BITS * np.clip(totals, 0, self.cap) + 7) // 8)
+        mx = int(needed.max(initial=0))
+        self._k = self._round(int(mx * self.headroom))
+        if mx <= k:
+            return host
+        # Under-predicted: complete ALL rows with one batched slice (a
+        # per-row fetch would pay the link's latency floor B times).
+        end = self._round(mx)
+        rest = np.asarray(buf[:, k:end])
+        return np.concatenate([host, rest], axis=1)
+
+    def fetch(self, buf) -> np.ndarray:
+        return self.finish(self.start(buf))
+
+
+_FETCHERS: dict = {}
+_FETCHERS_LOCK = __import__("threading").Lock()
+
+
+def wire_fetcher(H: int, W: int, cap: int) -> SparseWireFetcher:
+    """Process-wide fetcher per (tile shape, cap): prediction state is
+    shared across requests so the serving path warms up once."""
+    key = (H, W, cap)
+    with _FETCHERS_LOCK:
+        f = _FETCHERS.get(key)
+        if f is None:
+            f = _FETCHERS[key] = SparseWireFetcher(H, W, cap)
+        return f
 
 
 def default_sparse_cap(H: int, W: int) -> int:
@@ -243,7 +370,9 @@ def sparse_to_dense(buf: np.ndarray, H: int, W: int, cap: int):
     """Rebuild (y, cb, cr) dense coefficient blocks from one wire buffer.
 
     Returns None if the buffer overflowed ``cap`` (entries were dropped).
-    Pure-numpy; used by tests and the Python fallback encoder.
+    Pure-numpy; used by tests and the Python fallback encoder.  ``buf``
+    may be a prefix fetch: any length >= ``sparse_prefix_bytes(total)``
+    decodes.
     """
     # The wire buffer is packed for the 16-aligned (MCU-padded) grid, so
     # block counts use ceil — H/W may be the tile's true, unaligned size
@@ -255,12 +384,30 @@ def sparse_to_dense(buf: np.ndarray, H: int, W: int, cap: int):
     total = int(buf[:4].view(np.int32)[0])
     if total > cap:
         return None
+    need = 4 + nb + (ENTRY_BITS * total + 7) // 8
+    if len(buf) < need:
+        raise ValueError(
+            f"sparse buffer too short: {len(buf)} bytes < {need} needed")
     counts = buf[4:4 + nb].astype(np.int64)
-    ps = buf[4 + nb:4 + nb + cap]
-    vs = buf[4 + nb + cap:4 + nb + cap * 3].view("<i2")
+    if int(counts.sum()) != total:
+        raise ValueError("sparse buffer malformed: counts do not sum to "
+                         "total")
+    # Vectorized 18-bit field extraction: entry j lives MSB-first at bit
+    # 18j; read a 32-bit big-endian window at its byte and shift.
+    stream = np.pad(buf[4 + nb:], (0, 4)).astype(np.uint32)
+    j = np.arange(total)
+    bit = j * ENTRY_BITS
+    byte0 = bit >> 3
+    shift = bit & 7
+    window = ((stream[byte0] << 24) | (stream[byte0 + 1] << 16)
+              | (stream[byte0 + 2] << 8) | stream[byte0 + 3])
+    field = (window >> (32 - 18 - shift)) & 0x3FFFF
+    ps = (field >> 12).astype(np.int64)
+    vs = (field & 0xFFF).astype(np.int16)
+    vs = np.where(vs >= 2048, vs - 4096, vs).astype(np.int16)
     dense = np.zeros((nb, 64), np.int16)
     block_ids = np.repeat(np.arange(nb), counts)
-    dense[block_ids, ps[:total]] = vs[:total]
+    dense[block_ids, ps] = vs
     return (dense[:nb_y].reshape(nb_y, 64),
             dense[nb_y:nb_y + nb_c].reshape(nb_c, 64),
             dense[nb_y + nb_c:].reshape(nb_c, 64))
@@ -595,7 +742,6 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
     set) is entropy-coded from the top-left block subgrid on the host.
     Overflowing tiles re-run through the dense coefficient path.
     """
-    from ..native import SparseOverflowError
     B, C, H, W = raw.shape
     if cap is None:
         cap = default_sparse_cap(H, W)
@@ -604,15 +750,10 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
         raw, window_start, window_end, family, coefficient, reverse,
         cd_start, cd_end, tables, qy, qc, cap=cap)
     if hasattr(bufs, "copy_to_host_async"):
-        bufs.copy_to_host_async()   # overlap the wire with dispatch
-    bufs = np.asarray(bufs)
-    _encode = sparse_encoder()
-
-    from ..native import jpeg_native_available
-    if jpeg_native_available():
-        from ..native import jpeg_encode_native as _dense_encode
+        # Predictive prefix fetch: only the used bytes cross the link.
+        bufs = wire_fetcher(H, W, cap).fetch(bufs)
     else:
-        from ..jfif import encode_jfif as _dense_encode
+        bufs = np.asarray(bufs)
 
     def dense_coefficients(i):
         y, cb, cr = render_to_jpeg_coefficients(
@@ -623,6 +764,27 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
             cd_start, cd_end,
             tables[i:i + 1], qy, qc)
         return np.asarray(y)[0], np.asarray(cb)[0], np.asarray(cr)[0]
+
+    return finish_sparse_to_jpegs(bufs, dims, H, W, quality, cap,
+                                  dense_coefficients)
+
+
+def finish_sparse_to_jpegs(bufs, dims, H: int, W: int, quality: int,
+                           cap: int, dense_coefficients) -> list:
+    """Host tail of the sparse serving path: fetched wire rows -> JFIF.
+
+    ``dims`` gives each tile's true ``(width, height)``; tiles whose own
+    ceil-16 grid is smaller than the bucketed (H, W) are entropy-coded
+    from the top-left block subgrid, and tiles that overflowed ``cap``
+    re-render through ``dense_coefficients(i) -> (y, cb, cr)``.
+    """
+    from ..native import SparseOverflowError, jpeg_native_available
+
+    _encode = sparse_encoder()
+    if jpeg_native_available():
+        from ..native import jpeg_encode_native as _dense_encode
+    else:
+        from ..jfif import encode_jfif as _dense_encode
 
     out = []
     for i, (w_, h_) in enumerate(dims):

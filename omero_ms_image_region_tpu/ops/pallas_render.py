@@ -40,6 +40,21 @@ from .quantum import _ratio as _quantum_ratio
 _BLOCK_H = 256
 
 
+def pick_block_h(H: int, max_block: int = _BLOCK_H) -> int:
+    """Largest divisor of H at most ``max_block``.
+
+    The grid covers H in equal row blocks, so bh must divide H exactly;
+    the production buckets (256/512/1024/2048) all take ``max_block``,
+    while odd heights fall back to their largest small divisor (worst
+    case 1 for a large prime — correct, never fast; bucket such shapes
+    upstream).
+    """
+    bh = min(max_block, H)
+    while H % bh:
+        bh -= 1
+    return bh
+
+
 def _render_kernel(ws_ref, we_ref, fam_ref, coef_ref, rev_ref, cd_ref,
                    raw_ref, tables_ref, out_ref):
     """One (batch, row-block) grid step.
@@ -109,8 +124,7 @@ def render_tile_batch_packed_pallas(raw, window_start, window_end, family,
     tables f32[C, 256, 3].
     """
     B, C, H, W = raw.shape
-    bh = min(_BLOCK_H, H)
-    assert H % bh == 0, (H, bh)
+    bh = pick_block_h(H)
 
     # Pad table color axis 3 -> 128 so the MXU contraction output is
     # lane-aligned; dead columns contract to zeros.

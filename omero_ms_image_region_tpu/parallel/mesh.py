@@ -224,6 +224,123 @@ def render_jpeg_step_sharded(mesh: Mesh, quality: int = 85,
     return jax.jit(sharded)
 
 
+def _local_render_batched(raw, window_start, window_end, family,
+                          coefficient, reverse, cd_start, cd_end, tables):
+    """Per-device block with PER-TILE settings: raw f32[Bl, Cl, H, W],
+    settings [Bl, Cl], tables [Bl, Cl, ...].  The serving path's form —
+    concurrent requests carry their own windows/colors — where
+    :func:`_local_render` shares one setting vector across the batch.
+    Returns the partial per-component RGB sum f32[3, Bl, H, W]."""
+    Bl, Cl = raw.shape[:2]
+    q = quantize(
+        raw.reshape((-1,) + raw.shape[-2:]),
+        window_start.reshape(-1),
+        window_end.reshape(-1),
+        family.reshape(-1),
+        coefficient.reshape(-1),
+        cd_start,
+        cd_end,
+    ).reshape(raw.shape)
+    q = jnp.where(reverse[..., None, None] != 0, cd_start + cd_end - q, q)
+    if tables.ndim == 3:
+        qf = q.astype(jnp.float32)
+        comps = [
+            jnp.einsum("bchw,bc->bhw", qf, tables[..., comp])
+            for comp in range(3)
+        ]
+        return jnp.stack(comps, axis=0)
+    flat = tables.reshape(Bl * Cl * 256, 3)
+    offs = (jnp.arange(Bl * Cl, dtype=q.dtype) * 256).reshape(Bl, Cl, 1, 1)
+    idx = q + offs
+    comps = [
+        jnp.sum(jnp.take(flat[:, comp], idx, axis=0), axis=1)
+        for comp in range(3)
+    ]
+    return jnp.stack(comps, axis=0)
+
+
+# Batched-settings step: every per-channel array gains a leading batch
+# dim and shards with the tiles.
+_BATCHED_STEP_IN_SPECS = (
+    P("data", "chan"), P("data", "chan"), P("data", "chan"),
+    P("data", "chan"), P("data", "chan"), P("data", "chan"), P(), P(),
+    P("data", "chan"),
+)
+
+
+def _composite_step_batched(raw, window_start, window_end, family,
+                            coefficient, reverse, cd_start, cd_end,
+                            tables):
+    partial_rgb = _local_render_batched(
+        raw, window_start, window_end, family, coefficient, reverse,
+        cd_start, cd_end, tables)
+    rgb = jax.lax.psum(partial_rgb, axis_name="chan")
+    rgb = jnp.clip(jnp.round(rgb), 0.0, 255.0).astype(jnp.uint32)
+    return rgb[0] | (rgb[1] << 8) | (rgb[2] << 16) | jnp.uint32(0xFF000000)
+
+
+def render_step_sharded_batched(mesh: Mesh):
+    """Mesh-sharded render with per-tile settings -> u32[B, H, W]."""
+    sharded = shard_map(
+        _composite_step_batched,
+        mesh=mesh,
+        in_specs=_BATCHED_STEP_IN_SPECS,
+        out_specs=P("data"),
+    )
+    return jax.jit(sharded)
+
+
+def render_jpeg_step_sharded_batched(mesh: Mesh, quality: int = 85,
+                                     cap: int | None = None):
+    """Mesh-sharded serving step with per-tile settings: raw tiles ->
+    18-bit sparse JPEG wire buffers (``ops.jpegenc.sparse_pack`` layout),
+    data-sharded.  The per-request form of
+    :func:`render_jpeg_step_sharded`."""
+    from ..ops.jpegenc import (default_sparse_cap,
+                               packed_to_jpeg_coefficients, quant_tables,
+                               sparse_pack)
+
+    qy_h, qc_h = (np.asarray(t, np.int32) for t in quant_tables(quality))
+
+    def step(*args):
+        packed = _composite_step_batched(*args)
+        H, W = packed.shape[-2:]
+        local_cap = cap if cap is not None else default_sparse_cap(H, W)
+        y, cb, cr = packed_to_jpeg_coefficients(
+            packed, jnp.asarray(qy_h), jnp.asarray(qc_h))
+        return sparse_pack(y, cb, cr, local_cap)
+
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=_BATCHED_STEP_IN_SPECS,
+        out_specs=P("data"),
+    )
+    return jax.jit(sharded)
+
+
+def shard_batch_batched(mesh: Mesh, raw, stacked: dict):
+    """Device-put a batch with per-tile stacked settings onto the mesh.
+
+    ``stacked`` holds [B, C] settings arrays and [B, C, ...] tables (the
+    ``server.batcher`` group form).  Returns the argument tuple for the
+    batched sharded steps."""
+    put = jax.device_put
+    bc = NamedSharding(mesh, P("data", "chan"))
+    rep = NamedSharding(mesh, P())
+    return (
+        put(raw, bc),
+        put(stacked["window_start"], bc),
+        put(stacked["window_end"], bc),
+        put(stacked["family"], bc),
+        put(stacked["coefficient"], bc),
+        put(stacked["reverse"], bc),
+        put(np.int32(stacked["cd_start"]), rep),
+        put(np.int32(stacked["cd_end"]), rep),
+        put(stacked["tables"], bc),
+    )
+
+
 def shard_batch(mesh: Mesh, raw, settings):
     """Device-put a host batch + packed settings onto the mesh layout.
 

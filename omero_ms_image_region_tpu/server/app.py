@@ -82,7 +82,24 @@ def create_app(config: Optional[AppConfig] = None,
     config = config or AppConfig()
 
     if services is None:
-        if config.batcher.enabled:
+        if config.parallel.enabled:
+            # Mesh-sharded serving (≙ the reference's -cluster mode):
+            # groups dispatch through the (data, chan) mesh steps.
+            from ..parallel import cluster
+            from ..parallel.serve import MeshRenderer
+            if config.renderer.jpeg_engine != "sparse":
+                log.warning("renderer.jpeg-engine=%r ignored: the mesh "
+                            "renderer uses the sparse engine",
+                            config.renderer.jpeg_engine)
+            cluster.initialize()
+            mesh = cluster.global_mesh(
+                chan_parallel=config.parallel.chan_parallel,
+                n_devices=config.parallel.n_devices)
+            log.info("mesh serving enabled: %s", dict(mesh.shape))
+            renderer = MeshRenderer(
+                mesh, max_batch=config.batcher.max_batch,
+                linger_ms=config.batcher.linger_ms)
+        elif config.batcher.enabled:
             if config.renderer.jpeg_engine != "sparse":
                 log.warning("renderer.jpeg-engine=%r applies only to the "
                             "direct renderer; the batcher uses the sparse "
@@ -91,7 +108,8 @@ def create_app(config: Optional[AppConfig] = None,
                 max_batch=config.batcher.max_batch,
                 linger_ms=config.batcher.linger_ms)
         else:
-            renderer = Renderer(jpeg_engine=config.renderer.jpeg_engine)
+            renderer = Renderer(jpeg_engine=config.renderer.jpeg_engine,
+                                kernel=config.renderer.kernel)
         caches = Caches.from_config(config.caches)
         if config.caches.redis_uri and caches.redis is None:
             log.warning("redis package unavailable; redis cache tier and "
@@ -239,6 +257,25 @@ def create_app(config: Optional[AppConfig] = None,
 
     app = web.Application()
 
+    async def on_startup_metadata(app):
+        """Swap in the OMERO-DB metadata/ACL backend when configured
+        (≙ the backbone services the reference reaches over the bus,
+        ImageRegionRequestHandler.java:316-427).  Degrades to the local
+        backend with a warning when asyncpg is unavailable, the same
+        posture as the session stores."""
+        if config.metadata_backend != "postgres":
+            return
+        from ..services.db_metadata import PostgresMetadataService
+        try:
+            services.metadata = await PostgresMetadataService.connect(
+                config.metadata_dsn)
+            app["_db_metadata"] = services.metadata
+        except ImportError:
+            log.warning("metadata-service.type is 'postgres' but asyncpg "
+                        "is unavailable; using the local backend")
+
+    app.on_startup.append(on_startup_metadata)
+
     async def on_startup(app):
         # ≙ the reference's worker verticle pool sizing
         # (``worker_pool_size``, default 2 x cores,
@@ -265,6 +302,9 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):
+        db_meta = app.get("_db_metadata")
+        if db_meta is not None:
+            await db_meta.close()
         if isinstance(services.renderer, BatchingRenderer):
             await services.renderer.close()
         # Drain prefetch workers before the pixel stores close under them.

@@ -40,11 +40,37 @@ class RendererConfig:
     """Render path selection knobs."""
 
     # Renders of at most this many pixels take the CPU reference kernel
-    # (refimpl) instead of a device round trip.  0 disables.
-    cpu_fallback_max_px: int = 0
+    # (refimpl) instead of a device round trip.  0 disables.  Default is
+    # the measured break-even: at 256x256 single-channel the host kernel
+    # (~2 ms) matches co-located dispatch+fetch overhead and beats any
+    # network-attached device by orders of magnitude; beyond it batched
+    # device renders win.  Tunnel-attached deployments (device RTT in the
+    # 100 ms class) may want this much larger.
+    cpu_fallback_max_px: int = 256 * 256
     # Device JPEG wire format: "sparse" (coefficients + host entropy
     # coding) or "bitpack" (device-packed Huffman; fast-link deployments).
     jpeg_engine: str = "sparse"
+    # Render kernel for the direct (unbatched) renderer: "xla" (the
+    # fused gather kernel) or "pallas" (the one-hot-MXU VMEM kernel,
+    # ops.pallas_render; interpret mode off-TPU).
+    kernel: str = "xla"
+
+
+@dataclass
+class ParallelConfig:
+    """Mesh-sharded serving (≙ the reference's ``-cluster`` mode:
+    Hazelcast-clustered worker verticles,
+    ``ImageRegionMicroserviceVerticle.java:406-424``).  When enabled the
+    service renders every coalesced group through a ``(data, chan)``
+    ``jax.sharding.Mesh`` — tiles data-parallel, channels optionally
+    tensor-parallel with a ``psum`` composite over ICI."""
+
+    enabled: bool = False
+    chan_parallel: int = 1
+    # None = every visible device (multi-host: the whole slice via
+    # jax.distributed).  A number requests that mesh width, falling back
+    # to the virtual host mesh when the default platform is narrower.
+    n_devices: Optional[int] = None
 
 
 @dataclass
@@ -83,12 +109,18 @@ class AppConfig:
     session_store_type: Optional[str] = None   # redis | postgres | static
     session_store_uri: Optional[str] = None
     lut_root: Optional[str] = None         # omero.script_repo_root analogue
+    # Metadata/ACL backend: "local" (filesystem acl.json + meta.json) or
+    # "postgres" (OMERO-schema DB, ≙ the backbone services the reference
+    # reaches over the bus — ImageRegionRequestHandler.java:316-427).
+    metadata_backend: str = "local"
+    metadata_dsn: Optional[str] = None
     caches: CacheConfig = field(default_factory=CacheConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
     raw_cache: RawCacheConfig = field(default_factory=RawCacheConfig)
     renderer: RendererConfig = field(default_factory=RendererConfig)
     http: HttpConfig = field(default_factory=HttpConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
 
     @classmethod
     def from_yaml(cls, path: str) -> "AppConfig":
@@ -137,6 +169,16 @@ class AppConfig:
         store = raw.get("session-store", {}) or {}
         cfg.session_store_type = store.get("type")
         cfg.session_store_uri = store.get("uri")
+        meta = raw.get("metadata-service", {}) or {}
+        cfg.metadata_backend = str(meta.get("type", cfg.metadata_backend))
+        cfg.metadata_dsn = meta.get("dsn")
+        if cfg.metadata_backend not in ("local", "postgres"):
+            raise ValueError(
+                "metadata-service.type must be 'local' or 'postgres', "
+                f"got {cfg.metadata_backend!r}")
+        if cfg.metadata_backend == "postgres" and not cfg.metadata_dsn:
+            raise ValueError("metadata-service.type 'postgres' requires "
+                             "a dsn")
 
         redis_cache = raw.get("redis-cache", {}) or {}
         cfg.caches = CacheConfig(
@@ -162,6 +204,17 @@ class AppConfig:
             max_bytes=int(rc.get("max-bytes", rc_defaults.max_bytes)),
             prefetch=bool(rc.get("prefetch", rc_defaults.prefetch)),
         )
+        par = raw.get("parallel", {}) or {}
+        par_defaults = ParallelConfig()
+        cfg.parallel = ParallelConfig(
+            enabled=bool(par.get("enabled", par_defaults.enabled)),
+            chan_parallel=int(par.get("chan-parallel",
+                                      par_defaults.chan_parallel)),
+            n_devices=(int(par["n-devices"])
+                       if par.get("n-devices") is not None else None),
+        )
+        if cfg.parallel.chan_parallel < 1:
+            raise ValueError("parallel.chan-parallel must be >= 1")
         rd = raw.get("renderer", {}) or {}
         rd_defaults = RendererConfig()
         cfg.renderer = RendererConfig(
@@ -169,9 +222,14 @@ class AppConfig:
                 "cpu-fallback-max-px", rd_defaults.cpu_fallback_max_px)),
             jpeg_engine=str(rd.get("jpeg-engine",
                                    rd_defaults.jpeg_engine)),
+            kernel=str(rd.get("kernel", rd_defaults.kernel)),
         )
         if cfg.renderer.jpeg_engine not in ("sparse", "bitpack"):
             raise ValueError(
                 f"renderer.jpeg-engine must be 'sparse' or 'bitpack', "
                 f"got {cfg.renderer.jpeg_engine!r}")
+        if cfg.renderer.kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"renderer.kernel must be 'xla' or 'pallas', "
+                f"got {cfg.renderer.kernel!r}")
         return cfg

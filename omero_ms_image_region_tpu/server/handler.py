@@ -64,10 +64,14 @@ class Renderer:
     # the cache is a small LRU, not an unbounded dict.
     _MAX_BITPACK_ENCODERS = 8
 
-    def __init__(self, jpeg_engine: str = "sparse"):
+    def __init__(self, jpeg_engine: str = "sparse",
+                 kernel: str = "xla"):
         if jpeg_engine not in ("sparse", "bitpack"):
             raise ValueError(f"unknown jpeg engine {jpeg_engine!r}")
+        if kernel not in ("xla", "pallas"):
+            raise ValueError(f"unknown render kernel {kernel!r}")
         self.jpeg_engine = jpeg_engine
+        self.kernel = kernel
         import threading
         from collections import OrderedDict
         self._bitpack_encoders: "OrderedDict" = OrderedDict()
@@ -81,6 +85,8 @@ class Renderer:
         return await asyncio.to_thread(self._render_sync, raw, settings)
 
     def _render_sync(self, raw: np.ndarray, settings: dict) -> np.ndarray:
+        if self.kernel == "pallas":
+            return self._render_sync_pallas(raw, settings)
         out = render_tile_packed(
             raw, settings["window_start"], settings["window_end"],
             settings["family"], settings["coefficient"],
@@ -88,6 +94,32 @@ class Renderer:
             settings["tables"],
         )
         return np.asarray(out)
+
+    def _render_sync_pallas(self, raw: np.ndarray,
+                            settings: dict) -> np.ndarray:
+        """The Pallas one-hot-MXU kernel (``ops.pallas_render``) for the
+        direct render path.  Selected via ``renderer.kernel: pallas``; it
+        needs full color tables (ramp weights expand exactly: the folded
+        table at index q is q * weight) and per-request settings arrive
+        unbatched, which is precisely the kernel's contract.  Off-TPU
+        backends run it in interpreter mode so the config stays testable
+        anywhere.
+        """
+        import jax
+
+        from ..ops.pallas_render import render_tile_batch_packed_pallas
+
+        tables = settings["tables"]
+        if tables.ndim == 2:      # ramp weights [C, 3] -> full tables
+            tables = (np.arange(256, dtype=np.float32)[None, :, None]
+                      * np.asarray(tables, np.float32)[:, None, :])
+        out = render_tile_batch_packed_pallas(
+            np.ascontiguousarray(raw, np.float32)[None],
+            settings["window_start"], settings["window_end"],
+            settings["family"], settings["coefficient"],
+            settings["reverse"], settings["cd_start"], settings["cd_end"],
+            tables, interpret=jax.default_backend() != "tpu")
+        return np.asarray(out)[0]
 
     async def render_jpeg(self, raw: np.ndarray, settings: dict,
                           quality: int, width: int, height: int) -> bytes:
@@ -159,8 +191,10 @@ class ImageRegionServices:
     # Renders at or below this pixel count take the CPU reference kernel
     # (refimpl) instead of a device round trip — the SURVEY north star's
     # fallback path, and a latency win for tiny tiles anywhere the
-    # dispatch+fetch overhead exceeds host compute.  0 disables.
-    cpu_fallback_max_px: int = 0
+    # dispatch+fetch overhead exceeds host compute.  0 disables.  The
+    # served default comes from server.config.RendererConfig (256x256,
+    # the measured break-even).
+    cpu_fallback_max_px: int = 256 * 256
 
 
 def _restrict_to_active(rdef: RenderingDef) -> Tuple[RenderingDef, List[int]]:

@@ -1,0 +1,293 @@
+"""Compare device implementations of the sparse stream compaction."""
+
+import functools
+import statistics
+import time
+
+import numpy as np
+
+from omero_ms_image_region_tpu.flagship import (
+    batched_args, flagship_settings, synthetic_wsi_tiles,
+)
+from omero_ms_image_region_tpu.ops.jpegenc import (
+    default_sparse_cap, quant_tables, render_to_jpeg_coefficients,
+)
+
+import jax
+import jax.numpy as jnp
+
+
+def sync(x):
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    np.asarray(leaf.ravel()[:1])
+
+
+def t(fn, n=4):
+    fn()
+    xs = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        xs.append((time.perf_counter() - t0) * 1e3)
+    return min(xs)
+
+
+def make_flat(B=8, H=1024, W=1024):
+    rng = np.random.default_rng(7)
+    C = 4
+    _, settings = flagship_settings()
+    raw = synthetic_wsi_tiles(rng, B, C, H, W)
+    args_suffix = batched_args(settings, raw)[1:]
+    qy, qc = (tt.astype(np.int32) for tt in quant_tables(85))
+    y, cb, cr = render_to_jpeg_coefficients(
+        jax.device_put(raw), *args_suffix, qy, qc)
+    flat = jnp.concatenate(
+        [y.reshape(B, -1), cb.reshape(B, -1), cr.reshape(B, -1)], axis=1)
+    flat.block_until_ready()
+    return np.asarray(flat)  # host i16 [B, N]
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def pack_scatter(flat, cap: int):
+    B, N = flat.shape
+    nb = N // 64
+    mask = flat != 0
+    counts = mask.reshape(B, nb, 64).sum(-1).astype(jnp.uint8)
+    wi = jnp.cumsum(mask, axis=1) - 1
+    pos = (jnp.arange(N, dtype=jnp.int32) % 64).astype(jnp.uint8)
+
+    def one(m, w, v):
+        tgt = jnp.where(m & (w < cap), w, cap)
+        p = jnp.zeros(cap + 1, jnp.uint8).at[tgt].set(pos, mode="drop")
+        vv = jnp.zeros(cap + 1, jnp.int16).at[tgt].set(v, mode="drop")
+        return p[:cap], vv[:cap]
+
+    ps, vs = jax.vmap(one)(mask, wi, flat)
+    return ps, vs, counts
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def pack_blocksort(flat, cap: int):
+    """Per-block 64-lane sort compaction + block-offset binary search."""
+    B, N = flat.shape
+    nb = N // 64
+    blocks = flat.reshape(B, nb, 64).astype(jnp.int32)
+    mask = blocks != 0
+    counts = mask.sum(-1)                              # [B, nb] i32
+    pos = jnp.arange(64, dtype=jnp.int32)
+    # Pack (zero-flag, pos, value) into one u32 so one sort carries all:
+    # key bits [22]=zero flag, [21:16]=pos, [15:0]=value.
+    key = (jnp.where(mask, 0, 1 << 22)
+           | (pos << 16)
+           | (blocks & 0xFFFF)).astype(jnp.int32)
+    srt = jax.lax.sort(key, dimension=-1)              # [B, nb, 64]
+    stage_pos = ((srt >> 16) & 0x3F).astype(jnp.uint8)
+    stage_val = (srt & 0xFFFF).astype(jnp.uint16).astype(jnp.int16)
+
+    S = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.int32), jnp.cumsum(counts, axis=1)], axis=1)
+    qs = jnp.arange(cap, dtype=jnp.int32)
+
+    def one(S_row, sp, sv):
+        # rightmost block with S[b] <= j  (15-step binary search over S)
+        lo = jnp.zeros(cap, jnp.int32)
+        hi = jnp.full((cap,), nb, jnp.int32)
+        for _ in range(int(np.ceil(np.log2(nb + 1)))):
+            mid = (lo + hi + 1) >> 1
+            go = S_row[mid] <= qs
+            lo = jnp.where(go, mid, lo)
+            hi = jnp.where(go, hi, mid - 1)
+        b = lo
+        r = qs - S_row[b]
+        f = b * 64 + r
+        valid = qs < S_row[-1]
+        f = jnp.where(valid, f, 0)
+        return (jnp.where(valid, sp.reshape(-1)[f], 0),
+                jnp.where(valid, sv.reshape(-1)[f], 0))
+
+    ps, vs = jax.vmap(one)(S, stage_pos, stage_val)
+    return ps, vs, counts.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def pack_searchsorted(flat, cap: int):
+    B, N = flat.shape
+    nb = N // 64
+    mask = flat != 0
+    counts = mask.reshape(B, nb, 64).sum(-1).astype(jnp.uint8)
+    c = jnp.cumsum(mask.astype(jnp.int32), axis=1)
+    ranks = jnp.arange(1, cap + 1, dtype=jnp.int32)
+
+    def one(c_row, v_row):
+        src = jnp.searchsorted(c_row, ranks, side="left")
+        valid = src < N
+        src = jnp.minimum(src, N - 1)
+        p = jnp.where(valid, src % 64, 0).astype(jnp.uint8)
+        v = jnp.where(valid, v_row[src], 0).astype(jnp.int16)
+        return p, v
+
+    ps, vs = jax.vmap(one)(c, flat)
+    return ps, vs, counts
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def pack_blockscatter(flat, cap: int):
+    """Per-block 64-lane sort + windowed scatter-add of 64-wide rows."""
+    B, N = flat.shape
+    nb = N // 64
+    blocks = flat.reshape(B, nb, 64).astype(jnp.int32)
+    mask = blocks != 0
+    counts = mask.sum(-1)                              # [B, nb] i32
+    pos = jnp.arange(64, dtype=jnp.int32)
+    key = (jnp.where(mask, 0, 1 << 22)
+           | (pos << 16)
+           | (blocks & 0xFFFF)).astype(jnp.int32)
+    srt = jax.lax.sort(key, dimension=-1)              # [B, nb, 64]
+    lane = jnp.arange(64, dtype=jnp.int32)
+    staged = jnp.where(lane < counts[..., None], srt, 0)
+
+    S = jnp.cumsum(counts, axis=1) - counts            # exclusive [B, nb]
+
+    def one(S_row, st):
+        out = jnp.zeros(cap + 64, jnp.int32)
+        out = out.at[S_row[:, None] + lane[None, :]].add(st, mode="drop")
+        return out[:cap]
+
+    out32 = jax.vmap(one)(S, staged)
+    ps = ((out32 >> 16) & 0x3F).astype(jnp.uint8)
+    vs = (out32 & 0xFFFF).astype(jnp.uint16).astype(jnp.int16)
+    return ps, vs, counts.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def pack_blockscatter_win(flat, cap: int):
+    """Like blockscatter but a true windowed scatter (indices [nb, 1])."""
+    import jax.lax as lax
+    B, N = flat.shape
+    nb = N // 64
+    blocks = flat.reshape(B, nb, 64).astype(jnp.int32)
+    mask = blocks != 0
+    counts = mask.sum(-1)
+    pos = jnp.arange(64, dtype=jnp.int32)
+    key = (jnp.where(mask, 0, 1 << 22)
+           | (pos << 16)
+           | (blocks & 0xFFFF)).astype(jnp.int32)
+    srt = jax.lax.sort(key, dimension=-1)
+    lane = jnp.arange(64, dtype=jnp.int32)
+    staged = jnp.where(lane < counts[..., None], srt, 0)
+    S = jnp.cumsum(counts, axis=1) - counts
+
+    dn = lax.ScatterDimensionNumbers(
+        update_window_dims=(1,), inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(0,))
+
+    def one(S_row, st):
+        out = jnp.zeros(cap + 64, jnp.int32)
+        out = lax.scatter_add(out, S_row[:, None], st, dn,
+                              mode=lax.GatherScatterMode.FILL_OR_DROP)
+        return out[:cap]
+
+    out32 = jax.vmap(one)(S, staged)
+    ps = ((out32 >> 16) & 0x3F).astype(jnp.uint8)
+    vs = (out32 & 0xFFFF).astype(jnp.uint16).astype(jnp.int16)
+    return ps, vs, counts.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def pack_scatter_unique(flat, cap: int):
+    """One combined u32 set-scatter, unique targets, OOB-dropped tails."""
+    B, N = flat.shape
+    nb = N // 64
+    mask = flat != 0
+    counts = mask.reshape(B, nb, 64).sum(-1).astype(jnp.uint8)
+    wi = jnp.cumsum(mask, axis=1) - 1
+    pos = (jnp.arange(N, dtype=jnp.int32) % 64)
+    comb = (pos << 16) | (flat.astype(jnp.int32) & 0xFFFF)
+
+    def one(m, w, v):
+        tgt = jnp.where(m & (w < cap), w, jnp.int32(1 << 30))
+        out = jnp.zeros(cap, jnp.int32).at[tgt].set(
+            v, mode="drop", unique_indices=True)
+        return out
+
+    out32 = jax.vmap(one)(mask, wi, comb)
+    ps = ((out32 >> 16) & 0x3F).astype(jnp.uint8)
+    vs = (out32 & 0xFFFF).astype(jnp.uint16).astype(jnp.int16)
+    return ps, vs, counts
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def pack_blockscatter_unique(flat, cap: int):
+    """Sorted staging + ascending unique set-scatter."""
+    B, N = flat.shape
+    nb = N // 64
+    blocks = flat.reshape(B, nb, 64).astype(jnp.int32)
+    mask = blocks != 0
+    counts = mask.sum(-1)
+    pos = jnp.arange(64, dtype=jnp.int32)
+    key = (jnp.where(mask, 0, 1 << 22)
+           | (pos << 16)
+           | (blocks & 0xFFFF)).astype(jnp.int32)
+    srt = jax.lax.sort(key, dimension=-1)
+    lane = jnp.arange(64, dtype=jnp.int32)
+    S = jnp.cumsum(counts, axis=1) - counts
+
+    def one(S_row, st, c_row):
+        valid = lane[None, :] < c_row[:, None]
+        tgt = jnp.where(valid, S_row[:, None] + lane[None, :],
+                        jnp.int32(1 << 30))
+        out = jnp.zeros(cap, jnp.int32).at[tgt.reshape(-1)].set(
+            st.reshape(-1), mode="drop", unique_indices=True)
+        return out
+
+    out32 = jax.vmap(one)(S, srt, counts)
+    ps = ((out32 >> 16) & 0x3F).astype(jnp.uint8)
+    vs = (out32 & 0xFFFF).astype(jnp.uint16).astype(jnp.int16)
+    return ps, vs, counts.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit)
+def sort_only(flat):
+    B, N = flat.shape
+    nb = N // 64
+    blocks = flat.reshape(B, nb, 64).astype(jnp.int32)
+    mask = blocks != 0
+    pos = jnp.arange(64, dtype=jnp.int32)
+    key = (jnp.where(mask, 0, 1 << 22) | (pos << 16)
+           | (blocks & 0xFFFF)).astype(jnp.int32)
+    return jax.lax.sort(key, dimension=-1)
+
+
+def check(name, fn, flat, cap, ref):
+    ps, vs, counts = [np.asarray(a) for a in fn(jax.device_put(flat), cap)]
+    rps, rvs, rcounts = ref
+    tot = int(rcounts.astype(np.int64).sum(1)[0])
+    ok = (np.array_equal(ps[0, :tot], rps[0, :tot])
+          and np.array_equal(vs[0, :tot], rvs[0, :tot]))
+    print(f"{name}: match={ok}")
+
+
+def main():
+    flat = make_flat()
+    cap = default_sparse_cap(1024, 1024)
+    dev = jax.device_put(flat)
+    sync(dev)
+
+    ref = [np.asarray(a) for a in pack_scatter(dev, cap)]
+    check("blocksort", pack_blocksort, flat, cap, ref)
+    check("searchsorted", pack_searchsorted, flat, cap, ref)
+    check("blockscatter", pack_blockscatter, flat, cap, ref)
+    check("scatter_unique", pack_scatter_unique, flat, cap, ref)
+    check("blockscatter_unique", pack_blockscatter_unique, flat, cap, ref)
+
+    print("sort_only: %.1f ms" % t(lambda: sync(sort_only(dev))))
+    for name, fn in (("scatter", pack_scatter),
+                     ("blockscatter", pack_blockscatter),
+                     ("scatter_unique", pack_scatter_unique),
+                     ("blockscatter_unique", pack_blockscatter_unique)):
+        ms = t(lambda fn=fn: sync(fn(dev, cap)))
+        print(f"{name}: {ms:7.1f} ms for B=8 ({ms/8:5.1f} ms/tile)")
+
+
+if __name__ == "__main__":
+    main()

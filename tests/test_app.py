@@ -11,7 +11,9 @@ from omero_ms_image_region_tpu import codecs
 from omero_ms_image_region_tpu.io.store import build_pyramid
 from omero_ms_image_region_tpu.models.mask import Mask
 from omero_ms_image_region_tpu.server.app import create_app
-from omero_ms_image_region_tpu.server.config import AppConfig, BatcherConfig
+from omero_ms_image_region_tpu.server.config import (AppConfig,
+                                                     BatcherConfig,
+                                                     RendererConfig)
 from omero_ms_image_region_tpu.services.metadata import write_mask
 
 IMG, MASK = 7, 5
@@ -113,8 +115,10 @@ class TestMetrics:
         )
         assert s1 == 200 and status == 200
         text = body.decode()
-        assert 'imageregion_span_count{span="Renderer.renderAsPackedInt"}' \
-            in text
+        # The 64x64 render takes the default tiny-tile CPU fallback, whose
+        # span keeps the reference's name with a .cpu suffix.
+        assert ('imageregion_span_count{span="Renderer.renderAsPackedInt'
+                in text)
         assert "imageregion_cache_hits" in text
 
 
@@ -183,8 +187,12 @@ class TestStatusMapping:
 def _gather_requests(data_dir, paths):
     """Boot the batched app, issue ``paths`` concurrently, return
     (bodies, content_types, renderer)."""
-    config = AppConfig(data_dir=data_dir,
-                       batcher=BatcherConfig(enabled=True, linger_ms=5.0))
+    config = AppConfig(
+        data_dir=data_dir,
+        batcher=BatcherConfig(enabled=True, linger_ms=5.0),
+        # These tests use tiny tiles but exist to exercise the batched
+        # device path; keep the tiny-render CPU fallback out of the way.
+        renderer=RendererConfig(cpu_fallback_max_px=0))
 
     async def main():
         app = create_app(config)

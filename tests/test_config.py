@@ -90,6 +90,18 @@ class TestAppConfig:
                     h.close()
             root.handlers = saved
 
+    def test_metadata_service_block(self):
+        import pytest
+        cfg = AppConfig.from_dict({"metadata-service": {
+            "type": "postgres", "dsn": "postgresql://u@h/db"}})
+        assert cfg.metadata_backend == "postgres"
+        assert cfg.metadata_dsn == "postgresql://u@h/db"
+        assert AppConfig.from_dict({}).metadata_backend == "local"
+        with pytest.raises(ValueError):
+            AppConfig.from_dict({"metadata-service": {"type": "postgres"}})
+        with pytest.raises(ValueError):
+            AppConfig.from_dict({"metadata-service": {"type": "nope"}})
+
     def test_cache_flags_and_redis_uri(self):
         cfg = AppConfig.from_dict({
             "redis-cache": {"uri": "redis://x:1/0"},

@@ -1,0 +1,237 @@
+"""DbMetadataService against a seeded OMERO-schema subset (sqlite).
+
+The service's SQL is written for asyncpg/PostgreSQL; the adapter here
+translates only the placeholder style ($N -> ?) so the very same
+statements execute against sqlite — an e2e check of the queries, the
+group-permission ACL bits, and the session resolution, without a live
+OMERO database (this image ships no Postgres driver or server;
+``PostgresMetadataService.connect`` stays gated on asyncpg).
+"""
+
+import asyncio
+import re
+import sqlite3
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.services.db_metadata import (
+    DbMetadataService, GROUP_READ, USER_READ, WORLD_READ,
+)
+
+# Canonical OMERO permission longs (ome.model.internal.Permissions).
+PRIVATE = -120        # rw----
+GROUP_RO = -56        # rwr---
+PUBLIC_RO = -52       # rwr-r-
+
+
+class SqliteDb:
+    """fetchrow/fetch over sqlite with $N -> ? placeholder translation."""
+
+    def __init__(self, conn: sqlite3.Connection):
+        conn.row_factory = sqlite3.Row
+        self.conn = conn
+
+    @staticmethod
+    def _translate(sql: str) -> str:
+        return re.sub(r"\$\d+", "?", sql)
+
+    async def fetchrow(self, sql: str, *args):
+        cur = self.conn.execute(self._translate(sql), args)
+        row = cur.fetchone()
+        return None if row is None else dict(row)
+
+    async def fetch(self, sql: str, *args):
+        cur = self.conn.execute(self._translate(sql), args)
+        return [dict(r) for r in cur.fetchall()]
+
+
+SCHEMA = """
+CREATE TABLE experimentergroup (
+    id INTEGER PRIMARY KEY, name TEXT, permissions INTEGER);
+CREATE TABLE experimenter (id INTEGER PRIMARY KEY, omename TEXT);
+CREATE TABLE groupexperimentermap (child INTEGER, parent INTEGER);
+CREATE TABLE session (
+    id INTEGER PRIMARY KEY, uuid TEXT, owner INTEGER, closed TEXT);
+CREATE TABLE image (
+    id INTEGER PRIMARY KEY, owner_id INTEGER, group_id INTEGER);
+CREATE TABLE pixelstype (id INTEGER PRIMARY KEY, value TEXT);
+CREATE TABLE pixels (
+    id INTEGER PRIMARY KEY, image INTEGER, sizex INTEGER, sizey INTEGER,
+    sizez INTEGER, sizec INTEGER, sizet INTEGER, pixelstype INTEGER);
+CREATE TABLE roi (id INTEGER PRIMARY KEY, image INTEGER);
+CREATE TABLE shape (
+    id INTEGER PRIMARY KEY, roi INTEGER, owner_id INTEGER,
+    group_id INTEGER, width INTEGER, height INTEGER, bytes BLOB,
+    fillcolor INTEGER);
+"""
+
+MASK_BITS = np.packbits(
+    np.tile([1, 0], 16 * 8 // 2).astype(np.uint8)).tobytes()
+
+
+@pytest.fixture()
+def db():
+    conn = sqlite3.connect(":memory:")
+    conn.executescript(SCHEMA)
+    conn.executemany(
+        "INSERT INTO experimentergroup VALUES (?, ?, ?)",
+        [(0, "system", PRIVATE),
+         (10, "lab-private", PRIVATE),
+         (11, "lab-shared", GROUP_RO),
+         (12, "atlas-public", PUBLIC_RO)])
+    conn.executemany(
+        "INSERT INTO experimenter VALUES (?, ?)",
+        [(100, "owner"), (101, "labmate"), (102, "outsider"),
+         (103, "root")])
+    conn.executemany(
+        "INSERT INTO groupexperimentermap VALUES (?, ?)",
+        [(100, 10), (100, 11), (100, 12),
+         (101, 10), (101, 11),
+         (102, 12),
+         (103, 0)])
+    conn.executemany(
+        "INSERT INTO session VALUES (?, ?, ?, ?)",
+        [(1, "sess-owner", 100, None),
+         (2, "sess-labmate", 101, None),
+         (3, "sess-outsider", 102, None),
+         (4, "sess-root", 103, None),
+         (5, "sess-closed", 100, "2026-01-01 00:00:00")])
+    conn.executemany(
+        "INSERT INTO image VALUES (?, ?, ?)",
+        [(1, 100, 10),     # private image
+         (2, 100, 11),     # group-readable image
+         (3, 100, 12)])    # world-readable image
+    conn.execute("INSERT INTO pixelstype VALUES (1, 'uint16')")
+    conn.execute("INSERT INTO pixelstype VALUES (2, 'uint8')")
+    conn.executemany(
+        "INSERT INTO pixels VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        [(50, 1, 4096, 4096, 16, 4, 1, 1),
+         (51, 2, 512, 256, 1, 3, 1, 2)])
+    conn.execute("INSERT INTO roi VALUES (7, 2)")
+    # mask on the group-readable image; fillcolor = RGBA 0x00FF00FF
+    conn.execute(
+        "INSERT INTO shape VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        (70, 7, 100, 11, 16, 8, MASK_BITS, 0x00FF00FF))
+    # mask with no fillcolor in the private group
+    conn.execute(
+        "INSERT INTO shape VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+        (71, 7, 100, 10, 16, 8, MASK_BITS, None))
+    conn.commit()
+    return SqliteDb(conn)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestPermissionBits:
+    def test_documented_longs_decode(self):
+        assert PRIVATE & USER_READ and not PRIVATE & GROUP_READ
+        assert GROUP_RO & GROUP_READ and not GROUP_RO & WORLD_READ
+        assert PUBLIC_RO & WORLD_READ
+
+
+class TestCanRead:
+    @pytest.mark.parametrize("image_id,session,expect", [
+        (1, "sess-owner", True),      # owner reads own private image
+        (1, "sess-labmate", False),   # member, but group is rw----
+        (1, "sess-outsider", False),
+        (1, "sess-root", True),       # admin reads everything
+        (1, None, False),
+        (2, "sess-owner", True),
+        (2, "sess-labmate", True),    # member of rwr--- group
+        (2, "sess-outsider", False),  # non-member, no world read
+        (2, None, False),
+        (3, "sess-outsider", True),   # member of public group
+        (3, None, True),              # anonymous world read
+    ])
+    def test_image_acl(self, db, image_id, session, expect):
+        svc = DbMetadataService(db)
+        assert run(svc.can_read("Image", image_id, session)) is expect
+
+    def test_closed_session_is_anonymous(self, db):
+        svc = DbMetadataService(db)
+        assert run(svc.can_read("Image", 1, "sess-closed")) is False
+        assert run(svc.can_read("Image", 3, "sess-closed")) is True
+
+    def test_unknown_object_is_unreadable(self, db):
+        svc = DbMetadataService(db)
+        assert run(svc.can_read("Image", 999, "sess-root")) is False
+
+
+class TestPixels:
+    def test_resolves_geometry_and_type(self, db):
+        svc = DbMetadataService(db)
+        px = run(svc.get_pixels_description(1, "sess-owner"))
+        assert (px.size_x, px.size_y, px.size_z, px.size_c, px.size_t) \
+            == (4096, 4096, 16, 4, 1)
+        assert px.pixels_type == "uint16"
+        assert px.type.np_dtype == np.dtype("uint16")
+
+    def test_acl_gates_pixels(self, db):
+        svc = DbMetadataService(db)
+        assert run(svc.get_pixels_description(1, "sess-labmate")) is None
+        assert run(svc.get_pixels_description(2, "sess-labmate")) \
+            is not None
+
+
+class TestMask:
+    def test_mask_with_fillcolor(self, db):
+        svc = DbMetadataService(db)
+        mask = run(svc.get_mask(70, "sess-labmate"))
+        assert (mask.width, mask.height) == (16, 8)
+        assert mask.bytes_ == MASK_BITS
+        assert mask.fill_color == (0, 255, 0, 255)
+
+    def test_mask_without_fillcolor(self, db):
+        svc = DbMetadataService(db)
+        mask = run(svc.get_mask(71, "sess-owner"))
+        assert mask.fill_color is None
+
+    def test_mask_acl(self, db):
+        svc = DbMetadataService(db)
+        assert run(svc.get_mask(71, "sess-labmate")) is None  # rw---- group
+        assert run(svc.get_mask(70, "sess-outsider")) is None
+
+
+class TestHandlerIntegration:
+    def test_image_handler_serves_via_db_metadata(self, db, tmp_path):
+        """The HTTP handler stack runs unchanged on the DB backend."""
+        from omero_ms_image_region_tpu.io.store import build_pyramid
+
+        rng = np.random.default_rng(3)
+        planes = rng.integers(0, 60000, (3, 1, 64, 64)).astype(np.uint16)
+        build_pyramid(planes, str(tmp_path / "2"), n_levels=1)
+
+        from omero_ms_image_region_tpu.io.service import PixelsService
+        from omero_ms_image_region_tpu.ops.lut import LutProvider
+        from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+        from omero_ms_image_region_tpu.server.handler import (
+            ImageRegionHandler, ImageRegionServices, NotFoundError, Renderer)
+        from omero_ms_image_region_tpu.services.cache import (
+            CacheConfig, Caches)
+        from omero_ms_image_region_tpu.services.metadata import CanReadMemo
+
+        services = ImageRegionServices(
+            pixels_service=PixelsService(str(tmp_path)),
+            metadata=DbMetadataService(db),
+            caches=Caches.from_config(CacheConfig()),
+            can_read_memo=CanReadMemo(),
+            renderer=Renderer(),
+            lut_provider=LutProvider(),
+        )
+        handler = ImageRegionHandler(services)
+        ctx = ImageRegionCtx.from_params(
+            {"imageId": "2", "theZ": "0", "theT": "0",
+             "tile": "0,0,0,32,32", "m": "c", "c": "1|0:60000$FF0000"},
+            "sess-labmate")
+        body = run(handler.render_image_region(ctx))
+        assert body[:2] == b"\xff\xd8"
+
+        denied = ImageRegionCtx.from_params(
+            {"imageId": "2", "theZ": "0", "theT": "0",
+             "tile": "0,0,0,32,32", "m": "c", "c": "1|0:60000$FF0000"},
+            "sess-outsider")
+        with pytest.raises(NotFoundError):
+            run(handler.render_image_region(denied))

@@ -73,6 +73,7 @@ def test_prefetcher_stages_neighbor_tiles(tmp_path):
         lut_provider=LutProvider(),
         raw_cache=cache,
         prefetcher=prefetcher,
+        cpu_fallback_max_px=0,   # small test tiles must use the device path
     )
     handler = ImageRegionHandler(services)
     ctx = ImageRegionCtx.from_params({
@@ -133,6 +134,7 @@ def test_settings_change_rerenders_from_device(tmp_path):
         renderer=Renderer(),
         lut_provider=LutProvider(),
         raw_cache=cache,
+        cpu_fallback_max_px=0,   # small test tiles must use the device path
     )
     handler = ImageRegionHandler(services)
 

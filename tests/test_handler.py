@@ -53,6 +53,10 @@ def services(data_dir):
         can_read_memo=CanReadMemo(),
         renderer=Renderer(),
         lut_provider=LutProvider(),
+        # Tests use small tiles; disable the tiny-render CPU fallback so
+        # the device kernel path stays exercised (the fallback has its own
+        # dedicated test).
+        cpu_fallback_max_px=0,
     )
 
 

@@ -15,8 +15,9 @@ from PIL import Image
 
 from omero_ms_image_region_tpu.jfif import build_huffman_table, encode_jfif
 from omero_ms_image_region_tpu.ops.jpegenc import (
-    dct_matrix, encode_tiles_jpeg, packed_to_jpeg_coefficients, pad_to_mcu,
-    quant_tables, sparse_pack, sparse_to_dense, zigzag_order,
+    dct_matrix, encode_tiles_jpeg, max_sparse_cap,
+    packed_to_jpeg_coefficients, pad_to_mcu, quant_tables, sparse_pack,
+    sparse_to_dense, zigzag_order,
 )
 
 from omero_ms_image_region_tpu.native import (
@@ -170,6 +171,52 @@ def test_sparse_to_dense_accepts_unaligned_true_dims():
     assert dec.shape == (20, 28, 3)
 
 
+def test_sparse_prefix_decodes_and_short_prefix_raises():
+    from omero_ms_image_region_tpu.ops.jpegenc import sparse_prefix_bytes
+
+    img = blob_image(32, 48, seed=9, noise=3.0)
+    y, cb, cr = coeffs_for(img, 85)
+    cap = 512
+    buf = np.asarray(sparse_pack(y[None], cb[None], cr[None], cap))[0]
+    total = int(buf[:4].view(np.int32)[0])
+    need = sparse_prefix_bytes(total, 32, 48)
+    assert need < buf.size
+    got = sparse_to_dense(buf[:need], 32, 48, cap)
+    np.testing.assert_array_equal(got[0], y)
+    with pytest.raises(ValueError):
+        sparse_to_dense(buf[:need - 1], 32, 48, cap)
+    if HAVE_NATIVE:
+        assert (jpeg_encode_sparse_native(buf[:need], 48, 32, 85, cap)
+                == jpeg_encode_sparse_native(buf, 48, 32, 85, cap))
+        # A truncated buffer must error, not decode its tail from zeros.
+        with pytest.raises(ValueError):
+            jpeg_encode_sparse_native(buf[:need - 1], 48, 32, 85, cap)
+
+
+def test_wire_fetcher_prefix_and_completion():
+    from omero_ms_image_region_tpu.ops.jpegenc import (
+        SparseWireFetcher, sparse_prefix_bytes)
+
+    img = blob_image(32, 32, seed=3, noise=2.0)
+    y, cb, cr = coeffs_for(img, 85)
+    cap = max_sparse_cap(32, 32)
+    buf = np.asarray(sparse_pack(y[None], cb[None], cr[None], cap))
+    total = int(buf[0, :4].view(np.int32)[0])
+
+    f = SparseWireFetcher(32, 32, cap)
+    f.GRANULE = 16            # tiny granule so prediction is exercised
+    f._k = 8 + 16             # deliberately under-predict
+    rows = f.fetch(buf)
+    assert rows.shape[0] == 1
+    got = sparse_to_dense(rows[0], 32, 32, cap)
+    np.testing.assert_array_equal(got[0], y)
+    # prediction updated to cover the observed prefix (+headroom, rounded)
+    assert f._k >= sparse_prefix_bytes(total, 32, 32)
+    # a second fetch is single-pass (no completion path)
+    got2 = sparse_to_dense(f.fetch(buf)[0], 32, 32, cap)
+    np.testing.assert_array_equal(got2[0], y)
+
+
 def test_sparse_pack_overflow_detected():
     rng = np.random.default_rng(0)
     img = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)  # dense noise
@@ -201,10 +248,10 @@ def test_sparse_native_rejects_malformed_buffer():
     buf = np.array(sparse_pack(y[None], cb[None], cr[None], cap))[0].copy()
     nb = 4 + 2  # 16x16 tile: 4 luma + 2 chroma blocks
     counts = buf[4:4 + nb]
-    first = int(counts[0])
-    assert first >= 2
-    ps = buf[4 + nb:4 + nb + cap]
-    ps[0], ps[1] = ps[1], ps[0]  # non-ascending positions in block 0
+    assert int(counts[0]) >= 2
+    # counts no longer sum to the header total -> must be rejected, not
+    # trusted into fixed-size block arrays
+    counts[0] -= 1
     with pytest.raises(ValueError):
         jpeg_encode_sparse_native(buf, 16, 16, 85, cap)
 

@@ -36,20 +36,20 @@ def _rdef(C=3):
     return rdef
 
 
-@pytest.mark.parametrize("C", [1, 3])
-@pytest.mark.parametrize("family", ["linear", "polynomial", "logarithmic",
-                                    "exponential"])
-def test_pallas_matches_xla_kernel(C, family):
+def _parity(B, C, H, W, family="linear", lut=False, seed=0):
     from omero_ms_image_region_tpu.models.rendering import Family
-    rng = np.random.default_rng(C)
+    rng = np.random.default_rng(seed)
     rdef = _rdef(C)
     for cb in rdef.channel_bindings:
         cb.family = Family(family)
         cb.coefficient = 1.3 if family in ("polynomial",
                                            "exponential") else 1.0
-    s = pack_settings(rdef)
-    tables = build_channel_tables(rdef)       # pallas path: full tables
-    B, H, W = 2, 16, 64
+    lut_provider = None
+    if lut:
+        from omero_ms_image_region_tpu.ops.lut import LutProvider
+        lut_provider = LutProvider()  # no files: colors fold to ramps
+    s = pack_settings(rdef, lut_provider)
+    tables = build_channel_tables(rdef, lut_provider)
     raw = rng.integers(0, 65535, size=(B, C, H, W)).astype(np.float32)
 
     got = np.asarray(render_tile_batch_packed_pallas(
@@ -62,4 +62,67 @@ def test_pallas_matches_xla_kernel(C, family):
         raw, tiled(s["window_start"]), tiled(s["window_end"]),
         tiled(s["family"]), tiled(s["coefficient"]), tiled(s["reverse"]),
         s["cd_start"], s["cd_end"], tiled(tables)))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("C", [1, 3])
+@pytest.mark.parametrize("family", ["linear", "polynomial", "logarithmic",
+                                    "exponential"])
+def test_pallas_matches_xla_kernel(C, family):
+    _parity(2, C, 16, 64, family=family, seed=C)
+
+
+@pytest.mark.parametrize("B", [1, 2, 5])
+@pytest.mark.parametrize("H,W", [
+    (16, 64),     # small block
+    (40, 32),     # H with no pow2 block: bh=40
+    (96, 128),    # bh=96
+    (272, 64),    # H > _BLOCK_H with H % 256 != 0: bh=136
+])
+def test_pallas_shapes_and_batches(B, H, W):
+    """Shapes off the 256-divisible grid must render, not assert."""
+    _parity(B, 2, H, W, seed=B * H)
+
+
+def test_pallas_full_lut_tables():
+    _parity(1, 2, 16, 64, lut=True, seed=9)
+
+
+def test_pick_block_h_covers_buckets_and_odd_heights():
+    from omero_ms_image_region_tpu.ops.pallas_render import pick_block_h
+
+    # Production buckets take the full block.
+    for H in (256, 512, 1024, 2048):
+        assert pick_block_h(H) == 256
+    # Odd heights pick their largest divisor <= 256.
+    assert pick_block_h(16) == 16
+    assert pick_block_h(272) == 136
+    assert pick_block_h(384) == 192
+    assert pick_block_h(520) == 130
+    assert pick_block_h(509) == 1      # large prime: correct, never fast
+    for H in (16, 272, 384, 520, 509, 100):
+        bh = pick_block_h(H)
+        assert H % bh == 0 and bh <= 256
+
+
+def test_renderer_kernel_config_selects_pallas():
+    """renderer.kernel='pallas' serves through the pallas kernel with
+    results identical to the XLA path (ramp weights expand to tables)."""
+    import asyncio
+
+    from omero_ms_image_region_tpu.server.handler import Renderer
+
+    rdef = _rdef(2)
+    s = pack_settings(rdef)
+    assert s["tables"].ndim == 2   # ramp-weight fold applies
+    rng = np.random.default_rng(4)
+    raw = rng.integers(0, 65535, size=(2, 24, 48)).astype(np.float32)
+
+    loop = asyncio.new_event_loop()
+    try:
+        got = loop.run_until_complete(
+            Renderer(kernel="pallas").render(raw, s))
+        want = loop.run_until_complete(Renderer().render(raw, s))
+    finally:
+        loop.close()
     np.testing.assert_array_equal(got, want)

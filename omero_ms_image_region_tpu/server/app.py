@@ -100,13 +100,16 @@ def create_app(config: Optional[AppConfig] = None,
                 mesh, max_batch=config.batcher.max_batch,
                 linger_ms=config.batcher.linger_ms)
         elif config.batcher.enabled:
-            if config.renderer.jpeg_engine != "sparse":
-                log.warning("renderer.jpeg-engine=%r applies only to the "
-                            "direct renderer; the batcher uses the sparse "
-                            "engine", config.renderer.jpeg_engine)
+            engine = config.renderer.jpeg_engine
+            if engine == "bitpack":
+                log.warning("renderer.jpeg-engine='bitpack' applies only "
+                            "to the direct renderer; the batcher uses "
+                            "the sparse engine")
+                engine = "sparse"
             renderer = BatchingRenderer(
                 max_batch=config.batcher.max_batch,
-                linger_ms=config.batcher.linger_ms)
+                linger_ms=config.batcher.linger_ms,
+                jpeg_engine=engine)
         else:
             renderer = Renderer(jpeg_engine=config.renderer.jpeg_engine,
                                 kernel=config.renderer.kernel)

@@ -74,9 +74,14 @@ class BatchingRenderer:
     """
 
     def __init__(self, max_batch: int = 8, linger_ms: float = 2.0,
-                 buckets=DEFAULT_BUCKETS):
+                 buckets=DEFAULT_BUCKETS, jpeg_engine: str = "sparse"):
+        if jpeg_engine not in ("sparse", "huffman"):
+            raise ValueError(
+                f"batched jpeg engine must be 'sparse' or 'huffman', "
+                f"got {jpeg_engine!r}")
         self.max_batch = max_batch
         self.linger_ms = linger_ms
+        self.jpeg_engine = jpeg_engine
         self.buckets = tuple(buckets)
         self._queues: Dict[tuple, Deque[_Pending]] = {}
         self._dispatchers: Dict[tuple, asyncio.Task] = {}
@@ -250,6 +255,7 @@ class BatchingRenderer:
                 s0["cd_start"], s0["cd_end"], stack("tables"),
                 quality=group[0].quality,
                 dims=[(p.w, p.h) for p in group],  # pad tiles skip encode
+                engine=self.jpeg_engine,
             )
         self.batches_dispatched += 1
         self.tiles_rendered += n

@@ -47,8 +47,11 @@ class RendererConfig:
     # device renders win.  Tunnel-attached deployments (device RTT in the
     # 100 ms class) may want this much larger.
     cpu_fallback_max_px: int = 256 * 256
-    # Device JPEG wire format: "sparse" (coefficients + host entropy
-    # coding) or "bitpack" (device-packed Huffman; fast-link deployments).
+    # Device JPEG wire format: "sparse" (18-bit coefficient entries +
+    # host entropy coding — wins on fast links), "huffman" (device
+    # fixed-table Huffman stream, ~3x fewer wire bytes — wins on slow or
+    # congested links; batcher-compatible), or "bitpack" (the legacy
+    # full-grid device Huffman; direct renderer only).
     jpeg_engine: str = "sparse"
     # Render kernel for the direct (unbatched) renderer: "xla" (the
     # fused gather kernel) or "pallas" (the one-hot-MXU VMEM kernel,
@@ -224,10 +227,11 @@ class AppConfig:
                                    rd_defaults.jpeg_engine)),
             kernel=str(rd.get("kernel", rd_defaults.kernel)),
         )
-        if cfg.renderer.jpeg_engine not in ("sparse", "bitpack"):
+        if cfg.renderer.jpeg_engine not in ("sparse", "huffman",
+                                            "bitpack"):
             raise ValueError(
-                f"renderer.jpeg-engine must be 'sparse' or 'bitpack', "
-                f"got {cfg.renderer.jpeg_engine!r}")
+                f"renderer.jpeg-engine must be 'sparse', 'huffman' or "
+                f"'bitpack', got {cfg.renderer.jpeg_engine!r}")
         if cfg.renderer.kernel not in ("xla", "pallas"):
             raise ValueError(
                 f"renderer.kernel must be 'xla' or 'pallas', "

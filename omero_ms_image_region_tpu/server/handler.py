@@ -66,7 +66,7 @@ class Renderer:
 
     def __init__(self, jpeg_engine: str = "sparse",
                  kernel: str = "xla"):
-        if jpeg_engine not in ("sparse", "bitpack"):
+        if jpeg_engine not in ("sparse", "huffman", "bitpack"):
             raise ValueError(f"unknown jpeg engine {jpeg_engine!r}")
         if kernel not in ("xla", "pallas"):
             raise ValueError(f"unknown render kernel {kernel!r}")
@@ -170,8 +170,12 @@ class Renderer:
                     *args, quality=quality, dims=[(width, height)])[0]
             return enc.encode_batch(
                 *args, dense_fallback=dense_fallback)[0]
+        engine = (self.jpeg_engine
+                  if self.jpeg_engine in ("sparse", "huffman")
+                  else "sparse")
         return render_batch_to_jpeg(
-            *args, quality=quality, dims=[(width, height)])[0]
+            *args, quality=quality, dims=[(width, height)],
+            engine=engine)[0]
 
 
 @dataclass

@@ -1,0 +1,89 @@
+"""Device cost + wire bytes: sparse vs compacted-entry Huffman."""
+
+import statistics
+import time
+
+import numpy as np
+
+from omero_ms_image_region_tpu.flagship import (
+    batched_args, flagship_settings, synthetic_wsi_tiles,
+)
+from omero_ms_image_region_tpu.ops.jpegenc import (
+    HuffmanWireFetcher, SparseWireFetcher, _scan_order_flat,
+    default_sparse_cap, default_words_cap, encode_sparse_buffers,
+    finish_huffman_batch, huffman_spec_arrays, quant_tables,
+    render_to_jpeg_huffman, render_to_jpeg_sparse,
+)
+
+import jax
+
+
+def sync(x):
+    np.asarray(x.ravel()[:1])
+
+
+def t(fn, n=5):
+    fn()
+    xs = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        xs.append((time.perf_counter() - t0) * 1e3)
+    return min(xs), statistics.median(xs)
+
+
+def main():
+    rng = np.random.default_rng(7)
+    B, C, H, W = 8, 4, 1024, 1024
+    _, settings = flagship_settings()
+    raw = synthetic_wsi_tiles(rng, B, C, H, W)
+    args = batched_args(settings, raw)[1:]
+    qy, qc = (tt.astype(np.int32) for tt in quant_tables(85))
+    cap = default_sparse_cap(H, W)
+    cap_words = default_words_cap(H, W)
+    spec = huffman_spec_arrays()
+    scan = _scan_order_flat(H // 16, W // 16)
+    dev = jax.device_put(raw)
+    sync(dev)
+
+    # device-only cost
+    ms = t(lambda: sync(render_to_jpeg_sparse(
+        dev, *args, qy, qc, cap=cap)))
+    print(f"sparse  dispatch+sync: {ms[0]:6.1f} ms ({ms[0]/B:4.1f}/tile)")
+    ms = t(lambda: sync(render_to_jpeg_huffman(
+        dev, *args, qy, qc, *spec, scan, cap=cap, cap_words=cap_words)))
+    print(f"huffman dispatch+sync: {ms[0]:6.1f} ms ({ms[0]/B:4.1f}/tile)")
+
+    # wire + host end-to-end
+    sf = SparseWireFetcher(H, W, cap)
+    hf = HuffmanWireFetcher(H, W, cap, cap_words)
+
+    def run_sparse():
+        host = sf.fetch(render_to_jpeg_sparse(dev, *args, qy, qc, cap=cap))
+        jpegs = encode_sparse_buffers(host, W, H, 85, cap)
+        assert jpegs[0][:2] == b"\xff\xd8"
+        return host
+
+    def run_huff():
+        host = hf.fetch(render_to_jpeg_huffman(
+            dev, *args, qy, qc, *spec, scan, cap=cap, cap_words=cap_words))
+        jpegs = finish_huffman_batch(host, [(W, H)] * B, H, W, 85, cap,
+                                     cap_words)
+        assert jpegs[0][:2] == b"\xff\xd8"
+        return host
+
+    hs = run_sparse()
+    hh = run_huff()
+    bits = hh[:, 4:8].copy().view(np.int32).ravel()
+    print("sparse fetched bytes/batch:", hs.shape[1] * B,
+          " huffman:", hh.shape[1] * B)
+    print("huffman stream KB/tile:",
+          [int(b) // 8192 for b in bits])
+    ms = t(run_sparse)
+    print(f"sparse  e2e batch: {ms[0]:6.1f} ms min / {ms[1]:6.1f} med")
+    ms = t(run_huff)
+    print(f"huffman e2e batch: {ms[0]:6.1f} ms min / {ms[1]:6.1f} med")
+
+
+if __name__ == "__main__":
+    main()

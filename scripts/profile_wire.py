@@ -1,0 +1,88 @@
+"""Wire-size accounting: 18-bit sparse prefix vs finished JPEG bytes."""
+
+import statistics
+import time
+
+import numpy as np
+
+from omero_ms_image_region_tpu.flagship import (
+    batched_args, flagship_settings, synthetic_wsi_tiles,
+)
+from omero_ms_image_region_tpu.ops.jpegenc import (
+    SparseWireFetcher, default_sparse_cap, encode_sparse_buffers,
+    quant_tables, render_to_jpeg_sparse, sparse_prefix_bytes,
+)
+
+import jax
+
+
+def main():
+    rng = np.random.default_rng(7)
+    B, C, H, W = 8, 4, 1024, 1024
+    _, settings = flagship_settings()
+    raw = synthetic_wsi_tiles(rng, B, C, H, W)
+    args = batched_args(settings, raw)[1:]
+    qy, qc = (t.astype(np.int32) for t in quant_tables(85))
+    cap = default_sparse_cap(H, W)
+    dev = jax.device_put(raw)
+    f = SparseWireFetcher(H, W, cap)
+    host = f.fetch(render_to_jpeg_sparse(dev, *args, qy, qc, cap=cap))
+    totals = host[:, :4].copy().view(np.int32).ravel()
+    jpegs = encode_sparse_buffers(host, W, H, 85, cap)
+    for t, j in zip(totals, jpegs):
+        print(f"entries={t}  prefix={sparse_prefix_bytes(t, H, W)}  "
+              f"jpeg={len(j)}  ratio={sparse_prefix_bytes(t, H, W)/len(j):.2f}")
+    print("fetched row bytes:", host.shape[1])
+
+    # config4-style single small dispatch timing (diagnose the 14->8 drop)
+    from omero_ms_image_region_tpu.models.rendering import Projection
+    from omero_ms_image_region_tpu.ops.projection import project_stack
+    import jax.numpy as jnp
+
+    def _settings_for3():
+        from omero_ms_image_region_tpu.flagship import flagship_rdef
+        from omero_ms_image_region_tpu.ops.render import pack_settings
+        r = flagship_rdef(3)
+        return pack_settings(r)
+
+    s3 = _settings_for3()
+    stacks = jax.device_put(synthetic_wsi_tiles(rng, 3, 32, 512, 512))
+    args3 = batched_args(s3, np.zeros((1, 3, 1, 1), np.float32))[1:]
+    cap4 = default_sparse_cap(512, 512)
+    f4 = SparseWireFetcher(512, 512, cap4)
+
+    @jax.jit
+    def project_render(stacks_):
+        planes = jax.vmap(
+            lambda st: project_stack(st, Projection.MAXIMUM_INTENSITY,
+                                     0, 31, 1, 65535.0)
+        )(stacks_.astype(jnp.float32))
+        return render_to_jpeg_sparse(planes[None], *args3, qy, qc, cap=cap4)
+
+    def run():
+        buf = f4.fetch(project_render(stacks))
+        encode_sparse_buffers(buf, 512, 512, 85, cap4)
+
+    run()
+    xs = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        run()
+        xs.append((time.perf_counter() - t0) * 1e3)
+    print("config4 run ms:", [round(x, 1) for x in xs],
+          "median", round(statistics.median(xs), 1))
+    # split: device+sync only
+    def sync_only():
+        b = project_render(stacks)
+        np.asarray(b[0, :4])
+    sync_only()
+    xs = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        sync_only()
+        xs.append((time.perf_counter() - t0) * 1e3)
+    print("config4 dispatch+sync ms:", [round(x, 1) for x in xs])
+
+
+if __name__ == "__main__":
+    main()

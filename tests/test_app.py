@@ -184,7 +184,7 @@ class TestStatusMapping:
         assert status == 400
 
 
-def _gather_requests(data_dir, paths):
+def _gather_requests(data_dir, paths, jpeg_engine="sparse"):
     """Boot the batched app, issue ``paths`` concurrently, return
     (bodies, content_types, renderer)."""
     config = AppConfig(
@@ -192,7 +192,8 @@ def _gather_requests(data_dir, paths):
         batcher=BatcherConfig(enabled=True, linger_ms=5.0),
         # These tests use tiny tiles but exist to exercise the batched
         # device path; keep the tiny-render CPU fallback out of the way.
-        renderer=RendererConfig(cpu_fallback_max_px=0))
+        renderer=RendererConfig(cpu_fallback_max_px=0,
+                                jpeg_engine=jpeg_engine))
 
     async def main():
         app = create_app(config)
@@ -241,3 +242,19 @@ class TestBatchedApp:
             assert codecs.decode_to_rgba(body).shape == (h, w, 4)
         # Same spatial bucket -> the device JPEG groups actually coalesce.
         assert renderer.batches_dispatched < len(sizes)
+
+    def test_huffman_engine_through_batcher(self, data_dir):
+        """renderer.jpeg-engine='huffman' serves batched JPEG groups via
+        the device fixed-table Huffman wire (exact tiles) and the dense
+        path (bucket-padded ones)."""
+        sizes = [(16, 16), (20, 12)]
+        bodies, types, renderer = _gather_requests(data_dir, [
+            f"/webgateway/render_image_region/{IMG}/0/0"
+            f"?tile=0,0,0,{w},{h}&format=jpeg&m=c&"
+            f"c=1|0:60000$FF0000,2|0:60000$00FF00"
+            for w, h in sizes
+        ], jpeg_engine="huffman")
+        assert renderer.jpeg_engine == "huffman"
+        assert all(t == "image/jpeg" for t in types)
+        for (w, h), body in zip(sizes, bodies):
+            assert codecs.decode_to_rgba(body).shape == (h, w, 4)

@@ -256,6 +256,138 @@ def test_sparse_native_rejects_malformed_buffer():
         jpeg_encode_sparse_native(buf, 16, 16, 85, cap)
 
 
+# ------------------------------- compacted-entry device Huffman packer
+
+def _huffman_wire(y, cb, cr, H, W, cap=None, cap_words=None):
+    from omero_ms_image_region_tpu.ops.jpegenc import (
+        _scan_order_flat, default_words_cap, huffman_pack,
+        huffman_spec_arrays, max_sparse_cap)
+
+    cap = cap if cap is not None else max_sparse_cap(H, W)
+    cap_words = (cap_words if cap_words is not None
+                 else max(64, default_words_cap(H, W) * 4))
+    scan = _scan_order_flat((H + 15) // 16, (W + 15) // 16)
+    bufs = np.asarray(huffman_pack(
+        y[None], cb[None], cr[None], cap, cap_words,
+        *huffman_spec_arrays(), scan))
+    return bufs, cap, cap_words
+
+
+@pytest.mark.parametrize("seed,H,W,noise", [
+    (1, 16, 16, 2.0), (2, 32, 48, 3.0), (3, 64, 64, 6.0),
+])
+def test_huffman_pack_matches_host_fixed_coder(seed, H, W, noise):
+    """Device Huffman stream == the host fixed-table coder, byte for
+    byte, through the full JFIF framing."""
+    from omero_ms_image_region_tpu.jfif import encode_jfif
+    from omero_ms_image_region_tpu.ops.jpegenc import finish_huffman_batch
+
+    img = blob_image(H, W, seed=seed, noise=noise)
+    y, cb, cr = coeffs_for(img, 85)
+    bufs, cap, cap_words = _huffman_wire(y, cb, cr, H, W)
+    got = finish_huffman_batch(bufs, [(W, H)], H, W, 85, cap, cap_words)[0]
+    want = encode_jfif(y, cb, cr, W, H, 85, huffman="fixed")
+    assert got == want
+
+
+def test_huffman_pack_empty_blocks_and_dc_only():
+    """All-zero coefficients (EOBs everywhere) and DC-only blocks."""
+    from omero_ms_image_region_tpu.jfif import encode_jfif
+    from omero_ms_image_region_tpu.ops.jpegenc import finish_huffman_batch
+
+    H = W = 16
+    nb_y, nb_c = 4, 1
+    y = np.zeros((nb_y, 64), np.int16)
+    cb = np.zeros((nb_c, 64), np.int16)
+    cr = np.zeros((nb_c, 64), np.int16)
+    y[1, 0] = -37    # one DC-only block
+    y[2, 63] = 5     # last-position AC: no EOB for this block
+    bufs, cap, cap_words = _huffman_wire(y, cb, cr, H, W)
+    got = finish_huffman_batch(bufs, [(W, H)], H, W, 85, cap, cap_words)[0]
+    assert got == encode_jfif(y, cb, cr, W, H, 85, huffman="fixed")
+
+
+def test_huffman_long_zero_runs_fold_zrls():
+    """Runs of 16+, 32+ and 48+ zeros exercise the 1+2 ZRL split."""
+    from omero_ms_image_region_tpu.jfif import encode_jfif
+    from omero_ms_image_region_tpu.ops.jpegenc import finish_huffman_batch
+
+    H = W = 16
+    y = np.zeros((4, 64), np.int16)
+    y[0, 0], y[0, 20], y[0, 40] = 100, 7, -3      # run 19, run 19
+    y[1, 1], y[1, 35] = 2, 9                      # run 33 -> 2 ZRLs
+    y[2, 63] = 1                                  # run 62 -> 3 ZRLs
+    cb = np.zeros((1, 64), np.int16)
+    cr = np.zeros((1, 64), np.int16)
+    cb[0, 5] = -1
+    bufs, cap, cap_words = _huffman_wire(y, cb, cr, H, W)
+    got = finish_huffman_batch(bufs, [(W, H)], H, W, 85, cap, cap_words)[0]
+    assert got == encode_jfif(y, cb, cr, W, H, 85, huffman="fixed")
+
+
+def test_huffman_overflow_detected_and_falls_back():
+    from omero_ms_image_region_tpu.ops.jpegenc import finish_huffman_batch
+
+    img = blob_image(16, 16, seed=6, noise=8.0)
+    y, cb, cr = coeffs_for(img, 95)
+    bufs, cap, cap_words = _huffman_wire(y, cb, cr, 16, 16, cap=4)
+    with pytest.raises(ValueError):
+        finish_huffman_batch(bufs, [(16, 16)], 16, 16, 95, 4, cap_words)
+    out = finish_huffman_batch(bufs, [(16, 16)], 16, 16, 95, 4, cap_words,
+                               dense_fallback=lambda i: b"FALLBACK")
+    assert out == [b"FALLBACK"]
+
+
+def test_huffman_fetcher_prefix_roundtrip():
+    from omero_ms_image_region_tpu.jfif import encode_jfif
+    from omero_ms_image_region_tpu.ops.jpegenc import (
+        HuffmanWireFetcher, finish_huffman_batch)
+
+    img = blob_image(32, 32, seed=8, noise=4.0)
+    y, cb, cr = coeffs_for(img, 85)
+    bufs, cap, cap_words = _huffman_wire(y, cb, cr, 32, 32)
+    f = HuffmanWireFetcher(32, 32, cap, cap_words)
+    f.GRANULE = 16
+    f._k = 24                       # force the completion path
+    rows = f.fetch(bufs)
+    got = finish_huffman_batch(rows, [(32, 32)], 32, 32, 85, cap,
+                               cap_words)[0]
+    assert got == encode_jfif(y, cb, cr, 32, 32, 85, huffman="fixed")
+
+
+def test_render_batch_to_jpeg_huffman_engine_mixed_dims():
+    """The serving helper's huffman engine: exact tiles via the device
+    stream, bucket-padded ones via the dense path — every JPEG decodes
+    at its own size and matches the sparse engine's pixels."""
+    import io
+
+    from PIL import Image
+
+    from omero_ms_image_region_tpu.flagship import (
+        batched_args, flagship_settings, synthetic_wsi_tiles)
+    from omero_ms_image_region_tpu.ops.jpegenc import render_batch_to_jpeg
+
+    rng = np.random.default_rng(3)
+    B, C, H, W = 3, 2, 32, 32
+    _, settings = flagship_settings(C)
+    raw = synthetic_wsi_tiles(rng, B, C, H, W).astype(np.float32)
+    args = batched_args(settings, raw)
+    dims = [(32, 32), (20, 12), (32, 16)]   # exact, padded, padded
+    got = render_batch_to_jpeg(*args, quality=85, dims=dims,
+                               engine="huffman")
+    want = render_batch_to_jpeg(*args, quality=85, dims=dims,
+                                engine="sparse")
+    for (w_, h_), g, s in zip(dims, got, want):
+        gi = np.asarray(Image.open(io.BytesIO(g)).convert("RGB"),
+                        np.int16)
+        si = np.asarray(Image.open(io.BytesIO(s)).convert("RGB"),
+                        np.int16)
+        assert gi.shape == (h_, w_, 3) == si.shape
+        # Same quantized coefficients, different entropy tables: pixels
+        # decode identically.
+        np.testing.assert_array_equal(gi, si)
+
+
 # ------------------------------------------- device Huffman bit-packing
 
 def test_fixed_huffman_spec_is_complete_and_valid():

@@ -63,6 +63,36 @@ class TestMeshRenderer:
                 s["cd_end"], s["tables"]))
             np.testing.assert_array_equal(out, expect)
 
+    def test_render_parity_with_full_lut_tables(self):
+        """The [B, C, 256, 3] gather-table path through the mesh (ramp
+        weights cover the other branch)."""
+        from omero_ms_image_region_tpu.flagship import flagship_rdef
+        from omero_ms_image_region_tpu.ops.render import (
+            build_channel_tables, pack_settings, render_tile_packed)
+        from omero_ms_image_region_tpu.parallel.serve import MeshRenderer
+
+        mesh = _mesh(chan_parallel=2)
+        renderer = MeshRenderer(mesh, linger_ms=0.0)
+        rng = np.random.default_rng(7)
+        rdef = flagship_rdef(2)
+        for cb in rdef.channel_bindings:
+            cb.reverse_intensity = True   # defeat the ramp-weight fold
+        s = pack_settings(rdef)
+        if s["tables"].ndim == 2:
+            s = dict(s, tables=build_channel_tables(rdef))
+        assert s["tables"].ndim == 3      # full [C, 256, 3] tables
+        tile = rng.integers(0, 60000, (2, 32, 48)).astype(np.float32)
+
+        async def go():
+            return await renderer.render(tile, s)
+
+        out = run(go())
+        expect = np.asarray(render_tile_packed(
+            tile, s["window_start"], s["window_end"], s["family"],
+            s["coefficient"], s["reverse"], s["cd_start"], s["cd_end"],
+            s["tables"]))
+        np.testing.assert_array_equal(out, expect)
+
     def test_render_jpeg_produces_decodable_tiles(self):
         import io
 

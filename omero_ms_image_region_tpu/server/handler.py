@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -37,6 +38,8 @@ from ..utils.stopwatch import stopwatch
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
 from .region import RegionDef, clamp_region_to_plane, get_region_def
 from .settings import update_settings
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_MAX_TILE_LENGTH = 2048  # beanRefContext.xml:63-66
 
@@ -73,6 +76,8 @@ class Renderer:
         self.jpeg_engine = jpeg_engine
         self.kernel = kernel
         import threading
+        self._pallas_ok = False
+        self._pallas_lock = threading.Lock()
         from collections import OrderedDict
         self._bitpack_encoders: "OrderedDict" = OrderedDict()
         # render_jpeg runs on asyncio worker threads; concurrent requests
@@ -86,7 +91,28 @@ class Renderer:
 
     def _render_sync(self, raw: np.ndarray, settings: dict) -> np.ndarray:
         if self.kernel == "pallas":
-            return self._render_sync_pallas(raw, settings)
+            try:
+                out = self._render_sync_pallas(raw, settings)
+                self._pallas_ok = True
+                return out
+            except Exception:
+                # Degrade, never fail.  A failure is either environmental
+                # (a Mosaic/Pallas compile path that cannot work here,
+                # e.g. a remote-compile helper that cannot initialize
+                # libtpu — flip to the XLA kernel for good; bit-identical
+                # output, different codegen) or per-request (odd settings,
+                # transient OOM — serve this one via XLA, keep pallas).
+                # A tiny canonical probe distinguishes the two.
+                if self._pallas_env_broken():
+                    logger.warning(
+                        "pallas kernel cannot run in this environment; "
+                        "falling back to the XLA kernel for this "
+                        "renderer", exc_info=True)
+                    self.kernel = "xla"
+                else:
+                    logger.warning(
+                        "pallas render failed; serving this request via "
+                        "the XLA kernel", exc_info=True)
         out = render_tile_packed(
             raw, settings["window_start"], settings["window_end"],
             settings["family"], settings["coefficient"],
@@ -94,6 +120,33 @@ class Renderer:
             settings["tables"],
         )
         return np.asarray(out)
+
+    def _pallas_env_broken(self) -> bool:
+        """Classify a pallas failure: True iff even a canonical minimal
+        render fails here (broken compile environment).  Locked so
+        concurrent first requests probe once; a success recorded by any
+        request settles the question without probing."""
+        with self._pallas_lock:
+            if self._pallas_ok:
+                return False
+            if self.kernel != "pallas":   # another thread already flipped
+                return True
+            try:
+                probe = {
+                    "window_start": np.zeros(1, np.float32),
+                    "window_end": np.full(1, 255.0, np.float32),
+                    "family": np.zeros(1, np.int32),
+                    "coefficient": np.ones(1, np.float32),
+                    "reverse": np.zeros(1, np.int32),
+                    "cd_start": 0, "cd_end": 255,
+                    "tables": np.zeros((1, 256, 3), np.float32),
+                }
+                self._render_sync_pallas(
+                    np.zeros((1, 8, 128), np.float32), probe)
+            except Exception:
+                return True
+            self._pallas_ok = True
+            return False
 
     def _render_sync_pallas(self, raw: np.ndarray,
                             settings: dict) -> np.ndarray:

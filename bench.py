@@ -89,7 +89,7 @@ def bench_flagship(rng):
         )
 
     from omero_ms_image_region_tpu.ops.jpegenc import (
-        HuffmanWireFetcher, SparseWireFetcher, _scan_order_flat,
+        HuffmanWireFetcher, SparseWireFetcher,
         default_sparse_cap, default_words_cap, encode_sparse_buffers,
         finish_huffman_batch, huffman_spec_arrays,
         render_to_jpeg_huffman, render_to_jpeg_sparse,
@@ -108,7 +108,6 @@ def bench_flagship(rng):
     args_suffix = batched_args(settings, raw_batches[0])[1:]
     qy, qc = (t.astype(np.int32) for t in quant_tables(quality))
     spec = huffman_spec_arrays()
-    scan = _scan_order_flat(H // 16, W // 16)
     pool = cf.ThreadPoolExecutor(max_workers=8)
     fetcher = SparseWireFetcher(H, W, cap)
     hfetcher = HuffmanWireFetcher(H, W, cap, cap_words)
@@ -152,7 +151,8 @@ def bench_flagship(rng):
         else:
             handles = [
                 hfetcher.start(render_to_jpeg_huffman(
-                    raw, *args_suffix, qy, qc, *spec, scan,
+                    raw, *args_suffix, qy, qc, *spec,
+                    h16=H // 16, w16=W // 16,
                     cap=cap, cap_words=cap_words))
                 for raw in batches
             ]

@@ -87,7 +87,7 @@ def create_app(config: Optional[AppConfig] = None,
             # groups dispatch through the (data, chan) mesh steps.
             from ..parallel import cluster
             from ..parallel.serve import MeshRenderer
-            if config.renderer.jpeg_engine != "sparse":
+            if config.renderer.jpeg_engine not in ("sparse", "auto"):
                 log.warning("renderer.jpeg-engine=%r ignored: the mesh "
                             "renderer uses the sparse engine",
                             config.renderer.jpeg_engine)
@@ -106,12 +106,21 @@ def create_app(config: Optional[AppConfig] = None,
                             "to the direct renderer; the batcher uses "
                             "the sparse engine")
                 engine = "sparse"
+            elif engine == "auto":
+                # Pick the wire engine for this deployment's actual link
+                # (sparse above ~12 MB/s device->host, huffman below).
+                from ..utils.linkprobe import resolve_auto_engine
+                engine = resolve_auto_engine()
             renderer = BatchingRenderer(
                 max_batch=config.batcher.max_batch,
                 linger_ms=config.batcher.linger_ms,
                 jpeg_engine=engine)
         else:
-            renderer = Renderer(jpeg_engine=config.renderer.jpeg_engine,
+            engine = config.renderer.jpeg_engine
+            if engine == "auto":
+                from ..utils.linkprobe import resolve_auto_engine
+                engine = resolve_auto_engine()
+            renderer = Renderer(jpeg_engine=engine,
                                 kernel=config.renderer.kernel)
         caches = Caches.from_config(config.caches)
         if config.caches.redis_uri and caches.redis is None:

@@ -228,10 +228,10 @@ class AppConfig:
             kernel=str(rd.get("kernel", rd_defaults.kernel)),
         )
         if cfg.renderer.jpeg_engine not in ("sparse", "huffman",
-                                            "bitpack"):
+                                            "bitpack", "auto"):
             raise ValueError(
-                f"renderer.jpeg-engine must be 'sparse', 'huffman' or "
-                f"'bitpack', got {cfg.renderer.jpeg_engine!r}")
+                f"renderer.jpeg-engine must be 'sparse', 'huffman', "
+                f"'bitpack' or 'auto', got {cfg.renderer.jpeg_engine!r}")
         if cfg.renderer.kernel not in ("xla", "pallas"):
             raise ValueError(
                 f"renderer.kernel must be 'xla' or 'pallas', "

@@ -108,7 +108,6 @@ class Renderer:
                         "pallas kernel cannot run in this environment; "
                         "falling back to the XLA kernel for this "
                         "renderer", exc_info=True)
-                    self.kernel = "xla"
                 else:
                     logger.warning(
                         "pallas render failed; serving this request via "
@@ -124,8 +123,10 @@ class Renderer:
     def _pallas_env_broken(self) -> bool:
         """Classify a pallas failure: True iff even a canonical minimal
         render fails here (broken compile environment).  Locked so
-        concurrent first requests probe once; a success recorded by any
-        request settles the question without probing."""
+        concurrent first requests probe once: the probing thread flips
+        ``self.kernel`` before releasing the lock, so waiters
+        short-circuit instead of re-running the (slow) failing compile;
+        a success recorded by any request also settles the question."""
         with self._pallas_lock:
             if self._pallas_ok:
                 return False
@@ -144,6 +145,7 @@ class Renderer:
                 self._render_sync_pallas(
                     np.zeros((1, 8, 128), np.float32), probe)
             except Exception:
+                self.kernel = "xla"       # flip before waiters wake
                 return True
             self._pallas_ok = True
             return False

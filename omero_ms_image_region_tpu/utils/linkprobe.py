@@ -1,0 +1,59 @@
+"""Device->host link probe: pick the JPEG wire engine for this link.
+
+The two batched wire engines trade device time against wire bytes
+(``ops/jpegenc.py``): "sparse" ships ~0.29 MB per 1024d tile and spends
+almost no device time packing; "huffman" packs the full fixed-table
+bitstream on device (~0.08 MB/tile, ~3.6x fewer bytes) but its deposit
+scatters bound it to ~35-40 tiles/s of device throughput.  Sparse
+therefore wins exactly when the link can carry its extra bytes faster
+than huffman renders: rate > huffman_ceiling * sparse_bytes/tile
+~= 38 * 0.29 ~= 11 MB/s.  ``renderer.jpeg-engine: auto`` measures the
+link once at startup and picks accordingly — co-located TPUs (GB/s
+class) get sparse, congested tunnels get huffman.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+# Crossover (MB/s) above which the sparse wire out-runs the huffman
+# engine's device-bound ceiling; see module docstring for the arithmetic.
+AUTO_SPARSE_MIN_MB_S = 12.0
+
+
+def measure_fetch_mb_s(nbytes: int = 4 << 20, repeats: int = 3) -> float:
+    """Best-of-N device->host fetch bandwidth in MB/s.
+
+    Each repeat fetches a DISTINCT random buffer so relay-side content
+    caching (observed on tunnel transports for repeated identical
+    payloads) cannot inflate the estimate.
+    """
+    import jax
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    best = float("inf")
+    for _ in range(repeats):
+        x = jax.device_put(rng.integers(0, 255, nbytes, dtype=np.uint8))
+        jax.block_until_ready(x)
+        t0 = time.perf_counter()
+        np.asarray(x)
+        best = min(best, time.perf_counter() - t0)
+    return nbytes / 1e6 / best
+
+
+def resolve_auto_engine() -> str:
+    """Measure the link and return "sparse" or "huffman"."""
+    try:
+        rate = measure_fetch_mb_s()
+    except Exception:
+        logger.warning("link probe failed; defaulting jpeg engine to "
+                       "'sparse'", exc_info=True)
+        return "sparse"
+    engine = "sparse" if rate >= AUTO_SPARSE_MIN_MB_S else "huffman"
+    logger.info("link probe: %.1f MB/s device->host -> jpeg engine %r "
+                "(crossover %.0f MB/s)", rate, engine, AUTO_SPARSE_MIN_MB_S)
+    return engine

@@ -9,7 +9,7 @@ from omero_ms_image_region_tpu.flagship import (
     batched_args, flagship_settings, synthetic_wsi_tiles,
 )
 from omero_ms_image_region_tpu.ops.jpegenc import (
-    HuffmanWireFetcher, SparseWireFetcher, _scan_order_flat,
+    HuffmanWireFetcher, SparseWireFetcher,
     default_sparse_cap, default_words_cap, encode_sparse_buffers,
     finish_huffman_batch, huffman_spec_arrays, quant_tables,
     render_to_jpeg_huffman, render_to_jpeg_sparse,
@@ -42,7 +42,6 @@ def main():
     cap = default_sparse_cap(H, W)
     cap_words = default_words_cap(H, W)
     spec = huffman_spec_arrays()
-    scan = _scan_order_flat(H // 16, W // 16)
     dev = jax.device_put(raw)
     sync(dev)
 
@@ -51,7 +50,8 @@ def main():
         dev, *args, qy, qc, cap=cap)))
     print(f"sparse  dispatch+sync: {ms[0]:6.1f} ms ({ms[0]/B:4.1f}/tile)")
     ms = t(lambda: sync(render_to_jpeg_huffman(
-        dev, *args, qy, qc, *spec, scan, cap=cap, cap_words=cap_words)))
+        dev, *args, qy, qc, *spec, h16=H // 16, w16=W // 16,
+        cap=cap, cap_words=cap_words)))
     print(f"huffman dispatch+sync: {ms[0]:6.1f} ms ({ms[0]/B:4.1f}/tile)")
 
     # wire + host end-to-end
@@ -66,7 +66,8 @@ def main():
 
     def run_huff():
         host = hf.fetch(render_to_jpeg_huffman(
-            dev, *args, qy, qc, *spec, scan, cap=cap, cap_words=cap_words))
+            dev, *args, qy, qc, *spec, h16=H // 16, w16=W // 16,
+            cap=cap, cap_words=cap_words))
         jpegs = finish_huffman_batch(host, [(W, H)] * B, H, W, 85, cap,
                                      cap_words)
         assert jpegs[0][:2] == b"\xff\xd8"

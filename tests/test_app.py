@@ -258,3 +258,18 @@ class TestBatchedApp:
         assert all(t == "image/jpeg" for t in types)
         for (w, h), body in zip(sizes, bodies):
             assert codecs.decode_to_rgba(body).shape == (h, w, 4)
+
+    def test_auto_engine_resolves_by_link_probe(self, data_dir,
+                                                monkeypatch):
+        """renderer.jpeg-engine='auto' probes the device->host link and
+        builds the batcher with sparse (fast link) or huffman (slow)."""
+        from omero_ms_image_region_tpu.utils import linkprobe
+
+        for rate, expect in ((500.0, "sparse"), (2.0, "huffman")):
+            monkeypatch.setattr(linkprobe, "measure_fetch_mb_s",
+                                lambda *a, rate=rate, **k: rate)
+            _, _, renderer = _gather_requests(data_dir, [
+                f"/webgateway/render_image_region/{IMG}/0/0"
+                "?tile=0,0,0,16,16&format=jpeg&m=c&c=1|0:60000$FF0000"
+            ], jpeg_engine="auto")
+            assert renderer.jpeg_engine == expect

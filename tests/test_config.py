@@ -110,3 +110,14 @@ class TestAppConfig:
         assert cfg.caches.redis_uri == "redis://x:1/0"
         assert cfg.caches.image_region is True
         assert cfg.caches.shape_mask is False
+
+
+def test_jpeg_engine_auto_accepted():
+    import pytest
+
+    from omero_ms_image_region_tpu.server.config import AppConfig
+
+    cfg = AppConfig.from_dict({"renderer": {"jpeg-engine": "auto"}})
+    assert cfg.renderer.jpeg_engine == "auto"
+    with pytest.raises(ValueError):
+        AppConfig.from_dict({"renderer": {"jpeg-engine": "turbo"}})

@@ -260,16 +260,16 @@ def test_sparse_native_rejects_malformed_buffer():
 
 def _huffman_wire(y, cb, cr, H, W, cap=None, cap_words=None):
     from omero_ms_image_region_tpu.ops.jpegenc import (
-        _scan_order_flat, default_words_cap, huffman_pack,
-        huffman_spec_arrays, max_sparse_cap)
+        default_words_cap, huffman_pack, huffman_spec_arrays,
+        max_sparse_cap)
 
     cap = cap if cap is not None else max_sparse_cap(H, W)
     cap_words = (cap_words if cap_words is not None
                  else max(64, default_words_cap(H, W) * 4))
-    scan = _scan_order_flat((H + 15) // 16, (W + 15) // 16)
     bufs = np.asarray(huffman_pack(
         y[None], cb[None], cr[None], cap, cap_words,
-        *huffman_spec_arrays(), scan))
+        *huffman_spec_arrays(),
+        h16=(H + 15) // 16, w16=(W + 15) // 16))
     return bufs, cap, cap_words
 
 

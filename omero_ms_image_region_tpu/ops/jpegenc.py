@@ -960,6 +960,18 @@ class TpuJpegEncoder:
             dense_fallback=dense_fallback, executor=executor)
 
 
+def dense_encoder():
+    """The per-tile dense-coefficient entropy coder: native if available,
+    else Python.  Returns ``encode(y, cb, cr, width, height, quality) ->
+    bytes``."""
+    from ..native import jpeg_native_available
+    if jpeg_native_available():
+        from ..native import jpeg_encode_native
+        return jpeg_encode_native
+    from ..jfif import encode_jfif
+    return encode_jfif
+
+
 def sparse_encoder():
     """The per-tile sparse entropy coder: native if available, else Python.
 
@@ -1073,11 +1085,7 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
         else:
             bufs = np.asarray(bufs)
 
-        from ..native import jpeg_native_available
-        if jpeg_native_available():
-            from ..native import jpeg_encode_native as _dense_encode
-        else:
-            from ..jfif import encode_jfif as _dense_encode
+        _dense_encode = dense_encoder()
 
         def dense_tile(i):
             # Rare cap/bits overflow: re-encode from dense coefficients.
@@ -1110,13 +1118,10 @@ def finish_sparse_to_jpegs(bufs, dims, H: int, W: int, quality: int,
     from the top-left block subgrid, and tiles that overflowed ``cap``
     re-render through ``dense_coefficients(i) -> (y, cb, cr)``.
     """
-    from ..native import SparseOverflowError, jpeg_native_available
+    from ..native import SparseOverflowError
 
     _encode = sparse_encoder()
-    if jpeg_native_available():
-        from ..native import jpeg_encode_native as _dense_encode
-    else:
-        from ..jfif import encode_jfif as _dense_encode
+    _dense_encode = dense_encoder()
 
     out = []
     for i, (w_, h_) in enumerate(dims):

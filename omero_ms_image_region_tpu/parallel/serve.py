@@ -83,14 +83,19 @@ class MeshRenderer(BatchingRenderer):
     """Drop-in renderer serving every group through the sharded steps."""
 
     def __init__(self, mesh: Mesh, max_batch: int | None = None,
-                 linger_ms: float = 2.0, buckets=None):
+                 linger_ms: float = 2.0, buckets=None,
+                 jpeg_engine: str = "sparse"):
         data = mesh.shape["data"]
         if max_batch is None:
             max_batch = max(8, 2 * data)
+        if jpeg_engine not in ("sparse", "huffman"):
+            raise ValueError(f"mesh jpeg engine must be 'sparse' or "
+                             f"'huffman', got {jpeg_engine!r}")
         kwargs = {} if buckets is None else {"buckets": buckets}
         super().__init__(max_batch=max_batch, linger_ms=linger_ms,
                          **kwargs)
         self.mesh = mesh
+        self.jpeg_engine = jpeg_engine
         self._render_steps: dict = {}
         self._jpeg_steps: dict = {}
 
@@ -103,13 +108,15 @@ class MeshRenderer(BatchingRenderer):
                 render_step_sharded_batched(self.mesh)
         return step
 
-    def _jpeg_step(self, quality: int, cap: int):
-        key = (quality, cap)
+    def _jpeg_step(self, quality: int, cap: int, engine: str = "sparse",
+                   cap_words: int | None = None):
+        key = (engine, quality, cap, cap_words)
         step = self._jpeg_steps.get(key)
         if step is None:
             step = self._jpeg_steps[key] = \
                 render_jpeg_step_sharded_batched(self.mesh, quality,
-                                                 cap=cap)
+                                                 cap=cap, engine=engine,
+                                                 cap_words=cap_words)
         return step
 
     # ------------------------------------------------------------ groups
@@ -144,10 +151,26 @@ class MeshRenderer(BatchingRenderer):
         self.tiles_rendered += n
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
 
+    @staticmethod
+    def _dense_coefficients(raw, stacked, qy, qc, i):
+        """Single-tile dense coefficients on the default device — the
+        rare-overflow fallback shared by both wire engines."""
+        from ..ops.jpegenc import render_to_jpeg_coefficients
+
+        y, cb, cr = render_to_jpeg_coefficients(
+            np.asarray(raw[i:i + 1], np.float32),
+            np.asarray(stacked["window_start"][i:i + 1]),
+            np.asarray(stacked["window_end"][i:i + 1]),
+            np.asarray(stacked["family"][i:i + 1]),
+            np.asarray(stacked["coefficient"][i:i + 1]),
+            np.asarray(stacked["reverse"][i:i + 1]),
+            stacked["cd_start"], stacked["cd_end"],
+            np.asarray(stacked["tables"][i:i + 1]), qy, qc)
+        return np.asarray(y)[0], np.asarray(cb)[0], np.asarray(cr)[0]
+
     def _render_group_jpeg(self, group: List[_Pending]) -> List[bytes]:
         from ..ops.jpegenc import (default_sparse_cap,
                                    finish_sparse_to_jpegs,
-                                   render_to_jpeg_coefficients,
                                    quant_tables, wire_fetcher)
 
         n = len(group)
@@ -155,31 +178,54 @@ class MeshRenderer(BatchingRenderer):
         H, W = raw.shape[-2:]
         cap = default_sparse_cap(H, W)
         quality = group[0].quality
+        # The packed Huffman stream covers the full (H, W) grid, so the
+        # wire-optimal engine applies when every tile in the group is
+        # grid-exact (same policy as ``render_batch_to_jpeg``); mixed
+        # groups fall back to the sparse engine as a whole.
+        all_exact = all((p.h + 15) // 16 * 16 == H
+                        and (p.w + 15) // 16 * 16 == W for p in group)
+        if self.jpeg_engine == "huffman" and all_exact:
+            return self._render_group_jpeg_huffman(
+                group, raw, stacked, H, W, cap, quality)
         args = shard_batch_batched(self.mesh, raw, stacked)
         with stopwatch("Renderer.renderAsPackedInt.mesh"):
             bufs = self._jpeg_step(quality, cap)(*args)
             bufs = wire_fetcher(H, W, cap).fetch(bufs)
 
         qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
-
-        def dense_coefficients(i):
-            # Rare overflow fallback: single-tile dense coefficients on
-            # the default device.
-            y, cb, cr = render_to_jpeg_coefficients(
-                np.asarray(raw[i:i + 1], np.float32),
-                np.asarray(stacked["window_start"][i:i + 1]),
-                np.asarray(stacked["window_end"][i:i + 1]),
-                np.asarray(stacked["family"][i:i + 1]),
-                np.asarray(stacked["coefficient"][i:i + 1]),
-                np.asarray(stacked["reverse"][i:i + 1]),
-                stacked["cd_start"], stacked["cd_end"],
-                np.asarray(stacked["tables"][i:i + 1]), qy, qc)
-            return (np.asarray(y)[0], np.asarray(cb)[0],
-                    np.asarray(cr)[0])
-
         jpegs = finish_sparse_to_jpegs(
             bufs, [(p.w, p.h) for p in group], H, W, quality, cap,
-            dense_coefficients)
+            lambda i: self._dense_coefficients(raw, stacked, qy, qc, i))
+        self.batches_dispatched += 1
+        self.tiles_rendered += n
+        return jpegs
+
+    def _render_group_jpeg_huffman(self, group, raw, stacked, H, W, cap,
+                                   quality) -> List[bytes]:
+        from ..ops.jpegenc import (default_words_cap, dense_encoder,
+                                   finish_huffman_batch,
+                                   huffman_wire_fetcher, quant_tables)
+
+        n = len(group)
+        cap_words = default_words_cap(H, W)
+        args = shard_batch_batched(self.mesh, raw, stacked)
+        with stopwatch("Renderer.renderAsPackedInt.mesh"):
+            bufs = self._jpeg_step(quality, cap, "huffman",
+                                   cap_words)(*args)
+            bufs = huffman_wire_fetcher(H, W, cap, cap_words).fetch(bufs)
+
+        qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
+        _dense_encode = dense_encoder()
+
+        def dense_tile(i):
+            # Rare cap/bits overflow: dense re-encode of one tile.
+            y, cb, cr = self._dense_coefficients(raw, stacked, qy, qc, i)
+            return _dense_encode(y, cb, cr, group[i].w, group[i].h,
+                                 quality)
+
+        jpegs = finish_huffman_batch(
+            bufs, [(p.w, p.h) for p in group], H, W, quality, cap,
+            cap_words, dense_fallback=dense_tile)
         self.batches_dispatched += 1
         self.tiles_rendered += n
         return jpegs

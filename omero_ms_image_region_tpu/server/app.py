@@ -87,18 +87,29 @@ def create_app(config: Optional[AppConfig] = None,
             # groups dispatch through the (data, chan) mesh steps.
             from ..parallel import cluster
             from ..parallel.serve import MeshRenderer
-            if config.renderer.jpeg_engine not in ("sparse", "auto"):
-                log.warning("renderer.jpeg-engine=%r ignored: the mesh "
-                            "renderer uses the sparse engine",
-                            config.renderer.jpeg_engine)
+            engine = config.renderer.jpeg_engine
+            if engine == "bitpack":
+                log.warning("renderer.jpeg-engine='bitpack' applies only "
+                            "to the direct renderer; the mesh renderer "
+                            "uses the sparse engine")
+                engine = "sparse"
             cluster.initialize()
             mesh = cluster.global_mesh(
                 chan_parallel=config.parallel.chan_parallel,
                 n_devices=config.parallel.n_devices)
-            log.info("mesh serving enabled: %s", dict(mesh.shape))
+            if engine == "auto":
+                # Probe strictly after cluster.initialize():
+                # jax.distributed must come up before anything touches a
+                # backend, or a multi-host pod degrades to per-host
+                # standalone meshes.
+                from ..utils.linkprobe import resolve_auto_engine
+                engine = resolve_auto_engine()
+            log.info("mesh serving enabled: %s (jpeg engine %s)",
+                     dict(mesh.shape), engine)
             renderer = MeshRenderer(
                 mesh, max_batch=config.batcher.max_batch,
-                linger_ms=config.batcher.linger_ms)
+                linger_ms=config.batcher.linger_ms,
+                jpeg_engine=engine)
         elif config.batcher.enabled:
             engine = config.renderer.jpeg_engine
             if engine == "bitpack":

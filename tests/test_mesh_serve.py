@@ -123,6 +123,39 @@ class TestMeshRenderer:
             img = Image.open(io.BytesIO(j))
             assert img.size == (t.shape[2], t.shape[1])
 
+    def test_render_jpeg_huffman_engine_matches_sparse_pixels(self):
+        """The mesh huffman engine entropy-codes the SAME quantized
+        coefficients as the sparse engine, so both decode to identical
+        pixels (the wire bytes differ: fixed vs optimal tables)."""
+        import io
+
+        from PIL import Image
+
+        from omero_ms_image_region_tpu.parallel.serve import MeshRenderer
+
+        mesh = _mesh(chan_parallel=2)
+        sparse = MeshRenderer(mesh, linger_ms=0.0)
+        huff = MeshRenderer(mesh, linger_ms=0.0, jpeg_engine="huffman")
+        assert huff.jpeg_engine == "huffman"
+        rng = np.random.default_rng(3)
+        # 32x48 is MCU-grid-exact, so the group takes the packed stream.
+        tiles = [rng.integers(0, 60000, (2, 32, 48)).astype(np.float32)
+                 for _ in range(2)]
+        settings = [_settings(2, [(0, 50000)] * 2) for _ in range(2)]
+
+        def go(renderer):
+            async def inner():
+                return await asyncio.gather(*(
+                    renderer.render_jpeg(t, s, 85, t.shape[2], t.shape[1])
+                    for t, s in zip(tiles, settings)))
+            return run(inner())
+
+        sp_jpegs, hf_jpegs = go(sparse), go(huff)
+        for sj, hj in zip(sp_jpegs, hf_jpegs):
+            a = np.asarray(Image.open(io.BytesIO(sj)).convert("RGB"))
+            b = np.asarray(Image.open(io.BytesIO(hj)).convert("RGB"))
+            np.testing.assert_array_equal(a, b)
+
 
 class TestMeshServingHTTP:
     def test_request_served_by_mesh_renderer(self, tmp_path):
@@ -171,3 +204,48 @@ class TestMeshServingHTTP:
         assert body[:2] == b"\xff\xd8"
         assert renderer.batches_dispatched >= 1
         assert renderer.tiles_rendered >= 1
+
+    def test_mesh_honors_huffman_engine_config(self, tmp_path):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.io.store import build_pyramid
+        from omero_ms_image_region_tpu.parallel.serve import MeshRenderer
+        from omero_ms_image_region_tpu.server.app import (SERVICES_KEY,
+                                                          create_app)
+        from omero_ms_image_region_tpu.server.config import (
+            AppConfig, ParallelConfig, RendererConfig)
+
+        if len(resolve_devices(8)) < 8:
+            pytest.skip("no 8-wide device pool (real or virtual)")
+
+        rng = np.random.default_rng(6)
+        planes = rng.integers(0, 60000, (2, 1, 64, 64)).astype(np.uint16)
+        build_pyramid(planes, str(tmp_path / "1"), n_levels=1)
+
+        config = AppConfig(
+            data_dir=str(tmp_path),
+            parallel=ParallelConfig(enabled=True, chan_parallel=2,
+                                    n_devices=8),
+            renderer=RendererConfig(cpu_fallback_max_px=0,
+                                    jpeg_engine="huffman"),
+        )
+
+        async def go():
+            app = create_app(config)
+            services = app[SERVICES_KEY]
+            assert isinstance(services.renderer, MeshRenderer)
+            assert services.renderer.jpeg_engine == "huffman"
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.get(
+                    "/webgateway/render_image_region/1/0/0"
+                    "?tile=0,0,0,32,32&format=jpeg&m=c"
+                    "&c=1|0:60000$FF0000,2|0:60000$00FF00")
+                return resp.status, await resp.read()
+            finally:
+                await client.close()
+
+        status, body = run(go())
+        assert status == 200
+        assert body[:2] == b"\xff\xd8"

@@ -55,6 +55,18 @@ def _timed(fn, *args, repeats=3, warmup=True):
     return min(times)
 
 
+def _cpu_jpeg(rgba, quality=85):
+    """The CPU comparators' shared encode convention: PIL/libjpeg RGB."""
+    import io
+
+    from PIL import Image
+
+    out = io.BytesIO()
+    Image.fromarray(np.ascontiguousarray(rgba[..., :3])).save(
+        out, format="JPEG", quality=quality)
+    return out.getvalue()
+
+
 # ----------------------------------------------------------- config 3 (HEAD)
 
 def bench_flagship(rng):
@@ -239,16 +251,9 @@ def bench_flagship(rng):
 
     # CPU reference on identical tiles: render + PIL JPEG (libjpeg).
     # Fixed >=18 s window so the denominator is stable run to run.
-    import io
-
-    from PIL import Image
-
     def cpu_tile(raw_tile):
-        rgba = render_ref(raw_tile.astype(np.float32), rdef)
-        buf = io.BytesIO()
-        Image.fromarray(np.ascontiguousarray(rgba[..., :3])).save(
-            buf, format="JPEG", quality=quality)
-        return buf.getvalue()
+        return _cpu_jpeg(render_ref(raw_tile.astype(np.float32), rdef),
+                         quality)
 
     n, t0 = 0, time.perf_counter()
     while True:
@@ -354,15 +359,8 @@ def bench_config2(rng):
         planes_per_sec = n_planes / _timed(lambda: stream(pool), repeats=3)
 
     # CPU comparator: reference render + PIL JPEG on one identical plane.
-    import io
-
-    from PIL import Image
-
     def cpu_plane():
-        rgba = render_ref(planes[0].astype(np.float32), rdef)
-        out = io.BytesIO()
-        Image.fromarray(np.ascontiguousarray(rgba[..., :3])).save(
-            out, format="JPEG", quality=85)
+        _cpu_jpeg(render_ref(planes[0].astype(np.float32), rdef))
 
     cpu_planes_per_sec = 1.0 / _timed(cpu_plane, repeats=3)
     return planes_per_sec, cpu_planes_per_sec
@@ -392,7 +390,7 @@ def bench_config4(rng):
     from omero_ms_image_region_tpu.ops.projection import project_stack
 
     n_req = 6
-    _, s = _settings_for(3)
+    rdef, s = _settings_for(3)
     stacks = [jax.device_put(synthetic_wsi_tiles(rng, 3, 32, 512, 512))
               for _ in range(n_req)]          # [C=3, Z=32, H, W] each
     jax.block_until_ready(stacks)
@@ -416,7 +414,24 @@ def bench_config4(rng):
                 fetcher.finish(h), 512, 512, 85, cap)
             assert jpegs[0][:2] == b"\xff\xd8"
 
-    return n_req / _timed(stream, repeats=3)
+    tpu_rate = n_req / _timed(stream, repeats=3)
+
+    # CPU comparator: reference projection + render + PIL JPEG on one
+    # identical stack.
+    from omero_ms_image_region_tpu.refimpl import project_ref, render_ref
+
+    host_stack = np.asarray(stacks[0], np.float32)   # [C, Z, H, W]
+
+    def cpu_projection():
+        planes = np.stack([
+            project_ref(host_stack[c], Projection.MAXIMUM_INTENSITY,
+                        0, 31, 1, 65535.0)
+            for c in range(3)
+        ])
+        _cpu_jpeg(render_ref(planes, rdef))
+
+    cpu_rate = 1.0 / _timed(cpu_projection, repeats=3)
+    return tpu_rate, cpu_rate
 
 
 # -------------------------------------------------------------- config 5
@@ -451,7 +466,7 @@ def main():
     flag = bench_flagship(rng)
     c1_tpu, c1_cpu = bench_config1(rng)
     c2_planes, c2_cpu = bench_config2(rng)
-    c4_projections = bench_config4(rng)
+    c4_projections, c4_cpu = bench_config4(rng)
     c5_masks = bench_config5(rng)
 
     print(json.dumps({
@@ -475,6 +490,7 @@ def main():
         "config2_fullplane_2048_3ch_per_sec": round(c2_planes, 2),
         "config2_cpu_ref_per_sec": round(c2_cpu, 2),
         "config4_zproj32_3ch_512_per_sec": round(c4_projections, 2),
+        "config4_cpu_ref_per_sec": round(c4_cpu, 2),
         "config5_mask_overlay_512_per_sec": round(c5_masks, 2),
     }))
 

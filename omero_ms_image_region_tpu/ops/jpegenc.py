@@ -682,18 +682,26 @@ def huffman_pack(y, cb, cr, cap: int, cap_words: int,
     total = counts.sum(-1).astype(jnp.int32)             # [B]
 
     # Dense per-block DC fields: diff against the previous block of the
-    # same component in scan order (k%6 in {1,2,3}: previous Y is k-1;
-    # k%6==0: previous MCU's Y3 at k-3; Cb/Cr: k-6).
+    # same component in scan order.  The predecessor pattern is
+    # structural per MCU slot (Y1..Y3 <- the Y before them in the same
+    # MCU; Y0/Cb/Cr <- the same slot's value one MCU back), so it is
+    # shifted slices, not a gather — TPU gathers cost ~100ns/element.
     dc = blocks[..., 0]
-    k = jnp.arange(nb)
-    km = k % 6
-    prev_idx = jnp.where((km >= 1) & (km <= 3), k - 1,
-                         jnp.where(km == 0, k - 3, k - 6))
-    pred = jnp.where(prev_idx >= 0, dc[:, jnp.maximum(prev_idx, 0)], 0)
+    n_mcu = nb // 6
+    d6 = dc.reshape(B, n_mcu, 6)
+    prev_mcu = jnp.pad(d6[:, :-1], ((0, 0), (1, 0), (0, 0)))
+    pred = jnp.concatenate([
+        prev_mcu[:, :, 3:4],        # Y0 <- previous MCU's Y3
+        d6[:, :, 0:3],              # Y1..Y3 <- Y0..Y2
+        prev_mcu[:, :, 4:6],        # Cb/Cr <- previous MCU's Cb/Cr
+    ], axis=2).reshape(B, nb)
     dcdiff = dc - pred
     s_dc = _category(dcdiff)
-    dc_fval = jnp.left_shift(dc_code[s_dc], s_dc) | _amplitude(dcdiff, s_dc)
-    dc_flen = dc_len[s_dc] + s_dc
+    # One fused (len << 16 | code) table -> one gather instead of two.
+    dc_cl = (jnp.left_shift(dc_len, 16) | dc_code)[s_dc]
+    dc_fval = (jnp.left_shift(dc_cl & 0xFFFF, s_dc)
+               | _amplitude(dcdiff, s_dc))
+    dc_flen = jnp.right_shift(dc_cl, 16) + s_dc
     has_eob = ~mask[..., 63]
     eob_val = jnp.where(has_eob, ac_code[0x00], 0)
     eob_len = jnp.where(has_eob, ac_len[0x00], 0)
@@ -717,10 +725,10 @@ def huffman_pack(y, cb, cr, cap: int, cap_words: int,
     jidx = jnp.arange(cap, dtype=jnp.int32)
     evalid = jidx[None, :] < total[:, None]
 
-    # First-of-block flags + per-entry block rank (among nonempty blocks).
+    # First-of-block flags (scattered at each nonempty block's first
+    # entry slot).
     nonempty = counts > 0
     S = jnp.cumsum(counts, axis=1) - counts              # exclusive
-    rank = jnp.cumsum(nonempty, axis=1) - 1
 
     def flag_one(S_row, ne_row):
         tgt = jnp.where(ne_row & (S_row < cap), S_row, jnp.int32(1) << 30)
@@ -728,7 +736,6 @@ def huffman_pack(y, cb, cr, cap: int, cap_words: int,
             1, mode="drop", unique_indices=True)
 
     first = jax.vmap(flag_one)(S, nonempty)
-    r = jnp.cumsum(first, axis=1) - 1                    # [B, cap]
 
     # AC fields per entry (DC entries — pos 0, always a block's first
     # entry — carry no AC field; the dense pass above covers them).
@@ -740,8 +747,11 @@ def huffman_pack(y, cb, cr, cap: int, cap_words: int,
     z = jnp.clip(run >> 4, 0, 3)
     rem = jnp.where(ac_live, run & 15, 0)
     sym = jnp.left_shift(rem, 4) | s_ac
-    main_val = jnp.left_shift(ac_code[sym], s_ac) | _amplitude(evals, s_ac)
-    main_len = jnp.where(ac_live, ac_len[sym] + s_ac, 0)
+    # One fused (len << 16 | code) gather over the [B, cap] stream.
+    ac_cl = (jnp.left_shift(ac_len, 16) | ac_code)[sym]
+    main_val = (jnp.left_shift(ac_cl & 0xFFFF, s_ac)
+                | _amplitude(evals, s_ac))
+    main_len = jnp.where(ac_live, jnp.right_shift(ac_cl, 16) + s_ac, 0)
     main_val = jnp.where(ac_live, main_val, 0)
     # Up to three folded ZRL codes as ONE field: the fixed spec's ZRL is
     # 10 bits, so 3 x 10 = 30 fits an i32 deposit (one pass, not two).
@@ -768,17 +778,27 @@ def huffman_pack(y, cb, cr, cap: int, cap_words: int,
     block_start = jnp.cumsum(block_bits, axis=1) - block_bits
     total_bits = (block_start[:, -1] + block_bits[:, -1]).astype(jnp.int32)
 
+    # Per-entry bit base: scatter each nonempty block's base into its
+    # first entry slot, then carry it across the block's entries with a
+    # running max — NOT a [B, cap] gather.  Valid because the bases are
+    # provably non-decreasing across nonempty blocks: for consecutive
+    # nonempty b < b', base_{b'} - base_b = (sum of block_bits over
+    # [b, b')) + dc_flen_{b'} - dc_flen_b - block_ac_b
+    # >= eob_b + dc_flen_{b'} >= 0 (empty blocks between them only add
+    # their dc+eob bits), and base_0 = dc_flen_0 >= 0, so zero-filled
+    # gaps never win the max.
     base_b = block_start + dc_flen - jnp.take_along_axis(acX, e0, 1)
 
-    def base_one(rank_row, ne_row, vals):
-        tgt = jnp.where(ne_row, rank_row, jnp.int32(1) << 30)
-        return jnp.zeros(nb, jnp.int32).at[tgt].set(
+    def base_first_one(S_row, ne_row, vals):
+        tgt = jnp.where(ne_row & (S_row < cap), S_row, jnp.int32(1) << 30)
+        return jnp.zeros(cap, jnp.int32).at[tgt].set(
             vals, mode="drop", unique_indices=True)
 
-    base_c = jax.vmap(base_one)(rank, nonempty, base_b)
-    estart = (jnp.take_along_axis(base_c, jnp.clip(r, 0, nb - 1), 1)
-              + ac_excl)
-    estart = jnp.where(ac_live, estart, 0)
+    base_at_first = jax.vmap(base_first_one)(S, nonempty, base_b)
+    carried = jax.lax.cummax(base_at_first, axis=1)
+    estart = jnp.where(ac_live, carried + ac_excl, 0)
+
+    oob = jnp.int32(1) << 30
 
     def deposit(words, val, length, start):
         w = start >> 5
@@ -792,11 +812,15 @@ def huffman_pack(y, cb, cr, cap: int, cap_words: int,
         sh1 = 64 - rb - length
         c1 = jnp.where(
             sh1 < 32, jnp.left_shift(val, jnp.maximum(sh1, 0) & 31), 0)
+        # Route dead lanes (zero-length fields; second words the field
+        # never crosses into) out of bounds: drop-mode scatters skip
+        # them, and most fields are < 32 bits so this halves the
+        # effective update stream.
         live = length > 0
-        c0 = jnp.where(live, c0, 0)
-        c1 = jnp.where(live, c1, 0)
-        words = words.at[w].add(c0, mode="drop")
-        words = words.at[w + 1].add(c1, mode="drop")
+        w0 = jnp.where(live, w, oob)
+        w1 = jnp.where(live & (rb + length > 32), w + 1, oob)
+        words = words.at[w0].add(c0, mode="drop")
+        words = words.at[w1].add(c1, mode="drop")
         return words
 
     def pack_one(dcv, dcl, bst, bac, ev_, el_, zv, zlen, mv, ml, est):

@@ -157,6 +157,54 @@ class TestMeshRenderer:
             np.testing.assert_array_equal(a, b)
 
 
+class TestMeshRendererTorture:
+    def test_mixed_concurrent_load(self):
+        """Mixed sizes, channel counts, packed + JPEG, simultaneously:
+        every request completes with its own correct result (the group
+        builder must never cross-contaminate padded batches)."""
+        import io
+
+        from PIL import Image
+
+        from omero_ms_image_region_tpu.ops.render import render_tile_packed
+        from omero_ms_image_region_tpu.parallel.serve import MeshRenderer
+
+        mesh = _mesh(chan_parallel=2)
+        renderer = MeshRenderer(mesh, linger_ms=1.0)
+        rng = np.random.default_rng(11)
+        jobs = []
+        for i in range(12):
+            # Decorrelate channel count from the packed/JPEG flag so both
+            # paths see both C=2 (no chan padding) and C=3 (3 -> 4 pad).
+            C = 2 + ((i // 2) % 2)
+            h, w = [(16, 16), (24, 40), (32, 48)][i % 3]
+            tile = rng.integers(0, 60000, (C, h, w)).astype(np.float32)
+            s = _settings(C, [(0, 30000 + 5000 * (i % 4))] * C)
+            jobs.append((tile, s, i % 2 == 0))  # alternate packed/JPEG
+
+        async def go():
+            async def one(tile, s, packed):
+                if packed:
+                    return await renderer.render(tile, s)
+                return await renderer.render_jpeg(
+                    tile, s, 85, tile.shape[2], tile.shape[1])
+            return await asyncio.gather(*(one(*j) for j in jobs))
+
+        outs = run(go())
+        with jax.default_device(next(iter(mesh.devices.flat))):
+            for (tile, s, packed), out in zip(jobs, outs):
+                if packed:
+                    expect = np.asarray(render_tile_packed(
+                        tile, s["window_start"], s["window_end"],
+                        s["family"], s["coefficient"], s["reverse"],
+                        s["cd_start"], s["cd_end"], s["tables"]))
+                    np.testing.assert_array_equal(out, expect)
+                else:
+                    img = Image.open(io.BytesIO(out))
+                    assert img.size == (tile.shape[2], tile.shape[1])
+        assert renderer.tiles_rendered == len(jobs)
+
+
 class TestMeshServingHTTP:
     def test_request_served_by_mesh_renderer(self, tmp_path):
         from aiohttp.test_utils import TestClient, TestServer

@@ -647,8 +647,9 @@ def huffman_pack(y, cb, cr, cap: int, cap_words: int,
     components), so only ~Huffman-entropy bytes cross the link and the
     host merely 0xFF-stuffs and frames (``jfif.finish_fixed_stream``).
 
-    The round-1 device-Huffman path paid a deposit scatter for EVERY
-    coefficient slot (~15M updates/tile).  Here all per-entry work runs
+    The legacy full-grid device-Huffman path (``_bitpack_fixed``) paid a
+    deposit scatter for EVERY coefficient slot (~15M updates/tile).
+    Here all per-entry work runs
     on the ``cap``-sized COMPACTED stream (one unique-index set-scatter,
     the same trick as ``sparse_pack``), and the bit deposits touch
     ~1.3M update slots/tile: three AC sub-fields (main code+amplitude,

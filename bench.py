@@ -246,18 +246,21 @@ def bench_flagship(rng):
     # the tiles/sec a co-located deployment's device pipeline sustains
     # before the (local, fast) wire even matters.
     tick = jax.jit(lambda x: x.ravel()[:1] + 1)
+    # Content varies per (engine, rep) WITHOUT re-uploading: a jitted
+    # XOR perturbs the already-resident batches on device (only the
+    # scalar mask crosses the link), so a content-memoizing relay never
+    # sees a repeat and the probe costs no upload bandwidth.  XOR keeps
+    # the uint16 content class (no saturation wrap).
+    perturb = jax.jit(lambda x, m: x ^ m)
     exec_ms = {}
-    for eng in ("sparse", "huffman"):
+    for ei, eng in enumerate(("sparse", "huffman")):
         deltas = []
         for k in range(5):
-            # XOR the low bit: distinct content per rep (defeats relay
-            # memoization) without wrapping saturated uint16 pixels the
-            # way an add would.
-            fresh = jax.device_put(
-                raw_batches[k % n_batches] ^ np.uint16(k + 1))
-            # Force the upload to complete BEFORE the timing window —
-            # otherwise the RTT tick absorbs it and the subtraction goes
-            # negative.
+            mask = np.uint16(1 + k + 8 * ei)   # unique across both loops
+            fresh = perturb(dev_raw[k % n_batches], mask)
+            # Force the perturbation to complete BEFORE the timing
+            # window — otherwise the RTT tick absorbs it and the
+            # subtraction goes negative.
             np.asarray(fresh.ravel()[:1])
             t0 = time.perf_counter()
             np.asarray(tick(fresh))

@@ -505,14 +505,23 @@ class ImageRegionHandler:
             pixels.size_z, pixels.size_c, pixels.size_t)
         type_max = pixels.type_range()[1]
 
-        def run() -> np.ndarray:
+        def run():
+            import jax.numpy as jnp
             out = []
             for c in active:
+                # Span semantics: stack read + async device dispatch.
+                # The projection kernel itself completes under the
+                # downstream Renderer.renderAsPackedInt span (the planes
+                # stay device-resident; jax dispatch returns early).
                 with stopwatch("ProjectionService.projectStack"):
                     stack = src.get_stack(c, ctx.t).astype(np.float32)
-                    out.append(np.asarray(projection_ops.project_stack(
-                        stack, ctx.projection, start, end, 1, type_max)))
-            return np.stack(out)
+                    out.append(projection_ops.project_stack(
+                        stack, ctx.projection, start, end, 1, type_max))
+            # Stays device-resident: the projected planes feed straight
+            # into the render/JPEG dispatch (the batcher stacks on device
+            # when members are resident), so full-plane f32 pixels never
+            # cross the host link between the two stages.
+            return jnp.stack(out)
 
         raw = await asyncio.to_thread(run)
         return raw, RegionDef(0, 0, pixels.size_x, pixels.size_y)

@@ -226,6 +226,17 @@ class TestImageRegionHandler:
             _ctx(format="png", c="1|0:60000$FF0000,-2|0:60000$00FF00"))))
         assert (rgba[..., 0].astype(int) >= single[..., 0].astype(int)).all()
 
+    def test_projection_intmax_jpeg_device_resident(self, services):
+        """Projection feeds the device JPEG path without a host hop:
+        the projected planes stay jax-resident into the fused dispatch."""
+        handler = ImageRegionHandler(services)
+        data = run(handler.render_image_region(
+            _ctx(format="jpeg", p="intmax|0:3",
+                 c="1|0:60000$FF0000,-2|0:60000$00FF00")))
+        assert data[:2] == b"\xff\xd8"
+        rgba = codecs.decode_to_rgba(data)
+        assert rgba.shape == (H, W, 4)
+
     def test_greyscale_model(self, services):
         handler = ImageRegionHandler(services)
         data = run(handler.render_image_region(

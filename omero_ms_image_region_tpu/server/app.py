@@ -93,7 +93,10 @@ def create_app(config: Optional[AppConfig] = None,
                             "to the direct renderer; the mesh renderer "
                             "uses the sparse engine")
                 engine = "sparse"
-            cluster.initialize()
+            cluster.initialize(
+                coordinator_address=config.parallel.coordinator_address,
+                num_processes=config.parallel.num_processes,
+                process_id=config.parallel.process_id)
             mesh = cluster.global_mesh(
                 chan_parallel=config.parallel.chan_parallel,
                 n_devices=config.parallel.n_devices)

@@ -74,6 +74,13 @@ class ParallelConfig:
     # jax.distributed).  A number requests that mesh width, falling back
     # to the virtual host mesh when the default platform is narrower.
     n_devices: Optional[int] = None
+    # Explicit jax.distributed coordinates for multi-host deployments
+    # outside auto-discovering environments (TPU pods, Slurm, K8s).
+    # When coordinator-address is set, a failed cluster join is LOUD —
+    # the service refuses to silently serve standalone.
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
 
 @dataclass
@@ -215,9 +222,19 @@ class AppConfig:
                                       par_defaults.chan_parallel)),
             n_devices=(int(par["n-devices"])
                        if par.get("n-devices") is not None else None),
+            coordinator_address=par.get("coordinator-address"),
+            num_processes=(int(par["num-processes"])
+                           if par.get("num-processes") is not None
+                           else None),
+            process_id=(int(par["process-id"])
+                        if par.get("process-id") is not None else None),
         )
         if cfg.parallel.chan_parallel < 1:
             raise ValueError("parallel.chan-parallel must be >= 1")
+        if (cfg.parallel.coordinator_address is not None
+                and cfg.parallel.num_processes is None):
+            raise ValueError("parallel.coordinator-address requires "
+                             "num-processes and process-id")
         rd = raw.get("renderer", {}) or {}
         rd_defaults = RendererConfig()
         cfg.renderer = RendererConfig(

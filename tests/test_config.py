@@ -121,3 +121,20 @@ def test_jpeg_engine_auto_accepted():
     assert cfg.renderer.jpeg_engine == "auto"
     with pytest.raises(ValueError):
         AppConfig.from_dict({"renderer": {"jpeg-engine": "turbo"}})
+
+
+def test_parallel_cluster_coordinates():
+    import pytest
+
+    from omero_ms_image_region_tpu.server.config import AppConfig
+
+    cfg = AppConfig.from_dict({"parallel": {
+        "enabled": True, "coordinator-address": "host0:8476",
+        "num-processes": 4, "process-id": 2}})
+    assert cfg.parallel.coordinator_address == "host0:8476"
+    assert cfg.parallel.num_processes == 4
+    assert cfg.parallel.process_id == 2
+    assert AppConfig.from_dict({}).parallel.coordinator_address is None
+    with pytest.raises(ValueError):
+        AppConfig.from_dict({"parallel": {
+            "coordinator-address": "host0:8476"}})

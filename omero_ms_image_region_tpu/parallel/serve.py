@@ -84,7 +84,7 @@ class MeshRenderer(BatchingRenderer):
 
     def __init__(self, mesh: Mesh, max_batch: int | None = None,
                  linger_ms: float = 2.0, buckets=None,
-                 jpeg_engine: str = "sparse"):
+                 jpeg_engine: str = "sparse", pipeline_depth: int = 2):
         data = mesh.shape["data"]
         if max_batch is None:
             max_batch = max(8, 2 * data)
@@ -93,31 +93,39 @@ class MeshRenderer(BatchingRenderer):
                              f"'huffman', got {jpeg_engine!r}")
         kwargs = {} if buckets is None else {"buckets": buckets}
         super().__init__(max_batch=max_batch, linger_ms=linger_ms,
-                         **kwargs)
+                         pipeline_depth=pipeline_depth, **kwargs)
         self.mesh = mesh
         self.jpeg_engine = jpeg_engine
+        import threading
+        # Group renders run on up to pipeline_depth concurrent worker
+        # threads; without the lock a cold start would build (and
+        # mesh-wide-compile) the same step twice.
+        self._steps_lock = threading.Lock()
         self._render_steps: dict = {}
         self._jpeg_steps: dict = {}
 
     # ------------------------------------------------------------- steps
 
     def _render_step(self):
-        step = self._render_steps.get("render")
-        if step is None:
-            step = self._render_steps["render"] = \
-                render_step_sharded_batched(self.mesh)
-        return step
+        with self._steps_lock:
+            step = self._render_steps.get("render")
+            if step is None:
+                step = self._render_steps["render"] = \
+                    render_step_sharded_batched(self.mesh)
+            return step
 
     def _jpeg_step(self, quality: int, cap: int, engine: str = "sparse",
                    cap_words: int | None = None):
         key = (engine, quality, cap, cap_words)
-        step = self._jpeg_steps.get(key)
-        if step is None:
-            step = self._jpeg_steps[key] = \
-                render_jpeg_step_sharded_batched(self.mesh, quality,
-                                                 cap=cap, engine=engine,
-                                                 cap_words=cap_words)
-        return step
+        with self._steps_lock:
+            step = self._jpeg_steps.get(key)
+            if step is None:
+                step = self._jpeg_steps[key] = \
+                    render_jpeg_step_sharded_batched(self.mesh, quality,
+                                                     cap=cap,
+                                                     engine=engine,
+                                                     cap_words=cap_words)
+            return step
 
     # ------------------------------------------------------------ groups
 
@@ -147,8 +155,7 @@ class MeshRenderer(BatchingRenderer):
         with stopwatch("Renderer.renderAsPackedInt.mesh"):
             out = self._render_step()(*args)
             host = np.asarray(out)
-        self.batches_dispatched += 1
-        self.tiles_rendered += n
+        self._count_batch(n)
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
 
     @staticmethod
@@ -196,8 +203,7 @@ class MeshRenderer(BatchingRenderer):
         jpegs = finish_sparse_to_jpegs(
             bufs, [(p.w, p.h) for p in group], H, W, quality, cap,
             lambda i: self._dense_coefficients(raw, stacked, qy, qc, i))
-        self.batches_dispatched += 1
-        self.tiles_rendered += n
+        self._count_batch(n)
         return jpegs
 
     def _render_group_jpeg_huffman(self, group, raw, stacked, H, W, cap,
@@ -226,6 +232,5 @@ class MeshRenderer(BatchingRenderer):
         jpegs = finish_huffman_batch(
             bufs, [(p.w, p.h) for p in group], H, W, quality, cap,
             cap_words, dense_fallback=dense_tile)
-        self.batches_dispatched += 1
-        self.tiles_rendered += n
+        self._count_batch(n)
         return jpegs

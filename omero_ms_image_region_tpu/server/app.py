@@ -112,7 +112,8 @@ def create_app(config: Optional[AppConfig] = None,
             renderer = MeshRenderer(
                 mesh, max_batch=config.batcher.max_batch,
                 linger_ms=config.batcher.linger_ms,
-                jpeg_engine=engine)
+                jpeg_engine=engine,
+                pipeline_depth=config.batcher.pipeline_depth)
         elif config.batcher.enabled:
             engine = config.renderer.jpeg_engine
             if engine == "bitpack":
@@ -128,7 +129,8 @@ def create_app(config: Optional[AppConfig] = None,
             renderer = BatchingRenderer(
                 max_batch=config.batcher.max_batch,
                 linger_ms=config.batcher.linger_ms,
-                jpeg_engine=engine)
+                jpeg_engine=engine,
+                pipeline_depth=config.batcher.pipeline_depth)
         else:
             engine = config.renderer.jpeg_engine
             if engine == "auto":

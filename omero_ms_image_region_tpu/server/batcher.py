@@ -74,20 +74,34 @@ class BatchingRenderer:
     """
 
     def __init__(self, max_batch: int = 8, linger_ms: float = 2.0,
-                 buckets=DEFAULT_BUCKETS, jpeg_engine: str = "sparse"):
+                 buckets=DEFAULT_BUCKETS, jpeg_engine: str = "sparse",
+                 pipeline_depth: int = 2):
         if jpeg_engine not in ("sparse", "huffman"):
             raise ValueError(
                 f"batched jpeg engine must be 'sparse' or 'huffman', "
                 f"got {jpeg_engine!r}")
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         self.max_batch = max_batch
         self.linger_ms = linger_ms
         self.jpeg_engine = jpeg_engine
+        self.pipeline_depth = pipeline_depth
         self.buckets = tuple(buckets)
         self._queues: Dict[tuple, Deque[_Pending]] = {}
         self._dispatchers: Dict[tuple, asyncio.Task] = {}
         self._wakeups: Dict[tuple, asyncio.Event] = {}
+        self._inflight: set = set()
+        import threading
+        self._stats_lock = threading.Lock()
         self.batches_dispatched = 0
         self.tiles_rendered = 0
+
+    def _count_batch(self, tiles: int) -> None:
+        """Metrics update; group renders run concurrently on worker
+        threads, so the increments need the lock."""
+        with self._stats_lock:
+            self.batches_dispatched += 1
+            self.tiles_rendered += tiles
 
     # ------------------------------------------------------------- public
 
@@ -157,6 +171,12 @@ class BatchingRenderer:
             task.cancel()
         await asyncio.gather(*self._dispatchers.values(),
                              return_exceptions=True)
+        # In-flight group renders run on worker threads and cannot be
+        # interrupted; await them so their futures resolve (results or
+        # errors) rather than cancelling out from under the waiters.
+        if self._inflight:
+            await asyncio.gather(*tuple(self._inflight),
+                                 return_exceptions=True)
         # Fail any requests still queued so their awaiters don't hang
         # across shutdown.
         for queue in self._queues.values():
@@ -172,8 +192,17 @@ class BatchingRenderer:
     # --------------------------------------------------------- dispatcher
 
     async def _dispatch_loop(self, key: tuple) -> None:
+        """Drain the key's queue into group renders.
+
+        Up to ``pipeline_depth`` group renders run concurrently (each on
+        its own worker thread): group k+1's device dispatch overlaps
+        group k's wire fetch and host entropy encode — the render
+        functions release the GIL in those stages — so the device never
+        idles behind host work under sustained load.
+        """
         queue = self._queues[key]
         wakeup = self._wakeups[key]
+        slots = asyncio.Semaphore(self.pipeline_depth)
         while True:
             if not queue:
                 wakeup.clear()
@@ -182,32 +211,37 @@ class BatchingRenderer:
             # but never linger when a full batch is already waiting.
             if len(queue) < self.max_batch and self.linger_ms > 0:
                 await asyncio.sleep(self.linger_ms / 1000.0)
+            await slots.acquire()
+            # No awaits between popping the group and handing it to its
+            # task, so a close() cancellation (delivered only at the
+            # loop's await points) can never orphan a popped group.
             group: List[_Pending] = []
             while queue and len(group) < self.max_batch:
                 group.append(queue.popleft())
             if not group:
+                slots.release()
                 continue
-            try:
-                render = (self._render_group_jpeg if key[0] == "jpeg"
-                          else self._render_group)
-                results = await asyncio.to_thread(render, group)
-            except asyncio.CancelledError:
-                # close() cancelled us mid-dispatch: the group is already
-                # popped, so the queue drain in close() can't see it —
-                # fail its futures here before propagating.
-                for p in group:
-                    if not p.future.done():
-                        p.future.set_exception(
-                            asyncio.CancelledError("renderer shut down"))
-                raise
-            except Exception as e:  # propagate to every waiter
-                for p in group:
-                    if not p.future.done():
-                        p.future.set_exception(e)
-                continue
-            for p, out in zip(group, results):
+            render = (self._render_group_jpeg if key[0] == "jpeg"
+                      else self._render_group)
+            task = asyncio.create_task(
+                self._run_group(render, group, slots))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+
+    async def _run_group(self, render, group: List[_Pending],
+                         slots: asyncio.Semaphore) -> None:
+        try:
+            results = await asyncio.to_thread(render, group)
+        except Exception as e:  # propagate to every waiter
+            for p in group:
                 if not p.future.done():
-                    p.future.set_result(out)
+                    p.future.set_exception(e)
+            return
+        finally:
+            slots.release()
+        for p, out in zip(group, results):
+            if not p.future.done():
+                p.future.set_result(out)
 
     def _group_arrays(self, group: List[_Pending]):
         """Pad the batch to a power of two (repeating the last tile;
@@ -238,8 +272,7 @@ class BatchingRenderer:
                 s0["cd_start"], s0["cd_end"], stack("tables"),
             )
             host = np.asarray(out)
-        self.batches_dispatched += 1
-        self.tiles_rendered += n
+        self._count_batch(n)
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
 
     def _render_group_jpeg(self, group: List[_Pending]) -> List[bytes]:
@@ -257,6 +290,5 @@ class BatchingRenderer:
                 dims=[(p.w, p.h) for p in group],  # pad tiles skip encode
                 engine=self.jpeg_engine,
             )
-        self.batches_dispatched += 1
-        self.tiles_rendered += n
+        self._count_batch(n)
         return jpegs

@@ -24,6 +24,9 @@ class BatcherConfig:
     enabled: bool = True
     max_batch: int = 8
     linger_ms: float = 2.0
+    # Concurrent group renders per bucket key: group k+1's device
+    # dispatch overlaps group k's wire fetch + host entropy encode.
+    pipeline_depth: int = 2
 
 
 @dataclass
@@ -206,6 +209,8 @@ class AppConfig:
             enabled=bool(batcher.get("enabled", defaults.enabled)),
             max_batch=int(batcher.get("max-batch", defaults.max_batch)),
             linger_ms=float(batcher.get("linger-ms", defaults.linger_ms)),
+            pipeline_depth=int(batcher.get("pipeline-depth",
+                                           defaults.pipeline_depth)),
         )
         rc = raw.get("raw-cache", {}) or {}
         rc_defaults = RawCacheConfig()

@@ -188,3 +188,85 @@ class TestBatchingRenderer:
                 await batcher.close()
 
         run(main())
+
+
+class TestPipelining:
+    def test_groups_overlap_up_to_depth(self):
+        """With pipeline_depth=2, a second group dispatches while the
+        first is still rendering (the loop must not serialize on the
+        full render)."""
+        import threading
+
+        from omero_ms_image_region_tpu.server.batcher import (
+            BatchingRenderer)
+
+        gate = threading.Event()
+        concurrent = {"now": 0, "peak": 0}
+        lock = threading.Lock()
+
+        class SlowRenderer(BatchingRenderer):
+            def _render_group(self, group):
+                with lock:
+                    concurrent["now"] += 1
+                    concurrent["peak"] = max(concurrent["peak"],
+                                             concurrent["now"])
+                # Both groups must be in flight before either finishes.
+                if concurrent["peak"] < 2:
+                    gate.wait(timeout=30)
+                else:
+                    gate.set()
+                with lock:
+                    concurrent["now"] -= 1
+                return super()._render_group(group)
+
+        r = SlowRenderer(max_batch=1, linger_ms=0.0, pipeline_depth=2)
+        rng = np.random.default_rng(3)
+        from omero_ms_image_region_tpu.flagship import flagship_rdef
+        from omero_ms_image_region_tpu.ops.render import pack_settings
+        s = pack_settings(flagship_rdef(1))
+
+        async def go():
+            tiles = [rng.integers(0, 60000, (1, 16, 16))
+                     .astype(np.float32) for _ in range(2)]
+            return await asyncio.gather(
+                *(r.render(t, s) for t in tiles))
+
+        outs = asyncio.run(go())
+        assert concurrent["peak"] == 2
+        assert all(o.shape == (16, 16) for o in outs)
+
+    def test_depth_one_serializes(self):
+        import threading
+
+        from omero_ms_image_region_tpu.server.batcher import (
+            BatchingRenderer)
+
+        concurrent = {"now": 0, "peak": 0}
+        lock = threading.Lock()
+
+        class Probe(BatchingRenderer):
+            def _render_group(self, group):
+                with lock:
+                    concurrent["now"] += 1
+                    concurrent["peak"] = max(concurrent["peak"],
+                                             concurrent["now"])
+                try:
+                    return super()._render_group(group)
+                finally:
+                    with lock:
+                        concurrent["now"] -= 1
+
+        r = Probe(max_batch=1, linger_ms=0.0, pipeline_depth=1)
+        rng = np.random.default_rng(4)
+        from omero_ms_image_region_tpu.flagship import flagship_rdef
+        from omero_ms_image_region_tpu.ops.render import pack_settings
+        s = pack_settings(flagship_rdef(1))
+
+        async def go():
+            tiles = [rng.integers(0, 60000, (1, 16, 16))
+                     .astype(np.float32) for _ in range(4)]
+            return await asyncio.gather(
+                *(r.render(t, s) for t in tiles))
+
+        asyncio.run(go())
+        assert concurrent["peak"] == 1

@@ -346,44 +346,50 @@ def bench_service_level(rng):
     from omero_ms_image_region_tpu.server.config import (
         AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
 
-    tmp = tempfile.mkdtemp()
-    planes = synthetic_wsi_tiles(rng, 4, 1, 4096, 4096).reshape(
-        4, 1, 4096, 4096)
-    build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
-    config = AppConfig(
-        data_dir=tmp,
-        batcher=BatcherConfig(enabled=True, linger_ms=3.0),
-        raw_cache=RawCacheConfig(enabled=True, prefetch=False),
-        renderer=RendererConfig(cpu_fallback_max_px=0))
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 4, 1, 4096, 4096).reshape(
+            4, 1, 4096, 4096)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        config = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=True, linger_ms=3.0),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        return asyncio.run(_service_run(config))
 
-    async def run():
-        app = create_app(config)
-        client = TestClient(TestServer(app))
-        await client.start_server()
-        try:
-            def url(i):
-                x, y = i % 4, (i // 4) % 4
-                return (f"/webgateway/render_image_region/1/0/0"
-                        f"?tile=0,{x},{y},1024,1024&format=jpeg&m=c"
-                        f"&c=1|0:60000$FF0000,2|0:60000$00FF00,"
-                        f"3|0:50000$0000FF,4|0:45000$FFFF00")
-            # Warm: stage raw tiles into HBM + compile.
-            await asyncio.gather(*(client.get(url(i)) for i in range(16)))
-            best = None
-            for _ in range(3):
-                t0 = time.perf_counter()
-                resps = await asyncio.gather(
-                    *(client.get(url(i)) for i in range(16)))
-                assert all(r.status == 200 for r in resps)
-                for r in resps:
-                    await r.read()
-                dt = time.perf_counter() - t0
-                best = dt if best is None else min(best, dt)
-            return 16 / best
-        finally:
-            await client.close()
 
-    return asyncio.new_event_loop().run_until_complete(run())
+async def _service_run(config):
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from omero_ms_image_region_tpu.server.app import create_app
+
+    app = create_app(config)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        def url(i):
+            x, y = i % 4, (i // 4) % 4
+            return (f"/webgateway/render_image_region/1/0/0"
+                    f"?tile=0,{x},{y},1024,1024&format=jpeg&m=c"
+                    f"&c=1|0:60000$FF0000,2|0:60000$00FF00,"
+                    f"3|0:50000$0000FF,4|0:45000$FFFF00")
+        # Warm: stage raw tiles into HBM + compile.
+        await asyncio.gather(*(client.get(url(i)) for i in range(16)))
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            resps = await asyncio.gather(
+                *(client.get(url(i)) for i in range(16)))
+            assert all(r.status == 200 for r in resps)
+            for r in resps:
+                await r.read()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return 16 / best
+    finally:
+        await client.close()
 
 
 # -------------------------------------------------------------- config 1

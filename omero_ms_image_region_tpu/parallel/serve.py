@@ -91,9 +91,29 @@ class MeshRenderer(BatchingRenderer):
         if jpeg_engine not in ("sparse", "huffman"):
             raise ValueError(f"mesh jpeg engine must be 'sparse' or "
                              f"'huffman', got {jpeg_engine!r}")
+        import jax
+        multihost = jax.process_count() > 1
+        if multihost and pipeline_depth != 1:
+            # On a multi-host global mesh every process must launch the
+            # same programs in the same order (SPMD); overlapped group
+            # renders make local launch order racy, so pipelining is
+            # single-host only.
+            logger.warning("multi-host mesh: forcing pipeline_depth=1 "
+                           "(was %d) — sharded launches must not "
+                           "overlap", pipeline_depth)
+            pipeline_depth = 1
         kwargs = {} if buckets is None else {"buckets": buckets}
         super().__init__(max_batch=max_batch, linger_ms=linger_ms,
                          pipeline_depth=pipeline_depth, **kwargs)
+        if multihost:
+            # One launch slot shared across ALL bucket keys: without it,
+            # two keys' dispatchers would interleave sharded launches in
+            # a host-local order.  NOTE this serializes launches but
+            # does not by itself give every host the same group stream —
+            # multi-host pods must feed all processes an identical
+            # request schedule (see deploy/DEPLOY.md, driver process).
+            import asyncio as _asyncio
+            self._shared_slots = _asyncio.Semaphore(1)
         self.mesh = mesh
         self.jpeg_engine = jpeg_engine
         import threading

@@ -90,6 +90,10 @@ class BatchingRenderer:
         self._queues: Dict[tuple, Deque[_Pending]] = {}
         self._dispatchers: Dict[tuple, asyncio.Task] = {}
         self._wakeups: Dict[tuple, asyncio.Event] = {}
+        # When set (MeshRenderer in a multi-host pod), ONE launch slot
+        # is shared across every bucket key, so concurrent per-key
+        # dispatchers cannot interleave device launches.
+        self._shared_slots: asyncio.Semaphore | None = None
         self._inflight: set = set()
         import threading
         self._stats_lock = threading.Lock()
@@ -183,8 +187,11 @@ class BatchingRenderer:
             while queue:
                 pending = queue.popleft()
                 if not pending.future.done():
+                    # RuntimeError, not CancelledError: waiters sit in
+                    # HTTP handlers whose ``except Exception`` must map
+                    # this to a 500 instead of dropping the connection.
                     pending.future.set_exception(
-                        asyncio.CancelledError("renderer shut down"))
+                        RuntimeError("renderer shut down"))
         self._dispatchers.clear()
         self._queues.clear()
         self._wakeups.clear()
@@ -202,7 +209,7 @@ class BatchingRenderer:
         """
         queue = self._queues[key]
         wakeup = self._wakeups[key]
-        slots = asyncio.Semaphore(self.pipeline_depth)
+        slots = self._shared_slots or asyncio.Semaphore(self.pipeline_depth)
         while True:
             if not queue:
                 wakeup.clear()
@@ -230,18 +237,41 @@ class BatchingRenderer:
 
     async def _run_group(self, render, group: List[_Pending],
                          slots: asyncio.Semaphore) -> None:
-        try:
-            results = await asyncio.to_thread(render, group)
-        except Exception as e:  # propagate to every waiter
-            for p in group:
-                if not p.future.done():
-                    p.future.set_exception(e)
-            return
-        finally:
+        """Render one popped group on a worker thread.
+
+        Settlement (slot release + waiter resolution) happens in the
+        inner task's done callback, i.e. only when the worker THREAD has
+        actually finished: cancelling this task must not free the launch
+        slot while the render is still executing (on a multi-host mesh
+        the shared slot is what keeps sharded launches serialized), and
+        waiters must never see a raw CancelledError — it would bypass
+        the HTTP layer's ``except Exception`` mapping and drop the
+        connection without a response.
+        """
+        inner = asyncio.ensure_future(asyncio.to_thread(render, group))
+
+        def settle(fut: asyncio.Future) -> None:
             slots.release()
-        for p, out in zip(group, results):
-            if not p.future.done():
-                p.future.set_result(out)
+            if fut.cancelled():
+                exc: BaseException = RuntimeError("render cancelled")
+            else:
+                exc = fut.exception()
+            if exc is not None:
+                for p in group:
+                    if not p.future.done():
+                        p.future.set_exception(exc)
+                return
+            for p, out in zip(group, fut.result()):
+                if not p.future.done():
+                    p.future.set_result(out)
+
+        inner.add_done_callback(settle)
+        try:
+            await asyncio.shield(inner)
+        except asyncio.CancelledError:
+            raise  # settle() still fires when the thread finishes
+        except Exception:
+            pass   # waiters already failed by settle()
 
     def _group_arrays(self, group: List[_Pending]):
         """Pad the batch to a power of two (repeating the last tile;

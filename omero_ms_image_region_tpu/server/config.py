@@ -212,6 +212,8 @@ class AppConfig:
             pipeline_depth=int(batcher.get("pipeline-depth",
                                            defaults.pipeline_depth)),
         )
+        if cfg.batcher.pipeline_depth < 1:
+            raise ValueError("batcher.pipeline-depth must be >= 1")
         rc = raw.get("raw-cache", {}) or {}
         rc_defaults = RawCacheConfig()
         cfg.raw_cache = RawCacheConfig(

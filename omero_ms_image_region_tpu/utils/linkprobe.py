@@ -46,13 +46,36 @@ def measure_fetch_mb_s(nbytes: int = 4 << 20, repeats: int = 3) -> float:
 
 
 def resolve_auto_engine() -> str:
-    """Measure the link and return "sparse" or "huffman"."""
+    """Measure the link and return "sparse" or "huffman".
+
+    In a multi-host pod every process MUST resolve to the same engine —
+    the engines build different shard_map programs over the same global
+    mesh, and divergence hangs the pod (SPMD).  Hosts can sit on opposite
+    sides of the crossover (one fast NIC, one congested), so the local
+    rate is all-gathered and the pod-wide MINIMUM decides: the slowest
+    link is the one the sparse wire would actually stall on.
+    """
     try:
         rate = measure_fetch_mb_s()
     except Exception:
-        logger.warning("link probe failed; defaulting jpeg engine to "
-                       "'sparse'", exc_info=True)
-        return "sparse"
+        # Do NOT early-return here: in a pod every process must still
+        # join the allgather below or the others hang.  inf = "link
+        # unknown; don't drag the pod minimum down"; if every probe
+        # fails the inf survives and the >= crossover test lands on
+        # sparse, preserving the single-host failure default.
+        logger.warning("link probe failed; treating link rate as "
+                       "unknown", exc_info=True)
+        rate = float("inf")
+    import jax
+    if jax.process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        rates = np.asarray(
+            multihost_utils.process_allgather(np.float32(rate)))
+        pod_rate = float(rates.min())
+        logger.info("link probe (pod): local %.1f MB/s, pod min %.1f MB/s "
+                    "across %d hosts", rate, pod_rate, rates.size)
+        rate = pod_rate
     engine = "sparse" if rate >= AUTO_SPARSE_MIN_MB_S else "huffman"
     logger.info("link probe: %.1f MB/s device->host -> jpeg engine %r "
                 "(crossover %.0f MB/s)", rate, engine, AUTO_SPARSE_MIN_MB_S)

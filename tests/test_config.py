@@ -123,6 +123,17 @@ def test_jpeg_engine_auto_accepted():
         AppConfig.from_dict({"renderer": {"jpeg-engine": "turbo"}})
 
 
+def test_pipeline_depth_validated_at_load():
+    import pytest
+
+    from omero_ms_image_region_tpu.server.config import AppConfig
+
+    cfg = AppConfig.from_dict({"batcher": {"pipeline-depth": 3}})
+    assert cfg.batcher.pipeline_depth == 3
+    with pytest.raises(ValueError):
+        AppConfig.from_dict({"batcher": {"pipeline-depth": 0}})
+
+
 def test_parallel_cluster_coordinates():
     import pytest
 

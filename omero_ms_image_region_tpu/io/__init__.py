@@ -8,10 +8,17 @@ Re-provides the PixelBuffer/PixelsService surface the reference consumes
     re-render; ≙ ``InMemoryPlanarPixelBuffer``).
   * :class:`~.store.ChunkedPyramidStore` — an on-disk chunked, multi-
     resolution format (memmap reads, no external deps) standing in for the
-    OMERO binary repository + Bio-Formats pyramid.
+    OMERO binary repository layout.
+  * :class:`~.ometiff.OmeTiffSource` — real tiled/pyramidal OME-TIFF files
+    (plus plain TIFF), read with the in-repo container parser
+    (:mod:`.tiff`); written by :func:`~.tiffwrite.write_ome_tiff`.
+
+``PixelsService`` sniffs the backend per image directory.
 """
 
 from .pixelsource import PixelSource, TileRead  # noqa: F401
 from .memory import InMemoryPixelSource  # noqa: F401
 from .store import ChunkedPyramidStore, build_pyramid  # noqa: F401
+from .ometiff import OmeTiffSource  # noqa: F401
+from .tiffwrite import write_ome_tiff  # noqa: F401
 from .service import PixelsService  # noqa: F401

@@ -1,0 +1,315 @@
+"""OME-TIFF pixel source: serve real microscopy files directly.
+
+``OmeTiffSource`` implements the :class:`.pixelsource.PixelSource`
+protocol over a tiled/pyramidal OME-TIFF — the role Bio-Formats plays
+behind the reference's ``PixelsService.getPixelBuffer``
+(``ImageRegionRequestHandler.java:302-309``; dependency
+``build.gradle:81-83``).  With this backend the service serves existing
+OMERO exports drop-in, no re-ingest through ``build_pyramid``.
+
+Layout understood (OME-TIFF 6.0):
+
+- OME-XML in the first IFD's ImageDescription: ``Pixels`` geometry
+  (SizeX/Y/Z/C/T, DimensionOrder, Type) and optional ``TiffData``
+  plane->IFD mapping;
+- one IFD per (z, c, t) plane, ordered by DimensionOrder when no
+  TiffData elements are present;
+- pyramid levels as SubIFD chains (tag 330) of each plane IFD;
+- plain (non-OME) TIFFs degrade gracefully: pages become Z sections of
+  a single channel, or channels when SamplesPerPixel > 1.
+
+Decoded segments go through a bounded per-source LRU so pans that
+straddle tile boundaries do not re-inflate the same compressed tile.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import xml.etree.ElementTree as ET
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..server.region import RegionDef
+from .tiff import (IMAGE_DESCRIPTION, SAMPLES_PER_PIXEL, Ifd, TiffFile)
+
+# OME pixel Type values are exactly the OMERO pixels-type names the
+# render path already understands (models/pixels.py dtype table).
+_OME_TYPES = {"int8", "int16", "int32", "uint8", "uint16", "uint32",
+              "float", "double", "bit"}
+
+_SEG_CACHE_BYTES = 64 << 20
+
+
+def _localname(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def _find_pixels(root: ET.Element) -> Optional[ET.Element]:
+    for el in root.iter():
+        if _localname(el.tag) == "Pixels":
+            return el
+    return None
+
+
+class OmeTiffSource:
+    """PixelSource over one OME-TIFF (or plain TIFF) file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._tf = TiffFile(path)
+        self._lock = threading.Lock()
+        self._seg_cache: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        self._seg_cache_bytes = 0
+        self._parse_layout()
+
+    # ------------------------------------------------------------- layout
+
+    def _parse_layout(self) -> None:
+        tf = self._tf
+        first = tf.ifds[0]
+        desc = first.one(IMAGE_DESCRIPTION, "") or ""
+        self.size_z = self.size_c = self.size_t = 1
+        self.dimension_order = "XYZCT"
+        self.pixels_type: Optional[str] = None
+        self._interleaved_c = False   # channels live in SamplesPerPixel
+        plane_map: Dict[Tuple[int, int, int], int] = {}
+        spp = int(first.one(SAMPLES_PER_PIXEL, 1))
+
+        px = None
+        if "<OME" in desc or "<ome" in desc:
+            try:
+                root = ET.fromstring(desc)
+            except ET.ParseError:
+                root = None
+            px = _find_pixels(root) if root is not None else None
+
+        if px is not None:
+            self.size_z = int(px.get("SizeZ", 1))
+            self.size_c = int(px.get("SizeC", 1))
+            self.size_t = int(px.get("SizeT", 1))
+            order = px.get("DimensionOrder", "XYZCT")
+            if (len(order) == 5 and order[:2] == "XY"
+                    and set(order[2:]) == set("ZCT")):
+                self.dimension_order = order
+            ptype = (px.get("Type") or "").lower()
+            if ptype and ptype not in _OME_TYPES:
+                raise ValueError(
+                    f"{self.path}: unsupported OME pixel type {ptype!r}")
+            self.pixels_type = ptype or None
+            # Interleaved detection must precede TiffData mapping: with
+            # channels in SamplesPerPixel, C is not an IFD dimension and
+            # _advance() must not enumerate it.
+            if spp > 1 and self.size_c == spp and len(tf.ifds) < (
+                    self.size_z * self.size_c * self.size_t):
+                self._interleaved_c = True
+            for td in px:
+                if _localname(td.tag) != "TiffData":
+                    continue
+                # Multi-file OME-TIFF (UUID FileName elsewhere) is
+                # out of scope; same-file TiffData maps plane->IFD.
+                fz = int(td.get("FirstZ", 0))
+                fc = int(td.get("FirstC", 0))
+                ft = int(td.get("FirstT", 0))
+                ifd0 = int(td.get("IFD", 0))
+                if td.get("PlaneCount") is not None:
+                    count = int(td.get("PlaneCount"))
+                elif td.get("IFD") is not None:
+                    count = 1            # spec: IFD without PlaneCount
+                else:
+                    count = self._n_ifd_planes()
+                for k in range(count):
+                    z, c, t = self._advance(fz, fc, ft, k)
+                    plane_map[(z, c, t)] = ifd0 + k
+        else:
+            # Plain TIFF: pages = Z sections; chunky RGB = channels.
+            if spp > 1:
+                self.size_c = spp
+                self._interleaved_c = True
+            self.size_z = len(tf.ifds)
+        if self.pixels_type is None:
+            self.pixels_type = {
+                "uint8": "uint8", "uint16": "uint16", "uint32": "uint32",
+                "int8": "int8", "int16": "int16", "int32": "int32",
+                "float32": "float", "float64": "double",
+            }[np.dtype(first.dtype()).name]
+
+        n_ifd_planes = self._n_ifd_planes()
+        if len(tf.ifds) < n_ifd_planes:
+            raise ValueError(
+                f"{self.path}: {len(tf.ifds)} IFDs < {n_ifd_planes} "
+                f"planes declared by OME metadata")
+        if not plane_map:
+            for i in range(n_ifd_planes):
+                plane_map[self._plane_of_index(i)] = i
+        self._plane_map = plane_map
+
+        # Pyramid: SubIFD chain of each plane IFD (OME-TIFF 6.0).  Level
+        # dims come from the first plane; every plane must agree.
+        subs = tf.sub_ifds(first)
+        self._n_levels = 1 + len(subs)
+        self._level_dims: List[Tuple[int, int]] = [
+            (first.width, first.height)
+        ] + [(s.width, s.height) for s in subs]
+        self._level_ifds: Dict[Tuple[int, int], Ifd] = {}
+
+    def _n_ifd_planes(self) -> int:
+        """Planes that occupy their own IFD (interleaved C shares one)."""
+        return (self.size_z * self.size_t if self._interleaved_c
+                else self.size_z * self.size_c * self.size_t)
+
+    def _order_dims(self):
+        sizes = {"Z": self.size_z, "C": self.size_c, "T": self.size_t}
+        if self._interleaved_c:
+            sizes = {"Z": self.size_z, "C": 1, "T": self.size_t}
+        return [(d, sizes[d]) for d in self.dimension_order[2:]]
+
+    def _plane_of_index(self, i: int) -> Tuple[int, int, int]:
+        coords = {"Z": 0, "C": 0, "T": 0}
+        for dim, size in self._order_dims():
+            coords[dim] = i % size
+            i //= size
+        return coords["Z"], coords["C"], coords["T"]
+
+    def _advance(self, z: int, c: int, t: int, k: int
+                 ) -> Tuple[int, int, int]:
+        """plane (z,c,t) advanced k steps in DimensionOrder."""
+        coords = {"Z": z, "C": c, "T": t}
+        idx = 0
+        mult = 1
+        for dim, size in self._order_dims():
+            idx += coords[dim] * mult
+            mult *= size
+        idx += k
+        return self._plane_of_index(idx)
+
+    def _ifd_for(self, z: int, c: int, t: int, level: int) -> Ifd:
+        key_c = 0 if self._interleaved_c else c
+        try:
+            page = self._plane_map[(z, key_c, t)]
+        except KeyError:
+            raise ValueError(
+                f"{self.path}: no IFD for plane z={z} c={c} t={t}")
+        key = (page, level)
+        ifd = self._level_ifds.get(key)
+        if ifd is None:
+            base = self._tf.ifds[page]
+            if level == 0:
+                ifd = base
+            else:
+                subs = self._tf.sub_ifds(base)
+                if level - 1 >= len(subs):
+                    raise ValueError(
+                        f"{self.path}: page {page} has no level {level}")
+                ifd = subs[level - 1]
+            with self._lock:
+                self._level_ifds[key] = ifd
+        return ifd
+
+    # ----------------------------------------------------------- protocol
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._tf.ifds[0].dtype()
+
+    def resolution_levels(self) -> int:
+        return self._n_levels
+
+    def resolution_descriptions(self) -> List[Tuple[int, int]]:
+        return list(self._level_dims)
+
+    def tile_size(self) -> Tuple[int, int]:
+        ifd = self._tf.ifds[0]
+        if not ifd.tiled:
+            # Strips: serve a square default rather than a width x rows
+            # sliver (the reference's server-side tile-size default,
+            # ``ImageRegionRequestHandler.java:797``).
+            return (min(1024, ifd.width), min(1024, ifd.height))
+        seg_h, seg_w, _, _ = self._tf.segment_grid(ifd)
+        return (seg_w, seg_h)
+
+    def _segment(self, ifd: Ifd, page_key: tuple, gy: int, gx: int
+                 ) -> np.ndarray:
+        key = (page_key, gy, gx)
+        with self._lock:
+            seg = self._seg_cache.get(key)
+            if seg is not None:
+                self._seg_cache.move_to_end(key)
+                return seg
+        seg = self._tf.read_segment(ifd, gy, gx)
+        with self._lock:
+            if key not in self._seg_cache:
+                self._seg_cache[key] = seg
+                self._seg_cache_bytes += seg.nbytes
+                while self._seg_cache_bytes > _SEG_CACHE_BYTES:
+                    _, old = self._seg_cache.popitem(last=False)
+                    self._seg_cache_bytes -= old.nbytes
+        return seg
+
+    def get_region(self, z: int, c: int, t: int, region: RegionDef,
+                   level: int = 0) -> np.ndarray:
+        sx, sy = self._level_dims[level]
+        x0, y0 = region.x, region.y
+        x1, y1 = x0 + region.width, y0 + region.height
+        if not (0 <= x0 <= x1 <= sx and 0 <= y0 <= y1 <= sy):
+            raise ValueError(
+                f"region {region.as_tuple()} outside level {level} "
+                f"bounds ({sx}x{sy})")
+        ifd = self._ifd_for(z, c, t, level)
+        seg_h, seg_w, grid_y, grid_x = self._tf.segment_grid(ifd)
+        sample = c if self._interleaved_c else 0
+        out = np.empty((region.height, region.width), dtype=self.dtype)
+        page_key = (z, 0 if self._interleaved_c else c, t, level)
+        for gy in range(y0 // seg_h, min(grid_y, -(-y1 // seg_h))):
+            for gx in range(x0 // seg_w, min(grid_x, -(-x1 // seg_w))):
+                cy0, cx0 = gy * seg_h, gx * seg_w
+                ix0, ix1 = max(x0, cx0), min(x1, cx0 + seg_w)
+                iy0, iy1 = max(y0, cy0), min(y1, cy0 + seg_h)
+                if ix0 >= ix1 or iy0 >= iy1:
+                    continue
+                seg = self._segment(ifd, page_key, gy, gx)
+                out[iy0 - y0:iy1 - y0, ix0 - x0:ix1 - x0] = \
+                    seg[iy0 - cy0:iy1 - cy0, ix0 - cx0:ix1 - cx0, sample]
+        return out
+
+    def get_stack(self, c: int, t: int) -> np.ndarray:
+        sx, sy = self._level_dims[0]
+        region = RegionDef(0, 0, sx, sy)
+        return np.stack([
+            self.get_region(z, c, t, region, 0)
+            for z in range(self.size_z)
+        ])
+
+    def close(self) -> None:
+        with self._lock:
+            self._seg_cache.clear()
+            self._seg_cache_bytes = 0
+        self._tf.close()          # idempotent (file.close() is)
+
+    def __del__(self):  # pragma: no cover - GC timing
+        # The PixelsService LRU drops evicted sources WITHOUT closing
+        # them (an in-flight request may still be reading); the last
+        # reference closes the file handle here.
+        try:
+            self._tf.close()
+        except Exception:
+            pass
+
+
+_TIFF_RE = re.compile(r"\.(ome\.)?tiff?$", re.IGNORECASE)
+
+
+def find_tiff(image_dir: str) -> Optional[str]:
+    """The image directory's TIFF file, if it holds one (sniffing seam
+    used by ``PixelsService`` and ``LocalMetadataService``)."""
+    import os
+    if not os.path.isdir(image_dir):
+        return None
+    names = sorted(n for n in os.listdir(image_dir) if _TIFF_RE.search(n))
+    # Prefer .ome.tif(f) over plain .tif(f) when both are present.
+    for name in names:
+        if ".ome." in name.lower():
+            return os.path.join(image_dir, name)
+    return os.path.join(image_dir, names[0]) if names else None

@@ -14,6 +14,7 @@ import threading
 from collections import OrderedDict
 from typing import Optional
 
+from .ometiff import OmeTiffSource, find_tiff
 from .pixelsource import PixelSource
 from .store import ChunkedPyramidStore
 
@@ -23,20 +24,32 @@ DEFAULT_MAX_OPEN = 128
 class PixelsService:
     """Opens pixel sources from a data directory, with a bounded LRU handle
     cache (each open store holds live memmaps, so the bound caps fds and
-    address space on long-running servers)."""
+    address space on long-running servers).
+
+    Backend is sniffed per image directory: a ``meta.json`` selects the
+    chunked pyramid store; otherwise an ``*.ome.tif(f)`` / ``*.tif(f)``
+    file selects the OME-TIFF reader — the role Bio-Formats format
+    dispatch plays behind ``PixelsService.getPixelBuffer``
+    (``ImageRegionRequestHandler.java:302-309``)."""
 
     def __init__(self, data_dir: str, max_open: int = DEFAULT_MAX_OPEN):
         self.data_dir = data_dir
         self.max_open = max_open
         self._lock = threading.Lock()
-        self._open: "OrderedDict[int, ChunkedPyramidStore]" = OrderedDict()
+        self._open: "OrderedDict[int, PixelSource]" = OrderedDict()
 
     def image_dir(self, image_id: int) -> str:
         return os.path.join(self.data_dir, str(image_id))
 
+    def _sniff(self, image_id: int) -> Optional[str]:
+        """"chunked" | path-to-tiff | None."""
+        d = self.image_dir(image_id)
+        if os.path.exists(os.path.join(d, "meta.json")):
+            return "chunked"
+        return find_tiff(d)
+
     def exists(self, image_id: int) -> bool:
-        return os.path.exists(os.path.join(self.image_dir(image_id),
-                                           "meta.json"))
+        return self._sniff(image_id) is not None
 
     def get_pixel_source(self, image_id: int) -> PixelSource:
         """≙ ``PixelsService.getPixelBuffer(pixels, false)``."""
@@ -45,12 +58,16 @@ class PixelsService:
             if src is not None:
                 self._open.move_to_end(image_id)
                 return src
-        if not self.exists(image_id):
+        backend = self._sniff(image_id)
+        if backend is None:
             raise FileNotFoundError(
                 f"no pixel data for image {image_id} under "
                 f"{self.data_dir}"
             )
-        src = ChunkedPyramidStore(self.image_dir(image_id))
+        if backend == "chunked":
+            src = ChunkedPyramidStore(self.image_dir(image_id))
+        else:
+            src = OmeTiffSource(backend)
         with self._lock:
             # Double-check: a concurrent opener may have won the race;
             # keep theirs and drop ours so no store leaks its memmaps.
@@ -61,8 +78,12 @@ class PixelsService:
                 return existing
             self._open[image_id] = src
             while len(self._open) > self.max_open:
-                _, evicted = self._open.popitem(last=False)
-                evicted.close()
+                # Drop WITHOUT close(): a concurrent request may still be
+                # mid-read on the evicted source (close would yank the
+                # TIFF file handle out from under it).  The last live
+                # reference releases the handle via the source's
+                # finalizer; memmap-backed stores release on GC anyway.
+                self._open.popitem(last=False)
         return src
 
     def close(self) -> None:

@@ -60,6 +60,9 @@ class LocalMetadataService:
 
     def __init__(self, data_dir: str):
         self.data_dir = data_dir
+        # (path, mtime_ns)-validated Pixels memo for TIFF-backed images
+        # (the chunked path's meta.json read is cheap enough bare).
+        self._tiff_pixels: Dict[int, tuple] = {}
 
     def _image_dir(self, image_id: int) -> str:
         return os.path.join(self.data_dir, str(image_id))
@@ -71,19 +74,55 @@ class LocalMetadataService:
                                      session_key: Optional[str]
                                      ) -> Optional[Pixels]:
         meta_path = os.path.join(self._image_dir(image_id), "meta.json")
-        if not os.path.exists(meta_path):
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                m = json.load(f)
+            return Pixels(
+                image_id=image_id,
+                pixels_type=m.get("pixels_type", m["dtype"]),
+                size_x=m["levels"][0]["size_x"],
+                size_y=m["levels"][0]["size_y"],
+                size_z=m["size_z"],
+                size_c=m["size_c"],
+                size_t=m["size_t"],
+            )
+        # OME-TIFF-backed image: geometry from the OME-XML / IFDs (the
+        # reference resolves the same fields from the OMERO DB, which
+        # Bio-Formats populated at import; here the file is the truth).
+        # The parse walks every IFD, so cache per (path, mtime) and run
+        # it off the event loop; repeat requests additionally hit the
+        # handler's metadata write-back cache upstream.
+        import asyncio
+
+        from ..io.ometiff import find_tiff
+        tiff = find_tiff(self._image_dir(image_id))
+        if tiff is None:
             return None
-        with open(meta_path) as f:
-            m = json.load(f)
-        return Pixels(
-            image_id=image_id,
-            pixels_type=m.get("pixels_type", m["dtype"]),
-            size_x=m["levels"][0]["size_x"],
-            size_y=m["levels"][0]["size_y"],
-            size_z=m["size_z"],
-            size_c=m["size_c"],
-            size_t=m["size_t"],
-        )
+        mtime = os.stat(tiff).st_mtime_ns
+        cached = self._tiff_pixels.get(image_id)
+        if cached is not None and cached[0] == (tiff, mtime):
+            return cached[1]
+        px = await asyncio.to_thread(self._parse_tiff_pixels,
+                                     image_id, tiff)
+        self._tiff_pixels[image_id] = ((tiff, mtime), px)
+        return px
+
+    def _parse_tiff_pixels(self, image_id: int, tiff: str) -> Pixels:
+        from ..io.ometiff import OmeTiffSource
+        src = OmeTiffSource(tiff)
+        try:
+            (size_x, size_y) = src.resolution_descriptions()[0]
+            return Pixels(
+                image_id=image_id,
+                pixels_type=src.pixels_type,
+                size_x=size_x,
+                size_y=size_y,
+                size_z=src.size_z,
+                size_c=src.size_c,
+                size_t=src.size_t,
+            )
+        finally:
+            src.close()
 
     async def can_read(self, object_type: str, object_id: int,
                        session_key: Optional[str]) -> bool:

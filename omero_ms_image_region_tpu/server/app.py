@@ -47,31 +47,59 @@ SERVICES_KEY = web.AppKey("services", object)
 CONFIG_KEY = web.AppKey("config", object)
 
 
+def _session_required(config: AppConfig) -> bool:
+    """Reject-by-default for real stores; the standalone ACL-only
+    posture (static/no store) must opt in explicitly
+    (≙ the reference's mandatory session handler,
+    ``ImageRegionMicroserviceVerticle.java:199-212``)."""
+    if config.session_store_required is not None:
+        return config.session_store_required
+    return config.session_store_type in ("redis", "postgres")
+
+
 def _make_session_store(config: AppConfig) -> Optional[SessionStore]:
+    required = _session_required(config)
+
+    def unavailable(msg: str) -> None:
+        # With enforcement on, a config whose session store cannot be
+        # built must refuse to start (the reference throws;
+        # ImageRegionMicroserviceVerticle.java:199-212) — silently
+        # serving 403s for every request helps nobody.
+        if required:
+            raise ValueError(f"session enforcement is on but {msg}")
+        log.warning("%s; sessions disabled", msg)
+
     if config.session_store_type == "redis":
         if not config.session_store_uri:
-            log.warning("session-store.type is 'redis' but no uri is "
-                        "configured; sessions disabled")
+            unavailable("session-store.type is 'redis' with no uri")
             return None
         try:
             return DjangoRedisSessionStore(config.session_store_uri)
         except ImportError:
-            log.warning("redis package unavailable; sessions disabled")
+            unavailable("the redis package is unavailable")
             return None
     if config.session_store_type == "static":
         return StaticSessionStore(accept_all=True)
+    if config.session_store_type not in (None, "postgres"):
+        # Typo'd types must not silently serve anonymously
+        # (the reference throws on invalid types too).
+        raise ValueError(f"invalid session-store.type "
+                         f"{config.session_store_type!r} (expected "
+                         f"redis | postgres | static)")
     if config.session_store_type == "postgres":
         if not config.session_store_uri:
-            log.warning("session-store.type is 'postgres' but no uri is "
-                        "configured; sessions disabled")
+            unavailable("session-store.type is 'postgres' with no uri")
             return None
         try:
             from ..services.sessions import DjangoPostgresSessionStore
             return DjangoPostgresSessionStore(config.session_store_uri)
         except ImportError:
-            log.warning("no async postgres driver (asyncpg/psycopg) "
-                        "available; sessions disabled")
+            unavailable("no async postgres driver (asyncpg/psycopg) "
+                        "is available")
             return None
+    if required:
+        raise ValueError("session-store.required is true but no "
+                         "session-store.type is configured")
     return None
 
 
@@ -172,6 +200,20 @@ def create_app(config: Optional[AppConfig] = None,
         return await resolve_session_key(
             session_store, request.cookies, config.session_cookie_name)
 
+    # Session enforcement (≙ the mandatory OmeroWebSessionRequestHandler,
+    # ImageRegionMicroserviceVerticle.java:199-212: requests whose cookie
+    # does not resolve are failed before any handler runs).
+    session_required = _session_required(config)
+
+    class _NoSession(Exception):
+        pass
+
+    async def require_session_key(request: web.Request) -> Optional[str]:
+        key = await session_key(request)
+        if key is None and session_required:
+            raise _NoSession()
+        return key
+
     def _status_of(e: Exception) -> web.Response:
         """Failure-code mapping with the reference's empty 404/500 bodies
         (``ImageRegionMicroserviceVerticle.java:314-323``)."""
@@ -182,12 +224,21 @@ def create_app(config: Optional[AppConfig] = None,
         log.exception("render failed")
         return web.Response(status=500)
 
-    async def render_image_region(request: web.Request) -> web.Response:
+    def _params_of(request: web.Request) -> dict:
         params = dict(request.query)
         params.update(request.match_info)
+        # The wildcard route's tail must not reach the ctx: cache keys
+        # hash all params, and /7/0/0 vs /7/0/0/ must share a key.
+        params.pop("tail", None)
+        return params
+
+    async def render_image_region(request: web.Request) -> web.Response:
+        params = _params_of(request)
         try:
             ctx = ImageRegionCtx.from_params(
-                params, await session_key(request))
+                params, await require_session_key(request))
+        except _NoSession:
+            return web.Response(status=403)
         except BadRequestError as e:
             # Parse errors return the message body (the reference's 400
             # path, ImageRegionMicroserviceVerticle.java:300-305).
@@ -205,11 +256,12 @@ def create_app(config: Optional[AppConfig] = None,
         return web.Response(body=body, headers=headers)
 
     async def render_shape_mask(request: web.Request) -> web.Response:
-        params = dict(request.query)
-        params.update(request.match_info)
+        params = _params_of(request)
         try:
             ctx = ShapeMaskCtx.from_params(
-                params, await session_key(request))
+                params, await require_session_key(request))
+        except _NoSession:
+            return web.Response(status=403)
         except BadRequestError as e:
             return web.Response(status=400, text=str(e))
         try:
@@ -319,12 +371,17 @@ def create_app(config: Optional[AppConfig] = None,
                 max_workers=workers, thread_name_prefix="render-worker"))
 
     app.on_startup.append(on_startup)
+    # Trailing segments are tolerated like the reference's `:theT*` /
+    # `:shapeId*` patterns (ImageRegionMicroserviceVerticle.java:214-231):
+    # OMERO.web emits URLs with suffixes past the last parameter.
     for prefix in ("webgateway", "webclient"):
         for route in ("render_image_region", "render_image"):
-            app.router.add_get(
-                f"/{prefix}/{route}/{{imageId}}/{{theZ}}/{{theT}}",
-                render_image_region)
+            base = f"/{prefix}/{route}/{{imageId}}/{{theZ}}/{{theT}}"
+            app.router.add_get(base, render_image_region)
+            app.router.add_get(base + "/{tail:.*}", render_image_region)
     app.router.add_get("/webgateway/render_shape_mask/{shapeId}",
+                       render_shape_mask)
+    app.router.add_get("/webgateway/render_shape_mask/{shapeId}/{tail:.*}",
                        render_shape_mask)
     app.router.add_get("/metrics", metrics)
     app.router.add_route("OPTIONS", "/{tail:.*}", details)

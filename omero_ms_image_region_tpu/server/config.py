@@ -121,6 +121,12 @@ class AppConfig:
     session_cookie_name: str = "sessionid"  # omero.web.session_cookie_name
     session_store_type: Optional[str] = None   # redis | postgres | static
     session_store_uri: Optional[str] = None
+    # Reject requests whose cookie does not resolve to an OMERO session
+    # (the reference's session handler is mandatory and fails them:
+    # ImageRegionMicroserviceVerticle.java:199-212).  None = default on
+    # for redis/postgres stores, off for static/no store (the standalone
+    # ACL-only posture stays available as an explicit opt-out).
+    session_store_required: Optional[bool] = None
     lut_root: Optional[str] = None         # omero.script_repo_root analogue
     # Metadata/ACL backend: "local" (filesystem acl.json + meta.json) or
     # "postgres" (OMERO-schema DB, ≙ the backbone services the reference
@@ -182,6 +188,8 @@ class AppConfig:
         store = raw.get("session-store", {}) or {}
         cfg.session_store_type = store.get("type")
         cfg.session_store_uri = store.get("uri")
+        if store.get("required") is not None:
+            cfg.session_store_required = bool(store["required"])
         meta = raw.get("metadata-service", {}) or {}
         cfg.metadata_backend = str(meta.get("type", cfg.metadata_backend))
         cfg.metadata_dsn = meta.get("dsn")

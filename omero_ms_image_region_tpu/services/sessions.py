@@ -3,8 +3,11 @@
 The reference decodes the OMERO.web Django session cookie and resolves it
 to an ``omero.session_key`` request attribute through a Redis or Postgres
 session store (``ImageRegionMicroserviceVerticle.java:194-212``,
-``config.yaml:29-42``).  Requests without a resolvable session still flow —
-ACL checks decide what they may see.
+``config.yaml:29-42``).  With enforcement on (``session-store.required``,
+the default for redis/postgres stores — matching the reference's mandatory
+session handler) unresolvable cookies are rejected with 403; with it off
+(static/no store) such requests still flow and ACL checks decide what
+they may see.
 
 Here: a ``SessionStore`` protocol with
 

@@ -33,7 +33,7 @@ def data_dir(tmp_path_factory):
     return str(root)
 
 
-def client_fetch(data_dir, *requests, config=None):
+def client_fetch(data_dir, *requests, config=None, cookies=None):
     """Run GET/OPTIONS requests against a fresh app; returns
     [(status, headers, body)]."""
     config = config or AppConfig(
@@ -42,7 +42,7 @@ def client_fetch(data_dir, *requests, config=None):
 
     async def main():
         app = create_app(config)
-        client = TestClient(TestServer(app))
+        client = TestClient(TestServer(app), cookies=cookies)
         await client.start_server()
         out = []
         try:
@@ -182,6 +182,99 @@ class TestStatusMapping:
         [(status, _, _)] = client_fetch(
             data_dir, ("GET", "/webgateway/render_image_region/abc/0/0"))
         assert status == 400
+
+
+class TestSessionEnforcement:
+    """≙ the reference's mandatory OmeroWebSessionRequestHandler
+    (ImageRegionMicroserviceVerticle.java:199-212)."""
+
+    def _fetch(self, data_dir, path, required, cookies=None):
+        config = AppConfig(data_dir=data_dir,
+                           session_store_type="static",
+                           session_store_required=required)
+        [(status, _, body)] = client_fetch(
+            data_dir, ("GET", path), config=config, cookies=cookies)
+        return status, body
+
+    def test_no_cookie_rejected_403(self, data_dir):
+        status, body = self._fetch(
+            data_dir,
+            f"/webgateway/render_image_region/{IMG}/0/0?format=png&m=c",
+            required=True)
+        assert (status, body) == (403, b"")
+        status, _ = self._fetch(
+            data_dir, f"/webgateway/render_shape_mask/{MASK}",
+            required=True)
+        assert status == 403
+
+    def test_cookie_resolves_and_serves(self, data_dir):
+        status, body = self._fetch(
+            data_dir,
+            f"/webgateway/render_image_region/{IMG}/0/0?format=png&m=c",
+            required=True, cookies={"sessionid": "k1"})
+        assert status == 200 and body[:4] == b"\x89PNG"
+
+    def test_static_store_defaults_to_opt_out(self, data_dir):
+        # required=None: static stores keep the anonymous posture.
+        status, _ = self._fetch(
+            data_dir,
+            f"/webgateway/render_image_region/{IMG}/0/0?format=png&m=c",
+            required=None)
+        assert status == 200
+
+    def test_required_without_store_refuses_to_start(self, data_dir):
+        config = AppConfig(data_dir=data_dir,
+                           session_store_required=True)
+        with pytest.raises(ValueError, match="session"):
+            create_app(config)
+
+    def test_redis_store_defaults_to_required(self):
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        cfg = AppConfig.from_dict(
+            {"session-store": {"type": "redis"}})
+        from omero_ms_image_region_tpu.server.app import _session_required
+        assert _session_required(cfg) is True
+        cfg = AppConfig.from_dict(
+            {"session-store": {"type": "redis", "required": False}})
+        assert _session_required(cfg) is False
+
+
+class TestTrailingWildcardRoutes:
+    """Reference routes end in `*` (…Verticle.java:214-231): URLs with
+    trailing segments past the last parameter must still resolve."""
+
+    def test_image_route_with_trailing_segment(self, data_dir):
+        [(status, headers, body)] = client_fetch(
+            data_dir,
+            ("GET", f"/webgateway/render_image_region/{IMG}/0/0/extra"
+                    "?format=png&m=c"))
+        assert status == 200
+        assert codecs.decode_to_rgba(body).shape == (H, W, 4)
+
+    def test_mask_route_with_trailing_segment(self, data_dir):
+        [(status, _, body)] = client_fetch(
+            data_dir,
+            ("GET", f"/webgateway/render_shape_mask/{MASK}/trailing/x"))
+        assert status == 200
+        assert body[:4] == b"\x89PNG"
+
+    def test_tail_does_not_dilute_cache_key(self, data_dir):
+        """/7/0/0 and /7/0/0/ must hash to the same region cache key."""
+        from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+
+        base = {"imageId": str(IMG), "theZ": "0", "theT": "0",
+                "format": "png", "m": "c"}
+        k1 = ImageRegionCtx.create_cache_key(base)
+        k2 = ImageRegionCtx.create_cache_key({**base, "tail": ""})
+        assert k1 != k2  # raw params WOULD dilute...
+        # ...which is why the app strips `tail` before from_params:
+        [(s1, _, b1), (s2, _, b2)] = client_fetch(
+            data_dir,
+            ("GET", f"/webgateway/render_image_region/{IMG}/0/0"
+                    "?format=png&m=c"),
+            ("GET", f"/webgateway/render_image_region/{IMG}/0/0/"
+                    "?format=png&m=c"))
+        assert s1 == s2 == 200 and b1 == b2
 
 
 def _gather_requests(data_dir, paths, jpeg_engine="sparse"):

@@ -571,7 +571,23 @@ def bench_config5(rng):
         grids = np.stack([unpack_mask_bits(m.bytes_, W, H) for m in masks])
         overlay_masks_batch(base, grids, fills)
 
-    return B / _timed(run, repeats=3)
+    def run_cpu():
+        # Reference flavor: one mask at a time, PIL rasterize +
+        # alpha_composite (the way the Java service's BufferedImage +
+        # IndexColorModel path would overlay, ShapeMaskRequestHandler
+        # .java:185-203) — the comparator BASELINE.json config 5 needs.
+        from PIL import Image
+        for m, tile, fill in zip(masks, base, fills):
+            grid = unpack_mask_bits(m.bytes_, W, H)
+            over = np.empty((H, W, 4), np.uint8)
+            over[..., 0] = fill[0]
+            over[..., 1] = fill[1]
+            over[..., 2] = fill[2]
+            over[..., 3] = grid * fill[3]
+            Image.alpha_composite(Image.fromarray(tile, "RGBA"),
+                                  Image.fromarray(over, "RGBA"))
+
+    return B / _timed(run, repeats=3), B / _timed(run_cpu, repeats=3)
 
 
 def main():
@@ -585,7 +601,7 @@ def main():
     c1_tpu, c1_cpu = bench_config1(rng)
     c2_planes, c2_cpu = bench_config2(rng)
     c4_projections, c4_cpu = bench_config4(rng)
-    c5_masks = bench_config5(rng)
+    c5_masks, c5_cpu = bench_config5(rng)
 
     print(json.dumps({
         "metric": "jpeg_tiles_per_sec_1024sq_4ch_u16",
@@ -624,6 +640,7 @@ def main():
         "config4_zproj32_3ch_512_per_sec": round(c4_projections, 2),
         "config4_cpu_ref_per_sec": round(c4_cpu, 2),
         "config5_mask_overlay_512_per_sec": round(c5_masks, 2),
+        "config5_cpu_ref_per_sec": round(c5_cpu, 2),
     }))
 
 

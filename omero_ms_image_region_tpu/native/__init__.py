@@ -37,7 +37,7 @@ _jpeg_lib_error: Optional[str] = None
 def _compile_lib(source: str, lib_path: str) -> None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
         "-o", lib_path + ".tmp", source,
     ]
     subprocess.run(cmd, check=True, capture_output=True)
@@ -87,6 +87,10 @@ def _load() -> ctypes.CDLL:
         lib.flip_u32.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                  ctypes.c_int, ctypes.c_int,
                                  ctypes.c_int, ctypes.c_int]
+        lib.mask_overlay_u8.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_int, ctypes.c_int,
+                                        ctypes.c_int]
         _lib = lib
         return lib
 
@@ -156,6 +160,35 @@ def unpack_bits_msb(data: bytes, n_bits: int):
     out = np.empty(n_bits, dtype=np.uint8)
     lib.bits_unpack_msb(data, n_bits,
                         out.ctypes.data_as(ctypes.c_char_p))
+    return out
+
+
+def mask_overlay_u8(base_rgba, mask_grids, fills):
+    """Batched integer alpha-composite, OpenMP across the batch
+    (GIL released for the whole blend)."""
+    import numpy as np
+    lib = _load()
+    base = np.ascontiguousarray(base_rgba, dtype=np.uint8)
+    grids = np.ascontiguousarray(mask_grids, dtype=np.uint8)
+    f = np.ascontiguousarray(fills, dtype=np.uint8)
+    if base.ndim != 4 or base.shape[-1] != 4:
+        raise ValueError(f"base_rgba must be [B, H, W, 4], "
+                         f"got {base.shape}")
+    B, H, W, _ = base.shape
+    # The C kernel trusts these shapes; mismatches would read/write out
+    # of bounds where the numpy path raised a broadcast error.
+    if grids.shape != (B, H, W):
+        raise ValueError(f"mask_grids must be {(B, H, W)}, "
+                         f"got {grids.shape}")
+    if f.shape != (B, 4):
+        raise ValueError(f"fills must be {(B, 4)}, got {f.shape}")
+    out = np.empty_like(base)
+    lib.mask_overlay_u8(
+        base.ctypes.data_as(ctypes.c_void_p),
+        grids.ctypes.data_as(ctypes.c_void_p),
+        f.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        B, H, W)
     return out
 
 

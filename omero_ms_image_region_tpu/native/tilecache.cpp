@@ -161,6 +161,38 @@ void bits_unpack_msb(const uint8_t* src, size_t n_bits, uint8_t* dst) {
     }
 }
 
+// Alpha-composite B mask fills over B RGBA tiles (straight alpha,
+// integer math; ≙ the BufferedImage+IndexColorModel overlay a client of
+// ShapeMaskRequestHandler.java:185-203 performs).  out may alias base.
+// (x + 127) / 255 rounds x/255 to nearest for x >= 0.
+void mask_overlay_u8(const uint8_t* base, const uint8_t* grids,
+                     const uint8_t* fills, uint8_t* out,
+                     int B, int H, int W) {
+    const size_t plane = static_cast<size_t>(H) * W;
+#pragma omp parallel for schedule(static)
+    for (int b = 0; b < B; ++b) {
+        const uint8_t* f = fills + static_cast<size_t>(b) * 4;
+        const uint32_t fr = f[0], fg = f[1], fb = f[2], fa = f[3];
+        const uint8_t* bp = base + static_cast<size_t>(b) * plane * 4;
+        const uint8_t* gp = grids + static_cast<size_t>(b) * plane;
+        uint8_t* op = out + static_cast<size_t>(b) * plane * 4;
+        for (size_t i = 0; i < plane; ++i) {
+            const uint32_t a = gp[i] ? fa : 0;
+            const uint32_t ia = 255 - a;
+            op[4 * i + 0] =
+                static_cast<uint8_t>((bp[4 * i + 0] * ia + fr * a + 127)
+                                     / 255);
+            op[4 * i + 1] =
+                static_cast<uint8_t>((bp[4 * i + 1] * ia + fg * a + 127)
+                                     / 255);
+            op[4 * i + 2] =
+                static_cast<uint8_t>((bp[4 * i + 2] * ia + fb * a + 127)
+                                     / 255);
+            op[4 * i + 3] = bp[4 * i + 3];
+        }
+    }
+}
+
 // Flip a packed u32 image in place-free form (the reference's CPU flip,
 // ImageRegionRequestHandler.java:616-642, as a single native pass).
 void flip_u32(const uint32_t* src, uint32_t* dst, int height, int width,

@@ -103,3 +103,52 @@ class TestNativeBitOps:
                     expected = expected[:, ::-1]
                 np.testing.assert_array_equal(
                     native.flip_u32(img, fh, fv), expected)
+
+    def test_mask_overlay_matches_numpy_fallback(self):
+        """Native OpenMP blend is bit-identical to the integer numpy
+        formula overlay_masks_batch falls back to."""
+        rng = np.random.default_rng(2)
+        B, H, W = 4, 37, 53
+        base = rng.integers(0, 255, size=(B, H, W, 4)).astype(np.uint8)
+        grids = rng.integers(0, 2, size=(B, H, W)).astype(np.uint8)
+        fills = rng.integers(0, 255, size=(B, 4)).astype(np.uint8)
+        got = native.mask_overlay_u8(base, grids, fills)
+        a = (grids.astype(np.uint32)
+             * fills[:, None, None, 3].astype(np.uint32))[..., None]
+        fill_rgb = fills[:, None, None, :3].astype(np.uint32)
+        expected = base.copy()
+        expected[..., :3] = ((base[..., :3].astype(np.uint32) * (255 - a)
+                              + fill_rgb * a + 127) // 255).astype(np.uint8)
+        np.testing.assert_array_equal(got, expected)
+        # Opaque fill fully replaces RGB under the mask; alpha preserved.
+        fills[:, 3] = 255
+        o = native.mask_overlay_u8(base, grids, fills)
+        m = grids.astype(bool)
+        for b in range(B):
+            np.testing.assert_array_equal(
+                o[b][m[b]][:, :3],
+                np.broadcast_to(fills[b, :3], (int(m[b].sum()), 3)))
+        np.testing.assert_array_equal(o[..., 3], base[..., 3])
+
+    def test_mask_overlay_validates_shapes(self):
+        import pytest
+        base = np.zeros((2, 8, 8, 4), np.uint8)
+        with pytest.raises(ValueError, match="mask_grids"):
+            native.mask_overlay_u8(base, np.zeros((2, 4, 4), np.uint8),
+                                   np.zeros((2, 4), np.uint8))
+        with pytest.raises(ValueError, match="fills"):
+            native.mask_overlay_u8(base, np.zeros((2, 8, 8), np.uint8),
+                                   np.zeros((1, 4), np.uint8))
+
+    def test_mask_overlay_nonzero_means_on(self):
+        """0/255-style masks blend identically to 0/1 masks in both the
+        native and the numpy fallback paths."""
+        from omero_ms_image_region_tpu.ops.maskops import (
+            overlay_masks_batch)
+        rng = np.random.default_rng(3)
+        base = rng.integers(0, 255, size=(2, 16, 16, 4)).astype(np.uint8)
+        g01 = rng.integers(0, 2, size=(2, 16, 16)).astype(np.uint8)
+        fills = rng.integers(0, 255, size=(2, 4)).astype(np.uint8)
+        np.testing.assert_array_equal(
+            overlay_masks_batch(base, g01 * 255, fills),
+            overlay_masks_batch(base, g01, fills))

@@ -135,6 +135,14 @@ def bench_flagship(rng):
     t0 = time.perf_counter()
     dev_raw = [jax.device_put(r) for r in raw_batches]
     jax.block_until_ready(dev_raw)
+    # block_until_ready does NOT wait for remote completion on tunnel
+    # transports (dispatch is fully async); fetching one element of each
+    # array is what forces the transfer to have landed.  Dispatch every
+    # probe slice first, then materialize, so the forced landings
+    # overlap and the window absorbs ~1 RTT instead of n_batches RTTs.
+    probes = [r.ravel()[:1] for r in dev_raw]
+    for p in probes:
+        np.asarray(p)
     upload_s = time.perf_counter() - t0
     upload_mb_s = sum(r.nbytes for r in raw_batches) / 1e6 / upload_s
 
@@ -171,7 +179,9 @@ def bench_flagship(rng):
         handles = [starter.start(dispatch(raw, engine))
                    for raw in batches]
         batch_ms, jpegs = [], []
-        for raw, h in zip(raw_batches, handles):
+        # `batches`, not the closure's raw_batches: the cold path passes
+        # perturbed arrays and the dense fallback must see those pixels.
+        for raw, h in zip(batches, handles):
             t0 = time.perf_counter()
             if engine == "sparse":
                 host = fetcher.finish(h)
@@ -215,11 +225,14 @@ def bench_flagship(rng):
     tiles_per_sec, p50_batch_ms = results[engine]
 
     # Cold path: charge host->HBM staging too (fresh device_put feeding
-    # the same pipeline, twice; best of 2).
+    # the same pipeline, twice; best of 2).  Every rep ships DISTINCT
+    # bytes (xor perturbation, outside the timed window) so a
+    # content-memoizing relay cannot serve the upload from cache.
     cold_times = []
-    for _ in range(2):
+    for rep in range(2):
+        fresh = [r ^ np.uint16(rep + 1) for r in raw_batches]
         t0 = time.perf_counter()
-        run_once([jax.device_put(r) for r in raw_batches], engine)
+        run_once([jax.device_put(r) for r in fresh], engine)
         cold_times.append(time.perf_counter() - t0)
     cold_tiles_per_sec = (B * n_batches) / min(cold_times)
 
@@ -330,19 +343,26 @@ def bench_flagship(rng):
 
 def bench_service_level(rng):
     """Config-3 pan through the FULL HTTP stack (routes, ctx parsing,
-    caches, batcher, device dispatch, JPEG wire, entropy encode): 16-way
-    concurrent 1024^2 4-channel tile requests against the real app.
+    caches, batcher, device dispatch, JPEG wire, entropy encode):
+    sustained closed-loop load — 16 in-flight clients issuing 1024^2
+    4-channel tile renders against the real app for a fixed window.
 
-    Returns tiles/s or None if the app stack cannot boot here."""
+    Every request varies its channel windows, so each is a DISTINCT
+    render (no byte-cache hit, and no relay-side dispatch memoization
+    can serve a cached device reply); raw tiles stay device-resident
+    after first touch — the honest warm interactive posture.  Both wire
+    engines are measured and the better one carries the number,
+    mirroring what a linkprobe-``auto`` deployment would pick for the
+    link of the day.
+
+    Returns (tiles/s, per-engine dict) or (None, {}) if the app stack
+    cannot boot here."""
     import asyncio
     import os
     import tempfile
 
-    from aiohttp.test_utils import TestClient, TestServer
-
     from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
     from omero_ms_image_region_tpu.io.store import build_pyramid
-    from omero_ms_image_region_tpu.server.app import create_app
     from omero_ms_image_region_tpu.server.config import (
         AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
 
@@ -350,15 +370,20 @@ def bench_service_level(rng):
         planes = synthetic_wsi_tiles(rng, 4, 1, 4096, 4096).reshape(
             4, 1, 4096, 4096)
         build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
-        config = AppConfig(
-            data_dir=tmp,
-            batcher=BatcherConfig(enabled=True, linger_ms=3.0),
-            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
-            renderer=RendererConfig(cpu_fallback_max_px=0))
-        return asyncio.run(_service_run(config))
+        per_engine = {}
+        for engine in ("sparse", "huffman"):
+            config = AppConfig(
+                data_dir=tmp,
+                batcher=BatcherConfig(enabled=True, linger_ms=3.0),
+                raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+                renderer=RendererConfig(cpu_fallback_max_px=0,
+                                        jpeg_engine=engine))
+            per_engine[engine] = asyncio.run(_service_run(config))
+        return max(per_engine.values()), per_engine
 
 
-async def _service_run(config):
+async def _service_run(config, concurrency: int = 16,
+                       duration_s: float = 8.0):
     import asyncio
 
     from aiohttp.test_utils import TestClient, TestServer
@@ -369,25 +394,40 @@ async def _service_run(config):
     client = TestClient(TestServer(app))
     await client.start_server()
     try:
-        def url(i):
+        seq = 0
+
+        def url(i, k):
             x, y = i % 4, (i // 4) % 4
+            # k-varied windows: every request is a distinct render of
+            # the SAME device-resident raw tile.  k comes from a shared
+            # monotone counter (period 5000 — far beyond any realistic
+            # request count in the window), so no (tile, window) pair
+            # repeats and a dispatch-memoizing relay can never serve a
+            # cached device reply.
+            w = 20000 + (k % 5000) * 9
             return (f"/webgateway/render_image_region/1/0/0"
                     f"?tile=0,{x},{y},1024,1024&format=jpeg&m=c"
-                    f"&c=1|0:60000$FF0000,2|0:60000$00FF00,"
-                    f"3|0:50000$0000FF,4|0:45000$FFFF00")
-        # Warm: stage raw tiles into HBM + compile.
-        await asyncio.gather(*(client.get(url(i)) for i in range(16)))
-        best = None
-        for _ in range(3):
-            t0 = time.perf_counter()
-            resps = await asyncio.gather(
-                *(client.get(url(i)) for i in range(16)))
-            assert all(r.status == 200 for r in resps)
-            for r in resps:
+                    f"&c=1|0:{w}$FF0000,2|0:{w - 1000}$00FF00,"
+                    f"3|0:{w - 2000}$0000FF,4|0:{w - 3000}$FFFF00")
+        # Warm: stage raw tiles into HBM + compile both grid shapes.
+        resps = await asyncio.gather(
+            *(client.get(url(i, i)) for i in range(16)))
+        assert all(r.status == 200 for r in resps)
+        t_stop = time.perf_counter() + duration_s
+        done = 0
+
+        async def worker(i: int) -> None:
+            nonlocal done, seq
+            while time.perf_counter() < t_stop:
+                seq += 1
+                r = await client.get(url(i, 16 + seq))
                 await r.read()
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
-        return 16 / best
+                assert r.status == 200
+                done += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(i) for i in range(concurrency)))
+        return done / (time.perf_counter() - t0)
     finally:
         await client.close()
 
@@ -591,13 +631,21 @@ def bench_config5(rng):
 
 
 def main():
-    rng = np.random.default_rng(7)
+    # Fresh entropy per run: the tunnel relay memoizes content-identical
+    # transfers and dispatches, so a fixed seed would let repeat bench
+    # runs serve cached uploads/replies and overstate the link.  The
+    # content class (synthetic_wsi_tiles) is statistically identical
+    # run to run, so vs_baseline stays comparable.
+    import os as _os
+    rng = np.random.default_rng(
+        int.from_bytes(_os.urandom(8), "little"))
 
     flag = bench_flagship(rng)
     try:
-        service_tps = bench_service_level(rng)
+        service_tps, service_engines = bench_service_level(rng)
     except Exception:
-        service_tps = None   # app stack unavailable; library numbers stand
+        # App stack unavailable; library numbers stand.
+        service_tps, service_engines = None, {}
     c1_tpu, c1_cpu = bench_config1(rng)
     c2_planes, c2_cpu = bench_config2(rng)
     c4_projections, c4_cpu = bench_config4(rng)
@@ -632,6 +680,10 @@ def main():
         "service_tiles_per_sec": _opt_round(service_tps, 1),
         "service_vs_baseline": _opt_round(
             service_tps and service_tps / flag["cpu_tps"], 2),
+        "service_sparse_tiles_per_sec": _opt_round(
+            service_engines.get("sparse"), 1),
+        "service_huffman_tiles_per_sec": _opt_round(
+            service_engines.get("huffman"), 1),
         "batch": 8,
         "config1_tile256_u8_per_sec": round(c1_tpu, 2),
         "config1_cpu_ref_per_sec": round(c1_cpu, 2),

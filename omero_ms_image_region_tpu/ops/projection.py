@@ -23,6 +23,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.rendering import Projection
 
@@ -70,6 +71,81 @@ def _project(stack, start, end, stepping, type_max, algorithm: int):
         total = total / count
     # Clamp to the destination type maximum (:280-282); no lower clamp.
     return jnp.minimum(total, type_max)
+
+
+@jax.jit
+def _fold_max(acc, plane):
+    return jnp.maximum(acc, plane.astype(jnp.float32))
+
+
+@jax.jit
+def _fold_sum(acc, plane):
+    return acc + plane.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("algorithm",))
+def _finalize(acc, count, type_max, algorithm: int):
+    if algorithm == Projection.MAXIMUM_INTENSITY:
+        return jnp.maximum(acc, 0.0)     # 0-floor accumulator (:183)
+    if algorithm == Projection.MEAN_INTENSITY:
+        acc = acc / jnp.maximum(count, 1.0)
+    return jnp.minimum(acc, type_max)    # type-max clamp (:280-282)
+
+
+def project_planes(get_plane, algorithm, size_z: int, start: int,
+                   end: int, stepping: int = 1,
+                   type_max: float = 255.0, shape=None):
+    """Stream a Z-projection plane by plane — WSI-scale memory bound.
+
+    Where :func:`project_stack` needs the whole ``[Z, H, W]`` stack
+    resident (matching ``PixelBuffer.getStack`` at
+    ``ProjectionService.java:72``, which stalls and swaps on real WSI
+    stacks), this reads ONLY the planes inside the Z window via
+    ``get_plane(z) -> [H, W]`` and folds each into a device-resident
+    accumulator: peak memory is one host plane + two device planes per
+    channel, independent of Z.  Host reads overlap device folds (JAX
+    dispatch is async), so the stream also pipelines disk and link.
+
+    Reference semantics are identical to :func:`project_stack`
+    (inclusive max / exclusive mean-sum windows, stepping, 0-floor max
+    accumulator, type-max clamp).
+
+    Returns f32[H, W] on device.
+    """
+    algorithm = Projection(algorithm)
+    if algorithm not in (
+        Projection.MAXIMUM_INTENSITY,
+        Projection.MEAN_INTENSITY,
+        Projection.SUM_INTENSITY,
+    ):
+        raise ValueError(f"Unknown algorithm: {algorithm}")
+    if start < 0 or end < 0:
+        raise ValueError("Z interval value cannot be negative.")
+    if start >= size_z or end >= size_z:
+        raise ValueError(f"Z interval value cannot be >= {size_z}")
+    if stepping <= 0:
+        raise ValueError(f"stepping: {stepping} <= 0")
+
+    inclusive = algorithm == Projection.MAXIMUM_INTENSITY
+    stop = end + 1 if inclusive else end
+    zs = [z for z in range(start, stop) if (z - start) % stepping == 0]
+    fold = _fold_max if inclusive else _fold_sum
+    acc = None
+    for z in zs:
+        plane = jnp.asarray(get_plane(z))
+        acc = (plane.astype(jnp.float32) if acc is None
+               else fold(acc, plane))
+    if acc is None:
+        # Empty mean/sum window (start == end): all-zero plane, the
+        # full-stack kernel's result for a zero weight vector.  With
+        # ``shape`` provided (the serving path knows the plane geometry)
+        # no plane is read at all — a WSI-scale probe read just for its
+        # shape would defeat the bounded-reads contract.
+        if shape is None:
+            shape = np.asarray(get_plane(start)).shape
+        acc = jnp.zeros(shape, jnp.float32)
+    return _finalize(acc, jnp.asarray(float(len(zs)), jnp.float32),
+                     jnp.asarray(type_max, jnp.float32), int(algorithm))
 
 
 def project_stack(stack, algorithm, start: int, end: int,

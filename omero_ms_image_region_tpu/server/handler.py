@@ -42,6 +42,9 @@ from .settings import update_settings
 logger = logging.getLogger(__name__)
 
 DEFAULT_MAX_TILE_LENGTH = 2048  # beanRefContext.xml:63-66
+# Cold-staging band height: regions at least 2 bands tall ship as
+# per-band async device_puts so disk reads overlap H2D transfers.
+_STAGE_BAND_ROWS = 256
 
 
 class NotFoundError(Exception):
@@ -485,18 +488,65 @@ class ImageRegionHandler:
             # uint16 sources take half the HBM/link bytes.
             return np.stack(planes)
 
+        def load_staged():
+            """Cold staging pipeline: band the region's rows and ship
+            each band as its own async device_put, so band k+1's disk
+            read overlaps band k's host->HBM transfer (JAX dispatch
+            returns before the copy lands).  Small regions take the
+            single-shot path — banding only pays when the read itself
+            has substance."""
+            import jax
+            import jax.numpy as jnp
+            n_bands = min(4, region.height // _STAGE_BAND_ROWS)
+            if n_bands < 2:
+                return load()
+            # Interior bounds snap to the source's tile-row grid so a
+            # boundary never splits a chunk row (which both adjacent
+            # bands would otherwise read and decode).
+            tile_h = max(1, src.tile_size()[1])
+            bounds = [0]
+            for k in range(1, n_bands):
+                b = region.height * k // n_bands
+                # Snap the absolute row to the nearest tile boundary.
+                snapped = ((region.y + b + tile_h // 2) // tile_h
+                           * tile_h - region.y)
+                b = min(max(snapped, bounds[-1] + 1), region.height - 1)
+                if b > bounds[-1]:
+                    bounds.append(b)
+            bounds.append(region.height)
+            parts = []
+            for y0, y1 in zip(bounds, bounds[1:]):
+                sub = RegionDef(region.x, region.y + y0,
+                                region.width, y1 - y0)
+                band = np.stack([
+                    src.get_region(ctx.z, c, ctx.t, sub, level)
+                    for c in active
+                ])
+                parts.append(jax.device_put(band))
+            return jnp.concatenate(parts, axis=1)
+
         if self.s.raw_cache is None or not device_cache:
             return load().astype(np.float32)
         from ..io.devicecache import region_key
         key = region_key(ctx.image_id, ctx.z, ctx.t, level,
                          region.as_tuple(), tuple(active))
-        return self.s.raw_cache.get_or_load(key, load)
+        return self.s.raw_cache.get_or_load(key, load_staged)
 
     async def _project(self, ctx: ImageRegionCtx, pixels: Pixels, src,
                        active: List[int]
                        ) -> Tuple[np.ndarray, RegionDef]:
         """Z-projection branch (``:506-558``): project each active
-        channel's full stack, then render the projected full plane."""
+        channel, then render the projected full plane.
+
+        WSI-scale by construction: planes stream through
+        :func:`ops.projection.project_planes` — only the Z window's
+        planes are read, one at a time, into a device accumulator —
+        where the reference materializes the whole stack
+        (``pixelBuffer.getStack``, ``ProjectionService.java:72``) and
+        stalls on real WSIs.  Projected planes are device-cached like
+        raw tiles (same interactive re-window pattern), keyed by
+        everything the projection depends on.
+        """
         start = ctx.projection_start or 0
         end = (ctx.projection_end if ctx.projection_end is not None
                else pixels.size_z - 1)
@@ -504,19 +554,35 @@ class ImageRegionHandler:
             start, end, 1, active[0], ctx.t,
             pixels.size_z, pixels.size_c, pixels.size_t)
         type_max = pixels.type_range()[1]
+        full = RegionDef(0, 0, pixels.size_x, pixels.size_y)
+
+        def project_one(c: int):
+            with stopwatch("ProjectionService.projectStack"):
+                return projection_ops.project_planes(
+                    lambda z: src.get_region(z, c, ctx.t, full, 0),
+                    ctx.projection, pixels.size_z, start, end, 1,
+                    type_max, shape=(pixels.size_y, pixels.size_x))
+
+        # Full-plane f32 entries can dwarf the raw tiles the shared HBM
+        # cache exists for; cache a projection only when it fits well
+        # within the budget, so one WSI plane cannot flush the pan/zoom
+        # hot set.
+        cache = self.s.raw_cache
+        plane_bytes = pixels.size_x * pixels.size_y * 4
+        cacheable = (cache is not None
+                     and plane_bytes <= cache.max_bytes // 8)
 
         def run():
             import jax.numpy as jnp
             out = []
             for c in active:
-                # Span semantics: stack read + async device dispatch.
-                # The projection kernel itself completes under the
-                # downstream Renderer.renderAsPackedInt span (the planes
-                # stay device-resident; jax dispatch returns early).
-                with stopwatch("ProjectionService.projectStack"):
-                    stack = src.get_stack(c, ctx.t).astype(np.float32)
-                    out.append(projection_ops.project_stack(
-                        stack, ctx.projection, start, end, 1, type_max))
+                if cacheable:
+                    key = ("proj", ctx.image_id, ctx.t, c,
+                           int(ctx.projection), start, end)
+                    out.append(cache.get_or_load(
+                        key, lambda c=c: project_one(c)))
+                else:
+                    out.append(project_one(c))
             # Stays device-resident: the projected planes feed straight
             # into the render/JPEG dispatch (the batcher stacks on device
             # when members are resident), so full-plane f32 pixels never
@@ -524,7 +590,7 @@ class ImageRegionHandler:
             return jnp.stack(out)
 
         raw = await asyncio.to_thread(run)
-        return raw, RegionDef(0, 0, pixels.size_x, pixels.size_y)
+        return raw, full
 
 
 def _default_rdef(pixels: Pixels) -> RenderingDef:

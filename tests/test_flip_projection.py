@@ -128,3 +128,149 @@ def test_projection_bounds_checks():
         check_projection_bounds(0, 3, 1, 0, 5, 8, 3, 1)
     with pytest.raises(ValueError, match="channel index"):
         check_projection_bounds(0, 3, 1, 7, 0, 8, 3, 1)
+
+
+# ------------------------------------------------ streaming projection
+
+class TestProjectPlanes:
+    """project_planes (WSI-scale streaming) vs project_stack parity and
+    bounded reads — VERDICT item: ProjectionService.java:72,176-291."""
+
+    @pytest.mark.parametrize("alg", [Projection.MAXIMUM_INTENSITY,
+                                     Projection.MEAN_INTENSITY,
+                                     Projection.SUM_INTENSITY])
+    @pytest.mark.parametrize("start,end,step", [
+        (0, 7, 1), (2, 5, 1), (1, 6, 2), (3, 3, 1), (0, 0, 1),
+    ])
+    def test_matches_full_stack_kernel(self, alg, start, end, step):
+        from omero_ms_image_region_tpu.ops.projection import (
+            project_planes, project_stack)
+        rng = np.random.default_rng(4)
+        stack = rng.integers(0, 60000, size=(8, 17, 23)).astype(np.float32)
+        expected = np.asarray(project_stack(
+            stack, alg, start, end, step, type_max=65535.0))
+        got = np.asarray(project_planes(
+            lambda z: stack[z], alg, 8, start, end, step,
+            type_max=65535.0))
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+    def test_reads_only_window_planes(self):
+        """Only planes inside the Z window are read — the whole point
+        of streaming vs the reference's full-stack getStack."""
+        from omero_ms_image_region_tpu.ops.projection import (
+            project_planes)
+        reads = []
+
+        def get_plane(z):
+            reads.append(z)
+            return np.full((4, 4), z, np.float32)
+
+        project_planes(get_plane, Projection.MAXIMUM_INTENSITY,
+                       32, 10, 13, 1, 65535.0)
+        assert reads == [10, 11, 12, 13]
+        reads.clear()
+        project_planes(get_plane, Projection.MEAN_INTENSITY,
+                       32, 10, 13, 1, 65535.0)
+        assert reads == [10, 11, 12]            # exclusive end
+
+    def test_wsi_scale_bounded(self):
+        """32-Z 4096^2 projection completes with one plane resident at
+        a time (planes generated lazily; a full stack would be 2 GB)."""
+        from omero_ms_image_region_tpu.ops.projection import (
+            project_planes)
+        H = W = 4096
+        live = {"now": 0, "peak": 0}
+
+        class Plane(np.ndarray):
+            def __del__(self):
+                live["now"] -= 1
+
+        def get_plane(z):
+            live["now"] += 1
+            live["peak"] = max(live["peak"], live["now"])
+            base = np.full((H, W), 100 * z, np.uint16)
+            return base.view(Plane)
+
+        out = np.asarray(project_planes(
+            get_plane, Projection.MAXIMUM_INTENSITY, 32, 0, 31, 1,
+            65535.0))
+        assert out.shape == (H, W)
+        assert out[0, 0] == 3100.0              # max over z: 100*31
+        # Streaming keeps at most a couple of host planes alive, never
+        # anything like the 32-plane stack.
+        assert live["peak"] <= 4, live["peak"]
+
+    def test_handler_projection_streams(self, tmp_path):
+        """The serving projection path reads per-plane regions (never
+        get_stack) and serves correct results end to end."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu import codecs
+        from omero_ms_image_region_tpu.io.store import build_pyramid
+        from omero_ms_image_region_tpu.io.service import PixelsService
+        from omero_ms_image_region_tpu.server.app import create_app
+        from omero_ms_image_region_tpu.server.config import AppConfig
+
+        rng = np.random.default_rng(5)
+        planes = rng.integers(0, 60000, size=(1, 6, 64, 64)).astype(
+            np.uint16)
+        build_pyramid(planes, str(tmp_path / "1"), chunk=(32, 32),
+                      n_levels=1)
+        calls = {"get_stack": 0}
+        orig = PixelsService.get_pixel_source
+
+        def spying(self, image_id):
+            src = orig(self, image_id)
+            real = src.get_stack
+
+            def counted(c, t):
+                calls["get_stack"] += 1
+                return real(c, t)
+            src.get_stack = counted
+            return src
+
+        PixelsService.get_pixel_source = spying
+        try:
+            config = AppConfig(data_dir=str(tmp_path))
+
+            async def fetch():
+                app = create_app(config)
+                client = TestClient(TestServer(app))
+                await client.start_server()
+                try:
+                    r = await client.get(
+                        "/webgateway/render_image_region/1/0/0"
+                        "?c=1|0:60000$FF0000&m=g&p=intmax|1:4"
+                        "&format=png")
+                    assert r.status == 200
+                    return await r.read()
+                finally:
+                    await client.close()
+
+            body = asyncio.run(fetch())
+        finally:
+            PixelsService.get_pixel_source = orig
+        assert calls["get_stack"] == 0
+        rgba = codecs.decode_to_rgba(body)
+        expected = planes[0, 1:5].astype(np.float32).max(axis=0)
+        expected = np.clip(expected / 60000.0 * 255.0, 0, 255)
+        np.testing.assert_allclose(
+            rgba[..., 0].astype(np.float32), np.round(expected),
+            atol=1.0)
+
+    def test_empty_window_with_shape_reads_nothing(self):
+        from omero_ms_image_region_tpu.ops.projection import (
+            project_planes)
+        reads = []
+
+        def get_plane(z):
+            reads.append(z)
+            return np.zeros((4, 4), np.float32)
+
+        out = np.asarray(project_planes(
+            get_plane, Projection.MEAN_INTENSITY, 32, 3, 3, 1, 65535.0,
+            shape=(4, 4)))
+        assert reads == []
+        np.testing.assert_array_equal(out, np.zeros((4, 4)))

@@ -14,19 +14,27 @@ Everything in this package is pure, jittable, and batch-friendly:
                  (= ShapeMaskRequestHandler render path)
 """
 
-from .quantum import quantize
-from .render import build_channel_tables, render_tile, render_tile_batch
-from .flip import flip_image
-from .projection import project_stack
-from .maskops import unpack_mask_bits, rasterize_mask
+# Lazy re-exports (PEP 562): importing the package must NOT pull the
+# JAX device stack — frontend proxy processes import jax-free modules
+# like ops.lut through this package and must stay device-free.
+_EXPORTS = {
+    "quantize": ".quantum",
+    "build_channel_tables": ".render",
+    "render_tile": ".render",
+    "render_tile_batch": ".render",
+    "flip_image": ".flip",
+    "project_stack": ".projection",
+    "unpack_mask_bits": ".maskops",
+    "rasterize_mask": ".maskops",
+}
 
-__all__ = [
-    "quantize",
-    "build_channel_tables",
-    "render_tile",
-    "render_tile_batch",
-    "flip_image",
-    "project_stack",
-    "unpack_mask_bits",
-    "rasterize_mask",
-]
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod, __name__), name)

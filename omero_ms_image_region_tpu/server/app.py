@@ -32,11 +32,14 @@ from ..services.cache import Caches
 from ..services.metadata import CanReadMemo, LocalMetadataService
 from ..services.sessions import (DjangoRedisSessionStore, SessionStore,
                                  StaticSessionStore, resolve_session_key)
-from .batcher import BatchingRenderer
 from .config import AppConfig
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
-from .handler import (ImageRegionHandler, ImageRegionServices, NotFoundError,
-                      Renderer, ShapeMaskHandler)
+from .errors import NotFoundError
+
+# NOTE: .handler and .batcher are imported lazily (inside
+# build_services / the combined-mode branch) — they pull in the JAX
+# device stack, and `--role frontend` processes must stay device-free so
+# they restart in milliseconds.
 
 log = logging.getLogger("omero_ms_image_region_tpu.server")
 
@@ -103,97 +106,121 @@ def _make_session_store(config: AppConfig) -> Optional[SessionStore]:
     return None
 
 
+def build_services(config: AppConfig) -> "ImageRegionServices":
+    """Construct the full render service stack for one device-owning
+    process (shared by the in-process app and the render sidecar)."""
+    from .batcher import BatchingRenderer
+    from .handler import ImageRegionServices, Renderer
+    if config.parallel.enabled:
+        # Mesh-sharded serving (≙ the reference's -cluster mode):
+        # groups dispatch through the (data, chan) mesh steps.
+        from ..parallel import cluster
+        from ..parallel.serve import MeshRenderer
+        engine = config.renderer.jpeg_engine
+        if engine == "bitpack":
+            log.warning("renderer.jpeg-engine='bitpack' applies only "
+                        "to the direct renderer; the mesh renderer "
+                        "uses the sparse engine")
+            engine = "sparse"
+        cluster.initialize(
+            coordinator_address=config.parallel.coordinator_address,
+            num_processes=config.parallel.num_processes,
+            process_id=config.parallel.process_id)
+        mesh = cluster.global_mesh(
+            chan_parallel=config.parallel.chan_parallel,
+            n_devices=config.parallel.n_devices)
+        if engine == "auto":
+            # Probe strictly after cluster.initialize():
+            # jax.distributed must come up before anything touches a
+            # backend, or a multi-host pod degrades to per-host
+            # standalone meshes.
+            from ..utils.linkprobe import resolve_auto_engine
+            engine = resolve_auto_engine()
+        log.info("mesh serving enabled: %s (jpeg engine %s)",
+                 dict(mesh.shape), engine)
+        renderer = MeshRenderer(
+            mesh, max_batch=config.batcher.max_batch,
+            linger_ms=config.batcher.linger_ms,
+            jpeg_engine=engine,
+            pipeline_depth=config.batcher.pipeline_depth)
+    elif config.batcher.enabled:
+        engine = config.renderer.jpeg_engine
+        if engine == "bitpack":
+            log.warning("renderer.jpeg-engine='bitpack' applies only "
+                        "to the direct renderer; the batcher uses "
+                        "the sparse engine")
+            engine = "sparse"
+        elif engine == "auto":
+            # Pick the wire engine for this deployment's actual link
+            # (sparse above ~12 MB/s device->host, huffman below).
+            from ..utils.linkprobe import resolve_auto_engine
+            engine = resolve_auto_engine()
+        renderer = BatchingRenderer(
+            max_batch=config.batcher.max_batch,
+            linger_ms=config.batcher.linger_ms,
+            jpeg_engine=engine,
+            pipeline_depth=config.batcher.pipeline_depth)
+    else:
+        engine = config.renderer.jpeg_engine
+        if engine == "auto":
+            from ..utils.linkprobe import resolve_auto_engine
+            engine = resolve_auto_engine()
+        renderer = Renderer(jpeg_engine=engine,
+                            kernel=config.renderer.kernel)
+    caches = Caches.from_config(config.caches)
+    if config.caches.redis_uri and caches.redis is None:
+        log.warning("redis package unavailable; redis cache tier and "
+                    "shared canRead memo disabled")
+    services = ImageRegionServices(
+        pixels_service=PixelsService(config.data_dir),
+        metadata=LocalMetadataService(config.data_dir),
+        caches=caches,
+        # The canRead memo's shared tier plays the reference's
+        # Hazelcast distributed-map role across service instances; it
+        # rides the caches' one Redis client
+        # (ImageRegionVerticle.java:107-111).
+        can_read_memo=CanReadMemo(shared=caches.redis),
+        renderer=renderer,
+        lut_provider=LutProvider(config.lut_root),
+        max_tile_length=config.max_tile_length,
+        cpu_fallback_max_px=config.renderer.cpu_fallback_max_px,
+        # HBM-resident raw tile tier: settings changes re-render hot
+        # tiles without re-crossing the host link.
+        raw_cache=(DeviceRawCache(config.raw_cache.max_bytes)
+                   if config.raw_cache.enabled else None),
+    )
+    if services.raw_cache is not None and config.raw_cache.prefetch:
+        from ..services.prefetch import TilePrefetcher
+        services.prefetcher = TilePrefetcher(services.raw_cache)
+    return services
+
+
 def create_app(config: Optional[AppConfig] = None,
-               services: Optional[ImageRegionServices] = None
+               services: Optional["ImageRegionServices"] = None
                ) -> web.Application:
-    """Build the application; ``services`` injection is the test seam."""
+    """Build the application; ``services`` injection is the test seam.
+
+    With ``sidecar.socket`` configured and role ``frontend``, the app
+    builds NO device-side services: render requests forward over the
+    unix socket to the shared sidecar process (the reference's
+    event-bus seam, ``ImageRegionVerticle.java:128-136``)."""
     config = config or AppConfig()
 
-    if services is None:
-        if config.parallel.enabled:
-            # Mesh-sharded serving (≙ the reference's -cluster mode):
-            # groups dispatch through the (data, chan) mesh steps.
-            from ..parallel import cluster
-            from ..parallel.serve import MeshRenderer
-            engine = config.renderer.jpeg_engine
-            if engine == "bitpack":
-                log.warning("renderer.jpeg-engine='bitpack' applies only "
-                            "to the direct renderer; the mesh renderer "
-                            "uses the sparse engine")
-                engine = "sparse"
-            cluster.initialize(
-                coordinator_address=config.parallel.coordinator_address,
-                num_processes=config.parallel.num_processes,
-                process_id=config.parallel.process_id)
-            mesh = cluster.global_mesh(
-                chan_parallel=config.parallel.chan_parallel,
-                n_devices=config.parallel.n_devices)
-            if engine == "auto":
-                # Probe strictly after cluster.initialize():
-                # jax.distributed must come up before anything touches a
-                # backend, or a multi-host pod degrades to per-host
-                # standalone meshes.
-                from ..utils.linkprobe import resolve_auto_engine
-                engine = resolve_auto_engine()
-            log.info("mesh serving enabled: %s (jpeg engine %s)",
-                     dict(mesh.shape), engine)
-            renderer = MeshRenderer(
-                mesh, max_batch=config.batcher.max_batch,
-                linger_ms=config.batcher.linger_ms,
-                jpeg_engine=engine,
-                pipeline_depth=config.batcher.pipeline_depth)
-        elif config.batcher.enabled:
-            engine = config.renderer.jpeg_engine
-            if engine == "bitpack":
-                log.warning("renderer.jpeg-engine='bitpack' applies only "
-                            "to the direct renderer; the batcher uses "
-                            "the sparse engine")
-                engine = "sparse"
-            elif engine == "auto":
-                # Pick the wire engine for this deployment's actual link
-                # (sparse above ~12 MB/s device->host, huffman below).
-                from ..utils.linkprobe import resolve_auto_engine
-                engine = resolve_auto_engine()
-            renderer = BatchingRenderer(
-                max_batch=config.batcher.max_batch,
-                linger_ms=config.batcher.linger_ms,
-                jpeg_engine=engine,
-                pipeline_depth=config.batcher.pipeline_depth)
-        else:
-            engine = config.renderer.jpeg_engine
-            if engine == "auto":
-                from ..utils.linkprobe import resolve_auto_engine
-                engine = resolve_auto_engine()
-            renderer = Renderer(jpeg_engine=engine,
-                                kernel=config.renderer.kernel)
-        caches = Caches.from_config(config.caches)
-        if config.caches.redis_uri and caches.redis is None:
-            log.warning("redis package unavailable; redis cache tier and "
-                        "shared canRead memo disabled")
-        services = ImageRegionServices(
-            pixels_service=PixelsService(config.data_dir),
-            metadata=LocalMetadataService(config.data_dir),
-            caches=caches,
-            # The canRead memo's shared tier plays the reference's
-            # Hazelcast distributed-map role across service instances; it
-            # rides the caches' one Redis client
-            # (ImageRegionVerticle.java:107-111).
-            can_read_memo=CanReadMemo(shared=caches.redis),
-            renderer=renderer,
-            lut_provider=LutProvider(config.lut_root),
-            max_tile_length=config.max_tile_length,
-            cpu_fallback_max_px=config.renderer.cpu_fallback_max_px,
-            # HBM-resident raw tile tier: settings changes re-render hot
-            # tiles without re-crossing the host link.
-            raw_cache=(DeviceRawCache(config.raw_cache.max_bytes)
-                       if config.raw_cache.enabled else None),
-        )
-        if services.raw_cache is not None and config.raw_cache.prefetch:
-            from ..services.prefetch import TilePrefetcher
-            services.prefetcher = TilePrefetcher(services.raw_cache)
-
-    image_handler = ImageRegionHandler(services)
-    mask_handler = ShapeMaskHandler(services)
+    proxy_mode = (services is None and config.sidecar.socket
+                  and config.sidecar.role == "frontend")
+    if proxy_mode:
+        from .sidecar import (SidecarClient, SidecarImageHandler,
+                              SidecarMaskHandler)
+        client = SidecarClient(config.sidecar.socket)
+        image_handler = SidecarImageHandler(client)
+        mask_handler = SidecarMaskHandler(client)
+        services = None
+    else:
+        from .handler import ImageRegionHandler, ShapeMaskHandler
+        if services is None:
+            services = build_services(config)
+        image_handler = ImageRegionHandler(services)
+        mask_handler = ShapeMaskHandler(services)
     session_store = _make_session_store(config)
 
     async def session_key(request: web.Request) -> Optional[str]:
@@ -295,6 +322,9 @@ def create_app(config: Optional[AppConfig] = None,
                 f"imageregion_span_mean_ms{label} {s['mean_ms']}",
                 f"imageregion_span_p50_ms{label} {s['p50_ms']}",
             ]
+        if services is None:        # frontend proxy: span metrics only
+            return web.Response(text="\n".join(lines) + "\n",
+                                content_type="text/plain")
         for cache_name in ("image_region", "pixels_metadata", "shape_mask"):
             stack = getattr(services.caches, cache_name, None)
             for i, tier in enumerate(getattr(stack, "tiers", ())):
@@ -329,7 +359,9 @@ def create_app(config: Optional[AppConfig] = None,
             "provider": PROVIDER,
             "version": __version__,
             "features": FEATURES,
-            "options": {"maxTileLength": services.max_tile_length},
+            "options": {"maxTileLength":
+                        (services.max_tile_length if services is not None
+                         else config.max_tile_length)},
         }
         if config.cache_control_header:
             doc["options"]["cacheControl"] = config.cache_control_header
@@ -343,7 +375,7 @@ def create_app(config: Optional[AppConfig] = None,
         ImageRegionRequestHandler.java:316-427).  Degrades to the local
         backend with a warning when asyncpg is unavailable, the same
         posture as the session stores."""
-        if config.metadata_backend != "postgres":
+        if services is None or config.metadata_backend != "postgres":
             return
         from ..services.db_metadata import PostgresMetadataService
         try:
@@ -387,19 +419,24 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):
+        if proxy_mode:
+            await client.close()
         db_meta = app.get("_db_metadata")
         if db_meta is not None:
             await db_meta.close()
-        if isinstance(services.renderer, BatchingRenderer):
-            await services.renderer.close()
-        # Drain prefetch workers before the pixel stores close under them.
-        if services.prefetcher is not None:
-            services.prefetcher.flush(timeout=2.0)
-            services.prefetcher.close()
-        services.pixels_service.close()
-        close_caches = getattr(services.caches, "close", None)
-        if close_caches is not None:
-            await close_caches()  # the one shared Redis client (memo too)
+        if services is not None:
+            from .batcher import BatchingRenderer
+            if isinstance(services.renderer, BatchingRenderer):
+                await services.renderer.close()
+            # Drain prefetch workers before the pixel stores close under
+            # them.
+            if services.prefetcher is not None:
+                services.prefetcher.flush(timeout=2.0)
+                services.prefetcher.close()
+            services.pixels_service.close()
+            close_caches = getattr(services.caches, "close", None)
+            if close_caches is not None:
+                await close_caches()  # one shared Redis client (memo too)
         close = getattr(session_store, "close", None)
         if close is not None:
             await close()
@@ -487,6 +524,12 @@ def main(argv=None) -> None:
     parser.add_argument("--config", help="YAML config path")
     parser.add_argument("--port", type=int)
     parser.add_argument("--data-dir")
+    parser.add_argument(
+        "--role", choices=["combined", "frontend", "sidecar", "split"],
+        help="process role for the frontend/compute split "
+             "(sidecar.role in the config)")
+    parser.add_argument("--sidecar-socket",
+                        help="unix socket of the render sidecar")
     args = parser.parse_args(argv)
 
     config = (AppConfig.from_yaml(args.config) if args.config
@@ -495,9 +538,39 @@ def main(argv=None) -> None:
         config.port = args.port
     if args.data_dir is not None:
         config.data_dir = args.data_dir
+    if args.sidecar_socket is not None:
+        config.sidecar.socket = args.sidecar_socket
+    if args.role is not None:
+        config.sidecar.role = args.role
+    if config.sidecar.role != "combined" and not config.sidecar.socket:
+        parser.error(f"--role {config.sidecar.role} requires "
+                     f"--sidecar-socket")
 
     configure_logging(config)
-    run_app(create_app(config), config)
+
+    if config.sidecar.role == "sidecar":
+        # Device-owning process: no HTTP listener, serves renders on the
+        # unix socket (≙ a worker-verticle-only deployment).
+        from .sidecar import sidecar_main
+        sidecar_main(config)
+        return
+
+    child = None
+    if config.sidecar.role == "split":
+        from .sidecar import spawn_sidecar
+        child = spawn_sidecar(args.config, config.sidecar.socket,
+                              extra_args=(["--data-dir", args.data_dir]
+                                          if args.data_dir else None))
+        config.sidecar.role = "frontend"
+    try:
+        run_app(create_app(config), config)
+    finally:
+        if child is not None:
+            child.terminate()
+            try:
+                child.wait(timeout=15)
+            except Exception:
+                child.kill()
 
 
 if __name__ == "__main__":

@@ -63,6 +63,24 @@ class RendererConfig:
 
 
 @dataclass
+class SidecarConfig:
+    """Frontend/compute process split (≙ the reference's event-bus seam,
+    ``ImageRegionVerticle.java:128-136``): N frontend processes forward
+    serialized request ctxs over a unix socket to ONE device-owning
+    sidecar process.
+
+    role:
+      combined — single process, HTTP + device (default; socket unused)
+      frontend — HTTP only; forward renders to ``socket``
+      sidecar  — device only; serve renders on ``socket``
+      split    — spawn a sidecar child, then serve as a frontend
+    """
+
+    socket: Optional[str] = None
+    role: str = "combined"
+
+
+@dataclass
 class ParallelConfig:
     """Mesh-sharded serving (≙ the reference's ``-cluster`` mode:
     Hazelcast-clustered worker verticles,
@@ -140,6 +158,7 @@ class AppConfig:
     http: HttpConfig = field(default_factory=HttpConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    sidecar: SidecarConfig = field(default_factory=SidecarConfig)
 
     @classmethod
     def from_yaml(cls, path: str) -> "AppConfig":
@@ -229,6 +248,18 @@ class AppConfig:
             max_bytes=int(rc.get("max-bytes", rc_defaults.max_bytes)),
             prefetch=bool(rc.get("prefetch", rc_defaults.prefetch)),
         )
+        sc = raw.get("sidecar", {}) or {}
+        sc_defaults = SidecarConfig()
+        cfg.sidecar = SidecarConfig(
+            socket=sc.get("socket", sc_defaults.socket),
+            role=str(sc.get("role", sc_defaults.role)),
+        )
+        if cfg.sidecar.role not in ("combined", "frontend", "sidecar",
+                                    "split"):
+            raise ValueError(f"invalid sidecar.role {cfg.sidecar.role!r}")
+        if cfg.sidecar.role != "combined" and not cfg.sidecar.socket:
+            raise ValueError(f"sidecar.role {cfg.sidecar.role!r} "
+                             f"requires sidecar.socket")
         par = raw.get("parallel", {}) or {}
         par_defaults = ParallelConfig()
         cfg.parallel = ParallelConfig(

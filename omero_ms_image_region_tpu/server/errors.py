@@ -1,0 +1,10 @@
+"""Status-mapped service exceptions, importable without the device
+stack (frontend proxy processes must not pull JAX just for the error
+contract).  ``BadRequestError`` lives in :mod:`.ctx` next to the
+parsers; this module holds the rest.
+"""
+
+
+class NotFoundError(Exception):
+    """Maps to HTTP 404 (the reference's ObjectNotFound / unreadable /
+    unrenderable outcomes; ``ImageRegionVerticle.java:163-188``)."""

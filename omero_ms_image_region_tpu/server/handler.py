@@ -47,9 +47,9 @@ DEFAULT_MAX_TILE_LENGTH = 2048  # beanRefContext.xml:63-66
 _STAGE_BAND_ROWS = 256
 
 
-class NotFoundError(Exception):
-    """Maps to HTTP 404 (the reference's ObjectNotFound / unreadable /
-    unrenderable outcomes; ``ImageRegionVerticle.java:163-188``)."""
+from .errors import NotFoundError  # noqa: E402,F401  (re-export; the
+# exception lives in the device-free errors module so frontend proxy
+# processes can share the status contract without importing JAX)
 
 
 class Renderer:

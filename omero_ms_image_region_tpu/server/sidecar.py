@@ -1,0 +1,342 @@
+"""Render sidecar: the frontend/compute process boundary.
+
+The reference isolates HTTP handling from rendering across the Vert.x
+event bus — the HTTP verticle serializes the request ctx onto the
+``omero.render_image_region`` address and worker verticles (possibly in
+other JVMs) decode and render (``ImageRegionVerticle.java:128-136``,
+``ImageRegionMicroserviceVerticle.java:294-352``).  Here the bus is a
+unix-domain socket with length-prefixed JSON+binary frames: N frontend
+processes (HTTP parse, session resolution, status mapping) share ONE
+sidecar process that owns the device, the batcher, the pixel stores and
+the caches.  A frontend crash leaves the sidecar serving — the device
+never recompiles because an HTTP process died — and frontends restart
+in milliseconds because they import no device stack at all.
+
+Wire format, little-endian (the ctx payloads are the same JSON the
+in-process path round-trips through ``ImageRegionCtx.to_json`` — the
+reference's Jackson bus encoding, ``ImageRegionCtxTest.java:205-208``):
+
+  frame:    u32 frame_len | payload
+  request:  u32 header_len | header JSON {id, op, ctx}
+  response: u32 header_len | header JSON {id, status, content_type,
+            error?} | body bytes
+
+Responses are multiplexed by ``id`` and may arrive out of order, so one
+connection carries a frontend's full concurrency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import struct
+from typing import Dict, Optional
+
+from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
+from .errors import NotFoundError
+
+logger = logging.getLogger(__name__)
+
+_MAX_FRAME = 256 * 1024 * 1024
+
+
+def _pack(header: dict, body: bytes = b"") -> bytes:
+    h = json.dumps(header).encode()
+    return (struct.pack("<II", 4 + len(h) + len(body), len(h))
+            + h + body)
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    raw_len = await reader.readexactly(4)
+    (frame_len,) = struct.unpack("<I", raw_len)
+    if frame_len > _MAX_FRAME:
+        raise ValueError(f"frame of {frame_len} bytes exceeds limit")
+    payload = await reader.readexactly(frame_len)
+    (header_len,) = struct.unpack("<I", payload[:4])
+    header = json.loads(payload[4:4 + header_len])
+    return header, payload[4 + header_len:]
+
+
+# ---------------------------------------------------------------- server
+
+async def _serve_connection(image_handler, mask_handler, reader, writer):
+    """One frontend connection: demux requests, run each as a task."""
+    write_lock = asyncio.Lock()
+    tasks = set()
+
+    async def respond(header: dict, body: bytes = b"") -> None:
+        async with write_lock:
+            writer.write(_pack(header, body))
+            await writer.drain()
+
+    async def handle(header: dict) -> None:
+        from .. import codecs
+
+        rid = header.get("id")
+        try:
+            op = header["op"]
+            if op == "image":
+                ctx = ImageRegionCtx.from_json(header["ctx"])
+                body = await image_handler.render_image_region(ctx)
+                ctype = codecs.CONTENT_TYPES.get(
+                    ctx.format, "application/octet-stream")
+            elif op == "mask":
+                ctx = ShapeMaskCtx.from_json(header["ctx"])
+                body = await mask_handler.render_shape_mask(ctx)
+                ctype = "image/png"
+            else:
+                raise BadRequestError(f"unknown op {op!r}")
+        except BadRequestError as e:
+            body, out = b"", {"id": rid, "status": 400, "error": str(e)}
+        except (NotFoundError, FileNotFoundError):
+            body, out = b"", {"id": rid, "status": 404}
+        except Exception:
+            logger.exception("sidecar render failed")
+            body, out = b"", {"id": rid, "status": 500}
+        else:
+            out = {"id": rid, "status": 200, "content_type": ctype}
+        try:
+            await respond(out, body)
+        except (ConnectionError, OSError):
+            # The frontend died mid-response (its crash is survivable by
+            # design); the render itself completed fine.
+            logger.debug("frontend went away before response %s", rid)
+
+    try:
+        while True:
+            try:
+                header, _body = await _read_frame(reader)
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                break
+            t = asyncio.create_task(handle(header))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
+    finally:
+        for t in tasks:
+            t.cancel()
+        writer.close()
+
+
+async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
+    """Serve renders on the unix socket until cancelled.  Owns the full
+    device-side stack (``app.build_services``)."""
+    from .app import build_services
+    from .handler import ImageRegionHandler, ShapeMaskHandler
+
+    socket_path = socket_path or config.sidecar.socket
+    services = build_services(config)
+    if config.metadata_backend == "postgres":
+        from ..services.db_metadata import PostgresMetadataService
+        try:
+            services.metadata = await PostgresMetadataService.connect(
+                config.metadata_dsn)
+        except ImportError:
+            logger.warning("metadata-service.type is 'postgres' but "
+                           "asyncpg is unavailable; using the local "
+                           "backend")
+    image_handler = ImageRegionHandler(services)
+    mask_handler = ShapeMaskHandler(services)
+
+    # A stale socket from a crashed run must be cleared — but a LIVE one
+    # must not be stolen (a second sidecar would silently split serving
+    # state with the first).  Connecting probes liveness.
+    if os.path.exists(socket_path):
+        probe_ok = False
+        try:
+            _r, _w = await asyncio.wait_for(
+                asyncio.open_unix_connection(socket_path), timeout=2.0)
+            _w.close()
+            probe_ok = True
+        except (OSError, asyncio.TimeoutError):
+            pass
+        if probe_ok:
+            raise RuntimeError(
+                f"another render sidecar is already serving on "
+                f"{socket_path}")
+        os.unlink(socket_path)
+
+    async def on_conn(reader, writer):
+        await _serve_connection(image_handler, mask_handler, reader,
+                                writer)
+
+    server = await asyncio.start_unix_server(on_conn, path=socket_path)
+    logger.info("render sidecar serving on %s", socket_path)
+    try:
+        async with server:
+            await server.serve_forever()
+    finally:
+        # Same teardown order as the combined app's on_cleanup: renderer
+        # first, then prefetch workers BEFORE the pixel stores close
+        # under them, then the shared cache clients.
+        from .batcher import BatchingRenderer
+        if isinstance(services.renderer, BatchingRenderer):
+            await services.renderer.close()
+        if services.prefetcher is not None:
+            services.prefetcher.flush(timeout=2.0)
+            services.prefetcher.close()
+        services.pixels_service.close()
+        close_caches = getattr(services.caches, "close", None)
+        if close_caches is not None:
+            await close_caches()
+
+
+# ---------------------------------------------------------------- client
+
+class SidecarClient:
+    """Multiplexed unix-socket client (one connection, many in-flight
+    requests).  Reconnects lazily; in-flight requests fail fast when the
+    sidecar goes away, mirroring the reference's ReplyException
+    propagation from a dead bus consumer."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._conn_lock = asyncio.Lock()
+        self._write_lock = asyncio.Lock()
+
+    async def _ensure_connected(self) -> None:
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            reader, writer = await asyncio.open_unix_connection(
+                self.socket_path)
+            self._writer = writer
+            self._reader_task = asyncio.create_task(
+                self._read_loop(reader))
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                header, body = await _read_frame(reader)
+                fut = self._pending.pop(header.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result((header, body))
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                asyncio.CancelledError, OSError):
+            pass
+        finally:
+            self._fail_pending(ConnectionError("render sidecar went away"))
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    async def call(self, op: str, ctx_json: dict):
+        """Returns (status, content_type, body_or_error)."""
+        await self._ensure_connected()
+        self._next_id += 1
+        rid = self._next_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            async with self._write_lock:
+                self._writer.write(_pack(
+                    {"id": rid, "op": op, "ctx": ctx_json}))
+                await self._writer.drain()
+        except (ConnectionError, OSError):
+            self._pending.pop(rid, None)
+            raise ConnectionError("render sidecar went away")
+        header, body = await fut
+        return (header["status"], header.get("content_type"),
+                body if header["status"] == 200
+                else header.get("error", ""))
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except asyncio.CancelledError:
+                pass
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        self._fail_pending(ConnectionError("client closed"))
+
+
+class SidecarImageHandler:
+    """Drop-in for ``ImageRegionHandler`` on the frontend side: same
+    call surface, same exception contract (the app's status mapping is
+    reused verbatim)."""
+
+    def __init__(self, client: SidecarClient):
+        self.client = client
+
+    async def render_image_region(self, ctx: ImageRegionCtx) -> bytes:
+        status, _ctype, payload = await self.client.call(
+            "image", ctx.to_json())
+        return _map_status(status, payload)
+
+
+class SidecarMaskHandler:
+    def __init__(self, client: SidecarClient):
+        self.client = client
+
+    async def render_shape_mask(self, ctx: ShapeMaskCtx) -> bytes:
+        status, _ctype, payload = await self.client.call(
+            "mask", ctx.to_json())
+        return _map_status(status, payload)
+
+
+def _map_status(status: int, payload):
+    if status == 200:
+        return payload
+    if status == 400:
+        raise BadRequestError(str(payload))
+    if status == 404:
+        raise NotFoundError()
+    raise RuntimeError(f"sidecar render failed ({status})")
+
+
+# --------------------------------------------------------------- launch
+
+def sidecar_main(config) -> None:
+    """Blocking entry for ``--role sidecar`` (the device process)."""
+    try:
+        asyncio.run(run_sidecar(config))
+    except KeyboardInterrupt:
+        pass
+
+
+def spawn_sidecar(config_path: Optional[str], socket_path: str,
+                  extra_args: Optional[list] = None):
+    """``--role split``: start the device process as a child and wait
+    for its socket to accept.  Returns the Popen handle."""
+    import subprocess
+    import sys
+    import time
+
+    argv = [sys.executable, "-m", "omero_ms_image_region_tpu.server",
+            "--role", "sidecar", "--sidecar-socket", socket_path]
+    if config_path:
+        argv += ["--config", config_path]
+    argv += list(extra_args or ())
+    proc = subprocess.Popen(argv)
+    deadline = time.monotonic() + 180
+    import socket as pysocket
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"sidecar exited with {proc.returncode} during startup")
+        try:
+            s = pysocket.socket(pysocket.AF_UNIX)
+            s.settimeout(1.0)
+            s.connect(socket_path)
+            s.close()
+            return proc
+        except OSError:
+            time.sleep(0.2)
+    proc.terminate()
+    raise RuntimeError("sidecar did not open its socket in time")

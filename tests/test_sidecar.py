@@ -1,0 +1,215 @@
+"""Frontend/compute process split (render sidecar over a unix socket).
+
+≙ the reference's event-bus seam: HTTP verticles serialize ctxs to
+``omero.render_image_region``; worker verticles render
+(``ImageRegionVerticle.java:128-136``).
+"""
+
+import asyncio
+import os
+import signal
+import socket as pysocket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_image_region_tpu.io.store import build_pyramid
+from omero_ms_image_region_tpu.models.mask import Mask
+from omero_ms_image_region_tpu.server.app import create_app
+from omero_ms_image_region_tpu.server.config import (AppConfig,
+                                                     SidecarConfig)
+from omero_ms_image_region_tpu.server.sidecar import run_sidecar
+from omero_ms_image_region_tpu.services.metadata import write_mask
+
+IMG, MASK = 3, 9
+H = W = 64
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    rng = np.random.default_rng(21)
+    planes = rng.integers(0, 60000, size=(2, 2, H, W)).astype(np.uint16)
+    build_pyramid(planes, str(tmp_path / str(IMG)), chunk=(32, 32),
+                  n_levels=1)
+    bits = np.zeros(H * W, np.uint8)
+    bits[:512] = 1
+    write_mask(str(tmp_path), Mask(shape_id=MASK, width=W, height=H,
+                                   bytes_=np.packbits(bits).tobytes()))
+    return str(tmp_path)
+
+
+def _frontend_config(data_dir, sock):
+    return AppConfig(data_dir=data_dir,
+                     sidecar=SidecarConfig(socket=sock, role="frontend"))
+
+
+async def _with_sidecar(data_dir, sock, body):
+    """Run the sidecar task + `body()` in one loop."""
+    sidecar_cfg = AppConfig(data_dir=data_dir)
+    task = asyncio.create_task(run_sidecar(sidecar_cfg, sock))
+    try:
+        for _ in range(200):
+            if os.path.exists(sock):
+                break
+            await asyncio.sleep(0.05)
+        return await body()
+    finally:
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
+def test_render_through_sidecar_matches_combined(data_dir, tmp_path):
+    sock = str(tmp_path / "render.sock")
+    url = (f"/webgateway/render_image_region/{IMG}/1/0"
+           f"?c=1|0:60000$FF0000,2|0:55000$00FF00&m=c&format=png")
+    mask_url = f"/webgateway/render_shape_mask/{MASK}?color=00FF00"
+
+    async def body():
+        app = create_app(_frontend_config(data_dir, sock))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(url)
+            png = await r.read()
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "image/png"
+            rm = await client.get(mask_url)
+            mask_png = await rm.read()
+            assert rm.status == 200
+            # Status mapping crosses the boundary intact.
+            r400 = await client.get(
+                f"/webgateway/render_image_region/{IMG}/9/0?m=c")
+            assert r400.status == 400 and b"" != await r400.read()
+            r404 = await client.get(
+                "/webgateway/render_image_region/777/0/0?m=c")
+            assert r404.status == 404
+            return png, mask_png
+        finally:
+            await client.close()
+
+    png, mask_png = asyncio.run(_with_sidecar(data_dir, sock, body))
+
+    # Byte-identical to the combined single-process render.
+    async def combined():
+        app = create_app(AppConfig(data_dir=data_dir))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(url)
+            rm = await client.get(mask_url)
+            return await r.read(), await rm.read()
+        finally:
+            await client.close()
+
+    png2, mask_png2 = asyncio.run(combined())
+    assert png == png2
+    assert mask_png == mask_png2
+
+
+def test_two_frontends_share_one_sidecar(data_dir, tmp_path):
+    sock = str(tmp_path / "render.sock")
+    url = (f"/webgateway/render_image_region/{IMG}/0/0"
+           f"?c=1|0:60000$FF0000&m=g&format=png")
+
+    async def body():
+        apps = [create_app(_frontend_config(data_dir, sock))
+                for _ in range(2)]
+        clients = []
+        for app in apps:
+            c = TestClient(TestServer(app))
+            await c.start_server()
+            clients.append(c)
+        try:
+            rs = await asyncio.gather(*(c.get(url) for c in clients))
+            bodies = [await r.read() for r in rs]
+            assert all(r.status == 200 for r in rs)
+            assert bodies[0] == bodies[1]
+            # Tearing one frontend down leaves the other serving.
+            await clients[0].close()
+            r = await clients[1].get(url)
+            assert r.status == 200
+            return True
+        finally:
+            for c in clients[1:]:
+                await c.close()
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body))
+
+
+def _wait_http(port, path, deadline_s=120):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.status, r.read()
+        except Exception:
+            time.sleep(0.3)
+    raise TimeoutError(f"no HTTP answer on :{port}")
+
+
+def test_split_processes_survive_frontend_crash(data_dir, tmp_path):
+    """Real processes: one sidecar, two frontends.  SIGKILL one frontend;
+    the sidecar and the other frontend keep serving."""
+    sock = str(tmp_path / "render.sock")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def spawn(args, log_name):
+        log = open(tmp_path / log_name, "wb")
+        return subprocess.Popen(
+            [sys.executable, "-m", "omero_ms_image_region_tpu.server",
+             "--data-dir", data_dir] + args,
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+
+    def free_port():
+        with pysocket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    p1, p2 = free_port(), free_port()
+    sidecar = spawn(["--role", "sidecar", "--sidecar-socket", sock],
+                    "sidecar.log")
+    front1 = front2 = None
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(sock):
+            assert sidecar.poll() is None, "sidecar died at startup"
+            assert time.monotonic() < deadline, "sidecar socket missing"
+            time.sleep(0.2)
+        front1 = spawn(["--role", "frontend", "--sidecar-socket", sock,
+                        "--port", str(p1)], "front1.log")
+        front2 = spawn(["--role", "frontend", "--sidecar-socket", sock,
+                        "--port", str(p2)], "front2.log")
+        url = (f"/webgateway/render_image_region/{IMG}/0/0"
+               f"?c=1|0:60000$FF0000&m=g&format=png")
+        s1, b1 = _wait_http(p1, url)
+        s2, b2 = _wait_http(p2, url)
+        assert (s1, s2) == (200, 200)
+        assert b1 == b2 and b1[:4] == b"\x89PNG"
+
+        front1.kill()          # hard crash, no cleanup
+        front1.wait(timeout=30)
+        # The sidecar shrugs; the surviving frontend still renders.
+        s3, b3 = _wait_http(p2, url)
+        assert s3 == 200 and b3 == b2
+        assert sidecar.poll() is None
+    finally:
+        for proc in (front1, front2, sidecar):
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in (front1, front2, sidecar):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()

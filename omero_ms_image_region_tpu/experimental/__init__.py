@@ -1,0 +1,6 @@
+"""Experimental kernels — NOT on any serving path.
+
+Code here is kept for reference and future work; nothing in server/,
+ops/ or parallel/ imports it.  See each module's docstring for why it
+was demoted.
+"""

@@ -1,4 +1,26 @@
-"""Pallas TPU kernel for the fused tile render.
+"""Pallas TPU kernel for the fused tile render — EXPERIMENTAL, demoted
+off the serving path (round 3).
+
+Why demoted, with the on-chip evidence (v5e via tunnel, 2026-07-30):
+
+* Trivial Mosaic kernels now compile and run on the real chip (the
+  earlier remote-compile breakage is gone), but THIS kernel's one-hot
+  MXU formulation needs a ``(bh, W) -> (bh*W, 1)`` flatten that Mosaic
+  rejects: ``infer-vector-layout: unsupported shape cast`` for
+  ``tpu.reshape (256x1024) -> (262144x1)``.  Parity therefore still
+  holds only in interpret mode (tests/test_pallas.py).
+* More decisively: stage profiling on the real chip shows the XLA
+  render+DCT+quant path costs ~3 ms per 8-tile 1024^2 batch — the
+  render is already fused and effectively free, with the JPEG wire
+  packers' compaction/deposit scatters dominating device time.  A
+  faster render kernel has no headroom to win; the serving path should
+  not carry a dead config option for it
+  (``Renderer.renderAsPackedInt``, ``ImageRegionRequestHandler
+  .java:559``, is fully served by ``ops.render``).
+
+Kept as an experiment: the one-hot-as-MXU-contraction pattern and the
+SMEM scalar-prefetch layout are reusable if a VMEM-resident fusion ever
+becomes the bottleneck.
 
 Alternative device path to ``ops.render``'s XLA-fused gather: the whole
 pipeline — per-channel window/family quantization, reverse-intensity, color
@@ -33,7 +55,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .quantum import _ratio as _quantum_ratio
+from ..ops.quantum import _ratio as _quantum_ratio
 
 # Row-block height per grid step; W is never blocked (tiles are <= 2048
 # wide and a full row keeps the lane dim dense).

@@ -56,9 +56,11 @@ class RendererConfig:
     # congested links; batcher-compatible), or "bitpack" (the legacy
     # full-grid device Huffman; direct renderer only).
     jpeg_engine: str = "sparse"
-    # Render kernel for the direct (unbatched) renderer: "xla" (the
-    # fused gather kernel) or "pallas" (the one-hot-MXU VMEM kernel,
-    # ops.pallas_render; interpret mode off-TPU).
+    # Render kernel for the direct (unbatched) renderer.  Only "xla":
+    # the pallas one-hot-MXU kernel was demoted to
+    # experimental/pallas_render.py (Mosaic layout limitation on chip;
+    # and the XLA render is ~free — the wire packers dominate device
+    # time), so the serving path carries no dead option.
     kernel: str = "xla"
 
 
@@ -295,8 +297,9 @@ class AppConfig:
             raise ValueError(
                 f"renderer.jpeg-engine must be 'sparse', 'huffman', "
                 f"'bitpack' or 'auto', got {cfg.renderer.jpeg_engine!r}")
-        if cfg.renderer.kernel not in ("xla", "pallas"):
+        if cfg.renderer.kernel != "xla":
             raise ValueError(
-                f"renderer.kernel must be 'xla' or 'pallas', "
+                f"renderer.kernel must be 'xla' (the experimental "
+                f"pallas kernel is not a serving option), "
                 f"got {cfg.renderer.kernel!r}")
         return cfg

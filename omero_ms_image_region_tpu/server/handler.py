@@ -74,13 +74,18 @@ class Renderer:
                  kernel: str = "xla"):
         if jpeg_engine not in ("sparse", "huffman", "bitpack"):
             raise ValueError(f"unknown jpeg engine {jpeg_engine!r}")
-        if kernel not in ("xla", "pallas"):
-            raise ValueError(f"unknown render kernel {kernel!r}")
+        if kernel != "xla":
+            # The pallas render kernel was demoted to
+            # experimental/pallas_render.py: on-chip it hits a Mosaic
+            # layout limitation, and stage profiling shows the XLA
+            # render is already ~free (the wire packers dominate), so
+            # the serving path carries no dead option.
+            raise ValueError(
+                f"unknown render kernel {kernel!r} (only 'xla'; the "
+                f"experimental pallas kernel is not a serving option)")
         self.jpeg_engine = jpeg_engine
         self.kernel = kernel
         import threading
-        self._pallas_ok = False
-        self._pallas_lock = threading.Lock()
         from collections import OrderedDict
         self._bitpack_encoders: "OrderedDict" = OrderedDict()
         # render_jpeg runs on asyncio worker threads; concurrent requests
@@ -93,28 +98,6 @@ class Renderer:
         return await asyncio.to_thread(self._render_sync, raw, settings)
 
     def _render_sync(self, raw: np.ndarray, settings: dict) -> np.ndarray:
-        if self.kernel == "pallas":
-            try:
-                out = self._render_sync_pallas(raw, settings)
-                self._pallas_ok = True
-                return out
-            except Exception:
-                # Degrade, never fail.  A failure is either environmental
-                # (a Mosaic/Pallas compile path that cannot work here,
-                # e.g. a remote-compile helper that cannot initialize
-                # libtpu — flip to the XLA kernel for good; bit-identical
-                # output, different codegen) or per-request (odd settings,
-                # transient OOM — serve this one via XLA, keep pallas).
-                # A tiny canonical probe distinguishes the two.
-                if self._pallas_env_broken():
-                    logger.warning(
-                        "pallas kernel cannot run in this environment; "
-                        "falling back to the XLA kernel for this "
-                        "renderer", exc_info=True)
-                else:
-                    logger.warning(
-                        "pallas render failed; serving this request via "
-                        "the XLA kernel", exc_info=True)
         out = render_tile_packed(
             raw, settings["window_start"], settings["window_end"],
             settings["family"], settings["coefficient"],
@@ -122,62 +105,6 @@ class Renderer:
             settings["tables"],
         )
         return np.asarray(out)
-
-    def _pallas_env_broken(self) -> bool:
-        """Classify a pallas failure: True iff even a canonical minimal
-        render fails here (broken compile environment).  Locked so
-        concurrent first requests probe once: the probing thread flips
-        ``self.kernel`` before releasing the lock, so waiters
-        short-circuit instead of re-running the (slow) failing compile;
-        a success recorded by any request also settles the question."""
-        with self._pallas_lock:
-            if self._pallas_ok:
-                return False
-            if self.kernel != "pallas":   # another thread already flipped
-                return True
-            try:
-                probe = {
-                    "window_start": np.zeros(1, np.float32),
-                    "window_end": np.full(1, 255.0, np.float32),
-                    "family": np.zeros(1, np.int32),
-                    "coefficient": np.ones(1, np.float32),
-                    "reverse": np.zeros(1, np.int32),
-                    "cd_start": 0, "cd_end": 255,
-                    "tables": np.zeros((1, 256, 3), np.float32),
-                }
-                self._render_sync_pallas(
-                    np.zeros((1, 8, 128), np.float32), probe)
-            except Exception:
-                self.kernel = "xla"       # flip before waiters wake
-                return True
-            self._pallas_ok = True
-            return False
-
-    def _render_sync_pallas(self, raw: np.ndarray,
-                            settings: dict) -> np.ndarray:
-        """The Pallas one-hot-MXU kernel (``ops.pallas_render``) for the
-        direct render path.  Selected via ``renderer.kernel: pallas``; it
-        needs full color tables (ramp weights expand exactly: the folded
-        table at index q is q * weight) and per-request settings arrive
-        unbatched, which is precisely the kernel's contract.  Off-TPU
-        backends run it in interpreter mode so the config stays testable
-        anywhere.
-        """
-        import jax
-
-        from ..ops.pallas_render import render_tile_batch_packed_pallas
-
-        tables = settings["tables"]
-        if tables.ndim == 2:      # ramp weights [C, 3] -> full tables
-            tables = (np.arange(256, dtype=np.float32)[None, :, None]
-                      * np.asarray(tables, np.float32)[:, None, :])
-        out = render_tile_batch_packed_pallas(
-            np.ascontiguousarray(raw, np.float32)[None],
-            settings["window_start"], settings["window_end"],
-            settings["family"], settings["coefficient"],
-            settings["reverse"], settings["cd_start"], settings["cd_end"],
-            tables, interpret=jax.default_backend() != "tpu")
-        return np.asarray(out)[0]
 
     async def render_jpeg(self, raw: np.ndarray, settings: dict,
                           quality: int, width: int, height: int) -> bytes:

@@ -18,8 +18,11 @@ reference's Jackson bus encoding, ``ImageRegionCtxTest.java:205-208``):
 
   frame:    u32 frame_len | payload
   request:  u32 header_len | header JSON {id, op, ctx}
-  response: u32 header_len | header JSON {id, status, content_type,
-            error?} | body bytes
+  response: u32 header_len | header JSON {id, status, error?} | body
+            (the Content-Type stays a frontend concern — both sides
+            derive it from the ctx, exactly like the reference's HTTP
+            verticle does after a bus reply,
+            ``ImageRegionMicroserviceVerticle.java:326-345``)
 
 Responses are multiplexed by ``id`` and may arrive out of order, so one
 connection carries a frontend's full concurrency.
@@ -72,20 +75,15 @@ async def _serve_connection(image_handler, mask_handler, reader, writer):
             await writer.drain()
 
     async def handle(header: dict) -> None:
-        from .. import codecs
-
         rid = header.get("id")
         try:
             op = header["op"]
             if op == "image":
                 ctx = ImageRegionCtx.from_json(header["ctx"])
                 body = await image_handler.render_image_region(ctx)
-                ctype = codecs.CONTENT_TYPES.get(
-                    ctx.format, "application/octet-stream")
             elif op == "mask":
                 ctx = ShapeMaskCtx.from_json(header["ctx"])
                 body = await mask_handler.render_shape_mask(ctx)
-                ctype = "image/png"
             else:
                 raise BadRequestError(f"unknown op {op!r}")
         except BadRequestError as e:
@@ -96,7 +94,7 @@ async def _serve_connection(image_handler, mask_handler, reader, writer):
             logger.exception("sidecar render failed")
             body, out = b"", {"id": rid, "status": 500}
         else:
-            out = {"id": rid, "status": 200, "content_type": ctype}
+            out = {"id": rid, "status": 200}
         try:
             await respond(out, body)
         except (ConnectionError, OSError):
@@ -126,22 +124,12 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
     from .handler import ImageRegionHandler, ShapeMaskHandler
 
     socket_path = socket_path or config.sidecar.socket
-    services = build_services(config)
-    if config.metadata_backend == "postgres":
-        from ..services.db_metadata import PostgresMetadataService
-        try:
-            services.metadata = await PostgresMetadataService.connect(
-                config.metadata_dsn)
-        except ImportError:
-            logger.warning("metadata-service.type is 'postgres' but "
-                           "asyncpg is unavailable; using the local "
-                           "backend")
-    image_handler = ImageRegionHandler(services)
-    mask_handler = ShapeMaskHandler(services)
 
     # A stale socket from a crashed run must be cleared — but a LIVE one
     # must not be stolen (a second sidecar would silently split serving
-    # state with the first).  Connecting probes liveness.
+    # state with the first).  Probe BEFORE building the device stack so
+    # an accidental double-start fails instantly and side-effect-free
+    # (build_services grabs the device and may join jax.distributed).
     if os.path.exists(socket_path):
         probe_ok = False
         try:
@@ -157,6 +145,20 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
                 f"{socket_path}")
         os.unlink(socket_path)
 
+    services = build_services(config)
+    db_metadata = None
+    if config.metadata_backend == "postgres":
+        from ..services.db_metadata import PostgresMetadataService
+        try:
+            services.metadata = db_metadata = \
+                await PostgresMetadataService.connect(config.metadata_dsn)
+        except ImportError:
+            logger.warning("metadata-service.type is 'postgres' but "
+                           "asyncpg is unavailable; using the local "
+                           "backend")
+    image_handler = ImageRegionHandler(services)
+    mask_handler = ShapeMaskHandler(services)
+
     async def on_conn(reader, writer):
         await _serve_connection(image_handler, mask_handler, reader,
                                 writer)
@@ -167,10 +169,12 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
         async with server:
             await server.serve_forever()
     finally:
-        # Same teardown order as the combined app's on_cleanup: renderer
-        # first, then prefetch workers BEFORE the pixel stores close
-        # under them, then the shared cache clients.
+        # Same teardown order as the combined app's on_cleanup: DB
+        # metadata and renderer first, then prefetch workers BEFORE the
+        # pixel stores close under them, then the shared cache clients.
         from .batcher import BatchingRenderer
+        if db_metadata is not None:
+            await db_metadata.close()
         if isinstance(services.renderer, BatchingRenderer):
             await services.renderer.close()
         if services.prefetcher is not None:
@@ -234,7 +238,7 @@ class SidecarClient:
                 fut.set_exception(exc)
 
     async def call(self, op: str, ctx_json: dict):
-        """Returns (status, content_type, body_or_error)."""
+        """Returns (status, body_or_error)."""
         await self._ensure_connected()
         self._next_id += 1
         rid = self._next_id
@@ -249,7 +253,7 @@ class SidecarClient:
             self._pending.pop(rid, None)
             raise ConnectionError("render sidecar went away")
         header, body = await fut
-        return (header["status"], header.get("content_type"),
+        return (header["status"],
                 body if header["status"] == 200
                 else header.get("error", ""))
 
@@ -275,8 +279,7 @@ class SidecarImageHandler:
         self.client = client
 
     async def render_image_region(self, ctx: ImageRegionCtx) -> bytes:
-        status, _ctype, payload = await self.client.call(
-            "image", ctx.to_json())
+        status, payload = await self.client.call("image", ctx.to_json())
         return _map_status(status, payload)
 
 
@@ -285,8 +288,7 @@ class SidecarMaskHandler:
         self.client = client
 
     async def render_shape_mask(self, ctx: ShapeMaskCtx) -> bytes:
-        status, _ctype, payload = await self.client.call(
-            "mask", ctx.to_json())
+        status, payload = await self.client.call("mask", ctx.to_json())
         return _map_status(status, payload)
 
 

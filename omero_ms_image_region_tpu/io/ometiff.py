@@ -15,6 +15,10 @@ Layout understood (OME-TIFF 6.0):
 - one IFD per (z, c, t) plane, ordered by DimensionOrder when no
   TiffData elements are present;
 - pyramid levels as SubIFD chains (tag 330) of each plane IFD;
+- multi-file sets: TiffData UUID FileName entries map planes to sibling
+  files in the same directory (opened lazily), and BinaryOnly stubs
+  follow their MetadataFile pointer to the ``*.companion.ome`` — the
+  standard multi-file OMERO export layout;
 - plain (non-OME) TIFFs degrade gracefully: pages become Z sections of
   a single channel, or channels when SamplesPerPixel > 1.
 
@@ -24,6 +28,7 @@ straddle tile boundaries do not re-inflate the same compressed tile.
 
 from __future__ import annotations
 
+import os
 import re
 import threading
 import xml.etree.ElementTree as ET
@@ -63,9 +68,58 @@ class OmeTiffSource:
         self._lock = threading.Lock()
         self._seg_cache: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
         self._seg_cache_bytes = 0
+        # Multi-file OME-TIFF: sibling files referenced by TiffData UUID
+        # FileName entries, opened lazily and keyed by basename.  Key
+        # None = the primary file.
+        self._files: Dict[Optional[str], TiffFile] = {None: self._tf}
         self._parse_layout()
 
     # ------------------------------------------------------------- layout
+
+    def _file(self, key: Optional[str]) -> TiffFile:
+        tf = self._files.get(key)
+        if tf is None:
+            sibling = os.path.join(os.path.dirname(self.path), key)
+            if not os.path.exists(sibling):
+                raise FileNotFoundError(
+                    f"{self.path}: OME TiffData references missing "
+                    f"file {key!r}")
+            with self._lock:
+                tf = self._files.get(key)
+                if tf is None:
+                    tf = self._files[key] = TiffFile(sibling)
+        return tf
+
+    def _resolve_ome_root(self, desc: str) -> Optional[ET.Element]:
+        """The OME root for this file — following a BinaryOnly pointer
+        to its companion metadata file (``*.companion.ome``), the
+        standard multi-file OMERO export layout."""
+        try:
+            root = ET.fromstring(desc)
+        except ET.ParseError:
+            return None
+        for el in root.iter():
+            if _localname(el.tag) == "BinaryOnly":
+                meta = el.get("MetadataFile")
+                if not meta:
+                    return root
+                companion = os.path.join(
+                    os.path.dirname(self.path), meta)
+                if not os.path.exists(companion):
+                    raise FileNotFoundError(
+                        f"{self.path}: BinaryOnly metadata file "
+                        f"{meta!r} not found")
+                with open(companion, "rb") as f:
+                    try:
+                        return ET.fromstring(f.read())
+                    except ET.ParseError as e:
+                        # A present-but-corrupt companion must be as
+                        # loud as a missing one — degrading to plain-
+                        # TIFF semantics would serve wrong dimensions.
+                        raise ValueError(
+                            f"{self.path}: companion metadata "
+                            f"{meta!r} is not parseable XML: {e}")
+        return root
 
     def _parse_layout(self) -> None:
         tf = self._tf
@@ -75,15 +129,14 @@ class OmeTiffSource:
         self.dimension_order = "XYZCT"
         self.pixels_type: Optional[str] = None
         self._interleaved_c = False   # channels live in SamplesPerPixel
-        plane_map: Dict[Tuple[int, int, int], int] = {}
+        plane_map: Dict[Tuple[int, int, int],
+                        Tuple[Optional[str], int]] = {}
         spp = int(first.one(SAMPLES_PER_PIXEL, 1))
+        self_names = {None, os.path.basename(self.path)}
 
         px = None
         if "<OME" in desc or "<ome" in desc:
-            try:
-                root = ET.fromstring(desc)
-            except ET.ParseError:
-                root = None
+            root = self._resolve_ome_root(desc)
             px = _find_pixels(root) if root is not None else None
 
         if px is not None:
@@ -108,8 +161,14 @@ class OmeTiffSource:
             for td in px:
                 if _localname(td.tag) != "TiffData":
                     continue
-                # Multi-file OME-TIFF (UUID FileName elsewhere) is
-                # out of scope; same-file TiffData maps plane->IFD.
+                # Multi-file OME-TIFF: a UUID child's FileName names the
+                # sibling holding these planes (same directory).
+                file_key: Optional[str] = None
+                for child in td:
+                    if _localname(child.tag) == "UUID":
+                        name = child.get("FileName")
+                        if name and name not in self_names:
+                            file_key = name
                 fz = int(td.get("FirstZ", 0))
                 fc = int(td.get("FirstC", 0))
                 ft = int(td.get("FirstT", 0))
@@ -119,10 +178,15 @@ class OmeTiffSource:
                 elif td.get("IFD") is not None:
                     count = 1            # spec: IFD without PlaneCount
                 else:
-                    count = self._n_ifd_planes()
+                    # Attribute-less TiffData covers the TARGET file's
+                    # own IFDs in order (spec) — never the whole set's
+                    # plane count, which for a multi-file entry would
+                    # wrap plane coordinates and corrupt the map.
+                    count = len(self._file(file_key).ifds)
+                count = min(count, self._n_ifd_planes())
                 for k in range(count):
                     z, c, t = self._advance(fz, fc, ft, k)
-                    plane_map[(z, c, t)] = ifd0 + k
+                    plane_map[(z, c, t)] = (file_key, ifd0 + k)
         else:
             # Plain TIFF: pages = Z sections; chunky RGB = channels.
             if spp > 1:
@@ -137,13 +201,17 @@ class OmeTiffSource:
             }[np.dtype(first.dtype()).name]
 
         n_ifd_planes = self._n_ifd_planes()
-        if len(tf.ifds) < n_ifd_planes:
+        multi_file = any(k is not None for k, _ in plane_map.values())
+        if not multi_file and len(tf.ifds) < n_ifd_planes:
+            # Single-file: every declared plane must have an IFD here.
+            # Multi-file sets validate lazily at read (sibling files
+            # open on first touch).
             raise ValueError(
                 f"{self.path}: {len(tf.ifds)} IFDs < {n_ifd_planes} "
                 f"planes declared by OME metadata")
         if not plane_map:
             for i in range(n_ifd_planes):
-                plane_map[self._plane_of_index(i)] = i
+                plane_map[self._plane_of_index(i)] = (None, i)
         self._plane_map = plane_map
 
         # Pyramid: SubIFD chain of each plane IFD (OME-TIFF 6.0).  Level
@@ -153,7 +221,7 @@ class OmeTiffSource:
         self._level_dims: List[Tuple[int, int]] = [
             (first.width, first.height)
         ] + [(s.width, s.height) for s in subs]
-        self._level_ifds: Dict[Tuple[int, int], Ifd] = {}
+        self._level_ifds: Dict[Tuple[Optional[str], int, int], Ifd] = {}
 
     def _n_ifd_planes(self) -> int:
         """Planes that occupy their own IFD (interleaved C shares one)."""
@@ -185,28 +253,35 @@ class OmeTiffSource:
         idx += k
         return self._plane_of_index(idx)
 
-    def _ifd_for(self, z: int, c: int, t: int, level: int) -> Ifd:
+    def _ifd_for(self, z: int, c: int, t: int, level: int
+                 ) -> Tuple[TiffFile, Ifd]:
         key_c = 0 if self._interleaved_c else c
         try:
-            page = self._plane_map[(z, key_c, t)]
+            file_key, page = self._plane_map[(z, key_c, t)]
         except KeyError:
             raise ValueError(
                 f"{self.path}: no IFD for plane z={z} c={c} t={t}")
-        key = (page, level)
+        tf = self._file(file_key)
+        if page >= len(tf.ifds):
+            raise ValueError(
+                f"{self.path}: plane z={z} c={c} t={t} maps to IFD "
+                f"{page} but {file_key or 'this file'} has only "
+                f"{len(tf.ifds)}")
+        key = (file_key, page, level)
         ifd = self._level_ifds.get(key)
         if ifd is None:
-            base = self._tf.ifds[page]
+            base = tf.ifds[page]
             if level == 0:
                 ifd = base
             else:
-                subs = self._tf.sub_ifds(base)
+                subs = tf.sub_ifds(base)
                 if level - 1 >= len(subs):
                     raise ValueError(
                         f"{self.path}: page {page} has no level {level}")
                 ifd = subs[level - 1]
             with self._lock:
                 self._level_ifds[key] = ifd
-        return ifd
+        return tf, ifd
 
     # ----------------------------------------------------------- protocol
 
@@ -230,15 +305,15 @@ class OmeTiffSource:
         seg_h, seg_w, _, _ = self._tf.segment_grid(ifd)
         return (seg_w, seg_h)
 
-    def _segment(self, ifd: Ifd, page_key: tuple, gy: int, gx: int
-                 ) -> np.ndarray:
+    def _segment(self, tf: TiffFile, ifd: Ifd, page_key: tuple,
+                 gy: int, gx: int) -> np.ndarray:
         key = (page_key, gy, gx)
         with self._lock:
             seg = self._seg_cache.get(key)
             if seg is not None:
                 self._seg_cache.move_to_end(key)
                 return seg
-        seg = self._tf.read_segment(ifd, gy, gx)
+        seg = tf.read_segment(ifd, gy, gx)
         with self._lock:
             if key not in self._seg_cache:
                 self._seg_cache[key] = seg
@@ -257,8 +332,8 @@ class OmeTiffSource:
             raise ValueError(
                 f"region {region.as_tuple()} outside level {level} "
                 f"bounds ({sx}x{sy})")
-        ifd = self._ifd_for(z, c, t, level)
-        seg_h, seg_w, grid_y, grid_x = self._tf.segment_grid(ifd)
+        tf, ifd = self._ifd_for(z, c, t, level)
+        seg_h, seg_w, grid_y, grid_x = tf.segment_grid(ifd)
         sample = c if self._interleaved_c else 0
         out = np.empty((region.height, region.width), dtype=self.dtype)
         page_key = (z, 0 if self._interleaved_c else c, t, level)
@@ -269,7 +344,7 @@ class OmeTiffSource:
                 iy0, iy1 = max(y0, cy0), min(y1, cy0 + seg_h)
                 if ix0 >= ix1 or iy0 >= iy1:
                     continue
-                seg = self._segment(ifd, page_key, gy, gx)
+                seg = self._segment(tf, ifd, page_key, gy, gx)
                 out[iy0 - y0:iy1 - y0, ix0 - x0:ix1 - x0] = \
                     seg[iy0 - cy0:iy1 - cy0, ix0 - cx0:ix1 - cx0, sample]
         return out
@@ -286,14 +361,17 @@ class OmeTiffSource:
         with self._lock:
             self._seg_cache.clear()
             self._seg_cache_bytes = 0
-        self._tf.close()          # idempotent (file.close() is)
+            files = list(self._files.values())
+        for tf in files:
+            tf.close()            # idempotent (file.close() is)
 
     def __del__(self):  # pragma: no cover - GC timing
         # The PixelsService LRU drops evicted sources WITHOUT closing
         # them (an in-flight request may still be reading); the last
-        # reference closes the file handle here.
+        # reference closes the file handles here.
         try:
-            self._tf.close()
+            for tf in self._files.values():
+                tf.close()
         except Exception:
             pass
 

@@ -179,8 +179,12 @@ def write_ome_tiff(
     n_levels: Optional[int] = None,
     min_level_size: int = 256,
     bigtiff: Optional[bool] = None,
+    description: Optional[str] = None,
 ) -> str:
-    """Write [T, C, Z, H, W] (or [C, Z, H, W]) as a pyramidal OME-TIFF."""
+    """Write [T, C, Z, H, W] (or [C, Z, H, W]) as a pyramidal OME-TIFF.
+
+    ``description`` overrides the generated OME-XML — used to build
+    multi-file sets (TiffData FileName entries / BinaryOnly stubs)."""
     if planes.ndim == 4:
         planes = planes[None]
     if planes.ndim != 5:
@@ -197,7 +201,8 @@ def write_ome_tiff(
     bits = dt.itemsize * 8
     sfmt = _DTYPE_FMT[dt.kind]
     off_type = _LONG8 if bigtiff else _LONG
-    ome = _ome_xml(T, C, Z, H, W, dt).encode()
+    ome = (description if description is not None
+           else _ome_xml(T, C, Z, H, W, dt)).encode()
 
     with open(path, "wb") as f:
         out = _TiffOut(f, bigtiff)

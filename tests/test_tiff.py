@@ -389,3 +389,122 @@ def test_e2e_serves_ome_tiff(tmp_path):
     out = asyncio.run(fetch_all())
     for a, b in zip(out[1], out[2]):
         assert a == b
+
+
+# --------------------------------------------- multi-file OME-TIFF sets
+
+_OME_NS = 'xmlns="http://www.openmicroscopy.org/Schemas/OME/2016-06"'
+
+
+def _multi_file_xml(W, H, Z, C, names):
+    """OME-XML mapping channel c's Z planes to file names[c]."""
+    tds = "".join(
+        f'<TiffData FirstZ="0" FirstC="{c}" FirstT="0" IFD="0" '
+        f'PlaneCount="{Z}"><UUID FileName="{names[c]}">'
+        f'urn:uuid:f{c}</UUID></TiffData>'
+        for c in range(C))
+    return (
+        f'<?xml version="1.0"?><OME {_OME_NS}><Image ID="Image:0">'
+        f'<Pixels ID="Pixels:0" DimensionOrder="XYZCT" Type="uint16" '
+        f'SizeX="{W}" SizeY="{H}" SizeZ="{Z}" SizeC="{C}" SizeT="1" '
+        f'BigEndian="false">{tds}</Pixels></Image></OME>'
+    )
+
+
+def test_multi_file_ome_tiff(tmp_path):
+    """TiffData UUID FileName entries map planes to sibling files."""
+    rng = np.random.default_rng(22)
+    W, H, Z, C = 96, 80, 3, 2
+    planes = rng.integers(0, 60000, size=(C, Z, H, W)).astype(np.uint16)
+    names = ["c0.ome.tiff", "c1.ome.tiff"]
+    for c in range(C):
+        xml = _multi_file_xml(W, H, Z, C, names)
+        write_ome_tiff(planes[c][None], str(tmp_path / names[c]),
+                       tile=(64, 64), n_levels=1, description=xml)
+    src = OmeTiffSource(str(tmp_path / names[0]))
+    assert (src.size_z, src.size_c, src.size_t) == (Z, C, 1)
+    for c in range(C):
+        for z in range(Z):
+            got = src.get_region(z, c, 0, RegionDef(0, 0, W, H), 0)
+            assert np.array_equal(got, planes[c, z]), (c, z)
+    assert np.array_equal(src.get_stack(1, 0), planes[1])
+    src.close()
+
+
+def test_multi_file_missing_sibling_is_loud(tmp_path):
+    rng = np.random.default_rng(23)
+    W, H, Z, C = 32, 32, 1, 2
+    planes = rng.integers(0, 100, size=(C, Z, H, W)).astype(np.uint16)
+    names = ["a.ome.tiff", "gone.ome.tiff"]
+    xml = _multi_file_xml(W, H, Z, C, names)
+    write_ome_tiff(planes[0][None], str(tmp_path / names[0]),
+                   tile=(32, 32), n_levels=1, description=xml)
+    src = OmeTiffSource(str(tmp_path / names[0]))
+    # Plane in the present file reads; the missing sibling is loud.
+    src.get_region(0, 0, 0, RegionDef(0, 0, W, W), 0)
+    with pytest.raises(FileNotFoundError, match="gone.ome.tiff"):
+        src.get_region(0, 1, 0, RegionDef(0, 0, W, W), 0)
+    src.close()
+
+
+def test_companion_ome_metadata(tmp_path):
+    """BinaryOnly stubs follow MetadataFile to the companion OME-XML."""
+    rng = np.random.default_rng(24)
+    W, H, Z, C = 64, 48, 2, 2
+    planes = rng.integers(0, 60000, size=(C, Z, H, W)).astype(np.uint16)
+    names = ["p0.ome.tiff", "p1.ome.tiff"]
+    companion = "set.companion.ome"
+    (tmp_path / companion).write_text(
+        _multi_file_xml(W, H, Z, C, names))
+    stub = (f'<?xml version="1.0"?><OME {_OME_NS}>'
+            f'<BinaryOnly MetadataFile="{companion}" '
+            f'UUID="urn:uuid:x"/></OME>')
+    for c in range(C):
+        write_ome_tiff(planes[c][None], str(tmp_path / names[c]),
+                       tile=(64, 48), n_levels=1, description=stub)
+    src = OmeTiffSource(str(tmp_path / names[0]))
+    assert (src.size_z, src.size_c) == (Z, C)
+    for c in range(C):
+        for z in range(Z):
+            got = src.get_region(z, c, 0, RegionDef(0, 0, W, H), 0)
+            assert np.array_equal(got, planes[c, z]), (c, z)
+    src.close()
+
+
+def test_multi_file_bare_tiffdata_maps_target_file_only(tmp_path):
+    """Attribute-less TiffData with a FileName covers the TARGET file's
+    own IFDs, not the whole set's plane count."""
+    rng = np.random.default_rng(25)
+    W, H, Z, C = 32, 32, 3, 2
+    planes = rng.integers(0, 60000, size=(C, Z, H, W)).astype(np.uint16)
+    names = ["m0.ome.tiff", "m1.ome.tiff"]
+    tds = "".join(
+        f'<TiffData FirstZ="0" FirstC="{c}" FirstT="0">'
+        f'<UUID FileName="{names[c]}">urn:uuid:g{c}</UUID></TiffData>'
+        for c in range(C))
+    xml = (f'<?xml version="1.0"?><OME {_OME_NS}><Image ID="Image:0">'
+           f'<Pixels ID="Pixels:0" DimensionOrder="XYZCT" Type="uint16" '
+           f'SizeX="{W}" SizeY="{H}" SizeZ="{Z}" SizeC="{C}" SizeT="1" '
+           f'BigEndian="false">{tds}</Pixels></Image></OME>')
+    for c in range(C):
+        write_ome_tiff(planes[c][None], str(tmp_path / names[c]),
+                       tile=(32, 32), n_levels=1, description=xml)
+    src = OmeTiffSource(str(tmp_path / names[0]))
+    for c in range(C):
+        for z in range(Z):
+            got = src.get_region(z, c, 0, RegionDef(0, 0, W, H), 0)
+            assert np.array_equal(got, planes[c, z]), (c, z)
+    src.close()
+
+
+def test_corrupt_companion_is_loud(tmp_path):
+    rng = np.random.default_rng(26)
+    planes = rng.integers(0, 100, size=(1, 1, 32, 32)).astype(np.uint16)
+    (tmp_path / "bad.companion.ome").write_text("<OME truncated")
+    stub = (f'<?xml version="1.0"?><OME {_OME_NS}>'
+            f'<BinaryOnly MetadataFile="bad.companion.ome" '
+            f'UUID="urn:uuid:x"/></OME>')
+    write_ome_tiff(planes, str(tmp_path / "s.ome.tiff"), tile=(32, 32),
+                   n_levels=1, description=stub)
+    with pytest.raises(ValueError, match="companion"):
+        OmeTiffSource(str(tmp_path / "s.ome.tiff"))

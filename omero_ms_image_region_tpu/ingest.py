@@ -1,0 +1,138 @@
+"""Ingest/export tooling: ``python -m omero_ms_image_region_tpu.ingest``.
+
+The reference's deployments lean on OMERO's importer (Bio-Formats) to
+populate the binary repository; this CLI covers the same operational
+needs for a standalone data directory:
+
+  info <image_dir|tiff>              print geometry, levels, backend
+  tiff-to-store <tiff> <image_dir>   OME-TIFF -> chunked pyramid layout
+  store-to-tiff <image_dir> <tiff>   chunked pyramid -> tiled OME-TIFF
+
+Conversions read plane by plane but do hold ONE full-resolution
+[T, C, Z, H, W] copy (plus ~1/3 extra for the rebuilt pyramid levels)
+while writing — size the host accordingly for WSI-scale inputs.  The
+storage dtype is preserved; pyramid levels are rebuilt with the same
+mean-pool reduction both writers share.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _open_source(path: str):
+    import os
+
+    from .io.ometiff import OmeTiffSource, find_tiff
+    from .io.store import ChunkedPyramidStore
+
+    if os.path.isdir(path):
+        if os.path.exists(os.path.join(path, "meta.json")):
+            return ChunkedPyramidStore(path), "chunked"
+        tiff = find_tiff(path)
+        if tiff is not None:
+            return OmeTiffSource(tiff), "ome-tiff"
+        raise SystemExit(f"{path}: neither meta.json nor a TIFF found")
+    return OmeTiffSource(path), "ome-tiff"
+
+
+def cmd_info(args) -> int:
+    src, backend = _open_source(args.path)
+    try:
+        sx, sy = src.resolution_descriptions()[0]
+        print(f"backend:  {backend}")
+        print(f"plane:    {sx} x {sy}")
+        print(f"z/c/t:    {src.size_z} / {src.size_c} / {src.size_t}")
+        print(f"dtype:    {np.dtype(src.dtype).name}")
+        print(f"levels:   {src.resolution_descriptions()}")
+        print(f"tile:     {src.tile_size()}")
+    finally:
+        src.close()
+    return 0
+
+
+def _gather_planes(src):
+    """[T, C, Z, H, W] assembled via the sources' own stack reads."""
+    sx, sy = src.resolution_descriptions()[0]
+    out = np.empty((src.size_t, src.size_c, src.size_z, sy, sx),
+                   dtype=src.dtype)
+    for t in range(src.size_t):
+        for c in range(src.size_c):
+            out[t, c] = src.get_stack(c, t)
+    return out
+
+
+def cmd_tiff_to_store(args) -> int:
+    from .io.ometiff import OmeTiffSource
+    from .io.store import build_pyramid
+
+    src = OmeTiffSource(args.tiff)
+    try:
+        planes = _gather_planes(src)
+    finally:
+        src.close()
+    build_pyramid(planes, args.image_dir, chunk=(args.tile, args.tile),
+                  min_level_size=args.min_level)
+    print(f"wrote chunked pyramid at {args.image_dir}")
+    return 0
+
+
+def cmd_store_to_tiff(args) -> int:
+    from .io.store import ChunkedPyramidStore
+    from .io.tiffwrite import _OME_TYPE, write_ome_tiff
+
+    src = ChunkedPyramidStore(args.image_dir)
+    if np.dtype(src.dtype).name not in _OME_TYPE:
+        src.close()
+        raise SystemExit(
+            f"{args.image_dir}: dtype {np.dtype(src.dtype).name} has no "
+            f"OME-TIFF pixel type (supported: "
+            f"{', '.join(sorted(_OME_TYPE))})")
+    try:
+        planes = _gather_planes(src)
+    finally:
+        src.close()
+    write_ome_tiff(planes, args.tiff, tile=(args.tile, args.tile),
+                   compression=args.compression,
+                   min_level_size=args.min_level)
+    print(f"wrote OME-TIFF at {args.tiff}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m omero_ms_image_region_tpu.ingest",
+        description="Convert/inspect image-region data directories")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("info", help="print an image's geometry")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_info)
+
+    p = sub.add_parser("tiff-to-store",
+                       help="OME-TIFF -> chunked pyramid dir")
+    p.add_argument("tiff")
+    p.add_argument("image_dir")
+    p.add_argument("--tile", type=int, default=256)
+    p.add_argument("--min-level", type=int, default=256)
+    p.set_defaults(fn=cmd_tiff_to_store)
+
+    p = sub.add_parser("store-to-tiff",
+                       help="chunked pyramid dir -> tiled OME-TIFF")
+    p.add_argument("image_dir")
+    p.add_argument("tiff")
+    p.add_argument("--tile", type=int, default=256)
+    p.add_argument("--min-level", type=int, default=256)
+    p.add_argument("--compression", choices=["none", "deflate"],
+                   default="deflate")
+    p.set_defaults(fn=cmd_store_to_tiff)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""Ingest CLI: backend conversions round-trip losslessly."""
+
+import numpy as np
+
+from omero_ms_image_region_tpu.ingest import main
+from omero_ms_image_region_tpu.io.ometiff import OmeTiffSource
+from omero_ms_image_region_tpu.io.store import (ChunkedPyramidStore,
+                                                build_pyramid)
+from omero_ms_image_region_tpu.io.tiffwrite import write_ome_tiff
+from omero_ms_image_region_tpu.server.region import RegionDef
+
+
+def test_roundtrip_both_directions(tmp_path, capsys):
+    rng = np.random.default_rng(30)
+    planes = rng.integers(0, 60000, size=(2, 3, 150, 200)).astype(
+        np.uint16)
+    tiff1 = str(tmp_path / "in.ome.tiff")
+    write_ome_tiff(planes, tiff1, tile=(64, 64), n_levels=1)
+
+    store_dir = str(tmp_path / "5")
+    assert main(["tiff-to-store", tiff1, store_dir, "--tile", "64"]) == 0
+    store = ChunkedPyramidStore(store_dir)
+    full = RegionDef(0, 0, 200, 150)
+    for c in range(2):
+        for z in range(3):
+            assert np.array_equal(store.get_region(z, c, 0, full, 0),
+                                  planes[c, z])
+    store.close()
+
+    tiff2 = str(tmp_path / "out.ome.tiff")
+    assert main(["store-to-tiff", store_dir, tiff2, "--tile", "64"]) == 0
+    back = OmeTiffSource(tiff2)
+    for c in range(2):
+        for z in range(3):
+            assert np.array_equal(back.get_region(z, c, 0, full, 0),
+                                  planes[c, z])
+    back.close()
+
+    assert main(["info", store_dir]) == 0
+    out = capsys.readouterr().out
+    assert "chunked" in out and "200 x 150" in out and "uint16" in out
+    assert main(["info", tiff2]) == 0
+    assert "ome-tiff" in capsys.readouterr().out

@@ -112,8 +112,13 @@ async def _serve_connection(image_handler, mask_handler, reader, writer):
             tasks.add(t)
             t.add_done_callback(tasks.discard)
     finally:
-        for t in tasks:
+        # Cancel AND await the per-request tasks: a bare cancel() only
+        # schedules the CancelledError, and the sidecar's teardown must
+        # not close services while a render is still unwinding on them.
+        for t in list(tasks):
             t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
         writer.close()
 
 
@@ -159,16 +164,43 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
     image_handler = ImageRegionHandler(services)
     mask_handler = ShapeMaskHandler(services)
 
+    # Server.close() only stops the LISTENER; established connections
+    # and their handler coroutines would outlive a shutdown (and keep
+    # serving from half-torn-down services).  Track them and cancel at
+    # teardown so a restart is clean.
+    conn_tasks: set = set()
+
     async def on_conn(reader, writer):
-        await _serve_connection(image_handler, mask_handler, reader,
-                                writer)
+        task = asyncio.current_task()
+        conn_tasks.add(task)
+        try:
+            await _serve_connection(image_handler, mask_handler, reader,
+                                    writer)
+        finally:
+            conn_tasks.discard(task)
 
     server = await asyncio.start_unix_server(on_conn, path=socket_path)
     logger.info("render sidecar serving on %s", socket_path)
     try:
-        async with server:
-            await server.serve_forever()
+        # NOT serve_forever()/`async with server`: BOTH await
+        # wait_closed() on cancellation, which (3.12.1+) blocks until
+        # every live connection handler finishes — with frontends
+        # holding connections open, shutdown would deadlock before we
+        # could cancel the handlers.  The server is already accepting
+        # (start_unix_server starts serving); just park until
+        # cancelled, then close the listener, cancel the handlers, and
+        # only THEN wait.
+        await asyncio.Event().wait()
     finally:
+        server.close()
+        for task in list(conn_tasks):
+            task.cancel()
+        if conn_tasks:
+            await asyncio.gather(*conn_tasks, return_exceptions=True)
+        try:
+            await server.wait_closed()
+        except Exception:
+            pass
         # Same teardown order as the combined app's on_cleanup: DB
         # metadata and renderer first, then prefetch workers BEFORE the
         # pixel stores close under them, then the shared cache clients.
@@ -188,6 +220,25 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
 
 # ---------------------------------------------------------------- client
 
+class _Conn:
+    """One connection generation: its writer, its pending futures, its
+    read loop.  A stale generation's failure can then never touch a
+    newer generation's state."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.pending: Dict[int, asyncio.Future] = {}
+        self.reader_task: Optional[asyncio.Task] = None
+
+    def fail_pending(self, exc: BaseException) -> None:
+        pending, self.pending = self.pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+
 class SidecarClient:
     """Multiplexed unix-socket client (one connection, many in-flight
     requests).  Reconnects lazily; in-flight requests fail fast when the
@@ -196,78 +247,92 @@ class SidecarClient:
 
     def __init__(self, socket_path: str):
         self.socket_path = socket_path
-        self._writer: Optional[asyncio.StreamWriter] = None
-        self._reader_task: Optional[asyncio.Task] = None
-        self._pending: Dict[int, asyncio.Future] = {}
+        self._conn: Optional[_Conn] = None
         self._next_id = 0
         self._conn_lock = asyncio.Lock()
         self._write_lock = asyncio.Lock()
 
-    async def _ensure_connected(self) -> None:
-        if self._writer is not None and not self._writer.is_closing():
-            return
+    async def _ensure_connected(self) -> _Conn:
+        conn = self._conn
+        if conn is not None and not conn.writer.is_closing():
+            return conn
         async with self._conn_lock:
-            if self._writer is not None and not self._writer.is_closing():
-                return
+            conn = self._conn
+            if conn is not None and not conn.writer.is_closing():
+                return conn
             reader, writer = await asyncio.open_unix_connection(
                 self.socket_path)
-            self._writer = writer
-            self._reader_task = asyncio.create_task(
-                self._read_loop(reader))
+            conn = _Conn(reader, writer)
+            conn.reader_task = asyncio.create_task(
+                self._read_loop(conn))
+            self._conn = conn
+            return conn
 
-    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+    async def _read_loop(self, conn: _Conn) -> None:
         try:
             while True:
-                header, body = await _read_frame(reader)
-                fut = self._pending.pop(header.get("id"), None)
+                header, body = await _read_frame(conn.reader)
+                fut = conn.pending.pop(header.get("id"), None)
                 if fut is not None and not fut.done():
                     fut.set_result((header, body))
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 asyncio.CancelledError, OSError):
             pass
         finally:
-            self._fail_pending(ConnectionError("render sidecar went away"))
-            if self._writer is not None:
-                self._writer.close()
-                self._writer = None
-
-    def _fail_pending(self, exc: BaseException) -> None:
-        pending, self._pending = self._pending, {}
-        for fut in pending.values():
-            if not fut.done():
-                fut.set_exception(exc)
+            # Strictly generation-local: fail THIS connection's waiters
+            # and close THIS writer; a newer generation opened by a
+            # retry is untouched.
+            conn.fail_pending(
+                ConnectionError("render sidecar went away"))
+            conn.writer.close()
+            if self._conn is conn:
+                self._conn = None
 
     async def call(self, op: str, ctx_json: dict):
-        """Returns (status, body_or_error)."""
-        await self._ensure_connected()
-        self._next_id += 1
-        rid = self._next_id
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[rid] = fut
-        try:
-            async with self._write_lock:
-                self._writer.write(_pack(
-                    {"id": rid, "op": op, "ctx": ctx_json}))
-                await self._writer.drain()
-        except (ConnectionError, OSError):
-            self._pending.pop(rid, None)
-            raise ConnectionError("render sidecar went away")
-        header, body = await fut
-        return (header["status"],
-                body if header["status"] == 200
-                else header.get("error", ""))
+        """Returns (status, body_or_error).
+
+        One transparent retry on a send-time connection failure: after
+        a sidecar restart the cached connection is dead exactly once,
+        and the request was provably not yet delivered, so re-sending
+        is safe (requests already in flight when the sidecar dies DO
+        fail — the sidecar may have partially executed them)."""
+        for attempt in (0, 1):
+            conn = await self._ensure_connected()
+            self._next_id += 1
+            rid = self._next_id
+            loop = asyncio.get_running_loop()
+            fut: asyncio.Future = loop.create_future()
+            conn.pending[rid] = fut
+            try:
+                async with self._write_lock:
+                    conn.writer.write(_pack(
+                        {"id": rid, "op": op, "ctx": ctx_json}))
+                    await conn.writer.drain()
+            except (ConnectionError, OSError):
+                conn.pending.pop(rid, None)
+                conn.writer.close()
+                if self._conn is conn:
+                    self._conn = None
+                if attempt == 0:
+                    continue
+                raise ConnectionError("render sidecar went away")
+            header, body = await fut
+            return (header["status"],
+                    body if header["status"] == 200
+                    else header.get("error", ""))
 
     async def close(self) -> None:
-        if self._reader_task is not None:
-            self._reader_task.cancel()
+        conn, self._conn = self._conn, None
+        if conn is None:
+            return
+        if conn.reader_task is not None:
+            conn.reader_task.cancel()
             try:
-                await self._reader_task
+                await conn.reader_task
             except asyncio.CancelledError:
                 pass
-        if self._writer is not None:
-            self._writer.close()
-            self._writer = None
-        self._fail_pending(ConnectionError("client closed"))
+        conn.writer.close()
+        conn.fail_pending(ConnectionError("client closed"))
 
 
 class SidecarImageHandler:

@@ -213,3 +213,116 @@ def test_split_processes_survive_frontend_crash(data_dir, tmp_path):
                     proc.wait(timeout=30)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+
+
+def test_sidecar_serves_from_device_mesh(data_dir, tmp_path):
+    """Composition of the two process postures: a sidecar whose
+    renderer is the mesh-sharded MeshRenderer (8-device virtual mesh)
+    behind a thin frontend — the reference's clustered worker verticles
+    reached over the bus seam."""
+    from omero_ms_image_region_tpu.server.config import ParallelConfig
+
+    sock = str(tmp_path / "mesh.sock")
+    url = (f"/webgateway/render_image_region/{IMG}/0/0"
+           f"?c=1|0:60000$FF0000,2|0:55000$00FF00&m=c&format=png")
+
+    async def body():
+        app = create_app(_frontend_config(data_dir, sock))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(url)
+            png = await r.read()
+            assert r.status == 200
+            return png
+        finally:
+            await client.close()
+
+    async def with_mesh_sidecar():
+        from omero_ms_image_region_tpu.server.sidecar import run_sidecar
+        cfg = AppConfig(data_dir=data_dir,
+                        parallel=ParallelConfig(enabled=True,
+                                                chan_parallel=2))
+        task = asyncio.create_task(run_sidecar(cfg, sock))
+        try:
+            for _ in range(200):
+                if os.path.exists(sock):
+                    break
+                await asyncio.sleep(0.05)
+            return await body()
+        finally:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    png = asyncio.run(with_mesh_sidecar())
+
+    # Byte-identical to the combined single-process (non-mesh) app —
+    # the sharded steps are bit-exact vs single-device.
+    async def combined():
+        app = create_app(AppConfig(data_dir=data_dir))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(url)
+            return await r.read()
+        finally:
+            await client.close()
+
+    assert png == asyncio.run(combined())
+
+
+def test_frontend_survives_sidecar_restart(data_dir, tmp_path):
+    """A request issued AFTER a sidecar restart succeeds transparently:
+    the client notices the dead cached connection at send time and
+    retries once on the new socket."""
+    sock = str(tmp_path / "render.sock")
+    url = (f"/webgateway/render_image_region/{IMG}/0/0"
+           f"?c=1|0:60000$FF0000&m=g&format=png")
+
+    async def scenario():
+        app = create_app(_frontend_config(data_dir, sock))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            cfg = AppConfig(data_dir=data_dir)
+            task = asyncio.create_task(run_sidecar(cfg, sock))
+            for _ in range(200):
+                if os.path.exists(sock):
+                    break
+                await asyncio.sleep(0.05)
+            r1 = await client.get(url)
+            b1 = await r1.read()
+            assert r1.status == 200
+
+            # Restart the sidecar (old socket torn down, new one up).
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+            # 3.13+ asyncio unlinks unix sockets on server close itself.
+            import pathlib
+            pathlib.Path(sock).unlink(missing_ok=True)
+            task = asyncio.create_task(run_sidecar(cfg, sock))
+            for _ in range(200):
+                if os.path.exists(sock):
+                    break
+                await asyncio.sleep(0.05)
+            try:
+                r2 = await client.get(url)
+                b2 = await r2.read()
+                assert r2.status == 200 and b2 == b1
+            finally:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+            return True
+        finally:
+            await client.close()
+
+    assert asyncio.run(scenario())

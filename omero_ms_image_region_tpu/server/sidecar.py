@@ -291,11 +291,12 @@ class SidecarClient:
     async def call(self, op: str, ctx_json: dict):
         """Returns (status, body_or_error).
 
-        One transparent retry on a send-time connection failure: after
-        a sidecar restart the cached connection is dead exactly once,
-        and the request was provably not yet delivered, so re-sending
-        is safe (requests already in flight when the sidecar dies DO
-        fail — the sidecar may have partially executed them)."""
+        One transparent retry when the connection dies under the
+        request — at send time OR while awaiting the reply (on asyncio
+        a write to a dead peer usually buffers fine and the failure
+        only surfaces through the read loop).  Renders are idempotent
+        pure reads, so re-issuing a request the dead sidecar may or may
+        not have executed is safe."""
         for attempt in (0, 1):
             conn = await self._ensure_connected()
             self._next_id += 1
@@ -308,15 +309,17 @@ class SidecarClient:
                     conn.writer.write(_pack(
                         {"id": rid, "op": op, "ctx": ctx_json}))
                     await conn.writer.drain()
+                header, body = await fut
             except (ConnectionError, OSError):
                 conn.pending.pop(rid, None)
+                if fut.done() and not fut.cancelled():
+                    fut.exception()   # mark retrieved (no log noise)
                 conn.writer.close()
                 if self._conn is conn:
                     self._conn = None
                 if attempt == 0:
                     continue
                 raise ConnectionError("render sidecar went away")
-            header, body = await fut
             return (header["status"],
                     body if header["status"] == 200
                     else header.get("error", ""))
@@ -325,6 +328,10 @@ class SidecarClient:
         conn, self._conn = self._conn, None
         if conn is None:
             return
+        # Fail waiters BEFORE cancelling the reader: its finally would
+        # otherwise beat us to it with the misleading "sidecar went
+        # away" on what is a deliberate client shutdown.
+        conn.fail_pending(ConnectionError("client closed"))
         if conn.reader_task is not None:
             conn.reader_task.cancel()
             try:
@@ -332,7 +339,6 @@ class SidecarClient:
             except asyncio.CancelledError:
                 pass
         conn.writer.close()
-        conn.fail_pending(ConnectionError("client closed"))
 
 
 class SidecarImageHandler:

@@ -48,15 +48,25 @@ def _frontend_config(data_dir, sock):
                      sidecar=SidecarConfig(socket=sock, role="frontend"))
 
 
+async def _wait_socket(sock, task):
+    """Wait for the sidecar's socket, surfacing an early task death
+    instead of timing out into an unrelated connection error."""
+    for _ in range(200):
+        if task.done():
+            exc = task.exception()
+            raise AssertionError(f"sidecar died at startup: {exc!r}")
+        if os.path.exists(sock):
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("sidecar socket never appeared")
+
+
 async def _with_sidecar(data_dir, sock, body):
     """Run the sidecar task + `body()` in one loop."""
     sidecar_cfg = AppConfig(data_dir=data_dir)
     task = asyncio.create_task(run_sidecar(sidecar_cfg, sock))
     try:
-        for _ in range(200):
-            if os.path.exists(sock):
-                break
-            await asyncio.sleep(0.05)
+        await _wait_socket(sock, task)
         return await body()
     finally:
         task.cancel()
@@ -245,10 +255,7 @@ def test_sidecar_serves_from_device_mesh(data_dir, tmp_path):
                                                 chan_parallel=2))
         task = asyncio.create_task(run_sidecar(cfg, sock))
         try:
-            for _ in range(200):
-                if os.path.exists(sock):
-                    break
-                await asyncio.sleep(0.05)
+            await _wait_socket(sock, task)
             return await body()
         finally:
             task.cancel()
@@ -289,10 +296,7 @@ def test_frontend_survives_sidecar_restart(data_dir, tmp_path):
         try:
             cfg = AppConfig(data_dir=data_dir)
             task = asyncio.create_task(run_sidecar(cfg, sock))
-            for _ in range(200):
-                if os.path.exists(sock):
-                    break
-                await asyncio.sleep(0.05)
+            await _wait_socket(sock, task)
             r1 = await client.get(url)
             b1 = await r1.read()
             assert r1.status == 200
@@ -307,10 +311,7 @@ def test_frontend_survives_sidecar_restart(data_dir, tmp_path):
             import pathlib
             pathlib.Path(sock).unlink(missing_ok=True)
             task = asyncio.create_task(run_sidecar(cfg, sock))
-            for _ in range(200):
-                if os.path.exists(sock):
-                    break
-                await asyncio.sleep(0.05)
+            await _wait_socket(sock, task)
             try:
                 r2 = await client.get(url)
                 b2 = await r2.read()

@@ -202,7 +202,8 @@ def create_app(config: Optional[AppConfig] = None,
 
     With ``sidecar.socket`` configured and role ``frontend``, the app
     builds NO device-side services: render requests forward over the
-    unix socket to the shared sidecar process (the reference's
+    sidecar socket (unix path, or ``host:port`` TCP for cross-host
+    frontends) to the shared sidecar process (the reference's
     event-bus seam, ``ImageRegionVerticle.java:128-136``)."""
     config = config or AppConfig()
 
@@ -528,8 +529,11 @@ def main(argv=None) -> None:
         "--role", choices=["combined", "frontend", "sidecar", "split"],
         help="process role for the frontend/compute split "
              "(sidecar.role in the config)")
-    parser.add_argument("--sidecar-socket",
-                        help="unix socket of the render sidecar")
+    parser.add_argument(
+        "--sidecar-socket",
+        help="render sidecar address: unix socket path, or host:port "
+             "for cross-host TCP (bind to a private interface; the "
+             "protocol is unauthenticated)")
     args = parser.parse_args(argv)
 
     config = (AppConfig.from_yaml(args.config) if args.config

@@ -68,8 +68,9 @@ class RendererConfig:
 class SidecarConfig:
     """Frontend/compute process split (≙ the reference's event-bus seam,
     ``ImageRegionVerticle.java:128-136``): N frontend processes forward
-    serialized request ctxs over a unix socket to ONE device-owning
-    sidecar process.
+    serialized request ctxs over a unix socket — or TCP when ``socket``
+    is ``host:port``, for frontends on other hosts — to ONE
+    device-owning sidecar process.
 
     role:
       combined — single process, HTTP + device (default; socket unused)

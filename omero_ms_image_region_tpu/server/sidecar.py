@@ -5,10 +5,12 @@ event bus — the HTTP verticle serializes the request ctx onto the
 ``omero.render_image_region`` address and worker verticles (possibly in
 other JVMs) decode and render (``ImageRegionVerticle.java:128-136``,
 ``ImageRegionMicroserviceVerticle.java:294-352``).  Here the bus is a
-unix-domain socket with length-prefixed JSON+binary frames: N frontend
-processes (HTTP parse, session resolution, status mapping) share ONE
-sidecar process that owns the device, the batcher, the pixel stores and
-the caches.  A frontend crash leaves the sidecar serving — the device
+unix-domain socket — or, given a ``host:port`` address, TCP, so
+frontends can live on different hosts than the device process (the
+cross-host half of the clustered bus) — carrying length-prefixed
+JSON+binary frames: N frontend processes (HTTP parse, session
+resolution, status mapping) share ONE sidecar process that owns the
+device, the batcher, the pixel stores and the caches.  A frontend crash leaves the sidecar serving — the device
 never recompiles because an HTTP process died — and frontends restart
 in milliseconds because they import no device stack at all.
 
@@ -43,6 +45,47 @@ from .errors import NotFoundError
 logger = logging.getLogger(__name__)
 
 _MAX_FRAME = 256 * 1024 * 1024
+
+
+def parse_address(addr: str):
+    """``host:port`` / ``[v6]:port`` -> ("tcp", host, port); anything
+    else is a unix socket path.  TCP lets frontends live on DIFFERENT
+    hosts than the device process — the cross-host half of the
+    reference's clustered event bus."""
+    if addr.startswith("["):                    # "[::1]:8476"
+        host, sep, port = addr.partition("]:")
+        if sep and port.isdigit():
+            return ("tcp", host[1:], int(port))
+        return ("unix", addr, None)
+    if "/" not in addr and addr.count(":") == 1:
+        host, _, port = addr.partition(":")
+        if port.isdigit():
+            return ("tcp", host or "127.0.0.1", int(port))
+    return ("unix", addr, None)
+
+
+def _set_nodelay(writer: asyncio.StreamWriter) -> None:
+    """Small request/response frames must not sit behind Nagle's
+    algorithm on the cross-host hot path."""
+    import socket as pysocket
+
+    sock = writer.get_extra_info("socket")
+    if sock is not None and sock.family in (pysocket.AF_INET,
+                                            pysocket.AF_INET6):
+        try:
+            sock.setsockopt(pysocket.IPPROTO_TCP,
+                            pysocket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
+async def open_sidecar_connection(addr: str):
+    kind, host, port = parse_address(addr)
+    if kind == "tcp":
+        reader, writer = await asyncio.open_connection(host, port)
+        _set_nodelay(writer)
+        return reader, writer
+    return await asyncio.open_unix_connection(host)
 
 
 def _pack(header: dict, body: bytes = b"") -> bytes:
@@ -129,13 +172,15 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
     from .handler import ImageRegionHandler, ShapeMaskHandler
 
     socket_path = socket_path or config.sidecar.socket
+    kind, host, port = parse_address(socket_path)
 
-    # A stale socket from a crashed run must be cleared — but a LIVE one
-    # must not be stolen (a second sidecar would silently split serving
-    # state with the first).  Probe BEFORE building the device stack so
-    # an accidental double-start fails instantly and side-effect-free
-    # (build_services grabs the device and may join jax.distributed).
-    if os.path.exists(socket_path):
+    # A stale unix socket from a crashed run must be cleared — but a
+    # LIVE one must not be stolen (a second sidecar would silently
+    # split serving state with the first).  Probe BEFORE building the
+    # device stack so an accidental double-start fails instantly and
+    # side-effect-free (build_services grabs the device and may join
+    # jax.distributed).  TCP needs no probe: bind fails on a live port.
+    if kind == "unix" and os.path.exists(socket_path):
         probe_ok = False
         try:
             _r, _w = await asyncio.wait_for(
@@ -171,6 +216,7 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
     conn_tasks: set = set()
 
     async def on_conn(reader, writer):
+        _set_nodelay(writer)
         task = asyncio.current_task()
         conn_tasks.add(task)
         try:
@@ -179,7 +225,11 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
         finally:
             conn_tasks.discard(task)
 
-    server = await asyncio.start_unix_server(on_conn, path=socket_path)
+    if kind == "tcp":
+        server = await asyncio.start_server(on_conn, host, port)
+    else:
+        server = await asyncio.start_unix_server(on_conn,
+                                                 path=socket_path)
     logger.info("render sidecar serving on %s", socket_path)
     try:
         # NOT serve_forever()/`async with server`: BOTH await
@@ -260,7 +310,7 @@ class SidecarClient:
             conn = self._conn
             if conn is not None and not conn.writer.is_closing():
                 return conn
-            reader, writer = await asyncio.open_unix_connection(
+            reader, writer = await open_sidecar_connection(
                 self.socket_path)
             conn = _Conn(reader, writer)
             conn.reader_task = asyncio.create_task(
@@ -399,14 +449,18 @@ def spawn_sidecar(config_path: Optional[str], socket_path: str,
     proc = subprocess.Popen(argv)
     deadline = time.monotonic() + 180
     import socket as pysocket
+    kind, host, port = parse_address(socket_path)
     while time.monotonic() < deadline:
         if proc.poll() is not None:
             raise RuntimeError(
                 f"sidecar exited with {proc.returncode} during startup")
         try:
-            s = pysocket.socket(pysocket.AF_UNIX)
-            s.settimeout(1.0)
-            s.connect(socket_path)
+            if kind == "tcp":
+                s = pysocket.create_connection((host, port), timeout=1.0)
+            else:
+                s = pysocket.socket(pysocket.AF_UNIX)
+                s.settimeout(1.0)
+                s.connect(socket_path)
             s.close()
             return proc
         except OSError:

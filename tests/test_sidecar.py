@@ -327,3 +327,69 @@ def test_frontend_survives_sidecar_restart(data_dir, tmp_path):
             await client.close()
 
     assert asyncio.run(scenario())
+
+
+def test_parse_address_forms():
+    from omero_ms_image_region_tpu.server.sidecar import parse_address
+
+    assert parse_address("/run/x/render.sock") == ("unix",
+                                                   "/run/x/render.sock",
+                                                   None)
+    assert parse_address("render.sock") == ("unix", "render.sock", None)
+    assert parse_address("10.0.0.5:8476") == ("tcp", "10.0.0.5", 8476)
+    assert parse_address(":8476") == ("tcp", "127.0.0.1", 8476)
+    # A name with a colon but non-numeric tail stays a path.
+    assert parse_address("weird:name")[0] == "unix"
+
+
+def test_tcp_sidecar_end_to_end(data_dir):
+    """host:port addresses serve over TCP — the cross-host frontend
+    posture (frontends on other machines than the device process)."""
+    with pysocket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    addr = f"127.0.0.1:{port}"
+    url = (f"/webgateway/render_image_region/{IMG}/0/0"
+           f"?c=1|0:60000$FF0000&m=g&format=png")
+
+    async def scenario():
+        cfg = AppConfig(data_dir=data_dir)
+        task = asyncio.create_task(run_sidecar(cfg, addr))
+        for _ in range(200):
+            if task.done():
+                raise AssertionError(
+                    f"sidecar died: {task.exception()!r}")
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                w.close()
+                break
+            except OSError:
+                await asyncio.sleep(0.05)
+        else:
+            raise AssertionError("tcp sidecar never came up")
+        app = create_app(_frontend_config(data_dir, addr))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(url)
+            body = await r.read()
+            assert r.status == 200 and body[:4] == b"\x89PNG"
+            return True
+        finally:
+            await client.close()
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    assert asyncio.run(scenario())
+
+
+def test_parse_address_ipv6():
+    from omero_ms_image_region_tpu.server.sidecar import parse_address
+
+    assert parse_address("[::1]:8476") == ("tcp", "::1", 8476)
+    # Bare IPv6 (multiple colons, no brackets) is NOT mistaken for tcp.
+    assert parse_address("::1")[0] == "unix"
+    assert parse_address("[::1]")[0] == "unix"

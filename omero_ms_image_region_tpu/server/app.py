@@ -302,7 +302,7 @@ def create_app(config: Optional[AppConfig] = None,
         """Prometheus text exposition (≙ the reference's optional metrics
         beans, ``beanRefContext.xml:36-46`` — Graphite there, a scrape
         endpoint here).  Spans keep the perf4j names from the Java logs."""
-        from ..utils.stopwatch import REGISTRY
+        from ..utils.stopwatch import span_lines
 
         lines = [
             "# TYPE imageregion_span_count counter",
@@ -316,14 +316,23 @@ def create_app(config: Optional[AppConfig] = None,
             "# TYPE imageregion_batches_dispatched counter",
             "# TYPE imageregion_tiles_rendered counter",
         ]
-        for name, s in sorted(REGISTRY.snapshot().items()):
-            label = f'{{span="{name}"}}'
-            lines += [
-                f"imageregion_span_count{label} {s['count']}",
-                f"imageregion_span_mean_ms{label} {s['mean_ms']}",
-                f"imageregion_span_p50_ms{label} {s['p50_ms']}",
-            ]
-        if services is None:        # frontend proxy: span metrics only
+        lines += span_lines()
+        if services is None:
+            # Frontend proxy: local spans plus the device process's
+            # spans fetched over the sidecar socket (best-effort with a
+            # hard timeout — a dead OR partitioned sidecar must not
+            # hang the scrape).  NOTE for multi-frontend deployments:
+            # every frontend exposes an identical copy of the sidecar
+            # counters, so aggregate them with max(), or scrape only a
+            # designated frontend for process="sidecar" series.
+            import asyncio as _asyncio
+            try:
+                status, body = await _asyncio.wait_for(
+                    client.call("metrics", {}), timeout=2.0)
+                if status == 200 and body:
+                    lines.append(bytes(body).decode().rstrip("\n"))
+            except Exception:
+                lines.append("# sidecar metrics unavailable")
             return web.Response(text="\n".join(lines) + "\n",
                                 content_type="text/plain")
         for cache_name in ("image_region", "pixels_metadata", "shape_mask"):

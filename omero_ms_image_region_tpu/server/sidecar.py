@@ -127,6 +127,12 @@ async def _serve_connection(image_handler, mask_handler, reader, writer):
             elif op == "mask":
                 ctx = ShapeMaskCtx.from_json(header["ctx"])
                 body = await mask_handler.render_shape_mask(ctx)
+            elif op == "metrics":
+                # Span timings live in the device process; frontends
+                # merge this into their /metrics exposition.
+                from ..utils.stopwatch import span_lines
+                body = ("\n".join(span_lines(',process="sidecar"'))
+                        + "\n").encode()
             else:
                 raise BadRequestError(f"unknown op {op!r}")
         except BadRequestError as e:
@@ -227,9 +233,11 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
 
     if kind == "tcp":
         server = await asyncio.start_server(on_conn, host, port)
+        bound_ino = None
     else:
         server = await asyncio.start_unix_server(on_conn,
                                                  path=socket_path)
+        bound_ino = os.stat(socket_path).st_ino
     logger.info("render sidecar serving on %s", socket_path)
     try:
         # NOT serve_forever()/`async with server`: BOTH await
@@ -251,6 +259,16 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
             await server.wait_closed()
         except Exception:
             pass
+        if kind == "unix" and bound_ino is not None:
+            # Unlink ONLY our own socket file: a replacement sidecar may
+            # have already re-bound the path while this process drained
+            # its last renders, and deleting ITS socket would strand
+            # every frontend.
+            try:
+                if os.stat(socket_path).st_ino == bound_ino:
+                    os.unlink(socket_path)
+            except OSError:
+                pass
         # Same teardown order as the combined app's on_cleanup: DB
         # metadata and renderer first, then prefetch workers BEFORE the
         # pixel stores close under them, then the shared cache clients.
@@ -426,10 +444,27 @@ def _map_status(status: int, payload):
 # --------------------------------------------------------------- launch
 
 def sidecar_main(config) -> None:
-    """Blocking entry for ``--role sidecar`` (the device process)."""
+    """Blocking entry for ``--role sidecar`` (the device process).
+    SIGTERM (systemd stop) triggers the same orderly teardown as
+    cancellation: handlers drained, services closed."""
+    import signal
+
+    async def main():
+        task = asyncio.current_task()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, task.cancel)
+            except (NotImplementedError, RuntimeError):
+                pass
+        try:
+            await run_sidecar(config)
+        except asyncio.CancelledError:
+            logger.info("render sidecar stopped")
+
     try:
-        asyncio.run(run_sidecar(config))
-    except KeyboardInterrupt:
+        asyncio.run(main())
+    except (KeyboardInterrupt, asyncio.CancelledError):
         pass
 
 

@@ -76,6 +76,24 @@ class StopWatchRegistry:
 REGISTRY = StopWatchRegistry()
 
 
+def span_lines(extra_labels: str = "",
+               registry: StopWatchRegistry = REGISTRY) -> list:
+    """Prometheus exposition lines for every span — the one formatter
+    shared by the app's /metrics and the sidecar's metrics op.
+
+    ``extra_labels`` is appended inside the label braces (e.g.
+    ``,process="sidecar"``)."""
+    lines = []
+    for name, s in sorted(registry.snapshot().items()):
+        label = f'{{span="{name}"{extra_labels}}}'
+        lines += [
+            f"imageregion_span_count{label} {s['count']}",
+            f"imageregion_span_mean_ms{label} {s['mean_ms']}",
+            f"imageregion_span_p50_ms{label} {s['p50_ms']}",
+        ]
+    return lines
+
+
 @contextmanager
 def stopwatch(name: str, registry: StopWatchRegistry = REGISTRY):
     """Time a stage under a reference span name, e.g.

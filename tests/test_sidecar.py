@@ -393,3 +393,28 @@ def test_parse_address_ipv6():
     # Bare IPv6 (multiple colons, no brackets) is NOT mistaken for tcp.
     assert parse_address("::1")[0] == "unix"
     assert parse_address("[::1]")[0] == "unix"
+
+
+def test_frontend_metrics_include_sidecar_spans(data_dir, tmp_path):
+    """/metrics on a frontend merges the device process's span timings
+    (where the render actually ran) into its exposition."""
+    sock = str(tmp_path / "render.sock")
+    url = (f"/webgateway/render_image_region/{IMG}/0/0"
+           f"?c=1|0:60000$FF0000&m=g&format=png")
+
+    async def body():
+        app = create_app(_frontend_config(data_dir, sock))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(url)
+            assert r.status == 200
+            await r.read()
+            m = await (await client.get("/metrics")).text()
+            assert 'process="sidecar"' in m
+            assert "renderAsPackedInt" in m
+            return True
+        finally:
+            await client.close()
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body))

@@ -418,3 +418,37 @@ def test_frontend_metrics_include_sidecar_spans(data_dir, tmp_path):
             await client.close()
 
     assert asyncio.run(_with_sidecar(data_dir, sock, body))
+
+
+def test_session_enforcement_in_split_mode(data_dir, tmp_path):
+    """The frontend rejects unresolvable cookies before anything crosses
+    the socket; with a cookie, the resolved session key rides the ctx to
+    the sidecar (the reference's session-handler placement)."""
+    sock = str(tmp_path / "render.sock")
+    url = (f"/webgateway/render_image_region/{IMG}/0/0"
+           f"?c=1|0:60000$FF0000&m=g&format=png")
+
+    async def body():
+        cfg = _frontend_config(data_dir, sock)
+        cfg.session_store_type = "static"
+        cfg.session_store_required = True
+        app = create_app(cfg)
+        anon = TestClient(TestServer(app))
+        await anon.start_server()
+        try:
+            r = await anon.get(url)
+            assert r.status == 403          # no cookie -> rejected local
+        finally:
+            await anon.close()
+        app2 = create_app(cfg)
+        authed = TestClient(TestServer(app2),
+                            cookies={"sessionid": "k1"})
+        await authed.start_server()
+        try:
+            r = await authed.get(url)
+            assert r.status == 200
+            return True
+        finally:
+            await authed.close()
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body))

@@ -250,9 +250,14 @@ def test_sidecar_serves_from_device_mesh(data_dir, tmp_path):
 
     async def with_mesh_sidecar():
         from omero_ms_image_region_tpu.server.sidecar import run_sidecar
+        # n_devices=8 pins the 8-wide mesh: under a tunnel-attached TPU
+        # the default platform has ONE device, and resolve_devices then
+        # falls back to the 8-device virtual host mesh (the same
+        # posture as the driver's multi-chip dryrun).
         cfg = AppConfig(data_dir=data_dir,
                         parallel=ParallelConfig(enabled=True,
-                                                chan_parallel=2))
+                                                chan_parallel=2,
+                                                n_devices=8))
         task = asyncio.create_task(run_sidecar(cfg, sock))
         try:
             await _wait_socket(sock, task)

@@ -203,8 +203,11 @@ class MeshRenderer(BatchingRenderer):
         n = len(group)
         raw, stacked = self._stacked(group)
         H, W = raw.shape[-2:]
-        cap = default_sparse_cap(H, W)
         quality = group[0].quality
+        # Quality-aware cap: deterministic in (H, W, quality), so every
+        # process of a multi-host mesh — fed the same group stream —
+        # compiles the same sharded program.
+        cap = default_sparse_cap(H, W, quality)
         # The packed Huffman stream covers the full (H, W) grid, so the
         # wire-optimal engine applies when every tile in the group is
         # grid-exact (same policy as ``render_batch_to_jpeg``); mixed
@@ -233,7 +236,7 @@ class MeshRenderer(BatchingRenderer):
                                    huffman_wire_fetcher, quant_tables)
 
         n = len(group)
-        cap_words = default_words_cap(H, W)
+        cap_words = default_words_cap(H, W, quality)
         args = shard_batch_batched(self.mesh, raw, stacked)
         with stopwatch("Renderer.renderAsPackedInt.mesh"):
             bufs = self._jpeg_step(quality, cap, "huffman",

@@ -480,3 +480,39 @@ def test_encode_tiles_jpeg_batch():
     for img, data in zip(imgs, outs):
         dec = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
         assert np.abs(dec.astype(np.float32) - img).mean() < 8.0
+
+
+def test_high_quality_widens_wire_caps():
+    """q >= 88 doubles the wire caps so dense noisy content stays on the
+    device path instead of dropping to the per-tile host fallback."""
+    import omero_ms_image_region_tpu.ops.jpegenc as je
+
+    rng = np.random.default_rng(40)
+    B, C, H, W = 2, 1, 64, 64
+    raw = rng.integers(0, 65535, size=(B, C, H, W)).astype(np.float32)
+    ws = np.zeros((B, C), np.float32)
+    we = np.full((B, C), 65535.0, np.float32)
+    fam = np.zeros((B, C), np.int32)
+    coef = np.ones((B, C), np.float32)
+    rev = np.zeros((B, C), np.int32)
+    tables = np.tile(np.array([[1.0, 1.0, 1.0]], np.float32),
+                     (B, C, 1)).reshape(B, C, 3)
+
+    seen = {}
+    orig = je.render_to_jpeg_sparse
+
+    def spy(*args, **kwargs):
+        seen["cap"] = kwargs.get("cap")
+        return orig(*args, **kwargs)
+
+    je.render_to_jpeg_sparse = spy
+    try:
+        base = je.default_sparse_cap(H, W)
+        for q, expect in ((80, base), (92, 2 * base)):
+            jpegs = je.render_batch_to_jpeg(
+                raw, ws, we, fam, coef, rev, 0, 255, tables,
+                quality=q, dims=[(W, H)] * B, engine="sparse")
+            assert all(j[:2] == b"\xff\xd8" for j in jpegs)
+            assert seen["cap"] == expect, (q, seen["cap"], expect)
+    finally:
+        je.render_to_jpeg_sparse = orig

@@ -20,6 +20,7 @@ handle; decoded segments are cached by the caller, not here.
 
 from __future__ import annotations
 
+import os
 import struct
 import zlib
 from dataclasses import dataclass, field
@@ -196,7 +197,18 @@ class TiffFile:
     def __init__(self, path: str):
         self.path = path
         self._f = open(path, "rb")
+        try:
+            self._parse_header_and_ifds(path)
+        except BaseException:
+            # Any parse failure must not leak the fd (servers probe
+            # hostile files; GC-timed closes exhaust descriptors).
+            self._f.close()
+            raise
+
+    def _parse_header_and_ifds(self, path: str) -> None:
         head = self._f.read(16)
+        if len(head) < 8:
+            raise ValueError(f"{path}: truncated TIFF header")
         if head[:2] == b"II":
             self.endian = "<"
         elif head[:2] == b"MM":
@@ -212,6 +224,8 @@ class TiffFile:
             offsize, _pad = struct.unpack(self.endian + "HH", head[4:8])
             if offsize != 8:
                 raise ValueError(f"{path}: BigTIFF offset size {offsize}")
+            if len(head) < 16:
+                raise ValueError(f"{path}: truncated BigTIFF header")
             first = struct.unpack(self.endian + "Q", head[8:16])[0]
         else:
             raise ValueError(f"{path}: bad TIFF magic {magic}")
@@ -226,8 +240,15 @@ class TiffFile:
     # ------------------------------------------------------------ low level
 
     def _pread(self, offset: int, size: int) -> bytes:
-        self._f.seek(offset)
-        data = self._f.read(size)
+        # os.pread, not seek+read: one TiffFile is shared by concurrent
+        # render worker threads, and interleaved seeks on a single file
+        # object would silently corrupt both readers' tiles.  pread is
+        # positional and atomic per call.
+        if not 0 <= offset < (1 << 63):
+            # A corrupt 64-bit offset would raise OverflowError from the
+            # C off_t conversion — keep the clean-failure contract.
+            raise ValueError(f"{self.path}: bad file offset {offset}")
+        data = os.pread(self._f.fileno(), size, offset)
         if len(data) != size:
             raise EOFError(f"{self.path}: short read at {offset}")
         return data
@@ -240,6 +261,10 @@ class TiffFile:
         else:
             count = struct.unpack(e + "H", self._pread(offset, 2))[0]
             entry_size, count_size, next_fmt = 12, 2, "I"
+        if count > 65536:
+            # Hostile/corrupt count fields must not drive allocations.
+            raise ValueError(f"{self.path}: IFD at {offset} claims "
+                             f"{count} entries")
         next_size = 8 if self.big else 4
         raw = self._pread(offset + count_size,
                           count * entry_size + next_size)
@@ -259,6 +284,12 @@ class TiffFile:
                 inline = ent[8:12]
                 inline_cap = 4
             nbytes = n * size
+            if nbytes > (1 << 28):
+                # 256 MB of tag data (offset/count arrays for huge
+                # BigTIFF grids stay far below this) — corrupt counts
+                # must not drive allocations.
+                raise ValueError(f"{self.path}: tag {tag} claims "
+                                 f"{nbytes} bytes")
             if nbytes <= inline_cap:
                 data = inline[:nbytes]
             else:

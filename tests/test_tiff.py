@@ -508,3 +508,76 @@ def test_corrupt_companion_is_loud(tmp_path):
                    n_levels=1, description=stub)
     with pytest.raises(ValueError, match="companion"):
         OmeTiffSource(str(tmp_path / "s.ome.tiff"))
+
+
+def test_concurrent_region_reads_are_consistent(tmp_path):
+    """One OmeTiffSource shared by many threads (the serving posture:
+    render workers hit the same handle-cached source) must return
+    correct pixels — positional reads, no seek interleaving."""
+    import concurrent.futures as cf
+
+    rng = np.random.default_rng(31)
+    planes = rng.integers(0, 60000, size=(4, 2, 256, 256)).astype(
+        np.uint16)
+    path = str(tmp_path / "mt.ome.tiff")
+    write_ome_tiff(planes, path, tile=(64, 64), compression="deflate",
+                   n_levels=1)
+    src = OmeTiffSource(path)
+
+    def read_one(k):
+        c, z = k % 4, (k // 4) % 2
+        x, y = (k * 37) % 150, (k * 53) % 150
+        r = RegionDef(x, y, 100, 100)
+        got = src.get_region(z, c, 0, r, 0)
+        return np.array_equal(got, planes[c, z, y:y + 100, x:x + 100])
+
+    with cf.ThreadPoolExecutor(max_workers=8) as pool:
+        results = list(pool.map(read_one, range(200)))
+    assert all(results)
+    src.close()
+
+
+def test_corrupt_and_truncated_tiffs_fail_cleanly(tmp_path):
+    """Hostile/broken files raise clean exceptions (never hang, never
+    return garbage silently): truncation at every structural boundary,
+    random tag soup, and non-TIFF bytes."""
+    rng = np.random.default_rng(32)
+    planes = rng.integers(0, 60000, size=(1, 1, 64, 64)).astype(np.uint16)
+    good_path = str(tmp_path / "good.ome.tiff")
+    write_ome_tiff(planes, good_path, tile=(32, 32), n_levels=1)
+    good = open(good_path, "rb").read()
+
+    def expect_clean(data, name):
+        p = str(tmp_path / name)
+        with open(p, "wb") as f:
+            f.write(data)
+        try:
+            src = OmeTiffSource(p)
+            # Structure parsed; reads must still either work or raise.
+            try:
+                src.get_region(0, 0, 0, RegionDef(0, 0, 64, 64), 0)
+            except (ValueError, EOFError, KeyError, OSError,
+                    __import__("zlib").error):
+                pass
+            src.close()
+        except (ValueError, EOFError, KeyError, OSError):
+            pass
+
+    expect_clean(b"", "empty.tif")
+    expect_clean(b"II*\0", "header-only.tif")
+    expect_clean(b"not a tiff at all", "garbage.tif")
+    for cut in (6, 9, 20, len(good) // 2, len(good) - 3):
+        expect_clean(good[:cut], f"trunc{cut}.tif")
+    # Random tag soup after a valid header.
+    for seed in range(5):
+        r = np.random.default_rng(seed)
+        soup = b"II*\0" + b"\x08\0\0\0" + r.integers(
+            0, 255, 256, dtype=np.uint8).tobytes()
+        expect_clean(soup, f"soup{seed}.tif")
+    # Flipped random bytes inside a valid file.
+    for seed in range(5):
+        r = np.random.default_rng(100 + seed)
+        data = bytearray(good)
+        for pos in r.integers(8, len(good), 20):
+            data[pos] ^= 0xFF
+        expect_clean(bytes(data), f"flip{seed}.tif")

@@ -170,12 +170,22 @@ def _packbits_decode(data: bytes) -> bytes:
     return bytes(out)
 
 
-def decode_segment(data: bytes, compression: int) -> bytes:
+def decode_segment(data: bytes, compression: int,
+                   expected_bytes: "int | None" = None) -> bytes:
     if compression == 1:
         return data
     if compression in (8, 32946):    # Adobe deflate / old deflate
         return zlib.decompress(data)
     if compression == 5:
+        # Native LZW when available (the pure-Python fallback runs
+        # ~1 MB/s — too slow for cold pans over LZW OME-TIFF exports);
+        # expected_bytes bounds the output buffer.
+        if expected_bytes is not None:
+            try:
+                from ..native import tiff_lzw_decode
+                return tiff_lzw_decode(data, expected_bytes)
+            except (ImportError, ValueError):
+                pass
         return _lzw_decode(data)
     if compression == 32773:
         return _packbits_decode(data)
@@ -344,7 +354,6 @@ class TiffFile:
                          else STRIP_BYTE_COUNTS)
         raw = self._pread(int(offsets[idx]), int(counts[idx]))
         comp = int(ifd.one(COMPRESSION, 1))
-        data = decode_segment(raw, comp)
         dt = ifd.dtype().newbyteorder(self.endian)
         spp = int(ifd.one(SAMPLES_PER_PIXEL, 1))
         if spp > 1 and int(ifd.one(PLANAR_CONFIG, 1)) != 1:
@@ -353,6 +362,8 @@ class TiffFile:
                 f"{ifd.one(PLANAR_CONFIG)} (only chunky is supported)")
         if not ifd.tiled and gy == grid_y - 1:
             seg_h = ifd.height - gy * seg_h  # last strip may be short
+        data = decode_segment(raw, comp,
+                              seg_h * seg_w * spp * dt.itemsize)
         arr = np.frombuffer(data, dtype=dt,
                             count=seg_h * seg_w * spp)
         arr = arr.reshape(seg_h, seg_w, spp)

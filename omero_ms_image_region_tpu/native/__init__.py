@@ -91,6 +91,9 @@ def _load() -> ctypes.CDLL:
                                         ctypes.c_void_p, ctypes.c_void_p,
                                         ctypes.c_int, ctypes.c_int,
                                         ctypes.c_int]
+        lib.tiff_lzw_decode.restype = ctypes.c_longlong
+        lib.tiff_lzw_decode.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                        ctypes.c_void_p, ctypes.c_size_t]
         _lib = lib
         return lib
 
@@ -161,6 +164,18 @@ def unpack_bits_msb(data: bytes, n_bits: int):
     lib.bits_unpack_msb(data, n_bits,
                         out.ctypes.data_as(ctypes.c_char_p))
     return out
+
+
+def tiff_lzw_decode(data: bytes, dst_cap: int) -> bytes:
+    """TIFF-variant LZW decode (native; GIL released for the whole
+    stream).  Raises ValueError on malformed input or cap overflow."""
+    lib = _load()
+    out = ctypes.create_string_buffer(dst_cap)
+    n = lib.tiff_lzw_decode(data, len(data), out, dst_cap)
+    if n < 0:
+        raise ValueError("malformed TIFF LZW stream (or output cap "
+                         "exceeded)")
+    return ctypes.string_at(out, n)   # single copy (raw[:n] would do two)
 
 
 def mask_overlay_u8(base_rgba, mask_grids, fills):

@@ -161,6 +161,81 @@ void bits_unpack_msb(const uint8_t* src, size_t n_bits, uint8_t* dst) {
     }
 }
 
+// TIFF-variant LZW decode (TIFF 6.0 section 13: MSB-first codes, 9-bit
+// start, ClearCode 256 / EOI 257, EARLY code-width bump).  Returns the
+// decoded byte count, or -1 if dst_cap would overflow / the stream is
+// malformed.  The table stores (prev_code, first_byte, suffix_byte,
+// length) so no per-entry allocations happen; entries are emitted by
+// walking the chain backwards into the output slot.
+long long tiff_lzw_decode(const uint8_t* src, size_t n,
+                          uint8_t* dst, size_t dst_cap) {
+    const int MAXC = 4096;
+    static thread_local int prev_of[4096];
+    static thread_local uint8_t suffix[4096];
+    static thread_local uint8_t first[4096];
+    static thread_local int length[4096];
+    for (int i = 0; i < 256; ++i) {
+        prev_of[i] = -1;
+        suffix[i] = first[i] = static_cast<uint8_t>(i);
+        length[i] = 1;
+    }
+    int next = 258;
+    int code_bits = 9;
+    uint32_t buf = 0;
+    int nbits = 0;
+    int prev = -1;
+    size_t out = 0;
+    for (size_t i = 0; i < n; ++i) {
+        buf = (buf << 8) | src[i];
+        nbits += 8;
+        while (nbits >= code_bits) {
+            nbits -= code_bits;
+            int code = (buf >> nbits) & ((1 << code_bits) - 1);
+            if (code == 256) {              // ClearCode
+                next = 258;
+                code_bits = 9;
+                prev = -1;
+                continue;
+            }
+            if (code == 257) return static_cast<long long>(out);  // EOI
+            int entry;
+            if (prev < 0) {
+                if (code >= 256) return -1;
+                entry = code;
+            } else if (code < next) {
+                entry = code;
+                if (next < MAXC) {
+                    prev_of[next] = prev;
+                    suffix[next] = first[entry];
+                    first[next] = first[prev];
+                    length[next] = length[prev] + 1;
+                    ++next;
+                }
+            } else if (code == next && next < MAXC) {   // KwKwK
+                prev_of[next] = prev;
+                suffix[next] = first[prev];
+                first[next] = first[prev];
+                length[next] = length[prev] + 1;
+                entry = next++;
+            } else {
+                return -1;
+            }
+            const size_t len = static_cast<size_t>(length[entry]);
+            if (out + len > dst_cap) return -1;
+            size_t pos = out + len;
+            for (int c = entry; c >= 0; c = prev_of[c]) {
+                dst[--pos] = suffix[c];
+            }
+            out += len;
+            prev = entry;
+            if (next >= (1 << code_bits) - 1 && code_bits < 12) {
+                ++code_bits;
+            }
+        }
+    }
+    return static_cast<long long>(out);
+}
+
 // Alpha-composite B mask fills over B RGBA tiles (straight alpha,
 // integer math; ≙ the BufferedImage+IndexColorModel overlay a client of
 // ShapeMaskRequestHandler.java:185-203 performs).  out may alias base.

@@ -149,3 +149,12 @@ def test_parallel_cluster_coordinates():
     with pytest.raises(ValueError):
         AppConfig.from_dict({"parallel": {
             "coordinator-address": "host0:8476"}})
+
+
+def test_compilation_cache_dir_config():
+    from omero_ms_image_region_tpu.server.config import AppConfig
+
+    cfg = AppConfig.from_dict(
+        {"renderer": {"compilation-cache-dir": "/tmp/jc"}})
+    assert cfg.renderer.compilation_cache_dir == "/tmp/jc"
+    assert AppConfig().renderer.compilation_cache_dir is None

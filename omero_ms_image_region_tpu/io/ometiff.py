@@ -38,7 +38,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..server.region import RegionDef
-from .tiff import (IMAGE_DESCRIPTION, SAMPLES_PER_PIXEL, Ifd, TiffFile)
+from .tiff import (IMAGE_DESCRIPTION, NEW_SUBFILE_TYPE,
+                   SAMPLES_PER_PIXEL, Ifd, TiffFile)
 
 # OME pixel Type values are exactly the OMERO pixels-type names the
 # render path already understands (models/pixels.py dtype table).
@@ -72,6 +73,9 @@ class OmeTiffSource:
         # FileName entries, opened lazily and keyed by basename.  Key
         # None = the primary file.
         self._files: Dict[Optional[str], TiffFile] = {None: self._tf}
+        # Page-based pyramids (plain TIFF): full-res page -> its
+        # reduced-resolution page indices, in file order.
+        self._page_levels: Dict[int, List[int]] = {}
         self._parse_layout()
 
     # ------------------------------------------------------------- layout
@@ -189,10 +193,25 @@ class OmeTiffSource:
                     plane_map[(z, c, t)] = (file_key, ifd0 + k)
         else:
             # Plain TIFF: pages = Z sections; chunky RGB = channels.
+            # Reduced-resolution pages (NewSubfileType bit 0 — the
+            # pre-OME page-based pyramid layout vips/openslide-style
+            # exporters write) attach as pyramid levels of the
+            # preceding full-resolution page instead of masquerading
+            # as extra Z sections.
+            full_pages = []
+            for i, page_ifd in enumerate(tf.ifds):
+                if int(page_ifd.one(NEW_SUBFILE_TYPE, 0)) & 1:
+                    if full_pages:
+                        self._page_levels[full_pages[-1]].append(i)
+                else:
+                    full_pages.append(i)
+                    self._page_levels[i] = []
             if spp > 1:
                 self.size_c = spp
                 self._interleaved_c = True
-            self.size_z = len(tf.ifds)
+            self.size_z = max(1, len(full_pages))
+            for zi, page in enumerate(full_pages):
+                plane_map[(zi, 0, 0)] = (None, page)
         if self.pixels_type is None:
             self.pixels_type = {
                 "uint8": "uint8", "uint16": "uint16", "uint32": "uint32",
@@ -214,13 +233,27 @@ class OmeTiffSource:
                 plane_map[self._plane_of_index(i)] = (None, i)
         self._plane_map = plane_map
 
-        # Pyramid: SubIFD chain of each plane IFD (OME-TIFF 6.0).  Level
-        # dims come from the first plane; every plane must agree.
-        subs = tf.sub_ifds(first)
-        self._n_levels = 1 + len(subs)
+        # Pyramid: SubIFD chain of each plane IFD (OME-TIFF 6.0), or the
+        # reduced-resolution page chain for plain pyramidal TIFFs.
+        # Level dims come from the first plane; every plane must agree.
+        # Geometry anchors on plane (0,0,0)'s full-res IFD — for a
+        # thumbnail-first plain TIFF that is NOT page 0 (multi-file
+        # sets whose first plane lives elsewhere keep the primary
+        # file's first page as the anchor; files are homogeneous).
+        anchor_key, anchor_page = plane_map.get((0, 0, 0), (None, 0))
+        self._first_ifd = (tf.ifds[anchor_page]
+                           if anchor_key is None
+                           and anchor_page < len(tf.ifds) else first)
+        first_levels = self._page_levels.get(anchor_page, []) \
+            if anchor_key is None else []
+        if first_levels:
+            level_ifds = [tf.ifds[i] for i in first_levels]
+        else:
+            level_ifds = tf.sub_ifds(self._first_ifd)
+        self._n_levels = 1 + len(level_ifds)
         self._level_dims: List[Tuple[int, int]] = [
-            (first.width, first.height)
-        ] + [(s.width, s.height) for s in subs]
+            (self._first_ifd.width, self._first_ifd.height)
+        ] + [(s.width, s.height) for s in level_ifds]
         self._level_ifds: Dict[Tuple[Optional[str], int, int], Ifd] = {}
 
     def _n_ifd_planes(self) -> int:
@@ -274,11 +307,15 @@ class OmeTiffSource:
             if level == 0:
                 ifd = base
             else:
-                subs = tf.sub_ifds(base)
-                if level - 1 >= len(subs):
+                page_levels = (self._page_levels.get(page, [])
+                               if file_key is None else [])
+                levels = ([tf.ifds[i] for i in page_levels]
+                          if page_levels else tf.sub_ifds(base))
+                if level - 1 >= len(levels):
                     raise ValueError(
-                        f"{self.path}: page {page} has no level {level}")
-                ifd = subs[level - 1]
+                        f"{self.path}: page {page} has no level "
+                        f"{level}")
+                ifd = levels[level - 1]
             with self._lock:
                 self._level_ifds[key] = ifd
         return tf, ifd
@@ -287,7 +324,7 @@ class OmeTiffSource:
 
     @property
     def dtype(self) -> np.dtype:
-        return self._tf.ifds[0].dtype()
+        return self._first_ifd.dtype()
 
     def resolution_levels(self) -> int:
         return self._n_levels
@@ -296,7 +333,7 @@ class OmeTiffSource:
         return list(self._level_dims)
 
     def tile_size(self) -> Tuple[int, int]:
-        ifd = self._tf.ifds[0]
+        ifd = self._first_ifd
         if not ifd.tiled:
             # Strips: serve a square default rather than a width x rows
             # sliver (the reference's server-side tile-size default,

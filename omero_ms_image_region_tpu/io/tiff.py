@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 # TIFF tag ids (TIFF 6.0 spec; names per the spec).
+NEW_SUBFILE_TYPE = 254      # bit 0 = reduced-resolution page
 IMAGE_WIDTH = 256
 IMAGE_LENGTH = 257
 BITS_PER_SAMPLE = 258

@@ -581,3 +581,100 @@ def test_corrupt_and_truncated_tiffs_fail_cleanly(tmp_path):
         for pos in r.integers(8, len(good), 20):
             data[pos] ^= 0xFF
         expect_clean(bytes(data), f"flip{seed}.tif")
+
+
+def test_page_based_pyramid_tiff(tmp_path):
+    """Pre-OME page pyramids (reduced-resolution pages flagged
+    NewSubfileType=1 — the vips/openslide export style) read as levels
+    of the preceding full page, not as extra Z sections."""
+    from omero_ms_image_region_tpu.io.tiffwrite import _TiffOut
+
+    rng = np.random.default_rng(33)
+    z_planes = [rng.integers(0, 60000, size=(64, 80)).astype(np.uint16)
+                for _ in range(2)]
+    levels = [[p, _downsample2(p)] for p in z_planes]
+
+    path = str(tmp_path / "pagepyr.tif")
+    with open(path, "wb") as f:
+        out = _TiffOut(f, big=False)
+        page_meta = []
+        for plane_levels in levels:
+            for li, img in enumerate(plane_levels):
+                data = np.ascontiguousarray(img).tobytes()
+                out.align()
+                off = out.write(data)
+                h, w = img.shape
+                tags = [
+                    (256, 4, [w]), (257, 4, [h]), (258, 3, [16]),
+                    (259, 3, [1]), (262, 3, [1]), (273, 4, [off]),
+                    (277, 3, [1]), (278, 4, [h]),
+                    (279, 4, [len(data)]), (339, 3, [1]),
+                ]
+                if li > 0:
+                    tags.append((254, 4, [1]))   # reduced-resolution
+                page_meta.append(tags)
+        prev_next = None
+        first = None
+        for tags in page_meta:
+            ifd_off, next_pos = out.write_ifd(tags)
+            if first is None:
+                first = ifd_off
+            else:
+                out.patch(prev_next, ifd_off)
+            prev_next = next_pos
+        out.patch_first_ifd(first)
+
+    src = OmeTiffSource(path)
+    assert (src.size_z, src.size_c) == (2, 1)    # NOT 4 Z sections
+    assert src.resolution_levels() == 2
+    assert src.resolution_descriptions() == [(80, 64), (40, 32)]
+    for z in range(2):
+        got = src.get_region(z, 0, 0, RegionDef(0, 0, 80, 64), 0)
+        assert np.array_equal(got, levels[z][0]), z
+        got1 = src.get_region(z, 0, 0, RegionDef(0, 0, 40, 32), 1)
+        assert np.array_equal(got1, levels[z][1]), z
+    src.close()
+
+
+def test_thumbnail_first_page_pyramid(tmp_path):
+    """A reduced page BEFORE the first full page (thumbnail-first
+    layout) must not anchor the geometry: dims/dtype come from the
+    full-resolution plane."""
+    from omero_ms_image_region_tpu.io.tiffwrite import _TiffOut
+
+    rng = np.random.default_rng(34)
+    thumb = rng.integers(0, 255, size=(16, 20)).astype(np.uint16)
+    full = rng.integers(0, 60000, size=(64, 80)).astype(np.uint16)
+    path = str(tmp_path / "thumbfirst.tif")
+    with open(path, "wb") as f:
+        out = _TiffOut(f, big=False)
+        metas = []
+        for img, reduced in ((thumb, True), (full, False)):
+            data = np.ascontiguousarray(img).tobytes()
+            out.align()
+            off = out.write(data)
+            h, w = img.shape
+            tags = [(256, 4, [w]), (257, 4, [h]), (258, 3, [16]),
+                    (259, 3, [1]), (262, 3, [1]), (273, 4, [off]),
+                    (277, 3, [1]), (278, 4, [h]),
+                    (279, 4, [len(data)]), (339, 3, [1])]
+            if reduced:
+                tags.append((254, 4, [1]))
+            metas.append(tags)
+        prev = None
+        first = None
+        for tags in metas:
+            ifd_off, nxt = out.write_ifd(tags)
+            if first is None:
+                first = ifd_off
+            else:
+                out.patch(prev, ifd_off)
+            prev = nxt
+        out.patch_first_ifd(first)
+
+    src = OmeTiffSource(path)
+    assert src.size_z == 1
+    assert src.resolution_descriptions()[0] == (80, 64)
+    got = src.get_region(0, 0, 0, RegionDef(0, 0, 80, 64), 0)
+    assert np.array_equal(got, full)
+    src.close()

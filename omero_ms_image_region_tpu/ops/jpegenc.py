@@ -364,6 +364,18 @@ def _quality_widen(quality: "int | None") -> int:
     return 2 if quality is not None and quality >= 88 else 1
 
 
+def wire_header_i32(bufs: np.ndarray, word: int) -> np.ndarray:
+    """The per-row i32 header field ``word`` of fetched wire buffers
+    (one place for the layout; both engines lead with LE i32 words)."""
+    return bufs[:, 4 * word:4 * word + 4].copy().view(np.int32).ravel()
+
+
+# Process-wide overflow memo: once a (shape, quality, engine) workload
+# overflows its default cap, later groups start at the doubled cap
+# instead of paying a wasted base dispatch per group.
+_CAP_MEMO: dict = {}
+
+
 def default_sparse_cap(H: int, W: int, quality: "int | None" = None
                        ) -> int:
     """Wire-buffer entry budget per tile: 1/8 of all coefficient slots
@@ -1115,20 +1127,44 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
     all_exact = all((h_ + 15) // 16 * 16 == H
                     and (w_ + 15) // 16 * 16 == W for (w_, h_) in dims)
     if engine == "huffman" and all_exact:
+        def dispatch_huffman(c, cw):
+            bufs = render_to_jpeg_huffman(
+                raw, window_start, window_end, family, coefficient,
+                reverse, cd_start, cd_end, tables, qy, qc,
+                *huffman_spec_arrays(),
+                h16=H // 16, w16=W // 16, cap=c, cap_words=cw)
+            if hasattr(bufs, "copy_to_host_async"):
+                return huffman_wire_fetcher(H, W, c, cw).fetch(bufs)
+            return np.asarray(bufs)
+
         cap_words = default_words_cap(H, W, quality)
-        bufs = render_to_jpeg_huffman(
-            raw, window_start, window_end, family, coefficient, reverse,
-            cd_start, cd_end, tables, qy, qc, *huffman_spec_arrays(),
-            h16=H // 16, w16=W // 16, cap=cap, cap_words=cap_words)
-        if hasattr(bufs, "copy_to_host_async"):
-            bufs = huffman_wire_fetcher(H, W, cap, cap_words).fetch(bufs)
-        else:
-            bufs = np.asarray(bufs)
+        memo_key = ("huffman", H, W, quality)
+        if _CAP_MEMO.get(memo_key):
+            cap, cap_words = cap * 2, cap_words * 2
+        bufs = dispatch_huffman(cap, cap_words)
+        totals = wire_header_i32(bufs, 0)
+        bits = wire_header_i32(bufs, 1)
+        over = (totals > cap) | (bits > cap_words * 32)
+        rescuable = ((totals <= 2 * cap)
+                     & (bits <= 2 * cap_words * 32))
+        if memo_key not in _CAP_MEMO and (over & rescuable).any():
+            # Cap overflow (dense content, narrow windows): ONE retry of
+            # the whole batch at doubled caps instead of per-tile dense
+            # re-renders, whose full-coefficient fetches (~6 MB/tile)
+            # can cost seconds each on a congested link.  Skipped when
+            # every overflowing tile exceeds even the doubled caps (the
+            # retry could rescue nothing).  First retry per (shape,
+            # quality) compiles the 2x variant — a one-time stall the
+            # memo (and the persistent compilation cache) then avoids by
+            # starting such workloads at 2x.
+            _CAP_MEMO[memo_key] = True
+            cap, cap_words = cap * 2, cap_words * 2
+            bufs = dispatch_huffman(cap, cap_words)
 
         _dense_encode = dense_encoder()
 
         def dense_tile(i):
-            # Rare cap/bits overflow: re-encode from dense coefficients.
+            # Still overflowing at 2x: re-encode from dense coefficients.
             w_, h_ = dims[i]
             return _dense_encode(*dense_coefficients(i), w_, h_, quality)
 
@@ -1136,14 +1172,26 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
             bufs, dims, H, W, quality, cap, cap_words,
             dense_fallback=dense_tile)
 
-    bufs = render_to_jpeg_sparse(
-        raw, window_start, window_end, family, coefficient, reverse,
-        cd_start, cd_end, tables, qy, qc, cap=cap)
-    if hasattr(bufs, "copy_to_host_async"):
-        # Predictive prefix fetch: only the used bytes cross the link.
-        bufs = wire_fetcher(H, W, cap).fetch(bufs)
-    else:
-        bufs = np.asarray(bufs)
+    def dispatch_sparse(c):
+        bufs = render_to_jpeg_sparse(
+            raw, window_start, window_end, family, coefficient, reverse,
+            cd_start, cd_end, tables, qy, qc, cap=c)
+        if hasattr(bufs, "copy_to_host_async"):
+            # Predictive prefix fetch: only used bytes cross the link.
+            return wire_fetcher(H, W, c).fetch(bufs)
+        return np.asarray(bufs)
+
+    memo_key = ("sparse", H, W, quality)
+    if _CAP_MEMO.get(memo_key):
+        cap = cap * 2
+    bufs = dispatch_sparse(cap)
+    totals = wire_header_i32(bufs, 0)
+    if (memo_key not in _CAP_MEMO
+            and ((totals > cap) & (totals <= 2 * cap)).any()):
+        # Same one-shot widening + memo as the huffman engine above.
+        _CAP_MEMO[memo_key] = True
+        cap = cap * 2
+        bufs = dispatch_sparse(cap)
 
     return finish_sparse_to_jpegs(bufs, dims, H, W, quality, cap,
                                   dense_coefficients)

@@ -206,21 +206,39 @@ class MeshRenderer(BatchingRenderer):
         quality = group[0].quality
         # Quality-aware cap: deterministic in (H, W, quality), so every
         # process of a multi-host mesh — fed the same group stream —
-        # compiles the same sharded program.
+        # compiles the same sharded program.  The overflow memo is
+        # consulted on the driver (the supported multi-host posture
+        # feeds the mesh from ONE request stream, so the decision is
+        # made once and identically).
+        from ..ops.jpegenc import _CAP_MEMO, wire_header_i32
         cap = default_sparse_cap(H, W, quality)
         # The packed Huffman stream covers the full (H, W) grid, so the
         # wire-optimal engine applies when every tile in the group is
         # grid-exact (same policy as ``render_batch_to_jpeg``); mixed
-        # groups fall back to the sparse engine as a whole.
+        # groups fall back to the sparse engine as a whole.  Each
+        # engine applies its own overflow memo to the base cap.
         all_exact = all((p.h + 15) // 16 * 16 == H
                         and (p.w + 15) // 16 * 16 == W for p in group)
         if self.jpeg_engine == "huffman" and all_exact:
             return self._render_group_jpeg_huffman(
                 group, raw, stacked, H, W, cap, quality)
+        memo_key = ("mesh-sparse", H, W, quality)
+        if _CAP_MEMO.get(memo_key):
+            cap *= 2
         args = shard_batch_batched(self.mesh, raw, stacked)
         with stopwatch("Renderer.renderAsPackedInt.mesh"):
             bufs = self._jpeg_step(quality, cap)(*args)
             bufs = wire_fetcher(H, W, cap).fetch(bufs)
+            totals = wire_header_i32(bufs, 0)
+            if (memo_key not in _CAP_MEMO
+                    and ((totals > cap) & (totals <= 2 * cap)).any()):
+                # One-shot widening, mirroring render_batch_to_jpeg:
+                # a rescuable overflow re-dispatches the group at 2x
+                # instead of per-tile dense re-renders.
+                _CAP_MEMO[memo_key] = True
+                cap *= 2
+                bufs = self._jpeg_step(quality, cap)(*args)
+                bufs = wire_fetcher(H, W, cap).fetch(bufs)
 
         qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
         jpegs = finish_sparse_to_jpegs(
@@ -231,17 +249,34 @@ class MeshRenderer(BatchingRenderer):
 
     def _render_group_jpeg_huffman(self, group, raw, stacked, H, W, cap,
                                    quality) -> List[bytes]:
-        from ..ops.jpegenc import (default_words_cap, dense_encoder,
-                                   finish_huffman_batch,
-                                   huffman_wire_fetcher, quant_tables)
+        from ..ops.jpegenc import (_CAP_MEMO, default_words_cap,
+                                   dense_encoder, finish_huffman_batch,
+                                   huffman_wire_fetcher, quant_tables,
+                                   wire_header_i32)
 
         n = len(group)
         cap_words = default_words_cap(H, W, quality)
+        memo_key = ("mesh-huffman", H, W, quality)
+        if _CAP_MEMO.get(memo_key):
+            cap, cap_words = cap * 2, cap_words * 2
         args = shard_batch_batched(self.mesh, raw, stacked)
         with stopwatch("Renderer.renderAsPackedInt.mesh"):
             bufs = self._jpeg_step(quality, cap, "huffman",
                                    cap_words)(*args)
             bufs = huffman_wire_fetcher(H, W, cap, cap_words).fetch(bufs)
+            totals = wire_header_i32(bufs, 0)
+            bits = wire_header_i32(bufs, 1)
+            over = (totals > cap) | (bits > cap_words * 32)
+            rescuable = ((totals <= 2 * cap)
+                         & (bits <= 2 * cap_words * 32))
+            if memo_key not in _CAP_MEMO and (over & rescuable).any():
+                # One-shot widening (see render_batch_to_jpeg).
+                _CAP_MEMO[memo_key] = True
+                cap, cap_words = cap * 2, cap_words * 2
+                bufs = self._jpeg_step(quality, cap, "huffman",
+                                       cap_words)(*args)
+                bufs = huffman_wire_fetcher(H, W, cap,
+                                            cap_words).fetch(bufs)
 
         qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
         _dense_encode = dense_encoder()

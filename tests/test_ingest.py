@@ -41,3 +41,33 @@ def test_roundtrip_both_directions(tmp_path, capsys):
     assert "chunked" in out and "200 x 150" in out and "uint16" in out
     assert main(["info", tiff2]) == 0
     assert "ome-tiff" in capsys.readouterr().out
+
+
+def test_tiff_to_store_from_multi_file_set(tmp_path, capsys):
+    """Ingest resolves multi-file OME-TIFF sets (TiffData FileName)."""
+    rng = np.random.default_rng(31)
+    W, H, Z, C = 64, 48, 2, 2
+    planes = rng.integers(0, 60000, size=(C, Z, H, W)).astype(np.uint16)
+    names = ["i0.ome.tiff", "i1.ome.tiff"]
+    NS = 'xmlns="http://www.openmicroscopy.org/Schemas/OME/2016-06"'
+    tds = "".join(
+        f'<TiffData FirstZ="0" FirstC="{c}" FirstT="0" IFD="0" '
+        f'PlaneCount="{Z}"><UUID FileName="{names[c]}">u{c}</UUID>'
+        f'</TiffData>' for c in range(C))
+    xml = (f'<?xml version="1.0"?><OME {NS}><Image ID="Image:0">'
+           f'<Pixels ID="Pixels:0" DimensionOrder="XYZCT" Type="uint16" '
+           f'SizeX="{W}" SizeY="{H}" SizeZ="{Z}" SizeC="{C}" SizeT="1" '
+           f'BigEndian="false">{tds}</Pixels></Image></OME>')
+    for c in range(C):
+        write_ome_tiff(planes[c][None], str(tmp_path / names[c]),
+                       tile=(32, 32), n_levels=1, description=xml)
+    store_dir = str(tmp_path / "8")
+    assert main(["tiff-to-store", str(tmp_path / names[0]), store_dir,
+                 "--tile", "32"]) == 0
+    store = ChunkedPyramidStore(store_dir)
+    full = RegionDef(0, 0, W, H)
+    for c in range(C):
+        for z in range(Z):
+            assert np.array_equal(store.get_region(z, c, 0, full, 0),
+                                  planes[c, z])
+    store.close()

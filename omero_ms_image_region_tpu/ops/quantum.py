@@ -28,7 +28,6 @@ of channels with different families stays one fused XLA kernel.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 FAMILY_LINEAR = 0

@@ -31,7 +31,6 @@ Semantics preserved from the reference renderer:
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

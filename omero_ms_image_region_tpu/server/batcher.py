@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import asyncio
 import collections
-import time
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 

@@ -10,7 +10,6 @@ file ports by deleting the Java-only blocks and adding ``data-dir``.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Optional
 

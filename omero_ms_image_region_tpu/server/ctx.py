@@ -15,7 +15,7 @@ Redis cache with the Java service it replaces.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field, asdict
+from dataclasses import dataclass, asdict
 from typing import Dict, List, Mapping, Optional, Tuple
 
 from ..models.rendering import Projection

@@ -457,3 +457,94 @@ def test_session_enforcement_in_split_mode(data_dir, tmp_path):
             await authed.close()
 
     assert asyncio.run(_with_sidecar(data_dir, sock, body))
+
+
+def test_kitchen_sink_ome_tiff_sessions_projection(tmp_path):
+    """Round-3 features composed: a multi-file OME-TIFF set served
+    through a session-enforcing frontend + sidecar split, including a
+    Z-projection — byte-identical to the combined app."""
+    from omero_ms_image_region_tpu.io.tiffwrite import write_ome_tiff
+
+    rng = np.random.default_rng(41)
+    W, H, Z, C = 64, 64, 3, 2
+    planes = rng.integers(0, 60000, size=(C, Z, H, W)).astype(np.uint16)
+    names = ["c0.ome.tiff", "c1.ome.tiff"]
+    NS = 'xmlns="http://www.openmicroscopy.org/Schemas/OME/2016-06"'
+    tds = "".join(
+        f'<TiffData FirstZ="0" FirstC="{c}" FirstT="0" IFD="0" '
+        f'PlaneCount="{Z}"><UUID FileName="{names[c]}">k{c}</UUID>'
+        f'</TiffData>' for c in range(C))
+    xml = (f'<?xml version="1.0"?><OME {NS}><Image ID="Image:0">'
+           f'<Pixels ID="Pixels:0" DimensionOrder="XYZCT" Type="uint16" '
+           f'SizeX="{W}" SizeY="{H}" SizeZ="{Z}" SizeC="{C}" SizeT="1" '
+           f'BigEndian="false">{tds}</Pixels></Image></OME>')
+    data = tmp_path / "data"
+    os.makedirs(data / "6")
+    for c in range(C):
+        write_ome_tiff(planes[c][None], str(data / "6" / names[c]),
+                       tile=(32, 32), n_levels=1, description=xml)
+
+    sock = str(tmp_path / "render.sock")
+    urls = [
+        "/webgateway/render_image_region/6/1/0"
+        "?c=1|0:60000$FF0000,2|0:55000$00FF00&m=c&format=png",
+        "/webgateway/render_image_region/6/0/0"
+        "?c=1|0:60000$FF0000&m=g&p=intmax|0:2&format=png",
+    ]
+
+    def frontend_cfg():
+        cfg = AppConfig(data_dir=str(data),
+                        sidecar=SidecarConfig(socket=sock,
+                                              role="frontend"),
+                        session_store_type="static",
+                        session_store_required=True)
+        return cfg
+
+    async def body():
+        app = create_app(frontend_cfg())
+        client = TestClient(TestServer(app),
+                            cookies={"sessionid": "s1"})
+        await client.start_server()
+        try:
+            out = []
+            for u in urls:
+                r = await client.get(u)
+                assert r.status == 200, u
+                out.append(await r.read())
+            # No cookie -> rejected before the socket.
+            anon = TestClient(TestServer(create_app(frontend_cfg())))
+            await anon.start_server()
+            try:
+                r = await anon.get(urls[0])
+                assert r.status == 403
+            finally:
+                await anon.close()
+            return out
+        finally:
+            await client.close()
+
+    async def run_split():
+        cfg = AppConfig(data_dir=str(data))
+        task = asyncio.create_task(run_sidecar(cfg, sock))
+        try:
+            await _wait_socket(sock, task)
+            return await body()
+        finally:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    split_bodies = asyncio.run(run_split())
+
+    async def combined():
+        app = create_app(AppConfig(data_dir=str(data)))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return [await (await client.get(u)).read() for u in urls]
+        finally:
+            await client.close()
+
+    assert split_bodies == asyncio.run(combined())

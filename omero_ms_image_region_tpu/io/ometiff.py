@@ -213,11 +213,15 @@ class OmeTiffSource:
             for zi, page in enumerate(full_pages):
                 plane_map[(zi, 0, 0)] = (None, page)
         if self.pixels_type is None:
-            self.pixels_type = {
-                "uint8": "uint8", "uint16": "uint16", "uint32": "uint32",
-                "int8": "int8", "int16": "int16", "int32": "int32",
-                "float32": "float", "float64": "double",
-            }[np.dtype(first.dtype()).name]
+            if first.bits == 1:
+                self.pixels_type = "bit"
+            else:
+                self.pixels_type = {
+                    "uint8": "uint8", "uint16": "uint16",
+                    "uint32": "uint32", "int8": "int8", "int16": "int16",
+                    "int32": "int32", "float32": "float",
+                    "float64": "double",
+                }[np.dtype(first.dtype()).name]
 
         n_ifd_planes = self._n_ifd_planes()
         multi_file = any(k is not None for k, _ in plane_map.values())

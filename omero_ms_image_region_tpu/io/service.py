@@ -9,10 +9,12 @@ here a data directory holds one chunked pyramid per image
 
 from __future__ import annotations
 
+import gc
 import os
+import sys
 import threading
 from collections import OrderedDict
-from typing import Optional
+from typing import List, Optional
 
 from .ometiff import OmeTiffSource, find_tiff
 from .pixelsource import PixelSource
@@ -32,11 +34,50 @@ class PixelsService:
     dispatch plays behind ``PixelsService.getPixelBuffer``
     (``ImageRegionRequestHandler.java:302-309``)."""
 
+    # Evicted-set size past which a gc.collect() is forced: a reference
+    # cycle (e.g. a captured exception traceback) can keep an evicted
+    # source's refcount high until a cycle collection runs.
+    _GC_THRESHOLD = 8
+
     def __init__(self, data_dir: str, max_open: int = DEFAULT_MAX_OPEN):
         self.data_dir = data_dir
         self.max_open = max_open
         self._lock = threading.Lock()
         self._open: "OrderedDict[int, PixelSource]" = OrderedDict()
+        # Sources dropped from the LRU while possibly still mid-read;
+        # closed deterministically once no outside reference remains
+        # (see _drain_evicted) so fds/memmaps cannot outgrow max_open
+        # under heavy image churn.
+        self._evicted: List[PixelSource] = []
+
+    def _drain_evicted_locked(self) -> int:
+        """Close evicted sources no longer referenced anywhere else;
+        returns how many stragglers remain.
+
+        Caller holds ``self._lock``.  Refcount 3 = the list slot, the
+        loop variable, and getrefcount's argument — i.e. no reader still
+        holds the source.
+        """
+        still: List[PixelSource] = []
+        for src in self._evicted:
+            if sys.getrefcount(src) <= 3:
+                try:
+                    src.close()
+                except Exception:
+                    pass
+            else:
+                still.append(src)
+        self._evicted = still
+        return len(still)
+
+    def _gc_and_drain(self) -> None:
+        """Straggler pressure relief: a reference cycle (e.g. a captured
+        exception traceback) can pin an evicted source until a cycle
+        collection runs.  The collection happens OUTSIDE the lock so
+        concurrent lookups are never stalled behind a full gc pass."""
+        gc.collect()
+        with self._lock:
+            self._drain_evicted_locked()
 
     def image_dir(self, image_id: int) -> str:
         return os.path.join(self.data_dir, str(image_id))
@@ -57,6 +98,11 @@ class PixelsService:
             src = self._open.get(image_id)
             if src is not None:
                 self._open.move_to_end(image_id)
+                if self._evicted:
+                    # Steady-state hit traffic must still release
+                    # finished readers' handles (no gc here: a plain
+                    # refcount scan, trivial when the list is empty).
+                    self._drain_evicted_locked()
                 return src
         backend = self._sniff(image_id)
         if backend is None:
@@ -78,12 +124,15 @@ class PixelsService:
                 return existing
             self._open[image_id] = src
             while len(self._open) > self.max_open:
-                # Drop WITHOUT close(): a concurrent request may still be
+                # Do not close() here: a concurrent request may still be
                 # mid-read on the evicted source (close would yank the
-                # TIFF file handle out from under it).  The last live
-                # reference releases the handle via the source's
-                # finalizer; memmap-backed stores release on GC anyway.
-                self._open.popitem(last=False)
+                # TIFF file handle out from under it).  Park it on the
+                # deferred-close list instead; it is closed on a later
+                # drain once its refcount shows no reader remains.
+                self._evicted.append(self._open.popitem(last=False)[1])
+            stragglers = self._drain_evicted_locked()
+        if stragglers > self._GC_THRESHOLD:
+            self._gc_and_drain()
         return src
 
     def close(self) -> None:
@@ -91,3 +140,9 @@ class PixelsService:
             for src in self._open.values():
                 src.close()
             self._open.clear()
+            for src in self._evicted:
+                try:
+                    src.close()
+                except Exception:
+                    pass
+            self._evicted.clear()

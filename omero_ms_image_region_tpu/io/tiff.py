@@ -99,17 +99,27 @@ class Ifd:
     def tiled(self) -> bool:
         return TILE_OFFSETS in self.tags
 
+    @property
+    def bits(self) -> int:
+        # TIFF 6.0: BitsPerSample DEFAULTS TO 1 (bilevel files omit the
+        # tag — PIL mode-"1" output does exactly this).
+        return int(self.one(BITS_PER_SAMPLE, 1))
+
     def dtype(self) -> np.dtype:
-        bits = int(self.one(BITS_PER_SAMPLE, 8))
         fmt = int(self.one(SAMPLE_FORMAT, 1))
         table = {
+            # 1-bit bilevel (OME "bit", the ShapeMask raster class;
+            # ome.util.PixelData's 1-bit accessor is the reference
+            # analogue, ShapeMaskRequestHandler.java:214-221): stored
+            # packed MSB-first, exposed expanded as uint8 0/1.
+            (1, 1): "u1",
             (8, 1): "u1", (16, 1): "u2", (32, 1): "u4",
             (8, 2): "i1", (16, 2): "i2", (32, 2): "i4",
             (32, 3): "f4", (64, 3): "f8",
         }
-        key = (bits, fmt)
+        key = (self.bits, fmt)
         if key not in table:
-            raise ValueError(f"unsupported TIFF sample: {bits}-bit "
+            raise ValueError(f"unsupported TIFF sample: {key[0]}-bit "
                              f"format {fmt}")
         return np.dtype(table[key])
 
@@ -140,13 +150,20 @@ def _lzw_decode(data: bytes) -> bytes:
             if code == 257:          # EOI
                 return bytes(out)
             if prev is None:
+                if code >= len(table):
+                    raise ValueError(
+                        "corrupt LZW stream: code out of range")
                 entry = table[code]
             elif code < len(table):
                 entry = table[code]
                 table.append(prev + entry[:1])
-            else:                    # KwKwK case
+            elif code == len(table):  # the only legal KwKwK case
                 entry = prev + prev[:1]
                 table.append(entry)
+            else:
+                # Matching the native decoder's strictness: any code
+                # beyond next-table-entry is a corrupt stream, not KwKwK.
+                raise ValueError("corrupt LZW stream: code out of range")
             out += entry
             prev = entry
             if len(table) >= (1 << code_bits) - 1 and code_bits < 12:
@@ -363,6 +380,20 @@ class TiffFile:
                 f"{ifd.one(PLANAR_CONFIG)} (only chunky is supported)")
         if not ifd.tiled and gy == grid_y - 1:
             seg_h = ifd.height - gy * seg_h  # last strip may be short
+        if ifd.bits == 1:
+            # Packed bilevel rows: each row starts on a byte boundary.
+            # Expanded to uint8 0/1 with 1 = bright: WhiteIsZero files
+            # (photometric 0, the CCITT-era default) are inverted so
+            # the mask/render pipeline always sees set==foreground.
+            bpr = (seg_w * spp + 7) // 8
+            data = decode_segment(raw, comp, seg_h * bpr)
+            rows = np.frombuffer(data, np.uint8,
+                                 count=seg_h * bpr).reshape(seg_h, bpr)
+            arr = np.unpackbits(rows, axis=1)[:, :seg_w * spp]
+            if int(ifd.one(PHOTOMETRIC, 1)) == 0:
+                arr = 1 - arr
+            return np.ascontiguousarray(
+                arr.reshape(seg_h, seg_w, spp))
         data = decode_segment(raw, comp,
                               seg_h * seg_w * spp * dt.itemsize)
         arr = np.frombuffer(data, dtype=dt,

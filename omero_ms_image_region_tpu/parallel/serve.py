@@ -74,6 +74,27 @@ def _jnp():
     return jnp
 
 
+def _global_overflow_verdict(local: bool) -> bool:
+    """Agree on the cap-widening retry across every mesh process.
+
+    Each process fetches only its addressable shard of the wire totals, so
+    a tile overflowing on one host's shard is invisible to the others.  The
+    retry re-dispatches a *different* (2x-cap) sharded program — and also
+    flips ``_CAP_MEMO`` for every later group — so if processes decide
+    from local data alone their SPMD launch sequences diverge and the pod
+    hangs.  A one-bool all-gather makes the verdict global.  The caller
+    must gate this only on process-deterministic state (the memo), never
+    on shard-local data, so every process reaches the collective.
+    """
+    import jax
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([local], np.bool_))
+    return bool(np.asarray(flags).any())
+
+
 def _jnp_cat(raw, reps):
     jnp = _jnp()
     return jnp.concatenate([raw] + reps, axis=0)
@@ -123,6 +144,27 @@ class MeshRenderer(BatchingRenderer):
         self._steps_lock = threading.Lock()
         self._render_steps: dict = {}
         self._jpeg_steps: dict = {}
+        self._multihost = multihost
+        # Multi-host only: number of clean (globally-agreed no-overflow)
+        # groups seen per memo key.  Past the cap the steady-state hot
+        # path stops paying a cross-host collective per group; a later
+        # overflow then lands on the per-tile dense fallback instead of
+        # widening.  Counts advance only on agreed verdicts, so the
+        # counter — and therefore the launch sequence — stays identical
+        # on every process.
+        self._verdict_checks: dict = {}
+
+    _VERDICT_CHECK_CAP = 8
+
+    def _should_check_overflow(self, memo_key) -> bool:
+        if not self._multihost:
+            return True
+        return self._verdict_checks.get(memo_key, 0) < self._VERDICT_CHECK_CAP
+
+    def _record_clean_verdict(self, memo_key) -> None:
+        if self._multihost:
+            self._verdict_checks[memo_key] = \
+                self._verdict_checks.get(memo_key, 0) + 1
 
     # ------------------------------------------------------------- steps
 
@@ -206,10 +248,9 @@ class MeshRenderer(BatchingRenderer):
         quality = group[0].quality
         # Quality-aware cap: deterministic in (H, W, quality), so every
         # process of a multi-host mesh — fed the same group stream —
-        # compiles the same sharded program.  The overflow memo is
-        # consulted on the driver (the supported multi-host posture
-        # feeds the mesh from ONE request stream, so the decision is
-        # made once and identically).
+        # compiles the same sharded program.  Overflow retries are
+        # agreed globally via _global_overflow_verdict, so the memo
+        # (and the launch sequence) stays identical on every process.
         from ..ops.jpegenc import _CAP_MEMO, wire_header_i32
         cap = default_sparse_cap(H, W, quality)
         # The packed Huffman stream covers the full (H, W) grid, so the
@@ -230,15 +271,22 @@ class MeshRenderer(BatchingRenderer):
             bufs = self._jpeg_step(quality, cap)(*args)
             bufs = wire_fetcher(H, W, cap).fetch(bufs)
             totals = wire_header_i32(bufs, 0)
+            local_over = bool(((totals > cap)
+                               & (totals <= 2 * cap)).any())
             if (memo_key not in _CAP_MEMO
-                    and ((totals > cap) & (totals <= 2 * cap)).any()):
-                # One-shot widening, mirroring render_batch_to_jpeg:
-                # a rescuable overflow re-dispatches the group at 2x
-                # instead of per-tile dense re-renders.
-                _CAP_MEMO[memo_key] = True
-                cap *= 2
-                bufs = self._jpeg_step(quality, cap)(*args)
-                bufs = wire_fetcher(H, W, cap).fetch(bufs)
+                    and self._should_check_overflow(memo_key)):
+                if _global_overflow_verdict(local_over):
+                    # One-shot widening, mirroring render_batch_to_jpeg:
+                    # a rescuable overflow re-dispatches the group at 2x
+                    # instead of per-tile dense re-renders.  The verdict
+                    # is all-gathered so every process re-dispatches (or
+                    # not) in lockstep; the gates are deterministic.
+                    _CAP_MEMO[memo_key] = True
+                    cap *= 2
+                    bufs = self._jpeg_step(quality, cap)(*args)
+                    bufs = wire_fetcher(H, W, cap).fetch(bufs)
+                else:
+                    self._record_clean_verdict(memo_key)
 
         qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
         jpegs = finish_sparse_to_jpegs(
@@ -269,14 +317,21 @@ class MeshRenderer(BatchingRenderer):
             over = (totals > cap) | (bits > cap_words * 32)
             rescuable = ((totals <= 2 * cap)
                          & (bits <= 2 * cap_words * 32))
-            if memo_key not in _CAP_MEMO and (over & rescuable).any():
-                # One-shot widening (see render_batch_to_jpeg).
-                _CAP_MEMO[memo_key] = True
-                cap, cap_words = cap * 2, cap_words * 2
-                bufs = self._jpeg_step(quality, cap, "huffman",
-                                       cap_words)(*args)
-                bufs = huffman_wire_fetcher(H, W, cap,
-                                            cap_words).fetch(bufs)
+            local_over = bool((over & rescuable).any())
+            if (memo_key not in _CAP_MEMO
+                    and self._should_check_overflow(memo_key)):
+                if _global_overflow_verdict(local_over):
+                    # One-shot widening (see render_batch_to_jpeg);
+                    # verdict all-gathered across processes — see
+                    # _global_overflow_verdict.
+                    _CAP_MEMO[memo_key] = True
+                    cap, cap_words = cap * 2, cap_words * 2
+                    bufs = self._jpeg_step(quality, cap, "huffman",
+                                           cap_words)(*args)
+                    bufs = huffman_wire_fetcher(H, W, cap,
+                                                cap_words).fetch(bufs)
+                else:
+                    self._record_clean_verdict(memo_key)
 
         qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
         _dense_encode = dense_encoder()

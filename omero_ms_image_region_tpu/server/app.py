@@ -124,12 +124,9 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         # groups dispatch through the (data, chan) mesh steps.
         from ..parallel import cluster
         from ..parallel.serve import MeshRenderer
+        # config validation rejects bitpack in this posture; anything
+        # else invalid fails loudly in MeshRenderer's own check.
         engine = config.renderer.jpeg_engine
-        if engine == "bitpack":
-            log.warning("renderer.jpeg-engine='bitpack' applies only "
-                        "to the direct renderer; the mesh renderer "
-                        "uses the sparse engine")
-            engine = "sparse"
         cluster.initialize(
             coordinator_address=config.parallel.coordinator_address,
             num_processes=config.parallel.num_processes,
@@ -152,13 +149,9 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
             jpeg_engine=engine,
             pipeline_depth=config.batcher.pipeline_depth)
     elif config.batcher.enabled:
+        # config validation rejects bitpack in this posture.
         engine = config.renderer.jpeg_engine
-        if engine == "bitpack":
-            log.warning("renderer.jpeg-engine='bitpack' applies only "
-                        "to the direct renderer; the batcher uses "
-                        "the sparse engine")
-            engine = "sparse"
-        elif engine == "auto":
+        if engine == "auto":
             # Pick the wire engine for this deployment's actual link
             # (sparse above ~12 MB/s device->host, huffman below).
             from ..utils.linkprobe import resolve_auto_engine

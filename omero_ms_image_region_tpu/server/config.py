@@ -306,6 +306,16 @@ class AppConfig:
             raise ValueError(
                 f"renderer.jpeg-engine must be 'sparse', 'huffman', "
                 f"'bitpack' or 'auto', got {cfg.renderer.jpeg_engine!r}")
+        if (cfg.renderer.jpeg_engine == "bitpack"
+                and (cfg.batcher.enabled or cfg.parallel.enabled)):
+            # Engine/posture parity: bitpack has no batched group form,
+            # so a config valid for the direct renderer must fail loudly
+            # at load time in the batched/mesh postures instead of
+            # silently serving a different engine.
+            raise ValueError(
+                "renderer.jpeg-engine 'bitpack' is only supported by "
+                "the direct (unbatched) renderer; with batcher.enabled "
+                "or parallel.enabled use 'sparse', 'huffman' or 'auto'")
         if cfg.renderer.kernel != "xla":
             raise ValueError(
                 f"renderer.kernel must be 'xla' (the experimental "

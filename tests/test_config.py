@@ -158,3 +158,21 @@ def test_compilation_cache_dir_config():
         {"renderer": {"compilation-cache-dir": "/tmp/jc"}})
     assert cfg.renderer.compilation_cache_dir == "/tmp/jc"
     assert AppConfig().renderer.compilation_cache_dir is None
+
+
+def test_bitpack_engine_rejected_in_batched_postures():
+    """Engine/posture parity (VERDICT r3 item 8): bitpack is valid only
+    for the direct renderer; batched/mesh configs fail at load time."""
+    import pytest
+
+    from omero_ms_image_region_tpu.server.config import AppConfig
+
+    base = {"renderer": {"jpeg-engine": "bitpack"}}
+    # Direct posture: fine.
+    cfg = AppConfig.from_dict({**base, "batcher": {"enabled": False}})
+    assert cfg.renderer.jpeg_engine == "bitpack"
+    with pytest.raises(ValueError, match="bitpack"):
+        AppConfig.from_dict({**base, "batcher": {"enabled": True}})
+    with pytest.raises(ValueError, match="bitpack"):
+        AppConfig.from_dict({**base, "batcher": {"enabled": False},
+                             "parallel": {"enabled": True}})

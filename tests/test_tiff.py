@@ -678,3 +678,136 @@ def test_thumbnail_first_page_pyramid(tmp_path):
     got = src.get_region(0, 0, 0, RegionDef(0, 0, 80, 64), 0)
     assert np.array_equal(got, full)
     src.close()
+
+
+def test_lzw_rejects_out_of_range_code():
+    """A code beyond next-table-entry is corrupt, not KwKwK — both the
+    pure-Python and native decoders must refuse it (ADVICE r3)."""
+    from omero_ms_image_region_tpu.io.tiff import _lzw_decode
+
+    def pack(codes, bits=9):
+        buf = val = nbits = 0
+        out = bytearray()
+        for c in codes:
+            val = (val << bits) | c
+            nbits += bits
+            while nbits >= 8:
+                nbits -= 8
+                out.append((val >> nbits) & 0xFF)
+        if nbits:
+            out.append((val << (8 - nbits)) & 0xFF)
+        return bytes(out)
+
+    # Clear, 'A' (prev set, table size 258), then 300 > 258: corrupt.
+    with pytest.raises(ValueError, match="corrupt LZW"):
+        _lzw_decode(pack([256, 65, 300]))
+    # Same corruption as the FIRST code after a Clear (prev unset).
+    with pytest.raises(ValueError, match="corrupt LZW"):
+        _lzw_decode(pack([256, 300]))
+    # The legal KwKwK code (== len(table)) still decodes.
+    out = _lzw_decode(pack([256, 65, 258, 257]))
+    assert out == b"A" + b"AA"
+
+
+def test_pixels_service_defers_close_until_unreferenced(tmp_path):
+    """Evicted-but-in-use sources stay open; once the last outside
+    reference drops, a later drain closes them (ADVICE r3: fd bound)."""
+    rng = np.random.default_rng(7)
+    planes = rng.integers(0, 60000, size=(1, 1, 64, 64)).astype(np.uint16)
+    for i in (1, 2, 3):
+        os.makedirs(tmp_path / str(i))
+        write_ome_tiff(planes, str(tmp_path / str(i) / "img.ome.tiff"),
+                       tile=(32, 32), n_levels=1)
+    svc = PixelsService(str(tmp_path), max_open=1)
+    src1 = svc.get_pixel_source(1)
+    svc.get_pixel_source(2)          # evicts 1, but src1 is still held
+    assert len(svc._evicted) == 1
+    f1 = next(iter(src1._files.values()))._f
+    assert not f1.closed              # mid-read safety: never yanked
+    # Still readable after eviction.
+    src1.get_region(0, 0, 0, RegionDef(0, 0, 8, 8), 0)
+    del src1
+    svc.get_pixel_source(3)          # evicts 2; drain closes 1
+    assert f1.closed
+    # 2 was never referenced outside the cache → closed on the same
+    # drain; nothing lingers.
+    assert not svc._evicted
+    svc.close()
+
+
+def test_one_bit_tiff_reads_as_binary_uint8(tmp_path):
+    """OME ``bit`` / bilevel TIFF support (VERDICT r3 item 7): packed
+    MSB-first rows expand to uint8 0/1 — the raster class the reference
+    reads via ome.util.PixelData's 1-bit accessor
+    (``ShapeMaskRequestHandler.java:214-221``)."""
+    rng = np.random.default_rng(21)
+    # Non-byte-aligned width exercises the per-row bit padding.
+    grid = rng.integers(0, 2, size=(40, 51)).astype(bool)
+    d = tmp_path / "1"
+    os.makedirs(d)
+    path = str(d / "mask.ome.tiff")
+    ome = ('<OME xmlns="http://www.openmicroscopy.org/Schemas/OME/'
+           '2016-06"><Image ID="Image:0"><Pixels ID="Pixels:0" '
+           'DimensionOrder="XYZCT" Type="bit" SizeX="51" SizeY="40" '
+           'SizeZ="1" SizeC="1" SizeT="1"><TiffData/></Pixels>'
+           '</Image></OME>')
+    Image.fromarray(grid).save(path, tiffinfo={270: ome})
+
+    src = OmeTiffSource(path)
+    assert src.pixels_type == "bit"
+    got = src.get_region(0, 0, 0, RegionDef(0, 0, 51, 40), 0)
+    assert got.dtype == np.uint8
+    np.testing.assert_array_equal(got, grid.astype(np.uint8))
+    # Unaligned sub-region too.
+    sub = src.get_region(0, 0, 0, RegionDef(3, 5, 17, 9), 0)
+    np.testing.assert_array_equal(sub, grid[5:14, 3:20].astype(np.uint8))
+    src.close()
+
+
+def test_bare_bilevel_tiff_infers_bit_type(tmp_path):
+    grid = np.zeros((16, 24), bool)
+    grid[::3, ::2] = True
+    path = str(tmp_path / "m.tif")
+    Image.fromarray(grid).save(path)
+    src = OmeTiffSource(path)
+    assert src.pixels_type == "bit"
+    got = src.get_region(0, 0, 0, RegionDef(0, 0, 24, 16), 0)
+    np.testing.assert_array_equal(got, grid.astype(np.uint8))
+    src.close()
+
+
+def test_white_is_zero_bilevel_is_inverted(tmp_path):
+    """Photometric 0 (WhiteIsZero) bilevel reads with 1 = bright."""
+    from omero_ms_image_region_tpu.io.tiff import TiffFile
+
+    grid = np.zeros((10, 16), np.uint8)
+    grid[2:5, 3:9] = 1
+    path = str(tmp_path / "wz.tif")
+    # Hand-build: photometric 0 means 0 = white, so write the INVERTED
+    # bit pattern and expect the reader to undo it.
+    packed = np.packbits(1 - grid, axis=1).tobytes()
+    n = 8
+    entries = []
+
+    def ent(tag, ftype, count, value):
+        return struct.pack("<HHI4s", tag, ftype, count, value)
+
+    s = lambda v: struct.pack("<HH", v, 0)
+    l = lambda v: struct.pack("<I", v)
+    data_off = 8 + 2 + n * 12 + 4
+    entries.append(ent(256, 3, 1, s(16)))
+    entries.append(ent(257, 3, 1, s(10)))
+    entries.append(ent(259, 3, 1, s(1)))
+    entries.append(ent(262, 3, 1, s(0)))          # WhiteIsZero
+    entries.append(ent(273, 4, 1, l(data_off)))
+    entries.append(ent(277, 3, 1, s(1)))
+    entries.append(ent(278, 3, 1, s(10)))
+    entries.append(ent(279, 4, 1, l(len(packed))))
+    with open(path, "wb") as f:
+        f.write(b"II" + struct.pack("<HI", 42, 8))
+        f.write(struct.pack("<H", n) + b"".join(entries) + l(0))
+        f.write(packed)
+    tf = TiffFile(path)
+    got = tf.read_segment(tf.ifds[0], 0, 0)
+    np.testing.assert_array_equal(got[:, :, 0], grid)
+    tf.close()

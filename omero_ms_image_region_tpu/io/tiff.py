@@ -9,7 +9,9 @@ directly; scope is exactly what serving needs:
 
 - classic (magic 42) and BigTIFF (magic 43), both byte orders;
 - tiled (322/323/324/325) and stripped (273/278/279) image data;
-- compression: none (1), LZW (5), deflate (8 / 32946), PackBits (32773);
+- compression: none (1), LZW (5), new-style JPEG (7, baseline; tables
+  from tag 347, via ``io/jpegdec``), deflate (8 / 32946), PackBits
+  (32773);
 - horizontal-differencing predictor (317 = 2);
 - SubIFD chains (330) — OME-TIFF 6.0 stores pyramid levels there;
 - sample types: u8/u16/u32, i8/i16/i32, f32/f64 via 258/339.
@@ -48,6 +50,7 @@ TILE_OFFSETS = 324
 TILE_BYTE_COUNTS = 325
 SUB_IFDS = 330
 SAMPLE_FORMAT = 339
+JPEG_TABLES = 347
 
 # field type -> (struct code, byte size); struct code None = opaque bytes
 _TYPES: Dict[int, Tuple[Optional[str], int]] = {
@@ -225,6 +228,10 @@ class TiffFile:
     def __init__(self, path: str):
         self.path = path
         self._f = open(path, "rb")
+        # Parsed-JPEGTables memo (keyed by the tag's bytes object):
+        # every tile of an IFD shares one tag-347 stream, so the Huffman
+        # lookup tables build once per file, not once per tile.
+        self._jpeg_tables_cache: Dict[bytes, object] = {}
         try:
             self._parse_header_and_ifds(path)
         except BaseException:
@@ -380,6 +387,32 @@ class TiffFile:
                 f"{ifd.one(PLANAR_CONFIG)} (only chunky is supported)")
         if not ifd.tiled and gy == grid_y - 1:
             seg_h = ifd.height - gy * seg_h  # last strip may be short
+        if comp == 7:
+            # New-style JPEG-in-TIFF (the SVS/WSI vendor-pyramid class;
+            # Bio-Formats covers this behind getPixelBuffer).  The
+            # abbreviated per-segment stream carries its tables in tag
+            # 347; photometric 6 stores YCbCr and converts to RGB.
+            from .jpegdec import decode_tiff_jpeg
+            tables = ifd.get(JPEG_TABLES)
+            img = decode_tiff_jpeg(
+                raw, bytes(tables) if tables else None,
+                int(ifd.one(PHOTOMETRIC, 1)),
+                tables_cache=self._jpeg_tables_cache)
+            if (img.shape[1] < seg_w
+                    or (ifd.tiled and img.shape[0] < seg_h)):
+                # Tile JPEGs must cover the full padded tile; strips
+                # must cover the width (only the last strip's height
+                # may legitimately be shorter, handled below).
+                raise ValueError(
+                    f"{self.path}: JPEG frame {img.shape[:2]} smaller "
+                    f"than segment {seg_h}x{seg_w}")
+            if not ifd.tiled:
+                seg_h = min(seg_h, img.shape[0])
+            if img.shape[-1] != spp:
+                raise ValueError(
+                    f"{self.path}: JPEG components {img.shape[-1]} != "
+                    f"samples per pixel {spp}")
+            return np.ascontiguousarray(img[:seg_h, :seg_w])
         if ifd.bits == 1:
             # Packed bilevel rows: each row starts on a byte boundary.
             # Expanded to uint8 0/1 with 1 = bright: WhiteIsZero files

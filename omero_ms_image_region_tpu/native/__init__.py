@@ -24,14 +24,9 @@ _SOURCE = os.path.join(_HERE, "tilecache.cpp")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libtilecache.so")
 _JPEG_SOURCE = os.path.join(_HERE, "jpegenc.cpp")
 _JPEG_LIB_PATH = os.path.join(_BUILD_DIR, "libjpegenc.so")
+_JPEGDEC_SOURCE = os.path.join(_HERE, "jpegdec.cpp")
+_JPEGDEC_LIB_PATH = os.path.join(_BUILD_DIR, "libjpegdec.so")
 _BUILD_LOCK = threading.Lock()
-
-_lib: Optional[ctypes.CDLL] = None
-_jpeg_lib: Optional[ctypes.CDLL] = None
-# First failure is cached so hot paths that probe availability per batch
-# don't re-spawn a doomed g++ attempt every call.
-_lib_error: Optional[str] = None
-_jpeg_lib_error: Optional[str] = None
 
 
 def _compile_lib(source: str, lib_path: str) -> None:
@@ -44,58 +39,119 @@ def _compile_lib(source: str, lib_path: str) -> None:
     os.replace(lib_path + ".tmp", lib_path)
 
 
-def _compile() -> None:
-    _compile_lib(_SOURCE, _LIB_PATH)
+class _NativeLib:
+    """Build-on-first-use loader for one shared library: double-checked
+    lock, mtime-based staleness rebuild, cached first failure (so hot
+    paths probing availability per batch don't re-spawn a doomed g++
+    attempt every call), and per-lib ctypes prototype setup."""
+
+    def __init__(self, source: str, lib_path: str, what: str,
+                 configure) -> None:
+        self.source = source
+        self.lib_path = lib_path
+        self.what = what
+        self.configure = configure
+        self.lib: Optional[ctypes.CDLL] = None
+        self.error: Optional[str] = None
+
+    def load(self) -> ctypes.CDLL:
+        if self.lib is not None:
+            return self.lib
+        if self.error is not None:
+            raise ImportError(self.error)
+        with _BUILD_LOCK:
+            if self.lib is not None:
+                return self.lib
+            if self.error is not None:
+                raise ImportError(self.error)
+            if (not os.path.exists(self.lib_path)
+                    or os.path.getmtime(self.lib_path)
+                    < os.path.getmtime(self.source)):
+                try:
+                    _compile_lib(self.source, self.lib_path)
+                except (OSError, subprocess.CalledProcessError) as e:
+                    self.error = f"{self.what} unavailable: {e}"
+                    raise ImportError(self.error)
+            lib = ctypes.CDLL(self.lib_path)
+            self.configure(lib)
+            self.lib = lib
+            return lib
+
+
+def _configure_tilecache(lib: ctypes.CDLL) -> None:
+    lib.tc_create.restype = ctypes.c_void_p
+    lib.tc_create.argtypes = [ctypes.c_size_t, ctypes.c_uint]
+    lib.tc_destroy.argtypes = [ctypes.c_void_p]
+    lib.tc_put.restype = ctypes.c_int
+    lib.tc_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_size_t, ctypes.c_char_p,
+                           ctypes.c_size_t]
+    lib.tc_get.restype = ctypes.c_longlong
+    lib.tc_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_size_t,
+                           ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+    lib.tc_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    for fn in ("tc_hits", "tc_misses", "tc_size_bytes"):
+        getattr(lib, fn).restype = ctypes.c_uint64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.bits_unpack_msb.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                    ctypes.c_char_p]
+    lib.flip_u32.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                             ctypes.c_int, ctypes.c_int,
+                             ctypes.c_int, ctypes.c_int]
+    lib.mask_overlay_u8.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int]
+    lib.tiff_lzw_decode.restype = ctypes.c_longlong
+    lib.tiff_lzw_decode.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                    ctypes.c_void_p, ctypes.c_size_t]
+
+
+def _configure_jpegenc(lib: ctypes.CDLL) -> None:
+    lib.jpeg_encode.restype = ctypes.c_longlong
+    lib.jpeg_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_size_t,
+    ]
+    lib.jpeg_encode_sparse.restype = ctypes.c_longlong
+    lib.jpeg_encode_sparse.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_size_t,
+    ]
+
+
+def _configure_jpegdec(lib: ctypes.CDLL) -> None:
+    lib.jpeg_decode_baseline.restype = ctypes.c_longlong
+    lib.jpeg_decode_baseline.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+
+
+_TILECACHE = _NativeLib(_SOURCE, _LIB_PATH, "native tilecache",
+                        _configure_tilecache)
+_JPEGENC = _NativeLib(_JPEG_SOURCE, _JPEG_LIB_PATH,
+                      "native jpeg encoder", _configure_jpegenc)
+_JPEGDEC = _NativeLib(_JPEGDEC_SOURCE, _JPEGDEC_LIB_PATH,
+                      "native jpeg decoder", _configure_jpegdec)
 
 
 def _load() -> ctypes.CDLL:
-    global _lib, _lib_error
-    if _lib is not None:
-        return _lib
-    if _lib_error is not None:
-        raise ImportError(_lib_error)
-    with _BUILD_LOCK:
-        if _lib is not None:
-            return _lib
-        if _lib_error is not None:
-            raise ImportError(_lib_error)
-        if (not os.path.exists(_LIB_PATH)
-                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SOURCE)):
-            try:
-                _compile()
-            except (OSError, subprocess.CalledProcessError) as e:
-                _lib_error = f"native tilecache unavailable: {e}"
-                raise ImportError(_lib_error)
-        lib = ctypes.CDLL(_LIB_PATH)
-        lib.tc_create.restype = ctypes.c_void_p
-        lib.tc_create.argtypes = [ctypes.c_size_t, ctypes.c_uint]
-        lib.tc_destroy.argtypes = [ctypes.c_void_p]
-        lib.tc_put.restype = ctypes.c_int
-        lib.tc_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                               ctypes.c_size_t, ctypes.c_char_p,
-                               ctypes.c_size_t]
-        lib.tc_get.restype = ctypes.c_longlong
-        lib.tc_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                               ctypes.c_size_t,
-                               ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
-        lib.tc_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
-        for fn in ("tc_hits", "tc_misses", "tc_size_bytes"):
-            getattr(lib, fn).restype = ctypes.c_uint64
-            getattr(lib, fn).argtypes = [ctypes.c_void_p]
-        lib.bits_unpack_msb.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
-                                        ctypes.c_char_p]
-        lib.flip_u32.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                 ctypes.c_int, ctypes.c_int,
-                                 ctypes.c_int, ctypes.c_int]
-        lib.mask_overlay_u8.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                        ctypes.c_void_p, ctypes.c_void_p,
-                                        ctypes.c_int, ctypes.c_int,
-                                        ctypes.c_int]
-        lib.tiff_lzw_decode.restype = ctypes.c_longlong
-        lib.tiff_lzw_decode.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
-                                        ctypes.c_void_p, ctypes.c_size_t]
-        _lib = lib
-        return lib
+    return _TILECACHE.load()
+
+
+def _load_jpeg() -> ctypes.CDLL:
+    return _JPEGENC.load()
+
+
+def _load_jpegdec() -> ctypes.CDLL:
+    return _JPEGDEC.load()
 
 
 class NativeLRUCache:
@@ -207,42 +263,6 @@ def mask_overlay_u8(base_rgba, mask_grids, fills):
     return out
 
 
-def _load_jpeg() -> ctypes.CDLL:
-    global _jpeg_lib, _jpeg_lib_error
-    if _jpeg_lib is not None:
-        return _jpeg_lib
-    if _jpeg_lib_error is not None:
-        raise ImportError(_jpeg_lib_error)
-    with _BUILD_LOCK:
-        if _jpeg_lib is not None:
-            return _jpeg_lib
-        if _jpeg_lib_error is not None:
-            raise ImportError(_jpeg_lib_error)
-        if (not os.path.exists(_JPEG_LIB_PATH)
-                or os.path.getmtime(_JPEG_LIB_PATH)
-                < os.path.getmtime(_JPEG_SOURCE)):
-            try:
-                _compile_lib(_JPEG_SOURCE, _JPEG_LIB_PATH)
-            except (OSError, subprocess.CalledProcessError) as e:
-                _jpeg_lib_error = f"native jpeg encoder unavailable: {e}"
-                raise ImportError(_jpeg_lib_error)
-        lib = ctypes.CDLL(_JPEG_LIB_PATH)
-        lib.jpeg_encode.restype = ctypes.c_longlong
-        lib.jpeg_encode.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_void_p, ctypes.c_size_t,
-        ]
-        lib.jpeg_encode_sparse.restype = ctypes.c_longlong
-        lib.jpeg_encode_sparse.argtypes = [
-            ctypes.c_void_p, ctypes.c_size_t,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_void_p, ctypes.c_size_t,
-        ]
-        _jpeg_lib = lib
-        return lib
-
-
 class SparseOverflowError(ValueError):
     """The device wire buffer dropped entries (content denser than cap)."""
 
@@ -332,6 +352,40 @@ def jpeg_encode_sparse_native(buf, width: int, height: int, quality: int,
         if n == -1:
             raise ValueError("jpeg_encode_sparse: invalid arguments")
         out_cap = -n
+
+
+def jpeg_decode_baseline(data: bytes, tables: "bytes | None"):
+    """Decode one baseline JPEG (optionally abbreviated, with a TIFF
+    JPEGTables stream) to ``u8[h, w, ncomp]`` raw components.
+
+    Native mirror of ``io.jpegdec.decode_baseline_jpeg`` — same scope
+    (SOF0/1, sampling 1-2, DRI/RST), GIL released for the whole decode.
+    Raises ImportError when no toolchain built the library and
+    ValueError on malformed/unsupported streams.
+    """
+    import numpy as np
+    lib = _load_jpegdec()
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    nc = ctypes.c_int()
+    tb = tables or b""
+    # First call with zero cap: the decoder sizes the frame from the
+    # headers (before entropy decode), fills out_w/h/ncomp and returns
+    # the cap-too-small code (-2; -1 = malformed).
+    n = lib.jpeg_decode_baseline(data, len(data), tb, len(tb), None, 0,
+                                 ctypes.byref(w), ctypes.byref(h),
+                                 ctypes.byref(nc))
+    if n != -2:
+        raise ValueError("malformed or unsupported JPEG stream")
+    need = w.value * h.value * nc.value
+    out = np.empty(need, np.uint8)
+    n2 = lib.jpeg_decode_baseline(data, len(data), tb, len(tb),
+                                  out.ctypes.data_as(ctypes.c_void_p),
+                                  out.size, ctypes.byref(w),
+                                  ctypes.byref(h), ctypes.byref(nc))
+    if n2 != need:
+        raise ValueError("malformed or unsupported JPEG stream")
+    return out.reshape(h.value, w.value, nc.value)
 
 
 def flip_u32(packed, flip_horizontal: bool, flip_vertical: bool):

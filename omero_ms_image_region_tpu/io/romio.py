@@ -1,0 +1,117 @@
+"""Pre-FS OMERO pixel buffer: raw planes under ``<data.dir>/Pixels/<id>``.
+
+Images imported before OMERO 5's ManagedRepository keep their pixel data
+in the legacy ROMIO layout the reference reads through
+``ome.io.nio.PixelsService`` (the ``/OMERO/Pixels`` bean,
+``beanRefContext.xml:13-16``; ``config.yaml:19-20`` ``omero.data.dir``):
+one file per Pixels row holding size_z*size_c*size_t raw planes,
+**big-endian**, plane order z-fastest (XYZCT: index =
+z + size_z * (c + size_c * t)), no pyramid.
+
+Geometry and pixel type are not in the file — they come from the
+``pixels`` DB row, which is exactly what the resolving caller
+(``services.db_metadata.resolve_image_paths`` + ``io.service``) has in
+hand.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+from ..models.pixels import Pixels
+from ..server.region import RegionDef
+
+
+class RomioPixelSource:
+    """PixelSource over one legacy ROMIO pixels file."""
+
+    def __init__(self, path: str, pixels: Pixels):
+        self.path = path
+        self._px = pixels
+        self._dtype = np.dtype(pixels.type.np_dtype)
+        self._plane_px = pixels.size_x * pixels.size_y
+        plane_bytes = self._plane_px * self._dtype.itemsize
+        n_planes = pixels.size_z * pixels.size_c * pixels.size_t
+        self._plane_bytes = plane_bytes
+        self._f = open(path, "rb")
+        size = os.fstat(self._f.fileno()).st_size
+        if size < n_planes * plane_bytes:
+            self._f.close()
+            raise ValueError(
+                f"{path}: ROMIO file holds {size} bytes, geometry needs "
+                f"{n_planes * plane_bytes}")
+
+    # ------------------------------------------------------------- layout
+
+    def _plane_offset(self, z: int, c: int, t: int) -> int:
+        px = self._px
+        if not (0 <= z < px.size_z and 0 <= c < px.size_c
+                and 0 <= t < px.size_t):
+            raise ValueError(f"plane ({z}, {c}, {t}) out of bounds")
+        return (z + px.size_z * (c + px.size_c * t)) * self._plane_bytes
+
+    # ----------------------------------------------------------- protocol
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._dtype
+
+    def resolution_levels(self) -> int:
+        return 1
+
+    def resolution_descriptions(self) -> List[Tuple[int, int]]:
+        return [(self._px.size_x, self._px.size_y)]
+
+    def tile_size(self) -> Tuple[int, int]:
+        # The reference's server default tile for non-tiled buffers.
+        return (min(self._px.size_x, 256), min(self._px.size_y, 256))
+
+    def get_region(self, z: int, c: int, t: int, region: RegionDef,
+                   level: int = 0) -> np.ndarray:
+        if level != 0:
+            raise ValueError("ROMIO buffers have no pyramid levels")
+        px = self._px
+        x0, y0, w, h = region.x, region.y, region.width, region.height
+        if not (0 <= x0 and 0 <= y0 and x0 + w <= px.size_x
+                and y0 + h <= px.size_y and w > 0 and h > 0):
+            raise ValueError(f"region {region.as_tuple()} out of bounds")
+        base = self._plane_offset(z, c, t)
+        item = self._dtype.itemsize
+        if w == px.size_x:
+            # Full-width rows are one contiguous span.
+            off = base + y0 * px.size_x * item
+            data = os.pread(self._f.fileno(), h * w * item, off)
+            if len(data) != h * w * item:
+                raise EOFError(f"{self.path}: short read")
+            out = np.frombuffer(data, self._dtype.newbyteorder(">"),
+                                count=h * w).reshape(h, w)
+        else:
+            rows = []
+            for y in range(y0, y0 + h):
+                off = base + (y * px.size_x + x0) * item
+                data = os.pread(self._f.fileno(), w * item, off)
+                if len(data) != w * item:
+                    raise EOFError(f"{self.path}: short read")
+                rows.append(np.frombuffer(
+                    data, self._dtype.newbyteorder(">"), count=w))
+            out = np.stack(rows)
+        return np.ascontiguousarray(
+            out.astype(self._dtype.newbyteorder("="), copy=False))
+
+    def get_stack(self, c: int, t: int) -> np.ndarray:
+        px = self._px
+        region = RegionDef(0, 0, px.size_x, px.size_y)
+        return np.stack([self.get_region(z, c, t, region, 0)
+                         for z in range(px.size_z)])
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self._f.close()
+        except Exception:
+            pass

@@ -39,9 +39,18 @@ class PixelsService:
     # source's refcount high until a cycle collection runs.
     _GC_THRESHOLD = 8
 
-    def __init__(self, data_dir: str, max_open: int = DEFAULT_MAX_OPEN):
+    def __init__(self, data_dir: str, max_open: int = DEFAULT_MAX_OPEN,
+                 repo_root: Optional[str] = None):
         self.data_dir = data_dir
         self.max_open = max_open
+        # OMERO binary-repository mount (``omero.data.dir``,
+        # ``config.yaml:19-20``): when set, images absent from the
+        # per-image data_dir layout resolve through DB-provided
+        # repo-relative paths (ManagedRepository filesets, legacy
+        # Pixels/<id> ROMIO files) with zero re-arrangement — the role
+        # of the reference's file-path resolver bean
+        # (``beanRefContext.xml:13-16``).
+        self.repo_root = repo_root
         self._lock = threading.Lock()
         self._open: "OrderedDict[int, PixelSource]" = OrderedDict()
         # Sources dropped from the LRU while possibly still mid-read;
@@ -92,8 +101,57 @@ class PixelsService:
     def exists(self, image_id: int) -> bool:
         return self._sniff(image_id) is not None
 
-    def get_pixel_source(self, image_id: int) -> PixelSource:
-        """≙ ``PixelsService.getPixelBuffer(pixels, false)``."""
+    def is_open(self, image_id: int) -> bool:
+        """LRU probe without disk or DB I/O: a repo-resolved image that
+        is already open needs no re-resolution on the hot tile path."""
+        with self._lock:
+            return image_id in self._open
+
+    def _open_from_repo(self, image_id: int, candidates, pixels):
+        """Open the first usable repo-relative candidate path.
+
+        TIFF-suffixed entries (``.ome.tif(f)`` preferred) open through
+        the OME-TIFF reader; a ``Pixels/<id>`` entry opens as a legacy
+        ROMIO buffer, which needs the DB geometry (``pixels``).
+        """
+        from .romio import RomioPixelSource
+
+        def rank(rel: str) -> int:
+            low = rel.lower()
+            if low.endswith((".ome.tif", ".ome.tiff")):
+                return 0
+            if low.endswith((".tif", ".tiff")):
+                return 1
+            return 2
+
+        tried = []
+        for rel in sorted(candidates, key=rank):
+            path = os.path.join(self.repo_root, rel)
+            if not os.path.isfile(path):
+                tried.append(rel)
+                continue
+            if rank(rel) < 2:
+                return OmeTiffSource(path)
+            if rel.startswith("Pixels/"):
+                if pixels is None:
+                    raise ValueError(
+                        f"image {image_id}: ROMIO path {rel} needs "
+                        f"pixels geometry to open")
+                return RomioPixelSource(path, pixels)
+            tried.append(rel)   # present but not a format we serve
+        raise FileNotFoundError(
+            f"image {image_id}: no usable pixel file under "
+            f"{self.repo_root} (candidates: {tried or candidates})")
+
+    def get_pixel_source(self, image_id: int, candidates=None,
+                         pixels=None) -> PixelSource:
+        """≙ ``PixelsService.getPixelBuffer(pixels, false)``.
+
+        ``candidates`` are repo-root-relative paths from the metadata
+        DB (``DbMetadataService.resolve_image_paths``); they apply only
+        when the per-image ``data_dir`` layout has no entry, so a local
+        override always wins.
+        """
         with self._lock:
             src = self._open.get(image_id)
             if src is not None:
@@ -105,12 +163,14 @@ class PixelsService:
                     self._drain_evicted_locked()
                 return src
         backend = self._sniff(image_id)
-        if backend is None:
+        if backend is None and candidates and self.repo_root:
+            src = self._open_from_repo(image_id, candidates, pixels)
+        elif backend is None:
             raise FileNotFoundError(
                 f"no pixel data for image {image_id} under "
                 f"{self.data_dir}"
             )
-        if backend == "chunked":
+        elif backend == "chunked":
             src = ChunkedPyramidStore(self.image_dir(image_id))
         else:
             src = OmeTiffSource(backend)

@@ -173,7 +173,8 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         log.warning("redis package unavailable; redis cache tier and "
                     "shared canRead memo disabled")
     services = ImageRegionServices(
-        pixels_service=PixelsService(config.data_dir),
+        pixels_service=PixelsService(config.data_dir,
+                                     repo_root=config.omero_data_dir),
         metadata=LocalMetadataService(config.data_dir),
         caches=caches,
         # The canRead memo's shared tier plays the reference's

@@ -141,6 +141,12 @@ class AppConfig:
     # sizes the asyncio default executor every render offload runs on.
     worker_pool_size: Optional[int] = None
     data_dir: str = "./data"
+    # OMERO binary-repository mount (``omero.server:
+    # omero.data.dir``, reference ``config.yaml:19-20``): when set and
+    # the metadata backend is postgres, images resolve from the DB's
+    # fileset/originalfile rows under <root>/ManagedRepository (legacy
+    # images under <root>/Pixels) with zero re-arrangement.
+    omero_data_dir: Optional[str] = None
     max_tile_length: int = 2048            # omero.pixeldata.max_tile_length
     cache_control_header: str = ""         # cache-control-header
     session_cookie_name: str = "sessionid"  # omero.web.session_cookie_name
@@ -204,6 +210,8 @@ class AppConfig:
         server_block = raw.get("omero.server", {}) or {}
         cfg.max_tile_length = int(server_block.get(
             "omero.pixeldata.max_tile_length", cfg.max_tile_length))
+        cfg.omero_data_dir = server_block.get("omero.data.dir",
+                                              cfg.omero_data_dir)
         cfg.lut_root = server_block.get("omero.script_repo_root",
                                         cfg.lut_root)
         cfg.cache_control_header = raw.get("cache-control-header",

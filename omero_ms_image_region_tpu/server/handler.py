@@ -276,6 +276,28 @@ class ImageRegionHandler:
 
     # --------------------------------------------------------- pipeline
 
+    async def _open_pixel_source(self, image_id: int, pixels: Pixels):
+        """Resolve + open the image's pixel data.
+
+        The per-image ``data_dir`` layout is tried first; when it has no
+        entry and the metadata backend can resolve binary-repository
+        paths (``metadata-service: postgres`` + a mounted
+        ``omero.data.dir``), the image serves straight out of the OMERO
+        repository — the reference's resolver-bean + Bio-Formats flow
+        (``ImageRegionRequestHandler.java:302-309``).
+        """
+        svc = self.s.pixels_service
+        candidates = None
+        resolver = getattr(self.s.metadata, "resolve_image_paths", None)
+        if (resolver is not None and getattr(svc, "repo_root", None)
+                and not svc.is_open(image_id)
+                and not await asyncio.to_thread(svc.exists, image_id)):
+            # Resolution (a DB round trip) runs only on a true open
+            # miss; hot tile traffic on an already-open image skips it.
+            candidates = await resolver(image_id)
+        return await asyncio.to_thread(
+            svc.get_pixel_source, image_id, candidates, pixels)
+
     async def _get_region(self, ctx: ImageRegionCtx,
                           pixels: Pixels) -> bytes:
         if ctx.z < 0 or ctx.z >= pixels.size_z:
@@ -286,8 +308,7 @@ class ImageRegionHandler:
                 f"Parameter 'theT' not within bounds: {ctx.t}")
 
         with stopwatch("PixelsService.getPixelBuffer"):
-            src = await asyncio.to_thread(
-                self.s.pixels_service.get_pixel_source, ctx.image_id)
+            src = await self._open_pixel_source(ctx.image_id, pixels)
 
         if src.resolution_levels() > 1:
             levels: Sequence[Sequence[int]] = [

@@ -90,6 +90,40 @@ SELECT s.width, s.height, s.bytes, s.fillcolor
 FROM shape s WHERE s.id = $1 AND s.bytes IS NOT NULL
 """
 
+# Binary-repository resolution (the file-path resolver bean +
+# Bio-Formats behind PixelsService.getPixelBuffer,
+# beanRefContext.xml:13-21, ImageRegionRequestHandler.java:302-309):
+# an OMERO 5 import lands in the ManagedRepository as the fileset's
+# originalfile rows (path is repo-relative, name the filename).
+_SQL_FILESET_FILES = """
+SELECT f.path AS path, f.name AS name
+FROM image i
+JOIN filesetentry fe ON fe.fileset = i.fileset
+JOIN originalfile f ON fe.originalfile = f.id
+WHERE i.id = $1
+ORDER BY fe.id
+"""
+
+# Pre-FS images have no fileset; their pixel data is the legacy ROMIO
+# file <omero.data.dir>/Pixels/<pixels_id> (the "/OMERO/Pixels" bean).
+_SQL_PIXELS_ID = """
+SELECT p.id AS id FROM pixels p WHERE p.image = $1
+"""
+
+
+def _romio_rel_path(pixels_id: int) -> str:
+    """Legacy ROMIO path for a pixels id, with the Dir-### fan-out
+    (``ome.io.nio.AbstractFileSystemService``): ids >= 1000 nest into
+    3-digit-group subdirectories — 1234 lives at ``Pixels/Dir-001/1234``,
+    1234567 at ``Pixels/Dir-001/Dir-234/1234567``."""
+    suffix = ""
+    remaining = pixels_id
+    while remaining > 999:
+        remaining //= 1000
+        if remaining > 0:
+            suffix = f"/Dir-{remaining % 1000:03d}" + suffix
+    return f"Pixels{suffix}/{pixels_id}"
+
 
 def _unpack_fillcolor(value: Optional[int]):
     """OMERO stores shape colors as one signed 32-bit RGBA int."""
@@ -124,6 +158,31 @@ class DbMetadataService:
             size_c=int(row["sizec"]),
             size_t=int(row["sizet"]),
         )
+
+    # ------------------------------------------------------ binary repo
+
+    async def resolve_image_paths(self, image_id: int) -> list:
+        """Repo-root-relative candidate paths for an image's pixel data.
+
+        OMERO 5 filesets resolve to their ManagedRepository files;
+        pre-FS images fall back to the legacy ``Pixels/<pixels_id>``
+        ROMIO file.  No ACL here — callers resolve paths only after
+        ``can_read`` has already gated the request (the reference's
+        resolver bean is likewise permission-blind).
+        """
+        out = []
+        for row in await self.db.fetch(_SQL_FILESET_FILES, image_id):
+            path = (row["path"] or "").strip("/")
+            name = (row["name"] or "").strip("/")
+            if not name:
+                continue
+            rel = f"{path}/{name}" if path else name
+            out.append(f"ManagedRepository/{rel}")
+        if not out:
+            row = await self.db.fetchrow(_SQL_PIXELS_ID, image_id)
+            if row is not None:
+                out.append(_romio_rel_path(int(row["id"])))
+        return out
 
     # --------------------------------------------------------------- ACL
 

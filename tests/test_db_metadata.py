@@ -54,7 +54,14 @@ CREATE TABLE groupexperimentermap (child INTEGER, parent INTEGER);
 CREATE TABLE session (
     id INTEGER PRIMARY KEY, uuid TEXT, owner INTEGER, closed TEXT);
 CREATE TABLE image (
-    id INTEGER PRIMARY KEY, owner_id INTEGER, group_id INTEGER);
+    id INTEGER PRIMARY KEY, owner_id INTEGER, group_id INTEGER,
+    fileset INTEGER);
+CREATE TABLE fileset (id INTEGER PRIMARY KEY, templateprefix TEXT);
+CREATE TABLE filesetentry (
+    id INTEGER PRIMARY KEY, fileset INTEGER, originalfile INTEGER,
+    clientpath TEXT);
+CREATE TABLE originalfile (
+    id INTEGER PRIMARY KEY, path TEXT, name TEXT, mimetype TEXT);
 CREATE TABLE pixelstype (id INTEGER PRIMARY KEY, value TEXT);
 CREATE TABLE pixels (
     id INTEGER PRIMARY KEY, image INTEGER, sizex INTEGER, sizey INTEGER,
@@ -98,16 +105,28 @@ def db():
          (4, "sess-root", 103, None),
          (5, "sess-closed", 100, "2026-01-01 00:00:00")])
     conn.executemany(
-        "INSERT INTO image VALUES (?, ?, ?)",
-        [(1, 100, 10),     # private image
-         (2, 100, 11),     # group-readable image
-         (3, 100, 12)])    # world-readable image
+        "INSERT INTO image VALUES (?, ?, ?, ?)",
+        [(1, 100, 10, None),   # private image
+         (2, 100, 11, None),   # group-readable image
+         (3, 100, 12, None),   # world-readable image
+         (4, 100, 12, 900),    # fileset-backed (ManagedRepository)
+         (5, 100, 12, None)])  # pre-FS (legacy Pixels file)
+    conn.execute("INSERT INTO fileset VALUES (900, 'demo_2/2026-07/31/')")
+    conn.executemany(
+        "INSERT INTO filesetentry VALUES (?, ?, ?, ?)",
+        [(1, 900, 800, "a.fake"), (2, 900, 801, "img.ome.tiff")])
+    conn.executemany(
+        "INSERT INTO originalfile VALUES (?, ?, ?, ?)",
+        [(800, "demo_2/2026-07/31/", "a.fake", "application/x-fake"),
+         (801, "demo_2/2026-07/31/", "img.ome.tiff", "image/tiff")])
     conn.execute("INSERT INTO pixelstype VALUES (1, 'uint16')")
     conn.execute("INSERT INTO pixelstype VALUES (2, 'uint8')")
     conn.executemany(
         "INSERT INTO pixels VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
         [(50, 1, 4096, 4096, 16, 4, 1, 1),
-         (51, 2, 512, 256, 1, 3, 1, 2)])
+         (51, 2, 512, 256, 1, 3, 1, 2),
+         (52, 4, 96, 64, 1, 2, 1, 1),
+         (53, 5, 48, 32, 2, 1, 1, 1)])
     conn.execute("INSERT INTO roi VALUES (7, 2)")
     # mask on the group-readable image; fillcolor = RGBA 0x00FF00FF
     conn.execute(
@@ -235,3 +254,133 @@ class TestHandlerIntegration:
             "sess-outsider")
         with pytest.raises(NotFoundError):
             run(handler.render_image_region(denied))
+
+
+class TestBinaryRepoResolution:
+    """Image -> repository path resolution (the file-path resolver bean,
+    ``beanRefContext.xml:13-16``; ``config.yaml:18-20`` omero.data.dir)."""
+
+    def test_fileset_image_resolves_managed_repo_paths(self, db):
+        svc = DbMetadataService(db)
+        paths = run(svc.resolve_image_paths(4))
+        assert paths == [
+            "ManagedRepository/demo_2/2026-07/31/a.fake",
+            "ManagedRepository/demo_2/2026-07/31/img.ome.tiff",
+        ]
+
+    def test_prefs_image_falls_back_to_pixels_file(self, db):
+        svc = DbMetadataService(db)
+        assert run(svc.resolve_image_paths(5)) == ["Pixels/53"]
+
+    def test_unknown_image_resolves_nothing(self, db):
+        svc = DbMetadataService(db)
+        assert run(svc.resolve_image_paths(999)) == []
+
+    @staticmethod
+    def _services(db, tmp_path, repo_root):
+        from omero_ms_image_region_tpu.io.service import PixelsService
+        from omero_ms_image_region_tpu.ops.lut import LutProvider
+        from omero_ms_image_region_tpu.server.handler import (
+            ImageRegionServices, Renderer)
+        from omero_ms_image_region_tpu.services.cache import (
+            CacheConfig, Caches)
+        from omero_ms_image_region_tpu.services.metadata import CanReadMemo
+
+        return ImageRegionServices(
+            pixels_service=PixelsService(str(tmp_path / "data"),
+                                         repo_root=str(repo_root)),
+            metadata=DbMetadataService(db),
+            caches=Caches.from_config(CacheConfig()),
+            can_read_memo=CanReadMemo(),
+            renderer=Renderer(),
+            lut_provider=LutProvider(),
+        )
+
+    def test_e2e_serves_from_managed_repository(self, db, tmp_path):
+        """A fileset image renders straight out of a mounted repository
+        tree, with zero re-arrangement into the data_dir layout."""
+        from omero_ms_image_region_tpu.io.tiffwrite import write_ome_tiff
+        from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+        from omero_ms_image_region_tpu.server.handler import (
+            ImageRegionHandler)
+
+        rng = np.random.default_rng(9)
+        planes = rng.integers(0, 60000, (2, 1, 64, 96)).astype(np.uint16)
+        repo = tmp_path / "OMERO"
+        d = repo / "ManagedRepository" / "demo_2" / "2026-07" / "31"
+        d.mkdir(parents=True)
+        write_ome_tiff(planes, str(d / "img.ome.tiff"), tile=(32, 32),
+                       n_levels=1)
+        (d / "a.fake").write_bytes(b"not pixel data")
+
+        handler = ImageRegionHandler(self._services(db, tmp_path, repo))
+        ctx = ImageRegionCtx.from_params(
+            {"imageId": "4", "theZ": "0", "theT": "0",
+             "region": "0,0,96,64", "m": "g", "c": "1|0:60000$FFFFFF",
+             "format": "png"},
+            "sess-owner")
+        body = run(handler.render_image_region(ctx))
+        assert body[:8] == b"\x89PNG\r\n\x1a\n"
+        from PIL import Image as PILImage
+        import io as _io
+        img = np.asarray(PILImage.open(_io.BytesIO(body)).convert("L"))
+        want = np.round(
+            planes[0, 0].astype(np.float64) / 60000 * 255
+        ).clip(0, 255).astype(np.uint8)
+        assert np.abs(img.astype(int) - want.astype(int)).max() <= 1
+
+    def test_e2e_serves_prefs_romio_file(self, db, tmp_path):
+        """A pre-FS image serves from the legacy big-endian
+        Pixels/<pixels_id> file."""
+        from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+        from omero_ms_image_region_tpu.server.handler import (
+            ImageRegionHandler)
+
+        rng = np.random.default_rng(10)
+        planes = rng.integers(0, 60000, (2, 32, 48)).astype(np.uint16)
+        repo = tmp_path / "OMERO"
+        (repo / "Pixels").mkdir(parents=True)
+        # ROMIO layout: big-endian planes, z fastest.
+        (repo / "Pixels" / "53").write_bytes(
+            planes.astype(">u2").tobytes())
+
+        handler = ImageRegionHandler(self._services(db, tmp_path, repo))
+        ctx = ImageRegionCtx.from_params(
+            {"imageId": "5", "theZ": "1", "theT": "0",
+             "region": "8,4,24,16", "m": "g", "c": "1|0:60000$FFFFFF",
+             "format": "png"},
+            "sess-owner")
+        body = run(handler.render_image_region(ctx))
+        from PIL import Image as PILImage
+        import io as _io
+        img = np.asarray(PILImage.open(_io.BytesIO(body)).convert("L"))
+        want = np.round(
+            planes[1, 4:20, 8:32].astype(np.float64) / 60000 * 255
+        ).clip(0, 255).astype(np.uint8)
+        assert np.abs(img.astype(int) - want.astype(int)).max() <= 1
+
+    def test_local_layout_still_wins(self, db, tmp_path):
+        """An image present in data_dir never consults the repository."""
+        from omero_ms_image_region_tpu.io.store import build_pyramid
+
+        rng = np.random.default_rng(11)
+        planes = rng.integers(0, 60000, (2, 1, 32, 32)).astype(np.uint16)
+        build_pyramid(planes, str(tmp_path / "data" / "4"), n_levels=1)
+        repo = tmp_path / "OMERO"
+        repo.mkdir()
+        svc = self._services(db, tmp_path, repo)
+        src = svc.pixels_service.get_pixel_source(4)
+        from omero_ms_image_region_tpu.io.store import ChunkedPyramidStore
+        assert isinstance(src, ChunkedPyramidStore)
+
+
+def test_romio_dir_fanout_paths():
+    """ids >= 1000 nest into Dir-### groups
+    (ome.io.nio.AbstractFileSystemService)."""
+    from omero_ms_image_region_tpu.services.db_metadata import (
+        _romio_rel_path)
+    assert _romio_rel_path(53) == "Pixels/53"
+    assert _romio_rel_path(999) == "Pixels/999"
+    assert _romio_rel_path(1234) == "Pixels/Dir-001/1234"
+    assert _romio_rel_path(1234567) == "Pixels/Dir-001/Dir-234/1234567"
+    assert _romio_rel_path(1000) == "Pixels/Dir-001/1000"

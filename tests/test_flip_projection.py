@@ -221,8 +221,8 @@ class TestProjectPlanes:
         calls = {"get_stack": 0}
         orig = PixelsService.get_pixel_source
 
-        def spying(self, image_id):
-            src = orig(self, image_id)
+        def spying(self, image_id, candidates=None, pixels=None):
+            src = orig(self, image_id, candidates, pixels)
             real = src.get_stack
 
             def counted(c, t):

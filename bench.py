@@ -245,6 +245,21 @@ def bench_flagship(rng):
         run_once([jax.device_put(r) for r in fresh], engine)
         cold_times.append(time.perf_counter() - t0)
     cold_tiles_per_sec = (B * n_batches) / min(cold_times)
+    # Overlap honesty: cold throughput expressed as staged bytes/s over
+    # the raw upload rate measured ADJACENT to the cold window (the
+    # startup upload_mb_s is minutes old by now and the tunnel swings
+    # 5-700 MB/s — a stale denominator would make the ratio
+    # meaningless).  ~1.0 = staging hides everything but the wire (the
+    # wire IS the floor); well below 1.0 = staging serializes against
+    # upload and double-buffering has room.
+    cold_bytes_per_sec = (B * n_batches * raw_batches[0][0].nbytes
+                          / min(cold_times))
+    probe_raw = raw_batches[0] ^ np.uint16(101)
+    t0 = time.perf_counter()
+    probe_dev = jax.device_put(probe_raw)
+    np.asarray(probe_dev.ravel()[:1])
+    cold_window_upload_mb_s = probe_raw.nbytes / 1e6 \
+        / (time.perf_counter() - t0)
 
     # The tunnel's dispatch+fetch round-trip floor, measured with a no-op
     # kernel: co-located hardware does not pay it, so single-tile latency
@@ -302,19 +317,46 @@ def bench_flagship(rng):
                           if measurable else None)
 
     # Interactive single-tile latency (warm, B=1): raw resident -> JPEG
-    # bytes on host.
+    # bytes on host.  BOTH wire engines measured — on a congested link
+    # the huffman wire's ~3.6x fewer bytes win the single-tile race too,
+    # and the adaptive engine (utils.adaptive) serves exactly that
+    # choice — with per-rep on-device content perturbation so a
+    # memoizing relay cannot serve cached dispatches.
     one = dev_raw[0][:1]
     one_args = tuple(a[:1] if getattr(a, "ndim", 0) else a
                      for a in args_suffix)
-    one_fetcher = SparseWireFetcher(H, W, cap)
-    lat = []
-    for _ in range(7):
-        t0 = time.perf_counter()
-        host = one_fetcher.fetch(render_to_jpeg_sparse(
-            one, *one_args, qy, qc, cap=cap))
-        encode_sparse_buffers(host, W, H, quality, cap)
-        lat.append((time.perf_counter() - t0) * 1000.0)
-    p50_tile_ms = statistics.median(lat[1:])
+    one_fetchers = {"sparse": SparseWireFetcher(H, W, cap),
+                    "huffman": HuffmanWireFetcher(H, W, cap, cap_words)}
+    perturb1 = jax.jit(lambda x, m: x ^ m)
+
+    def one_tile(x, eng):
+        if eng == "sparse":
+            host = one_fetchers[eng].fetch(render_to_jpeg_sparse(
+                x, *one_args, qy, qc, cap=cap))
+            encode_sparse_buffers(host, W, H, quality, cap)
+        else:
+            host = one_fetchers[eng].fetch(render_to_jpeg_huffman(
+                x, *one_args, qy, qc, *spec,
+                h16=H // 16, w16=W // 16, cap=cap,
+                cap_words=cap_words))
+            finish_huffman_batch(host, [(W, H)], H, W, quality, cap,
+                                 cap_words,
+                                 dense_fallback=lambda i:
+                                     dense_fallback(raw_batches[0], i))
+    p50_by_engine = {}
+    for ei, eng in enumerate(("sparse", "huffman")):
+        lat = []
+        for k in range(8):
+            fresh = perturb1(one, np.uint16(32 + k + 16 * ei))
+            np.asarray(fresh.ravel()[:1])   # land the perturbation
+            t0 = time.perf_counter()
+            one_tile(fresh, eng)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        # Reps 0-1 carry compile AND the fetcher's prefix-prediction
+        # warm-up (measured ~1.2 s vs ~0.2 s steady); the steady-state
+        # interactive latency is what the metric means.
+        p50_by_engine[eng] = statistics.median(lat[2:])
+    p50_tile_ms = min(p50_by_engine.values())
     p50_tile_ms_ex_rtt = max(0.0, p50_tile_ms - rtt_floor_ms)
 
     # CPU reference on identical tiles: render + PIL JPEG (libjpeg).
@@ -337,9 +379,13 @@ def bench_flagship(rng):
         "sparse_tiles_per_sec": results["sparse"][0],
         "huffman_tiles_per_sec": results["huffman"][0],
         "cold_tiles_per_sec": cold_tiles_per_sec,
+        "cold_overlap_efficiency": (cold_bytes_per_sec / 1e6
+                                    / cold_window_upload_mb_s),
         "p50_batch_ms": p50_batch_ms,
         "p50_tile_ms": p50_tile_ms,
         "p50_tile_ms_ex_rtt": p50_tile_ms_ex_rtt,
+        "p50_tile_ms_sparse": p50_by_engine["sparse"],
+        "p50_tile_ms_huffman": p50_by_engine["huffman"],
         "rtt_floor_ms": rtt_floor_ms,
         "cpu_tps": cpu_tps,
         "upload_mb_s": upload_mb_s,
@@ -601,30 +647,61 @@ def bench_config4(rng):
 # -------------------------------------------------------------- config 5
 
 def bench_config4_stream(rng):
-    """WSI-scale streamed Z-projection: 32-plane 1024^2 uint16 stack
-    projected plane-by-plane from HOST memory (the serving path for
-    stacks too large to materialize — ``project_planes``), projections/s
-    end to end including the streamed upload.  Fresh bytes per rep so
-    the relay cannot serve memoized uploads."""
+    """WSI-scale streamed Z-projection, 32-plane 1024^2 uint16 stack.
+
+    Cold: banded streaming from HOST memory (``project_region_banded``
+    — chunked [z, band, W] uploads + device folds), projections/s end
+    to end including the streamed upload; fresh bytes per rep so the
+    relay cannot serve memoized uploads.  Warm: the same banded fold
+    over DEVICE-resident planes (the HBM raw-cache serving case —
+    interactive re-projection after the stack is staged), with a
+    per-rep on-device XOR so content differs every rep.
+    """
+    import jax.numpy as jnp
+
     from omero_ms_image_region_tpu.models.rendering import Projection
-    from omero_ms_image_region_tpu.ops.projection import project_planes
+    from omero_ms_image_region_tpu.ops.projection import (
+        project_region_banded)
 
     base = rng.integers(0, 60000, size=(32, 1024, 1024)).astype(np.uint16)
 
-    def run(stack):
-        out = project_planes(lambda z: stack[z],
-                             Projection.MAXIMUM_INTENSITY,
-                             32, 0, 31, 1, 65535.0)
+    def run_cold(stack):
+        out = project_region_banded(
+            lambda z, y0, h: stack[z, y0:y0 + h],
+            Projection.MAXIMUM_INTENSITY, 32, 0, 31, 1, 65535.0,
+            plane_shape=(1024, 1024), band_rows=256, z_chunk=8)
         np.asarray(out.ravel()[:1])    # force the fold chain to land
 
-    run(base)                          # compile folds
-    times = []
+    run_cold(base)                     # compile folds + stitch
+    cold_times = []
     for rep in (1, 2):
         fresh = base ^ np.uint16(rep)
         t0 = time.perf_counter()
-        run(fresh)
-        times.append(time.perf_counter() - t0)
-    return 1.0 / min(times)
+        run_cold(fresh)
+        cold_times.append(time.perf_counter() - t0)
+
+    staged = jnp.asarray(base)         # one upload; stays in HBM
+    staged.block_until_ready()
+
+    def run_warm(rep):
+        stack = staged ^ jnp.uint16(rep)   # fresh content, zero upload
+        # Device-resident source: one sliced [z, band, W] chunk per
+        # fold dispatch (per-plane slicing would cost a dispatch per
+        # plane — ~150 round trips through the tunnel).
+        out = project_region_banded(
+            None, Projection.MAXIMUM_INTENSITY, 32, 0, 31, 1, 65535.0,
+            plane_shape=(1024, 1024), band_rows=512, z_chunk=32,
+            get_chunk=lambda zs, y0, h:
+                stack[zs[0]:zs[-1] + 1, y0:y0 + h])
+        np.asarray(out.ravel()[:1])
+
+    run_warm(0)                        # compile the device-slice path
+    warm_times = []
+    for rep in (1, 2):
+        t0 = time.perf_counter()
+        run_warm(rep + 1)
+        warm_times.append(time.perf_counter() - t0)
+    return 1.0 / min(cold_times), 1.0 / min(warm_times)
 
 
 def bench_config5(rng):
@@ -713,7 +790,7 @@ def main():
     c1_tpu, c1_cpu = bench_config1(rng)
     c2_planes, c2_cpu = bench_config2(rng)
     c4_projections, c4_cpu = bench_config4(rng)
-    c4_stream = bench_config4_stream(rng)
+    c4_stream, c4_stream_warm = bench_config4_stream(rng)
     c5_masks, c5_cpu = bench_config5(rng)
 
     print(json.dumps({
@@ -725,9 +802,15 @@ def main():
         "sparse_tiles_per_sec": round(flag["sparse_tiles_per_sec"], 2),
         "huffman_tiles_per_sec": round(flag["huffman_tiles_per_sec"], 2),
         "cold_tiles_per_sec": round(flag["cold_tiles_per_sec"], 2),
+        # staged-bytes/s over raw upload rate: ~1.0 = wire-bound (the
+        # staging hides everything but the link), <0.9 = overlap gap.
+        "cold_overlap_efficiency": round(
+            flag["cold_overlap_efficiency"], 2),
         "p50_batch_ms": round(flag["p50_batch_ms"], 2),
         "p50_tile_ms": round(flag["p50_tile_ms"], 2),
         "p50_tile_ms_ex_rtt": round(flag["p50_tile_ms_ex_rtt"], 2),
+        "p50_tile_ms_sparse": round(flag["p50_tile_ms_sparse"], 2),
+        "p50_tile_ms_huffman": round(flag["p50_tile_ms_huffman"], 2),
         "tunnel_rtt_floor_ms": round(flag["rtt_floor_ms"], 2),
         "cpu_ref_tiles_per_sec": round(flag["cpu_tps"], 2),
         "raw_upload_mb_per_sec": round(flag["upload_mb_s"], 1),
@@ -756,6 +839,8 @@ def main():
         "config2_cpu_ref_per_sec": round(c2_cpu, 2),
         "config4_zproj32_3ch_512_per_sec": round(c4_projections, 2),
         "config4_stream_zproj32_1024_per_sec": round(c4_stream, 2),
+        "config4_stream_zproj32_1024_warm_per_sec": round(
+            c4_stream_warm, 2),
         "config4_cpu_ref_per_sec": round(c4_cpu, 2),
         "config5_mask_overlay_512_per_sec": round(c5_masks, 2),
         "config5_cpu_ref_per_sec": round(c5_cpu, 2),

@@ -322,8 +322,12 @@ class SparseWireFetcher:
     def finish(self, handle) -> np.ndarray:
         """Complete a fetch: host u8[B, >=prefix] rows, decodable by
         the matching decoder."""
+        import time as _time
+
         pre, buf, k = handle
+        t0 = _time.perf_counter()
         host = np.asarray(pre)
+        _observe_fetch(host.nbytes, _time.perf_counter() - t0)
         needed = self._needed(host)
         mx = int(needed.max(initial=0))
         self._k = self._round(int(mx * self.headroom))
@@ -332,7 +336,9 @@ class SparseWireFetcher:
         # Under-predicted: complete ALL rows with one batched slice (a
         # per-row fetch would pay the link's latency floor B times).
         end = self._round(mx)
+        t0 = _time.perf_counter()
         rest = np.asarray(buf[:, k:end])
+        _observe_fetch(rest.nbytes, _time.perf_counter() - t0)
         return np.concatenate([host, rest], axis=1)
 
     def fetch(self, buf) -> np.ndarray:
@@ -341,6 +347,25 @@ class SparseWireFetcher:
 
 _FETCHERS: dict = {}
 _FETCHERS_LOCK = __import__("threading").Lock()
+
+# Optional wire-fetch observer: fn(nbytes, seconds), fed by the
+# fetchers so an adaptive engine controller (utils.adaptive) can track
+# the live device->host rate.  None = disabled (zero overhead).
+_FETCH_OBSERVER = None
+
+
+def set_fetch_observer(fn) -> None:
+    global _FETCH_OBSERVER
+    _FETCH_OBSERVER = fn
+
+
+def _observe_fetch(nbytes: int, seconds: float) -> None:
+    obs = _FETCH_OBSERVER
+    if obs is not None:
+        try:
+            obs(nbytes, seconds)
+        except Exception:   # pragma: no cover - observer bugs must not
+            pass            # break the serving path
 
 
 def wire_fetcher(H: int, W: int, cap: int) -> SparseWireFetcher:

@@ -135,6 +135,10 @@ class MeshRenderer(BatchingRenderer):
             # request schedule (see deploy/DEPLOY.md, driver process).
             import asyncio as _asyncio
             self._shared_slots = _asyncio.Semaphore(1)
+            # Host-local queue-pressure batch growth would launch
+            # program shapes the other processes never compile (SPMD);
+            # the pod serves the configured max_batch only.
+            self._growth_enabled = False
         self.mesh = mesh
         self.jpeg_engine = jpeg_engine
         import threading

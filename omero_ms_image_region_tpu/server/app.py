@@ -151,16 +151,31 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
     elif config.batcher.enabled:
         # config validation rejects bitpack in this posture.
         engine = config.renderer.jpeg_engine
+        controller = None
         if engine == "auto":
-            # Pick the wire engine for this deployment's actual link
-            # (sparse above ~12 MB/s device->host, huffman below).
-            from ..utils.linkprobe import resolve_auto_engine
-            engine = resolve_auto_engine()
+            # Startup probe picks the opening engine (sparse above
+            # ~12 MB/s device->host, huffman below); the controller
+            # then keeps the choice LIVE — per-fetch EWMA of the link
+            # rate, hysteresis flips, re-probe after idle — because
+            # tunnel links swing far past the crossover both ways.
+            from ..ops.jpegenc import set_fetch_observer
+            from ..utils.adaptive import AdaptiveEngine
+            from ..utils.linkprobe import measure_fetch_mb_s
+            try:
+                rate = measure_fetch_mb_s()
+            except Exception:
+                rate = None
+            controller = AdaptiveEngine(initial_rate_mb_s=rate)
+            set_fetch_observer(controller.observe_fetch)
+            engine = controller.engine
+            log.info("adaptive jpeg engine enabled (opening: %s)",
+                     engine)
         renderer = BatchingRenderer(
             max_batch=config.batcher.max_batch,
             linger_ms=config.batcher.linger_ms,
             jpeg_engine=engine,
-            pipeline_depth=config.batcher.pipeline_depth)
+            pipeline_depth=config.batcher.pipeline_depth,
+            engine_controller=controller)
     else:
         engine = config.renderer.jpeg_engine
         if engine == "auto":

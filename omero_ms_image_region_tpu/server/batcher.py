@@ -72,9 +72,15 @@ class BatchingRenderer:
     and resolves each request's future with its cropped result.
     """
 
+    # Consecutive full-batch dispatches that leave a backlog before the
+    # batch size doubles (larger groups amortize dispatch + wire
+    # round-trips under sustained load; each step compiles once).
+    GROW_STREAK = 4
+
     def __init__(self, max_batch: int = 8, linger_ms: float = 2.0,
                  buckets=DEFAULT_BUCKETS, jpeg_engine: str = "sparse",
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2, max_batch_limit: int = None,
+                 engine_controller=None):
         if jpeg_engine not in ("sparse", "huffman"):
             raise ValueError(
                 f"batched jpeg engine must be 'sparse' or 'huffman', "
@@ -82,8 +88,22 @@ class BatchingRenderer:
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self.max_batch = max_batch
+        # Queue-pressure growth ceiling: default 4x the configured size.
+        self.max_batch_limit = max(max_batch, max_batch_limit
+                                   or max_batch * 4)
+        # Per-bucket-key backlog streaks: one saturated key must not be
+        # reset by trickle traffic on another.
+        self._full_streaks: Dict[tuple, int] = {}
+        # Multi-host meshes must NOT grow from host-local timing: a
+        # host doubling alone would launch a sharded program shape the
+        # others never compile and hang the pod (MeshRenderer clears
+        # this when process_count > 1).
+        self._growth_enabled = True
         self.linger_ms = linger_ms
         self.jpeg_engine = jpeg_engine
+        # Live engine selection (utils.adaptive.AdaptiveEngine); None =
+        # startup-static jpeg_engine.
+        self.engine_controller = engine_controller
         self.pipeline_depth = pipeline_depth
         self.buckets = tuple(buckets)
         self._queues: Dict[tuple, Deque[_Pending]] = {}
@@ -214,8 +234,13 @@ class BatchingRenderer:
                 wakeup.clear()
                 await wakeup.wait()
             # Linger briefly so co-arriving tiles share the dispatch —
-            # but never linger when a full batch is already waiting.
-            if len(queue) < self.max_batch and self.linger_ms > 0:
+            # but never linger when a full batch is already waiting,
+            # and never for a lone request on an otherwise idle
+            # renderer (no queue behind it, nothing in flight): lingering
+            # there buys no coalescing and only taxes single-tile p50.
+            lone_idle = len(queue) == 1 and not self._inflight
+            if (len(queue) < self.max_batch and self.linger_ms > 0
+                    and not lone_idle):
                 await asyncio.sleep(self.linger_ms / 1000.0)
             await slots.acquire()
             # No awaits between popping the group and handing it to its
@@ -227,6 +252,19 @@ class BatchingRenderer:
             if not group:
                 slots.release()
                 continue
+            # Sustained backlog: full groups that still leave a queue
+            # mean the batch is the bottleneck — grow it (bounded).
+            if self._growth_enabled:
+                if len(group) == self.max_batch and queue:
+                    streak = self._full_streaks.get(key, 0) + 1
+                    if (streak >= self.GROW_STREAK
+                            and self.max_batch < self.max_batch_limit):
+                        self.max_batch = min(self.max_batch * 2,
+                                             self.max_batch_limit)
+                        streak = 0
+                    self._full_streaks[key] = streak
+                else:
+                    self._full_streaks[key] = 0
             render = (self._render_group_jpeg if key[0] == "jpeg"
                       else self._render_group)
             task = asyncio.create_task(
@@ -304,6 +342,13 @@ class BatchingRenderer:
         self._count_batch(n)
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
 
+    def _current_engine(self) -> str:
+        """This group's wire engine: the adaptive controller when one is
+        wired (jpeg-engine: auto), else the startup-static choice."""
+        if self.engine_controller is not None:
+            return self.engine_controller.current()
+        return self.jpeg_engine
+
     def _render_group_jpeg(self, group: List[_Pending]) -> List[bytes]:
         from ..ops.jpegenc import render_batch_to_jpeg
 
@@ -317,7 +362,7 @@ class BatchingRenderer:
                 s0["cd_start"], s0["cd_end"], stack("tables"),
                 quality=group[0].quality,
                 dims=[(p.w, p.h) for p in group],  # pad tiles skip encode
-                engine=self.jpeg_engine,
+                engine=self._current_engine(),
             )
         self._count_batch(n)
         return jpegs

@@ -206,6 +206,35 @@ class OmeTiffSource:
                 else:
                     full_pages.append(i)
                     self._page_levels[i] = []
+            if len(full_pages) > 1 and not any(
+                    self._page_levels[i] for i in full_pages):
+                # Aperio SVS-style layout: vendors historically flag
+                # NOTHING — page 0 is the tiled baseline, later TILED
+                # pages with strictly smaller dims are pyramid levels,
+                # and STRIPPED pages (thumbnail/label/macro) are
+                # associated images, not Z sections.  Only applied when
+                # page 0 is tiled and every other page fits the
+                # pattern; equal-size tiled pages (a real tiled Z
+                # stack) never match.
+                base = tf.ifds[full_pages[0]]
+                levels, associated, ok = [], 0, base.tiled
+                for i in full_pages[1:]:
+                    p = tf.ifds[i]
+                    smaller = (p.width < base.width
+                               and p.height < base.height)
+                    if p.tiled and smaller:
+                        levels.append(i)
+                    elif not p.tiled and smaller:
+                        associated += 1    # thumbnail/label/macro
+                    else:
+                        # Equal-size page (tiled or stripped): a
+                        # genuine Z section — no vendor layout here.
+                        ok = False
+                        break
+                if ok and (levels or associated):
+                    levels.sort(key=lambda i: -tf.ifds[i].width)
+                    full_pages = [full_pages[0]]
+                    self._page_levels = {full_pages[0]: levels}
             if spp > 1:
                 self.size_c = spp
                 self._interleaved_c = True

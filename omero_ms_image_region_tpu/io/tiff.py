@@ -210,6 +210,14 @@ def decode_segment(data: bytes, compression: int,
         return _lzw_decode(data)
     if compression == 32773:
         return _packbits_decode(data)
+    if compression == 6:
+        raise ValueError(
+            "old-style JPEG (TIFF compression 6) is not supported — "
+            "re-export with new-style JPEG (7) or a lossless codec")
+    if compression in (33003, 33005):
+        raise ValueError(
+            f"JPEG 2000 (Aperio compression {compression}) is not "
+            f"supported — convert to JPEG/LZW/deflate tiles")
     raise ValueError(f"unsupported TIFF compression {compression}")
 
 

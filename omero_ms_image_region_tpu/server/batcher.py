@@ -88,9 +88,13 @@ class BatchingRenderer:
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self.max_batch = max_batch
-        # Queue-pressure growth ceiling: default 4x the configured size.
+        # Queue-pressure growth ceiling: default 2x the configured
+        # size.  Measured on-chip (1024d 4-ch, v5e): both wire engines
+        # hold their per-tile exec rate at batch 16 but LOSE 20-30% at
+        # 32 (huffman 56->55->44 t/s, sparse 106->109->77), so growth
+        # past 2x trades wire-RTT amortization for worse exec.
         self.max_batch_limit = max(max_batch, max_batch_limit
-                                   or max_batch * 4)
+                                   or max_batch * 2)
         # Per-bucket-key backlog streaks: one saturated key must not be
         # reset by trickle traffic on another.
         self._full_streaks: Dict[tuple, int] = {}

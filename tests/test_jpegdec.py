@@ -349,3 +349,139 @@ def test_malformed_headers_raise_jpeg_error():
     for blob in cases:
         with pytest.raises(ValueError):
             decode_baseline_jpeg(blob)
+
+
+def test_svs_style_layout(tmp_path):
+    """Unflagged vendor layout (Aperio SVS): tiled baseline + smaller
+    tiled levels + stripped thumbnail/label pages — levels attach as a
+    pyramid, associated images are skipped, Z stays 1."""
+    arr = _smooth_rgb(288, 384)
+    d = tmp_path / "1"
+    os.makedirs(d)
+    path = str(d / "svs_like.tif")
+
+    def ent(tag, ftype, count, value):
+        return struct.pack("<HHI4s", tag, ftype, count, value)
+
+    s = lambda v: struct.pack("<HH", v, 0)
+    l = lambda v: struct.pack("<I", v)
+
+    pages = [
+        ("tiled", arr),                     # baseline
+        ("strip", arr[::8, ::8]),           # thumbnail (stripped)
+        ("tiled", arr[::2, ::2]),           # level 1
+        ("strip", arr[:40, :100]),          # label (stripped)
+    ]
+    out = bytearray(b"II" + struct.pack("<HI", 42, 8))
+    starts, ptrs = [], []
+    for kind, page in pages:
+        h, w = page.shape[:2]
+        if kind == "tiled":
+            th = h + (-h) % 16
+            tw = w + (-w) % 16
+            t = np.zeros((th, tw, 3), np.uint8)
+            t[:h, :w] = page
+            data = _jfif(np.ascontiguousarray(t), 95)
+            tags = [(256, s(w)), (257, s(h)), (259, s(7)),
+                    (262, s(6)), (277, s(3)),
+                    (322, s(tw)), (323, s(th))]
+            data_tags = [(324, None), (325, None)]
+        else:
+            data = np.ascontiguousarray(page).tobytes()
+            tags = [(256, s(w)), (257, s(h)), (259, s(1)),
+                    (262, s(2)), (277, s(3)), (278, s(h))]
+            data_tags = [(273, None), (279, None)]
+        n = len(tags) + len(data_tags) + 1     # +1 for BitsPerSample
+        ifd_off = len(out)
+        starts.append(ifd_off)
+        bps_off = ifd_off + 2 + n * 12 + 4
+        data_off = bps_off + 8
+        entries = []
+        all_tags = tags + [(258, l(bps_off)),
+                           (data_tags[0][0], l(data_off)),
+                           (data_tags[1][0], l(len(data)))]
+        for tag, val in sorted(all_tags):
+            ftype = 3 if len(val) == 4 and tag not in (
+                258, 273, 279, 324, 325) else (3 if tag == 258 else 4)
+            count = 3 if tag == 258 else 1
+            entries.append(ent(tag, ftype, count, val))
+        out += struct.pack("<H", n) + b"".join(entries)
+        ptrs.append(len(out))
+        out += l(0)
+        out += struct.pack("<HHH", 8, 8, 8) + b"\0\0"
+        out += data
+    for i, p in enumerate(ptrs[:-1]):
+        out[p:p + 4] = struct.pack("<I", starts[i + 1])
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+    src = OmeTiffSource(path)
+    assert (src.size_z, src.size_c) == (1, 3)
+    assert src.resolution_levels() == 2
+    assert src.resolution_descriptions() == [(384, 288), (192, 144)]
+    got = src.get_region(0, 0, 0, RegionDef(0, 0, 384, 288), 0)
+    assert np.abs(got.astype(int) - arr[:, :, 0].astype(int)).max() <= 8
+    lvl1 = src.get_region(0, 1, 0, RegionDef(0, 0, 192, 144), 1)
+    assert np.abs(lvl1.astype(int)
+                  - arr[::2, ::2, 1].astype(int)).max() <= 8
+    src.close()
+
+
+def test_svs_style_layout_without_levels(tmp_path):
+    """Tiled baseline + stripped associated images but NO tiled levels:
+    the associated pages still must not masquerade as Z sections."""
+    import omero_ms_image_region_tpu.io.ometiff as om
+
+    arr = _smooth_rgb(144, 192)
+    path = str(tmp_path / "flat_svs.tif")
+
+    def ent(tag, ftype, count, value):
+        return struct.pack("<HHI4s", tag, ftype, count, value)
+
+    s = lambda v: struct.pack("<HH", v, 0)
+    l = lambda v: struct.pack("<I", v)
+    out = bytearray(b"II" + struct.pack("<HI", 42, 8))
+    starts, ptrs = [], []
+    pages = [("tiled", arr), ("strip", arr[::4, ::4])]
+    for kind, page in pages:
+        h, w = page.shape[:2]
+        if kind == "tiled":
+            th, tw = h + (-h) % 16, w + (-w) % 16
+            t = np.zeros((th, tw, 3), np.uint8)
+            t[:h, :w] = page
+            data = _jfif(np.ascontiguousarray(t), 95)
+            tags = [(256, 3, s(w)), (257, 3, s(h)), (259, 3, s(7)),
+                    (262, 3, s(6)), (277, 3, s(3)), (322, 3, s(tw)),
+                    (323, 3, s(th))]
+            dt = [(324, 4), (325, 4)]
+        else:
+            data = np.ascontiguousarray(page).tobytes()
+            tags = [(256, 3, s(w)), (257, 3, s(h)), (259, 3, s(1)),
+                    (262, 3, s(2)), (277, 3, s(3)), (278, 3, s(h))]
+            dt = [(273, 4), (279, 4)]
+        n = len(tags) + 3
+        ifd_off = len(out)
+        starts.append(ifd_off)
+        bps_off = ifd_off + 2 + n * 12 + 4
+        data_off = bps_off + 8
+        all_tags = tags + [(258, 3, l(bps_off)),
+                           (dt[0][0], 4, l(data_off)),
+                           (dt[1][0], 4, l(len(data)))]
+        entries = [ent(tag, ftype, 3 if tag == 258 else 1, val)
+                   for tag, ftype, val in sorted(all_tags)]
+        out += struct.pack("<H", n) + b"".join(entries)
+        ptrs.append(len(out))
+        out += l(0)
+        out += struct.pack("<HHH", 8, 8, 8) + b"\0\0"
+        out += data
+    for i, p in enumerate(ptrs[:-1]):
+        out[p:p + 4] = struct.pack("<I", starts[i + 1])
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+    src = OmeTiffSource(path)
+    assert (src.size_z, src.size_c) == (1, 3)
+    assert src.resolution_levels() == 1
+    got = src.get_region(0, 2, 0, RegionDef(0, 0, 192, 144), 0)
+    assert np.abs(got.astype(int) - arr[:, :, 2].astype(int)).max() <= 8
+    src.close()

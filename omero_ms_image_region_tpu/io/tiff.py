@@ -11,7 +11,7 @@ directly; scope is exactly what serving needs:
 - tiled (322/323/324/325) and stripped (273/278/279) image data;
 - compression: none (1), LZW (5), new-style JPEG (7, baseline; tables
   from tag 347, via ``io/jpegdec``), deflate (8 / 32946), PackBits
-  (32773);
+  (32773), Aperio JPEG 2000 (33003/33005, via ``io/jp2k``);
 - horizontal-differencing predictor (317 = 2);
 - SubIFD chains (330) — OME-TIFF 6.0 stores pyramid levels there;
 - sample types: u8/u16/u32, i8/i16/i32, f32/f64 via 258/339.
@@ -215,9 +215,11 @@ def decode_segment(data: bytes, compression: int,
             "old-style JPEG (TIFF compression 6) is not supported — "
             "re-export with new-style JPEG (7) or a lossless codec")
     if compression in (33003, 33005):
+        # Array-path codec: handled in read_segment (io/jp2k.py), never
+        # through this bytes-level API.
         raise ValueError(
-            f"JPEG 2000 (Aperio compression {compression}) is not "
-            f"supported — convert to JPEG/LZW/deflate tiles")
+            f"JPEG 2000 segments (compression {compression}) decode "
+            f"via read_segment, not decode_segment")
     raise ValueError(f"unsupported TIFF compression {compression}")
 
 
@@ -395,6 +397,27 @@ class TiffFile:
                 f"{ifd.one(PLANAR_CONFIG)} (only chunky is supported)")
         if not ifd.tiled and gy == grid_y - 1:
             seg_h = ifd.height - gy * seg_h  # last strip may be short
+        if comp in (33003, 33005):
+            # Aperio JPEG 2000 tiles (raw J2K codestreams; 33003 =
+            # YCbCr planes, 33005 = RGB) — Bio-Formats reads these
+            # behind getPixelBuffer.  Pure-Python Tier-1: correct but
+            # slow; convert hot WSIs to JPEG/LZW tiles for serving.
+            from .jp2k import decode_tiff_jp2k
+            img = decode_tiff_jp2k(raw, comp,
+                                   int(ifd.one(PHOTOMETRIC, 1)))
+            if (img.shape[1] < seg_w
+                    or (ifd.tiled and img.shape[0] < seg_h)):
+                raise ValueError(
+                    f"{self.path}: JPEG2000 frame {img.shape[:2]} "
+                    f"smaller than segment {seg_h}x{seg_w}")
+            if not ifd.tiled:
+                seg_h = min(seg_h, img.shape[0])
+            if img.shape[-1] != spp:
+                raise ValueError(
+                    f"{self.path}: JPEG2000 components {img.shape[-1]}"
+                    f" != samples per pixel {spp}")
+            return np.ascontiguousarray(
+                img[:seg_h, :seg_w].astype(dt.newbyteorder("=")))
         if comp == 7:
             # New-style JPEG-in-TIFF (the SVS/WSI vendor-pyramid class;
             # Bio-Formats covers this behind getPixelBuffer).  The

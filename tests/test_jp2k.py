@@ -1,0 +1,331 @@
+"""JPEG 2000 decoder vs the openjpeg oracle (via PIL), plus the
+TIFF 33003/33005 (Aperio) integration and fuzz.
+
+Closes the last Bio-Formats format gap named in round-3's review: SVS
+and vendor WSI pyramids that store JPEG 2000 tiles.
+"""
+
+import io
+import os
+import struct
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from omero_ms_image_region_tpu.io.jp2k import (Jp2kError, decode_jp2k,
+                                               decode_tiff_jp2k)
+from omero_ms_image_region_tpu.io.ometiff import OmeTiffSource
+from omero_ms_image_region_tpu.io.tiff import TiffFile
+from omero_ms_image_region_tpu.server.region import RegionDef
+
+
+def _enc(img, **kw):
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "JPEG2000", **kw)
+    return buf.getvalue()
+
+
+def _oracle(data):
+    ref = np.asarray(Image.open(io.BytesIO(data)))
+    return ref[:, :, None] if ref.ndim == 2 else ref
+
+
+def _smooth_rgb(h, w):
+    yy, xx = np.mgrid[0:h, 0:w]
+    return np.stack([xx * 255 // max(w - 1, 1),
+                     yy * 255 // max(h - 1, 1),
+                     (xx + yy) * 255 // max(w + h - 2, 1)],
+                    -1).astype(np.uint8)
+
+
+# --------------------------------------------------------- codestreams
+
+class TestLossless:
+    """5/3 reversible streams must decode EXACTLY."""
+
+    @pytest.mark.parametrize("size", [(4, 4), (16, 16), (17, 13),
+                                      (64, 64), (33, 70)])
+    def test_gray_exact(self, size):
+        rng = np.random.default_rng(hash(size) % 1000)
+        a = rng.integers(0, 256, size, dtype=np.uint8)
+        got = decode_jp2k(_enc(a, irreversible=False))
+        np.testing.assert_array_equal(got[:, :, 0], a)
+
+    def test_rgb_rct_exact(self):
+        rng = np.random.default_rng(3)
+        a = rng.integers(0, 256, (48, 80, 3), dtype=np.uint8)
+        got = decode_jp2k(_enc(a, irreversible=False))
+        np.testing.assert_array_equal(got, a)
+
+    def test_quality_layers_exact(self):
+        rng = np.random.default_rng(4)
+        a = rng.integers(0, 256, (40, 40, 3), dtype=np.uint8)
+        got = decode_jp2k(_enc(a, irreversible=False,
+                               quality_layers=[40, 20, 0]))
+        np.testing.assert_array_equal(got, a)
+
+    def test_tiled_exact(self):
+        rng = np.random.default_rng(5)
+        a = rng.integers(0, 256, (48, 80, 3), dtype=np.uint8)
+        got = decode_jp2k(_enc(a, irreversible=False,
+                               tile_size=(32, 32)))
+        np.testing.assert_array_equal(got, a)
+
+    def test_explicit_precincts_exact(self):
+        rng = np.random.default_rng(6)
+        a = rng.integers(0, 256, (48, 80, 3), dtype=np.uint8)
+        got = decode_jp2k(_enc(a, irreversible=False,
+                               precinct_size=(64, 64)))
+        np.testing.assert_array_equal(got, a)
+
+    def test_small_codeblocks_exact(self):
+        rng = np.random.default_rng(7)
+        a = rng.integers(0, 256, (48, 80), dtype=np.uint8)
+        got = decode_jp2k(_enc(a, irreversible=False,
+                               codeblock_size=(16, 16)))
+        np.testing.assert_array_equal(got[:, :, 0], a)
+
+    def test_raw_j2k_codestream(self, tmp_path):
+        rng = np.random.default_rng(8)
+        a = rng.integers(0, 256, (32, 32), dtype=np.uint8)
+        path = str(tmp_path / "x.j2k")
+        Image.fromarray(a).save(path, irreversible=False)
+        data = open(path, "rb").read()
+        assert data[:2] == b"\xff\x4f"     # SOC, no JP2 wrapper
+        np.testing.assert_array_equal(
+            decode_jp2k(data)[:, :, 0], a)
+
+
+class TestLossy:
+    """9/7 irreversible streams must match openjpeg's own decode
+    within float rounding."""
+
+    def test_gray(self):
+        yy, xx = np.mgrid[0:64, 0:96]
+        a = (xx * 255 // 95).astype(np.uint8)
+        data = _enc(a, irreversible=True)
+        d = np.abs(decode_jp2k(data).astype(int) - _oracle(data))
+        assert d.max() <= 1
+
+    def test_rgb_ict(self):
+        data = _enc(_smooth_rgb(64, 96), irreversible=True)
+        d = np.abs(decode_jp2k(data).astype(int) - _oracle(data))
+        assert d.max() <= 1
+
+    def test_noise(self):
+        rng = np.random.default_rng(9)
+        a = rng.integers(0, 256, (40, 56, 3), dtype=np.uint8)
+        data = _enc(a, irreversible=True)
+        d = np.abs(decode_jp2k(data).astype(int) - _oracle(data))
+        assert d.max() <= 1
+
+    def test_rate_truncated(self):
+        data = _enc(_smooth_rgb(64, 96), irreversible=True,
+                    quality_layers=[30])
+        d = np.abs(decode_jp2k(data).astype(int) - _oracle(data))
+        assert d.max() <= 1
+
+    def test_tiles(self):
+        data = _enc(_smooth_rgb(64, 96), irreversible=True,
+                    tile_size=(32, 32))
+        d = np.abs(decode_jp2k(data).astype(int) - _oracle(data))
+        assert d.max() <= 1
+
+
+class TestProgressionOrders:
+    @pytest.mark.parametrize("order", ["LRCP", "RLCP", "RPCL",
+                                       "PCRL", "CPRL"])
+    def test_orders_decode_exactly(self, order):
+        rng = np.random.default_rng(10)
+        a = rng.integers(0, 256, (48, 64, 3), dtype=np.uint8)
+        got = decode_jp2k(_enc(a, irreversible=False,
+                               progression=order))
+        np.testing.assert_array_equal(got, a)
+
+
+class Test16Bit:
+    def test_uint16_lossless(self):
+        rng = np.random.default_rng(11)
+        a = rng.integers(0, 65535, (32, 40), dtype=np.uint16)
+        # PIL writes 16-bit via mode I;16
+        got = decode_jp2k(_enc(a, irreversible=False))
+        assert got.dtype == np.uint16
+        np.testing.assert_array_equal(got[:, :, 0], a)
+
+
+# --------------------------------------------------------------- fuzz
+
+class TestFuzz:
+    def test_truncations_fail_cleanly_or_degrade(self):
+        rng = np.random.default_rng(12)
+        a = rng.integers(0, 256, (32, 32), dtype=np.uint8)
+        data = _enc(a, irreversible=False)
+        for cut in (1, 2, 10, 40, len(data) // 2, len(data) - 4):
+            try:
+                out = decode_jp2k(data[:cut])
+            except (Jp2kError, ValueError):
+                continue
+            # JPEG 2000 is progressive: a truncated-but-parseable
+            # stream legitimately decodes to a degraded image.
+            assert out.shape == (32, 32, 1)
+
+    def test_garbage_fails_cleanly(self):
+        rng = np.random.default_rng(13)
+        for n in (0, 2, 16, 256):
+            blob = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            with pytest.raises((Jp2kError, ValueError)):
+                decode_jp2k(b"\xff\x4f\xff\x51" + blob)
+
+    def test_not_jp2k_rejected(self):
+        with pytest.raises(Jp2kError, match="not a JPEG 2000"):
+            decode_jp2k(b"II*\x00plainly-not")
+
+
+# ------------------------------------------------------- TIFF (Aperio)
+
+def _write_jp2k_tiff(path, arr, compression, tile=64, photometric=None,
+                     ycc=False):
+    """Tiled TIFF whose tile data are raw J2K codestreams (the Aperio
+    SVS layout for compressions 33003/33005)."""
+
+    def ent(tag, ftype, count, value):
+        return struct.pack("<HHI4s", tag, ftype, count, value)
+
+    s = lambda v: struct.pack("<HH", v, 0)
+    l = lambda v: struct.pack("<I", v)
+
+    h, w = arr.shape[:2]
+    ty, tx = -(-h // tile), -(-w // tile)
+    tiles = []
+    for gy in range(ty):
+        for gx in range(tx):
+            t = np.zeros((tile, tile, 3), np.uint8)
+            seg = arr[gy * tile:(gy + 1) * tile,
+                      gx * tile:(gx + 1) * tile]
+            t[:seg.shape[0], :seg.shape[1]] = seg
+            t[seg.shape[0]:] = t[max(seg.shape[0] - 1, 0)]
+            t[:, seg.shape[1]:] = t[:, max(seg.shape[1] - 1, 0):
+                                    seg.shape[1]]
+            if ycc:
+                # Store YCbCr planes, MCT off — the 33003 convention
+                # (BT.601 full range, the inverse of jpegdec's
+                # ycbcr_to_rgb).
+                f = t.astype(np.float32)
+                r_, g_, b_ = f[..., 0], f[..., 1], f[..., 2]
+                t = np.stack([
+                    0.299 * r_ + 0.587 * g_ + 0.114 * b_,
+                    128.0 - 0.168736 * r_ - 0.331264 * g_ + 0.5 * b_,
+                    128.0 + 0.5 * r_ - 0.418688 * g_ - 0.081312 * b_,
+                ], -1).round().clip(0, 255).astype(np.uint8)
+            # mct=0 keeps components as stored (PIL: mct only for RGB).
+            buf = io.BytesIO()
+            Image.fromarray(t).save(buf, "JPEG2000",
+                                    irreversible=False, mct=0)
+            from omero_ms_image_region_tpu.io.jp2k import \
+                _find_codestream
+            tiles.append(_find_codestream(buf.getvalue()))
+    n = 10
+    ifd_off = 8
+    bps_off = ifd_off + 2 + n * 12 + 4
+    ntiles = len(tiles)
+    toffs_off = bps_off + 8
+    tcnts_off = toffs_off + 4 * ntiles
+    data_off = tcnts_off + 4 * ntiles
+    offs, cnts, cur = [], [], data_off
+    for t in tiles:
+        offs.append(cur)
+        cnts.append(len(t))
+        cur += len(t)
+    entries = [
+        ent(256, 3, 1, s(w)), ent(257, 3, 1, s(h)),
+        ent(258, 3, 3, l(bps_off)), ent(259, 3, 1, s(compression)),
+        ent(262, 3, 1, s(6 if ycc else 2)), ent(277, 3, 1, s(3)),
+        ent(322, 3, 1, s(tile)), ent(323, 3, 1, s(tile)),
+        ent(324, 4, ntiles, l(toffs_off)),
+        ent(325, 4, ntiles, l(tcnts_off)),
+    ]
+    with open(path, "wb") as f:
+        f.write(b"II" + struct.pack("<HI", 42, 8))
+        f.write(struct.pack("<H", n) + b"".join(entries) + l(0))
+        f.write(struct.pack("<HHH", 8, 8, 8) + b"\0\0")
+        f.write(b"".join(l(o) for o in offs))
+        f.write(b"".join(l(c) for c in cnts))
+        for t in tiles:
+            f.write(t)
+
+
+def test_tiff_33005_rgb(tmp_path):
+    arr = _smooth_rgb(100, 150)
+    path = str(tmp_path / "a.tif")
+    _write_jp2k_tiff(path, arr, 33005, tile=64)
+    src = OmeTiffSource(path)
+    assert src.size_c == 3
+    for c in range(3):
+        got = src.get_region(0, c, 0, RegionDef(10, 20, 80, 60), 0)
+        # Lossless tiles: exact except replicated-edge padding crops.
+        np.testing.assert_array_equal(got, arr[20:80, 10:90, c])
+    src.close()
+
+
+def test_tiff_33003_ycbcr(tmp_path):
+    arr = _smooth_rgb(64, 96)
+    path = str(tmp_path / "y.tif")
+    _write_jp2k_tiff(path, arr, 33003, tile=64, ycc=True)
+    tf = TiffFile(path)
+    got = tf.read_segment(tf.ifds[0], 0, 0)   # first 64x64 tile
+    # YCbCr round trip (forward f32 + decode int) costs a little.
+    assert np.abs(got.astype(int)
+                  - arr[:64, :64].astype(int)).max() <= 3
+    tf.close()
+
+
+def test_tiff_jp2k_e2e(tmp_path):
+    """33005 tiles serve through the HTTP app."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from omero_ms_image_region_tpu.server.app import create_app
+    from omero_ms_image_region_tpu.server.config import AppConfig
+
+    arr = _smooth_rgb(128, 128)
+    d = tmp_path / "1"
+    os.makedirs(d)
+    _write_jp2k_tiff(str(d / "wsi.tif"), arr, 33005, tile=64)
+    config = AppConfig(data_dir=str(tmp_path))
+
+    async def fetch():
+        app = create_app(config)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(
+                "/webgateway/render_image_region/1/0/0"
+                "?region=0,0,128,128"
+                "&c=1|0:255$FF0000,2|0:255$00FF00,3|0:255$0000FF&m=c"
+                "&format=png")
+            assert r.status == 200
+            return await r.read()
+        finally:
+            await client.close()
+
+    body = asyncio.run(fetch())
+    png = np.asarray(Image.open(io.BytesIO(body)).convert("RGB"))
+    assert np.abs(png.astype(int) - arr.astype(int)).max() <= 1
+
+
+class TestMCT:
+    """Streams with the multiple-component transform ON (openjpeg CLI
+    default for RGB; PIL defaults mct=0, so these set it explicitly)."""
+
+    def test_rct_lossless_exact(self):
+        rng = np.random.default_rng(14)
+        a = rng.integers(0, 256, (40, 64, 3), dtype=np.uint8)
+        got = decode_jp2k(_enc(a, irreversible=False, mct=1))
+        np.testing.assert_array_equal(got, a)
+
+    def test_ict_lossy(self):
+        data = _enc(_smooth_rgb(64, 96), irreversible=True, mct=1)
+        d = np.abs(decode_jp2k(data).astype(int) - _oracle(data))
+        assert d.max() <= 1

@@ -364,6 +364,18 @@ class _Decoder:
             body = d[pos + 4:pos + 2 + seglen]
             if marker == _J2K_SIZ:
                 self._parse_siz(body)
+            elif marker in (_J2K_COD, _J2K_COC, _J2K_QCD, _J2K_QCC) \
+                    and in_tile is not None:
+                # Tile-part-local coding/quantization overrides are
+                # spec-legal but would need per-tile style state; the
+                # current decoder applies styles globally, so refusing
+                # is the only honest behavior (silently-global would
+                # decode OTHER tiles with the wrong tables).
+                raise Jp2kError(
+                    "tile-part-local COD/COC/QCD/QCC is not supported")
+            elif marker in (0xFF60, 0xFF61):    # PPM / PPT
+                raise Jp2kError(
+                    "packed packet headers (PPM/PPT) are not supported")
             elif marker == _J2K_COD:
                 self.cod = self._parse_cod(body)
             elif marker == _J2K_COC:
@@ -409,6 +421,11 @@ class _Decoder:
             raise Jp2kError("empty image grid")
         if self.xtsiz == 0 or self.ytsiz == 0:
             raise Jp2kError("zero tile size")
+        # Hostile/corrupt headers must not drive allocations or tile
+        # loops (same posture as the TIFF parser's count caps).
+        if (self.xsiz - self.xosiz) * (self.ysiz - self.yosiz) \
+                > (1 << 28):
+            raise Jp2kError("image area exceeds the 256M-sample cap")
         if len(b) < 36 + 3 * csiz:
             raise Jp2kError("truncated SIZ components")
         self.comps = []
@@ -421,6 +438,8 @@ class _Decoder:
                 dx=xr, dy=yr))
         self.ntx = _ceil_div(self.xsiz - self.xtosiz, self.xtsiz)
         self.nty = _ceil_div(self.ysiz - self.ytosiz, self.ytsiz)
+        if self.ntx * self.nty > 65536:
+            raise Jp2kError("tile grid exceeds the 65536-tile cap")
 
     def _parse_cod(self, b: bytes) -> _CodingStyle:
         if len(b) < 10:
@@ -474,9 +493,13 @@ class _Decoder:
             transform=sp[4])
         cs.sop = getattr(self.cod, "sop", False)
         cs.eph = getattr(self.cod, "eph", False)
+        if cs.cblk_w_exp + cs.cblk_h_exp > 12:
+            raise Jp2kError("code-block area > 4096")
         if cs.cblk_style & ~0x10:
             raise Jp2kError(
                 f"unsupported code-block style {cs.cblk_style:#x}")
+        if cs.transform not in (0, 1):
+            raise Jp2kError(f"unknown wavelet transform {cs.transform}")
         if scoc & 1:
             if len(sp) < 5 + cs.levels + 1:
                 raise Jp2kError("truncated COC precincts")
@@ -860,8 +883,7 @@ class _Decoder:
         NL = cod.levels
         # Decode every code-block into its band plane, then run the
         # inverse DWT over the multi-resolution layout.
-        full = np.zeros((cy1 - cy0, cx1 - cx0), np.float64)
-        # Band planes keyed by (level nb, orient).
+        # Band planes keyed by (resolution r, orient).
         planes: Dict[Tuple[int, int], np.ndarray] = {}
         for r in range(NL + 1):
             for band in res_bands[r]:
@@ -876,18 +898,18 @@ class _Decoder:
                     for cb in row:
                         if not cb.included or cb.passes == 0:
                             continue
-                        vals = _t1_decode(
+                        vals = _t1(
                             bytes(cb.data), cb.x1 - cb.x0,
                             cb.y1 - cb.y0, cb.passes,
                             Mb - cb.zero_planes, band.orient,
                             bool(cod.cblk_style & 0x10),
-                            half_at_zero=quant.style != 0)
+                            quant.style != 0)
                         arr[cb.y0 - band.y0:cb.y1 - band.y0,
                             cb.x0 - band.x0:cb.x1 - band.x0] = vals
                 step = self._band_step(ci, comp, quant, cod, r,
                                        band.orient)
                 planes[(r, band.orient)] = arr * step
-        return _inverse_dwt(planes, cod, cx0, cy0, cx1, cy1, full)
+        return _inverse_dwt(planes, cod, cx0, cy0, cx1, cy1)
 
     def _band_gain(self, orient: int) -> int:
         return {0: 0, 1: 1, 2: 1, 3: 2}[orient]
@@ -951,6 +973,18 @@ def _decode_npasses(reader) -> int:
 
 
 # ------------------------------------------------------------- Tier-1
+
+def _t1(data, w, h, npasses, msbs, orient, segsym, half_at_zero):
+    """Tier-1 dispatch: the native decoder when a toolchain built it
+    (~100x the Python loops — what makes JPEG2000 TIFFs servable),
+    else the pure-Python reference below (same LZW/JPEG pattern)."""
+    try:
+        from ..native import jp2k_t1_decode
+        return jp2k_t1_decode(data, w, h, npasses, msbs, orient,
+                              segsym, half_at_zero)
+    except ImportError:
+        return _t1_decode(data, w, h, npasses, msbs, orient, segsym,
+                          half_at_zero)
 
 # Zero-coding context tables per band class, indexed [h][v][d] with
 # h, v in 0..2 and d in 0..4 (clamped): T.800 Table D.1.
@@ -1138,8 +1172,7 @@ def _t1_decode(data: bytes, w: int, h: int, npasses: int, msbs: int,
 # --------------------------------------------------------- inverse DWT
 
 def _inverse_dwt(planes: Dict[Tuple[int, int], np.ndarray],
-                 cod: _CodingStyle, cx0, cy0, cx1, cy1,
-                 out: np.ndarray) -> np.ndarray:
+                 cod: _CodingStyle, cx0, cy0, cx1, cy1) -> np.ndarray:
     """Multi-level inverse DWT from band planes (T.800 F.3)."""
     NL = cod.levels
     ll = planes[(0, 0)]

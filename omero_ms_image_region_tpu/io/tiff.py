@@ -400,8 +400,8 @@ class TiffFile:
         if comp in (33003, 33005):
             # Aperio JPEG 2000 tiles (raw J2K codestreams; 33003 =
             # YCbCr planes, 33005 = RGB) — Bio-Formats reads these
-            # behind getPixelBuffer.  Pure-Python Tier-1: correct but
-            # slow; convert hot WSIs to JPEG/LZW tiles for serving.
+            # behind getPixelBuffer.  Tier-1 runs natively (C++) when
+            # a toolchain exists; pure-Python fallback otherwise.
             from .jp2k import decode_tiff_jp2k
             img = decode_tiff_jp2k(raw, comp,
                                    int(ifd.one(PHOTOMETRIC, 1)))
@@ -416,6 +416,13 @@ class TiffFile:
                 raise ValueError(
                     f"{self.path}: JPEG2000 components {img.shape[-1]}"
                     f" != samples per pixel {spp}")
+            if img.dtype.itemsize > dt.itemsize:
+                # A deeper codestream cast down would wrap mod 2^bits —
+                # a declaration mismatch must fail, not corrupt pixels.
+                raise ValueError(
+                    f"{self.path}: JPEG2000 sample depth "
+                    f"{img.dtype.itemsize * 8} exceeds declared "
+                    f"{dt.itemsize * 8}-bit samples")
             return np.ascontiguousarray(
                 img[:seg_h, :seg_w].astype(dt.newbyteorder("=")))
         if comp == 7:

@@ -26,6 +26,8 @@ _JPEG_SOURCE = os.path.join(_HERE, "jpegenc.cpp")
 _JPEG_LIB_PATH = os.path.join(_BUILD_DIR, "libjpegenc.so")
 _JPEGDEC_SOURCE = os.path.join(_HERE, "jpegdec.cpp")
 _JPEGDEC_LIB_PATH = os.path.join(_BUILD_DIR, "libjpegdec.so")
+_JP2KT1_SOURCE = os.path.join(_HERE, "jp2kt1.cpp")
+_JP2KT1_LIB_PATH = os.path.join(_BUILD_DIR, "libjp2kt1.so")
 _BUILD_LOCK = threading.Lock()
 
 
@@ -134,12 +136,24 @@ def _configure_jpegdec(lib: ctypes.CDLL) -> None:
     ]
 
 
+def _configure_jp2kt1(lib: ctypes.CDLL) -> None:
+    lib.jp2k_t1_decode.restype = ctypes.c_longlong
+    lib.jp2k_t1_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p,
+    ]
+
+
 _TILECACHE = _NativeLib(_SOURCE, _LIB_PATH, "native tilecache",
                         _configure_tilecache)
 _JPEGENC = _NativeLib(_JPEG_SOURCE, _JPEG_LIB_PATH,
                       "native jpeg encoder", _configure_jpegenc)
 _JPEGDEC = _NativeLib(_JPEGDEC_SOURCE, _JPEGDEC_LIB_PATH,
                       "native jpeg decoder", _configure_jpegdec)
+_JP2KT1 = _NativeLib(_JP2KT1_SOURCE, _JP2KT1_LIB_PATH,
+                     "native jpeg2000 tier-1", _configure_jp2kt1)
 
 
 def _load() -> ctypes.CDLL:
@@ -152,6 +166,26 @@ def _load_jpeg() -> ctypes.CDLL:
 
 def _load_jpegdec() -> ctypes.CDLL:
     return _JPEGDEC.load()
+
+
+def _load_jp2kt1() -> ctypes.CDLL:
+    return _JP2KT1.load()
+
+
+def jp2k_t1_decode(data: bytes, w: int, h: int, npasses: int,
+                   msbs: int, orient: int, segsym: bool,
+                   half_at_zero: bool):
+    """EBCOT Tier-1 decode of one code-block (native mirror of
+    ``io.jp2k._t1_decode``; GIL released for the whole block)."""
+    import numpy as np
+    lib = _load_jp2kt1()
+    out = np.zeros((h, w), np.float64)
+    rc = lib.jp2k_t1_decode(data, len(data), w, h, npasses, msbs,
+                            orient, int(segsym), int(half_at_zero),
+                            out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        raise ValueError("jp2k_t1_decode: invalid arguments")
+    return out
 
 
 class NativeLRUCache:

@@ -551,6 +551,9 @@ class _Decoder:
             # components when flagged.
             cod = self.cod
             if cod.mct and len(planes) >= 3:
+                if len({p.shape for p in planes[:3]}) != 1:
+                    raise Jp2kError(
+                        "MCT over subsampled components is not valid")
                 if cod.transform == 1:
                     planes[:3] = _inverse_rct(*planes[:3])
                 else:
@@ -580,8 +583,27 @@ class _Decoder:
                 dt = np.int16 if comp.signed else np.uint16
             final.append(a.astype(dt))
         if len({c.shape for c in final}) != 1:
-            raise Jp2kError("subsampled components are not supported "
-                            "for interleaved output")
+            # Subsampled chroma (Aperio 33003 writes 4:2:x YCbCr):
+            # replicate each component up to the full grid.  Smooth
+            # chroma makes pixel replication visually equivalent to
+            # interpolation at WSI viewing scales.
+            fh = max(c.shape[0] for c in final)
+            fw = max(c.shape[1] for c in final)
+            up = []
+            for ci, c in enumerate(final):
+                if c.shape[0] == 0 or c.shape[1] == 0:
+                    # A SIZ-valid but degenerate registration can give
+                    # a zero-size component grid; keep the hostile-
+                    # header contract (Jp2kError, never a raw crash).
+                    raise Jp2kError(
+                        f"component {ci} has an empty sample grid")
+                ry = _ceil_div(fh, c.shape[0])
+                rx = _ceil_div(fw, c.shape[1])
+                if ry > 1 or rx > 1:
+                    c = np.repeat(np.repeat(c, ry, axis=0), rx,
+                                  axis=1)[:fh, :fw]
+                up.append(c)
+            final = up
         return np.stack(final, axis=-1)
 
     def _decode_tile(self, t: int):

@@ -105,7 +105,8 @@ class MeshRenderer(BatchingRenderer):
 
     def __init__(self, mesh: Mesh, max_batch: int | None = None,
                  linger_ms: float = 2.0, buckets=None,
-                 jpeg_engine: str = "sparse", pipeline_depth: int = 2):
+                 jpeg_engine: str = "sparse", pipeline_depth: int = 2,
+                 max_batch_limit: int = None):
         data = mesh.shape["data"]
         if max_batch is None:
             max_batch = max(8, 2 * data)
@@ -125,7 +126,8 @@ class MeshRenderer(BatchingRenderer):
             pipeline_depth = 1
         kwargs = {} if buckets is None else {"buckets": buckets}
         super().__init__(max_batch=max_batch, linger_ms=linger_ms,
-                         pipeline_depth=pipeline_depth, **kwargs)
+                         pipeline_depth=pipeline_depth,
+                         max_batch_limit=max_batch_limit, **kwargs)
         if multihost:
             # One launch slot shared across ALL bucket keys: without it,
             # two keys' dispatchers would interleave sharded launches in

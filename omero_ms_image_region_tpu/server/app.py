@@ -145,6 +145,7 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
                  dict(mesh.shape), engine)
         renderer = MeshRenderer(
             mesh, max_batch=config.batcher.max_batch,
+            max_batch_limit=config.batcher.max_batch_limit,
             linger_ms=config.batcher.linger_ms,
             jpeg_engine=engine,
             pipeline_depth=config.batcher.pipeline_depth)
@@ -172,6 +173,7 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
                      engine)
         renderer = BatchingRenderer(
             max_batch=config.batcher.max_batch,
+            max_batch_limit=config.batcher.max_batch_limit,
             linger_ms=config.batcher.linger_ms,
             jpeg_engine=engine,
             pipeline_depth=config.batcher.pipeline_depth,

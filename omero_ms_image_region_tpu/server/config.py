@@ -22,6 +22,9 @@ from ..services.cache import CacheConfig
 class BatcherConfig:
     enabled: bool = True
     max_batch: int = 8
+    # Queue-pressure growth bound; None = 2x max_batch (measured
+    # on-chip: exec rates hold at batch 16, degrade past it).
+    max_batch_limit: Optional[int] = None
     linger_ms: float = 2.0
     # Concurrent group renders per bucket key: group k+1's device
     # dispatch overlaps group k's wire fetch + host entropy encode.
@@ -250,6 +253,9 @@ class AppConfig:
         cfg.batcher = BatcherConfig(
             enabled=bool(batcher.get("enabled", defaults.enabled)),
             max_batch=int(batcher.get("max-batch", defaults.max_batch)),
+            max_batch_limit=(int(batcher["max-batch-limit"])
+                             if batcher.get("max-batch-limit")
+                             is not None else None),
             linger_ms=float(batcher.get("linger-ms", defaults.linger_ms)),
             pipeline_depth=int(batcher.get("pipeline-depth",
                                            defaults.pipeline_depth)),

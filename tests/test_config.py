@@ -176,3 +176,11 @@ def test_bitpack_engine_rejected_in_batched_postures():
     with pytest.raises(ValueError, match="bitpack"):
         AppConfig.from_dict({**base, "batcher": {"enabled": False},
                              "parallel": {"enabled": True}})
+
+
+def test_max_batch_limit_parses():
+    from omero_ms_image_region_tpu.server.config import AppConfig
+
+    cfg = AppConfig.from_dict({"batcher": {"max-batch-limit": 16}})
+    assert cfg.batcher.max_batch_limit == 16
+    assert AppConfig.from_dict({}).batcher.max_batch_limit is None

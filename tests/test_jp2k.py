@@ -418,3 +418,46 @@ class TestHostileHeaders:
                    + spliced[sot + 10:])
         with pytest.raises(Jp2kError, match="tile-part-local"):
             decode_jp2k(spliced)
+
+
+def test_subsampled_components_upsample(monkeypatch):
+    """4:2:0-style subsampled chroma (Aperio 33003) replicates up to
+    the full grid instead of being rejected.  No encoder here can
+    write subsampled J2K, so the stream is synthesized by decoding a
+    full-res stream and shrinking the chroma components' registration
+    in SIZ is out of reach — instead exercise the interleave path
+    directly via the decoder internals."""
+    import omero_ms_image_region_tpu.io.jp2k as jp2k_mod
+
+    rng = np.random.default_rng(18)
+    a = rng.integers(0, 256, (32, 32, 3), dtype=np.uint8)
+    data = _enc(a, irreversible=False)
+    dec = jp2k_mod._Decoder(jp2k_mod._find_codestream(data))
+    # Pretend components 1/2 are 2x2-subsampled: halve their decoded
+    # planes; the interleave must replicate them back to full size.
+    orig = jp2k_mod._Decoder._decode_tile
+
+    def shrunk(self, t):
+        # The real codestream is full-resolution: decode it with the
+        # pristine grids, then present components 1/2 as if the stream
+        # had been 2x2-subsampled.
+        for c in self.comps:
+            c.dx = c.dy = 1
+        try:
+            planes = orig(self, t)
+        finally:
+            self.comps[1].dx = self.comps[1].dy = 2
+            self.comps[2].dx = self.comps[2].dy = 2
+        if planes is None:
+            return None
+        return [planes[0], planes[1][::2, ::2], planes[2][::2, ::2]]
+
+    monkeypatch.setattr(jp2k_mod._Decoder, "_decode_tile", shrunk)
+    # Subsampled grids the outer loop pastes into.
+    dec.comps[1].dx = dec.comps[1].dy = 2
+    dec.comps[2].dx = dec.comps[2].dy = 2
+    out = dec.decode()
+    assert out.shape == (32, 32, 3)
+    np.testing.assert_array_equal(out[:, :, 0], a[:, :, 0])
+    np.testing.assert_array_equal(out[::2, ::2, 1], a[::2, ::2, 1])
+    assert (out[1::2, ::2, 1] == out[::2, ::2, 1]).all()  # replicated

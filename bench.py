@@ -784,9 +784,21 @@ def main():
         if retry_tps is not None:
             service_tps = (retry_tps if service_tps is None
                            else max(service_tps, retry_tps))
+        # Link context for the service number: the huffman engine ships
+        # ~90 KB/tile, so service tiles/s is bounded by fetch_rate/0.09
+        # on congested windows — reporting the adjacent rate makes a
+        # weather-bound result readable as such.
+        try:
+            from omero_ms_image_region_tpu.utils.linkprobe import \
+                measure_fetch_mb_s
+            service_fetch_mb_s = measure_fetch_mb_s(nbytes=2 << 20,
+                                                    repeats=2)
+        except Exception:
+            service_fetch_mb_s = None
     except Exception:
         # App stack unavailable; library numbers stand.
         service_tps, service_engines = None, {}
+        service_fetch_mb_s = None
     c1_tpu, c1_cpu = bench_config1(rng)
     c2_planes, c2_cpu = bench_config2(rng)
     c4_projections, c4_cpu = bench_config4(rng)
@@ -832,6 +844,11 @@ def main():
             service_engines.get("sparse"), 1),
         "service_huffman_tiles_per_sec": _opt_round(
             service_engines.get("huffman"), 1),
+        # Device->host rate adjacent to the service windows: on
+        # congested links service tiles/s ~= this / 0.09 MB-per-tile
+        # (huffman wire), i.e. the wire, not the stack, is the bound.
+        "service_window_fetch_mb_per_sec": _opt_round(
+            service_fetch_mb_s, 1),
         "batch": 8,
         "config1_tile256_u8_per_sec": round(c1_tpu, 2),
         "config1_cpu_ref_per_sec": round(c1_cpu, 2),

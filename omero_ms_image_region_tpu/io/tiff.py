@@ -9,9 +9,10 @@ directly; scope is exactly what serving needs:
 
 - classic (magic 42) and BigTIFF (magic 43), both byte orders;
 - tiled (322/323/324/325) and stripped (273/278/279) image data;
-- compression: none (1), LZW (5), new-style JPEG (7, baseline; tables
-  from tag 347, via ``io/jpegdec``), deflate (8 / 32946), PackBits
-  (32773), Aperio JPEG 2000 (33003/33005, via ``io/jp2k``);
+- compression: none (1), old-style JPEG (6, interchange-format layout),
+  LZW (5), new-style JPEG (7, baseline; tables from tag 347, via
+  ``io/jpegdec``), deflate (8 / 32946), PackBits (32773), Aperio
+  JPEG 2000 (33003/33005, via ``io/jp2k``);
 - horizontal-differencing predictor (317 = 2);
 - SubIFD chains (330) — OME-TIFF 6.0 stores pyramid levels there;
 - sample types: u8/u16/u32, i8/i16/i32, f32/f64 via 258/339.
@@ -51,6 +52,8 @@ TILE_BYTE_COUNTS = 325
 SUB_IFDS = 330
 SAMPLE_FORMAT = 339
 JPEG_TABLES = 347
+JPEG_INTERCHANGE = 513          # old-style JPEG (compression 6)
+JPEG_INTERCHANGE_LEN = 514
 
 # field type -> (struct code, byte size); struct code None = opaque bytes
 _TYPES: Dict[int, Tuple[Optional[str], int]] = {
@@ -211,9 +214,11 @@ def decode_segment(data: bytes, compression: int,
     if compression == 32773:
         return _packbits_decode(data)
     if compression == 6:
+        # Array-path codec (interchange-format layout only); handled
+        # in read_segment, never through this bytes-level API.
         raise ValueError(
-            "old-style JPEG (TIFF compression 6) is not supported — "
-            "re-export with new-style JPEG (7) or a lossless codec")
+            "old-style JPEG segments (compression 6) decode via "
+            "read_segment, not decode_segment")
     if compression in (33003, 33005):
         # Array-path codec: handled in read_segment (io/jp2k.py), never
         # through this bytes-level API.
@@ -242,6 +247,9 @@ class TiffFile:
         # every tile of an IFD shares one tag-347 stream, so the Huffman
         # lookup tables build once per file, not once per tile.
         self._jpeg_tables_cache: Dict[bytes, object] = {}
+        # Decoded whole-image memo for old-style JPEG IFDs (keyed by
+        # IFD offset); see _old_jpeg_image.
+        self._old_jpeg_cache: Dict[int, np.ndarray] = {}
         try:
             self._parse_header_and_ifds(path)
         except BaseException:
@@ -383,18 +391,52 @@ class TiffFile:
         back at their true height.
         """
         seg_h, seg_w, grid_y, grid_x = self.segment_grid(ifd)
-        idx = gy * grid_x + gx
-        offsets = ifd.get(TILE_OFFSETS if ifd.tiled else STRIP_OFFSETS)
-        counts = ifd.get(TILE_BYTE_COUNTS if ifd.tiled
-                         else STRIP_BYTE_COUNTS)
-        raw = self._pread(int(offsets[idx]), int(counts[idx]))
         comp = int(ifd.one(COMPRESSION, 1))
-        dt = ifd.dtype().newbyteorder(self.endian)
         spp = int(ifd.one(SAMPLES_PER_PIXEL, 1))
         if spp > 1 and int(ifd.one(PLANAR_CONFIG, 1)) != 1:
             raise ValueError(
                 f"{self.path}: unsupported planar configuration "
                 f"{ifd.one(PLANAR_CONFIG)} (only chunky is supported)")
+        if comp == 6:
+            # Old-style JPEG, BEFORE the strip-offset read: the
+            # compression-6 layout stores its pointer in tags 513/514
+            # (one complete JFIF stream for the whole image), and real
+            # files often omit or garbage the 273/279 tags entirely.
+            # Only the interchange-format layout is supported; the
+            # deprecated per-strip tables variants stay rejected.
+            if ifd.tiled:
+                raise ValueError(
+                    f"{self.path}: tiled old-style JPEG is not "
+                    f"supported")
+            if not ifd.tiled and gy == grid_y - 1:
+                seg_h = ifd.height - gy * seg_h
+            off = ifd.one(JPEG_INTERCHANGE)
+            if off is None:
+                raise ValueError(
+                    f"{self.path}: old-style JPEG (compression 6) "
+                    f"without JPEGInterchangeFormat is not supported — "
+                    f"re-export with new-style JPEG (7)")
+            img = self._old_jpeg_image(ifd, int(off))
+            # One stream covers the whole image; slice this strip.
+            # (seg_h was already shortened for the last strip, so the
+            # row origin uses the nominal rows-per-strip.)
+            rps = min(int(ifd.one(ROWS_PER_STRIP, ifd.height)),
+                      ifd.height)
+            y0 = gy * rps
+            if img.shape[0] < y0 + seg_h and gy == grid_y - 1:
+                seg_h = max(0, img.shape[0] - y0)
+            if img.shape[-1] != spp:
+                raise ValueError(
+                    f"{self.path}: JPEG components {img.shape[-1]} != "
+                    f"samples per pixel {spp}")
+            return np.ascontiguousarray(
+                img[y0:y0 + seg_h, :seg_w])
+        idx = gy * grid_x + gx
+        offsets = ifd.get(TILE_OFFSETS if ifd.tiled else STRIP_OFFSETS)
+        counts = ifd.get(TILE_BYTE_COUNTS if ifd.tiled
+                         else STRIP_BYTE_COUNTS)
+        raw = self._pread(int(offsets[idx]), int(counts[idx]))
+        dt = ifd.dtype().newbyteorder(self.endian)
         if not ifd.tiled and gy == grid_y - 1:
             seg_h = ifd.height - gy * seg_h  # last strip may be short
         if comp in (33003, 33005):
@@ -475,6 +517,24 @@ class TiffFile:
         if int(ifd.one(PREDICTOR, 1)) == 2:
             arr = _undo_predictor(arr)
         return arr
+
+    def _old_jpeg_image(self, ifd: Ifd, off: int) -> np.ndarray:
+        """Decode (and memoize) the one interchange-format JFIF stream
+        a compression-6 IFD holds: per-strip reads would otherwise pay
+        a full-image decode EACH (an 8-row-strip scan would decode the
+        same stream hundreds of times)."""
+        from .jpegdec import decode_tiff_jpeg
+
+        cached = self._old_jpeg_cache.get(ifd.offset)
+        if cached is not None:
+            return cached
+        n = ifd.one(JPEG_INTERCHANGE_LEN)
+        jf = self._pread(off, int(n) if n else
+                         os.fstat(self._f.fileno()).st_size - off)
+        img = decode_tiff_jpeg(jf, None, int(ifd.one(PHOTOMETRIC, 1)),
+                               tables_cache=self._jpeg_tables_cache)
+        self._old_jpeg_cache[ifd.offset] = img
+        return img
 
     def close(self) -> None:
         self._f.close()

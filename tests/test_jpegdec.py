@@ -485,3 +485,143 @@ def test_svs_style_layout_without_levels(tmp_path):
     got = src.get_region(0, 2, 0, RegionDef(0, 0, 192, 144), 0)
     assert np.abs(got.astype(int) - arr[:, :, 2].astype(int)).max() <= 8
     src.close()
+
+
+def _write_old_jpeg_tiff(path, arr, rows_per_strip=None):
+    """Old-style JPEG (compression 6), interchange-format layout: tags
+    513/514 point at one complete JFIF stream for the whole image."""
+    jf = _jfif(arr, 95)
+    h, w = arr.shape[:2]
+    rps = rows_per_strip or h
+    nstrips = -(-h // rps)
+
+    def ent(tag, ftype, count, value):
+        return struct.pack("<HHI4s", tag, ftype, count, value)
+
+    s = lambda v: struct.pack("<HH", v, 0)
+    l = lambda v: struct.pack("<I", v)
+    n = 11
+    ifd_off = 8
+    bps_off = ifd_off + 2 + n * 12 + 4
+    arrs_off = bps_off + 8
+    if nstrips > 1:
+        soff_off = arrs_off
+        scnt_off = soff_off + 4 * nstrips
+        data_off = scnt_off + 4 * nstrips
+    else:
+        data_off = arrs_off
+    entries = [
+        ent(256, 3, 1, s(w)), ent(257, 3, 1, s(h)),
+        ent(258, 3, 3, l(bps_off)), ent(259, 3, 1, s(6)),
+        ent(262, 3, 1, s(6)), ent(277, 3, 1, s(3)),
+        ent(278, 3, 1, s(rps)),
+        # Strip offsets/counts are nominal (readers use 513/514).
+        (ent(273, 4, nstrips, l(soff_off)) if nstrips > 1
+         else ent(273, 4, 1, l(data_off))),
+        (ent(279, 4, nstrips, l(scnt_off)) if nstrips > 1
+         else ent(279, 4, 1, l(len(jf)))),
+        ent(513, 4, 1, l(data_off)),
+        ent(514, 4, 1, l(len(jf))),
+    ]
+    with open(path, "wb") as f:
+        f.write(b"II" + struct.pack("<HI", 42, 8))
+        f.write(struct.pack("<H", n) + b"".join(entries) + l(0))
+        f.write(struct.pack("<HHH", 8, 8, 8) + b"\0\0")
+        if nstrips > 1:
+            f.write(b"".join(l(data_off) for _ in range(nstrips)))
+            f.write(b"".join(l(len(jf)) for _ in range(nstrips)))
+        f.write(jf)
+
+
+def test_old_style_jpeg_interchange(tmp_path):
+    a = _smooth_rgb(90, 120)
+    path = str(tmp_path / "old.tif")
+    _write_old_jpeg_tiff(path, a)
+    src = OmeTiffSource(path)
+    got = src.get_region(0, 0, 0, RegionDef(0, 0, 120, 90), 0)
+    assert np.abs(got.astype(int) - a[:, :, 0].astype(int)).max() <= 8
+    src.close()
+
+
+def test_old_style_jpeg_multi_strip_slices(tmp_path):
+    a = _smooth_rgb(90, 120)
+    path = str(tmp_path / "old2.tif")
+    _write_old_jpeg_tiff(path, a, rows_per_strip=32)
+    tf = TiffFile(path)
+    seg = tf.read_segment(tf.ifds[0], 2, 0)    # rows 64..89 (short)
+    assert seg.shape == (26, 120, 3)
+    assert np.abs(seg.astype(int) - a[64:90].astype(int)).max() <= 8
+    tf.close()
+
+
+def test_old_style_jpeg_without_interchange_rejected(tmp_path):
+    a = _smooth_rgb(32, 32)
+    path = str(tmp_path / "old3.tif")
+    _write_old_jpeg_tiff(path, a)
+    # Strip tags 513/514 to simulate the unsupported tables variant.
+    data = bytearray(open(path, "rb").read())
+    n = struct.unpack("<H", data[8:10])[0]
+    for i in range(n):
+        off = 10 + i * 12
+        tag = struct.unpack("<H", data[off:off + 2])[0]
+        if tag in (513, 514):
+            struct.pack_into("<H", data, off, 60000 + tag)  # junk tag
+    open(path, "wb").write(bytes(data))
+    tf = TiffFile(path)
+    with pytest.raises(ValueError, match="JPEGInterchangeFormat"):
+        tf.read_segment(tf.ifds[0], 0, 0)
+    tf.close()
+
+
+def test_old_style_jpeg_missing_strip_tags(tmp_path):
+    """Real compression-6 files often omit 273/279 entirely (the
+    pointer lives in 513/514); they must still decode."""
+    a = _smooth_rgb(48, 64)
+    path = str(tmp_path / "old4.tif")
+    _write_old_jpeg_tiff(path, a)
+    data = bytearray(open(path, "rb").read())
+    n = struct.unpack("<H", data[8:10])[0]
+    for i in range(n):
+        off = 10 + i * 12
+        tag = struct.unpack("<H", data[off:off + 2])[0]
+        if tag in (273, 279):
+            struct.pack_into("<H", data, off, 60000 + tag)
+    open(path, "wb").write(bytes(data))
+    tf = TiffFile(path)
+    got = tf.read_segment(tf.ifds[0], 0, 0)
+    assert np.abs(got.astype(int) - a.astype(int)).max() <= 8
+    tf.close()
+
+
+def test_old_style_jpeg_decodes_once_per_ifd(tmp_path):
+    """Strip reads share ONE full-image decode (memoized per IFD)."""
+    import omero_ms_image_region_tpu.io.jpegdec as jd
+
+    a = _smooth_rgb(96, 64)
+    path = str(tmp_path / "old5.tif")
+    _write_old_jpeg_tiff(path, a, rows_per_strip=16)
+    calls = []
+    orig = jd.decode_baseline_jpeg
+
+    def spy(data, tables=None):
+        calls.append(1)
+        return orig(data, tables)
+
+    jd.decode_baseline_jpeg = spy
+    native_off = None
+    try:
+        # Force the python path so the spy sees the decode count.
+        import omero_ms_image_region_tpu.native as native
+        native_off = native.jpeg_decode_baseline
+        def _no_native(*a_, **k_):
+            raise ImportError("disabled for test")
+        native.jpeg_decode_baseline = _no_native
+        tf = TiffFile(path)
+        for gy in range(6):
+            tf.read_segment(tf.ifds[0], gy, 0)
+        tf.close()
+    finally:
+        jd.decode_baseline_jpeg = orig
+        if native_off is not None:
+            native.jpeg_decode_baseline = native_off
+    assert len(calls) == 1

@@ -423,8 +423,11 @@ class _Decoder:
             raise Jp2kError("zero tile size")
         # Hostile/corrupt headers must not drive allocations or tile
         # loops (same posture as the TIFF parser's count caps).
+        if csiz < 1 or csiz > 64:
+            raise Jp2kError(f"component count {csiz} exceeds the "
+                            f"64-component cap")
         if (self.xsiz - self.xosiz) * (self.ysiz - self.yosiz) \
-                > (1 << 28):
+                * csiz > (1 << 28):
             raise Jp2kError("image area exceeds the 256M-sample cap")
         if len(b) < 36 + 3 * csiz:
             raise Jp2kError("truncated SIZ components")
@@ -459,6 +462,11 @@ class _Decoder:
         cs.eph = bool(scod & 4)
         if cs.layers == 0:
             raise Jp2kError("zero quality layers")
+        if cs.layers > 4096:
+            # Spec allows 65535, but layers scale the packet walk per
+            # precinct; real encoders use a handful.
+            raise Jp2kError(f"{cs.layers} quality layers exceed the "
+                            f"4096-layer cap")
         if cs.cblk_w_exp + cs.cblk_h_exp > 12:
             raise Jp2kError("code-block area > 4096")
         # Styles we cannot decode: selective bypass (1), reset (2),
@@ -806,7 +814,9 @@ class _Decoder:
                 gx = ((rx0 >> ppx) + px) << (ppx + nb)
                 gy = ((ry0 >> ppy) + py) << (ppy + nb)
                 if (gy * comp.dy, gx * comp.dx) == p:
-                    for l in range(cod.layers):
+                    # Layers are SGcod-global (COD); a per-component
+                    # COC snapshot could predate COD in the header.
+                    for l in range(self.cod.layers):
                         yield (c, r, l, py * npx + px)
 
     def _read_packet(self, stream: bytes, pos: int, tile_bands,
@@ -1359,6 +1369,12 @@ def decode_tiff_jp2k(data: bytes, compression: int,
     out = dec.decode()
     wants_ycc = compression == 33003 or photometric == 6
     if wants_ycc and out.shape[-1] == 3 and not dec.cod.mct:
+        if out.dtype.itemsize != 1:
+            # ycbcr_to_rgb is 8-bit; clipping deeper data would serve
+            # silently saturated garbage.
+            raise Jp2kError(
+                f"{out.dtype.itemsize * 8}-bit YCbCr JPEG 2000 is not "
+                f"supported (8-bit only)")
         from .jpegdec import ycbcr_to_rgb
-        out = ycbcr_to_rgb(np.clip(out, 0, 255).astype(np.uint8))
+        out = ycbcr_to_rgb(out.astype(np.uint8))
     return out

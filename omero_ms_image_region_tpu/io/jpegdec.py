@@ -256,10 +256,21 @@ def _parse_segments(data: bytes, tables: _TableSet):
         elif marker == 0xC0 or marker == 0xC1:   # SOF0/1 (baseline)
             if len(body) < 6:
                 raise JpegError("truncated SOF")
+            if body[0] != 8:
+                # 12-bit extended sequential is legal JPEG but not this
+                # decoder's scope; decoding it as 8-bit would serve
+                # silently saturated garbage.
+                raise JpegError(
+                    f"unsupported sample precision {body[0]} "
+                    f"(baseline 8-bit only)")
             h, w = struct.unpack(">HH", body[1:5])
             ncomp = body[5]
             if not 1 <= ncomp <= 4 or len(body) < 6 + 3 * ncomp:
                 raise JpegError("truncated SOF components")
+            if h * w * ncomp > (1 << 28):
+                # Hostile headers must not drive allocations (a TIFF
+                # tile is orders of magnitude smaller).
+                raise JpegError("frame exceeds the 256M-sample cap")
             comps = []
             for ci in range(ncomp):
                 ident, hv, tq = body[6 + 3 * ci:9 + 3 * ci]
@@ -283,6 +294,13 @@ def _parse_segments(data: bytes, tables: _TableSet):
             ns = body[0]
             if not 1 <= ns <= 4 or len(body) < 1 + 2 * ns:
                 raise JpegError("truncated SOS components")
+            if ns != len(frame[2]):
+                # Non-interleaved multi-scan baseline files exist but
+                # this decoder walks one interleaved scan; misparsing
+                # the entropy stream would yield garbage, so fail loud.
+                raise JpegError(
+                    "non-interleaved (multi-scan) JPEG is not "
+                    "supported")
             sel = []
             for si in range(ns):
                 cs, tdta = body[1 + 2 * si:3 + 2 * si]
@@ -312,7 +330,8 @@ def _jpeg_error_contract(fn):
     def wrapped(*args, **kwargs):
         try:
             return fn(*args, **kwargs)
-        except (IndexError, struct.error, OverflowError) as e:
+        except (IndexError, struct.error, OverflowError,
+                MemoryError) as e:
             raise JpegError(f"malformed JPEG stream: {e}") from e
     return wrapped
 

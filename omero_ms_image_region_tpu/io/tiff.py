@@ -417,14 +417,19 @@ class TiffFile:
                     f"without JPEGInterchangeFormat is not supported — "
                     f"re-export with new-style JPEG (7)")
             img = self._old_jpeg_image(ifd, int(off))
-            # One stream covers the whole image; slice this strip.
-            # (seg_h was already shortened for the last strip, so the
-            # row origin uses the nominal rows-per-strip.)
+            # One stream covers the whole image; it must actually
+            # cover the declared geometry (the comp-7/JP2K paths make
+            # the same frame-vs-segment check).
+            if img.shape[1] < ifd.width or img.shape[0] < ifd.height:
+                raise ValueError(
+                    f"{self.path}: JPEG frame {img.shape[:2]} smaller "
+                    f"than declared {ifd.height}x{ifd.width}")
+            # Slice this strip.  (seg_h was already shortened for the
+            # last strip, so the row origin uses the nominal
+            # rows-per-strip.)
             rps = min(int(ifd.one(ROWS_PER_STRIP, ifd.height)),
                       ifd.height)
             y0 = gy * rps
-            if img.shape[0] < y0 + seg_h and gy == grid_y - 1:
-                seg_h = max(0, img.shape[0] - y0)
             if img.shape[-1] != spp:
                 raise ValueError(
                     f"{self.path}: JPEG components {img.shape[-1]} != "
@@ -528,6 +533,10 @@ class TiffFile:
         cached = self._old_jpeg_cache.get(ifd.offset)
         if cached is not None:
             return cached
+        # Bounded: one decoded image at a time (reads are sequential
+        # per IFD; an unbounded memo would pin every page's pixels for
+        # the file's lifetime).
+        self._old_jpeg_cache.clear()
         n = ifd.one(JPEG_INTERCHANGE_LEN)
         jf = self._pread(off, int(n) if n else
                          os.fstat(self._f.fileno()).st_size - off)

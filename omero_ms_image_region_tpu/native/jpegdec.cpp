@@ -206,10 +206,15 @@ size_t parse_segments(const uint8_t* data, size_t len, Tables& t,
       t.restart_interval = ((int)body[0] << 8) | body[1];
     } else if (marker == 0xC0 || marker == 0xC1) {  // SOF0/1
       if (blen < 6) return SIZE_MAX;
+      if (body[0] != 8) return SIZE_MAX;  // 8-bit baseline only
       f.h = ((int)body[1] << 8) | body[2];
       f.w = ((int)body[3] << 8) | body[4];
       f.ncomp = body[5];
       if (f.h == 0 || f.w == 0 || f.ncomp < 1 || f.ncomp > 4)
+        return SIZE_MAX;
+      // Hostile headers must not drive allocations (bad_alloc across
+      // the C ABI would terminate the process).
+      if ((int64_t)f.h * f.w * f.ncomp > ((int64_t)1 << 28))
         return SIZE_MAX;
       if (blen < 6 + 3 * (size_t)f.ncomp) return SIZE_MAX;
       for (int ci = 0; ci < f.ncomp; ++ci) {
@@ -232,6 +237,7 @@ size_t parse_segments(const uint8_t* data, size_t len, Tables& t,
       if (!f.present || blen < 1) return SIZE_MAX;
       int ns = body[0];
       if (ns < 1 || ns > 4 || blen < 1 + 2 * (size_t)ns) return SIZE_MAX;
+      if (ns != f.ncomp) return SIZE_MAX;  // non-interleaved multi-scan
       for (int si = 0; si < ns; ++si) {
         int cs = body[1 + 2 * si];
         int td = body[2 + 2 * si] >> 4, ta = body[2 + 2 * si] & 0xF;

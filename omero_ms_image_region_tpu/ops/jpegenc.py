@@ -327,7 +327,10 @@ class SparseWireFetcher:
         pre, buf, k = handle
         t0 = _time.perf_counter()
         host = np.asarray(pre)
-        _observe_fetch(host.nbytes, _time.perf_counter() - t0)
+        # Conflated: this wait covers the device render completing, not
+        # just the wire, so its rate is only a lower bound on the link.
+        _observe_fetch(host.nbytes, _time.perf_counter() - t0,
+                       conflated=True)
         needed = self._needed(host)
         mx = int(needed.max(initial=0))
         self._k = self._round(int(mx * self.headroom))
@@ -359,11 +362,16 @@ def set_fetch_observer(fn) -> None:
     _FETCH_OBSERVER = fn
 
 
-def _observe_fetch(nbytes: int, seconds: float) -> None:
+def _observe_fetch(nbytes: int, seconds: float,
+                   conflated: bool = False) -> None:
+    """``conflated``: the timed window synchronized on device EXECUTION
+    as well as the transfer (the first fetch of a dispatched program),
+    so bytes/seconds is a LOWER BOUND on the link rate, not a
+    measurement of it."""
     obs = _FETCH_OBSERVER
     if obs is not None:
         try:
-            obs(nbytes, seconds)
+            obs(nbytes, seconds, conflated)
         except Exception:   # pragma: no cover - observer bugs must not
             pass            # break the serving path
 

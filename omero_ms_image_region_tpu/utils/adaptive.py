@@ -74,9 +74,15 @@ class AdaptiveEngine:
             initial_engine = self._pick(initial_rate_mb_s, "sparse")
         self.engine = initial_engine
         self.switches = 0            # metrics / tests
+        self._suspect = 0
+        self._probe_due = False
         now = clock()
         self._last_observation = now
         self._last_probe = now
+
+    # Consecutive low conflated (compute-synced) readings before a real
+    # probe is forced; see observe_fetch.
+    SUSPECT_STREAK = 4
 
     # ------------------------------------------------------------ policy
 
@@ -114,18 +120,44 @@ class AdaptiveEngine:
 
     # ------------------------------------------------------------ inputs
 
-    def observe_fetch(self, nbytes: int, seconds: float) -> None:
+    def observe_fetch(self, nbytes: int, seconds: float,
+                      conflated: bool = False) -> None:
         """Feed one device->host wire fetch (called from the fetchers).
 
         Small fetches are ignored (latency-dominated); the timestamp
         still counts as activity so idle detection stays honest.
+
+        ``conflated`` samples timed device execution along with the
+        transfer, so their rate is only a LOWER BOUND on the link: a
+        high reading is real evidence (the link carried at least that),
+        but a low one cannot distinguish slow-link from slow-compute.
+        Low conflated readings therefore never feed the EWMA directly —
+        they accumulate suspicion that triggers a real probe on the
+        next :meth:`current` call instead.
         """
         now = self._clock()
         with self._lock:
             self._last_observation = now
             if nbytes < MIN_OBSERVATION_BYTES or seconds <= 0:
                 return
-            self._update(nbytes / 1e6 / seconds)
+            rate = nbytes / 1e6 / seconds
+            if conflated:
+                if rate >= self.crossover * (1.0 + self.hysteresis):
+                    # Lower bound already above the sparse band: safe
+                    # to count (the true rate is even higher).
+                    self._suspect = 0
+                    self._update(rate)
+                elif self.engine == "sparse":
+                    self._suspect += 1
+                    if self._suspect >= self.SUSPECT_STREAK:
+                        # Persistently low lower-bounds: force a real
+                        # probe at the next engine query.
+                        self._last_probe = (
+                            now - self.reprobe_interval_s)
+                        self._probe_due = True
+                return
+            self._suspect = 0
+            self._update(rate)
 
     def current(self) -> str:
         """The engine to use for the next group.
@@ -141,8 +173,11 @@ class AdaptiveEngine:
             stale = (self.engine == "huffman"
                      and (now - self._last_probe)
                      >= self.reprobe_interval_s)
-            if not (idle or stale):
+            suspect = self._probe_due
+            if not (idle or stale or suspect):
                 return self.engine
+            self._probe_due = False
+            self._suspect = 0
             self._last_probe = now
             self._last_observation = now
         try:

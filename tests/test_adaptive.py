@@ -98,13 +98,17 @@ class TestBatcherIntegration:
         from omero_ms_image_region_tpu.ops import jpegenc
 
         seen = []
-        jpegenc.set_fetch_observer(lambda n, s: seen.append((n, s)))
+        jpegenc.set_fetch_observer(
+            lambda n, s, c=False: seen.append((n, s, c)))
         try:
             f = jpegenc.SparseWireFetcher(256, 256, cap=1024)
             width = f.width
             buf = np.zeros((2, width), np.uint8)
             f.fetch(buf)
             assert seen and seen[0][0] > 0
+            # The first fetch of a dispatched program is flagged as
+            # compute-conflated (its rate is only a lower bound).
+            assert seen[0][2] is True
         finally:
             jpegenc.set_fetch_observer(None)
 
@@ -244,3 +248,38 @@ def test_mesh_multihost_disables_batch_growth(monkeypatch):
 def BatchingRendererForTest():
     from omero_ms_image_region_tpu.server.batcher import BatchingRenderer
     return BatchingRenderer(max_batch=2, linger_ms=0.0)
+
+
+class TestConflatedSamples:
+    def test_low_conflated_reading_never_flips_directly(self):
+        probes = []
+        ctrl = AdaptiveEngine(initial_rate_mb_s=100.0,
+                              probe=lambda: probes.append(1) or 3.0)
+        for _ in range(3):
+            ctrl.observe_fetch(*mb(2.0), conflated=True)
+        assert ctrl.engine == "sparse"       # no direct flip
+        assert ctrl.rate_mb_s == 100.0       # EWMA untouched
+
+    def test_suspicion_streak_forces_probe(self):
+        clock = FakeClock()
+        probes = []
+
+        def probe():
+            probes.append(clock.t)
+            return 3.0
+
+        ctrl = AdaptiveEngine(initial_rate_mb_s=100.0, probe=probe,
+                              clock=clock)
+        for _ in range(ctrl.SUSPECT_STREAK):
+            ctrl.observe_fetch(*mb(2.0), conflated=True)
+        assert ctrl.current() == "huffman"   # probe saw the real 3 MB/s
+        assert len(probes) == 1
+
+    def test_high_conflated_reading_counts(self):
+        ctrl = AdaptiveEngine(initial_rate_mb_s=3.0,
+                              probe=lambda: 3.0)
+        assert ctrl.engine == "huffman"
+        for _ in range(8):
+            # Lower bound 100 MB/s: the link carried at least that.
+            ctrl.observe_fetch(*mb(100.0), conflated=True)
+        assert ctrl.engine == "sparse"

@@ -461,3 +461,13 @@ def test_subsampled_components_upsample(monkeypatch):
     np.testing.assert_array_equal(out[:, :, 0], a[:, :, 0])
     np.testing.assert_array_equal(out[::2, ::2, 1], a[::2, ::2, 1])
     assert (out[1::2, ::2, 1] == out[::2, ::2, 1]).all()  # replicated
+
+
+def test_hostile_component_count_rejected():
+    siz = struct.pack(">HIIIIIIIIH", 0, 1000, 1000, 0, 0,
+                      1000, 1000, 0, 0, 100)
+    siz += bytes([7, 1, 1]) * 100
+    blob = (b"\xff\x4f" + b"\xff\x51"
+            + struct.pack(">H", 2 + len(siz)) + siz)
+    with pytest.raises(Jp2kError, match="component cap|64-component"):
+        decode_jp2k(blob)

@@ -625,3 +625,36 @@ def test_old_style_jpeg_decodes_once_per_ifd(tmp_path):
         if native_off is not None:
             native.jpeg_decode_baseline = native_off
     assert len(calls) == 1
+
+
+def test_hostile_sof_dimensions_rejected():
+    """Corrupt SOF claiming a huge frame must not drive allocations
+    (python and native agree)."""
+    sof = (b"\xff\xd8\xff\xc0\x00\x11\x08\xff\xff\xff\xff\x04"
+           + b"\x01\x22\x00\x02\x11\x00\x03\x11\x00\x04\x11\x00")
+    with pytest.raises(ValueError):
+        decode_baseline_jpeg(sof)
+    native = pytest.importorskip("omero_ms_image_region_tpu.native")
+    try:
+        native._load_jpegdec()
+    except ImportError:
+        pytest.skip("no toolchain")
+    with pytest.raises(ValueError):
+        native.jpeg_decode_baseline(sof, None)
+
+
+def test_twelve_bit_precision_rejected():
+    blob = bytearray(_jfif(_smooth_rgb(16, 16), 90))
+    i = blob.index(b"\xff\xc0")
+    blob[i + 4] = 12                    # SOF precision byte
+    with pytest.raises(ValueError, match="precision"):
+        decode_baseline_jpeg(bytes(blob))
+
+
+def test_multi_scan_rejected():
+    """ns != frame component count (non-interleaved baseline)."""
+    blob = bytearray(_jfif(_smooth_rgb(16, 16), 90))
+    i = blob.index(b"\xff\xda")
+    blob[i + 4] = 1                     # SOS ns: 3 -> 1 (len now lies,
+    with pytest.raises(ValueError):     # either check may fire first)
+        decode_baseline_jpeg(bytes(blob))

@@ -384,6 +384,106 @@ class TiffFile:
         rps = int(ifd.one(ROWS_PER_STRIP, ifd.height))
         return min(rps, ifd.height), ifd.width, -(-ifd.height // rps), 1
 
+    @staticmethod
+    def _check_frame(img: np.ndarray, seg_h: int, seg_w: int, spp: int,
+                     tiled: bool, path: str, codec: str) -> int:
+        """Shared frame-vs-segment contract for the array codecs (JPEG
+        variants, JPEG 2000): the decoded frame must cover the segment
+        width (and height, for tiles); only the last strip's height may
+        run short.  Returns the (possibly shortened) segment height."""
+        if img.shape[1] < seg_w or (tiled and img.shape[0] < seg_h):
+            raise ValueError(
+                f"{path}: {codec} frame {img.shape[:2]} smaller than "
+                f"segment {seg_h}x{seg_w}")
+        if img.shape[-1] != spp:
+            raise ValueError(
+                f"{path}: {codec} components {img.shape[-1]} != "
+                f"samples per pixel {spp}")
+        return seg_h if tiled else min(seg_h, img.shape[0])
+
+    def _read_old_jpeg_segment(self, ifd: Ifd, gy: int, seg_h: int,
+                               seg_w: int, spp: int) -> np.ndarray:
+        """Old-style JPEG (compression 6), interchange-format layout:
+        tags 513/514 point at ONE complete JFIF stream for the whole
+        image (real files often omit or garbage the 273/279 tags, so
+        this path never touches them).  The deprecated per-strip tables
+        variants stay rejected."""
+        if ifd.tiled:
+            raise ValueError(
+                f"{self.path}: tiled old-style JPEG is not supported")
+        off = ifd.one(JPEG_INTERCHANGE)
+        if off is None:
+            raise ValueError(
+                f"{self.path}: old-style JPEG (compression 6) without "
+                f"JPEGInterchangeFormat is not supported — re-export "
+                f"with new-style JPEG (7)")
+        img = self._old_jpeg_image(ifd, int(off))
+        # The one stream must cover the declared geometry.
+        if img.shape[1] < ifd.width or img.shape[0] < ifd.height:
+            raise ValueError(
+                f"{self.path}: JPEG frame {img.shape[:2]} smaller "
+                f"than declared {ifd.height}x{ifd.width}")
+        seg_h = self._check_frame(img, seg_h, seg_w, spp, False,
+                                  self.path, "JPEG")
+        # Slice this strip (seg_h was already shortened for the last
+        # strip, so the row origin uses the nominal rows-per-strip).
+        rps = min(int(ifd.one(ROWS_PER_STRIP, ifd.height)), ifd.height)
+        y0 = gy * rps
+        return np.ascontiguousarray(img[y0:y0 + seg_h, :seg_w])
+
+    def _read_jp2k_segment(self, ifd: Ifd, raw: bytes, comp: int,
+                           seg_h: int, seg_w: int, spp: int,
+                           dt: np.dtype) -> np.ndarray:
+        """Aperio JPEG 2000 tiles (raw J2K codestreams; 33003 = YCbCr
+        planes, 33005 = RGB) — Bio-Formats reads these behind
+        getPixelBuffer.  Tier-1 runs natively (C++) when a toolchain
+        exists; pure-Python fallback otherwise."""
+        from .jp2k import decode_tiff_jp2k
+        img = decode_tiff_jp2k(raw, comp, int(ifd.one(PHOTOMETRIC, 1)))
+        seg_h = self._check_frame(img, seg_h, seg_w, spp, ifd.tiled,
+                                  self.path, "JPEG2000")
+        if img.dtype.itemsize > dt.itemsize:
+            # A deeper codestream cast down would wrap mod 2^bits — a
+            # declaration mismatch must fail, not corrupt pixels.
+            raise ValueError(
+                f"{self.path}: JPEG2000 sample depth "
+                f"{img.dtype.itemsize * 8} exceeds declared "
+                f"{dt.itemsize * 8}-bit samples")
+        return np.ascontiguousarray(
+            img[:seg_h, :seg_w].astype(dt.newbyteorder("=")))
+
+    def _read_jpeg_segment(self, ifd: Ifd, raw: bytes, seg_h: int,
+                           seg_w: int, spp: int) -> np.ndarray:
+        """New-style JPEG-in-TIFF (compression 7, the SVS/WSI
+        vendor-pyramid class).  The abbreviated per-segment stream
+        carries its tables in tag 347; photometric 6 stores YCbCr and
+        converts to RGB."""
+        from .jpegdec import decode_tiff_jpeg
+        tables = ifd.get(JPEG_TABLES)
+        img = decode_tiff_jpeg(
+            raw, bytes(tables) if tables else None,
+            int(ifd.one(PHOTOMETRIC, 1)),
+            tables_cache=self._jpeg_tables_cache)
+        seg_h = self._check_frame(img, seg_h, seg_w, spp, ifd.tiled,
+                                  self.path, "JPEG")
+        return np.ascontiguousarray(img[:seg_h, :seg_w])
+
+    def _read_bilevel_segment(self, ifd: Ifd, raw: bytes, comp: int,
+                              seg_h: int, seg_w: int,
+                              spp: int) -> np.ndarray:
+        """Packed bilevel rows: each row starts on a byte boundary.
+        Expanded to uint8 0/1 with 1 = bright: WhiteIsZero files
+        (photometric 0, the CCITT-era default) are inverted so the
+        mask/render pipeline always sees set==foreground."""
+        bpr = (seg_w * spp + 7) // 8
+        data = decode_segment(raw, comp, seg_h * bpr)
+        rows = np.frombuffer(data, np.uint8,
+                             count=seg_h * bpr).reshape(seg_h, bpr)
+        arr = np.unpackbits(rows, axis=1)[:, :seg_w * spp]
+        if int(ifd.one(PHOTOMETRIC, 1)) == 0:
+            arr = 1 - arr
+        return np.ascontiguousarray(arr.reshape(seg_h, seg_w, spp))
+
     def read_segment(self, ifd: Ifd, gy: int, gx: int) -> np.ndarray:
         """Decode one tile/strip as [seg_h, seg_w, spp] in storage dtype.
 
@@ -397,121 +497,27 @@ class TiffFile:
             raise ValueError(
                 f"{self.path}: unsupported planar configuration "
                 f"{ifd.one(PLANAR_CONFIG)} (only chunky is supported)")
+        if not ifd.tiled and gy == grid_y - 1:
+            seg_h = ifd.height - gy * seg_h  # last strip may be short
         if comp == 6:
-            # Old-style JPEG, BEFORE the strip-offset read: the
-            # compression-6 layout stores its pointer in tags 513/514
-            # (one complete JFIF stream for the whole image), and real
-            # files often omit or garbage the 273/279 tags entirely.
-            # Only the interchange-format layout is supported; the
-            # deprecated per-strip tables variants stay rejected.
-            if ifd.tiled:
-                raise ValueError(
-                    f"{self.path}: tiled old-style JPEG is not "
-                    f"supported")
-            if not ifd.tiled and gy == grid_y - 1:
-                seg_h = ifd.height - gy * seg_h
-            off = ifd.one(JPEG_INTERCHANGE)
-            if off is None:
-                raise ValueError(
-                    f"{self.path}: old-style JPEG (compression 6) "
-                    f"without JPEGInterchangeFormat is not supported — "
-                    f"re-export with new-style JPEG (7)")
-            img = self._old_jpeg_image(ifd, int(off))
-            # One stream covers the whole image; it must actually
-            # cover the declared geometry (the comp-7/JP2K paths make
-            # the same frame-vs-segment check).
-            if img.shape[1] < ifd.width or img.shape[0] < ifd.height:
-                raise ValueError(
-                    f"{self.path}: JPEG frame {img.shape[:2]} smaller "
-                    f"than declared {ifd.height}x{ifd.width}")
-            # Slice this strip.  (seg_h was already shortened for the
-            # last strip, so the row origin uses the nominal
-            # rows-per-strip.)
-            rps = min(int(ifd.one(ROWS_PER_STRIP, ifd.height)),
-                      ifd.height)
-            y0 = gy * rps
-            if img.shape[-1] != spp:
-                raise ValueError(
-                    f"{self.path}: JPEG components {img.shape[-1]} != "
-                    f"samples per pixel {spp}")
-            return np.ascontiguousarray(
-                img[y0:y0 + seg_h, :seg_w])
+            # Handled BEFORE the strip-offset read: see
+            # _read_old_jpeg_segment.
+            return self._read_old_jpeg_segment(ifd, gy, seg_h, seg_w,
+                                               spp)
         idx = gy * grid_x + gx
         offsets = ifd.get(TILE_OFFSETS if ifd.tiled else STRIP_OFFSETS)
         counts = ifd.get(TILE_BYTE_COUNTS if ifd.tiled
                          else STRIP_BYTE_COUNTS)
         raw = self._pread(int(offsets[idx]), int(counts[idx]))
         dt = ifd.dtype().newbyteorder(self.endian)
-        if not ifd.tiled and gy == grid_y - 1:
-            seg_h = ifd.height - gy * seg_h  # last strip may be short
         if comp in (33003, 33005):
-            # Aperio JPEG 2000 tiles (raw J2K codestreams; 33003 =
-            # YCbCr planes, 33005 = RGB) — Bio-Formats reads these
-            # behind getPixelBuffer.  Tier-1 runs natively (C++) when
-            # a toolchain exists; pure-Python fallback otherwise.
-            from .jp2k import decode_tiff_jp2k
-            img = decode_tiff_jp2k(raw, comp,
-                                   int(ifd.one(PHOTOMETRIC, 1)))
-            if (img.shape[1] < seg_w
-                    or (ifd.tiled and img.shape[0] < seg_h)):
-                raise ValueError(
-                    f"{self.path}: JPEG2000 frame {img.shape[:2]} "
-                    f"smaller than segment {seg_h}x{seg_w}")
-            if not ifd.tiled:
-                seg_h = min(seg_h, img.shape[0])
-            if img.shape[-1] != spp:
-                raise ValueError(
-                    f"{self.path}: JPEG2000 components {img.shape[-1]}"
-                    f" != samples per pixel {spp}")
-            if img.dtype.itemsize > dt.itemsize:
-                # A deeper codestream cast down would wrap mod 2^bits —
-                # a declaration mismatch must fail, not corrupt pixels.
-                raise ValueError(
-                    f"{self.path}: JPEG2000 sample depth "
-                    f"{img.dtype.itemsize * 8} exceeds declared "
-                    f"{dt.itemsize * 8}-bit samples")
-            return np.ascontiguousarray(
-                img[:seg_h, :seg_w].astype(dt.newbyteorder("=")))
+            return self._read_jp2k_segment(ifd, raw, comp, seg_h,
+                                           seg_w, spp, dt)
         if comp == 7:
-            # New-style JPEG-in-TIFF (the SVS/WSI vendor-pyramid class;
-            # Bio-Formats covers this behind getPixelBuffer).  The
-            # abbreviated per-segment stream carries its tables in tag
-            # 347; photometric 6 stores YCbCr and converts to RGB.
-            from .jpegdec import decode_tiff_jpeg
-            tables = ifd.get(JPEG_TABLES)
-            img = decode_tiff_jpeg(
-                raw, bytes(tables) if tables else None,
-                int(ifd.one(PHOTOMETRIC, 1)),
-                tables_cache=self._jpeg_tables_cache)
-            if (img.shape[1] < seg_w
-                    or (ifd.tiled and img.shape[0] < seg_h)):
-                # Tile JPEGs must cover the full padded tile; strips
-                # must cover the width (only the last strip's height
-                # may legitimately be shorter, handled below).
-                raise ValueError(
-                    f"{self.path}: JPEG frame {img.shape[:2]} smaller "
-                    f"than segment {seg_h}x{seg_w}")
-            if not ifd.tiled:
-                seg_h = min(seg_h, img.shape[0])
-            if img.shape[-1] != spp:
-                raise ValueError(
-                    f"{self.path}: JPEG components {img.shape[-1]} != "
-                    f"samples per pixel {spp}")
-            return np.ascontiguousarray(img[:seg_h, :seg_w])
+            return self._read_jpeg_segment(ifd, raw, seg_h, seg_w, spp)
         if ifd.bits == 1:
-            # Packed bilevel rows: each row starts on a byte boundary.
-            # Expanded to uint8 0/1 with 1 = bright: WhiteIsZero files
-            # (photometric 0, the CCITT-era default) are inverted so
-            # the mask/render pipeline always sees set==foreground.
-            bpr = (seg_w * spp + 7) // 8
-            data = decode_segment(raw, comp, seg_h * bpr)
-            rows = np.frombuffer(data, np.uint8,
-                                 count=seg_h * bpr).reshape(seg_h, bpr)
-            arr = np.unpackbits(rows, axis=1)[:, :seg_w * spp]
-            if int(ifd.one(PHOTOMETRIC, 1)) == 0:
-                arr = 1 - arr
-            return np.ascontiguousarray(
-                arr.reshape(seg_h, seg_w, spp))
+            return self._read_bilevel_segment(ifd, raw, comp, seg_h,
+                                              seg_w, spp)
         data = decode_segment(raw, comp,
                               seg_h * seg_w * spp * dt.itemsize)
         arr = np.frombuffer(data, dtype=dt,

@@ -242,8 +242,12 @@ def _write_jp2k_tiff(path, arr, compression, tile=64, photometric=None,
         ent(258, 3, 3, l(bps_off)), ent(259, 3, 1, s(compression)),
         ent(262, 3, 1, s(6 if ycc else 2)), ent(277, 3, 1, s(3)),
         ent(322, 3, 1, s(tile)), ent(323, 3, 1, s(tile)),
-        ent(324, 4, ntiles, l(toffs_off)),
-        ent(325, 4, ntiles, l(tcnts_off)),
+        # Count-1 LONG values are INLINE in TIFF; only multi-tile
+        # arrays live out-of-line.
+        ent(324, 4, ntiles,
+            l(toffs_off) if ntiles > 1 else l(offs[0])),
+        ent(325, 4, ntiles,
+            l(tcnts_off) if ntiles > 1 else l(cnts[0])),
     ]
     with open(path, "wb") as f:
         f.write(b"II" + struct.pack("<HI", 42, 8))

@@ -548,3 +548,62 @@ def test_kitchen_sink_ome_tiff_sessions_projection(tmp_path):
             await client.close()
 
     assert split_bodies == asyncio.run(combined())
+
+
+def test_sidecar_serves_vendor_codec_images(data_dir, tmp_path):
+    """The process split composes with the vendor codec paths: a
+    JPEG 2000 (Aperio 33005) image and a JPEG-compressed (7) image
+    serve through a device-free frontend + render sidecar identically
+    to the combined process."""
+    import io as _io
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_jp2k import _write_jp2k_tiff
+    from test_jpegdec import _smooth_rgb
+
+    from PIL import Image as PILImage
+
+    arr = _smooth_rgb(96, 96)
+    os.makedirs(os.path.join(data_dir, "301"))
+    _write_jp2k_tiff(os.path.join(data_dir, "301", "a.tif"), arr,
+                     33005, tile=96)
+    os.makedirs(os.path.join(data_dir, "302"))
+    PILImage.fromarray(arr).save(
+        os.path.join(data_dir, "302", "b.tif"),
+        compression="jpeg", quality=95)
+
+    sock = str(tmp_path / "render.sock")
+    urls = [
+        "/webgateway/render_image_region/301/0/0?region=0,0,96,96"
+        "&c=1|0:255$FF0000,2|0:255$00FF00,3|0:255$0000FF&m=c"
+        "&format=png",
+        "/webgateway/render_image_region/302/0/0?region=0,0,96,96"
+        "&c=1|0:255$FF0000,2|0:255$00FF00,3|0:255$0000FF&m=c"
+        "&format=png",
+    ]
+
+    async def fetch(config):
+        app = create_app(config)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            out = []
+            for u in urls:
+                r = await client.get(u)
+                assert r.status == 200, (u, r.status)
+                out.append(await r.read())
+            return out
+        finally:
+            await client.close()
+
+    async def split():
+        return await _with_sidecar(
+            data_dir, sock,
+            lambda: fetch(_frontend_config(data_dir, sock)))
+
+    split_bodies = asyncio.run(split())
+    combined_bodies = asyncio.run(fetch(AppConfig(data_dir=data_dir)))
+    assert split_bodies == combined_bodies
+    png = np.asarray(PILImage.open(
+        _io.BytesIO(split_bodies[0])).convert("RGB"))
+    assert np.abs(png.astype(int) - arr.astype(int)).max() <= 1

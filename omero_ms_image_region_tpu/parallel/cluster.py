@@ -40,8 +40,13 @@ def initialize(coordinator_address: Optional[str] = None,
     single-process deployments: with no coordinator configured anywhere it
     leaves the process standalone.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    # Idempotency check WITHOUT touching the backend:
+    # jax.process_count() would initialize XLA, after which
+    # jax.distributed.initialize() permanently refuses — i.e. the old
+    # process_count() probe made every explicit multi-host join fail.
+    # (Caught by the 2-process simulated-pod test.)
+    if jax.distributed.is_initialized():
+        return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -1,0 +1,64 @@
+"""One process of a simulated 2-process multi-host pod (CPU backend).
+
+Launched by ``tests/test_multihost.py`` — NOT a pytest module.  Each
+process owns 4 virtual CPU devices; ``jax.distributed`` joins them into
+one 8-device slice and the mesh-sharded render step runs SPMD across
+both, exactly as a 2-host TPU pod would.  Prints one JSON line with
+per-process shard checksums (all-gathered, so the test can assert every
+process observed the same global result).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    coordinator = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import numpy as np
+
+    import jax
+    from omero_ms_image_region_tpu.flagship import flagship_rdef
+    from omero_ms_image_region_tpu.ops.render import pack_settings
+    from omero_ms_image_region_tpu.parallel import cluster
+    from omero_ms_image_region_tpu.parallel.mesh import (
+        render_step_sharded_batched, shard_batch_batched)
+
+    cluster.initialize(coordinator_address=coordinator,
+                       num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    mesh = cluster.global_mesh(chan_parallel=2)
+    rng = np.random.default_rng(0)     # same stream on both processes
+    B, C, H, W = 8, 4, 64, 64
+    raw = rng.uniform(0, 60000, (B, C, H, W)).astype(np.float32)
+    settings = pack_settings(flagship_rdef(C))
+    stacked = {
+        k: np.stack([settings[k]] * B)
+        for k in ("window_start", "window_end", "family",
+                  "coefficient", "reverse", "tables")
+    }
+    stacked["cd_start"] = settings["cd_start"]
+    stacked["cd_end"] = settings["cd_end"]
+    args = shard_batch_batched(mesh, raw, stacked)
+    out = render_step_sharded_batched(mesh)(*args)
+
+    from jax.experimental import multihost_utils
+    local_sum = np.float64(sum(
+        np.asarray(jax.device_get(s.data)).astype(np.float64).sum()
+        for s in out.addressable_shards))
+    sums = np.asarray(multihost_utils.process_allgather(local_sum))
+    print(json.dumps({"pid": pid, "ok": True,
+                      "shard_sums": [float(v) for v in sums.ravel()]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

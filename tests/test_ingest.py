@@ -71,3 +71,28 @@ def test_tiff_to_store_from_multi_file_set(tmp_path, capsys):
             assert np.array_equal(store.get_region(z, c, 0, full, 0),
                                   planes[c, z])
     store.close()
+
+
+def test_vendor_jp2k_tiff_converts_to_store(tmp_path, capsys):
+    """The documented hot-WSI workflow: an Aperio-style JPEG 2000 TIFF
+    converts to the chunked store via the ingest CLI and serves
+    pixel-identically from it (lossless tiles -> exact)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from vendor_tiff import smooth_rgb as _smooth_rgb
+    from vendor_tiff import write_jp2k_tiff as _write_jp2k_tiff
+
+    arr = _smooth_rgb(150, 200)
+    src_tiff = str(tmp_path / "wsi.tif")
+    _write_jp2k_tiff(src_tiff, arr, 33005, tile=64)
+
+    store_dir = str(tmp_path / "9")
+    assert main(["tiff-to-store", src_tiff, store_dir,
+                 "--tile", "64"]) == 0
+    store = ChunkedPyramidStore(store_dir)
+    for c in range(3):
+        got = store.get_region(0, c, 0, RegionDef(0, 0, 200, 150), 0)
+        np.testing.assert_array_equal(got, arr[:, :, c])
+    store.close()

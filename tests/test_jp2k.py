@@ -31,12 +31,8 @@ def _oracle(data):
     return ref[:, :, None] if ref.ndim == 2 else ref
 
 
-def _smooth_rgb(h, w):
-    yy, xx = np.mgrid[0:h, 0:w]
-    return np.stack([xx * 255 // max(w - 1, 1),
-                     yy * 255 // max(h - 1, 1),
-                     (xx + yy) * 255 // max(w + h - 2, 1)],
-                    -1).astype(np.uint8)
+from vendor_tiff import smooth_rgb as _smooth_rgb  # noqa: E402
+from vendor_tiff import write_jp2k_tiff as _write_jp2k_tiff  # noqa: E402
 
 
 # --------------------------------------------------------- codestreams
@@ -183,81 +179,6 @@ class TestFuzz:
 
 
 # ------------------------------------------------------- TIFF (Aperio)
-
-def _write_jp2k_tiff(path, arr, compression, tile=64, photometric=None,
-                     ycc=False):
-    """Tiled TIFF whose tile data are raw J2K codestreams (the Aperio
-    SVS layout for compressions 33003/33005)."""
-
-    def ent(tag, ftype, count, value):
-        return struct.pack("<HHI4s", tag, ftype, count, value)
-
-    s = lambda v: struct.pack("<HH", v, 0)
-    l = lambda v: struct.pack("<I", v)
-
-    h, w = arr.shape[:2]
-    ty, tx = -(-h // tile), -(-w // tile)
-    tiles = []
-    for gy in range(ty):
-        for gx in range(tx):
-            t = np.zeros((tile, tile, 3), np.uint8)
-            seg = arr[gy * tile:(gy + 1) * tile,
-                      gx * tile:(gx + 1) * tile]
-            t[:seg.shape[0], :seg.shape[1]] = seg
-            t[seg.shape[0]:] = t[max(seg.shape[0] - 1, 0)]
-            t[:, seg.shape[1]:] = t[:, max(seg.shape[1] - 1, 0):
-                                    seg.shape[1]]
-            if ycc:
-                # Store YCbCr planes, MCT off — the 33003 convention
-                # (BT.601 full range, the inverse of jpegdec's
-                # ycbcr_to_rgb).
-                f = t.astype(np.float32)
-                r_, g_, b_ = f[..., 0], f[..., 1], f[..., 2]
-                t = np.stack([
-                    0.299 * r_ + 0.587 * g_ + 0.114 * b_,
-                    128.0 - 0.168736 * r_ - 0.331264 * g_ + 0.5 * b_,
-                    128.0 + 0.5 * r_ - 0.418688 * g_ - 0.081312 * b_,
-                ], -1).round().clip(0, 255).astype(np.uint8)
-            # mct=0 keeps components as stored (PIL: mct only for RGB).
-            buf = io.BytesIO()
-            Image.fromarray(t).save(buf, "JPEG2000",
-                                    irreversible=False, mct=0)
-            from omero_ms_image_region_tpu.io.jp2k import \
-                _find_codestream
-            tiles.append(_find_codestream(buf.getvalue()))
-    n = 10
-    ifd_off = 8
-    bps_off = ifd_off + 2 + n * 12 + 4
-    ntiles = len(tiles)
-    toffs_off = bps_off + 8
-    tcnts_off = toffs_off + 4 * ntiles
-    data_off = tcnts_off + 4 * ntiles
-    offs, cnts, cur = [], [], data_off
-    for t in tiles:
-        offs.append(cur)
-        cnts.append(len(t))
-        cur += len(t)
-    entries = [
-        ent(256, 3, 1, s(w)), ent(257, 3, 1, s(h)),
-        ent(258, 3, 3, l(bps_off)), ent(259, 3, 1, s(compression)),
-        ent(262, 3, 1, s(6 if ycc else 2)), ent(277, 3, 1, s(3)),
-        ent(322, 3, 1, s(tile)), ent(323, 3, 1, s(tile)),
-        # Count-1 LONG values are INLINE in TIFF; only multi-tile
-        # arrays live out-of-line.
-        ent(324, 4, ntiles,
-            l(toffs_off) if ntiles > 1 else l(offs[0])),
-        ent(325, 4, ntiles,
-            l(tcnts_off) if ntiles > 1 else l(cnts[0])),
-    ]
-    with open(path, "wb") as f:
-        f.write(b"II" + struct.pack("<HI", 42, 8))
-        f.write(struct.pack("<H", n) + b"".join(entries) + l(0))
-        f.write(struct.pack("<HHH", 8, 8, 8) + b"\0\0")
-        f.write(b"".join(l(o) for o in offs))
-        f.write(b"".join(l(c) for c in cnts))
-        for t in tiles:
-            f.write(t)
-
 
 def test_tiff_33005_rgb(tmp_path):
     arr = _smooth_rgb(100, 150)

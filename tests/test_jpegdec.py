@@ -24,15 +24,7 @@ from omero_ms_image_region_tpu.io.tiff import TiffFile
 from omero_ms_image_region_tpu.server.region import RegionDef
 
 
-def _smooth_rgb(h, w):
-    # No wrap-around edges: modulo gradients put step discontinuities
-    # in the chroma planes, where decoder upsampling choices diverge.
-    yy, xx = np.mgrid[0:h, 0:w]
-    return np.stack([
-        xx * 255.0 / max(w - 1, 1),
-        yy * 255.0 / max(h - 1, 1),
-        (xx + yy) * 255.0 / max(w + h - 2, 1),
-    ], -1).astype(np.uint8)
+from vendor_tiff import smooth_rgb as _smooth_rgb  # noqa: E402
 
 
 def _jfif(arr, quality=90):

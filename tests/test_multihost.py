@@ -1,0 +1,60 @@
+"""True multi-process pod simulation: 2 OS processes x 4 virtual CPU
+devices join via ``jax.distributed`` and run the mesh-sharded render
+step SPMD — the closest this environment gets to a real 2-host TPU pod
+(the 8-device single-process tests cannot catch per-process divergence
+or a broken cluster join).
+
+Regression anchor: ``cluster.initialize`` used to probe
+``jax.process_count()`` first, which initialized the XLA backend and
+made every explicit multi-host join fail with "initialize() must be
+called before any JAX calls".
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_pod_renders_in_lockstep():
+    # (Hang protection is the communicate(timeout=240) below —
+    # pytest-timeout is not shipped in this image.)
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_worker.py")
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                        "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen([sys.executable, worker, str(pid), coordinator],
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, env=env, text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    assert all(o["ok"] for o in outs)
+    # Every process observed the same all-gathered shard checksums —
+    # the SPMD launch sequences stayed in lockstep and the global
+    # result is consistent across hosts.
+    assert outs[0]["shard_sums"] == outs[1]["shard_sums"]
+    assert len(outs[0]["shard_sums"]) == 2
+    assert all(np.isfinite(outs[0]["shard_sums"]))

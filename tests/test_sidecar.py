@@ -558,8 +558,8 @@ def test_sidecar_serves_vendor_codec_images(data_dir, tmp_path):
     import io as _io
 
     sys.path.insert(0, os.path.dirname(__file__))
-    from test_jp2k import _write_jp2k_tiff
-    from test_jpegdec import _smooth_rgb
+    from vendor_tiff import smooth_rgb as _smooth_rgb
+    from vendor_tiff import write_jp2k_tiff as _write_jp2k_tiff
 
     from PIL import Image as PILImage
 

@@ -279,13 +279,28 @@ def _composite_step_batched(raw, window_start, window_end, family,
     return rgb[0] | (rgb[1] << 8) | (rgb[2] << 16) | jnp.uint32(0xFF000000)
 
 
-def render_step_sharded_batched(mesh: Mesh):
-    """Mesh-sharded render with per-tile settings -> u32[B, H, W]."""
+def render_step_sharded_batched(mesh: Mesh,
+                                replicate_output: bool = False):
+    """Mesh-sharded render with per-tile settings -> u32[B, H, W].
+
+    ``replicate_output`` finishes with an all-gather over the data axis
+    so EVERY process holds the full batch — required on multi-host
+    meshes, where a data-sharded global array is not addressable from
+    the serving process (the gather rides ICI/DCN once instead of N
+    host-to-host fetches)."""
+    if replicate_output:
+        def fn(*args):
+            out = _composite_step_batched(*args)
+            return jax.lax.all_gather(out, "data", axis=0, tiled=True)
+        out_specs = P()
+    else:
+        fn = _composite_step_batched
+        out_specs = P("data")
     sharded = shard_map(
-        _composite_step_batched,
+        fn,
         mesh=mesh,
         in_specs=_BATCHED_STEP_IN_SPECS,
-        out_specs=P("data"),
+        out_specs=out_specs,
     )
     return jax.jit(sharded)
 
@@ -293,7 +308,8 @@ def render_step_sharded_batched(mesh: Mesh):
 def render_jpeg_step_sharded_batched(mesh: Mesh, quality: int = 85,
                                      cap: int | None = None,
                                      engine: str = "sparse",
-                                     cap_words: int | None = None):
+                                     cap_words: int | None = None,
+                                     replicate_output: bool = False):
     """Mesh-sharded serving step with per-tile settings: raw tiles ->
     JPEG wire buffers, data-sharded.  The per-request form of
     :func:`render_jpeg_step_sharded`.
@@ -322,17 +338,24 @@ def render_jpeg_step_sharded_batched(mesh: Mesh, quality: int = 85,
         if engine == "huffman":
             local_words = (cap_words if cap_words is not None
                            else default_words_cap(H, W))
-            return huffman_pack(
+            bufs = huffman_pack(
                 y, cb, cr, local_cap, local_words,
                 *(jnp.asarray(a) for a in spec_h),
                 h16=H // 16, w16=W // 16)
-        return sparse_pack(y, cb, cr, local_cap)
+        else:
+            bufs = sparse_pack(y, cb, cr, local_cap)
+        if replicate_output:
+            # Multi-host: every process needs the full wire buffers
+            # (both to serve and to agree on overflow verdicts without
+            # a host collective).
+            bufs = jax.lax.all_gather(bufs, "data", axis=0, tiled=True)
+        return bufs
 
     sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=_BATCHED_STEP_IN_SPECS,
-        out_specs=P("data"),
+        out_specs=P() if replicate_output else P("data"),
     )
     return jax.jit(sharded)
 

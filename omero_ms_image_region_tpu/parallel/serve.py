@@ -16,6 +16,14 @@ multiple of the ``data`` axis (repeating the last tile) and the channel
 count pads up to a multiple of the ``chan`` axis with inert channels
 (unit window, zero color tables — they contribute nothing to the
 composite).
+
+Multi-host pods: only the leader (process 0) has a request stream;
+before each dispatch it replicates the group to the followers over the
+pod broadcast channel (:class:`_PodChannel`), and every process —
+followers via :func:`run_pod_follower` (``--role pod-worker``) — runs
+the identical sharded flow.  Step outputs are all-gathered inside the
+program (``replicate_output``), so the leader can materialize full
+results and overflow decisions are deterministic everywhere.
 """
 
 from __future__ import annotations
@@ -74,30 +82,102 @@ def _jnp():
     return jnp
 
 
-def _global_overflow_verdict(local: bool) -> bool:
-    """Agree on the cap-widening retry across every mesh process.
-
-    Each process fetches only its addressable shard of the wire totals, so
-    a tile overflowing on one host's shard is invisible to the others.  The
-    retry re-dispatches a *different* (2x-cap) sharded program — and also
-    flips ``_CAP_MEMO`` for every later group — so if processes decide
-    from local data alone their SPMD launch sequences diverge and the pod
-    hangs.  A one-bool all-gather makes the verdict global.  The caller
-    must gate this only on process-deterministic state (the memo), never
-    on shard-local data, so every process reaches the collective.
-    """
-    import jax
-    if jax.process_count() == 1:
-        return local
-    from jax.experimental import multihost_utils
-    flags = multihost_utils.process_allgather(
-        np.asarray([local], np.bool_))
-    return bool(np.asarray(flags).any())
-
-
 def _jnp_cat(raw, reps):
     jnp = _jnp()
     return jnp.concatenate([raw] + reps, axis=0)
+
+
+# ------------------------------------------------------ pod replication
+
+# Header words for the pod broadcast protocol (leader -> followers).
+_POD_HDR = 16
+_POD_SHUTDOWN, _POD_RENDER, _POD_JPEG = 0, 1, 2
+
+
+class _PodChannel:
+    """Group replication for multi-host serving.
+
+    SPMD requires every process of a pod to launch identical sharded
+    programs in identical order, but only the leader (process 0) has a
+    request stream.  Before each group dispatch the leader broadcasts a
+    fixed-size header plus the group's arrays
+    (``multihost_utils.broadcast_one_to_all`` — one collective per
+    array); followers reconstruct the group and run the IDENTICAL
+    dispatch flow, so the pod stays in lockstep without any sidecar
+    traffic reaching the followers.
+    """
+
+    @staticmethod
+    def _bcast(x):
+        from jax.experimental import multihost_utils
+        return multihost_utils.broadcast_one_to_all(x)
+
+    # ---------------------------------------------------------- leader
+
+    def announce(self, kind: int, raw=None, stacked=None,
+                 quality: int = 0, engine_id: int = 0) -> None:
+        hdr = np.zeros(_POD_HDR, np.int32)
+        hdr[0] = kind
+        if kind != _POD_SHUTDOWN:
+            B, C, H, W = raw.shape
+            hdr[1:5] = (B, C, H, W)
+            hdr[5] = quality
+            hdr[6] = engine_id
+            hdr[7] = np.asarray(stacked["tables"]).ndim
+            hdr[8] = int(stacked["cd_start"])
+            hdr[9] = int(stacked["cd_end"])
+        self._bcast(hdr)
+        if kind == _POD_SHUTDOWN:
+            return
+        for arr, dt in self._payload(raw, stacked):
+            self._bcast(np.ascontiguousarray(np.asarray(arr, dt)))
+
+    # -------------------------------------------------------- follower
+
+    def recv(self):
+        """Next announced group: (kind, raw, stacked, quality,
+        engine_id); raw/stacked are None at shutdown."""
+        hdr = np.asarray(self._bcast(np.zeros(_POD_HDR, np.int32)))
+        kind = int(hdr[0])
+        if kind == _POD_SHUTDOWN:
+            return kind, None, None, 0, 0
+        B, C, H, W = (int(v) for v in hdr[1:5])
+        tables_shape = ((B, C, 3) if int(hdr[7]) == 3
+                        else (B, C, 256, 3))
+        shapes = self._shapes(B, C, H, W, tables_shape)
+        got = [np.asarray(self._bcast(np.zeros(shape, dt)))
+               for shape, dt in shapes]
+        raw = got[0]
+        stacked = {
+            "window_start": got[1], "window_end": got[2],
+            "family": got[3], "coefficient": got[4], "reverse": got[5],
+            "tables": got[6],
+            "cd_start": int(hdr[8]), "cd_end": int(hdr[9]),
+        }
+        return kind, raw, stacked, int(hdr[5]), int(hdr[6])
+
+    # ---------------------------------------------------------- layout
+
+    @staticmethod
+    def _payload(raw, stacked):
+        return (
+            (raw, np.float32),
+            (stacked["window_start"], np.float32),
+            (stacked["window_end"], np.float32),
+            (stacked["family"], np.int32),
+            (stacked["coefficient"], np.float32),
+            (stacked["reverse"], np.int32),
+            (stacked["tables"], np.float32),
+        )
+
+    @staticmethod
+    def _shapes(B, C, H, W, tables_shape):
+        return (
+            ((B, C, H, W), np.float32),
+            ((B, C), np.float32), ((B, C), np.float32),
+            ((B, C), np.int32), ((B, C), np.float32),
+            ((B, C), np.int32), (tables_shape, np.float32),
+        )
 
 
 class MeshRenderer(BatchingRenderer):
@@ -131,10 +211,7 @@ class MeshRenderer(BatchingRenderer):
         if multihost:
             # One launch slot shared across ALL bucket keys: without it,
             # two keys' dispatchers would interleave sharded launches in
-            # a host-local order.  NOTE this serializes launches but
-            # does not by itself give every host the same group stream —
-            # multi-host pods must feed all processes an identical
-            # request schedule (see deploy/DEPLOY.md, driver process).
+            # a host-local order.
             import asyncio as _asyncio
             self._shared_slots = _asyncio.Semaphore(1)
             # Host-local queue-pressure batch growth would launch
@@ -151,26 +228,16 @@ class MeshRenderer(BatchingRenderer):
         self._render_steps: dict = {}
         self._jpeg_steps: dict = {}
         self._multihost = multihost
-        # Multi-host only: number of clean (globally-agreed no-overflow)
-        # groups seen per memo key.  Past the cap the steady-state hot
-        # path stops paying a cross-host collective per group; a later
-        # overflow then lands on the per-tile dense fallback instead of
-        # widening.  Counts advance only on agreed verdicts, so the
-        # counter — and therefore the launch sequence — stays identical
-        # on every process.
-        self._verdict_checks: dict = {}
-
-    _VERDICT_CHECK_CAP = 8
-
-    def _should_check_overflow(self, memo_key) -> bool:
-        if not self._multihost:
-            return True
-        return self._verdict_checks.get(memo_key, 0) < self._VERDICT_CHECK_CAP
-
-    def _record_clean_verdict(self, memo_key) -> None:
-        if self._multihost:
-            self._verdict_checks[memo_key] = \
-                self._verdict_checks.get(memo_key, 0) + 1
+        # Multi-host: outputs are all-gathered inside the sharded step
+        # (replicate_output) so (a) the leader can materialize the full
+        # result — a data-sharded global array is not addressable
+        # cross-host — and (b) overflow verdicts are computed from
+        # identical replicated totals on every process, keeping the
+        # cap memos in lockstep with no host collective.  The leader
+        # replicates each group to the followers over the pod channel
+        # before dispatching (see _PodChannel / run_pod_follower).
+        self._replicated = multihost
+        self._pod = _PodChannel() if multihost else None
 
     # ------------------------------------------------------------- steps
 
@@ -179,7 +246,8 @@ class MeshRenderer(BatchingRenderer):
             step = self._render_steps.get("render")
             if step is None:
                 step = self._render_steps["render"] = \
-                    render_step_sharded_batched(self.mesh)
+                    render_step_sharded_batched(
+                        self.mesh, replicate_output=self._replicated)
             return step
 
     def _jpeg_step(self, quality: int, cap: int, engine: str = "sparse",
@@ -189,10 +257,10 @@ class MeshRenderer(BatchingRenderer):
             step = self._jpeg_steps.get(key)
             if step is None:
                 step = self._jpeg_steps[key] = \
-                    render_jpeg_step_sharded_batched(self.mesh, quality,
-                                                     cap=cap,
-                                                     engine=engine,
-                                                     cap_words=cap_words)
+                    render_jpeg_step_sharded_batched(
+                        self.mesh, quality, cap=cap, engine=engine,
+                        cap_words=cap_words,
+                        replicate_output=self._replicated)
             return step
 
     # ------------------------------------------------------------ groups
@@ -219,12 +287,18 @@ class MeshRenderer(BatchingRenderer):
     def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:
         n = len(group)
         raw, stacked = self._stacked(group)
-        args = shard_batch_batched(self.mesh, raw, stacked)
+        if self._pod is not None:
+            self._pod.announce(_POD_RENDER, raw, stacked)
         with stopwatch("Renderer.renderAsPackedInt.mesh"):
-            out = self._render_step()(*args)
-            host = np.asarray(out)
+            host = self._render_wire(raw, stacked)
         self._count_batch(n)
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
+
+    def _render_wire(self, raw, stacked) -> np.ndarray:
+        """The SPMD-identical half of a packed render: dispatch + full
+        result materialization.  Leader and followers both run this."""
+        args = shard_batch_batched(self.mesh, raw, stacked)
+        return np.asarray(self._render_step()(*args))
 
     @staticmethod
     def _dense_coefficients(raw, stacked, qy, qc, i):
@@ -243,113 +317,148 @@ class MeshRenderer(BatchingRenderer):
             np.asarray(stacked["tables"][i:i + 1]), qy, qc)
         return np.asarray(y)[0], np.asarray(cb)[0], np.asarray(cr)[0]
 
-    def _render_group_jpeg(self, group: List[_Pending]) -> List[bytes]:
-        from ..ops.jpegenc import (default_sparse_cap,
-                                   finish_sparse_to_jpegs,
-                                   quant_tables, wire_fetcher)
+    def _sparse_wire(self, raw, stacked, H, W, quality):
+        """Sparse-engine dispatch with the one-shot cap-widening
+        rescue; SPMD-identical on leader and followers (with replicated
+        outputs every process sees the same totals, so the memo — and
+        therefore the launch sequence — stays in lockstep with no host
+        collective)."""
+        from ..ops.jpegenc import (_CAP_MEMO, default_sparse_cap,
+                                   wire_fetcher, wire_header_i32)
 
-        n = len(group)
-        raw, stacked = self._stacked(group)
-        H, W = raw.shape[-2:]
-        quality = group[0].quality
-        # Quality-aware cap: deterministic in (H, W, quality), so every
-        # process of a multi-host mesh — fed the same group stream —
-        # compiles the same sharded program.  Overflow retries are
-        # agreed globally via _global_overflow_verdict, so the memo
-        # (and the launch sequence) stays identical on every process.
-        from ..ops.jpegenc import _CAP_MEMO, wire_header_i32
         cap = default_sparse_cap(H, W, quality)
-        # The packed Huffman stream covers the full (H, W) grid, so the
-        # wire-optimal engine applies when every tile in the group is
-        # grid-exact (same policy as ``render_batch_to_jpeg``); mixed
-        # groups fall back to the sparse engine as a whole.  Each
-        # engine applies its own overflow memo to the base cap.
-        all_exact = all((p.h + 15) // 16 * 16 == H
-                        and (p.w + 15) // 16 * 16 == W for p in group)
-        if self.jpeg_engine == "huffman" and all_exact:
-            return self._render_group_jpeg_huffman(
-                group, raw, stacked, H, W, cap, quality)
         memo_key = ("mesh-sparse", H, W, quality)
         if _CAP_MEMO.get(memo_key):
             cap *= 2
         args = shard_batch_batched(self.mesh, raw, stacked)
-        with stopwatch("Renderer.renderAsPackedInt.mesh"):
-            bufs = self._jpeg_step(quality, cap)(*args)
-            bufs = wire_fetcher(H, W, cap).fetch(bufs)
-            totals = wire_header_i32(bufs, 0)
-            local_over = bool(((totals > cap)
-                               & (totals <= 2 * cap)).any())
-            if (memo_key not in _CAP_MEMO
-                    and self._should_check_overflow(memo_key)):
-                if _global_overflow_verdict(local_over):
-                    # One-shot widening, mirroring render_batch_to_jpeg:
-                    # a rescuable overflow re-dispatches the group at 2x
-                    # instead of per-tile dense re-renders.  The verdict
-                    # is all-gathered so every process re-dispatches (or
-                    # not) in lockstep; the gates are deterministic.
-                    _CAP_MEMO[memo_key] = True
-                    cap *= 2
-                    bufs = self._jpeg_step(quality, cap)(*args)
-                    bufs = wire_fetcher(H, W, cap).fetch(bufs)
-                else:
-                    self._record_clean_verdict(memo_key)
+        bufs = wire_fetcher(H, W, cap).fetch(
+            self._jpeg_step(quality, cap)(*args))
+        totals = wire_header_i32(bufs, 0)
+        if (memo_key not in _CAP_MEMO
+                and ((totals > cap) & (totals <= 2 * cap)).any()):
+            _CAP_MEMO[memo_key] = True
+            cap *= 2
+            bufs = wire_fetcher(H, W, cap).fetch(
+                self._jpeg_step(quality, cap)(*args))
+        return bufs, cap
 
-        qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
-        jpegs = finish_sparse_to_jpegs(
-            bufs, [(p.w, p.h) for p in group], H, W, quality, cap,
-            lambda i: self._dense_coefficients(raw, stacked, qy, qc, i))
-        self._count_batch(n)
-        return jpegs
+    def _huffman_wire(self, raw, stacked, H, W, quality):
+        """Huffman-engine dispatch with the one-shot widening; same
+        lockstep contract as :meth:`_sparse_wire`."""
+        from ..ops.jpegenc import (_CAP_MEMO, default_sparse_cap,
+                                   default_words_cap,
+                                   huffman_wire_fetcher, wire_header_i32)
 
-    def _render_group_jpeg_huffman(self, group, raw, stacked, H, W, cap,
-                                   quality) -> List[bytes]:
-        from ..ops.jpegenc import (_CAP_MEMO, default_words_cap,
-                                   dense_encoder, finish_huffman_batch,
-                                   huffman_wire_fetcher, quant_tables,
-                                   wire_header_i32)
-
-        n = len(group)
+        cap = default_sparse_cap(H, W, quality)
         cap_words = default_words_cap(H, W, quality)
         memo_key = ("mesh-huffman", H, W, quality)
         if _CAP_MEMO.get(memo_key):
             cap, cap_words = cap * 2, cap_words * 2
         args = shard_batch_batched(self.mesh, raw, stacked)
-        with stopwatch("Renderer.renderAsPackedInt.mesh"):
-            bufs = self._jpeg_step(quality, cap, "huffman",
-                                   cap_words)(*args)
-            bufs = huffman_wire_fetcher(H, W, cap, cap_words).fetch(bufs)
-            totals = wire_header_i32(bufs, 0)
-            bits = wire_header_i32(bufs, 1)
-            over = (totals > cap) | (bits > cap_words * 32)
-            rescuable = ((totals <= 2 * cap)
-                         & (bits <= 2 * cap_words * 32))
-            local_over = bool((over & rescuable).any())
-            if (memo_key not in _CAP_MEMO
-                    and self._should_check_overflow(memo_key)):
-                if _global_overflow_verdict(local_over):
-                    # One-shot widening (see render_batch_to_jpeg);
-                    # verdict all-gathered across processes — see
-                    # _global_overflow_verdict.
-                    _CAP_MEMO[memo_key] = True
-                    cap, cap_words = cap * 2, cap_words * 2
-                    bufs = self._jpeg_step(quality, cap, "huffman",
-                                           cap_words)(*args)
-                    bufs = huffman_wire_fetcher(H, W, cap,
-                                                cap_words).fetch(bufs)
-                else:
-                    self._record_clean_verdict(memo_key)
+        bufs = huffman_wire_fetcher(H, W, cap, cap_words).fetch(
+            self._jpeg_step(quality, cap, "huffman", cap_words)(*args))
+        totals = wire_header_i32(bufs, 0)
+        bits = wire_header_i32(bufs, 1)
+        over = (totals > cap) | (bits > cap_words * 32)
+        rescuable = ((totals <= 2 * cap)
+                     & (bits <= 2 * cap_words * 32))
+        if memo_key not in _CAP_MEMO and (over & rescuable).any():
+            _CAP_MEMO[memo_key] = True
+            cap, cap_words = cap * 2, cap_words * 2
+            bufs = huffman_wire_fetcher(H, W, cap, cap_words).fetch(
+                self._jpeg_step(quality, cap, "huffman",
+                                cap_words)(*args))
+        return bufs, cap, cap_words
 
+    def _jpeg_engine_for(self, all_exact: bool) -> str:
+        # The packed Huffman stream covers the full (H, W) grid, so the
+        # wire-optimal engine applies only when every tile in the group
+        # is grid-exact (same policy as ``render_batch_to_jpeg``);
+        # mixed groups fall back to the sparse engine as a whole.
+        return ("huffman" if self.jpeg_engine == "huffman" and all_exact
+                else "sparse")
+
+    def _render_group_jpeg(self, group: List[_Pending]) -> List[bytes]:
+        from ..ops.jpegenc import (dense_encoder, finish_huffman_batch,
+                                   finish_sparse_to_jpegs, quant_tables)
+
+        n = len(group)
+        raw, stacked = self._stacked(group)
+        H, W = raw.shape[-2:]
+        quality = group[0].quality
+        all_exact = all((p.h + 15) // 16 * 16 == H
+                        and (p.w + 15) // 16 * 16 == W for p in group)
+        engine = self._jpeg_engine_for(all_exact)
+        if self._pod is not None:
+            self._pod.announce(_POD_JPEG, raw, stacked, quality,
+                               engine_id=1 if engine == "huffman" else 0)
         qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
-        _dense_encode = dense_encoder()
+        dims = [(p.w, p.h) for p in group]
+        if engine == "huffman":
+            with stopwatch("Renderer.renderAsPackedInt.mesh"):
+                bufs, cap, cap_words = self._huffman_wire(
+                    raw, stacked, H, W, quality)
+            _dense_encode = dense_encoder()
 
-        def dense_tile(i):
-            # Rare cap/bits overflow: dense re-encode of one tile.
-            y, cb, cr = self._dense_coefficients(raw, stacked, qy, qc, i)
-            return _dense_encode(y, cb, cr, group[i].w, group[i].h,
-                                 quality)
+            def dense_tile(i):
+                # Rare cap/bits overflow: dense re-encode of one tile.
+                y, cb, cr = self._dense_coefficients(raw, stacked, qy,
+                                                     qc, i)
+                return _dense_encode(y, cb, cr, group[i].w, group[i].h,
+                                     quality)
 
-        jpegs = finish_huffman_batch(
-            bufs, [(p.w, p.h) for p in group], H, W, quality, cap,
-            cap_words, dense_fallback=dense_tile)
+            jpegs = finish_huffman_batch(
+                bufs, dims, H, W, quality, cap, cap_words,
+                dense_fallback=dense_tile)
+        else:
+            with stopwatch("Renderer.renderAsPackedInt.mesh"):
+                bufs, cap = self._sparse_wire(raw, stacked, H, W,
+                                              quality)
+            jpegs = finish_sparse_to_jpegs(
+                bufs, dims, H, W, quality, cap,
+                lambda i: self._dense_coefficients(raw, stacked, qy,
+                                                   qc, i))
         self._count_batch(n)
         return jpegs
+
+    async def close(self) -> None:
+        await super().close()
+        if self._pod is not None and jax_process_index() == 0:
+            logger.info("pod leader: announcing shutdown")
+            self._pod.announce(_POD_SHUTDOWN)
+            logger.info("pod leader: shutdown announced")
+
+
+def jax_process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def run_pod_follower(mesh: Mesh, jpeg_engine: str = "sparse") -> int:
+    """Follower loop for non-leader pod processes.
+
+    Receives each group the leader announces over the pod channel and
+    runs the IDENTICAL sharded dispatch flow (including the cap-rescue
+    re-dispatches, whose decisions are deterministic from the
+    replicated wire totals), keeping the pod's SPMD launch sequence in
+    lockstep.  Host-side JFIF finishing is skipped — followers produce
+    no responses.  Returns the number of groups served; exits on the
+    leader's shutdown announcement.
+    """
+    renderer = MeshRenderer(mesh, jpeg_engine=jpeg_engine)
+    pod = renderer._pod or _PodChannel()
+    groups = 0
+    while True:
+        kind, raw, stacked, quality, engine_id = pod.recv()
+        if kind == _POD_SHUTDOWN:
+            logger.info("pod follower: shutdown after %d groups", groups)
+            return groups
+        if kind == _POD_RENDER:
+            renderer._render_wire(raw, stacked)
+        else:
+            H, W = raw.shape[-2:]
+            if engine_id == 1:
+                renderer._huffman_wire(raw, stacked, H, W, quality)
+            else:
+                renderer._sparse_wire(raw, stacked, H, W, quality)
+        groups += 1

@@ -131,6 +131,11 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
             coordinator_address=config.parallel.coordinator_address,
             num_processes=config.parallel.num_processes,
             process_id=config.parallel.process_id)
+        import jax
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            raise ValueError(
+                "mesh-serving leader must be process 0 of the pod; "
+                "run the other processes with --role pod-worker")
         mesh = cluster.global_mesh(
             chan_parallel=config.parallel.chan_parallel,
             n_devices=config.parallel.n_devices)
@@ -537,8 +542,10 @@ def run_app(app: web.Application, config: AppConfig) -> None:
                 pass
         try:
             await stop.wait()
+            log.info("shutdown signal received")
         finally:
             await runner.cleanup()
+            log.info("shutdown complete")
 
     try:
         asyncio.run(serve())
@@ -554,9 +561,13 @@ def main(argv=None) -> None:
     parser.add_argument("--port", type=int)
     parser.add_argument("--data-dir")
     parser.add_argument(
-        "--role", choices=["combined", "frontend", "sidecar", "split"],
+        "--role",
+        choices=["combined", "frontend", "sidecar", "split",
+                 "pod-worker"],
         help="process role for the frontend/compute split "
-             "(sidecar.role in the config)")
+             "(sidecar.role in the config); pod-worker = non-leader "
+             "process of a multi-host mesh (joins the cluster and "
+             "replays the leader's group dispatches)")
     parser.add_argument(
         "--sidecar-socket",
         help="render sidecar address: unix socket path, or host:port "
@@ -572,6 +583,31 @@ def main(argv=None) -> None:
         config.data_dir = args.data_dir
     if args.sidecar_socket is not None:
         config.sidecar.socket = args.sidecar_socket
+    if args.role == "pod-worker":
+        configure_logging(config)
+        if not config.parallel.enabled:
+            parser.error("--role pod-worker requires parallel.enabled")
+        if config.parallel.process_id == 0:
+            # broadcast_one_to_all sources from process 0; a follower
+            # there would read its own zeros as a shutdown and exit
+            # while the real leader blocks forever.
+            parser.error("--role pod-worker must not be process-id 0 "
+                         "(process 0 is the serving leader)")
+        from ..parallel import cluster
+        from ..parallel.serve import run_pod_follower
+        cluster.initialize(
+            coordinator_address=config.parallel.coordinator_address,
+            num_processes=config.parallel.num_processes,
+            process_id=config.parallel.process_id)
+        mesh = cluster.global_mesh(
+            chan_parallel=config.parallel.chan_parallel,
+            n_devices=config.parallel.n_devices)
+        engine = config.renderer.jpeg_engine
+        if engine == "auto":
+            from ..utils.linkprobe import resolve_auto_engine
+            engine = resolve_auto_engine()   # pod-agreed (allgathered)
+        run_pod_follower(mesh, jpeg_engine=engine)
+        return
     if args.role is not None:
         config.sidecar.role = args.role
     if config.sidecar.role != "combined" and not config.sidecar.socket:

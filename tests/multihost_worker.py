@@ -13,17 +13,97 @@ import os
 import sys
 
 
+def _make_group(B=8, C=4, H=64, W=64, quality=0):
+    """Deterministic batcher group (same on every process/run)."""
+    import numpy as np
+
+    from omero_ms_image_region_tpu.flagship import flagship_rdef
+    from omero_ms_image_region_tpu.ops.render import pack_settings
+    from omero_ms_image_region_tpu.server.batcher import _Pending
+
+    rng = np.random.default_rng(7)
+    settings = pack_settings(flagship_rdef(C))
+    group = []
+    for _ in range(B):
+        raw = rng.uniform(0, 60000, (C, H, W)).astype(np.float32)
+        group.append(_Pending(raw=raw, settings=settings, h=H, w=W,
+                              quality=quality))
+    return group
+
+
+def serve_mode(pid: int) -> dict:
+    """Leader drives a MeshRenderer; followers replay via the pod
+    channel.  Returns the leader's output digests."""
+    import hashlib
+
+    import numpy as np
+
+    from omero_ms_image_region_tpu.parallel import cluster
+    from omero_ms_image_region_tpu.parallel.serve import (
+        MeshRenderer, run_pod_follower)
+
+    mesh = cluster.global_mesh(chan_parallel=2)
+    if pid != 0:
+        groups = run_pod_follower(mesh, jpeg_engine="huffman")
+        return {"follower_groups": groups}
+    renderer = MeshRenderer(mesh, jpeg_engine="huffman")
+    packed = renderer._render_group(_make_group())
+    jpegs = renderer._render_group_jpeg(_make_group(quality=85))
+    renderer._pod.announce(0)          # shutdown broadcast
+    return {
+        "packed_sha": hashlib.sha256(
+            b"".join(np.ascontiguousarray(p).tobytes()
+                     for p in packed)).hexdigest(),
+        "jpeg_sha": hashlib.sha256(b"".join(jpegs)).hexdigest(),
+        "n_jpegs": len(jpegs),
+    }
+
+
+def reference_mode() -> dict:
+    """Single-process 8-device reference for the serve-mode digests
+    (run in its own clean-env subprocess: an in-pytest reference would
+    see whatever default platform the outer environment registered and
+    diverge numerically from the workers)."""
+    import hashlib
+
+    import numpy as np
+
+    from omero_ms_image_region_tpu.parallel.mesh import make_mesh
+    from omero_ms_image_region_tpu.parallel.serve import MeshRenderer
+
+    renderer = MeshRenderer(make_mesh(8, chan_parallel=2),
+                            jpeg_engine="huffman")
+    packed = renderer._render_group(_make_group())
+    jpegs = renderer._render_group_jpeg(_make_group(quality=85))
+    return {
+        "packed_sha": hashlib.sha256(
+            b"".join(np.ascontiguousarray(p).tobytes()
+                     for p in packed)).hexdigest(),
+        "jpeg_sha": hashlib.sha256(b"".join(jpegs)).hexdigest(),
+        "n_jpegs": len(jpegs),
+    }
+
+
 def main() -> int:
     pid = int(sys.argv[1])
     coordinator = sys.argv[2]
+    mode = sys.argv[3] if len(sys.argv) > 3 else "checksum"
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    ndev = 8 if mode == "reference" else 4
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={ndev}"
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 
     import numpy as np
 
     import jax
+
+    if mode == "reference":
+        out = reference_mode()
+        out.update({"pid": pid, "ok": True})
+        print(json.dumps(out))
+        return 0
     from omero_ms_image_region_tpu.flagship import flagship_rdef
     from omero_ms_image_region_tpu.ops.render import pack_settings
     from omero_ms_image_region_tpu.parallel import cluster
@@ -34,6 +114,12 @@ def main() -> int:
                        num_processes=2, process_id=pid)
     assert jax.process_count() == 2, jax.process_count()
     assert jax.device_count() == 8, jax.device_count()
+
+    if mode == "serve":
+        out = serve_mode(pid)
+        out.update({"pid": pid, "ok": True})
+        print(json.dumps(out))
+        return 0
 
     mesh = cluster.global_mesh(chan_parallel=2)
     rng = np.random.default_rng(0)     # same stream on both processes

@@ -297,35 +297,3 @@ class TestMeshServingHTTP:
         status, body = run(go())
         assert status == 200
         assert body[:2] == b"\xff\xd8"
-
-
-class TestGlobalOverflowVerdict:
-    """The cap-widening retry must be decided identically on every
-    process of a multi-host mesh (ADVICE r3: shard-local verdicts
-    diverge the SPMD launch sequence)."""
-
-    def test_single_process_passthrough(self):
-        from omero_ms_image_region_tpu.parallel.serve import (
-            _global_overflow_verdict)
-        assert _global_overflow_verdict(True) is True
-        assert _global_overflow_verdict(False) is False
-
-    def test_multihost_allgathers_any(self, monkeypatch):
-        from jax.experimental import multihost_utils
-
-        from omero_ms_image_region_tpu.parallel.serve import (
-            _global_overflow_verdict)
-
-        monkeypatch.setattr(jax, "process_count", lambda: 2)
-        calls = []
-
-        def fake_allgather(x):
-            calls.append(np.asarray(x))
-            # Simulate the OTHER process having seen an overflow even
-            # though this one did not.
-            return np.asarray([[False], [True]])
-
-        monkeypatch.setattr(multihost_utils, "process_allgather",
-                            fake_allgather)
-        assert _global_overflow_verdict(False) is True
-        assert len(calls) == 1

@@ -18,6 +18,8 @@ import sys
 
 import numpy as np
 
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -25,36 +27,67 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_pod_renders_in_lockstep():
-    # (Hang protection is the communicate(timeout=240) below —
-    # pytest-timeout is not shipped in this image.)
-    worker = os.path.join(os.path.dirname(__file__),
-                          "multihost_worker.py")
+def _clean_env() -> dict:
+    """Workers must start platform-neutral: the outer process may carry
+    a TPU/axon plugin registration whose default-device numerics differ
+    from plain CPU."""
+    return {k: v for k, v in os.environ.items()
+            if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                         "XLA_FLAGS")}
+
+
+def _run_workers(mode: str, pids) -> dict:
+    """One worker subprocess per pid (shared coordinator); returns
+    {pid: parsed-json-line} once every worker exits cleanly.  Hang
+    protection is the communicate timeout (pytest-timeout is not
+    shipped in this image)."""
     coordinator = f"127.0.0.1:{_free_port()}"
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
-                        "XLA_FLAGS")}
+    env = _clean_env()
     procs = [
-        subprocess.Popen([sys.executable, worker, str(pid), coordinator],
-                         stdout=subprocess.PIPE,
-                         stderr=subprocess.PIPE, env=env, text=True)
-        for pid in (0, 1)
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), coordinator, mode],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True)
+        for pid in pids
     ]
-    outs = []
-    for p in procs:
+    outs = {}
+    for p, pid in zip(procs, pids):
         try:
             out, err = p.communicate(timeout=240)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+        assert p.returncode == 0, f"worker {pid} failed:\n{err[-3000:]}"
+        outs[pid] = json.loads(out.strip().splitlines()[-1])
+    return outs
 
-    assert all(o["ok"] for o in outs)
+
+def test_two_process_pod_renders_in_lockstep():
+    outs = _run_workers("checksum", (0, 1))
+    assert all(o["ok"] for o in outs.values())
     # Every process observed the same all-gathered shard checksums —
     # the SPMD launch sequences stayed in lockstep and the global
     # result is consistent across hosts.
     assert outs[0]["shard_sums"] == outs[1]["shard_sums"]
     assert len(outs[0]["shard_sums"]) == 2
     assert all(np.isfinite(outs[0]["shard_sums"]))
+
+
+def test_two_process_pod_serves_groups_via_follower_replication():
+    """The full multi-host SERVING loop: the leader's MeshRenderer
+    replicates each group over the pod broadcast channel, the follower
+    replays the identical sharded dispatches (render + huffman JPEG,
+    including cap-rescue determinism), and the leader's outputs are
+    byte-identical to a single-process mesh render of the same groups
+    (the reference runs in its own clean-env subprocess so the outer
+    environment's default platform cannot skew the comparison).
+    """
+    outs = _run_workers("serve", (0, 1))
+    leader, follower = outs[0], outs[1]
+    assert follower["follower_groups"] == 2
+    assert leader["n_jpegs"] == 8
+
+    ref = _run_workers("reference", (0,))[0]
+    assert ref["packed_sha"] == leader["packed_sha"]
+    assert ref["jpeg_sha"] == leader["jpeg_sha"]

@@ -436,9 +436,15 @@ class _Decoder:
             ssiz, xr, yr = b[36 + 3 * ci:39 + 3 * ci]
             if xr == 0 or yr == 0:
                 raise Jp2kError("zero component subsampling")
+            depth = (ssiz & 0x7F) + 1
+            if depth > 32:
+                # T.800 allows up to 38 bits, but past 32 the output
+                # dtypes would silently wrap — fail loudly instead.
+                raise Jp2kError(
+                    f"{depth}-bit components are not supported "
+                    f"(32-bit max)")
             self.comps.append(_Component(
-                depth=(ssiz & 0x7F) + 1, signed=bool(ssiz & 0x80),
-                dx=xr, dy=yr))
+                depth=depth, signed=bool(ssiz & 0x80), dx=xr, dy=yr))
         self.ntx = _ceil_div(self.xsiz - self.xtosiz, self.xtsiz)
         self.nty = _ceil_div(self.ysiz - self.ytosiz, self.ytsiz)
         if self.ntx * self.nty > 65536:
@@ -1350,12 +1356,33 @@ def _find_codestream(data: bytes) -> bytes:
     raise Jp2kError("not a JPEG 2000 stream (no SOC / JP2 signature)")
 
 
+def _jp2k_error_contract(fn):
+    """Everything malformed must surface as :class:`Jp2kError` (a
+    ValueError): these streams come from untrusted files, and server
+    error mapping turns ValueError into a 4xx instead of a 500.  The
+    explicit checks cover the known shapes; this net catches residual
+    IndexError/struct.error/AttributeError/etc from hostile input
+    (same pattern as jpegdec's _jpeg_error_contract)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except (IndexError, KeyError, AttributeError, struct.error,
+                OverflowError, MemoryError, ZeroDivisionError) as e:
+            raise Jp2kError(f"malformed JPEG 2000 stream: {e}") from e
+    return wrapped
+
+
+@_jp2k_error_contract
 def decode_jp2k(data: bytes) -> np.ndarray:
     """Decode a JPEG 2000 codestream (raw J2K or JP2 file) to
     ``[h, w, ncomp]``."""
     return _Decoder(_find_codestream(bytes(data))).decode()
 
 
+@_jp2k_error_contract
 def decode_tiff_jp2k(data: bytes, compression: int,
                      photometric: int) -> np.ndarray:
     """Decode one TIFF 33003/33005 segment (a raw J2K codestream, the

@@ -120,8 +120,8 @@ class PixelsService:
             low = rel.lower()
             if low.endswith((".ome.tif", ".ome.tiff")):
                 return 0
-            if low.endswith((".tif", ".tiff")):
-                return 1
+            if low.endswith((".tif", ".tiff", ".svs", ".ndpi")):
+                return 1       # TIFF-based vendor formats included
             return 2
 
         tried = []
@@ -138,6 +138,13 @@ class PixelsService:
                         f"image {image_id}: ROMIO path {rel} needs "
                         f"pixels geometry to open")
                 return RomioPixelSource(path, pixels)
+            # Unknown extension: vendor WSI files are very often plain
+            # TIFF containers under another name — sniff the magic
+            # rather than trusting the suffix.
+            with open(path, "rb") as f:
+                magic = f.read(4)
+            if magic[:2] in (b"II", b"MM"):
+                return OmeTiffSource(path)
             tried.append(rel)   # present but not a format we serve
         raise FileNotFoundError(
             f"image {image_id}: no usable pixel file under "

@@ -516,8 +516,16 @@ class TiffFile:
         if comp == 7:
             return self._read_jpeg_segment(ifd, raw, seg_h, seg_w, spp)
         if ifd.bits == 1:
-            return self._read_bilevel_segment(ifd, raw, comp, seg_h,
-                                              seg_w, spp)
+            if (BITS_PER_SAMPLE not in ifd.tags and comp == 1
+                    and len(raw) == seg_h * seg_w * spp):
+                # Spec says a missing BitsPerSample means 1-bit, but
+                # sloppy 8-bit writers omit the tag too; uncompressed
+                # data whose length matches the byte-per-sample layout
+                # (a real bilevel strip is ~8x smaller) disambiguates.
+                pass
+            else:
+                return self._read_bilevel_segment(ifd, raw, comp,
+                                                  seg_h, seg_w, spp)
         data = decode_segment(raw, comp,
                               seg_h * seg_w * spp * dt.itemsize)
         arr = np.frombuffer(data, dtype=dt,

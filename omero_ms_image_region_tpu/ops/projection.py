@@ -178,19 +178,23 @@ def project_region_banded(get_band, algorithm, size_z: int, start: int,
                           type_max: float = 255.0, plane_shape=None,
                           band_rows: int = 256, z_chunk: int = 8,
                           get_chunk=None):
-    """Spatially-banded streamed Z-projection — peak footprint is
-    band-sized, not plane-sized.
+    """Spatially-banded streamed Z-projection — peak HOST footprint is
+    chunk-sized, not plane-sized.
 
     :func:`project_planes` bounds memory in Z but still reads (and
     uploads) FULL planes; at real WSI scale (80k x 80k u16 => 12.8 GB
-    per host plane, 25 GB per f32 device accumulator) that breaks both
-    host and HBM.  Here the plane is processed in horizontal bands of
-    ``band_rows`` rows: ``get_band(z, y0, h) -> [h, W]`` reads only a
-    band, ``z_chunk`` bands stack into one device fold dispatch, and
-    finished band accumulators stitch into the output plane on device.
-    Peak host memory is one ``[z_chunk, band_rows, W]`` chunk; peak
-    device memory is the output plane plus one band accumulator and one
-    chunk.
+    per host plane) that breaks the host long before the device.  Here
+    the plane is processed in horizontal bands of ``band_rows`` rows:
+    ``get_band(z, y0, h) -> [h, W]`` reads only a band, ``z_chunk``
+    bands stack into one device fold dispatch, and finished band
+    accumulators stitch into the output plane on device.  Peak host
+    memory is one ``[z_chunk, band_rows, W]`` chunk.  Peak DEVICE
+    memory is still the f32 output plane (plus one band accumulator
+    and one chunk) — the projected plane feeds the render, which needs
+    it whole, so the largest projectable plane is bounded by HBM
+    exactly as any renderable plane is (the reference materializes
+    full byte[] planes at the same point, ``ProjectionService.java
+    :72``).
 
     The last band is aligned to ``H - band_rows`` (fixed shapes keep
     one compiled executable); its overlap rows recompute identical

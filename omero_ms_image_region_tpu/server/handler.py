@@ -293,14 +293,17 @@ class ImageRegionHandler:
         (``ImageRegionRequestHandler.java:302-309``).
         """
         svc = self.s.pixels_service
-        candidates = None
         resolver = getattr(self.s.metadata, "resolve_image_paths", None)
-        if (resolver is not None and getattr(svc, "repo_root", None)
-                and not svc.is_open(image_id)
-                and not await asyncio.to_thread(svc.exists, image_id)):
-            # Resolution (a DB round trip) runs only on a true open
-            # miss; hot tile traffic on an already-open image skips it.
-            candidates = await resolver(image_id)
+        try:
+            # Fast path: the handle cache or the data_dir layout serves
+            # without any DB round trip (and without a second sniff, or
+            # a check-then-open race against LRU eviction).
+            return await asyncio.to_thread(svc.get_pixel_source,
+                                           image_id)
+        except FileNotFoundError:
+            if resolver is None or not getattr(svc, "repo_root", None):
+                raise
+        candidates = await resolver(image_id)
         return await asyncio.to_thread(
             svc.get_pixel_source, image_id, candidates, pixels)
 

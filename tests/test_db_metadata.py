@@ -384,3 +384,27 @@ def test_romio_dir_fanout_paths():
     assert _romio_rel_path(1234) == "Pixels/Dir-001/1234"
     assert _romio_rel_path(1234567) == "Pixels/Dir-001/Dir-234/1234567"
     assert _romio_rel_path(1000) == "Pixels/Dir-001/1000"
+
+
+def test_vendor_named_repo_file_resolves(tmp_path, db):
+    """A fileset whose file is named .svs (an Aperio TIFF) serves from
+    the repository — TIFF-based vendor names must not be filtered out
+    by suffix."""
+    import numpy as np
+
+    from omero_ms_image_region_tpu.io.ometiff import OmeTiffSource
+    from omero_ms_image_region_tpu.io.service import PixelsService
+    from omero_ms_image_region_tpu.io.tiffwrite import write_ome_tiff
+
+    rng = np.random.default_rng(12)
+    planes = rng.integers(0, 60000, (1, 1, 32, 32)).astype(np.uint16)
+    repo = tmp_path / "OMERO"
+    d = repo / "ManagedRepository" / "lab"
+    d.mkdir(parents=True)
+    write_ome_tiff(planes, str(d / "slide.svs"), tile=(32, 32),
+                   n_levels=1)
+    svc = PixelsService(str(tmp_path / "data"), repo_root=str(repo))
+    src = svc.get_pixel_source(
+        42, candidates=["ManagedRepository/lab/slide.svs"])
+    assert isinstance(src, OmeTiffSource)
+    svc.close()

@@ -396,3 +396,40 @@ def test_hostile_component_count_rejected():
             + struct.pack(">H", 2 + len(siz)) + siz)
     with pytest.raises(Jp2kError, match="component cap|64-component"):
         decode_jp2k(blob)
+
+
+class TestErrorContract:
+    """Residual malformed-header shapes must surface as Jp2kError (a
+    ValueError), never IndexError/struct.error/AttributeError."""
+
+    def test_qcd_even_body(self):
+        # Style-1 QCD whose body length parses to a struct error.
+        blob = (b"\xff\x4f"
+                + b"\xff\x51" + struct.pack(">H", 41)
+                + struct.pack(">HIIIIIIIIH", 0, 8, 8, 0, 0, 8, 8, 0,
+                              0, 1) + bytes([7, 1, 1])
+                + b"\xff\x5c" + struct.pack(">H", 4) + bytes([1, 0]))
+        with pytest.raises(Jp2kError):
+            decode_jp2k(blob + b"\xff\xd9")
+
+    def test_truncated_jp2_box(self):
+        sig = b"\x00\x00\x00\x0cjP  \r\n\x87\n"
+        blob = sig + struct.pack(">I", 1) + b"jp2c" + b"\x00\x00"
+        with pytest.raises((Jp2kError, ValueError)):
+            decode_jp2k(blob)
+
+    def test_sot_without_siz(self):
+        blob = (b"\xff\x4f"
+                + b"\xff\x90" + struct.pack(">H", 10)
+                + struct.pack(">HIBB", 0, 14, 0, 1)
+                + b"\xff\x93" + b"\xff\xd9")
+        with pytest.raises((Jp2kError, ValueError)):
+            decode_jp2k(blob)
+
+    def test_deep_components_rejected(self):
+        siz = struct.pack(">HIIIIIIIIH", 0, 8, 8, 0, 0, 8, 8, 0, 0,
+                          1) + bytes([37, 1, 1])   # 38-bit depth
+        blob = (b"\xff\x4f" + b"\xff\x51"
+                + struct.pack(">H", 2 + len(siz)) + siz + b"\xff\xd9")
+        with pytest.raises(Jp2kError, match="32-bit max"):
+            decode_jp2k(blob)

@@ -811,3 +811,38 @@ def test_white_is_zero_bilevel_is_inverted(tmp_path):
     got = tf.read_segment(tf.ifds[0], 0, 0)
     np.testing.assert_array_equal(got[:, :, 0], grid)
     tf.close()
+
+
+def test_sloppy_eight_bit_tiff_without_bits_tag(tmp_path):
+    """Spec default for a missing BitsPerSample is 1-bit, but an
+    uncompressed segment sized byte-per-sample disambiguates a sloppy
+    8-bit writer — those files must keep decoding as 8-bit."""
+    a = (np.arange(16 * 24).reshape(16, 24) * 3 % 256).astype(np.uint8)
+    path = str(tmp_path / "sloppy.tif")
+    n = 8
+    entries = []
+
+    def ent(tag, ftype, count, value):
+        return struct.pack("<HHI4s", tag, ftype, count, value)
+
+    s = lambda v: struct.pack("<HH", v, 0)
+    l = lambda v: struct.pack("<I", v)
+    data_off = 8 + 2 + n * 12 + 4
+    entries.append(ent(256, 3, 1, s(24)))
+    entries.append(ent(257, 3, 1, s(16)))
+    # NO tag 258 (BitsPerSample)
+    entries.append(ent(259, 3, 1, s(1)))
+    entries.append(ent(262, 3, 1, s(1)))
+    entries.append(ent(273, 4, 1, l(data_off)))
+    entries.append(ent(277, 3, 1, s(1)))
+    entries.append(ent(278, 3, 1, s(16)))
+    entries.append(ent(279, 4, 1, l(a.size)))
+    with open(path, "wb") as f:
+        f.write(b"II" + struct.pack("<HI", 42, 8))
+        f.write(struct.pack("<H", n) + b"".join(entries) + l(0))
+        f.write(a.tobytes())
+    from omero_ms_image_region_tpu.io.tiff import TiffFile
+    tf = TiffFile(path)
+    got = tf.read_segment(tf.ifds[0], 0, 0)
+    np.testing.assert_array_equal(got[:, :, 0], a)
+    tf.close()

@@ -1,0 +1,133 @@
+"""Handle/memory soak over the DEPLOYED server stack (AppRunner +
+TCPSite + a real aiohttp client): sustained serving over more images
+than the pixel-source LRU holds (handle churn drives the deferred-close
+path), asserting fd count and live RSS stay flat.
+
+Measured here (round 4): 480 measured requests over 60 images with a
+12-slot LRU (every request cycles sources through eviction and the
+deferred-close drain) at 0 KB/request RSS growth and a flat fd count.
+NOTE: aiohttp's TestClient/TestServer
+harness accumulates ~20-30 KB/request of its own state — soaks must
+run through a real server or they measure the harness, not the
+service.
+
+Not part of the pytest suite (runs ~1-2 min); invoke directly:
+
+    JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/soak_handles.py
+"""
+
+import asyncio
+import gc
+import os
+import sys
+import tempfile
+
+
+def _fd_count() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+def _rss_kb() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1])
+    return 0
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import numpy as np
+
+    import aiohttp
+    from aiohttp import web
+    from omero_ms_image_region_tpu.io.tiffwrite import write_ome_tiff
+    from omero_ms_image_region_tpu.server.app import create_app
+    from omero_ms_image_region_tpu.server.config import AppConfig
+
+    n_images = 60
+    rounds = 8
+    port = 9191
+
+    tmp = tempfile.mkdtemp(prefix="soak_")
+    rng = np.random.default_rng(0)
+    for i in range(1, n_images + 1):
+        d = os.path.join(tmp, str(i))
+        os.makedirs(d)
+        planes = rng.integers(0, 60000, (1, 1, 96, 96)).astype(
+            np.uint16)
+        write_ome_tiff(planes, os.path.join(d, "img.ome.tiff"),
+                       tile=(48, 48), n_levels=1)
+
+    # A small LRU forces constant eviction: every request cycles
+    # sources through the deferred-close path this soak exists to
+    # exercise (the default 128 would hold all 60 images resident).
+    config = AppConfig(data_dir=tmp, port=port)
+    from omero_ms_image_region_tpu.io.service import PixelsService
+    from omero_ms_image_region_tpu.server.app import build_services
+    services = build_services(config)
+    services.pixels_service.close()
+    services.pixels_service = PixelsService(tmp, max_open=12)
+    app = create_app(config, services=services)
+
+    async def run() -> tuple:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, port=port)
+        await site.start()
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async def one(i):
+                    url = (f"http://127.0.0.1:{port}/webgateway/"
+                           f"render_image_region/{i}/0/0"
+                           f"?region=0,0,96,96&c=1|0:60000$FF0000"
+                           f"&m=g&format=png")
+                    async with sess.get(url) as r:
+                        assert r.status == 200, (i, r.status)
+                        await r.read()
+
+                # Warm with the SAME 8-way concurrency as the measured
+                # phase: the client pool opens one connection per
+                # concurrent request (2 fds per in-process pair), and
+                # the baseline must include the filled pool.
+                for chunk in range(0, n_images, 8):
+                    await asyncio.gather(*[
+                        one(i + 1)
+                        for i in range(chunk,
+                                       min(chunk + 8, n_images))])
+                gc.collect()
+                fd0, rss0 = _fd_count(), _rss_kb()
+                served = 0
+                for _ in range(rounds):
+                    for chunk in range(0, n_images, 8):
+                        await asyncio.gather(*[
+                            one(i + 1)
+                            for i in range(chunk,
+                                           min(chunk + 8, n_images))])
+                        served += min(8, n_images - chunk)
+                gc.collect()
+                return served, fd0, _fd_count(), rss0, _rss_kb()
+        finally:
+            await runner.cleanup()
+
+    try:
+        served, fd0, fd1, rss0, rss1 = asyncio.run(run())
+    finally:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(f"served {served} requests over {n_images} images "
+          f"(pixel-source LRU churn)")
+    print(f"fds: {fd0} -> {fd1} (delta {fd1 - fd0})")
+    print(f"VmRSS: {rss0 // 1024} MB -> {rss1 // 1024} MB "
+          f"(delta {(rss1 - rss0) // 1024} MB)")
+    assert fd1 - fd0 <= 8, f"fd leak: {fd0} -> {fd1}"
+    assert rss1 - rss0 <= 64 * 1024, f"RSS leak: {rss0} -> {rss1}"
+    print("soak OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -471,15 +471,25 @@ async def _service_run(config, concurrency: int = 16,
         assert all(r.status == 200 for r in resps)
         t_stop = time.perf_counter() + duration_s
         done = 0
+        failed = 0
 
         async def worker(i: int) -> None:
-            nonlocal done, seq
+            nonlocal done, seq, failed
             while time.perf_counter() < t_stop:
                 seq += 1
                 r = await client.get(url(i, 16 + seq))
                 await r.read()
-                assert r.status == 200
-                done += 1
+                if r.status == 200:
+                    done += 1
+                else:
+                    # A relay-transport drop that survived the group
+                    # retry: count it (failures don't add to done) and
+                    # only fail the window when errors aren't rare.
+                    failed += 1
+                    if failed > 5:
+                        raise AssertionError(
+                            f"service window: {failed} failed requests "
+                            f"(last status {r.status})")
 
         t0 = time.perf_counter()
         await asyncio.gather(*(worker(i) for i in range(concurrency)))
@@ -764,7 +774,13 @@ def main():
     rng = np.random.default_rng(
         int.from_bytes(_os.urandom(8), "little"))
 
-    flag = bench_flagship(rng)
+    # A dropped relay connection mid-compile surfaces as a transient
+    # JaxRuntimeError and would otherwise zero out the whole round's
+    # record; each section gets one retry on that class of failure.
+    from omero_ms_image_region_tpu.utils.transient import retry_transient
+
+    flag = retry_transient(lambda: bench_flagship(rng), "bench_flagship",
+                           backoff_s=15.0)
     try:
         # Fixed sampling policy: ALWAYS two windows, best-of-2 per
         # engine, regardless of where the first window lands.  The
@@ -799,11 +815,17 @@ def main():
         # App stack unavailable; library numbers stand.
         service_tps, service_engines = None, {}
         service_fetch_mb_s = None
-    c1_tpu, c1_cpu = bench_config1(rng)
-    c2_planes, c2_cpu = bench_config2(rng)
-    c4_projections, c4_cpu = bench_config4(rng)
-    c4_stream, c4_stream_warm = bench_config4_stream(rng)
-    c5_masks, c5_cpu = bench_config5(rng)
+    c1_tpu, c1_cpu = retry_transient(
+        lambda: bench_config1(rng), "bench_config1", backoff_s=15.0)
+    c2_planes, c2_cpu = retry_transient(
+        lambda: bench_config2(rng), "bench_config2", backoff_s=15.0)
+    c4_projections, c4_cpu = retry_transient(
+        lambda: bench_config4(rng), "bench_config4", backoff_s=15.0)
+    c4_stream, c4_stream_warm = retry_transient(
+        lambda: bench_config4_stream(rng), "bench_config4_stream",
+        backoff_s=15.0)
+    c5_masks, c5_cpu = retry_transient(
+        lambda: bench_config5(rng), "bench_config5", backoff_s=15.0)
 
     print(json.dumps({
         "metric": "jpeg_tiles_per_sec_1024sq_4ch_u16",

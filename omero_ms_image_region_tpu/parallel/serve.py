@@ -185,7 +185,7 @@ class MeshRenderer(BatchingRenderer):
 
     def __init__(self, mesh: Mesh, max_batch: int | None = None,
                  linger_ms: float = 2.0, buckets=None,
-                 jpeg_engine: str = "sparse", pipeline_depth: int = 2,
+                 jpeg_engine: str = "sparse", pipeline_depth: int = 4,
                  max_batch_limit: int = None):
         data = mesh.shape["data"]
         if max_batch is None:
@@ -218,6 +218,10 @@ class MeshRenderer(BatchingRenderer):
             # program shapes the other processes never compile (SPMD);
             # the pod serves the configured max_batch only.
             self._growth_enabled = False
+            # Likewise a host-local transient-error retry would launch
+            # the sharded program a second time on one process only,
+            # diverging the pod's lockstep launch sequence.
+            self._transient_retry_enabled = False
         self.mesh = mesh
         self.jpeg_engine = jpeg_engine
         import threading

@@ -79,7 +79,7 @@ class BatchingRenderer:
 
     def __init__(self, max_batch: int = 8, linger_ms: float = 2.0,
                  buckets=DEFAULT_BUCKETS, jpeg_engine: str = "sparse",
-                 pipeline_depth: int = 2, max_batch_limit: int = None,
+                 pipeline_depth: int = 4, max_batch_limit: int = None,
                  engine_controller=None):
         if jpeg_engine not in ("sparse", "huffman"):
             raise ValueError(
@@ -103,6 +103,11 @@ class BatchingRenderer:
         # others never compile and hang the pod (MeshRenderer clears
         # this when process_count > 1).
         self._growth_enabled = True
+        # One host-local retry of a group whose dispatch died on a
+        # transient transport error (tunnel relay drop).  Also cleared
+        # on multi-host meshes: a lone host re-launching would diverge
+        # the pod's SPMD launch sequence.
+        self._transient_retry_enabled = True
         self.linger_ms = linger_ms
         self.jpeg_engine = jpeg_engine
         # Live engine selection (utils.adaptive.AdaptiveEngine); None =
@@ -289,7 +294,16 @@ class BatchingRenderer:
         the HTTP layer's ``except Exception`` mapping and drop the
         connection without a response.
         """
-        inner = asyncio.ensure_future(asyncio.to_thread(render, group))
+        if self._transient_retry_enabled:
+            from ..utils.transient import retry_transient
+            # Short backoff: the slot (and every request in the group)
+            # waits it out, so a serving retry must not stall the
+            # pipeline the way the bench's section-level retry may.
+            run = lambda: retry_transient(        # noqa: E731
+                lambda: render(group), "group render", backoff_s=0.25)
+        else:
+            run = lambda: render(group)           # noqa: E731
+        inner = asyncio.ensure_future(asyncio.to_thread(run))
 
         def settle(fut: asyncio.Future) -> None:
             slots.release()

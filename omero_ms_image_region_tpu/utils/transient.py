@@ -1,0 +1,67 @@
+"""Transient device-runtime error classification + one-shot retry.
+
+Tunnel/relay transports (remote TPU attachment) surface mid-compile and
+mid-transfer connection drops as ``jax.errors.JaxRuntimeError`` with
+INTERNAL or UNAVAILABLE status — e.g. ``remote_compile: read body:
+response body closed before all bytes were read``.  The program being
+launched is fine; re-dispatching over a fresh connection succeeds.  On
+co-located hardware these statuses are not produced by healthy
+operation, so a single retry is safe everywhere and rescues an entire
+render group (or a whole bench section) from one dropped connection.
+
+Deterministic failures — shape errors, tracer leaks,
+RESOURCE_EXHAUSTED (HBM OOM) — carry other statuses/types and are NOT
+retried.
+
+The check is name-based so device-free processes (frontend proxies) can
+import this module without pulling in jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, TypeVar
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+# Substrings of transient transport statuses (matched case-insensitively
+# — strerror text capitalizes "Connection reset by peer"/"Broken pipe").
+# INTERNAL alone would be too broad for XLA (it also tags compiler
+# bugs), so the match requires a transport-flavored detail alongside it.
+_TRANSIENT_MARKERS = (
+    "unavailable",
+    "read body",
+    "response body closed",
+    "connection reset",
+    "broken pipe",
+    "socket closed",
+    "transport closed",
+)
+
+
+def is_transient_device_error(exc: BaseException) -> bool:
+    """True when ``exc`` is a device-runtime error whose message says
+    the transport (not the program) failed."""
+    if type(exc).__name__ not in ("JaxRuntimeError", "XlaRuntimeError"):
+        return False
+    msg = str(exc).lower()
+    return any(marker in msg for marker in _TRANSIENT_MARKERS)
+
+
+def retry_transient(fn: Callable[[], T], what: str = "device call",
+                    backoff_s: float = 2.0) -> T:
+    """Run ``fn``; on a transient transport error, retry ONCE after a
+    short backoff.  Anything else (including a second transient
+    failure) propagates."""
+    try:
+        return fn()
+    except Exception as exc:
+        if not is_transient_device_error(exc):
+            raise
+        logger.warning("%s hit a transient device transport error; "
+                       "retrying once: %s", what, exc)
+        time.sleep(backoff_s)
+        return fn()

@@ -270,3 +270,103 @@ class TestPipelining:
 
         asyncio.run(go())
         assert concurrent["peak"] == 1
+
+
+class TestTransientRetry:
+    """One host-local retry of a group whose dispatch died on a
+    transient transport error (utils.transient; tunnel relay drops
+    surface as JaxRuntimeError INTERNAL/UNAVAILABLE mid-compile)."""
+
+    @staticmethod
+    def _transient_error():
+        # Name-matched by is_transient_device_error (the real class
+        # lives in jax.errors; the classifier is import-light).
+        cls = type("JaxRuntimeError", (RuntimeError,), {})
+        return cls("INTERNAL: http://127.0.0.1:8083/remote_compile: "
+                   "read body: response body closed before all bytes "
+                   "were read")
+
+    def test_classifier(self):
+        from omero_ms_image_region_tpu.utils.transient import (
+            is_transient_device_error,
+        )
+        assert is_transient_device_error(self._transient_error())
+        # Deterministic program/runtime failures must not match.
+        cls = type("JaxRuntimeError", (RuntimeError,), {})
+        assert not is_transient_device_error(
+            cls("RESOURCE_EXHAUSTED: out of memory"))
+        assert not is_transient_device_error(
+            ValueError("response body closed"))
+
+    def test_retry_once_then_propagate(self):
+        from omero_ms_image_region_tpu.utils.transient import (
+            retry_transient,
+        )
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise self._transient_error()
+            return "ok"
+
+        assert retry_transient(flaky, backoff_s=0.0) == "ok"
+        assert calls["n"] == 2
+
+        calls["n"] = 0
+
+        def always_broken():
+            calls["n"] += 1
+            raise self._transient_error()
+
+        with pytest.raises(RuntimeError):
+            retry_transient(always_broken, backoff_s=0.0)
+        assert calls["n"] == 2   # exactly one retry
+
+    def test_group_render_survives_one_transient_failure(self):
+        settings = _settings()
+        rng = np.random.default_rng(1)
+        raw = rng.integers(0, 60000, size=(3, 16, 16)).astype(np.float32)
+        fails = {"left": 1}
+        outer = self
+
+        class Flaky(BatchingRenderer):
+            def _render_group(self, group):
+                if fails["left"]:
+                    fails["left"] -= 1
+                    raise outer._transient_error()
+                return super()._render_group(group)
+
+        async def main():
+            batcher = Flaky(linger_ms=0.0)
+            try:
+                out = await batcher.render(raw, settings)
+                assert out.shape == (16, 16)
+            finally:
+                await batcher.close()
+
+        run(main())
+
+    def test_multihost_gate_disables_retry(self):
+        settings = _settings()
+        rng = np.random.default_rng(2)
+        raw = rng.integers(0, 60000, size=(3, 16, 16)).astype(np.float32)
+        outer = self
+
+        class Flaky(BatchingRenderer):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self._transient_retry_enabled = False
+
+            def _render_group(self, group):
+                raise outer._transient_error()
+
+        async def main():
+            batcher = Flaky(linger_ms=0.0)
+            try:
+                with pytest.raises(RuntimeError):
+                    await batcher.render(raw, settings)
+            finally:
+                await batcher.close()
+
+        run(main())

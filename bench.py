@@ -492,7 +492,15 @@ async def _service_run(config, concurrency: int = 16,
                             f"(last status {r.status})")
 
         t0 = time.perf_counter()
-        await asyncio.gather(*(worker(i) for i in range(concurrency)))
+        # return_exceptions: one worker's failure must not strand the
+        # other 15 mid-request while the client closes under them —
+        # drain everyone (bounded by t_stop), then surface the error.
+        results = await asyncio.gather(
+            *(worker(i) for i in range(concurrency)),
+            return_exceptions=True)
+        errors = [r for r in results if isinstance(r, BaseException)]
+        if errors:
+            raise errors[0]
         return done / (time.perf_counter() - t0)
     finally:
         await client.close()

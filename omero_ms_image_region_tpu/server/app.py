@@ -216,6 +216,28 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
     if services.raw_cache is not None and config.raw_cache.prefetch:
         from ..services.prefetch import TilePrefetcher
         services.prefetcher = TilePrefetcher(services.raw_cache)
+    if (config.renderer.prewarm and config.batcher.enabled
+            and not config.parallel.enabled):
+        # Compile the listed shapes' serving programs now so the first
+        # request of each shape doesn't pay 20-40 s of jit (adaptive
+        # deployments warm BOTH wire engines — the controller may flip
+        # mid-serving).  MeshRenderer is excluded: its sharded steps
+        # are warmed by the pod bring-up dryrun instead.
+        import numpy as _np
+
+        from .prewarm import prewarm_renderer
+        engines = (("sparse", "huffman")
+                   if renderer.engine_controller is not None
+                   else (renderer.jpeg_engine,))
+        prewarm_renderer(
+            list(config.renderer.prewarm), engines,
+            renderer.max_batch, renderer.buckets,
+            # The dtype serving stacks keys the program: the HBM raw
+            # cache keeps storage dtype (uint16 — the WSI class), the
+            # uncached path stages float32 (handler._read_region).
+            raw_dtype=(_np.uint16 if config.raw_cache.enabled
+                       else _np.float32),
+            cpu_fallback_max_px=config.renderer.cpu_fallback_max_px)
     return services
 
 

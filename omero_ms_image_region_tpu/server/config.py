@@ -11,7 +11,7 @@ file ports by deleting the Java-only blocks and adding ``data-dir``.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Tuple
 
 import yaml
 
@@ -75,6 +75,12 @@ class RendererConfig:
     # and the XLA render is ~free — the wire packers dominate device
     # time), so the serving path carries no dead option.
     kernel: str = "xla"
+    # Tile shapes ("<channels>x<tile-edge>[@quality]", e.g. "4x1024")
+    # whose serving programs compile at STARTUP instead of on the first
+    # request of that shape (server.prewarm; ≙ the reference's
+    # Bio-Formats memoizer wait, beanRefContext.xml:19-21).  Batched
+    # postures only.  Empty = lazy compiles.
+    prewarm: Tuple[str, ...] = ()
 
 
 @dataclass
@@ -320,7 +326,11 @@ class AppConfig:
                 str(rd["compilation-cache-dir"])
                 if rd.get("compilation-cache-dir") is not None
                 else rd_defaults.compilation_cache_dir),
+            prewarm=tuple(str(s) for s in rd.get("prewarm", ()) or ()),
         )
+        from .prewarm import parse_spec
+        for spec in cfg.renderer.prewarm:
+            parse_spec(spec)   # malformed specs fail at load, not boot
         if cfg.renderer.jpeg_engine not in ("sparse", "huffman",
                                             "bitpack", "auto"):
             raise ValueError(

@@ -1,0 +1,131 @@
+"""Startup pre-warming of the hot render executables.
+
+Everything under ``jit`` compiles on first use — 20-40 s per program on
+a remote-attached chip (cached across restarts by the persistent
+compilation cache, but a fresh deployment pays it once per shape).
+Without this, the FIRST interactive request of each shape eats that
+compile; the reference's analogue is the Bio-Formats memoizer wait that
+front-loads reader construction cost at startup
+(``beanRefContext.xml:19-21``).
+
+``renderer.prewarm`` lists the tile shapes a deployment expects, e.g.::
+
+    renderer:
+        prewarm: ["4x1024", "3x512@90"]
+
+Each spec is ``<channels>x<tile-edge>[@quality]`` (quality defaults to
+the LocalCompress default).  For every spec the serving-path programs
+are compiled through the real ops entry points with the renderer's own
+wire engine(s):
+
+- the batched JPEG program at batch 1 (the idle lone-tile path — what
+  single-tile p50 rides) and at ``max_batch`` (the loaded steady
+  state);
+- the packed-RGBA program at batch 1 (png/tif formats).
+
+Raw inputs are uint16 — the storage dtype the HBM raw-tile cache keeps
+tiles in, which keys the compiled program — and settings use the
+ramp-weight table form (plain color channels; LUT renders compile on
+first use).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import time
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..codecs import DEFAULT_JPEG_QUALITY
+
+logger = logging.getLogger(__name__)
+
+_SPEC_RE = re.compile(r"^(\d+)x(\d+)(?:@(\d+))?$")
+
+
+def parse_spec(spec: str) -> Tuple[int, int, int]:
+    """``"4x1024[@90]"`` -> (channels, tile_edge, quality)."""
+    m = _SPEC_RE.match(spec.strip())
+    if not m:
+        raise ValueError(
+            f"renderer.prewarm spec {spec!r} is not "
+            f"'<channels>x<tile-edge>[@quality]'")
+    channels, edge, q = (int(m.group(1)), int(m.group(2)),
+                        int(m.group(3)) if m.group(3)
+                        else round(DEFAULT_JPEG_QUALITY * 100))
+    if not (1 <= channels <= 64):
+        raise ValueError(f"prewarm channels out of range: {spec!r}")
+    if not (16 <= edge <= 8192) or edge % 16:
+        raise ValueError(
+            f"prewarm tile edge must be a multiple of 16 in "
+            f"[16, 8192]: {spec!r}")
+    if not (1 <= q <= 100):
+        raise ValueError(f"prewarm quality out of range: {spec!r}")
+    return channels, edge, q
+
+
+def _warm_one(C: int, edge: int, quality: int, batch_sizes: Sequence[int],
+              engines: Sequence[str], buckets, raw_dtype) -> None:
+    from ..flagship import flagship_settings
+    from ..ops.jpegenc import render_batch_to_jpeg
+    from ..ops.render import render_tile_batch_packed
+    from .batcher import pick_bucket
+
+    bh, bw = pick_bucket(edge, edge, buckets)
+    _, settings = flagship_settings(C)
+    for B in dict.fromkeys(batch_sizes):   # de-dup, keep order
+        # Zeros: programs are content-independent.  The dtype must match
+        # what serving stacks (it keys the compiled program): the HBM
+        # raw cache keeps tiles in storage dtype, the uncached path
+        # stages float32.
+        raw = np.zeros((B, C, bh, bw), raw_dtype)
+        stacked = {
+            k: (np.stack([v] * B) if getattr(v, "ndim", 0) else v)
+            for k, v in settings.items()
+        }
+        args = (raw, stacked["window_start"], stacked["window_end"],
+                stacked["family"], stacked["coefficient"],
+                stacked["reverse"], settings["cd_start"],
+                settings["cd_end"], stacked["tables"])
+        for engine in engines:
+            render_batch_to_jpeg(*args, quality=quality,
+                                 dims=[(edge, edge)] * B, engine=engine)
+        if B == 1:
+            np.asarray(render_tile_batch_packed(*args))
+
+
+def prewarm_renderer(specs: List[str], engines: Sequence[str],
+                     max_batch: int, buckets,
+                     raw_dtype=np.uint16,
+                     cpu_fallback_max_px: int = 0) -> None:
+    """Compile the serving programs for each spec; failures are logged,
+    never fatal (serving still works, it just compiles lazily).
+
+    ``raw_dtype`` must be the dtype serving will stack (uint16 with the
+    HBM raw cache, float32 without — it keys the program).  Specs at or
+    below ``cpu_fallback_max_px`` are skipped: the handler routes those
+    renders to the host kernel, so a device program would never be hit.
+    """
+    for spec in specs:
+        C, edge, quality = parse_spec(spec)
+        if edge * edge <= cpu_fallback_max_px:
+            logger.info(
+                "prewarm %s skipped: %dx%d px is at/below "
+                "renderer.cpu-fallback-max-px (%d) and serves on the "
+                "host kernel", spec, edge, edge, cpu_fallback_max_px)
+            continue
+        t0 = time.perf_counter()
+        try:
+            _warm_one(C, edge, quality, (1, max_batch), engines, buckets,
+                      raw_dtype)
+        except Exception:
+            logger.warning("prewarm %s failed; first requests of this "
+                           "shape will compile lazily", spec,
+                           exc_info=True)
+        else:
+            logger.info("prewarmed %s (engines %s, batch 1+%d, %s) "
+                        "in %.1fs", spec, "/".join(engines), max_batch,
+                        np.dtype(raw_dtype).name,
+                        time.perf_counter() - t0)

@@ -366,3 +366,22 @@ class TestBatchedApp:
                 "?tile=0,0,0,16,16&format=jpeg&m=c&c=1|0:60000$FF0000"
             ], jpeg_engine="auto")
             assert renderer.jpeg_engine == expect
+
+
+class TestPrewarm:
+    def test_app_boots_with_prewarm_and_serves(self, data_dir):
+        """renderer.prewarm compiles at build_services time; the app
+        then serves the warmed shape through the batched device path
+        (cpu-fallback disabled so 64x64 doesn't route to the host
+        kernel — prewarm skips shapes the fallback would serve)."""
+        config = AppConfig(data_dir=data_dir)
+        config.renderer.prewarm = ("1x64",)
+        config.renderer.cpu_fallback_max_px = 0
+        (r,) = client_fetch(data_dir, (
+            "GET",
+            f"/webgateway/render_image_region/{IMG}/0/0"
+            "?tile=0,0,0,64,64&format=jpeg&m=c&c=1|0:60000$FF0000",
+        ), config=config)
+        status, headers, body = r
+        assert status == 200
+        assert body[:2] == b"\xff\xd8"

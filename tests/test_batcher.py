@@ -370,3 +370,66 @@ class TestTransientRetry:
                 await batcher.close()
 
         run(main())
+
+
+class TestPrewarm:
+    def test_prewarm_compiles_and_serving_matches(self):
+        """prewarm_renderer runs the real serving entry points; a
+        subsequent batched render of the warmed shape still produces
+        correct output (programs warm, semantics untouched)."""
+        from omero_ms_image_region_tpu.server.prewarm import (
+            prewarm_renderer,
+        )
+
+        prewarm_renderer(["3x64"], ("sparse",), max_batch=2,
+                         buckets=((64, 64),))
+
+        settings = _settings()
+        rng = np.random.default_rng(5)
+        raw = rng.integers(0, 60000, size=(3, 64, 64)).astype(np.float32)
+
+        async def main():
+            batcher = BatchingRenderer(linger_ms=0.0,
+                                       buckets=((64, 64),))
+            try:
+                direct = await Renderer().render(raw, settings)
+                batched = await batcher.render(raw, settings)
+                np.testing.assert_array_equal(np.asarray(direct),
+                                              np.asarray(batched))
+                jpeg = await batcher.render_jpeg(raw, settings, 85,
+                                                 64, 64)
+                assert jpeg[:2] == b"\xff\xd8"
+            finally:
+                await batcher.close()
+
+        run(main())
+
+    def test_prewarm_failure_is_nonfatal(self):
+        from omero_ms_image_region_tpu.server.prewarm import (
+            prewarm_renderer,
+        )
+
+        # 8192 channels is out of parse range -> ValueError (caught at
+        # config load normally); prewarm_renderer itself must raise for
+        # malformed specs (the loader's contract) ...
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            prewarm_renderer(["0x64"], ("sparse",), 2, ((64, 64),))
+        # ... but a VALID spec whose compile dies is logged, not fatal.
+        prewarm_renderer(["3x64"], ("no-such-engine",), 2, ((64, 64),))
+
+    def test_prewarm_skips_cpu_fallback_shapes_and_warms_f32(self):
+        """Shapes the CPU fallback serves are skipped (their device
+        program would never be hit); the uncached posture warms the
+        float32 programs serving actually stacks."""
+        from omero_ms_image_region_tpu.server.prewarm import (
+            prewarm_renderer,
+        )
+
+        # 64*64 = 4096 <= threshold -> skipped (returns instantly even
+        # with a bogus engine that would fail compile).
+        prewarm_renderer(["3x64"], ("no-such-engine",), 2, ((64, 64),),
+                         cpu_fallback_max_px=64 * 64)
+        # float32 raw (raw-cache-off posture) compiles fine.
+        prewarm_renderer(["3x64"], ("sparse",), 2, ((64, 64),),
+                         raw_dtype=np.float32)

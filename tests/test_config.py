@@ -2,6 +2,8 @@
 
 import os
 
+import pytest
+
 from omero_ms_image_region_tpu.server.config import AppConfig, BatcherConfig
 
 EXAMPLE = os.path.join(os.path.dirname(__file__), "..", "conf",
@@ -184,3 +186,23 @@ def test_max_batch_limit_parses():
     cfg = AppConfig.from_dict({"batcher": {"max-batch-limit": 16}})
     assert cfg.batcher.max_batch_limit == 16
     assert AppConfig.from_dict({}).batcher.max_batch_limit is None
+
+
+def test_prewarm_specs_parse_and_validate():
+    from omero_ms_image_region_tpu.server.config import AppConfig
+    from omero_ms_image_region_tpu.server.prewarm import parse_spec
+
+    cfg = AppConfig.from_dict(
+        {"renderer": {"prewarm": ["4x1024", "3x512@90"]}})
+    assert cfg.renderer.prewarm == ("4x1024", "3x512@90")
+    assert AppConfig.from_dict({}).renderer.prewarm == ()
+
+    assert parse_spec("4x1024") == (4, 1024, 85)   # LocalCompress default
+    assert parse_spec("3x512@90") == (3, 512, 90)
+    for bad in ("x1024", "4x", "4x1000", "0x256", "4x256@0", "4x256@101",
+                "4x20"):
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+    # Malformed specs fail at config LOAD, not at first serving touch.
+    with pytest.raises(ValueError):
+        AppConfig.from_dict({"renderer": {"prewarm": ["4x1000"]}})

@@ -82,14 +82,26 @@ class Ifd:
 
     offset: int
     tags: Dict[int, tuple] = field(default_factory=dict)
+    # Source label for error messages (the module's convention prefixes
+    # every reader error with the file path).
+    path: str = ""
 
     def get(self, tag: int, default=None):
         v = self.tags.get(tag)
         return v if v is not None else default
 
-    def one(self, tag: int, default=None):
+    _REQUIRED = object()
+
+    def one(self, tag: int, default=_REQUIRED):
         v = self.tags.get(tag)
         if v is None:
+            if default is Ifd._REQUIRED:
+                # Hostile/corrupt files can omit any tag; a clean parse
+                # error (not a TypeError from int(None) downstream) is
+                # the error contract.
+                where = f"{self.path}: " if self.path else ""
+                raise ValueError(
+                    f"{where}missing required TIFF tag {tag}")
             return default
         return v[0] if isinstance(v, tuple) else v
 
@@ -321,7 +333,7 @@ class TiffFile:
         next_size = 8 if self.big else 4
         raw = self._pread(offset + count_size,
                           count * entry_size + next_size)
-        ifd = Ifd(offset=offset)
+        ifd = Ifd(offset=offset, path=self.path)
         for i in range(count):
             ent = raw[i * entry_size:(i + 1) * entry_size]
             tag, ftype = struct.unpack(e + "HH", ent[:4])
@@ -411,7 +423,7 @@ class TiffFile:
         if ifd.tiled:
             raise ValueError(
                 f"{self.path}: tiled old-style JPEG is not supported")
-        off = ifd.one(JPEG_INTERCHANGE)
+        off = ifd.one(JPEG_INTERCHANGE, None)
         if off is None:
             raise ValueError(
                 f"{self.path}: old-style JPEG (compression 6) without "
@@ -508,6 +520,12 @@ class TiffFile:
         offsets = ifd.get(TILE_OFFSETS if ifd.tiled else STRIP_OFFSETS)
         counts = ifd.get(TILE_BYTE_COUNTS if ifd.tiled
                          else STRIP_BYTE_COUNTS)
+        if offsets is None or counts is None:
+            raise ValueError(f"{self.path}: IFD lacks segment "
+                             f"offset/byte-count tags")
+        if idx >= len(offsets) or idx >= len(counts):
+            raise ValueError(f"{self.path}: segment index {idx} beyond "
+                             f"declared offsets ({len(offsets)})")
         raw = self._pread(int(offsets[idx]), int(counts[idx]))
         dt = ifd.dtype().newbyteorder(self.endian)
         if comp in (33003, 33005):
@@ -551,7 +569,7 @@ class TiffFile:
         # per IFD; an unbounded memo would pin every page's pixels for
         # the file's lifetime).
         self._old_jpeg_cache.clear()
-        n = ifd.one(JPEG_INTERCHANGE_LEN)
+        n = ifd.one(JPEG_INTERCHANGE_LEN, None)
         jf = self._pread(off, int(n) if n else
                          os.fstat(self._f.fileno()).st_size - off)
         img = decode_tiff_jpeg(jf, None, int(ifd.one(PHOTOMETRIC, 1)),

@@ -583,6 +583,44 @@ def test_corrupt_and_truncated_tiffs_fail_cleanly(tmp_path):
         expect_clean(bytes(data), f"flip{seed}.tif")
 
 
+def test_missing_required_tag_raises_value_error(tmp_path):
+    """An IFD lacking a required tag (ImageLength, TileWidth, ...) must
+    raise a clean ValueError, not a TypeError from int(None) — found by
+    mutation fuzz: a spliced-out ImageLength crashed read_segment."""
+    from omero_ms_image_region_tpu.io.tiff import (
+        IMAGE_LENGTH, TILE_WIDTH, Ifd, TiffFile,
+    )
+
+    ifd = Ifd(offset=0, tags={256: (64,)})   # width only
+    with pytest.raises(ValueError, match="missing required TIFF tag"):
+        _ = ifd.height
+    with pytest.raises(ValueError, match="missing required TIFF tag"):
+        ifd.one(TILE_WIDTH)
+    assert ifd.one(IMAGE_LENGTH, None) is None   # explicit default holds
+
+    # End-to-end: strip ImageLength (tag 257) from a valid file's IFD;
+    # opening/reading fails cleanly.
+    rng = np.random.default_rng(44)
+    planes = rng.integers(0, 60000, size=(1, 1, 32, 32)).astype(np.uint16)
+    good_path = str(tmp_path / "g.ome.tiff")
+    write_ome_tiff(planes, good_path, tile=(16, 16), n_levels=1)
+    tf = TiffFile(good_path)
+    ifd0 = tf.ifds[0]
+    del ifd0.tags[257]
+    with pytest.raises(ValueError, match="missing required TIFF tag"):
+        tf.read_segment(ifd0, 0, 0)
+    tf.close()
+
+    # Missing TileOffsets/ByteCounts (tags 324/325) on a tiled IFD:
+    # clean ValueError, not None[idx] (the second fuzz-found escape).
+    tf = TiffFile(good_path)
+    ifd0 = tf.ifds[0]
+    del ifd0.tags[325]
+    with pytest.raises(ValueError, match="offset/byte-count"):
+        tf.read_segment(ifd0, 0, 0)
+    tf.close()
+
+
 def test_page_based_pyramid_tiff(tmp_path):
     """Pre-OME page pyramids (reduced-resolution pages flagged
     NewSubfileType=1 — the vips/openslide export style) read as levels

@@ -53,6 +53,17 @@ def _localname(tag: str) -> str:
     return tag.rsplit("}", 1)[-1]
 
 
+class _NoDoctypeTreeBuilder(ET.TreeBuilder):
+    """Tree builder whose ``doctype`` callback rejects the document —
+    expat invokes it when the declaration is parsed, before any entity
+    is used, so a billion-laughs payload never expands."""
+
+    def doctype(self, name, pubid, system):
+        raise ValueError(
+            "OME-XML with a DTD/entity declaration is rejected "
+            "(entity expansion is not OME and unsafe)")
+
+
 def _find_pixels(root: ET.Element) -> Optional[ET.Element]:
     for el in root.iter():
         if _localname(el.tag) == "Pixels":
@@ -76,7 +87,14 @@ class OmeTiffSource:
         # Page-based pyramids (plain TIFF): full-res page -> its
         # reduced-resolution page indices, in file order.
         self._page_levels: Dict[int, List[int]] = {}
-        self._parse_layout()
+        try:
+            self._parse_layout()
+        except BaseException:
+            # Loud metadata failures (corrupt companion, rejected DTD,
+            # unsupported layout) must not leak the already-open
+            # descriptors to GC timing — servers probe hostile files.
+            self.close()
+            raise
 
     # ------------------------------------------------------------- layout
 
@@ -94,13 +112,33 @@ class OmeTiffSource:
                     tf = self._files[key] = TiffFile(sibling)
         return tf
 
+    @staticmethod
+    def _fromstring_no_dtd(text) -> ET.Element:
+        """``ET.fromstring`` with any DOCTYPE rejected at the parser.
+
+        ElementTree expands internal entities, so a hostile
+        ImageDescription carrying a billion-laughs DTD would balloon
+        memory before any OME validation runs.  Real OME-XML never
+        declares a DTD (the schema is XSD), so the presence of one IS
+        the verdict.  The rejection rides the TreeBuilder ``doctype``
+        callback — which expat fires when the declaration is parsed,
+        before any entity use in the body — so it cannot be dodged by
+        prolog padding or an exotic document encoding the way a raw
+        substring scan of a decoded prefix could.
+        """
+        return ET.fromstring(
+            text, parser=ET.XMLParser(target=_NoDoctypeTreeBuilder()))
+
     def _resolve_ome_root(self, desc: str) -> Optional[ET.Element]:
         """The OME root for this file — following a BinaryOnly pointer
         to its companion metadata file (``*.companion.ome``), the
         standard multi-file OMERO export layout."""
         try:
-            root = ET.fromstring(desc)
-        except ET.ParseError:
+            root = self._fromstring_no_dtd(desc)
+        except (ET.ParseError, ValueError):
+            # Unparseable — or DTD-carrying, which is unparseable by
+            # policy — descriptions degrade to plain-TIFF semantics,
+            # exactly like any other non-OME ImageDescription.
             return None
         for el in root.iter():
             if _localname(el.tag) == "BinaryOnly":
@@ -115,7 +153,7 @@ class OmeTiffSource:
                         f"{meta!r} not found")
                 with open(companion, "rb") as f:
                     try:
-                        return ET.fromstring(f.read())
+                        return self._fromstring_no_dtd(f.read())
                     except ET.ParseError as e:
                         # A present-but-corrupt companion must be as
                         # loud as a missing one — degrading to plain-

@@ -884,3 +884,60 @@ def test_sloppy_eight_bit_tiff_without_bits_tag(tmp_path):
     got = tf.read_segment(tf.ifds[0], 0, 0)
     np.testing.assert_array_equal(got[:, :, 0], a)
     tf.close()
+
+
+def test_xml_entity_expansion_rejected(tmp_path):
+    """A billion-laughs DTD in the ImageDescription (or a companion)
+    must be rejected before ElementTree expands it — OME-XML is
+    XSD-based and never declares a DTD, so a DOCTYPE IS the verdict."""
+    rng = np.random.default_rng(27)
+    planes = rng.integers(0, 100, size=(1, 1, 32, 32)).astype(np.uint16)
+    bomb = (
+        '<?xml version="1.0"?>\n'
+        '<!DOCTYPE lolz [\n'
+        ' <!ENTITY lol "lollollollollollollollollollol">\n'
+        ' <!ENTITY lol2 "&lol;&lol;&lol;&lol;&lol;&lol;&lol;&lol;">\n'
+        ' <!ENTITY lol3 "&lol2;&lol2;&lol2;&lol2;&lol2;&lol2;">\n'
+        ']>\n'
+        f'<OME {_OME_NS}>&lol3;</OME>')
+    # In the description: the file opens as plain TIFF (the hostile
+    # description is ignored as non-OME metadata, never expanded).
+    write_ome_tiff(planes, str(tmp_path / "d.ome.tiff"), tile=(32, 32),
+                   n_levels=1, description=bomb)
+    src = OmeTiffSource(str(tmp_path / "d.ome.tiff"))
+    got = src.get_region(0, 0, 0, RegionDef(0, 0, 32, 32), 0)
+    assert np.array_equal(got, planes[0, 0])
+    src.close()
+
+    # In a companion file a BinaryOnly stub points at: loud failure
+    # (same contract as a corrupt companion).
+    (tmp_path / "bomb.companion.ome").write_text(bomb)
+    stub = (f'<?xml version="1.0"?><OME {_OME_NS}>'
+            f'<BinaryOnly MetadataFile="bomb.companion.ome" '
+            f'UUID="urn:uuid:x"/></OME>')
+    write_ome_tiff(planes, str(tmp_path / "s.ome.tiff"), tile=(32, 32),
+                   n_levels=1, description=stub)
+    with pytest.raises(ValueError, match="DTD|entity"):
+        OmeTiffSource(str(tmp_path / "s.ome.tiff"))
+    # ... without leaking the already-open descriptors to GC timing.
+    before = len(os.listdir("/proc/self/fd"))
+    for _ in range(20):
+        with pytest.raises(ValueError):
+            OmeTiffSource(str(tmp_path / "s.ome.tiff"))
+    assert len(os.listdir("/proc/self/fd")) <= before
+
+    # The rejection is parser-level (TreeBuilder doctype callback), so
+    # the two substring-scan bypasses stay closed: a DOCTYPE pushed
+    # past any fixed scan window by comment padding, and a UTF-16
+    # companion whose interleaved NULs hide the keyword from a
+    # byte/latin-1 scan.
+    padded = ('<?xml version="1.0"?><!--' + 'a' * 5000 + '-->'
+              + bomb.split("?>", 1)[1])
+    (tmp_path / "bomb.companion.ome").write_text(padded)
+    with pytest.raises(ValueError, match="DTD|entity"):
+        OmeTiffSource(str(tmp_path / "s.ome.tiff"))
+    utf16 = ('<?xml version="1.0" encoding="utf-16"?>'
+             + bomb.split("?>", 1)[1]).encode("utf-16")
+    (tmp_path / "bomb.companion.ome").write_bytes(utf16)
+    with pytest.raises(ValueError, match="DTD|entity"):
+        OmeTiffSource(str(tmp_path / "s.ome.tiff"))

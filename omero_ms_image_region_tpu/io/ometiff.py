@@ -154,13 +154,15 @@ class OmeTiffSource:
                 with open(companion, "rb") as f:
                     try:
                         return self._fromstring_no_dtd(f.read())
-                    except ET.ParseError as e:
-                        # A present-but-corrupt companion must be as
-                        # loud as a missing one — degrading to plain-
-                        # TIFF semantics would serve wrong dimensions.
+                    except (ET.ParseError, ValueError) as e:
+                        # A present-but-corrupt (or DTD-carrying)
+                        # companion must be as loud as a missing one —
+                        # degrading to plain-TIFF semantics would serve
+                        # wrong dimensions — and must name the file an
+                        # operator has to go look at.
                         raise ValueError(
                             f"{self.path}: companion metadata "
-                            f"{meta!r} is not parseable XML: {e}")
+                            f"{meta!r} rejected: {e}")
         return root
 
     def _parse_layout(self) -> None:

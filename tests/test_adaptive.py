@@ -130,8 +130,15 @@ class TestBatcherIntegration:
 
         monkeypatch.setattr(jpegenc, "render_batch_to_jpeg", spying)
 
+        # Huge re-probe interval: on a COLD compilation cache the first
+        # render takes tens of seconds, and the huffman steady-state
+        # re-probe (stubbed at a healthy 100 MB/s) would flip the
+        # engine back before the second assertion.  Re-probing has its
+        # own tests; this one is about per-group consultation.
         ctrl = AdaptiveEngine(initial_rate_mb_s=100.0,
-                              probe=lambda: 100.0)
+                              probe=lambda: 100.0,
+                              reprobe_interval_s=1e9,
+                              idle_reprobe_s=1e9)
         r = BatchingRenderer(max_batch=2, linger_ms=0.0,
                              jpeg_engine="sparse",
                              engine_controller=ctrl)
@@ -283,3 +290,60 @@ class TestConflatedSamples:
             # Lower bound 100 MB/s: the link carried at least that.
             ctrl.observe_fetch(*mb(100.0), conflated=True)
         assert ctrl.engine == "sparse"
+
+
+class TestFlipUnderLoad:
+    def test_engine_flips_mid_load_are_safe(self):
+        """The controller flipping engines WHILE concurrent groups are
+        in flight (pipeline_depth > 1, worker threads reading
+        ``current()`` racily) must never corrupt output: every JPEG
+        decodes, whatever engine its group drew."""
+        from omero_ms_image_region_tpu import codecs
+        from omero_ms_image_region_tpu.flagship import flagship_rdef
+        from omero_ms_image_region_tpu.ops.render import pack_settings
+        from omero_ms_image_region_tpu.server.batcher import (
+            BatchingRenderer)
+
+        # Stubbed-probe re-probes disabled for the same cold-cache
+        # reason as test_batcher_consults_controller_per_group; the
+        # flipper task is the only rate source.
+        ctrl = AdaptiveEngine(initial_rate_mb_s=100.0,
+                              probe=lambda: 100.0,
+                              reprobe_interval_s=1e9,
+                              idle_reprobe_s=1e9)
+        r = BatchingRenderer(max_batch=4, linger_ms=0.5,
+                             jpeg_engine="sparse",
+                             engine_controller=ctrl,
+                             pipeline_depth=3)
+        rdef = flagship_rdef(2)
+        settings = pack_settings(rdef)
+        rng = np.random.default_rng(9)
+        tiles = [rng.uniform(0, 60000, (2, 48, 48)).astype(np.float32)
+                 for _ in range(24)]
+
+        async def flipper():
+            # Alternate cratered/recovered signals while renders run.
+            for k in range(12):
+                rate = 3.0 if k % 2 == 0 else 100.0
+                for _ in range(8):
+                    ctrl.observe_fetch(*mb(rate))
+                await asyncio.sleep(0.002)
+
+        async def main():
+            jobs = [r.render_jpeg(t, settings, 80, 48, 48)
+                    for t in tiles]
+            out, _ = await asyncio.gather(asyncio.gather(*jobs),
+                                          flipper())
+            return out
+
+        loop = asyncio.new_event_loop()
+        try:
+            bodies = loop.run_until_complete(main())
+        finally:
+            loop.run_until_complete(r.close())
+            loop.close()
+        assert len(bodies) == 24
+        assert ctrl.switches >= 2   # flips really happened mid-run
+        for b in bodies:
+            rgba = codecs.decode_to_rgba(b)
+            assert rgba.shape[:2] == (48, 48)

@@ -130,6 +130,33 @@ class TestNativeBitOps:
                 np.broadcast_to(fills[b, :3], (int(m[b].sum()), 3)))
         np.testing.assert_array_equal(o[..., 3], base[..., 3])
 
+    def test_mask_overlay_division_exactness(self):
+        """Pin the exact (x + 127) / 255 rounding over the full input
+        lattice.  The vectorized blend uses the identity
+        q = (x + 1 + (x >> 8)) >> 8; the widespread variant WITHOUT the
+        +1 is wrong exactly when x + 127 lands on 255 (e.g. alpha 1,
+        base 0, fill 128) — enumerate every (base, fill) pair for the
+        boundary-prone alphas so that class can never regress."""
+        for alpha in (0, 1, 2, 127, 128, 253, 254, 255):
+            b_all = np.repeat(np.arange(256, dtype=np.uint8), 256)
+            f_all = np.tile(np.arange(256, dtype=np.uint8), 256)
+            B = b_all.size
+            base = np.zeros((1, 1, B, 4), np.uint8)
+            base[0, 0, :, 0] = b_all
+            grids = np.ones((1, 1, B), np.uint8)
+            for fv in (0, 1, 128, 255):
+                fills = np.array([[0, fv, fv, alpha]], np.uint8)
+                fills[0, 0] = 0   # red channel swept via base instead
+                got = native.mask_overlay_u8(base, grids, fills)
+                a = np.uint32(alpha)
+                exp_r = ((b_all.astype(np.uint32) * (255 - a) + 0 * a
+                          + 127) // 255).astype(np.uint8)
+                np.testing.assert_array_equal(got[0, 0, :, 0], exp_r)
+                exp_g = ((0 * (255 - a) + np.uint32(fv) * a + 127)
+                         // 255).astype(np.uint8)
+                np.testing.assert_array_equal(
+                    got[0, 0, :, 1], np.full(B, exp_g, np.uint8))
+
     def test_mask_overlay_validates_shapes(self):
         import pytest
         base = np.zeros((2, 8, 8, 4), np.uint8)

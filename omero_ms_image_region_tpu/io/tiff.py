@@ -301,6 +301,11 @@ class TiffFile:
             seen.add(off)
             ifd, off = self._read_ifd(off)
             self.ifds.append(ifd)
+        if not self.ifds:
+            # TIFF 6.0 requires at least one IFD; a zeroed first-IFD
+            # offset otherwise surfaces later as IndexError from
+            # ifds[0] (fuzz-found escape of the error contract).
+            raise ValueError(f"{path}: TIFF has no IFDs")
 
     # ------------------------------------------------------------ low level
 

@@ -1,0 +1,140 @@
+"""Mutation fuzz over the hostile-input decoders (TIFF / JPEG / JP2K).
+
+Takes valid files produced by the repo's own writers, applies random
+byte flips, splice-deletes, truncations and noise insertions, and runs
+each decoder (native fast paths live, where built).  The contract under
+fuzz: decode successfully OR raise the decoder's clean error classes —
+anything else (TypeError, segfault, hang) is a bug.  Round-4 catches:
+a spliced-out ImageLength crashing `read_segment` with TypeError, and
+a missing TileOffsets tag crashing with `'NoneType' is not
+subscriptable` (both fixed in `io/tiff.py` with regression tests in
+`tests/test_tiff.py`).
+
+Not part of the pytest suite (runs minutes, nondeterministic volume);
+invoke directly:
+
+    JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/fuzz_decoders.py [seed] [iters]
+"""
+
+import os
+import struct
+import sys
+import tempfile
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+from omero_ms_image_region_tpu.io.jp2k import Jp2kError, decode_jp2k
+from omero_ms_image_region_tpu.io.jpegdec import JpegError, decode_tiff_jpeg
+from omero_ms_image_region_tpu.io.tiff import TiffFile
+
+# The decoders' clean error contract.  MemoryError is allowed: a
+# mutated header may legally declare a huge-but-capped allocation.
+OK_ERRORS = (Jp2kError, JpegError, ValueError, KeyError, EOFError,
+             OSError, MemoryError, struct.error)
+
+
+def _corpus(rng):
+    from test_jp2k import _enc as jp2k_enc
+
+    import io as _io
+
+    from PIL import Image
+
+    gray = rng.integers(0, 256, (48, 48), dtype=np.uint8)
+    rgb = rng.integers(0, 256, (48, 48, 3), dtype=np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(rgb).save(buf, "JPEG", quality=80)
+    jpeg = buf.getvalue()
+    buf = _io.BytesIO()
+    Image.fromarray(rgb).save(buf, "TIFF", compression="tiff_lzw")
+    tiff = buf.getvalue()
+    return {
+        "jp2k": [jp2k_enc(gray, irreversible=False),
+                 jp2k_enc(rgb, irreversible=True)],
+        "jpeg": [jpeg],
+        "tiff": [tiff],
+    }
+
+
+def mutate(rng, data: bytes) -> bytes:
+    b = bytearray(data)
+    for _ in range(int(rng.integers(1, 9))):
+        kind = rng.integers(0, 4)
+        if kind == 0 and len(b) > 4:           # flip byte
+            b[int(rng.integers(0, len(b)))] = int(rng.integers(0, 256))
+        elif kind == 1 and len(b) > 16:        # truncate
+            del b[int(rng.integers(8, len(b))):]
+        elif kind == 2 and len(b) > 16:        # splice-delete
+            i = int(rng.integers(4, len(b) - 4))
+            del b[i:i + int(rng.integers(1, 16))]
+        else:                                  # insert noise
+            i = int(rng.integers(0, len(b)))
+            b[i:i] = rng.integers(
+                0, 256, int(rng.integers(1, 8)), dtype=np.uint8).tobytes()
+    return bytes(b)
+
+
+def _try_tiff(blob: bytes) -> bool:
+    with tempfile.NamedTemporaryFile(suffix=".tif", delete=False) as f:
+        f.write(blob)
+        path = f.name
+    try:
+        tf = TiffFile(path)
+        try:
+            tf.read_segment(tf.ifds[0], 0, 0)
+        finally:
+            tf.close()
+        return True
+    finally:
+        os.unlink(path)
+
+
+def main() -> int:
+    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 1500
+    rng = np.random.default_rng(seed)
+    corpus = _corpus(rng)
+    runners = {
+        "jp2k": lambda m: decode_jp2k(m),
+        "jpeg": lambda m: decode_tiff_jpeg(m, None, 6),
+        "tiff": _try_tiff,
+    }
+    stats = {k: [0, 0] for k in runners}
+    crashes = 0
+    # A hang is a contract escape too (the pure-Python decode paths
+    # loop over hostile-controlled counts): bound every decode call.
+    import signal
+
+    def _alarm(signum, frame):
+        raise TimeoutError("decode exceeded the per-call bound")
+
+    signal.signal(signal.SIGALRM, _alarm)
+    for i in range(iters):
+        for kind, run in runners.items():
+            seeds = corpus[kind]
+            m = mutate(rng, seeds[i % len(seeds)])
+            try:
+                signal.alarm(30)
+                run(m)
+                stats[kind][0] += 1
+            except OK_ERRORS:
+                stats[kind][1] += 1
+            except Exception:
+                crashes += 1
+                print(f"--- {kind} ESCAPED ERROR CONTRACT (iter {i}) ---")
+                traceback.print_exc()
+            finally:
+                signal.alarm(0)
+    print(f"seed {seed}, {iters} iters/decoder — "
+          f"[decoded, clean-error]: {stats}")
+    print("OK" if crashes == 0 else f"{crashes} CONTRACT ESCAPES")
+    return 1 if crashes else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -563,6 +563,14 @@ def test_corrupt_and_truncated_tiffs_fail_cleanly(tmp_path):
         except (ValueError, EOFError, KeyError, OSError):
             pass
 
+    # Zeroed first-IFD offset: TIFF 6.0 requires >= 1 IFD; must be a
+    # clean open error, not IndexError from ifds[0] later (fuzz-found).
+    with pytest.raises(ValueError, match="no IFDs"):
+        p0 = str(tmp_path / "zeroifd.tif")
+        open(p0, "wb").write(b"II*\0" + b"\0\0\0\0")
+        from omero_ms_image_region_tpu.io.tiff import TiffFile
+        TiffFile(p0)
+
     expect_clean(b"", "empty.tif")
     expect_clean(b"II*\0", "header-only.tif")
     expect_clean(b"not a tiff at all", "garbage.tif")

@@ -76,6 +76,24 @@ _TYPES: Dict[int, Tuple[Optional[str], int]] = {
 }
 
 
+# (BitsPerSample, SampleFormat) -> numpy dtype — the storage dtypes
+# this reader can stage.  (1, 1) is 1-bit bilevel (OME "bit", the
+# ShapeMask raster class; ome.util.PixelData's 1-bit accessor is the
+# reference analogue, ShapeMaskRequestHandler.java:214-221): stored
+# packed MSB-first, exposed expanded as uint8 0/1.
+_SAMPLE_DTYPES = {
+    (1, 1): "u1",
+    (8, 1): "u1", (16, 1): "u2", (32, 1): "u4",
+    (8, 2): "i1", (16, 2): "i2", (32, 2): "i4",
+    (32, 3): "f4", (64, 3): "f8",
+}
+
+# The same domain by dtype name — the single source for consumers that
+# validate a configured storage dtype (server.prewarm spec suffixes).
+STORAGE_DTYPE_NAMES = tuple(sorted(
+    {np.dtype(v).name for v in _SAMPLE_DTYPES.values()}))
+
+
 @dataclass
 class Ifd:
     """One decoded image file directory."""
@@ -124,22 +142,11 @@ class Ifd:
         return int(self.one(BITS_PER_SAMPLE, 1))
 
     def dtype(self) -> np.dtype:
-        fmt = int(self.one(SAMPLE_FORMAT, 1))
-        table = {
-            # 1-bit bilevel (OME "bit", the ShapeMask raster class;
-            # ome.util.PixelData's 1-bit accessor is the reference
-            # analogue, ShapeMaskRequestHandler.java:214-221): stored
-            # packed MSB-first, exposed expanded as uint8 0/1.
-            (1, 1): "u1",
-            (8, 1): "u1", (16, 1): "u2", (32, 1): "u4",
-            (8, 2): "i1", (16, 2): "i2", (32, 2): "i4",
-            (32, 3): "f4", (64, 3): "f8",
-        }
-        key = (self.bits, fmt)
-        if key not in table:
+        key = (self.bits, int(self.one(SAMPLE_FORMAT, 1)))
+        if key not in _SAMPLE_DTYPES:
             raise ValueError(f"unsupported TIFF sample: {key[0]}-bit "
-                             f"format {fmt}")
-        return np.dtype(table[key])
+                             f"format {key[1]}")
+        return np.dtype(_SAMPLE_DTYPES[key])
 
 
 def _lzw_decode(data: bytes) -> bytes:

@@ -223,8 +223,6 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         # deployments warm BOTH wire engines — the controller may flip
         # mid-serving).  MeshRenderer is excluded: its sharded steps
         # are warmed by the pod bring-up dryrun instead.
-        import numpy as _np
-
         from .prewarm import prewarm_renderer
         engines = (("sparse", "huffman")
                    if renderer.engine_controller is not None
@@ -232,11 +230,6 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         prewarm_renderer(
             list(config.renderer.prewarm), engines,
             renderer.max_batch, renderer.buckets,
-            # The dtype serving stacks keys the program: the HBM raw
-            # cache keeps storage dtype (uint16 — the WSI class), the
-            # uncached path stages float32 (handler._read_region).
-            raw_dtype=(_np.uint16 if config.raw_cache.enabled
-                       else _np.float32),
             cpu_fallback_max_px=config.renderer.cpu_fallback_max_px)
     return services
 

@@ -75,11 +75,12 @@ class RendererConfig:
     # and the XLA render is ~free — the wire packers dominate device
     # time), so the serving path carries no dead option.
     kernel: str = "xla"
-    # Tile shapes ("<channels>x<tile-edge>[@quality]", e.g. "4x1024")
-    # whose serving programs compile at STARTUP instead of on the first
-    # request of that shape (server.prewarm; ≙ the reference's
-    # Bio-Formats memoizer wait, beanRefContext.xml:19-21).  Batched
-    # postures only.  Empty = lazy compiles.
+    # Tile shapes ("<channels>x<tile-edge>[@quality][:dtype]", e.g.
+    # "4x1024" or "3x1024:uint8" — :dtype is the images' storage dtype,
+    # default uint16) whose serving programs compile at STARTUP instead
+    # of on the first request of that shape (server.prewarm; ≙ the
+    # reference's Bio-Formats memoizer wait, beanRefContext.xml:19-21).
+    # Batched postures only.  Empty = lazy compiles.
     prewarm: Tuple[str, ...] = ()
 
 

@@ -429,7 +429,7 @@ class ImageRegionHandler:
     def _read_region(self, src, ctx: ImageRegionCtx, region: RegionDef,
                      level: int, active: List[int],
                      device_cache: bool = True):
-        """Raw f32[C_active, h, w] for the resolved region.
+        """Raw [C_active, h, w] planes (storage dtype) for the region.
 
         With a device raw cache configured (and ``device_cache`` true) the
         result is an HBM-resident ``jax.Array``: raw planes are
@@ -483,7 +483,12 @@ class ImageRegionHandler:
             return jnp.concatenate(parts, axis=1)
 
         if self.s.raw_cache is None or not device_cache:
-            return load().astype(np.float32)
+            # Storage dtype here too: the cached branch already feeds
+            # uint16 through the identical downstream kernels (dtype
+            # keys the batch group; quantize casts on device), and a
+            # float32 staging copy would double the host->device bytes
+            # of the posture that pays for every upload.
+            return load()
         from ..io.devicecache import region_key
         key = region_key(ctx.image_id, ctx.z, ctx.t, level,
                          region.as_tuple(), tuple(active))

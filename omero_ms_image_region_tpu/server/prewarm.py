@@ -42,16 +42,25 @@ from ..codecs import DEFAULT_JPEG_QUALITY
 
 logger = logging.getLogger(__name__)
 
-_SPEC_RE = re.compile(r"^(\d+)x(\d+)(?:@(\d+))?$")
+_SPEC_RE = re.compile(r"^(\d+)x(\d+)(?:@(\d+))?(?::([a-z0-9]+))?$")
+
+# Storage dtypes a pixel source can stage — imported from the TIFF
+# reader's sample table so the two can never drift.
+from ..io.tiff import STORAGE_DTYPE_NAMES as _SPEC_DTYPES  # noqa: E402
 
 
-def parse_spec(spec: str) -> Tuple[int, int, int]:
-    """``"4x1024[@90]"`` -> (channels, tile_edge, quality)."""
+def parse_spec(spec: str) -> Tuple[int, int, int, "np.dtype"]:
+    """``"4x1024[@90][:uint8]"`` -> (channels, edge, quality, dtype).
+
+    The dtype suffix names the images' STORAGE dtype (serving stages
+    storage dtype in both cache postures, and dtype keys the compiled
+    program); default uint16, the WSI class.
+    """
     m = _SPEC_RE.match(spec.strip())
     if not m:
         raise ValueError(
             f"renderer.prewarm spec {spec!r} is not "
-            f"'<channels>x<tile-edge>[@quality]'")
+            f"'<channels>x<tile-edge>[@quality][:dtype]'")
     channels, edge, q = (int(m.group(1)), int(m.group(2)),
                         int(m.group(3)) if m.group(3)
                         else round(DEFAULT_JPEG_QUALITY * 100))
@@ -63,7 +72,11 @@ def parse_spec(spec: str) -> Tuple[int, int, int]:
             f"[16, 8192]: {spec!r}")
     if not (1 <= q <= 100):
         raise ValueError(f"prewarm quality out of range: {spec!r}")
-    return channels, edge, q
+    dt = m.group(4) or "uint16"
+    if dt not in _SPEC_DTYPES:
+        raise ValueError(
+            f"prewarm dtype {dt!r} not one of {_SPEC_DTYPES}: {spec!r}")
+    return channels, edge, q, np.dtype(dt)
 
 
 def _warm_one(C: int, edge: int, quality: int, batch_sizes: Sequence[int],
@@ -76,10 +89,9 @@ def _warm_one(C: int, edge: int, quality: int, batch_sizes: Sequence[int],
     bh, bw = pick_bucket(edge, edge, buckets)
     _, settings = flagship_settings(C)
     for B in dict.fromkeys(batch_sizes):   # de-dup, keep order
-        # Zeros: programs are content-independent.  The dtype must match
-        # what serving stacks (it keys the compiled program): the HBM
-        # raw cache keeps tiles in storage dtype, the uncached path
-        # stages float32.
+        # Zeros: programs are content-independent.  The dtype must
+        # match what serving stacks (it keys the compiled program);
+        # both cache postures stage the images' STORAGE dtype.
         raw = np.zeros((B, C, bh, bw), raw_dtype)
         stacked = {
             k: (np.stack([v] * B) if getattr(v, "ndim", 0) else v)
@@ -98,18 +110,18 @@ def _warm_one(C: int, edge: int, quality: int, batch_sizes: Sequence[int],
 
 def prewarm_renderer(specs: List[str], engines: Sequence[str],
                      max_batch: int, buckets,
-                     raw_dtype=np.uint16,
                      cpu_fallback_max_px: int = 0) -> None:
     """Compile the serving programs for each spec; failures are logged,
     never fatal (serving still works, it just compiles lazily).
 
-    ``raw_dtype`` must be the dtype serving will stack (uint16 with the
-    HBM raw cache, float32 without — it keys the program).  Specs at or
-    below ``cpu_fallback_max_px`` are skipped: the handler routes those
-    renders to the host kernel, so a device program would never be hit.
+    Each spec carries its images' storage dtype (default uint16) — the
+    dtype serving stacks in either cache posture, which keys the
+    compiled program.  Specs at or below ``cpu_fallback_max_px`` are
+    skipped: the handler routes those renders to the host kernel, so a
+    device program would never be hit.
     """
     for spec in specs:
-        C, edge, quality = parse_spec(spec)
+        C, edge, quality, raw_dtype = parse_spec(spec)
         if edge * edge <= cpu_fallback_max_px:
             logger.info(
                 "prewarm %s skipped: %dx%d px is at/below "

@@ -385,3 +385,36 @@ class TestPrewarm:
         status, headers, body = r
         assert status == 200
         assert body[:2] == b"\xff\xd8"
+
+
+class TestUncachedPosturesMatch:
+    def test_raw_cache_off_serves_identical_bytes(self, data_dir):
+        """raw-cache disabled must serve byte-identical output to the
+        default posture: both stage STORAGE dtype (the uncached branch
+        stopped casting to float32 — it halves that posture's upload
+        bytes) and run the same device programs."""
+        from omero_ms_image_region_tpu.server.config import (
+            RawCacheConfig,
+        )
+
+        # Two windows over the same tile: in the cached posture the
+        # second render replays the DEVICE-resident raw (distinct byte-
+        # cache keys force a re-render); cpu-fallback is disabled so
+        # both postures exercise the batched device path this change
+        # touches (uint16 staging end to end).
+        paths = [(f"/webgateway/render_image_region/{IMG}/0/0"
+                  f"?tile=0,0,0,64,64&format=png&m=c"
+                  f"&c=1|{lo}:60000$FF0000,2|0:50000$00FF00")
+                 for lo in (1000, 2000)]
+        reqs = [("GET", p) for p in paths]
+        cfg_on = AppConfig(data_dir=data_dir)
+        cfg_on.renderer.cpu_fallback_max_px = 0
+        cfg_off = AppConfig(data_dir=data_dir,
+                            raw_cache=RawCacheConfig(enabled=False))
+        cfg_off.renderer.cpu_fallback_max_px = 0
+        on = client_fetch(data_dir, *reqs, config=cfg_on)
+        off = client_fetch(data_dir, *reqs, config=cfg_off)
+        for a, b in zip(on, off):
+            assert a[0] == 200 and b[0] == 200
+            assert a[2] == b[2]
+        assert on[0][2] != on[1][2]   # the two windows truly differ

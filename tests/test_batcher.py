@@ -418,10 +418,10 @@ class TestPrewarm:
         # ... but a VALID spec whose compile dies is logged, not fatal.
         prewarm_renderer(["3x64"], ("no-such-engine",), 2, ((64, 64),))
 
-    def test_prewarm_skips_cpu_fallback_shapes_and_warms_f32(self):
+    def test_prewarm_skips_cpu_fallback_shapes_and_dtype_specs(self):
         """Shapes the CPU fallback serves are skipped (their device
-        program would never be hit); the uncached posture warms the
-        float32 programs serving actually stacks."""
+        program would never be hit); a spec's :dtype suffix warms the
+        storage dtype those images actually stage."""
         from omero_ms_image_region_tpu.server.prewarm import (
             prewarm_renderer,
         )
@@ -430,6 +430,5 @@ class TestPrewarm:
         # with a bogus engine that would fail compile).
         prewarm_renderer(["3x64"], ("no-such-engine",), 2, ((64, 64),),
                          cpu_fallback_max_px=64 * 64)
-        # float32 raw (raw-cache-off posture) compiles fine.
-        prewarm_renderer(["3x64"], ("sparse",), 2, ((64, 64),),
-                         raw_dtype=np.float32)
+        # Non-default storage dtype (uint8 sources) compiles fine.
+        prewarm_renderer(["3x64:uint8"], ("sparse",), 2, ((64, 64),))

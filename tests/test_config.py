@@ -197,10 +197,14 @@ def test_prewarm_specs_parse_and_validate():
     assert cfg.renderer.prewarm == ("4x1024", "3x512@90")
     assert AppConfig.from_dict({}).renderer.prewarm == ()
 
-    assert parse_spec("4x1024") == (4, 1024, 85)   # LocalCompress default
-    assert parse_spec("3x512@90") == (3, 512, 90)
+    import numpy as np
+    assert parse_spec("4x1024") == (4, 1024, 85, np.dtype(np.uint16))
+    assert parse_spec("3x512@90") == (3, 512, 90, np.dtype(np.uint16))
+    assert parse_spec("1x256:uint8") == (1, 256, 85, np.dtype(np.uint8))
+    assert parse_spec("2x256@70:float32") == (2, 256, 70,
+                                              np.dtype(np.float32))
     for bad in ("x1024", "4x", "4x1000", "0x256", "4x256@0", "4x256@101",
-                "4x20"):
+                "4x20", "4x256:uint64", "4x256:bogus"):
         with pytest.raises(ValueError):
             parse_spec(bad)
     # Malformed specs fail at config LOAD, not at first serving touch.

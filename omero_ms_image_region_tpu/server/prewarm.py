@@ -11,22 +11,22 @@ front-loads reader construction cost at startup
 ``renderer.prewarm`` lists the tile shapes a deployment expects, e.g.::
 
     renderer:
-        prewarm: ["4x1024", "3x512@90"]
+        prewarm: ["4x1024", "3x512@90", "2x1024:uint8"]
 
-Each spec is ``<channels>x<tile-edge>[@quality]`` (quality defaults to
-the LocalCompress default).  For every spec the serving-path programs
-are compiled through the real ops entry points with the renderer's own
-wire engine(s):
+Each spec is ``<channels>x<tile-edge>[@quality][:dtype]`` (quality
+defaults to the LocalCompress default; ``:dtype`` names the images'
+storage dtype, default uint16 — serving stages storage dtype in both
+cache postures, and the dtype keys the compiled program).  For every
+spec the serving-path programs are compiled through the real ops entry
+points with the renderer's own wire engine(s):
 
 - the batched JPEG program at batch 1 (the idle lone-tile path — what
   single-tile p50 rides) and at ``max_batch`` (the loaded steady
   state);
 - the packed-RGBA program at batch 1 (png/tif formats).
 
-Raw inputs are uint16 — the storage dtype the HBM raw-tile cache keeps
-tiles in, which keys the compiled program — and settings use the
-ramp-weight table form (plain color channels; LUT renders compile on
-first use).
+Settings use the ramp-weight table form (plain color channels; LUT
+renders compile on first use).
 """
 
 from __future__ import annotations

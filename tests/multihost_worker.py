@@ -1,11 +1,12 @@
-"""One process of a simulated 2-process multi-host pod (CPU backend).
+"""One process of a simulated multi-host pod (CPU backend).
 
-Launched by ``tests/test_multihost.py`` — NOT a pytest module.  Each
-process owns 4 virtual CPU devices; ``jax.distributed`` joins them into
-one 8-device slice and the mesh-sharded render step runs SPMD across
-both, exactly as a 2-host TPU pod would.  Prints one JSON line with
-per-process shard checksums (all-gathered, so the test can assert every
-process observed the same global result).
+Launched by ``tests/test_multihost.py`` — NOT a pytest module.  The
+pod's process count arrives as argv[4] (2 or 4 in the tests); each
+process owns ``8 // nprocs`` virtual CPU devices and ``jax.distributed``
+joins them into one 8-device slice over which the mesh-sharded render
+step runs SPMD, exactly as an N-host TPU pod would.  Prints one JSON
+line with per-process shard checksums (all-gathered, so the test can
+assert every process observed the same global result).
 """
 
 import json
@@ -88,8 +89,10 @@ def main() -> int:
     pid = int(sys.argv[1])
     coordinator = sys.argv[2]
     mode = sys.argv[3] if len(sys.argv) > 3 else "checksum"
+    nprocs = int(sys.argv[4]) if len(sys.argv) > 4 else 2
     os.environ["JAX_PLATFORMS"] = "cpu"
-    ndev = 8 if mode == "reference" else 4
+    # The global mesh is always 8 devices; each process owns its slice.
+    ndev = 8 if mode == "reference" else 8 // nprocs
     os.environ["XLA_FLAGS"] = \
         f"--xla_force_host_platform_device_count={ndev}"
     sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -111,8 +114,8 @@ def main() -> int:
         render_step_sharded_batched, shard_batch_batched)
 
     cluster.initialize(coordinator_address=coordinator,
-                       num_processes=2, process_id=pid)
-    assert jax.process_count() == 2, jax.process_count()
+                       num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs, jax.process_count()
     assert jax.device_count() == 8, jax.device_count()
 
     if mode == "serve":

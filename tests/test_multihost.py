@@ -45,7 +45,8 @@ def _run_workers(mode: str, pids) -> dict:
     env = _clean_env()
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(pid), coordinator, mode],
+            [sys.executable, _WORKER, str(pid), coordinator, mode,
+             str(len(pids))],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
             text=True)
         for pid in pids
@@ -86,6 +87,22 @@ def test_two_process_pod_serves_groups_via_follower_replication():
     outs = _run_workers("serve", (0, 1))
     leader, follower = outs[0], outs[1]
     assert follower["follower_groups"] == 2
+    assert leader["n_jpegs"] == 8
+
+    ref = _run_workers("reference", (0,))[0]
+    assert ref["packed_sha"] == leader["packed_sha"]
+    assert ref["jpeg_sha"] == leader["jpeg_sha"]
+
+
+def test_four_process_pod_serves_identically():
+    """The pod serving loop at 4 processes x 2 devices: three followers
+    replay the leader's dispatches, and the leader's digests still
+    equal the single-process 8-device reference — replication and
+    lockstep are process-count-independent."""
+    outs = _run_workers("serve", (0, 1, 2, 3))
+    leader = outs[0]
+    for pid in (1, 2, 3):
+        assert outs[pid]["follower_groups"] == 2
     assert leader["n_jpegs"] == 8
 
     ref = _run_workers("reference", (0,))[0]

@@ -13,7 +13,8 @@ directly; scope is exactly what serving needs:
   LZW (5), new-style JPEG (7, baseline; tables from tag 347, via
   ``io/jpegdec``), deflate (8 / 32946), PackBits (32773), Aperio
   JPEG 2000 (33003/33005, via ``io/jp2k``);
-- horizontal-differencing predictor (317 = 2);
+- predictors (317): horizontal differencing (2) and floating-point
+  byte differencing (3, TIFF TechNote 3); unknown ids reject loudly;
 - SubIFD chains (330) — OME-TIFF 6.0 stores pyramid levels there;
 - sample types: u8/u16/u32, i8/i16/i32, f32/f64 via 258/339.
 
@@ -254,6 +255,35 @@ def _undo_predictor(rows: np.ndarray) -> np.ndarray:
     subtraction did (modular arithmetic), so no widening is needed.
     """
     return np.cumsum(rows, axis=1, dtype=rows.dtype)
+
+
+def _undo_float_predictor(data: bytes, seg_h: int, seg_w: int, spp: int,
+                          dt: np.dtype) -> np.ndarray:
+    """Predictor 3 = floating-point horizontal differencing (TIFF
+    Technical Note 3; GDAL/ImageJ float exports).
+
+    Per row the encoder splits each value into its bytes, regroups them
+    byte-plane-major — ALL most-significant bytes first, regardless of
+    the file's byte order — then byte-wise horizontally differences the
+    whole row.  Undo: uint8 cumsum along the row (wrapping, mirroring
+    the encoder's modular subtraction), de-interleave the byte planes,
+    and view the reassembled per-value bytes big-endian.
+    """
+    n = seg_w * spp
+    rows = np.frombuffer(data, np.uint8,
+                         count=seg_h * n * dt.itemsize).reshape(
+        seg_h, n * dt.itemsize)
+    # The encoder (libtiff fpDiff) differences the reorganized row's
+    # bytes in stride-spp chains — per-sample chains, continuing across
+    # the byte-plane boundaries — so the undo accumulates the same way.
+    rows = rows.reshape(seg_h, -1, spp).cumsum(
+        axis=1, dtype=np.uint8).reshape(seg_h, n * dt.itemsize)
+    planes = rows.reshape(seg_h, dt.itemsize, n)
+    be = np.ascontiguousarray(planes.transpose(0, 2, 1))
+    arr = be.reshape(seg_h, n * dt.itemsize).view(dt.newbyteorder(">"))
+    return np.ascontiguousarray(
+        arr.astype(dt.newbyteorder("="), copy=False)).reshape(
+        seg_h, seg_w, spp)
 
 
 class TiffFile:
@@ -558,12 +588,25 @@ class TiffFile:
                                                   seg_h, seg_w, spp)
         data = decode_segment(raw, comp,
                               seg_h * seg_w * spp * dt.itemsize)
+        predictor = int(ifd.one(PREDICTOR, 1))
+        if predictor == 3:
+            # Byte-level transform: must run BEFORE the dtype view.
+            if dt.kind != "f":
+                raise ValueError(
+                    f"{self.path}: predictor 3 (floating point) on "
+                    f"non-float samples ({dt})")
+            return _undo_float_predictor(data, seg_h, seg_w, spp, dt)
+        if predictor not in (1, 2):
+            # An unrecognized predictor silently ignored would serve
+            # garbage samples; reject loudly instead.
+            raise ValueError(
+                f"{self.path}: unsupported TIFF predictor {predictor}")
         arr = np.frombuffer(data, dtype=dt,
                             count=seg_h * seg_w * spp)
         arr = arr.reshape(seg_h, seg_w, spp)
         arr = np.ascontiguousarray(
             arr.astype(arr.dtype.newbyteorder("="), copy=False))
-        if int(ifd.one(PREDICTOR, 1)) == 2:
+        if predictor == 2:
             arr = _undo_predictor(arr)
         return arr
 

@@ -219,7 +219,13 @@ def decode_segment(data: bytes, compression: int,
     if compression == 1:
         return data
     if compression in (8, 32946):    # Adobe deflate / old deflate
-        return zlib.decompress(data)
+        try:
+            return zlib.decompress(data)
+        except zlib.error as e:
+            # One error contract across codecs: corrupt streams raise
+            # ValueError here like the LZW/PackBits paths do, not a
+            # bare zlib.error.
+            raise ValueError(f"corrupt deflate segment: {e}") from e
     if compression == 5:
         # Native LZW when available (the pure-Python fallback runs
         # ~1 MB/s — too slow for cold pans over LZW OME-TIFF exports);

@@ -57,8 +57,25 @@ def _corpus(rng):
         "jp2k": [jp2k_enc(gray, irreversible=False),
                  jp2k_enc(rgb, irreversible=True)],
         "jpeg": [jpeg],
-        "tiff": [tiff],
+        "tiff": [tiff, _pred3_tiff(rng)],
     }
+
+
+def _pred3_tiff(rng) -> bytes:
+    """Deflate + predictor-3 float TIFF (the TechNote 3 byte-transform
+    path is parse logic fed by hostile data too).  Built with the SAME
+    helpers as tests/test_tiff.py so seed and test cannot drift."""
+    import io as _io
+    import zlib
+
+    from test_tiff import encode_pred3, write_float_tiff
+
+    h, w, spp = 24, 32, 3
+    img = (rng.standard_normal((h, w * spp)) * 50).astype(np.float32)
+    payload = zlib.compress(encode_pred3(img, spp=spp))
+    buf = _io.BytesIO()
+    write_float_tiff(buf, 3, payload, h, w, spp)
+    return buf.getvalue()
 
 
 def mutate(rng, data: bytes) -> bytes:

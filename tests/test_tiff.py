@@ -556,8 +556,7 @@ def test_corrupt_and_truncated_tiffs_fail_cleanly(tmp_path):
             # Structure parsed; reads must still either work or raise.
             try:
                 src.get_region(0, 0, 0, RegionDef(0, 0, 64, 64), 0)
-            except (ValueError, EOFError, KeyError, OSError,
-                    __import__("zlib").error):
+            except (ValueError, EOFError, KeyError, OSError):
                 pass
             src.close()
         except (ValueError, EOFError, KeyError, OSError):
@@ -951,6 +950,40 @@ def test_xml_entity_expansion_rejected(tmp_path):
         OmeTiffSource(str(tmp_path / "s.ome.tiff"))
 
 
+def encode_pred3(rows: np.ndarray, spp: int = 1) -> bytes:
+    """Predictor-3 forward transform (libtiff fpDiff): per row,
+    big-endian bytes regrouped byte-plane-major, then byte-wise
+    differenced in stride-spp chains.  Shared with
+    scripts/fuzz_decoders.py so the fuzz seed and this test can never
+    drift from each other."""
+    hh = rows.shape[0]
+    be = rows.astype(">f4")
+    by = be.view(np.uint8).reshape(hh, -1, 4)
+    planes = np.ascontiguousarray(
+        by.transpose(0, 2, 1)).reshape(hh, -1)
+    diff = planes.astype(np.int16)
+    diff[:, spp:] -= planes[:, :-spp].astype(np.int16)
+    return (diff & 0xFF).astype(np.uint8).tobytes()
+
+
+def write_float_tiff(out_file, predictor, payload, h, w, spp=1):
+    """Minimal deflate float TIFF with the given predictor tag;
+    ``out_file`` is a binary file object."""
+    from omero_ms_image_region_tpu.io.tiffwrite import _TiffOut
+
+    out = _TiffOut(out_file, big=False)
+    data_off = out.write(payload)
+    ifd_off, _ = out.write_ifd([
+        (256, 3, [w]), (257, 3, [h]),          # width / length
+        (258, 3, [32] * spp), (259, 3, [8]),   # bits / deflate
+        (262, 3, [1]), (277, 3, [spp]),        # photometric / spp
+        (278, 3, [h]),                         # rows per strip
+        (273, 4, [data_off]), (279, 4, [len(payload)]),
+        (317, 3, [predictor]), (339, 3, [3] * spp),
+    ])
+    out.patch_first_ifd(ifd_off)
+
+
 def test_float_predictor3(tmp_path):
     """Predictor 3 (floating-point horizontal differencing, TIFF
     TechNote 3 — GDAL/ImageJ float exports): decoded exactly.  An
@@ -959,39 +992,15 @@ def test_float_predictor3(tmp_path):
     import zlib
 
     from omero_ms_image_region_tpu.io.tiff import TiffFile
-    from omero_ms_image_region_tpu.io.tiffwrite import _TiffOut
 
     rng = np.random.default_rng(50)
     h, w = 23, 37
     img = (rng.standard_normal((h, w)) * 100).astype(np.float32)
 
-    def encode_pred3(rows: np.ndarray, spp: int = 1) -> bytes:
-        # Forward transform per spec (libtiff fpDiff): per row,
-        # big-endian bytes regrouped byte-plane-major, then byte-wise
-        # differenced in stride-spp chains.
-        hh = rows.shape[0]
-        be = rows.astype(">f4")
-        by = be.view(np.uint8).reshape(hh, -1, 4)
-        planes = np.ascontiguousarray(
-            by.transpose(0, 2, 1)).reshape(hh, -1)
-        diff = planes.astype(np.int16)
-        diff[:, spp:] -= planes[:, :-spp].astype(np.int16)
-        return (diff & 0xFF).astype(np.uint8).tobytes()
-
     def write_one(path, predictor, payload, spp=1, width=None):
         with open(path, "wb") as f:
-            out = _TiffOut(f, big=False)
-            data_off = out.write(payload)
-            ww = w if width is None else width
-            ifd_off, next_pos = out.write_ifd([
-                (256, 3, [ww]), (257, 3, [h]),     # width / length
-                (258, 3, [32] * spp), (259, 3, [8]),   # bits / deflate
-                (262, 3, [1]), (277, 3, [spp]),    # photometric / spp
-                (278, 3, [h]),                     # rows per strip
-                (273, 4, [data_off]), (279, 4, [len(payload)]),
-                (317, 3, [predictor]), (339, 3, [3] * spp),
-            ])
-            out.patch_first_ifd(ifd_off)
+            write_float_tiff(f, predictor, payload, h,
+                             w if width is None else width, spp)
 
     p3 = str(tmp_path / "pred3.tif")
     write_one(p3, 3, zlib.compress(encode_pred3(img)))

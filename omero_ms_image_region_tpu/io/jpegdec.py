@@ -202,18 +202,19 @@ def _decode_huff(reader: _BitReader, table: _Huff) -> int:
 
 def _parse_segments(data: bytes, tables: _TableSet):
     """Walk marker segments until SOS (or EOI).  Returns
-    (frame, scan_components, scan_start) — frame is None for a
+    (frame, first_scan, scan_start, progressive) — frame is None for a
     tables-only stream."""
     if len(data) < 2 or data[0] != 0xFF or data[1] != 0xD8:
         raise JpegError("no SOI")
     pos = 2
     frame: Optional[Tuple[int, int, List[_Component]]] = None
+    progressive = False
     while pos + 2 <= len(data):
         if data[pos] != 0xFF:
             raise JpegError(f"expected marker at {pos}")
         marker = data[pos + 1]
         if marker == 0xD9:               # EOI (tables-only stream)
-            return frame, None, pos
+            return frame, None, pos, progressive
         if marker == 0x01 or 0xD0 <= marker <= 0xD7:
             pos += 2                     # standalone marker, no length
             continue
@@ -253,7 +254,7 @@ def _parse_segments(data: bytes, tables: _TableSet):
             if len(body) < 2:
                 raise JpegError("truncated DRI")
             tables.restart_interval = struct.unpack(">H", body[:2])[0]
-        elif marker == 0xC0 or marker == 0xC1:   # SOF0/1 (baseline)
+        elif marker in (0xC0, 0xC1, 0xC2):   # SOF0/1 baseline, SOF2 prog
             if len(body) < 6:
                 raise JpegError("truncated SOF")
             if body[0] != 8:
@@ -262,7 +263,7 @@ def _parse_segments(data: bytes, tables: _TableSet):
                 # silently saturated garbage.
                 raise JpegError(
                     f"unsupported sample precision {body[0]} "
-                    f"(baseline 8-bit only)")
+                    f"(8-bit only)")
             h, w = struct.unpack(">HH", body[1:5])
             ncomp = body[5]
             if not 1 <= ncomp <= 4 or len(body) < 6 + 3 * ncomp:
@@ -282,40 +283,60 @@ def _parse_segments(data: bytes, tables: _TableSet):
             if h == 0 or w == 0:
                 raise JpegError("zero frame dimension")
             frame = (h, w, comps)
-        elif marker in (0xC2, 0xC3, 0xC5, 0xC6, 0xC7,
+            progressive = marker == 0xC2
+        elif marker in (0xC3, 0xC5, 0xC6, 0xC7,
                         0xC9, 0xCA, 0xCB, 0xCD, 0xCE, 0xCF):
             raise JpegError(
                 f"unsupported JPEG process (SOF{marker & 0xF})")
         elif marker == 0xDA:             # SOS
             if frame is None:
                 raise JpegError("SOS before SOF")
-            if len(body) < 1:
-                raise JpegError("truncated SOS")
-            ns = body[0]
-            if not 1 <= ns <= 4 or len(body) < 1 + 2 * ns:
-                raise JpegError("truncated SOS components")
-            if ns != len(frame[2]):
-                # Non-interleaved multi-scan baseline files exist but
-                # this decoder walks one interleaved scan; misparsing
-                # the entropy stream would yield garbage, so fail loud.
-                raise JpegError(
-                    "non-interleaved (multi-scan) JPEG is not "
-                    "supported")
-            sel = []
-            for si in range(ns):
-                cs, tdta = body[1 + 2 * si:3 + 2 * si]
-                sel.append((cs, tdta >> 4, tdta & 0xF))
-            for cs, td, ta in sel:
-                for c in frame[2]:
-                    if c.ident == cs:
-                        c.td, c.ta = td, ta
-                        break
-                else:
-                    raise JpegError(f"SOS names unknown component {cs}")
-            return frame, sel, pos + 2 + seglen
+            scan = _parse_sos_body(body, frame, progressive)
+            return frame, scan, pos + 2 + seglen, progressive
         # APPn/COM/others: skipped.
         pos += 2 + seglen
     raise JpegError("no SOS/EOI")
+
+
+def _parse_sos_body(body: bytes, frame, progressive: bool):
+    """SOS body -> (selected components, Ss, Se, Ah, Al).
+
+    Baseline keeps the one-interleaved-scan constraint; progressive
+    scans may name any component subset (non-interleaved AC scans are
+    mandatory there, T.81 G.1.1.1.1)."""
+    if len(body) < 1:
+        raise JpegError("truncated SOS")
+    ns = body[0]
+    if not 1 <= ns <= 4 or len(body) < 1 + 2 * ns + 3:
+        raise JpegError("truncated SOS components")
+    if not progressive and ns != len(frame[2]):
+        # Non-interleaved multi-scan BASELINE files exist but this
+        # decoder walks one interleaved scan; misparsing the entropy
+        # stream would yield garbage, so fail loud.
+        raise JpegError(
+            "non-interleaved (multi-scan) sequential JPEG is not "
+            "supported")
+    sel = []
+    for si in range(ns):
+        cs, tdta = body[1 + 2 * si:3 + 2 * si]
+        for c in frame[2]:
+            if c.ident == cs:
+                c.td, c.ta = tdta >> 4, tdta & 0xF
+                sel.append(c)
+                break
+        else:
+            raise JpegError(f"SOS names unknown component {cs}")
+    ss, se, ahal = body[1 + 2 * ns:4 + 2 * ns]
+    ah, al = ahal >> 4, ahal & 0xF
+    if progressive:
+        if ss > se or se > 63 or al > 13 or ah > 13:
+            raise JpegError(f"bad spectral selection {ss}..{se}")
+        if ss == 0 and se != 0:
+            raise JpegError("progressive DC scan must have Se=0")
+        if ss > 0 and len(sel) != 1:
+            raise JpegError("progressive AC scan must be single-"
+                            "component")
+    return sel, ss, se, ah, al
 
 
 def _jpeg_error_contract(fn):
@@ -348,16 +369,17 @@ def parse_jpeg_tables(tables_bytes: bytes) -> _TableSet:
 def decode_baseline_jpeg(data: bytes,
                          tables: Optional[_TableSet] = None
                          ) -> np.ndarray:
-    """Decode one baseline JPEG (optionally abbreviated) to
-    ``u8[h, w, ncomp]`` raw components (no color transform)."""
+    """Decode one JPEG (baseline SOF0/1 or progressive SOF2, optionally
+    abbreviated) to ``u8[h, w, ncomp]`` raw components (no color
+    transform)."""
     ts = _TableSet()
     if tables is not None:
         ts.quant.update(tables.quant)
         ts.huff_dc.update(tables.huff_dc)
         ts.huff_ac.update(tables.huff_ac)
         ts.restart_interval = tables.restart_interval
-    frame, sel, scan_start = _parse_segments(data, ts)
-    if frame is None or sel is None:
+    frame, scan, scan_start, progressive = _parse_segments(data, ts)
+    if frame is None or scan is None:
         raise JpegError("stream has no frame/scan")
     h, w, comps = frame
     hmax = max(c.h for c in comps)
@@ -368,13 +390,21 @@ def decode_baseline_jpeg(data: bytes,
     for c in comps:
         if c.tq not in ts.quant:
             raise JpegError(f"missing quant table {c.tq}")
-        if c.td not in ts.huff_dc or c.ta not in ts.huff_ac:
-            raise JpegError("missing huffman table")
 
     # Per-component coefficient grids [by, bx, 64] (zigzag order).
     grids = []
     for c in comps:
         grids.append(np.zeros((mcuy * c.v, mcux * c.h, 64), np.int32))
+
+    if progressive:
+        _decode_progressive_scans(data, ts, frame, grids, scan,
+                                  scan_start, hmax, vmax, mcux, mcuy)
+        return _reconstruct(frame, ts, grids, hmax, vmax)
+
+    sel, ss, se, ah, al = scan
+    for c in comps:
+        if c.td not in ts.huff_dc or c.ta not in ts.huff_ac:
+            raise JpegError("missing huffman table")
 
     reader = _BitReader(data, scan_start)
     preds = [0] * len(comps)
@@ -421,8 +451,13 @@ def decode_baseline_jpeg(data: bytes,
         # Trailing RST is tolerated; anything else is malformed.
         if not (0xD0 <= (reader.marker or 0) <= 0xD7):
             raise JpegError(f"unexpected marker {reader.marker:#x}")
+    return _reconstruct(frame, ts, grids, hmax, vmax)
 
-    # Vectorized dequant + IDCT + level shift, per component.
+
+def _reconstruct(frame, ts: _TableSet, grids, hmax: int,
+                 vmax: int) -> np.ndarray:
+    """Vectorized dequant + IDCT + level shift, per component."""
+    h, w, comps = frame
     planes = []
     for c, grid in zip(comps, grids):
         q = ts.quant[c.tq]
@@ -443,6 +478,255 @@ def decode_baseline_jpeg(data: bytes,
     return np.stack(planes, axis=-1)
 
 
+# ---------------------------------------------------- progressive scans
+
+# Bound on the scan count (T.81 allows many; real encoders emit ~10):
+# hostile streams must not drive unbounded re-walks of the image.
+_MAX_SCANS = 256
+
+# Cumulative block-visit budget across ALL scans: every scan re-walks
+# its band over the frame, so scan count alone is not a work bound — a
+# tiny stream declaring a huge frame plus many refinement scans (which
+# decode "successfully" off the reader's 1-bit padding) would amplify
+# ~256x past the frame-size cap.  Each Python block visit costs ~1 us,
+# so 8M bounds a hostile stream's CPU at seconds, while a 4096^2
+# 10-scan progressive photo (~7.8M visits) still decodes and real WSI
+# tiles (<= 2048^2, ~2M visits for a rich 10-scan file) clear it with
+# wide margin.
+_MAX_BLOCK_VISITS = 1 << 23
+
+
+def _next_marker_pos(data: bytes, pos: int) -> int:
+    """First non-RST, non-stuffing marker at/after ``pos`` (the segment
+    stream between progressive scans)."""
+    while pos + 1 < len(data):
+        if data[pos] == 0xFF and data[pos + 1] not in (0x00, 0xFF) \
+                and not (0xD0 <= data[pos + 1] <= 0xD7):
+            return pos
+        pos += 1
+    raise JpegError("no marker after scan")
+
+
+def _decode_progressive_scans(data, ts, frame, grids, scan, scan_start,
+                              hmax, vmax, mcux, mcuy) -> None:
+    """Accumulate every progressive scan into the coefficient grids.
+
+    DC scans (Ss=0) walk the MCU grid interleaved (or a component's own
+    block grid when single-component); AC scans (Ss>0) are always
+    single-component and walk the component's TRUE block grid — MCU
+    padding blocks are not coded in non-interleaved scans
+    (T.81 G.2 / A.2.2).
+    """
+    h, w, comps = frame
+    visits = 0
+    for _ in range(_MAX_SCANS):
+        sel, ss, se, ah, al = scan
+        if ss == 0:
+            visits += (sum(mcux * c.h * mcuy * c.v for c in sel)
+                       if len(sel) > 1 else
+                       int(np.prod(_comp_block_dims(sel[0], h, w,
+                                                    hmax, vmax))))
+        else:
+            visits += int(np.prod(_comp_block_dims(sel[0], h, w,
+                                                   hmax, vmax)))
+        if visits > _MAX_BLOCK_VISITS:
+            raise JpegError("progressive stream exceeds the "
+                            "cumulative block budget")
+        reader = _BitReader(data, scan_start)
+        if ss == 0:
+            _prog_dc_scan(reader, ts, sel, comps, grids, ah, al,
+                          mcux, mcuy, h, w, hmax, vmax)
+        else:
+            _prog_ac_scan(reader, ts, sel[0], comps, grids, ss, se,
+                          ah, al, h, w, hmax, vmax)
+        # Next segment stream starts at the first marker past the
+        # scan's entropy bytes.
+        pos = _next_marker_pos(data, reader.pos)
+        scan = None
+        while pos + 2 <= len(data):
+            marker = data[pos + 1]
+            if marker == 0xD9:           # EOI: done
+                return
+            if pos + 4 > len(data):
+                raise JpegError("truncated segment")
+            seglen = struct.unpack(">H", data[pos + 2:pos + 4])[0]
+            if seglen < 2 or pos + 2 + seglen > len(data):
+                raise JpegError("truncated segment")
+            body = data[pos + 4:pos + 2 + seglen]
+            if marker == 0xDA:
+                scan = _parse_sos_body(body, frame, True)
+                scan_start = pos + 2 + seglen
+                break
+            # Inter-scan DHT/DQT/DRI updates reuse the SOI-path parser
+            # by faking a minimal stream prefix.
+            _parse_segments(
+                b"\xff\xd8" + data[pos:pos + 2 + seglen] + b"\xff\xd9",
+                ts)
+            pos += 2 + seglen
+        if scan is None:
+            raise JpegError("progressive stream ended without EOI")
+    raise JpegError(f"more than {_MAX_SCANS} scans")
+
+
+def _comp_block_dims(c, h, w, hmax, vmax):
+    """A component's TRUE (non-interleaved) block-grid dimensions."""
+    cw = -(-w * c.h // hmax)
+    ch = -(-h * c.v // vmax)
+    return -(-ch // 8), -(-cw // 8)
+
+
+def _prog_dc_scan(reader, ts, sel, comps, grids, ah, al,
+                  mcux, mcuy, h, w, hmax, vmax) -> None:
+    for c in sel:
+        if ah == 0 and c.td not in ts.huff_dc:
+            raise JpegError("missing huffman table")
+    ri = ts.restart_interval
+    preds = {c.ident: 0 for c in sel}
+    interleaved = len(sel) > 1
+
+    def first_bit(c, grid, by, bx):
+        t = _decode_huff(reader, ts.huff_dc[c.td])
+        if t > 15:
+            raise JpegError("bad DC category")
+        preds[c.ident] += _extend(reader.receive(t), t)
+        grid[by, bx, 0] = preds[c.ident] << al
+
+    def refine_bit(c, grid, by, bx):
+        if reader.receive(1):
+            grid[by, bx, 0] |= (1 << al)
+
+    visit = first_bit if ah == 0 else refine_bit
+    unit = 0
+    if interleaved:
+        pairs = [(c, grids[comps.index(c)]) for c in sel]
+        for my in range(mcuy):
+            for mx in range(mcux):
+                if ri and unit and unit % ri == 0:
+                    reader.restart()
+                    preds = {c.ident: 0 for c in sel}
+                unit += 1
+                for c, grid in pairs:
+                    for by in range(c.v):
+                        for bx in range(c.h):
+                            visit(c, grid, my * c.v + by, mx * c.h + bx)
+    else:
+        c = sel[0]
+        grid = grids[comps.index(c)]
+        nby, nbx = _comp_block_dims(c, h, w, hmax, vmax)
+        for by in range(nby):
+            for bx in range(nbx):
+                if ri and unit and unit % ri == 0:
+                    reader.restart()
+                    preds = {c.ident: 0 for c in sel}
+                unit += 1
+                visit(c, grid, by, bx)
+
+
+def _prog_ac_scan(reader, ts, c, comps, grids, ss, se, ah, al,
+                  h, w, hmax, vmax) -> None:
+    if c.ta not in ts.huff_ac:
+        raise JpegError("missing huffman table")
+    ac_tbl = ts.huff_ac[c.ta]
+    grid = grids[comps.index(c)]
+    nby, nbx = _comp_block_dims(c, h, w, hmax, vmax)
+    ri = ts.restart_interval
+    eobrun = 0
+    unit = 0
+    for by in range(nby):
+        for bx in range(nbx):
+            if ri and unit and unit % ri == 0:
+                reader.restart()
+                eobrun = 0
+            unit += 1
+            block = grid[by, bx]
+            if ah == 0:
+                eobrun = _ac_first_block(reader, ac_tbl, block, ss, se,
+                                         al, eobrun)
+            else:
+                eobrun = _ac_refine_block(reader, ac_tbl, block, ss, se,
+                                          al, eobrun)
+
+
+def _ac_first_block(reader, ac_tbl, block, ss, se, al, eobrun) -> int:
+    """T.81 G.2.2: first pass over an AC spectral band."""
+    if eobrun:
+        return eobrun - 1
+    k = ss
+    while k <= se:
+        rs = _decode_huff(reader, ac_tbl)
+        r, s = rs >> 4, rs & 0xF
+        if s == 0:
+            if r == 15:
+                k += 16                       # ZRL
+                continue
+            eobrun = 1 << r
+            if r:
+                eobrun += reader.receive(r)
+            return eobrun - 1                 # covers this block
+        k += r
+        if k > se:
+            raise JpegError("AC run overflow")
+        block[k] = _extend(reader.receive(s), s) << al
+        k += 1
+    return 0
+
+
+def _ac_refine_block(reader, ac_tbl, block, ss, se, al, eobrun) -> int:
+    """T.81 G.2.3 correction pass (the jdphuff.c refinement walk):
+    every already-nonzero coefficient in the band gets one correction
+    bit as it is passed; zero-history coefficients consume the run
+    lengths and receive new ±1<<Al values."""
+    p1 = 1 << al
+    m1 = -1 << al
+
+    def correct(k):
+        # libjpeg's jdphuff form: partially-decoded coefficients are
+        # multiples of p1, where (x & p1) == (|x| & p1) in two's
+        # complement, so the signed test equals the spec's magnitude
+        # test.
+        if reader.receive(1) and not (block[k] & p1):
+            block[k] += p1 if block[k] >= 0 else m1
+
+    k = ss
+    if not eobrun:
+        while k <= se:
+            rs = _decode_huff(reader, ac_tbl)
+            r, s = rs >> 4, rs & 0xF
+            val = 0
+            if s == 0:
+                if r != 15:
+                    eobrun = 1 << r
+                    if r:
+                        eobrun += reader.receive(r)
+                    break
+                # r == 15: run of 16 zero-history coefficients
+            else:
+                if s != 1:
+                    raise JpegError("bad refinement size")
+                val = p1 if reader.receive(1) else m1
+            while k <= se:
+                if block[k]:
+                    correct(k)
+                else:
+                    if r == 0:
+                        if val:
+                            block[k] = val
+                        k += 1
+                        break
+                    r -= 1
+                k += 1
+            else:
+                if val:
+                    raise JpegError("refinement value past band end")
+    if eobrun:
+        while k <= se:
+            if block[k]:
+                correct(k)
+            k += 1
+        eobrun -= 1
+    return eobrun
+
+
 def ycbcr_to_rgb(img: np.ndarray) -> np.ndarray:
     """JFIF YCbCr -> RGB on u8[h, w, 3] (BT.601 full range)."""
     y = img[..., 0].astype(np.float32)
@@ -456,26 +740,55 @@ def ycbcr_to_rgb(img: np.ndarray) -> np.ndarray:
     return np.clip(np.round(rgb), 0, 255).astype(np.uint8)
 
 
+def _sniff_sof(data: bytes) -> Optional[int]:
+    """The stream's SOF marker byte (0xC0..0xCF), or None.  Header-only
+    walk — used to route progressive (SOF2) streams straight to the
+    Python decoder instead of bouncing off the baseline-only native
+    one."""
+    pos = 2
+    while pos + 4 <= len(data):
+        if data[pos] != 0xFF:
+            return None
+        marker = data[pos + 1]
+        if marker == 0xD9 or marker == 0xDA:
+            return None
+        if marker == 0x01 or 0xD0 <= marker <= 0xD7:
+            pos += 2
+            continue
+        if 0xC0 <= marker <= 0xCF and marker not in (0xC4, 0xC8, 0xCC):
+            return marker
+        seglen = struct.unpack(">H", data[pos + 2:pos + 4])[0]
+        if seglen < 2:
+            return None
+        pos += 2 + seglen
+    return None
+
+
 def decode_tiff_jpeg(data: bytes, tables_bytes: Optional[bytes],
                      photometric: int,
                      tables_cache: Optional[dict] = None) -> np.ndarray:
     """Decode one TIFF compression-7 segment to ``u8[h, w, spp]``.
 
     Prefers the native decoder (``native.jpeg_decode_baseline``), falls
-    back to the pure-Python implementation — the LZW pattern.  YCbCr
-    (photometric 6) converts to RGB here; photometric 1/2 pass raw
-    components through (libtiff writes photometric 2 with RGB stored
-    directly in the JPEG).  ``tables_cache`` (per-TiffFile) memoizes the
-    parsed JPEGTables so the Python path builds its Huffman lookups
-    once per file rather than once per tile; the native decoder's own
-    table build is a ~1 MB fill, noise next to its per-tile decode.
+    back to the pure-Python implementation — the LZW pattern.
+    Progressive (SOF2) streams go straight to the Python decoder (the
+    native fast path is baseline-only; vendor WSI tiles are baseline in
+    practice, so the slow path only carries the rare progressive
+    export).  YCbCr (photometric 6) converts to RGB here; photometric
+    1/2 pass raw components through (libtiff writes photometric 2 with
+    RGB stored directly in the JPEG).  ``tables_cache`` (per-TiffFile)
+    memoizes the parsed JPEGTables so the Python path builds its
+    Huffman lookups once per file rather than once per tile; the native
+    decoder's own table build is a ~1 MB fill, noise next to its
+    per-tile decode.
     """
     out: Optional[np.ndarray] = None
-    try:
-        from ..native import jpeg_decode_baseline
-        out = jpeg_decode_baseline(data, tables_bytes)
-    except ImportError:
-        pass
+    if _sniff_sof(data) != 0xC2:
+        try:
+            from ..native import jpeg_decode_baseline
+            out = jpeg_decode_baseline(data, tables_bytes)
+        except ImportError:
+            pass
     if out is None:
         ts = None
         if tables_bytes:

@@ -51,12 +51,15 @@ def _corpus(rng):
     Image.fromarray(rgb).save(buf, "JPEG", quality=80)
     jpeg = buf.getvalue()
     buf = _io.BytesIO()
+    Image.fromarray(rgb).save(buf, "JPEG", quality=80, progressive=True)
+    jpeg_prog = buf.getvalue()
+    buf = _io.BytesIO()
     Image.fromarray(rgb).save(buf, "TIFF", compression="tiff_lzw")
     tiff = buf.getvalue()
     return {
         "jp2k": [jp2k_enc(gray, irreversible=False),
                  jp2k_enc(rgb, irreversible=True)],
-        "jpeg": [jpeg],
+        "jpeg": [jpeg, jpeg_prog],
         "tiff": [tiff, _pred3_tiff(rng)],
     }
 

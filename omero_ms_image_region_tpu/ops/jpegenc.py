@@ -387,6 +387,206 @@ def wire_fetcher(H: int, W: int, cap: int) -> SparseWireFetcher:
         return f
 
 
+def _compact_rows(bufs, lengths):
+    """Device-side wire compaction: pack each row's used prefix
+    contiguously so the host fetch carries exactly the needed bytes.
+
+    ``bufs`` is u8[B, width] (either engine's wire layout), ``lengths``
+    i32[B] gives each row's used-byte count (0 for rows the caller wants
+    excluded, e.g. batch padding).  Returns u8[4*B + B*width]:
+
+        [ lengths i32 LE x B | row0[:len0] | row1[:len1] | ... ]
+
+    The prefix-fetch economics this enables: the old per-batch fetch
+    sliced a COMMON prefix ``bufs[:, :k]`` with k predicted from the
+    largest row — under per-request settings variance that over-fetches
+    every smaller row (measured 1.8x wire waste at service load) and
+    pads rows cost full freight.  Compacted, prediction tracks the SUM
+    of row sizes (far lower relative variance), pad rows cost zero, and
+    a group's wire bytes equal its entropy bytes.
+    """
+    B, width = bufs.shape
+    cum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                           jnp.cumsum(lengths.astype(jnp.int32))])
+    pos = jnp.arange(B * width, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(cum, pos, side="right") - 1, 0, B - 1)
+    col = pos - cum[row]
+    data = jnp.where(
+        pos < cum[B],
+        bufs[row, jnp.clip(col, 0, width - 1)],
+        jnp.uint8(0))
+    header = jax.lax.bitcast_convert_type(
+        cum[1:] - cum[:-1], jnp.uint8).reshape(-1)
+    return jnp.concatenate([header, data])
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def render_to_jpeg_sparse_compact(raw, window_start, window_end, family,
+                                  coefficient, reverse, cd_start, cd_end,
+                                  tables, qy, qc, n_valid, *, cap: int):
+    """Fused render + sparse wire + device compaction, one dispatch.
+
+    ``n_valid`` (traced i32) masks trailing batch-padding rows to zero
+    wire bytes.  Overflowed rows (total > cap) compact to just their
+    header + counts — enough for the host to detect the overflow and
+    take the dense path without shipping a dropped-entry stream.
+    """
+    bufs = render_to_jpeg_sparse(
+        raw, window_start, window_end, family, coefficient, reverse,
+        cd_start, cd_end, tables, qy, qc, cap=cap)
+    B = bufs.shape[0]
+    H, W = raw.shape[-2:]
+    nb = ((H + 15) // 16) * ((W + 15) // 16) * 6
+    total = jax.lax.bitcast_convert_type(
+        bufs[:, :4].reshape(B, 1, 4), jnp.int32).reshape(B)
+    used = 4 + nb + (ENTRY_BITS * jnp.minimum(total, cap) + 7) // 8
+    lengths = jnp.where(total <= cap, used, 4 + nb)
+    lengths = jnp.where(jnp.arange(B) < n_valid, lengths, 0)
+    return _compact_rows(bufs, lengths)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cap", "cap_words", "h16", "w16"))
+def render_to_jpeg_huffman_compact(raw, window_start, window_end, family,
+                                   coefficient, reverse, cd_start, cd_end,
+                                   tables, qy, qc, dc_code, dc_len,
+                                   ac_code, ac_len, n_valid, *,
+                                   h16: int, w16: int,
+                                   cap: int, cap_words: int):
+    """Fused render + device Huffman + device compaction, one dispatch.
+
+    Overflowed rows (entries > cap or bits > word budget) compact to
+    their 8-byte header only; the host detects and dense-falls-back.
+    """
+    bufs = render_to_jpeg_huffman(
+        raw, window_start, window_end, family, coefficient, reverse,
+        cd_start, cd_end, tables, qy, qc, dc_code, dc_len, ac_code,
+        ac_len, h16=h16, w16=w16, cap=cap, cap_words=cap_words)
+    B = bufs.shape[0]
+    hdr = jax.lax.bitcast_convert_type(
+        bufs[:, :8].reshape(B, 2, 4), jnp.int32)
+    total, bits = hdr[:, 0], hdr[:, 1]
+    ok = (total <= cap) & (bits <= cap_words * 32)
+    words = jnp.where(ok, (bits + 31) // 32, 0)
+    lengths = (8 + 4 * words).astype(jnp.int32)
+    lengths = jnp.where(jnp.arange(B) < n_valid, lengths, 0)
+    return _compact_rows(bufs, lengths)
+
+
+class CompactWireFetcher:
+    """Predictive prefix fetch of a COMPACTED wire buffer.
+
+    The buffer is ``[lengths i32 x B | concatenated used prefixes]``
+    (:func:`_compact_rows`), so prediction tracks the batch's total
+    used bytes — much lower relative variance than the per-row max the
+    uncompacted fetchers must bound.  Under-prediction costs ~1 link
+    RTT (~100 ms on a tunnel — as dear as ~400 KB of transfer), so the
+    headroom adapts asymmetrically: a miss raises it sharply, on-target
+    batches decay it slowly back toward the floor.
+    """
+
+    GRANULE = 32 * 1024
+    HEADROOM_FLOOR = 1.06
+    HEADROOM_CEIL = 1.6
+    # Fetch sizes snap UP to a geometric ladder (ratio 2^(1/4), <=19%
+    # over-fetch) instead of a fine arithmetic granule: every distinct
+    # device slice shape costs an XLA compile (seconds on a
+    # tunnel-attached chip), so the shape set must be small and stable
+    # while predictions drift with content.
+    LADDER_RATIO = 2.0 ** 0.25
+
+    def __init__(self, B: int, width: int, prior_row_bytes: int = None):
+        self.B = B
+        self.hdr = 4 * B
+        self.width = self.hdr + B * width     # full device buffer bytes
+        self.headroom = self.HEADROOM_FLOOR
+        ladder = []
+        step = float(self.GRANULE)
+        while step < self.width:
+            ladder.append(int(step))
+            step *= self.LADDER_RATIO
+        ladder.append(self.width)
+        self._ladder = ladder
+        # First fetch: the caller's content prior (e.g. measured
+        # bytes/px for the engine's stream class) with generous slack —
+        # a first-touch miss pays a link RTT AND a one-time slice-shape
+        # compile, both far dearer than a fat first fetch.
+        prior = (int(prior_row_bytes * B * 1.5) if prior_row_bytes
+                 else self.width // 8)
+        self._k = self._round(max(self.GRANULE, prior))
+
+    def _round(self, n: int) -> int:
+        n = max(n, self.hdr)
+        for step in self._ladder:
+            if step >= n:
+                return step
+        return self.width
+
+    def start(self, buf):
+        k = self._k
+        pre = buf if k >= self.width else buf[:k]
+        if hasattr(pre, "copy_to_host_async"):
+            pre.copy_to_host_async()
+        return pre, buf, k
+
+    def finish(self, handle) -> list:
+        """Complete a fetch -> per-row u8 arrays (length B; excluded
+        rows come back empty)."""
+        import time as _time
+
+        from ..utils.stopwatch import REGISTRY as _REG
+
+        pre, buf, k = handle
+        t0 = _time.perf_counter()
+        host = np.asarray(pre)
+        dt = _time.perf_counter() - t0
+        _REG.record("wire.fetch", dt * 1000.0)
+        _observe_fetch(host.nbytes, dt, conflated=True)
+        lengths = host[:self.hdr].view(np.int32)
+        total = self.hdr + int(lengths.sum())
+        if total > k:
+            end = self._round(total)
+            t0 = _time.perf_counter()
+            rest = np.asarray(buf[k:end])
+            dt = _time.perf_counter() - t0
+            _REG.record("wire.fetch2", dt * 1000.0)
+            _observe_fetch(rest.nbytes, dt)
+            host = np.concatenate([host, rest])
+            self.headroom = min(self.HEADROOM_CEIL, self.headroom * 1.2)
+        else:
+            self.headroom = max(self.HEADROOM_FLOOR,
+                                self.headroom * 0.995)
+        self._k = self._round(int(total * self.headroom))
+        offs = self.hdr + np.concatenate(
+            [[0], np.cumsum(lengths, dtype=np.int64)])
+        return [host[offs[i]:offs[i + 1]] for i in range(self.B)]
+
+    def fetch(self, buf) -> list:
+        return self.finish(self.start(buf))
+
+
+def compact_fetcher(engine: str, H: int, W: int, cap: int,
+                    cap_words: int, B: int) -> CompactWireFetcher:
+    """Process-wide prediction state per (engine, shape, caps, batch)."""
+    if engine == "huffman":
+        width = 8 + 4 * cap_words
+        # Measured q85 fixed-table streams on WSI-class content run
+        # ~0.10-0.12 B/px; 0.14 as the first-touch prior.
+        prior = 8 + int(H * W * 0.14)
+    else:
+        width = sparse_wire_width(H, W, cap)
+        # Sparse wire: counts (6 B per 16x16 MCU region... nb bytes)
+        # plus ~3.6x the huffman stream's entropy bytes.
+        prior = 4 + ((H + 15) // 16) * ((W + 15) // 16) * 6 \
+            + int(H * W * 0.5)
+    key = ("compact", engine, H, W, cap, cap_words, B)
+    with _FETCHERS_LOCK:
+        f = _FETCHERS.get(key)
+        if f is None:
+            f = _FETCHERS[key] = CompactWireFetcher(B, width, prior)
+        return f
+
+
 def _quality_widen(quality: "int | None") -> int:
     """Cap multiplier for high-quality quant tables: measured WSI
     content runs ~5% coefficient density at q80 but ~12% at q90 — past
@@ -401,6 +601,13 @@ def wire_header_i32(bufs: np.ndarray, word: int) -> np.ndarray:
     """The per-row i32 header field ``word`` of fetched wire buffers
     (one place for the layout; both engines lead with LE i32 words)."""
     return bufs[:, 4 * word:4 * word + 4].copy().view(np.int32).ravel()
+
+
+def row_header_i32(row: np.ndarray, word: int) -> int:
+    """Header field of ONE wire row (compacted rows may sit at
+    unaligned offsets, so go through bytes, not a view)."""
+    return int.from_bytes(row[4 * word:4 * word + 4].tobytes(),
+                          "little", signed=True)
 
 
 # Process-wide overflow memo: once a (shape, quality, engine) workload
@@ -944,16 +1151,19 @@ def huffman_spec_arrays():
             ac_code.astype(np.int32), ac_len.astype(np.int32))
 
 
-def finish_huffman_batch(bufs: np.ndarray, dims, H: int, W: int,
+def finish_huffman_batch(bufs, dims, H: int, W: int,
                          quality: int, cap: int, cap_words: int,
                          dense_fallback=None) -> list:
     """Fetched Huffman wire rows -> JFIF bytes per tile.
 
-    Host work is O(stream bytes): byte-swap + 0xFF-stuff + frame
-    (``jfif.finish_fixed_stream``).  Overflowed tiles (entries > cap or
-    bits > capacity) — and tiles whose ``dims`` entry is None (callers
-    mark tiles the packed stream cannot serve, e.g. bucket-padded ones)
-    — go through ``dense_fallback(i) -> bytes``.
+    ``bufs`` indexes per-row u8 buffers: a 2D [B, >=prefix] array (the
+    uncompacted wire) or a list of per-row arrays (the compacted wire,
+    where rows carry exactly their used bytes).  Host work is O(stream
+    bytes): byte-swap + 0xFF-stuff + frame (``jfif.finish_fixed_stream``).
+    Overflowed tiles (entries > cap or bits > capacity) — and tiles whose
+    ``dims`` entry is None (callers mark tiles the packed stream cannot
+    serve, e.g. bucket-padded ones) — go through
+    ``dense_fallback(i) -> bytes``.
     """
     from ..jfif import finish_fixed_stream
 
@@ -966,8 +1176,9 @@ def finish_huffman_batch(bufs: np.ndarray, dims, H: int, W: int,
             out.append(dense_fallback(i))
             continue
         w_, h_ = dim
-        total = int(bufs[i, :4].view(np.int32)[0])
-        bits = int(bufs[i, 4:8].view(np.int32)[0])
+        row = bufs[i]
+        total = row_header_i32(row, 0)
+        bits = row_header_i32(row, 1)
         if total > cap or bits > cap_words * 32:
             if dense_fallback is None:
                 raise ValueError(
@@ -975,7 +1186,10 @@ def finish_huffman_batch(bufs: np.ndarray, dims, H: int, W: int,
             out.append(dense_fallback(i))
             continue
         nwords = (bits + 31) // 32
-        words = bufs[i, 8:8 + 4 * nwords].view("<u4")
+        # Compacted rows can sit at unaligned offsets in the fetched
+        # stream; ascontiguousarray re-bases so the u32 view is legal.
+        words = np.ascontiguousarray(
+            row[8:8 + 4 * nwords]).view("<u4")
         out.append(finish_fixed_stream(words, bits, w_, h_, quality))
     return out
 
@@ -1085,9 +1299,11 @@ def encode_sparse_buffers(bufs: np.ndarray, width: int, height: int,
                           dense_fallback=None) -> list:
     """Entropy-encode a batch of fetched sparse wire buffers to JFIF.
 
-    ``bufs`` is the host u8[B, ...] array from :func:`render_to_jpeg_sparse`.
-    Tiles whose coefficient density overflowed ``cap`` are re-encoded via
-    ``dense_fallback(i) -> bytes`` when given (else ValueError propagates).
+    ``bufs`` indexes per-row u8 buffers: the host u8[B, ...] array from
+    :func:`render_to_jpeg_sparse`, or a list of per-row arrays (the
+    compacted wire).  Tiles whose coefficient density overflowed ``cap``
+    are re-encoded via ``dense_fallback(i) -> bytes`` when given (else
+    ValueError propagates).
     """
     from ..native import SparseOverflowError
     _encode = sparse_encoder()
@@ -1101,8 +1317,8 @@ def encode_sparse_buffers(bufs: np.ndarray, width: int, height: int,
             return dense_fallback(i)
 
     if executor is None:
-        return [one(i) for i in range(bufs.shape[0])]
-    return list(executor.map(one, range(bufs.shape[0])))
+        return [one(i) for i in range(len(bufs))]
+    return list(executor.map(one, range(len(bufs))))
 
 
 _HUFF_FETCHERS: dict = {}
@@ -1157,26 +1373,26 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
             tables[i:i + 1], qy, qc)
         return np.asarray(y)[0], np.asarray(cb)[0], np.asarray(cr)[0]
 
+    n = len(dims)
     all_exact = all((h_ + 15) // 16 * 16 == H
                     and (w_ + 15) // 16 * 16 == W for (w_, h_) in dims)
     if engine == "huffman" and all_exact:
         def dispatch_huffman(c, cw):
-            bufs = render_to_jpeg_huffman(
+            bufs = render_to_jpeg_huffman_compact(
                 raw, window_start, window_end, family, coefficient,
                 reverse, cd_start, cd_end, tables, qy, qc,
-                *huffman_spec_arrays(),
+                *huffman_spec_arrays(), np.int32(n),
                 h16=H // 16, w16=W // 16, cap=c, cap_words=cw)
-            if hasattr(bufs, "copy_to_host_async"):
-                return huffman_wire_fetcher(H, W, c, cw).fetch(bufs)
-            return np.asarray(bufs)
+            return compact_fetcher("huffman", H, W, c, cw,
+                                   B).fetch(bufs)[:n]
 
         cap_words = default_words_cap(H, W, quality)
         memo_key = ("huffman", H, W, quality)
         if _CAP_MEMO.get(memo_key):
             cap, cap_words = cap * 2, cap_words * 2
-        bufs = dispatch_huffman(cap, cap_words)
-        totals = wire_header_i32(bufs, 0)
-        bits = wire_header_i32(bufs, 1)
+        rows = dispatch_huffman(cap, cap_words)
+        totals = np.array([row_header_i32(r, 0) for r in rows])
+        bits = np.array([row_header_i32(r, 1) for r in rows])
         over = (totals > cap) | (bits > cap_words * 32)
         rescuable = ((totals <= 2 * cap)
                      & (bits <= 2 * cap_words * 32))
@@ -1192,7 +1408,7 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
             # starting such workloads at 2x.
             _CAP_MEMO[memo_key] = True
             cap, cap_words = cap * 2, cap_words * 2
-            bufs = dispatch_huffman(cap, cap_words)
+            rows = dispatch_huffman(cap, cap_words)
 
         _dense_encode = dense_encoder()
 
@@ -1201,33 +1417,34 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
             w_, h_ = dims[i]
             return _dense_encode(*dense_coefficients(i), w_, h_, quality)
 
-        return finish_huffman_batch(
-            bufs, dims, H, W, quality, cap, cap_words,
-            dense_fallback=dense_tile)
+        from ..utils.stopwatch import stopwatch
+        with stopwatch("jfif.encodeBatch"):
+            return finish_huffman_batch(
+                rows, dims, H, W, quality, cap, cap_words,
+                dense_fallback=dense_tile)
 
     def dispatch_sparse(c):
-        bufs = render_to_jpeg_sparse(
+        bufs = render_to_jpeg_sparse_compact(
             raw, window_start, window_end, family, coefficient, reverse,
-            cd_start, cd_end, tables, qy, qc, cap=c)
-        if hasattr(bufs, "copy_to_host_async"):
-            # Predictive prefix fetch: only used bytes cross the link.
-            return wire_fetcher(H, W, c).fetch(bufs)
-        return np.asarray(bufs)
+            cd_start, cd_end, tables, qy, qc, np.int32(n), cap=c)
+        return compact_fetcher("sparse", H, W, c, 0, B).fetch(bufs)[:n]
 
     memo_key = ("sparse", H, W, quality)
     if _CAP_MEMO.get(memo_key):
         cap = cap * 2
-    bufs = dispatch_sparse(cap)
-    totals = wire_header_i32(bufs, 0)
+    rows = dispatch_sparse(cap)
+    totals = np.array([row_header_i32(r, 0) for r in rows])
     if (memo_key not in _CAP_MEMO
             and ((totals > cap) & (totals <= 2 * cap)).any()):
         # Same one-shot widening + memo as the huffman engine above.
         _CAP_MEMO[memo_key] = True
         cap = cap * 2
-        bufs = dispatch_sparse(cap)
+        rows = dispatch_sparse(cap)
 
-    return finish_sparse_to_jpegs(bufs, dims, H, W, quality, cap,
-                                  dense_coefficients)
+    from ..utils.stopwatch import stopwatch
+    with stopwatch("jfif.encodeBatch"):
+        return finish_sparse_to_jpegs(rows, dims, H, W, quality, cap,
+                                      dense_coefficients)
 
 
 def finish_sparse_to_jpegs(bufs, dims, H: int, W: int, quality: int,

@@ -28,10 +28,12 @@ import collections
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 
+import time
+
 import numpy as np
 
 from ..ops.render import render_tile_batch_packed
-from ..utils.stopwatch import stopwatch
+from ..utils.stopwatch import REGISTRY, stopwatch
 
 DEFAULT_BUCKETS = ((256, 256), (512, 512), (1024, 1024), (2048, 2048))
 
@@ -61,6 +63,7 @@ class _Pending:
     w: int
     quality: int = 0              # JPEG groups only
     future: asyncio.Future = None  # type: ignore[assignment]
+    t_enqueue: float = 0.0        # queue-wait waterfall span
 
 
 class BatchingRenderer:
@@ -188,6 +191,7 @@ class BatchingRenderer:
         return await self._enqueue(key, pending)
 
     async def _enqueue(self, key: tuple, pending: _Pending):
+        pending.t_enqueue = time.perf_counter()
         queue = self._queues.get(key)
         if queue is None:
             queue = self._queues[key] = collections.deque()
@@ -371,6 +375,11 @@ class BatchingRenderer:
         from ..ops.jpegenc import render_batch_to_jpeg
 
         n = len(group)
+        now = time.perf_counter()
+        REGISTRY.record("batcher.groupTiles", float(n))
+        for p in group:
+            REGISTRY.record("batcher.queueWait",
+                            (now - p.t_enqueue) * 1000.0)
         raw, stack = self._group_arrays(group)
         s0 = group[0].settings
         with stopwatch("Renderer.renderAsPackedInt.batch"):

@@ -522,7 +522,9 @@ def test_high_quality_widens_wire_caps():
 
     caps_seen = []
     dense_calls = []
-    orig = je.render_to_jpeg_sparse
+    # The serving path dispatches through the compacted-wire wrapper
+    # (render_batch_to_jpeg), so that is where per-group caps surface.
+    orig = je.render_to_jpeg_sparse_compact
     orig_coeff = je.render_to_jpeg_coefficients
 
     def spy(*args, **kwargs):
@@ -536,7 +538,7 @@ def test_high_quality_widens_wire_caps():
             dense_calls.append(1)
         return orig_coeff(*args, **kwargs)
 
-    je.render_to_jpeg_sparse = spy
+    je.render_to_jpeg_sparse_compact = spy
     je.render_to_jpeg_coefficients = spy_coeff
     try:
         def run(raw, q):
@@ -562,6 +564,160 @@ def test_high_quality_widens_wire_caps():
         # ...and the memo starts subsequent groups at 2x directly.
         assert run(mid, 80) == ([2 * base], 0)
     finally:
-        je.render_to_jpeg_sparse = orig
+        je.render_to_jpeg_sparse_compact = orig
         je.render_to_jpeg_coefficients = orig_coeff
         je._CAP_MEMO.clear()
+
+
+# ---------------------------------------------------- compacted wire
+
+class TestCompactWire:
+    """Device-side wire compaction: the fetch carries exactly each
+    row's used bytes, pad rows cost zero, and the compacted rows are
+    byte-identical to the uncompacted wire's used prefixes."""
+
+    def _args(self, B, C, H, W, seed=0, window=255.0):
+        rng = np.random.default_rng(seed)
+        # Smooth gradients (per-tile phase): small streams that stay
+        # well under the tiny-tile default caps, sized differently per
+        # row so compaction has real variance to pack.
+        yy, xx = np.mgrid[0:H, 0:W].astype(np.float32)
+        phase = rng.uniform(0, np.pi, size=(B, C, 1, 1)).astype(
+            np.float32)
+        freq = rng.uniform(1.0, 3.0, size=(B, C, 1, 1)).astype(
+            np.float32)
+        raw = 120.0 + 60.0 * np.sin(
+            freq * (yy + xx)[None, None] / max(H, W) + phase)
+        ws = np.zeros((B, C), np.float32)
+        we = np.full((B, C), window, np.float32)
+        fam = np.zeros((B, C), np.int32)
+        coef = np.ones((B, C), np.float32)
+        rev = np.zeros((B, C), np.bool_)
+        tables = np.tile(np.array([[1.0, 0.8, 0.5]], np.float32),
+                         (B, C, 1)).reshape(B, C, 3)
+        return raw, ws, we, fam, coef, rev, tables
+
+    def test_sparse_rows_match_uncompacted(self):
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+        B, C, H, W = 4, 2, 32, 32
+        raw, ws, we, fam, coef, rev, tables = self._args(B, C, H, W)
+        qy, qc = (np.asarray(t, np.int32) for t in quant_tables(85))
+        # Generous cap: parity is about layout, not overflow policy
+        # (tiny-tile default caps are a 128-byte stream budget).
+        cap = je.max_sparse_cap(H, W)
+        full = np.asarray(je.render_to_jpeg_sparse(
+            raw, ws, we, fam, coef, rev, 0, 255, tables, qy, qc,
+            cap=cap))
+        compact = np.asarray(je.render_to_jpeg_sparse_compact(
+            raw, ws, we, fam, coef, rev, 0, 255, tables, qy, qc,
+            np.int32(B), cap=cap))
+        lengths = compact[:4 * B].view(np.int32)
+        nb = (H // 16) * (W // 16) * 6
+        offs = 4 * B + np.concatenate([[0], np.cumsum(lengths)])
+        for i in range(B):
+            total = int(full[i, :4].view(np.int32)[0])
+            assert total <= cap
+            need = 4 + nb + (je.ENTRY_BITS * total + 7) // 8
+            assert lengths[i] == need
+            row = compact[offs[i]:offs[i + 1]]
+            np.testing.assert_array_equal(row, full[i, :need])
+
+    def test_huffman_rows_match_uncompacted(self):
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+        B, C, H, W = 3, 1, 32, 32
+        raw, ws, we, fam, coef, rev, tables = self._args(B, C, H, W, 1)
+        qy, qc = (np.asarray(t, np.int32) for t in quant_tables(85))
+        cap = je.max_sparse_cap(H, W)
+        cap_words = H * W           # generous: parity, not overflow
+        spec = je.huffman_spec_arrays()
+        full = np.asarray(je.render_to_jpeg_huffman(
+            raw, ws, we, fam, coef, rev, 0, 255, tables, qy, qc, *spec,
+            h16=H // 16, w16=W // 16, cap=cap, cap_words=cap_words))
+        compact = np.asarray(je.render_to_jpeg_huffman_compact(
+            raw, ws, we, fam, coef, rev, 0, 255, tables, qy, qc, *spec,
+            np.int32(B), h16=H // 16, w16=W // 16, cap=cap,
+            cap_words=cap_words))
+        lengths = compact[:4 * B].view(np.int32)
+        offs = 4 * B + np.concatenate([[0], np.cumsum(lengths)])
+        for i in range(B):
+            bits = int(full[i, 4:8].view(np.int32)[0])
+            need = 8 + 4 * ((bits + 31) // 32)
+            assert lengths[i] == need
+            np.testing.assert_array_equal(
+                compact[offs[i]:offs[i + 1]], full[i, :need])
+
+    def test_pad_rows_cost_zero_wire_bytes(self):
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+        B, C, H, W = 4, 1, 32, 32
+        raw, ws, we, fam, coef, rev, tables = self._args(B, C, H, W, 2)
+        qy, qc = (np.asarray(t, np.int32) for t in quant_tables(85))
+        cap = je.max_sparse_cap(H, W)
+        compact = np.asarray(je.render_to_jpeg_sparse_compact(
+            raw, ws, we, fam, coef, rev, 0, 255, tables, qy, qc,
+            np.int32(2), cap=cap))
+        lengths = compact[:4 * B].view(np.int32)
+        assert (lengths[:2] > 0).all()
+        assert (lengths[2:] == 0).all()
+
+    def test_overflow_row_compacts_to_header(self):
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+        B, C, H, W = 2, 1, 32, 32
+        rng = np.random.default_rng(3)
+        # Uniform noise: dense coefficients, guaranteed cap overflow.
+        raw = rng.uniform(0, 255, size=(B, C, H, W)).astype(np.float32)
+        ws = np.zeros((B, C), np.float32)
+        we = np.full((B, C), 255.0, np.float32)
+        fam = np.zeros((B, C), np.int32)
+        coef = np.ones((B, C), np.float32)
+        rev = np.zeros((B, C), np.bool_)
+        tables = np.ones((B, C, 3), np.float32)
+        qy, qc = (np.asarray(t, np.int32) for t in quant_tables(85))
+        cap = 8   # tiny: force overflow
+        nb = (H // 16) * (W // 16) * 6
+        compact = np.asarray(je.render_to_jpeg_sparse_compact(
+            raw, ws, we, fam, coef, rev, 0, 255, tables, qy, qc,
+            np.int32(B), cap=cap))
+        lengths = compact[:4 * B].view(np.int32)
+        # Overflowed rows ship header + counts only (detectable, small).
+        assert (lengths == 4 + nb).all()
+        row0 = compact[4 * B:4 * B + lengths[0]]
+        assert je.row_header_i32(row0, 0) > cap
+
+    def test_fetcher_roundtrip_and_prediction(self):
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+        B, C, H, W = 4, 2, 32, 32
+        raw, ws, we, fam, coef, rev, tables = self._args(B, C, H, W, 4)
+        qy, qc = (np.asarray(t, np.int32) for t in quant_tables(85))
+        cap = je.max_sparse_cap(H, W)
+        buf = je.render_to_jpeg_sparse_compact(
+            raw, ws, we, fam, coef, rev, 0, 255, tables, qy, qc,
+            np.int32(B), cap=cap)
+        width = je.sparse_wire_width(H, W, cap)
+        f = je.CompactWireFetcher(B, width)
+        f._k = f.hdr            # force an under-prediction second fetch
+        rows = f.fetch(buf)
+        full = np.asarray(buf)
+        lengths = full[:4 * B].view(np.int32)
+        offs = 4 * B + np.concatenate([[0], np.cumsum(lengths)])
+        assert len(rows) == B
+        for i in range(B):
+            np.testing.assert_array_equal(rows[i],
+                                          full[offs[i]:offs[i + 1]])
+        # Miss raised the headroom; an on-target fetch decays it.
+        assert f.headroom > f.HEADROOM_FLOOR
+        hr = f.headroom
+        f.fetch(buf)
+        assert f.headroom <= hr
+
+    def test_batch_to_jpeg_end_to_end_decodable(self):
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+        B, C, H, W = 3, 2, 32, 32
+        raw, ws, we, fam, coef, rev, tables = self._args(B, C, H, W, 5)
+        for engine in ("sparse", "huffman"):
+            jpegs = je.render_batch_to_jpeg(
+                raw, ws, we, fam, coef, rev, 0, 255, tables,
+                quality=85, dims=[(W, H)] * B, engine=engine)
+            assert len(jpegs) == B
+            for j in jpegs:
+                img = Image.open(io.BytesIO(j))
+                assert img.size == (W, H)

@@ -4,9 +4,11 @@ The reference's deployments lean on OMERO's importer (Bio-Formats) to
 populate the binary repository; this CLI covers the same operational
 needs for a standalone data directory:
 
-  info <image_dir|tiff>              print geometry, levels, backend
+  info <image_dir|tiff|zarr>         print geometry, levels, backend
   tiff-to-store <tiff> <image_dir>   OME-TIFF -> chunked pyramid layout
   store-to-tiff <image_dir> <tiff>   chunked pyramid -> tiled OME-TIFF
+  to-ngff <src> <zarr_dir>           any readable source -> OME-NGFF
+                                     (zarr v2 multiscales)
 
 Conversions read plane by plane but do hold ONE full-resolution
 [T, C, Z, H, W] copy (plus ~1/3 extra for the rebuilt pyramid levels)
@@ -29,13 +31,19 @@ def _open_source(path: str):
     from .io.ometiff import OmeTiffSource, find_tiff
     from .io.store import ChunkedPyramidStore
 
+    from .io.ngff import NgffZarrSource, find_ngff
+
     if os.path.isdir(path):
         if os.path.exists(os.path.join(path, "meta.json")):
             return ChunkedPyramidStore(path), "chunked"
+        ngff = find_ngff(path)
+        if ngff is not None:
+            return NgffZarrSource(ngff), "ome-ngff"
         tiff = find_tiff(path)
         if tiff is not None:
             return OmeTiffSource(tiff), "ome-tiff"
-        raise SystemExit(f"{path}: neither meta.json nor a TIFF found")
+        raise SystemExit(
+            f"{path}: no meta.json, NGFF markers, or TIFF found")
     return OmeTiffSource(path), "ome-tiff"
 
 
@@ -102,6 +110,22 @@ def cmd_store_to_tiff(args) -> int:
     return 0
 
 
+def cmd_to_ngff(args) -> int:
+    from .io.ngff import write_ngff
+
+    src, backend = _open_source(args.src)
+    try:
+        planes = _gather_planes(src)
+    finally:
+        src.close()
+    write_ngff(planes, args.zarr_dir, chunk=(args.tile, args.tile),
+               min_level_size=args.min_level,
+               compressor=(None if args.compression == "none"
+                           else args.compression))
+    print(f"wrote OME-NGFF at {args.zarr_dir} (from {backend})")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m omero_ms_image_region_tpu.ingest",
@@ -129,6 +153,16 @@ def main(argv=None) -> int:
     p.add_argument("--compression", choices=["none", "deflate"],
                    default="deflate")
     p.set_defaults(fn=cmd_store_to_tiff)
+
+    p = sub.add_parser("to-ngff",
+                       help="any readable source -> OME-NGFF zarr")
+    p.add_argument("src")
+    p.add_argument("zarr_dir")
+    p.add_argument("--tile", type=int, default=256)
+    p.add_argument("--min-level", type=int, default=256)
+    p.add_argument("--compression", choices=["none", "zlib", "gzip"],
+                   default="zlib")
+    p.set_defaults(fn=cmd_to_ngff)
 
     args = parser.parse_args(argv)
     return args.fn(args)

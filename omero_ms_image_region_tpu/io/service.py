@@ -16,6 +16,7 @@ import threading
 from collections import OrderedDict
 from typing import List, Optional
 
+from .ngff import NgffZarrSource, find_ngff
 from .ometiff import OmeTiffSource, find_tiff
 from .pixelsource import PixelSource
 from .store import ChunkedPyramidStore
@@ -29,9 +30,11 @@ class PixelsService:
     address space on long-running servers).
 
     Backend is sniffed per image directory: a ``meta.json`` selects the
-    chunked pyramid store; otherwise an ``*.ome.tif(f)`` / ``*.tif(f)``
-    file selects the OME-TIFF reader — the role Bio-Formats format
-    dispatch plays behind ``PixelsService.getPixelBuffer``
+    chunked pyramid store; ``.zattrs``/``.zarray`` markers (directly or
+    in a ``*.zarr`` child) select the OME-NGFF reader; otherwise an
+    ``*.ome.tif(f)`` / ``*.tif(f)`` file selects the OME-TIFF reader —
+    the role Bio-Formats format dispatch plays behind
+    ``PixelsService.getPixelBuffer``
     (``ImageRegionRequestHandler.java:302-309``)."""
 
     # Evicted-set size past which a gc.collect() is forced: a reference
@@ -91,12 +94,18 @@ class PixelsService:
     def image_dir(self, image_id: int) -> str:
         return os.path.join(self.data_dir, str(image_id))
 
-    def _sniff(self, image_id: int) -> Optional[str]:
-        """"chunked" | path-to-tiff | None."""
+    def _sniff(self, image_id: int) -> Optional[tuple]:
+        """("chunked"|"ngff"|"tiff", path) | None."""
         d = self.image_dir(image_id)
         if os.path.exists(os.path.join(d, "meta.json")):
-            return "chunked"
-        return find_tiff(d)
+            return ("chunked", d)
+        ngff = find_ngff(d)
+        if ngff is not None:
+            return ("ngff", ngff)
+        tiff = find_tiff(d)
+        if tiff is not None:
+            return ("tiff", tiff)
+        return None
 
     def exists(self, image_id: int) -> bool:
         return self._sniff(image_id) is not None
@@ -111,8 +120,9 @@ class PixelsService:
         """Open the first usable repo-relative candidate path.
 
         TIFF-suffixed entries (``.ome.tif(f)`` preferred) open through
-        the OME-TIFF reader; a ``Pixels/<id>`` entry opens as a legacy
-        ROMIO buffer, which needs the DB geometry (``pixels``).
+        the OME-TIFF reader; ``*.zarr`` directories open as OME-NGFF;
+        a ``Pixels/<id>`` entry opens as a legacy ROMIO buffer, which
+        needs the DB geometry (``pixels``).
         """
         from .romio import RomioPixelSource
 
@@ -127,6 +137,12 @@ class PixelsService:
         tried = []
         for rel in sorted(candidates, key=rank):
             path = os.path.join(self.repo_root, rel)
+            if os.path.isdir(path):
+                ngff = find_ngff(path)
+                if ngff is not None:
+                    return NgffZarrSource(ngff)
+                tried.append(rel)
+                continue
             if not os.path.isfile(path):
                 tried.append(rel)
                 continue
@@ -177,10 +193,12 @@ class PixelsService:
                 f"no pixel data for image {image_id} under "
                 f"{self.data_dir}"
             )
-        elif backend == "chunked":
-            src = ChunkedPyramidStore(self.image_dir(image_id))
+        elif backend[0] == "chunked":
+            src = ChunkedPyramidStore(backend[1])
+        elif backend[0] == "ngff":
+            src = NgffZarrSource(backend[1])
         else:
-            src = OmeTiffSource(backend)
+            src = OmeTiffSource(backend[1])
         with self._lock:
             # Double-check: a concurrent opener may have won the race;
             # keep theirs and drop ours so no store leaks its memmaps.

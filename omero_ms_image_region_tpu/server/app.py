@@ -182,7 +182,8 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
             linger_ms=config.batcher.linger_ms,
             jpeg_engine=engine,
             pipeline_depth=config.batcher.pipeline_depth,
-            engine_controller=controller)
+            engine_controller=controller,
+            target_inflight=config.batcher.target_inflight)
     else:
         engine = config.renderer.jpeg_engine
         if engine == "auto":

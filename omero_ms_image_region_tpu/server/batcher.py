@@ -48,11 +48,19 @@ def pick_bucket(h: int, w: int,
     return h, w
 
 
+# Allowed padded batch shapes: powers of two plus 3 and 6, so the
+# inflight-aware group split (see _pop_size) can run ~3 concurrent
+# groups from a 16-request burst without paying 8-shape execution for
+# 5-6 real tiles.  Every entry is one compile per bucket key (cached
+# persistently); pad tiles are excluded from the wire by compaction.
+_BATCH_SHAPES = (1, 2, 3, 4, 6, 8, 16, 32, 64)
+
+
 def _pad_batch_size(n: int, max_batch: int) -> int:
-    size = 1
-    while size < n:
-        size *= 2
-    return min(size, max_batch)
+    for size in _BATCH_SHAPES:
+        if size >= n:
+            return min(size, max_batch)
+    return max_batch
 
 
 @dataclass
@@ -83,7 +91,7 @@ class BatchingRenderer:
     def __init__(self, max_batch: int = 8, linger_ms: float = 2.0,
                  buckets=DEFAULT_BUCKETS, jpeg_engine: str = "sparse",
                  pipeline_depth: int = 4, max_batch_limit: int = None,
-                 engine_controller=None):
+                 engine_controller=None, target_inflight: int = 1):
         if jpeg_engine not in ("sparse", "huffman"):
             raise ValueError(
                 f"batched jpeg engine must be 'sparse' or 'huffman', "
@@ -112,6 +120,12 @@ class BatchingRenderer:
         # the pod's SPMD launch sequence.
         self._transient_retry_enabled = True
         self.linger_ms = linger_ms
+        # Preferred concurrent group count under backlog (see
+        # BatcherConfig.target_inflight: default 1 = max_batch convoys,
+        # the measured winner on the tunnel; >1 splits bursts across
+        # streams for low-RTT links).  Capped by pipeline_depth.
+        self.target_inflight = max(1, min(target_inflight,
+                                          pipeline_depth))
         self.jpeg_engine = jpeg_engine
         # Live engine selection (utils.adaptive.AdaptiveEngine); None =
         # startup-static jpeg_engine.
@@ -260,7 +274,8 @@ class BatchingRenderer:
             # task, so a close() cancellation (delivered only at the
             # loop's await points) can never orphan a popped group.
             group: List[_Pending] = []
-            while queue and len(group) < self.max_batch:
+            take = self._pop_size(len(queue))
+            while queue and len(group) < take:
                 group.append(queue.popleft())
             if not group:
                 slots.release()
@@ -284,6 +299,26 @@ class BatchingRenderer:
                 self._run_group(render, group, slots))
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
+
+    def _pop_size(self, qlen: int) -> int:
+        """How many requests this group takes.
+
+        Splits a backlog across the remaining pipeline slots so
+        ``target_inflight`` wire streams overlap (each fetch pays the
+        link RTT up front; concurrent streams hide it), instead of two
+        max_batch convoys.  Multi-host meshes keep the plain
+        max_batch pop: group sizes there must not depend on host-local
+        queue timing (same reason growth is disabled —
+        ``parallel/serve.py`` lockstep).
+        """
+        if (not self._growth_enabled or self.target_inflight <= 1
+                or qlen <= self.max_batch):
+            # Small backlogs coalesce into one dispatch — splitting
+            # only pays when there is more than a full batch to spread
+            # across streams.
+            return self.max_batch
+        open_streams = max(1, self.target_inflight - len(self._inflight))
+        return max(1, min(self.max_batch, -(-qlen // open_streams)))
 
     async def _run_group(self, render, group: List[_Pending],
                          slots: asyncio.Semaphore) -> None:

@@ -35,6 +35,15 @@ class BatcherConfig:
     # pairs): depth 4 never lost to 2 and recovered 15-60% in the
     # high-RTT windows (huffman 24.9->31.5, sparse 11.1->17.6 tiles/s).
     pipeline_depth: int = 4
+    # Preferred concurrent group count under backlog: >1 makes the
+    # dispatcher split a burst across that many wire streams instead
+    # of popping max_batch-sized convoys.  Default 1 (off): measured
+    # closed-loop on-chip (scripts/exp_inflight.py, interleaved
+    # windows), max_batch convoys beat 3-way splitting 31.2 vs 21.8
+    # tiles/s — B=8 execution efficiency and fewer dispatches outweigh
+    # the extra RTT hiding.  Kept as a knob for low-RTT deployments.
+    # Single-host only; multi-host meshes always pop max_batch.
+    target_inflight: int = 1
 
 
 @dataclass

@@ -86,6 +86,24 @@ class LocalMetadataService:
                 size_c=m["size_c"],
                 size_t=m["size_t"],
             )
+        # OME-NGFF-backed image: geometry from the zarr/multiscales
+        # JSON.  Same discipline as the TIFF branch below: the listdir
+        # + per-level JSON parses run off the event loop and cache per
+        # (path, mtime) — a WSI pyramid re-parses only when rewritten.
+        import asyncio
+
+        from ..io.ngff import find_ngff
+        ngff = await asyncio.to_thread(
+            find_ngff, self._image_dir(image_id))
+        if ngff is not None:
+            mtime = os.stat(ngff).st_mtime_ns
+            cached = self._tiff_pixels.get(image_id)
+            if cached is not None and cached[0] == (ngff, mtime):
+                return cached[1]
+            px = await asyncio.to_thread(self._parse_ngff_pixels,
+                                         image_id, ngff)
+            self._tiff_pixels[image_id] = ((ngff, mtime), px)
+            return px
         # OME-TIFF-backed image: geometry from the OME-XML / IFDs (the
         # reference resolves the same fields from the OMERO DB, which
         # Bio-Formats populated at import; here the file is the truth).
@@ -106,6 +124,19 @@ class LocalMetadataService:
                                      image_id, tiff)
         self._tiff_pixels[image_id] = ((tiff, mtime), px)
         return px
+
+    def _parse_ngff_pixels(self, image_id: int, ngff: str) -> Pixels:
+        import numpy as np
+
+        from ..io.ngff import NgffZarrSource
+        src = NgffZarrSource(ngff)
+        return Pixels(
+            image_id=image_id,
+            pixels_type=np.dtype(src.dtype).name,
+            size_x=src.size_x, size_y=src.size_y,
+            size_z=src.size_z, size_c=src.size_c,
+            size_t=src.size_t,
+        )
 
     def _parse_tiff_pixels(self, image_id: int, tiff: str) -> Pixels:
         from ..io.ometiff import OmeTiffSource

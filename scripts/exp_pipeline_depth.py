@@ -51,7 +51,7 @@ def main() -> None:
                     renderer=RendererConfig(cpu_fallback_max_px=0,
                                             jpeg_engine=engine))
                 t0 = time.perf_counter()
-                tps = asyncio.run(bench._service_run(config))
+                tps, _p50 = asyncio.run(bench._service_run(config))
                 print(f"engine={engine} batch={max_batch} depth={depth}: "
                       f"{tps:.1f} tiles/s "
                       f"(window {time.perf_counter() - t0:.1f}s)", flush=True)

@@ -110,7 +110,8 @@ def main():
             renderer=RendererConfig(cpu_fallback_max_px=0,
                                     jpeg_engine=engine))
         t0 = time.perf_counter()
-        tps = asyncio.run(bench._service_run(config, duration_s=duration))
+        tps, p50 = asyncio.run(
+            bench._service_run(config, duration_s=duration))
         wall = time.perf_counter() - t0
 
     from omero_ms_image_region_tpu.utils.linkprobe import \
@@ -120,7 +121,7 @@ def main():
     wire_mb = sum(REC.events.get("wire_bytes", [])) / 1e6
     per_tile = wire_mb / max(tiles, 1)
     print(f"\nengine={engine} window={duration}s wall={wall:.1f}s "
-          f"tiles/s={tps:.1f}")
+          f"tiles/s={tps:.1f} p50={p50:.0f}ms")
     print(f"  link_adjacent={link:.1f} MB/s  wire={wire_mb:.1f} MB "
           f"({per_tile * 1000:.0f} KB/tile)  "
           f"wire_bound_ceiling={link / max(per_tile, 1e-9):.1f} tiles/s")

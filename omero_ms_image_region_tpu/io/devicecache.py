@@ -48,7 +48,17 @@ class DeviceRawCache:
                 return arr
             self.misses += 1
         import jax
-        arr = jax.device_put(loader())
+        import numpy as np
+        loaded = loader()
+        if isinstance(loaded, np.ndarray):
+            # Host ndarray miss: packed staging ships ~1.4x fewer wire
+            # bytes for uint16 pixel content (io.staging.stage falls
+            # back to a plain transfer when packing doesn't pay).
+            from .staging import stage
+            arr = stage(loaded)
+        else:
+            # Already device-resident (banded staging path).
+            arr = jax.device_put(loaded)
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:

@@ -63,9 +63,13 @@ class ZarrV2Array:
         if len(self.shape) != len(self.chunks):
             raise NgffError("shape/chunks rank mismatch")
         try:
-            self.dtype = np.dtype(meta["dtype"])
+            self._stored_dtype = np.dtype(meta["dtype"])
         except TypeError:
             raise NgffError(f"unsupported dtype {meta['dtype']!r}")
+        # Serve native byte order: big-endian zarr is spec-legal but
+        # the render/staging path needs native ndarrays (the TIFF
+        # reader normalizes the same way).
+        self.dtype = self._stored_dtype.newbyteorder("=")
         if meta.get("order", "C") != "C":
             raise NgffError("only C-order zarr arrays are supported")
         if meta.get("filters"):
@@ -105,10 +109,12 @@ class ZarrV2Array:
         elif self.codec == "gzip":
             raw = gzip.decompress(raw)
         n = int(np.prod(self.chunks))
-        arr = np.frombuffer(raw, dtype=self.dtype, count=-1)
+        arr = np.frombuffer(raw, dtype=self._stored_dtype, count=-1)
         if arr.size != n:
             raise NgffError(
                 f"chunk {path}: {arr.size} items, expected {n}")
+        if self._stored_dtype != self.dtype:
+            arr = arr.astype(self.dtype)      # byte-order normalize
         return arr.reshape(self.chunks)
 
 

@@ -48,6 +48,23 @@ _OME_TYPES = {"int8", "int16", "int32", "uint8", "uint16", "uint32",
 
 _SEG_CACHE_BYTES = 64 << 20
 
+# Process-wide segment-decode pool (daemon threads, lazily built):
+# sized for I/O + GIL-released native decode overlap rather than CPU
+# parallelism, so it helps even on single-core hosts.
+_DECODE_POOL = None
+_DECODE_POOL_LOCK = threading.Lock()
+
+
+def _decode_pool():
+    global _DECODE_POOL
+    with _DECODE_POOL_LOCK:
+        if _DECODE_POOL is None:
+            import concurrent.futures as cf
+            _DECODE_POOL = cf.ThreadPoolExecutor(
+                max_workers=max(4, (os.cpu_count() or 1) * 2),
+                thread_name_prefix="tiffdec")
+        return _DECODE_POOL
+
 
 def _localname(tag: str) -> str:
     return tag.rsplit("}", 1)[-1]
@@ -447,6 +464,7 @@ class OmeTiffSource:
         sample = c if self._interleaved_c else 0
         out = np.empty((region.height, region.width), dtype=self.dtype)
         page_key = (z, 0 if self._interleaved_c else c, t, level)
+        spans = []
         for gy in range(y0 // seg_h, min(grid_y, -(-y1 // seg_h))):
             for gx in range(x0 // seg_w, min(grid_x, -(-x1 // seg_w))):
                 cy0, cx0 = gy * seg_h, gx * seg_w
@@ -454,9 +472,24 @@ class OmeTiffSource:
                 iy0, iy1 = max(y0, cy0), min(y1, cy0 + seg_h)
                 if ix0 >= ix1 or iy0 >= iy1:
                     continue
-                seg = self._segment(tf, ifd, page_key, gy, gx)
-                out[iy0 - y0:iy1 - y0, ix0 - x0:ix1 - x0] = \
-                    seg[iy0 - cy0:iy1 - cy0, ix0 - cx0:ix1 - cx0, sample]
+                spans.append((gy, gx, cy0, cx0, iy0, iy1, ix0, ix1))
+
+        def fill(span) -> None:
+            gy, gx, cy0, cx0, iy0, iy1, ix0, ix1 = span
+            seg = self._segment(tf, ifd, page_key, gy, gx)
+            out[iy0 - y0:iy1 - y0, ix0 - x0:ix1 - x0] = \
+                seg[iy0 - cy0:iy1 - cy0, ix0 - cx0:ix1 - cx0, sample]
+
+        # Multi-segment regions decode concurrently on the shared pool
+        # (disjoint output slices; the native decoders release the GIL,
+        # so preads and entropy decode overlap even single-core — the
+        # cold first-touch path was serialized here).  Single-segment
+        # reads (the common warm tile) stay inline.
+        if len(spans) > 1:
+            list(_decode_pool().map(fill, spans))
+        else:
+            for span in spans:
+                fill(span)
         return out
 
     def get_stack(self, c: int, t: int) -> np.ndarray:

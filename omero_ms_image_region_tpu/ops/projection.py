@@ -92,19 +92,50 @@ def _finalize(acc, count, type_max, algorithm: int):
     return jnp.minimum(acc, type_max)    # type-max clamp (:280-282)
 
 
+def _finalize_host(acc: np.ndarray, count: int, type_max: float,
+                   algorithm) -> np.ndarray:
+    """Numpy mirror of :func:`_finalize` (identical reference
+    semantics: 0-floor max accumulator, mean divide, type-max clamp)."""
+    if algorithm == Projection.MAXIMUM_INTENSITY:
+        return np.maximum(acc, 0.0)
+    if algorithm == Projection.MEAN_INTENSITY:
+        acc = acc / max(float(count), 1.0)
+    return np.minimum(acc, np.float32(type_max))
+
+
+def _resolve_placement(placement: str, sample) -> str:
+    """``auto`` folds where the data lives: a host-resident source
+    (numpy reads) folds on host and ships ONE projected plane across
+    the link — a projection is a reduction, so uploading Z planes to
+    reduce them device-side pays Z plane transfers to save host work
+    that is memory-bound anyway (measured on the tunnel: 32x1024^2 u16
+    cold projections went 0.14/s device-fold -> host-fold at memory
+    speed).  Device-resident sources keep the device fold (zero
+    transfers either way).  Co-located deployments with fast links can
+    force ``device``."""
+    if placement == "auto":
+        return "host" if isinstance(sample, np.ndarray) else "device"
+    if placement not in ("host", "device"):
+        raise ValueError(f"unknown placement {placement!r}")
+    return placement
+
+
 def project_planes(get_plane, algorithm, size_z: int, start: int,
                    end: int, stepping: int = 1,
-                   type_max: float = 255.0, shape=None):
+                   type_max: float = 255.0, shape=None,
+                   placement: str = "auto"):
     """Stream a Z-projection plane by plane — WSI-scale memory bound.
 
     Where :func:`project_stack` needs the whole ``[Z, H, W]`` stack
     resident (matching ``PixelBuffer.getStack`` at
     ``ProjectionService.java:72``, which stalls and swaps on real WSI
     stacks), this reads ONLY the planes inside the Z window via
-    ``get_plane(z) -> [H, W]`` and folds each into a device-resident
-    accumulator: peak memory is one host plane + two device planes per
-    channel, independent of Z.  Host reads overlap device folds (JAX
-    dispatch is async), so the stream also pipelines disk and link.
+    ``get_plane(z) -> [H, W]`` and folds each into an accumulator:
+    peak memory is one plane + the accumulator, independent of Z.
+
+    ``placement`` picks where the fold runs (see
+    :func:`_resolve_placement`: ``auto`` folds where the data lives, so
+    host sources never upload the stack just to reduce it).
 
     Reference semantics are identical to :func:`project_stack`
     (inclusive max / exclusive mean-sum windows, stepping, 0-floor max
@@ -114,10 +145,20 @@ def project_planes(get_plane, algorithm, size_z: int, start: int,
     """
     algorithm, zs, inclusive = _validate_and_window(
         algorithm, size_z, start, end, stepping)
-    fold = _fold_max if inclusive else _fold_sum
     acc = None
-    for z in zs:
-        plane = jnp.asarray(get_plane(z))
+    first = get_plane(zs[0]) if zs else None
+    if zs:
+        placement = _resolve_placement(placement, first)
+    if placement == "host" and zs:
+        acc = np.asarray(first, np.float32)
+        for z in zs[1:]:
+            plane = np.asarray(get_plane(z), np.float32)
+            acc = np.maximum(acc, plane) if inclusive else acc + plane
+        return jnp.asarray(_finalize_host(acc, len(zs), type_max,
+                                          algorithm))
+    fold = _fold_max if inclusive else _fold_sum
+    for i, z in enumerate(zs):
+        plane = jnp.asarray(first if i == 0 else get_plane(z))
         acc = (plane.astype(jnp.float32) if acc is None
                else fold(acc, plane))
     if acc is None:
@@ -177,7 +218,7 @@ def project_region_banded(get_band, algorithm, size_z: int, start: int,
                           end: int, stepping: int = 1,
                           type_max: float = 255.0, plane_shape=None,
                           band_rows: int = 256, z_chunk: int = 8,
-                          get_chunk=None):
+                          get_chunk=None, placement: str = "auto"):
     """Spatially-banded streamed Z-projection — peak HOST footprint is
     chunk-sized, not plane-sized.
 
@@ -203,6 +244,10 @@ def project_region_banded(get_band, algorithm, size_z: int, start: int,
     windows, stepping, 0-floor max accumulator, type-max clamp —
     ``ProjectionService.java:176-291``).
 
+    ``placement`` picks where the folds run (``auto`` = where the data
+    lives, :func:`_resolve_placement`): a host source folds each band
+    in numpy and only the finished [H, W] plane crosses the link.
+
     Returns f32[H, W] on device.
     """
     algorithm, zs, inclusive = _validate_and_window(
@@ -210,11 +255,62 @@ def project_region_banded(get_band, algorithm, size_z: int, start: int,
     if plane_shape is None:
         raise ValueError("plane_shape is required")
     H, W = plane_shape
+    band_h = min(band_rows, H)
+    alg = int(algorithm)
+
+    # Auto-placement probes are REUSED as the first loop read, so auto
+    # costs no extra I/O: the band probe is (band 0, z0); the chunk
+    # probe reads the full first [z_chunk, band, W] block.
+    probe = probe_chunk = None
+    first_chunk_zs = tuple(zs[:z_chunk])
+    if zs and placement == "auto":
+        if get_chunk is not None:
+            sample = probe_chunk = get_chunk(list(first_chunk_zs), 0,
+                                             band_h)
+        else:
+            sample = probe = get_band(zs[0], 0, band_h)
+        placement = _resolve_placement(placement, sample)
+
+    def read_band(z, y0, h):
+        nonlocal probe
+        if probe is not None and z == zs[0] and y0 == 0:
+            band, probe = probe, None
+            return band
+        return get_band(z, y0, h)
+
+    def read_chunk(chunk_zs, y0, h):
+        nonlocal probe_chunk
+        if (probe_chunk is not None and y0 == 0
+                and tuple(chunk_zs) == first_chunk_zs):
+            chunk, probe_chunk = probe_chunk, None
+            return chunk
+        return get_chunk(chunk_zs, y0, h)
+
+    if placement == "host" and zs:
+        out = np.zeros((H, W), np.float32)
+        for bi in range(-(-H // band_h)):
+            y0 = min(bi * band_h, H - band_h)
+            acc = (np.full((band_h, W), -np.inf, np.float32)
+                   if inclusive else np.zeros((band_h, W), np.float32))
+            for ci in range(0, len(zs), z_chunk):
+                chunk_zs = zs[ci:ci + z_chunk]
+                if get_chunk is not None:
+                    chunk = np.asarray(
+                        read_chunk(chunk_zs, y0, band_h), np.float32)
+                else:
+                    chunk = np.stack([
+                        np.asarray(read_band(z, y0, band_h), np.float32)
+                        for z in chunk_zs])
+                if inclusive:
+                    acc = np.maximum(acc, chunk.max(axis=0))
+                else:
+                    acc += chunk.sum(axis=0)
+            out[y0:y0 + band_h] = acc
+        return jnp.asarray(_finalize_host(out, len(zs), type_max,
+                                          algorithm))
 
     out = jnp.zeros((H, W), jnp.float32)
-    band_h = min(band_rows, H)
     n_bands = -(-H // band_h)
-    alg = int(algorithm)
     for bi in range(n_bands):
         y0 = min(bi * band_h, H - band_h)
         if not zs:
@@ -237,7 +333,7 @@ def project_region_banded(get_band, algorithm, size_z: int, start: int,
                     chunk = xp.concatenate(
                         [chunk] + [pad] * (z_chunk - len(chunk_zs)))
             else:
-                bands = [get_band(z, y0, band_h) for z in chunk_zs]
+                bands = [read_band(z, y0, band_h) for z in chunk_zs]
                 if len(bands) < z_chunk:
                     # Fixed chunk shape = one compiled fold.  Max pads
                     # by repeating a real band (idempotent); sum pads

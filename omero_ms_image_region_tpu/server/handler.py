@@ -447,13 +447,15 @@ class ImageRegionHandler:
 
         def load_staged():
             """Cold staging pipeline: band the region's rows and ship
-            each band as its own async device_put, so band k+1's disk
-            read overlaps band k's host->HBM transfer (JAX dispatch
-            returns before the copy lands).  Small regions take the
-            single-shot path — banding only pays when the read itself
-            has substance."""
-            import jax
+            each band as its own async packed upload (``io.staging``),
+            so band k+1's disk read + pack overlaps band k's host->HBM
+            transfer (JAX dispatch returns before the copy lands) and
+            uint16 content crosses the link ~1.4x smaller.  Small
+            regions take the single-shot path — banding only pays when
+            the read itself has substance."""
             import jax.numpy as jnp
+
+            from ..io.staging import stage
             n_bands = min(4, region.height // _STAGE_BAND_ROWS)
             if n_bands < 2:
                 return load()
@@ -479,7 +481,7 @@ class ImageRegionHandler:
                     src.get_region(ctx.z, c, ctx.t, sub, level)
                     for c in active
                 ])
-                parts.append(jax.device_put(band))
+                parts.append(stage(band))
             return jnp.concatenate(parts, axis=1)
 
         if self.s.raw_cache is None or not device_cache:
@@ -528,6 +530,11 @@ class ImageRegionHandler:
                     # getStack would materialize Z full planes here).
                     band = max(64, _PROJECTION_BAND_BYTES
                                // max(pixels.size_x * 4, 1))
+                    # placement="host": PixelSource reads are host
+                    # numpy, and a projection is a reduction — folding
+                    # host-side ships ONE plane over the link instead
+                    # of the whole Z window (the cold-path bottleneck
+                    # on network-attached devices).
                     return projection_ops.project_region_banded(
                         lambda z, y0, h: src.get_region(
                             z, c, ctx.t,
@@ -535,11 +542,12 @@ class ImageRegionHandler:
                         ctx.projection, pixels.size_z, start, end, 1,
                         type_max,
                         plane_shape=(pixels.size_y, pixels.size_x),
-                        band_rows=band)
+                        band_rows=band, placement="host")
                 return projection_ops.project_planes(
                     lambda z: src.get_region(z, c, ctx.t, full, 0),
                     ctx.projection, pixels.size_z, start, end, 1,
-                    type_max, shape=(pixels.size_y, pixels.size_x))
+                    type_max, shape=(pixels.size_y, pixels.size_x),
+                    placement="host")
 
         # Full-plane f32 entries can dwarf the raw tiles the shared HBM
         # cache exists for; cache a projection only when it fits well

@@ -96,7 +96,15 @@ class LocalMetadataService:
         ngff = await asyncio.to_thread(
             find_ngff, self._image_dir(image_id))
         if ngff is not None:
-            mtime = os.stat(ngff).st_mtime_ns
+            # Stat the metadata FILES, not the directory: an in-place
+            # rewrite replaces .zattrs/.zarray contents without
+            # touching the directory mtime.
+            mtime = max(
+                (os.stat(p).st_mtime_ns
+                 for p in (os.path.join(ngff, ".zattrs"),
+                           os.path.join(ngff, ".zarray"))
+                 if os.path.exists(p)),
+                default=os.stat(ngff).st_mtime_ns)
             cached = self._tiff_pixels.get(image_id)
             if cached is not None and cached[0] == (ngff, mtime):
                 return cached[1]

@@ -32,6 +32,95 @@ def _make_group(B=8, C=4, H=64, W=64, quality=0):
     return group
 
 
+def _make_overflow_group(B=8, C=4, H=64, W=64, quality=85):
+    """Deterministic mid-density content whose wire totals land in
+    (cap, 2*cap] for every tile (probed: 10 noise columns over a flat
+    background, seed 7) — forces the one-shot cap-widening rescue."""
+    import numpy as np
+
+    from omero_ms_image_region_tpu.flagship import flagship_rdef
+    from omero_ms_image_region_tpu.ops.render import pack_settings
+    from omero_ms_image_region_tpu.server.batcher import _Pending
+
+    rng = np.random.default_rng(7)
+    settings = pack_settings(flagship_rdef(C))
+    group = []
+    for _ in range(B):
+        raw = np.full((C, H, W), 20000, np.float32)
+        raw[:, :, :10] = rng.uniform(0, 60000, (C, H, 10)).astype(
+            np.float32)
+        group.append(_Pending(raw=raw, settings=settings, h=H, w=W,
+                              quality=quality))
+    return group
+
+
+def _spy_jpeg_launches():
+    """Class-level instrumentation of every sharded JPEG dispatch:
+    returns the list the launches append to (leader and follower alike
+    go through MeshRenderer._jpeg_step)."""
+    from omero_ms_image_region_tpu.parallel.serve import MeshRenderer
+
+    launches = []
+    orig = MeshRenderer._jpeg_step
+
+    def spy(self, quality, cap, engine="sparse", cap_words=None):
+        step = orig(self, quality, cap, engine, cap_words)
+
+        def wrapped(*args):
+            launches.append([engine, quality, cap, cap_words])
+            return step(*args)
+        return wrapped
+
+    MeshRenderer._jpeg_step = spy
+    return launches
+
+
+def serve_overflow_mode(pid: int) -> dict:
+    """Pod-wide wire-cap overflow: the leader serves two overflowing
+    groups (base dispatch -> 2x rescue -> memo-started 2x); the
+    follower must replay the IDENTICAL launch sequence from the
+    replicated totals alone (``parallel/serve.py`` lockstep memos)."""
+    import hashlib
+
+    from omero_ms_image_region_tpu.parallel import cluster
+    from omero_ms_image_region_tpu.parallel.serve import (
+        MeshRenderer, run_pod_follower)
+
+    launches = _spy_jpeg_launches()
+    mesh = cluster.global_mesh(chan_parallel=2)
+    if pid != 0:
+        groups = run_pod_follower(mesh, jpeg_engine="huffman")
+        return {"follower_groups": groups, "launches": launches}
+    renderer = MeshRenderer(mesh, jpeg_engine="huffman")
+    jpegs1 = renderer._render_group_jpeg(_make_overflow_group())
+    jpegs2 = renderer._render_group_jpeg(_make_overflow_group())
+    renderer._pod.announce(0)          # shutdown broadcast
+    return {
+        "launches": launches,
+        "jpeg_sha": hashlib.sha256(
+            b"".join(jpegs1 + jpegs2)).hexdigest(),
+        "n_jpegs": len(jpegs1) + len(jpegs2),
+    }
+
+
+def reference_overflow_mode() -> dict:
+    """Single-process 8-device digests for the overflow groups."""
+    import hashlib
+
+    from omero_ms_image_region_tpu.parallel.mesh import make_mesh
+    from omero_ms_image_region_tpu.parallel.serve import MeshRenderer
+
+    renderer = MeshRenderer(make_mesh(8, chan_parallel=2),
+                            jpeg_engine="huffman")
+    jpegs1 = renderer._render_group_jpeg(_make_overflow_group())
+    jpegs2 = renderer._render_group_jpeg(_make_overflow_group())
+    return {
+        "jpeg_sha": hashlib.sha256(
+            b"".join(jpegs1 + jpegs2)).hexdigest(),
+        "n_jpegs": len(jpegs1) + len(jpegs2),
+    }
+
+
 def serve_mode(pid: int) -> dict:
     """Leader drives a MeshRenderer; followers replay via the pod
     channel.  Returns the leader's output digests."""
@@ -107,6 +196,11 @@ def main() -> int:
         out.update({"pid": pid, "ok": True})
         print(json.dumps(out))
         return 0
+    if mode == "reference-overflow":
+        out = reference_overflow_mode()
+        out.update({"pid": pid, "ok": True})
+        print(json.dumps(out))
+        return 0
     from omero_ms_image_region_tpu.flagship import flagship_rdef
     from omero_ms_image_region_tpu.ops.render import pack_settings
     from omero_ms_image_region_tpu.parallel import cluster
@@ -120,6 +214,11 @@ def main() -> int:
 
     if mode == "serve":
         out = serve_mode(pid)
+        out.update({"pid": pid, "ok": True})
+        print(json.dumps(out))
+        return 0
+    if mode == "serve-overflow":
+        out = serve_overflow_mode(pid)
         out.update({"pid": pid, "ok": True})
         print(json.dumps(out))
         return 0

@@ -280,12 +280,16 @@ class TestProjectRegionBanded:
     """Spatially-banded streaming projection: band-sized peak memory,
     exact parity with the full-stack kernel."""
 
+    @pytest.mark.parametrize("placement", ["host", "device"])
     @pytest.mark.parametrize("alg", [
         Projection.MAXIMUM_INTENSITY, Projection.MEAN_INTENSITY,
         Projection.SUM_INTENSITY])
     @pytest.mark.parametrize("start,end,stepping", [
         (0, 7, 1), (2, 6, 2), (1, 1, 1), (3, 3, 1)])
-    def test_parity_with_project_stack(self, alg, start, end, stepping):
+    def test_parity_with_project_stack(self, alg, start, end, stepping,
+                                       placement):
+        """Both fold placements (host numpy, device jnp) match the
+        full-stack kernel bit-for-bit in semantics."""
         from omero_ms_image_region_tpu.ops.projection import (
             project_region_banded, project_stack)
 
@@ -300,8 +304,57 @@ class TestProjectRegionBanded:
         got = np.asarray(project_region_banded(
             lambda z, y0, h: stack[z, y0:y0 + h],
             alg, 8, start, end, stepping, 65535.0,
-            plane_shape=(75, 40), band_rows=32, z_chunk=3))
+            plane_shape=(75, 40), band_rows=32, z_chunk=3,
+            placement=placement))
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-3)
+
+    def test_auto_placement_folds_host_sources_on_host(self):
+        """A numpy source must not upload the stack: auto placement
+        folds host-side and ships one plane."""
+        import jax.numpy as jnp
+
+        from omero_ms_image_region_tpu.ops import projection as proj
+
+        rng = np.random.default_rng(47)
+        stack = rng.integers(0, 60000, size=(6, 64, 48)).astype(
+            np.uint16)
+        uploads = []
+        orig = jnp.asarray
+
+        def spy(x, *a, **k):
+            if isinstance(x, np.ndarray) and x.ndim >= 2:
+                uploads.append(x.shape)
+            return orig(x, *a, **k)
+
+        proj.jnp.asarray = spy
+        try:
+            got = np.asarray(proj.project_region_banded(
+                lambda z, y0, h: stack[z, y0:y0 + h],
+                Projection.MAXIMUM_INTENSITY, 6, 0, 5, 1, 65535.0,
+                plane_shape=(64, 48), band_rows=32, z_chunk=4))
+        finally:
+            proj.jnp.asarray = orig
+        # Exactly ONE device transfer: the finished projected plane.
+        assert uploads == [(64, 48)]
+        np.testing.assert_array_equal(
+            got, stack.astype(np.float32).max(axis=0))
+
+    def test_project_planes_host_placement_parity(self):
+        from omero_ms_image_region_tpu.ops.projection import (
+            project_planes, project_stack)
+
+        rng = np.random.default_rng(48)
+        stack = rng.integers(0, 60000, size=(5, 40, 40)).astype(
+            np.uint16)
+        for alg in (Projection.MAXIMUM_INTENSITY,
+                    Projection.MEAN_INTENSITY,
+                    Projection.SUM_INTENSITY):
+            want = np.asarray(project_stack(
+                stack.astype(np.float32), alg, 1, 4, 1, 65535.0))
+            got = np.asarray(project_planes(
+                lambda z: stack[z], alg, 5, 1, 4, 1, 65535.0,
+                placement="host"))
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-3)
 
     def test_reads_are_band_bounded(self):
         from omero_ms_image_region_tpu.ops.projection import (

@@ -297,3 +297,87 @@ class TestMeshServingHTTP:
         status, body = run(go())
         assert status == 200
         assert body[:2] == b"\xff\xd8"
+
+
+class TestMeshOverflowLockstep:
+    """Wire-cap overflow on the 8-device mesh: the one-shot cap-
+    widening rescue must produce a DETERMINISTIC launch sequence
+    (base cap, then 2x, then memo-started 2x) and byte-identical
+    output to the single-device serving path — the property multi-host
+    lockstep rests on (``parallel/serve.py`` cap memos driven by
+    replicated totals)."""
+
+    B, C, H, W = 8, 4, 64, 64
+
+    def _overflow_group(self, quality=85):
+        """Deterministic mid-density content whose wire totals land in
+        (cap, 2*cap] for every tile (probed: band=10 noise columns over
+        a flat background, seed 7)."""
+        from omero_ms_image_region_tpu.flagship import flagship_rdef
+        from omero_ms_image_region_tpu.ops.render import pack_settings
+        from omero_ms_image_region_tpu.server.batcher import _Pending
+
+        rng = np.random.default_rng(7)
+        flat = np.full((self.C, self.H, self.W), 20000, np.float32)
+        settings = pack_settings(flagship_rdef(self.C))
+        group = []
+        for _ in range(self.B):
+            raw = flat.copy()
+            raw[:, :, :10] = rng.uniform(
+                0, 60000, (self.C, self.H, 10)).astype(np.float32)
+            group.append(_Pending(raw=raw, settings=settings,
+                                  h=self.H, w=self.W, quality=quality))
+        return group
+
+    @pytest.mark.parametrize("engine", ["huffman", "sparse"])
+    def test_overflow_rescue_launch_sequence_and_parity(self, engine):
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+        from omero_ms_image_region_tpu.flagship import batched_args
+        from omero_ms_image_region_tpu.parallel.serve import MeshRenderer
+
+        je._CAP_MEMO.clear()
+        renderer = MeshRenderer(_mesh(), jpeg_engine=engine)
+        launches = []
+        orig = MeshRenderer._jpeg_step
+
+        def spy(self, quality, cap, engine_="sparse", cap_words=None):
+            step = orig(self, quality, cap, engine_, cap_words)
+
+            def wrapped(*args):
+                launches.append((engine_, quality, cap, cap_words))
+                return step(*args)
+            return wrapped
+
+        MeshRenderer._jpeg_step = spy
+        try:
+            jpegs1 = renderer._render_group_jpeg(self._overflow_group())
+            jpegs2 = renderer._render_group_jpeg(self._overflow_group())
+        finally:
+            MeshRenderer._jpeg_step = orig
+        base_cap = je.default_sparse_cap(self.H, self.W, 85)
+        base_words = je.default_words_cap(self.H, self.W, 85)
+        if engine == "huffman":
+            want = [("huffman", 85, base_cap, base_words),
+                    ("huffman", 85, 2 * base_cap, 2 * base_words),
+                    ("huffman", 85, 2 * base_cap, 2 * base_words)]
+        else:
+            want = [("sparse", 85, base_cap, None),
+                    ("sparse", 85, 2 * base_cap, None),
+                    ("sparse", 85, 2 * base_cap, None)]
+        # Group 1: base dispatch + one rescue at 2x; group 2: the memo
+        # starts at 2x directly.  NO dense fallbacks (rescue covered
+        # every tile) and NO extra launches.
+        assert launches == want
+
+        # Byte parity with the single-device serving path on the same
+        # pixels/settings (its own memo key; fresh = same rescue).
+        group = self._overflow_group()
+        raw = np.stack([p.raw for p in group])
+        s = group[0].settings
+        args = batched_args(s, raw)
+        plain = je.render_batch_to_jpeg(
+            raw, *args[1:], quality=85,
+            dims=[(self.W, self.H)] * self.B, engine=engine)
+        assert plain == jpegs1 == jpegs2
+        run(renderer.close())
+        je._CAP_MEMO.clear()

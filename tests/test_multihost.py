@@ -108,3 +108,29 @@ def test_four_process_pod_serves_identically():
     ref = _run_workers("reference", (0,))[0]
     assert ref["packed_sha"] == leader["packed_sha"]
     assert ref["jpeg_sha"] == leader["jpeg_sha"]
+
+
+def test_two_process_pod_overflow_rescue_stays_in_lockstep():
+    """Wire-cap overflow across the pod: both processes must launch the
+    IDENTICAL sharded program sequence — base caps, the one-shot 2x
+    rescue, then the memo-started 2x for the next group — decided
+    purely from replicated wire totals (``parallel/serve.py``; a
+    host-local divergence here would hang a real pod).  The leader's
+    bytes must equal the single-process 8-device reference."""
+    outs = _run_workers("serve-overflow", (0, 1))
+    leader, follower = outs[0], outs[1]
+    assert follower["follower_groups"] == 2
+    assert leader["n_jpegs"] == 16
+
+    # Identical launch sequences, and exactly the rescue shape:
+    # [base, 2x] for group 1, [2x] (memo) for group 2.
+    assert leader["launches"] == follower["launches"]
+    caps = [tuple(launch) for launch in leader["launches"]]
+    assert len(caps) == 3
+    (e0, q0, c0, w0), (e1, q1, c1, w1), (e2, q2, c2, w2) = caps
+    assert e0 == e1 == e2 == "huffman" and q0 == q1 == q2 == 85
+    assert c1 == 2 * c0 and w1 == 2 * w0
+    assert (c2, w2) == (c1, w1)
+
+    ref = _run_workers("reference-overflow", (0,))[0]
+    assert ref["jpeg_sha"] == leader["jpeg_sha"]

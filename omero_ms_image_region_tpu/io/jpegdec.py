@@ -484,16 +484,59 @@ def _reconstruct(frame, ts: _TableSet, grids, hmax: int,
 # hostile streams must not drive unbounded re-walks of the image.
 _MAX_SCANS = 256
 
-# Cumulative block-visit budget across ALL scans: every scan re-walks
-# its band over the frame, so scan count alone is not a work bound — a
-# tiny stream declaring a huge frame plus many refinement scans (which
-# decode "successfully" off the reader's 1-bit padding) would amplify
-# ~256x past the frame-size cap.  Each Python block visit costs ~1 us,
-# so 8M bounds a hostile stream's CPU at seconds, while a 4096^2
-# 10-scan progressive photo (~7.8M visits) still decodes and real WSI
-# tiles (<= 2048^2, ~2M visits for a rich 10-scan file) clear it with
-# wide margin.
+# FLOOR of the cumulative block-visit budget across ALL scans: every
+# scan re-walks its band over the frame, so scan count alone is not a
+# work bound — a tiny stream declaring a huge frame plus many scans
+# (which decode "successfully" off the reader's 1-bit padding) would
+# amplify far past the frame-size cap.  The effective budget scales
+# with the DECLARED frame (``max(floor, 64 * blocks_per_frame)``, the
+# rule the native decoder shares), so a deep scan script over a
+# legitimately large frame decodes while amplification beyond ~64 full
+# walks is rejected.
 _MAX_BLOCK_VISITS = 1 << 23
+
+
+class _ScanScript:
+    """Successive-approximation succession state (T.81 G.1.1.1.1):
+    tracks each coefficient's current approximation level so a
+    malformed-but-parseable scan script raises instead of silently
+    decoding garbage — an AC scan needs its component's DC first scan,
+    a first scan per coefficient happens once, and a refinement's Ah
+    must continue the band's Al (with Al = Ah - 1).  Identical rules in
+    the native decoder (byte-parity contract)."""
+
+    _NONE = -2
+
+    def __init__(self, ncomp: int) -> None:
+        self.dc_al = [self._NONE] * ncomp
+        self.ac_al = [[self._NONE] * 64 for _ in range(ncomp)]
+
+    def validate(self, comps, sel, ss, se, ah, al) -> None:
+        if ss == 0:
+            for c in sel:
+                ci = comps.index(c)
+                if ah == 0:
+                    if self.dc_al[ci] != self._NONE:
+                        raise JpegError("duplicate DC first scan")
+                elif self.dc_al[ci] != ah or al != ah - 1:
+                    raise JpegError(
+                        f"DC refinement Ah={ah} does not continue "
+                        f"Al={self.dc_al[ci]}")
+                self.dc_al[ci] = al
+            return
+        ci = comps.index(sel[0])
+        if self.dc_al[ci] == self._NONE:
+            raise JpegError("AC scan before the component's DC scan")
+        band = self.ac_al[ci]
+        for k in range(ss, se + 1):
+            if ah == 0:
+                if band[k] != self._NONE:
+                    raise JpegError("duplicate AC first scan")
+            elif band[k] != ah or al != ah - 1:
+                raise JpegError(
+                    f"AC refinement Ah={ah} does not continue "
+                    f"Al={band[k]}")
+            band[k] = al
 
 
 def _next_marker_pos(data: bytes, pos: int) -> int:
@@ -519,8 +562,18 @@ def _decode_progressive_scans(data, ts, frame, grids, scan, scan_start,
     """
     h, w, comps = frame
     visits = 0
+    # Frame-scaled budget (floor _MAX_BLOCK_VISITS): see the constant's
+    # comment; the native decoder applies the same rule.  The scale
+    # term is CAPPED (1 << 25 visits, ~30 s worst case on this pure-
+    # Python path) so attacker-declared SOF dimensions cannot buy
+    # unbounded amplification headroom.
+    total_blocks = sum(mcux * c.h * mcuy * c.v for c in comps)
+    max_visits = max(_MAX_BLOCK_VISITS,
+                     min(64 * total_blocks, 1 << 25))
+    script = _ScanScript(len(comps))
     for _ in range(_MAX_SCANS):
         sel, ss, se, ah, al = scan
+        script.validate(comps, sel, ss, se, ah, al)
         if ss == 0:
             visits += (sum(mcux * c.h * mcuy * c.v for c in sel)
                        if len(sel) > 1 else
@@ -529,7 +582,7 @@ def _decode_progressive_scans(data, ts, frame, grids, scan, scan_start,
         else:
             visits += int(np.prod(_comp_block_dims(sel[0], h, w,
                                                    hmax, vmax)))
-        if visits > _MAX_BLOCK_VISITS:
+        if visits > max_visits:
             raise JpegError("progressive stream exceeds the "
                             "cumulative block budget")
         reader = _BitReader(data, scan_start)
@@ -740,55 +793,28 @@ def ycbcr_to_rgb(img: np.ndarray) -> np.ndarray:
     return np.clip(np.round(rgb), 0, 255).astype(np.uint8)
 
 
-def _sniff_sof(data: bytes) -> Optional[int]:
-    """The stream's SOF marker byte (0xC0..0xCF), or None.  Header-only
-    walk — used to route progressive (SOF2) streams straight to the
-    Python decoder instead of bouncing off the baseline-only native
-    one."""
-    pos = 2
-    while pos + 4 <= len(data):
-        if data[pos] != 0xFF:
-            return None
-        marker = data[pos + 1]
-        if marker == 0xD9 or marker == 0xDA:
-            return None
-        if marker == 0x01 or 0xD0 <= marker <= 0xD7:
-            pos += 2
-            continue
-        if 0xC0 <= marker <= 0xCF and marker not in (0xC4, 0xC8, 0xCC):
-            return marker
-        seglen = struct.unpack(">H", data[pos + 2:pos + 4])[0]
-        if seglen < 2:
-            return None
-        pos += 2 + seglen
-    return None
-
-
 def decode_tiff_jpeg(data: bytes, tables_bytes: Optional[bytes],
                      photometric: int,
                      tables_cache: Optional[dict] = None) -> np.ndarray:
     """Decode one TIFF compression-7 segment to ``u8[h, w, spp]``.
 
-    Prefers the native decoder (``native.jpeg_decode_baseline``), falls
-    back to the pure-Python implementation — the LZW pattern.
-    Progressive (SOF2) streams go straight to the Python decoder (the
-    native fast path is baseline-only; vendor WSI tiles are baseline in
-    practice, so the slow path only carries the rare progressive
-    export).  YCbCr (photometric 6) converts to RGB here; photometric
-    1/2 pass raw components through (libtiff writes photometric 2 with
-    RGB stored directly in the JPEG).  ``tables_cache`` (per-TiffFile)
+    Prefers the native decoder (``native.jpeg_decode_baseline``, which
+    despite the name covers baseline SOF0/1 AND progressive SOF2),
+    falls back to the pure-Python implementation — the LZW pattern.
+    YCbCr (photometric 6) converts to RGB here; photometric 1/2 pass
+    raw components through (libtiff writes photometric 2 with RGB
+    stored directly in the JPEG).  ``tables_cache`` (per-TiffFile)
     memoizes the parsed JPEGTables so the Python path builds its
     Huffman lookups once per file rather than once per tile; the native
     decoder's own table build is a ~1 MB fill, noise next to its
     per-tile decode.
     """
     out: Optional[np.ndarray] = None
-    if _sniff_sof(data) != 0xC2:
-        try:
-            from ..native import jpeg_decode_baseline
-            out = jpeg_decode_baseline(data, tables_bytes)
-        except ImportError:
-            pass
+    try:
+        from ..native import jpeg_decode_baseline
+        out = jpeg_decode_baseline(data, tables_bytes)
+    except ImportError:
+        pass
     if out is None:
         ts = None
         if tables_bytes:

@@ -433,11 +433,12 @@ def jpeg_encode_sparse_native(buf, width: int, height: int, quality: int,
 
 
 def jpeg_decode_baseline(data: bytes, tables: "bytes | None"):
-    """Decode one baseline JPEG (optionally abbreviated, with a TIFF
-    JPEGTables stream) to ``u8[h, w, ncomp]`` raw components.
+    """Decode one JPEG (optionally abbreviated, with a TIFF JPEGTables
+    stream) to ``u8[h, w, ncomp]`` raw components.
 
     Native mirror of ``io.jpegdec.decode_baseline_jpeg`` — same scope
-    (SOF0/1, sampling 1-2, DRI/RST), GIL released for the whole decode.
+    (SOF0/1 baseline AND SOF2 progressive, sampling 1-2, DRI/RST,
+    inter-scan table updates), GIL released for the whole decode.
     Raises ImportError when no toolchain built the library and
     ValueError on malformed/unsupported streams.
     """

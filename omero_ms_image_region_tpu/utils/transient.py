@@ -29,26 +29,35 @@ T = TypeVar("T")
 
 # Substrings of transient transport statuses (matched case-insensitively
 # — strerror text capitalizes "Connection reset by peer"/"Broken pipe").
-# INTERNAL alone would be too broad for XLA (it also tags compiler
-# bugs), so the match requires a transport-flavored detail alongside it.
-_TRANSIENT_MARKERS = (
-    "unavailable",
+# Bare status names are too broad on their own: INTERNAL also tags
+# compiler bugs, and UNAVAILABLE also tags a persistently dead/detached
+# device ("device unavailable"), which a retry would only delay — and
+# double-dispatch against.  Both therefore require a transport-flavored
+# detail alongside the status.
+_TRANSPORT_DETAILS = (
     "read body",
     "response body closed",
     "connection reset",
     "broken pipe",
     "socket closed",
     "transport closed",
+    "connection refused",
+    "connection closed",
+    # gRPC transient texts that carry no socket-level detail.
+    "failed to connect",
+    "goaway",
+    "keepalive",
 )
 
 
 def is_transient_device_error(exc: BaseException) -> bool:
     """True when ``exc`` is a device-runtime error whose message says
-    the transport (not the program) failed."""
+    the TRANSPORT (not the program, and not the device itself)
+    failed."""
     if type(exc).__name__ not in ("JaxRuntimeError", "XlaRuntimeError"):
         return False
     msg = str(exc).lower()
-    return any(marker in msg for marker in _TRANSIENT_MARKERS)
+    return any(marker in msg for marker in _TRANSPORT_DETAILS)
 
 
 def retry_transient(fn: Callable[[], T], what: str = "device call",

@@ -93,9 +93,8 @@ def test_restart_markers():
 class TestProgressive:
     """Progressive (SOF2) decode — spectral-selection +
     successive-approximation scans, cross-validated against PIL's own
-    libjpeg decode.  Vendor WSI tiles are baseline in practice, so
-    progressive rides the pure-Python path (the native fast path stays
-    baseline-only; _sniff_sof routes around it)."""
+    libjpeg decode (the pure-Python path here; the native decoder's
+    byte parity with it is pinned by TestProgressiveNativeParity)."""
 
     def test_gray_and_444_match_pil_exactly(self):
         a = _smooth_rgb(61, 83)
@@ -129,8 +128,8 @@ class TestProgressive:
             assert d.max() <= 20 and d.mean() <= 4
 
     def test_progressive_tiff_serves(self, tmp_path):
-        """A progressive-JPEG TIFF reads through the TIFF layer (the
-        sniffer must route around the baseline-only native decoder)."""
+        """A progressive-JPEG TIFF reads through the TIFF layer
+        (native-first, Python fallback — both decode SOF2)."""
         a = _smooth_rgb(64, 64)
         # PIL's TIFF writer can't emit progressive; build a minimal
         # strip TIFF holding one full progressive JFIF stream
@@ -726,12 +725,15 @@ def test_multi_scan_rejected():
 
 
 def test_progressive_block_budget_bounds_hostile_streams(monkeypatch):
-    """A tiny stream declaring a large SOF2 frame plus many refinement
-    scans must die on the CUMULATIVE block budget - scan count alone is
-    no work bound, since each scan re-walks the whole declared frame
-    and DC-refine scans "decode" off the reader's padding bits with no
-    Huffman data at all.  The budget is patched small so the mechanism
-    is exercised without burning the CPU it exists to protect."""
+    """A tiny stream declaring a large SOF2 frame plus many scans must
+    die on the CUMULATIVE block budget - scan count alone is no work
+    bound, since each scan re-walks the whole declared frame off the
+    reader's padding bits with almost no Huffman data.  The scan script
+    here is VALID (succession checks pass: DC first, then per-band AC
+    first scans at Al=13, then refinements) so the budget itself is
+    what fires; the budget floor is patched small but the frame-scaled
+    term (64 full walks of the declared 640^2 frame) is what bounds
+    this stream."""
     import time
 
     from omero_ms_image_region_tpu.io import jpegdec
@@ -740,19 +742,127 @@ def test_progressive_block_budget_bounds_hostile_streams(monkeypatch):
         return (bytes([0xFF, marker])
                 + struct.pack(">H", len(body) + 2) + body)
 
-    # 640x640 1-component frame; two codes of length 1 put value 0 on
-    # code '1', so the DC-first scan decodes entirely off padding bits.
-    dqt = seg(0xDB, bytes([0]) + bytes([16] * 64))
-    dht = seg(0xC4, bytes([0]) + bytes([2] + [0] * 15) + bytes([0, 0]))
-    sof = seg(0xC2, bytes([8]) + struct.pack(">HH", 640, 640)
-              + bytes([1, 1, 0x11, 0]))
-    first = seg(0xDA, bytes([1, 1, 0x00, 0, 0, 0x06]))
-    refine = b"".join(
-        seg(0xDA, bytes([1, 1, 0x00, 0, 0, (a + 1) << 4 | a]))
-        for a in (5, 4, 3, 2, 1, 0) * 20)
-    data = b"\xff\xd8" + dqt + dht + sof + first + refine + b"\xff\xd9"
+    def hostile(side):
+        # 1-component frame; two codes of length 1 put value 0 on code
+        # '1', so every scan decodes entirely off padding bits (DC
+        # category 0; AC rs=0 -> immediate EOB run).  Scan script is
+        # valid: DC first, per-band AC firsts at Al=13, then per-band
+        # refinement chains 13..1.
+        dqt = seg(0xDB, bytes([0]) + bytes([16] * 64))
+        dht_dc = seg(0xC4, bytes([0x00]) + bytes([2] + [0] * 15)
+                     + bytes([0, 0]))
+        dht_ac = seg(0xC4, bytes([0x10]) + bytes([2] + [0] * 15)
+                     + bytes([0, 0]))
+        sof = seg(0xC2, bytes([8]) + struct.pack(">HH", side, side)
+                  + bytes([1, 1, 0x11, 0]))
+        scans = [seg(0xDA, bytes([1, 1, 0x00, 0, 0, 0x00]))]
+        scans += [seg(0xDA, bytes([1, 1, 0x00, k, k, 0x0D]))
+                  for k in range(1, 64)]
+        scans += [seg(0xDA, bytes([1, 1, 0x00, k, k,
+                                   (a << 4) | (a - 1)]))
+                  for k in range(1, 64)
+                  for a in range(13, 0, -1)]
+        return (b"\xff\xd8" + dqt + dht_dc + dht_ac + sof
+                + b"".join(scans[:250]) + b"\xff\xd9")
+
+    # Python: floor patched small; the frame-scaled term (64 walks of
+    # the 640^2 frame = 409,600 visits) fires at scan 65 of 250.
     monkeypatch.setattr(jpegdec, "_MAX_BLOCK_VISITS", 25_000)
     t0 = time.perf_counter()
     with pytest.raises(JpegError, match="block budget"):
-        decode_baseline_jpeg(data)
+        decode_baseline_jpeg(hostile(640))
     assert time.perf_counter() - t0 < 30
+    # Native: same rule with the compiled-in 8M floor — a declared
+    # 2048^2 frame (65,536 blocks/scan) exceeds it at scan 128.
+    from omero_ms_image_region_tpu.native import (
+        jpeg_decode_baseline, jpeg_native_available)
+    if jpeg_native_available():
+        with pytest.raises(ValueError):
+            jpeg_decode_baseline(hostile(2048), None)
+
+
+def test_progressive_frame_scaled_budget_allows_deep_scripts():
+    """The frame-scaled budget term must NOT reject a legitimate deep
+    scan script over a large frame: a PIL 10-scan progressive at a size
+    whose visits exceed the old fixed 8M budget would have been
+    rejected before the scaling rule."""
+    from omero_ms_image_region_tpu.io import jpegdec
+
+    # Claim: frame-scaling admits >= 64 full walks regardless of size.
+    # (A real 4096^2 decode is too slow for a unit test; assert the
+    # arithmetic instead of the walk.)
+    mcux = mcuy = 4096 // 8
+    total_blocks = mcux * mcuy
+    assert 64 * total_blocks > jpegdec._MAX_BLOCK_VISITS
+    assert max(jpegdec._MAX_BLOCK_VISITS, 64 * total_blocks) \
+        >= 12 * total_blocks   # a rich 12-scan script fits
+
+
+class TestProgressiveNativeParity:
+    """The native SOF2 path against the Python decoder: identical
+    coefficient reconstruction up to the float-IDCT rounding envelope
+    (+-1, the same contract the baseline decoders share in
+    test_native_matches_python), identical validation behavior."""
+
+    def _both(self, data, tables=None):
+        from omero_ms_image_region_tpu.io import jpegdec
+        from omero_ms_image_region_tpu.native import (
+            jpeg_decode_baseline, jpeg_native_available)
+        if not jpeg_native_available():
+            pytest.skip("no native toolchain")
+        ts = jpegdec.parse_jpeg_tables(tables) if tables else None
+        py = jpegdec.decode_baseline_jpeg(data, ts)
+        nat = jpeg_decode_baseline(data, tables)
+        return py, nat
+
+    @pytest.mark.parametrize("subsampling,quality", [
+        (0, 92), (1, 85), (2, 75)])
+    def test_rgb_parity(self, subsampling, quality):
+        a = _smooth_rgb(83, 61)
+        buf = io.BytesIO()
+        Image.fromarray(a).save(buf, "jpeg", quality=quality,
+                                progressive=True,
+                                subsampling=subsampling)
+        py, nat = self._both(buf.getvalue())
+        assert np.abs(py.astype(int) - nat.astype(int)).max() <= 1
+
+    def test_gray_parity(self):
+        a = _smooth_rgb(64, 96)[..., 0]
+        buf = io.BytesIO()
+        Image.fromarray(a).save(buf, "jpeg", quality=88,
+                                progressive=True)
+        py, nat = self._both(buf.getvalue())
+        assert np.abs(py.astype(int) - nat.astype(int)).max() <= 1
+
+    def test_restart_interval_parity(self):
+        a = _smooth_rgb(96, 80)
+        buf = io.BytesIO()
+        Image.fromarray(a).save(buf, "jpeg", quality=80,
+                                progressive=True, subsampling=2,
+                                restart_marker_blocks=2)
+        py, nat = self._both(buf.getvalue())
+        assert np.abs(py.astype(int) - nat.astype(int)).max() <= 1
+
+    def test_native_rejects_what_python_rejects(self):
+        """Validation parity on malformed scripts: a refinement whose
+        Ah does not continue the band's Al fails BOTH decoders."""
+        from omero_ms_image_region_tpu.io.jpegdec import (
+            JpegError, decode_baseline_jpeg)
+        from omero_ms_image_region_tpu.native import (
+            jpeg_decode_baseline, jpeg_native_available)
+        a = _smooth_rgb(48, 48)
+        buf = io.BytesIO()
+        Image.fromarray(a).save(buf, "jpeg", quality=85,
+                                progressive=True, subsampling=0)
+        blob = bytearray(buf.getvalue())
+        # Find the SECOND SOS and corrupt its Ah/Al byte to a level
+        # that cannot continue any band (Ah=9, Al=3).
+        first = blob.index(b"\xff\xda")
+        second = blob.index(b"\xff\xda", first + 2)
+        seglen = struct.unpack(">H", blob[second + 2:second + 4])[0]
+        blob[second + 2 + seglen - 1] = 0x93
+        with pytest.raises(JpegError):
+            decode_baseline_jpeg(bytes(blob))
+        if jpeg_native_available():
+            with pytest.raises(ValueError):
+                jpeg_decode_baseline(bytes(blob), None)

@@ -7,11 +7,16 @@ Hamamatsu exports, most vendor WSI pyramids) through Bio-Formats behind
 abbreviated per-tile streams TIFF stores), so the decoder is implemented
 directly; scope is what TIFF serving needs:
 
-- baseline sequential DCT, 8-bit samples (SOF0);
+- baseline sequential DCT (SOF0/1) and progressive DCT (SOF2);
+- 8-bit samples, plus 12-bit extended/progressive frames decoding to
+  uint16 (the precision-over-8 microscopy exports Bio-Formats reads);
 - 1..4 components, sampling factors 1-2 (4:4:4, 4:2:2, 4:2:0);
 - abbreviated streams: a ``JPEGTables`` (TIFF tag 347) stream carries
   DQT/DHT once, per-tile streams reference them (T.81 Annex B.5);
-- restart markers (DRI/RSTn).
+- restart markers (DRI/RSTn), inter-scan DHT/DQT/DRI updates.
+
+Lossless JPEG (SOF3) and arithmetic-coded processes reject with errors
+naming the variant.
 
 The entropy decode is a tight Python loop over Huffman codes; the heavy
 math (dequantize + IDCT + upsample + color transform) is vectorized
@@ -20,9 +25,10 @@ module (``native.jpeg_decode_baseline``); callers go through
 :func:`decode_tiff_jpeg` which prefers it — the same native-fallback
 pattern the LZW path uses (``io/tiff.py``).
 
-Output is the raw decoded component array ``[h, w, ncomp]`` uint8; the
-YCbCr→RGB decision belongs to the TIFF layer (photometric 6 converts,
-photometric 1/2 serve components as stored).
+Output is the raw decoded component array ``[h, w, ncomp]`` (uint8, or
+uint16 for 12-bit frames); the YCbCr→RGB decision belongs to the TIFF
+layer (photometric 6 converts, photometric 1/2 serve components as
+stored).
 """
 
 from __future__ import annotations
@@ -257,13 +263,19 @@ def _parse_segments(data: bytes, tables: _TableSet):
         elif marker in (0xC0, 0xC1, 0xC2):   # SOF0/1 baseline, SOF2 prog
             if len(body) < 6:
                 raise JpegError("truncated SOF")
-            if body[0] != 8:
-                # 12-bit extended sequential is legal JPEG but not this
-                # decoder's scope; decoding it as 8-bit would serve
-                # silently saturated garbage.
+            precision = body[0]
+            if marker == 0xC0 and precision != 8:
+                # Baseline DCT is 8-bit by definition (T.81 4.11).
                 raise JpegError(
-                    f"unsupported sample precision {body[0]} "
-                    f"(8-bit only)")
+                    f"unsupported sample precision {precision} "
+                    f"for baseline SOF0 (8-bit only)")
+            if precision not in (8, 12):
+                # 16-bit precision exists only in lossless JPEG
+                # (SOF3); DCT processes are 8/12-bit.  Decoding
+                # anything else would serve silently saturated garbage.
+                raise JpegError(
+                    f"unsupported sample precision {precision} "
+                    f"(8-bit and 12-bit extended/progressive only)")
             h, w = struct.unpack(">HH", body[1:5])
             ncomp = body[5]
             if not 1 <= ncomp <= 4 or len(body) < 6 + 3 * ncomp:
@@ -282,9 +294,12 @@ def _parse_segments(data: bytes, tables: _TableSet):
                         f"unsupported sampling {c.h}x{c.v}")
             if h == 0 or w == 0:
                 raise JpegError("zero frame dimension")
-            frame = (h, w, comps)
+            frame = (h, w, comps, precision)
             progressive = marker == 0xC2
-        elif marker in (0xC3, 0xC5, 0xC6, 0xC7,
+        elif marker == 0xC3:
+            raise JpegError(
+                "lossless JPEG (SOF3) is not supported")
+        elif marker in (0xC5, 0xC6, 0xC7,
                         0xC9, 0xCA, 0xCB, 0xCD, 0xCE, 0xCF):
             raise JpegError(
                 f"unsupported JPEG process (SOF{marker & 0xF})")
@@ -381,7 +396,7 @@ def decode_baseline_jpeg(data: bytes,
     frame, scan, scan_start, progressive = _parse_segments(data, ts)
     if frame is None or scan is None:
         raise JpegError("stream has no frame/scan")
-    h, w, comps = frame
+    h, w, comps, precision = frame
     hmax = max(c.h for c in comps)
     vmax = max(c.v for c in comps)
     mcux = -(-w // (8 * hmax))
@@ -427,7 +442,9 @@ def decode_baseline_jpeg(data: bytes,
                         t = _decode_huff(reader, dc_tbl)
                         if t > 15:
                             # A corrupt DHT can map codes to arbitrary
-                            # byte values; DC categories stop at 15.
+                            # byte values; DCT DC categories stop at 15
+                            # at BOTH 8- and 12-bit precision (SSSS 16
+                            # exists only in lossless coding).
                             raise JpegError("bad DC category")
                         diff = _extend(reader.receive(t), t)
                         preds[ci] += diff
@@ -456,8 +473,15 @@ def decode_baseline_jpeg(data: bytes,
 
 def _reconstruct(frame, ts: _TableSet, grids, hmax: int,
                  vmax: int) -> np.ndarray:
-    """Vectorized dequant + IDCT + level shift, per component."""
-    h, w, comps = frame
+    """Vectorized dequant + IDCT + level shift, per component.
+
+    12-bit frames (extended sequential / progressive, T.81 Table B.2)
+    level-shift by 2048 and serve uint16 planes — the
+    precision-over-8 microscopy exports Bio-Formats reads."""
+    h, w, comps, precision = frame
+    shift = 1 << (precision - 1)
+    top = (1 << precision) - 1
+    dtype = np.uint8 if precision == 8 else np.uint16
     planes = []
     for c, grid in zip(comps, grids):
         q = ts.quant[c.tq]
@@ -468,7 +492,7 @@ def _reconstruct(frame, ts: _TableSet, grids, hmax: int,
         spatial = np.einsum("ux,ybuv,vz->ybxz", _IDCT_M, coeff,
                             _IDCT_M, optimize=True)
         plane = spatial.transpose(0, 2, 1, 3).reshape(by * 8, bx * 8)
-        plane = np.clip(np.round(plane) + 128, 0, 255).astype(np.uint8)
+        plane = np.clip(np.round(plane) + shift, 0, top).astype(dtype)
         # Upsample to full MCU-grid resolution (pixel replication).
         if c.h < hmax:
             plane = np.repeat(plane, hmax // c.h, axis=1)
@@ -560,7 +584,7 @@ def _decode_progressive_scans(data, ts, frame, grids, scan, scan_start,
     padding blocks are not coded in non-interleaved scans
     (T.81 G.2 / A.2.2).
     """
-    h, w, comps = frame
+    h, w, comps, _precision = frame
     visits = 0
     # Frame-scaled budget (floor _MAX_BLOCK_VISITS): see the constant's
     # comment; the native decoder applies the same rule.  The scale
@@ -793,6 +817,29 @@ def ycbcr_to_rgb(img: np.ndarray) -> np.ndarray:
     return np.clip(np.round(rgb), 0, 255).astype(np.uint8)
 
 
+def _sniff_precision(data: bytes) -> int:
+    """The frame's SOF sample precision from a header-only walk
+    (default 8 when no SOF is found before SOS — the full parse will
+    produce the real error)."""
+    pos = 2
+    while pos + 4 <= len(data):
+        if data[pos] != 0xFF:
+            return 8
+        marker = data[pos + 1]
+        if marker in (0xD9, 0xDA):
+            return 8
+        if marker == 0x01 or 0xD0 <= marker <= 0xD7:
+            pos += 2
+            continue
+        if 0xC0 <= marker <= 0xCF and marker not in (0xC4, 0xC8, 0xCC):
+            return data[pos + 4] if pos + 4 < len(data) else 8
+        seglen = struct.unpack(">H", data[pos + 2:pos + 4])[0]
+        if seglen < 2:
+            return 8
+        pos += 2 + seglen
+    return 8
+
+
 def decode_tiff_jpeg(data: bytes, tables_bytes: Optional[bytes],
                      photometric: int,
                      tables_cache: Optional[dict] = None) -> np.ndarray:
@@ -810,11 +857,14 @@ def decode_tiff_jpeg(data: bytes, tables_bytes: Optional[bytes],
     per-tile decode.
     """
     out: Optional[np.ndarray] = None
-    try:
-        from ..native import jpeg_decode_baseline
-        out = jpeg_decode_baseline(data, tables_bytes)
-    except ImportError:
-        pass
+    if _sniff_precision(data) == 8:
+        # The native fast path is 8-bit only; 12-bit extended/
+        # progressive frames take the Python decoder (uint16 output).
+        try:
+            from ..native import jpeg_decode_baseline
+            out = jpeg_decode_baseline(data, tables_bytes)
+        except ImportError:
+            pass
     if out is None:
         ts = None
         if tables_bytes:
@@ -829,5 +879,9 @@ def decode_tiff_jpeg(data: bytes, tables_bytes: Optional[bytes],
         if out.shape[-1] != 3:
             raise JpegError(
                 f"YCbCr photometric with {out.shape[-1]} components")
+        if out.dtype != np.uint8:
+            raise JpegError(
+                "12-bit YCbCr JPEG-in-TIFF is not supported (12-bit "
+                "microscopy exports store components directly)")
         out = ycbcr_to_rgb(out)
     return out

@@ -85,6 +85,9 @@ _TYPES: Dict[int, Tuple[Optional[str], int]] = {
 _SAMPLE_DTYPES = {
     (1, 1): "u1",
     (8, 1): "u1", (16, 1): "u2", (32, 1): "u4",
+    # 12-bit: the standard declaration for 12-bit JPEG-in-TIFF
+    # microscopy exports; decoded samples are served as uint16.
+    (12, 1): "u2",
     (8, 2): "i1", (16, 2): "i2", (32, 2): "i4",
     (32, 3): "f4", (64, 3): "f8",
 }
@@ -526,7 +529,17 @@ class TiffFile:
             tables_cache=self._jpeg_tables_cache)
         seg_h = self._check_frame(img, seg_h, seg_w, spp, ifd.tiled,
                                   self.path, "JPEG")
-        return np.ascontiguousarray(img[:seg_h, :seg_w])
+        dt = ifd.dtype()
+        if img.dtype.itemsize > dt.itemsize:
+            # A 12-bit stream inside a file declaring 8-bit samples
+            # cast down would wrap mod 256 — a declaration mismatch
+            # must fail, not corrupt pixels (same rule as JPEG2000).
+            raise ValueError(
+                f"{self.path}: JPEG sample depth "
+                f"{img.dtype.itemsize * 8} exceeds declared "
+                f"{ifd.bits}-bit samples")
+        return np.ascontiguousarray(
+            img[:seg_h, :seg_w].astype(dt.newbyteorder("=")))
 
     def _read_bilevel_segment(self, ifd: Ifd, raw: bytes, comp: int,
                               seg_h: int, seg_w: int,

@@ -139,21 +139,38 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         mesh = cluster.global_mesh(
             chan_parallel=config.parallel.chan_parallel,
             n_devices=config.parallel.n_devices)
+        mesh_controller = None
         if engine == "auto":
             # Probe strictly after cluster.initialize():
             # jax.distributed must come up before anything touches a
             # backend, or a multi-host pod degrades to per-host
-            # standalone meshes.
+            # standalone meshes.  resolve_auto_engine is COLLECTIVE on
+            # a pod (every process, leader included, joins its
+            # allgather — pod-worker followers call it too; a
+            # leader-local probe here would strand them in the
+            # collective).  The LIVE controller then keeps the choice
+            # current pod-wide, seeded with the pod-agreed opening:
+            # only the leader consults it, at group boundaries, and
+            # the per-group engine rides the pod announcement so
+            # followers replay the identical launch (parallel/
+            # serve.py) — a pod deployed during congestion recovers
+            # instead of freezing on its startup probe.
+            from ..ops.jpegenc import set_fetch_observer
+            from ..utils.adaptive import AdaptiveEngine
             from ..utils.linkprobe import resolve_auto_engine
             engine = resolve_auto_engine()
-        log.info("mesh serving enabled: %s (jpeg engine %s)",
-                 dict(mesh.shape), engine)
+            mesh_controller = AdaptiveEngine(initial_engine=engine)
+            set_fetch_observer(mesh_controller.observe_fetch)
+        log.info("mesh serving enabled: %s (jpeg engine %s%s)",
+                 dict(mesh.shape), engine,
+                 ", live" if mesh_controller is not None else "")
         renderer = MeshRenderer(
             mesh, max_batch=config.batcher.max_batch,
             max_batch_limit=config.batcher.max_batch_limit,
             linger_ms=config.batcher.linger_ms,
             jpeg_engine=engine,
-            pipeline_depth=config.batcher.pipeline_depth)
+            pipeline_depth=config.batcher.pipeline_depth,
+            engine_controller=mesh_controller)
     elif config.batcher.enabled:
         # config validation rejects bitpack in this posture.
         engine = config.renderer.jpeg_engine

@@ -18,10 +18,12 @@ live:
   is re-probed with a real transfer, so recovery back to sparse is
   observed rather than assumed.
 
-Single-process only: on a multi-host mesh the engines build different
-SPMD programs, so a per-host flip would diverge the pod.  The mesh
-renderer keeps the startup-static pod-agreed choice
-(``linkprobe.resolve_auto_engine``).
+Pod-safe on multi-host meshes by construction: the engines build
+different SPMD programs, so per-HOST flips would diverge the pod —
+instead ONLY the leader consults the controller, at group boundaries,
+and the chosen engine rides the existing per-group pod announcement
+(``parallel/serve.py``), so every process launches the identical
+sharded program for each group.
 
 Reference analogue: the compression level/codec applied per render in
 ``ImageRegionRequestHandler.java:559,580-582`` — here the *wire format*

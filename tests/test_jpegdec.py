@@ -866,3 +866,122 @@ class TestProgressiveNativeParity:
         if jpeg_native_available():
             with pytest.raises(ValueError):
                 jpeg_decode_baseline(bytes(blob), None)
+
+
+class TestExtended12Bit:
+    """12-bit extended-sequential JPEG (SOF1, T.81 Table B.2): the
+    precision-over-8 class some vendor microscopy exports use and the
+    reference's Bio-Formats path reads.  Decodes to uint16 with the
+    2048 level shift; lossless (SOF3) and 16-bit precision reject with
+    errors naming the variant."""
+
+    @staticmethod
+    def _seg(marker, body):
+        return (bytes([0xFF, marker])
+                + struct.pack(">H", len(body) + 2) + body)
+
+    def _stream12(self, diff=1000):
+        seg = self._seg
+        # Quant table 0, Pq=1 (16-bit entries), all ones.
+        dqt = seg(0xDB, bytes([0x10]) + b"\x00\x01" * 64)
+        # One DC code '0' (len 1) -> category 10; one AC code '0' -> EOB.
+        dht_dc = seg(0xC4, bytes([0x00]) + bytes([1] + [0] * 15)
+                     + bytes([10]))
+        dht_ac = seg(0xC4, bytes([0x10]) + bytes([1] + [0] * 15)
+                     + bytes([0]))
+        sof = seg(0xC1, bytes([12]) + struct.pack(">HH", 8, 8)
+                  + bytes([1, 1, 0x11, 0]))
+        sos = seg(0xDA, bytes([1, 1, 0x00, 0, 63, 0]))
+        # Entropy: DC code '0', 10 magnitude bits of `diff`, AC EOB '0',
+        # padded with 1s.
+        bits = "0" + format(diff, "010b") + "0"
+        bits += "1" * (-len(bits) % 8)
+        entropy = bytes(int(bits[i:i + 8], 2)
+                        for i in range(0, len(bits), 8))
+        return (b"\xff\xd8" + dqt + dht_dc + dht_ac + sof + sos
+                + entropy + b"\xff\xd9")
+
+    def test_12bit_decodes_to_uint16(self):
+        out = decode_baseline_jpeg(self._stream12())
+        assert out.dtype == np.uint16
+        assert out.shape == (8, 8, 1)
+        # DC-only block: IDCT gives coeff/8 everywhere, +2048 shift.
+        np.testing.assert_array_equal(out[..., 0],
+                                      np.full((8, 8), 1000 // 8 + 2048))
+
+    def test_12bit_through_tiff_decode_path(self):
+        # decode_tiff_jpeg routes 12-bit around the 8-bit native
+        # decoder and serves uint16 components (photometric 1).
+        out = decode_tiff_jpeg(self._stream12(), None, photometric=1)
+        assert out.dtype == np.uint16
+        assert int(out[0, 0, 0]) == 1000 // 8 + 2048
+
+    def test_12bit_ycbcr_rejected_with_named_error(self):
+        # Single-component stream trips the component-count check; the
+        # dtype guard ("12-bit YCbCr") covers the 3-component case.
+        with pytest.raises(JpegError, match="YCbCr"):
+            decode_tiff_jpeg(self._stream12(), None, photometric=6)
+
+    def test_baseline_sof0_stays_8bit(self):
+        blob = bytearray(self._stream12())
+        i = blob.index(b"\xff\xc1")
+        blob[i + 1] = 0xC0
+        with pytest.raises(JpegError, match="baseline SOF0"):
+            decode_baseline_jpeg(bytes(blob))
+
+    def test_16bit_precision_rejected_named(self):
+        blob = bytearray(self._stream12())
+        i = blob.index(b"\xff\xc1")
+        blob[i + 4] = 16
+        with pytest.raises(JpegError, match="8-bit and 12-bit"):
+            decode_baseline_jpeg(bytes(blob))
+
+    def test_lossless_sof3_rejected_named(self):
+        blob = bytearray(self._stream12())
+        i = blob.index(b"\xff\xc1")
+        blob[i + 1] = 0xC3
+        with pytest.raises(JpegError, match="lossless"):
+            decode_baseline_jpeg(bytes(blob))
+
+    def test_12bit_tiff_declared_12_serves_uint16(self, tmp_path):
+        """BitsPerSample=12 + compression 7: opens, serves uint16."""
+        from omero_ms_image_region_tpu.io.tiffwrite import _TiffOut
+        payload = self._stream12()
+        path = str(tmp_path / "t12.tif")
+        with open(path, "wb") as f:
+            out = _TiffOut(f, big=False)
+            off = out.write(payload)
+            ifd, _ = out.write_ifd([
+                (256, 3, [8]), (257, 3, [8]), (258, 3, [12]),
+                (259, 3, [7]), (262, 3, [1]), (277, 3, [1]),
+                (278, 3, [8]), (273, 4, [off]),
+                (279, 4, [len(payload)]),
+            ])
+            out.patch_first_ifd(ifd)
+        tf = TiffFile(path)
+        assert tf.ifds[0].dtype() == np.uint16
+        got = tf.read_segment(tf.ifds[0], 0, 0)
+        tf.close()
+        assert got.dtype == np.uint16
+        assert int(got[0, 0, 0]) == 1000 // 8 + 2048
+
+    def test_12bit_stream_in_8bit_tiff_fails_loudly(self, tmp_path):
+        """Declared 8-bit + 12-bit stream: declaration mismatch must
+        fail, not serve mod-256-wrapped pixels."""
+        from omero_ms_image_region_tpu.io.tiffwrite import _TiffOut
+        payload = self._stream12()
+        path = str(tmp_path / "bad.tif")
+        with open(path, "wb") as f:
+            out = _TiffOut(f, big=False)
+            off = out.write(payload)
+            ifd, _ = out.write_ifd([
+                (256, 3, [8]), (257, 3, [8]), (258, 3, [8]),
+                (259, 3, [7]), (262, 3, [1]), (277, 3, [1]),
+                (278, 3, [8]), (273, 4, [off]),
+                (279, 4, [len(payload)]),
+            ])
+            out.patch_first_ifd(ifd)
+        tf = TiffFile(path)
+        with pytest.raises(ValueError, match="exceeds declared"):
+            tf.read_segment(tf.ifds[0], 0, 0)
+        tf.close()

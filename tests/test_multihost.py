@@ -134,3 +134,18 @@ def test_two_process_pod_overflow_rescue_stays_in_lockstep():
 
     ref = _run_workers("reference-overflow", (0,))[0]
     assert ref["jpeg_sha"] == leader["jpeg_sha"]
+
+
+def test_two_process_pod_flips_engine_on_link_change():
+    """Pod-coordinated adaptive wire engine: the leader's controller
+    observes a simulated link-rate collapse between groups and the
+    engine flip rides the per-group pod announcement — both processes
+    launch sparse for group 1 and huffman for group 2, in lockstep
+    (the r4 gap: a pod froze its startup-probed engine for life)."""
+    outs = _run_workers("serve-adaptive", (0, 1))
+    leader, follower = outs[0], outs[1]
+    assert follower["follower_groups"] == 2
+    assert leader["engine_after"] == "huffman"
+    assert leader["launches"] == follower["launches"]
+    engines = [launch[0] for launch in leader["launches"]]
+    assert engines == ["sparse", "huffman"]

@@ -70,6 +70,16 @@ class DeviceRawCache:
                 self._bytes -= evicted.nbytes
         return arr
 
+    def get(self, key: Hashable):
+        """Pure hit probe WITH the LRU bump; None on miss (the serving
+        fast path — callers fall back to ``get_or_load`` off-loop)."""
+        with self._lock:
+            arr = self._entries.get(key)
+            if arr is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            return arr
+
     def __contains__(self, key: Hashable) -> bool:
         """Residency probe without an LRU bump (prefetch skip check)."""
         with self._lock:

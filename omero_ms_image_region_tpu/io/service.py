@@ -116,6 +116,17 @@ class PixelsService:
         with self._lock:
             return image_id in self._open
 
+    def get_open_source(self, image_id: int) -> Optional[PixelSource]:
+        """The already-open source, or None — NEVER sniffs or opens,
+        so it is safe to call on an event loop (the serving fast path;
+        a concurrent eviction just returns None and the caller takes
+        the off-loop open)."""
+        with self._lock:
+            src = self._open.get(image_id)
+            if src is not None:
+                self._open.move_to_end(image_id)
+            return src
+
     def _open_from_repo(self, image_id: int, candidates, pixels):
         """Open the first usable repo-relative candidate path.
 

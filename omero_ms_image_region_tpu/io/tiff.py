@@ -150,6 +150,15 @@ class Ifd:
         if key not in _SAMPLE_DTYPES:
             raise ValueError(f"unsupported TIFF sample: {key[0]}-bit "
                              f"format {key[1]}")
+        if self.bits == 12 and int(self.one(COMPRESSION, 1)) not in (6,
+                                                                     7):
+            # Only the JPEG codecs deliver decoded uint16 samples for
+            # 12-bit declarations; packed 12-bit raw/LZW/deflate rows
+            # (1.5 bytes/sample) are not unpacked here.
+            raise ValueError(
+                f"unsupported TIFF sample: 12-bit outside "
+                f"JPEG-compressed files (compression "
+                f"{int(self.one(COMPRESSION, 1))})")
         return np.dtype(_SAMPLE_DTYPES[key])
 
 
@@ -529,17 +538,22 @@ class TiffFile:
             tables_cache=self._jpeg_tables_cache)
         seg_h = self._check_frame(img, seg_h, seg_w, spp, ifd.tiled,
                                   self.path, "JPEG")
+        self._check_jpeg_depth(ifd, img)
         dt = ifd.dtype()
-        if img.dtype.itemsize > dt.itemsize:
-            # A 12-bit stream inside a file declaring 8-bit samples
-            # cast down would wrap mod 256 — a declaration mismatch
-            # must fail, not corrupt pixels (same rule as JPEG2000).
+        return np.ascontiguousarray(
+            img[:seg_h, :seg_w].astype(dt.newbyteorder("="),
+                                       copy=False))
+
+    def _check_jpeg_depth(self, ifd: Ifd, img: np.ndarray) -> None:
+        """A 12-bit stream inside a file declaring 8-bit samples cast
+        down would wrap mod 256 — a declaration mismatch must fail,
+        not corrupt pixels (same rule as JPEG2000); shared by the
+        compression-6 and -7 paths."""
+        if img.dtype.itemsize > ifd.dtype().itemsize:
             raise ValueError(
                 f"{self.path}: JPEG sample depth "
                 f"{img.dtype.itemsize * 8} exceeds declared "
                 f"{ifd.bits}-bit samples")
-        return np.ascontiguousarray(
-            img[:seg_h, :seg_w].astype(dt.newbyteorder("=")))
 
     def _read_bilevel_segment(self, ifd: Ifd, raw: bytes, comp: int,
                               seg_h: int, seg_w: int,
@@ -648,6 +662,7 @@ class TiffFile:
                          os.fstat(self._f.fileno()).st_size - off)
         img = decode_tiff_jpeg(jf, None, int(ifd.one(PHOTOMETRIC, 1)),
                                tables_cache=self._jpeg_tables_cache)
+        self._check_jpeg_depth(ifd, img)
         self._old_jpeg_cache[ifd.offset] = img
         return img
 

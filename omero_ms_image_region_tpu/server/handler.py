@@ -294,10 +294,22 @@ class ImageRegionHandler:
         """
         svc = self.s.pixels_service
         resolver = getattr(self.s.metadata, "resolve_image_paths", None)
+        opened = getattr(svc, "get_open_source", None)
+        if opened is not None:
+            # Hot path: an already-open source is a lock + dict hit —
+            # the thread-pool hop would cost more than the lookup
+            # (measured ~2-4 ms per request at service concurrency on
+            # one core, paid on the batching convoy's critical path).
+            # get_open_source NEVER sniffs or opens, so a concurrent
+            # eviction just returns None and the full path runs
+            # off-loop below.
+            src = opened(image_id)
+            if src is not None:
+                return src
         try:
-            # Fast path: the handle cache or the data_dir layout serves
-            # without any DB round trip (and without a second sniff, or
-            # a check-then-open race against LRU eviction).
+            # The handle cache or the data_dir layout serves without
+            # any DB round trip (and without a second sniff, or a
+            # check-then-open race against LRU eviction).
             return await asyncio.to_thread(svc.get_pixel_source,
                                            image_id)
         except FileNotFoundError:
@@ -359,9 +371,18 @@ class ImageRegionHandler:
         if ctx.projection is not None:
             raw, region = await self._project(ctx, pixels, src, active)
         else:
-            raw = await asyncio.to_thread(
-                self._read_region, src, ctx, region, level or 0, active,
-                not tiny)   # tiny renders stay host-side end to end
+            cached = (None if tiny else
+                      self._cached_region(ctx, region, level or 0,
+                                          active))
+            if cached is not None:
+                # HBM raw-cache hit: a dict lookup — skip the
+                # thread-pool hop (same economics as the open-source
+                # fast path above).
+                raw = cached
+            else:
+                raw = await asyncio.to_thread(
+                    self._read_region, src, ctx, region, level or 0,
+                    active, not tiny)  # tiny renders stay host-side
             if (self.s.prefetcher is not None and ctx.tile is not None
                     and not tiny):   # tiny neighbors never read the cache
                 self.s.prefetcher.tile_served(
@@ -425,6 +446,25 @@ class ImageRegionHandler:
             rgba = render_ref(raw.astype(np.float32), rdef,
                               self.s.lut_provider)
         return self._encode_rgba(rgba, ctx)
+
+    @staticmethod
+    def _region_key(ctx: ImageRegionCtx, region: RegionDef, level: int,
+                    active: List[int]):
+        """The raw read's cache identity — ONE construction site shared
+        by the event-loop probe and the loader (a drifted duplicate
+        would silently defeat the fast path)."""
+        from ..io.devicecache import region_key
+        return region_key(ctx.image_id, ctx.z, ctx.t, level,
+                          region.as_tuple(), tuple(active))
+
+    def _cached_region(self, ctx: ImageRegionCtx, region: RegionDef,
+                       level: int, active: List[int]):
+        """HBM raw-cache probe for the read's identity; None = miss
+        (which includes caches that are disabled)."""
+        if self.s.raw_cache is None:
+            return None
+        return self.s.raw_cache.get(
+            self._region_key(ctx, region, level, active))
 
     def _read_region(self, src, ctx: ImageRegionCtx, region: RegionDef,
                      level: int, active: List[int],
@@ -491,9 +531,7 @@ class ImageRegionHandler:
             # float32 staging copy would double the host->device bytes
             # of the posture that pays for every upload.
             return load()
-        from ..io.devicecache import region_key
-        key = region_key(ctx.image_id, ctx.z, ctx.t, level,
-                         region.as_tuple(), tuple(active))
+        key = self._region_key(ctx, region, level, active)
         return self.s.raw_cache.get_or_load(key, load_staged)
 
     async def _project(self, ctx: ImageRegionCtx, pixels: Pixels, src,

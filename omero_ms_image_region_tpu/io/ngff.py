@@ -104,10 +104,16 @@ class ZarrV2Array:
                 raw = f.read()
         except OSError:
             return None
-        if self.codec == "zlib":
-            raw = zlib.decompress(raw)
-        elif self.codec == "gzip":
-            raw = gzip.decompress(raw)
+        try:
+            if self.codec == "zlib":
+                raw = zlib.decompress(raw)
+            elif self.codec == "gzip":
+                raw = gzip.decompress(raw)
+        except (zlib.error, gzip.BadGzipFile, EOFError) as e:
+            # Corrupt chunk payloads surface as the reader's clean
+            # error class (zlib.error is neither ValueError nor
+            # OSError and would escape the server's 4xx mapping).
+            raise NgffError(f"chunk {path}: {e}")
         n = int(np.prod(self.chunks))
         arr = np.frombuffer(raw, dtype=self._stored_dtype, count=-1)
         if arr.size != n:

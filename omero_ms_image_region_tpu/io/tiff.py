@@ -545,14 +545,16 @@ class TiffFile:
                                        copy=False))
 
     def _check_jpeg_depth(self, ifd: Ifd, img: np.ndarray) -> None:
-        """A 12-bit stream inside a file declaring 8-bit samples cast
-        down would wrap mod 256 — a declaration mismatch must fail,
-        not corrupt pixels (same rule as JPEG2000); shared by the
-        compression-6 and -7 paths."""
-        if img.dtype.itemsize > ifd.dtype().itemsize:
+        """Decoded-vs-declared sample depth must MATCH, both ways: a
+        12-bit stream under an 8-bit declaration cast down would wrap
+        mod 256, and an 8-bit stream under a 12-bit declaration upcast
+        would render ~16x dark against the declared range — either
+        mismatch serves wrong pixels, so both fail loudly (same rule
+        as JPEG2000); shared by the compression-6 and -7 paths."""
+        if img.dtype.itemsize != ifd.dtype().itemsize:
             raise ValueError(
                 f"{self.path}: JPEG sample depth "
-                f"{img.dtype.itemsize * 8} exceeds declared "
+                f"{img.dtype.itemsize * 8} does not match declared "
                 f"{ifd.bits}-bit samples")
 
     def _read_bilevel_segment(self, ifd: Ifd, raw: bytes, comp: int,

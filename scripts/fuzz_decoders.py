@@ -1,4 +1,5 @@
-"""Mutation fuzz over the hostile-input decoders (TIFF / JPEG / JP2K).
+"""Mutation fuzz over the hostile-input decoders (TIFF / JPEG / JP2K /
+NGFF-zarr).
 
 Takes valid files produced by the repo's own writers, applies random
 byte flips, splice-deletes, truncations and noise insertions, and runs
@@ -64,6 +65,46 @@ def _corpus(rng):
     }
 
 
+def _ngff_corpus(rng, root: str) -> list:
+    """A small valid NGFF group; returns its file list (the mutation
+    targets: metadata JSON and chunk payloads alike)."""
+    from omero_ms_image_region_tpu.io.ngff import write_ngff
+
+    planes = rng.integers(0, 60000, size=(1, 1, 2, 48, 48)).astype(
+        np.uint16)
+    write_ngff(planes, root, chunk=(32, 32), n_levels=1)
+    files = []
+    for dirpath, _dirs, names in os.walk(root):
+        files += [os.path.join(dirpath, n) for n in names]
+    return sorted(files)
+
+
+def _try_ngff(root: str, files, rng) -> bool:
+    """Mutate ONE file of a pristine copy and open+read the group."""
+    import shutil
+
+    from omero_ms_image_region_tpu.io.ngff import NgffZarrSource
+    from omero_ms_image_region_tpu.server.region import RegionDef
+
+    with tempfile.TemporaryDirectory() as tmp:
+        dst = os.path.join(tmp, "z")
+        shutil.copytree(root, dst)
+        rel = os.path.relpath(files[int(rng.integers(0, len(files)))],
+                              root)
+        target = os.path.join(dst, rel)
+        if rng.integers(0, 8) == 0:
+            os.unlink(target)           # missing file class
+        else:
+            blob = mutate(rng, open(target, "rb").read())
+            open(target, "wb").write(blob)
+        src = NgffZarrSource(dst)
+        # Read EVERY channel: a mutation landing in any chunk file must
+        # actually be decoded, not just survive metadata parsing.
+        for c in range(src.size_c):
+            src.get_region(0, c, 0, RegionDef(0, 0, 48, 48), 0)
+        return True
+
+
 def _pred3_tiff(rng) -> bytes:
     """Deflate + predictor-3 float TIFF (the TechNote 3 byte-transform
     path is parse logic fed by hostile data too).  Built with the SAME
@@ -119,10 +160,14 @@ def main() -> int:
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 1500
     rng = np.random.default_rng(seed)
     corpus = _corpus(rng)
+    ngff_root = tempfile.mkdtemp(prefix="fuzz_ngff_")
+    ngff_files = _ngff_corpus(rng, ngff_root)
+    corpus["ngff"] = []                 # disk-based: mutated in-place
     runners = {
         "jp2k": lambda m: decode_jp2k(m),
         "jpeg": lambda m: decode_tiff_jpeg(m, None, 6),
         "tiff": _try_tiff,
+        "ngff": lambda m: _try_ngff(ngff_root, ngff_files, rng),
     }
     stats = {k: [0, 0] for k in runners}
     crashes = 0
@@ -137,7 +182,10 @@ def main() -> int:
     for i in range(iters):
         for kind, run in runners.items():
             seeds = corpus[kind]
-            m = mutate(rng, seeds[i % len(seeds)])
+            # Disk-based targets (empty seed list) mutate in-place
+            # inside their runner; blob targets mutate here.
+            m = (mutate(rng, seeds[i % len(seeds)]) if seeds
+                 else None)
             try:
                 signal.alarm(30)
                 run(m)
@@ -150,6 +198,8 @@ def main() -> int:
                 traceback.print_exc()
             finally:
                 signal.alarm(0)
+    import shutil
+    shutil.rmtree(ngff_root, ignore_errors=True)
     print(f"seed {seed}, {iters} iters/decoder — "
           f"[decoded, clean-error]: {stats}")
     print("OK" if crashes == 0 else f"{crashes} CONTRACT ESCAPES")

@@ -982,6 +982,6 @@ class TestExtended12Bit:
             ])
             out.patch_first_ifd(ifd)
         tf = TiffFile(path)
-        with pytest.raises(ValueError, match="exceeds declared"):
+        with pytest.raises(ValueError, match="does not match declared"):
             tf.read_segment(tf.ifds[0], 0, 0)
         tf.close()

@@ -281,9 +281,13 @@ class AppConfig:
             linger_ms=float(batcher.get("linger-ms", defaults.linger_ms)),
             pipeline_depth=int(batcher.get("pipeline-depth",
                                            defaults.pipeline_depth)),
+            target_inflight=int(batcher.get("target-inflight",
+                                            defaults.target_inflight)),
         )
         if cfg.batcher.pipeline_depth < 1:
             raise ValueError("batcher.pipeline-depth must be >= 1")
+        if cfg.batcher.target_inflight < 1:
+            raise ValueError("batcher.target-inflight must be >= 1")
         rc = raw.get("raw-cache", {}) or {}
         rc_defaults = RawCacheConfig()
         cfg.raw_cache = RawCacheConfig(

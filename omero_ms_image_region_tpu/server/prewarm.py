@@ -102,8 +102,13 @@ def _warm_one(C: int, edge: int, quality: int, batch_sizes: Sequence[int],
                 stacked["reverse"], settings["cd_start"],
                 settings["cd_end"], stacked["tables"])
         for engine in engines:
+            # tune=False: these all-zero compile probes must never
+            # feed the per-workload Huffman tuning — tables fitted to
+            # a black tile would be published permanently and serve
+            # every real tile of this shape with mismatched codes.
             render_batch_to_jpeg(*args, quality=quality,
-                                 dims=[(edge, edge)] * B, engine=engine)
+                                 dims=[(edge, edge)] * B, engine=engine,
+                                 tune=False)
         if B == 1:
             np.asarray(render_tile_batch_packed(*args))
 

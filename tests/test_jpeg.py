@@ -721,3 +721,167 @@ class TestCompactWire:
             for j in jpegs:
                 img = Image.open(io.BytesIO(j))
                 assert img.size == (W, H)
+
+
+# ------------------------------------------------- tuned huffman tables
+
+class TestTunedHuffmanTables:
+    """Per-workload tuned Huffman tables on the device wire: same
+    coefficients, smaller streams, every legal symbol still encodable."""
+
+    def _batch(self, seed=0, B=3, C=2, H=64, W=64):
+        # Gentle content (sigma-2 noise): streams stay inside the wire
+        # word budget, so every tile serves from the device stream and
+        # the size comparison measures the TABLES, not the dense-
+        # fallback policy (denser content is covered by the drift
+        # test, where tuned tables RESCUE tiles from the fallback).
+        rng = np.random.default_rng(seed)
+        yy, xx = np.mgrid[0:H, 0:W].astype(np.float32)
+        phase = rng.uniform(0, np.pi, size=(B, C, 1, 1)).astype(
+            np.float32)
+        raw = 120.0 + 60.0 * np.sin((yy + xx)[None, None] / 24 + phase)
+        raw += rng.normal(0, 2.0, raw.shape).astype(np.float32)
+        ws = np.zeros((B, C), np.float32)
+        we = np.full((B, C), 255.0, np.float32)
+        fam = np.zeros((B, C), np.int32)
+        coef = np.ones((B, C), np.float32)
+        rev = np.zeros((B, C), np.bool_)
+        tables = np.tile(np.array([[1.0, 0.8, 0.5]], np.float32),
+                         (B, C, 1)).reshape(B, C, 3)
+        return raw, ws, we, fam, coef, rev, tables
+
+    def _clear(self):
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+        with je._TUNED_LOCK:
+            je._TUNED_TABLES.clear()
+            je._TUNED_PENDING.clear()
+
+    def test_tuned_spec_every_legal_symbol_coded(self):
+        from omero_ms_image_region_tpu.jfif import tuned_huffman_spec
+        spec = tuned_huffman_spec(np.zeros(256, np.int64),
+                                  np.zeros(256, np.int64))
+        _, _, dc_code, dc_len, _, _, ac_code, ac_len = spec
+        for s in range(12):
+            assert dc_len[s] > 0
+        for run in range(16):
+            for size in range(1, 11):
+                assert ac_len[(run << 4) | size] > 0
+        assert ac_len[0x00] > 0 and ac_len[0xF0] > 0
+        assert int(dc_len.max()) <= 16 and int(ac_len.max()) <= 16
+
+    def test_tuned_batch_same_pixels_smaller_bytes(self):
+        """render_batch_to_jpeg with tuned tables published: decoded
+        pixels identical to the fixed-profile run (same coefficients),
+        streams smaller on the measured content class."""
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+
+        args = self._batch()
+        B, C, H, W = args[0].shape
+        full = args[:6] + (0, 255, args[6])
+        dims = [(W, H)] * B
+        self._clear()
+        try:
+            fixed = je.render_batch_to_jpeg(
+                *full, quality=85, dims=dims, engine="huffman")
+            # Publish tuned tables synchronously (the serving path
+            # kicked off a background thread; tests want determinism).
+            key = (H, W, 85)
+            with je._TUNED_LOCK:
+                je._TUNED_TABLES.pop(key, None)
+                je._TUNED_PENDING.clear()
+            qy, qc = (np.asarray(t, np.int32)
+                      for t in je.quant_tables(85))
+
+            def dense0(i):
+                y, cb, cr = je.render_to_jpeg_coefficients(
+                    args[0][i:i + 1], *(a[i:i + 1] for a in args[1:6]),
+                    0, 255, args[6][i:i + 1], qy, qc)
+                return (np.asarray(y)[0], np.asarray(cb)[0],
+                        np.asarray(cr)[0])
+
+            je._compute_tuned_tables(key, dense0)
+            assert je._TUNED_TABLES[key] is not None
+            tuned = je.render_batch_to_jpeg(
+                *full, quality=85, dims=dims, engine="huffman")
+        finally:
+            self._clear()
+        for f, t in zip(fixed, tuned):
+            pf = np.asarray(Image.open(io.BytesIO(f)).convert("RGB"))
+            pt = np.asarray(Image.open(io.BytesIO(t)).convert("RGB"))
+            np.testing.assert_array_equal(pf, pt)
+        assert sum(map(len, tuned)) < sum(map(len, fixed))
+
+    def test_tuned_tables_survive_content_drift(self):
+        """Tables tuned on smooth content must still encode NOISE
+        (every legal symbol has a code); overflow falls back densely
+        rather than failing."""
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+
+        args = self._batch(seed=1)
+        B, C, H, W = args[0].shape
+        key = (H, W, 85)
+        self._clear()
+        try:
+            qy, qc = (np.asarray(t, np.int32)
+                      for t in je.quant_tables(85))
+
+            def dense0(i):
+                y, cb, cr = je.render_to_jpeg_coefficients(
+                    args[0][i:i + 1], *(a[i:i + 1] for a in args[1:6]),
+                    0, 255, args[6][i:i + 1], qy, qc)
+                return (np.asarray(y)[0], np.asarray(cb)[0],
+                        np.asarray(cr)[0])
+
+            je._compute_tuned_tables(key, dense0)
+            rng = np.random.default_rng(2)
+            noise_raw = rng.uniform(0, 255, args[0].shape).astype(
+                np.float32)
+            jpegs = je.render_batch_to_jpeg(
+                noise_raw, *args[1:6], 0, 255, args[6], quality=85,
+                dims=[(W, H)] * B, engine="huffman")
+        finally:
+            self._clear()
+        for j in jpegs:
+            assert Image.open(io.BytesIO(j)).size == (W, H)
+
+    def test_background_tuning_kicks_in(self):
+        """The serving path publishes tuned tables after the first
+        group and uses them for later groups."""
+        import time
+
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+
+        args = self._batch(seed=3)
+        B, C, H, W = args[0].shape
+        full = args[:6] + (0, 255, args[6])
+        self._clear()
+        try:
+            je.render_batch_to_jpeg(*full, quality=85,
+                                    dims=[(W, H)] * B, engine="huffman")
+            for _ in range(100):            # background thread
+                if (H, W, 85) in je._TUNED_TABLES:
+                    break
+                time.sleep(0.1)
+            assert je._TUNED_TABLES.get((H, W, 85)) is not None
+        finally:
+            self._clear()
+
+    def test_prewarm_never_seeds_tuning(self):
+        """All-zero compile probes (tune=False) must not publish
+        tables fitted to black content."""
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+
+        args = self._batch(seed=4)
+        B, C, H, W = args[0].shape
+        full = (np.zeros_like(args[0]),) + args[1:6] + (0, 255, args[6])
+        self._clear()
+        try:
+            je.render_batch_to_jpeg(*full, quality=85,
+                                    dims=[(W, H)] * B, engine="huffman",
+                                    tune=False)
+            import time
+            time.sleep(0.3)
+            assert (H, W, 85) not in je._TUNED_TABLES
+            assert not je._TUNED_PENDING
+        finally:
+            self._clear()

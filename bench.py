@@ -108,7 +108,7 @@ def bench_flagship(rng):
         compact_fetcher, default_sparse_cap, default_words_cap,
         encode_sparse_buffers, finish_huffman_batch,
         render_to_jpeg_coefficients, render_to_jpeg_huffman_compact,
-        render_to_jpeg_sparse_compact,
+        render_to_jpeg_sparse_compact, spec_kernel_arrays,
     )
 
     import jax
@@ -135,8 +135,7 @@ def bench_flagship(rng):
                        render_to_jpeg_coefficients(
                            raw_batches[0][:1], *_one, qy, qc))
     tuned8 = tuned_huffman_spec(*symbol_frequencies(_y0, _cb0, _cr0))
-    spec = tuple(a.astype(np.int32)
-                 for a in (tuned8[2], tuned8[3], tuned8[6], tuned8[7]))
+    spec = spec_kernel_arrays(tuned8)
     pool = cf.ThreadPoolExecutor(max_workers=8)
     # Compacted wire (the serving path's format): the fetch carries
     # exactly the batch's used bytes behind a lengths header.

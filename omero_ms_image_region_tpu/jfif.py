@@ -167,9 +167,22 @@ def tuned_huffman_spec(dc_freq: np.ndarray, ac_freq: np.ndarray):
     ac[0x00] = 1 + (int(ac_freq[0x00]) << 8)   # EOB
     ac[0xF0] = 1 + (int(ac_freq[0xF0]) << 8)   # ZRL
     dc_bits, dc_vals = build_huffman_table(dc)
-    ac_bits, ac_vals = build_huffman_table(ac)
     dc_code, dc_len = _codes_from_table(dc_bits, dc_vals)
-    ac_code, ac_len = _codes_from_table(ac_bits, ac_vals)
+    # HARD CONSTRAINT from the device packer (ops/jpegenc.huffman_pack):
+    # up to three ZRL codes fold into ONE 32-bit deposit, so ZRL's code
+    # must stay <= 10 bits (3 x 10 = 30).  Content where runs are rare
+    # would otherwise push ZRL to the long-code end and silently corrupt
+    # the packed stream; bump its frequency until the bound holds (the
+    # cost — a shorter-than-optimal code for a then-rare symbol — is
+    # noise).
+    for _ in range(32):
+        ac_bits, ac_vals = build_huffman_table(ac)
+        ac_code, ac_len = _codes_from_table(ac_bits, ac_vals)
+        if int(ac_len[0xF0]) <= 10:
+            break
+        ac[0xF0] = max(ac[0xF0] * 4, 16)
+    else:                               # pragma: no cover - 4^32 floor
+        raise AssertionError("ZRL code would not converge to <= 10 bits")
     return (dc_bits, dc_vals, dc_code, dc_len,
             ac_bits, ac_vals, ac_code, ac_len)
 

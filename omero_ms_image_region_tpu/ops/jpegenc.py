@@ -630,6 +630,15 @@ _TUNED_PENDING: set = set()
 _TUNED_LOCK = __import__("threading").Lock()
 
 
+def spec_kernel_arrays(spec8) -> tuple:
+    """A jfif 8-tuple spec -> the (dc_code, dc_len, ac_code, ac_len)
+    i32 arrays the device packer takes — ONE projection shared by the
+    serving tuner and the bench (a drifted duplicate would silently
+    decouple what the bench measures from what serving runs)."""
+    return (spec8[2].astype(np.int32), spec8[3].astype(np.int32),
+            spec8[6].astype(np.int32), spec8[7].astype(np.int32))
+
+
 def _compute_tuned_tables(key, dense_coefficients) -> None:
     """Build and publish the tuned spec for ``key``; any failure
     (device error, odd content) publishes None so serving never
@@ -638,9 +647,7 @@ def _compute_tuned_tables(key, dense_coefficients) -> None:
     try:
         y, cb, cr = dense_coefficients(0)
         spec8 = tuned_huffman_spec(*symbol_frequencies(y, cb, cr))
-        arrays = (spec8[2].astype(np.int32), spec8[3].astype(np.int32),
-                  spec8[6].astype(np.int32), spec8[7].astype(np.int32))
-        result = (arrays, spec8)
+        result = (spec_kernel_arrays(spec8), spec8)
     except Exception:       # pragma: no cover - tuning must never break
         result = None       # serving; the fixed profile keeps working
     with _TUNED_LOCK:

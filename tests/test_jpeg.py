@@ -885,3 +885,59 @@ class TestTunedHuffmanTables:
             assert not je._TUNED_PENDING
         finally:
             self._clear()
+
+    def test_zrl_code_bounded_for_device_fold(self):
+        """The device packer folds up to 3 ZRL codes into one 32-bit
+        deposit: tuned tables must keep ZRL <= 10 bits even when the
+        sample contains no runs at all (ZRL at the long-code end would
+        silently corrupt the packed stream)."""
+        from omero_ms_image_region_tpu.jfif import tuned_huffman_spec
+
+        # Adversarial stats: heavy mass on many symbols, ZRL unseen.
+        ac = np.zeros(256, np.int64)
+        for run in range(16):
+            for size in range(1, 11):
+                ac[(run << 4) | size] = 1_000_000
+        ac[0x00] = 50_000_000
+        ac[0xF0] = 0                       # never observed
+        dc = np.zeros(256, np.int64)
+        dc[0] = 1_000_000
+        spec = tuned_huffman_spec(dc, ac)
+        assert int(spec[7][0xF0]) <= 10
+
+    def test_tuned_run_content_with_zrl_runs(self):
+        """Content with >=16-zero runs (sparse isolated spikes) must
+        encode and decode correctly through tuned tables built from
+        run-free content — the ZRL fold bound end to end."""
+        from omero_ms_image_region_tpu.ops import jpegenc as je
+
+        args = self._batch(seed=6)
+        B, C, H, W = args[0].shape
+        key = (H, W, 85)
+        self._clear()
+        try:
+            qy, qc = (np.asarray(t, np.int32)
+                      for t in je.quant_tables(85))
+
+            def dense0(i):
+                y, cb, cr = je.render_to_jpeg_coefficients(
+                    args[0][i:i + 1], *(a[i:i + 1] for a in args[1:6]),
+                    0, 255, args[6][i:i + 1], qy, qc)
+                return (np.asarray(y)[0], np.asarray(cb)[0],
+                        np.asarray(cr)[0])
+
+            je._compute_tuned_tables(key, dense0)
+            spikes = np.full(args[0].shape, 128.0, np.float32)
+            spikes[:, :, ::16, ::24] = 255.0     # isolated spikes
+            jpegs = je.render_batch_to_jpeg(
+                spikes, *args[1:6], 0, 255, args[6], quality=85,
+                dims=[(W, H)] * B, engine="huffman")
+            ref = je.render_batch_to_jpeg(
+                spikes, *args[1:6], 0, 255, args[6], quality=85,
+                dims=[(W, H)] * B, engine="sparse")
+        finally:
+            self._clear()
+        for jh, js in zip(jpegs, ref):
+            ph = np.asarray(Image.open(io.BytesIO(jh)).convert("RGB"))
+            ps = np.asarray(Image.open(io.BytesIO(js)).convert("RGB"))
+            np.testing.assert_array_equal(ph, ps)

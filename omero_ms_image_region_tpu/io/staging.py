@@ -141,7 +141,10 @@ def stage(arr: np.ndarray, min_ratio: float = 1.1):
         words, widths = pack16_host(arr)
     except ImportError:
         return jax.device_put(arr)
-    packed_bytes = words.nbytes + widths.nbytes
+    # Judge the bytes that actually cross the link: the words buffer
+    # ships at its ladder-padded length (up to ~19% over), so a pack
+    # accepted at ~0.91x raw could ship ~1.08x raw after padding.
+    packed_bytes = _pad_words(len(words)) * 4 + widths.nbytes
     if packed_bytes * min_ratio > arr.nbytes:
         return jax.device_put(arr)
     padded = np.zeros(_pad_words(len(words)), np.uint32)

@@ -25,6 +25,7 @@ dimensions (the JPEG spec decodes only the declared WxH).
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -349,7 +350,7 @@ class SparseWireFetcher:
 
 
 _FETCHERS: dict = {}
-_FETCHERS_LOCK = __import__("threading").Lock()
+_FETCHERS_LOCK = threading.Lock()
 
 # Optional wire-fetch observer: fn(nbytes, seconds), fed by the
 # fetchers so an adaptive engine controller (utils.adaptive) can track
@@ -368,6 +369,15 @@ def _observe_fetch(nbytes: int, seconds: float,
     as well as the transfer (the first fetch of a dispatched program),
     so bytes/seconds is a LOWER BOUND on the link rate, not a
     measurement of it."""
+    # The link-health EWMA gauge (/metrics imageregion_link_mb_s) rides
+    # every fetch, independent of whether an adaptive controller is
+    # wired — it is what settles "weather or regression?" when a bench
+    # headline moves.
+    from ..utils.telemetry import LINK
+    try:
+        LINK.observe(nbytes, seconds, conflated)
+    except Exception:       # pragma: no cover - telemetry must never
+        pass                # break the serving path
     obs = _FETCH_OBSERVER
     if obs is not None:
         try:
@@ -500,6 +510,12 @@ class CompactWireFetcher:
         self.hdr = 4 * B
         self.width = self.hdr + B * width     # full device buffer bytes
         self.headroom = self.HEADROOM_FLOOR
+        # The fetcher is shared process-wide per (engine, shape, caps,
+        # batch) while up to pipeline_depth workers render groups of
+        # the same bucket concurrently; the _k/headroom read-modify-
+        # write must not interleave or the prefix prediction mis-trains
+        # (each mis-prediction costs ~1 link RTT).
+        self._lock = threading.Lock()
         ladder = []
         step = float(self.GRANULE)
         while step < self.width:
@@ -523,7 +539,8 @@ class CompactWireFetcher:
         return self.width
 
     def start(self, buf):
-        k = self._k
+        with self._lock:
+            k = self._k
         pre = buf if k >= self.width else buf[:k]
         if hasattr(pre, "copy_to_host_async"):
             pre.copy_to_host_async()
@@ -544,7 +561,8 @@ class CompactWireFetcher:
         _observe_fetch(host.nbytes, dt, conflated=True)
         lengths = host[:self.hdr].view(np.int32)
         total = self.hdr + int(lengths.sum())
-        if total > k:
+        missed = total > k
+        if missed:
             end = self._round(total)
             t0 = _time.perf_counter()
             rest = np.asarray(buf[k:end])
@@ -552,11 +570,18 @@ class CompactWireFetcher:
             _REG.record("wire.fetch2", dt * 1000.0)
             _observe_fetch(rest.nbytes, dt)
             host = np.concatenate([host, rest])
-            self.headroom = min(self.HEADROOM_CEIL, self.headroom * 1.2)
-        else:
-            self.headroom = max(self.HEADROOM_FLOOR,
-                                self.headroom * 0.995)
-        self._k = self._round(int(total * self.headroom))
+        # Atomic prediction update: the fetches themselves run
+        # unlocked (concurrent groups overlap on the wire by design);
+        # only the read-modify-write of the shared training state is
+        # serialized.
+        with self._lock:
+            if missed:
+                self.headroom = min(self.HEADROOM_CEIL,
+                                    self.headroom * 1.2)
+            else:
+                self.headroom = max(self.HEADROOM_FLOOR,
+                                    self.headroom * 0.995)
+            self._k = self._round(int(total * self.headroom))
         offs = self.hdr + np.concatenate(
             [[0], np.cumsum(lengths, dtype=np.int64)])
         return [host[offs[i]:offs[i + 1]] for i in range(self.B)]
@@ -627,7 +652,7 @@ _CAP_MEMO: dict = {}
 # serving only — the mesh path keeps the fixed pod-agreed tables.
 _TUNED_TABLES: dict = {}
 _TUNED_PENDING: set = set()
-_TUNED_LOCK = __import__("threading").Lock()
+_TUNED_LOCK = threading.Lock()
 
 
 def spec_kernel_arrays(spec8) -> tuple:
@@ -656,7 +681,6 @@ def _compute_tuned_tables(key, dense_coefficients) -> None:
 
 
 def _maybe_start_tuning(key, dense_coefficients) -> None:
-    import threading
     with _TUNED_LOCK:
         if key in _TUNED_TABLES or key in _TUNED_PENDING:
             return

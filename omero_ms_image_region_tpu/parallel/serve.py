@@ -398,8 +398,10 @@ class MeshRenderer(BatchingRenderer):
     def _render_group_jpeg(self, group: List[_Pending]) -> List[bytes]:
         from ..ops.jpegenc import (dense_encoder, finish_huffman_batch,
                                    finish_sparse_to_jpegs, quant_tables)
+        from ..utils.stopwatch import REGISTRY
 
         n = len(group)
+        REGISTRY.record("batcher.groupTiles", float(n))
         raw, stacked = self._stacked(group)
         H, W = raw.shape[-2:]
         quality = group[0].quality

@@ -19,6 +19,7 @@ reference's ReplyException failure-code propagation
 
 from __future__ import annotations
 
+import json
 import logging
 from typing import Optional
 
@@ -32,6 +33,7 @@ from ..services.cache import Caches
 from ..services.metadata import CanReadMemo, LocalMetadataService
 from ..services.sessions import (DjangoRedisSessionStore, SessionStore,
                                  StaticSessionStore, resolve_session_key)
+from ..utils import telemetry
 from .config import AppConfig
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
 from .errors import NotFoundError
@@ -42,6 +44,7 @@ from .errors import NotFoundError
 # they restart in milliseconds.
 
 log = logging.getLogger("omero_ms_image_region_tpu.server")
+access_log = logging.getLogger("omero_ms_image_region_tpu.access")
 
 PROVIDER = "ImageRegionMicroservice"
 FEATURES = ["flip", "mask-color", "png-tiles"]
@@ -109,6 +112,11 @@ def _make_session_store(config: AppConfig) -> Optional[SessionStore]:
 def build_services(config: AppConfig) -> "ImageRegionServices":
     """Construct the full render service stack for one device-owning
     process (shared by the in-process app and the render sidecar)."""
+    # Mechanical XLA compile accounting (count + cumulative ms on
+    # /metrics): a serving shape missed by prewarm shows up as a
+    # compile event with a seconds-scale duration.  Installed before
+    # anything can compile.
+    telemetry.install_compile_listener()
     if config.renderer.compilation_cache_dir:
         # Warm restarts: compiled executables persist across processes
         # (measured 11 s -> 1.5 s first render after restart).  Set
@@ -236,19 +244,37 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         services.prefetcher = TilePrefetcher(services.raw_cache)
     if (config.renderer.prewarm and config.batcher.enabled
             and not config.parallel.enabled):
-        # Compile the listed shapes' serving programs now so the first
+        # Compile the listed shapes' serving programs so the first
         # request of each shape doesn't pay 20-40 s of jit (adaptive
         # deployments warm BOTH wire engines — the controller may flip
         # mid-serving).  MeshRenderer is excluded: its sharded steps
         # are warmed by the pod bring-up dryrun instead.
-        from .prewarm import prewarm_renderer
+        #
+        # On a BACKGROUND thread, flagged in telemetry.READINESS: the
+        # listener binds immediately and /readyz answers 503 until the
+        # compiles land, so orchestration (the systemd ExecStartPost
+        # poll, k8s readiness probes) gates traffic on warm — instead
+        # of minutes of connection-refused during a blocking prewarm
+        # that no probe could distinguish from a hung boot.
+        import threading
+
+        from .prewarm import parse_spec, prewarm_renderer
+        for spec in config.renderer.prewarm:
+            parse_spec(spec)   # malformed specs fail the BOOT, loudly —
+            # never a background thread dying into a silently-unwarmed
+            # "ready" service (YAML loads validate too; this covers
+            # programmatic AppConfigs).
         engines = (("sparse", "huffman")
                    if renderer.engine_controller is not None
                    else (renderer.jpeg_engine,))
-        prewarm_renderer(
-            list(config.renderer.prewarm), engines,
-            renderer.max_batch, renderer.buckets,
-            cpu_fallback_max_px=config.renderer.cpu_fallback_max_px)
+        telemetry.READINESS.prewarm_pending = True
+        threading.Thread(
+            target=prewarm_renderer,
+            args=(list(config.renderer.prewarm), engines,
+                  renderer.max_batch, renderer.buckets),
+            kwargs={"cpu_fallback_max_px":
+                    config.renderer.cpu_fallback_max_px},
+            name="prewarm", daemon=True).start()
     return services
 
 
@@ -355,31 +381,96 @@ def create_app(config: Optional[AppConfig] = None,
             return _status_of(e)
         return web.Response(body=body, headers={"Content-Type": "image/png"})
 
+    def _finish_request(route: str, status: int, nbytes: int,
+                        total_ms: float, trace) -> None:
+        """Post-response accounting: request histogram + totals, the
+        structured access line, and the slow-request waterfall dump."""
+        telemetry.REQUEST_HIST.observe(route, total_ms)
+        telemetry.count_request(route, status)
+        if trace is None:
+            return
+        if config.telemetry.access_log:
+            queue_ms = trace.span_ms("batcher.queueWait")
+            render_ms = trace.span_ms("Renderer.renderAsPackedInt",
+                                      "Renderer.renderAsPackedInt.cpu")
+            if render_ms is not None and queue_ms:
+                # The handler's render span wraps the whole await of
+                # the batcher — queue wait included; the stage
+                # breakdown must not blame backlog on the renderer.
+                render_ms = max(0.0, render_ms - queue_ms)
+            encode_ms = trace.span_ms("encodeImage",
+                                      "jfif.encodeBatch")
+            access_log.info("%s", json.dumps({
+                "ts": round(trace.wall_ts, 3),
+                "trace": trace.trace_id,
+                "route": route,
+                "status": status,
+                "bytes": nbytes,
+                "ms": round(total_ms, 3),
+                "queue_ms": queue_ms,
+                "render_ms": render_ms,
+                "encode_ms": encode_ms,
+                "cache": ("hit" if trace.span_ms("cache.hit")
+                          is not None else "miss"),
+            }))
+        if (config.telemetry.slow_request_ms > 0
+                and total_ms >= config.telemetry.slow_request_ms):
+            path = telemetry.dump_slow_trace(
+                trace, total_ms, status,
+                config.telemetry.slow_request_dir)
+            if path:
+                log.warning("slow request %s (%.0f ms) on %s: "
+                            "waterfall dumped to %s", trace.trace_id,
+                            total_ms, route, path)
+
+    def _observed(route: str, handler):
+        """Wrap a render handler in a request trace: a fresh trace id
+        becomes the context's recording target (and rides the sidecar
+        wire), every stopwatch span below lands on the waterfall, and
+        completion feeds the duration histogram / access log / slow
+        dump."""
+        import time as _time
+
+        async def wrapper(request: web.Request) -> web.Response:
+            trace_id = telemetry.new_trace_id()
+            t0 = _time.perf_counter()
+            try:
+                with telemetry.trace_scope(trace_id, route):
+                    resp = await handler(request)
+            except BaseException:
+                # Client-disconnect cancellation (or a handler bug)
+                # must not leak the trace into the active registry —
+                # finish it, count the abort, and let the exception
+                # propagate to aiohttp.
+                telemetry.TRACES.finish(trace_id)
+                telemetry.count_request(route, 499)
+                raise
+            total_ms = (_time.perf_counter() - t0) * 1000.0
+            trace = telemetry.TRACES.finish(trace_id)
+            _finish_request(route, resp.status,
+                            len(resp.body) if resp.body else 0,
+                            total_ms, trace)
+            return resp
+
+        return wrapper
+
     async def metrics(request: web.Request) -> web.Response:
         """Prometheus text exposition (≙ the reference's optional metrics
         beans, ``beanRefContext.xml:36-46`` — Graphite there, a scrape
-        endpoint here).  Spans keep the perf4j names from the Java logs."""
+        endpoint here).  Spans keep the perf4j names from the Java logs;
+        per-span and per-route latencies are proper histogram series
+        (``_bucket``/``_sum``/``_count``), and TYPE headers are emitted
+        once per family by the shared finalizer."""
         from ..utils.stopwatch import span_lines
 
-        lines = [
-            "# TYPE imageregion_span_count counter",
-            "# TYPE imageregion_span_mean_ms gauge",
-            "# TYPE imageregion_span_p50_ms gauge",
-            "# TYPE imageregion_cache_hits counter",
-            "# TYPE imageregion_cache_misses counter",
-            "# TYPE imageregion_rawcache_hits counter",
-            "# TYPE imageregion_rawcache_misses counter",
-            "# TYPE imageregion_rawcache_bytes gauge",
-            "# TYPE imageregion_batches_dispatched counter",
-            "# TYPE imageregion_tiles_rendered counter",
-        ]
+        lines = telemetry.request_metric_lines()
         lines += span_lines()
         if services is None:
-            # Frontend proxy: local spans plus the device process's
-            # spans fetched over the sidecar socket (best-effort with a
-            # hard timeout — a dead OR partitioned sidecar must not
-            # hang the scrape).  NOTE for multi-frontend deployments:
-            # every frontend exposes an identical copy of the sidecar
+            # Frontend proxy: local series plus the device process's
+            # fetched over the sidecar socket (best-effort with a hard
+            # timeout — a dead OR partitioned sidecar must not hang the
+            # scrape).  NOTE for multi-frontend deployments: every
+            # frontend exposes an identical copy of the sidecar
             # counters, so aggregate them with max(), or scrape only a
             # designated frontend for process="sidecar" series.
             import asyncio as _asyncio
@@ -387,39 +478,67 @@ def create_app(config: Optional[AppConfig] = None,
                 status, body = await _asyncio.wait_for(
                     client.call("metrics", {}), timeout=2.0)
                 if status == 200 and body:
-                    lines.append(bytes(body).decode().rstrip("\n"))
+                    lines += bytes(body).decode().splitlines()
             except Exception:
                 lines.append("# sidecar metrics unavailable")
-            return web.Response(text="\n".join(lines) + "\n",
-                                content_type="text/plain")
-        for cache_name in ("image_region", "pixels_metadata", "shape_mask"):
-            stack = getattr(services.caches, cache_name, None)
-            for i, tier in enumerate(getattr(stack, "tiers", ())):
-                hits, misses = (getattr(tier, "hits", None),
-                                getattr(tier, "misses", None))
-                if hits is None:
-                    continue
-                label = f'{{cache="{cache_name}",tier="{i}"}}'
-                lines += [
-                    f"imageregion_cache_hits{label} {hits}",
-                    f"imageregion_cache_misses{label} {misses}",
-                ]
-        raw_cache = services.raw_cache
-        if raw_cache is not None:
-            lines += [
-                f"imageregion_rawcache_hits {raw_cache.hits}",
-                f"imageregion_rawcache_misses {raw_cache.misses}",
-                f"imageregion_rawcache_bytes {raw_cache.size_bytes}",
-            ]
-        renderer = services.renderer
-        if hasattr(renderer, "batches_dispatched"):
-            lines += [
-                "imageregion_batches_dispatched "
-                f"{renderer.batches_dispatched}",
-                f"imageregion_tiles_rendered {renderer.tiles_rendered}",
-            ]
-        return web.Response(text="\n".join(lines) + "\n",
+        else:
+            lines += telemetry.device_metric_lines(services)
+        return web.Response(text=telemetry.finalize_exposition(lines),
                             content_type="text/plain")
+
+    async def healthz(request: web.Request) -> web.Response:
+        """Liveness: the process answers HTTP.  Deeper state belongs to
+        /readyz — a loaded-but-alive service must NOT be restarted."""
+        return web.json_response({"status": "ok"})
+
+    async def _ready_state() -> tuple:
+        """(ok, checks) for /readyz: sidecar reachability (proxy mode),
+        prewarm completion, and batcher backlog below the configured
+        threshold."""
+        checks = {}
+        ok = True
+        max_depth = config.telemetry.ready_max_queue_depth
+        if services is None:
+            import asyncio as _asyncio
+            try:
+                status, body = await _asyncio.wait_for(
+                    client.call("ping", {}), timeout=2.0)
+                info = (json.loads(bytes(body).decode())
+                        if status == 200 and body else {})
+                if status != 200 or not info.get("ok"):
+                    ok = False
+                    checks["sidecar"] = f"status {status}"
+                else:
+                    checks["sidecar"] = "ok"
+                prewarm_pending = bool(info.get("prewarm_pending"))
+                depth = int(info.get("queue_depth", 0))
+            except Exception:
+                return False, {"sidecar": "unreachable"}
+        else:
+            prewarm_pending = telemetry.READINESS.prewarm_pending
+            renderer = services.renderer
+            depth = (renderer.queue_depth()
+                     if hasattr(renderer, "queue_depth") else 0)
+        if prewarm_pending:
+            ok = False
+            checks["prewarm"] = "pending"
+        else:
+            checks["prewarm"] = "complete"
+        if depth > max_depth:
+            ok = False
+            checks["queue"] = f"depth {depth} over {max_depth}"
+        else:
+            checks["queue"] = "ok"
+        return ok, checks
+
+    async def readyz(request: web.Request) -> web.Response:
+        """Readiness: 200 only when this process can serve renders NOW
+        (sidecar up, prewarm done, backlog sane); 503 carries the
+        degradation detail so a probe log reads like a diagnosis."""
+        ok, checks = await _ready_state()
+        return web.json_response(
+            {"status": "ready" if ok else "degraded", "checks": checks},
+            status=200 if ok else 503)
 
     async def details(request: web.Request) -> web.Response:
         doc = {
@@ -473,16 +592,23 @@ def create_app(config: Optional[AppConfig] = None,
     # Trailing segments are tolerated like the reference's `:theT*` /
     # `:shapeId*` patterns (ImageRegionMicroserviceVerticle.java:214-231):
     # OMERO.web emits URLs with suffixes past the last parameter.
+    traced_image = {
+        route: _observed(route, render_image_region)
+        for route in ("render_image_region", "render_image")
+    }
+    traced_mask = _observed("render_shape_mask", render_shape_mask)
     for prefix in ("webgateway", "webclient"):
         for route in ("render_image_region", "render_image"):
             base = f"/{prefix}/{route}/{{imageId}}/{{theZ}}/{{theT}}"
-            app.router.add_get(base, render_image_region)
-            app.router.add_get(base + "/{tail:.*}", render_image_region)
+            app.router.add_get(base, traced_image[route])
+            app.router.add_get(base + "/{tail:.*}", traced_image[route])
     app.router.add_get("/webgateway/render_shape_mask/{shapeId}",
-                       render_shape_mask)
+                       traced_mask)
     app.router.add_get("/webgateway/render_shape_mask/{shapeId}/{tail:.*}",
-                       render_shape_mask)
+                       traced_mask)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/readyz", readyz)
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):

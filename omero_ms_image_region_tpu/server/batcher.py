@@ -33,6 +33,7 @@ import time
 import numpy as np
 
 from ..ops.render import render_tile_batch_packed
+from ..utils import telemetry
 from ..utils.stopwatch import REGISTRY, stopwatch
 
 DEFAULT_BUCKETS = ((256, 256), (512, 512), (1024, 1024), (2048, 2048))
@@ -72,6 +73,7 @@ class _Pending:
     quality: int = 0              # JPEG groups only
     future: asyncio.Future = None  # type: ignore[assignment]
     t_enqueue: float = 0.0        # queue-wait waterfall span
+    trace_id: str = None          # type: ignore[assignment]  # requester
 
 
 class BatchingRenderer:
@@ -152,6 +154,27 @@ class BatchingRenderer:
             self.batches_dispatched += 1
             self.tiles_rendered += tiles
 
+    def queue_depth(self) -> int:
+        """Requests waiting across every bucket key (the /metrics
+        backlog gauge and the /readyz pressure check)."""
+        return sum(len(q) for q in self._queues.values())
+
+    def inflight(self) -> int:
+        """Group renders currently occupying pipeline slots."""
+        return len(self._inflight)
+
+    @staticmethod
+    def _record_queue_waits(group: List[_Pending], now: float) -> None:
+        """Per-request queue-wait spans: aggregate histogram via the
+        registry plus each member's own waterfall entry."""
+        for p in group:
+            wait_ms = (now - p.t_enqueue) * 1000.0
+            REGISTRY.record("batcher.queueWait", wait_ms)
+            if p.trace_id:
+                telemetry.record_span(
+                    "batcher.queueWait", p.t_enqueue, wait_ms,
+                    trace_ids=(p.trace_id,))
+
     # ------------------------------------------------------------- public
 
     async def render(self, raw: np.ndarray, settings: dict) -> np.ndarray:
@@ -175,7 +198,8 @@ class BatchingRenderer:
                str(raw.dtype))
 
         pending = _Pending(raw=raw, settings=settings, h=h, w=w,
-                           future=asyncio.get_running_loop().create_future())
+                           future=asyncio.get_running_loop().create_future(),
+                           trace_id=telemetry.current_trace_id())
         return await self._enqueue(key, pending)
 
     async def render_jpeg(self, raw: np.ndarray, settings: dict,
@@ -201,7 +225,8 @@ class BatchingRenderer:
                str(raw.dtype))
         pending = _Pending(raw=raw, settings=settings, h=height, w=width,
                            quality=quality,
-                           future=asyncio.get_running_loop().create_future())
+                           future=asyncio.get_running_loop().create_future(),
+                           trace_id=telemetry.current_trace_id())
         return await self._enqueue(key, pending)
 
     async def _enqueue(self, key: tuple, pending: _Pending):
@@ -253,6 +278,9 @@ class BatchingRenderer:
         functions release the GIL in those stages — so the device never
         idles behind host work under sustained load.
         """
+        # The loop task was created from some request's context; detach
+        # so dispatcher-side spans never attach to that one waterfall.
+        telemetry.clear_context()
         queue = self._queues[key]
         wakeup = self._wakeups[key]
         slots = self._shared_slots or asyncio.Semaphore(self.pipeline_depth)
@@ -333,15 +361,25 @@ class BatchingRenderer:
         the HTTP layer's ``except Exception`` mapping and drop the
         connection without a response.
         """
+        self._record_queue_waits(group, time.perf_counter())
         if self._transient_retry_enabled:
             from ..utils.transient import retry_transient
             # Short backoff: the slot (and every request in the group)
             # waits it out, so a serving retry must not stall the
             # pipeline the way the bench's section-level retry may.
-            run = lambda: retry_transient(        # noqa: E731
+            run_inner = lambda: retry_transient(  # noqa: E731
                 lambda: render(group), "group render", backoff_s=0.25)
         else:
-            run = lambda: render(group)           # noqa: E731
+            run_inner = lambda: render(group)     # noqa: E731
+        trace_ids = tuple(p.trace_id for p in group if p.trace_id)
+
+        def run():
+            # Worker-thread trace target: the group's device render,
+            # wire fetch and encode spans land on EVERY member's
+            # waterfall (each request really did wait on them).
+            with telemetry.group_trace(trace_ids):
+                return run_inner()
+
         inner = asyncio.ensure_future(asyncio.to_thread(run))
 
         def settle(fut: asyncio.Future) -> None:
@@ -410,11 +448,7 @@ class BatchingRenderer:
         from ..ops.jpegenc import render_batch_to_jpeg
 
         n = len(group)
-        now = time.perf_counter()
         REGISTRY.record("batcher.groupTiles", float(n))
-        for p in group:
-            REGISTRY.record("batcher.queueWait",
-                            (now - p.t_enqueue) * 1000.0)
         raw, stack = self._group_arrays(group)
         s0 = group[0].settings
         with stopwatch("Renderer.renderAsPackedInt.batch"):

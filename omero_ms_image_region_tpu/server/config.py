@@ -137,6 +137,26 @@ class ParallelConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Tracing / health-probe knobs (utils.telemetry; ≙ the reference's
+    optional metrics beans, ``beanRefContext.xml:36-46`` — Graphite
+    there, Prometheus scrape + trace waterfalls here)."""
+
+    # Requests slower than this dump their full span waterfall as JSON
+    # into slow_request_dir (scripts/trace_report.py renders them).
+    # 0 disables the tracer.
+    slow_request_ms: float = 0.0
+    slow_request_dir: str = "./slow-traces"
+    # One-line JSON access log per request (route, status, bytes, cache
+    # tier, queue-wait/render/encode ms, trace id) on the
+    # "omero_ms_image_region_tpu.access" logger.
+    access_log: bool = True
+    # /readyz reports degraded (503) when the batcher backlog exceeds
+    # this many queued requests.
+    ready_max_queue_depth: int = 64
+
+
+@dataclass
 class HttpConfig:
     """Request parse limits (≙ ``config.yaml:5-12`` — the Vert.x
     ``HttpServerOptions`` line/header limits, mapped onto aiohttp's
@@ -197,6 +217,7 @@ class AppConfig:
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     sidecar: SidecarConfig = field(default_factory=SidecarConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     @classmethod
     def from_yaml(cls, path: str) -> "AppConfig":
@@ -328,6 +349,24 @@ class AppConfig:
                 and cfg.parallel.num_processes is None):
             raise ValueError("parallel.coordinator-address requires "
                              "num-processes and process-id")
+        tel = raw.get("telemetry", {}) or {}
+        tel_defaults = TelemetryConfig()
+        cfg.telemetry = TelemetryConfig(
+            slow_request_ms=float(tel.get("slow-request-ms",
+                                          tel_defaults.slow_request_ms)),
+            slow_request_dir=str(tel.get(
+                "slow-request-dir", tel_defaults.slow_request_dir)),
+            access_log=bool(tel.get("access-log",
+                                    tel_defaults.access_log)),
+            ready_max_queue_depth=int(tel.get(
+                "ready-max-queue-depth",
+                tel_defaults.ready_max_queue_depth)),
+        )
+        if cfg.telemetry.slow_request_ms < 0:
+            raise ValueError("telemetry.slow-request-ms must be >= 0")
+        if cfg.telemetry.ready_max_queue_depth < 1:
+            raise ValueError("telemetry.ready-max-queue-depth must be "
+                             ">= 1")
         rd = raw.get("renderer", {}) or {}
         rd_defaults = RendererConfig()
         cfg.renderer = RendererConfig(

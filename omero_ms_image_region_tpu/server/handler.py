@@ -33,6 +33,7 @@ from ..ops import projection as projection_ops
 from ..ops.render import pack_settings, render_tile_packed, unpack_rgba
 from ..services.cache import Caches
 from ..services.metadata import CanReadMemo, MetadataService
+from ..utils import telemetry
 from ..utils.color import split_html_color
 from ..utils.stopwatch import stopwatch
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
@@ -264,10 +265,17 @@ class ImageRegionHandler:
 
     async def render_image_region(self, ctx: ImageRegionCtx) -> bytes:
         """The cache-first flow (``renderImageRegion``, ``:159-249``)."""
+        import time as _time
+        t0 = _time.perf_counter()
         cached = await self.s.caches.image_region.get(ctx.cache_key)
         if cached is not None:
             if await self._can_read("Image", ctx.image_id,
                                     ctx.omero_session_key):
+                # Waterfall/access-log marker: the byte cache answered
+                # (the render stages below never ran).
+                telemetry.record_span(
+                    "cache.hit", t0,
+                    (_time.perf_counter() - t0) * 1000.0)
                 return cached
             raise NotFoundError(f"Cannot find Image:{ctx.image_id}")
 
@@ -424,8 +432,10 @@ class ImageRegionHandler:
     def _encode_rgba(self, rgba: np.ndarray, ctx: ImageRegionCtx) -> bytes:
         """Shared encode tail (format dispatch + 404 on unknown format)."""
         try:
-            return codecs.encode_rgba(np.ascontiguousarray(rgba),
-                                      ctx.format, ctx.compression_quality)
+            with stopwatch("encodeImage"):
+                return codecs.encode_rgba(np.ascontiguousarray(rgba),
+                                          ctx.format,
+                                          ctx.compression_quality)
         except codecs.UnknownFormatError as e:
             raise NotFoundError(str(e))
 
@@ -630,9 +640,13 @@ class ShapeMaskHandler:
         self.s = services
 
     async def render_shape_mask(self, ctx: ShapeMaskCtx) -> bytes:
+        import time as _time
+        t0 = _time.perf_counter()
         cached = await self.s.caches.shape_mask.get(ctx.cache_key())
         readable = await self._can_read(ctx)
         if cached is not None and readable:
+            telemetry.record_span(
+                "cache.hit", t0, (_time.perf_counter() - t0) * 1000.0)
             return cached
         if not readable:
             raise NotFoundError(f"Cannot find Shape:{ctx.shape_id}")

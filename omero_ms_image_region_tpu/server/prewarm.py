@@ -20,9 +20,11 @@ cache postures, and the dtype keys the compiled program).  For every
 spec the serving-path programs are compiled through the real ops entry
 points with the renderer's own wire engine(s):
 
-- the batched JPEG program at batch 1 (the idle lone-tile path — what
-  single-tile p50 rides) and at ``max_batch`` (the loaded steady
-  state);
+- the batched JPEG program at EVERY launchable padded batch shape up
+  to ``max_batch`` (``batcher._BATCH_SHAPES``: batch 1 is the idle
+  lone-tile path single-tile p50 rides, max_batch the loaded steady
+  state, and the intermediate shapes — including the non-power-of-two
+  3 and 6 — are what the inflight-aware group split launches);
 - the packed-RGBA program at batch 1 (png/tif formats).
 
 Settings use the ramp-weight table form (plain color channels; LUT
@@ -113,6 +115,17 @@ def _warm_one(C: int, edge: int, quality: int, batch_sizes: Sequence[int],
             np.asarray(render_tile_batch_packed(*args))
 
 
+def prewarm_batch_sizes(max_batch: int) -> tuple:
+    """Every padded batch shape the dispatcher can launch at or below
+    ``max_batch`` — imported from the batcher's own shape table so the
+    two can never drift.  Warming only (1, max_batch) left the
+    intermediate entries (3, 6) to lazy XLA compiles on the first 3-/
+    6-tile group (seconds on tunnel-attached chips)."""
+    from .batcher import _BATCH_SHAPES
+    sizes = tuple(s for s in _BATCH_SHAPES if s <= max_batch)
+    return sizes if max_batch in sizes else sizes + (max_batch,)
+
+
 def prewarm_renderer(specs: List[str], engines: Sequence[str],
                      max_batch: int, buckets,
                      cpu_fallback_max_px: int = 0) -> None:
@@ -123,26 +136,42 @@ def prewarm_renderer(specs: List[str], engines: Sequence[str],
     dtype serving stacks in either cache posture, which keys the
     compiled program.  Specs at or below ``cpu_fallback_max_px`` are
     skipped: the handler routes those renders to the host kernel, so a
-    device program would never be hit.
+    device program would never be hit.  ``/readyz`` reports degraded
+    while this runs (telemetry.READINESS).
     """
-    for spec in specs:
-        C, edge, quality, raw_dtype = parse_spec(spec)
-        if edge * edge <= cpu_fallback_max_px:
-            logger.info(
-                "prewarm %s skipped: %dx%d px is at/below "
-                "renderer.cpu-fallback-max-px (%d) and serves on the "
-                "host kernel", spec, edge, edge, cpu_fallback_max_px)
-            continue
-        t0 = time.perf_counter()
-        try:
-            _warm_one(C, edge, quality, (1, max_batch), engines, buckets,
-                      raw_dtype)
-        except Exception:
-            logger.warning("prewarm %s failed; first requests of this "
-                           "shape will compile lazily", spec,
-                           exc_info=True)
-        else:
-            logger.info("prewarmed %s (engines %s, batch 1+%d, %s) "
-                        "in %.1fs", spec, "/".join(engines), max_batch,
-                        np.dtype(raw_dtype).name,
-                        time.perf_counter() - t0)
+    from ..utils.telemetry import READINESS
+    # Malformed specs raise HERE, before the readiness flag flips or
+    # any compile starts (the loader's contract: config errors are
+    # loud, and a caller spawning this on a background thread gets the
+    # raise before the thread — never a silently-degraded prewarm or a
+    # stuck-pending /readyz).
+    parsed = [(spec,) + tuple(parse_spec(spec)) for spec in specs]
+    batch_sizes = prewarm_batch_sizes(max_batch)
+    READINESS.prewarm_pending = bool(specs)
+    try:
+        for spec, C, edge, quality, raw_dtype in parsed:
+            if edge * edge <= cpu_fallback_max_px:
+                logger.info(
+                    "prewarm %s skipped: %dx%d px is at/below "
+                    "renderer.cpu-fallback-max-px (%d) and serves on "
+                    "the host kernel", spec, edge, edge,
+                    cpu_fallback_max_px)
+                continue
+            t0 = time.perf_counter()
+            try:
+                _warm_one(C, edge, quality, batch_sizes, engines,
+                          buckets, raw_dtype)
+            except Exception:
+                # Per-spec: one shape's dead compile must not strand
+                # the others (serving still works, it compiles lazily).
+                logger.warning("prewarm %s failed; first requests of "
+                               "this shape will compile lazily", spec,
+                               exc_info=True)
+            else:
+                logger.info("prewarmed %s (engines %s, batches %s, %s) "
+                            "in %.1fs", spec, "/".join(engines),
+                            "/".join(map(str, batch_sizes)),
+                            np.dtype(raw_dtype).name,
+                            time.perf_counter() - t0)
+    finally:
+        READINESS.prewarm_pending = False

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from ..models.rendering import RenderingDef, RenderingModel
 from ..utils.color import split_html_color
+from ..utils.stopwatch import stopwatch
 from .ctx import BadRequestError, ImageRegionCtx
 
 
@@ -37,6 +38,12 @@ def update_settings(rdef: RenderingDef, ctx: ImageRegionCtx) -> RenderingDef:
     * ``m`` (already normalized to "greyscale"/"rgb" by the ctx parser)
       switches the model.
     """
+    with stopwatch("updateSettings"):
+        return _update_settings(rdef, ctx)
+
+
+def _update_settings(rdef: RenderingDef, ctx: ImageRegionCtx
+                     ) -> RenderingDef:
     out = rdef.copy()
     channels = ctx.channels
     for c, cb in enumerate(out.channel_bindings):

@@ -39,6 +39,7 @@ import os
 import struct
 from typing import Dict, Optional
 
+from ..utils import telemetry
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
 from .errors import NotFoundError
 
@@ -107,8 +108,12 @@ async def _read_frame(reader: asyncio.StreamReader):
 
 # ---------------------------------------------------------------- server
 
-async def _serve_connection(image_handler, mask_handler, reader, writer):
-    """One frontend connection: demux requests, run each as a task."""
+async def _serve_connection(image_handler, mask_handler, reader, writer,
+                            status_fn=None):
+    """One frontend connection: demux requests, run each as a task.
+
+    ``status_fn`` answers the ``ping`` op (readiness state for the
+    frontend's ``/readyz``); None keeps a bare liveness answer."""
     write_lock = asyncio.Lock()
     tasks = set()
 
@@ -119,20 +124,63 @@ async def _serve_connection(image_handler, mask_handler, reader, writer):
 
     async def handle(header: dict) -> None:
         rid = header.get("id")
+        spans = None
         try:
             op = header["op"]
-            if op == "image":
-                ctx = ImageRegionCtx.from_json(header["ctx"])
-                body = await image_handler.render_image_region(ctx)
-            elif op == "mask":
-                ctx = ShapeMaskCtx.from_json(header["ctx"])
-                body = await mask_handler.render_shape_mask(ctx)
+            if op == "image" or op == "mask":
+                # Join the frontend's trace: device-side spans (render,
+                # wire fetch, encode) carry the requester's trace id,
+                # so the request yields ONE waterfall across processes.
+                # In a real split the trace is unknown here, so the
+                # spans recorded below are exported on the response and
+                # the local orphan entry is retired; an in-process
+                # sidecar (tests) shares the frontend's live trace and
+                # must neither export (duplicates) nor finish it.
+                trace_id = header.get("trace")
+                shared = bool(trace_id
+                              and telemetry.TRACES.is_active(trace_id))
+                try:
+                    with telemetry.adopt_trace(trace_id):
+                        import time as _time
+                        t0 = _time.perf_counter()
+                        if op == "image":
+                            ctx = ImageRegionCtx.from_json(
+                                header["ctx"])
+                            body = await \
+                                image_handler.render_image_region(ctx)
+                        else:
+                            ctx = ShapeMaskCtx.from_json(header["ctx"])
+                            body = await \
+                                mask_handler.render_shape_mask(ctx)
+                        telemetry.record_span(
+                            "sidecar.render", t0,
+                            (_time.perf_counter() - t0) * 1000.0,
+                            op=op)
+                finally:
+                    # Error paths too: retire the orphan and export
+                    # whatever was recorded, so a failed request still
+                    # shows its device-side spans on the frontend
+                    # waterfall instead of leaking a registry entry.
+                    if trace_id and not shared:
+                        trace = telemetry.TRACES.finish(trace_id)
+                        if trace is not None:
+                            spans = trace.export_spans()
             elif op == "metrics":
-                # Span timings live in the device process; frontends
-                # merge this into their /metrics exposition.
+                # Device-process series (spans, caches, batcher gauges,
+                # compile events, link health); frontends merge these
+                # into their /metrics exposition.  No # TYPE lines here
+                # — the frontend's finalizer owns the headers.
                 from ..utils.stopwatch import span_lines
-                body = ("\n".join(span_lines(',process="sidecar"'))
-                        + "\n").encode()
+                lines = span_lines(',process="sidecar"')
+                handler_services = getattr(image_handler, "s", None)
+                if handler_services is not None:
+                    lines += telemetry.device_metric_lines(
+                        handler_services, ',process="sidecar"')
+                body = ("\n".join(lines) + "\n").encode()
+            elif op == "ping":
+                doc = status_fn() if status_fn is not None \
+                    else {"ok": True}
+                body = json.dumps(doc).encode()
             else:
                 raise BadRequestError(f"unknown op {op!r}")
         except BadRequestError as e:
@@ -144,6 +192,8 @@ async def _serve_connection(image_handler, mask_handler, reader, writer):
             body, out = b"", {"id": rid, "status": 500}
         else:
             out = {"id": rid, "status": 200}
+        if spans:
+            out["spans"] = spans
         try:
             await respond(out, body)
         except (ConnectionError, OSError):
@@ -215,6 +265,18 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
     image_handler = ImageRegionHandler(services)
     mask_handler = ShapeMaskHandler(services)
 
+    def status_fn() -> dict:
+        """The ping op's readiness document (frontend /readyz rolls
+        this into its own verdict)."""
+        renderer = services.renderer
+        depth = (renderer.queue_depth()
+                 if hasattr(renderer, "queue_depth") else 0)
+        return {
+            "ok": True,
+            "prewarm_pending": telemetry.READINESS.prewarm_pending,
+            "queue_depth": depth,
+        }
+
     # Server.close() only stops the LISTENER; established connections
     # and their handler coroutines would outlive a shutdown (and keep
     # serving from half-torn-down services).  Track them and cancel at
@@ -227,7 +289,7 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
         conn_tasks.add(task)
         try:
             await _serve_connection(image_handler, mask_handler, reader,
-                                    writer)
+                                    writer, status_fn=status_fn)
         finally:
             conn_tasks.discard(task)
 
@@ -365,6 +427,8 @@ class SidecarClient:
         only surfaces through the read loop).  Renders are idempotent
         pure reads, so re-issuing a request the dead sidecar may or may
         not have executed is safe."""
+        import time as _time
+
         for attempt in (0, 1):
             conn = await self._ensure_connected()
             self._next_id += 1
@@ -372,10 +436,16 @@ class SidecarClient:
             loop = asyncio.get_running_loop()
             fut: asyncio.Future = loop.create_future()
             conn.pending[rid] = fut
+            header = {"id": rid, "op": op, "ctx": ctx_json}
+            trace_id = telemetry.current_trace_id()
+            if trace_id:
+                # The trace rides the wire so device-side spans join
+                # the requesting frontend's waterfall.
+                header["trace"] = trace_id
+            t_call = _time.perf_counter()
             try:
                 async with self._write_lock:
-                    conn.writer.write(_pack(
-                        {"id": rid, "op": op, "ctx": ctx_json}))
+                    conn.writer.write(_pack(header))
                     await conn.writer.drain()
                 header, body = await fut
             except (ConnectionError, OSError):
@@ -388,6 +458,22 @@ class SidecarClient:
                 if attempt == 0:
                     continue
                 raise ConnectionError("render sidecar went away")
+            if trace_id and header.get("spans"):
+                # Graft the device process's spans onto our waterfall.
+                # Their offsets are relative to the sidecar's request
+                # arrival; anchoring at our send time puts them at most
+                # one wire hop early — invisible at waterfall scale.
+                for s in header["spans"]:
+                    try:
+                        meta = {k: v for k, v in s.items()
+                                if k not in ("name", "start_ms",
+                                             "dur_ms")}
+                        telemetry.record_span(
+                            s["name"],
+                            t_call + s["start_ms"] / 1000.0,
+                            s["dur_ms"], trace_ids=(trace_id,), **meta)
+                    except (KeyError, TypeError):
+                        pass    # malformed span: drop it, keep serving
             return (header["status"],
                     body if header["status"] == 200
                     else header.get("error", ""))

@@ -42,6 +42,59 @@ class MetadataService(Protocol):
                        session_key: Optional[str]) -> Optional[Mask]: ...
 
 
+# Level-0 dataset path per NGFF root, validated by the root .zattrs
+# mtime: the path only changes when .zattrs changes, so the per-request
+# freshness stamp stays stat-only (the JSON parse runs once per
+# rewrite, not once per tile).
+_NGFF_LEVEL0: Dict[str, Tuple[int, Optional[str]]] = {}
+_NGFF_LEVEL0_LOCK = threading.Lock()
+
+
+def _ngff_level0_zarray(ngff: str, zattrs_mtime_ns: int
+                        ) -> Optional[str]:
+    with _NGFF_LEVEL0_LOCK:
+        cached = _NGFF_LEVEL0.get(ngff)
+        if cached is not None and cached[0] == zattrs_mtime_ns:
+            return cached[1]
+    path = None
+    try:
+        with open(os.path.join(ngff, ".zattrs")) as f:
+            attrs = json.load(f)
+        datasets = (attrs.get("multiscales") or [{}])[0] \
+            .get("datasets") or []
+        if datasets and datasets[0].get("path"):
+            path = os.path.join(ngff, datasets[0]["path"], ".zarray")
+    except (OSError, ValueError, KeyError, IndexError):
+        pass    # malformed/absent .zattrs: the parse downstream complains
+    with _NGFF_LEVEL0_LOCK:
+        _NGFF_LEVEL0[ngff] = (zattrs_mtime_ns, path)
+    return path
+
+
+def _ngff_meta_mtime(ngff: str) -> int:
+    """Freshness stamp for an NGFF group's geometry.
+
+    Stats the metadata FILES, not the directory (an in-place rewrite
+    replaces contents without touching the directory mtime) — and
+    includes the first multiscales level's ``.zarray``: the per-level
+    files carry the shapes, so rewriting level 0 in place without
+    touching the root ``.zattrs`` must still invalidate cached Pixels
+    geometry."""
+    candidates = [os.path.join(ngff, ".zattrs"),
+                  os.path.join(ngff, ".zarray")]
+    try:
+        zattrs_mtime = os.stat(candidates[0]).st_mtime_ns
+    except OSError:
+        zattrs_mtime = 0
+    if zattrs_mtime:
+        level0 = _ngff_level0_zarray(ngff, zattrs_mtime)
+        if level0 is not None:
+            candidates.append(level0)
+    return max((os.stat(p).st_mtime_ns for p in candidates
+                if os.path.exists(p)),
+               default=os.stat(ngff).st_mtime_ns)
+
+
 def _check_acl(path: str, session_key: Optional[str]) -> bool:
     acl_file = os.path.join(path, "acl.json")
     if not os.path.exists(acl_file):
@@ -96,15 +149,9 @@ class LocalMetadataService:
         ngff = await asyncio.to_thread(
             find_ngff, self._image_dir(image_id))
         if ngff is not None:
-            # Stat the metadata FILES, not the directory: an in-place
-            # rewrite replaces .zattrs/.zarray contents without
-            # touching the directory mtime.
-            mtime = max(
-                (os.stat(p).st_mtime_ns
-                 for p in (os.path.join(ngff, ".zattrs"),
-                           os.path.join(ngff, ".zarray"))
-                 if os.path.exists(p)),
-                default=os.stat(ngff).st_mtime_ns)
+            # File IO (two stats + a small JSON read) runs off the
+            # event loop like the parse below.
+            mtime = await asyncio.to_thread(_ngff_meta_mtime, ngff)
             cached = self._tiff_pixels.get(image_id)
             if cached is not None and cached[0] == (ngff, mtime):
                 return cached[1]

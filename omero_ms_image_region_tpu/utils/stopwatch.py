@@ -6,8 +6,13 @@ start/elapsed pairs double as latency metrics in the logs (SURVEY.md §5:
 343,502,522``).  The span names are kept verbatim so dashboards built on the
 Java service's logs keep working against this one.
 
-Spans log at debug level and feed an in-process aggregator that the OPTIONS
-endpoint / tests can read back (count, total, p50-ish via ring buffer).
+Spans log at debug level and feed an in-process aggregator exposed on
+``/metrics``.  Each span keeps a fixed log-scale bucketed histogram
+(``utils.telemetry.Histogram``) — proper Prometheus
+``_bucket``/``_sum``/``_count`` series, replacing the old 256-sample
+ring whose p50 hid tail regressions.  Every recorded duration is also
+offered to the active request trace(s) (``telemetry.observe_span``), so
+stage timings double as waterfall child spans.
 """
 
 from __future__ import annotations
@@ -15,32 +20,26 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import deque
 from contextlib import contextmanager
 from typing import Dict
 
-log = logging.getLogger("omero_ms_image_region_tpu.perf")
+from .telemetry import Histogram, observe_span
 
-_RING = 256
+log = logging.getLogger("omero_ms_image_region_tpu.perf")
 
 
 class SpanStats:
-    __slots__ = ("count", "total_ms", "recent")
+    __slots__ = ("count", "total_ms", "hist")
 
     def __init__(self):
         self.count = 0
         self.total_ms = 0.0
-        self.recent = deque(maxlen=_RING)
+        self.hist = Histogram()
 
     def add(self, ms: float) -> None:
         self.count += 1
         self.total_ms += ms
-        self.recent.append(ms)
-
-    def p50(self) -> float:
-        if not self.recent:
-            return 0.0
-        return sorted(self.recent)[len(self.recent) // 2]
+        self.hist.add(ms)
 
     def as_dict(self) -> dict:
         return {
@@ -48,7 +47,9 @@ class SpanStats:
             "total_ms": round(self.total_ms, 3),
             "mean_ms": round(self.total_ms / self.count, 3)
             if self.count else 0.0,
-            "p50_ms": round(self.p50(), 3),
+            # Bucket-resolution estimate (upper bucket bound), kept for
+            # the profiling scripts that read the old ring p50.
+            "p50_ms": round(self.hist.quantile(0.5), 3),
         }
 
 
@@ -63,10 +64,18 @@ class StopWatchRegistry:
             if stats is None:
                 stats = self._spans[name] = SpanStats()
             stats.add(ms)
+        # Outside the lock: trace recording takes the trace's own lock.
+        observe_span(name, ms)
 
     def snapshot(self) -> Dict[str, dict]:
         with self._lock:
             return {name: s.as_dict() for name, s in self._spans.items()}
+
+    def histograms(self) -> Dict[str, Histogram]:
+        """Shallow snapshot of the live histograms (read-only use)."""
+        with self._lock:
+            return dict((name, s.hist)
+                        for name, s in self._spans.items())
 
     def reset(self) -> None:
         with self._lock:
@@ -81,16 +90,23 @@ def span_lines(extra_labels: str = "",
     """Prometheus exposition lines for every span — the one formatter
     shared by the app's /metrics and the sidecar's metrics op.
 
+    Per span: the legacy count/mean series plus the full
+    ``imageregion_span_ms`` histogram (``_bucket``/``_sum``/``_count``).
     ``extra_labels`` is appended inside the label braces (e.g.
     ``,process="sidecar"``)."""
+    extra = extra_labels.lstrip(",")
     lines = []
-    for name, s in sorted(registry.snapshot().items()):
-        label = f'{{span="{name}"{extra_labels}}}'
-        lines += [
-            f"imageregion_span_count{label} {s['count']}",
-            f"imageregion_span_mean_ms{label} {s['mean_ms']}",
-            f"imageregion_span_p50_ms{label} {s['p50_ms']}",
-        ]
+    with registry._lock:
+        items = sorted((name, s.count, s.total_ms, s.hist)
+                       for name, s in registry._spans.items())
+        for name, count, total_ms, hist in items:
+            body = f'span="{name}"' + (f",{extra}" if extra else "")
+            mean = round(total_ms / count, 3) if count else 0.0
+            lines += [
+                f"imageregion_span_count{{{body}}} {count}",
+                f"imageregion_span_mean_ms{{{body}}} {mean}",
+            ]
+            lines += hist.series("imageregion_span_ms", body)
     return lines
 
 

@@ -1,0 +1,685 @@
+"""Request tracing, bucketed histograms and health state.
+
+The reference treats its perf4j stopwatch spans and Graphite metric
+beans as first-class plumbing (``beanRefContext.xml:36-46``); this
+module is the grown-up form of that layer for the TPU service:
+
+* **Traces** — every HTTP request gets a trace id; spans recorded
+  anywhere in the pipeline (frontend handler, sidecar dispatch, batcher
+  group, device render, wire fetch) attach to the requesting trace(s)
+  through a ``contextvars`` context, so one request yields a
+  parent/child span waterfall even when its render rode a coalesced
+  group with seven other requests.  The sidecar wire carries the trace
+  id, so device-process spans join the frontend's trace.
+* **Histograms** — fixed log-scale bucket latency distributions
+  (Prometheus ``_bucket``/``_sum``/``_count`` semantics), replacing the
+  p50-only ring that could not distinguish a tail regression from link
+  weather.
+* **Gauges** — link-health EWMA from the wire fetch observations
+  (settles the weather-vs-structure question when a bench headline
+  moves), XLA compile events (count + cumulative ms — a lazily compiled
+  batch shape shows up here mechanically), queue depth and pipeline
+  occupancy are read live from the batcher at scrape time.
+* **Slow-request dumps** — requests over a configured threshold write
+  their full waterfall JSON to a spool directory
+  (``scripts/trace_report.py`` renders them).
+* **Readiness** — process-wide degradation state behind ``/readyz``.
+
+Device-free on import: nothing here pulls in JAX (frontends import this
+module), and the compile listener only touches ``jax.monitoring`` when
+a device-owning process installs it.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("omero_ms_image_region_tpu.telemetry")
+
+# --------------------------------------------------------------- histograms
+
+# Fixed log-scale bucket bounds (ms): 0.25 ms .. ~32.8 s, ratio 2.
+# Fixed — not adaptive — so series from different processes, restarts
+# and dashboards always align bucket-for-bucket.
+BUCKET_BOUNDS_MS: Tuple[float, ...] = tuple(0.25 * 2 ** i
+                                            for i in range(18))
+
+
+def _fmt(v: float) -> str:
+    """Prometheus-friendly number formatting (no trailing zeros)."""
+    return ("%g" % v)
+
+
+class Histogram:
+    """Cumulative log-bucket histogram (not thread-safe on its own;
+    callers hold their registry lock around ``add``)."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Tuple[float, ...] = BUCKET_BOUNDS_MS):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)     # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+    def add(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, b in enumerate(self.bounds):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def cumulative(self) -> List[int]:
+        out, acc = [], 0
+        for c in self.counts:
+            acc += c
+            out.append(acc)
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (upper bound of the
+        bucket holding the q-th sample) — keeps the old ring-p50 API
+        alive for profiling scripts."""
+        if not self.count:
+            return 0.0
+        target = max(1, int(q * self.count + 0.5))
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                return (self.bounds[i] if i < len(self.bounds)
+                        else self.bounds[-1] * 2)
+        return self.bounds[-1] * 2
+
+    def series(self, name: str, labels: str = "") -> List[str]:
+        """Exposition lines.  ``labels`` is the inner label body without
+        braces (e.g. ``route="x"``); ``le`` composes after it."""
+        sep = "," if labels else ""
+        lines = []
+        cum = self.cumulative()
+        for b, c in zip(self.bounds, cum):
+            lines.append(f'{name}_bucket{{{labels}{sep}le="{_fmt(b)}"}}'
+                         f" {c}")
+        lines.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} '
+                     f"{cum[-1]}")
+        suffix = f"{{{labels}}}" if labels else ""
+        lines.append(f"{name}_sum{suffix} {round(self.sum, 3)}")
+        lines.append(f"{name}_count{suffix} {self.count}")
+        return lines
+
+
+class HistogramVec:
+    """Thread-safe histogram family keyed by one label value."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self._lock = threading.Lock()
+        self._hists: Dict[str, Histogram] = {}
+
+    def observe(self, label_value: str, value: float) -> None:
+        with self._lock:
+            h = self._hists.get(label_value)
+            if h is None:
+                h = self._hists[label_value] = Histogram()
+            h.add(value)
+
+    def series(self, name: str) -> List[str]:
+        with self._lock:
+            items = sorted(self._hists.items())
+            lines = []
+            for lv, h in items:
+                lines += h.series(name, f'{self.label}="{lv}"')
+            return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hists.clear()
+
+
+# End-to-end request latency by route — the acceptance-criteria series.
+REQUEST_HIST = HistogramVec("route")
+_REQ_LOCK = threading.Lock()
+_REQ_TOTALS: Dict[tuple, int] = {}
+
+
+def count_request(route: str, status: int) -> None:
+    with _REQ_LOCK:
+        key = (route, int(status))
+        _REQ_TOTALS[key] = _REQ_TOTALS.get(key, 0) + 1
+
+
+# ------------------------------------------------------------------- traces
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Trace:
+    __slots__ = ("trace_id", "route", "t0", "wall_ts", "spans", "lock")
+
+    def __init__(self, trace_id: str, route: str = ""):
+        self.trace_id = trace_id
+        self.route = route
+        self.t0 = time.perf_counter()
+        self.wall_ts = time.time()
+        self.spans: List[dict] = []
+        self.lock = threading.Lock()
+
+    def add_span(self, name: str, t_start: float, dur_ms: float,
+                 **meta) -> None:
+        span = {"name": name,
+                "start_ms": round((t_start - self.t0) * 1000.0, 3),
+                "dur_ms": round(dur_ms, 3)}
+        if meta:
+            span.update(meta)
+        with self.lock:
+            self.spans.append(span)
+
+    def export_spans(self) -> List[dict]:
+        """Copied span list (wire-safe: plain JSON dicts whose
+        ``start_ms`` offsets are relative to this trace's t0)."""
+        with self.lock:
+            return [dict(s) for s in self.spans]
+
+    def span_ms(self, *names: str) -> Optional[float]:
+        """Total duration of spans with one of the EXACT ``names``
+        (None when the request never touched those stages).  Exact, not
+        prefix: "Renderer.renderAsPackedInt" must not also sum its
+        nested ".batch" child or totals exceed the request wall time."""
+        with self.lock:
+            total, seen = 0.0, False
+            for s in self.spans:
+                if s["name"] in names:
+                    total += s["dur_ms"]
+                    seen = True
+        return total if seen else None
+
+    def to_json(self, total_ms: Optional[float] = None,
+                status: Optional[int] = None) -> dict:
+        with self.lock:
+            spans = sorted(self.spans, key=lambda s: s["start_ms"])
+        doc = {"trace_id": self.trace_id, "route": self.route,
+               "ts": self.wall_ts, "spans": spans}
+        if total_ms is not None:
+            doc["total_ms"] = round(total_ms, 3)
+        if status is not None:
+            doc["status"] = status
+        return doc
+
+
+class TraceRegistry:
+    """Active traces by id, bounded; finished traces keep a short ring
+    for tests and ad-hoc inspection.
+
+    A sidecar process records spans for trace ids it never started (the
+    frontend owns the request); those auto-created entries are evicted
+    oldest-first once ``max_active`` is exceeded, so an orphaned trace
+    can never leak memory."""
+
+    def __init__(self, max_active: int = 4096, recent: int = 64):
+        self._lock = threading.Lock()
+        self._active: Dict[str, Trace] = {}
+        self._max_active = max_active
+        from collections import deque
+        self.recent = deque(maxlen=recent)
+
+    def start(self, trace_id: str, route: str = "") -> Trace:
+        trace = Trace(trace_id, route)
+        with self._lock:
+            self._active[trace_id] = trace
+            while len(self._active) > self._max_active:
+                self._active.pop(next(iter(self._active)))
+        return trace
+
+    def get_or_create(self, trace_id: str) -> Trace:
+        with self._lock:
+            trace = self._active.get(trace_id)
+            if trace is None:
+                trace = self._active[trace_id] = Trace(trace_id)
+                while len(self._active) > self._max_active:
+                    self._active.pop(next(iter(self._active)))
+            return trace
+
+    def is_active(self, trace_id: str) -> bool:
+        with self._lock:
+            return trace_id in self._active
+
+    def finish(self, trace_id: str) -> Optional[Trace]:
+        with self._lock:
+            trace = self._active.pop(trace_id, None)
+        if trace is not None:
+            self.recent.append(trace)
+        return trace
+
+    def reset(self) -> None:
+        with self._lock:
+            self._active.clear()
+        self.recent.clear()
+
+
+TRACES = TraceRegistry()
+
+# The trace ids the CURRENT execution context is working for.  A plain
+# request context carries one id; a batcher worker thread rendering a
+# coalesced group carries every member's id, so the one group-render
+# span lands on all of their waterfalls.
+_TRACE_IDS: contextvars.ContextVar[Tuple[str, ...]] = \
+    contextvars.ContextVar("imageregion_trace_ids", default=())
+
+# Registry values recorded through the stopwatch registry that are NOT
+# durations (counts etc.) — excluded from trace waterfalls.
+_NON_SPAN_NAMES = frozenset({"batcher.groupTiles"})
+
+
+def current_trace_ids() -> Tuple[str, ...]:
+    return _TRACE_IDS.get()
+
+
+def current_trace_id() -> Optional[str]:
+    ids = _TRACE_IDS.get()
+    return ids[0] if ids else None
+
+
+def clear_context() -> None:
+    """Detach the current execution context from any trace.  Long-lived
+    tasks spawned from inside a request (the batcher's per-key
+    dispatcher loops) MUST call this: contextvars copy at task creation,
+    and without it every span the task ever records would attach to the
+    spawning request's waterfall."""
+    _TRACE_IDS.set(())
+
+
+@contextmanager
+def trace_scope(trace_id: str, route: str = ""):
+    """Root scope for one request: registers the trace, makes it the
+    context's recording target, yields the Trace (the caller finishes
+    it — the finish policy lives with the HTTP layer)."""
+    trace = TRACES.start(trace_id, route)
+    token = _TRACE_IDS.set((trace_id,))
+    try:
+        yield trace
+    finally:
+        _TRACE_IDS.reset(token)
+
+
+@contextmanager
+def adopt_trace(trace_id: Optional[str]):
+    """Join an existing trace (sidecar side of the wire): spans recorded
+    inside attach to ``trace_id``'s waterfall.  No-op for None."""
+    if not trace_id:
+        yield None
+        return
+    trace = TRACES.get_or_create(trace_id)
+    token = _TRACE_IDS.set((trace_id,))
+    try:
+        yield trace
+    finally:
+        _TRACE_IDS.reset(token)
+
+
+@contextmanager
+def group_trace(trace_ids: Tuple[str, ...]):
+    """Recording target for a batcher worker thread rendering a
+    coalesced group: spans land on EVERY member's waterfall."""
+    token = _TRACE_IDS.set(tuple(trace_ids))
+    try:
+        yield
+    finally:
+        _TRACE_IDS.reset(token)
+
+
+def record_span(name: str, t_start: float, dur_ms: float,
+                trace_ids: Optional[Tuple[str, ...]] = None,
+                **meta) -> None:
+    """Attach a span to the given traces (default: the context's)."""
+    ids = trace_ids if trace_ids is not None else _TRACE_IDS.get()
+    for tid in ids:
+        trace = TRACES.get_or_create(tid)
+        trace.add_span(name, t_start, dur_ms, **meta)
+
+
+def observe_span(name: str, dur_ms: float) -> None:
+    """Hook for the stopwatch registry: every recorded stage duration
+    becomes a child span on whatever traces the context carries."""
+    if name in _NON_SPAN_NAMES:
+        return
+    ids = _TRACE_IDS.get()
+    if not ids:
+        return
+    record_span(name, time.perf_counter() - dur_ms / 1000.0, dur_ms,
+                trace_ids=ids)
+
+
+# ------------------------------------------------------------- link health
+
+class LinkHealth:
+    """EWMAs of the device->host link rate, fed by the wire fetchers
+    (``ops.jpegenc._observe_fetch``).
+
+    Two gauges, because almost every PRIMARY prefetch is ``conflated``
+    (its timed window covers device execution as well as the transfer):
+
+    * ``effective_mb_s`` — EWMA over ALL bandwidth-class fetches, both
+      directions.  This is the rate requests actually experience, and
+      the one that TRACKS a link slowdown (a conflated-only stream
+      would otherwise never move a lower bound downward).
+    * ``ewma_mb_s`` — floor estimate of the RAW link: conflated
+      observations update it only upward (a conflated 40 MB/s proves
+      the link is at least that fast; a conflated 2 MB/s proves
+      nothing — it may be compile or execution stall, not wire).
+
+    Effective falling while the floor holds reads as device-side
+    weather; both falling together is the link itself.
+    """
+
+    MIN_BYTES = 256 * 1024      # below this, latency dominates
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        self.ewma_mb_s: Optional[float] = None
+        self.effective_mb_s: Optional[float] = None
+        self.fetches = 0
+        self.bytes_total = 0
+        self.last_ts = 0.0
+
+    def _blend(self, prev: Optional[float], rate: float) -> float:
+        return rate if prev is None else prev + self.alpha * (rate
+                                                              - prev)
+
+    def observe(self, nbytes: int, seconds: float,
+                conflated: bool = False) -> None:
+        with self._lock:
+            self.fetches += 1
+            self.bytes_total += int(nbytes)
+            self.last_ts = time.time()
+            if seconds <= 0 or nbytes < self.MIN_BYTES:
+                return
+            rate = nbytes / seconds / 1e6
+            self.effective_mb_s = self._blend(self.effective_mb_s,
+                                              rate)
+            if conflated and (self.ewma_mb_s is not None
+                              and rate <= self.ewma_mb_s):
+                return
+            self.ewma_mb_s = self._blend(self.ewma_mb_s, rate)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.ewma_mb_s = None
+            self.effective_mb_s = None
+            self.fetches = 0
+            self.bytes_total = 0
+            self.last_ts = 0.0
+
+
+LINK = LinkHealth()
+
+
+# ---------------------------------------------------------- compile events
+
+class CompileStats:
+    """XLA compile activity: count + cumulative ms of backend compiles.
+
+    A serving-path program shape that was missed by prewarm shows up
+    here as a count increment with a seconds-scale duration — the
+    mechanical detector for first-touch compile stalls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = 0
+        self.total_ms = 0.0
+
+    def observe(self, duration_s: float) -> None:
+        with self._lock:
+            self.events += 1
+            self.total_ms += duration_s * 1000.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self.events = 0
+            self.total_ms = 0.0
+
+
+COMPILE = CompileStats()
+_COMPILE_LISTENER = threading.Lock()
+_compile_listener_installed = False
+
+
+def install_compile_listener() -> bool:
+    """Register the jax.monitoring listener (device processes only —
+    this is the one function here that imports JAX).  Idempotent;
+    returns whether the listener is active."""
+    global _compile_listener_installed
+    with _COMPILE_LISTENER:
+        if _compile_listener_installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:       # pragma: no cover - jax-free frontends
+            return False
+
+        def _on_event(event: str, duration: float, **kw) -> None:
+            # backend_compile is the actual XLA compile; trace/lowering
+            # events would double-count the same program.
+            if "backend_compile" in event:
+                COMPILE.observe(duration)
+
+        try:
+            monitoring.register_event_duration_secs_listener(_on_event)
+        except Exception:       # pragma: no cover - API drift
+            return False
+        _compile_listener_installed = True
+        return True
+
+
+# ---------------------------------------------------------------- readiness
+
+class Readiness:
+    """Process-wide degradation state behind ``/readyz``."""
+
+    def __init__(self):
+        self.prewarm_pending = False
+
+    def reset(self) -> None:
+        self.prewarm_pending = False
+
+
+READINESS = Readiness()
+
+
+# -------------------------------------------------------------- slow dumps
+
+def dump_slow_trace(trace: Trace, total_ms: float, status: int,
+                    directory: str) -> Optional[str]:
+    """Write the waterfall JSON for a slow request; never raises (a
+    full disk must not fail the request that just succeeded)."""
+    try:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"{trace.trace_id}.json")
+        with open(path, "w") as f:
+            json.dump(trace.to_json(total_ms=total_ms, status=status),
+                      f, indent=1)
+        return path
+    except OSError:
+        log.warning("slow-trace dump to %s failed", directory,
+                    exc_info=True)
+        return None
+
+
+# -------------------------------------------------------------- exposition
+
+# Metric family -> Prometheus type, for every family this service can
+# emit (frontend, sidecar and combined posture).  finalize_exposition
+# derives each line's family and emits the # TYPE header once.
+METRIC_TYPES: Dict[str, str] = {
+    "imageregion_span_count": "counter",
+    "imageregion_span_mean_ms": "gauge",
+    "imageregion_span_ms": "histogram",
+    "imageregion_request_duration_ms": "histogram",
+    "imageregion_requests_total": "counter",
+    "imageregion_cache_hits": "counter",
+    "imageregion_cache_misses": "counter",
+    "imageregion_rawcache_hits": "counter",
+    "imageregion_rawcache_misses": "counter",
+    "imageregion_rawcache_bytes": "gauge",
+    "imageregion_batches_dispatched": "counter",
+    "imageregion_tiles_rendered": "counter",
+    "imageregion_batcher_queue_depth": "gauge",
+    "imageregion_pipeline_inflight": "gauge",
+    "imageregion_batcher_max_batch": "gauge",
+    "imageregion_compile_events_total": "counter",
+    "imageregion_compile_ms_total": "counter",
+    "imageregion_link_mb_s": "gauge",
+    "imageregion_link_effective_mb_s": "gauge",
+    "imageregion_link_fetches_total": "counter",
+    "imageregion_link_fetch_bytes_total": "counter",
+    "imageregion_ready": "gauge",
+}
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _family_of(line: str) -> str:
+    name = line.split("{", 1)[0].split(" ", 1)[0]
+    for suffix in _HIST_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if METRIC_TYPES.get(base) == "histogram":
+                return base
+    return name
+
+
+def finalize_exposition(lines: List[str]) -> str:
+    """Order series by family (first-seen), emit one ``# TYPE`` header
+    per family, pass comments through.  The single formatter shared by
+    the app's ``/metrics`` and the sidecar merge path, so TYPE headers
+    can never duplicate across the process boundary."""
+    families: Dict[str, List[str]] = {}
+    order: List[str] = []
+    comments: List[str] = []
+    for line in lines:
+        line = line.rstrip("\n")
+        if not line:
+            continue
+        if line.startswith("#"):
+            if not line.startswith("# TYPE"):
+                comments.append(line)
+            continue
+        fam = _family_of(line)
+        if fam not in families:
+            families[fam] = []
+            order.append(fam)
+        families[fam].append(line)
+    out: List[str] = []
+    for fam in order:
+        out.append(f"# TYPE {fam} {METRIC_TYPES.get(fam, 'untyped')}")
+        out += families[fam]
+    out += comments
+    return "\n".join(out) + "\n"
+
+
+def request_metric_lines() -> List[str]:
+    """The frontend-local request series (histogram + totals)."""
+    lines = REQUEST_HIST.series("imageregion_request_duration_ms")
+    with _REQ_LOCK:
+        totals = sorted(_REQ_TOTALS.items())
+    for (route, status), n in totals:
+        lines.append(f'imageregion_requests_total{{route="{route}",'
+                     f'status="{status}"}} {n}')
+    return lines
+
+
+def device_metric_lines(services, extra_labels: str = "") -> List[str]:
+    """Series owned by a device-side process (combined app or sidecar):
+    caches, raw cache, batcher gauges, compile events, link health.
+
+    ``services`` is duck-typed (``server.handler.ImageRegionServices``)
+    so this module stays importable without the server stack;
+    ``extra_labels`` is appended inside every label brace (the
+    sidecar's ``process="sidecar"``).
+    """
+    def label(body: str = "") -> str:
+        inner = body + (("," if body else "")
+                        + extra_labels.lstrip(",") if extra_labels
+                        else "")
+        return f"{{{inner}}}" if inner else ""
+
+    lines: List[str] = []
+    for cache_name in ("image_region", "pixels_metadata", "shape_mask"):
+        stack = getattr(getattr(services, "caches", None), cache_name,
+                        None)
+        for i, tier in enumerate(getattr(stack, "tiers", ())):
+            hits = getattr(tier, "hits", None)
+            misses = getattr(tier, "misses", None)
+            if hits is None:
+                continue
+            lb = label(f'cache="{cache_name}",tier="{i}"')
+            lines += [
+                f"imageregion_cache_hits{lb} {hits}",
+                f"imageregion_cache_misses{lb} {misses}",
+            ]
+    raw_cache = getattr(services, "raw_cache", None)
+    if raw_cache is not None:
+        lb = label()
+        lines += [
+            f"imageregion_rawcache_hits{lb} {raw_cache.hits}",
+            f"imageregion_rawcache_misses{lb} {raw_cache.misses}",
+            f"imageregion_rawcache_bytes{lb} {raw_cache.size_bytes}",
+        ]
+    renderer = getattr(services, "renderer", None)
+    if hasattr(renderer, "batches_dispatched"):
+        lb = label()
+        lines += [
+            f"imageregion_batches_dispatched{lb} "
+            f"{renderer.batches_dispatched}",
+            f"imageregion_tiles_rendered{lb} "
+            f"{renderer.tiles_rendered}",
+        ]
+    if hasattr(renderer, "queue_depth"):
+        lb = label()
+        lines += [
+            f"imageregion_batcher_queue_depth{lb} "
+            f"{renderer.queue_depth()}",
+            f"imageregion_pipeline_inflight{lb} "
+            f"{renderer.inflight()}",
+            f"imageregion_batcher_max_batch{lb} {renderer.max_batch}",
+        ]
+    lb = label()
+    lines += [
+        f"imageregion_compile_events_total{lb} {COMPILE.events}",
+        f"imageregion_compile_ms_total{lb} "
+        f"{round(COMPILE.total_ms, 3)}",
+        f"imageregion_link_fetches_total{lb} {LINK.fetches}",
+        f"imageregion_link_fetch_bytes_total{lb} {LINK.bytes_total}",
+    ]
+    if LINK.fetches:
+        # 0.0 until a bandwidth-class fetch has been rated (small
+        # fetches are latency-dominated and carry no rate signal).
+        lines += [
+            f"imageregion_link_mb_s{lb} "
+            f"{round(LINK.ewma_mb_s or 0.0, 3)}",
+            f"imageregion_link_effective_mb_s{lb} "
+            f"{round(LINK.effective_mb_s or 0.0, 3)}",
+        ]
+    return lines
+
+
+def reset() -> None:
+    """Test isolation: clear every process-global accumulator."""
+    TRACES.reset()
+    REQUEST_HIST.reset()
+    with _REQ_LOCK:
+        _REQ_TOTALS.clear()
+    LINK.reset()
+    COMPILE.reset()
+    READINESS.reset()

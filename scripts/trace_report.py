@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Render slow-request trace dumps as waterfall tables.
+
+The serving app writes one JSON file per over-threshold request
+(``telemetry.slow-request-ms`` / ``slow-request-dir``); this renders
+them human-readable::
+
+    python scripts/trace_report.py slow-traces/3f2a... .json
+    python scripts/trace_report.py slow-traces/          # newest N
+    python scripts/trace_report.py --limit 3 slow-traces/
+
+Each span prints its offset from the request start, its duration, and a
+proportional bar, so "where did 2.6 s go?" is answered by eye: a wide
+``wire.fetch`` bar is link weather, a wide ``batcher.queueWait`` bar is
+backlog, a wide first-request ``Renderer.renderAsPackedInt.batch`` bar
+with a compile-event bump on /metrics is a missed prewarm shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+BAR_WIDTH = 40
+
+
+def load_traces(paths, limit):
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files += [os.path.join(p, f) for f in os.listdir(p)
+                      if f.endswith(".json")]
+        else:
+            files.append(p)
+    files.sort(key=lambda f: os.path.getmtime(f), reverse=True)
+    docs = []
+    for f in files[:limit]:
+        try:
+            with open(f) as fh:
+                docs.append(json.load(fh))
+        except (OSError, ValueError) as e:
+            print(f"skipping {f}: {e}", file=sys.stderr)
+    return docs
+
+
+def render_trace(doc) -> str:
+    total = float(doc.get("total_ms") or max(
+        (s["start_ms"] + s["dur_ms"] for s in doc.get("spans", ())),
+        default=1.0))
+    total = max(total, 1e-6)
+    ts = doc.get("ts")
+    when = (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+            if ts else "?")
+    lines = [
+        f"trace {doc.get('trace_id', '?')}  route="
+        f"{doc.get('route', '?')}  status={doc.get('status', '?')}  "
+        f"total={total:.1f} ms  at {when}",
+        f"  {'start':>9}  {'dur':>9}  "
+        f"{'waterfall':<{BAR_WIDTH}}  span",
+    ]
+    for s in sorted(doc.get("spans", ()), key=lambda s: s["start_ms"]):
+        x0 = int(BAR_WIDTH * max(s["start_ms"], 0.0) / total)
+        x1 = int(BAR_WIDTH * min(s["start_ms"] + s["dur_ms"], total)
+                 / total)
+        x0 = min(x0, BAR_WIDTH - 1)
+        bar = (" " * x0 + "#" * max(x1 - x0, 1)).ljust(BAR_WIDTH)
+        extra = {k: v for k, v in s.items()
+                 if k not in ("name", "start_ms", "dur_ms")}
+        suffix = f"  {extra}" if extra else ""
+        lines.append(f"  {s['start_ms']:>8.1f}m {s['dur_ms']:>8.1f}m  "
+                     f"{bar}  {s['name']}{suffix}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render slow-request trace dumps as waterfalls")
+    parser.add_argument("paths", nargs="+",
+                        help="dump file(s) or spool directory")
+    parser.add_argument("--limit", type=int, default=5,
+                        help="newest N traces when given a directory "
+                             "(default 5)")
+    args = parser.parse_args(argv)
+    docs = load_traces(args.paths, args.limit)
+    if not docs:
+        print("no trace dumps found", file=sys.stderr)
+        return 1
+    print("\n\n".join(render_trace(d) for d in docs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

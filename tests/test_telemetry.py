@@ -1,0 +1,600 @@
+"""Observability layer: trace waterfalls, bucketed histograms, health
+probes, slow-request dumps, and the Prometheus exposition contract."""
+
+import asyncio
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_image_region_tpu.io.store import build_pyramid
+from omero_ms_image_region_tpu.server.app import create_app
+from omero_ms_image_region_tpu.server.config import (AppConfig,
+                                                     SidecarConfig)
+from omero_ms_image_region_tpu.server.sidecar import run_sidecar
+from omero_ms_image_region_tpu.utils import telemetry
+from omero_ms_image_region_tpu.utils.stopwatch import REGISTRY
+
+IMG = 7
+H = W = 64
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("teledata")
+    rng = np.random.default_rng(13)
+    planes = rng.integers(0, 60000, size=(2, 2, H, W)).astype(np.uint16)
+    build_pyramid(planes, str(root / str(IMG)), chunk=(32, 32),
+                  n_levels=1)
+    return str(root)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _device_config(data_dir, **kw):
+    cfg = AppConfig(data_dir=data_dir, **kw)
+    # Tiny test tiles must exercise the batched device path the traces
+    # thread through, not the host-kernel fallback.
+    cfg.renderer.cpu_fallback_max_px = 0
+    return cfg
+
+
+def _fetch(config, *requests, cookies=None):
+    async def main():
+        app = create_app(config)
+        client = TestClient(TestServer(app), cookies=cookies)
+        await client.start_server()
+        out = []
+        try:
+            for method, path in requests:
+                resp = await client.request(method, path)
+                out.append((resp.status, dict(resp.headers),
+                            await resp.read()))
+        finally:
+            await client.close()
+        return out
+
+    return asyncio.run(main())
+
+
+URL = (f"/webgateway/render_image_region/{IMG}/0/0"
+       "?tile=0,0,0,32,32&format=jpeg&m=c&c=1|0:60000$FF0000")
+
+
+# ------------------------------------------------------------ histograms
+
+class TestHistogram:
+    def test_fixed_log_scale_bounds(self):
+        b = telemetry.BUCKET_BOUNDS_MS
+        assert b[0] == 0.25 and len(b) == 18
+        assert all(hi == lo * 2 for lo, hi in zip(b, b[1:]))
+
+    def test_bucket_boundaries_are_le(self):
+        h = telemetry.Histogram(bounds=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.0, 1.5, 2.0, 4.0, 5.0):
+            h.add(v)
+        # le semantics: a sample equal to the bound lands IN the bucket.
+        assert h.counts == [2, 2, 1, 1]
+        assert h.cumulative() == [2, 4, 5, 6]
+        assert h.count == 6
+        assert h.sum == pytest.approx(14.0)
+
+    def test_series_exposition(self):
+        h = telemetry.Histogram(bounds=(1.0, 2.0))
+        h.add(0.5)
+        h.add(3.0)
+        lines = h.series("x_ms", 'route="r"')
+        assert 'x_ms_bucket{route="r",le="1"} 1' in lines
+        assert 'x_ms_bucket{route="r",le="2"} 1' in lines
+        assert 'x_ms_bucket{route="r",le="+Inf"} 2' in lines
+        assert 'x_ms_sum{route="r"} 3.5' in lines
+        assert 'x_ms_count{route="r"} 2' in lines
+
+    def test_unlabelled_series(self):
+        h = telemetry.Histogram(bounds=(1.0,))
+        h.add(0.5)
+        lines = h.series("y_ms")
+        assert 'y_ms_bucket{le="1"} 1' in lines
+        assert "y_ms_sum 0.5" in lines
+        assert "y_ms_count 1" in lines
+
+    def test_quantile_estimate(self):
+        h = telemetry.Histogram()
+        for v in [1.0] * 50 + [100.0] * 50:
+            h.add(v)
+        assert h.quantile(0.25) == 1.0
+        assert h.quantile(0.9) >= 100.0
+
+
+# ----------------------------------------------------------- trace flow
+
+class TestTracePropagation:
+    def test_combined_batcher_spans_share_request_trace(self, data_dir):
+        [(status, _, _)] = _fetch(_device_config(data_dir),
+                                  ("GET", URL))
+        assert status == 200
+        traces = [t for t in telemetry.TRACES.recent
+                  if t.route == "render_image_region"]
+        assert traces, "request trace was never finished"
+        trace = traces[-1]
+        names = {s["name"] for s in trace.spans}
+        # The frontend handler span, the batcher queue-wait, the
+        # batched device render and the wire fetch all landed on the
+        # ONE request trace.
+        assert "Renderer.renderAsPackedInt" in names
+        assert "batcher.queueWait" in names
+        assert "Renderer.renderAsPackedInt.batch" in names
+        assert "wire.fetch" in names
+
+    def test_sidecar_spans_join_frontend_trace(self, data_dir,
+                                               tmp_path):
+        """frontend -> sidecar -> batcher: every child span carries the
+        trace id the FRONTEND generated (same-process sidecar, so both
+        sides share the registry the assertion reads)."""
+        sock = str(tmp_path / "t.sock")
+
+        async def scenario():
+            sidecar_cfg = _device_config(data_dir)
+            task = asyncio.create_task(run_sidecar(sidecar_cfg, sock))
+            for _ in range(200):
+                if task.done():
+                    raise AssertionError(
+                        f"sidecar died: {task.exception()!r}")
+                if os.path.exists(sock):
+                    break
+                await asyncio.sleep(0.05)
+            app = create_app(AppConfig(
+                data_dir=data_dir,
+                sidecar=SidecarConfig(socket=sock, role="frontend")))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                assert r.status == 200
+                await r.read()
+            finally:
+                await client.close()
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+        asyncio.run(scenario())
+        traces = [t for t in telemetry.TRACES.recent
+                  if t.route == "render_image_region"]
+        assert traces
+        trace = traces[-1]
+        names = {s["name"] for s in trace.spans}
+        assert "sidecar.render" in names          # crossed the wire
+        assert "batcher.queueWait" in names       # batcher child
+        assert "Renderer.renderAsPackedInt.batch" in names  # device
+        assert "jfif.encodeBatch" in names        # encode tail
+
+    def test_cross_process_sidecar_spans_graft_onto_trace(self,
+                                                          data_dir,
+                                                          tmp_path):
+        """A REAL split (sidecar subprocess): the device process's spans
+        come back on the wire response and graft onto the frontend's
+        waterfall — the frontend's slow dump shows the full render."""
+        import signal
+        import subprocess
+        import sys
+        import time as _time
+
+        sock = str(tmp_path / "x.sock")
+        conf = tmp_path / "sidecar.yaml"
+        conf.write_text(f"data-dir: {json.dumps(data_dir)}\n"
+                        "renderer:\n    cpu-fallback-max-px: 0\n")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "omero_ms_image_region_tpu.server",
+             "--config", str(conf), "--role", "sidecar",
+             "--sidecar-socket", sock],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            deadline = _time.monotonic() + 120
+            while not os.path.exists(sock):
+                assert proc.poll() is None, "sidecar died at startup"
+                assert _time.monotonic() < deadline
+                _time.sleep(0.2)
+
+            async def scenario():
+                app = create_app(AppConfig(
+                    data_dir=data_dir,
+                    sidecar=SidecarConfig(socket=sock,
+                                          role="frontend")))
+                client = TestClient(TestServer(app))
+                await client.start_server()
+                try:
+                    r = await client.get(URL)
+                    assert r.status == 200
+                    await r.read()
+                finally:
+                    await client.close()
+
+            asyncio.run(scenario())
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        traces = [t for t in telemetry.TRACES.recent
+                  if t.route == "render_image_region"]
+        assert traces
+        names = {s["name"] for s in traces[-1].spans}
+        # Device-process children landed on the frontend trace even
+        # though they were recorded in another process.
+        assert "sidecar.render" in names
+        assert "Renderer.renderAsPackedInt.batch" in names
+        assert "batcher.queueWait" in names
+
+    def test_dispatcher_task_does_not_adopt_first_request(self,
+                                                          data_dir):
+        """The per-key dispatcher loop is spawned from the FIRST
+        request's context; its spans must not all attach to that one
+        trace forever."""
+        cfg = _device_config(data_dir)
+        reqs = [("GET", URL),
+                ("GET", URL.replace("0:60000", "0:50000"))]
+        out = _fetch(cfg, *reqs)
+        assert [s for s, _, _ in out] == [200, 200]
+        traces = [t for t in telemetry.TRACES.recent
+                  if t.route == "render_image_region"]
+        assert len(traces) >= 2
+        # Both requests carry their own render waterfall.
+        for t in traces[-2:]:
+            assert any(s["name"] == "Renderer.renderAsPackedInt.batch"
+                       for s in t.spans), t.to_json()
+
+
+# -------------------------------------------------------- health probes
+
+class TestHealthProbes:
+    def test_healthz_always_ok(self, data_dir):
+        [(status, _, body)] = _fetch(_device_config(data_dir),
+                                     ("GET", "/healthz"))
+        assert status == 200
+        assert json.loads(body)["status"] == "ok"
+
+    def test_readyz_combined_ready(self, data_dir):
+        [(status, _, body)] = _fetch(_device_config(data_dir),
+                                     ("GET", "/readyz"))
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["status"] == "ready"
+        assert doc["checks"]["prewarm"] == "complete"
+
+    def test_readyz_503_during_prewarm(self, data_dir):
+        telemetry.READINESS.prewarm_pending = True
+        [(status, _, body)] = _fetch(_device_config(data_dir),
+                                     ("GET", "/readyz"))
+        assert status == 503
+        assert json.loads(body)["checks"]["prewarm"] == "pending"
+
+    def test_readyz_503_on_backlog(self, data_dir):
+        cfg = _device_config(data_dir)
+        cfg.telemetry.ready_max_queue_depth = 1
+
+        async def main():
+            app = create_app(cfg)
+            from omero_ms_image_region_tpu.server.app import SERVICES_KEY
+            app[SERVICES_KEY].renderer.queue_depth = lambda: 99
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/readyz")
+                return r.status, await r.json()
+            finally:
+                await client.close()
+
+        status, doc = asyncio.run(main())
+        assert status == 503
+        assert doc["checks"]["queue"].startswith("depth 99")
+
+    def test_readyz_flips_on_sidecar_death_and_recovery(self, data_dir,
+                                                        tmp_path):
+        sock = str(tmp_path / "r.sock")
+
+        async def scenario():
+            async def start_sidecar():
+                task = asyncio.create_task(
+                    run_sidecar(_device_config(data_dir), sock))
+                for _ in range(200):
+                    if task.done():
+                        raise AssertionError(
+                            f"sidecar died: {task.exception()!r}")
+                    if os.path.exists(sock):
+                        return task
+                    await asyncio.sleep(0.05)
+                raise AssertionError("sidecar socket never appeared")
+
+            async def stop(task):
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+                import pathlib
+                pathlib.Path(sock).unlink(missing_ok=True)
+
+            task = await start_sidecar()
+            app = create_app(AppConfig(
+                data_dir=data_dir,
+                sidecar=SidecarConfig(socket=sock, role="frontend")))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r1 = await client.get("/readyz")
+                assert r1.status == 200, await r1.text()
+                doc1 = await r1.json()
+                assert doc1["checks"]["sidecar"] == "ok"
+
+                await stop(task)
+                r2 = await client.get("/readyz")
+                assert r2.status == 503
+                doc2 = await r2.json()
+                assert doc2["status"] == "degraded"
+                assert doc2["checks"]["sidecar"] == "unreachable"
+
+                task = await start_sidecar()
+                try:
+                    r3 = await client.get("/readyz")
+                    assert r3.status == 200, await r3.text()
+                finally:
+                    await stop(task)
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+
+# ------------------------------------------------------- slow requests
+
+class TestSlowRequestTracer:
+    def test_dump_written_and_renderable(self, data_dir, tmp_path):
+        cfg = _device_config(data_dir)
+        cfg.telemetry.slow_request_ms = 0.001   # everything is "slow"
+        cfg.telemetry.slow_request_dir = str(tmp_path / "slow")
+        [(status, _, _)] = _fetch(cfg, ("GET", URL))
+        assert status == 200
+        dumps = os.listdir(cfg.telemetry.slow_request_dir)
+        assert dumps
+        path = os.path.join(cfg.telemetry.slow_request_dir, dumps[0])
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["route"] == "render_image_region"
+        assert doc["status"] == 200
+        assert doc["total_ms"] > 0
+        assert doc["trace_id"] == os.path.splitext(dumps[0])[0]
+        names = [s["name"] for s in doc["spans"]]
+        assert "Renderer.renderAsPackedInt" in names
+        # Spans carry offsets + durations (the waterfall coordinates).
+        for s in doc["spans"]:
+            assert s["dur_ms"] >= 0 and "start_ms" in s
+
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "trace_report",
+            os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "trace_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        table = mod.render_trace(doc)
+        assert "render_image_region" in table
+        assert "Renderer.renderAsPackedInt" in table
+        assert "#" in table                     # the bars rendered
+
+    def test_threshold_zero_disables(self, data_dir, tmp_path):
+        cfg = _device_config(data_dir)
+        cfg.telemetry.slow_request_ms = 0.0
+        cfg.telemetry.slow_request_dir = str(tmp_path / "never")
+        [(status, _, _)] = _fetch(cfg, ("GET", URL))
+        assert status == 200
+        assert not os.path.exists(cfg.telemetry.slow_request_dir)
+
+
+# ----------------------------------------------------------- access log
+
+class TestAccessLog:
+    def test_one_json_line_per_request(self, data_dir, caplog):
+        import logging
+        with caplog.at_level(
+                logging.INFO, logger="omero_ms_image_region_tpu.access"):
+            [(status, _, body)] = _fetch(_device_config(data_dir),
+                                         ("GET", URL))
+        assert status == 200
+        lines = [r.message for r in caplog.records
+                 if r.name == "omero_ms_image_region_tpu.access"]
+        assert lines
+        doc = json.loads(lines[-1])
+        assert doc["route"] == "render_image_region"
+        assert doc["status"] == 200
+        assert doc["bytes"] == len(body)
+        assert doc["ms"] > 0
+        assert re.fullmatch(r"[0-9a-f]{16}", doc["trace"])
+        assert doc["cache"] in ("hit", "miss")
+        assert doc["render_ms"] is not None
+
+
+# ------------------------------------------------------ exposition lint
+
+_SERIES_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r" -?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?$")
+
+
+def _lint_exposition(text):
+    """Line-by-line Prometheus text-format check: valid series syntax,
+    a # TYPE for every family, no duplicate (name, labels)."""
+    typed = set()
+    seen = set()
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) == 4, line
+            assert parts[3] in ("counter", "gauge", "histogram",
+                                "summary", "untyped"), line
+            assert parts[2] not in typed, f"duplicate TYPE: {line}"
+            typed.add(parts[2])
+            continue
+        if line.startswith("#") or not line:
+            continue
+        m = _SERIES_RE.match(line)
+        assert m, f"malformed series line: {line!r}"
+        name = m.group(1)
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in typed:
+                family = name[:-len(suffix)]
+        assert family in typed, f"series without # TYPE: {line!r}"
+        key = (name, m.group(2) or "")
+        assert key not in seen, f"duplicate series: {line!r}"
+        seen.add(key)
+    assert typed and seen
+
+
+class TestExpositionLint:
+    def test_combined_app_metrics_parse(self, data_dir):
+        [(s1, _, _), (s2, _, body)] = _fetch(
+            _device_config(data_dir), ("GET", URL), ("GET", "/metrics"))
+        assert (s1, s2) == (200, 200)
+        text = body.decode()
+        _lint_exposition(text)
+        assert "imageregion_request_duration_ms_bucket" in text
+        assert "imageregion_batcher_queue_depth" in text
+        assert "imageregion_pipeline_inflight" in text
+        assert "imageregion_compile_events_total" in text
+        assert "imageregion_link_fetches_total" in text
+        # The JPEG render's wire fetch registered, so the link-health
+        # gauge is live (0.0 until a bandwidth-class fetch rates it).
+        assert "imageregion_link_mb_s" in text
+
+    def test_split_merged_metrics_parse(self, data_dir, tmp_path):
+        sock = str(tmp_path / "m.sock")
+
+        async def scenario():
+            task = asyncio.create_task(
+                run_sidecar(_device_config(data_dir), sock))
+            for _ in range(200):
+                if task.done():
+                    raise AssertionError(
+                        f"sidecar died: {task.exception()!r}")
+                if os.path.exists(sock):
+                    break
+                await asyncio.sleep(0.05)
+            app = create_app(AppConfig(
+                data_dir=data_dir,
+                sidecar=SidecarConfig(socket=sock, role="frontend")))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                assert r.status == 200
+                await r.read()
+                return await (await client.get("/metrics")).text()
+            finally:
+                await client.close()
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+        text = asyncio.run(scenario())
+        _lint_exposition(text)
+        assert 'process="sidecar"' in text
+        assert "imageregion_request_duration_ms_bucket" in text
+
+    def test_finalize_emits_one_type_per_family(self):
+        lines = [
+            "imageregion_cache_hits 1",
+            'imageregion_cache_hits{tier="1"} 2',
+            "made_up_metric 3",
+            "# a comment",
+        ]
+        text = telemetry.finalize_exposition(lines)
+        assert text.count("# TYPE imageregion_cache_hits counter") == 1
+        assert "# TYPE made_up_metric untyped" in text
+        assert "# a comment" in text
+
+
+# ----------------------------------------------------------- satellites
+
+class TestSatellites:
+    def test_prewarm_covers_intermediate_batch_shapes(self):
+        from omero_ms_image_region_tpu.server.batcher import \
+            _BATCH_SHAPES
+        from omero_ms_image_region_tpu.server.prewarm import \
+            prewarm_batch_sizes
+        sizes = prewarm_batch_sizes(8)
+        # Every launchable padded shape <= max_batch, including the
+        # non-power-of-two split shapes 3 and 6 (ADVICE #3).
+        assert sizes == tuple(s for s in _BATCH_SHAPES if s <= 8)
+        assert 3 in sizes and 6 in sizes
+        assert prewarm_batch_sizes(5) == (1, 2, 3, 4, 5)
+
+    def test_ngff_mtime_tracks_level_zarray(self, tmp_path):
+        from omero_ms_image_region_tpu.services.metadata import \
+            _ngff_meta_mtime
+        root = tmp_path / "img"
+        planes = np.zeros((1, 1, 1, 64, 64), np.uint16)   # t,c,z,y,x
+        from omero_ms_image_region_tpu.io.ngff import (find_ngff,
+                                                       write_ngff)
+        write_ngff(planes, str(root))
+        ngff = find_ngff(str(root))
+        assert ngff is not None
+        before = _ngff_meta_mtime(ngff)
+        # Rewrite the level-0 array metadata in place, root untouched.
+        level0 = os.path.join(ngff, "0", ".zarray")
+        assert os.path.exists(level0)
+        stamp = os.stat(level0).st_mtime_ns + 10**9
+        os.utime(level0, ns=(stamp, stamp))
+        assert _ngff_meta_mtime(ngff) != before
+
+    def test_link_health_conflated_is_lower_bound(self):
+        link = telemetry.LinkHealth()
+        mb = 1024 * 1024
+        link.observe(8 * mb, 1.0)                  # 8 MB/s measured
+        assert link.ewma_mb_s == pytest.approx(8.39, rel=0.01)
+        # A conflated slow sample proves nothing about the RAW link ->
+        # the floor holds...
+        link.observe(8 * mb, 100.0, conflated=True)
+        assert link.ewma_mb_s == pytest.approx(8.39, rel=0.01)
+        # ...but the EFFECTIVE rate tracks the slowdown requests feel.
+        assert link.effective_mb_s < link.ewma_mb_s
+        # A conflated FAST sample raises the floor.
+        link.observe(80 * mb, 1.0, conflated=True)
+        assert link.ewma_mb_s > 20.0
+        # Tiny fetches are latency-dominated: counted, not rated.
+        before = link.ewma_mb_s
+        link.observe(1024, 5.0)
+        assert link.ewma_mb_s == before
+        assert link.fetches == 4
+
+    def test_link_effective_tracks_conflated_only_slowdown(self):
+        """An all-conflated stream (the real serving pattern) must
+        still move the effective gauge DOWN when the wire degrades."""
+        link = telemetry.LinkHealth()
+        mb = 1024 * 1024
+        for _ in range(5):
+            link.observe(80 * mb, 1.0, conflated=True)   # 80 MB/s
+        fast = link.effective_mb_s
+        for _ in range(20):
+            link.observe(8 * mb, 1.0, conflated=True)    # now 8 MB/s
+        assert link.effective_mb_s < fast / 5
+        assert link.ewma_mb_s >= fast                    # floor holds

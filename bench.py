@@ -443,6 +443,8 @@ def bench_service_level(rng):
     from omero_ms_image_region_tpu.server.config import (
         AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
 
+    from omero_ms_image_region_tpu.services.cache import CacheConfig
+
     with tempfile.TemporaryDirectory() as tmp:
         planes = synthetic_wsi_tiles(rng, 4, 1, 4096, 4096).reshape(
             4, 1, 4096, 4096)
@@ -451,6 +453,12 @@ def bench_service_level(rng):
         for engine in ("sparse", "huffman"):
             config = AppConfig(
                 data_dir=tmp,
+                # Byte caches ON (the serving posture): the throughput
+                # window's k-varied requests never repeat a key, so the
+                # headline is unchanged, and the warm-repeat probe can
+                # prove the acceptance path (second identical request
+                # answers from the byte cache with no device span).
+                caches=CacheConfig.enabled_all(),
                 batcher=BatcherConfig(enabled=True, linger_ms=3.0),
                 raw_cache=RawCacheConfig(enabled=True, prefetch=False),
                 renderer=RendererConfig(cpu_fallback_max_px=0,
@@ -461,21 +469,26 @@ def bench_service_level(rng):
 
 
 async def _service_run(config, concurrency: int = 16,
-                       duration_s: float = 8.0):
+                       duration_s: float = 8.0, grid: int = 4,
+                       tile_edge: int = 1024, channels: int = 4,
+                       fmt: str = "jpeg"):
     import asyncio
 
     from aiohttp.test_utils import TestClient, TestServer
 
     from omero_ms_image_region_tpu.server.app import create_app
+    from omero_ms_image_region_tpu.utils.stopwatch import (
+        REGISTRY as _REG)
 
     app = create_app(config)
     client = TestClient(TestServer(app))
     await client.start_server()
     try:
         seq = 0
+        colors = ("FF0000", "00FF00", "0000FF", "FFFF00")
 
         def url(i, k):
-            x, y = i % 4, (i // 4) % 4
+            x, y = i % grid, (i // grid) % grid
             # k-varied windows: every request is a distinct render of
             # the SAME device-resident raw tile.  k comes from a shared
             # monotone counter (period 5000 — far beyond any realistic
@@ -483,14 +496,17 @@ async def _service_run(config, concurrency: int = 16,
             # repeats and a dispatch-memoizing relay can never serve a
             # cached device reply.
             w = 20000 + (k % 5000) * 9
+            chans = ",".join(
+                f"{c + 1}|0:{w - 1000 * c}${colors[c % len(colors)]}"
+                for c in range(channels))
             return (f"/webgateway/render_image_region/1/0/0"
-                    f"?tile=0,{x},{y},1024,1024&format=jpeg&m=c"
-                    f"&c=1|0:{w}$FF0000,2|0:{w - 1000}$00FF00,"
-                    f"3|0:{w - 2000}$0000FF,4|0:{w - 3000}$FFFF00")
+                    f"?tile=0,{x},{y},{tile_edge},{tile_edge}"
+                    f"&format={fmt}&m=c&c={chans}")
         # Warm: stage raw tiles into HBM + compile both grid shapes.
         resps = await asyncio.gather(
-            *(client.get(url(i, i)) for i in range(16)))
+            *(client.get(url(i, i)) for i in range(grid * grid)))
         assert all(r.status == 200 for r in resps)
+        snap0 = _REG.snapshot()
         t_stop = time.perf_counter() + duration_s
         done = 0
         failed = 0
@@ -527,12 +543,137 @@ async def _service_run(config, concurrency: int = 16,
         errors = [r for r in results if isinstance(r, BaseException)]
         if errors:
             raise errors[0]
-        tps = done / (time.perf_counter() - t0)
+        wall_s = time.perf_counter() - t0
+        tps = done / wall_s
         p50 = (statistics.median(latencies_ms) if latencies_ms
                else None)
-        return tps, p50
+        extras = await _hot_path_probes(app, client, url, seq,
+                                        _REG.snapshot(), snap0, wall_s)
+        return tps, p50, extras
     finally:
         await client.close()
+
+
+async def _hot_path_probes(app, client, url, seq, snap1, snap0,
+                           wall_s):
+    """Dedup / plane-cache / overlap probes run right after a service
+    window (same app instance, counters still live).
+
+    * ``overlap_efficiency`` — device-execute span coverage of the
+      window wall clock (exec_total_ms / wall_ms): 1.0 means the device
+      never idled behind the fetch/stage half of the two-stage group
+      pipeline; a regression back to serial fetch->render shows up as
+      this falling with tiles/s.
+    * ``dedup_hit_rate`` — of a burst of 8 concurrent IDENTICAL
+      requests, the fraction coalesced by the single-flight table.
+    * ``warm_repeat_cached`` — a repeated identical request answers
+      from the byte cache with ZERO new device dispatches (the
+      acceptance criterion's warm repeated-tile path).
+    * ``planecache_hits/misses`` — content-digest staging skips.
+    """
+    import asyncio
+
+    from omero_ms_image_region_tpu.server.app import SERVICES_KEY
+
+    def total_ms(snap, name):
+        return snap.get(name, {}).get("total_ms", 0.0)
+
+    exec_ms = (total_ms(snap1, "Renderer.renderAsPackedInt.batch")
+               - total_ms(snap0, "Renderer.renderAsPackedInt.batch"))
+    stage_ms = (total_ms(snap1, "batcher.stage")
+                - total_ms(snap0, "batcher.stage"))
+    extras = {
+        "overlap_efficiency": (round(exec_ms / (wall_s * 1000.0), 3)
+                               if wall_s > 0 else None),
+        "stage_ms_total": round(stage_ms, 1),
+        "exec_ms_total": round(exec_ms, 1),
+        "dedup_hit_rate": None,
+        "warm_repeat_cached": None,
+        "planecache_hits": None,
+        "planecache_misses": None,
+    }
+    services = app[SERVICES_KEY]
+    if services is None:
+        return extras
+    raw_cache = getattr(services, "raw_cache", None)
+    if raw_cache is not None and hasattr(raw_cache, "plane_hits"):
+        extras["planecache_hits"] = raw_cache.plane_hits
+        extras["planecache_misses"] = raw_cache.plane_misses
+    single_flight = getattr(services, "single_flight", None)
+    renderer = services.renderer
+    # Concurrent-identical burst: one render identity, 8 in flight.
+    burst_url = url(0, seq + 2500)
+    burst = 8
+    hits0 = single_flight.hits if single_flight is not None else 0
+    resps = await asyncio.gather(*(client.get(burst_url)
+                                   for _ in range(burst)))
+    bodies = [await r.read() for r in resps]
+    if all(r.status == 200 for r in resps) and len(set(bodies)) == 1:
+        if single_flight is not None:
+            extras["dedup_hit_rate"] = round(
+                (single_flight.hits - hits0) / burst, 3)
+        # Warm repeat: the identical request again, now byte-cached —
+        # zero new device dispatches proves no wire/device span ran.
+        dispatched0 = getattr(renderer, "batches_dispatched", None)
+        r = await client.get(burst_url)
+        body = await r.read()
+        extras["warm_repeat_cached"] = bool(
+            r.status == 200 and body == bodies[0]
+            and (dispatched0 is None
+                 or renderer.batches_dispatched == dispatched0))
+    return extras
+
+
+def bench_smoke(duration_s: float = 1.5):
+    """Hot-path regression gate at smoke scale: CPU, small shapes, <60 s.
+
+    The FULL app — routes, ctx parsing, byte caches, single-flight
+    dedup, two-stage batcher pipeline, device plane cache — over a
+    small synthetic pyramid (2-channel 512^2, 256^2 png tiles, so
+    compiles stay in the seconds on the host platform).  Prints ONE
+    JSON line mirroring the service-level keys; wired into tier-1
+    (tests/test_bench_smoke.py) so a cache or pipeline regression fails
+    tests instead of waiting for the next BENCH round.
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
+    from omero_ms_image_region_tpu.services.cache import CacheConfig
+
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(7)
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 2, 1, 512, 512).reshape(
+            2, 1, 512, 512)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        config = AppConfig(
+            data_dir=tmp,
+            caches=CacheConfig.enabled_all(),
+            batcher=BatcherConfig(enabled=True, linger_ms=2.0),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        tps, p50, extras = asyncio.run(_service_run(
+            config, concurrency=4, duration_s=duration_s, grid=2,
+            tile_edge=256, channels=2, fmt="png"))
+    out = {
+        "metric": "smoke_hotpath_tiles_per_sec",
+        "value": round(tps, 2),
+        "unit": "tiles/s",
+        "p50_ms": _opt_round(p50, 2),
+        "dedup_hit_rate": extras.get("dedup_hit_rate"),
+        "warm_repeat_cached": extras.get("warm_repeat_cached"),
+        "overlap_efficiency": extras.get("overlap_efficiency"),
+        "planecache_hits": extras.get("planecache_hits"),
+        "planecache_misses": extras.get("planecache_misses"),
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    }
+    print(json.dumps(out))
+    return out
 
 
 # -------------------------------------------------------------- config 1
@@ -797,6 +938,11 @@ def bench_config5(rng):
 
 
 def main():
+    # --smoke: the CPU-fast hot-path gate (also a tier-1 test); no
+    # device, no multi-minute windows, one JSON line.
+    if "--smoke" in sys.argv[1:]:
+        bench_smoke()
+        return
     # Fresh entropy per run: the tunnel relay memoizes content-identical
     # transfers and dispatches, so a fixed seed would let repeat bench
     # runs serve cached uploads/replies and overstate the link.  The
@@ -824,8 +970,8 @@ def main():
     flag = retry_transient(lambda: bench_flagship(rng), "bench_flagship",
                            backoff_s=15.0)
     _WATERFALL_SPANS = (
-        "batcher.queueWait", "batcher.groupTiles", "wire.fetch",
-        "wire.fetch2", "jfif.encodeBatch",
+        "batcher.queueWait", "batcher.groupTiles", "batcher.stage",
+        "wire.fetch", "wire.fetch2", "jfif.encodeBatch",
         "Renderer.renderAsPackedInt.batch")
     try:
         # Fixed sampling policy: ALWAYS two windows, best-of-2 per
@@ -855,12 +1001,17 @@ def main():
         # p50 request latency from the window that carried the headline
         # (closed-loop, 16-way concurrency — the number a user feels).
         service_p50_ms = None
+        service_hot_path = {}
         if service_engines:
             best_eng = max(service_engines, key=service_engines.get)
             best_i = max(range(len(windows)),
                          key=lambda i: windows[i].get(best_eng,
                                                       (0.0, None))[0])
             service_p50_ms = windows[best_i][best_eng][1]
+            # Dedup / plane-cache / pipeline-overlap probes from the
+            # headline window (so the next BENCH round can falsify the
+            # hot-path win mechanically).
+            service_hot_path = windows[best_i][best_eng][2] or {}
         # The stage waterfall across the service windows: where a tile's
         # wall time goes between the HTTP socket and the JPEG bytes.
         service_waterfall = {
@@ -883,6 +1034,7 @@ def main():
         service_windows, service_waterfall = {}, {}
         service_p50_ms = None
         service_fetch_mb_s = None
+        service_hot_path = {}
     c1_tpu, c1_cpu = retry_transient(
         lambda: bench_config1(rng), "bench_config1", backoff_s=15.0)
     c2_planes, c2_cpu = retry_transient(
@@ -951,6 +1103,21 @@ def main():
         # 68 ms regression class cannot pass silently.
         "p50_ex_rtt_target_met": bool(
             flag["p50_tile_ms_ex_rtt"] < 50.0),
+        # Hot-path probes from the headline window: single-flight
+        # coalescing of a concurrent-identical burst, byte-cache warm
+        # repeat (no device span), content-digest staging skips, and
+        # device-execute coverage of the wall clock (1.0 = the device
+        # never idled behind the fetch/stage half).
+        "service_dedup_hit_rate": service_hot_path.get(
+            "dedup_hit_rate"),
+        "service_warm_repeat_cached": service_hot_path.get(
+            "warm_repeat_cached"),
+        "service_overlap_efficiency": service_hot_path.get(
+            "overlap_efficiency"),
+        "service_planecache_hits": service_hot_path.get(
+            "planecache_hits"),
+        "service_planecache_misses": service_hot_path.get(
+            "planecache_misses"),
         # Stage waterfall over the service windows (span -> count,
         # mean, p50 ms): queue wait, device batch, wire fetch (+second
         # fetches), host entropy/framing.

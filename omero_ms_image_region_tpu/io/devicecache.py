@@ -12,13 +12,42 @@ Keyed by (image, z, t, level, region, channels); bounded by device bytes
 with LRU eviction (dropping the reference frees the HBM buffer).  Raw
 planes stay in their storage dtype (uint16 halves HBM vs float32); the
 render kernels cast on device.
+
+Content addressing: with ``digest_index`` on (the default), every host
+plane stack staged through :meth:`DeviceRawCache.get_or_load` is also
+indexed by its content digest (:func:`plane_digest`).  A plane whose
+bytes are already resident — under ANY key: a wire-pushed
+``("plane", digest)`` entry, or the same content read for a different
+region identity — is never re-shipped over the host->device link; the
+new key aliases the resident buffer.  This is what backs the sidecar's
+digest-first wire protocol (``server.sidecar``: probe by digest, upload
+only on miss).
 """
 
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Callable, Hashable, Tuple
+from typing import Callable, Dict, Hashable, Optional, Set, Tuple
+
+
+def plane_digest(arr) -> str:
+    """Content address of a host plane stack: dtype + shape + bytes.
+
+    BLAKE2b-128 — collision-safe at cache scale and ~GB/s on host, so
+    digesting an 8 MB tile costs ~ms against the 100s-of-ms its upload
+    costs on a thin link.
+    """
+    import hashlib
+
+    import numpy as np
+
+    a = np.ascontiguousarray(arr)
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(a.dtype).encode())
+    h.update(",".join(str(s) for s in a.shape).encode())
+    h.update(memoryview(a).cast("B"))
+    return h.hexdigest()
 
 
 class DeviceRawCache:
@@ -31,15 +60,94 @@ class DeviceRawCache:
     is correct for immutable pixel data.
     """
 
-    def __init__(self, max_bytes: int = 2 * 1024 * 1024 * 1024):
+    def __init__(self, max_bytes: int = 2 * 1024 * 1024 * 1024,
+                 digest_index: bool = True):
         self.max_bytes = max_bytes
+        self.digest_index = digest_index
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Hashable, object]" = OrderedDict()
+        # Content-digest index: digest -> the keys whose entries hold
+        # that content (aliases share ONE device buffer).
+        self._digests_of: Dict[Hashable, str] = {}
+        self._keys_by_digest: Dict[str, Set[Hashable]] = {}
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+        # Uploads skipped (served) / paid because of the content digest.
+        self.plane_hits = 0
+        self.plane_misses = 0
 
-    def get_or_load(self, key: Hashable, loader: Callable):
+    # ------------------------------------------------------------ digest
+
+    def get_by_digest(self, digest: str, bump: bool = True):
+        """Device buffer holding this content under any key; None when
+        the content is not resident.  ``bump=False`` skips the LRU
+        touch (the internal alias lookup: the NEW key gets its own LRU
+        position, and the alias source's age must stay its own)."""
+        with self._lock:
+            for key in self._keys_by_digest.get(digest, ()):
+                arr = self._entries.get(key)
+                if arr is not None:
+                    if bump:
+                        self._entries.move_to_end(key)
+                    return arr
+        return None
+
+    def count_plane(self, hit: bool) -> None:
+        """Lock-protected plane-counter bump — every mutation of the
+        hit/miss counters goes through the lock (worker threads race
+        these), including the external staging helper
+        (``io.staging.stage_deduped``)."""
+        with self._lock:
+            if hit:
+                self.plane_hits += 1
+            else:
+                self.plane_misses += 1
+
+    def resident_digest(self, digest: str, count: bool = True) -> bool:
+        """Digest-probe residency (the sidecar wire's ``plane_probe``
+        answer).  ``count`` feeds the plane-cache HIT counter only — a
+        probe hit is an upload that never happens.  A probe miss is NOT
+        counted here: the upload that follows lands in
+        :meth:`get_or_load`, which records the one miss, so one actual
+        upload is exactly one ``plane_misses`` increment."""
+        with self._lock:
+            resident = bool(self._keys_by_digest.get(digest))
+            if count and resident:
+                self.plane_hits += 1
+            return resident
+
+    def _index_digest(self, key: Hashable, digest: Optional[str]) -> None:
+        """Record key->digest under the lock (caller holds it)."""
+        if digest is None:
+            return
+        self._digests_of[key] = digest
+        self._keys_by_digest.setdefault(digest, set()).add(key)
+
+    def _drop_digest(self, key: Hashable) -> None:
+        digest = self._digests_of.pop(key, None)
+        if digest is None:
+            return
+        keys = self._keys_by_digest.get(digest)
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                del self._keys_by_digest[digest]
+
+    def _release_bytes(self, key: Hashable, arr) -> None:
+        """Remove a key's accounting (lock held).  Digest aliases share
+        ONE device buffer, so its bytes leave the budget only when the
+        LAST key referencing that content goes."""
+        digest = self._digests_of.get(key)
+        self._drop_digest(key)
+        if digest is None or not self._keys_by_digest.get(digest):
+            self._bytes -= arr.nbytes
+
+    # ------------------------------------------------------------- loads
+
+    def get_or_load(self, key: Hashable, loader: Callable,
+                    digest: Optional[str] = None):
         with self._lock:
             arr = self._entries.get(key)
             if arr is not None:
@@ -50,24 +158,59 @@ class DeviceRawCache:
         import jax
         import numpy as np
         loaded = loader()
+        arr = None
         if isinstance(loaded, np.ndarray):
-            # Host ndarray miss: packed staging ships ~1.4x fewer wire
-            # bytes for uint16 pixel content (io.staging.stage falls
-            # back to a plain transfer when packing doesn't pay).
-            from .staging import stage
-            arr = stage(loaded)
+            if self.digest_index:
+                # Content-addressed staging skip: bytes already resident
+                # under another key (a wire-pushed plane, or the same
+                # content at a different region identity) alias the
+                # resident buffer — zero host->device bytes.
+                digest = digest or plane_digest(loaded)
+                arr = self.get_by_digest(digest, bump=False)
+                self.count_plane(hit=arr is not None)
+            if arr is None:
+                # Host ndarray miss: packed staging ships ~1.4x fewer
+                # wire bytes for uint16 pixel content (io.staging.stage
+                # falls back to a plain transfer when packing doesn't
+                # pay).
+                from .staging import stage
+                arr = stage(loaded)
         else:
-            # Already device-resident (banded staging path).
+            # Already device-resident (banded staging path); content
+            # digests are host-side only, so these entries carry none.
             arr = jax.device_put(loaded)
+            digest = None
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
-                self._bytes -= old.nbytes
+                self._release_bytes(key, old)
+            digest = digest if self.digest_index else None
+            if digest is not None:
+                # Re-probe under the lock: a racing miss for the SAME
+                # content may have landed since the pre-stage check.
+                # Adopt its buffer (dropping the one this thread just
+                # staged) so digest aliases always share one HBM
+                # allocation and the byte charge stays buffer-accurate
+                # — without this, two live buffers would carry one
+                # budget charge and max_bytes would no longer bound
+                # real device memory.
+                for k in self._keys_by_digest.get(digest, ()):
+                    existing = self._entries.get(k)
+                    if existing is not None:
+                        arr = existing
+                        break
             self._entries[key] = arr
-            self._bytes += arr.nbytes
+            # Aliases share one device buffer: its bytes enter the
+            # budget once, with the digest's FIRST key — so effective
+            # capacity GROWS with dedup instead of shrinking under
+            # double counting.
+            if digest is None or not self._keys_by_digest.get(digest):
+                self._bytes += arr.nbytes
+            self._index_digest(key, digest)
             while self._bytes > self.max_bytes and len(self._entries) > 1:
-                _, evicted = self._entries.popitem(last=False)
-                self._bytes -= evicted.nbytes
+                evicted_key, evicted = self._entries.popitem(last=False)
+                self._release_bytes(evicted_key, evicted)
+                self.evictions += 1
         return arr
 
     def get(self, key: Hashable):
@@ -99,3 +242,8 @@ def region_key(image_id: int, z: int, t: int, level: int,
     """The raw-read identity: everything the pixel data depends on and
     nothing the rendering settings touch."""
     return (image_id, z, t, level, region, channels)
+
+
+def plane_key(digest: str) -> tuple:
+    """Cache key of a content-addressed (wire-pushed) plane entry."""
+    return ("plane", digest)

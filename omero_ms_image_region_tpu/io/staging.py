@@ -153,6 +153,35 @@ def stage(arr: np.ndarray, min_ratio: float = 1.1):
                            jax.device_put(widths), arr.shape)
 
 
+def stage_deduped(arr: np.ndarray, cache, digest: str = None):
+    """Digest-first staging: skip the upload when the content is already
+    device-resident.
+
+    ``cache`` is an ``io.devicecache.DeviceRawCache`` with its digest
+    index on.  Returns ``(device_array, digest, was_resident)``:
+    ``was_resident`` True means zero bytes crossed the host->device link
+    (the plane was found under some key — a prior wire push, or the same
+    content staged for another region identity).  On a miss the plane
+    stages through :func:`stage` (packed when it pays) and is recorded
+    under its content key, so the NEXT identical push — from any
+    frontend, for any region identity — skips the wire.
+
+    This is the server half of the sidecar's digest-first plane
+    protocol (``server.sidecar``: ``plane_probe`` then ``plane_put``
+    only on miss), and the in-process staging skip for everything else.
+    """
+    from .devicecache import plane_digest, plane_key
+
+    digest = digest or plane_digest(arr)
+    resident = cache.get_by_digest(digest)
+    if resident is not None:
+        cache.count_plane(hit=True)
+        return resident, digest, True
+    staged = cache.get_or_load(plane_key(digest), lambda: arr,
+                               digest=digest)
+    return staged, digest, False
+
+
 def stage_ratio(arr: np.ndarray) -> float:
     """Diagnostic: packed/raw byte ratio for ``arr`` (1.0 = raw)."""
     words, widths = pack16_host(arr)

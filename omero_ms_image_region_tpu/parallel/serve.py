@@ -186,7 +186,8 @@ class MeshRenderer(BatchingRenderer):
     def __init__(self, mesh: Mesh, max_batch: int | None = None,
                  linger_ms: float = 2.0, buckets=None,
                  jpeg_engine: str = "sparse", pipeline_depth: int = 4,
-                 max_batch_limit: int = None, engine_controller=None):
+                 max_batch_limit: int = None, engine_controller=None,
+                 device_lanes: int = 2):
         data = mesh.shape["data"]
         if max_batch is None:
             max_batch = max(8, 2 * data)
@@ -204,10 +205,15 @@ class MeshRenderer(BatchingRenderer):
                            "(was %d) — sharded launches must not "
                            "overlap", pipeline_depth)
             pipeline_depth = 1
+        if multihost:
+            # The two-stage fetch/execute split likewise must not let
+            # two groups' sharded launches race a host-local gate order.
+            device_lanes = 1
         kwargs = {} if buckets is None else {"buckets": buckets}
         super().__init__(max_batch=max_batch, linger_ms=linger_ms,
                          pipeline_depth=pipeline_depth,
-                         max_batch_limit=max_batch_limit, **kwargs)
+                         max_batch_limit=max_batch_limit,
+                         device_lanes=device_lanes, **kwargs)
         if multihost:
             # One launch slot shared across ALL bucket keys: without it,
             # two keys' dispatchers would interleave sharded launches in
@@ -298,11 +304,17 @@ class MeshRenderer(BatchingRenderer):
 
     def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:
         n = len(group)
-        raw, stacked = self._stacked(group)
-        if self._pod is not None:
-            self._pod.announce(_POD_RENDER, raw, stacked)
-        with stopwatch("Renderer.renderAsPackedInt.mesh"):
-            host = self._render_wire(raw, stacked)
+        # Fetch/stage half outside the device gate: group N+1 stacks
+        # and pads while group N executes.  The pod announce stays
+        # INSIDE the gate so announce order always equals launch order
+        # (single-lane on multi-host).
+        with stopwatch("batcher.stage"):
+            raw, stacked = self._stacked(group)
+        with self._device_gate:
+            if self._pod is not None:
+                self._pod.announce(_POD_RENDER, raw, stacked)
+            with stopwatch("Renderer.renderAsPackedInt.mesh"):
+                host = self._render_wire(raw, stacked)
         self._count_batch(n)
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
 
@@ -402,21 +414,23 @@ class MeshRenderer(BatchingRenderer):
 
         n = len(group)
         REGISTRY.record("batcher.groupTiles", float(n))
-        raw, stacked = self._stacked(group)
+        with stopwatch("batcher.stage"):
+            raw, stacked = self._stacked(group)
         H, W = raw.shape[-2:]
         quality = group[0].quality
         all_exact = all((p.h + 15) // 16 * 16 == H
                         and (p.w + 15) // 16 * 16 == W for p in group)
         engine = self._jpeg_engine_for(all_exact)
-        if self._pod is not None:
-            self._pod.announce(_POD_JPEG, raw, stacked, quality,
-                               engine_id=1 if engine == "huffman" else 0)
         qy, qc = (np.asarray(t, np.int32) for t in quant_tables(quality))
         dims = [(p.w, p.h) for p in group]
         if engine == "huffman":
-            with stopwatch("Renderer.renderAsPackedInt.mesh"):
-                bufs, cap, cap_words = self._huffman_wire(
-                    raw, stacked, H, W, quality)
+            with self._device_gate:
+                if self._pod is not None:
+                    self._pod.announce(_POD_JPEG, raw, stacked, quality,
+                                       engine_id=1)
+                with stopwatch("Renderer.renderAsPackedInt.mesh"):
+                    bufs, cap, cap_words = self._huffman_wire(
+                        raw, stacked, H, W, quality)
             _dense_encode = dense_encoder()
 
             def dense_tile(i):
@@ -430,9 +444,13 @@ class MeshRenderer(BatchingRenderer):
                 bufs, dims, H, W, quality, cap, cap_words,
                 dense_fallback=dense_tile)
         else:
-            with stopwatch("Renderer.renderAsPackedInt.mesh"):
-                bufs, cap = self._sparse_wire(raw, stacked, H, W,
-                                              quality)
+            with self._device_gate:
+                if self._pod is not None:
+                    self._pod.announce(_POD_JPEG, raw, stacked, quality,
+                                       engine_id=0)
+                with stopwatch("Renderer.renderAsPackedInt.mesh"):
+                    bufs, cap = self._sparse_wire(raw, stacked, H, W,
+                                                  quality)
             jpegs = finish_sparse_to_jpegs(
                 bufs, dims, H, W, quality, cap,
                 lambda i: self._dense_coefficients(raw, stacked, qy,

@@ -178,7 +178,8 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
             linger_ms=config.batcher.linger_ms,
             jpeg_engine=engine,
             pipeline_depth=config.batcher.pipeline_depth,
-            engine_controller=mesh_controller)
+            engine_controller=mesh_controller,
+            device_lanes=config.batcher.device_lanes)
     elif config.batcher.enabled:
         # config validation rejects bitpack in this posture.
         engine = config.renderer.jpeg_engine
@@ -208,7 +209,8 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
             jpeg_engine=engine,
             pipeline_depth=config.batcher.pipeline_depth,
             engine_controller=controller,
-            target_inflight=config.batcher.target_inflight)
+            target_inflight=config.batcher.target_inflight,
+            device_lanes=config.batcher.device_lanes)
     else:
         engine = config.renderer.jpeg_engine
         if engine == "auto":
@@ -235,10 +237,19 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         max_tile_length=config.max_tile_length,
         cpu_fallback_max_px=config.renderer.cpu_fallback_max_px,
         # HBM-resident raw tile tier: settings changes re-render hot
-        # tiles without re-crossing the host link.
-        raw_cache=(DeviceRawCache(config.raw_cache.max_bytes)
-                   if config.raw_cache.enabled else None),
+        # tiles without re-crossing the host link.  The digest index
+        # makes it content-addressed: planes resident under any key
+        # (wire pushes included) are never re-shipped.
+        raw_cache=(DeviceRawCache(
+            config.raw_cache.max_bytes,
+            digest_index=config.raw_cache.digest_dedup)
+            if config.raw_cache.enabled else None),
     )
+    if config.single_flight:
+        # In-flight render dedup: concurrent identical requests
+        # coalesce onto one pipeline run (server.handler.SingleFlight).
+        from .handler import SingleFlight
+        services.single_flight = SingleFlight()
     if services.raw_cache is not None and config.raw_cache.prefetch:
         from ..services.prefetch import TilePrefetcher
         services.prefetcher = TilePrefetcher(services.raw_cache)

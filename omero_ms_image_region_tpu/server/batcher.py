@@ -93,13 +93,16 @@ class BatchingRenderer:
     def __init__(self, max_batch: int = 8, linger_ms: float = 2.0,
                  buckets=DEFAULT_BUCKETS, jpeg_engine: str = "sparse",
                  pipeline_depth: int = 4, max_batch_limit: int = None,
-                 engine_controller=None, target_inflight: int = 1):
+                 engine_controller=None, target_inflight: int = 1,
+                 device_lanes: int = 2):
         if jpeg_engine not in ("sparse", "huffman"):
             raise ValueError(
                 f"batched jpeg engine must be 'sparse' or 'huffman', "
                 f"got {jpeg_engine!r}")
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
+        if device_lanes < 1:
+            raise ValueError("device_lanes must be >= 1")
         self.max_batch = max_batch
         # Queue-pressure growth ceiling: default 2x the configured
         # size.  Measured on-chip (1024d 4-ch, v5e): both wire engines
@@ -146,6 +149,18 @@ class BatchingRenderer:
         self._stats_lock = threading.Lock()
         self.batches_dispatched = 0
         self.tiles_rendered = 0
+        # Two-stage group pipeline: each group render splits into a
+        # fetch/stage half (stacking + host->device upload, run by any
+        # of the pipeline_depth worker threads) and a device-execute
+        # half gated by this bounded semaphore — the bounded queue
+        # between the stages.  Default 2 (double-buffered): group N+1's
+        # upload overlaps group N's execute, while at most two groups
+        # contend for the device itself.
+        self.device_lanes = device_lanes
+        self._device_gate = threading.BoundedSemaphore(device_lanes)
+        # High-water queue wait (ms) for the /metrics gauge — the
+        # stragglers a mean hides and a p50 cannot see.
+        self.queue_wait_max_ms = 0.0
 
     def _count_batch(self, tiles: int) -> None:
         """Metrics update; group renders run concurrently on worker
@@ -163,13 +178,21 @@ class BatchingRenderer:
         """Group renders currently occupying pipeline slots."""
         return len(self._inflight)
 
-    @staticmethod
-    def _record_queue_waits(group: List[_Pending], now: float) -> None:
-        """Per-request queue-wait spans: aggregate histogram via the
-        registry plus each member's own waterfall entry."""
+    def _record_queue_waits(self, group: List[_Pending],
+                            now: float) -> None:
+        """Per-request queue-wait spans, recorded ONCE per pending at
+        the moment its group is popped for dispatch — never re-sampled
+        later in the group's life, so the aggregate mean is exactly
+        "how long did requests wait to be dispatched" and a few
+        stragglers cannot re-enter the series.  The high-water mark
+        feeds the imageregion_batcher_queue_wait_max_ms gauge
+        (stragglers invisible at p50 — and diluted in a mean — stay
+        visible there)."""
         for p in group:
             wait_ms = (now - p.t_enqueue) * 1000.0
             REGISTRY.record("batcher.queueWait", wait_ms)
+            if wait_ms > self.queue_wait_max_ms:
+                self.queue_wait_max_ms = wait_ms
             if p.trace_id:
                 telemetry.record_span(
                     "batcher.queueWait", p.t_enqueue, wait_ms,
@@ -273,10 +296,13 @@ class BatchingRenderer:
         """Drain the key's queue into group renders.
 
         Up to ``pipeline_depth`` group renders run concurrently (each on
-        its own worker thread): group k+1's device dispatch overlaps
-        group k's wire fetch and host entropy encode — the render
-        functions release the GIL in those stages — so the device never
-        idles behind host work under sustained load.
+        its own worker thread), and each render is itself two stages —
+        fetch/stage (stack + host->device upload) then device-execute —
+        connected by the bounded ``device_lanes`` gate.  Group k+1's
+        upload and group k's wire fetch / host entropy encode overlap
+        group k's device execute (the render functions release the GIL
+        in those stages), so the device never idles behind host or wire
+        work under sustained load.
         """
         # The loop task was created from some request's context; detach
         # so dispatcher-side spans never attach to that one waterfall.
@@ -321,6 +347,10 @@ class BatchingRenderer:
                     self._full_streaks[key] = streak
                 else:
                     self._full_streaks[key] = 0
+            # Dispatch time IS the end of the queue wait: record here,
+            # synchronously at pop (not when the group task happens to
+            # run), once per pending.
+            self._record_queue_waits(group, time.perf_counter())
             render = (self._render_group_jpeg if key[0] == "jpeg"
                       else self._render_group)
             task = asyncio.create_task(
@@ -361,7 +391,6 @@ class BatchingRenderer:
         the HTTP layer's ``except Exception`` mapping and drop the
         connection without a response.
         """
-        self._record_queue_waits(group, time.perf_counter())
         if self._transient_retry_enabled:
             from ..utils.transient import retry_transient
             # Short backoff: the slot (and every request in the group)
@@ -423,17 +452,33 @@ class BatchingRenderer:
 
         return raw, stack
 
+    def _stage_group(self, group: List[_Pending]):
+        """Fetch/stage half of a group render: stack the batch and ship
+        it to the device BEFORE a device lane is taken, so group N+1's
+        wire upload overlaps group N's device execute instead of
+        running serially behind it.  Host stacks go through the packed
+        stager (uint16 content crosses the link ~1.4x smaller); batches
+        with device-resident members are already staged."""
+        with stopwatch("batcher.stage"):
+            raw, stack = self._group_arrays(group)
+            if isinstance(raw, np.ndarray):
+                from ..io.staging import stage
+                raw = stage(raw)
+        return raw, stack
+
     def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:
         n = len(group)
-        raw, stack = self._group_arrays(group)
+        raw, stack = self._stage_group(group)
         s0 = group[0].settings
-        with stopwatch("Renderer.renderAsPackedInt.batch"):
-            out = render_tile_batch_packed(
-                raw, stack("window_start"), stack("window_end"),
-                stack("family"), stack("coefficient"), stack("reverse"),
-                s0["cd_start"], s0["cd_end"], stack("tables"),
-            )
-            host = np.asarray(out)
+        with self._device_gate:
+            with stopwatch("Renderer.renderAsPackedInt.batch"):
+                out = render_tile_batch_packed(
+                    raw, stack("window_start"), stack("window_end"),
+                    stack("family"), stack("coefficient"),
+                    stack("reverse"),
+                    s0["cd_start"], s0["cd_end"], stack("tables"),
+                )
+                host = np.asarray(out)
         self._count_batch(n)
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
 
@@ -449,16 +494,18 @@ class BatchingRenderer:
 
         n = len(group)
         REGISTRY.record("batcher.groupTiles", float(n))
-        raw, stack = self._group_arrays(group)
+        raw, stack = self._stage_group(group)
         s0 = group[0].settings
-        with stopwatch("Renderer.renderAsPackedInt.batch"):
-            jpegs = render_batch_to_jpeg(
-                raw, stack("window_start"), stack("window_end"),
-                stack("family"), stack("coefficient"), stack("reverse"),
-                s0["cd_start"], s0["cd_end"], stack("tables"),
-                quality=group[0].quality,
-                dims=[(p.w, p.h) for p in group],  # pad tiles skip encode
-                engine=self._current_engine(),
-            )
+        with self._device_gate:
+            with stopwatch("Renderer.renderAsPackedInt.batch"):
+                jpegs = render_batch_to_jpeg(
+                    raw, stack("window_start"), stack("window_end"),
+                    stack("family"), stack("coefficient"),
+                    stack("reverse"),
+                    s0["cd_start"], s0["cd_end"], stack("tables"),
+                    quality=group[0].quality,
+                    dims=[(p.w, p.h) for p in group],  # pads skip encode
+                    engine=self._current_engine(),
+                )
         self._count_batch(n)
         return jpegs

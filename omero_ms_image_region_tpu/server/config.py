@@ -44,6 +44,14 @@ class BatcherConfig:
     # the extra RTT hiding.  Kept as a knob for low-RTT deployments.
     # Single-host only; multi-host meshes always pop max_batch.
     target_inflight: int = 1
+    # Bounded device-execute stage of the two-stage group pipeline:
+    # each group render splits into fetch/stage (stack + host->device
+    # upload) and device-execute halves, and at most this many groups
+    # occupy the execute stage at once.  Default 2 (double-buffered):
+    # group N+1's upload overlaps group N's execute without letting
+    # every pipeline_depth group pile onto the device.  Multi-host
+    # meshes force 1 (SPMD launch order).
+    device_lanes: int = 2
 
 
 @dataclass
@@ -53,6 +61,12 @@ class RawCacheConfig:
     enabled: bool = True
     max_bytes: int = 2 * 1024 * 1024 * 1024
     prefetch: bool = True              # pan-ahead neighbor staging
+    # Content-digest index over the cache: planes whose bytes are
+    # already HBM-resident (under any key — wire pushes included) are
+    # never re-shipped over the host->device link, and the sidecar
+    # answers digest probes (wire protocol v2) from it.  Costs one
+    # BLAKE2b pass per cold host read (~ms per 8 MB tile).
+    digest_dedup: bool = True
 
 
 @dataclass
@@ -209,6 +223,12 @@ class AppConfig:
     # reaches over the bus — ImageRegionRequestHandler.java:316-427).
     metadata_backend: str = "local"
     metadata_dsn: Optional[str] = None
+    # In-flight render dedup (server.handler.SingleFlight): concurrent
+    # identical requests coalesce onto one pipeline run instead of each
+    # paying the full read/stage/render/encode.  Off only for A/B
+    # measurement — coalescing is semantics-free (ACL still runs per
+    # caller; followers get the exact bytes the byte cache would).
+    single_flight: bool = True
     caches: CacheConfig = field(default_factory=CacheConfig)
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
     raw_cache: RawCacheConfig = field(default_factory=RawCacheConfig)
@@ -304,17 +324,33 @@ class AppConfig:
                                            defaults.pipeline_depth)),
             target_inflight=int(batcher.get("target-inflight",
                                             defaults.target_inflight)),
+            device_lanes=int(batcher.get("device-lanes",
+                                         defaults.device_lanes)),
         )
         if cfg.batcher.pipeline_depth < 1:
             raise ValueError("batcher.pipeline-depth must be >= 1")
         if cfg.batcher.target_inflight < 1:
             raise ValueError("batcher.target-inflight must be >= 1")
+        if cfg.batcher.device_lanes < 1:
+            raise ValueError("batcher.device-lanes must be >= 1")
+        # An EMPTY "single-flight:" section (all children commented
+        # out, the standard pattern in the example config) parses as
+        # YAML null and must keep the default — only an explicit value
+        # changes it.
+        sf = raw.get("single-flight")
+        if isinstance(sf, dict):
+            cfg.single_flight = bool(sf.get("enabled",
+                                            cfg.single_flight))
+        elif sf is not None:
+            cfg.single_flight = bool(sf)
         rc = raw.get("raw-cache", {}) or {}
         rc_defaults = RawCacheConfig()
         cfg.raw_cache = RawCacheConfig(
             enabled=bool(rc.get("enabled", rc_defaults.enabled)),
             max_bytes=int(rc.get("max-bytes", rc_defaults.max_bytes)),
             prefetch=bool(rc.get("prefetch", rc_defaults.prefetch)),
+            digest_dedup=bool(rc.get("digest-dedup",
+                                     rc_defaults.digest_dedup)),
         )
         sc = raw.get("sidecar", {}) or {}
         sc_defaults = SidecarConfig()

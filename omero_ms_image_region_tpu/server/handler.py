@@ -38,7 +38,7 @@ from ..utils.color import split_html_color
 from ..utils.stopwatch import stopwatch
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
 from .region import RegionDef, clamp_region_to_plane, get_region_def
-from .settings import update_settings
+from .settings import render_identity_key, update_settings
 
 logger = logging.getLogger(__name__)
 
@@ -170,6 +170,60 @@ class Renderer:
             engine=engine)[0]
 
 
+class SingleFlight:
+    """In-flight render dedup: concurrent requests for one canonical
+    render identity (``settings.render_identity_key``) coalesce onto a
+    single pending task — today every duplicate pays the full pipeline
+    (read, stage, device render, encode) because the byte cache only
+    answers AFTER the first completes.
+
+    Event-loop confined: all bookkeeping runs on the loop thread, so no
+    lock.  Followers await the leader's task through ``asyncio.shield``,
+    which pins the cancellation contract: a waiter's disconnect (aiohttp
+    cancels its handler) never cancels the shared render the other
+    waiters — or the byte-cache write-back — depend on; the task runs to
+    completion even if EVERY waiter disconnects, so the next identical
+    request hits the byte cache instead of re-rendering.
+    """
+
+    def __init__(self):
+        self._inflight: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    def inflight(self) -> int:
+        """Pending coalescable renders (the /metrics gauge)."""
+        return len(self._inflight)
+
+    async def run(self, key: str, producer):
+        """``(result, coalesced)`` — ``producer()`` runs at most once
+        per key at a time; followers share the leader's outcome
+        (result OR exception)."""
+        task = self._inflight.get(key)
+        if (task is not None
+                and task.get_loop() is not asyncio.get_running_loop()):
+            # A stale entry from another (closed) event loop — test
+            # harnesses run one loop per call — must not strand this
+            # loop's requests behind a task that can never complete.
+            self._inflight.pop(key, None)
+            task = None
+        coalesced = task is not None
+        if task is None:
+            self.misses += 1
+            task = asyncio.ensure_future(producer())
+            self._inflight[key] = task
+
+            def _cleanup(t, key=key):
+                if self._inflight.get(key) is t:
+                    self._inflight.pop(key, None)
+                if not t.cancelled():
+                    t.exception()   # retrieved even with no waiters left
+            task.add_done_callback(_cleanup)
+        else:
+            self.hits += 1
+        return await asyncio.shield(task), coalesced
+
+
 @dataclass
 class ImageRegionServices:
     """Everything a handler needs, injected once at startup (the analogue of
@@ -184,6 +238,8 @@ class ImageRegionServices:
     max_tile_length: int = DEFAULT_MAX_TILE_LENGTH
     raw_cache: object = None          # io.devicecache.DeviceRawCache
     prefetcher: object = None         # services.prefetch.TilePrefetcher
+    # In-flight render dedup (SingleFlight); None disables coalescing.
+    single_flight: object = None
     # Renders at or below this pixel count take the CPU reference kernel
     # (refimpl) instead of a device round trip — the SURVEY north star's
     # fallback path, and a latency win for tiny tiles anywhere the
@@ -284,8 +340,28 @@ class ImageRegionHandler:
                 "Image", ctx.image_id, ctx.omero_session_key):
             raise NotFoundError(f"Cannot find Image:{ctx.image_id}")
 
-        data = await self._get_region(ctx, pixels)
-        await self.s.caches.image_region.set(ctx.cache_key, data)
+        single_flight = self.s.single_flight
+
+        async def produce() -> bytes:
+            data = await self._get_region(ctx, pixels)
+            await self.s.caches.image_region.set(ctx.cache_key, data)
+            return data
+
+        if single_flight is None:
+            return await produce()
+        # Coalesce concurrent identical requests onto one pipeline run:
+        # the leader renders and writes the byte cache back; followers
+        # settle from the same task.  ACL already ran per caller above,
+        # so sharing the bytes is exactly as safe as the byte-cache hit
+        # path.
+        data, coalesced = await single_flight.run(
+            render_identity_key(ctx), produce)
+        if coalesced:
+            # Waterfall marker for the follower: its wall time was one
+            # await on the leader's pipeline, not a pipeline of its own.
+            telemetry.record_span(
+                "dedup.coalesced", t0,
+                (_time.perf_counter() - t0) * 1000.0)
         return data
 
     # --------------------------------------------------------- pipeline

@@ -42,6 +42,27 @@ def update_settings(rdef: RenderingDef, ctx: ImageRegionCtx) -> RenderingDef:
         return _update_settings(rdef, ctx)
 
 
+def render_identity_key(ctx: ImageRegionCtx) -> str:
+    """Canonical identity of a render for in-flight dedup.
+
+    Everything the produced bytes depend on — the plane address
+    (image/z/t/level/tile-or-region) AND the canonical rendering
+    settings (channels, windows, colors/LUTs, maps, model, projection,
+    flips, format, quality) — and nothing else.  ``ctx.cache_key`` is
+    exactly that: SipHash over the class name + the SORTED request
+    params (``ImageRegionCtx.create_cache_key``), so two requests whose
+    params differ only in ordering share one key, and the session key —
+    which never reaches the params — is deliberately NOT part of it:
+    ACL gates per caller before the shared render is awaited, and the
+    pixels are the same for everyone allowed to see them.
+
+    The single-flight table (``server.handler.SingleFlight``) and the
+    byte cache key off this same value, so a coalesced request settles
+    from the exact bytes the leader wrote back.
+    """
+    return ctx.cache_key
+
+
 def _update_settings(rdef: RenderingDef, ctx: ImageRegionCtx
                      ) -> RenderingDef:
     out = rdef.copy()

@@ -19,7 +19,7 @@ in-process path round-trips through ``ImageRegionCtx.to_json`` — the
 reference's Jackson bus encoding, ``ImageRegionCtxTest.java:205-208``):
 
   frame:    u32 frame_len | payload
-  request:  u32 header_len | header JSON {id, op, ctx}
+  request:  u32 header_len | header JSON {id, op, ctx, v} | body
   response: u32 header_len | header JSON {id, status, error?} | body
             (the Content-Type stays a frontend concern — both sides
             derive it from the ctx, exactly like the reference's HTTP
@@ -28,6 +28,17 @@ reference's Jackson bus encoding, ``ImageRegionCtxTest.java:205-208``):
 
 Responses are multiplexed by ``id`` and may arrive out of order, so one
 connection carries a frontend's full concurrency.
+
+Protocol v2 adds the digest-first plane ops backing the device-resident
+plane cache (``io.devicecache``): ``plane_probe`` ({digest}) answers
+whether that content is already HBM-resident, and ``plane_put``
+({digest, dtype, shape} + raw bytes body) stages a plane into the
+device cache.  A client ALWAYS probes before shipping
+(:meth:`SidecarClient.stage_plane`), so a plane already on the device —
+pushed by any frontend/ingester, or read by the sidecar itself — never
+crosses the wire twice.  v1 peers reject the new ops with status 400
+and everything else is unchanged, so mixed-version deployments degrade
+to always-upload, never to an error surface.
 """
 
 from __future__ import annotations
@@ -46,6 +57,10 @@ from .errors import NotFoundError
 logger = logging.getLogger(__name__)
 
 _MAX_FRAME = 256 * 1024 * 1024
+# Wire protocol generation: 2 = the digest-first plane ops
+# (plane_probe / plane_put).  Sent in every request header; servers
+# tolerate its absence (v1 clients never use the new ops).
+WIRE_VERSION = 2
 
 
 def parse_address(addr: str):
@@ -108,6 +123,65 @@ async def _read_frame(reader: asyncio.StreamReader):
 
 # ---------------------------------------------------------------- server
 
+async def _plane_put(image_handler, header: dict,
+                     req_body: bytes) -> bytes:
+    """Stage a wire-pushed plane into the device cache (protocol v2).
+
+    The claimed digest is VERIFIED against the received bytes before
+    anything reaches the cache — the socket is unauthenticated (private
+    interface only), and a digest/content mismatch must poison nothing:
+    it is a 400, not a cache entry.
+    """
+    import numpy as np
+
+    cache = getattr(getattr(image_handler, "s", None), "raw_cache",
+                    None)
+    if cache is None or not getattr(cache, "digest_index", False):
+        raise BadRequestError(
+            "device plane cache is disabled on this sidecar "
+            "(raw-cache.enabled / raw-cache.digest-dedup)")
+    digest = str(header.get("digest") or "")
+    try:
+        dtype = np.dtype(str(header["dtype"]))
+        shape = tuple(int(s) for s in header["shape"])
+        if dtype.kind not in "uif":
+            # Pixel storage is numeric only; anything else ("O",
+            # datetime64, ...) would blow up in frombuffer/device_put
+            # as a 500 instead of this 400.
+            raise ValueError(f"non-numeric dtype {dtype}")
+    except (KeyError, TypeError, ValueError) as e:
+        raise BadRequestError(f"malformed plane_put header: {e}")
+    if not shape or any(s <= 0 for s in shape):
+        # Checked BEFORE np.prod: an even count of negative dims would
+        # multiply out positive and sail past the size check into a
+        # reshape ValueError (a 500, not the contract's 400).
+        raise BadRequestError(f"plane_put shape {list(shape)} must be "
+                              f"all-positive")
+    expected = int(np.prod(shape)) * dtype.itemsize
+    if expected != len(req_body):
+        raise BadRequestError(
+            f"plane_put body is {len(req_body)} bytes, shape/dtype "
+            f"say {expected}")
+    arr = np.frombuffer(req_body, dtype).reshape(shape)
+
+    def stage_verified():
+        from ..io.devicecache import plane_digest
+        from ..io.staging import stage_deduped
+        actual = plane_digest(arr)
+        if digest and digest != actual:
+            raise BadRequestError(
+                f"plane_put digest mismatch: claimed {digest}, "
+                f"content is {actual}")
+        _, _, was_resident = stage_deduped(arr, cache, digest=actual)
+        return actual, was_resident
+
+    # Digesting + packing + the device transfer are CPU/link work;
+    # keep the event loop (and the other multiplexed renders) free.
+    actual, was_resident = await asyncio.to_thread(stage_verified)
+    return json.dumps({"digest": actual,
+                       "resident": was_resident}).encode()
+
+
 async def _serve_connection(image_handler, mask_handler, reader, writer,
                             status_fn=None):
     """One frontend connection: demux requests, run each as a task.
@@ -122,7 +196,7 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
             writer.write(_pack(header, body))
             await writer.drain()
 
-    async def handle(header: dict) -> None:
+    async def handle(header: dict, req_body: bytes = b"") -> None:
         rid = header.get("id")
         spans = None
         try:
@@ -177,6 +251,25 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                     lines += telemetry.device_metric_lines(
                         handler_services, ',process="sidecar"')
                 body = ("\n".join(lines) + "\n").encode()
+            elif op == "plane_probe":
+                # Digest-first residency probe: the peer only ships the
+                # plane bytes when this answers resident=false.
+                cache = getattr(getattr(image_handler, "s", None),
+                                "raw_cache", None)
+                enabled = bool(cache is not None
+                               and getattr(cache, "digest_index",
+                                           False))
+                digest = str(header.get("digest") or "")
+                resident = bool(enabled and digest
+                                and cache.resident_digest(digest))
+                body = json.dumps({
+                    "resident": resident,
+                    # enabled=false tells the client to SKIP the put
+                    # (nothing to push into), not to error.
+                    "enabled": enabled,
+                }).encode()
+            elif op == "plane_put":
+                body = await _plane_put(image_handler, header, req_body)
             elif op == "ping":
                 doc = status_fn() if status_fn is not None \
                     else {"ok": True}
@@ -204,10 +297,10 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
     try:
         while True:
             try:
-                header, _body = await _read_frame(reader)
+                header, req_body = await _read_frame(reader)
             except (asyncio.IncompleteReadError, ConnectionResetError):
                 break
-            t = asyncio.create_task(handle(header))
+            t = asyncio.create_task(handle(header, req_body))
             tasks.add(t)
             t.add_done_callback(tasks.discard)
     finally:
@@ -418,15 +511,17 @@ class SidecarClient:
             if self._conn is conn:
                 self._conn = None
 
-    async def call(self, op: str, ctx_json: dict):
+    async def call(self, op: str, ctx_json: dict, body: bytes = b"",
+                   extra: Optional[dict] = None):
         """Returns (status, body_or_error).
 
         One transparent retry when the connection dies under the
         request — at send time OR while awaiting the reply (on asyncio
         a write to a dead peer usually buffers fine and the failure
         only surfaces through the read loop).  Renders are idempotent
-        pure reads, so re-issuing a request the dead sidecar may or may
-        not have executed is safe."""
+        pure reads — and the v2 plane ops idempotent content puts — so
+        re-issuing a request the dead sidecar may or may not have
+        executed is safe."""
         import time as _time
 
         for attempt in (0, 1):
@@ -436,7 +531,10 @@ class SidecarClient:
             loop = asyncio.get_running_loop()
             fut: asyncio.Future = loop.create_future()
             conn.pending[rid] = fut
-            header = {"id": rid, "op": op, "ctx": ctx_json}
+            header = {"id": rid, "op": op, "ctx": ctx_json,
+                      "v": WIRE_VERSION}
+            if extra:
+                header.update(extra)
             trace_id = telemetry.current_trace_id()
             if trace_id:
                 # The trace rides the wire so device-side spans join
@@ -445,7 +543,7 @@ class SidecarClient:
             t_call = _time.perf_counter()
             try:
                 async with self._write_lock:
-                    conn.writer.write(_pack(header))
+                    conn.writer.write(_pack(header, body))
                     await conn.writer.drain()
                 header, body = await fut
             except (ConnectionError, OSError):
@@ -477,6 +575,54 @@ class SidecarClient:
             return (header["status"],
                     body if header["status"] == 200
                     else header.get("error", ""))
+
+    async def stage_plane(self, arr, digest: Optional[str] = None):
+        """Digest-first plane push (protocol v2): probe the sidecar's
+        device plane cache, upload ONLY on miss.
+
+        ``arr`` is a host ndarray in storage dtype.  Returns
+        ``(digest, was_resident)``: resident True means zero plane
+        bytes crossed the wire — the content was already in HBM (a
+        previous push from any frontend, or the sidecar's own reads).
+        Used by ingest/prewarm-style producers to land planes on the
+        device ahead of the first interactive request.
+
+        Degrades, never errors, against a peer that cannot take the
+        push: a v1 sidecar (probe op unknown -> 400) or one with the
+        plane cache disabled returns ``(digest, False)`` without
+        uploading anything — the sidecar still stages its own reads,
+        the push optimization just is not available there.
+        """
+        import numpy as np
+
+        from ..io.devicecache import plane_digest
+
+        arr = np.ascontiguousarray(arr)
+        digest = digest or plane_digest(arr)
+        status, payload = await self.call(
+            "plane_probe", {}, extra={"digest": digest})
+        if status != 200:
+            # v1 sidecar: no plane ops.  Degrade to no-push.
+            return digest, False
+        try:
+            doc = json.loads(bytes(payload).decode())
+        except (ValueError, AttributeError):
+            doc = {}
+        if doc.get("resident"):
+            return digest, True
+        if not doc.get("enabled", True):
+            # Plane cache disabled sidecar-side: nothing to push into.
+            return digest, False
+        status, payload = await self.call(
+            "plane_put", {},
+            body=arr.tobytes(),
+            extra={"digest": digest, "dtype": str(arr.dtype),
+                   "shape": list(arr.shape)})
+        if status != 200:
+            raise RuntimeError(
+                f"plane_put failed ({status}): {payload}")
+        doc = json.loads(bytes(payload).decode())
+        return doc.get("digest", digest), bool(doc.get("resident"))
 
     async def close(self) -> None:
         conn, self._conn = self._conn, None

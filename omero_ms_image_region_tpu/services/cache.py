@@ -49,6 +49,7 @@ class MemoryLRUCache:
         self._size = 0
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def get_sync(self, key: str) -> Optional[bytes]:
         with self._lock:
@@ -70,6 +71,7 @@ class MemoryLRUCache:
             while self._size > self.max_bytes and self._data:
                 _, evicted = self._data.popitem(last=False)
                 self._size -= len(evicted)
+                self.evictions += 1
 
     async def get(self, key: str) -> Optional[bytes]:
         return self.get_sync(key)

@@ -529,14 +529,22 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_requests_total": "counter",
     "imageregion_cache_hits": "counter",
     "imageregion_cache_misses": "counter",
+    "imageregion_cache_evictions": "counter",
     "imageregion_rawcache_hits": "counter",
     "imageregion_rawcache_misses": "counter",
+    "imageregion_rawcache_evictions": "counter",
     "imageregion_rawcache_bytes": "gauge",
+    "imageregion_planecache_hits": "counter",
+    "imageregion_planecache_misses": "counter",
+    "imageregion_singleflight_hits": "counter",
+    "imageregion_singleflight_misses": "counter",
+    "imageregion_singleflight_inflight": "gauge",
     "imageregion_batches_dispatched": "counter",
     "imageregion_tiles_rendered": "counter",
     "imageregion_batcher_queue_depth": "gauge",
     "imageregion_pipeline_inflight": "gauge",
     "imageregion_batcher_max_batch": "gauge",
+    "imageregion_batcher_queue_wait_max_ms": "gauge",
     "imageregion_compile_events_total": "counter",
     "imageregion_compile_ms_total": "counter",
     "imageregion_link_mb_s": "gauge",
@@ -628,6 +636,10 @@ def device_metric_lines(services, extra_labels: str = "") -> List[str]:
                 f"imageregion_cache_hits{lb} {hits}",
                 f"imageregion_cache_misses{lb} {misses}",
             ]
+            evictions = getattr(tier, "evictions", None)
+            if evictions is not None:
+                lines.append(
+                    f"imageregion_cache_evictions{lb} {evictions}")
     raw_cache = getattr(services, "raw_cache", None)
     if raw_cache is not None:
         lb = label()
@@ -635,6 +647,28 @@ def device_metric_lines(services, extra_labels: str = "") -> List[str]:
             f"imageregion_rawcache_hits{lb} {raw_cache.hits}",
             f"imageregion_rawcache_misses{lb} {raw_cache.misses}",
             f"imageregion_rawcache_bytes{lb} {raw_cache.size_bytes}",
+        ]
+        if hasattr(raw_cache, "evictions"):
+            lines.append(f"imageregion_rawcache_evictions{lb} "
+                         f"{raw_cache.evictions}")
+        if hasattr(raw_cache, "plane_hits"):
+            # Content-digest staging skips: uploads the plane cache
+            # saved (hits) vs paid (misses) — wire probes included.
+            lines += [
+                f"imageregion_planecache_hits{lb} "
+                f"{raw_cache.plane_hits}",
+                f"imageregion_planecache_misses{lb} "
+                f"{raw_cache.plane_misses}",
+            ]
+    single_flight = getattr(services, "single_flight", None)
+    if single_flight is not None:
+        lb = label()
+        lines += [
+            f"imageregion_singleflight_hits{lb} {single_flight.hits}",
+            f"imageregion_singleflight_misses{lb} "
+            f"{single_flight.misses}",
+            f"imageregion_singleflight_inflight{lb} "
+            f"{single_flight.inflight()}",
         ]
     renderer = getattr(services, "renderer", None)
     if hasattr(renderer, "batches_dispatched"):
@@ -654,6 +688,11 @@ def device_metric_lines(services, extra_labels: str = "") -> List[str]:
             f"{renderer.inflight()}",
             f"imageregion_batcher_max_batch{lb} {renderer.max_batch}",
         ]
+        if hasattr(renderer, "queue_wait_max_ms"):
+            # High-water queue wait: the stragglers a mean hides and a
+            # p50 cannot see at all.
+            lines.append(f"imageregion_batcher_queue_wait_max_ms{lb} "
+                         f"{round(renderer.queue_wait_max_ms, 3)}")
     lb = label()
     lines += [
         f"imageregion_compile_events_total{lb} {COMPILE.events}",

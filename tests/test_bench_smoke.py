@@ -1,0 +1,39 @@
+"""bench.py --smoke as a tier-1 gate: cache and pipeline regressions
+fail tests here instead of waiting for the next BENCH round."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_bench_smoke_hot_path(capsys):
+    import bench
+
+    t0 = time.monotonic()
+    out = bench.bench_smoke(duration_s=1.5)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, f"smoke bench took {elapsed:.0f}s (budget 60)"
+
+    # Throughput through the full app at smoke scale.
+    assert out["value"] > 0
+    # Acceptance path: a repeated identical request answers from the
+    # byte cache with ZERO new device dispatches.
+    assert out["warm_repeat_cached"] is True
+    # The single-flight probe ran (the rate itself is timing-dependent;
+    # determinism for the mechanism lives in test_singleflight.py).
+    assert out["dedup_hit_rate"] is not None
+    assert 0.0 <= out["dedup_hit_rate"] <= 1.0
+    # The two-stage pipeline recorded device-execute coverage.
+    assert out["overlap_efficiency"] is not None
+    assert out["overlap_efficiency"] > 0
+    # Plane-digest staging accounting is live.
+    assert out["planecache_misses"] is not None
+    assert out["planecache_misses"] > 0
+
+    # The printed line is the machine-readable contract.
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["metric"] == "smoke_hotpath_tiles_per_sec"

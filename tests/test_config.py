@@ -210,3 +210,32 @@ def test_prewarm_specs_parse_and_validate():
     # Malformed specs fail at config LOAD, not at first serving touch.
     with pytest.raises(ValueError):
         AppConfig.from_dict({"renderer": {"prewarm": ["4x1000"]}})
+
+
+def test_hot_path_knobs_parse_and_validate():
+    """PR 2's hot-path knobs: two-stage device lanes, single-flight
+    dedup, and the raw cache's content-digest index."""
+    import pytest
+
+    from omero_ms_image_region_tpu.server.config import AppConfig
+
+    cfg = AppConfig.from_dict({})
+    assert cfg.batcher.device_lanes == 2          # double-buffered
+    assert cfg.single_flight is True
+    assert cfg.raw_cache.digest_dedup is True
+
+    cfg = AppConfig.from_dict({
+        "batcher": {"device-lanes": 3},
+        "single-flight": {"enabled": False},
+        "raw-cache": {"digest-dedup": False},
+    })
+    assert cfg.batcher.device_lanes == 3
+    assert cfg.single_flight is False
+    assert cfg.raw_cache.digest_dedup is False
+
+    # Bare boolean form tolerated too.
+    assert AppConfig.from_dict(
+        {"single-flight": False}).single_flight is False
+
+    with pytest.raises(ValueError, match="device-lanes"):
+        AppConfig.from_dict({"batcher": {"device-lanes": 0}})

@@ -28,17 +28,91 @@ def test_same_key_loads_once_and_counts():
 
 
 def test_eviction_respects_byte_budget():
+    # DISTINCT content per key: identical content would alias one
+    # device buffer (content-digest dedup) and fit the budget forever.
     tile_bytes = 2 * 8 * 8 * 4
     cache = DeviceRawCache(max_bytes=tile_bytes * 2)
     for i in range(4):
         cache.get_or_load(("k", i),
-                          lambda: np.zeros((2, 8, 8), np.float32))
+                          lambda i=i: np.full((2, 8, 8), float(i),
+                                              np.float32))
     assert len(cache) == 2                       # oldest two evicted
     assert cache.size_bytes == tile_bytes * 2
+    assert cache.evictions == 2
     # Oldest keys are gone: reloading key 0 is a miss.
     misses = cache.misses
-    cache.get_or_load(("k", 0), lambda: np.zeros((2, 8, 8), np.float32))
+    cache.get_or_load(("k", 0),
+                      lambda: np.full((2, 8, 8), 0.0, np.float32))
     assert cache.misses == misses + 1
+
+
+def test_digest_aliases_share_buffer_and_bytes():
+    """Identical content under many keys holds ONE device buffer and
+    ONE byte-budget charge; the bytes leave only with the last alias."""
+    tile_bytes = 2 * 8 * 8 * 4
+    cache = DeviceRawCache(max_bytes=tile_bytes * 4)
+    arrs = [cache.get_or_load(("k", i),
+                              lambda: np.zeros((2, 8, 8), np.float32))
+            for i in range(3)]
+    assert arrs[0] is arrs[1] is arrs[2]     # one buffer, three keys
+    assert len(cache) == 3
+    assert cache.size_bytes == tile_bytes    # accounted once
+    assert cache.plane_hits == 2 and cache.plane_misses == 1
+    # Distinct content pushes the shared buffer's aliases out one by
+    # one; the shared bytes leave the budget only with the LAST alias.
+    for i in range(3):
+        cache.get_or_load(("fresh", i),
+                          lambda i=i: np.full((2, 8, 8), 1.0 + i,
+                                              np.float32))
+    assert cache.size_bytes <= tile_bytes * 4
+
+
+def test_racing_identical_content_misses_share_one_buffer():
+    """Two threads key-missing concurrently on identical content must
+    converge on ONE device buffer (the in-lock digest re-probe): no
+    unaccounted second HBM allocation survives in the cache."""
+    import threading
+
+    cache = DeviceRawCache()
+    content = np.arange(2 * 8 * 8, dtype=np.uint16).reshape(2, 8, 8)
+    barrier = threading.Barrier(2, timeout=10)
+
+    def load():
+        barrier.wait()      # both threads inside the miss path at once
+        return content.copy()
+
+    outs = [None, None]
+
+    def worker(i):
+        outs[i] = cache.get_or_load(("r", i), load)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert outs[0] is outs[1]               # loser adopted the winner's
+    assert cache.size_bytes == content.nbytes
+    assert len(cache) == 2                  # both keys present, aliased
+
+
+def test_wire_probe_counts_hits_only():
+    """One actual upload = exactly one plane_misses increment: the
+    probe counts only hits (uploads that never happen); the miss is
+    recorded by the staging itself."""
+    from omero_ms_image_region_tpu.io.staging import stage_deduped
+
+    cache = DeviceRawCache()
+    arr = np.arange(128, dtype=np.uint16).reshape(2, 8, 8)
+    from omero_ms_image_region_tpu.io.devicecache import plane_digest
+    digest = plane_digest(arr)
+    assert cache.resident_digest(digest) is False     # probe: cold
+    assert cache.plane_misses == 0                    # not yet an upload
+    stage_deduped(arr, cache, digest=digest)          # the upload
+    assert cache.plane_misses == 1
+    assert cache.resident_digest(digest) is True      # probe: warm
+    assert cache.plane_hits == 1
 
 
 def test_prefetcher_stages_neighbor_tiles(tmp_path):

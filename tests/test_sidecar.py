@@ -550,6 +550,167 @@ def test_kitchen_sink_ome_tiff_sessions_projection(tmp_path):
     assert split_bodies == asyncio.run(combined())
 
 
+def test_plane_digest_wire_push(data_dir, tmp_path):
+    """Protocol v2 digest-first plane staging: the first push uploads,
+    the second (same content, any client) probes resident and ships
+    ZERO plane bytes; a digest/content mismatch is rejected before it
+    can poison the cache."""
+    from omero_ms_image_region_tpu.server.sidecar import SidecarClient
+
+    sock = str(tmp_path / "render.sock")
+    rng = np.random.default_rng(5)
+    arr = rng.integers(0, 60000, size=(2, 64, 64)).astype(np.uint16)
+
+    async def body():
+        client = SidecarClient(sock)
+        try:
+            digest, resident = await client.stage_plane(arr)
+            assert resident is False           # first push: uploaded
+            digest2, resident2 = await client.stage_plane(arr.copy())
+            assert digest2 == digest
+            assert resident2 is True           # probe hit: no upload
+            # A second client (another frontend) sees the same residency.
+            other = SidecarClient(sock)
+            try:
+                _, resident3 = await other.stage_plane(arr.copy())
+                assert resident3 is True
+            finally:
+                await other.close()
+            # Probe op answers directly too.
+            import json as _json
+            status, payload = await client.call(
+                "plane_probe", {}, extra={"digest": digest})
+            assert status == 200
+            assert _json.loads(bytes(payload).decode())["resident"]
+            # Digest mismatch: 400, nothing cached under the bogus key.
+            status, err = await client.call(
+                "plane_put", {}, body=arr.tobytes(),
+                extra={"digest": "00" * 16, "dtype": str(arr.dtype),
+                       "shape": list(arr.shape)})
+            assert status == 400 and "mismatch" in str(err)
+            # Body/shape disagreement: 400 as well.
+            status, err = await client.call(
+                "plane_put", {}, body=arr.tobytes()[:-2],
+                extra={"digest": digest, "dtype": str(arr.dtype),
+                       "shape": list(arr.shape)})
+            assert status == 400
+            # Negative dims whose product multiplies out positive must
+            # still be a 400, never a reshape 500.
+            status, err = await client.call(
+                "plane_put", {}, body=b"\x00" * (2 * 2 * 64 * 2),
+                extra={"digest": digest, "dtype": str(arr.dtype),
+                       "shape": [-2, -2, 64]})
+            assert status == 400 and "positive" in str(err)
+            # Non-numeric dtypes are a 400 too, not a frombuffer 500.
+            status, err = await client.call(
+                "plane_put", {}, body=b"\x00" * 64,
+                extra={"digest": digest, "dtype": "O",
+                       "shape": [8]})
+            assert status == 400 and "dtype" in str(err)
+            return True
+        finally:
+            await client.close()
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body))
+
+
+def test_plane_push_degrades_when_cache_disabled(data_dir, tmp_path):
+    """A sidecar without the plane cache (raw-cache disabled) makes
+    stage_plane a no-op — (digest, False), nothing uploaded, no error
+    surface (the documented mixed-version degrade contract)."""
+    from omero_ms_image_region_tpu.server.config import RawCacheConfig
+    from omero_ms_image_region_tpu.server.sidecar import SidecarClient
+
+    sock = str(tmp_path / "render.sock")
+    arr = np.arange(2 * 16 * 16, dtype=np.uint16).reshape(2, 16, 16)
+
+    async def scenario():
+        cfg = AppConfig(data_dir=data_dir,
+                        raw_cache=RawCacheConfig(enabled=False))
+        task = asyncio.create_task(run_sidecar(cfg, sock))
+        client = SidecarClient(sock)
+        try:
+            await _wait_socket(sock, task)
+            digest, resident = await client.stage_plane(arr)
+            assert resident is False
+            # Still not resident afterwards: nothing was pushed.
+            digest2, resident2 = await client.stage_plane(arr)
+            assert digest2 == digest and resident2 is False
+            return True
+        finally:
+            await client.close()
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    assert asyncio.run(scenario())
+
+
+def test_wire_pushed_plane_skips_handler_upload(data_dir, tmp_path):
+    """A plane pushed over the wire is found by the handler's region
+    read through the content-digest index: the read aliases the
+    resident HBM buffer instead of re-staging it (the planecache_hits
+    counter proves no second upload happened)."""
+    import json as _json
+
+    from omero_ms_image_region_tpu.io.store import ChunkedPyramidStore
+    from omero_ms_image_region_tpu.server.sidecar import SidecarClient
+
+    sock = str(tmp_path / "render.sock")
+    url = (f"/webgateway/render_image_region/{IMG}/0/0"
+           f"?c=1|0:60000$FF0000&m=g&format=png")
+
+    async def body():
+        # Push exactly the plane stack the handler's full-plane read
+        # will produce: channel 0, z 0, t 0, stacked along C.
+        src = ChunkedPyramidStore(os.path.join(data_dir, str(IMG)))
+        from omero_ms_image_region_tpu.server.region import RegionDef
+        plane = src.get_region(0, 0, 0, RegionDef(0, 0, W, H), 0)
+        pusher = SidecarClient(sock)
+        try:
+            _, resident = await pusher.stage_plane(plane[None])
+            assert resident is False
+            app = create_app(_frontend_config(data_dir, sock))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(url)
+                assert r.status == 200
+                await r.read()
+                m = await (await client.get("/metrics")).text()
+                hits = [line for line in m.splitlines()
+                        if line.startswith("imageregion_planecache_hits")]
+                assert hits, m
+                assert int(hits[0].rsplit(" ", 1)[1]) >= 1
+            finally:
+                await client.close()
+            return True
+        finally:
+            await pusher.close()
+
+    async def with_device_sidecar():
+        # Small test tiles must take the device path (the CPU fallback
+        # never touches the raw cache).
+        from omero_ms_image_region_tpu.server.config import (
+            RendererConfig)
+        cfg = AppConfig(data_dir=data_dir,
+                        renderer=RendererConfig(cpu_fallback_max_px=0))
+        task = asyncio.create_task(run_sidecar(cfg, sock))
+        try:
+            await _wait_socket(sock, task)
+            return await body()
+        finally:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    assert asyncio.run(with_device_sidecar())
+
+
 def test_sidecar_serves_vendor_codec_images(data_dir, tmp_path):
     """The process split composes with the vendor codec paths: a
     JPEG 2000 (Aperio 33005) image and a JPEG-compressed (7) image

@@ -1,0 +1,233 @@
+"""In-flight render dedup (server.handler.SingleFlight): N concurrent
+identical requests produce exactly ONE device render and N identical
+byte responses — including the cancellation path (first caller
+disconnects, the others still settle)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.io.service import PixelsService
+from omero_ms_image_region_tpu.io.store import build_pyramid
+from omero_ms_image_region_tpu.ops.lut import LutProvider
+from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+from omero_ms_image_region_tpu.server.handler import (
+    ImageRegionHandler, ImageRegionServices, Renderer, SingleFlight,
+)
+from omero_ms_image_region_tpu.services.cache import CacheConfig, Caches
+from omero_ms_image_region_tpu.services.metadata import (
+    CanReadMemo, LocalMetadataService,
+)
+
+IMG = 11
+H = W = 64
+
+
+class GatedRenderer(Renderer):
+    """Counts renders and holds them behind an asyncio gate so the test
+    controls exactly when the shared pipeline completes."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+        self.gate = asyncio.Event()
+
+    async def render(self, raw, settings):
+        self.calls += 1
+        await self.gate.wait()
+        return await super().render(raw, settings)
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    rng = np.random.default_rng(17)
+    planes = rng.integers(0, 60000, size=(2, 1, H, W)).astype(np.uint16)
+    build_pyramid(planes, str(tmp_path / str(IMG)), chunk=(32, 32),
+                  n_levels=1)
+    return str(tmp_path)
+
+
+def _services(data_dir, renderer):
+    return ImageRegionServices(
+        pixels_service=PixelsService(data_dir),
+        metadata=LocalMetadataService(data_dir),
+        caches=Caches.from_config(CacheConfig.enabled_all()),
+        can_read_memo=CanReadMemo(),
+        renderer=renderer,
+        lut_provider=LutProvider(),
+        cpu_fallback_max_px=0,
+        single_flight=SingleFlight(),
+    )
+
+
+def _ctx():
+    return ImageRegionCtx.from_params({
+        "imageId": str(IMG), "theZ": "0", "theT": "0", "m": "c",
+        "c": "1|0:60000$FF0000,2|0:55000$00FF00", "format": "png"})
+
+
+def test_concurrent_identical_requests_render_once(data_dir):
+    renderer = GatedRenderer()
+    services = _services(data_dir, renderer)
+    handler = ImageRegionHandler(services)
+    N = 6
+
+    async def main():
+        tasks = [asyncio.ensure_future(
+            handler.render_image_region(_ctx())) for _ in range(N)]
+        # Let every request reach the single-flight table before the
+        # gate opens (a follower arriving after the leader settles
+        # would be a fresh miss, not a coalesce).
+        for _ in range(500):
+            await asyncio.sleep(0.01)
+            if services.single_flight.hits == N - 1:
+                break
+        assert services.single_flight.hits == N - 1
+        assert services.single_flight.inflight() == 1
+        renderer.gate.set()
+        return await asyncio.gather(*tasks)
+
+    bodies = asyncio.run(main())
+    assert renderer.calls == 1                 # exactly one device render
+    assert len(set(bodies)) == 1               # N identical responses
+    assert bodies[0][:4] == b"\x89PNG"
+    assert services.single_flight.hits == N - 1
+    assert services.single_flight.misses == 1
+    assert services.single_flight.inflight() == 0
+
+
+def test_leader_cancellation_still_settles_followers(data_dir):
+    """The FIRST caller disconnecting (aiohttp cancels its handler) must
+    not cancel the shared render: the followers still get bytes, and
+    the byte cache still gets its write-back."""
+    renderer = GatedRenderer()
+    services = _services(data_dir, renderer)
+    handler = ImageRegionHandler(services)
+    ctx = _ctx()
+
+    async def main():
+        leader = asyncio.ensure_future(
+            handler.render_image_region(_ctx()))
+        for _ in range(200):
+            await asyncio.sleep(0.005)
+            if renderer.calls:            # leader reached the renderer
+                break
+        followers = [asyncio.ensure_future(
+            handler.render_image_region(_ctx())) for _ in range(3)]
+        for _ in range(500):              # followers join the table
+            await asyncio.sleep(0.01)
+            if services.single_flight.hits == 3:
+                break
+        assert services.single_flight.hits == 3
+        leader.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await leader
+        renderer.gate.set()
+        return await asyncio.gather(*followers)
+
+    bodies = asyncio.run(main())
+    assert renderer.calls == 1
+    assert len(set(bodies)) == 1
+    assert bodies[0][:4] == b"\x89PNG"
+
+    # The shared task also completed the cache write-back: a fresh
+    # request is a byte-cache hit, no new render.
+    async def repeat():
+        return await handler.render_image_region(_ctx())
+
+    again = asyncio.run(repeat())
+    assert again == bodies[0]
+    assert renderer.calls == 1
+
+    run_cached = asyncio.run(
+        services.caches.image_region.get(ctx.cache_key))
+    assert run_cached == bodies[0]
+
+
+def test_all_waiters_cancelled_render_completes(data_dir):
+    """Even with EVERY waiter gone the shared render runs to completion
+    and writes the byte cache, so the next identical request is a hit
+    instead of a re-render."""
+    renderer = GatedRenderer()
+    services = _services(data_dir, renderer)
+    handler = ImageRegionHandler(services)
+
+    async def main():
+        waiters = [asyncio.ensure_future(
+            handler.render_image_region(_ctx())) for _ in range(2)]
+        for _ in range(200):
+            await asyncio.sleep(0.005)
+            if renderer.calls:
+                break
+        for w in waiters:
+            w.cancel()
+        await asyncio.gather(*waiters, return_exceptions=True)
+        renderer.gate.set()
+        # Drain the orphaned shared task.
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if services.single_flight.inflight() == 0:
+                break
+        return await handler.render_image_region(_ctx())
+
+    body = asyncio.run(main())
+    assert body[:4] == b"\x89PNG"
+    assert renderer.calls == 1          # served from the byte cache
+
+
+def test_different_requests_do_not_coalesce(data_dir):
+    renderer = GatedRenderer()
+    renderer.gate.set()
+    services = _services(data_dir, renderer)
+    handler = ImageRegionHandler(services)
+
+    async def main():
+        a = ImageRegionCtx.from_params({
+            "imageId": str(IMG), "theZ": "0", "theT": "0", "m": "c",
+            "c": "1|0:60000$FF0000", "format": "png"})
+        b = ImageRegionCtx.from_params({
+            "imageId": str(IMG), "theZ": "0", "theT": "0", "m": "c",
+            "c": "1|0:30000$FF0000", "format": "png"})
+        return await asyncio.gather(handler.render_image_region(a),
+                                    handler.render_image_region(b))
+
+    one, two = asyncio.run(main())
+    assert one != two
+    assert renderer.calls == 2
+    assert services.single_flight.hits == 0
+
+
+def test_param_order_shares_identity(data_dir):
+    """The canonical key is over SORTED params, so two requests that
+    differ only in query ordering coalesce (and share a cache key)."""
+    from omero_ms_image_region_tpu.server.settings import (
+        render_identity_key,
+    )
+
+    a = ImageRegionCtx.from_params({
+        "imageId": str(IMG), "theZ": "0", "theT": "0", "m": "c",
+        "c": "1|0:60000$FF0000", "format": "png"})
+    b = ImageRegionCtx.from_params({
+        "format": "png", "c": "1|0:60000$FF0000", "m": "c",
+        "theT": "0", "theZ": "0", "imageId": str(IMG)})
+    assert render_identity_key(a) == render_identity_key(b)
+
+
+def test_singleflight_metrics_exported(data_dir):
+    renderer = GatedRenderer()
+    renderer.gate.set()
+    services = _services(data_dir, renderer)
+    handler = ImageRegionHandler(services)
+
+    async def main():
+        return await asyncio.gather(*(
+            handler.render_image_region(_ctx()) for _ in range(3)))
+
+    asyncio.run(main())
+    from omero_ms_image_region_tpu.utils import telemetry
+    lines = telemetry.device_metric_lines(services)
+    text = "\n".join(lines)
+    assert "imageregion_singleflight_misses" in text
+    assert "imageregion_singleflight_hits" in text
+    assert "imageregion_singleflight_inflight" in text

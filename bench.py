@@ -676,6 +676,186 @@ def bench_smoke(duration_s: float = 1.5):
     return out
 
 
+def bench_chaos_smoke(duration_s: float = 1.5, seed: int = 1234):
+    """Robustness gate at smoke scale: the full frontend -> sidecar ->
+    batcher chain under SEEDED fault injection (wire drops/truncations/
+    delays, transient device errors, a freezing device lane), with
+    deadlines + admission control + breaker armed.
+
+    The invariants (tests/test_chaos_smoke.py wires this into tier-1):
+
+    * **zero 5xx-without-shed** — every response is 200, 503 (shed,
+      with ``Retry-After``) or 504 (deadline); a bare 500 means a
+      fault leaked through the tolerance layer as a raw failure;
+    * **bounded p99** — chaos-window latency stays under the request
+      deadline plus scheduling slack (the deadline actually cuts
+      tails, rather than work queueing toward a timeout);
+    * the chaos actually happened (injected-fault counters are
+      nonzero — a chaos run that injected nothing proves nothing) and
+      the service still made progress (some 200s);
+    * ``plane_put`` was never auto-retried.
+
+    Prints ONE JSON line, like the other smoke gate.
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, FaultToleranceConfig, RawCacheConfig,
+        RendererConfig, SidecarConfig)
+    from omero_ms_image_region_tpu.utils import telemetry
+    from omero_ms_image_region_tpu.utils.faultinject import (
+        FaultInjectionConfig)
+
+    DEADLINE_MS = 5000.0
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(seed)
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 2, 1, 512, 512).reshape(
+            2, 1, 512, 512)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        sock = os.path.join(tmp, "chaos.sock")
+        sidecar_cfg = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=True, linger_ms=2.0),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        frontend_cfg = AppConfig(
+            data_dir=tmp,
+            sidecar=SidecarConfig(socket=sock, role="frontend"),
+            fault_tolerance=FaultToleranceConfig(
+                request_deadline_ms=DEADLINE_MS,
+                retry_base_backoff_ms=10.0,
+                retry_max_backoff_ms=100.0,
+                # One injected connection death fails EVERY multiplexed
+                # in-flight call at once, so consecutive-failure bursts
+                # run 4-5 deep per fault; 8 keeps the breaker for real
+                # outages rather than single chaos events.
+                breaker_failure_threshold=8,
+                breaker_reset_s=0.25,
+                admission_max_queue=64))
+        chaos = FaultInjectionConfig(
+            seed=seed,
+            wire_drop_rate=0.04,
+            wire_truncate_rate=0.02,
+            wire_delay_rate=0.05, wire_delay_ms=30.0,
+            device_error_rate=0.08,
+            freeze_rate=0.05, freeze_ms=100.0)
+        retries_before = dict(telemetry.RESILIENCE.retries)
+        out = asyncio.run(_chaos_run(sidecar_cfg, frontend_cfg, sock,
+                                     chaos, duration_s, DEADLINE_MS))
+        # Diff against the pre-run counters: the gate must judge THIS
+        # window, not retries other tests in the process accumulated.
+        retried_ops = {
+            op for op, n in telemetry.RESILIENCE.retries.items()
+            if n > retries_before.get(op, 0)}
+        out.update({
+            "metric": "chaos_smoke",
+            "unit": "invariants",
+            "deadline_ms": DEADLINE_MS,
+            "plane_put_retried": "plane_put" in retried_ops,
+            "retried_ops": sorted(retried_ops),
+            "elapsed_s": round(time.perf_counter() - t_start, 1),
+        })
+    print(json.dumps(out))
+    return out
+
+
+async def _chaos_run(sidecar_cfg, frontend_cfg, sock, chaos,
+                     duration_s, deadline_ms):
+    import asyncio
+    import os
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from omero_ms_image_region_tpu.server.app import create_app
+    from omero_ms_image_region_tpu.server.sidecar import run_sidecar
+    from omero_ms_image_region_tpu.utils import faultinject
+
+    sidecar_task = asyncio.create_task(run_sidecar(sidecar_cfg, sock))
+    for _ in range(600):
+        if sidecar_task.done():
+            raise AssertionError(
+                f"chaos sidecar died at startup: "
+                f"{sidecar_task.exception()!r}")
+        if os.path.exists(sock):
+            break
+        await asyncio.sleep(0.05)
+    app = create_app(frontend_cfg)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        grid, channels, edge = 2, 2, 256
+
+        def url(i, k):
+            x, y = i % grid, (i // grid) % grid
+            w = 20000 + (k % 5000) * 9
+            chans = ",".join(f"{c + 1}|0:{w - 1000 * c}$FF0000"
+                             for c in range(channels))
+            return (f"/webgateway/render_image_region/1/0/0"
+                    f"?tile=0,{x},{y},{edge},{edge}"
+                    f"&format=png&m=c&c={chans}")
+
+        # Warm FIRST (compiles, byte-cache-miss path) with no chaos, so
+        # the p99 bound below measures the tolerance layer, not XLA's
+        # first-compile.
+        resps = await asyncio.gather(
+            *(client.get(url(i, i)) for i in range(grid * grid)))
+        assert all(r.status == 200 for r in resps), \
+            [r.status for r in resps]
+
+        faultinject.install(chaos)
+        statuses: list = []
+        latencies_ms: list = []
+        missing_retry_after = 0
+        seq = 0
+        t_stop = time.perf_counter() + duration_s
+
+        async def worker(i: int) -> None:
+            nonlocal seq, missing_retry_after
+            while time.perf_counter() < t_stop:
+                seq += 1
+                t0 = time.perf_counter()
+                r = await client.get(url(i, 16 + seq))
+                await r.read()
+                statuses.append(r.status)
+                latencies_ms.append(
+                    (time.perf_counter() - t0) * 1000.0)
+                if r.status == 503 and "Retry-After" not in r.headers:
+                    missing_retry_after += 1
+
+        await asyncio.gather(*(worker(i) for i in range(4)))
+        ok = sum(1 for s in statuses if s == 200)
+        shed = sum(1 for s in statuses if s == 503)
+        deadline_hit = sum(1 for s in statuses if s == 504)
+        bare_5xx = sum(1 for s in statuses
+                       if s >= 500 and s not in (503, 504))
+        lat = sorted(latencies_ms)
+        p99 = lat[max(0, int(len(lat) * 0.99) - 1)] if lat else 0.0
+        inj = faultinject.active()
+        return {
+            "injected": inj.snapshot() if inj is not None else {},
+            "value": len(statuses),
+            "ok": ok, "shed": shed, "deadline_hit": deadline_hit,
+            "bare_5xx": bare_5xx,
+            "missing_retry_after": missing_retry_after,
+            "p99_ms": round(p99, 1),
+            "zero_bare_5xx": bare_5xx == 0,
+            "p99_bounded": p99 <= deadline_ms + 2000.0,
+        }
+    finally:
+        await client.close()
+        faultinject.uninstall()
+        sidecar_task.cancel()
+        try:
+            await sidecar_task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
 # -------------------------------------------------------------- config 1
 
 def bench_config1(rng):
@@ -939,9 +1119,14 @@ def bench_config5(rng):
 
 def main():
     # --smoke: the CPU-fast hot-path gate (also a tier-1 test); no
-    # device, no multi-minute windows, one JSON line.
+    # device, no multi-minute windows, one JSON line.  --smoke --chaos
+    # runs the same scale under seeded fault injection instead: the
+    # robustness gate (zero bare 5xx, bounded p99).
     if "--smoke" in sys.argv[1:]:
-        bench_smoke()
+        if "--chaos" in sys.argv[1:]:
+            bench_chaos_smoke()
+        else:
+            bench_smoke()
         return
     # Fresh entropy per run: the tunnel relay memoizes content-identical
     # transfers and dispatches, so a fixed seed would let repeat bench

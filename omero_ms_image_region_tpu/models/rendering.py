@@ -125,6 +125,26 @@ class RenderingDef:
         )
 
 
+def restrict_to_active(rdef: RenderingDef
+                       ) -> Tuple[RenderingDef, List[int]]:
+    """Drop inactive channel bindings so a renderer never reads or
+    composites planes that contribute nothing.
+
+    The reference reads all active channels inside
+    ``renderAsPackedInt``; inactive channels in our kernels would be
+    zero tables — correct but wasted I/O and HBM.  Order is preserved,
+    so greyscale first-active semantics survive.  Shared by the device
+    pipeline (``server.handler``) and the degraded-mode CPU path
+    (``server.degraded``) — ONE implementation, so the two renders
+    cannot silently diverge on channel selection.
+    """
+    active = rdef.active_channels()
+    out = rdef.copy()
+    out.channel_bindings = [replace(rdef.channel_bindings[i])
+                            for i in active]
+    return out, active
+
+
 def default_rendering_def(pixels: Pixels) -> RenderingDef:
     """Default settings for a pixels set.
 

@@ -228,6 +228,17 @@ class MeshRenderer(BatchingRenderer):
             # the sharded program a second time on one process only,
             # diverging the pod's lockstep launch sequence.
             self._transient_retry_enabled = False
+            # Deadline-expired pendings DO still drop
+            # (_deadline_drop_enabled stays True): the drop happens on
+            # the LEADER at dispatch pop, before the group rides the
+            # pod announcement, so every follower replays the identical
+            # post-drop group — unlike growth/retry, no host-local
+            # divergence is possible.  Chaos freeze/device-error
+            # injection, however, fires on whatever process installed
+            # it and would stall or re-launch one process's lockstep
+            # sequence only — config load rejects explicit multi-host
+            # + fault-injection.seed, and build_services disarms the
+            # injector on auto-discovered pods.
         self.mesh = mesh
         self.jpeg_engine = jpeg_engine
         # Live wire-engine selection (utils.adaptive.AdaptiveEngine).

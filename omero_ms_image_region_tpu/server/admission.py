@@ -1,0 +1,92 @@
+"""Admission control: a bounded queue in front of the render pipeline.
+
+The reference's Vert.x event loop gave it implicit backpressure — a
+bounded worker pool and bus delivery timeouts.  The TPU build's batcher
+happily queues unboundedly, so under overload every request eventually
+times out instead of most requests succeeding: the classic unshed
+overload collapse.  This controller makes the service refuse work it
+cannot finish — ``503 + Retry-After`` (``server.errors.OverloadedError``)
+at ADMISSION, before any read/stage/render cost is paid — when either
+
+* the number of admitted-but-unfinished renders reaches ``max_queue``
+  (absolute depth bound), or
+* the estimated wait (depth x EWMA service time / device lanes)
+  exceeds the caller's remaining deadline budget — accepting would only
+  convert this 503-now into a 504-later that still occupied a slot.
+
+Event-loop confined (admit/release run on the loop thread, like the
+single-flight table), so no lock.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..utils import telemetry, transient
+from .errors import OverloadedError
+
+
+class AdmissionController:
+    """Depth- and deadline-aware load shedding for the render path."""
+
+    # EWMA weight for per-render service time (seconds).
+    ALPHA = 0.2
+
+    def __init__(self, max_queue: int, renderer=None,
+                 retry_after_s: float = 1.0):
+        if max_queue < 1:
+            raise ValueError("admission max_queue must be >= 1")
+        self.max_queue = max_queue
+        self.renderer = renderer          # duck-typed; lanes estimate
+        self.retry_after_s = retry_after_s
+        self.inflight = 0                 # admitted, not yet released
+        self.ewma_s: Optional[float] = None
+        self.admitted_total = 0
+        self.shed_total = 0
+
+    def _lanes(self) -> int:
+        return max(1, getattr(self.renderer, "device_lanes", 1))
+
+    def estimated_wait_ms(self) -> float:
+        """Expected queueing delay for a request admitted now."""
+        if self.ewma_s is None:
+            return 0.0
+        return self.inflight * self.ewma_s * 1000.0 / self._lanes()
+
+    def admit(self) -> float:
+        """Claim a slot or shed.  Returns the admission timestamp the
+        caller hands back to :meth:`release`."""
+        if self.inflight >= self.max_queue:
+            self.shed_total += 1
+            telemetry.RESILIENCE.count_shed("queue-full")
+            raise OverloadedError(
+                f"admission queue full ({self.inflight} renders "
+                f"in flight)",
+                retry_after_s=max(self.retry_after_s,
+                                  self.estimated_wait_ms() / 1000.0))
+        remaining = transient.remaining_ms()
+        if remaining is not None:
+            est = self.estimated_wait_ms()
+            if est > remaining:
+                # Accepting would convert this shed into a guaranteed
+                # deadline miss that still held a slot the whole time.
+                self.shed_total += 1
+                telemetry.RESILIENCE.count_shed("deadline")
+                raise OverloadedError(
+                    f"estimated wait {est:.0f} ms exceeds remaining "
+                    f"deadline budget {remaining:.0f} ms",
+                    retry_after_s=max(self.retry_after_s, est / 1000.0))
+        self.inflight += 1
+        self.admitted_total += 1
+        return time.monotonic()
+
+    def release(self, t_admit: float, completed: bool = True) -> None:
+        """Free the slot; completed renders feed the service-time EWMA
+        (sheds and failures must not drag the estimate down)."""
+        self.inflight = max(0, self.inflight - 1)
+        if not completed:
+            return
+        dur = time.monotonic() - t_admit
+        self.ewma_s = (dur if self.ewma_s is None
+                       else self.ewma_s + self.ALPHA * (dur - self.ewma_s))

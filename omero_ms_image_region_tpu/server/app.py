@@ -109,6 +109,15 @@ def _make_session_store(config: AppConfig) -> Optional[SessionStore]:
     return None
 
 
+def _install_fault_injection(config: AppConfig) -> None:
+    """Arm the seeded chaos layer when the config asks for it.  Guarded
+    on the seed so a default config can never clobber an injector a
+    test installed directly."""
+    if config.fault_injection.seed is not None:
+        from ..utils import faultinject
+        faultinject.install(config.fault_injection)
+
+
 def build_services(config: AppConfig) -> "ImageRegionServices":
     """Construct the full render service stack for one device-owning
     process (shared by the in-process app and the render sidecar)."""
@@ -117,6 +126,7 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
     # compile event with a seconds-scale duration.  Installed before
     # anything can compile.
     telemetry.install_compile_listener()
+    _install_fault_injection(config)
     if config.renderer.compilation_cache_dir:
         # Warm restarts: compiled executables persist across processes
         # (measured 11 s -> 1.5 s first render after restart).  Set
@@ -140,6 +150,17 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
             num_processes=config.parallel.num_processes,
             process_id=config.parallel.process_id)
         import jax
+        if jax.process_count() > 1:
+            from ..utils import faultinject
+            if faultinject.active() is not None:
+                # Chaos on one pod process stalls/re-launches ITS SPMD
+                # lockstep sequence only and hangs the slice; config
+                # load rejects explicit multi-host + seed, and this
+                # disarms the auto-discovered-pod case.
+                log.warning("multi-host pod: disarming fault "
+                            "injection (chaos would diverge SPMD "
+                            "lockstep)")
+                faultinject.uninstall()
         if jax.process_count() > 1 and jax.process_index() != 0:
             raise ValueError(
                 "mesh-serving leader must be process 0 of the pod; "
@@ -250,6 +271,14 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         # coalesce onto one pipeline run (server.handler.SingleFlight).
         from .handler import SingleFlight
         services.single_flight = SingleFlight()
+    if config.fault_tolerance.admission_max_queue > 0:
+        # Bounded admission in front of the batcher: overload sheds
+        # with 503 + Retry-After instead of queueing toward a timeout.
+        from .admission import AdmissionController
+        services.admission = AdmissionController(
+            config.fault_tolerance.admission_max_queue,
+            renderer=renderer,
+            retry_after_s=config.fault_tolerance.shed_retry_after_s)
     if services.raw_cache is not None and config.raw_cache.prefetch:
         from ..services.prefetch import TilePrefetcher
         services.prefetcher = TilePrefetcher(services.raw_cache)
@@ -304,11 +333,29 @@ def create_app(config: Optional[AppConfig] = None,
     proxy_mode = (services is None and config.sidecar.socket
                   and config.sidecar.role == "frontend")
     if proxy_mode:
+        from ..utils.transient import CircuitBreaker, RetryPolicy
         from .sidecar import (SidecarClient, SidecarImageHandler,
                               SidecarMaskHandler)
-        client = SidecarClient(config.sidecar.socket)
-        image_handler = SidecarImageHandler(client)
-        mask_handler = SidecarMaskHandler(client)
+        _install_fault_injection(config)
+        ft = config.fault_tolerance
+        client = SidecarClient(
+            config.sidecar.socket,
+            breaker=CircuitBreaker(
+                failure_threshold=ft.breaker_failure_threshold,
+                reset_after_s=ft.breaker_reset_s),
+            retry=RetryPolicy(
+                max_attempts=ft.retry_max_attempts,
+                base_backoff_s=ft.retry_base_backoff_ms / 1000.0,
+                max_backoff_s=ft.retry_max_backoff_ms / 1000.0))
+        fallback = None
+        if ft.degraded_mode:
+            # Graceful degradation: while the device backend is down,
+            # tiles render on this process's CPU reference path
+            # (server.degraded — jax-free) at reduced rate.
+            from .degraded import DegradedCpuHandler
+            fallback = DegradedCpuHandler(config)
+        image_handler = SidecarImageHandler(client, fallback=fallback)
+        mask_handler = SidecarMaskHandler(client, fallback=fallback)
         services = None
     else:
         from .handler import ImageRegionHandler, ShapeMaskHandler
@@ -338,11 +385,47 @@ def create_app(config: Optional[AppConfig] = None,
 
     def _status_of(e: Exception) -> web.Response:
         """Failure-code mapping with the reference's empty 404/500 bodies
-        (``ImageRegionMicroserviceVerticle.java:314-323``)."""
+        (``ImageRegionMicroserviceVerticle.java:314-323``), extended by
+        the fault-tolerance statuses (``server.errors`` documents the
+        full contract): shed -> 503 + Retry-After, spent deadline ->
+        504.  Never a traceback: unexpected exceptions log server-side
+        and answer an empty 500."""
+        from .errors import DeadlineExceededError, OverloadedError
         if isinstance(e, BadRequestError):
             return web.Response(status=400, text=str(e))
         if isinstance(e, (NotFoundError, FileNotFoundError)):
             return web.Response(status=404)
+        if isinstance(e, OverloadedError):
+            # Honoring Retry-After spreads the client retry storm past
+            # the congestion (or breaker-reset) window.
+            retry_after = max(1, round(e.retry_after_s))
+            return web.json_response(
+                {"error": str(e)}, status=503,
+                headers={"Retry-After": str(retry_after)})
+        if isinstance(e, ConnectionError):
+            # The render backend is unreachable (connection died
+            # through every policy retry).  That is an AVAILABILITY
+            # failure, not a server bug: 503 + Retry-After tells the
+            # client to come back once the supervisor (or operator)
+            # has the sidecar serving again — never a bare 500.
+            telemetry.RESILIENCE.count_shed("sidecar-unreachable")
+            retry_after = max(1, round(
+                config.fault_tolerance.shed_retry_after_s))
+            return web.json_response(
+                {"error": "render backend unreachable"}, status=503,
+                headers={"Retry-After": str(retry_after)})
+        if isinstance(e, DeadlineExceededError):
+            return web.json_response({"error": str(e)}, status=504)
+        from ..utils.transient import is_transient_device_error
+        if is_transient_device_error(e):
+            # Combined-mode twin of the sidecar's mapping: a transport
+            # drop that outlived the group-render retry is weather the
+            # client retries through, not a bug — shed class, not 500.
+            log.warning("render failed on a transient device "
+                        "transport error: %s", e)
+            return web.json_response(
+                {"error": "transient device transport error"},
+                status=503, headers={"Retry-After": "1"})
         log.exception("render failed")
         return web.Response(status=500)
 
@@ -442,11 +525,15 @@ def create_app(config: Optional[AppConfig] = None,
         dump."""
         import time as _time
 
+        from ..utils.transient import deadline_scope
+        deadline_ms = config.fault_tolerance.request_deadline_ms
+
         async def wrapper(request: web.Request) -> web.Response:
             trace_id = telemetry.new_trace_id()
             t0 = _time.perf_counter()
             try:
-                with telemetry.trace_scope(trace_id, route):
+                with telemetry.trace_scope(trace_id, route), \
+                        deadline_scope(deadline_ms):
                     resp = await handler(request)
             except BaseException:
                 # Client-disconnect cancellation (or a handler bug)
@@ -476,6 +563,10 @@ def create_app(config: Optional[AppConfig] = None,
 
         lines = telemetry.request_metric_lines()
         lines += span_lines()
+        # Fault-tolerance series: breaker state (proxy mode), sheds,
+        # retries, deadline cancellations, supervisor restarts.
+        lines += telemetry.resilience_metric_lines(
+            breaker=(client.breaker if services is None else None))
         if services is None:
             # Frontend proxy: local series plus the device process's
             # fetched over the sidecar socket (best-effort with a hard
@@ -511,6 +602,11 @@ def create_app(config: Optional[AppConfig] = None,
         max_depth = config.telemetry.ready_max_queue_depth
         if services is None:
             import asyncio as _asyncio
+            breaker = client.breaker
+            if breaker is not None and breaker.state == breaker.OPEN:
+                # Fail-fast surface: the probe log says WHY requests
+                # are shedding before the ping below even times out.
+                checks["breaker"] = "open"
             try:
                 status, body = await _asyncio.wait_for(
                     client.call("ping", {}), timeout=2.0)
@@ -524,7 +620,15 @@ def create_app(config: Optional[AppConfig] = None,
                 prewarm_pending = bool(info.get("prewarm_pending"))
                 depth = int(info.get("queue_depth", 0))
             except Exception:
-                return False, {"sidecar": "unreachable"}
+                checks["sidecar"] = "unreachable"
+                if fallback is not None:
+                    # Degraded mode IS servable: the CPU fallback keeps
+                    # answering tiles, so a load balancer must keep
+                    # routing here — the probe body carries the
+                    # degradation for operators and alerting.
+                    checks["degraded-mode"] = "active"
+                    return True, checks
+                return False, checks
         else:
             prewarm_pending = telemetry.READINESS.prewarm_pending
             renderer = services.renderer
@@ -794,15 +898,31 @@ def main(argv=None) -> None:
         return
 
     child = None
+    supervisor = None
     if config.sidecar.role == "split":
-        from .sidecar import spawn_sidecar
-        child = spawn_sidecar(args.config, config.sidecar.socket,
-                              extra_args=(["--data-dir", args.data_dir]
-                                          if args.data_dir else None))
+        extra = ["--data-dir", args.data_dir] if args.data_dir else None
+        if config.fault_tolerance.supervise:
+            # Supervised child (the reference's Vert.x supervisor
+            # posture): a sidecar crash restarts it with capped
+            # backoff; /readyz holds traffic until the restart's
+            # prewarm gate clears.  fault-tolerance.supervise: false
+            # restores the bare spawn (orchestrator-managed restarts).
+            from .sidecar import SidecarSupervisor
+            supervisor = SidecarSupervisor.for_config(
+                args.config, config.sidecar.socket, extra_args=extra,
+                max_backoff_s=(
+                    config.fault_tolerance.supervisor_max_backoff_s))
+            supervisor.start()
+        else:
+            from .sidecar import spawn_sidecar
+            child = spawn_sidecar(args.config, config.sidecar.socket,
+                                  extra_args=extra)
         config.sidecar.role = "frontend"
     try:
         run_app(create_app(config), config)
     finally:
+        if supervisor is not None:
+            supervisor.stop()
         if child is not None:
             child.terminate()
             try:

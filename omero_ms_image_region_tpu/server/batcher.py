@@ -74,6 +74,10 @@ class _Pending:
     future: asyncio.Future = None  # type: ignore[assignment]
     t_enqueue: float = 0.0        # queue-wait waterfall span
     trace_id: str = None          # type: ignore[assignment]  # requester
+    # Absolute time.monotonic() budget (utils.transient); queued work
+    # whose budget is spent is cancelled at dispatch pop, never
+    # rendered for a caller that already gave up.
+    deadline: float = None        # type: ignore[assignment]
 
 
 class BatchingRenderer:
@@ -124,6 +128,11 @@ class BatchingRenderer:
         # on multi-host meshes: a lone host re-launching would diverge
         # the pod's SPMD launch sequence.
         self._transient_retry_enabled = True
+        # Deadline-expired pendings are failed at dispatch pop instead
+        # of rendered.  Safe on multi-host meshes too — the drop
+        # happens on the LEADER before the group is announced, so every
+        # process replays the identical post-drop group.
+        self._deadline_drop_enabled = True
         self.linger_ms = linger_ms
         # Preferred concurrent group count under backlog (see
         # BatcherConfig.target_inflight: default 1 = max_batch convoys,
@@ -220,9 +229,11 @@ class BatchingRenderer:
                int(settings["cd_end"]), settings["tables"].ndim,
                str(raw.dtype))
 
+        from ..utils.transient import deadline as _deadline
         pending = _Pending(raw=raw, settings=settings, h=h, w=w,
                            future=asyncio.get_running_loop().create_future(),
-                           trace_id=telemetry.current_trace_id())
+                           trace_id=telemetry.current_trace_id(),
+                           deadline=_deadline())
         return await self._enqueue(key, pending)
 
     async def render_jpeg(self, raw: np.ndarray, settings: dict,
@@ -246,10 +257,12 @@ class BatchingRenderer:
         key = ("jpeg", C, bh, bw, int(settings["cd_start"]),
                int(settings["cd_end"]), settings["tables"].ndim, quality,
                str(raw.dtype))
+        from ..utils.transient import deadline as _deadline
         pending = _Pending(raw=raw, settings=settings, h=height, w=width,
                            quality=quality,
                            future=asyncio.get_running_loop().create_future(),
-                           trace_id=telemetry.current_trace_id())
+                           trace_id=telemetry.current_trace_id(),
+                           deadline=_deadline())
         return await self._enqueue(key, pending)
 
     async def _enqueue(self, key: tuple, pending: _Pending):
@@ -329,8 +342,28 @@ class BatchingRenderer:
             # loop's await points) can never orphan a popped group.
             group: List[_Pending] = []
             take = self._pop_size(len(queue))
+            now_mono = time.monotonic()
+            expired: List[_Pending] = []
             while queue and len(group) < take:
-                group.append(queue.popleft())
+                p = queue.popleft()
+                if (self._deadline_drop_enabled
+                        and p.deadline is not None
+                        and now_mono >= p.deadline):
+                    # Budget died in the queue: cancel cooperatively
+                    # instead of rendering for a caller that already
+                    # gave up — the slot goes to work that can still
+                    # make its deadline.
+                    expired.append(p)
+                    continue
+                group.append(p)
+            if expired:
+                from ..utils.transient import DeadlineExceededError
+                telemetry.RESILIENCE.count_deadline_cancelled(
+                    len(expired))
+                for p in expired:
+                    if not p.future.done():
+                        p.future.set_exception(DeadlineExceededError(
+                            "deadline exceeded in batch queue"))
             if not group:
                 slots.release()
                 continue
@@ -391,15 +424,26 @@ class BatchingRenderer:
         the HTTP layer's ``except Exception`` mapping and drop the
         connection without a response.
         """
+        from ..utils import faultinject
+
+        def render_hooked():
+            # Chaos hook: a seeded injector raises a transient device
+            # error here, so the retry path under test is the
+            # production retry_transient, not a double.
+            inj = faultinject.active()
+            if inj is not None:
+                inj.maybe_device_error()
+            return render(group)
+
         if self._transient_retry_enabled:
             from ..utils.transient import retry_transient
             # Short backoff: the slot (and every request in the group)
             # waits it out, so a serving retry must not stall the
             # pipeline the way the bench's section-level retry may.
             run_inner = lambda: retry_transient(  # noqa: E731
-                lambda: render(group), "group render", backoff_s=0.25)
+                render_hooked, "group render", backoff_s=0.25)
         else:
-            run_inner = lambda: render(group)     # noqa: E731
+            run_inner = render_hooked
         trace_ids = tuple(p.trace_id for p in group if p.trace_id)
 
         def run():
@@ -459,6 +503,16 @@ class BatchingRenderer:
         running serially behind it.  Host stacks go through the packed
         stager (uint16 content crosses the link ~1.4x smaller); batches
         with device-resident members are already staged."""
+        from ..utils import faultinject
+        inj = faultinject.active()
+        if inj is not None:
+            freeze = inj.freeze_s()
+            if freeze > 0:
+                # Chaos hook: a wedged device lane.  Requests queued
+                # behind it either shed at admission or cancel at
+                # dispatch pop when their budgets die — the stall must
+                # never back traffic up unboundedly.
+                time.sleep(freeze)
         with stopwatch("batcher.stage"):
             raw, stack = self._group_arrays(group)
             if isinstance(raw, np.ndarray):

@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 import yaml
 
 from ..services.cache import CacheConfig
+from ..utils.faultinject import FaultInjectionConfig
 
 
 @dataclass
@@ -151,6 +152,47 @@ class ParallelConfig:
 
 
 @dataclass
+class FaultToleranceConfig:
+    """The fault-tolerant serving chain's knobs (the reference leaned
+    on Vert.x supervisor restarts and bounded event-loop backpressure;
+    these are the TPU build's equivalents — see deploy/DEPLOY.md's
+    failure-mode runbook)."""
+
+    # Per-request time budget, opened at the HTTP frontend and carried
+    # over the sidecar wire; queued work whose budget is spent is
+    # cancelled cooperatively (504), never rendered for nobody.
+    # 0 disables deadlines.
+    request_deadline_ms: float = 0.0
+    # Sidecar circuit breaker: this many CONSECUTIVE connection
+    # failures trip it open; after breaker-reset-s one trial call is
+    # admitted (half-open).  Open = calls fail fast with 503.
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 5.0
+    # Op-aware sidecar retry: idempotent ops (render, probe, ping)
+    # get up to this many total attempts with capped exponential
+    # backoff + jitter; plane_put is NEVER auto-retried.
+    retry_max_attempts: int = 3
+    retry_base_backoff_ms: float = 25.0
+    retry_max_backoff_ms: float = 1000.0
+    # Admission control: at most this many admitted-but-unfinished
+    # renders; beyond it (or when the estimated wait exceeds the
+    # caller's remaining deadline) requests shed with 503 +
+    # Retry-After instead of queueing toward a timeout.  0 disables.
+    admission_max_queue: int = 512
+    shed_retry_after_s: float = 1.0
+    # Degraded mode: while the sidecar is unreachable (connection dead
+    # or breaker open), frontends render on the in-process CPU
+    # reference path (refimpl) so tiles stay servable at reduced rate.
+    # Off by default: it requires the frontend host to mount data-dir.
+    degraded_mode: bool = False
+    # --role split: supervise the sidecar child — restart with capped
+    # backoff on crash; the respawn gate (socket accept + prewarm via
+    # /readyz) holds traffic until the device stack is back.
+    supervise: bool = True
+    supervisor_max_backoff_s: float = 30.0
+
+
+@dataclass
 class TelemetryConfig:
     """Tracing / health-probe knobs (utils.telemetry; ≙ the reference's
     optional metrics beans, ``beanRefContext.xml:36-46`` — Graphite
@@ -238,6 +280,11 @@ class AppConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     sidecar: SidecarConfig = field(default_factory=SidecarConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    fault_tolerance: FaultToleranceConfig = field(
+        default_factory=FaultToleranceConfig)
+    # Seeded chaos layer (utils.faultinject); seed absent = disabled.
+    fault_injection: FaultInjectionConfig = field(
+        default_factory=FaultInjectionConfig)
 
     @classmethod
     def from_yaml(cls, path: str) -> "AppConfig":
@@ -403,6 +450,81 @@ class AppConfig:
         if cfg.telemetry.ready_max_queue_depth < 1:
             raise ValueError("telemetry.ready-max-queue-depth must be "
                              ">= 1")
+        ft = raw.get("fault-tolerance", {}) or {}
+        ft_defaults = FaultToleranceConfig()
+        cfg.fault_tolerance = FaultToleranceConfig(
+            request_deadline_ms=float(ft.get(
+                "request-deadline-ms",
+                ft_defaults.request_deadline_ms)),
+            breaker_failure_threshold=int(ft.get(
+                "breaker-failure-threshold",
+                ft_defaults.breaker_failure_threshold)),
+            breaker_reset_s=float(ft.get(
+                "breaker-reset-s", ft_defaults.breaker_reset_s)),
+            retry_max_attempts=int(ft.get(
+                "retry-max-attempts", ft_defaults.retry_max_attempts)),
+            retry_base_backoff_ms=float(ft.get(
+                "retry-base-backoff-ms",
+                ft_defaults.retry_base_backoff_ms)),
+            retry_max_backoff_ms=float(ft.get(
+                "retry-max-backoff-ms",
+                ft_defaults.retry_max_backoff_ms)),
+            admission_max_queue=int(ft.get(
+                "admission-max-queue",
+                ft_defaults.admission_max_queue)),
+            shed_retry_after_s=float(ft.get(
+                "shed-retry-after-s", ft_defaults.shed_retry_after_s)),
+            degraded_mode=bool(ft.get("degraded-mode",
+                                      ft_defaults.degraded_mode)),
+            supervise=bool(ft.get("supervise", ft_defaults.supervise)),
+            supervisor_max_backoff_s=float(ft.get(
+                "supervisor-max-backoff-s",
+                ft_defaults.supervisor_max_backoff_s)),
+        )
+        if cfg.fault_tolerance.request_deadline_ms < 0:
+            raise ValueError("fault-tolerance.request-deadline-ms must "
+                             "be >= 0")
+        if cfg.fault_tolerance.breaker_failure_threshold < 1:
+            raise ValueError("fault-tolerance.breaker-failure-threshold "
+                             "must be >= 1")
+        if cfg.fault_tolerance.retry_max_attempts < 1:
+            raise ValueError("fault-tolerance.retry-max-attempts must "
+                             "be >= 1")
+        if cfg.fault_tolerance.admission_max_queue < 0:
+            raise ValueError("fault-tolerance.admission-max-queue must "
+                             "be >= 0 (0 disables admission control)")
+        fi = raw.get("fault-injection", {}) or {}
+        fi_defaults = FaultInjectionConfig()
+        cfg.fault_injection = FaultInjectionConfig(
+            seed=(int(fi["seed"]) if fi.get("seed") is not None
+                  else None),
+            wire_drop_rate=float(fi.get(
+                "wire-drop-rate", fi_defaults.wire_drop_rate)),
+            wire_truncate_rate=float(fi.get(
+                "wire-truncate-rate", fi_defaults.wire_truncate_rate)),
+            wire_delay_rate=float(fi.get(
+                "wire-delay-rate", fi_defaults.wire_delay_rate)),
+            wire_delay_ms=float(fi.get(
+                "wire-delay-ms", fi_defaults.wire_delay_ms)),
+            device_error_rate=float(fi.get(
+                "device-error-rate", fi_defaults.device_error_rate)),
+            freeze_rate=float(fi.get(
+                "freeze-rate", fi_defaults.freeze_rate)),
+            freeze_ms=float(fi.get("freeze-ms", fi_defaults.freeze_ms)),
+            die_after_requests=int(fi.get(
+                "die-after-requests", fi_defaults.die_after_requests)),
+        ).validate()   # rate/delay bounds fail at load, not mid-serving
+        if (cfg.fault_injection.seed is not None
+                and (raw.get("parallel", {}) or {}).get("enabled")
+                and int((raw.get("parallel", {}) or {})
+                        .get("num-processes") or 1) > 1):
+            # Chaos fires on whatever process installed it; on a
+            # multi-host pod that stalls/re-launches ONE process's SPMD
+            # lockstep sequence and hangs the slice.  (Auto-discovered
+            # pods without explicit coordinates are disarmed at
+            # bring-up instead — see build_services.)
+            raise ValueError("fault-injection.seed cannot be combined "
+                             "with a multi-host parallel config")
         rd = raw.get("renderer", {}) or {}
         rd_defaults = RendererConfig()
         cfg.renderer = RendererConfig(

@@ -2,9 +2,40 @@
 stack (frontend proxy processes must not pull JAX just for the error
 contract).  ``BadRequestError`` lives in :mod:`.ctx` next to the
 parsers; this module holds the rest.
+
+The full status contract (shared by the app's ``_status_of`` and the
+sidecar wire's ``_map_status`` so a failure mode keeps one status no
+matter which process it surfaced in):
+
+  ========================  ======  ================================
+  exception                 status  body
+  ========================  ======  ================================
+  BadRequestError           400     message text
+  NotFoundError             404     empty
+  OverloadedError           503     JSON ``{"error": ...}`` +
+                                    ``Retry-After`` header
+  DeadlineExceededError     504     JSON ``{"error": ...}``
+  anything else             500     empty (never a traceback)
+  ========================  ======  ================================
 """
+
+from ..utils.transient import DeadlineExceededError  # noqa: F401
+# (re-export: the deadline machinery lives with the other resilience
+# primitives in utils.transient; the HTTP status contract lives here)
 
 
 class NotFoundError(Exception):
     """Maps to HTTP 404 (the reference's ObjectNotFound / unreadable /
     unrenderable outcomes; ``ImageRegionVerticle.java:163-188``)."""
+
+
+class OverloadedError(Exception):
+    """The service refuses work it cannot finish — admission-queue
+    shed, or a tripped sidecar circuit breaker.  Maps to HTTP 503 with
+    a ``Retry-After`` of :attr:`retry_after_s` (clients that honor it
+    spread the retry storm past the congestion window)."""
+
+    def __init__(self, message: str = "service overloaded",
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = max(0.0, float(retry_after_s))

@@ -198,7 +198,19 @@ class SingleFlight:
     async def run(self, key: str, producer):
         """``(result, coalesced)`` — ``producer()`` runs at most once
         per key at a time; followers share the leader's outcome
-        (result OR exception)."""
+        (result OR exception).
+
+        Deadlines: the shared task inherits the LEADER's budget — it
+        is the leader's pipeline run, and that budget is what lets
+        admission's estimated-wait shed and the batcher's dispatch-pop
+        cancellation fire on it.  Each waiter additionally enforces
+        its OWN remaining budget on the await side, so a FOLLOWER
+        whose budget dies gets its 504 without cancelling the render
+        the other waiters depend on (a follower's deadline never
+        touches the shared task; only the leader's budget — the one
+        the run was admitted under — can cancel queued work)."""
+        from ..utils import transient
+
         task = self._inflight.get(key)
         if (task is not None
                 and task.get_loop() is not asyncio.get_running_loop()):
@@ -221,7 +233,19 @@ class SingleFlight:
             task.add_done_callback(_cleanup)
         else:
             self.hits += 1
-        return await asyncio.shield(task), coalesced
+        remaining = transient.remaining_ms()
+        if remaining is None:
+            return await asyncio.shield(task), coalesced
+        try:
+            # wait_for cancels only the shield wrapper on timeout; the
+            # shared task (and its byte-cache write-back) runs on.
+            result = await asyncio.wait_for(
+                asyncio.shield(task), timeout=max(0.0, remaining)
+                / 1000.0)
+        except asyncio.TimeoutError:
+            raise transient.DeadlineExceededError(
+                "deadline exceeded awaiting coalesced render")
+        return result, coalesced
 
 
 @dataclass
@@ -240,6 +264,9 @@ class ImageRegionServices:
     prefetcher: object = None         # services.prefetch.TilePrefetcher
     # In-flight render dedup (SingleFlight); None disables coalescing.
     single_flight: object = None
+    # Admission control / load shedding (server.admission); None
+    # admits everything (the batcher queues unboundedly).
+    admission: object = None
     # Renders at or below this pixel count take the CPU reference kernel
     # (refimpl) instead of a device round trip — the SURVEY north star's
     # fallback path, and a latency win for tiny tiles anywhere the
@@ -249,21 +276,10 @@ class ImageRegionServices:
     cpu_fallback_max_px: int = 256 * 256
 
 
-def _restrict_to_active(rdef: RenderingDef) -> Tuple[RenderingDef, List[int]]:
-    """Drop inactive channel bindings so the device never reads or
-    composites planes that contribute nothing.
-
-    The reference reads all active channels inside ``renderAsPackedInt``;
-    inactive channels in our kernel would be zero tables — correct but
-    wasted I/O and HBM.  Order is preserved, so greyscale first-active
-    semantics survive.
-    """
-    from dataclasses import replace
-    active = rdef.active_channels()
-    out = rdef.copy()
-    out.channel_bindings = [replace(rdef.channel_bindings[i])
-                            for i in active]
-    return out, active
+from ..models.rendering import restrict_to_active \
+    as _restrict_to_active  # noqa: E402  (shared with server.degraded
+# so the device pipeline and the CPU fallback cannot silently diverge
+# on channel selection)
 
 
 async def check_can_read(services: ImageRegionServices, object_type: str,
@@ -343,12 +359,42 @@ class ImageRegionHandler:
         single_flight = self.s.single_flight
 
         async def produce() -> bytes:
-            data = await self._get_region(ctx, pixels)
+            # Admission control sits HERE — after the byte cache (hits
+            # are nearly free and must never shed) and inside the
+            # single-flight producer (a coalesced follower adds no
+            # work, so only the leader's pipeline run claims a slot).
+            admission = self.s.admission
+            t_admit = admission.admit() if admission is not None \
+                else None
+            completed = False
+            try:
+                from ..utils.transient import check_deadline
+                check_deadline("render pipeline")
+                data = await self._get_region(ctx, pixels)
+                completed = True
+            finally:
+                if admission is not None:
+                    admission.release(t_admit, completed=completed)
             await self.s.caches.image_region.set(ctx.cache_key, data)
             return data
 
         if single_flight is None:
-            return await produce()
+            # Deadline-bounded await even without coalescing: a group
+            # popped before its members' budgets died can still wedge
+            # in the device thread, and the caller must get its 504 at
+            # budget end, not hang behind the lane (the device work
+            # itself cannot be interrupted; its future settles into
+            # the void).
+            from ..utils import transient
+            remaining = transient.remaining_ms()
+            if remaining is None:
+                return await produce()
+            try:
+                return await asyncio.wait_for(
+                    produce(), timeout=max(0.0, remaining) / 1000.0)
+            except asyncio.TimeoutError:
+                raise transient.DeadlineExceededError(
+                    "deadline exceeded awaiting render")
         # Coalesce concurrent identical requests onto one pipeline run:
         # the leader renders and writes the byte cache back; followers
         # settle from the same task.  ACL already ran per caller above,

@@ -39,6 +39,17 @@ pushed by any frontend/ingester, or read by the sidecar itself — never
 crosses the wire twice.  v1 peers reject the new ops with status 400
 and everything else is unchanged, so mixed-version deployments degrade
 to always-upload, never to an error surface.
+
+Fault-tolerance fields (all optional, all tolerated absent, so they
+are not a wire-version bump): a request may carry ``deadline_ms`` —
+the requester's REMAINING budget, re-anchored on the server's own
+clock (absolute times never cross the wire); a spent budget answers
+status 504 without rendering.  Responses may carry status 503
+(admission shed) with ``retry_after`` seconds, and 504 (deadline).
+Client-side policy — op-aware retry with capped backoff + jitter and a
+consecutive-failure circuit breaker — lives in
+:class:`SidecarClient`/:mod:`..utils.transient`; ``plane_put`` is
+never auto-retried.
 """
 
 from __future__ import annotations
@@ -197,10 +208,34 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
             await writer.drain()
 
     async def handle(header: dict, req_body: bytes = b"") -> None:
+        from ..utils import faultinject, transient
+        from .errors import OverloadedError
+
         rid = header.get("id")
         spans = None
+        inj = faultinject.active()
+        if inj is not None and inj.sidecar_should_die():
+            # Supervision drill: die MID-call, the way a real crash
+            # does — the peer sees the connection drop with this
+            # request unanswered, and the supervisor must bring the
+            # process back without operator action.
+            logger.error("fault injection: sidecar self-kill "
+                         "(die-after-requests)")
+            os._exit(23)
+        # Re-anchor the requester's remaining budget on this process's
+        # clock; an already-spent budget answers 504 without rendering.
+        budget = header.get("deadline_ms")
+        try:
+            budget = float(budget) if budget is not None else None
+        except (TypeError, ValueError):
+            budget = None
+        # Per-task set, no scope: this handler task's context dies
+        # with it, and a generator scope would be GC'd cross-context
+        # when teardown cancels in-flight handlers.
+        transient.set_task_deadline(budget)
         try:
             op = header["op"]
+            transient.check_deadline(f"sidecar {op}")
             if op == "image" or op == "mask":
                 # Join the frontend's trace: device-side spans (render,
                 # wire fetch, encode) carry the requester's trace id,
@@ -250,6 +285,11 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 if handler_services is not None:
                     lines += telemetry.device_metric_lines(
                         handler_services, ',process="sidecar"')
+                # Device-side resilience counters (admission sheds,
+                # queue deadline cancellations) — the breaker gauge is
+                # frontend-local and stays out of this copy.
+                lines += telemetry.resilience_metric_lines(
+                    extra_labels=',process="sidecar"')
                 body = ("\n".join(lines) + "\n").encode()
             elif op == "plane_probe":
                 # Digest-first residency probe: the peer only ships the
@@ -276,13 +316,36 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 body = json.dumps(doc).encode()
             else:
                 raise BadRequestError(f"unknown op {op!r}")
+        except transient.DeadlineExceededError as e:
+            # The budget died while this request queued or rendered:
+            # 504, and the frontend does NOT retry (more attempts
+            # cannot make a spent budget whole).
+            body, out = b"", {"id": rid, "status": 504,
+                              "error": str(e)}
+        except OverloadedError as e:
+            # Admission shed: 503 + how long to back off.
+            body, out = b"", {"id": rid, "status": 503,
+                              "error": str(e),
+                              "retry_after": e.retry_after_s}
         except BadRequestError as e:
             body, out = b"", {"id": rid, "status": 400, "error": str(e)}
         except (NotFoundError, FileNotFoundError):
             body, out = b"", {"id": rid, "status": 404}
-        except Exception:
-            logger.exception("sidecar render failed")
-            body, out = b"", {"id": rid, "status": 500}
+        except Exception as e:
+            if transient.is_transient_device_error(e):
+                # A transport drop that survived even the group-render
+                # retry is an AVAILABILITY failure, not a server bug:
+                # 503 + Retry-After, the shed class — never a bare 500
+                # for weather the client should simply retry through.
+                logger.warning("render failed on a transient device "
+                               "transport error: %s", e)
+                body, out = b"", {"id": rid, "status": 503,
+                                  "error": "transient device "
+                                           "transport error",
+                                  "retry_after": 1.0}
+            else:
+                logger.exception("sidecar render failed")
+                body, out = b"", {"id": rid, "status": 500}
         else:
             out = {"id": rid, "status": 200}
         if spans:
@@ -454,10 +517,29 @@ class _Conn:
         self.writer = writer
         self.pending: Dict[int, asyncio.Future] = {}
         self.reader_task: Optional[asyncio.Task] = None
+        # Set (to the failure) BEFORE pendings are drained: a caller
+        # that raced the read loop's death — ensure_connected returned
+        # this generation an await ago — must fail at registration, not
+        # park a future no reader will ever resolve.
+        self.dead: Optional[BaseException] = None
+
+    def register(self, rid: int, fut: asyncio.Future) -> None:
+        """Park a waiter; refuses (raising the death cause) once the
+        connection is marked dead, closing the enqueue/fail_pending
+        race that could strand a request forever."""
+        if self.dead is not None:
+            raise ConnectionError(str(self.dead) or
+                                  "render sidecar went away")
+        self.pending[rid] = fut
 
     def fail_pending(self, exc: BaseException) -> None:
-        pending, self.pending = self.pending, {}
-        for fut in pending.values():
+        self.dead = exc
+        # Drain-until-empty, not a one-shot swap: anything registered
+        # between the swap and the loop's end (same-tick callbacks)
+        # would otherwise hang.  New registrations are already refused
+        # via ``dead`` above.
+        while self.pending:
+            _, fut = self.pending.popitem()
             if not fut.done():
                 fut.set_exception(exc)
 
@@ -466,10 +548,26 @@ class SidecarClient:
     """Multiplexed unix-socket client (one connection, many in-flight
     requests).  Reconnects lazily; in-flight requests fail fast when the
     sidecar goes away, mirroring the reference's ReplyException
-    propagation from a dead bus consumer."""
+    propagation from a dead bus consumer.
 
-    def __init__(self, socket_path: str):
+    Failure policy (utils.transient): idempotent ops (renders, probes,
+    ping, metrics) retry with capped exponential backoff + jitter when
+    the connection dies under them; ``plane_put`` — a state-changing
+    upload — is NEVER auto-retried.  Consecutive failures trip the
+    circuit breaker, after which calls fail fast
+    (``errors.OverloadedError`` -> 503) until a half-open trial
+    succeeds; pass ``breaker=None``/``retry=None`` to disable either."""
+
+    _DEFAULT = object()   # "construct the standard policy" sentinel
+
+    def __init__(self, socket_path: str, breaker=_DEFAULT,
+                 retry=_DEFAULT):
+        from ..utils.transient import CircuitBreaker, RetryPolicy
         self.socket_path = socket_path
+        self.breaker = (CircuitBreaker()
+                        if breaker is self._DEFAULT else breaker)
+        self.retry = (RetryPolicy()
+                      if retry is self._DEFAULT else retry)
         self._conn: Optional[_Conn] = None
         self._next_id = 0
         self._conn_lock = asyncio.Lock()
@@ -513,55 +611,135 @@ class SidecarClient:
 
     async def call(self, op: str, ctx_json: dict, body: bytes = b"",
                    extra: Optional[dict] = None):
-        """Returns (status, body_or_error).
+        """Returns (status, body_or_error)."""
+        resp_header, resp_body = await self.call_full(
+            op, ctx_json, body=body, extra=extra)
+        return (resp_header["status"],
+                resp_body if resp_header["status"] == 200
+                else resp_header.get("error", ""))
 
-        One transparent retry when the connection dies under the
+    async def call_full(self, op: str, ctx_json: dict,
+                        body: bytes = b"",
+                        extra: Optional[dict] = None):
+        """Returns (response_header, response_body).
+
+        Retries transparently when the connection dies under the
         request — at send time OR while awaiting the reply (on asyncio
         a write to a dead peer usually buffers fine and the failure
-        only surfaces through the read loop).  Renders are idempotent
-        pure reads — and the v2 plane ops idempotent content puts — so
-        re-issuing a request the dead sidecar may or may not have
-        executed is safe."""
+        only surfaces through the read loop) — but ONLY for ops the
+        retry policy declares idempotent: renders and probes are pure
+        reads, so re-issuing one the dead sidecar may or may not have
+        executed is safe; ``plane_put`` is not re-issued.  Consecutive
+        failures trip the breaker (fail-fast ``OverloadedError``); the
+        context's deadline caps backoffs and rides the wire as
+        ``deadline_ms`` so the device process inherits the remaining
+        budget."""
         import time as _time
 
-        for attempt in (0, 1):
-            conn = await self._ensure_connected()
-            self._next_id += 1
-            rid = self._next_id
-            loop = asyncio.get_running_loop()
-            fut: asyncio.Future = loop.create_future()
-            conn.pending[rid] = fut
-            header = {"id": rid, "op": op, "ctx": ctx_json,
-                      "v": WIRE_VERSION}
-            if extra:
-                header.update(extra)
-            trace_id = telemetry.current_trace_id()
-            if trace_id:
-                # The trace rides the wire so device-side spans join
-                # the requesting frontend's waterfall.
-                header["trace"] = trace_id
-            t_call = _time.perf_counter()
+        from ..utils import faultinject, transient
+        from .errors import OverloadedError
+
+        attempts = (self.retry.attempts_for(op)
+                    if self.retry is not None else 1)
+        attempt = 0
+        while True:
+            # Deadline BEFORE the breaker: a spent budget must not
+            # claim (and then abandon) the half-open probe slot.
+            transient.check_deadline(f"sidecar {op}")
+            if self.breaker is not None and not self.breaker.allow():
+                raise OverloadedError(
+                    f"sidecar circuit breaker open (op {op})",
+                    retry_after_s=self.breaker.retry_after_s() or 1.0)
+            conn: Optional[_Conn] = None
+            fut: Optional[asyncio.Future] = None
+            rid = 0
             try:
+                conn = await self._ensure_connected()
+                self._next_id += 1
+                rid = self._next_id
+                loop = asyncio.get_running_loop()
+                fut = loop.create_future()
+                conn.register(rid, fut)
+                header = {"id": rid, "op": op, "ctx": ctx_json,
+                          "v": WIRE_VERSION}
+                if extra:
+                    header.update(extra)
+                remaining = transient.remaining_ms()
+                if remaining is not None:
+                    # The REMAINING budget, not an absolute time: the
+                    # device process re-anchors on its own clock (wall
+                    # clocks never cross the wire).
+                    header["deadline_ms"] = max(0.0, round(remaining, 1))
+                trace_id = telemetry.current_trace_id()
+                if trace_id:
+                    # The trace rides the wire so device-side spans
+                    # join the requesting frontend's waterfall.
+                    header["trace"] = trace_id
+                t_call = _time.perf_counter()
+                inj = faultinject.active()
+                if inj is not None:
+                    delay = inj.wire_delay_s()
+                    if delay:
+                        await asyncio.sleep(delay)
+                    fault = inj.wire_fault()
+                    if fault is not None:
+                        await self._inject_wire_fault(conn, fault,
+                                                      header, body)
                 async with self._write_lock:
                     conn.writer.write(_pack(header, body))
                     await conn.writer.drain()
-                header, body = await fut
-            except (ConnectionError, OSError):
-                conn.pending.pop(rid, None)
-                if fut.done() and not fut.cancelled():
-                    fut.exception()   # mark retrieved (no log noise)
-                conn.writer.close()
-                if self._conn is conn:
-                    self._conn = None
-                if attempt == 0:
-                    continue
-                raise ConnectionError("render sidecar went away")
-            if trace_id and header.get("spans"):
+                if remaining is not None:
+                    # A wedged sidecar must not hold this caller past
+                    # its budget: stop waiting at budget end.  The
+                    # connection stays up — a late reply just finds no
+                    # parked future and is dropped by the read loop.
+                    try:
+                        resp_header, resp_body = await asyncio.wait_for(
+                            fut, timeout=max(0.0, remaining) / 1000.0)
+                    except asyncio.TimeoutError:
+                        conn.pending.pop(rid, None)
+                        raise transient.DeadlineExceededError(
+                            f"sidecar {op}: deadline exceeded awaiting "
+                            f"reply")
+                else:
+                    resp_header, resp_body = await fut
+            except (ConnectionError, OSError) as exc:
+                if conn is not None:
+                    conn.pending.pop(rid, None)
+                    if (fut is not None and fut.done()
+                            and not fut.cancelled()):
+                        fut.exception()   # mark retrieved (no noise)
+                    conn.writer.close()
+                    if self._conn is conn:
+                        self._conn = None
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                attempt += 1
+                if attempt >= attempts:
+                    telemetry.RESILIENCE.observe_attempts(op, attempt)
+                    raise ConnectionError(
+                        "render sidecar went away") from exc
+                telemetry.RESILIENCE.count_retry(op)
+                backoff = self.retry.backoff_s(attempt - 1)
+                remaining = transient.remaining_ms()
+                if remaining is not None:
+                    # Never sleep past the caller's budget: the next
+                    # loop iteration turns an exhausted budget into a
+                    # DeadlineExceededError instead of a long stall.
+                    backoff = min(backoff, max(0.0, remaining / 1000.0))
+                if backoff > 0:
+                    await asyncio.sleep(backoff)
+                continue
+            if self.breaker is not None:
+                self.breaker.record_success()
+            telemetry.RESILIENCE.observe_attempts(op, attempt + 1)
+            trace_id = telemetry.current_trace_id()
+            if trace_id and resp_header.get("spans"):
                 # Graft the device process's spans onto our waterfall.
                 # Their offsets are relative to the sidecar's request
                 # arrival; anchoring at our send time puts them at most
                 # one wire hop early — invisible at waterfall scale.
-                for s in header["spans"]:
+                for s in resp_header["spans"]:
                     try:
                         meta = {k: v for k, v in s.items()
                                 if k not in ("name", "start_ms",
@@ -572,9 +750,27 @@ class SidecarClient:
                             s["dur_ms"], trace_ids=(trace_id,), **meta)
                     except (KeyError, TypeError):
                         pass    # malformed span: drop it, keep serving
-            return (header["status"],
-                    body if header["status"] == 200
-                    else header.get("error", ""))
+            return resp_header, resp_body
+
+    async def _inject_wire_fault(self, conn: _Conn, kind: str,
+                                 header: dict, body: bytes) -> None:
+        """Chaos hook: make the connection die under this request the
+        way a real wire failure would — ``drop`` never sends, and
+        ``truncate`` ships a partial frame (the sidecar's read loop
+        sees the mid-frame EOF too) — then raise the ConnectionError
+        the retry/breaker path handles."""
+        if kind == "truncate":
+            frame = _pack(header, body)
+            async with self._write_lock:
+                conn.writer.write(frame[:max(1, len(frame) // 2)])
+                try:
+                    await conn.writer.drain()
+                except (ConnectionError, OSError):
+                    pass
+        conn.writer.close()
+        if self._conn is conn:
+            self._conn = None
+        raise ConnectionError(f"injected wire fault: {kind}")
 
     async def stage_plane(self, arr, digest: Optional[str] = None):
         """Digest-first plane push (protocol v2): probe the sidecar's
@@ -644,32 +840,78 @@ class SidecarClient:
 class SidecarImageHandler:
     """Drop-in for ``ImageRegionHandler`` on the frontend side: same
     call surface, same exception contract (the app's status mapping is
-    reused verbatim)."""
+    reused verbatim).
 
-    def __init__(self, client: SidecarClient):
+    ``fallback`` (``server.degraded.DegradedCpuHandler``) is the
+    graceful-degradation seam: when the device backend is UNREACHABLE —
+    the connection (and every policy retry) died, or the circuit
+    breaker is open — the render runs on the frontend's in-process CPU
+    reference path instead, so tiles stay servable at reduced rate.
+    Sidecar-reported errors (it answered: 4xx, its own shed, deadline)
+    never fall back — a live sidecar's verdict stands."""
+
+    def __init__(self, client: SidecarClient, fallback=None):
         self.client = client
+        self.fallback = fallback
 
     async def render_image_region(self, ctx: ImageRegionCtx) -> bytes:
-        status, payload = await self.client.call("image", ctx.to_json())
-        return _map_status(status, payload)
+        from .errors import OverloadedError
+        try:
+            resp_header, payload = await self.client.call_full(
+                "image", ctx.to_json())
+        except (ConnectionError, OverloadedError):
+            if self.fallback is None:
+                raise
+            telemetry.RESILIENCE.count_degraded_render()
+            return await self.fallback.render_image_region(ctx)
+        return _map_response(resp_header, payload)
 
 
 class SidecarMaskHandler:
-    def __init__(self, client: SidecarClient):
+    def __init__(self, client: SidecarClient, fallback=None):
         self.client = client
+        self.fallback = fallback
 
     async def render_shape_mask(self, ctx: ShapeMaskCtx) -> bytes:
-        status, payload = await self.client.call("mask", ctx.to_json())
-        return _map_status(status, payload)
+        from .errors import OverloadedError
+        try:
+            resp_header, payload = await self.client.call_full(
+                "mask", ctx.to_json())
+        except (ConnectionError, OverloadedError):
+            if self.fallback is None:
+                raise
+            telemetry.RESILIENCE.count_degraded_render()
+            return await self.fallback.render_shape_mask(ctx)
+        return _map_response(resp_header, payload)
 
 
-def _map_status(status: int, payload):
+def _map_response(resp_header: dict, payload):
+    status = resp_header["status"]
+    return _map_status(
+        status, payload if status == 200
+        else resp_header.get("error", ""),
+        retry_after_s=resp_header.get("retry_after"))
+
+
+def _map_status(status: int, payload, retry_after_s=None):
+    """Wire status -> the one exception contract ``server.errors``
+    documents (the app's ``_status_of`` completes the round trip)."""
+    from .errors import OverloadedError
+    from ..utils.transient import DeadlineExceededError
     if status == 200:
         return payload
     if status == 400:
         raise BadRequestError(str(payload))
     if status == 404:
         raise NotFoundError()
+    if status == 503:
+        raise OverloadedError(
+            str(payload) or "sidecar overloaded",
+            retry_after_s=(retry_after_s if retry_after_s is not None
+                           else 1.0))
+    if status == 504:
+        raise DeadlineExceededError(str(payload)
+                                    or "sidecar deadline exceeded")
     raise RuntimeError(f"sidecar render failed ({status})")
 
 
@@ -700,27 +942,26 @@ def sidecar_main(config) -> None:
         pass
 
 
-def spawn_sidecar(config_path: Optional[str], socket_path: str,
-                  extra_args: Optional[list] = None):
-    """``--role split``: start the device process as a child and wait
-    for its socket to accept.  Returns the Popen handle."""
-    import subprocess
-    import sys
+def wait_sidecar_socket(proc, socket_path: str,
+                        timeout_s: float = 180.0) -> None:
+    """Block until the child accepts on ``socket_path``.
+
+    Distinguishes "socket not yet bound" (keep polling) from "sidecar
+    crashed during boot" (raise with the child's EXIT CODE immediately
+    — a config typo must not masquerade as a 3-minute startup timeout).
+    The child is re-polled AFTER each failed probe, so a crash landing
+    between the liveness check and the connect can never slip through
+    to the timeout either."""
+    import socket as pysocket
     import time
 
-    argv = [sys.executable, "-m", "omero_ms_image_region_tpu.server",
-            "--role", "sidecar", "--sidecar-socket", socket_path]
-    if config_path:
-        argv += ["--config", config_path]
-    argv += list(extra_args or ())
-    proc = subprocess.Popen(argv)
-    deadline = time.monotonic() + 180
-    import socket as pysocket
+    deadline = time.monotonic() + timeout_s
     kind, host, port = parse_address(socket_path)
-    while time.monotonic() < deadline:
-        if proc.poll() is not None:
+    while True:
+        code = proc.poll()
+        if code is not None:
             raise RuntimeError(
-                f"sidecar exited with {proc.returncode} during startup")
+                f"sidecar exited with {code} during startup")
         try:
             if kind == "tcp":
                 s = pysocket.create_connection((host, port), timeout=1.0)
@@ -729,8 +970,144 @@ def spawn_sidecar(config_path: Optional[str], socket_path: str,
                 s.settimeout(1.0)
                 s.connect(socket_path)
             s.close()
-            return proc
+            return
         except OSError:
-            time.sleep(0.2)
-    proc.terminate()
-    raise RuntimeError("sidecar did not open its socket in time")
+            pass
+        code = proc.poll()
+        if code is not None:
+            raise RuntimeError(
+                f"sidecar exited with {code} during startup")
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                "sidecar did not open its socket in time")
+        time.sleep(0.2)
+
+
+def spawn_sidecar(config_path: Optional[str], socket_path: str,
+                  extra_args: Optional[list] = None):
+    """``--role split``: start the device process as a child and wait
+    for its socket to accept.  Returns the Popen handle."""
+    import subprocess
+    import sys
+
+    argv = [sys.executable, "-m", "omero_ms_image_region_tpu.server",
+            "--role", "sidecar", "--sidecar-socket", socket_path]
+    if config_path:
+        argv += ["--config", config_path]
+    argv += list(extra_args or ())
+    proc = subprocess.Popen(argv)
+    try:
+        wait_sidecar_socket(proc, socket_path)
+    except Exception:
+        if proc.poll() is None:
+            proc.terminate()
+        raise
+    return proc
+
+
+class SidecarSupervisor:
+    """Keep the device process alive (the reference leaned on Vert.x
+    supervisor restarts; this is the TPU build's equivalent for
+    ``--role split``): spawn the sidecar, watch it from a daemon
+    thread, respawn with capped exponential backoff when it dies.
+
+    The readmission gate is built into the spawn itself:
+    ``spawn_sidecar`` returns only once the socket ACCEPTS — and
+    ``run_sidecar`` binds the socket strictly after ``build_services``,
+    so an accepting socket means the device stack is up — while the
+    frontends' ``/readyz`` (sidecar ping, ``prewarm_pending``) holds
+    external traffic until the restarted process has re-run its
+    prewarm gate.  ``spawn_fn`` is injectable so tests can supervise a
+    cheap child instead of a full device process."""
+
+    def __init__(self, spawn_fn, base_backoff_s: float = 0.5,
+                 max_backoff_s: float = 30.0):
+        import threading
+        self._spawn_fn = spawn_fn
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.proc = None
+        self.restarts = 0
+        self._stop = threading.Event()
+        self._thread: Optional[object] = None
+
+    @classmethod
+    def for_config(cls, config_path: Optional[str], socket_path: str,
+                   extra_args: Optional[list] = None,
+                   max_backoff_s: float = 30.0) -> "SidecarSupervisor":
+        return cls(lambda: spawn_sidecar(config_path, socket_path,
+                                         extra_args),
+                   max_backoff_s=max_backoff_s)
+
+    def start(self):
+        """Spawn the first child (blocking until its socket accepts,
+        exactly like a bare ``spawn_sidecar``) and begin supervising."""
+        import threading
+        self.proc = self._spawn_fn()
+        self._thread = threading.Thread(
+            target=self._monitor, name="sidecar-supervisor",
+            daemon=True)
+        self._thread.start()
+        return self.proc
+
+    def _monitor(self) -> None:
+        import subprocess
+        import time
+
+        backoff = self.base_backoff_s
+        spawned_at = time.monotonic()
+        while not self._stop.is_set():
+            proc = self.proc
+            try:
+                proc.wait(timeout=0.5)
+            except subprocess.TimeoutExpired:
+                if time.monotonic() - spawned_at > 30.0:
+                    # A child that held for a while earns a reset: the
+                    # backoff ladder punishes crash LOOPS, not isolated
+                    # crashes an hour apart.
+                    backoff = self.base_backoff_s
+                continue
+            if self._stop.is_set():
+                break
+            logger.warning(
+                "render sidecar exited with %s; restarting in %.1f s",
+                proc.returncode, backoff)
+            if self._stop.wait(backoff):
+                break
+            backoff = min(backoff * 2.0, self.max_backoff_s)
+            try:
+                self.proc = self._spawn_fn()
+            except Exception:
+                # Spawn (or its startup probe) failed; the loop sees
+                # the dead child again and ladders the backoff.
+                logger.exception("sidecar respawn failed; will retry")
+                continue
+            if self._stop.is_set():
+                # stop() raced this respawn (it can only terminate the
+                # child it saw); the fresh child must not leak as an
+                # orphan holding the socket.
+                try:
+                    self.proc.terminate()
+                except Exception:
+                    pass
+                break
+            spawned_at = time.monotonic()
+            self.restarts += 1
+            telemetry.RESILIENCE.count_supervisor_restart()
+            logger.info("render sidecar restarted (restart #%d)",
+                        self.restarts)
+
+    def stop(self, timeout_s: float = 15.0) -> None:
+        """Stop supervising and terminate the child (the deliberate
+        shutdown path — no restart)."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+        proc = self.proc
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=timeout_s)
+            except Exception:
+                proc.kill()

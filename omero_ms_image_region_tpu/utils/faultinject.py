@@ -1,0 +1,164 @@
+"""Deterministic fault injection for the serving chain.
+
+Robustness claims must be falsifiable the same way PR 1 made perf
+claims falsifiable: every behavior in the fault-tolerance layer
+(deadlines, breaker, shedding, supervision) is exercised by CPU-only
+tier-1 tests through THIS seeded chaos layer instead of by prose.
+
+One process-global :class:`FaultInjector` (installed from
+``AppConfig.fault_injection``, or directly by tests) is consulted at
+fixed hook points:
+
+* ``server.sidecar.SidecarClient.call`` — drop or truncate the request
+  frame (the connection dies under the request), or delay it;
+* ``server.sidecar`` request handling — self-kill the sidecar process
+  after N requests (supervision drills: the crash happens MID-call);
+* ``server.batcher`` group renders — raise a transient device error
+  (exercises the transient-retry path) or freeze a device lane.
+
+Decisions come from one seeded ``random.Random`` under a lock, so a
+fixed seed yields a reproducible fault schedule for a fixed call
+sequence.  All rates default to 0 and the module-global injector
+defaults to ``None``: the serving hot path pays one ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from random import Random
+from typing import Dict, Optional
+
+
+class XlaRuntimeError(RuntimeError):
+    """Injected transient device error.
+
+    Named so ``utils.transient.is_transient_device_error`` classifies
+    it exactly like the real runtime's transport drops — the retry
+    path under test is the production one, not a test double."""
+
+
+@dataclass
+class FaultInjectionConfig:
+    """``fault-injection`` config block.  ``seed`` None disables the
+    whole layer (the production default)."""
+
+    seed: Optional[int] = None
+    wire_drop_rate: float = 0.0       # request frame never sent
+    wire_truncate_rate: float = 0.0   # partial frame then close
+    wire_delay_rate: float = 0.0
+    wire_delay_ms: float = 0.0
+    device_error_rate: float = 0.0    # transient error in group render
+    freeze_rate: float = 0.0          # device lane stalls freeze_ms
+    freeze_ms: float = 0.0
+    die_after_requests: int = 0       # sidecar self-kill mid-call
+
+    def validate(self) -> "FaultInjectionConfig":
+        for name in ("wire_drop_rate", "wire_truncate_rate",
+                     "wire_delay_rate", "device_error_rate",
+                     "freeze_rate"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"fault-injection.{name} must be in "
+                                 f"[0, 1], got {v}")
+        if self.wire_delay_ms < 0 or self.freeze_ms < 0:
+            raise ValueError("fault-injection delays must be >= 0")
+        if self.die_after_requests < 0:
+            raise ValueError("fault-injection.die-after-requests must "
+                             "be >= 0")
+        return self
+
+
+class FaultInjector:
+    """Seeded chaos decisions + counters of what was actually injected
+    (tests assert the chaos happened; a chaos run that injected nothing
+    proves nothing)."""
+
+    def __init__(self, config: FaultInjectionConfig):
+        self.config = config.validate()
+        self._rng = Random(config.seed)
+        self._lock = threading.Lock()
+        self._requests_seen = 0
+        self.counts: Dict[str, int] = {}
+
+    def _roll(self, rate: float, kind: str) -> bool:
+        if rate <= 0.0:
+            return False
+        with self._lock:
+            hit = self._rng.random() < rate
+            if hit:
+                self.counts[kind] = self.counts.get(kind, 0) + 1
+        return hit
+
+    # ------------------------------------------------------ wire faults
+
+    def wire_fault(self) -> Optional[str]:
+        """``"drop"`` / ``"truncate"`` / None for the frame about to be
+        sent."""
+        if self._roll(self.config.wire_drop_rate, "wire_drop"):
+            return "drop"
+        if self._roll(self.config.wire_truncate_rate, "wire_truncate"):
+            return "truncate"
+        return None
+
+    def wire_delay_s(self) -> float:
+        if self._roll(self.config.wire_delay_rate, "wire_delay"):
+            return self.config.wire_delay_ms / 1000.0
+        return 0.0
+
+    # ---------------------------------------------------- device faults
+
+    def maybe_device_error(self) -> None:
+        """Raise a transient device error at the group-render hook."""
+        if self._roll(self.config.device_error_rate, "device_error"):
+            raise XlaRuntimeError(
+                "injected transient fault: connection reset by peer")
+
+    def freeze_s(self) -> float:
+        """Stall duration for the device-lane hook (0 = no stall)."""
+        if self._roll(self.config.freeze_rate, "freeze"):
+            return self.config.freeze_ms / 1000.0
+        return 0.0
+
+    # ------------------------------------------------------- supervision
+
+    def sidecar_should_die(self) -> bool:
+        """True on the Nth request this process handles (then never
+        again — a supervised restart must not die in a loop)."""
+        if self.config.die_after_requests <= 0:
+            return False
+        with self._lock:
+            self._requests_seen += 1
+            if self._requests_seen == self.config.die_after_requests:
+                self.counts["sidecar_kill"] = \
+                    self.counts.get("sidecar_kill", 0) + 1
+                return True
+        return False
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counts)
+
+
+_INSTALLED: Optional[FaultInjector] = None
+
+
+def install(config: Optional[FaultInjectionConfig]) -> \
+        Optional[FaultInjector]:
+    """Install the process-global injector (None / seed-less config
+    uninstalls).  Returns the active injector."""
+    global _INSTALLED
+    if config is None or config.seed is None:
+        _INSTALLED = None
+    else:
+        _INSTALLED = FaultInjector(config)
+    return _INSTALLED
+
+
+def uninstall() -> None:
+    global _INSTALLED
+    _INSTALLED = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _INSTALLED

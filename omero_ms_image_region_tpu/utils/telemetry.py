@@ -482,6 +482,105 @@ def install_compile_listener() -> bool:
         return True
 
 
+# --------------------------------------------------------------- resilience
+
+class Resilience:
+    """Fault-tolerance accounting behind /metrics: sheds, deadline
+    cancellations, sidecar retries, degraded-mode renders, supervisor
+    restarts.  Thread-safe — the batcher's worker threads and the
+    supervisor's monitor thread both count here."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.shed: Dict[str, int] = {}            # reason -> count
+        self.retries: Dict[str, int] = {}         # op -> retry count
+        self.deadline_cancelled = 0
+        self.degraded_renders = 0
+        self.supervisor_restarts = 0
+        # Attempts actually used per sidecar call, by op (a histogram,
+        # not a mean: "most calls take 1, a few take 3" is the signal).
+        self.attempts_hist = HistogramVec("op")
+
+    def count_shed(self, reason: str = "queue-full") -> None:
+        with self._lock:
+            self.shed[reason] = self.shed.get(reason, 0) + 1
+
+    def count_retry(self, op: str) -> None:
+        with self._lock:
+            self.retries[op] = self.retries.get(op, 0) + 1
+
+    def count_deadline_cancelled(self, n: int = 1) -> None:
+        with self._lock:
+            self.deadline_cancelled += n
+
+    def count_degraded_render(self) -> None:
+        with self._lock:
+            self.degraded_renders += 1
+
+    def count_supervisor_restart(self) -> None:
+        with self._lock:
+            self.supervisor_restarts += 1
+
+    def observe_attempts(self, op: str, attempts: int) -> None:
+        self.attempts_hist.observe(op, float(attempts))
+
+    def reset(self) -> None:
+        with self._lock:
+            self.shed.clear()
+            self.retries.clear()
+            self.deadline_cancelled = 0
+            self.degraded_renders = 0
+            self.supervisor_restarts = 0
+        self.attempts_hist.reset()
+
+
+RESILIENCE = Resilience()
+
+
+def resilience_metric_lines(breaker=None,
+                            extra_labels: str = "") -> List[str]:
+    """The fault-tolerance series.  ``breaker`` is the sidecar client's
+    CircuitBreaker (frontend processes only; None omits the gauge)."""
+    def label(body: str = "") -> str:
+        inner = body + (("," if body else "")
+                        + extra_labels.lstrip(",") if extra_labels
+                        else "")
+        return f"{{{inner}}}" if inner else ""
+
+    lines: List[str] = []
+    if breaker is not None:
+        # 0 closed / 1 half-open / 2 open (utils.transient enum order).
+        lines += [
+            f"imageregion_breaker_state{label()} {breaker.state}",
+            f"imageregion_breaker_opens_total{label()} {breaker.opens}",
+        ]
+    with RESILIENCE._lock:
+        shed = sorted(RESILIENCE.shed.items())
+        retries = sorted(RESILIENCE.retries.items())
+        deadline_cancelled = RESILIENCE.deadline_cancelled
+        degraded = RESILIENCE.degraded_renders
+        restarts = RESILIENCE.supervisor_restarts
+    for reason, n in shed:
+        body = f'reason="{reason}"'
+        lines.append(f"imageregion_shed_total{label(body)} {n}")
+    for op, n in retries:
+        body = f'op="{op}"'
+        lines.append(f"imageregion_retries_total{label(body)} {n}")
+    lines += [
+        f"imageregion_deadline_cancelled_total{label()} "
+        f"{deadline_cancelled}",
+        f"imageregion_degraded_renders_total{label()} {degraded}",
+        f"imageregion_supervisor_restarts_total{label()} {restarts}",
+    ]
+    if not extra_labels:
+        # The per-op attempts histogram composes its own labels; the
+        # sidecar merge path (extra_labels) skips it rather than emit
+        # label-mangled series.
+        lines += RESILIENCE.attempts_hist.series(
+            "imageregion_retry_attempts")
+    return lines
+
+
 # ---------------------------------------------------------------- readiness
 
 class Readiness:
@@ -552,6 +651,14 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_link_fetches_total": "counter",
     "imageregion_link_fetch_bytes_total": "counter",
     "imageregion_ready": "gauge",
+    "imageregion_breaker_state": "gauge",
+    "imageregion_breaker_opens_total": "counter",
+    "imageregion_shed_total": "counter",
+    "imageregion_retries_total": "counter",
+    "imageregion_retry_attempts": "histogram",
+    "imageregion_deadline_cancelled_total": "counter",
+    "imageregion_degraded_renders_total": "counter",
+    "imageregion_supervisor_restarts_total": "counter",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -722,3 +829,4 @@ def reset() -> None:
     LINK.reset()
     COMPILE.reset()
     READINESS.reset()
+    RESILIENCE.reset()

@@ -1,0 +1,40 @@
+"""bench.py --smoke --chaos as a tier-1 gate: seeded faults over the
+frontend -> sidecar -> batcher chain must yield zero 5xx-without-shed
+and a bounded p99 — the robustness analogue of the hot-path smoke
+gate (test_bench_smoke.py)."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_chaos_smoke_invariants(capsys):
+    import bench
+
+    t0 = time.monotonic()
+    out = bench.bench_chaos_smoke(duration_s=1.5)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 120.0, f"chaos smoke took {elapsed:.0f}s"
+
+    # The chaos actually happened: a run that injected nothing proves
+    # nothing.
+    assert out["injected"], out
+    assert sum(out["injected"].values()) >= 3
+    # The service functioned under it.
+    assert out["ok"] >= 5, out
+    # Zero 5xx-without-shed: every failure was a deliberate 503 (with
+    # Retry-After) or 504 — a bare 500 means a fault leaked through
+    # the tolerance layer raw.
+    assert out["zero_bare_5xx"] is True, out
+    assert out["missing_retry_after"] == 0, out
+    # Deadlines bound the tail: p99 under deadline + scheduling slack.
+    assert out["p99_bounded"] is True, out
+    # plane_put is never auto-retried, under chaos or otherwise.
+    assert out["plane_put_retried"] is False, out
+
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["metric"] == "chaos_smoke"

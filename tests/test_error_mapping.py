@@ -1,0 +1,115 @@
+"""The status contract (server/errors.py): every failure mode maps to
+ONE stable HTTP status with the documented body shape, in both the
+sidecar wire's ``_map_status`` and the app's ``_status_of`` — and no
+path ever leaks a traceback to a client."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_image_region_tpu.server.app import create_app
+from omero_ms_image_region_tpu.server.config import AppConfig
+from omero_ms_image_region_tpu.server.ctx import BadRequestError
+from omero_ms_image_region_tpu.server.errors import (
+    DeadlineExceededError, NotFoundError, OverloadedError)
+from omero_ms_image_region_tpu.server.sidecar import (_map_response,
+                                                      _map_status)
+
+
+# ------------------------------------------------------ wire -> exception
+
+class TestMapStatus:
+    def test_200_passes_payload_through(self):
+        assert _map_status(200, b"bytes") == b"bytes"
+
+    def test_400_is_bad_request_with_message(self):
+        with pytest.raises(BadRequestError, match="bad z"):
+            _map_status(400, "bad z")
+
+    def test_404_is_not_found(self):
+        with pytest.raises(NotFoundError):
+            _map_status(404, "")
+
+    def test_503_is_overloaded_with_retry_after(self):
+        with pytest.raises(OverloadedError) as ei:
+            _map_status(503, "queue full", retry_after_s=2.5)
+        assert ei.value.retry_after_s == 2.5
+        # No retry_after on the wire: a sane default, not a crash.
+        with pytest.raises(OverloadedError) as ei:
+            _map_status(503, "")
+        assert ei.value.retry_after_s > 0
+
+    def test_504_is_deadline_exceeded(self):
+        with pytest.raises(DeadlineExceededError):
+            _map_status(504, "spent")
+
+    def test_unknown_status_is_runtime_error(self):
+        with pytest.raises(RuntimeError, match="500"):
+            _map_status(500, "")
+
+    def test_map_response_carries_retry_after_header_field(self):
+        with pytest.raises(OverloadedError) as ei:
+            _map_response({"status": 503, "error": "shed",
+                           "retry_after": 4.0}, b"")
+        assert ei.value.retry_after_s == 4.0
+        assert "shed" in str(ei.value)
+
+
+# -------------------------------------------------- exception -> response
+
+def test_every_failure_mode_maps_to_stable_status(tmp_path,
+                                                  monkeypatch):
+    """One app, every exception class the chain can surface: the
+    response status/body contract holds and NO raw traceback reaches
+    the client (the reference's empty 404/500 bodies,
+    ImageRegionMicroserviceVerticle.java:314-323, extended by the
+    fault-tolerance statuses)."""
+    from omero_ms_image_region_tpu.server.handler import (
+        ImageRegionHandler)
+
+    cases = [
+        (BadRequestError("bad window"), 400,
+         lambda r, b: b == b"bad window"),
+        (NotFoundError("gone"), 404, lambda r, b: b == b""),
+        (OverloadedError("shed", retry_after_s=3.0), 503,
+         lambda r, b: (r.headers["Retry-After"] == "3"
+                       and b"shed" in b)),
+        (ConnectionError("sidecar went away"), 503,
+         lambda r, b: ("Retry-After" in r.headers
+                       and b"unreachable" in b)),
+        (DeadlineExceededError("budget spent"), 504,
+         lambda r, b: b"budget spent" in b),
+        (RuntimeError("secret internal detail"), 500,
+         lambda r, b: b == b""),
+    ]
+    # A transport drop that outlived the transient retry is weather the
+    # client retries through — shed class, never a bare 500.
+    from omero_ms_image_region_tpu.utils.faultinject import (
+        XlaRuntimeError)
+    cases.append(
+        (XlaRuntimeError("connection reset by peer"), 503,
+         lambda r, b: "Retry-After" in r.headers))
+
+    async def scenario():
+        app = create_app(AppConfig(data_dir=str(tmp_path)))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for exc, want_status, check in cases:
+                async def boom(self, ctx, _exc=exc):
+                    raise _exc
+                monkeypatch.setattr(ImageRegionHandler,
+                                    "render_image_region", boom)
+                r = await client.get(
+                    "/webgateway/render_image_region/3/0/0?m=g")
+                body = await r.read()
+                assert r.status == want_status, (exc, r.status)
+                assert check(r, body), (exc, body)
+                assert b"Traceback" not in body, exc
+                assert b"secret internal detail" not in body or \
+                    want_status != 500
+        finally:
+            await client.close()
+
+    asyncio.run(scenario())

@@ -1,0 +1,453 @@
+"""Fault-tolerance primitives: deadlines, circuit breaker, op-aware
+retry, admission control, deterministic fault injection, supervisor —
+the building blocks of the frontend -> sidecar -> batcher resilience
+chain (wire-level composition lives in test_sidecar_faults.py)."""
+
+import asyncio
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.utils import faultinject, telemetry
+from omero_ms_image_region_tpu.utils.transient import (
+    IDEMPOTENT_OPS, CircuitBreaker, DeadlineExceededError, RetryPolicy,
+    check_deadline, clear_deadline, deadline_scope, remaining_ms,
+    set_task_deadline)
+
+
+# ------------------------------------------------------------- deadlines
+
+class TestDeadlines:
+    def test_scope_sets_and_restores(self):
+        assert remaining_ms() is None
+        with deadline_scope(50.0):
+            r = remaining_ms()
+            assert r is not None and 0 < r <= 50.0
+            check_deadline()          # budget left: no raise
+        assert remaining_ms() is None
+
+    def test_zero_budget_disables(self):
+        # Config semantics: request-deadline-ms 0 = no deadline.
+        with deadline_scope(0):
+            assert remaining_ms() is None
+
+    def test_spent_budget_raises(self):
+        with deadline_scope(0.0001):
+            time.sleep(0.001)
+            with pytest.raises(DeadlineExceededError):
+                check_deadline("unit")
+
+    def test_task_deadline_zero_means_expired(self):
+        # Wire semantics: a deadline_ms HEADER of 0 is a spent budget,
+        # not an unbounded one (the config-side 0 never reaches the
+        # wire — the client omits the header when no deadline is set).
+        async def run():
+            set_task_deadline(0.0)
+            with pytest.raises(DeadlineExceededError):
+                check_deadline("wire")
+            set_task_deadline(None)
+            check_deadline("wire")
+        asyncio.run(run())
+
+    def test_clear_deadline_detaches(self):
+        with deadline_scope(0.0001):
+            time.sleep(0.001)
+            clear_deadline()
+            check_deadline()          # detached: no raise
+
+
+# -------------------------------------------------------- circuit breaker
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_half_opens(self):
+        clock = [0.0]
+        b = CircuitBreaker(3, reset_after_s=5.0, clock=lambda: clock[0])
+        assert b.allow() and b.state_name == "closed"
+        for _ in range(2):
+            b.record_failure()
+        assert b.state_name == "closed"   # threshold not reached
+        b.record_failure()
+        assert b.state_name == "open" and not b.allow()
+        assert b.opens == 1
+        assert b.retry_after_s() == pytest.approx(5.0)
+        clock[0] = 5.0
+        # Half-open: exactly ONE caller gets the trial slot.
+        assert b.state_name == "half-open"
+        assert b.allow() and not b.allow()
+
+    def test_half_open_failure_reopens_success_closes(self):
+        clock = [0.0]
+        b = CircuitBreaker(1, reset_after_s=2.0, clock=lambda: clock[0])
+        b.record_failure()
+        clock[0] = 2.0
+        assert b.allow()
+        b.record_failure()                # trial failed
+        assert b.state_name == "open" and b.opens == 2
+        clock[0] = 4.0
+        assert b.allow()
+        b.record_success()                # trial succeeded
+        assert b.state_name == "closed" and b.allow()
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker(2)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state_name == "closed"   # never 2 consecutive
+
+    def test_abandoned_half_open_probe_expires(self):
+        # Regression: a probe whose caller never reported an outcome
+        # (cancelled mid-call) must not wedge the breaker into
+        # shedding forever — the trial slot re-opens after the reset
+        # window.
+        clock = [0.0]
+        b = CircuitBreaker(1, reset_after_s=1.0, clock=lambda: clock[0])
+        b.record_failure()
+        clock[0] = 1.0
+        assert b.allow()          # probe claimed... and abandoned
+        assert not b.allow()
+        clock[0] = 2.0
+        assert b.allow()          # slot expired: a new probe may run
+        b.record_success()
+        assert b.state_name == "closed"
+
+
+# ----------------------------------------------------------- retry policy
+
+class TestRetryPolicy:
+    def test_op_awareness(self):
+        p = RetryPolicy(max_attempts=4)
+        for op in IDEMPOTENT_OPS:
+            assert p.attempts_for(op) == 4
+        # The acceptance-critical one: a state-changing upload gets
+        # exactly one attempt, no matter the configured ladder.
+        assert p.attempts_for("plane_put") == 1
+
+    def test_backoff_capped_exponential_deterministic(self):
+        p = RetryPolicy(base_backoff_s=0.1, max_backoff_s=0.4,
+                        jitter=0.0, rng=random.Random(3))
+        assert [p.backoff_s(i) for i in range(4)] == \
+            [0.1, 0.2, 0.4, 0.4]
+        # Jitter is seeded -> reproducible sequences.
+        a = RetryPolicy(jitter=0.5, rng=random.Random(7))
+        b = RetryPolicy(jitter=0.5, rng=random.Random(7))
+        seq_a = [a.backoff_s(i) for i in range(5)]
+        seq_b = [b.backoff_s(i) for i in range(5)]
+        assert seq_a == seq_b
+        assert all(s >= base for s, base in
+                   zip(seq_a, [0.025, 0.05, 0.1, 0.2, 0.4]))
+
+
+# ------------------------------------------------------ admission control
+
+class TestAdmission:
+    def test_depth_bound_sheds_with_retry_after(self):
+        from omero_ms_image_region_tpu.server.admission import (
+            AdmissionController)
+        from omero_ms_image_region_tpu.server.errors import (
+            OverloadedError)
+
+        adm = AdmissionController(max_queue=2, retry_after_s=1.5)
+        t1, t2 = adm.admit(), adm.admit()
+        with pytest.raises(OverloadedError) as ei:
+            adm.admit()
+        assert ei.value.retry_after_s >= 1.5
+        assert adm.shed_total == 1
+        adm.release(t1)
+        adm.release(t2)
+        assert adm.inflight == 0
+        adm.release(adm.admit())          # slot freed: admits again
+
+    def test_deadline_aware_shed(self):
+        from omero_ms_image_region_tpu.server.admission import (
+            AdmissionController)
+        from omero_ms_image_region_tpu.server.errors import (
+            OverloadedError)
+
+        adm = AdmissionController(max_queue=100)
+        # Teach the EWMA a 100 ms service time, with one slot occupied.
+        t = adm.admit()
+        adm.ewma_s = 0.1
+        with deadline_scope(5.0):     # 5 ms budget, ~100 ms est. wait
+            with pytest.raises(OverloadedError):
+                adm.admit()
+        with deadline_scope(5000.0):  # plenty of budget: admitted
+            adm.release(adm.admit(), completed=False)
+        adm.release(t)
+
+    def test_failed_renders_do_not_feed_ewma(self):
+        from omero_ms_image_region_tpu.server.admission import (
+            AdmissionController)
+
+        adm = AdmissionController(max_queue=4)
+        adm.release(adm.admit(), completed=False)
+        assert adm.ewma_s is None
+        adm.release(adm.admit(), completed=True)
+        assert adm.ewma_s is not None
+
+
+# -------------------------------------------------------- fault injection
+
+class TestFaultInjection:
+    def test_seeded_determinism(self):
+        cfg = faultinject.FaultInjectionConfig(
+            seed=42, wire_drop_rate=0.3, wire_truncate_rate=0.2,
+            device_error_rate=0.5)
+        a = faultinject.FaultInjector(cfg)
+        b = faultinject.FaultInjector(cfg)
+
+        def schedule(inj):
+            out = []
+            for _ in range(50):
+                out.append(inj.wire_fault())
+                try:
+                    inj.maybe_device_error()
+                    out.append("ok")
+                except faultinject.XlaRuntimeError:
+                    out.append("boom")
+            return out
+
+        assert schedule(a) == schedule(b)
+        assert a.snapshot() == b.snapshot()
+        assert a.snapshot()        # the chaos actually happened
+
+    def test_injected_error_is_classified_transient(self):
+        from omero_ms_image_region_tpu.utils.transient import (
+            is_transient_device_error)
+        inj = faultinject.FaultInjector(faultinject.FaultInjectionConfig(
+            seed=1, device_error_rate=1.0))
+        with pytest.raises(faultinject.XlaRuntimeError) as ei:
+            inj.maybe_device_error()
+        # The production retry path must classify it exactly like a
+        # real transport drop.
+        assert is_transient_device_error(ei.value)
+
+    def test_rates_validated(self):
+        with pytest.raises(ValueError):
+            faultinject.FaultInjectionConfig(
+                seed=1, wire_drop_rate=1.5).validate()
+
+    def test_install_guard(self):
+        inj = faultinject.install(faultinject.FaultInjectionConfig(
+            seed=9, wire_drop_rate=1.0))
+        try:
+            assert faultinject.active() is inj
+        finally:
+            faultinject.uninstall()
+        assert faultinject.active() is None
+
+    def test_seed_rejected_on_explicit_multihost_config(self):
+        # Chaos on one pod process would diverge SPMD lockstep; the
+        # combination must fail at config load, not hang a slice.
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        raw = {"parallel": {"enabled": True,
+                            "coordinator-address": "h0:8476",
+                            "num-processes": 2, "process-id": 0},
+               "fault-injection": {"seed": 1}}
+        with pytest.raises(ValueError, match="multi-host"):
+            AppConfig.from_dict(raw)
+        raw["parallel"]["enabled"] = False
+        AppConfig.from_dict(raw)        # single-host: allowed
+
+    def test_die_after_requests_fires_once(self):
+        inj = faultinject.FaultInjector(faultinject.FaultInjectionConfig(
+            seed=1, die_after_requests=3))
+        hits = [inj.sidecar_should_die() for _ in range(6)]
+        assert hits == [False, False, True, False, False, False]
+
+
+# ------------------------------------------- batcher deadline cancellation
+
+def test_batcher_cancels_expired_queued_work():
+    """A pending whose budget died in the queue is failed with
+    DeadlineExceededError at dispatch pop — the device kernel never
+    runs for it (batches_dispatched stays 0)."""
+    from omero_ms_image_region_tpu.server.batcher import (
+        BatchingRenderer)
+
+    async def run():
+        r = BatchingRenderer(max_batch=4, linger_ms=1.0)
+        settings = {"cd_start": 0, "cd_end": 255,
+                    "tables": np.zeros((1, 3), np.float32),
+                    "window_start": np.zeros(1, np.float32),
+                    "window_end": np.ones(1, np.float32),
+                    "family": np.zeros(1, np.int32),
+                    "coefficient": np.ones(1, np.float32),
+                    "reverse": np.zeros(1, np.int32)}
+        raw = np.zeros((1, 32, 32), np.uint16)
+        try:
+            with deadline_scope(0.0001):     # spent before the pop
+                with pytest.raises(DeadlineExceededError):
+                    await r.render(raw, settings)
+            assert r.batches_dispatched == 0
+        finally:
+            await r.close()
+
+    shed0 = telemetry.RESILIENCE.deadline_cancelled
+    asyncio.run(run())
+    assert telemetry.RESILIENCE.deadline_cancelled == shed0 + 1
+
+
+def test_batcher_renders_within_budget():
+    """Same path, generous budget: the render completes (the deadline
+    plumbing must not fail work that still has time)."""
+    from omero_ms_image_region_tpu.server.batcher import (
+        BatchingRenderer)
+    from omero_ms_image_region_tpu.ops.render import pack_settings
+    from omero_ms_image_region_tpu.models.pixels import Pixels
+    from omero_ms_image_region_tpu.models.rendering import (
+        default_rendering_def)
+
+    async def run():
+        r = BatchingRenderer(max_batch=2, linger_ms=0.5)
+        pixels = Pixels(image_id=1, pixels_type="uint16", size_x=32,
+                        size_y=32, size_z=1, size_c=1, size_t=1)
+        settings = pack_settings(default_rendering_def(pixels), None)
+        raw = np.random.default_rng(0).integers(
+            0, 60000, size=(1, 32, 32)).astype(np.float32)
+        try:
+            with deadline_scope(60000.0):
+                out = await r.render(raw, settings)
+            assert out.shape == (32, 32)
+        finally:
+            await r.close()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------ single-flight follower budget
+
+def test_single_flight_follower_deadline_leaves_leader_running():
+    """A follower whose budget dies waiting gets its own 504; the
+    shared render is NOT cancelled and still settles the leader."""
+    from omero_ms_image_region_tpu.server.handler import SingleFlight
+
+    async def run():
+        sf = SingleFlight()
+        release = asyncio.Event()
+
+        async def producer():
+            await release.wait()
+            return b"bytes"
+
+        leader = asyncio.ensure_future(sf.run("k", producer))
+        await asyncio.sleep(0.01)      # leader task in flight
+
+        async def follower():
+            with deadline_scope(20.0):
+                return await sf.run("k", producer)
+
+        with pytest.raises(DeadlineExceededError):
+            await follower()
+        # The shared task survived the follower's timeout.
+        assert sf.inflight() == 1
+        release.set()
+        result, coalesced = await leader
+        assert result == b"bytes" and coalesced is False
+        assert sf.hits == 1            # the follower did coalesce
+
+    asyncio.run(run())
+
+
+def test_single_flight_leader_budget_reaches_batcher():
+    """Regression: the shared render inherits the LEADER's budget (it
+    is the leader's admitted pipeline run) — a spent leader budget
+    still cancels the queued work instead of being silently detached
+    by the coalescing layer."""
+    from omero_ms_image_region_tpu.server.batcher import (
+        BatchingRenderer)
+    from omero_ms_image_region_tpu.server.handler import SingleFlight
+
+    async def run():
+        r = BatchingRenderer(max_batch=4, linger_ms=1.0)
+        sf = SingleFlight()
+        settings = {"cd_start": 0, "cd_end": 255,
+                    "tables": np.zeros((1, 3), np.float32)}
+        raw = np.zeros((1, 32, 32), np.uint16)
+        try:
+            with deadline_scope(0.0001):     # leader budget: spent
+                with pytest.raises(DeadlineExceededError):
+                    await sf.run(
+                        "k", lambda: r.render(raw, settings))
+            assert r.batches_dispatched == 0
+        finally:
+            await r.close()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------- supervisor
+
+def test_supervisor_restarts_killed_child_and_stops_cleanly():
+    """Mechanism-level drill with a cheap child (the full device
+    process drill lives in test_sidecar_faults.py): kill -9 the child,
+    the supervisor respawns it with backoff; stop() terminates without
+    a restart."""
+    from omero_ms_image_region_tpu.server.sidecar import (
+        SidecarSupervisor)
+
+    spawned = []
+
+    def spawn():
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(300)"])
+        spawned.append(proc)
+        return proc
+
+    restarts0 = telemetry.RESILIENCE.supervisor_restarts
+    sup = SidecarSupervisor(spawn, base_backoff_s=0.05,
+                            max_backoff_s=0.2)
+    first = sup.start()
+    try:
+        first.kill()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if sup.restarts >= 1 and sup.proc is not first \
+                    and sup.proc.poll() is None:
+                break
+            time.sleep(0.05)
+        assert sup.restarts >= 1, "supervisor never restarted the child"
+        assert sup.proc is not first and sup.proc.poll() is None
+        assert telemetry.RESILIENCE.supervisor_restarts > restarts0
+    finally:
+        sup.stop()
+    # Deliberate shutdown: child terminated, and NOT restarted.
+    assert sup.proc.poll() is not None
+    time.sleep(0.3)
+    assert all(p.poll() is not None for p in spawned)
+
+
+# ----------------------------------------------- _Conn registration race
+
+def test_conn_refuses_registration_after_death():
+    """Regression for the enqueue/fail_pending race: a pending
+    registered after the connection died must fail IMMEDIATELY, not
+    hang forever on a future no read loop will ever resolve."""
+    from omero_ms_image_region_tpu.server.sidecar import _Conn
+
+    class DummyWriter:
+        def is_closing(self):
+            return True
+
+        def close(self):
+            pass
+
+    async def run():
+        conn = _Conn(reader=None, writer=DummyWriter())
+        loop = asyncio.get_running_loop()
+        parked = loop.create_future()
+        conn.register(1, parked)
+        conn.fail_pending(ConnectionError("sidecar went away"))
+        # Already-parked waiters were failed...
+        with pytest.raises(ConnectionError):
+            parked.result()
+        # ...and late registration is refused instead of stranded.
+        with pytest.raises(ConnectionError):
+            conn.register(2, loop.create_future())
+        assert not conn.pending
+
+    asyncio.run(run())

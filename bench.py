@@ -646,6 +646,12 @@ def bench_smoke(duration_s: float = 1.5):
     from omero_ms_image_region_tpu.services.cache import CacheConfig
 
     t_start = time.perf_counter()
+    # The gate below judges THIS window's ledger: the top-K table is
+    # process-global, and a stale expensive request from whatever this
+    # interpreter ran earlier (tier-1 shares it) must not stand in for
+    # the smoke run's attribution.
+    from omero_ms_image_region_tpu.utils import telemetry
+    telemetry.COST_TOPK.reset()
     rng = np.random.default_rng(7)
     with tempfile.TemporaryDirectory() as tmp:
         planes = synthetic_wsi_tiles(rng, 2, 1, 512, 512).reshape(
@@ -660,6 +666,14 @@ def bench_smoke(duration_s: float = 1.5):
         tps, p50, extras = asyncio.run(_service_run(
             config, concurrency=4, duration_s=duration_s, grid=2,
             tile_edge=256, channels=2, fmt="png"))
+    # Cost-ledger liveness: the attribution layer must have recorded
+    # WHERE the smoke window's time went, request by request — a
+    # refactor that silently drops the ledger fails the gate here.
+    top = telemetry.COST_TOPK.snapshot()
+    cost_keys = sorted(top[0]["cost"].keys()) if top else []
+    assert {"device_ms", "queue_ms", "total_ms",
+            "wire_bytes"} <= set(cost_keys), \
+        f"cost ledger missing fields: {cost_keys}"
     out = {
         "metric": "smoke_hotpath_tiles_per_sec",
         "value": round(tps, 2),
@@ -670,13 +684,15 @@ def bench_smoke(duration_s: float = 1.5):
         "overlap_efficiency": extras.get("overlap_efficiency"),
         "planecache_hits": extras.get("planecache_hits"),
         "planecache_misses": extras.get("planecache_misses"),
+        "cost_ledger_keys": cost_keys,
         "elapsed_s": round(time.perf_counter() - t_start, 1),
     }
     print(json.dumps(out))
     return out
 
 
-def bench_chaos_smoke(duration_s: float = 1.5, seed: int = 1234):
+def bench_chaos_smoke(duration_s: float = 1.5, seed: int = 1234,
+                      artifacts_dir: str = None):
     """Robustness gate at smoke scale: the full frontend -> sidecar ->
     batcher chain under SEEDED fault injection (wire drops/truncations/
     delays, transient device errors, a freezing device lane), with
@@ -693,9 +709,16 @@ def bench_chaos_smoke(duration_s: float = 1.5, seed: int = 1234):
     * the chaos actually happened (injected-fault counters are
       nonzero — a chaos run that injected nothing proves nothing) and
       the service still made progress (some 200s);
-    * ``plane_put`` was never auto-retried.
+    * ``plane_put`` was never auto-retried;
+    * the FORENSIC chain fired: the flight-recorder ring is non-empty
+      after the chaos window, and the induced availability-SLO breach
+      (the sidecar is killed at the end and requests shed) produced a
+      black-box dump plus slow-request waterfalls.
 
-    Prints ONE JSON line, like the other smoke gate.
+    ``artifacts_dir`` keeps the dump/waterfall files after the run
+    (tests round-trip them through scripts/trace_report.py); None
+    spools them inside the run's tempdir.  Prints ONE JSON line, like
+    the other smoke gate.
     """
     import asyncio
     import os
@@ -705,7 +728,7 @@ def bench_chaos_smoke(duration_s: float = 1.5, seed: int = 1234):
     from omero_ms_image_region_tpu.io.store import build_pyramid
     from omero_ms_image_region_tpu.server.config import (
         AppConfig, BatcherConfig, FaultToleranceConfig, RawCacheConfig,
-        RendererConfig, SidecarConfig)
+        RendererConfig, SidecarConfig, SloConfig, TelemetryConfig)
     from omero_ms_image_region_tpu.utils import telemetry
     from omero_ms_image_region_tpu.utils.faultinject import (
         FaultInjectionConfig)
@@ -714,6 +737,7 @@ def bench_chaos_smoke(duration_s: float = 1.5, seed: int = 1234):
     t_start = time.perf_counter()
     rng = np.random.default_rng(seed)
     with tempfile.TemporaryDirectory() as tmp:
+        art = artifacts_dir or os.path.join(tmp, "artifacts")
         planes = synthetic_wsi_tiles(rng, 2, 1, 512, 512).reshape(
             2, 1, 512, 512)
         build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
@@ -726,6 +750,17 @@ def bench_chaos_smoke(duration_s: float = 1.5, seed: int = 1234):
         frontend_cfg = AppConfig(
             data_dir=tmp,
             sidecar=SidecarConfig(socket=sock, role="frontend"),
+            # Forensics under chaos: every request over 1 ms dumps its
+            # waterfall, and an availability SLO tight enough that the
+            # induced outage below must breach it (short windows keep
+            # the smoke run fast; the burn math is scale-free).
+            telemetry=TelemetryConfig(
+                slow_request_ms=1.0,
+                slow_request_dir=os.path.join(art, "slow"),
+                flight_recorder_dir=os.path.join(art, "flight")),
+            slo=SloConfig(availability_target=0.999,
+                          fast_window_s=5.0, slow_window_s=10.0,
+                          breach_burn_rate=5.0),
             fault_tolerance=FaultToleranceConfig(
                 request_deadline_ms=DEADLINE_MS,
                 retry_base_backoff_ms=10.0,
@@ -745,19 +780,28 @@ def bench_chaos_smoke(duration_s: float = 1.5, seed: int = 1234):
             device_error_rate=0.08,
             freeze_rate=0.05, freeze_ms=100.0)
         retries_before = dict(telemetry.RESILIENCE.retries)
-        out = asyncio.run(_chaos_run(sidecar_cfg, frontend_cfg, sock,
-                                     chaos, duration_s, DEADLINE_MS))
+        try:
+            out = asyncio.run(_chaos_run(sidecar_cfg, frontend_cfg,
+                                         sock, chaos, duration_s,
+                                         DEADLINE_MS))
+        finally:
+            # The chaos SLO posture must not leak into whatever this
+            # process runs next (tier-1 shares the interpreter).
+            telemetry.SLO.reset()
         # Diff against the pre-run counters: the gate must judge THIS
         # window, not retries other tests in the process accumulated.
         retried_ops = {
             op for op, n in telemetry.RESILIENCE.retries.items()
             if n > retries_before.get(op, 0)}
+        slow_dir = os.path.join(art, "slow")
         out.update({
             "metric": "chaos_smoke",
             "unit": "invariants",
             "deadline_ms": DEADLINE_MS,
             "plane_put_retried": "plane_put" in retried_ops,
             "retried_ops": sorted(retried_ops),
+            "slow_dumps": (len(os.listdir(slow_dir))
+                           if os.path.isdir(slow_dir) else 0),
             "elapsed_s": round(time.perf_counter() - t_start, 1),
         })
     print(json.dumps(out))
@@ -836,8 +880,44 @@ async def _chaos_run(sidecar_cfg, frontend_cfg, sock, chaos,
         lat = sorted(latencies_ms)
         p99 = lat[max(0, int(len(lat) * 0.99) - 1)] if lat else 0.0
         inj = faultinject.active()
+        injected = inj.snapshot() if inj is not None else {}
+        # The black box must have been recording through the window
+        # (batch formation, retries, breaker transitions) — a chaos
+        # run whose flight ring is empty proves the recorder is dead.
+        from omero_ms_image_region_tpu.utils import telemetry
+        flight_events = len(telemetry.FLIGHT)
+
+        # Induced SLO breach: kill the device backend and keep asking.
+        # Every request now sheds (503 after the retry ladder, then
+        # breaker-fast), availability burns through the tight budget in
+        # both windows, and the breach transition must dump the flight
+        # recorder — the acceptance-criteria forensic chain, end to
+        # end, deterministic (no chaos dice involved).
+        faultinject.uninstall()
+        sidecar_task.cancel()
+        try:
+            await sidecar_task
+        except (asyncio.CancelledError, Exception):
+            pass
+        try:
+            os.unlink(sock)
+        except OSError:
+            pass
+        outage_statuses = []
+        for i in range(12):
+            r = await client.get(url(i, 9000 + i))
+            await r.read()
+            outage_statuses.append(r.status)
+        slo_breached = telemetry.SLO.any_breached()
+        flight_dir = frontend_cfg.telemetry.flight_recorder_dir
+        dumps = (sorted(os.listdir(flight_dir))
+                 if os.path.isdir(flight_dir) else [])
+        dump_events = 0
+        if dumps:
+            with open(os.path.join(flight_dir, dumps[-1])) as f:
+                dump_events = len(json.load(f).get("events", ()))
         return {
-            "injected": inj.snapshot() if inj is not None else {},
+            "injected": injected,
             "value": len(statuses),
             "ok": ok, "shed": shed, "deadline_hit": deadline_hit,
             "bare_5xx": bare_5xx,
@@ -845,6 +925,14 @@ async def _chaos_run(sidecar_cfg, frontend_cfg, sock, chaos,
             "p99_ms": round(p99, 1),
             "zero_bare_5xx": bare_5xx == 0,
             "p99_bounded": p99 <= deadline_ms + 2000.0,
+            "flight_events": flight_events,
+            "outage_sheds": sum(1 for s in outage_statuses
+                                if s in (503, 504)),
+            "slo_breached": slo_breached,
+            "flight_dumps": len(dumps),
+            "flight_dump": (os.path.join(flight_dir, dumps[-1])
+                            if dumps else None),
+            "flight_dump_events": dump_events,
         }
     finally:
         await client.close()

@@ -168,6 +168,13 @@ class DeviceRawCache:
                 digest = digest or plane_digest(loaded)
                 arr = self.get_by_digest(digest, bump=False)
                 self.count_plane(hit=arr is not None)
+                if arr is not None:
+                    # Cost ledger: the upload this request did NOT pay
+                    # (dedup-skipped HBM bytes).  No-op outside a
+                    # request trace context (prefetch, prewarm).
+                    from ..utils import telemetry
+                    telemetry.add_cost("staged_bytes_skipped",
+                                       loaded.nbytes)
             if arr is None:
                 # Host ndarray miss: packed staging ships ~1.4x fewer
                 # wire bytes for uint16 pixel content (io.staging.stage
@@ -175,6 +182,8 @@ class DeviceRawCache:
                 # pay).
                 from .staging import stage
                 arr = stage(loaded)
+                from ..utils import telemetry
+                telemetry.add_cost("staged_bytes", loaded.nbytes)
         else:
             # Already device-resident (banded staging path); content
             # digests are host-side only, so these entries carry none.
@@ -207,10 +216,20 @@ class DeviceRawCache:
             if digest is None or not self._keys_by_digest.get(digest):
                 self._bytes += arr.nbytes
             self._index_digest(key, digest)
+            evicted_labels = []
             while self._bytes > self.max_bytes and len(self._entries) > 1:
                 evicted_key, evicted = self._entries.popitem(last=False)
                 self._release_bytes(evicted_key, evicted)
                 self.evictions += 1
+                evicted_labels.append((str(evicted_key)[:80],
+                                       evicted.nbytes))
+        if evicted_labels:
+            # Black box (outside the lock): an eviction storm right
+            # before a stall is the "hot set no longer fits" signature.
+            from ..utils import telemetry
+            for label, nbytes in evicted_labels:
+                telemetry.FLIGHT.record("rawcache.evict", key=label,
+                                        bytes=nbytes)
         return arr
 
     def get(self, key: Hashable):

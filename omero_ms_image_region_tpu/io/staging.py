@@ -176,6 +176,8 @@ def stage_deduped(arr: np.ndarray, cache, digest: str = None):
     resident = cache.get_by_digest(digest)
     if resident is not None:
         cache.count_plane(hit=True)
+        from ..utils import telemetry
+        telemetry.add_cost("staged_bytes_skipped", arr.nbytes)
         return resident, digest, True
     staged = cache.get_or_load(plane_key(digest), lambda: arr,
                                digest=digest)

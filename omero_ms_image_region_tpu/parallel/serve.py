@@ -29,11 +29,13 @@ results and overflow decisions are deterministic everywhere.
 from __future__ import annotations
 
 import logging
+import time
 from typing import List
 
 import numpy as np
 
-from ..server.batcher import BatchingRenderer, _Pending
+from ..server.batcher import BatchingRenderer, _Pending, _shape_label
+from ..utils import telemetry
 from ..utils.stopwatch import stopwatch
 from .mesh import (Mesh, render_jpeg_step_sharded_batched,
                    render_step_sharded_batched, shard_batch_batched)
@@ -319,13 +321,21 @@ class MeshRenderer(BatchingRenderer):
         # and pads while group N executes.  The pod announce stays
         # INSIDE the gate so announce order always equals launch order
         # (single-lane on multi-host).
+        t_stage = time.perf_counter()
         with stopwatch("batcher.stage"):
             raw, stacked = self._stacked(group)
+        telemetry.add_cost(
+            "stage_ms", (time.perf_counter() - t_stage) * 1000.0 / n)
+        shape = "mesh:" + _shape_label(raw.shape)
         with self._device_gate:
             if self._pod is not None:
                 self._pod.announce(_POD_RENDER, raw, stacked)
+            t0 = time.perf_counter()
             with stopwatch("Renderer.renderAsPackedInt.mesh"):
                 host = self._render_wire(raw, stacked)
+            exec_ms = (time.perf_counter() - t0) * 1000.0
+        telemetry.add_cost("device_ms", exec_ms / n)
+        telemetry.SHAPE_COSTS.observe(shape, exec_ms)
         self._count_batch(n)
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
 
@@ -425,8 +435,12 @@ class MeshRenderer(BatchingRenderer):
 
         n = len(group)
         REGISTRY.record("batcher.groupTiles", float(n))
+        t_stage = time.perf_counter()
         with stopwatch("batcher.stage"):
             raw, stacked = self._stacked(group)
+        telemetry.add_cost(
+            "stage_ms", (time.perf_counter() - t_stage) * 1000.0 / n)
+        shape = "mesh:" + _shape_label(raw.shape, jpeg=True)
         H, W = raw.shape[-2:]
         quality = group[0].quality
         all_exact = all((p.h + 15) // 16 * 16 == H
@@ -439,9 +453,13 @@ class MeshRenderer(BatchingRenderer):
                 if self._pod is not None:
                     self._pod.announce(_POD_JPEG, raw, stacked, quality,
                                        engine_id=1)
+                t0 = time.perf_counter()
                 with stopwatch("Renderer.renderAsPackedInt.mesh"):
                     bufs, cap, cap_words = self._huffman_wire(
                         raw, stacked, H, W, quality)
+                exec_ms = (time.perf_counter() - t0) * 1000.0
+            telemetry.add_cost("device_ms", exec_ms / n)
+            telemetry.SHAPE_COSTS.observe(shape, exec_ms)
             _dense_encode = dense_encoder()
 
             def dense_tile(i):
@@ -459,9 +477,13 @@ class MeshRenderer(BatchingRenderer):
                 if self._pod is not None:
                     self._pod.announce(_POD_JPEG, raw, stacked, quality,
                                        engine_id=0)
+                t0 = time.perf_counter()
                 with stopwatch("Renderer.renderAsPackedInt.mesh"):
                     bufs, cap = self._sparse_wire(raw, stacked, H, W,
                                                   quality)
+                exec_ms = (time.perf_counter() - t0) * 1000.0
+            telemetry.add_cost("device_ms", exec_ms / n)
+            telemetry.SHAPE_COSTS.observe(shape, exec_ms)
             jpegs = finish_sparse_to_jpegs(
                 bufs, dims, H, W, quality, cap,
                 lambda i: self._dense_coefficients(raw, stacked, qy,

@@ -60,6 +60,9 @@ class AdmissionController:
         if self.inflight >= self.max_queue:
             self.shed_total += 1
             telemetry.RESILIENCE.count_shed("queue-full")
+            telemetry.FLIGHT.record("admission.shed",
+                                    reason="queue-full",
+                                    inflight=self.inflight)
             raise OverloadedError(
                 f"admission queue full ({self.inflight} renders "
                 f"in flight)",
@@ -73,6 +76,11 @@ class AdmissionController:
                 # deadline miss that still held a slot the whole time.
                 self.shed_total += 1
                 telemetry.RESILIENCE.count_shed("deadline")
+                telemetry.FLIGHT.record(
+                    "admission.shed", reason="deadline",
+                    inflight=self.inflight,
+                    est_wait_ms=round(est, 1),
+                    remaining_ms=round(remaining, 1))
                 raise OverloadedError(
                     f"estimated wait {est:.0f} ms exceeds remaining "
                     f"deadline budget {remaining:.0f} ms",

@@ -126,6 +126,7 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
     # compile event with a seconds-scale duration.  Installed before
     # anything can compile.
     telemetry.install_compile_listener()
+    telemetry.FLIGHT.configure(config.telemetry.flight_recorder_events)
     _install_fault_injection(config)
     if config.renderer.compilation_cache_dir:
         # Warm restarts: compiled executables persist across processes
@@ -330,6 +331,32 @@ def create_app(config: Optional[AppConfig] = None,
     event-bus seam, ``ImageRegionVerticle.java:128-136``)."""
     config = config or AppConfig()
 
+    # Forensics layer: size the black-box ring, and declare the SLOs.
+    # A breach TRANSITION dumps the flight recorder — the black box
+    # snapshots exactly when the objective says things fell over.
+    telemetry.FLIGHT.configure(config.telemetry.flight_recorder_events)
+
+    def _on_slo_breach(objective: str, fast: float,
+                       slow: float) -> None:
+        telemetry.FLIGHT.record("slo.breach", objective=objective,
+                                fast=round(fast, 2),
+                                slow=round(slow, 2))
+        path = telemetry.FLIGHT.dump(
+            config.telemetry.flight_recorder_dir,
+            f"slo-{objective}")
+        log.warning("SLO breach on %s (burn %.1f fast / %.1f slow); "
+                    "flight recorder dumped to %s", objective, fast,
+                    slow, path)
+
+    telemetry.SLO.configure(
+        availability_target=config.slo.availability_target,
+        latency_ms=config.slo.latency_ms,
+        latency_target=config.slo.latency_target,
+        fast_window_s=config.slo.fast_window_s,
+        slow_window_s=config.slo.slow_window_s,
+        breach_burn_rate=config.slo.breach_burn_rate,
+        on_breach=_on_slo_breach)
+
     proxy_mode = (services is None and config.sidecar.socket
                   and config.sidecar.role == "frontend")
     if proxy_mode:
@@ -478,11 +505,26 @@ def create_app(config: Optional[AppConfig] = None,
     def _finish_request(route: str, status: int, nbytes: int,
                         total_ms: float, trace) -> None:
         """Post-response accounting: request histogram + totals, the
+        SLO windows, the cost ledger (histograms + top-K), the
         structured access line, and the slow-request waterfall dump."""
         telemetry.REQUEST_HIST.observe(route, total_ms)
         telemetry.count_request(route, status)
+        telemetry.SLO.record(status, total_ms)
+        if status >= 500:
+            telemetry.FLIGHT.record(
+                "request.error", route=route, status=status,
+                trace=trace.trace_id if trace is not None else None,
+                ms=round(total_ms, 1))
         if trace is None:
             return
+        ledger, cache_class = telemetry.assemble_ledger(
+            trace, total_ms, nbytes)
+        telemetry.observe_request_cost(route, ledger)
+        telemetry.COST_TOPK.offer({
+            "trace": trace.trace_id, "route": route, "status": status,
+            "ts": round(trace.wall_ts, 3), "cache": cache_class,
+            "total_ms": round(total_ms, 3), "cost": ledger,
+        })
         if config.telemetry.access_log:
             queue_ms = trace.span_ms("batcher.queueWait")
             render_ms = trace.span_ms("Renderer.renderAsPackedInt",
@@ -504,8 +546,8 @@ def create_app(config: Optional[AppConfig] = None,
                 "queue_ms": queue_ms,
                 "render_ms": render_ms,
                 "encode_ms": encode_ms,
-                "cache": ("hit" if trace.span_ms("cache.hit")
-                          is not None else "miss"),
+                "cache": cache_class,
+                "cost": ledger,
             }))
         if (config.telemetry.slow_request_ms > 0
                 and total_ms >= config.telemetry.slow_request_ms):
@@ -593,6 +635,78 @@ def create_app(config: Optional[AppConfig] = None,
         /readyz — a loaded-but-alive service must NOT be restarted."""
         return web.json_response({"status": "ok"})
 
+    async def debug_costs(request: web.Request) -> web.Response:
+        """Top-K most expensive recent requests with their full cost
+        ledgers — "which requests are expensive, and where did the
+        time go" without grepping the access log."""
+        return web.json_response({
+            "observed": telemetry.COST_TOPK.observed,
+            "k": telemetry.COST_TOPK.k,
+            "top": telemetry.COST_TOPK.snapshot(),
+            "shapes": telemetry.SHAPE_COSTS.snapshot(),
+        })
+
+    async def debug_flightrecorder(request: web.Request) -> web.Response:
+        """The black-box ring as JSON; ``?dump=1`` also snapshots it to
+        the configured spool directory (the same artifact a SIGTERM or
+        SLO breach writes).  Proxy mode merges the sidecar's ring so
+        one read shows both processes' last seconds."""
+        doc = {
+            "events": telemetry.FLIGHT.snapshot(),
+            "events_total": telemetry.FLIGHT.events_total,
+            "dumps_written": telemetry.FLIGHT.dumps_written,
+        }
+        if services is None:
+            import asyncio as _asyncio
+            try:
+                status, body = await _asyncio.wait_for(
+                    client.call("flightrecorder", {}), timeout=2.0)
+                doc["sidecar"] = (json.loads(bytes(body).decode())
+                                  if status == 200 and body else None)
+            except Exception:
+                doc["sidecar"] = None
+        if request.query.get("dump"):
+            doc["dumped_to"] = telemetry.FLIGHT.dump(
+                config.telemetry.flight_recorder_dir, "manual")
+        return web.json_response(doc)
+
+    async def debug_profile(request: web.Request) -> web.Response:
+        """On-demand device profiling: wrap ``jax.profiler`` around
+        whatever the batcher lanes are doing for ``?ms=N`` and return
+        the artifact manifest.  Single-flight (409 while one is live);
+        proxy mode forwards over the sidecar wire (``profile`` op) so
+        the capture runs in the process that owns the device."""
+        try:
+            ms = float(request.query.get("ms", 500.0))
+        except ValueError:
+            return web.Response(status=400,
+                                text="ms must be a number")
+        ms = max(1.0, min(ms, config.telemetry.profile_max_ms))
+        if services is None:
+            try:
+                resp_header, body = await client.call_full(
+                    "profile", {}, extra={"ms": ms})
+            except Exception as e:
+                return _status_of(e)
+            status = resp_header["status"]
+            if status == 200:
+                return web.json_response(
+                    json.loads(bytes(body).decode()))
+            return web.json_response(
+                {"error": resp_header.get("error", "")}, status=status)
+        import asyncio as _asyncio
+        try:
+            doc = await _asyncio.to_thread(
+                telemetry.capture_profile,
+                config.telemetry.profile_dir, ms)
+        except telemetry.ProfileInProgressError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        except Exception:
+            log.exception("profile capture failed")
+            return web.json_response(
+                {"error": "profiler unavailable"}, status=503)
+        return web.json_response(doc)
+
     async def _ready_state() -> tuple:
         """(ok, checks) for /readyz: sidecar reachability (proxy mode),
         prewarm completion, and batcher backlog below the configured
@@ -644,6 +758,11 @@ def create_app(config: Optional[AppConfig] = None,
             checks["queue"] = f"depth {depth} over {max_depth}"
         else:
             checks["queue"] = "ok"
+        if telemetry.SLO.enabled:
+            # Annotation only: a burning error budget is an ALERT (and
+            # a flight-recorder dump), not a reason to pull the last
+            # healthy-enough instance out of rotation.
+            checks["slo"] = telemetry.SLO.summary()
         return ok, checks
 
     async def readyz(request: web.Request) -> web.Response:
@@ -724,6 +843,9 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", readyz)
+    app.router.add_get("/debug/costs", debug_costs)
+    app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
+    app.router.add_get("/debug/profile", debug_profile)
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):
@@ -809,9 +931,20 @@ def run_app(app: web.Application, config: AppConfig) -> None:
         # client shutdown).
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
+
+        def _on_signal(signame: str) -> None:
+            # Black-box snapshot FIRST: the dump must exist even if
+            # the orderly teardown below wedges and the supervisor
+            # escalates to SIGKILL.
+            telemetry.FLIGHT.record("signal", sig=signame)
+            telemetry.FLIGHT.dump(
+                config.telemetry.flight_recorder_dir, signame.lower())
+            stop.set()
+
         for sig in (signal.SIGINT, signal.SIGTERM):
             try:
-                loop.add_signal_handler(sig, stop.set)
+                loop.add_signal_handler(
+                    sig, _on_signal, sig.name)
             except NotImplementedError:
                 pass
         try:

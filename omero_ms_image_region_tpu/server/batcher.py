@@ -64,6 +64,58 @@ def _pad_batch_size(n: int, max_batch: int) -> int:
     return max_batch
 
 
+def _key_label(key: tuple) -> str:
+    """Compact group-key label for flight-recorder events: the shape
+    prefix only (channels x bucket), never the settings scalars."""
+    if key and key[0] == "jpeg":
+        return "jpeg:" + "x".join(str(v) for v in key[1:4])
+    return "x".join(str(v) for v in key[:3])
+
+
+def _shape_label(raw_shape, jpeg: bool = False) -> str:
+    """Ladder-shape label for the estimated-vs-observed device cost
+    model ("B8x4x1024x1024"); cardinality is bounded by the bucket and
+    batch ladders."""
+    label = "B" + "x".join(str(int(s)) for s in raw_shape)
+    return ("jpeg:" + label) if jpeg else label
+
+
+# How long a shape's cost-estimate capture waits before running: the
+# AOT re-compile it may trigger is multi-core CPU churn, and the burst
+# that minted the new shape deserves the machine first.
+_ESTIMATE_DELAY_S = 5.0
+
+
+def _capture_shape_estimate(shape: str, jitted_fn, args) -> None:
+    """One-time XLA ``cost_analysis()`` capture for a compiled render
+    shape (the /metrics estimated-vs-observed pair), spawned on a
+    BACKGROUND daemon thread after a grace delay:
+    ``lower().compile()`` re-traces and may re-compile on backends
+    without a persistent compilation cache (seconds of multi-core
+    work), and neither the first group of a new shape nor the traffic
+    burst right behind it should pay for a diagnostic.  Any failure
+    records a zero estimate; the per-shape claim in SHAPE_COSTS
+    guarantees one capture per shape."""
+    def capture():
+        time.sleep(_ESTIMATE_DELAY_S)
+        flops = nbytes = None
+        try:
+            cost = jitted_fn.lower(*args).compile().cost_analysis()
+            # API drift: older JAX returns [dict], newer returns dict.
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            if isinstance(cost, dict):
+                flops = float(cost.get("flops", 0.0) or 0.0)
+                nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
+        except Exception:
+            pass
+        telemetry.SHAPE_COSTS.set_estimate(shape, flops, nbytes)
+
+    import threading
+    threading.Thread(target=capture, name=f"cost-est-{shape}",
+                     daemon=True).start()
+
+
 @dataclass
 class _Pending:
     raw: np.ndarray               # f32[C, bh, bw] padded
@@ -187,8 +239,8 @@ class BatchingRenderer:
         """Group renders currently occupying pipeline slots."""
         return len(self._inflight)
 
-    def _record_queue_waits(self, group: List[_Pending],
-                            now: float) -> None:
+    def _record_queue_waits(self, group: List[_Pending], now: float,
+                            cancelled: bool = False) -> None:
         """Per-request queue-wait spans, recorded ONCE per pending at
         the moment its group is popped for dispatch — never re-sampled
         later in the group's life, so the aggregate mean is exactly
@@ -196,15 +248,25 @@ class BatchingRenderer:
         stragglers cannot re-enter the series.  The high-water mark
         feeds the imageregion_batcher_queue_wait_max_ms gauge
         (stragglers invisible at p50 — and diluted in a mean — stay
-        visible there)."""
+        visible there).
+
+        ``cancelled`` pendings — budgets that died in the queue, or
+        futures a disconnect/fault already settled — record under the
+        SEPARATE ``batcher.queueWait.cancelled`` series: a request
+        nobody rendered for must not skew the dispatched-wait mean
+        (the BENCH_r05 "mean 2276 ms vs p50 2.2 ms" anomaly was
+        exactly these corpses re-entering the aggregate) or the
+        high-water gauge."""
+        series = ("batcher.queueWait.cancelled" if cancelled
+                  else "batcher.queueWait")
         for p in group:
             wait_ms = (now - p.t_enqueue) * 1000.0
-            REGISTRY.record("batcher.queueWait", wait_ms)
-            if wait_ms > self.queue_wait_max_ms:
+            REGISTRY.record(series, wait_ms)
+            if not cancelled and wait_ms > self.queue_wait_max_ms:
                 self.queue_wait_max_ms = wait_ms
             if p.trace_id:
                 telemetry.record_span(
-                    "batcher.queueWait", p.t_enqueue, wait_ms,
+                    series, p.t_enqueue, wait_ms,
                     trace_ids=(p.trace_id,))
 
     # ------------------------------------------------------------- public
@@ -344,8 +406,16 @@ class BatchingRenderer:
             take = self._pop_size(len(queue))
             now_mono = time.monotonic()
             expired: List[_Pending] = []
+            dead: List[_Pending] = []
             while queue and len(group) < take:
                 p = queue.popleft()
+                if p.future.done():
+                    # Already settled while queued — the waiter
+                    # disconnected (its await cancelled the future) or
+                    # a fault path failed it.  Never rendered, and
+                    # never counted as a dispatched queue wait.
+                    dead.append(p)
+                    continue
                 if (self._deadline_drop_enabled
                         and p.deadline is not None
                         and now_mono >= p.deadline):
@@ -360,10 +430,18 @@ class BatchingRenderer:
                 from ..utils.transient import DeadlineExceededError
                 telemetry.RESILIENCE.count_deadline_cancelled(
                     len(expired))
+                telemetry.FLIGHT.record(
+                    "batch.deadline-cancelled", n=len(expired),
+                    key=_key_label(key))
                 for p in expired:
                     if not p.future.done():
                         p.future.set_exception(DeadlineExceededError(
                             "deadline exceeded in batch queue"))
+            if expired or dead:
+                # Labelled separately — see _record_queue_waits.
+                self._record_queue_waits(expired + dead,
+                                         time.perf_counter(),
+                                         cancelled=True)
             if not group:
                 slots.release()
                 continue
@@ -384,6 +462,9 @@ class BatchingRenderer:
             # synchronously at pop (not when the group task happens to
             # run), once per pending.
             self._record_queue_waits(group, time.perf_counter())
+            telemetry.FLIGHT.record(
+                "batch.formed", key=_key_label(key), tiles=len(group),
+                queued=len(queue), inflight=len(self._inflight))
             render = (self._render_group_jpeg if key[0] == "jpeg"
                       else self._render_group)
             task = asyncio.create_task(
@@ -513,26 +594,46 @@ class BatchingRenderer:
                 # dispatch pop when their budgets die — the stall must
                 # never back traffic up unboundedly.
                 time.sleep(freeze)
+        t0 = time.perf_counter()
         with stopwatch("batcher.stage"):
             raw, stack = self._group_arrays(group)
+            staged_bytes = (raw.nbytes
+                            if isinstance(raw, np.ndarray) else 0)
             if isinstance(raw, np.ndarray):
                 from ..io.staging import stage
                 raw = stage(raw)
+        # Cost ledger, pro-rata: the group's one stack+upload spread
+        # over its members (runs under group_trace, so each member's
+        # ledger receives its share).  Device-resident stacks staged
+        # zero host->HBM bytes.
+        n = max(1, len(group))
+        telemetry.add_cost(
+            "stage_ms", (time.perf_counter() - t0) * 1000.0 / n)
+        if staged_bytes:
+            telemetry.add_cost("staged_bytes", staged_bytes / n)
         return raw, stack
 
     def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:
         n = len(group)
         raw, stack = self._stage_group(group)
         s0 = group[0].settings
+        args = (raw, stack("window_start"), stack("window_end"),
+                stack("family"), stack("coefficient"),
+                stack("reverse"),
+                s0["cd_start"], s0["cd_end"], stack("tables"))
+        shape = _shape_label(raw.shape)
+        estimate = telemetry.SHAPE_COSTS.claim_estimate(shape)
         with self._device_gate:
+            t0 = time.perf_counter()
             with stopwatch("Renderer.renderAsPackedInt.batch"):
-                out = render_tile_batch_packed(
-                    raw, stack("window_start"), stack("window_end"),
-                    stack("family"), stack("coefficient"),
-                    stack("reverse"),
-                    s0["cd_start"], s0["cd_end"], stack("tables"),
-                )
+                out = render_tile_batch_packed(*args)
                 host = np.asarray(out)
+            exec_ms = (time.perf_counter() - t0) * 1000.0
+        telemetry.add_cost("device_ms", exec_ms / n)
+        telemetry.SHAPE_COSTS.observe(shape, exec_ms)
+        if estimate:
+            _capture_shape_estimate(shape, render_tile_batch_packed,
+                                    args)
         self._count_batch(n)
         return [host[i, :p.h, :p.w] for i, p in enumerate(group[:n])]
 
@@ -550,7 +651,9 @@ class BatchingRenderer:
         REGISTRY.record("batcher.groupTiles", float(n))
         raw, stack = self._stage_group(group)
         s0 = group[0].settings
+        shape = _shape_label(raw.shape, jpeg=True)
         with self._device_gate:
+            t0 = time.perf_counter()
             with stopwatch("Renderer.renderAsPackedInt.batch"):
                 jpegs = render_batch_to_jpeg(
                     raw, stack("window_start"), stack("window_end"),
@@ -561,5 +664,11 @@ class BatchingRenderer:
                     dims=[(p.w, p.h) for p in group],  # pads skip encode
                     engine=self._current_engine(),
                 )
+            exec_ms = (time.perf_counter() - t0) * 1000.0
+        # Observed-only for JPEG groups: the wire span conflates device
+        # execute with fetch + host entropy coding, and the host
+        # wrapper has no single compiled program to cost-analyze.
+        telemetry.add_cost("device_ms", exec_ms / n)
+        telemetry.SHAPE_COSTS.observe(shape, exec_ms)
         self._count_batch(n)
         return jpegs

@@ -204,12 +204,46 @@ class TelemetryConfig:
     slow_request_ms: float = 0.0
     slow_request_dir: str = "./slow-traces"
     # One-line JSON access log per request (route, status, bytes, cache
-    # tier, queue-wait/render/encode ms, trace id) on the
+    # tier, queue-wait/render/encode ms, trace id, cost ledger) on the
     # "omero_ms_image_region_tpu.access" logger.
     access_log: bool = True
     # /readyz reports degraded (503) when the batcher backlog exceeds
     # this many queued requests.
     ready_max_queue_depth: int = 64
+    # Black-box flight recorder (utils.telemetry.FLIGHT): bounded ring
+    # of structured events (admission sheds, batch formation, breaker
+    # transitions, deadline cancels, cache evictions, compiles) that
+    # snapshots to flight_recorder_dir on SIGTERM, on SLO breach, or
+    # via /debug/flightrecorder?dump=1.
+    flight_recorder_events: int = 512
+    flight_recorder_dir: str = "./flight-recorder"
+    # /debug/profile?ms=N artifacts (jax.profiler traces) land here;
+    # requests are clamped to profile_max_ms.
+    profile_dir: str = "./profiles"
+    profile_max_ms: float = 10000.0
+
+
+@dataclass
+class SloConfig:
+    """Service-level objectives evaluated as multi-window burn rates
+    (utils.telemetry.SloEngine); gauges on /metrics, an annotation on
+    /readyz, and a flight-recorder dump on breach.  Both objectives
+    default off."""
+
+    # Availability objective: target fraction of requests answering
+    # below 500 (sheds and deadline 504s spend the budget).  0 = off.
+    availability_target: float = 0.0
+    # Latency objective: latency_target fraction of successful
+    # requests must finish under latency_ms (p99 tile latency ex-RTT
+    # when latency_ms is set to the interactive bound minus the
+    # deployment's measured RTT floor).  latency_ms 0 = off.
+    latency_ms: float = 0.0
+    latency_target: float = 0.99
+    # Multi-window burn evaluation: breach = burn rate over threshold
+    # in BOTH windows (fast catches the cliff, slow filters blips).
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    breach_burn_rate: float = 14.4
 
 
 @dataclass
@@ -280,6 +314,7 @@ class AppConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     sidecar: SidecarConfig = field(default_factory=SidecarConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
     fault_tolerance: FaultToleranceConfig = field(
         default_factory=FaultToleranceConfig)
     # Seeded chaos layer (utils.faultinject); seed absent = disabled.
@@ -444,12 +479,57 @@ class AppConfig:
             ready_max_queue_depth=int(tel.get(
                 "ready-max-queue-depth",
                 tel_defaults.ready_max_queue_depth)),
+            flight_recorder_events=int(tel.get(
+                "flight-recorder-events",
+                tel_defaults.flight_recorder_events)),
+            flight_recorder_dir=str(tel.get(
+                "flight-recorder-dir",
+                tel_defaults.flight_recorder_dir)),
+            profile_dir=str(tel.get("profile-dir",
+                                    tel_defaults.profile_dir)),
+            profile_max_ms=float(tel.get(
+                "profile-max-ms", tel_defaults.profile_max_ms)),
         )
         if cfg.telemetry.slow_request_ms < 0:
             raise ValueError("telemetry.slow-request-ms must be >= 0")
         if cfg.telemetry.ready_max_queue_depth < 1:
             raise ValueError("telemetry.ready-max-queue-depth must be "
                              ">= 1")
+        if cfg.telemetry.flight_recorder_events < 16:
+            raise ValueError("telemetry.flight-recorder-events must be "
+                             ">= 16 (the black box needs some tape)")
+        if cfg.telemetry.profile_max_ms <= 0:
+            raise ValueError("telemetry.profile-max-ms must be > 0")
+        slo = raw.get("slo", {}) or {}
+        slo_defaults = SloConfig()
+        cfg.slo = SloConfig(
+            availability_target=float(slo.get(
+                "availability-target",
+                slo_defaults.availability_target)),
+            latency_ms=float(slo.get("latency-ms",
+                                     slo_defaults.latency_ms)),
+            latency_target=float(slo.get(
+                "latency-target", slo_defaults.latency_target)),
+            fast_window_s=float(slo.get(
+                "fast-window-s", slo_defaults.fast_window_s)),
+            slow_window_s=float(slo.get(
+                "slow-window-s", slo_defaults.slow_window_s)),
+            breach_burn_rate=float(slo.get(
+                "breach-burn-rate", slo_defaults.breach_burn_rate)),
+        )
+        for name in ("availability_target", "latency_target"):
+            v = getattr(cfg.slo, name)
+            if not 0.0 <= v < 1.0:
+                raise ValueError(
+                    f"slo.{name.replace('_', '-')} must be in [0, 1) "
+                    f"(a target of 1.0 leaves zero error budget), "
+                    f"got {v}")
+        if cfg.slo.latency_ms < 0:
+            raise ValueError("slo.latency-ms must be >= 0")
+        if cfg.slo.fast_window_s <= 0 or cfg.slo.slow_window_s <= 0:
+            raise ValueError("slo windows must be > 0 seconds")
+        if cfg.slo.breach_burn_rate <= 0:
+            raise ValueError("slo.breach-burn-rate must be > 0")
         ft = raw.get("fault-tolerance", {}) or {}
         ft_defaults = FaultToleranceConfig()
         cfg.fault_tolerance = FaultToleranceConfig(

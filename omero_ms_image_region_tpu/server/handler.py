@@ -607,7 +607,21 @@ class ImageRegionHandler:
         result is an HBM-resident ``jax.Array``: raw planes are
         settings-independent, so the interactive re-window/re-color
         pattern re-renders without moving a byte over the host link.
+
+        Wrapped in the ``PixelsService.readRegion`` span (and the
+        ledger's ``read_ms``): the cold disk-read + staging half of a
+        request's wall time, which the render/encode spans never see —
+        without it a slow store and a slow device look identical in a
+        waterfall.
         """
+        with stopwatch("PixelsService.readRegion"):
+            return self._read_region_inner(src, ctx, region, level,
+                                           active, device_cache)
+
+    def _read_region_inner(self, src, ctx: ImageRegionCtx,
+                           region: RegionDef, level: int,
+                           active: List[int],
+                           device_cache: bool = True):
         def load() -> np.ndarray:
             planes = [
                 src.get_region(ctx.z, c, ctx.t, region, level)

@@ -194,11 +194,14 @@ async def _plane_put(image_handler, header: dict,
 
 
 async def _serve_connection(image_handler, mask_handler, reader, writer,
-                            status_fn=None):
+                            status_fn=None, profile_fn=None):
     """One frontend connection: demux requests, run each as a task.
 
     ``status_fn`` answers the ``ping`` op (readiness state for the
-    frontend's ``/readyz``); None keeps a bare liveness answer."""
+    frontend's ``/readyz``); None keeps a bare liveness answer.
+    ``profile_fn(ms)`` serves the ``profile`` op (on-demand
+    ``jax.profiler`` capture in THIS device-owning process); None
+    rejects the op."""
     write_lock = asyncio.Lock()
     tasks = set()
 
@@ -213,6 +216,7 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
 
         rid = header.get("id")
         spans = None
+        costs = None
         inj = faultinject.active()
         if inj is not None and inj.sidecar_should_die():
             # Supervision drill: die MID-call, the way a real crash
@@ -268,12 +272,14 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 finally:
                     # Error paths too: retire the orphan and export
                     # whatever was recorded, so a failed request still
-                    # shows its device-side spans on the frontend
-                    # waterfall instead of leaking a registry entry.
+                    # shows its device-side spans (and its cost
+                    # ledger) on the frontend waterfall instead of
+                    # leaking a registry entry.
                     if trace_id and not shared:
                         trace = telemetry.TRACES.finish(trace_id)
                         if trace is not None:
                             spans = trace.export_spans()
+                            costs = trace.export_costs()
             elif op == "metrics":
                 # Device-process series (spans, caches, batcher gauges,
                 # compile events, link health); frontends merge these
@@ -314,8 +320,34 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 doc = status_fn() if status_fn is not None \
                     else {"ok": True}
                 body = json.dumps(doc).encode()
+            elif op == "flightrecorder":
+                # This process's black-box ring; the frontend merges
+                # it into its /debug/flightrecorder answer.
+                body = json.dumps({
+                    "events": telemetry.FLIGHT.snapshot(),
+                    "events_total": telemetry.FLIGHT.events_total,
+                    "dumps_written": telemetry.FLIGHT.dumps_written,
+                }).encode()
+            elif op == "profile":
+                # On-demand jax.profiler capture around the live
+                # batcher lanes of THIS device-owning process.
+                if profile_fn is None:
+                    raise BadRequestError(
+                        "profiling is not available on this sidecar")
+                try:
+                    ms = float(header.get("ms", 500.0))
+                except (TypeError, ValueError):
+                    raise BadRequestError("profile ms must be a number")
+                doc = await asyncio.to_thread(profile_fn, ms)
+                body = json.dumps(doc).encode()
             else:
                 raise BadRequestError(f"unknown op {op!r}")
+        except telemetry.ProfileInProgressError as e:
+            # Single-flight: a capture is already running; the caller
+            # retries after it finishes (concurrent captures would
+            # interleave one trace file).
+            body, out = b"", {"id": rid, "status": 409,
+                              "error": str(e)}
         except transient.DeadlineExceededError as e:
             # The budget died while this request queued or rendered:
             # 504, and the frontend does NOT retry (more attempts
@@ -350,6 +382,13 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
             out = {"id": rid, "status": 200}
         if spans:
             out["spans"] = spans
+        if costs:
+            out["costs"] = costs
+        if out["status"] >= 400:
+            # Black box: failed sidecar ops are forensic events (the
+            # routine 200 stream would only launder the ring).
+            telemetry.FLIGHT.record("sidecar.op-error", op=header.get(
+                "op"), status=out["status"])
         try:
             await respond(out, body)
         except (ConnectionError, OSError):
@@ -433,6 +472,13 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
             "queue_depth": depth,
         }
 
+    def profile_fn(ms: float) -> dict:
+        """The ``profile`` op: capture in THIS process (it owns the
+        device); the frontend only relays the manifest."""
+        return telemetry.capture_profile(
+            config.telemetry.profile_dir,
+            min(ms, config.telemetry.profile_max_ms))
+
     # Server.close() only stops the LISTENER; established connections
     # and their handler coroutines would outlive a shutdown (and keep
     # serving from half-torn-down services).  Track them and cancel at
@@ -445,7 +491,8 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
         conn_tasks.add(task)
         try:
             await _serve_connection(image_handler, mask_handler, reader,
-                                    writer, status_fn=status_fn)
+                                    writer, status_fn=status_fn,
+                                    profile_fn=profile_fn)
         finally:
             conn_tasks.discard(task)
 
@@ -713,13 +760,25 @@ class SidecarClient:
                     if self._conn is conn:
                         self._conn = None
                 if self.breaker is not None:
+                    opens_before = self.breaker.opens
                     self.breaker.record_failure()
+                    if self.breaker.opens > opens_before:
+                        # Breaker transition: exactly the black-box
+                        # event class — the seconds before a shedding
+                        # episode started.
+                        telemetry.FLIGHT.record(
+                            "breaker.open", op=op,
+                            opens=self.breaker.opens)
                 attempt += 1
                 if attempt >= attempts:
                     telemetry.RESILIENCE.observe_attempts(op, attempt)
+                    telemetry.FLIGHT.record("sidecar.exhausted", op=op,
+                                            attempts=attempt)
                     raise ConnectionError(
                         "render sidecar went away") from exc
                 telemetry.RESILIENCE.count_retry(op)
+                telemetry.FLIGHT.record("sidecar.retry", op=op,
+                                        attempt=attempt)
                 backoff = self.retry.backoff_s(attempt - 1)
                 remaining = transient.remaining_ms()
                 if remaining is not None:
@@ -731,7 +790,11 @@ class SidecarClient:
                     await asyncio.sleep(backoff)
                 continue
             if self.breaker is not None:
+                was_closed = self.breaker.state == self.breaker.CLOSED
                 self.breaker.record_success()
+                if not was_closed:
+                    # Half-open probe succeeded: the episode is over.
+                    telemetry.FLIGHT.record("breaker.close", op=op)
             telemetry.RESILIENCE.observe_attempts(op, attempt + 1)
             trace_id = telemetry.current_trace_id()
             if trace_id and resp_header.get("spans"):
@@ -750,6 +813,10 @@ class SidecarClient:
                             s["dur_ms"], trace_ids=(trace_id,), **meta)
                     except (KeyError, TypeError):
                         pass    # malformed span: drop it, keep serving
+            if trace_id and isinstance(resp_header.get("costs"), dict):
+                # Device-side ledger entries (device-execute ms,
+                # staged bytes) join the frontend's per-request ledger.
+                telemetry.merge_costs(trace_id, resp_header["costs"])
             return resp_header, resp_body
 
     async def _inject_wire_fault(self, conn: _Conn, kind: str,
@@ -934,6 +1001,12 @@ def sidecar_main(config) -> None:
         try:
             await run_sidecar(config)
         except asyncio.CancelledError:
+            # Orderly stop (SIGTERM): snapshot the black box so the
+            # last seconds of batcher/cache/chaos activity survive the
+            # process.
+            telemetry.FLIGHT.record("signal", sig="SIGTERM")
+            telemetry.FLIGHT.dump(config.telemetry.flight_recorder_dir,
+                                  "sigterm")
             logger.info("render sidecar stopped")
 
     try:
@@ -1094,6 +1167,8 @@ class SidecarSupervisor:
             spawned_at = time.monotonic()
             self.restarts += 1
             telemetry.RESILIENCE.count_supervisor_restart()
+            telemetry.FLIGHT.record("supervisor.restart",
+                                    n=self.restarts)
             logger.info("render sidecar restarted (restart #%d)",
                         self.restarts)
 

@@ -165,7 +165,8 @@ def new_trace_id() -> str:
 
 
 class Trace:
-    __slots__ = ("trace_id", "route", "t0", "wall_ts", "spans", "lock")
+    __slots__ = ("trace_id", "route", "t0", "wall_ts", "spans", "lock",
+                 "costs")
 
     def __init__(self, trace_id: str, route: str = ""):
         self.trace_id = trace_id
@@ -173,7 +174,23 @@ class Trace:
         self.t0 = time.perf_counter()
         self.wall_ts = time.time()
         self.spans: List[dict] = []
+        # Per-request cost ledger: numeric accumulators attributed to
+        # this request (device-execute ms pro-rata from its batch
+        # group, staged vs dedup-skipped HBM bytes, ...).  Written by
+        # whatever layer did the work — batcher worker threads, the
+        # device cache, the sidecar wire graft — under ``lock``.
+        self.costs: Dict[str, float] = {}
         self.lock = threading.Lock()
+
+    def add_cost(self, key: str, value: float) -> None:
+        with self.lock:
+            self.costs[key] = self.costs.get(key, 0.0) + float(value)
+
+    def export_costs(self) -> Dict[str, float]:
+        """Wire-safe copy of the ledger (the sidecar response carries
+        it so device-side costs land on the frontend's ledger)."""
+        with self.lock:
+            return dict(self.costs)
 
     def add_span(self, name: str, t_start: float, dur_ms: float,
                  **meta) -> None:
@@ -208,8 +225,11 @@ class Trace:
                 status: Optional[int] = None) -> dict:
         with self.lock:
             spans = sorted(self.spans, key=lambda s: s["start_ms"])
+            costs = dict(self.costs)
         doc = {"trace_id": self.trace_id, "route": self.route,
                "ts": self.wall_ts, "spans": spans}
+        if costs:
+            doc["cost"] = {k: round(v, 3) for k, v in costs.items()}
         if total_ms is not None:
             doc["total_ms"] = round(total_ms, 3)
         if status is not None:
@@ -360,6 +380,29 @@ def observe_span(name: str, dur_ms: float) -> None:
                 trace_ids=ids)
 
 
+def add_cost(key: str, value: float,
+             trace_ids: Optional[Tuple[str, ...]] = None) -> None:
+    """Accumulate a cost onto the context's trace ledger(s).
+
+    Pro-rata attribution is the CALLER's job: a batcher group render
+    running under ``group_trace`` passes ``exec_ms / len(group)`` and
+    every member's ledger receives its fair share of the one device
+    dispatch.  No-op outside any trace context (prefetchers, prewarm)."""
+    ids = trace_ids if trace_ids is not None else _TRACE_IDS.get()
+    for tid in ids:
+        TRACES.get_or_create(tid).add_cost(key, value)
+
+
+def merge_costs(trace_id: str, costs: Dict[str, float]) -> None:
+    """Graft a wire-exported ledger (sidecar response) onto a trace."""
+    trace = TRACES.get_or_create(trace_id)
+    for key, value in costs.items():
+        try:
+            trace.add_cost(str(key), float(value))
+        except (TypeError, ValueError):
+            pass    # malformed wire field: drop it, keep serving
+
+
 # ------------------------------------------------------------- link health
 
 class LinkHealth:
@@ -443,6 +486,9 @@ class CompileStats:
         with self._lock:
             self.events += 1
             self.total_ms += duration_s * 1000.0
+        # Compile stalls are exactly the "what was it doing before it
+        # fell over" class the black box exists for.
+        FLIGHT.record("xla.compile", ms=round(duration_s * 1000.0, 1))
 
     def reset(self) -> None:
         with self._lock:
@@ -453,6 +499,551 @@ class CompileStats:
 COMPILE = CompileStats()
 _COMPILE_LISTENER = threading.Lock()
 _compile_listener_installed = False
+
+
+# --------------------------------------------------------- cost ledger
+
+# Per-route histograms over the request cost ledger — which requests
+# are expensive, and WHERE the expense sits (device, queue, staging,
+# encode, wire).  Keys are the ledger fields; byte fields convert to
+# KB so the fixed ms-scale log buckets still resolve them.
+_COST_HIST_FIELDS = {
+    "device_ms": "imageregion_request_cost_device_ms",
+    "read_ms": "imageregion_request_cost_read_ms",
+    "stage_ms": "imageregion_request_cost_stage_ms",
+    "queue_ms": "imageregion_request_cost_queue_ms",
+    "encode_ms": "imageregion_request_cost_encode_ms",
+    "staged_kb": "imageregion_request_cost_staged_kb",
+    "wire_kb": "imageregion_request_cost_wire_kb",
+}
+
+COST_HISTS: Dict[str, HistogramVec] = {
+    field: HistogramVec("route") for field in _COST_HIST_FIELDS
+}
+
+
+class CostTopK:
+    """Bounded ledger of the most expensive recent requests (by wall
+    total_ms) — the ``/debug/costs`` answer to "which requests are
+    expensive".  Thread-safe; eviction is cheapest-first."""
+
+    def __init__(self, k: int = 16):
+        self.k = k
+        self._lock = threading.Lock()
+        self._entries: List[dict] = []   # sorted descending by score
+        self.observed = 0
+
+    def offer(self, doc: dict) -> None:
+        score = float(doc.get("total_ms") or 0.0)
+        with self._lock:
+            self.observed += 1
+            if (len(self._entries) >= self.k
+                    and score <= float(
+                        self._entries[-1].get("total_ms") or 0.0)):
+                return
+            self._entries.append(doc)
+            self._entries.sort(key=lambda d: -(d.get("total_ms") or 0.0))
+            del self._entries[self.k:]
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [dict(d) for d in self._entries]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.observed = 0
+
+
+COST_TOPK = CostTopK()
+
+
+def assemble_ledger(trace: Trace, total_ms: float,
+                    nbytes: int) -> Tuple[Dict[str, float], str]:
+    """(ledger, cache_class) for a finished request.
+
+    Accumulated costs (device/stage ms, staged bytes — written by the
+    layers that did the work) merge with span-derived fields (queue
+    wait, encode) and the response size.  ``cache_class`` is where the
+    bytes came from: ``byte-cache`` (no pipeline ran), ``coalesced``
+    (single-flight follower), else ``render``."""
+    ledger = trace.export_costs()
+    queue_ms = trace.span_ms("batcher.queueWait")
+    if queue_ms is not None:
+        ledger["queue_ms"] = round(queue_ms, 3)
+    read_ms = trace.span_ms("PixelsService.readRegion")
+    if read_ms is not None:
+        ledger["read_ms"] = round(read_ms, 3)
+    encode_ms = trace.span_ms("encodeImage", "jfif.encodeBatch")
+    if encode_ms is not None:
+        ledger["encode_ms"] = round(encode_ms, 3)
+    ledger["wire_bytes"] = int(nbytes)
+    ledger["total_ms"] = round(total_ms, 3)
+    if trace.span_ms("cache.hit") is not None:
+        cache_class = "byte-cache"
+    elif trace.span_ms("dedup.coalesced") is not None:
+        cache_class = "coalesced"
+    else:
+        cache_class = "render"
+    return ledger, cache_class
+
+
+def observe_request_cost(route: str, ledger: Dict[str, float]) -> None:
+    """Feed the per-route cost histograms from a finished ledger."""
+    for field, hist in COST_HISTS.items():
+        if field == "staged_kb":
+            value = ledger.get("staged_bytes")
+        elif field == "wire_kb":
+            value = ledger.get("wire_bytes")
+        else:
+            value = ledger.get(field)
+        if value is None:
+            continue
+        if field.endswith("_kb"):
+            value = float(value) / 1024.0
+        hist.observe(route, float(value))
+
+
+def cost_metric_lines() -> List[str]:
+    lines: List[str] = []
+    for field, hist in COST_HISTS.items():
+        lines += hist.series(_COST_HIST_FIELDS[field])
+    return lines
+
+
+# ------------------------------------------------------ flight recorder
+
+# Monotone artifact sequence shared by flight dumps and profile
+# captures: two artifacts in the same wall-clock second must get two
+# names, never silently overwrite one (next() is atomic on CPython).
+import itertools as _itertools          # noqa: E402
+
+_ARTIFACT_SEQ = _itertools.count(1)
+
+
+class FlightRecorder:
+    """Black-box ring of structured events: what the system was doing
+    in the seconds before it fell over.
+
+    Lock-free on the hot path — ``deque.append`` with a ``maxlen`` is
+    atomic under the GIL, so recording from batcher worker threads,
+    the admission path and (best-effort) signal handlers never blocks
+    and never deadlocks.  ``dump`` snapshots via ``list(ring)`` (also
+    atomic) and NEVER raises: a full disk must not turn a crash dump
+    into a second crash."""
+
+    def __init__(self, maxlen: int = 512):
+        from collections import deque
+        self._ring = deque(maxlen=maxlen)
+        self.events_total = 0
+        self.dumps_written = 0
+
+    def configure(self, maxlen: int) -> None:
+        from collections import deque
+        if maxlen != self._ring.maxlen:
+            self._ring = deque(self._ring, maxlen=max(16, maxlen))
+
+    def record(self, kind: str, **fields) -> None:
+        event = {"ts": round(time.time(), 3), "kind": kind}
+        if fields:
+            event.update(fields)
+        self._ring.append(event)
+        self.events_total += 1    # benign race: a count, not a key
+
+    def snapshot(self) -> List[dict]:
+        return list(self._ring)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    # Spool retention: dumps past this many are pruned oldest-first on
+    # each write, so a breach-flapping (or curl-looping) deployment
+    # cannot fill the disk with black-box snapshots.
+    MAX_DUMPS = 64
+
+    def dump(self, directory: str, reason: str) -> Optional[str]:
+        """Write the ring as one JSON document; returns the path or
+        None (never raises — see class docstring).  Names carry a
+        monotone sequence so same-second dumps never collide."""
+        try:
+            events = self.snapshot()
+            os.makedirs(directory, exist_ok=True)
+            seq = next(_ARTIFACT_SEQ)
+            path = os.path.join(
+                directory,
+                time.strftime(f"flight-%Y%m%d-%H%M%S-{os.getpid()}"
+                              f"-{seq:04d}-{reason}.json"))
+            doc = {"flight_recorder": True, "reason": reason,
+                   "ts": round(time.time(), 3), "pid": os.getpid(),
+                   "events": events}
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=1)
+            self.dumps_written += 1
+            self._prune(directory)
+            return path
+        except Exception:
+            try:
+                log.warning("flight-recorder dump to %s failed",
+                            directory, exc_info=True)
+            except Exception:
+                pass
+            return None
+
+    def _prune(self, directory: str) -> None:
+        dumps = sorted(
+            os.path.join(directory, name)
+            for name in os.listdir(directory)
+            if name.startswith("flight-") and name.endswith(".json"))
+        for stale in dumps[:-self.MAX_DUMPS]:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+
+    def reset(self) -> None:
+        self._ring.clear()
+        self.events_total = 0
+        self.dumps_written = 0
+
+
+FLIGHT = FlightRecorder()
+
+
+# ----------------------------------------------------------- SLO engine
+
+class SloEngine:
+    """Config-declared service objectives evaluated as multi-window
+    burn rates (the Google SRE alerting form: error_rate /
+    error_budget over a fast AND a slow window — both over threshold
+    means the budget is burning fast enough, for long enough, to
+    matter).
+
+    Objectives:
+
+    * ``availability`` — fraction of requests answering below 500
+      (deliberate sheds and deadline 504s spend the budget: the user
+      still did not get a tile);
+    * ``latency`` — fraction of SUCCESSFUL requests under
+      ``latency_ms`` (the p-target latency objective; errors are the
+      availability objective's problem, not this one's).
+
+    Time is bucketed (``BUCKET_S``) so the windows are O(window /
+    bucket) memory and record() is a dict increment.  Disabled (the
+    default — no targets configured) it costs one boolean check.
+    A breach TRANSITION fires ``on_breach`` once per episode — the
+    flight-recorder dump hook."""
+
+    BUCKET_S = 5.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._clock = time.monotonic
+        self.enabled = False
+        self.availability_target = 0.0
+        self.latency_ms = 0.0
+        self.latency_target = 0.99
+        self.fast_window_s = 60.0
+        self.slow_window_s = 600.0
+        self.breach_burn_rate = 14.4
+        self.on_breach = None
+        self.breached: Dict[str, bool] = {}
+        self.breaches_total = 0
+        # bucket index -> {"good": n, "bad": n, "fast": n, "slow": n}
+        self._buckets: Dict[int, Dict[str, int]] = {}
+
+    def configure(self, availability_target: float = 0.0,
+                  latency_ms: float = 0.0,
+                  latency_target: float = 0.99,
+                  fast_window_s: float = 60.0,
+                  slow_window_s: float = 600.0,
+                  breach_burn_rate: float = 14.4,
+                  on_breach=None, clock=time.monotonic) -> None:
+        with self._lock:
+            self.availability_target = availability_target
+            self.latency_ms = latency_ms
+            self.latency_target = latency_target
+            self.fast_window_s = fast_window_s
+            self.slow_window_s = max(slow_window_s, fast_window_s)
+            self.breach_burn_rate = breach_burn_rate
+            self.on_breach = on_breach
+            self._clock = clock
+            self.enabled = bool(availability_target or latency_ms)
+            self._buckets.clear()
+            self.breached = {}
+
+    def _bucket(self, now: float) -> Dict[str, int]:
+        idx = int(now // self.BUCKET_S)
+        b = self._buckets.get(idx)
+        if b is None:
+            b = self._buckets[idx] = {"ok": 0, "err": 0,
+                                      "fast": 0, "slow": 0}
+            # Prune everything older than the slow window.
+            floor = idx - int(self.slow_window_s // self.BUCKET_S) - 1
+            for old in [i for i in self._buckets if i < floor]:
+                del self._buckets[old]
+        return b
+
+    def record(self, status: int, dur_ms: float) -> None:
+        if not self.enabled:
+            return
+        breach_cbs = []
+        with self._lock:
+            b = self._bucket(self._clock())
+            if status >= 500:
+                b["err"] += 1
+            else:
+                b["ok"] += 1
+                if self.latency_ms:
+                    if dur_ms <= self.latency_ms:
+                        b["fast"] += 1
+                    else:
+                        b["slow"] += 1
+            rates = self._burn_rates_locked()
+            for objective, (fast, slow) in rates.items():
+                now_breached = (fast >= self.breach_burn_rate
+                                and slow >= self.breach_burn_rate)
+                was = self.breached.get(objective, False)
+                self.breached[objective] = now_breached
+                if now_breached and not was:
+                    self.breaches_total += 1
+                    # Appended, not assigned: both objectives may
+                    # transition on ONE record, and each breach owns
+                    # its dump.
+                    breach_cbs.append((objective, fast, slow))
+        if self.on_breach is not None:
+            for cb in breach_cbs:
+                try:
+                    self.on_breach(*cb)
+                except Exception:  # forensics must never fail requests
+                    log.warning("SLO on_breach hook failed",
+                                exc_info=True)
+
+    def _window_counts(self, window_s: float) -> Dict[str, int]:
+        floor = int((self._clock() - window_s) // self.BUCKET_S)
+        out = {"ok": 0, "err": 0, "fast": 0, "slow": 0}
+        for idx, b in self._buckets.items():
+            if idx >= floor:
+                for k in out:
+                    out[k] += b[k]
+        return out
+
+    def _burn_rates_locked(self) -> Dict[str, Tuple[float, float]]:
+        rates: Dict[str, Tuple[float, float]] = {}
+
+        def burn(bad: int, total: int, target: float) -> float:
+            if total == 0:
+                return 0.0
+            budget = max(1e-9, 1.0 - target)
+            return (bad / total) / budget
+
+        pair = []
+        for window_s in (self.fast_window_s, self.slow_window_s):
+            pair.append(self._window_counts(window_s))
+        if self.availability_target:
+            rates["availability"] = tuple(
+                burn(c["err"], c["ok"] + c["err"],
+                     self.availability_target) for c in pair)
+        if self.latency_ms:
+            rates["latency"] = tuple(
+                burn(c["slow"], c["fast"] + c["slow"],
+                     self.latency_target) for c in pair)
+        return rates
+
+    def burn_rates(self) -> Dict[str, Tuple[float, float]]:
+        """{objective: (fast_burn, slow_burn)} over the two windows."""
+        with self._lock:
+            return self._burn_rates_locked()
+
+    def any_breached(self) -> bool:
+        with self._lock:
+            return any(self.breached.values())
+
+    def summary(self) -> str:
+        """One-line state for the /readyz annotation."""
+        with self._lock:
+            rates = self._burn_rates_locked()
+            breached = [o for o, v in self.breached.items() if v]
+        if not rates:
+            return "disabled"
+        parts = [f"{o} burn {fast:.1f}/{slow:.1f}"
+                 for o, (fast, slow) in sorted(rates.items())]
+        state = "BREACH " if breached else "ok "
+        return state + ", ".join(parts)
+
+    def metric_lines(self) -> List[str]:
+        if not self.enabled:
+            return []
+        lines = []
+        with self._lock:
+            rates = self._burn_rates_locked()
+            breached = dict(self.breached)
+            breaches = self.breaches_total
+        for objective, (fast, slow) in sorted(rates.items()):
+            for window, rate in (("fast", fast), ("slow", slow)):
+                lines.append(
+                    f'imageregion_slo_burn_rate{{slo="{objective}",'
+                    f'window="{window}"}} {round(rate, 4)}')
+            lines.append(
+                f'imageregion_slo_breach{{slo="{objective}"}} '
+                f'{1 if breached.get(objective) else 0}')
+        lines.append(f"imageregion_slo_breaches_total {breaches}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.enabled = False
+            self.availability_target = 0.0
+            self.latency_ms = 0.0
+            self.on_breach = None
+            self._clock = time.monotonic
+            self._buckets.clear()
+            self.breached = {}
+            self.breaches_total = 0
+
+
+SLO = SloEngine()
+
+
+# ------------------------------------------------------ shape cost model
+
+class ShapeCostModel:
+    """Estimated vs observed device cost per compiled render shape.
+
+    The batcher records every group's device-execute wall ms under its
+    ladder-shape label, and (once per shape, best-effort) the XLA
+    ``cost_analysis()`` flops/bytes estimate of the compiled program —
+    so /metrics answers "is this shape running at the speed its
+    program says it should" without a profiler attached.  Label
+    cardinality is bounded by the bucket/batch ladder."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shapes: Dict[str, dict] = {}
+        self._claimed: set = set()
+
+    def observe(self, shape: str, ms: float) -> None:
+        with self._lock:
+            s = self._shapes.get(shape)
+            if s is None:
+                s = self._shapes[shape] = {
+                    "dispatches": 0, "ms_total": 0.0,
+                    "est_flops": None, "est_bytes": None}
+            s["dispatches"] += 1
+            s["ms_total"] += ms
+
+    def claim_estimate(self, shape: str) -> bool:
+        """One-shot claim of the estimate capture for ``shape`` — True
+        exactly once, so concurrent first groups of one shape spawn
+        one capture, not one per lane."""
+        with self._lock:
+            if shape in self._claimed:
+                return False
+            self._claimed.add(shape)
+            return True
+
+    def set_estimate(self, shape: str, flops: Optional[float],
+                     nbytes: Optional[float]) -> None:
+        with self._lock:
+            s = self._shapes.setdefault(shape, {
+                "dispatches": 0, "ms_total": 0.0,
+                "est_flops": None, "est_bytes": None})
+            # 0.0 marks "capture attempted, nothing learned" so the
+            # one-time hook never re-fires for the shape.
+            s["est_flops"] = float(flops or 0.0)
+            s["est_bytes"] = float(nbytes or 0.0)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._shapes.items()}
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        lines = []
+        extra = extra_labels.lstrip(",")
+        with self._lock:
+            items = sorted(self._shapes.items())
+        for shape, s in items:
+            lb = f'{{shape="{shape}"' + (f",{extra}" if extra
+                                         else "") + "}"
+            lines += [
+                f"imageregion_shape_dispatches_total{lb} "
+                f"{s['dispatches']}",
+                f"imageregion_shape_device_ms_total{lb} "
+                f"{round(s['ms_total'], 3)}",
+            ]
+            if s["dispatches"]:
+                lines.append(
+                    f"imageregion_shape_device_ms_mean{lb} "
+                    f"{round(s['ms_total'] / s['dispatches'], 3)}")
+            if s["est_flops"] is not None:
+                lines += [
+                    f"imageregion_shape_estimated_flops{lb} "
+                    f"{_fmt(s['est_flops'])}",
+                    f"imageregion_shape_estimated_bytes{lb} "
+                    f"{_fmt(s['est_bytes'])}",
+                ]
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self._shapes.clear()
+            self._claimed.clear()
+
+
+SHAPE_COSTS = ShapeCostModel()
+
+
+# ------------------------------------------------------ device profiling
+
+class ProfileInProgressError(Exception):
+    """A capture is already running (the endpoint answers 409)."""
+
+
+_PROFILE_LOCK = threading.Lock()
+
+
+def capture_profile(directory: str, ms: float) -> dict:
+    """Wrap ``jax.profiler`` around whatever the device is doing for
+    ``ms`` milliseconds; returns the artifact manifest.
+
+    Single-flight (`ProfileInProgressError` when one is live —
+    concurrent captures would interleave one trace file), blocking
+    (call via a worker thread), and the ONE telemetry function besides
+    the compile listener that imports JAX — only device-owning
+    processes serve it (frontends forward over the sidecar wire)."""
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise ProfileInProgressError("a profile capture is already "
+                                     "running")
+    try:
+        import jax
+        seq = next(_ARTIFACT_SEQ)
+        path = os.path.join(
+            directory,
+            time.strftime(f"profile-%Y%m%d-%H%M%S-{seq:04d}"))
+        os.makedirs(path, exist_ok=True)
+        t0 = time.perf_counter()
+        jax.profiler.start_trace(path)
+        try:
+            time.sleep(max(0.0, ms) / 1000.0)
+        finally:
+            jax.profiler.stop_trace()
+        files = []
+        total = 0
+        for root, _dirs, names in os.walk(path):
+            for name in names:
+                full = os.path.join(root, name)
+                files.append(os.path.relpath(full, path))
+                try:
+                    total += os.path.getsize(full)
+                except OSError:
+                    pass
+        FLIGHT.record("profile.captured", dir=path,
+                      ms=round(ms, 1), files=len(files))
+        return {"dir": path, "ms": round(
+            (time.perf_counter() - t0) * 1000.0, 1),
+            "requested_ms": ms, "files": sorted(files),
+            "bytes": total}
+    finally:
+        _PROFILE_LOCK.release()
 
 
 def install_compile_listener() -> bool:
@@ -659,6 +1250,59 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_deadline_cancelled_total": "counter",
     "imageregion_degraded_renders_total": "counter",
     "imageregion_supervisor_restarts_total": "counter",
+    # Cost-ledger histograms (per-route attribution of where each
+    # request's time and bytes went).
+    "imageregion_request_cost_device_ms": "histogram",
+    "imageregion_request_cost_read_ms": "histogram",
+    "imageregion_request_cost_stage_ms": "histogram",
+    "imageregion_request_cost_queue_ms": "histogram",
+    "imageregion_request_cost_encode_ms": "histogram",
+    "imageregion_request_cost_staged_kb": "histogram",
+    "imageregion_request_cost_wire_kb": "histogram",
+    # SLO burn rates + breach bits.
+    "imageregion_slo_burn_rate": "gauge",
+    "imageregion_slo_breach": "gauge",
+    "imageregion_slo_breaches_total": "counter",
+    # Flight-recorder ring state.
+    "imageregion_flight_events": "gauge",
+    "imageregion_flight_events_total": "counter",
+    "imageregion_flight_dumps_total": "counter",
+    # Per-ladder-shape device cost (estimated vs observed).
+    "imageregion_shape_dispatches_total": "counter",
+    "imageregion_shape_device_ms_total": "counter",
+    "imageregion_shape_device_ms_mean": "gauge",
+    "imageregion_shape_estimated_flops": "gauge",
+    "imageregion_shape_estimated_bytes": "gauge",
+}
+
+# Terse HELP strings for the families whose meaning is not obvious
+# from the name; every family gets a HELP line (fallback text) so the
+# exposition lint can hold "HELP exactly once per family" everywhere.
+METRIC_HELP: Dict[str, str] = {
+    "imageregion_request_cost_device_ms":
+        "Per-request device-execute ms (pro-rata from batch group)",
+    "imageregion_request_cost_read_ms":
+        "Per-request cold pixel-store read + staging ms",
+    "imageregion_request_cost_stage_ms":
+        "Per-request host->HBM staging ms (pro-rata)",
+    "imageregion_request_cost_queue_ms":
+        "Per-request batcher queue wait ms",
+    "imageregion_request_cost_encode_ms":
+        "Per-request host encode ms",
+    "imageregion_request_cost_staged_kb":
+        "Per-request HBM bytes staged (KB, pro-rata)",
+    "imageregion_request_cost_wire_kb":
+        "Per-request response bytes (KB)",
+    "imageregion_slo_burn_rate":
+        "Error-budget burn rate per objective and window",
+    "imageregion_slo_breach":
+        "1 while the objective is in multi-window breach",
+    "imageregion_flight_events":
+        "Events currently held in the flight-recorder ring",
+    "imageregion_shape_estimated_flops":
+        "XLA cost_analysis flops estimate of the shape's program",
+    "imageregion_batcher_queue_wait_max_ms":
+        "High-water dispatched queue wait (cancelled waits excluded)",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -687,7 +1331,9 @@ def finalize_exposition(lines: List[str]) -> str:
         if not line:
             continue
         if line.startswith("#"):
-            if not line.startswith("# TYPE"):
+            # TYPE and HELP are the finalizer's to emit (exactly once
+            # per family); merged inputs must not smuggle duplicates.
+            if not line.startswith(("# TYPE", "# HELP")):
                 comments.append(line)
             continue
         fam = _family_of(line)
@@ -697,6 +1343,8 @@ def finalize_exposition(lines: List[str]) -> str:
         families[fam].append(line)
     out: List[str] = []
     for fam in order:
+        out.append(f"# HELP {fam} "
+                   f"{METRIC_HELP.get(fam, fam.replace('_', ' '))}")
         out.append(f"# TYPE {fam} {METRIC_TYPES.get(fam, 'untyped')}")
         out += families[fam]
     out += comments
@@ -704,13 +1352,22 @@ def finalize_exposition(lines: List[str]) -> str:
 
 
 def request_metric_lines() -> List[str]:
-    """The frontend-local request series (histogram + totals)."""
+    """The frontend-local request series (histogram + totals), the
+    cost-ledger histograms, the SLO burn gauges and the local
+    flight-recorder ring state."""
     lines = REQUEST_HIST.series("imageregion_request_duration_ms")
     with _REQ_LOCK:
         totals = sorted(_REQ_TOTALS.items())
     for (route, status), n in totals:
         lines.append(f'imageregion_requests_total{{route="{route}",'
                      f'status="{status}"}} {n}')
+    lines += cost_metric_lines()
+    lines += SLO.metric_lines()
+    lines += [
+        f"imageregion_flight_events {len(FLIGHT)}",
+        f"imageregion_flight_events_total {FLIGHT.events_total}",
+        f"imageregion_flight_dumps_total {FLIGHT.dumps_written}",
+    ]
     return lines
 
 
@@ -808,6 +1465,21 @@ def device_metric_lines(services, extra_labels: str = "") -> List[str]:
         f"imageregion_link_fetches_total{lb} {LINK.fetches}",
         f"imageregion_link_fetch_bytes_total{lb} {LINK.bytes_total}",
     ]
+    # Per-ladder-shape estimated vs observed device cost (the batcher
+    # records both; cardinality is bounded by the bucket/batch ladder).
+    lines += SHAPE_COSTS.metric_lines(extra_labels)
+    if extra_labels:
+        # The sidecar's flight-recorder ring, labelled so the
+        # frontend's merged exposition keeps both processes' series
+        # distinct.  Combined/frontend processes emit their own copy
+        # unlabelled via request_metric_lines.
+        lines += [
+            f"imageregion_flight_events{lb} {len(FLIGHT)}",
+            f"imageregion_flight_events_total{lb} "
+            f"{FLIGHT.events_total}",
+            f"imageregion_flight_dumps_total{lb} "
+            f"{FLIGHT.dumps_written}",
+        ]
     if LINK.fetches:
         # 0.0 until a bandwidth-class fetch has been rated (small
         # fetches are latency-dominated and carry no rate signal).
@@ -821,7 +1493,9 @@ def device_metric_lines(services, extra_labels: str = "") -> List[str]:
 
 
 def reset() -> None:
-    """Test isolation: clear every process-global accumulator."""
+    """Test isolation: clear every process-global accumulator —
+    repeated in-process test apps must not leak counts (or SLO breach
+    state, or flight events) across tests."""
     TRACES.reset()
     REQUEST_HIST.reset()
     with _REQ_LOCK:
@@ -830,3 +1504,9 @@ def reset() -> None:
     COMPILE.reset()
     READINESS.reset()
     RESILIENCE.reset()
+    for hist in COST_HISTS.values():
+        hist.reset()
+    COST_TOPK.reset()
+    FLIGHT.reset()
+    SLO.reset()
+    SHAPE_COSTS.reset()

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff a pair of BENCH_r*.json records and
+exit non-zero on a service-rate regression, so the round-over-round
+trajectory becomes a GATE instead of a log entry someone may read.
+
+Usage::
+
+    python scripts/bench_gate.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_gate.py --dir .          # newest pair by name
+    python scripts/bench_gate.py --key service_tiles_per_sec \
+        --max-regression 0.10 old.json new.json
+
+Exit codes: 0 pass (or nothing to judge — see --strict), 1 regression
+over the threshold, 2 usage/input error.
+
+The default key is the full-HTTP-stack service rate; tunnel weather
+can null it out for a round, so an absent/None value SKIPS the gate
+(with a printed verdict) rather than failing the build — ``--strict``
+turns skips into failures for CI postures that must always measure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+DEFAULT_KEYS = ("service_tiles_per_sec",)
+_BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def load_record(path: str) -> dict:
+    """One bench record: a JSON object, or the last JSON line of the
+    file (bench.py prints ONE line; drivers may append logs)."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: no JSON object found")
+    return doc
+
+
+def newest_pair(directory: str):
+    """The two highest-numbered BENCH_r*.json records in ``directory``
+    (old, new) — the pair the driver's latest round produced."""
+    rounds = []
+    for name in os.listdir(directory):
+        m = _BENCH_RE.match(name)
+        if m:
+            rounds.append((int(m.group(1)),
+                           os.path.join(directory, name)))
+    rounds.sort()
+    if len(rounds) < 2:
+        raise ValueError(
+            f"{directory}: need at least two BENCH_r*.json records, "
+            f"found {len(rounds)}")
+    return rounds[-2][1], rounds[-1][1]
+
+
+def judge(old: dict, new: dict, keys, max_regression: float):
+    """Per-key verdicts: ``pass`` / ``regression`` / ``skipped``
+    (value absent or null on either side — congestion weather)."""
+    verdicts = []
+    for key in keys:
+        v_old, v_new = old.get(key), new.get(key)
+        if not isinstance(v_old, (int, float)) \
+                or not isinstance(v_new, (int, float)) or v_old <= 0:
+            verdicts.append({"key": key, "verdict": "skipped",
+                             "old": v_old, "new": v_new})
+            continue
+        change = (v_new - v_old) / v_old
+        # Inclusive: a dead-on 10% drop against the default threshold
+        # is a failure, not a float-equality pass.
+        verdict = ("regression" if change <= -max_regression
+                   else "pass")
+        verdicts.append({"key": key, "verdict": verdict,
+                         "old": round(float(v_old), 2),
+                         "new": round(float(v_new), 2),
+                         "change": round(change, 4)})
+    return verdicts
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Fail on a bench-record service-rate regression")
+    parser.add_argument("paths", nargs="*",
+                        help="old.json new.json (in that order)")
+    parser.add_argument("--dir",
+                        help="scan for the newest BENCH_r*.json pair")
+    parser.add_argument("--key", action="append", default=None,
+                        help="record key(s) to judge (default "
+                             "service_tiles_per_sec)")
+    parser.add_argument("--max-regression", type=float, default=0.10,
+                        help="fail when new < old by this fraction or "
+                             "more (default 0.10)")
+    parser.add_argument("--strict", action="store_true",
+                        help="treat skipped (absent/null) keys as "
+                             "failures")
+    args = parser.parse_args(argv)
+
+    try:
+        if args.dir:
+            old_path, new_path = newest_pair(args.dir)
+        elif len(args.paths) == 2:
+            old_path, new_path = args.paths
+        else:
+            parser.error("give exactly two record paths, or --dir")
+        old, new = load_record(old_path), load_record(new_path)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"gate": "bench", "error": str(e)}))
+        return 2
+
+    keys = tuple(args.key) if args.key else DEFAULT_KEYS
+    verdicts = judge(old, new, keys, args.max_regression)
+    regressed = [v for v in verdicts if v["verdict"] == "regression"]
+    skipped = [v for v in verdicts if v["verdict"] == "skipped"]
+    failed = bool(regressed) or (args.strict and bool(skipped))
+    print(json.dumps({
+        "gate": "bench",
+        "old": os.path.basename(old_path),
+        "new": os.path.basename(new_path),
+        "max_regression": args.max_regression,
+        "verdict": "fail" if failed else "pass",
+        "keys": verdicts,
+    }))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Render slow-request trace dumps as waterfall tables.
+"""Render slow-request trace dumps and flight-recorder dumps.
 
 The serving app writes one JSON file per over-threshold request
 (``telemetry.slow-request-ms`` / ``slow-request-dir``); this renders
@@ -8,12 +8,20 @@ them human-readable::
     python scripts/trace_report.py slow-traces/3f2a... .json
     python scripts/trace_report.py slow-traces/          # newest N
     python scripts/trace_report.py --limit 3 slow-traces/
+    python scripts/trace_report.py flight-recorder/flight-*.json
 
 Each span prints its offset from the request start, its duration, and a
 proportional bar, so "where did 2.6 s go?" is answered by eye: a wide
 ``wire.fetch`` bar is link weather, a wide ``batcher.queueWait`` bar is
 backlog, a wide first-request ``Renderer.renderAsPackedInt.batch`` bar
-with a compile-event bump on /metrics is a missed prewarm shape.
+with a compile-event bump on /metrics is a missed prewarm shape.  A
+trace that carries a cost ledger prints it under the waterfall (the
+attribution the access log and /debug/costs record).
+
+Flight-recorder dumps (``{"flight_recorder": true, "events": [...]}``
+— written on SIGTERM, SLO breach, or /debug/flightrecorder?dump=1)
+render as an event timeline instead: seconds-before-dump offsets, one
+event per line.
 """
 
 from __future__ import annotations
@@ -72,12 +80,45 @@ def render_trace(doc) -> str:
         suffix = f"  {extra}" if extra else ""
         lines.append(f"  {s['start_ms']:>8.1f}m {s['dur_ms']:>8.1f}m  "
                      f"{bar}  {s['name']}{suffix}")
+    cost = doc.get("cost")
+    if cost:
+        pretty = "  ".join(
+            f"{k}={cost[k]:g}" for k in sorted(cost))
+        lines.append(f"  cost: {pretty}")
     return "\n".join(lines)
+
+
+def render_flight(doc) -> str:
+    """Flight-recorder dump -> event timeline (newest events last,
+    offsets in seconds before the dump instant)."""
+    events = doc.get("events", ())
+    t_dump = float(doc.get("ts") or (events[-1]["ts"] if events
+                                     else 0.0))
+    lines = [
+        f"flight recorder  reason={doc.get('reason', '?')}  "
+        f"pid={doc.get('pid', '?')}  events={len(events)}",
+        f"  {'t-dump':>9}  event",
+    ]
+    for e in events:
+        extra = {k: v for k, v in e.items() if k not in ("ts", "kind")}
+        suffix = ("  " + " ".join(f"{k}={v}" for k, v in
+                                  sorted(extra.items()))
+                  if extra else "")
+        offset = float(e.get("ts", t_dump)) - t_dump
+        lines.append(f"  {offset:>8.2f}s  {e.get('kind', '?')}{suffix}")
+    return "\n".join(lines)
+
+
+def render_doc(doc) -> str:
+    if doc.get("flight_recorder"):
+        return render_flight(doc)
+    return render_trace(doc)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
-        description="Render slow-request trace dumps as waterfalls")
+        description="Render slow-request trace dumps as waterfalls "
+                    "and flight-recorder dumps as event timelines")
     parser.add_argument("paths", nargs="+",
                         help="dump file(s) or spool directory")
     parser.add_argument("--limit", type=int, default=5,
@@ -88,7 +129,7 @@ def main(argv=None) -> int:
     if not docs:
         print("no trace dumps found", file=sys.stderr)
         return 1
-    print("\n\n".join(render_trace(d) for d in docs))
+    print("\n\n".join(render_doc(d) for d in docs))
     return 0
 
 

@@ -33,6 +33,11 @@ def test_bench_smoke_hot_path(capsys):
     # Plane-digest staging accounting is live.
     assert out["planecache_misses"] is not None
     assert out["planecache_misses"] > 0
+    # Per-request cost attribution is live: the most expensive request
+    # of the window carries a ledger that says where its time went.
+    assert "device_ms" in out["cost_ledger_keys"]
+    assert "queue_ms" in out["cost_ledger_keys"]
+    assert "wire_bytes" in out["cost_ledger_keys"]
 
     # The printed line is the machine-readable contract.
     line = capsys.readouterr().out.strip().splitlines()[-1]

@@ -1,0 +1,593 @@
+"""Attribution-and-forensics layer: per-request cost ledger, flight
+recorder, SLO burn-rate engine, on-demand profiling, the cancelled
+queue-wait split, and the bench regression gate."""
+
+import asyncio
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.utils import telemetry
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(SCRIPTS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ----------------------------------------------------------- cost ledger
+
+class TestCostLedger:
+    def test_trace_accumulates_costs(self):
+        trace = telemetry.Trace("t1")
+        trace.add_cost("device_ms", 2.0)
+        trace.add_cost("device_ms", 3.0)
+        assert trace.export_costs() == {"device_ms": 5.0}
+
+    def test_add_cost_lands_on_every_context_trace(self):
+        """A group render under group_trace attributes pro-rata to
+        every member's ledger."""
+        telemetry.TRACES.start("a")
+        telemetry.TRACES.start("b")
+        with telemetry.group_trace(("a", "b")):
+            telemetry.add_cost("device_ms", 4.0)
+        for tid in ("a", "b"):
+            trace = telemetry.TRACES.finish(tid)
+            assert trace.export_costs()["device_ms"] == 4.0
+
+    def test_merge_costs_drops_malformed_fields(self):
+        telemetry.TRACES.start("w")
+        telemetry.merge_costs("w", {"device_ms": "3.5",
+                                    "staged_bytes": None})
+        costs = telemetry.TRACES.finish("w").export_costs()
+        assert costs == {"device_ms": 3.5}
+
+    def test_assemble_ledger_classes(self):
+        trace = telemetry.Trace("t2", "r")
+        trace.add_span("cache.hit", trace.t0, 0.5)
+        ledger, cache_class = telemetry.assemble_ledger(trace, 10.0, 99)
+        assert cache_class == "byte-cache"
+        assert ledger["wire_bytes"] == 99
+        assert ledger["total_ms"] == 10.0
+        trace2 = telemetry.Trace("t3", "r")
+        trace2.add_span("dedup.coalesced", trace2.t0, 0.5)
+        assert telemetry.assemble_ledger(trace2, 1.0, 1)[1] == "coalesced"
+        assert telemetry.assemble_ledger(
+            telemetry.Trace("t4", "r"), 1.0, 1)[1] == "render"
+
+    def test_topk_is_bounded_and_sorted(self):
+        topk = telemetry.CostTopK(k=3)
+        for ms in (5.0, 1.0, 9.0, 7.0, 3.0):
+            topk.offer({"total_ms": ms})
+        snap = topk.snapshot()
+        assert [d["total_ms"] for d in snap] == [9.0, 7.0, 5.0]
+        assert topk.observed == 5
+
+    def test_cost_histograms_feed_per_route(self):
+        telemetry.observe_request_cost("r", {
+            "device_ms": 2.0, "staged_bytes": 2048, "wire_bytes": 1024,
+            "queue_ms": 1.0})
+        lines = telemetry.cost_metric_lines()
+        text = "\n".join(lines)
+        assert 'imageregion_request_cost_device_ms_count{route="r"} 1' \
+            in text
+        # Byte fields convert to KB for the log-scale buckets.
+        assert 'imageregion_request_cost_staged_kb_sum{route="r"} 2' \
+            in text
+        assert 'imageregion_request_cost_wire_kb_sum{route="r"} 1' \
+            in text
+
+
+# ------------------------------------------------------- flight recorder
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = telemetry.FlightRecorder(maxlen=16)
+        for i in range(100):
+            rec.record("e", i=i)
+        assert len(rec) == 16
+        assert rec.events_total == 100
+        assert rec.snapshot()[-1]["i"] == 99
+
+    def test_configure_preserves_events(self):
+        rec = telemetry.FlightRecorder(maxlen=32)
+        rec.record("a")
+        rec.configure(64)
+        assert [e["kind"] for e in rec.snapshot()] == ["a"]
+
+    def test_dump_roundtrips_through_trace_report(self, tmp_path):
+        rec = telemetry.FlightRecorder()
+        rec.record("admission.shed", reason="queue-full", inflight=64)
+        rec.record("breaker.open", op="image")
+        path = rec.dump(str(tmp_path), "test")
+        assert path and os.path.exists(path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["flight_recorder"] is True
+        assert doc["reason"] == "test"
+        assert [e["kind"] for e in doc["events"]] == [
+            "admission.shed", "breaker.open"]
+        mod = _load_script("trace_report")
+        out = mod.render_doc(doc)
+        assert "flight recorder" in out
+        assert "admission.shed" in out and "reason=queue-full" in out
+
+    def test_same_second_dumps_do_not_collide(self, tmp_path):
+        rec = telemetry.FlightRecorder()
+        rec.record("e")
+        a = rec.dump(str(tmp_path), "manual")
+        b = rec.dump(str(tmp_path), "manual")
+        assert a != b
+        assert len(os.listdir(tmp_path)) == 2
+
+    def test_spool_prunes_oldest(self, tmp_path):
+        rec = telemetry.FlightRecorder()
+        rec.record("e")
+        for _ in range(rec.MAX_DUMPS + 5):
+            rec.dump(str(tmp_path), "x")
+        assert len(os.listdir(tmp_path)) == rec.MAX_DUMPS
+
+    def test_shape_estimate_claim_is_one_shot(self):
+        assert telemetry.SHAPE_COSTS.claim_estimate("B1x1x8x8")
+        assert not telemetry.SHAPE_COSTS.claim_estimate("B1x1x8x8")
+        telemetry.SHAPE_COSTS.reset()
+        assert telemetry.SHAPE_COSTS.claim_estimate("B1x1x8x8")
+
+    def test_dump_never_raises(self):
+        rec = telemetry.FlightRecorder()
+        rec.record("e")
+        # An unwritable spool directory yields None, not an exception.
+        assert rec.dump("/proc/definitely/not/writable", "x") is None
+
+
+# ------------------------------------------------------------ SLO engine
+
+class TestSloEngine:
+    def _engine(self, clock, **kw):
+        eng = telemetry.SloEngine()
+        kw.setdefault("availability_target", 0.99)
+        kw.setdefault("fast_window_s", 10.0)
+        kw.setdefault("slow_window_s", 30.0)
+        kw.setdefault("breach_burn_rate", 10.0)
+        eng.configure(clock=lambda: clock[0], **kw)
+        return eng
+
+    def test_burn_rate_math(self):
+        clock = [1000.0]
+        eng = self._engine(clock)
+        for _ in range(98):
+            eng.record(200, 1.0)
+        for _ in range(2):
+            eng.record(503, 1.0)
+        # 2% errors against a 1% budget = burn rate 2.0 both windows.
+        fast, slow = eng.burn_rates()["availability"]
+        assert fast == pytest.approx(2.0)
+        assert slow == pytest.approx(2.0)
+        assert not eng.any_breached()
+
+    def test_breach_fires_once_per_episode(self):
+        clock = [1000.0]
+        fired = []
+        eng = self._engine(clock)
+        eng.on_breach = lambda obj, fast, slow: fired.append(obj)
+        for _ in range(10):
+            eng.record(503, 1.0)
+        assert eng.any_breached()
+        assert fired == ["availability"]
+        # Still breached: no second callback while the episode holds.
+        eng.record(503, 1.0)
+        assert fired == ["availability"]
+        # Recovery (errors age out of both windows) re-arms the hook.
+        clock[0] += 60.0
+        for _ in range(50):
+            eng.record(200, 1.0)
+        assert not eng.any_breached()
+        for _ in range(50):
+            eng.record(503, 1.0)
+        assert fired == ["availability", "availability"]
+
+    def test_latency_objective(self):
+        clock = [5000.0]
+        eng = self._engine(clock, availability_target=0.0,
+                           latency_ms=100.0, latency_target=0.9)
+        for _ in range(8):
+            eng.record(200, 10.0)
+        for _ in range(2):
+            eng.record(200, 500.0)
+        # 20% slow against a 10% budget = burn 2.0; errors excluded.
+        eng.record(503, 9999.0)
+        fast, _slow = eng.burn_rates()["latency"]
+        assert fast == pytest.approx(2.0)
+
+    def test_both_objectives_breaching_fire_both_hooks(self):
+        """One record can transition BOTH objectives at once (a window
+        boundary dropping good buckets moves every denominator); each
+        breach owns its own flight-recorder dump."""
+        clock = [1000.0]
+        fired = []
+        eng = self._engine(clock, availability_target=0.9,
+                           latency_ms=10.0, latency_target=0.9)
+        eng.on_breach = lambda obj, fast, slow: fired.append(obj)
+        # Pin the burn computation over threshold for both objectives
+        # so the one record() transitions them together.
+        eng._burn_rates_locked = lambda: {
+            "availability": (99.0, 99.0), "latency": (99.0, 99.0)}
+        eng.record(200, 1.0)
+        assert sorted(fired) == ["availability", "latency"]
+        assert eng.breaches_total == 2
+
+    def test_disabled_is_free_and_silent(self):
+        eng = telemetry.SloEngine()
+        eng.record(500, 1.0)
+        assert eng.burn_rates() == {}
+        assert eng.metric_lines() == []
+        assert eng.summary() == "disabled"
+
+    def test_metric_lines_and_summary(self):
+        clock = [1000.0]
+        eng = self._engine(clock)
+        for _ in range(10):
+            eng.record(503, 1.0)
+        text = "\n".join(eng.metric_lines())
+        assert 'imageregion_slo_burn_rate{slo="availability",' \
+               'window="fast"}' in text
+        assert 'imageregion_slo_breach{slo="availability"} 1' in text
+        assert eng.summary().startswith("BREACH availability burn")
+
+
+# ------------------------------------------------- cancelled queue waits
+
+class TestCancelledQueueWaits:
+    def test_cancelled_waits_use_separate_series(self):
+        """Deadline- and fault-cancelled pendings must not enter the
+        dispatched-wait series or its high-water gauge (the BENCH_r05
+        mean-vs-p50 skew)."""
+        import time as _time
+
+        from omero_ms_image_region_tpu.server.batcher import (
+            BatchingRenderer, _Pending)
+        from omero_ms_image_region_tpu.utils.stopwatch import REGISTRY
+
+        REGISTRY.reset()
+        renderer = BatchingRenderer()
+        loop = asyncio.new_event_loop()
+        try:
+            pend = _Pending(raw=None, settings={}, h=1, w=1,
+                            future=loop.create_future())
+            pend.t_enqueue = _time.perf_counter() - 2.0  # waited ~2 s
+            renderer._record_queue_waits([pend], _time.perf_counter(),
+                                         cancelled=True)
+            snap = REGISTRY.snapshot()
+            assert "batcher.queueWait" not in snap
+            assert snap["batcher.queueWait.cancelled"]["count"] == 1
+            assert snap["batcher.queueWait.cancelled"]["mean_ms"] \
+                >= 1900.0
+            assert renderer.queue_wait_max_ms == 0.0
+        finally:
+            loop.close()
+        REGISTRY.reset()
+
+    def test_expired_pending_cancelled_not_rendered(self):
+        """A pending whose budget died in the queue gets its 504 at
+        dispatch pop and records a CANCELLED wait, not a dispatched
+        one."""
+        from omero_ms_image_region_tpu.server.batcher import (
+            BatchingRenderer)
+        from omero_ms_image_region_tpu.utils.stopwatch import REGISTRY
+        from omero_ms_image_region_tpu.utils.transient import (
+            DeadlineExceededError, deadline_scope)
+
+        from test_batcher import _settings
+
+        REGISTRY.reset()
+        rng = np.random.default_rng(3)
+        settings = _settings()
+        raw = rng.integers(0, 60000, size=(3, 8, 8)).astype(np.float32)
+
+        async def main():
+            batcher = BatchingRenderer(linger_ms=5.0)
+            try:
+                with deadline_scope(0.01):   # spent before dispatch
+                    with pytest.raises(DeadlineExceededError):
+                        await batcher.render(raw, settings)
+            finally:
+                await batcher.close()
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(main())
+        finally:
+            loop.close()
+        snap = REGISTRY.snapshot()
+        assert snap["batcher.queueWait.cancelled"]["count"] == 1
+        assert "batcher.queueWait" not in snap
+        assert telemetry.RESILIENCE.deadline_cancelled == 1
+        kinds = [e["kind"] for e in telemetry.FLIGHT.snapshot()]
+        assert "batch.deadline-cancelled" in kinds
+        REGISTRY.reset()
+
+
+# ------------------------------------------------------------ bench gate
+
+class TestBenchGate:
+    def _gate(self):
+        return _load_script("bench_gate")
+
+    def _write(self, tmp_path, name, doc):
+        path = tmp_path / name
+        path.write_text(json.dumps(doc) + "\n")
+        return str(path)
+
+    def test_regression_fails(self, tmp_path, capsys):
+        gate = self._gate()
+        old = self._write(tmp_path, "BENCH_r01.json",
+                          {"service_tiles_per_sec": 100.0})
+        new = self._write(tmp_path, "BENCH_r02.json",
+                          {"service_tiles_per_sec": 89.0})
+        assert gate.main([old, new]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["verdict"] == "fail"
+        assert verdict["keys"][0]["verdict"] == "regression"
+
+    def test_exact_ten_percent_pair_fails(self, tmp_path):
+        """The acceptance pair: a synthetic dead-on 10% drop."""
+        gate = self._gate()
+        old = self._write(tmp_path, "a.json",
+                          {"service_tiles_per_sec": 100.0})
+        new = self._write(tmp_path, "b.json",
+                          {"service_tiles_per_sec": 90.0})
+        assert gate.main([old, new]) == 1
+
+    def test_within_threshold_passes(self, tmp_path):
+        gate = self._gate()
+        old = self._write(tmp_path, "a.json",
+                          {"service_tiles_per_sec": 100.0})
+        new = self._write(tmp_path, "b.json",
+                          {"service_tiles_per_sec": 91.0})
+        assert gate.main([old, new]) == 0
+        # Improvements obviously pass too.
+        better = self._write(tmp_path, "c.json",
+                             {"service_tiles_per_sec": 140.0})
+        assert gate.main([old, better]) == 0
+
+    def test_null_value_skips_unless_strict(self, tmp_path):
+        gate = self._gate()
+        old = self._write(tmp_path, "a.json",
+                          {"service_tiles_per_sec": None})
+        new = self._write(tmp_path, "b.json",
+                          {"service_tiles_per_sec": 50.0})
+        assert gate.main([old, new]) == 0
+        assert gate.main(["--strict", old, new]) == 1
+
+    def test_dir_mode_picks_newest_pair(self, tmp_path, capsys):
+        gate = self._gate()
+        self._write(tmp_path, "BENCH_r01.json",
+                    {"service_tiles_per_sec": 500.0})
+        self._write(tmp_path, "BENCH_r04.json",
+                    {"service_tiles_per_sec": 100.0})
+        self._write(tmp_path, "BENCH_r05.json",
+                    {"service_tiles_per_sec": 50.0})
+        assert gate.main(["--dir", str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["old"] == "BENCH_r04.json"
+        assert verdict["new"] == "BENCH_r05.json"
+
+    def test_custom_keys(self, tmp_path):
+        gate = self._gate()
+        old = self._write(tmp_path, "a.json",
+                          {"x": 10.0, "service_tiles_per_sec": 1.0})
+        new = self._write(tmp_path, "b.json",
+                          {"x": 5.0, "service_tiles_per_sec": 1.0})
+        assert gate.main(["--key", "x", old, new]) == 1
+        assert gate.main([old, new]) == 0
+
+
+# -------------------------------------------------------- debug surface
+
+IMG = 7
+URL = (f"/webgateway/render_image_region/{IMG}/0/0"
+       "?tile=0,0,0,32,32&format=jpeg&m=c&c=1|0:60000$FF0000")
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    root = tmp_path_factory.mktemp("forensicsdata")
+    rng = np.random.default_rng(13)
+    planes = rng.integers(0, 60000, size=(2, 2, 64, 64)).astype(
+        np.uint16)
+    build_pyramid(planes, str(root / str(IMG)), chunk=(32, 32),
+                  n_levels=1)
+    return str(root)
+
+
+def _device_config(data_dir, tmp_path=None):
+    from omero_ms_image_region_tpu.server.config import AppConfig
+    cfg = AppConfig(data_dir=data_dir)
+    cfg.renderer.cpu_fallback_max_px = 0   # exercise the batched path
+    if tmp_path is not None:
+        cfg.telemetry.profile_dir = str(tmp_path / "profiles")
+        cfg.telemetry.flight_recorder_dir = str(tmp_path / "flight")
+    return cfg
+
+
+class TestDebugEndpoints:
+    def test_combined_costs_flight_profile(self, data_dir, tmp_path):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.server.app import create_app
+
+        async def main():
+            app = create_app(_device_config(data_dir, tmp_path))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                assert r.status == 200
+                await r.read()
+
+                r = await client.get("/debug/costs")
+                costs = await r.json()
+                assert r.status == 200
+                assert costs["observed"] >= 1
+                top = costs["top"][0]
+                assert top["route"] == "render_image_region"
+                assert top["cost"]["device_ms"] > 0
+                assert top["cost"]["wire_bytes"] > 0
+                # The shape cost model saw the batched dispatch.
+                assert any(s["dispatches"] >= 1
+                           for s in costs["shapes"].values())
+
+                r = await client.get("/debug/flightrecorder?dump=1")
+                flight = await r.json()
+                assert r.status == 200
+                kinds = {e["kind"] for e in flight["events"]}
+                assert "batch.formed" in kinds
+                assert flight["dumped_to"] and os.path.exists(
+                    flight["dumped_to"])
+
+                # The acceptance criterion: a capture artifact on the
+                # CPU backend.
+                r = await client.get("/debug/profile?ms=50")
+                prof = await r.json()
+                assert r.status == 200, prof
+                assert prof["files"], prof
+                assert os.path.isdir(prof["dir"])
+                assert prof["bytes"] > 0
+            finally:
+                await client.close()
+
+        asyncio.run(main())
+
+    def test_profile_bad_ms_is_400(self, data_dir):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.server.app import create_app
+
+        async def main():
+            app = create_app(_device_config(data_dir))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/debug/profile?ms=banana")
+                assert r.status == 400
+            finally:
+                await client.close()
+
+        asyncio.run(main())
+
+    def test_proxy_forwards_profile_and_merges_flight(self, data_dir,
+                                                      tmp_path):
+        """Frontend proxy: /debug/profile rides the sidecar wire (the
+        capture runs in the device-owning process) and the frontend's
+        /debug/flightrecorder merges the sidecar's ring."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.server.app import create_app
+        from omero_ms_image_region_tpu.server.config import (
+            AppConfig, SidecarConfig)
+        from omero_ms_image_region_tpu.server.sidecar import run_sidecar
+
+        sock = str(tmp_path / "f.sock")
+
+        async def main():
+            task = asyncio.create_task(
+                run_sidecar(_device_config(data_dir, tmp_path), sock))
+            for _ in range(200):
+                if task.done():
+                    raise AssertionError(
+                        f"sidecar died: {task.exception()!r}")
+                if os.path.exists(sock):
+                    break
+                await asyncio.sleep(0.05)
+            app = create_app(AppConfig(
+                data_dir=data_dir,
+                sidecar=SidecarConfig(socket=sock, role="frontend")))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                assert r.status == 200
+                await r.read()
+                r = await client.get("/debug/profile?ms=50")
+                prof = await r.json()
+                assert r.status == 200, prof
+                assert prof["files"], prof
+                r = await client.get("/debug/flightrecorder")
+                flight = await r.json()
+                assert r.status == 200
+                assert flight["sidecar"] is not None
+                assert flight["sidecar"]["events_total"] > 0
+                # Proxy-side cost ledger: the render above carried its
+                # device-side costs over the wire (in-process sidecar
+                # shares the trace; either path must yield a ledger).
+                r = await client.get("/debug/costs")
+                costs = await r.json()
+                assert costs["top"], costs
+                assert costs["top"][0]["cost"]["device_ms"] > 0
+            finally:
+                await client.close()
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+        asyncio.run(main())
+
+
+# ------------------------------------------------------- reset contract
+
+class TestResetContract:
+    def test_reset_clears_every_accumulator(self):
+        """Repeated in-process test apps must not leak counts across
+        tests: everything reset() owns goes back to zero."""
+        telemetry.RESILIENCE.count_shed("queue-full")
+        telemetry.RESILIENCE.count_retry("image")
+        telemetry.RESILIENCE.observe_attempts("image", 2)
+        telemetry.RESILIENCE.count_deadline_cancelled()
+        telemetry.READINESS.prewarm_pending = True
+        telemetry.FLIGHT.record("e")
+        telemetry.SLO.configure(availability_target=0.9)
+        telemetry.SLO.record(503, 1.0)
+        telemetry.SHAPE_COSTS.observe("B1x1x8x8", 1.0)
+        telemetry.COST_TOPK.offer({"total_ms": 5.0})
+        telemetry.observe_request_cost("r", {"device_ms": 1.0})
+        telemetry.count_request("r", 200)
+
+        telemetry.reset()
+
+        assert telemetry.RESILIENCE.shed == {}
+        assert telemetry.RESILIENCE.retries == {}
+        assert telemetry.RESILIENCE.deadline_cancelled == 0
+        assert telemetry.RESILIENCE.attempts_hist.series("x") == []
+        assert telemetry.READINESS.prewarm_pending is False
+        assert len(telemetry.FLIGHT) == 0
+        assert telemetry.FLIGHT.events_total == 0
+        assert telemetry.SLO.enabled is False
+        assert telemetry.SLO.metric_lines() == []
+        assert telemetry.SHAPE_COSTS.metric_lines() == []
+        assert telemetry.COST_TOPK.snapshot() == []
+        assert telemetry.cost_metric_lines() == []
+        assert telemetry.request_metric_lines() == [
+            "imageregion_flight_events 0",
+            "imageregion_flight_events_total 0",
+            "imageregion_flight_dumps_total 0",
+        ]

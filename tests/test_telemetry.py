@@ -240,6 +240,10 @@ class TestTracePropagation:
         assert "sidecar.render" in names
         assert "Renderer.renderAsPackedInt.batch" in names
         assert "batcher.queueWait" in names
+        # The cost ledger rode the wire too: the sidecar's device-
+        # execute/staging attribution landed on the FRONTEND's ledger.
+        costs = traces[-1].export_costs()
+        assert costs.get("device_ms", 0) > 0, costs
 
     def test_dispatcher_task_does_not_adopt_first_request(self,
                                                           data_dir):
@@ -425,8 +429,14 @@ class TestAccessLog:
         assert doc["bytes"] == len(body)
         assert doc["ms"] > 0
         assert re.fullmatch(r"[0-9a-f]{16}", doc["trace"])
-        assert doc["cache"] in ("hit", "miss")
+        assert doc["cache"] in ("byte-cache", "coalesced", "render")
         assert doc["render_ms"] is not None
+        # The per-request cost ledger rides the access line: the
+        # batched device render attributed its pro-rata execute ms and
+        # the response bytes to this request.
+        assert doc["cost"]["device_ms"] > 0
+        assert doc["cost"]["wire_bytes"] == len(body)
+        assert doc["cost"]["total_ms"] == doc["ms"]
 
 
 # ------------------------------------------------------ exposition lint
@@ -437,19 +447,40 @@ _SERIES_RE = re.compile(
     r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
     r" -?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?$")
 
+_LABEL_KEY_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)=')
+
+# Every label key any family may legally use.  The closed set is the
+# cardinality guard: a per-request label (trace id, image id, client
+# address) sneaking onto a series would grow without bound — it fails
+# here, mechanically, before it melts a Prometheus.
+_ALLOWED_LABEL_KEYS = frozenset({
+    "route", "status", "span", "le", "cache", "tier", "op", "reason",
+    "process", "slo", "window", "shape",
+})
+
 
 def _lint_exposition(text):
     """Line-by-line Prometheus text-format check: valid series syntax,
-    a # TYPE for every family, no duplicate (name, labels)."""
+    # HELP and # TYPE exactly once per family (HELP first), no
+    duplicate (name, labels), and label keys drawn from the closed
+    bounded-cardinality set."""
     typed = set()
+    helped = set()
     seen = set()
     for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            assert len(parts) == 4 and parts[3], line
+            assert parts[2] not in helped, f"duplicate HELP: {line}"
+            helped.add(parts[2])
+            continue
         if line.startswith("# TYPE "):
             parts = line.split()
             assert len(parts) == 4, line
             assert parts[3] in ("counter", "gauge", "histogram",
                                 "summary", "untyped"), line
             assert parts[2] not in typed, f"duplicate TYPE: {line}"
+            assert parts[2] in helped, f"TYPE without HELP: {line}"
             typed.add(parts[2])
             continue
         if line.startswith("#") or not line:
@@ -457,14 +488,22 @@ def _lint_exposition(text):
         m = _SERIES_RE.match(line)
         assert m, f"malformed series line: {line!r}"
         name = m.group(1)
+        assert re.fullmatch(r"[a-z0-9_]+", name), \
+            f"metric name not snake_case: {line!r}"
         family = name
         for suffix in ("_bucket", "_sum", "_count"):
             if name.endswith(suffix) and name[:-len(suffix)] in typed:
                 family = name[:-len(suffix)]
         assert family in typed, f"series without # TYPE: {line!r}"
-        key = (name, m.group(2) or "")
+        labels = m.group(2) or ""
+        for label_key in _LABEL_KEY_RE.findall(labels):
+            assert label_key in _ALLOWED_LABEL_KEYS, \
+                f"unexpected label key {label_key!r} (unbounded " \
+                f"cardinality risk): {line!r}"
+        key = (name, labels)
         assert key not in seen, f"duplicate series: {line!r}"
         seen.add(key)
+    assert typed == helped, "HELP/TYPE family sets diverge"
     assert typed and seen
 
 
@@ -483,6 +522,15 @@ class TestExpositionLint:
         # The JPEG render's wire fetch registered, so the link-health
         # gauge is live (0.0 until a bandwidth-class fetch rates it).
         assert "imageregion_link_mb_s" in text
+        # The attribution layer's families are live: per-route cost
+        # histograms, the per-shape device cost model, and the flight
+        # recorder's ring gauges.
+        assert "imageregion_request_cost_device_ms_bucket" in text
+        assert "imageregion_request_cost_queue_ms_bucket" in text
+        assert "imageregion_request_cost_wire_kb_bucket" in text
+        assert "imageregion_shape_dispatches_total" in text
+        assert "imageregion_shape_device_ms_total" in text
+        assert "imageregion_flight_events" in text
 
     def test_split_merged_metrics_parse(self, data_dir, tmp_path):
         sock = str(tmp_path / "m.sock")

@@ -254,6 +254,30 @@ class DeviceRawCache:
     def __len__(self) -> int:
         return len(self._entries)
 
+    def snapshot_entries(self, limit: int = 0):
+        """Warm-state manifest export: the resident REGION entries
+        (source coords + content digest), most-recently-used first.
+        Only region keys are restageable from source at boot; content-
+        only ``("plane", digest)`` entries and projection planes are
+        skipped — their bytes exist nowhere but HBM.  ``limit`` 0 =
+        all."""
+        out = []
+        with self._lock:
+            keys = list(reversed(self._entries.keys()))   # MRU first
+            for key in keys:
+                if (not isinstance(key, tuple) or len(key) != 6
+                        or not isinstance(key[0], int)):
+                    continue
+                image_id, z, t, level, region, channels = key
+                out.append({
+                    "key": [image_id, z, t, level, list(region),
+                            list(channels)],
+                    "digest": self._digests_of.get(key),
+                })
+                if limit and len(out) >= limit:
+                    break
+        return out
+
 
 def region_key(image_id: int, z: int, t: int, level: int,
                region: Tuple[int, int, int, int],

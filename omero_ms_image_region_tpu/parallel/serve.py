@@ -242,6 +242,13 @@ class MeshRenderer(BatchingRenderer):
             # + fault-injection.seed, and build_services disarms the
             # injector on auto-discovered pods.
         self.mesh = mesh
+        # Never the serialized-executable cache (server.execcache):
+        # sharded programs are bound to this mesh's topology and, on a
+        # pod, to the lockstep compile sequence — a deserialized
+        # executable on one host would diverge SPMD launch order.
+        # Warm restarts here ride the trace cache
+        # (renderer.compilation-cache-dir) and the bring-up dryrun.
+        self.exec_cache = None
         self.jpeg_engine = jpeg_engine
         # Live wire-engine selection (utils.adaptive.AdaptiveEngine).
         # Pod-safe by construction: ONLY the leader consults it, at a

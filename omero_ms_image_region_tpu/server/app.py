@@ -132,10 +132,21 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         # Warm restarts: compiled executables persist across processes
         # (measured 11 s -> 1.5 s first render after restart).  Set
         # before anything compiles; harmless if the backend cannot
-        # serialize (jax skips caching then).
+        # serialize (jax skips caching then).  With persistence on,
+        # this trace cache is the FALLBACK under the serialized-
+        # executable tier (server.execcache).
         import jax
         jax.config.update("jax_compilation_cache_dir",
                           config.renderer.compilation_cache_dir)
+    if config.persistence.enabled and not config.caches.disk_dir:
+        # Durable byte tier: slot the disk cache into every named
+        # cache's chain (between memory and Redis) so rendered bytes
+        # survive process death with no external dependency.
+        import os as _os
+        config.caches.disk_dir = _os.path.join(
+            config.persistence.dir, "bytecache")
+        config.caches.disk_max_bytes = \
+            config.persistence.disk_cache_max_bytes
     from .batcher import BatchingRenderer
     from .handler import ImageRegionServices, Renderer
     if config.parallel.enabled:
@@ -283,6 +294,33 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
     if services.raw_cache is not None and config.raw_cache.prefetch:
         from ..services.prefetch import TilePrefetcher
         services.prefetcher = TilePrefetcher(services.raw_cache)
+    exec_cache = None
+    if config.persistence.enabled:
+        import os as _os
+        if (config.persistence.executables
+                and isinstance(renderer, BatchingRenderer)
+                and not config.parallel.enabled):
+            # Serialized compiled-program tier.  Batched single-host
+            # posture only: mesh-sharded programs are topology-bound
+            # and stay on the pod's lockstep compile path.
+            from .execcache import ExecutableCache
+            exec_cache = ExecutableCache(
+                _os.path.join(config.persistence.dir, "executables"))
+            renderer.exec_cache = exec_cache
+        # Snapshot/rehydrate engine: periodic (+ SIGTERM, through the
+        # shutdown chain) manifest of the hot state; a background
+        # rehydrator replays it on boot — disk->memory byte promote,
+        # HBM plane re-stage, executable deserialize.
+        from ..services.warmstate import WarmStateManager
+        services.warmstate = WarmStateManager(
+            config.persistence.dir, services,
+            snapshot_interval_s=config.persistence.snapshot_interval_s,
+            snapshot_top_k=config.persistence.snapshot_top_k,
+            max_plane_entries=config.persistence.max_plane_entries,
+            rehydrate_concurrency=(
+                config.persistence.rehydrate_concurrency))
+        services.warmstate.start(
+            rehydrate=config.persistence.rehydrate)
     if (config.renderer.prewarm and config.batcher.enabled
             and not config.parallel.enabled):
         # Compile the listed shapes' serving programs so the first
@@ -314,7 +352,11 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
             args=(list(config.renderer.prewarm), engines,
                   renderer.max_batch, renderer.buckets),
             kwargs={"cpu_fallback_max_px":
-                    config.renderer.cpu_fallback_max_px},
+                    config.renderer.cpu_fallback_max_px,
+                    # Persistence: warmed packed programs deserialize
+                    # from a prior life instead of compiling, and
+                    # fresh compiles are serialized for the next one.
+                    "exec_cache": exec_cache},
             name="prewarm", daemon=True).start()
     return services
 
@@ -707,6 +749,40 @@ def create_app(config: Optional[AppConfig] = None,
                 {"error": "profiler unavailable"}, status=503)
         return web.json_response(doc)
 
+    async def debug_warmstate(request: web.Request) -> web.Response:
+        """Warm-state persistence status: live rehydrate progress,
+        snapshot accounting, and (``?snapshot=1``) an on-demand
+        manifest write.  Proxy mode forwards to the device process
+        over the sidecar ``warmstate`` op — the state lives where the
+        device lives."""
+        want_snapshot = bool(request.query.get("snapshot"))
+        if services is None:
+            import asyncio as _asyncio
+            try:
+                status, body = await _asyncio.wait_for(
+                    client.call("warmstate", {},
+                                extra=({"snapshot": 1}
+                                       if want_snapshot else None)),
+                    timeout=10.0)
+            except Exception as e:
+                return _status_of(e)
+            if status != 200:
+                return web.json_response(
+                    {"error": str(body)}, status=status)
+            return web.json_response(json.loads(bytes(body).decode()))
+        warmstate = services.warmstate
+        doc = {
+            "enabled": warmstate is not None,
+            "rehydrate": telemetry.PERSIST.rehydrate_summary(),
+            "snapshots": telemetry.PERSIST.snapshots,
+            "snapshot_errors": telemetry.PERSIST.snapshot_errors,
+        }
+        if warmstate is not None and want_snapshot:
+            import asyncio as _asyncio
+            doc["snapshot_path"] = await _asyncio.to_thread(
+                warmstate.snapshot_now)
+        return web.json_response(doc)
+
     async def _ready_state() -> tuple:
         """(ok, checks) for /readyz: sidecar reachability (proxy mode),
         prewarm completion, and batcher backlog below the configured
@@ -733,6 +809,11 @@ def create_app(config: Optional[AppConfig] = None,
                     checks["sidecar"] = "ok"
                 prewarm_pending = bool(info.get("prewarm_pending"))
                 depth = int(info.get("queue_depth", 0))
+                if info.get("rehydrate") is not None:
+                    # Annotation only (like the SLO line): a slow
+                    # rehydrate is a cold-ish first minute, never a
+                    # reason to pull the instance from rotation.
+                    checks["rehydrate"] = str(info["rehydrate"])
             except Exception:
                 checks["sidecar"] = "unreachable"
                 if fallback is not None:
@@ -748,6 +829,9 @@ def create_app(config: Optional[AppConfig] = None,
             renderer = services.renderer
             depth = (renderer.queue_depth()
                      if hasattr(renderer, "queue_depth") else 0)
+            if services.warmstate is not None:
+                checks["rehydrate"] = \
+                    telemetry.PERSIST.rehydrate_summary()
         if prewarm_pending:
             ok = False
             checks["prewarm"] = "pending"
@@ -846,6 +930,7 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_get("/debug/costs", debug_costs)
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
     app.router.add_get("/debug/profile", debug_profile)
+    app.router.add_get("/debug/warmstate", debug_warmstate)
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):
@@ -855,6 +940,11 @@ def create_app(config: Optional[AppConfig] = None,
         if db_meta is not None:
             await db_meta.close()
         if services is not None:
+            if services.warmstate is not None:
+                # Stop the snapshot timer and abort any in-flight
+                # rehydrate BEFORE the stores it reads close under it.
+                import asyncio as _asyncio
+                await _asyncio.to_thread(services.warmstate.close)
             from .batcher import BatchingRenderer
             if isinstance(services.renderer, BatchingRenderer):
                 await services.renderer.close()
@@ -931,14 +1021,28 @@ def run_app(app: web.Application, config: AppConfig) -> None:
         # client shutdown).
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
+        # ONE ordered shutdown hook chain (warm-state snapshot first —
+        # it captures serving state while services are live; the
+        # black-box flight dump LAST — it must exist even if the
+        # snapshot wedged and the supervisor escalates to SIGKILL).
+        # Each hook is guarded: one failing never skips the rest.  The
+        # chain runs on its OWN thread, started at signal time: it
+        # must not stall the event loop (in-flight responses are still
+        # draining), and it must not wait for the orderly teardown (a
+        # wedged drain must not cost the black box); the teardown
+        # below joins it so a fast exit cannot truncate the writes.
+        import threading as _threading
+
+        from .shutdown import build_shutdown_chain
+        chain = build_shutdown_chain(config, app[SERVICES_KEY])
+        chain_thread: list = []
 
         def _on_signal(signame: str) -> None:
-            # Black-box snapshot FIRST: the dump must exist even if
-            # the orderly teardown below wedges and the supervisor
-            # escalates to SIGKILL.
             telemetry.FLIGHT.record("signal", sig=signame)
-            telemetry.FLIGHT.dump(
-                config.telemetry.flight_recorder_dir, signame.lower())
+            t = _threading.Thread(target=chain.run, args=(signame,),
+                                  name="shutdown-chain", daemon=True)
+            chain_thread.append(t)
+            t.start()
             stop.set()
 
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -952,6 +1056,11 @@ def run_app(app: web.Application, config: AppConfig) -> None:
             log.info("shutdown signal received")
         finally:
             await runner.cleanup()
+            if chain_thread:
+                # Bounded: the snapshot/dump must land before the
+                # process exits, but a wedged hook cannot hold the
+                # exit hostage either.
+                await asyncio.to_thread(chain_thread[0].join, 15.0)
             log.info("shutdown complete")
 
     try:

@@ -222,6 +222,15 @@ class BatchingRenderer:
         # High-water queue wait (ms) for the /metrics gauge — the
         # stragglers a mean hides and a p50 cannot see.
         self.queue_wait_max_ms = 0.0
+        # Serialized-executable cache (server.execcache), wired by
+        # build_services when persistence is on: packed group renders
+        # call a deserialized compiled program when one matches the
+        # call signature, and first-compiles are captured to disk for
+        # the next process life.  None = today's jit-only path.
+        # MeshRenderer never sets it: sharded programs are
+        # mesh-topology-bound and must stay on the pod's lockstep
+        # compile path.
+        self.exec_cache = None
 
     def _count_batch(self, tiles: int) -> None:
         """Metrics update; group renders run concurrently on worker
@@ -623,12 +632,39 @@ class BatchingRenderer:
                 s0["cd_start"], s0["cd_end"], stack("tables"))
         shape = _shape_label(raw.shape)
         estimate = telemetry.SHAPE_COSTS.claim_estimate(shape)
+        # Warm-restart path: a serialized executable matching this call
+        # signature (deserialized at rehydrate, or captured in a prior
+        # life) runs with NO trace/lower/compile.  Any failure falls
+        # back to the jitted entry point — the executable cache can
+        # only ever remove work.
+        loaded_fn = (self.exec_cache.lookup("render_tile_batch_packed",
+                                            args)
+                     if self.exec_cache is not None else None)
         with self._device_gate:
             t0 = time.perf_counter()
             with stopwatch("Renderer.renderAsPackedInt.batch"):
-                out = render_tile_batch_packed(*args)
+                if loaded_fn is not None:
+                    try:
+                        out = loaded_fn(*args)
+                    except Exception:
+                        # Runtime drift the fingerprint cannot see:
+                        # evict so only THIS group pays the failed
+                        # attempt — every later group goes straight
+                        # to the jit path.
+                        self.exec_cache.invalidate(
+                            "render_tile_batch_packed", args)
+                        out = render_tile_batch_packed(*args)
+                else:
+                    out = render_tile_batch_packed(*args)
                 host = np.asarray(out)
             exec_ms = (time.perf_counter() - t0) * 1000.0
+        if loaded_fn is None and self.exec_cache is not None:
+            # First group of this signature in this life: capture the
+            # compiled program to disk (one-shot, delayed, background)
+            # so the NEXT life skips the compile entirely.
+            self.exec_cache.capture_async(
+                "render_tile_batch_packed", render_tile_batch_packed,
+                args)
         telemetry.add_cost("device_ms", exec_ms / n)
         telemetry.SHAPE_COSTS.observe(shape, exec_ms)
         if estimate:

@@ -193,6 +193,38 @@ class FaultToleranceConfig:
 
 
 @dataclass
+class PersistenceConfig:
+    """Warm-state persistence tier (services.diskcache +
+    services.warmstate + server.execcache): what survives a restart.
+    Off by default — enabling it turns every deploy/respawn/crash from
+    minutes of wire fetches and XLA compiles (BENCH_r05: 0.73 cold vs
+    26 warm tiles/s) into a disk read."""
+
+    enabled: bool = False
+    # Root directory; the tier lays out bytecache/, executables/ and
+    # manifest.json under it.  Must be service-user-owned (executables
+    # are pickles, same trust model as jax's compilation cache).
+    dir: str = "./warm-state"
+    # Disk byte-cache budget (LRU by mtime; evicts to 90% on breach).
+    disk_cache_max_bytes: int = 1024 * 1024 * 1024
+    # Serialize compiled render executables
+    # (jax.experimental.serialize_executable); restarts deserialize
+    # instead of re-tracing + re-compiling.  The trace cache
+    # (renderer.compilation-cache-dir) remains the fallback when the
+    # backend cannot serialize.
+    executables: bool = True
+    # Manifest cadence; SIGTERM always snapshots through the shutdown
+    # chain regardless.  0 disables the timer.
+    snapshot_interval_s: float = 60.0
+    # Hot-set bounds recorded per snapshot.
+    snapshot_top_k: int = 512
+    max_plane_entries: int = 256
+    # Boot rehydrate: replay the manifest in the background.
+    rehydrate: bool = True
+    rehydrate_concurrency: int = 2
+
+
+@dataclass
 class TelemetryConfig:
     """Tracing / health-probe knobs (utils.telemetry; ≙ the reference's
     optional metrics beans, ``beanRefContext.xml:36-46`` — Graphite
@@ -313,6 +345,8 @@ class AppConfig:
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     sidecar: SidecarConfig = field(default_factory=SidecarConfig)
+    persistence: PersistenceConfig = field(
+        default_factory=PersistenceConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     slo: SloConfig = field(default_factory=SloConfig)
     fault_tolerance: FaultToleranceConfig = field(
@@ -467,6 +501,40 @@ class AppConfig:
                 and cfg.parallel.num_processes is None):
             raise ValueError("parallel.coordinator-address requires "
                              "num-processes and process-id")
+        per = raw.get("persistence", {}) or {}
+        per_defaults = PersistenceConfig()
+        cfg.persistence = PersistenceConfig(
+            enabled=bool(per.get("enabled", per_defaults.enabled)),
+            dir=str(per.get("dir", per_defaults.dir)),
+            disk_cache_max_bytes=int(per.get(
+                "disk-cache-max-bytes",
+                per_defaults.disk_cache_max_bytes)),
+            executables=bool(per.get("executables",
+                                     per_defaults.executables)),
+            snapshot_interval_s=float(per.get(
+                "snapshot-interval-s",
+                per_defaults.snapshot_interval_s)),
+            snapshot_top_k=int(per.get("snapshot-top-k",
+                                       per_defaults.snapshot_top_k)),
+            max_plane_entries=int(per.get(
+                "max-plane-entries", per_defaults.max_plane_entries)),
+            rehydrate=bool(per.get("rehydrate",
+                                   per_defaults.rehydrate)),
+            rehydrate_concurrency=int(per.get(
+                "rehydrate-concurrency",
+                per_defaults.rehydrate_concurrency)),
+        )
+        if cfg.persistence.disk_cache_max_bytes < 1024 * 1024:
+            raise ValueError("persistence.disk-cache-max-bytes must "
+                             "be >= 1 MiB")
+        if cfg.persistence.snapshot_interval_s < 0:
+            raise ValueError("persistence.snapshot-interval-s must be "
+                             ">= 0 (0 disables the timer)")
+        if cfg.persistence.rehydrate_concurrency < 1:
+            raise ValueError("persistence.rehydrate-concurrency must "
+                             "be >= 1")
+        if cfg.persistence.snapshot_top_k < 1:
+            raise ValueError("persistence.snapshot-top-k must be >= 1")
         tel = raw.get("telemetry", {}) or {}
         tel_defaults = TelemetryConfig()
         cfg.telemetry = TelemetryConfig(

@@ -267,6 +267,9 @@ class ImageRegionServices:
     # Admission control / load shedding (server.admission); None
     # admits everything (the batcher queues unboundedly).
     admission: object = None
+    # Warm-state persistence engine (services.warmstate); None when
+    # persistence is disabled — nothing survives the process then.
+    warmstate: object = None
     # Renders at or below this pixel count take the CPU reference kernel
     # (refimpl) instead of a device round trip — the SURVEY north star's
     # fallback path, and a latency win for tiny tiles anywhere the

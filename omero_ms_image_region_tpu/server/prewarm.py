@@ -119,7 +119,8 @@ def _warm_stage_shapes(B: int, C: int, bh: int, bw: int,
 
 
 def _warm_one(C: int, edge: int, quality: int, batch_sizes: Sequence[int],
-              engines: Sequence[str], buckets, raw_dtype) -> None:
+              engines: Sequence[str], buckets, raw_dtype,
+              exec_cache=None) -> None:
     from ..flagship import flagship_settings
     from ..ops.jpegenc import render_batch_to_jpeg
     from ..ops.render import render_tile_batch_packed
@@ -149,7 +150,18 @@ def _warm_one(C: int, edge: int, quality: int, batch_sizes: Sequence[int],
                                  dims=[(edge, edge)] * B, engine=engine,
                                  tune=False)
         if B == 1:
-            np.asarray(render_tile_batch_packed(*args))
+            if exec_cache is not None:
+                # Persistence posture: the packed program loads from a
+                # prior life's serialized executable (no trace, no
+                # compile) or compiles once and is serialized for the
+                # next life; either way the registered program is what
+                # serving groups of this signature will call.
+                fn = exec_cache.ensure("render_tile_batch_packed",
+                                       render_tile_batch_packed, args)
+                np.asarray(fn(*args) if fn is not None
+                           else render_tile_batch_packed(*args))
+            else:
+                np.asarray(render_tile_batch_packed(*args))
         # The pipelined dispatch's fetch-stage half (packed-staging
         # unpack programs for this stacked group shape).
         _warm_stage_shapes(B, C, bh, bw, raw_dtype)
@@ -168,7 +180,8 @@ def prewarm_batch_sizes(max_batch: int) -> tuple:
 
 def prewarm_renderer(specs: List[str], engines: Sequence[str],
                      max_batch: int, buckets,
-                     cpu_fallback_max_px: int = 0) -> None:
+                     cpu_fallback_max_px: int = 0,
+                     exec_cache=None) -> None:
     """Compile the serving programs for each spec; failures are logged,
     never fatal (serving still works, it just compiles lazily).
 
@@ -200,7 +213,7 @@ def prewarm_renderer(specs: List[str], engines: Sequence[str],
             t0 = time.perf_counter()
             try:
                 _warm_one(C, edge, quality, batch_sizes, engines,
-                          buckets, raw_dtype)
+                          buckets, raw_dtype, exec_cache=exec_cache)
             except Exception:
                 # Per-spec: one shape's dead compile must not strand
                 # the others (serving still works, it compiles lazily).

@@ -1,0 +1,86 @@
+"""One ordered shutdown hook chain for the device-free signal path.
+
+PR 4 gave ``run_app``/``sidecar_main`` a flight-recorder dump on
+SIGTERM; the warm-state tier adds a snapshot.  Two ad-hoc calls in two
+signal handlers is how one of them silently stops running, so both now
+route through this chain: hooks run IN ORDER (snapshot first — it
+captures serving state while services are still live; the black-box
+dump last — it must exist even if everything before it wedged), and
+every hook is guarded so one failing never skips the rest.  ``run``
+itself never raises: it is called from signal handlers and ``finally``
+blocks where an escape would abort the teardown it exists to protect.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Tuple
+
+log = logging.getLogger("omero_ms_image_region_tpu.shutdown")
+
+
+class ShutdownChain:
+    """Ordered, guarded, once-only shutdown hooks."""
+
+    def __init__(self):
+        self._hooks: List[Tuple[str, Callable[[], object]]] = []
+        self._ran = False
+        self._lock = threading.Lock()
+
+    def add(self, name: str, fn: Callable[[], object]) -> None:
+        self._hooks.append((name, fn))
+
+    def run(self, reason: str = "") -> List[Tuple[str, bool]]:
+        """Run every hook in registration order; returns
+        ``[(name, ok)]``.  Re-entry (SIGTERM then SIGINT in quick
+        succession — each starts a chain thread — or signal then
+        finally) is a no-op: the claim is taken under a lock, so each
+        hook runs at most once process-wide."""
+        with self._lock:
+            if self._ran:
+                return []
+            self._ran = True
+        results: List[Tuple[str, bool]] = []
+        for name, fn in self._hooks:
+            try:
+                fn()
+                results.append((name, True))
+            except Exception:
+                # A failing snapshot must never skip the flight dump
+                # (and vice versa); log and continue.
+                try:
+                    log.warning("shutdown hook %r failed (%s); "
+                                "continuing the chain", name, reason,
+                                exc_info=True)
+                except Exception:
+                    pass
+                results.append((name, False))
+        return results
+
+
+def build_shutdown_chain(config, services=None) -> ShutdownChain:
+    """The standard chain: warm-state snapshot first (serving state is
+    still live), flight-recorder dump last (the black box must land
+    even if the snapshot wedged).  ``services`` None (frontend proxy)
+    has no warm state to snapshot — the chain is just the dump."""
+    from ..utils import telemetry
+
+    chain = ShutdownChain()
+    warmstate = getattr(services, "warmstate", None)
+    if warmstate is not None:
+        chain.add("warmstate-snapshot", warmstate.snapshot_now)
+    exec_cache = getattr(getattr(services, "renderer", None),
+                         "exec_cache", None)
+    if exec_cache is not None:
+        # In-flight executable captures get a bounded window to land —
+        # a compile serialized now is a compile the next life skips.
+        chain.add("execcache-drain",
+                  lambda: exec_cache.drain(timeout_s=5.0))
+
+    def dump():
+        telemetry.FLIGHT.dump(config.telemetry.flight_recorder_dir,
+                              "shutdown")
+
+    chain.add("flight-dump", dump)
+    return chain

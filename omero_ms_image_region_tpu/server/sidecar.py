@@ -194,14 +194,17 @@ async def _plane_put(image_handler, header: dict,
 
 
 async def _serve_connection(image_handler, mask_handler, reader, writer,
-                            status_fn=None, profile_fn=None):
+                            status_fn=None, profile_fn=None,
+                            warmstate_fn=None):
     """One frontend connection: demux requests, run each as a task.
 
     ``status_fn`` answers the ``ping`` op (readiness state for the
     frontend's ``/readyz``); None keeps a bare liveness answer.
     ``profile_fn(ms)`` serves the ``profile`` op (on-demand
     ``jax.profiler`` capture in THIS device-owning process); None
-    rejects the op."""
+    rejects the op.  ``warmstate_fn(snapshot)`` serves the
+    ``warmstate`` op — persistence status (+ on-demand snapshot) from
+    the process that owns the warm state; None rejects the op."""
     write_lock = asyncio.Lock()
     tasks = set()
 
@@ -328,6 +331,17 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                     "events_total": telemetry.FLIGHT.events_total,
                     "dumps_written": telemetry.FLIGHT.dumps_written,
                 }).encode()
+            elif op == "warmstate":
+                # Proxy-mode rehydrate/snapshot surface: the warm
+                # state lives with the device process; frontends
+                # relay /debug/warmstate here.
+                if warmstate_fn is None:
+                    raise BadRequestError(
+                        "warm-state persistence is not enabled on "
+                        "this sidecar")
+                doc = await asyncio.to_thread(
+                    warmstate_fn, bool(header.get("snapshot")))
+                body = json.dumps(doc).encode()
             elif op == "profile":
                 # On-demand jax.profiler capture around the live
                 # batcher lanes of THIS device-owning process.
@@ -416,9 +430,13 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
         writer.close()
 
 
-async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
+async def run_sidecar(config, socket_path: Optional[str] = None,
+                      services_out: Optional[dict] = None) -> None:
     """Serve renders on the unix socket until cancelled.  Owns the full
-    device-side stack (``app.build_services``)."""
+    device-side stack (``app.build_services``).  ``services_out``
+    (when given) receives the built services under ``"services"`` so
+    the process entry's shutdown chain can snapshot warm state at
+    SIGTERM."""
     from .app import build_services
     from .handler import ImageRegionHandler, ShapeMaskHandler
 
@@ -447,6 +465,8 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
         os.unlink(socket_path)
 
     services = build_services(config)
+    if services_out is not None:
+        services_out["services"] = services
     db_metadata = None
     if config.metadata_backend == "postgres":
         from ..services.db_metadata import PostgresMetadataService
@@ -466,11 +486,16 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
         renderer = services.renderer
         depth = (renderer.queue_depth()
                  if hasattr(renderer, "queue_depth") else 0)
-        return {
+        doc = {
             "ok": True,
             "prewarm_pending": telemetry.READINESS.prewarm_pending,
             "queue_depth": depth,
         }
+        if services.warmstate is not None:
+            # /readyz annotation material: how far the boot
+            # rehydrator has replayed the warm-state manifest.
+            doc["rehydrate"] = telemetry.PERSIST.rehydrate_summary()
+        return doc
 
     def profile_fn(ms: float) -> dict:
         """The ``profile`` op: capture in THIS process (it owns the
@@ -478,6 +503,20 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
         return telemetry.capture_profile(
             config.telemetry.profile_dir,
             min(ms, config.telemetry.profile_max_ms))
+
+    warmstate_fn = None
+    if services.warmstate is not None:
+        def warmstate_fn(snapshot: bool) -> dict:
+            doc = {
+                "enabled": True,
+                "rehydrate": telemetry.PERSIST.rehydrate_summary(),
+                "snapshots": telemetry.PERSIST.snapshots,
+                "snapshot_errors": telemetry.PERSIST.snapshot_errors,
+            }
+            if snapshot:
+                doc["snapshot_path"] = \
+                    services.warmstate.snapshot_now()
+            return doc
 
     # Server.close() only stops the LISTENER; established connections
     # and their handler coroutines would outlive a shutdown (and keep
@@ -492,7 +531,8 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
         try:
             await _serve_connection(image_handler, mask_handler, reader,
                                     writer, status_fn=status_fn,
-                                    profile_fn=profile_fn)
+                                    profile_fn=profile_fn,
+                                    warmstate_fn=warmstate_fn)
         finally:
             conn_tasks.discard(task)
 
@@ -538,6 +578,13 @@ async def run_sidecar(config, socket_path: Optional[str] = None) -> None:
         # metadata and renderer first, then prefetch workers BEFORE the
         # pixel stores close under them, then the shared cache clients.
         from .batcher import BatchingRenderer
+        if services.warmstate is not None:
+            # Stop the snapshot timer / abort rehydrate before the
+            # stores it reads close under it.  (On SIGTERM the entry's
+            # shutdown chain snapshots CONCURRENTLY from its own
+            # thread, started at signal time; snapshot_now serializes
+            # against itself, so this close never loses that write.)
+            await asyncio.to_thread(services.warmstate.close)
         if db_metadata is not None:
             await db_metadata.close()
         if isinstance(services.renderer, BatchingRenderer):
@@ -987,32 +1034,57 @@ def _map_status(status: int, payload, retry_after_s=None):
 def sidecar_main(config) -> None:
     """Blocking entry for ``--role sidecar`` (the device process).
     SIGTERM (systemd stop) triggers the same orderly teardown as
-    cancellation: handlers drained, services closed."""
+    cancellation: handlers drained, services closed; the ordered
+    shutdown hook chain (warm-state snapshot first, black-box flight
+    dump last, each guarded) runs before the teardown finishes."""
     import signal
+
+    import threading
+
+    holder: dict = {}
+
+    def _start_chain() -> None:
+        """Signal time: run the ordered chain (warm-state snapshot
+        first, flight dump last, each guarded) on its OWN thread —
+        it must capture state NOW, while services are live, and must
+        not wait behind the orderly drain (a wedged teardown +
+        supervisor SIGKILL must not cost the black box)."""
+        from .shutdown import build_shutdown_chain
+        telemetry.FLIGHT.record("signal", sig="SIGTERM")
+        chain = build_shutdown_chain(config, holder.get("services"))
+        t = threading.Thread(target=chain.run, args=("sigterm",),
+                             name="shutdown-chain", daemon=True)
+        holder["chain_thread"] = t
+        t.start()
 
     async def main():
         task = asyncio.current_task()
         loop = asyncio.get_running_loop()
+
+        def on_signal():
+            _start_chain()
+            task.cancel()
+
         for sig in (signal.SIGTERM, signal.SIGINT):
             try:
-                loop.add_signal_handler(sig, task.cancel)
+                loop.add_signal_handler(sig, on_signal)
             except (NotImplementedError, RuntimeError):
                 pass
         try:
-            await run_sidecar(config)
+            await run_sidecar(config, services_out=holder)
         except asyncio.CancelledError:
-            # Orderly stop (SIGTERM): snapshot the black box so the
-            # last seconds of batcher/cache/chaos activity survive the
-            # process.
-            telemetry.FLIGHT.record("signal", sig="SIGTERM")
-            telemetry.FLIGHT.dump(config.telemetry.flight_recorder_dir,
-                                  "sigterm")
             logger.info("render sidecar stopped")
 
     try:
         asyncio.run(main())
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
+    finally:
+        chain_thread = holder.get("chain_thread")
+        if chain_thread is not None:
+            # Bounded join: the snapshot/dump land before exit, but a
+            # wedged hook cannot hold the process hostage.
+            chain_thread.join(timeout=15.0)
 
 
 def wait_sidecar_socket(proc, socket_path: str,

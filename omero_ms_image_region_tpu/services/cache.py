@@ -73,6 +73,13 @@ class MemoryLRUCache:
                 self._size -= len(evicted)
                 self.evictions += 1
 
+    def keys_by_recency(self, limit: int = 0) -> List[str]:
+        """Resident keys, most-recently-used first — the warm-state
+        snapshot's view of the hot set.  ``limit`` 0 = all."""
+        with self._lock:
+            keys = list(reversed(self._data.keys()))
+        return keys[:limit] if limit else keys
+
     async def get(self, key: str) -> Optional[bytes]:
         return self.get_sync(key)
 
@@ -184,6 +191,13 @@ class CacheConfig:
     image_region: bool = False         # image-region-cache.enabled
     pixels_metadata: bool = False      # pixels-metadata-cache.enabled
     shape_mask: bool = False           # shape-mask-cache.enabled
+    # Durable disk tier (services.diskcache), slotted between the
+    # in-memory LRU and Redis so rendered bytes survive process death
+    # with no external dependency.  None disables (today's posture);
+    # the persistence block (server.config.PersistenceConfig) sets it.
+    disk_dir: Optional[str] = None
+    disk_max_bytes: int = 1024 * 1024 * 1024
+    disk_sync_writes: bool = False     # tests: deterministic writes
 
     @classmethod
     def enabled_all(cls, **kwargs) -> "CacheConfig":
@@ -192,30 +206,69 @@ class CacheConfig:
 
 
 def make_cache(config: CacheConfig, enabled: bool,
-               redis: Optional[RedisCache] = None) -> CacheStack:
+               redis: Optional[RedisCache] = None,
+               disk: Optional[CacheTier] = None) -> CacheStack:
     """Build one named cache's tier stack from config.
 
     ``redis`` is the deployment's one shared client (all stacks ride the
-    same connection pool, like the reference's single RedisCacheVerticle).
+    same connection pool, like the reference's single RedisCacheVerticle);
+    ``disk`` is the deployment's one shared durable tier (all stacks
+    share its byte budget and write-behind worker), slotted between the
+    memory LRU and Redis — a read-through hit there back-fills memory,
+    exactly the warm-restart promote path.
     """
     tiers: List[CacheTier] = []
     native = _native_cache(config.local_max_bytes)
     tiers.append(native if native is not None
                  else MemoryLRUCache(config.local_max_bytes))
+    if disk is not None:
+        tiers.append(disk)
     if redis is not None:
         tiers.append(redis)
     return CacheStack(tiers, enabled=enabled)
 
 
+class NamespacedTier:
+    """Per-cache view of one shared tier: keys gain a namespace prefix
+    so the three named caches can share ONE disk store (one byte
+    budget, one write-behind worker) without key collisions.  Counter
+    attributes delegate, so the generic per-tier /metrics export still
+    sees the shared tier's accounting."""
+
+    def __init__(self, inner, prefix: str):
+        self.inner = inner
+        self.prefix = prefix
+
+    async def get(self, key: str) -> Optional[bytes]:
+        return await self.inner.get(self.prefix + key)
+
+    async def set(self, key: str, value: bytes) -> None:
+        await self.inner.set(self.prefix + key, value)
+
+    @property
+    def hits(self):
+        return self.inner.hits
+
+    @property
+    def misses(self):
+        return self.inner.misses
+
+    @property
+    def evictions(self):
+        return self.inner.evictions
+
+
 @dataclass
 class Caches:
     """The three named caches the reference runs (``config.yaml:53-60``),
-    plus the one shared Redis client they (and the canRead memo) ride."""
+    plus the one shared Redis client they (and the canRead memo) ride
+    and the one shared durable disk tier (warm-state persistence)."""
 
     image_region: CacheStack
     pixels_metadata: CacheStack
     shape_mask: CacheStack
     redis: Optional[RedisCache] = None
+    disk: object = None                # services.diskcache.DiskByteCache
 
     @classmethod
     def from_config(cls, config: CacheConfig) -> "Caches":
@@ -225,14 +278,32 @@ class Caches:
                 redis = RedisCache(config.redis_uri)
             except ImportError:
                 pass
+        disk = None
+        if config.disk_dir:
+            from .diskcache import DiskByteCache
+            disk = DiskByteCache(config.disk_dir,
+                                 max_bytes=config.disk_max_bytes,
+                                 sync_writes=config.disk_sync_writes)
+
+        def disk_view(prefix: str):
+            return (NamespacedTier(disk, prefix)
+                    if disk is not None else None)
+
         return cls(
-            image_region=make_cache(config, config.image_region, redis),
+            image_region=make_cache(config, config.image_region, redis,
+                                    disk=disk_view("img:")),
             pixels_metadata=make_cache(config, config.pixels_metadata,
-                                       redis),
-            shape_mask=make_cache(config, config.shape_mask, redis),
+                                       redis, disk=disk_view("meta:")),
+            shape_mask=make_cache(config, config.shape_mask, redis,
+                                  disk=disk_view("mask:")),
             redis=redis,
+            disk=disk,
         )
 
     async def close(self) -> None:
+        if self.disk is not None:
+            # Drain the write-behind queue so bytes rendered in the
+            # last seconds of this life are durable for the next one.
+            await asyncio.to_thread(self.disk.close)
         if self.redis is not None:
             await self.redis.close()

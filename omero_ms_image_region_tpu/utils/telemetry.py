@@ -1128,6 +1128,194 @@ class Resilience:
 RESILIENCE = Resilience()
 
 
+# ------------------------------------------------------ warm persistence
+
+class Persistence:
+    """Warm-state persistence accounting (services.diskcache +
+    services.warmstate + server.execcache): disk byte-cache write/
+    corruption counters, snapshot age/duration, and live rehydrate
+    progress.  Thread-safe — the disk tier's write-behind worker, the
+    snapshot timer thread and the boot rehydrator all count here; the
+    scrape path only reads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # Disk byte-cache tier (services.diskcache.DiskByteCache).
+        self.diskcache_writes = 0
+        self.diskcache_write_errors = 0
+        self.diskcache_write_dropped = 0
+        self.diskcache_corrupt = 0
+        self.diskcache_bytes = 0          # gauge (set by the cache)
+        self.diskcache_entries = 0        # gauge
+        # Snapshot engine (services.warmstate).
+        self.snapshots = 0
+        self.snapshot_errors = 0
+        self.snapshot_last_ts = 0.0       # wall clock of the last write
+        self.snapshot_duration_ms = 0.0
+        # Boot rehydrator progress (the /readyz annotation + gauges).
+        self.rehydrate_running = False
+        self.rehydrate_items_total = 0
+        self.rehydrate_items_done = 0
+        self.rehydrate_errors = 0
+        self.rehydrate_aborted = False
+        self.rehydrate_duration_ms = 0.0
+        self.rehydrate_bytes_promoted = 0
+        self.rehydrate_planes_restaged = 0
+        self.rehydrate_executables_loaded = 0
+
+    # ------------------------------------------------------- disk tier
+
+    def count_disk_write(self, error: bool = False,
+                         dropped: bool = False) -> None:
+        with self._lock:
+            if dropped:
+                self.diskcache_write_dropped += 1
+            elif error:
+                self.diskcache_write_errors += 1
+            else:
+                self.diskcache_writes += 1
+
+    def count_disk_corrupt(self) -> None:
+        with self._lock:
+            self.diskcache_corrupt += 1
+        FLIGHT.record("diskcache.corrupt")
+
+    def set_disk_size(self, nbytes: int, entries: int) -> None:
+        with self._lock:
+            self.diskcache_bytes = int(nbytes)
+            self.diskcache_entries = int(entries)
+
+    # -------------------------------------------------------- snapshot
+
+    def count_snapshot(self, duration_ms: float,
+                       error: bool = False) -> None:
+        with self._lock:
+            if error:
+                self.snapshot_errors += 1
+                return
+            self.snapshots += 1
+            self.snapshot_last_ts = time.time()
+            self.snapshot_duration_ms = float(duration_ms)
+
+    # ------------------------------------------------------- rehydrate
+
+    def rehydrate_begin(self, items_total: int) -> None:
+        with self._lock:
+            self.rehydrate_running = True
+            self.rehydrate_aborted = False
+            self.rehydrate_items_total = int(items_total)
+            self.rehydrate_items_done = 0
+
+    def rehydrate_step(self, kind: str = "", nbytes: int = 0,
+                       error: bool = False) -> None:
+        with self._lock:
+            self.rehydrate_items_done += 1
+            if error:
+                self.rehydrate_errors += 1
+                return
+            if kind == "byte":
+                self.rehydrate_bytes_promoted += int(nbytes)
+            elif kind == "plane":
+                self.rehydrate_planes_restaged += 1
+            elif kind == "executable":
+                self.rehydrate_executables_loaded += 1
+
+    def rehydrate_end(self, duration_ms: float,
+                      aborted: bool = False) -> None:
+        with self._lock:
+            self.rehydrate_running = False
+            self.rehydrate_aborted = bool(aborted)
+            self.rehydrate_duration_ms = float(duration_ms)
+
+    def rehydrate_summary(self) -> str:
+        """One-line state for the /readyz annotation (rehydrate is
+        best-effort: never a readiness failure, always visible)."""
+        with self._lock:
+            if self.rehydrate_running:
+                return (f"running {self.rehydrate_items_done}"
+                        f"/{self.rehydrate_items_total}")
+            if self.rehydrate_aborted:
+                return (f"aborted {self.rehydrate_items_done}"
+                        f"/{self.rehydrate_items_total}")
+            if self.rehydrate_items_total:
+                return (f"done {self.rehydrate_items_done}"
+                        f"/{self.rehydrate_items_total}")
+        return "idle"
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        def label() -> str:
+            inner = extra_labels.lstrip(",")
+            return f"{{{inner}}}" if inner else ""
+
+        lb = label()
+        with self._lock:
+            age_s = (time.time() - self.snapshot_last_ts
+                     if self.snapshot_last_ts else 0.0)
+            return [
+                f"imageregion_diskcache_writes_total{lb} "
+                f"{self.diskcache_writes}",
+                f"imageregion_diskcache_write_errors_total{lb} "
+                f"{self.diskcache_write_errors}",
+                f"imageregion_diskcache_write_dropped_total{lb} "
+                f"{self.diskcache_write_dropped}",
+                f"imageregion_diskcache_corrupt_total{lb} "
+                f"{self.diskcache_corrupt}",
+                f"imageregion_diskcache_bytes{lb} "
+                f"{self.diskcache_bytes}",
+                f"imageregion_diskcache_entries{lb} "
+                f"{self.diskcache_entries}",
+                f"imageregion_warmstate_snapshots_total{lb} "
+                f"{self.snapshots}",
+                f"imageregion_warmstate_snapshot_errors_total{lb} "
+                f"{self.snapshot_errors}",
+                f"imageregion_warmstate_snapshot_age_seconds{lb} "
+                f"{round(age_s, 3)}",
+                f"imageregion_warmstate_snapshot_duration_ms{lb} "
+                f"{round(self.snapshot_duration_ms, 3)}",
+                f"imageregion_rehydrate_running{lb} "
+                f"{1 if self.rehydrate_running else 0}",
+                f"imageregion_rehydrate_items_total{lb} "
+                f"{self.rehydrate_items_total}",
+                f"imageregion_rehydrate_items_done{lb} "
+                f"{self.rehydrate_items_done}",
+                f"imageregion_rehydrate_errors_total{lb} "
+                f"{self.rehydrate_errors}",
+                f"imageregion_rehydrate_duration_ms{lb} "
+                f"{round(self.rehydrate_duration_ms, 3)}",
+                f"imageregion_rehydrate_bytes_promoted_total{lb} "
+                f"{self.rehydrate_bytes_promoted}",
+                f"imageregion_rehydrate_planes_restaged_total{lb} "
+                f"{self.rehydrate_planes_restaged}",
+                f"imageregion_rehydrate_executables_loaded_total{lb} "
+                f"{self.rehydrate_executables_loaded}",
+            ]
+
+    def reset(self) -> None:
+        with self._lock:
+            self.diskcache_writes = 0
+            self.diskcache_write_errors = 0
+            self.diskcache_write_dropped = 0
+            self.diskcache_corrupt = 0
+            self.diskcache_bytes = 0
+            self.diskcache_entries = 0
+            self.snapshots = 0
+            self.snapshot_errors = 0
+            self.snapshot_last_ts = 0.0
+            self.snapshot_duration_ms = 0.0
+            self.rehydrate_running = False
+            self.rehydrate_items_total = 0
+            self.rehydrate_items_done = 0
+            self.rehydrate_errors = 0
+            self.rehydrate_aborted = False
+            self.rehydrate_duration_ms = 0.0
+            self.rehydrate_bytes_promoted = 0
+            self.rehydrate_planes_restaged = 0
+            self.rehydrate_executables_loaded = 0
+
+
+PERSIST = Persistence()
+
+
 def resilience_metric_lines(breaker=None,
                             extra_labels: str = "") -> List[str]:
     """The fault-tolerance series.  ``breaker`` is the sidecar client's
@@ -1273,6 +1461,30 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_shape_device_ms_mean": "gauge",
     "imageregion_shape_estimated_flops": "gauge",
     "imageregion_shape_estimated_bytes": "gauge",
+    # Warm-state persistence tier: disk byte cache, snapshot engine,
+    # boot rehydrator, serialized render executables.
+    "imageregion_diskcache_writes_total": "counter",
+    "imageregion_diskcache_write_errors_total": "counter",
+    "imageregion_diskcache_write_dropped_total": "counter",
+    "imageregion_diskcache_corrupt_total": "counter",
+    "imageregion_diskcache_bytes": "gauge",
+    "imageregion_diskcache_entries": "gauge",
+    "imageregion_warmstate_snapshots_total": "counter",
+    "imageregion_warmstate_snapshot_errors_total": "counter",
+    "imageregion_warmstate_snapshot_age_seconds": "gauge",
+    "imageregion_warmstate_snapshot_duration_ms": "gauge",
+    "imageregion_rehydrate_running": "gauge",
+    "imageregion_rehydrate_items_total": "gauge",
+    "imageregion_rehydrate_items_done": "gauge",
+    "imageregion_rehydrate_errors_total": "counter",
+    "imageregion_rehydrate_duration_ms": "gauge",
+    "imageregion_rehydrate_bytes_promoted_total": "counter",
+    "imageregion_rehydrate_planes_restaged_total": "counter",
+    "imageregion_rehydrate_executables_loaded_total": "counter",
+    "imageregion_execcache_hits": "counter",
+    "imageregion_execcache_misses": "counter",
+    "imageregion_execcache_loaded_total": "counter",
+    "imageregion_execcache_saved_total": "counter",
 }
 
 # Terse HELP strings for the families whose meaning is not obvious
@@ -1303,6 +1515,17 @@ METRIC_HELP: Dict[str, str] = {
         "XLA cost_analysis flops estimate of the shape's program",
     "imageregion_batcher_queue_wait_max_ms":
         "High-water dispatched queue wait (cancelled waits excluded)",
+    "imageregion_diskcache_corrupt_total":
+        "Disk byte-cache entries rejected by checksum/format checks",
+    "imageregion_warmstate_snapshot_age_seconds":
+        "Seconds since the last warm-state manifest write (0 = never)",
+    "imageregion_rehydrate_running":
+        "1 while the boot rehydrator is replaying the warm-state "
+        "manifest",
+    "imageregion_rehydrate_bytes_promoted_total":
+        "Disk byte-cache bytes promoted to the memory tier at boot",
+    "imageregion_execcache_loaded_total":
+        "Serialized render executables deserialized from disk",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -1468,6 +1691,20 @@ def device_metric_lines(services, extra_labels: str = "") -> List[str]:
     # Per-ladder-shape estimated vs observed device cost (the batcher
     # records both; cardinality is bounded by the bucket/batch ladder).
     lines += SHAPE_COSTS.metric_lines(extra_labels)
+    # Warm-state persistence tier (disk byte cache, snapshot engine,
+    # boot rehydrator) — device-side state, merged like the rest.
+    lines += PERSIST.metric_lines(extra_labels)
+    exec_cache = getattr(getattr(services, "renderer", None),
+                         "exec_cache", None)
+    if exec_cache is not None:
+        lines += [
+            f"imageregion_execcache_hits{lb} {exec_cache.hits}",
+            f"imageregion_execcache_misses{lb} {exec_cache.misses}",
+            f"imageregion_execcache_loaded_total{lb} "
+            f"{exec_cache.loaded}",
+            f"imageregion_execcache_saved_total{lb} "
+            f"{exec_cache.saved}",
+        ]
     if extra_labels:
         # The sidecar's flight-recorder ring, labelled so the
         # frontend's merged exposition keeps both processes' series
@@ -1510,3 +1747,4 @@ def reset() -> None:
     FLIGHT.reset()
     SLO.reset()
     SHAPE_COSTS.reset()
+    PERSIST.reset()

@@ -13,10 +13,13 @@ Usage::
 Exit codes: 0 pass (or nothing to judge — see --strict), 1 regression
 over the threshold, 2 usage/input error.
 
-The default key is the full-HTTP-stack service rate; tunnel weather
-can null it out for a round, so an absent/None value SKIPS the gate
-(with a printed verdict) rather than failing the build — ``--strict``
-turns skips into failures for CI postures that must always measure.
+The default keys are the full-HTTP-stack service rate AND its p50
+latency ex-RTT (latency regressions must not hide behind a flat
+throughput headline; ``_ms`` keys are judged in the opposite
+direction — up is the regression).  Tunnel weather can null either
+out for a round, so an absent/None value SKIPS that key's gate (with
+a printed verdict) rather than failing the build — ``--strict`` turns
+skips into failures for CI postures that must always measure.
 """
 
 from __future__ import annotations
@@ -27,8 +30,15 @@ import os
 import re
 import sys
 
-DEFAULT_KEYS = ("service_tiles_per_sec",)
+DEFAULT_KEYS = ("service_tiles_per_sec", "p50_service_tile_ms_ex_rtt")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def lower_is_better(key: str) -> bool:
+    """Latency keys regress UPWARD — without direction awareness a
+    latency regression would read as an improvement (and a flat
+    throughput headline could hide it entirely)."""
+    return key.endswith("_ms") or "_ms_" in key
 
 
 def load_record(path: str) -> dict:
@@ -81,10 +91,15 @@ def judge(old: dict, new: dict, keys, max_regression: float):
                              "old": v_old, "new": v_new})
             continue
         change = (v_new - v_old) / v_old
-        # Inclusive: a dead-on 10% drop against the default threshold
-        # is a failure, not a float-equality pass.
-        verdict = ("regression" if change <= -max_regression
-                   else "pass")
+        # Inclusive: a dead-on 10% move against the default threshold
+        # is a failure, not a float-equality pass.  Direction depends
+        # on the key: throughput regresses down, latency regresses up.
+        if lower_is_better(key):
+            verdict = ("regression" if change >= max_regression
+                       else "pass")
+        else:
+            verdict = ("regression" if change <= -max_regression
+                       else "pass")
         verdicts.append({"key": key, "verdict": verdict,
                          "old": round(float(v_old), 2),
                          "new": round(float(v_new), 2),

@@ -1,0 +1,39 @@
+"""bench.py --smoke --restart as a tier-1 gate: the warm-state
+persistence acceptance path — kill + restart serves the previously-seen
+working set from the disk tier + deserialized executables, byte-
+identical, without wire fetches or XLA compiles."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_bench_restart_smoke(capsys):
+    import bench
+
+    t0 = time.monotonic()
+    out = bench.bench_restart_smoke()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, f"restart smoke took {elapsed:.0f}s"
+
+    # Acceptance: the repeat working set serves warm — no device
+    # dispatch (hence no wire fetch) for >= 90% of it.
+    assert out["restart_warm_hit_rate"] >= 0.9, out
+    # The rehydrated first tile is byte-identical to the pre-restart
+    # render AND to the jax-free refimpl golden render.
+    assert out["restart_bytes_identical"] is True
+    assert out["restart_first_tile_identical"] is True
+    # No XLA compile served the restart window, and the executable
+    # ladder really deserialized from disk (the mechanism a true
+    # process restart rides).
+    assert out["restart_compile_events"] == 0
+    assert out["rehydrate_executables_loaded"] >= 1
+    assert out["rehydrate_planes_restaged"] >= 1
+    assert out["restart_time_to_first_tile_ms"] > 0
+
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["metric"] == "restart_smoke"

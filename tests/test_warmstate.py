@@ -1,0 +1,620 @@
+"""Warm-state persistence tier: disk byte cache, shutdown hook chain,
+snapshot/rehydrate engine, serialized executables, telemetry contract.
+
+The corruption-tolerance classes extend the ``scripts/fuzz_decoders.py``
+pattern into tier-1: every hostile mutation of the durable state —
+truncated files, flipped bytes, zero-length entries, a manifest from a
+different fingerprint — must degrade to a source re-render; never a
+5xx, never a poisoned cache entry served.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.services.diskcache import (
+    DiskByteCache, decode_entry, encode_entry)
+from omero_ms_image_region_tpu.utils import telemetry
+
+IMG = 1
+URL = (f"/webgateway/render_image_region/{IMG}/0/0"
+       "?tile=0,0,0,64,64&format=png&m=c&c=1|0:60000$FF0000")
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    root = tmp_path_factory.mktemp("warmdata")
+    rng = np.random.default_rng(3)
+    planes = rng.integers(0, 60000, size=(2, 2, 128, 128)).astype(
+        np.uint16)
+    build_pyramid(planes, str(root / str(IMG)), chunk=(64, 64),
+                  n_levels=1)
+    return str(root)
+
+
+def _persist_config(data_dir, warm_dir):
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, PersistenceConfig)
+    from omero_ms_image_region_tpu.services.cache import CacheConfig
+    cfg = AppConfig(
+        data_dir=data_dir,
+        caches=CacheConfig.enabled_all(disk_sync_writes=True),
+        persistence=PersistenceConfig(enabled=True, dir=str(warm_dir),
+                                      snapshot_interval_s=0))
+    cfg.renderer.cpu_fallback_max_px = 0
+    return cfg
+
+
+def _fetch(config, *reqs):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from omero_ms_image_region_tpu.server.app import create_app
+
+    async def scenario():
+        app = create_app(config)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        out = []
+        try:
+            for method, path in reqs:
+                r = await client.request(method, path)
+                out.append((r.status, dict(r.headers), await r.read()))
+        finally:
+            await client.close()
+        return out
+
+    return asyncio.run(scenario())
+
+
+# --------------------------------------------------------- disk tier
+
+class TestDiskByteCache:
+    def _cache(self, tmp_path, **kw):
+        kw.setdefault("sync_writes", True)
+        return DiskByteCache(str(tmp_path / "dc"), **kw)
+
+    def test_round_trip_and_counters(self, tmp_path):
+        c = self._cache(tmp_path)
+        assert c.get_sync("k") is None
+        c.set_sync("k", b"value")
+        assert c.get_sync("k") == b"value"
+        assert (c.hits, c.misses) == (1, 1)
+        assert telemetry.PERSIST.diskcache_writes == 1
+        assert len(c) == 1 and c.size_bytes > 0
+
+    def test_entry_format_rejects_foreign_key(self):
+        blob = encode_entry("mine", b"payload")
+        assert decode_entry(blob, "mine") == b"payload"
+        # A filename-hash collision (or a re-sharded foreign file)
+        # must alias to a MISS, never to another key's bytes.
+        assert decode_entry(blob, "theirs") is None
+
+    @pytest.mark.parametrize("mutate", [
+        lambda b: b[:len(b) // 2],                      # truncated
+        lambda b: b"",                                  # zero-length
+        lambda b: bytes([b[0] ^ 0xFF]) + b[1:],         # magic flip
+        lambda b: b[:-1] + bytes([b[-1] ^ 0x01]),       # payload flip
+        lambda b: b + b"trailing-garbage",              # grown file
+        lambda b: b"not an entry at all",               # alien file
+    ])
+    def test_corrupt_entry_reads_as_miss_and_is_removed(
+            self, tmp_path, mutate):
+        c = self._cache(tmp_path)
+        c.set_sync("k", b"precious bytes")
+        path = c._path_of("k")
+        with open(path, "rb") as f:
+            blob = f.read()
+        with open(path, "wb") as f:
+            f.write(mutate(blob))
+        assert c.get_sync("k") is None      # never poisoned bytes
+        assert telemetry.PERSIST.diskcache_corrupt == 1
+        assert not os.path.exists(path)     # removed, not re-served
+
+    def test_fuzzed_entries_never_escape(self, tmp_path):
+        """fuzz_decoders pattern over the entry format: random byte
+        flips, splice-deletes, truncations, insertions — the contract
+        is value-or-miss, never an exception."""
+        rng = np.random.default_rng(0)
+        c = self._cache(tmp_path)
+        keys = [f"key-{i}" for i in range(8)]
+        for i, k in enumerate(keys):
+            c.set_sync(k, bytes(rng.integers(0, 256, 64 + i * 37,
+                                             dtype=np.uint8)))
+        for it in range(300):
+            k = keys[int(rng.integers(0, len(keys)))]
+            path = c._path_of(k)
+            if not os.path.exists(path):
+                c.set_sync(k, b"refill")
+            with open(path, "rb") as f:
+                b = bytearray(f.read())
+            kind = int(rng.integers(0, 4))
+            if kind == 0 and len(b) > 4:
+                b[int(rng.integers(0, len(b)))] = int(
+                    rng.integers(0, 256))
+            elif kind == 1 and len(b) > 8:
+                del b[int(rng.integers(4, len(b))):]
+            elif kind == 2 and len(b) > 16:
+                i = int(rng.integers(4, len(b) - 4))
+                del b[i:i + int(rng.integers(1, 12))]
+            else:
+                i = int(rng.integers(0, len(b)))
+                b[i:i] = bytes(rng.integers(0, 256, 5, dtype=np.uint8))
+            with open(path, "wb") as f:
+                f.write(bytes(b))
+            got = c.get_sync(k)         # must not raise
+            if got is not None:
+                # A surviving read must be the EXACT original value
+                # (the mutation missed the file or was re-filled).
+                assert isinstance(got, bytes)
+
+    def test_eviction_bounds_size_oldest_first(self, tmp_path):
+        c = self._cache(tmp_path, max_bytes=4096)
+        for i in range(32):
+            c.set_sync(f"k{i}", bytes(300))
+        assert c.size_bytes <= 4096
+        assert c.evictions > 0
+        # Newest entries survive (mtime LRU).
+        assert c.get_sync("k31") is not None
+
+    def test_oversize_value_is_not_stored(self, tmp_path):
+        c = self._cache(tmp_path, max_bytes=1024)
+        c.set_sync("big", bytes(4096))
+        assert c.get_sync("big") is None
+
+    def test_crash_orphan_tmp_is_swept(self, tmp_path):
+        c = self._cache(tmp_path, max_bytes=2048)
+        c.set_sync("k", b"v")
+        shard = os.path.dirname(c._path_of("k"))
+        orphan = os.path.join(shard, "deadbeef.irb.tmp.123.456")
+        with open(orphan, "wb") as f:
+            f.write(b"half a write")
+        for i in range(16):                 # force an eviction scan
+            c.set_sync(f"fill{i}", bytes(300))
+        assert not os.path.exists(orphan)
+
+    def test_write_behind_drops_when_full_never_blocks(self, tmp_path):
+        c = DiskByteCache(str(tmp_path / "wb"), sync_writes=False)
+
+        async def go():
+            # Deterministic stall: a closed cache never starts its
+            # worker, so the bounded queue fills and the overflow MUST
+            # drop (count) instead of blocking the caller.
+            c._closed = True
+            c._queue.maxsize = 1
+            await c.set("a", b"1")
+            await c.set("b", b"2")      # queue full -> dropped, no block
+        asyncio.run(go())
+        assert telemetry.PERSIST.diskcache_write_dropped >= 1
+
+    def test_keys_sync_reports_stored_keys(self, tmp_path):
+        c = self._cache(tmp_path)
+        for i in range(5):
+            c.set_sync(f"key-{i}", b"x")
+        assert set(c.keys_sync()) == {f"key-{i}" for i in range(5)}
+
+
+# ---------------------------------------------------- shutdown chain
+
+class TestShutdownChain:
+    def test_ordered_guarded_once_only(self):
+        from omero_ms_image_region_tpu.server.shutdown import (
+            ShutdownChain)
+        ran = []
+        chain = ShutdownChain()
+        chain.add("snapshot", lambda: ran.append("snapshot"))
+        chain.add("boom", lambda: 1 / 0)
+        chain.add("dump", lambda: ran.append("dump"))
+        results = chain.run("test")
+        # One failing hook never skips the others, order preserved.
+        assert ran == ["snapshot", "dump"]
+        assert results == [("snapshot", True), ("boom", False),
+                           ("dump", True)]
+        # Re-entry (SIGTERM then finally) is a no-op.
+        assert chain.run("again") == []
+        assert ran == ["snapshot", "dump"]
+
+    def test_build_chain_orders_snapshot_before_dump(self, data_dir,
+                                                     tmp_path):
+        """The regression test the satellite asks for: both shutdown
+        duties (warm-state snapshot AND flight dump) ride ONE chain,
+        snapshot first, dump last, and a failing snapshot still dumps.
+        """
+        from omero_ms_image_region_tpu.server.app import (SERVICES_KEY,
+                                                          create_app)
+        from omero_ms_image_region_tpu.server.shutdown import (
+            build_shutdown_chain)
+        cfg = _persist_config(data_dir, tmp_path / "warm")
+        cfg.telemetry.flight_recorder_dir = str(tmp_path / "flight")
+
+        async def scenario():
+            app = create_app(cfg)
+            services = app[SERVICES_KEY]
+            try:
+                chain = build_shutdown_chain(cfg, services)
+                names = [name for name, _ in chain._hooks]
+                assert names[0] == "warmstate-snapshot"
+                assert names[-1] == "flight-dump"
+                # Sabotage the snapshot: the dump must still land.
+                services.warmstate.snapshot_now = \
+                    lambda: (_ for _ in ()).throw(OSError("disk gone"))
+                chain2 = build_shutdown_chain(cfg, services)
+                results = dict(chain2.run("sigterm"))
+                assert results["warmstate-snapshot"] is False
+                assert results["flight-dump"] is True
+                dumps = os.listdir(str(tmp_path / "flight"))
+                assert any(n.startswith("flight-") for n in dumps)
+            finally:
+                services.warmstate.close()
+                from omero_ms_image_region_tpu.server.batcher import (
+                    BatchingRenderer)
+                if isinstance(services.renderer, BatchingRenderer):
+                    await services.renderer.close()
+                services.pixels_service.close()
+                await services.caches.close()
+
+        asyncio.run(scenario())
+
+    def test_frontend_chain_is_dump_only(self, tmp_path):
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        from omero_ms_image_region_tpu.server.shutdown import (
+            build_shutdown_chain)
+        cfg = AppConfig()
+        cfg.telemetry.flight_recorder_dir = str(tmp_path / "fl")
+        chain = build_shutdown_chain(cfg, None)
+        assert [name for name, _ in chain._hooks] == ["flight-dump"]
+
+
+# ------------------------------------------------ snapshot/rehydrate
+
+class TestWarmRestart:
+    def test_restart_serves_from_disk_without_dispatch(self, data_dir,
+                                                       tmp_path):
+        """Kill + restart: the previously-seen tile serves from the
+        disk tier with zero new device dispatches, byte-identical."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.server.app import (SERVICES_KEY,
+                                                          create_app)
+        warm = tmp_path / "warm"
+
+        async def life(expect_rehydrate):
+            app = create_app(_persist_config(data_dir, warm))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                if expect_rehydrate:
+                    for _ in range(200):
+                        if (not telemetry.PERSIST.rehydrate_running
+                                and telemetry.PERSIST
+                                .rehydrate_items_total):
+                            break
+                        await asyncio.sleep(0.02)
+                services = app[SERVICES_KEY]
+                renderer = services.renderer
+                d0 = getattr(renderer, "batches_dispatched", 0)
+                r = await client.get(URL)
+                body = await r.read()
+                assert r.status == 200
+                dispatched = (getattr(renderer, "batches_dispatched",
+                                      0) - d0)
+                services.warmstate.snapshot_now()
+                return body, dispatched
+            finally:
+                await client.close()
+
+        body1, dispatched1 = asyncio.run(life(False))
+        assert dispatched1 >= 1          # cold: a real device render
+        telemetry.reset()
+        body2, dispatched2 = asyncio.run(life(True))
+        assert dispatched2 == 0          # warm: disk tier answered
+        assert body2 == body1
+        assert telemetry.PERSIST.rehydrate_items_total > 0
+
+    def test_manifest_from_different_fingerprint_skips_executables(
+            self, data_dir, tmp_path):
+        """A manifest written by another jax/jaxlib/device life must
+        degrade (bytes/planes still replay; executables skipped) —
+        never crash the boot."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.server.app import (SERVICES_KEY,
+                                                          create_app)
+        warm = tmp_path / "warm"
+
+        async def seed():
+            app = create_app(_persist_config(data_dir, warm))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                await r.read()
+                assert r.status == 200
+                services = app[SERVICES_KEY]
+                services.renderer.exec_cache.drain(30.0)
+                services.warmstate.snapshot_now()
+            finally:
+                await client.close()
+
+        asyncio.run(seed())
+        manifest = warm / "manifest.json"
+        doc = json.loads(manifest.read_text())
+        doc["fingerprint"] = "alien-device-and-toolchain"
+        manifest.write_text(json.dumps(doc))
+        telemetry.reset()
+
+        async def reboot():
+            app = create_app(_persist_config(data_dir, warm))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                for _ in range(200):
+                    if (not telemetry.PERSIST.rehydrate_running
+                            and telemetry.PERSIST.rehydrate_items_total):
+                        break
+                    await asyncio.sleep(0.02)
+                r = await client.get(URL)
+                await r.read()
+                return r.status
+            finally:
+                await client.close()
+
+        assert asyncio.run(reboot()) == 200
+        assert telemetry.PERSIST.rehydrate_executables_loaded == 0
+
+    def test_corrupt_cache_dir_serves_cold_never_5xx(self, data_dir,
+                                                     tmp_path):
+        """Trash EVERY durable artifact (entries, manifest,
+        executables) and restart: behavior degrades to the cold path —
+        200s all the way, nothing poisoned, no startup failure."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.server.app import (SERVICES_KEY,
+                                                          create_app)
+        warm = tmp_path / "warm"
+
+        async def seed():
+            app = create_app(_persist_config(data_dir, warm))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                body = await r.read()
+                assert r.status == 200
+                services = app[SERVICES_KEY]
+                services.renderer.exec_cache.drain(30.0)
+                services.warmstate.snapshot_now()
+                return body
+            finally:
+                await client.close()
+
+        body1 = asyncio.run(seed())
+        # Flip bytes in every file under the persistence root.
+        rng = np.random.default_rng(5)
+        for dirpath, _dirs, names in os.walk(warm):
+            for name in names:
+                path = os.path.join(dirpath, name)
+                with open(path, "rb") as f:
+                    b = bytearray(f.read())
+                if not b:
+                    continue
+                for _ in range(3):
+                    b[int(rng.integers(0, len(b)))] = int(
+                        rng.integers(0, 256))
+                with open(path, "wb") as f:
+                    f.write(bytes(b))
+        telemetry.reset()
+        status, _headers, body2 = _fetch(
+            _persist_config(data_dir, warm), ("GET", URL))[0]
+        assert status == 200            # re-rendered from source
+        assert body2 == body1           # and correct (never poisoned)
+
+    def test_snapshot_manifest_contents(self, data_dir, tmp_path):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.server.app import (SERVICES_KEY,
+                                                          create_app)
+        warm = tmp_path / "warm"
+
+        async def scenario():
+            app = create_app(_persist_config(data_dir, warm))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                await r.read()
+                assert r.status == 200
+                services = app[SERVICES_KEY]
+                services.renderer.exec_cache.drain(30.0)
+                return services.warmstate.snapshot_now()
+            finally:
+                await client.close()
+
+        path = asyncio.run(scenario())
+        doc = json.loads(open(path).read())
+        assert doc["version"] == 1
+        # The hot byte keys, the HBM plane coords + digests, and the
+        # compiled ladder all made the manifest.
+        assert any(doc["byte_keys"].values())
+        assert doc["planes"] and doc["planes"][0]["digest"]
+        assert doc["planes"][0]["key"][0] == IMG
+        assert doc["executables"]
+        assert doc["fingerprint"]
+        kinds = [e["kind"] for e in telemetry.FLIGHT.snapshot()]
+        assert "warmstate.snapshot" in kinds
+        assert "execcache.save" in kinds
+
+    def test_disabled_persistence_is_byte_identical_to_today(
+            self, data_dir):
+        """persistence.enabled false: no disk tier, no warm-state
+        threads, no /debug surface changes beyond enabled=false."""
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        from omero_ms_image_region_tpu.services.cache import CacheConfig
+        cfg = AppConfig(data_dir=data_dir,
+                        caches=CacheConfig.enabled_all())
+        cfg.renderer.cpu_fallback_max_px = 0
+        [(s1, _h1, b1), (s2, _h2, ws)] = _fetch(
+            cfg, ("GET", URL), ("GET", "/debug/warmstate"))
+        assert (s1, s2) == (200, 200)
+        doc = json.loads(ws.decode())
+        assert doc["enabled"] is False
+
+
+# -------------------------------------------------- telemetry contract
+
+class TestPersistenceTelemetry:
+    def test_families_pass_exposition_lint(self, data_dir, tmp_path):
+        from test_telemetry import _lint_exposition
+        cfg = _persist_config(data_dir, tmp_path / "warm")
+        [(s1, _, _), (s2, _, body)] = _fetch(
+            cfg, ("GET", URL), ("GET", "/metrics"))
+        assert (s1, s2) == (200, 200)
+        text = body.decode()
+        _lint_exposition(text)
+        assert "imageregion_diskcache_writes_total" in text
+        assert "imageregion_diskcache_corrupt_total" in text
+        assert "imageregion_warmstate_snapshot_age_seconds" in text
+        assert "imageregion_rehydrate_items_total" in text
+        assert "imageregion_execcache_hits" in text
+
+    def test_reset_clears_persist_accumulators(self):
+        telemetry.PERSIST.count_disk_write()
+        telemetry.PERSIST.count_disk_corrupt()
+        telemetry.PERSIST.count_snapshot(12.0)
+        telemetry.PERSIST.rehydrate_begin(3)
+        telemetry.PERSIST.rehydrate_step("byte", nbytes=100)
+        telemetry.reset()
+        assert telemetry.PERSIST.diskcache_writes == 0
+        assert telemetry.PERSIST.diskcache_corrupt == 0
+        assert telemetry.PERSIST.snapshots == 0
+        assert telemetry.PERSIST.rehydrate_items_total == 0
+        assert telemetry.PERSIST.rehydrate_bytes_promoted == 0
+        assert telemetry.PERSIST.rehydrate_summary() == "idle"
+
+    def test_rehydrate_summary_states(self):
+        assert telemetry.PERSIST.rehydrate_summary() == "idle"
+        telemetry.PERSIST.rehydrate_begin(2)
+        assert telemetry.PERSIST.rehydrate_summary() == "running 0/2"
+        telemetry.PERSIST.rehydrate_step("byte")
+        telemetry.PERSIST.rehydrate_step("plane")
+        telemetry.PERSIST.rehydrate_end(5.0)
+        assert telemetry.PERSIST.rehydrate_summary() == "done 2/2"
+        telemetry.PERSIST.rehydrate_begin(4)
+        telemetry.PERSIST.rehydrate_step("byte")
+        telemetry.PERSIST.rehydrate_end(5.0, aborted=True)
+        assert telemetry.PERSIST.rehydrate_summary() == "aborted 1/4"
+
+
+# ------------------------------------------------------ namespacing
+
+class TestNamespacedTier:
+    def test_named_caches_share_disk_without_collisions(self, tmp_path):
+        from omero_ms_image_region_tpu.services.cache import (
+            CacheConfig, Caches)
+        caches = Caches.from_config(CacheConfig.enabled_all(
+            disk_dir=str(tmp_path / "dc"), disk_sync_writes=True))
+
+        async def go():
+            await caches.image_region.set("k", b"image bytes")
+            await caches.shape_mask.set("k", b"mask bytes")
+            # Same short key, different namespaces: no collision.
+            assert await caches.image_region.get("k") == b"image bytes"
+            assert await caches.shape_mask.get("k") == b"mask bytes"
+            await caches.close()
+
+        asyncio.run(go())
+        assert sorted(caches.disk.keys_sync()) == ["img:k", "mask:k"]
+
+
+# ---------------------------------------------------- proxy surface
+
+class TestSidecarWarmstateOp:
+    def test_proxy_forwards_warmstate(self, data_dir, tmp_path):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.server.app import create_app
+        from omero_ms_image_region_tpu.server.config import (
+            AppConfig, SidecarConfig)
+        from omero_ms_image_region_tpu.server.sidecar import run_sidecar
+
+        sock = str(tmp_path / "w.sock")
+        sidecar_cfg = _persist_config(data_dir, tmp_path / "warm")
+
+        async def scenario():
+            task = asyncio.create_task(run_sidecar(sidecar_cfg, sock))
+            for _ in range(200):
+                if task.done():
+                    raise AssertionError(
+                        f"sidecar died: {task.exception()!r}")
+                if os.path.exists(sock):
+                    break
+                await asyncio.sleep(0.05)
+            app = create_app(AppConfig(
+                data_dir=data_dir,
+                sidecar=SidecarConfig(socket=sock, role="frontend")))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/debug/warmstate?snapshot=1")
+                doc = await r.json()
+                assert r.status == 200
+                assert doc["enabled"] is True
+                assert doc["snapshot_path"]
+                assert os.path.exists(doc["snapshot_path"])
+                # The readyz annotation rides the sidecar ping.
+                rz = await (await client.get("/readyz")).json()
+                assert "rehydrate" in rz["checks"]
+                return doc
+            finally:
+                await client.close()
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+        asyncio.run(scenario())
+
+
+# ----------------------------------------------------- config layer
+
+class TestPersistenceConfig:
+    def test_from_dict_parses_block(self):
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        cfg = AppConfig.from_dict({"persistence": {
+            "enabled": True, "dir": "/var/warm",
+            "disk-cache-max-bytes": 2 * 1024 * 1024,
+            "snapshot-interval-s": 30,
+            "rehydrate-concurrency": 4,
+            "executables": False}})
+        assert cfg.persistence.enabled is True
+        assert cfg.persistence.dir == "/var/warm"
+        assert cfg.persistence.disk_cache_max_bytes == 2 * 1024 * 1024
+        assert cfg.persistence.snapshot_interval_s == 30
+        assert cfg.persistence.rehydrate_concurrency == 4
+        assert cfg.persistence.executables is False
+
+    def test_defaults_off(self):
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        assert AppConfig.from_dict({}).persistence.enabled is False
+
+    @pytest.mark.parametrize("block", [
+        {"disk-cache-max-bytes": 1024},
+        {"snapshot-interval-s": -1},
+        {"rehydrate-concurrency": 0},
+        {"snapshot-top-k": 0},
+    ])
+    def test_invalid_values_fail_at_load(self, block):
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        with pytest.raises(ValueError):
+            AppConfig.from_dict({"persistence": block})

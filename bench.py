@@ -624,6 +624,79 @@ async def _hot_path_probes(app, client, url, seq, snap1, snap0,
     return extras
 
 
+def _overhead_table(n: int = 2000) -> dict:
+    """ns/op of each cross-cutting feature's HOT-PATH guard cost —
+    the per-request/per-tile tax of tracing, cost accounting, deadline
+    checks, admission control and the disk write-behind enqueue,
+    measured as tight micro-loops over the exact calls the serving
+    path makes.
+
+    This is the pay-for-what-you-use ledger for the feature layers
+    PRs 1-5 added: each entry must stay ns-to-µs scale (the smoke gate
+    asserts a budget in tests/test_bench_smoke.py), so a refactor that
+    quietly puts a lock round-trip, a directory scan or a JSON encode
+    on the hot path fails tier-1 instead of surfacing as the next
+    BENCH round's -10%.
+    """
+    import queue as _queue
+    import tempfile
+
+    from omero_ms_image_region_tpu.server.admission import (
+        AdmissionController)
+    from omero_ms_image_region_tpu.services.diskcache import (
+        DiskByteCache)
+    from omero_ms_image_region_tpu.utils import telemetry, transient
+    from omero_ms_image_region_tpu.utils.stopwatch import (
+        REGISTRY as _REG)
+
+    def per_op(fn) -> float:
+        fn()                                   # warm
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            fn()
+        return round((time.perf_counter_ns() - t0) / n, 1)
+
+    out = {}
+    with telemetry.trace_scope(telemetry.new_trace_id(),
+                               "bench.overhead"):
+        # One stage span landing on a live trace's waterfall (the
+        # stopwatch registry + histogram + trace attach).
+        out["trace"] = per_op(
+            lambda: _REG.record("bench.overhead", 0.01))
+        # One batched cost-ledger flush (two fields, one lock).
+        out["ledger"] = per_op(
+            lambda: telemetry.add_costs({"device_ms": 0.01,
+                                         "stage_ms": 0.01}))
+        with transient.deadline_scope(30000.0):
+            out["deadline"] = per_op(
+                lambda: transient.check_deadline("bench"))
+    adm = AdmissionController(max_queue=64)
+
+    def admit_release():
+        t = adm.admit()
+        adm.release(t)
+
+    out["admission"] = per_op(admit_release)
+    with tempfile.TemporaryDirectory() as tmp:
+        cache = DiskByteCache(tmp, max_bytes=1 << 20)
+
+        def write_behind():
+            # The request thread's share of a disk-cache set: enqueue
+            # onto the bounded queue (a full queue drops + counts —
+            # also the request thread's cost, never a block).
+            try:
+                cache._queue.put_nowait(("k", b"v"))
+            except _queue.Full:
+                telemetry.PERSIST.count_disk_write(dropped=True)
+            try:
+                cache._queue.get_nowait()
+            except _queue.Empty:
+                pass
+
+        out["write_behind"] = per_op(write_behind)
+    return out
+
+
 def bench_smoke(duration_s: float = 1.5):
     """Hot-path regression gate at smoke scale: CPU, small shapes, <60 s.
 
@@ -685,6 +758,11 @@ def bench_smoke(duration_s: float = 1.5):
         "planecache_hits": extras.get("planecache_hits"),
         "planecache_misses": extras.get("planecache_misses"),
         "cost_ledger_keys": cost_keys,
+        # Per-feature hot-path tax (ns/op): trace span record, cost
+        # ledger flush, deadline check, admission admit+release, disk
+        # write-behind enqueue.  Gated in tests/test_bench_smoke.py so
+        # the feature layers stay pay-for-what-you-use.
+        "overhead_ns_per_op": _overhead_table(),
         "elapsed_s": round(time.perf_counter() - t_start, 1),
     }
     print(json.dumps(out))

@@ -1,47 +1,48 @@
-"""Pallas TPU kernel for the fused tile render — EXPERIMENTAL, demoted
-off the serving path (round 3).
+"""Pallas TPU kernels for the fused tile render.
 
-Why demoted, with the on-chip evidence (v5e via tunnel, 2026-07-30):
+Two kernels, matching :mod:`..ops.render`'s own shape dispatch:
 
-* Trivial Mosaic kernels now compile and run on the real chip (the
-  earlier remote-compile breakage is gone), but THIS kernel's one-hot
-  MXU formulation needs a ``(bh, W) -> (bh*W, 1)`` flatten that Mosaic
-  rejects: ``infer-vector-layout: unsupported shape cast`` for
-  ``tpu.reshape (256x1024) -> (262144x1)``.  Parity therefore still
-  holds only in interpret mode (tests/test_pallas.py).
-* More decisively: stage profiling on the real chip shows the XLA
-  render+DCT+quant path costs ~3 ms per 8-tile 1024^2 batch — the
-  render is already fused and effectively free, with the JPEG wire
-  packers' compaction/deposit scatters dominating device time.  A
-  faster render kernel has no headroom to win; the serving path should
-  not carry a dead config option for it
-  (``Renderer.renderAsPackedInt``, ``ImageRegionRequestHandler
-  .java:559``, is fully served by ``ops.render``).
+* **Ramp kernel** (``tables`` = f32[C, 3] weights) — the serving-path
+  formulation, promoted in round 6 as a COMPILE-GUARDED option
+  (``renderer.kernel: pallas``; ``server.handler.Renderer`` falls back
+  to the XLA kernel on any compile/runtime failure, so the option can
+  only ever remove work).  ``pack_settings`` emits ramp weights
+  whenever no active channel resolves an actual LUT file — the
+  overwhelmingly common case — and the ramp composite is pure
+  elementwise arithmetic: window clamp, family curve, round, per-channel
+  multiply-accumulate, clip, u32 pack.  No gather, no one-hot, no
+  reshape — nothing in the Mosaic-unsupported layout classes.  This is
+  the same reformulation the XLA path itself made
+  (``ops.render.composite_ramp_packed``: arithmetic beats table gathers
+  ~9x on TPU), applied to the Pallas formulation: the round-3 blocker —
+  a ``(bh, W) -> (bh*W, 1)`` flatten Mosaic rejects
+  (``infer-vector-layout: unsupported shape cast``, minor dim cast to
+  1) — existed only to feed the one-hot MXU contraction, and the ramp
+  path needs neither.
 
-Kept as an experiment: the one-hot-as-MXU-contraction pattern and the
-SMEM scalar-prefetch layout are reusable if a VMEM-resident fusion ever
-becomes the bottleneck.
+* **One-hot LUT kernel** (``tables`` = f32[C, 256, 3]) — the original
+  round-3 experiment, kept for real-LUT renders and as the
+  one-hot-as-MXU-contraction reference:
 
-Alternative device path to ``ops.render``'s XLA-fused gather: the whole
-pipeline — per-channel window/family quantization, reverse-intensity, color
-table application, additive composite, u32 pack — runs in one pallas kernel
-per (batch, row-block) grid step, with the color lookup expressed as a
-**one-hot contraction on the MXU** instead of a gather:
+      onehot(q)[N, 256] @ table[256, 3]  ==  table[q]
 
-    onehot(q)[N, 256] @ table[256, 3]  ==  table[q]
+  Still EXPERIMENTAL on hardware: the pixel flatten feeding the MXU is
+  now expressed as a leading-dim collapse ``(bh, W, 256) ->
+  (bh*W, 256)`` (minor dim preserved — the shape-cast class Mosaic
+  supports) instead of the rejected minor-dim-1 cast, and the row block
+  is sized so the one-hot fits VMEM, but the final per-component
+  un-flatten remains a layout hazard; parity is proven in interpret
+  mode (tests/test_pallas.py) and the serving option never routes LUT
+  renders here.
 
-The VPU builds the one-hot by comparing q against a [256]-iota; the MXU
-contracts it with the channel's 256x3 table.  At 256 classes that is
-256x2 FLOPs per pixel-component — trivial against the MXU's throughput —
-and it avoids dynamic-index gathers, which TPUs have no vector unit for.
+Stage profiling on-chip (v5e via tunnel, 2026-07-30) shows the XLA
+render+DCT+quant path costs ~3 ms per 8-tile 1024^2 batch — the wire
+packers dominate device time — which is why the Pallas kernel lands as
+an option rather than the default: ``ops.render`` remains the portable
+reference, and the option exists for deployments where a VMEM-resident
+fusion measures faster.
 
-Everything stays in VMEM for a row block: raw f32[C, bh, W], tables
-f32[C*256, 3 padded], out u32[bh, W].  Settings are per-channel scalars
-prefetched to SMEM.
-
-Used when ``jax.default_backend() == "tpu"`` (interpret mode covers CPU
-tests); ``ops.render`` remains the portable reference path.  Replaces the
-same reference surface (``Renderer.renderAsPackedInt``,
+Replaces the same reference surface (``Renderer.renderAsPackedInt``,
 ``ImageRegionRequestHandler.java:559``).
 """
 
@@ -59,6 +60,10 @@ from ..ops.quantum import _ratio as _quantum_ratio
 # Row-block height per grid step; W is never blocked (tiles are <= 2048
 # wide and a full row keeps the lane dim dense).
 _BLOCK_H = 256
+# LUT (one-hot) kernel budget: the materialized one-hot is
+# f32[bh*W, 256] (1 KB per pixel), so the row block is capped to keep
+# it ~4 MB of VMEM.
+_ONEHOT_MAX_PIXELS = 4096
 
 
 def pick_block_h(H: int, max_block: int = _BLOCK_H) -> int:
@@ -76,9 +81,69 @@ def pick_block_h(H: int, max_block: int = _BLOCK_H) -> int:
     return bh
 
 
-def _render_kernel(ws_ref, we_ref, fam_ref, coef_ref, rev_ref, cd_ref,
-                   raw_ref, tables_ref, out_ref):
-    """One (batch, row-block) grid step.
+def _quantize_channel(x, ws, we, fam, k, cd_start, cd_end, rev):
+    """One channel's window clamp + family curve + reverse, in f32.
+
+    The exact closed forms the XLA kernel uses (ops.quantum._ratio),
+    evaluated on VMEM blocks, so the two paths agree bit-for-bit for
+    every family.
+    """
+    k_max = (cd_end - cd_start).astype(jnp.float32)
+    x_clamped = jnp.clip(x, jnp.minimum(ws, we), jnp.maximum(ws, we))
+    ratio = jnp.clip(
+        _quantum_ratio(x_clamped, x, ws, we, fam, k), 0.0, 1.0)
+    q = jnp.round(cd_start.astype(jnp.float32) + k_max * ratio)
+    q = jnp.where(rev != 0,
+                  (cd_start + cd_end).astype(jnp.float32) - q, q)
+    return jnp.clip(q, 0.0, 255.0)
+
+
+def _pack_u32(acc_r, acc_g, acc_b):
+    """Clip/round the composites and pack to the u32 RGBA layout.
+
+    Mosaic has no direct f32->u32 cast; go through i32 (values <= 255).
+    """
+    r = jnp.clip(jnp.round(acc_r), 0.0, 255.0).astype(jnp.int32)
+    g = jnp.clip(jnp.round(acc_g), 0.0, 255.0).astype(jnp.int32)
+    b = jnp.clip(jnp.round(acc_b), 0.0, 255.0).astype(jnp.int32)
+    packed = r | (g << 8) | (b << 16) | jnp.int32(-0x1000000)  # A=0xFF
+    return jax.lax.bitcast_convert_type(packed, jnp.uint32)
+
+
+def _render_kernel_ramp(ws_ref, we_ref, fam_ref, coef_ref, rev_ref,
+                        cd_ref, w_ref, raw_ref, out_ref):
+    """One (batch, row-block) grid step of the RAMP composite.
+
+    raw_ref: f32[C, bh, W] (VMEM; already loaded block)
+    out_ref: u32[1, bh, W] (VMEM ref; leading block dim)
+    scalars (SMEM, prefetched): ws/we/coef f32[C], fam/rev i32[C],
+    cd i32[2], w f32[C*3] flattened ramp weights.
+
+    Entirely elementwise — the serving formulation with no layout
+    hazards (see module docstring).
+    """
+    C, bh, W = raw_ref.shape
+    cd_start = cd_ref[0]
+    cd_end = cd_ref[1]
+
+    acc_r = jnp.zeros((bh, W), jnp.float32)
+    acc_g = jnp.zeros((bh, W), jnp.float32)
+    acc_b = jnp.zeros((bh, W), jnp.float32)
+
+    for c in range(C):  # C is a static block dim: unrolled at trace time
+        q = _quantize_channel(raw_ref[c], ws_ref[c], we_ref[c],
+                              fam_ref[c], coef_ref[c], cd_start,
+                              cd_end, rev_ref[c])
+        acc_r += q * w_ref[3 * c]
+        acc_g += q * w_ref[3 * c + 1]
+        acc_b += q * w_ref[3 * c + 2]
+
+    out_ref[0] = _pack_u32(acc_r, acc_g, acc_b)
+
+
+def _render_kernel_lut(ws_ref, we_ref, fam_ref, coef_ref, rev_ref,
+                       cd_ref, raw_ref, tables_ref, out_ref):
+    """One (batch, row-block) grid step of the one-hot LUT composite.
 
     raw_ref:    f32[C, bh, W]       (VMEM; already loaded block)
     tables_ref: f32[C, 256, 128]    (VMEM; only cols 0..2 are live)
@@ -88,48 +153,33 @@ def _render_kernel(ws_ref, we_ref, fam_ref, coef_ref, rev_ref, cd_ref,
     C, bh, W = raw_ref.shape
     cd_start = cd_ref[0]
     cd_end = cd_ref[1]
-    k_max = (cd_end - cd_start).astype(jnp.float32)
 
     acc_r = jnp.zeros((bh, W), jnp.float32)
     acc_g = jnp.zeros((bh, W), jnp.float32)
     acc_b = jnp.zeros((bh, W), jnp.float32)
 
-    for c in range(C):  # C is a static block dim: unrolled at trace time
-        x = raw_ref[c]
-        ws = ws_ref[c]
-        we = we_ref[c]
-        fam = fam_ref[c]
-        k = coef_ref[c]
-
-        # Window clamp + family curve: the exact closed forms the XLA
-        # kernel uses (ops.quantum._ratio), evaluated on VMEM blocks, so
-        # the two paths agree bit-for-bit for every family.
-        x_clamped = jnp.clip(x, jnp.minimum(ws, we), jnp.maximum(ws, we))
-        ratio = jnp.clip(
-            _quantum_ratio(x_clamped, x, ws, we, fam, k), 0.0, 1.0)
-        q = jnp.round(cd_start.astype(jnp.float32) + k_max * ratio)
-        # Reverse-intensity codomain op.
-        q = jnp.where(rev_ref[c] != 0,
-                      (cd_start + cd_end).astype(jnp.float32) - q, q)
-        q = jnp.clip(q, 0.0, 255.0)
-
+    for c in range(C):
+        q = _quantize_channel(raw_ref[c], ws_ref[c], we_ref[c],
+                              fam_ref[c], coef_ref[c], cd_start,
+                              cd_end, rev_ref[c])
         # One-hot contraction on the MXU: [bh*W, 256] @ [256, 128].
+        # The one-hot is built 3-D with the class axis MINOR and the
+        # pixel flatten expressed as a leading-dim collapse (minor dim
+        # preserved) — the shape-cast class Mosaic supports, unlike the
+        # round-3 (bh, W) -> (bh*W, 1) minor-dim cast it rejected.
         # (Integer compare: Mosaic rejects float iota.)
-        qi = q.astype(jnp.int32).reshape(bh * W, 1)
-        classes = jax.lax.broadcasted_iota(jnp.int32, (1, 256), 1)
-        onehot = (qi == classes).astype(jnp.float32)
+        qi = q.astype(jnp.int32)
+        classes = jax.lax.broadcasted_iota(jnp.int32, (bh, W, 256), 2)
+        qb = jax.lax.broadcast_in_dim(qi, (bh, W, 256), (0, 1))
+        onehot = (qb == classes).astype(jnp.float32).reshape(
+            bh * W, 256)
         rgb = jnp.dot(onehot, tables_ref[c],
                       preferred_element_type=jnp.float32)
         acc_r += rgb[:, 0].reshape(bh, W)
         acc_g += rgb[:, 1].reshape(bh, W)
         acc_b += rgb[:, 2].reshape(bh, W)
 
-    # Mosaic has no direct f32->u32 cast; go through i32 (values <= 255).
-    r = jnp.clip(jnp.round(acc_r), 0.0, 255.0).astype(jnp.int32)
-    g = jnp.clip(jnp.round(acc_g), 0.0, 255.0).astype(jnp.int32)
-    b = jnp.clip(jnp.round(acc_b), 0.0, 255.0).astype(jnp.int32)
-    packed = r | (g << 8) | (b << 16) | jnp.int32(-0x1000000)  # A=0xFF
-    out_ref[0] = jax.lax.bitcast_convert_type(packed, jnp.uint32)
+    out_ref[0] = _pack_u32(acc_r, acc_g, acc_b)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -139,21 +189,55 @@ def render_tile_batch_packed_pallas(raw, window_start, window_end, family,
     """Pallas fused batched render: f32[B, C, H, W] -> u32[B, H, W].
 
     Same contract as ``ops.render.render_tile_batch_packed`` except the
-    per-channel settings are shared across the batch (the batcher keys
-    groups by settings when using this path), so they arrive unbatched:
-    window_start/window_end/coefficient f32[C], family/reverse i32[C],
-    tables f32[C, 256, 3].
+    per-channel settings are shared across the batch (the direct
+    renderer's case; the batcher keys groups by settings when using
+    this path), so they arrive unbatched: window_start/window_end/
+    coefficient f32[C], family/reverse i32[C], and ``tables`` either
+    f32[C, 3] ramp weights (the serving ramp kernel) or f32[C, 256, 3]
+    LUT tables (the experimental one-hot kernel) — the same shape
+    dispatch as ``ops.render._render_packed_impl``.
     """
     B, C, H, W = raw.shape
-    bh = pick_block_h(H)
+    cd = jnp.stack([jnp.asarray(cd_start, jnp.int32),
+                    jnp.asarray(cd_end, jnp.int32)])
+    scalars = (window_start.astype(jnp.float32),
+               window_end.astype(jnp.float32),
+               family.astype(jnp.int32),
+               coefficient.astype(jnp.float32),
+               reverse.astype(jnp.int32), cd)
 
-    # Pad table color axis 3 -> 128 so the MXU contraction output is
-    # lane-aligned; dead columns contract to zeros.
+    if tables.ndim == 2:
+        # Ramp weights [C, 3]: the elementwise serving kernel.  The
+        # weights ride SMEM with the other per-channel scalars.
+        bh = pick_block_h(H)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=7,
+            grid=(B, H // bh),
+            in_specs=[
+                pl.BlockSpec((1, C, bh, W), lambda b, h, *_: (b, 0, h, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, bh, W), lambda b, h, *_: (b, h, 0)),
+        )
+
+        def kernel(ws, we, fam, coef, rev, cdv, w, raw_blk, out_blk):
+            _render_kernel_ramp(ws, we, fam, coef, rev, cdv, w,
+                                raw_blk[0], out_blk)
+
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, H, W), jnp.uint32),
+            interpret=interpret,
+        )(*scalars, tables.astype(jnp.float32).reshape(C * 3),
+          raw.astype(jnp.float32))
+
+    # LUT tables [C, 256, 3]: pad the color axis 3 -> 128 so the MXU
+    # contraction output is lane-aligned; dead columns contract to
+    # zeros.  Row block capped so the materialized one-hot fits VMEM.
+    bh = pick_block_h(H, max_block=max(1, _ONEHOT_MAX_PIXELS // W))
     tables_padded = jnp.zeros((C, 256, 128), jnp.float32)
     tables_padded = tables_padded.at[:, :, :3].set(
         tables.astype(jnp.float32))
-    cd = jnp.stack([jnp.asarray(cd_start, jnp.int32),
-                    jnp.asarray(cd_end, jnp.int32)])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
@@ -166,15 +250,22 @@ def render_tile_batch_packed_pallas(raw, window_start, window_end, family,
     )
 
     def kernel(ws, we, fam, coef, rev, cdv, raw_blk, tab_blk, out_blk):
-        _render_kernel(ws, we, fam, coef, rev, cdv,
-                       raw_blk[0], tab_blk, out_blk)
+        _render_kernel_lut(ws, we, fam, coef, rev, cdv,
+                           raw_blk[0], tab_blk, out_blk)
 
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, W), jnp.uint32),
         interpret=interpret,
-    )(window_start.astype(jnp.float32), window_end.astype(jnp.float32),
-      family.astype(jnp.int32), coefficient.astype(jnp.float32),
-      reverse.astype(jnp.int32), cd,
-      raw.astype(jnp.float32), tables_padded)
+    )(*scalars, raw.astype(jnp.float32), tables_padded)
+
+
+def render_tile_packed_pallas(raw, window_start, window_end, family,
+                              coefficient, reverse, cd_start, cd_end,
+                              tables, *, interpret=False):
+    """Single-tile convenience: f32[C, H, W] -> u32[H, W] (the direct
+    renderer's call shape)."""
+    return render_tile_batch_packed_pallas(
+        raw[None], window_start, window_end, family, coefficient,
+        reverse, cd_start, cd_end, tables, interpret=interpret)[0]

@@ -414,17 +414,27 @@ def _compact_rows(bufs, lengths):
     pads rows cost full freight.  Compacted, prediction tracks the SUM
     of row sizes (far lower relative variance), pad rows cost zero, and
     a group's wire bytes equal its entropy bytes.
+
+    Formulated as ONE unique-index set-scatter (source byte (b, i)
+    lands at ``cum[b] + i``; bytes past a row's length route out of
+    bounds and drop): row ranges partition the output and offsets
+    within a row are distinct, so XLA lowers it to plain stores.  The
+    previous formulation ran backwards — per OUTPUT byte, a
+    searchsorted over the row bounds plus a random-access 2-D gather —
+    and that B*width-element gather dominated the packers' device
+    profile (gathers serialize per element on TPU; unique-index stores
+    do not).
     """
     B, width = bufs.shape
+    lengths = lengths.astype(jnp.int32)
     cum = jnp.concatenate([jnp.zeros(1, jnp.int32),
-                           jnp.cumsum(lengths.astype(jnp.int32))])
-    pos = jnp.arange(B * width, dtype=jnp.int32)
-    row = jnp.clip(jnp.searchsorted(cum, pos, side="right") - 1, 0, B - 1)
-    col = pos - cum[row]
-    data = jnp.where(
-        pos < cum[B],
-        bufs[row, jnp.clip(col, 0, width - 1)],
-        jnp.uint8(0))
+                           jnp.cumsum(lengths)])
+    col = jnp.arange(width, dtype=jnp.int32)
+    tgt = jnp.where(col[None, :] < lengths[:, None],
+                    cum[:-1, None] + col[None, :],
+                    jnp.int32(1) << 30)
+    data = jnp.zeros(B * width, jnp.uint8).at[tgt.reshape(-1)].set(
+        bufs.reshape(-1), mode="drop", unique_indices=True)
     header = jax.lax.bitcast_convert_type(
         cum[1:] - cum[:-1], jnp.uint8).reshape(-1)
     return jnp.concatenate([header, data])
@@ -902,33 +912,34 @@ def _bitpack_fixed(blocks, scan_idx, dc_code, dc_len, ac_code, ac_len,
     f2_start = f1_start + f1_len
     eob_start = block_start + dc_f_len + block_ac_bits
 
+    # ONE coalesced deposit pass over every field stream (non-unique
+    # scatter-adds serialize on TPU, so the five per-field passes —
+    # ten scatters — collapse to two): disjoint-bit adds commute, so
+    # the packed stream is bit-identical to the per-field form.
+    val = jnp.concatenate([a.reshape(-1) for a in (
+        dc_f_val, f0_val, f1_val, f2_val, eob_val)])
+    length = jnp.concatenate([a.reshape(-1) for a in (
+        dc_f_len, f0_len, f1_len, f2_len, eob_len)])
+    start = jnp.concatenate([a.reshape(-1) for a in (
+        dc_start, f0_start, f1_start, f2_start, eob_start)])
     words = jnp.zeros(cap_words, jnp.int32)
-    for val, length, start in (
-        (dc_f_val, dc_f_len, dc_start),
-        (f0_val, f0_len, f0_start),
-        (f1_val, f1_len, f1_start),
-        (f2_val, f2_len, f2_start),
-        (eob_val, eob_len, eob_start),
-    ):
-        val, length, start = (val.reshape(-1), length.reshape(-1),
-                              start.reshape(-1))
-        w = start >> 5
-        r = start & 31
-        sh0 = 32 - r - length                      # in [-30, 32]
-        # Field values never set bit 31, so arithmetic >> == logical >>.
-        c0 = jnp.where(
-            sh0 >= 0,
-            jnp.left_shift(val, jnp.minimum(sh0, 31)),
-            jnp.right_shift(val, jnp.minimum(-sh0, 31)),
-        )
-        sh1 = 64 - r - length                      # in [2, 64]
-        c1 = jnp.where(
-            sh1 < 32, jnp.left_shift(val, jnp.maximum(sh1, 0) & 31), 0)
-        live = length > 0
-        c0 = jnp.where(live, c0, 0)
-        c1 = jnp.where(live, c1, 0)
-        words = words.at[w].add(c0, mode="drop")
-        words = words.at[w + 1].add(c1, mode="drop")
+    w = start >> 5
+    r = start & 31
+    sh0 = 32 - r - length                      # in [-30, 32]
+    # Field values never set bit 31, so arithmetic >> == logical >>.
+    c0 = jnp.where(
+        sh0 >= 0,
+        jnp.left_shift(val, jnp.minimum(sh0, 31)),
+        jnp.right_shift(val, jnp.minimum(-sh0, 31)),
+    )
+    sh1 = 64 - r - length                      # in [2, 64]
+    c1 = jnp.where(
+        sh1 < 32, jnp.left_shift(val, jnp.maximum(sh1, 0) & 31), 0)
+    live = length > 0
+    c0 = jnp.where(live, c0, 0)
+    c1 = jnp.where(live, c1, 0)
+    words = words.at[w].add(c0, mode="drop")
+    words = words.at[w + 1].add(c1, mode="drop")
     return (jax.lax.bitcast_convert_type(words, jnp.uint32),
             total_bits.astype(jnp.int32))
 
@@ -992,9 +1003,11 @@ def huffman_pack(y, cb, cr, cap: int, cap_words: int,
     Here all per-entry work runs
     on the ``cap``-sized COMPACTED stream (one unique-index set-scatter,
     the same trick as ``sparse_pack``), and the bit deposits touch
-    ~1.3M update slots/tile: three AC sub-fields (main code+amplitude,
-    plus up to three folded ZRL codes split 1+2) over ``cap`` and two
-    dense per-block fields (DC diff, EOB) over ``nb``.
+    ~1.3M update slots/tile across TWO coalesced scatter passes: the
+    dense per-block fields (DC diff + EOB, over ``2*nb``) ride one and
+    the per-entry fields (folded ZRLs + main code+amplitude, over
+    ``2*cap``) the other — non-unique scatter-adds serialize on TPU,
+    so halving the pass count matters as much as the slot count.
 
     Per tile the output is ``[total_entries i32 | total_bits i32 |
     stream words u32[cap_words]]`` as LE bytes; the used prefix is
@@ -1166,10 +1179,20 @@ def huffman_pack(y, cb, cr, cap: int, cap_words: int,
 
     def pack_one(dcv, dcl, bst, bac, ev_, el_, zv, zlen, mv, ml, est):
         words = jnp.zeros(cap_words + 1, jnp.int32)
-        words = deposit(words, dcv, dcl, bst)
-        words = deposit(words, ev_, el_, bst + dcl + bac)
-        words = deposit(words, zv, zlen, est)
-        words = deposit(words, mv, ml, est + zlen)
+        # Coalesced deposits: the two dense per-block fields (DC diff,
+        # EOB) ride one scatter pass and the two per-entry fields
+        # (folded ZRLs, main code+amplitude) ride another — 2 deposit
+        # passes (4 scatter-adds) instead of 4 (8).  Scatter-adds over
+        # disjoint bits commute, so the stream is bit-identical; the
+        # win is fewer serialized non-unique scatter ops per tile.
+        words = deposit(words,
+                        jnp.concatenate([dcv, ev_]),
+                        jnp.concatenate([dcl, el_]),
+                        jnp.concatenate([bst, bst + dcl + bac]))
+        words = deposit(words,
+                        jnp.concatenate([zv, mv]),
+                        jnp.concatenate([zlen, ml]),
+                        jnp.concatenate([est, est + zlen]))
         return words[:cap_words]
 
     words = jax.vmap(pack_one)(

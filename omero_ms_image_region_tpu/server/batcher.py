@@ -614,12 +614,13 @@ class BatchingRenderer:
         # Cost ledger, pro-rata: the group's one stack+upload spread
         # over its members (runs under group_trace, so each member's
         # ledger receives its share).  Device-resident stacks staged
-        # zero host->HBM bytes.
+        # zero host->HBM bytes.  One batched flush per group — not a
+        # lock round-trip per field per member.
         n = max(1, len(group))
-        telemetry.add_cost(
-            "stage_ms", (time.perf_counter() - t0) * 1000.0 / n)
+        fields = {"stage_ms": (time.perf_counter() - t0) * 1000.0 / n}
         if staged_bytes:
-            telemetry.add_cost("staged_bytes", staged_bytes / n)
+            fields["staged_bytes"] = staged_bytes / n
+        telemetry.add_costs(fields)
         return raw, stack
 
     def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:

@@ -93,11 +93,14 @@ class RendererConfig:
     # per program shape on tunnel-attached chips; measured 11 s -> 1.5 s
     # cross-process).  None disables.
     compilation_cache_dir: Optional[str] = None
-    # Render kernel for the direct (unbatched) renderer.  Only "xla":
-    # the pallas one-hot-MXU kernel was demoted to
-    # experimental/pallas_render.py (Mosaic layout limitation on chip;
-    # and the XLA render is ~free — the wire packers dominate device
-    # time), so the serving path carries no dead option.
+    # Render kernel for the direct (unbatched) renderer: "xla" (the
+    # portable reference, ops.render) or "pallas" — the experimental
+    # VMEM-resident fused kernel as a COMPILE-GUARDED option: it serves
+    # only ramp-weight renders (no LUT files) on a real TPU backend,
+    # and ANY compile/runtime failure falls back permanently to the XLA
+    # kernel, so the option can only ever remove work.  Stage profiling
+    # shows the XLA render is already ~free (the wire packers dominate
+    # device time), so "xla" stays the default.
     kernel: str = "xla"
     # Tile shapes ("<channels>x<tile-edge>[@quality][:dtype]", e.g.
     # "4x1024" or "3x1024:uint8" — :dtype is the images' storage dtype,
@@ -705,9 +708,8 @@ class AppConfig:
                 "renderer.jpeg-engine 'bitpack' is only supported by "
                 "the direct (unbatched) renderer; with batcher.enabled "
                 "or parallel.enabled use 'sparse', 'huffman' or 'auto'")
-        if cfg.renderer.kernel != "xla":
+        if cfg.renderer.kernel not in ("xla", "pallas"):
             raise ValueError(
-                f"renderer.kernel must be 'xla' (the experimental "
-                f"pallas kernel is not a serving option), "
+                f"renderer.kernel must be 'xla' or 'pallas', "
                 f"got {cfg.renderer.kernel!r}")
         return cfg

@@ -81,17 +81,20 @@ class Renderer:
                  kernel: str = "xla"):
         if jpeg_engine not in ("sparse", "huffman", "bitpack"):
             raise ValueError(f"unknown jpeg engine {jpeg_engine!r}")
-        if kernel != "xla":
-            # The pallas render kernel was demoted to
-            # experimental/pallas_render.py: on-chip it hits a Mosaic
-            # layout limitation, and stage profiling shows the XLA
-            # render is already ~free (the wire packers dominate), so
-            # the serving path carries no dead option.
+        if kernel not in ("xla", "pallas"):
             raise ValueError(
-                f"unknown render kernel {kernel!r} (only 'xla'; the "
-                f"experimental pallas kernel is not a serving option)")
+                f"unknown render kernel {kernel!r} ('xla' or 'pallas')")
         self.jpeg_engine = jpeg_engine
         self.kernel = kernel
+        # Compile guard for the pallas option: flips False forever on
+        # the first compile/runtime failure (Mosaic layout limits vary
+        # by backend generation), so the option can only remove work —
+        # never fail a request the XLA kernel would have served.
+        self._pallas_ok = kernel == "pallas"
+        # Test hook: force interpret-mode pallas off-TPU (real serving
+        # only routes to pallas on a tpu backend — interpret mode is a
+        # correctness harness, not a fast path).
+        self._pallas_interpret = False
         import threading
         from collections import OrderedDict
         self._bitpack_encoders: "OrderedDict" = OrderedDict()
@@ -104,7 +107,40 @@ class Renderer:
         """f32[C, H, W] + packed settings -> u32[H, W] packed RGBA."""
         return await asyncio.to_thread(self._render_sync, raw, settings)
 
+    def _pallas_eligible(self, settings: dict) -> bool:
+        """Route to the pallas kernel?  Ramp-weight renders only (LUT
+        tables keep the XLA gather path — the one-hot formulation is
+        still experimental on hardware), and only on a real TPU backend
+        unless the interpret test hook is set."""
+        if not self._pallas_ok or settings["tables"].ndim != 2:
+            return False
+        if self._pallas_interpret:
+            return True
+        import jax
+        return jax.default_backend() == "tpu"
+
     def _render_sync(self, raw: np.ndarray, settings: dict) -> np.ndarray:
+        if self._pallas_eligible(settings):
+            try:
+                from ..experimental.pallas_render import (
+                    render_tile_packed_pallas)
+                out = render_tile_packed_pallas(
+                    raw, settings["window_start"],
+                    settings["window_end"], settings["family"],
+                    settings["coefficient"], settings["reverse"],
+                    settings["cd_start"], settings["cd_end"],
+                    settings["tables"],
+                    interpret=self._pallas_interpret)
+                return np.asarray(out)
+            except Exception:
+                # Mosaic rejected the kernel (or it failed at runtime):
+                # disable the option for the process life and serve
+                # this and every later render on the XLA path.
+                self._pallas_ok = False
+                logger.warning(
+                    "pallas render kernel failed; falling back to the "
+                    "XLA kernel for the rest of this process",
+                    exc_info=True)
         out = render_tile_packed(
             raw, settings["window_start"], settings["window_end"],
             settings["family"], settings["coefficient"],

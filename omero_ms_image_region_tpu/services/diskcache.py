@@ -106,6 +106,11 @@ class DiskByteCache:
 
     SHARD_CHARS = 2          # 256 shard dirs
     QUEUE_DEPTH = 256        # pending write-behind entries
+    # Gauge-publish coalescing: the write-behind worker publishes the
+    # size gauges at most this often (plus once when its queue drains),
+    # instead of taking the telemetry lock on every write — measured
+    # contention against request threads flushing their own counters.
+    PUBLISH_INTERVAL_S = 0.5
 
     def __init__(self, directory: str,
                  max_bytes: int = 1024 * 1024 * 1024,
@@ -122,6 +127,7 @@ class DiskByteCache:
         self._bytes = 0
         self._entries = 0
         self._scanned = False
+        self._last_publish = 0.0
         self._queue: "queue.Queue[Optional[Tuple[str, bytes]]]" = \
             queue.Queue(maxsize=self.QUEUE_DEPTH)
         self._worker: Optional[threading.Thread] = None
@@ -173,8 +179,14 @@ class DiskByteCache:
             self._scanned = True
         self._scan_size()
 
-    def _publish_size(self) -> None:
+    def _publish_size(self, force: bool = False) -> None:
+        import time as _time
+        now = _time.monotonic()
         with self._size_lock:
+            if not force and (now - self._last_publish
+                              < self.PUBLISH_INTERVAL_S):
+                return
+            self._last_publish = now
             telemetry.PERSIST.set_disk_size(self._bytes, self._entries)
 
     @property
@@ -324,6 +336,10 @@ class DiskByteCache:
             key, value = item
             try:
                 self.set_sync(key, value)
+                if self._queue.empty():
+                    # Burst drained: land the coalesced gauges now
+                    # rather than waiting out the publish interval.
+                    self._publish_size(force=True)
             except Exception:
                 # set_sync already degrades on OSError; this catches
                 # anything else so the worker thread never dies and

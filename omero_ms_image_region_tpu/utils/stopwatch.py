@@ -29,16 +29,19 @@ log = logging.getLogger("omero_ms_image_region_tpu.perf")
 
 
 class SpanStats:
-    __slots__ = ("count", "total_ms", "hist")
+    __slots__ = ("count", "total_ms", "max_ms", "hist")
 
     def __init__(self):
         self.count = 0
         self.total_ms = 0.0
+        self.max_ms = 0.0
         self.hist = Histogram()
 
     def add(self, ms: float) -> None:
         self.count += 1
         self.total_ms += ms
+        if ms > self.max_ms:
+            self.max_ms = ms
         self.hist.add(ms)
 
     def as_dict(self) -> dict:
@@ -50,6 +53,13 @@ class SpanStats:
             # Bucket-resolution estimate (upper bucket bound), kept for
             # the profiling scripts that read the old ring p50.
             "p50_ms": round(self.hist.quantile(0.5), 3),
+            # Tail breakdown: BENCH_r05's batcher.queueWait showed mean
+            # 2276 ms against p50 2.2 ms — a heavy tail a mean conflates
+            # and a p50 cannot see.  p95/p99 are bucket-resolution
+            # estimates like p50; max is exact.
+            "p95_ms": round(self.hist.quantile(0.95), 3),
+            "p99_ms": round(self.hist.quantile(0.99), 3),
+            "max_ms": round(self.max_ms, 3),
         }
 
 

@@ -39,8 +39,9 @@ import os
 import threading
 import time
 import uuid
+from bisect import bisect_left
 from contextlib import contextmanager
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Mapping, Optional, Tuple
 
 log = logging.getLogger("omero_ms_image_region_tpu.telemetry")
 
@@ -73,11 +74,10 @@ class Histogram:
     def add(self, value: float) -> None:
         self.sum += value
         self.count += 1
-        for i, b in enumerate(self.bounds):
-            if value <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+        # bisect, not a linear bucket scan: add() sits on the span hot
+        # path (every stage of every request lands here), and the scan
+        # walked up to 18 bounds per observation.
+        self.counts[bisect_left(self.bounds, value)] += 1
 
     def cumulative(self) -> List[int]:
         out, acc = [], 0
@@ -186,6 +186,15 @@ class Trace:
         with self.lock:
             self.costs[key] = self.costs.get(key, 0.0) + float(value)
 
+    def add_costs(self, items: Mapping[str, float]) -> None:
+        """Batched ledger update: one lock acquisition for the whole
+        mapping (the batcher flushes several fields per group; a lock
+        round-trip per field was pure hot-path tax)."""
+        with self.lock:
+            costs = self.costs
+            for key, value in items.items():
+                costs[key] = costs.get(key, 0.0) + float(value)
+
     def export_costs(self) -> Dict[str, float]:
         """Wire-safe copy of the ledger (the sidecar response carries
         it so device-side costs land on the frontend's ledger)."""
@@ -199,32 +208,34 @@ class Trace:
                 "dur_ms": round(dur_ms, 3)}
         if meta:
             span.update(meta)
-        with self.lock:
-            self.spans.append(span)
+        # Lock-free: list.append is atomic under the GIL, and every
+        # reader below snapshots via list(self.spans) (also atomic)
+        # before iterating — spans are recorded on the request path,
+        # so the per-span lock round-trip was the single hottest
+        # telemetry cost in the PR 4/5 profile.
+        self.spans.append(span)
 
     def export_spans(self) -> List[dict]:
         """Copied span list (wire-safe: plain JSON dicts whose
         ``start_ms`` offsets are relative to this trace's t0)."""
-        with self.lock:
-            return [dict(s) for s in self.spans]
+        return [dict(s) for s in list(self.spans)]
 
     def span_ms(self, *names: str) -> Optional[float]:
         """Total duration of spans with one of the EXACT ``names``
         (None when the request never touched those stages).  Exact, not
         prefix: "Renderer.renderAsPackedInt" must not also sum its
         nested ".batch" child or totals exceed the request wall time."""
-        with self.lock:
-            total, seen = 0.0, False
-            for s in self.spans:
-                if s["name"] in names:
-                    total += s["dur_ms"]
-                    seen = True
+        total, seen = 0.0, False
+        for s in list(self.spans):
+            if s["name"] in names:
+                total += s["dur_ms"]
+                seen = True
         return total if seen else None
 
     def to_json(self, total_ms: Optional[float] = None,
                 status: Optional[int] = None) -> dict:
+        spans = sorted(list(self.spans), key=lambda s: s["start_ms"])
         with self.lock:
-            spans = sorted(self.spans, key=lambda s: s["start_ms"])
             costs = dict(self.costs)
         doc = {"trace_id": self.trace_id, "route": self.route,
                "ts": self.wall_ts, "spans": spans}
@@ -262,6 +273,14 @@ class TraceRegistry:
         return trace
 
     def get_or_create(self, trace_id: str) -> Trace:
+        # Lock-free fast path: dict.get is GIL-atomic, and this lookup
+        # runs once per span per trace (the hottest telemetry call in
+        # the serving profile) — only the create takes the lock.  A
+        # concurrent eviction racing the get just falls through to the
+        # locked path.
+        trace = self._active.get(trace_id)
+        if trace is not None:
+            return trace
         with self._lock:
             trace = self._active.get(trace_id)
             if trace is None:
@@ -391,6 +410,18 @@ def add_cost(key: str, value: float,
     ids = trace_ids if trace_ids is not None else _TRACE_IDS.get()
     for tid in ids:
         TRACES.get_or_create(tid).add_cost(key, value)
+
+
+def add_costs(items: Mapping[str, float],
+              trace_ids: Optional[Tuple[str, ...]] = None) -> None:
+    """Batched :func:`add_cost`: the whole mapping lands under ONE lock
+    per trace (pay-for-what-you-use: a group render flushes its ledger
+    fields in one shot instead of a lock round-trip per field)."""
+    ids = trace_ids if trace_ids is not None else _TRACE_IDS.get()
+    if not ids or not items:
+        return
+    for tid in ids:
+        TRACES.get_or_create(tid).add_costs(items)
 
 
 def merge_costs(trace_id: str, costs: Dict[str, float]) -> None:

@@ -1,25 +1,40 @@
 #!/usr/bin/env python
-"""Bench regression gate: diff a pair of BENCH_r*.json records and
-exit non-zero on a service-rate regression, so the round-over-round
+"""Bench regression gate: judge BENCH_r*.json records and exit
+non-zero on a service-rate regression, so the round-over-round
 trajectory becomes a GATE instead of a log entry someone may read.
+
+Two modes:
+
+* **Pairwise** (default) — diff the newest record against the previous
+  one.  Catches step regressions, but a -10% drift per round compounds
+  to -37% over four rounds without ever tripping a pairwise gate —
+  which is exactly what BENCH_r02 -> r05 did (41 -> 26 tiles/s).
+* **Watermark** (``--watermark``) — gate the newest record against the
+  BEST value each key ever recorded across every earlier
+  ``BENCH_r*.json`` (max for throughput keys, min for ``_ms`` latency
+  keys).  Slow-burn regressions cannot hide: the gate re-anchors to
+  the best round, not the latest.
 
 Usage::
 
     python scripts/bench_gate.py BENCH_r04.json BENCH_r05.json
-    python scripts/bench_gate.py --dir .          # newest pair by name
+    python scripts/bench_gate.py --dir .              # newest pair
+    python scripts/bench_gate.py --watermark --dir .  # newest vs best
     python scripts/bench_gate.py --key service_tiles_per_sec \
         --max-regression 0.10 old.json new.json
 
 Exit codes: 0 pass (or nothing to judge — see --strict), 1 regression
 over the threshold, 2 usage/input error.
 
-The default keys are the full-HTTP-stack service rate AND its p50
-latency ex-RTT (latency regressions must not hide behind a flat
-throughput headline; ``_ms`` keys are judged in the opposite
-direction — up is the regression).  Tunnel weather can null either
-out for a round, so an absent/None value SKIPS that key's gate (with
-a printed verdict) rather than failing the build — ``--strict`` turns
-skips into failures for CI postures that must always measure.
+The default keys are the full-HTTP-stack service rate, its p50 latency
+ex-RTT (latency regressions must not hide behind a flat throughput
+headline; ``_ms`` keys are judged in the opposite direction — up is
+the regression) AND the raw host->HBM upload rate (the r01 -> r05
+524 -> 4.8 MB/s collapse shipped in pieces no pairwise service-rate
+gate could see).  Tunnel weather can null any of them out for a round,
+so an absent/None value SKIPS that key's gate (with a printed verdict)
+rather than failing the build — ``--strict`` turns skips into failures
+for CI postures that must always measure.
 """
 
 from __future__ import annotations
@@ -30,7 +45,8 @@ import os
 import re
 import sys
 
-DEFAULT_KEYS = ("service_tiles_per_sec", "p50_service_tile_ms_ex_rtt")
+DEFAULT_KEYS = ("service_tiles_per_sec", "p50_service_tile_ms_ex_rtt",
+                "raw_upload_mb_per_sec")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 
@@ -43,7 +59,9 @@ def lower_is_better(key: str) -> bool:
 
 def load_record(path: str) -> dict:
     """One bench record: a JSON object, or the last JSON line of the
-    file (bench.py prints ONE line; drivers may append logs)."""
+    file (bench.py prints ONE line; drivers may append logs).  Driver
+    wrappers ({"parsed": {...}} / {"tail": "..."} envelopes) unwrap to
+    the bench line itself."""
     with open(path) as f:
         text = f.read().strip()
     try:
@@ -59,12 +77,36 @@ def load_record(path: str) -> dict:
                     continue
     if not isinstance(doc, dict):
         raise ValueError(f"{path}: no JSON object found")
+    if "metric" not in doc:
+        # Driver envelope: prefer the pre-parsed bench line; fall back
+        # to scanning the captured tail for it.
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            return parsed
+        tail = doc.get("tail")
+        if isinstance(tail, str):
+            for line in tail.splitlines():
+                line = line.strip()
+                if '"metric"' not in line:
+                    continue
+                # Driver tails are length-capped from the FRONT, which
+                # can shear the bench line's opening brace off (seen in
+                # BENCH_r05); a line that starts mid-object is repaired
+                # rather than dropped — the watermark gate must be able
+                # to read every historical round.
+                for candidate_text in (line, "{" + line):
+                    try:
+                        candidate = json.loads(candidate_text)
+                    except ValueError:
+                        continue
+                    if isinstance(candidate, dict) and "metric" in \
+                            candidate:
+                        return candidate
     return doc
 
 
-def newest_pair(directory: str):
-    """The two highest-numbered BENCH_r*.json records in ``directory``
-    (old, new) — the pair the driver's latest round produced."""
+def all_records(directory: str):
+    """Every BENCH_r*.json in ``directory``, round order (ascending)."""
     rounds = []
     for name in os.listdir(directory):
         m = _BENCH_RE.match(name)
@@ -72,11 +114,18 @@ def newest_pair(directory: str):
             rounds.append((int(m.group(1)),
                            os.path.join(directory, name)))
     rounds.sort()
+    return [path for _, path in rounds]
+
+
+def newest_pair(directory: str):
+    """The two highest-numbered BENCH_r*.json records in ``directory``
+    (old, new) — the pair the driver's latest round produced."""
+    rounds = all_records(directory)
     if len(rounds) < 2:
         raise ValueError(
             f"{directory}: need at least two BENCH_r*.json records, "
             f"found {len(rounds)}")
-    return rounds[-2][1], rounds[-1][1]
+    return rounds[-2], rounds[-1]
 
 
 def judge(old: dict, new: dict, keys, max_regression: float):
@@ -107,16 +156,63 @@ def judge(old: dict, new: dict, keys, max_regression: float):
     return verdicts
 
 
+def watermark(records, keys):
+    """Best-ever value per key across ``records`` (list of parsed
+    record dicts): max for throughput keys, min for latency keys;
+    absent/null values are ignored.  Returns {key: (value, index)}
+    with the index of the record that set the mark."""
+    marks = {}
+    for i, rec in enumerate(records):
+        for key in keys:
+            v = rec.get(key)
+            if not isinstance(v, (int, float)) or v <= 0:
+                continue
+            if key not in marks:
+                marks[key] = (float(v), i)
+                continue
+            best, _ = marks[key]
+            better = (v < best) if lower_is_better(key) else (v > best)
+            if better:
+                marks[key] = (float(v), i)
+    return marks
+
+
+def judge_watermark(records, names, new, keys,
+                    max_regression: float):
+    """Judge ``new`` against each key's best-ever watermark over
+    ``records``; verdict rows carry which round set the mark."""
+    marks = watermark(records, keys)
+    synthetic_old = {key: value for key, (value, _) in marks.items()}
+    verdicts = judge(synthetic_old, new, keys, max_regression)
+    for v in verdicts:
+        mark = marks.get(v["key"])
+        v["watermark_record"] = (os.path.basename(names[mark[1]])
+                                 if mark else None)
+    return verdicts
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Fail on a bench-record service-rate regression")
     parser.add_argument("paths", nargs="*",
-                        help="old.json new.json (in that order)")
+                        help="old.json new.json (pairwise), or "
+                             "old1.json ... new.json (--watermark: "
+                             "the LAST record is judged)")
     parser.add_argument("--dir",
-                        help="scan for the newest BENCH_r*.json pair")
+                        help="scan BENCH_r*.json records (pairwise: "
+                             "newest pair; --watermark: newest vs "
+                             "best-ever across the rest)")
+    parser.add_argument("--watermark", action="store_true",
+                        help="gate the newest record against each "
+                             "key's best-ever value across all prior "
+                             "records, not just the previous run "
+                             "(pairwise -10%% per round compounds to "
+                             "-37%% over four rounds undetected)")
     parser.add_argument("--key", action="append", default=None,
                         help="record key(s) to judge (default "
-                             "service_tiles_per_sec)")
+                             "service_tiles_per_sec, "
+                             "p50_service_tile_ms_ex_rtt, "
+                             "raw_upload_mb_per_sec)")
     parser.add_argument("--max-regression", type=float, default=0.10,
                         help="fail when new < old by this fraction or "
                              "more (default 0.10)")
@@ -125,31 +221,52 @@ def main(argv=None) -> int:
                              "failures")
     args = parser.parse_args(argv)
 
+    keys = tuple(args.key) if args.key else DEFAULT_KEYS
     try:
-        if args.dir:
-            old_path, new_path = newest_pair(args.dir)
-        elif len(args.paths) == 2:
-            old_path, new_path = args.paths
+        if args.watermark:
+            if args.dir:
+                paths = all_records(args.dir)
+            else:
+                paths = list(args.paths)
+            if len(paths) < 2:
+                raise ValueError(
+                    "watermark mode needs at least two records "
+                    f"(got {len(paths)})")
+            records = [load_record(p) for p in paths]
+            verdicts = judge_watermark(
+                records[:-1], paths[:-1], records[-1],
+                keys, args.max_regression)
+            doc = {
+                "gate": "bench", "mode": "watermark",
+                "records": len(paths) - 1,
+                "new": os.path.basename(paths[-1]),
+                "max_regression": args.max_regression,
+            }
         else:
-            parser.error("give exactly two record paths, or --dir")
-        old, new = load_record(old_path), load_record(new_path)
+            if args.dir:
+                old_path, new_path = newest_pair(args.dir)
+            elif len(args.paths) == 2:
+                old_path, new_path = args.paths
+            else:
+                parser.error("give exactly two record paths, or --dir")
+            old, new = load_record(old_path), load_record(new_path)
+            verdicts = judge(old, new, keys, args.max_regression)
+            doc = {
+                "gate": "bench", "mode": "pairwise",
+                "old": os.path.basename(old_path),
+                "new": os.path.basename(new_path),
+                "max_regression": args.max_regression,
+            }
     except (OSError, ValueError) as e:
         print(json.dumps({"gate": "bench", "error": str(e)}))
         return 2
 
-    keys = tuple(args.key) if args.key else DEFAULT_KEYS
-    verdicts = judge(old, new, keys, args.max_regression)
     regressed = [v for v in verdicts if v["verdict"] == "regression"]
     skipped = [v for v in verdicts if v["verdict"] == "skipped"]
     failed = bool(regressed) or (args.strict and bool(skipped))
-    print(json.dumps({
-        "gate": "bench",
-        "old": os.path.basename(old_path),
-        "new": os.path.basename(new_path),
-        "max_regression": args.max_regression,
-        "verdict": "fail" if failed else "pass",
-        "keys": verdicts,
-    }))
+    doc["verdict"] = "fail" if failed else "pass"
+    doc["keys"] = verdicts
+    print(json.dumps(doc))
     return 1 if failed else 0
 
 

@@ -109,9 +109,56 @@ def render_flight(doc) -> str:
     return "\n".join(lines)
 
 
+def _is_stats_table(doc) -> bool:
+    """A per-stage stats mapping: {span: {count, mean_ms, ...}} — the
+    bench record's ``service_waterfall`` export, or that record itself."""
+    if not isinstance(doc, dict) or not doc:
+        return False
+    if "service_waterfall" in doc:
+        return True
+    return all(isinstance(v, dict) and "count" in v and "mean_ms" in v
+               for v in doc.values())
+
+
+def render_stats(doc) -> str:
+    """Per-stage stats table with the tail breakdown: mean vs p50 vs
+    p95/p99/max per stage, so heavy-tail queueing (BENCH_r05:
+    batcher.queueWait mean 2276 ms, p50 2.2 ms) is visible per stage
+    instead of hidden in the mean."""
+    stats = doc.get("service_waterfall", doc)
+    lines = [
+        "stage waterfall (per-span stats)",
+        f"  {'span':<36} {'count':>7} {'mean':>9} {'p50':>9} "
+        f"{'p95':>9} {'p99':>9} {'max':>9}",
+    ]
+    for name in sorted(stats):
+        s = stats[name]
+        if not isinstance(s, dict) or "count" not in s:
+            continue
+
+        def col(key):
+            v = s.get(key)
+            return f"{v:>8.1f}m" if isinstance(v, (int, float)) else \
+                f"{'-':>9}"
+
+        lines.append(
+            f"  {name:<36} {s.get('count', 0):>7} {col('mean_ms')} "
+            f"{col('p50_ms')} {col('p95_ms')} {col('p99_ms')} "
+            f"{col('max_ms')}")
+        mean, p50 = s.get("mean_ms"), s.get("p50_ms")
+        if (isinstance(mean, (int, float)) and isinstance(p50,
+                                                          (int, float))
+                and p50 > 0 and mean > 10 * p50 and mean > 50.0):
+            lines.append(f"  {'':<36} ^ heavy tail: mean {mean:.0f} ms "
+                         f"is {mean / p50:.0f}x p50 — see p95/p99/max")
+    return "\n".join(lines)
+
+
 def render_doc(doc) -> str:
     if doc.get("flight_recorder"):
         return render_flight(doc)
+    if _is_stats_table(doc):
+        return render_stats(doc)
     return render_trace(doc)
 
 

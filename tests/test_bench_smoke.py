@@ -39,6 +39,19 @@ def test_bench_smoke_hot_path(capsys):
     assert "queue_ms" in out["cost_ledger_keys"]
     assert "wire_bytes" in out["cost_ledger_keys"]
 
+    # Pay-for-what-you-use: every cross-cutting feature's hot-path
+    # guard (trace span, cost-ledger flush, deadline check, admission
+    # admit+release, write-behind enqueue) stays micro-seconds scale.
+    # The budget is deliberately loose for CI-host jitter — the class
+    # it catches is a lock round-trip becoming a directory scan or a
+    # JSON encode (100x-1000x moves), not a 2x wobble.
+    overhead = out["overhead_ns_per_op"]
+    assert set(overhead) == {"trace", "ledger", "deadline",
+                             "admission", "write_behind"}
+    for name, ns in overhead.items():
+        assert ns < 100_000, \
+            f"hot-path overhead {name} = {ns:.0f} ns/op (budget 100µs)"
+
     # The printed line is the machine-readable contract.
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["metric"] == "smoke_hotpath_tiles_per_sec"

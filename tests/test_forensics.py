@@ -433,6 +433,95 @@ class TestBenchGate:
         assert gate.main([old, new]) == 0
         assert gate.main(["--strict", old, new]) == 1
 
+    def test_raw_upload_is_a_default_key(self, tmp_path):
+        """The r01 -> r05 524 -> 4.8 MB/s upload collapse class gates
+        by default now."""
+        gate = self._gate()
+        old = self._write(tmp_path, "a.json",
+                          {"service_tiles_per_sec": 100.0,
+                           "raw_upload_mb_per_sec": 500.0})
+        new = self._write(tmp_path, "b.json",
+                          {"service_tiles_per_sec": 100.0,
+                           "raw_upload_mb_per_sec": 5.0})
+        assert gate.main([old, new]) == 1
+
+    def test_watermark_catches_compounded_drift(self, tmp_path,
+                                                capsys):
+        """The r02 -> r05 failure mode in miniature: -10% per round
+        passes every PAIRWISE gate but compounds past the watermark
+        threshold — the watermark gate fails where pairwise cannot."""
+        gate = self._gate()
+        rates = [100.0, 91.0, 83.0, 76.0]      # each pair within 10%
+        for i, rate in enumerate(rates):
+            self._write(tmp_path, f"BENCH_r{i + 1:02d}.json",
+                        {"service_tiles_per_sec": rate})
+        # Every pairwise gate over the sequence passes...
+        paths = sorted(str(p) for p in tmp_path.iterdir())
+        for old, new in zip(paths, paths[1:]):
+            assert gate.main([old, new]) == 0
+        capsys.readouterr()
+        # ...but the best-ever watermark (100, set by r01) fails r04.
+        assert gate.main(["--watermark", "--dir", str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["mode"] == "watermark"
+        row = verdict["keys"][0]
+        assert row["verdict"] == "regression"
+        assert row["old"] == 100.0
+        assert row["watermark_record"] == "BENCH_r01.json"
+
+    def test_watermark_passes_a_recovered_record(self, tmp_path):
+        """A new record at (or within threshold of) the best-ever mark
+        passes — recovery closes the gate cleanly."""
+        gate = self._gate()
+        for i, rate in enumerate([100.0, 70.0, 60.0, 96.0]):
+            self._write(tmp_path, f"BENCH_r{i + 1:02d}.json",
+                        {"service_tiles_per_sec": rate})
+        assert gate.main(["--watermark", "--dir", str(tmp_path)]) == 0
+
+    def test_watermark_latency_key_uses_min(self, tmp_path, capsys):
+        """Latency watermarks are the BEST (lowest) value ever seen;
+        a new record >=10% above that mark fails even if it beats the
+        previous round."""
+        gate = self._gate()
+        lat = [40.0, 90.0, 80.0]   # best-ever 40 set in r01
+        for i, v in enumerate(lat):
+            self._write(tmp_path, f"BENCH_r{i + 1:02d}.json",
+                        {"service_tiles_per_sec": 100.0,
+                         "p50_service_tile_ms_ex_rtt": v})
+        assert gate.main(["--watermark", "--dir", str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        rows = {r["key"]: r for r in verdict["keys"]}
+        row = rows["p50_service_tile_ms_ex_rtt"]
+        assert row["verdict"] == "regression"
+        assert row["old"] == 40.0
+
+    def test_watermark_skips_never_recorded_keys(self, tmp_path):
+        """A key no historical record ever carried skips (weather
+        semantics), and --strict turns that into a failure."""
+        gate = self._gate()
+        for i in range(2):
+            self._write(tmp_path, f"BENCH_r{i + 1:02d}.json",
+                        {"service_tiles_per_sec": 100.0})
+        assert gate.main(["--watermark", "--dir", str(tmp_path)]) == 0
+        assert gate.main(["--watermark", "--strict", "--dir",
+                          str(tmp_path)]) == 1
+
+    def test_watermark_reads_driver_envelopes(self, tmp_path):
+        """Historical BENCH records are driver envelopes ({parsed} or
+        a {tail} whose bench line may have its leading brace sheared
+        off by the front-truncated capture); the watermark gate must
+        read every round or the mark silently shrinks."""
+        gate = self._gate()
+        self._write(tmp_path, "BENCH_r01.json",
+                    {"parsed": {"metric": "m",
+                                "service_tiles_per_sec": 100.0}})
+        bench_line = json.dumps({"metric": "m",
+                                 "service_tiles_per_sec": 50.0})
+        self._write(tmp_path, "BENCH_r02.json",
+                    {"parsed": None,
+                     "tail": "noise\n" + bench_line[1:] + "\n"})
+        assert gate.main(["--watermark", "--dir", str(tmp_path)]) == 1
+
 
 # -------------------------------------------------------- debug surface
 
@@ -626,3 +715,60 @@ class TestResetContract:
             "imageregion_flight_events_total 0",
             "imageregion_flight_dumps_total 0",
         ]
+
+
+# ------------------------------------------- waterfall tail breakdown
+
+class TestWaterfallTailBreakdown:
+    def test_span_stats_report_tail_percentiles_and_max(self):
+        """The r05 anomaly class made visible: a stage whose mean is
+        dominated by a few stragglers exposes p95/p99/max alongside
+        the mean and p50 in every stats export."""
+        from omero_ms_image_region_tpu.utils.stopwatch import (
+            StopWatchRegistry)
+
+        reg = StopWatchRegistry()
+        for _ in range(90):
+            reg.record("batcher.queueWait", 2.0)
+        for _ in range(10):                         # straggler decile
+            reg.record("batcher.queueWait", 5000.0)
+        s = reg.snapshot()["batcher.queueWait"]
+        assert s["count"] == 100
+        assert s["p50_ms"] <= 4.0                   # bucket bound of 2ms
+        assert s["mean_ms"] > 400.0                 # the mean conflates
+        assert s["p95_ms"] >= 4000.0                # the tail is visible
+        assert s["p99_ms"] >= 4000.0
+        assert s["max_ms"] == 5000.0                # exact high-water
+        assert s["p95_ms"] <= s["p99_ms"] <= 2 * s["max_ms"]
+
+    def test_trace_report_renders_stats_tables(self, capsys):
+        """scripts/trace_report.py renders a per-stage stats mapping
+        (the bench record's service_waterfall export) as a table and
+        flags heavy-tail stages."""
+        mod = _load_script("trace_report")
+        doc = {
+            "service_waterfall": {
+                "batcher.queueWait": {
+                    "count": 672, "total_ms": 1530041.2,
+                    "mean_ms": 2276.8, "p50_ms": 2.2,
+                    "p95_ms": 16384.0, "p99_ms": 16384.0,
+                    "max_ms": 21034.7},
+                "wire.fetch": {
+                    "count": 102, "total_ms": 218004.3,
+                    "mean_ms": 2137.3, "p50_ms": 598.7,
+                    "p95_ms": 8192.0, "p99_ms": 8192.0,
+                    "max_ms": 9123.0},
+            },
+        }
+        out = mod.render_doc(doc)
+        assert "batcher.queueWait" in out
+        assert "p95" in out and "p99" in out and "max" in out
+        # The 1000x mean-vs-p50 stage is called out; the 3.5x one not.
+        assert out.count("heavy tail") == 1
+        # Plain {span: stats} mappings (REGISTRY.snapshot()) render too.
+        out2 = mod.render_doc(doc["service_waterfall"])
+        assert "wire.fetch" in out2
+        # Legacy stats without the tail fields still render (dashes).
+        legacy = {"x": {"count": 1, "total_ms": 1.0, "mean_ms": 1.0,
+                        "p50_ms": 1.0}}
+        assert "x" in mod.render_doc(legacy)

@@ -1,8 +1,10 @@
-"""EXPERIMENTAL pallas render kernel: parity with the XLA kernel.
+"""Pallas render kernels: parity with the XLA kernel.
 
-The kernel lives in experimental/ and is NOT a serving option (see its
-module docstring for the on-chip Mosaic findings); these tests keep the
-interpret-mode parity contract honest while it stays an experiment.
+The RAMP kernel (elementwise, no one-hot — the Mosaic reshape blocker
+reformulated away, exactly as the XLA path's own arithmetic composite
+did) is a compile-guarded serving option (renderer.kernel: pallas); the
+one-hot LUT kernel stays an interpret-mode experiment.  These tests
+keep both parity contracts honest and pin the fallback guard.
 """
 
 import numpy as np
@@ -36,7 +38,8 @@ def _rdef(C=3):
     return rdef
 
 
-def _parity(B, C, H, W, family="linear", lut=False, seed=0):
+def _parity(B, C, H, W, family="linear", lut=False, seed=0,
+            ramp=False):
     from omero_ms_image_region_tpu.models.rendering import Family
     rng = np.random.default_rng(seed)
     rdef = _rdef(C)
@@ -49,7 +52,13 @@ def _parity(B, C, H, W, family="linear", lut=False, seed=0):
         from omero_ms_image_region_tpu.ops.lut import LutProvider
         lut_provider = LutProvider()  # no files: colors fold to ramps
     s = pack_settings(rdef, lut_provider)
-    tables = build_channel_tables(rdef, lut_provider)
+    if ramp:
+        # The serving ramp path: pack_settings already folded the
+        # colors to f32[C, 3] weights (no LUT files resolve).
+        tables = s["tables"]
+        assert tables.ndim == 2
+    else:
+        tables = build_channel_tables(rdef, lut_provider)
     raw = rng.integers(0, 65535, size=(B, C, H, W)).astype(np.float32)
 
     got = np.asarray(render_tile_batch_packed_pallas(
@@ -106,13 +115,82 @@ def test_pick_block_h_covers_buckets_and_odd_heights():
         assert H % bh == 0 and bh <= 256
 
 
-def test_pallas_not_a_serving_option():
-    """The serving path carries no dead kernel option (VERDICT r2 #8):
-    both the config loader and the Renderer reject 'pallas'."""
+@pytest.mark.parametrize("family", ["linear", "polynomial",
+                                    "logarithmic", "exponential"])
+def test_pallas_ramp_kernel_matches_xla(family):
+    """The serving RAMP kernel (elementwise, no one-hot) is bit-exact
+    against the XLA arithmetic composite for every family."""
+    _parity(2, 3, 16, 64, family=family, seed=11, ramp=True)
+
+
+@pytest.mark.parametrize("B,H,W", [(1, 16, 64), (3, 96, 128)])
+def test_pallas_ramp_kernel_shapes(B, H, W):
+    _parity(B, 2, H, W, seed=B + H, ramp=True)
+
+
+def test_pallas_is_a_guarded_serving_option():
+    """renderer.kernel: pallas is accepted (compile-guarded promotion,
+    round 6) and the direct Renderer serves ramp renders through it
+    bit-identically to the XLA kernel (interpret mode off-TPU)."""
     from omero_ms_image_region_tpu.server.config import AppConfig
     from omero_ms_image_region_tpu.server.handler import Renderer
+    from omero_ms_image_region_tpu.ops.render import render_tile_packed
 
-    with pytest.raises(ValueError, match="experimental"):
-        AppConfig.from_dict({"renderer": {"kernel": "pallas"}})
-    with pytest.raises(ValueError, match="experimental"):
-        Renderer(kernel="pallas")
+    cfg = AppConfig.from_dict({"renderer": {"kernel": "pallas"}})
+    assert cfg.renderer.kernel == "pallas"
+
+    rdef = _rdef(2)
+    s = pack_settings(rdef)
+    assert s["tables"].ndim == 2          # ramp weights: eligible
+    rng = np.random.default_rng(5)
+    raw = rng.integers(0, 65535, size=(2, 16, 64)).astype(np.float32)
+
+    r = Renderer(kernel="pallas")
+    r._pallas_interpret = True            # off-TPU test hook
+    got = r._render_sync(raw, s)
+    want = np.asarray(render_tile_packed(
+        raw, s["window_start"], s["window_end"], s["family"],
+        s["coefficient"], s["reverse"], s["cd_start"], s["cd_end"],
+        s["tables"]))
+    np.testing.assert_array_equal(got, want)
+    assert r._pallas_ok                   # the guard never tripped
+
+
+def test_pallas_option_falls_back_on_failure():
+    """The compile guard: a pallas failure serves the render on the XLA
+    kernel and disables the option for the process life — the option
+    can only remove work, never fail a request."""
+    from omero_ms_image_region_tpu.server.handler import Renderer
+
+    rdef = _rdef(2)
+    s = pack_settings(rdef)
+    rng = np.random.default_rng(6)
+    raw = rng.integers(0, 65535, size=(2, 16, 64)).astype(np.float32)
+
+    r = Renderer(kernel="pallas")
+    r._pallas_interpret = True
+    import omero_ms_image_region_tpu.experimental.pallas_render as pr
+    original = pr.render_tile_packed_pallas
+    pr.render_tile_packed_pallas = (
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("mosaic")))
+    try:
+        out = r._render_sync(raw, s)      # served by the fallback
+    finally:
+        pr.render_tile_packed_pallas = original
+    assert out.shape == (16, 64)
+    assert not r._pallas_ok               # guard latched off
+    out2 = r._render_sync(raw, s)         # straight to XLA now
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_pallas_lut_renders_stay_on_xla():
+    """LUT-table renders (tables.ndim == 3) never route to pallas —
+    the one-hot formulation is still experimental on hardware."""
+    from omero_ms_image_region_tpu.server.handler import Renderer
+
+    rdef = _rdef(2)
+    s = dict(pack_settings(rdef))
+    s["tables"] = build_channel_tables(rdef)    # force the 3-D tables
+    r = Renderer(kernel="pallas")
+    r._pallas_interpret = True
+    assert not r._pallas_eligible(s)

@@ -768,3 +768,100 @@ def test_sidecar_serves_vendor_codec_images(data_dir, tmp_path):
     png = np.asarray(PILImage.open(
         _io.BytesIO(split_bodies[0])).convert("RGB"))
     assert np.abs(png.astype(int) - arr.astype(int)).max() <= 1
+
+
+def test_bulk_stage_planes_single_probe_roundtrip(data_dir, tmp_path):
+    """Bulk digest-first staging (round 6): N planes probe in ONE wire
+    round-trip (the per-plane probe RTT was the bulk-upload tax), only
+    misses upload, and a repeat of the whole batch ships zero plane
+    bytes."""
+    from omero_ms_image_region_tpu.server.sidecar import SidecarClient
+
+    sock = str(tmp_path / "render.sock")
+    rng = np.random.default_rng(11)
+    planes = [rng.integers(0, 60000, size=(1, 64, 64)).astype(np.uint16)
+              for _ in range(4)]
+    planes.append(planes[0].copy())     # duplicate content in the batch
+
+    async def body():
+        client = SidecarClient(sock)
+        try:
+            results = await client.stage_planes(planes)
+            assert len(results) == len(planes)
+            digests = [d for d, _ in results]
+            assert digests[4] == digests[0]     # content-addressed
+            # First batch: the four distinct planes uploaded; the
+            # duplicate rode index 0's upload (intra-batch dedup:
+            # zero bytes crossed the wire for it).
+            assert [r for _, r in results[:4]] == [False] * 4
+            assert results[4] == (digests[0], True)
+            # Whole batch again: one probe round-trip, all resident,
+            # zero plane bytes on the wire.
+            results2 = await client.stage_planes(
+                [p.copy() for p in planes])
+            assert [r for _, r in results2] == [True] * len(planes)
+            assert [d for d, _ in results2] == digests
+            # The batched probe op itself answers aligned lists.
+            import json as _json
+            status, payload = await client.call(
+                "plane_probe", {},
+                extra={"digests": digests + ["ff" * 16]})
+            assert status == 200
+            doc = _json.loads(bytes(payload).decode())
+            assert doc["resident"] == [True] * len(digests) + [False]
+            return True
+        finally:
+            await client.close()
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body))
+
+
+def test_bulk_stage_planes_degrades_to_scalar_probes_on_old_peer():
+    """Mixed-version posture: a previous-round sidecar knows only the
+    scalar plane_probe.  The bulk client must fall back to per-digest
+    probes (the old cost) rather than silently re-uploading resident
+    planes on every call."""
+    import json as _json
+
+    from omero_ms_image_region_tpu.server.sidecar import SidecarClient
+
+    client = SidecarClient("/nonexistent", breaker=None, retry=None)
+    calls = []
+    device_resident = {}
+
+    async def fake_call(op, ctx, body=b"", extra=None):
+        extra = dict(extra or {})
+        calls.append((op, extra))
+        if op == "plane_probe":
+            # Old peer: the batched "digests" key is unknown; it reads
+            # the absent scalar "digest" as never-resident.
+            d = extra.get("digest", "")
+            return 200, _json.dumps({
+                "enabled": True,
+                "resident": bool(device_resident.get(d)),
+            }).encode()
+        assert op == "plane_put"
+        d = extra["digest"]
+        was = bool(device_resident.get(d))
+        device_resident[d] = True
+        return 200, _json.dumps({"digest": d,
+                                 "resident": was}).encode()
+
+    client.call = fake_call
+    rng = np.random.default_rng(13)
+    arrs = [rng.integers(0, 60000, size=(1, 8, 8)).astype(np.uint16)
+            for _ in range(3)]
+
+    first = asyncio.run(client.stage_planes(arrs))
+    assert [r for _, r in first] == [False] * 3     # all uploaded once
+    n_puts_first = sum(1 for op, _ in calls if op == "plane_put")
+    assert n_puts_first == 3
+    second = asyncio.run(client.stage_planes(
+        [a.copy() for a in arrs]))
+    assert [r for _, r in second] == [True] * 3     # dedup survived
+    n_puts = sum(1 for op, _ in calls if op == "plane_put")
+    assert n_puts == 3                               # zero re-uploads
+    # The fallback really probed per digest (scalar form).
+    scalar_probes = [e for op, e in calls
+                     if op == "plane_probe" and "digest" in e]
+    assert len(scalar_probes) == 6                   # 3 per batch

@@ -55,6 +55,24 @@ def _timed(fn, *args, repeats=3, warmup=True):
     return min(times)
 
 
+def telemetry_wire_frames_per_flush():
+    """Process-global wire coalescing mean, None when the run never
+    crossed the sidecar wire (combined posture)."""
+    try:
+        from omero_ms_image_region_tpu.utils import telemetry
+        return telemetry.WIRE.frames_per_flush()
+    except Exception:
+        return None
+
+
+def telemetry_wire_ring_hit_rate():
+    try:
+        from omero_ms_image_region_tpu.utils import telemetry
+        return telemetry.WIRE.ring_hit_rate()
+    except Exception:
+        return None
+
+
 def _opt_round(v, nd):
     return None if v is None else round(v, nd)
 
@@ -511,6 +529,7 @@ async def _service_run(config, concurrency: int = 16,
         done = 0
         failed = 0
         latencies_ms: list = []
+        first_byte_ms: list = []
 
         async def worker(i: int) -> None:
             nonlocal done, seq, failed
@@ -518,9 +537,15 @@ async def _service_run(config, concurrency: int = 16,
                 seq += 1
                 t_req = time.perf_counter()
                 r = await client.get(url(i, 16 + seq))
+                # First body bytes (the progressive-wire headline),
+                # then the rest: with streaming on, chunked responses
+                # surface the first tile bytes before the batch tail.
+                await r.content.readany()
+                t_first = time.perf_counter()
                 await r.read()
                 if r.status == 200:
                     done += 1
+                    first_byte_ms.append((t_first - t_req) * 1000.0)
                     latencies_ms.append(
                         (time.perf_counter() - t_req) * 1000.0)
                 else:
@@ -549,6 +574,9 @@ async def _service_run(config, concurrency: int = 16,
                else None)
         extras = await _hot_path_probes(app, client, url, seq,
                                         _REG.snapshot(), snap0, wall_s)
+        extras["p50_first_tile_byte_ms"] = (
+            round(statistics.median(first_byte_ms), 2)
+            if first_byte_ms else None)
         return tps, p50, extras
     finally:
         await client.close()
@@ -697,6 +725,237 @@ def _overhead_table(n: int = 2000) -> dict:
     return out
 
 
+def _wire_smoke() -> dict:
+    """Wire-transport probes at smoke scale (protocol v3): a REAL
+    frontend -> sidecar hop over a unix socket with coalescing,
+    chunked streaming and the same-host shm ring live.
+
+    Three measurements, one JSON block merged into the smoke line:
+
+    * ``p50_first_tile_byte_ms`` vs ``p50_batch_complete_ms`` — bursts
+      of 4 concurrent distinct renders of one tile co-batch into one
+      group; first-tile-out + chunk frames must land a request's first
+      body byte strictly before the burst's last request completes
+      (the v2 barrier settled everyone together at the tail).
+    * ``wire_frames_per_flush`` — mean frames per vectored flush
+      across the window; > 1 under concurrent load proves the
+      coalescer amortizes syscalls/RTTs.
+    * ``shm_ring_hit_rate`` + ``shm_upload_mb_per_sec`` vs
+      ``socket_upload_mb_per_sec`` — the same bulk ``stage_planes``
+      upload through a ring-negotiated client and a ring-disabled one
+      (fresh content each, so digest dedup cannot short-circuit).
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+
+    rng = np.random.default_rng(11)
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 2, 1, 512, 512).reshape(
+            2, 1, 512, 512)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        sock = os.path.join(tmp, "wire.sock")
+        return asyncio.run(_wire_run(tmp, sock, rng))
+
+
+async def _wire_run(tmp: str, sock: str, rng) -> dict:
+    import asyncio
+    import os
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from omero_ms_image_region_tpu.server.app import create_app
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, RawCacheConfig, RendererConfig,
+        SidecarConfig, WireConfig)
+    from omero_ms_image_region_tpu.server.sidecar import (SidecarClient,
+                                                          run_sidecar)
+    from omero_ms_image_region_tpu.utils import telemetry
+
+    telemetry.WIRE.reset()
+    sidecar_cfg = AppConfig(
+        data_dir=tmp,
+        # linger long enough that an 8-way burst forms ONE group (the
+        # batch whose barrier the streaming path must beat — a bigger
+        # group means a longer per-tile encode tail to get ahead of).
+        batcher=BatcherConfig(enabled=True, linger_ms=15.0,
+                              max_batch=8),
+        raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+        renderer=RendererConfig(cpu_fallback_max_px=0))
+    task = asyncio.create_task(run_sidecar(sidecar_cfg, sock))
+    for _ in range(600):
+        if task.done():
+            raise RuntimeError(f"wire smoke sidecar died: "
+                               f"{task.exception()!r}")
+        if os.path.exists(sock):
+            break
+        await asyncio.sleep(0.05)
+    front_cfg = AppConfig(data_dir=tmp,
+                          sidecar=SidecarConfig(socket=sock,
+                                                role="frontend"))
+    app = create_app(front_cfg)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        colors = ("FF0000", "00FF00")
+
+        def url(k: int) -> str:
+            # k-varied windows: 4 DISTINCT renders of the same raw
+            # tile (no byte-cache or single-flight short-circuit), all
+            # in one bucket/batch key.
+            w = 20000 + (k % 5000) * 9
+            chans = ",".join(
+                f"{c + 1}|0:{w - 1000 * c}${colors[c]}"
+                for c in range(2))
+            return (f"/webgateway/render_image_region/1/0/0"
+                    f"?tile=0,0,0,256,256&format=jpeg&m=c&c={chans}")
+
+        seq_box = [100]
+
+        async def one(cl, k: int):
+            t0 = time.perf_counter()
+            r = await cl.get(url(k))
+            await r.content.readany()
+            t_first = time.perf_counter()
+            await r.read()
+            return (r.status, (t_first - t0) * 1000.0,
+                    (time.perf_counter() - t0) * 1000.0)
+
+        async def burst_stats(cl, n_bursts: int):
+            # Warm: stage the tile + compile the burst's group shape
+            # (the second stack reuses the in-process jit caches).
+            warm = await asyncio.gather(*(cl.get(url(seq_box[0] + i))
+                                          for i in range(8)))
+            assert all(r.status == 200 for r in warm), \
+                [r.status for r in warm]
+            for r in warm:
+                await r.read()
+            seq_box[0] += 8
+            firsts, completes = [], []
+            for _ in range(n_bursts):
+                rs = await asyncio.gather(*(one(cl, seq_box[0] + j)
+                                            for j in range(8)))
+                seq_box[0] += 8
+                assert all(s == 200 for s, _, _ in rs), rs
+                # The burst's first body byte vs its batch completion
+                # (last member fully answered) — the gap IS the
+                # first-tile-out + chunk-forwarding win.
+                firsts.append(min(f for _, f, _ in rs))
+                completes.append(max(t for _, _, t in rs))
+            return firsts, completes
+
+        firsts, batch_completes = await burst_stats(client, 12)
+
+        # Upload-path A/B on the same live sidecar: ring-negotiated vs
+        # ring-disabled client shipping the SAME MB-scale bodies.  The
+        # bodies ride ``ping`` requests (whose body the server reads
+        # and discards), so this isolates the WIRE leg the ring
+        # replaces — ``stage_planes`` end-to-end would be dominated by
+        # the server's digest + device staging, identical both ways
+        # (and already measured by ``raw_upload_mb_per_sec``).
+        body = rng.integers(0, 60000, size=(1024, 1024)) \
+            .astype(np.uint16).tobytes()               # 2 MiB
+        n_bodies = 8
+        ring_client = SidecarClient(sock)
+        sock_client = SidecarClient(sock, wire=WireConfig(ring_bytes=0))
+        try:
+            await ring_client.call("ping", {})     # handshakes +
+            await sock_client.call("ping", {})     # connection setup
+
+            async def upload_window(cl) -> float:
+                t0 = time.perf_counter()
+                rs = await asyncio.gather(
+                    *(cl.call("ping", {}, body=body)
+                      for _ in range(n_bodies)))
+                assert all(s == 200 for s, _ in rs)
+                return (n_bodies * len(body) / 1e6
+                        / (time.perf_counter() - t0))
+
+            rates = {"socket": 0.0, "ring": 0.0}
+            # Interleaved best-of-3 per path: single-rep ordering (and
+            # this box's scheduler) otherwise decides the A/B.
+            for _ in range(3):
+                for name, cl in (("socket", sock_client),
+                                 ("ring", ring_client)):
+                    rates[name] = max(rates[name],
+                                      await upload_window(cl))
+        finally:
+            await ring_client.close()
+            await sock_client.close()
+
+        # Barrier A/B (informational, not gated: the CPU-smoke margin
+        # is a few ms and CI jitter would flake a strict ordering):
+        # the same bursts against a streaming-OFF stack, where the v2
+        # barrier settles everyone at the batch tail.  The mechanism
+        # itself is gated deterministically in
+        # tests/test_wire_v3.py::test_first_tile_out_settles_before_barrier.
+        p50_first_barrier = None
+        sock2 = sock + ".barrier"
+        barrier_cfg = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=True, linger_ms=15.0,
+                                  max_batch=8),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0),
+            wire=WireConfig(streaming=False))
+        task2 = asyncio.create_task(run_sidecar(barrier_cfg, sock2))
+        client2 = None
+        try:
+            for _ in range(600):
+                if task2.done():
+                    raise RuntimeError(f"barrier sidecar died: "
+                                       f"{task2.exception()!r}")
+                if os.path.exists(sock2):
+                    break
+                await asyncio.sleep(0.05)
+            app2 = create_app(AppConfig(
+                data_dir=tmp,
+                sidecar=SidecarConfig(socket=sock2, role="frontend"),
+                wire=WireConfig(streaming=False)))
+            client2 = TestClient(TestServer(app2))
+            await client2.start_server()
+            b_firsts, _ = await burst_stats(client2, 6)
+            p50_first_barrier = round(statistics.median(b_firsts), 2)
+        except Exception:
+            pass     # informational only: never fail the smoke on it
+        finally:
+            if client2 is not None:
+                await client2.close()
+            task2.cancel()
+            try:
+                await task2
+            except (asyncio.CancelledError, Exception):
+                pass
+
+        wire = telemetry.WIRE
+        hit_rate = wire.ring_hit_rate()
+        return {
+            "p50_first_tile_byte_ms": round(
+                statistics.median(firsts), 2),
+            "p50_batch_complete_ms": round(
+                statistics.median(batch_completes), 2),
+            "p50_first_tile_byte_ms_barrier": p50_first_barrier,
+            "wire_frames_per_flush": round(
+                wire.frames_per_flush() or 0.0, 3),
+            "shm_ring_hit_rate": (round(hit_rate, 3)
+                                  if hit_rate is not None else None),
+            "shm_upload_mb_per_sec": round(rates["ring"], 1),
+            "socket_upload_mb_per_sec": round(rates["socket"], 1),
+            "wire_streams": wire.streams,
+            "wire_ring_negotiated": wire.ring_negotiated,
+        }
+    finally:
+        await client.close()
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
 def bench_smoke(duration_s: float = 1.5):
     """Hot-path regression gate at smoke scale: CPU, small shapes, <60 s.
 
@@ -739,6 +998,10 @@ def bench_smoke(duration_s: float = 1.5):
         tps, p50, extras = asyncio.run(_service_run(
             config, concurrency=4, duration_s=duration_s, grid=2,
             tile_edge=256, channels=2, fmt="png"))
+    # Wire-transport probes (protocol v3): split posture over a unix
+    # socket — first-byte vs batch barrier, frames per vectored flush,
+    # and the shm-ring vs socket upload A/B.
+    wire = _wire_smoke()
     # Cost-ledger liveness: the attribution layer must have recorded
     # WHERE the smoke window's time went, request by request — a
     # refactor that silently drops the ledger fails the gate here.
@@ -763,6 +1026,9 @@ def bench_smoke(duration_s: float = 1.5):
         # write-behind enqueue.  Gated in tests/test_bench_smoke.py so
         # the feature layers stay pay-for-what-you-use.
         "overhead_ns_per_op": _overhead_table(),
+        # Wire v3 probes (split posture, streaming + coalescing + shm
+        # ring live) — gated in tests/test_bench_smoke.py.
+        **wire,
         "elapsed_s": round(time.perf_counter() - t_start, 1),
     }
     print(json.dumps(out))
@@ -1632,6 +1898,12 @@ def main():
         "p50_service_tile_ms_ex_rtt": _opt_round(
             service_p50_ms and max(
                 0.0, service_p50_ms - flag["rtt_floor_ms"]), 2),
+        # First BODY byte at the client (the progressive-wire
+        # headline): with streaming + first-tile-out this lands a
+        # batch-tail before request completion; watermark-gated in
+        # scripts/bench_gate.py (direction: _ms regresses upward).
+        "p50_first_tile_byte_ms": service_hot_path.get(
+            "p50_first_tile_byte_ms"),
         # BASELINE.md's <50 ms target is INTERACTIVE tile latency
         # (single in-flight tile); pinned as a boolean so the r3-style
         # 68 ms regression class cannot pass silently.
@@ -1656,6 +1928,14 @@ def main():
         # mean, p50 ms): queue wait, device batch, wire fetch (+second
         # fetches), host entropy/framing.
         "service_waterfall": service_waterfall,
+        # Wire-transport accounting across the run (frames per
+        # vectored flush, shm-ring hit rate): populated when the
+        # serving posture actually crosses the sidecar wire; the
+        # combined-mode windows report null rather than a fake 1.0.
+        "wire_frames_per_flush": _opt_round(
+            telemetry_wire_frames_per_flush(), 3),
+        "shm_ring_hit_rate": _opt_round(
+            telemetry_wire_ring_hit_rate(), 3),
         # Device->host rate adjacent to the service windows: on
         # congested links service tiles/s ~= this / 0.09 MB-per-tile
         # (huffman wire), i.e. the wire, not the stack, is the bound.

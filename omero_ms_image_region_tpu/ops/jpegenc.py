@@ -1250,7 +1250,8 @@ def huffman_spec_arrays():
 
 def finish_huffman_batch(bufs, dims, H: int, W: int,
                          quality: int, cap: int, cap_words: int,
-                         dense_fallback=None, spec=None) -> list:
+                         dense_fallback=None, spec=None,
+                         on_tile=None) -> list:
     """Fetched Huffman wire rows -> JFIF bytes per tile.
 
     ``bufs`` indexes per-row u8 buffers: a 2D [B, >=prefix] array (the
@@ -1274,6 +1275,8 @@ def finish_huffman_batch(bufs, dims, H: int, W: int,
                 raise ValueError("tile %d needs the dense path but no "
                                  "fallback was given" % i)
             out.append(dense_fallback(i))
+            if on_tile is not None:
+                on_tile(i, out[-1])
             continue
         w_, h_ = dim
         row = bufs[i]
@@ -1284,6 +1287,8 @@ def finish_huffman_batch(bufs, dims, H: int, W: int,
                 raise ValueError(
                     f"huffman wire overflow (entries={total}, bits={bits})")
             out.append(dense_fallback(i))
+            if on_tile is not None:
+                on_tile(i, out[-1])
             continue
         nwords = (bits + 31) // 32
         # Compacted rows can sit at unaligned offsets in the fetched
@@ -1294,6 +1299,8 @@ def finish_huffman_batch(bufs, dims, H: int, W: int,
                    if spec is None else
                    finish_stream_with_spec(words, bits, w_, h_,
                                            quality, spec))
+        if on_tile is not None:
+            on_tile(i, out[-1])
     return out
 
 
@@ -1442,7 +1449,7 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
                          reverse, cd_start, cd_end, tables, quality: int,
                          dims, cap: int | None = None,
                          engine: str = "sparse",
-                         tune: bool = True) -> list:
+                         tune: bool = True, on_tile=None) -> list:
     """Serving-path helper: one batched device dispatch -> JFIF per tile.
 
     ``raw`` is [B, C, H, W] with H, W multiples of 16 (callers edge-pad;
@@ -1461,6 +1468,13 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
     full (H, W) grid, so a group containing bucket-padded tiles (true
     grid smaller than (H, W)) falls back to the sparse engine as a
     whole — one dispatch either way, never per-tile re-renders.
+
+    ``on_tile(i, jpeg_bytes)`` (optional) fires the moment tile ``i``'s
+    encode slice lands — the batcher's first-tile-out settlement hook:
+    tile 0's waiter can be answered while tile N-1 is still entropy
+    coding, instead of every waiter parking behind the batch tail.  The
+    bytes passed are EXACTLY the returned list's entry (byte-identity is
+    the streaming contract); callback exceptions are the caller's.
     """
     B, C, H, W = raw.shape
     if cap is None:
@@ -1540,7 +1554,8 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
         with stopwatch("jfif.encodeBatch"):
             return finish_huffman_batch(
                 rows, dims, H, W, quality, cap, cap_words,
-                dense_fallback=dense_tile, spec=frame_spec)
+                dense_fallback=dense_tile, spec=frame_spec,
+                on_tile=on_tile)
 
     def dispatch_sparse(c):
         bufs = render_to_jpeg_sparse_compact(
@@ -1563,11 +1578,13 @@ def render_batch_to_jpeg(raw, window_start, window_end, family, coefficient,
     from ..utils.stopwatch import stopwatch
     with stopwatch("jfif.encodeBatch"):
         return finish_sparse_to_jpegs(rows, dims, H, W, quality, cap,
-                                      dense_coefficients)
+                                      dense_coefficients,
+                                      on_tile=on_tile)
 
 
 def finish_sparse_to_jpegs(bufs, dims, H: int, W: int, quality: int,
-                           cap: int, dense_coefficients) -> list:
+                           cap: int, dense_coefficients,
+                           on_tile=None) -> list:
     """Host tail of the sparse serving path: fetched wire rows -> JFIF.
 
     ``dims`` gives each tile's true ``(width, height)``; tiles whose own
@@ -1586,6 +1603,8 @@ def finish_sparse_to_jpegs(bufs, dims, H: int, W: int, quality: int,
         try:
             if exact:
                 out.append(_encode(bufs[i], w_, h_, quality, cap))
+                if on_tile is not None:
+                    on_tile(i, out[-1])
                 continue
             dense = sparse_to_dense(bufs[i], H, W, cap)
             if dense is None:
@@ -1595,6 +1614,8 @@ def finish_sparse_to_jpegs(bufs, dims, H: int, W: int, quality: int,
         y, cb, cr = slice_block_subgrid(*dense, H, W, w_, h_) \
             if not exact else dense
         out.append(_dense_encode(y, cb, cr, w_, h_, quality))
+        if on_tile is not None:
+            on_tile(i, out[-1])
     return out
 
 

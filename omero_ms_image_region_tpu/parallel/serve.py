@@ -478,7 +478,11 @@ class MeshRenderer(BatchingRenderer):
 
             jpegs = finish_huffman_batch(
                 bufs, dims, H, W, quality, cap, cap_words,
-                dense_fallback=dense_tile)
+                dense_fallback=dense_tile,
+                # First-tile-out is host-side settlement AFTER the
+                # lockstep device work — safe on a pod (no launch
+                # depends on it).
+                on_tile=self._early_settle_cb(group))
         else:
             with self._device_gate:
                 if self._pod is not None:
@@ -494,7 +498,8 @@ class MeshRenderer(BatchingRenderer):
             jpegs = finish_sparse_to_jpegs(
                 bufs, dims, H, W, quality, cap,
                 lambda i: self._dense_coefficients(raw, stacked, qy,
-                                                   qc, i))
+                                                   qc, i),
+                on_tile=self._early_settle_cb(group))
         self._count_batch(n)
         return jpegs
 

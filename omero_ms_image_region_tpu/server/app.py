@@ -251,6 +251,11 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
             engine = resolve_auto_engine()
         renderer = Renderer(jpeg_engine=engine,
                             kernel=config.renderer.kernel)
+    if hasattr(renderer, "first_tile_out"):
+        # First-tile-out settlement rides the streaming knob: with
+        # wire.streaming off the batcher reverts to barrier
+        # settlement (the v2 behavior, for A/B measurement).
+        renderer.first_tile_out = config.wire.streaming
     caches = Caches.from_config(config.caches)
     if config.caches.redis_uri and caches.redis is None:
         log.warning("redis package unavailable; redis cache tier and "
@@ -415,7 +420,10 @@ def create_app(config: Optional[AppConfig] = None,
             retry=RetryPolicy(
                 max_attempts=ft.retry_max_attempts,
                 base_backoff_s=ft.retry_base_backoff_ms / 1000.0,
-                max_backoff_s=ft.retry_max_backoff_ms / 1000.0))
+                max_backoff_s=ft.retry_max_backoff_ms / 1000.0),
+            # Wire v3 knobs: coalescing bounds, shm-ring sizing,
+            # chunk streaming (deploy/DEPLOY.md "Wire transport").
+            wire=config.wire)
         fallback = None
         if ft.degraded_mode:
             # Graceful degradation: while the device backend is down,
@@ -507,6 +515,9 @@ def create_app(config: Optional[AppConfig] = None,
         return params
 
     async def render_image_region(request: web.Request) -> web.Response:
+        import time as _time
+
+        t_req = _time.perf_counter()
         params = _params_of(request)
         try:
             ctx = ImageRegionCtx.from_params(
@@ -517,17 +528,71 @@ def create_app(config: Optional[AppConfig] = None,
             # Parse errors return the message body (the reference's 400
             # path, ImageRegionMicroserviceVerticle.java:300-305).
             return web.Response(status=400, text=str(e))
-        try:
-            body = await image_handler.render_image_region(ctx)
-        except Exception as e:
-            return _status_of(e)
         headers = {
             "Content-Type": codecs.CONTENT_TYPES.get(
                 ctx.format, "application/octet-stream"),
         }
         if config.cache_control_header:
             headers["Cache-Control"] = config.cache_control_header
-        return web.Response(body=body, headers=headers)
+        stream_fn = (getattr(image_handler,
+                             "render_image_region_stream", None)
+                     if config.wire.streaming else None)
+        if stream_fn is None:
+            try:
+                body = await image_handler.render_image_region(ctx)
+            except Exception as e:
+                return _status_of(e)
+            return web.Response(body=body, headers=headers)
+        # Progressive first-byte-out response (wire v3 leg 2): the
+        # body leaves as an HTTP chunked response, each chunk written
+        # the moment its wire frame (or, combined-mode, the
+        # first-tile-out settled body) arrives — first bytes reach the
+        # client while the rest of the batch is still encoding.  The
+        # FIRST chunk is awaited before the response is prepared, so
+        # every pre-body failure maps through the identical status
+        # contract as the unary path.
+        agen = stream_fn(ctx)
+        try:
+            first = await agen.__anext__()
+        except StopAsyncIteration:
+            first = b""
+        except Exception as e:
+            return _status_of(e)
+        resp = web.StreamResponse(headers=headers)
+        nbytes = 0
+        try:
+            await resp.prepare(request)
+            if first:
+                await resp.write(first)
+                nbytes += len(first)
+            telemetry.record_span(
+                "http.firstByte", t_req,
+                (_time.perf_counter() - t_req) * 1000.0)
+            async for chunk in agen:
+                await resp.write(chunk)
+                nbytes += len(chunk)
+            await resp.write_eof()
+        except ConnectionResetError:
+            # The HTTP CLIENT went away mid-stream (with buffered
+            # responses aiohttp swallows this internally; manual
+            # StreamResponse writes surface it here).  A peer's
+            # disconnect is not a server failure — stop writing and
+            # account what left.
+            request["streamed_nbytes"] = nbytes
+            log.debug("client disconnected mid-stream")
+            return resp
+        except Exception:
+            # Mid-stream RENDER failure with bytes already on the
+            # wire: the status cannot be rewritten under them —
+            # truncate the connection (the client sees a short chunked
+            # body), and let _observed's abort accounting see the
+            # raise.
+            request["streamed_nbytes"] = nbytes
+            log.warning("streamed render truncated mid-body",
+                        exc_info=True)
+            raise
+        request["streamed_nbytes"] = nbytes
+        return resp
 
     async def render_shape_mask(request: web.Request) -> web.Response:
         params = _params_of(request)
@@ -629,8 +694,12 @@ def create_app(config: Optional[AppConfig] = None,
                 raise
             total_ms = (_time.perf_counter() - t0) * 1000.0
             trace = telemetry.TRACES.finish(trace_id)
-            _finish_request(route, resp.status,
-                            len(resp.body) if resp.body else 0,
+            nbytes = request.get("streamed_nbytes")
+            if nbytes is None:
+                # Buffered Response path; StreamResponse has no .body.
+                body = getattr(resp, "body", None)
+                nbytes = len(body) if body else 0
+            _finish_request(route, resp.status, nbytes,
                             total_ms, trace)
             return resp
 
@@ -651,6 +720,10 @@ def create_app(config: Optional[AppConfig] = None,
         # retries, deadline cancellations, supervisor restarts.
         lines += telemetry.resilience_metric_lines(
             breaker=(client.breaker if services is None else None))
+        # Wire transport series: vectored-flush coalescing, shm-ring
+        # hits/fallbacks, chunk streams (this process's side of the
+        # socket; the sidecar merge below carries the other side).
+        lines += telemetry.wire_metric_lines()
         if services is None:
             # Frontend proxy: local series plus the device process's
             # fetched over the sidecar socket (best-effort with a hard

@@ -231,6 +231,14 @@ class BatchingRenderer:
         # mesh-topology-bound and must stay on the pod's lockstep
         # compile path.
         self.exec_cache = None
+        # First-tile-out settlement (wire.streaming): JPEG pendings
+        # resolve the moment THEIR tile's entropy-encode slice lands,
+        # instead of at the whole group's barrier — the first tile of
+        # a B-tile group answers up to a batch-tail earlier, and the
+        # sidecar's chunk frames forward it while siblings still
+        # encode.  Byte-identical either way (the bytes ARE the
+        # returned list's entries); settlement is loop-threadsafe.
+        self.first_tile_out = True
 
     def _count_batch(self, tiles: int) -> None:
         """Metrics update; group renders run concurrently on worker
@@ -681,6 +689,33 @@ class BatchingRenderer:
             return self.engine_controller.current()
         return self.jpeg_engine
 
+    def _early_settle_cb(self, group: List[_Pending]):
+        """First-tile-out hook for a JPEG group: resolve pending ``i``
+        from the encode worker thread the moment its bytes exist.  The
+        final group settle skips already-done futures, so this only
+        ever MOVES a resolution earlier — same bytes, same error paths
+        (a group failure after some tiles settled fails only the
+        still-pending members, exactly like a partial disconnect)."""
+        if not self.first_tile_out:
+            return None
+        n = len(group)
+
+        def on_tile(i: int, data: bytes) -> None:
+            if i >= n:
+                return                     # batch-shape pad entries
+            fut = group[i].future
+            if fut is None:
+                return    # harness-driven group (no waiter to settle)
+
+            def settle() -> None:
+                if not fut.done():
+                    fut.set_result(data)
+            try:
+                fut.get_loop().call_soon_threadsafe(settle)
+            except RuntimeError:
+                pass                       # loop already closed
+        return on_tile
+
     def _render_group_jpeg(self, group: List[_Pending]) -> List[bytes]:
         from ..ops.jpegenc import render_batch_to_jpeg
 
@@ -700,6 +735,7 @@ class BatchingRenderer:
                     quality=group[0].quality,
                     dims=[(p.w, p.h) for p in group],  # pads skip encode
                     engine=self._current_engine(),
+                    on_tile=self._early_settle_cb(group),
                 )
             exec_ms = (time.perf_counter() - t0) * 1000.0
         # Observed-only for JPEG groups: the wire span conflates device

@@ -131,6 +131,33 @@ class SidecarConfig:
 
 
 @dataclass
+class WireConfig:
+    """Frontend<->sidecar transport knobs (wire protocol v3 — see
+    deploy/DEPLOY.md "Wire transport").  All three legs degrade
+    per-feature against previous-round peers, so a mixed-version fleet
+    keeps serving on the v2 behavior."""
+
+    # Scatter-gather frame coalescing: queued frames flush as ONE
+    # vectored write + ONE drain(), bounded per flush by these two
+    # knobs.  Purely sender-local (the byte stream is identical), so
+    # it needs no negotiation and no version gate.
+    coalesce_max_frames: int = 64
+    coalesce_max_bytes: int = 1 * 1024 * 1024
+    # Same-host shared-memory ring per connection direction: bodies of
+    # at least ring-min-body-bytes ride the ring with only a
+    # descriptor frame on the socket.  0 disables (and declines peer
+    # hellos offering one).  Negotiation failure or ring exhaustion
+    # falls back to socket bodies automatically.
+    ring_bytes: int = 32 * 1024 * 1024
+    ring_min_body_bytes: int = 4096
+    # Progressive first-tile-out streaming: render responses leave as
+    # per-tile chunk frames the moment the tile's encode slice lands,
+    # and the HTTP frontend forwards them as a chunked response.
+    streaming: bool = True
+    chunk_max_bytes: int = 256 * 1024
+
+
+@dataclass
 class ParallelConfig:
     """Mesh-sharded serving (≙ the reference's ``-cluster`` mode:
     Hazelcast-clustered worker verticles,
@@ -348,6 +375,7 @@ class AppConfig:
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     sidecar: SidecarConfig = field(default_factory=SidecarConfig)
+    wire: WireConfig = field(default_factory=WireConfig)
     persistence: PersistenceConfig = field(
         default_factory=PersistenceConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
@@ -483,6 +511,31 @@ class AppConfig:
         if cfg.sidecar.role != "combined" and not cfg.sidecar.socket:
             raise ValueError(f"sidecar.role {cfg.sidecar.role!r} "
                              f"requires sidecar.socket")
+        wi = raw.get("wire", {}) or {}
+        wi_defaults = WireConfig()
+        cfg.wire = WireConfig(
+            coalesce_max_frames=int(wi.get(
+                "coalesce-max-frames", wi_defaults.coalesce_max_frames)),
+            coalesce_max_bytes=int(wi.get(
+                "coalesce-max-bytes", wi_defaults.coalesce_max_bytes)),
+            ring_bytes=int(wi.get("ring-bytes", wi_defaults.ring_bytes)),
+            ring_min_body_bytes=int(wi.get(
+                "ring-min-body-bytes", wi_defaults.ring_min_body_bytes)),
+            streaming=bool(wi.get("streaming", wi_defaults.streaming)),
+            chunk_max_bytes=int(wi.get(
+                "chunk-max-bytes", wi_defaults.chunk_max_bytes)),
+        )
+        if cfg.wire.coalesce_max_frames < 1:
+            raise ValueError("wire.coalesce-max-frames must be >= 1")
+        if cfg.wire.coalesce_max_bytes < 4096:
+            raise ValueError("wire.coalesce-max-bytes must be >= 4096")
+        if cfg.wire.ring_bytes != 0 and cfg.wire.ring_bytes < 1024 * 1024:
+            raise ValueError("wire.ring-bytes must be 0 (disabled) or "
+                             ">= 1 MiB")
+        if cfg.wire.ring_min_body_bytes < 1:
+            raise ValueError("wire.ring-min-body-bytes must be >= 1")
+        if cfg.wire.chunk_max_bytes < 4096:
+            raise ValueError("wire.chunk-max-bytes must be >= 4096")
         par = raw.get("parallel", {}) or {}
         par_defaults = ParallelConfig()
         cfg.parallel = ParallelConfig(

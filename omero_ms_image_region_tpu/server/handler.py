@@ -449,6 +449,16 @@ class ImageRegionHandler:
                 (_time.perf_counter() - t0) * 1000.0)
         return data
 
+    async def render_image_region_stream(self, ctx: ImageRegionCtx):
+        """Progressive surface parity with the sidecar proxy
+        (``SidecarImageHandler.render_image_region_stream``): combined
+        mode has no wire hop to pipeline over, so the stream is the one
+        body — which the batcher's first-tile-out settlement already
+        resolves the moment this tile's encode slice lands, a
+        batch-tail ahead of the v2 barrier.  The HTTP layer gets ONE
+        uniform chunked-response path either way."""
+        yield await self.render_image_region(ctx)
+
     # --------------------------------------------------------- pipeline
 
     async def _open_pixel_source(self, image_id: int, pixels: Pixels):

@@ -50,28 +50,59 @@ Client-side policy — op-aware retry with capped backoff + jitter and a
 consecutive-failure circuit breaker — lives in
 :class:`SidecarClient`/:mod:`..utils.transient`; ``plane_put`` is
 never auto-retried.
+
+Protocol v3 is the streaming zero-copy wire (``WireConfig`` knobs,
+DEPLOY.md "Wire transport"), three independent legs that each degrade
+to the v2 behavior against an older peer:
+
+* **Scatter-gather frame coalescing** — every connection's outbound
+  frames queue in a :class:`FrameWriter` and flush as ONE vectored
+  ``writer.writelines`` + ONE ``drain()`` (the ``native/wirepack.cpp``
+  gather-then-write idiom), so N multiplexed frames cost one syscall
+  and one tunnel round-trip instead of N.  Sender-local: the byte
+  stream is identical, so no negotiation and no version gate.
+* **Progressive chunk streaming** — a request carrying ``stream: 1``
+  may be answered as ordered chunk frames ``{id, seq}`` + body
+  followed by a final ``{id, status, fin: true}`` frame (which still
+  carries the spans/costs exports).  Concatenated chunks are
+  byte-identical to the v2 single-frame body.  A v2 server ignores the
+  unknown ``stream`` key and answers one frame; the client treats that
+  as a single-chunk stream — per-request degradation, no handshake.
+* **Same-host shared-memory ring** — negotiated by a ``hello`` op at
+  connection setup: the client creates BOTH directions' ring segments
+  (``server.shmring``) and offers their names; a server that attaches
+  answers ``ring: true`` and MB-scale bodies (``plane_put`` uploads,
+  rendered tiles) then ride the ring with only a tiny
+  ``ring: [offset, length]`` descriptor on the socket.  A v2 server
+  answers the unknown ``hello`` with 400 — the client destroys the
+  segments and everything runs on the socket; ring exhaustion falls
+  back per-body.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import logging
 import os
 import struct
-from typing import Dict, Optional
+from typing import Deque, Dict, Optional, Tuple
 
 from ..utils import telemetry
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
 from .errors import NotFoundError
+from .shmring import RingError, ShmRing
 
 logger = logging.getLogger(__name__)
 
 _MAX_FRAME = 256 * 1024 * 1024
 # Wire protocol generation: 2 = the digest-first plane ops
-# (plane_probe / plane_put).  Sent in every request header; servers
-# tolerate its absence (v1 clients never use the new ops).
-WIRE_VERSION = 2
+# (plane_probe / plane_put); 3 = the streaming zero-copy wire (hello
+# negotiation, chunked responses, shm-ring descriptors).  Sent in
+# every request header; servers tolerate its absence and every v3
+# feature degrades per-feature against a v2 peer.
+WIRE_VERSION = 3
 
 
 def parse_address(addr: str):
@@ -141,6 +172,140 @@ async def _read_frame(reader: asyncio.StreamReader):
     return header, payload[4 + header_len:]
 
 
+def _ring_body(ring: Optional[ShmRing], header: dict, body: bytes):
+    """Resolve a frame's body: a ``ring: [off, len]`` descriptor reads
+    (and releases) the shared-memory ring; anything else is the socket
+    body as-is.  Raises :class:`shmring.RingError` on a descriptor with
+    no negotiated ring or one outside the live window — hostile input
+    degrades to a clean protocol error, never an out-of-window read."""
+    rd = header.get("ring")
+    if rd is None:
+        return body
+    if ring is None:
+        raise RingError("ring descriptor on a connection with no "
+                        "negotiated ring")
+    if not isinstance(rd, (list, tuple)) or len(rd) != 2:
+        raise RingError(f"malformed ring descriptor {rd!r}")
+    return ring.read_release(rd[0], rd[1])
+
+
+class FrameWriter:
+    """Per-connection scatter-gather frame writer (protocol v3 leg 1).
+
+    Frames enqueue here and ONE flusher task hands the whole backlog to
+    ``writer.writelines`` as a list of buffers with a single ``drain()``
+    per flush — N small frames cost one syscall and one round-trip
+    instead of N (``native/wirepack.cpp``'s gather-then-write idiom,
+    lifted to the socket).  This also retires the old ``respond()``
+    hazard: no lock is held across ``drain()`` anymore, so a
+    slow-reading peer backpressures only the flusher — concurrent
+    responders keep enqueueing and their frames coalesce into the next
+    flush instead of serializing behind the stalled drain.
+
+    When a same-host ring is negotiated (``self.ring``), bodies of at
+    least ``ring_min_bytes`` ride it and the frame shrinks to a
+    descriptor; ring exhaustion falls back to a socket body per-frame.
+    Ring allocations happen at ENQUEUE time on the event loop, so
+    descriptor order on the socket equals allocation order — the
+    consumer's in-order release needs nothing more.
+    """
+
+    def __init__(self, writer: asyncio.StreamWriter,
+                 max_frames: int = 64, max_bytes: int = 1 << 20):
+        self.writer = writer
+        self.max_frames = max(1, int(max_frames))
+        self.max_bytes = max(4096, int(max_bytes))
+        self.ring: Optional[ShmRing] = None
+        self.ring_min_bytes = 4096
+        self._pending: Deque[tuple] = collections.deque()
+        self._wake = asyncio.Event()
+        self._dead: Optional[BaseException] = None
+        self._task: Optional[asyncio.Task] = \
+            asyncio.create_task(self._flush_loop())
+
+    def _buffers(self, header: dict, body) -> list:
+        n = len(body) if body else 0
+        if self.ring is not None and n >= self.ring_min_bytes:
+            off = self.ring.alloc_write(body)
+            if off is not None:
+                header = dict(header)
+                header["ring"] = [off, n]
+                telemetry.WIRE.count_ring(n, hit=True)
+                return [_pack_prefix(header, 0)]
+            telemetry.WIRE.count_ring(n, hit=False)
+        prefix = _pack_prefix(header, n)
+        if not n:
+            return [prefix]
+        # No concatenation: MB-scale bodies (plane uploads, tile
+        # chunks) go to the transport as their own buffer.
+        return [prefix, body if isinstance(body, (bytes, memoryview))
+                else memoryview(body)]
+
+    async def send(self, header: dict, body=b"") -> None:
+        """Enqueue one frame and wait until its flush drained (so a
+        sender sees the same ConnectionError surface the direct write
+        had).  Frames enqueued while a flush is in flight coalesce
+        into the next one."""
+        if self._dead is not None:
+            raise ConnectionError(str(self._dead)
+                                  or "wire writer closed")
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((self._buffers(header, body), fut))
+        self._wake.set()
+        await fut
+
+    async def _flush_loop(self) -> None:
+        try:
+            while True:
+                await self._wake.wait()
+                self._wake.clear()
+                while self._pending:
+                    batch = []
+                    nbytes = 0
+                    while (self._pending
+                           and len(batch) < self.max_frames
+                           and nbytes < self.max_bytes):
+                        bufs, fut = self._pending.popleft()
+                        batch.append((bufs, fut))
+                        nbytes += sum(len(b) for b in bufs)
+                    try:
+                        self.writer.writelines(
+                            [b for bufs, _ in batch for b in bufs])
+                        await self.writer.drain()
+                    except asyncio.CancelledError:
+                        self._fail(ConnectionError(
+                            "wire writer closed"), batch)
+                        raise
+                    except Exception as e:
+                        # ConnectionError/OSError is the expected
+                        # class; anything else still must not strand
+                        # senders parked on their flush futures.
+                        self._fail(e, batch)
+                        return
+                    telemetry.WIRE.observe_flush(len(batch), nbytes)
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_result(None)
+        except asyncio.CancelledError:
+            self._fail(ConnectionError("wire writer closed"), ())
+            raise
+
+    def _fail(self, exc: BaseException, batch) -> None:
+        self._dead = exc
+        for _, fut in list(batch) + list(self._pending):
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    def close(self) -> None:
+        """Stop the flusher and fail queued senders; idempotent."""
+        if self._dead is None:
+            self._dead = ConnectionError("wire writer closed")
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+
+
 # ---------------------------------------------------------------- server
 
 async def _plane_put(image_handler, header: dict,
@@ -202,9 +367,50 @@ async def _plane_put(image_handler, header: dict,
                        "resident": was_resident}).encode()
 
 
+def _server_hello(header: dict, frames: FrameWriter, wire) -> tuple:
+    """Negotiate the ``hello`` op server-side: attach the client's ring
+    segments when offered (and enabled), answer the feature document.
+    Returns ``(body, recv_ring, attached)`` — ``recv_ring`` resolves
+    request-body descriptors, ``attached`` lists rings to close at
+    teardown.  ANY attach failure degrades to ``ring: false`` (socket
+    bodies), never an error surface."""
+    ring_ok = False
+    recv_ring = None
+    attached: list = []
+    rings = header.get("rings")
+    ring_enabled = wire is None or wire.ring_bytes > 0
+    if isinstance(rings, dict) and ring_enabled:
+        try:
+            c2s_spec, s2c_spec = rings["c2s"], rings["s2c"]
+            c2s = ShmRing.attach(str(c2s_spec["name"]),
+                                 int(c2s_spec["size"]))
+            attached.append(c2s)
+            s2c = ShmRing.attach(str(s2c_spec["name"]),
+                                 int(s2c_spec["size"]))
+            attached.append(s2c)
+            recv_ring = c2s
+            frames.ring = s2c
+            if wire is not None:
+                frames.ring_min_bytes = wire.ring_min_body_bytes
+            ring_ok = True
+        except Exception as e:
+            # Cross-host TCP peer, /dev/shm unavailable, size
+            # mismatch, hostile hello: all the same degrade.
+            for r in attached:
+                r.close()
+            attached = []
+            recv_ring = None
+            frames.ring = None
+            logger.info("shm ring negotiation failed (%s); "
+                        "socket bodies", e)
+    telemetry.WIRE.count_negotiation(ring=ring_ok)
+    body = json.dumps({"v": WIRE_VERSION, "ring": ring_ok}).encode()
+    return body, recv_ring, attached
+
+
 async def _serve_connection(image_handler, mask_handler, reader, writer,
                             status_fn=None, profile_fn=None,
-                            warmstate_fn=None):
+                            warmstate_fn=None, wire=None):
     """One frontend connection: demux requests, run each as a task.
 
     ``status_fn`` answers the ``ping`` op (readiness state for the
@@ -213,14 +419,29 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
     ``jax.profiler`` capture in THIS device-owning process); None
     rejects the op.  ``warmstate_fn(snapshot)`` serves the
     ``warmstate`` op — persistence status (+ on-demand snapshot) from
-    the process that owns the warm state; None rejects the op."""
-    write_lock = asyncio.Lock()
+    the process that owns the warm state; None rejects the op.
+    ``wire`` is the ``WireConfig`` (None = defaults): coalescing
+    bounds, ring acceptance, chunk sizing."""
+    frames = FrameWriter(
+        writer,
+        max_frames=(wire.coalesce_max_frames if wire is not None
+                    else 64),
+        max_bytes=(wire.coalesce_max_bytes if wire is not None
+                   else 1 << 20))
+    chunk_max = (wire.chunk_max_bytes if wire is not None
+                 else 256 * 1024)
     tasks = set()
+    # The client's c2s ring (attached at hello) resolving request-body
+    # descriptors; list-wrapped so the read loop sees the swap.
+    ring_state: dict = {"recv": None, "attached": []}
 
     async def respond(header: dict, body: bytes = b"") -> None:
-        async with write_lock:
-            writer.write(_pack(header, body))
-            await writer.drain()
+        # Enqueue-and-flush through the FrameWriter: the old form held
+        # a write lock across ``drain()``, so ONE slow-reading frontend
+        # serialized every response on the connection behind its
+        # stalled socket; now concurrent responders coalesce into the
+        # next vectored flush instead.
+        await frames.send(header, body)
 
     async def handle(header: dict, req_body: bytes = b"") -> None:
         from ..utils import faultinject, transient
@@ -308,6 +529,10 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 # frontend-local and stays out of this copy.
                 lines += telemetry.resilience_metric_lines(
                     extra_labels=',process="sidecar"')
+                # This side of the wire: server-side flush coalescing,
+                # ring traffic, chunk streams.
+                lines += telemetry.wire_metric_lines(
+                    ',process="sidecar"')
                 body = ("\n".join(lines) + "\n").encode()
             elif op == "plane_probe":
                 # Digest-first residency probe: the peer only ships the
@@ -425,7 +650,32 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
             telemetry.FLIGHT.record("sidecar.op-error", op=header.get(
                 "op"), status=out["status"])
         try:
-            await respond(out, body)
+            if (header.get("stream") and out["status"] == 200 and body
+                    and header.get("op") in ("image", "mask")):
+                # Progressive answer (protocol v3 leg 2): the body
+                # leaves as ordered chunk frames the moment it exists —
+                # which, with the batcher's first-tile-out settlement,
+                # is one batch-tail EARLIER than the v2 barrier — and
+                # the final fin frame carries status + spans/costs.
+                # Concatenated chunks are byte-identical to the v2
+                # single-frame body; a v2 client never sets ``stream``.
+                mv = memoryview(body)
+                seq = 0
+                for off in range(0, len(mv), chunk_max):
+                    # The slice goes down as a memoryview: the frame
+                    # writer (and the ring) take buffers as-is, so a
+                    # streamed body costs zero extra copies on the
+                    # socket path — ``body`` outlives the awaited
+                    # flush by construction.
+                    await respond({"id": rid, "seq": seq},
+                                  mv[off:off + chunk_max])
+                    seq += 1
+                out["fin"] = True
+                out["chunks"] = seq
+                telemetry.WIRE.count_stream(seq)
+                await respond(out)
+            else:
+                await respond(out, body)
         except (ConnectionError, OSError):
             # The frontend died mid-response (its crash is survivable by
             # design); the render itself completed fine.
@@ -437,6 +687,51 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 header, req_body = await _read_frame(reader)
             except (asyncio.IncompleteReadError, ConnectionResetError):
                 break
+            except ValueError as e:
+                # Malformed frame (oversize, bad lengths, broken JSON):
+                # hostile or corrupt input answers a clean protocol
+                # error and the connection closes — never an unhandled
+                # exception wedging the serve task.
+                telemetry.FLIGHT.record("wire.frame-error",
+                                        error=str(e)[:120])
+                try:
+                    await respond({"id": None, "status": 400,
+                                   "error": f"malformed frame: {e}"})
+                except (ConnectionError, OSError):
+                    pass
+                break
+            try:
+                req_body = _ring_body(ring_state["recv"], header,
+                                      req_body)
+            except RingError as e:
+                # A descriptor outside the live window poisons the
+                # ring's release ordering: answer the op cleanly, then
+                # drop the connection (the client reconnects; v2
+                # socket bodies would resume on the new connection if
+                # negotiation keeps failing).
+                telemetry.FLIGHT.record("wire.ring-error",
+                                        error=str(e)[:120])
+                try:
+                    await respond({"id": header.get("id"),
+                                   "status": 400,
+                                   "error": f"bad ring descriptor: "
+                                            f"{e}"})
+                except (ConnectionError, OSError):
+                    pass
+                break
+            if header.get("op") == "hello":
+                # Handshake, inline (never a task): the recv ring must
+                # be live before any later frame's descriptor resolves.
+                body, recv_ring, attached = _server_hello(
+                    header, frames, wire)
+                ring_state["recv"] = recv_ring
+                ring_state["attached"] += attached
+                try:
+                    await respond({"id": header.get("id"),
+                                   "status": 200}, body)
+                except (ConnectionError, OSError):
+                    break
+                continue
             t = asyncio.create_task(handle(header, req_body))
             tasks.add(t)
             t.add_done_callback(tasks.discard)
@@ -448,6 +743,11 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
             t.cancel()
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
+        frames.close()
+        for r in ring_state["attached"]:
+            # Attach-side close only: the client created the segments
+            # and owns their unlink.
+            r.close()
         writer.close()
 
 
@@ -553,7 +853,8 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
             await _serve_connection(image_handler, mask_handler, reader,
                                     writer, status_fn=status_fn,
                                     profile_fn=profile_fn,
-                                    warmstate_fn=warmstate_fn)
+                                    warmstate_fn=warmstate_fn,
+                                    wire=getattr(config, "wire", None))
         finally:
             conn_tasks.discard(task)
 
@@ -621,31 +922,59 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
 
 # ---------------------------------------------------------------- client
 
+class _StreamSink:
+    """Chunk-frame consumer for one streaming call (protocol v3): the
+    read loop pushes ordered chunk frames and the final status frame;
+    :meth:`SidecarClient.call_stream` drains them as a generator."""
+
+    def __init__(self):
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    def push(self, header: dict, body: bytes) -> None:
+        self.queue.put_nowait(("chunk", header, body))
+
+    def finish(self, header: dict, body: bytes) -> None:
+        self.queue.put_nowait(("final", header, body))
+
+    def fail(self, exc: BaseException) -> None:
+        self.queue.put_nowait(("error", exc, b""))
+
+
 class _Conn:
-    """One connection generation: its writer, its pending futures, its
-    read loop.  A stale generation's failure can then never touch a
-    newer generation's state."""
+    """One connection generation: its writer, its pending waiters, its
+    read loop, its negotiated wire features.  A stale generation's
+    failure can then never touch a newer generation's state."""
 
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter):
         self.reader = reader
         self.writer = writer
-        self.pending: Dict[int, asyncio.Future] = {}
+        # rid -> asyncio.Future (unary call) or _StreamSink (stream).
+        self.pending: Dict[int, object] = {}
         self.reader_task: Optional[asyncio.Task] = None
+        self.frames: Optional[FrameWriter] = None
+        # v3 negotiation state: a peer that rejected the hello is v2 —
+        # streaming requests still go out (the key is ignored there),
+        # but the ring stays down for the connection's life.
+        self.peer_v3 = False
+        self.recv_ring: Optional[ShmRing] = None
+        # Client-created segments (both directions); closed AND
+        # unlinked with the connection.
+        self.owned_rings: Tuple[ShmRing, ...] = ()
         # Set (to the failure) BEFORE pendings are drained: a caller
         # that raced the read loop's death — ensure_connected returned
         # this generation an await ago — must fail at registration, not
         # park a future no reader will ever resolve.
         self.dead: Optional[BaseException] = None
 
-    def register(self, rid: int, fut: asyncio.Future) -> None:
-        """Park a waiter; refuses (raising the death cause) once the
-        connection is marked dead, closing the enqueue/fail_pending
-        race that could strand a request forever."""
+    def register(self, rid: int, waiter) -> None:
+        """Park a waiter (future or stream sink); refuses (raising the
+        death cause) once the connection is marked dead, closing the
+        enqueue/fail_pending race that could strand a request forever."""
         if self.dead is not None:
             raise ConnectionError(str(self.dead) or
                                   "render sidecar went away")
-        self.pending[rid] = fut
+        self.pending[rid] = waiter
 
     def fail_pending(self, exc: BaseException) -> None:
         self.dead = exc
@@ -654,9 +983,19 @@ class _Conn:
         # would otherwise hang.  New registrations are already refused
         # via ``dead`` above.
         while self.pending:
-            _, fut = self.pending.popitem()
-            if not fut.done():
-                fut.set_exception(exc)
+            _, waiter = self.pending.popitem()
+            if isinstance(waiter, _StreamSink):
+                waiter.fail(exc)
+            elif not waiter.done():
+                waiter.set_exception(exc)
+
+    def release_rings(self) -> None:
+        """Teardown of this generation's ring segments (creator side:
+        close + unlink)."""
+        for r in self.owned_rings:
+            r.close()
+        self.owned_rings = ()
+        self.recv_ring = None
 
 
 class SidecarClient:
@@ -676,13 +1015,15 @@ class SidecarClient:
     _DEFAULT = object()   # "construct the standard policy" sentinel
 
     def __init__(self, socket_path: str, breaker=_DEFAULT,
-                 retry=_DEFAULT):
+                 retry=_DEFAULT, wire=None):
         from ..utils.transient import CircuitBreaker, RetryPolicy
+        from .config import WireConfig
         self.socket_path = socket_path
         self.breaker = (CircuitBreaker()
                         if breaker is self._DEFAULT else breaker)
         self.retry = (RetryPolicy()
                       if retry is self._DEFAULT else retry)
+        self.wire = wire if wire is not None else WireConfig()
         self._conn: Optional[_Conn] = None
         self._next_id = 0
         self._conn_lock = asyncio.Lock()
@@ -699,28 +1040,147 @@ class SidecarClient:
             reader, writer = await open_sidecar_connection(
                 self.socket_path)
             conn = _Conn(reader, writer)
+            conn.frames = FrameWriter(
+                writer, max_frames=self.wire.coalesce_max_frames,
+                max_bytes=self.wire.coalesce_max_bytes)
             conn.reader_task = asyncio.create_task(
                 self._read_loop(conn))
+            try:
+                await self._negotiate(conn)
+            except BaseException:
+                self._drop_conn(conn)
+                raise
             self._conn = conn
             return conn
+
+    async def _negotiate(self, conn: _Conn) -> None:
+        """Protocol v3 handshake (one RTT per connection LIFE, not per
+        call): offer the client-created ring segments, learn the peer's
+        generation.  A v2 peer answers the unknown ``hello`` op with
+        400 — the segments are destroyed and every feature degrades to
+        its v2 behavior; only a dead connection raises."""
+        rings: Tuple[ShmRing, ...] = ()
+        if self.wire.ring_bytes > 0:
+            created: list = []
+            try:
+                created.append(ShmRing.create(self.wire.ring_bytes))
+                created.append(ShmRing.create(self.wire.ring_bytes))
+                rings = tuple(created)
+            except Exception as e:
+                # No /dev/shm (or an exhausted one): socket bodies.
+                # The FIRST segment must not leak when the second
+                # create is what failed.
+                logger.info("shm ring unavailable (%s); socket "
+                            "bodies", e)
+                for r in created:
+                    r.close()
+                rings = ()
+        self._next_id += 1
+        rid = self._next_id
+        fut = asyncio.get_running_loop().create_future()
+        header = {"id": rid, "op": "hello", "v": WIRE_VERSION}
+        if rings:
+            header["rings"] = {
+                "c2s": {"name": rings[0].name,
+                        "size": self.wire.ring_bytes},
+                "s2c": {"name": rings[1].name,
+                        "size": self.wire.ring_bytes},
+            }
+        try:
+            conn.register(rid, fut)
+            await conn.frames.send(header)
+            resp_header, resp_body = await asyncio.wait_for(fut, 10.0)
+        except asyncio.TimeoutError:
+            # A peer that answers nothing to an unknown op (no known
+            # generation does this, but the wire is a contract): treat
+            # as v2 rather than failing the connection.
+            conn.pending.pop(rid, None)
+            for r in rings:
+                r.close()
+            telemetry.WIRE.count_negotiation(ring=False)
+            return
+        except BaseException:
+            # ConnectionError, register on a dead conn, CancelledError
+            # (the caller's request task torn down mid-handshake): the
+            # segments are not yet owned by the conn, so nobody else
+            # can release them — a leak here compounds 2x ring-bytes
+            # per reconnect attempt.
+            for r in rings:
+                r.close()
+            raise
+        doc = {}
+        if resp_header.get("status") == 200:
+            try:
+                doc = json.loads(bytes(resp_body).decode())
+            except (ValueError, AttributeError):
+                doc = {}
+        ring_ok = bool(rings and doc.get("ring")
+                       and int(doc.get("v", 2)) >= 3)
+        conn.peer_v3 = int(doc.get("v", 2)) >= 3 \
+            if resp_header.get("status") == 200 else False
+        if ring_ok:
+            conn.owned_rings = rings
+            conn.frames.ring = rings[0]            # c2s: our bodies out
+            conn.frames.ring_min_bytes = self.wire.ring_min_body_bytes
+            conn.recv_ring = rings[1]              # s2c: peer bodies in
+        else:
+            for r in rings:
+                r.close()
+        telemetry.WIRE.count_negotiation(ring=ring_ok)
+
+    def _drop_conn(self, conn: _Conn) -> None:
+        """Generation-local teardown (send failure, protocol
+        corruption): fail its waiters, stop its flusher, release its
+        rings; a newer generation is untouched."""
+        conn.fail_pending(ConnectionError("render sidecar went away"))
+        if conn.frames is not None:
+            conn.frames.close()
+        if conn.reader_task is not None:
+            conn.reader_task.cancel()
+        conn.writer.close()
+        conn.release_rings()
+        if self._conn is conn:
+            self._conn = None
 
     async def _read_loop(self, conn: _Conn) -> None:
         try:
             while True:
                 header, body = await _read_frame(conn.reader)
-                fut = conn.pending.pop(header.get("id"), None)
-                if fut is not None and not fut.done():
-                    fut.set_result((header, body))
+                body = _ring_body(conn.recv_ring, header, body)
+                rid = header.get("id")
+                waiter = conn.pending.get(rid)
+                if isinstance(waiter, _StreamSink):
+                    if "status" in header:
+                        # fin frame: status + spans/costs (or the v2
+                        # single-frame answer with the whole body).
+                        conn.pending.pop(rid, None)
+                        waiter.finish(header, body)
+                    else:
+                        waiter.push(header, body)
+                else:
+                    conn.pending.pop(rid, None)
+                    if waiter is not None and not waiter.done():
+                        waiter.set_result((header, body))
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 asyncio.CancelledError, OSError):
             pass
+        except (RingError, ValueError) as e:
+            # A corrupt frame or descriptor means the stream can no
+            # longer be trusted; fail cleanly and reconnect — never
+            # hand garbage bytes to a waiter.
+            logger.warning("sidecar wire protocol error: %s", e)
+            telemetry.FLIGHT.record("wire.protocol-error",
+                                    error=str(e)[:120])
         finally:
             # Strictly generation-local: fail THIS connection's waiters
             # and close THIS writer; a newer generation opened by a
             # retry is untouched.
             conn.fail_pending(
                 ConnectionError("render sidecar went away"))
+            if conn.frames is not None:
+                conn.frames.close()
             conn.writer.close()
+            conn.release_rings()
             if self._conn is conn:
                 self._conn = None
 
@@ -800,14 +1260,11 @@ class SidecarClient:
                     if fault is not None:
                         await self._inject_wire_fault(conn, fault,
                                                       header, body)
-                async with self._write_lock:
-                    # Two writes, no concatenation: plane_put bodies
-                    # are MB-scale and the single-buffer _pack form
-                    # copied them once more per upload.
-                    conn.writer.write(_pack_prefix(header, len(body)))
-                    if body:
-                        conn.writer.write(body)
-                    await conn.writer.drain()
+                # Vectored path: the frame queues on the connection's
+                # FrameWriter and flushes with whatever else is
+                # pending as ONE writelines + drain (bodies ride the
+                # negotiated shm ring when they qualify).
+                await conn.frames.send(header, body)
                 if remaining is not None:
                     # A wedged sidecar must not hold this caller past
                     # its budget: stop waiting at budget end.  The
@@ -824,43 +1281,11 @@ class SidecarClient:
                 else:
                     resp_header, resp_body = await fut
             except (ConnectionError, OSError) as exc:
-                if conn is not None:
-                    conn.pending.pop(rid, None)
-                    if (fut is not None and fut.done()
-                            and not fut.cancelled()):
-                        fut.exception()   # mark retrieved (no noise)
-                    conn.writer.close()
-                    if self._conn is conn:
-                        self._conn = None
-                if self.breaker is not None:
-                    opens_before = self.breaker.opens
-                    self.breaker.record_failure()
-                    if self.breaker.opens > opens_before:
-                        # Breaker transition: exactly the black-box
-                        # event class — the seconds before a shedding
-                        # episode started.
-                        telemetry.FLIGHT.record(
-                            "breaker.open", op=op,
-                            opens=self.breaker.opens)
-                attempt += 1
-                if attempt >= attempts:
-                    telemetry.RESILIENCE.observe_attempts(op, attempt)
-                    telemetry.FLIGHT.record("sidecar.exhausted", op=op,
-                                            attempts=attempt)
-                    raise ConnectionError(
-                        "render sidecar went away") from exc
-                telemetry.RESILIENCE.count_retry(op)
-                telemetry.FLIGHT.record("sidecar.retry", op=op,
-                                        attempt=attempt)
-                backoff = self.retry.backoff_s(attempt - 1)
-                remaining = transient.remaining_ms()
-                if remaining is not None:
-                    # Never sleep past the caller's budget: the next
-                    # loop iteration turns an exhausted budget into a
-                    # DeadlineExceededError instead of a long stall.
-                    backoff = min(backoff, max(0.0, remaining / 1000.0))
-                if backoff > 0:
-                    await asyncio.sleep(backoff)
+                if (fut is not None and fut.done()
+                        and not fut.cancelled()):
+                    fut.exception()   # mark retrieved (no noise)
+                attempt = await self._retry_step(op, conn, rid,
+                                                 attempt, attempts, exc)
                 continue
             if self.breaker is not None:
                 was_closed = self.breaker.state == self.breaker.CLOSED
@@ -869,28 +1294,222 @@ class SidecarClient:
                     # Half-open probe succeeded: the episode is over.
                     telemetry.FLIGHT.record("breaker.close", op=op)
             telemetry.RESILIENCE.observe_attempts(op, attempt + 1)
-            trace_id = telemetry.current_trace_id()
-            if trace_id and resp_header.get("spans"):
-                # Graft the device process's spans onto our waterfall.
-                # Their offsets are relative to the sidecar's request
-                # arrival; anchoring at our send time puts them at most
-                # one wire hop early — invisible at waterfall scale.
-                for s in resp_header["spans"]:
-                    try:
-                        meta = {k: v for k, v in s.items()
-                                if k not in ("name", "start_ms",
-                                             "dur_ms")}
-                        telemetry.record_span(
-                            s["name"],
-                            t_call + s["start_ms"] / 1000.0,
-                            s["dur_ms"], trace_ids=(trace_id,), **meta)
-                    except (KeyError, TypeError):
-                        pass    # malformed span: drop it, keep serving
-            if trace_id and isinstance(resp_header.get("costs"), dict):
-                # Device-side ledger entries (device-execute ms,
-                # staged bytes) join the frontend's per-request ledger.
-                telemetry.merge_costs(trace_id, resp_header["costs"])
+            self._graft_response(resp_header, t_call)
             return resp_header, resp_body
+
+    async def _retry_step(self, op: str, conn: Optional[_Conn],
+                          rid: int, attempt: int, attempts: int,
+                          exc: BaseException) -> int:
+        """ONE failure-bookkeeping ladder shared by the unary and
+        streaming calls (a drifted copy here is a resilience-contract
+        bug): drop the dead connection generation, feed the breaker,
+        count the retry (or raise on exhaustion), and sleep the
+        deadline-capped backoff.  Returns the incremented attempt."""
+        from ..utils import transient
+
+        if conn is not None:
+            conn.pending.pop(rid, None)
+            # The write half can die while the read loop still parks
+            # on a healthy-looking socket: close + clear so the next
+            # attempt reconnects instead of reusing the dead writer.
+            conn.writer.close()
+            if self._conn is conn:
+                self._conn = None
+        if self.breaker is not None:
+            opens_before = self.breaker.opens
+            self.breaker.record_failure()
+            if self.breaker.opens > opens_before:
+                # Breaker transition: exactly the black-box event
+                # class — the seconds before a shedding episode began.
+                telemetry.FLIGHT.record("breaker.open", op=op,
+                                        opens=self.breaker.opens)
+        attempt += 1
+        if attempt >= attempts:
+            telemetry.RESILIENCE.observe_attempts(op, attempt)
+            telemetry.FLIGHT.record("sidecar.exhausted", op=op,
+                                    attempts=attempt)
+            raise ConnectionError("render sidecar went away") from exc
+        telemetry.RESILIENCE.count_retry(op)
+        telemetry.FLIGHT.record("sidecar.retry", op=op,
+                                attempt=attempt)
+        backoff = self.retry.backoff_s(attempt - 1)
+        remaining = transient.remaining_ms()
+        if remaining is not None:
+            # Never sleep past the caller's budget: the next loop
+            # iteration turns an exhausted budget into a
+            # DeadlineExceededError instead of a long stall.
+            backoff = min(backoff, max(0.0, remaining / 1000.0))
+        if backoff > 0:
+            await asyncio.sleep(backoff)
+        return attempt
+
+    def _graft_response(self, resp_header: dict, t_call: float) -> None:
+        """Join the device process's exported spans/costs onto the
+        requesting trace (shared by the unary and streaming paths)."""
+        trace_id = telemetry.current_trace_id()
+        if trace_id and resp_header.get("spans"):
+            # Graft the device process's spans onto our waterfall.
+            # Their offsets are relative to the sidecar's request
+            # arrival; anchoring at our send time puts them at most
+            # one wire hop early — invisible at waterfall scale.
+            for s in resp_header["spans"]:
+                try:
+                    meta = {k: v for k, v in s.items()
+                            if k not in ("name", "start_ms",
+                                         "dur_ms")}
+                    telemetry.record_span(
+                        s["name"],
+                        t_call + s["start_ms"] / 1000.0,
+                        s["dur_ms"], trace_ids=(trace_id,), **meta)
+                except (KeyError, TypeError):
+                    pass    # malformed span: drop it, keep serving
+        if trace_id and isinstance(resp_header.get("costs"), dict):
+            # Device-side ledger entries (device-execute ms,
+            # staged bytes) join the frontend's per-request ledger.
+            telemetry.merge_costs(trace_id, resp_header["costs"])
+
+    async def call_stream(self, op: str, ctx_json: dict,
+                          extra: Optional[dict] = None):
+        """Progressive call (protocol v3 leg 2): an async generator
+        yielding body chunks as their frames arrive; the final frame's
+        status maps through the same exception contract as
+        :meth:`call_full` (raised before the first yield when the
+        request failed outright).  A v2 peer — or a server that chose
+        not to stream this answer — degrades to one yield of the whole
+        body.
+
+        Retry policy: identical to :meth:`call_full` UP TO the first
+        chunk — a connection that dies under the request before any
+        bytes surfaced is re-issued per the op-aware policy and feeds
+        the breaker.  Once a chunk has been yielded, bytes may already
+        be on the HTTP wire, so a mid-stream death surfaces as a
+        ConnectionError for the caller to truncate on.
+        """
+        import time as _time
+
+        from ..utils import faultinject, transient
+        from .errors import OverloadedError
+
+        async def sink_get(sink):
+            remaining = transient.remaining_ms()
+            if remaining is None:
+                return await sink.queue.get()
+            try:
+                return await asyncio.wait_for(
+                    sink.queue.get(),
+                    timeout=max(0.0, remaining) / 1000.0)
+            except asyncio.TimeoutError:
+                raise transient.DeadlineExceededError(
+                    f"sidecar {op}: deadline exceeded awaiting stream")
+
+        attempts = (self.retry.attempts_for(op)
+                    if self.retry is not None else 1)
+        attempt = 0
+        while True:
+            # Pre-first-chunk window: same deadline/breaker/retry
+            # contract as the unary call.
+            transient.check_deadline(f"sidecar {op}")
+            if self.breaker is not None and not self.breaker.allow():
+                raise OverloadedError(
+                    f"sidecar circuit breaker open (op {op})",
+                    retry_after_s=self.breaker.retry_after_s() or 1.0)
+            conn = None
+            rid = 0
+            sink = _StreamSink()
+            try:
+                conn = await self._ensure_connected()
+                self._next_id += 1
+                rid = self._next_id
+                conn.register(rid, sink)
+                header = {"id": rid, "op": op, "ctx": ctx_json,
+                          "v": WIRE_VERSION, "stream": 1}
+                if extra:
+                    header.update(extra)
+                remaining = transient.remaining_ms()
+                if remaining is not None:
+                    header["deadline_ms"] = max(0.0,
+                                                round(remaining, 1))
+                trace_id = telemetry.current_trace_id()
+                if trace_id:
+                    header["trace"] = trace_id
+                t_call = _time.perf_counter()
+                inj = faultinject.active()
+                if inj is not None:
+                    delay = inj.wire_delay_s()
+                    if delay:
+                        await asyncio.sleep(delay)
+                    fault = inj.wire_fault()
+                    if fault is not None:
+                        await self._inject_wire_fault(conn, fault,
+                                                      header, b"")
+                await conn.frames.send(header)
+                kind, first_h, first_body = await sink_get(sink)
+                if kind == "error":
+                    raise ConnectionError(
+                        str(first_h) or "render sidecar went away")
+            except (ConnectionError, OSError) as exc:
+                attempt = await self._retry_step(op, conn, rid,
+                                                 attempt, attempts, exc)
+                continue
+            except BaseException:
+                # Deadline death (or cancellation) while parked on the
+                # sink: the waiter entry must not outlive this call.
+                if conn is not None:
+                    conn.pending.pop(rid, None)
+                raise
+            break
+        telemetry.RESILIENCE.observe_attempts(op, attempt + 1)
+        try:
+            expected_seq = 0
+            final = None
+            final_body = b""
+            kind, h, body = kind, first_h, first_body
+            while True:
+                if kind == "error":
+                    raise ConnectionError(str(h) or
+                                          "render sidecar went away")
+                if kind == "chunk":
+                    seq = h.get("seq")
+                    if seq != expected_seq:
+                        # Reordered/alien chunk framing: the stream
+                        # can't be trusted — clean error, drop the
+                        # generation (never serve spliced bytes).
+                        self._drop_conn(conn)
+                        raise ConnectionError(
+                            f"stream chunk seq {seq!r} != expected "
+                            f"{expected_seq} (op {op})")
+                    expected_seq += 1
+                    if expected_seq == 1:
+                        telemetry.record_span(
+                            "wire.firstChunk", t_call,
+                            (_time.perf_counter() - t_call) * 1000.0,
+                            op=op)
+                    yield bytes(body)
+                else:
+                    final, final_body = h, body
+                    break
+                kind, h, body = await sink_get(sink)
+            if self.breaker is not None:
+                was_closed = self.breaker.state == self.breaker.CLOSED
+                self.breaker.record_success()
+                if not was_closed:
+                    telemetry.FLIGHT.record("breaker.close", op=op)
+            self._graft_response(final, t_call)
+            status = final.get("status")
+            if status != 200:
+                if expected_seq:
+                    # Bytes already surfaced: a status can't be
+                    # re-mapped under them.
+                    raise ConnectionError(
+                        f"stream failed mid-flight ({status})")
+                _map_status(status, final.get("error", ""),
+                            retry_after_s=final.get("retry_after"))
+                return
+            if expected_seq == 0 and final_body:
+                # v2 single-frame answer (or an unstreamed body).
+                yield bytes(final_body)
+        finally:
+            conn.pending.pop(rid, None)
 
     async def _inject_wire_fault(self, conn: _Conn, kind: str,
                                  header: dict, body: bytes) -> None:
@@ -1057,6 +1676,8 @@ class SidecarClient:
         # otherwise beat us to it with the misleading "sidecar went
         # away" on what is a deliberate client shutdown.
         conn.fail_pending(ConnectionError("client closed"))
+        if conn.frames is not None:
+            conn.frames.close()
         if conn.reader_task is not None:
             conn.reader_task.cancel()
             try:
@@ -1064,6 +1685,7 @@ class SidecarClient:
             except asyncio.CancelledError:
                 pass
         conn.writer.close()
+        conn.release_rings()
 
 
 class SidecarImageHandler:
@@ -1094,6 +1716,55 @@ class SidecarImageHandler:
             telemetry.RESILIENCE.count_degraded_render()
             return await self.fallback.render_image_region(ctx)
         return _map_response(resp_header, payload)
+
+    async def render_image_region_stream(self, ctx: ImageRegionCtx):
+        """Progressive render: yields body chunks as their wire frames
+        arrive (concatenation is byte-identical to
+        :meth:`render_image_region`).  ANY pre-first-chunk failure of
+        the v3 stream (exhausted retries, chunk-framing corruption,
+        breaker) degrades to the unary path — which carries its own
+        CPU fallback — so a streaming-feature failure is never an
+        error surface the unary wire would have served through.  A
+        mid-stream death propagates (bytes are already on the HTTP
+        wire — the frontend truncates)."""
+        from .errors import OverloadedError
+        offset = 0
+        try:
+            async for chunk in self.client.call_stream(
+                    "image", ctx.to_json()):
+                offset += len(chunk)
+                yield chunk
+            return
+        except (ConnectionError, OverloadedError):
+            if offset == 0 and self.fallback is not None:
+                # Same landing as the unary path's unreachable case —
+                # call_stream already exhausted the retry policy, so
+                # re-running it through call_full would only double
+                # the backoff ladder in front of the CPU render.
+                telemetry.RESILIENCE.count_degraded_render()
+                yield await self.fallback.render_image_region(ctx)
+                return
+        if offset == 0:
+            # No CPU fallback: ONE unary pass — a stream-layer failure
+            # (chunk-framing corruption the read loop refused) must
+            # not surface when the v2 unary wire still serves.
+            yield await self.render_image_region(ctx)
+            return
+        # Mid-stream death with bytes already surfaced: RESUME instead
+        # of truncating.  The render is deterministic and byte-exact
+        # across every serving path (device re-render, sidecar byte
+        # cache, degraded CPU — all pinned to the same golden in
+        # tier-1), so re-fetching through the unary path (its own
+        # retries + fallback behind it) and slicing off what already
+        # left yields the identical remainder.  Under chaos this turns
+        # "sidecar crashed between my chunk frames" from a truncated
+        # HTTP body into a served tile.
+        body = await self.render_image_region(ctx)
+        if len(body) < offset:
+            raise ConnectionError(
+                "stream resume mismatch: re-rendered body shorter "
+                "than the bytes already sent")
+        yield bytes(body[offset:])
 
 
 class SidecarMaskHandler:

@@ -1391,6 +1391,129 @@ def resilience_metric_lines(breaker=None,
     return lines
 
 
+# ------------------------------------------------------------- wire stats
+
+class WireStats:
+    """Sidecar wire transport accounting (protocol v3): vectored-flush
+    coalescing, the same-host shared-memory ring, and progressive chunk
+    streaming.  Thread-safe — the client and server frame writers run
+    on event loops, but smoke benches read concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # Scatter-gather flushes: one writelines + one drain each.
+        self.flushes = 0
+        self.frames_flushed = 0
+        self.flush_bytes = 0
+        # Same-host ring: bodies that rode it vs fell back to the
+        # socket (exhaustion / no negotiated ring for that size class).
+        self.ring_hits = 0
+        self.ring_fallbacks = 0
+        self.ring_bytes = 0
+        # Handshakes: connections that negotiated a ring vs degraded.
+        self.ring_negotiated = 0
+        self.ring_declined = 0
+        # Progressive streaming: responses sent as chunk frames.
+        self.streams = 0
+        self.chunks = 0
+
+    def observe_flush(self, frames: int, nbytes: int) -> None:
+        with self._lock:
+            self.flushes += 1
+            self.frames_flushed += int(frames)
+            self.flush_bytes += int(nbytes)
+
+    def count_ring(self, nbytes: int, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.ring_hits += 1
+                self.ring_bytes += int(nbytes)
+            else:
+                self.ring_fallbacks += 1
+
+    def count_negotiation(self, ring: bool) -> None:
+        with self._lock:
+            if ring:
+                self.ring_negotiated += 1
+            else:
+                self.ring_declined += 1
+
+    def count_stream(self, chunks: int) -> None:
+        with self._lock:
+            self.streams += 1
+            self.chunks += int(chunks)
+
+    def frames_per_flush(self) -> Optional[float]:
+        """Mean frames per vectored flush — >1 under concurrent load
+        means the coalescer is actually amortizing syscalls/RTTs."""
+        with self._lock:
+            if not self.flushes:
+                return None
+            return self.frames_flushed / self.flushes
+
+    def ring_hit_rate(self) -> Optional[float]:
+        """Of the bodies eligible for the ring, the fraction that rode
+        it (None until anything was eligible)."""
+        with self._lock:
+            total = self.ring_hits + self.ring_fallbacks
+            if not total:
+                return None
+            return self.ring_hits / total
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        def label() -> str:
+            inner = extra_labels.lstrip(",")
+            return f"{{{inner}}}" if inner else ""
+
+        lb = label()
+        with self._lock:
+            fpf = (self.frames_flushed / self.flushes
+                   if self.flushes else 0.0)
+            return [
+                f"imageregion_wire_flushes_total{lb} {self.flushes}",
+                f"imageregion_wire_frames_total{lb} "
+                f"{self.frames_flushed}",
+                f"imageregion_wire_flush_bytes_total{lb} "
+                f"{self.flush_bytes}",
+                f"imageregion_wire_frames_per_flush{lb} "
+                f"{round(fpf, 3)}",
+                f"imageregion_wire_ring_hits_total{lb} "
+                f"{self.ring_hits}",
+                f"imageregion_wire_ring_fallbacks_total{lb} "
+                f"{self.ring_fallbacks}",
+                f"imageregion_wire_ring_bytes_total{lb} "
+                f"{self.ring_bytes}",
+                f"imageregion_wire_ring_negotiated_total{lb} "
+                f"{self.ring_negotiated}",
+                f"imageregion_wire_ring_declined_total{lb} "
+                f"{self.ring_declined}",
+                f"imageregion_wire_streams_total{lb} {self.streams}",
+                f"imageregion_wire_chunks_total{lb} {self.chunks}",
+            ]
+
+    def reset(self) -> None:
+        with self._lock:
+            self.flushes = 0
+            self.frames_flushed = 0
+            self.flush_bytes = 0
+            self.ring_hits = 0
+            self.ring_fallbacks = 0
+            self.ring_bytes = 0
+            self.ring_negotiated = 0
+            self.ring_declined = 0
+            self.streams = 0
+            self.chunks = 0
+
+
+WIRE = WireStats()
+
+
+def wire_metric_lines(extra_labels: str = "") -> List[str]:
+    """The wire transport series; both sides of the socket emit a copy
+    (the sidecar's merges with ``process="sidecar"`` labels)."""
+    return WIRE.metric_lines(extra_labels)
+
+
 # ---------------------------------------------------------------- readiness
 
 class Readiness:
@@ -1779,3 +1902,4 @@ def reset() -> None:
     SLO.reset()
     SHAPE_COSTS.reset()
     PERSIST.reset()
+    WIRE.reset()

@@ -46,7 +46,7 @@ import re
 import sys
 
 DEFAULT_KEYS = ("service_tiles_per_sec", "p50_service_tile_ms_ex_rtt",
-                "raw_upload_mb_per_sec")
+                "raw_upload_mb_per_sec", "p50_first_tile_byte_ms")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 
@@ -212,7 +212,8 @@ def main(argv=None) -> int:
                         help="record key(s) to judge (default "
                              "service_tiles_per_sec, "
                              "p50_service_tile_ms_ex_rtt, "
-                             "raw_upload_mb_per_sec)")
+                             "raw_upload_mb_per_sec, "
+                             "p50_first_tile_byte_ms)")
     parser.add_argument("--max-regression", type=float, default=0.10,
                         help="fail when new < old by this fraction or "
                              "more (default 0.10)")

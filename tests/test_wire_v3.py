@@ -1,0 +1,839 @@
+"""Wire protocol v3: the streaming zero-copy sidecar transport.
+
+Covers the three legs and their degradations:
+
+* scatter-gather frame coalescing (FrameWriter) + the ``respond()``
+  drain-under-lock regression;
+* the same-host shared-memory ring (server.shmring) — allocation,
+  wrap, exhaustion fallback, hostile-descriptor validation;
+* progressive chunk streaming — byte-exact vs the v2 single-frame
+  body AND vs the jax-free refimpl golden render;
+* mixed-version peers: v3 client <-> v2 server and v2 client <-> v3
+  server round-trips (per-feature degradation, no hangs, identical
+  bytes);
+* a seeded frame/descriptor fuzz: truncated/garbled frames, ring
+  descriptors past the ring and alien chunk ``seq`` all degrade to
+  clean op-errors or a clean reconnect — never a wedged connection;
+* the checked-in golden v2+v3 frame corpus (tests/data/wire/): a
+  protocol edit that breaks old-frame decoding fails HERE, in tier-1,
+  instead of breaking a rolling deploy.
+"""
+
+import asyncio
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_image_region_tpu.io.store import build_pyramid
+from omero_ms_image_region_tpu.server.app import create_app
+from omero_ms_image_region_tpu.server.config import (AppConfig,
+                                                     SidecarConfig,
+                                                     WireConfig)
+from omero_ms_image_region_tpu.server.shmring import RingError, ShmRing
+from omero_ms_image_region_tpu.server.sidecar import (FrameWriter,
+                                                      SidecarClient,
+                                                      _pack,
+                                                      _read_frame,
+                                                      run_sidecar)
+from omero_ms_image_region_tpu.utils import telemetry
+
+IMG = 3
+H = W = 64
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "data", "wire")
+
+URL = (f"/webgateway/render_image_region/{IMG}/0/0"
+       f"?c=1|0:60000$FF0000&m=g&format=png")
+CTX_PARAMS = {"imageId": str(IMG), "theZ": "0", "theT": "0",
+              "c": "1|0:60000$FF0000", "m": "g", "format": "png"}
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    rng = np.random.default_rng(21)
+    planes = rng.integers(0, 60000, size=(2, 2, H, W)).astype(np.uint16)
+    build_pyramid(planes, str(tmp_path / str(IMG)), chunk=(32, 32),
+                  n_levels=1)
+    return str(tmp_path)
+
+
+async def _wait_socket(sock, task):
+    for _ in range(200):
+        if task.done():
+            raise AssertionError(
+                f"sidecar died at startup: {task.exception()!r}")
+        if os.path.exists(sock):
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("sidecar socket never appeared")
+
+
+async def _with_sidecar(data_dir, sock, body, config=None):
+    cfg = config or AppConfig(data_dir=data_dir)
+    task = asyncio.create_task(run_sidecar(cfg, sock))
+    try:
+        await _wait_socket(sock, task)
+        return await body()
+    finally:
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
+def _image_ctx():
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    return ImageRegionCtx.from_params(dict(CTX_PARAMS), None)
+
+
+# ------------------------------------------------------------- shm ring
+
+def test_shmring_alloc_release_and_wrap():
+    ring = ShmRing.create(4096)
+    try:
+        # Simple round trip.
+        off = ring.alloc_write(b"x" * 100)
+        assert off == 0
+        assert ring.read_release(off, 100) == b"x" * 100
+        assert ring.tail == 100
+        off2 = ring.alloc_write(b"y" * 3000)
+        assert off2 == 100
+        assert ring.read_release(off2, 3000) == b"y" * 3000
+        # A body that would cross the end skips to the next lap (996
+        # dead tail bytes); the consumer's release frees the skipped
+        # pad implicitly.
+        off3 = ring.alloc_write(b"z" * 1500)
+        assert off3 == 4096      # pos 3100 + 1500 > size -> next lap
+        assert off3 % 4096 == 0
+        assert ring.read_release(off3, 1500) == b"z" * 1500
+        # Exhaustion: a body bigger than the free window is a clean
+        # None (socket fallback), not an overwrite.
+        a = ring.alloc_write(b"a" * 2000)
+        assert a is not None
+        assert ring.alloc_write(b"b" * 2200) is None
+        assert ring.read_release(a, 2000) == b"a" * 2000
+        assert ring.alloc_write(b"b" * 2200) is not None   # freed now
+        # Oversize and empty bodies never allocate.
+        assert ring.alloc_write(b"") is None
+        assert ring.alloc_write(b"c" * 5000) is None
+    finally:
+        ring.close()
+
+
+def test_shmring_descriptor_validation():
+    ring = ShmRing.create(4096)
+    try:
+        off = ring.alloc_write(b"d" * 256)
+        # Beyond head (unwritten), behind tail (released), wrapping,
+        # oversize, non-integer: all clean RingErrors.
+        with pytest.raises(RingError):
+            ring.read_release(off + 1, 256)
+        with pytest.raises(RingError):
+            ring.read_release(off, 10 ** 9)
+        with pytest.raises(RingError):
+            ring.read_release("junk", 16)
+        assert ring.read_release(off, 256) == b"d" * 256
+        with pytest.raises(RingError):
+            ring.read_release(off, 256)          # already released
+    finally:
+        ring.close()
+
+
+def test_shmring_attach_validates_header():
+    ring = ShmRing.create(8192)
+    try:
+        peer = ShmRing.attach(ring.name, 8192)
+        off = ring.alloc_write(b"cross" * 10)
+        assert peer.read_release(off, 50) == b"cross" * 10
+        assert ring.tail == 50                   # shared cursor
+        peer.close()
+        with pytest.raises(RingError):
+            ShmRing.attach(ring.name, 4096)      # size mismatch
+    finally:
+        ring.close()
+
+
+# ----------------------------------------------- FrameWriter coalescing
+
+class _FakeWriter:
+    """StreamWriter stand-in: collects buffers; drain() blocks until
+    released (the slow-reading-peer simulation)."""
+
+    def __init__(self):
+        self.flushes = []          # list of buffer-lists per writelines
+        self.gate = asyncio.Event()
+        self.gate.set()
+        self.drains = 0
+
+    def writelines(self, bufs):
+        self.flushes.append([bytes(b) for b in bufs])
+
+    def write(self, b):
+        self.flushes.append([bytes(b)])
+
+    async def drain(self):
+        self.drains += 1
+        await self.gate.wait()
+
+    def close(self):
+        pass
+
+
+def test_framewriter_coalesces_concurrent_frames():
+    async def scenario():
+        w = _FakeWriter()
+        fw = FrameWriter(w)
+        try:
+            # Enqueued in one tick -> ONE flush, one drain, N frames.
+            await asyncio.gather(*(fw.send({"id": i}) for i in range(5)))
+            assert len(w.flushes) == 1
+            assert w.drains == 1
+            assert len(w.flushes[0]) == 5
+        finally:
+            fw.close()
+
+    asyncio.run(scenario())
+
+
+def test_framewriter_drain_not_under_a_lock():
+    """The respond() regression: with the first flush's drain BLOCKED
+    (slow-reading peer), later responders must still enqueue and
+    complete their handler-side work — under the old write-lock form
+    every respond() serialized behind the stalled drain.  When the
+    peer drains, the backlog leaves as one coalesced flush."""
+    async def scenario():
+        w = _FakeWriter()
+        fw = FrameWriter(w)
+        try:
+            w.gate.clear()                      # peer stops reading
+            first = asyncio.create_task(fw.send({"id": 1}))
+            await asyncio.sleep(0.05)
+            assert w.drains == 1 and not first.done()
+            # Two more senders: they enqueue immediately (no lock to
+            # park on) even though the drain is stalled.
+            s2 = asyncio.create_task(fw.send({"id": 2}))
+            s3 = asyncio.create_task(fw.send({"id": 3}))
+            await asyncio.sleep(0.05)
+            assert len(fw._pending) == 2        # queued, not blocked on
+            assert w.drains == 1                # ... the stalled drain
+            w.gate.set()                        # peer reads again
+            await asyncio.gather(first, s2, s3)
+            # The backlog flushed as ONE coalesced writelines.
+            assert len(w.flushes) == 2
+            assert len(w.flushes[1]) == 2
+            assert telemetry.WIRE.flushes >= 2
+        finally:
+            fw.close()
+
+    telemetry.WIRE.reset()
+    asyncio.run(scenario())
+
+
+def test_framewriter_failure_fails_queued_senders():
+    class _DeadWriter(_FakeWriter):
+        def writelines(self, bufs):
+            raise ConnectionResetError("peer gone")
+
+    async def scenario():
+        fw = FrameWriter(_DeadWriter())
+        with pytest.raises(ConnectionError):
+            await fw.send({"id": 1})
+        # The writer is latched dead: later sends refuse immediately.
+        with pytest.raises(ConnectionError):
+            await fw.send({"id": 2})
+        fw.close()
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------------------------- golden corpus
+
+async def _parse_frames(data: bytes):
+    reader = asyncio.StreamReader()
+    reader.feed_data(data)
+    reader.feed_eof()
+    frames = []
+    while True:
+        try:
+            frames.append(await _read_frame(reader))
+        except asyncio.IncompleteReadError:
+            break
+    return frames
+
+
+def test_golden_corpus_roundtrips_byte_identical():
+    """Every checked-in v2 and v3 frame must decode with today's code
+    and re-encode to the EXACT original bytes — the compatibility
+    contract a rolling deploy depends on."""
+    names = sorted(n for n in os.listdir(CORPUS_DIR)
+                   if n.endswith(".bin"))
+    assert len(names) >= 13, names
+    for name in names:
+        with open(os.path.join(CORPUS_DIR, name), "rb") as f:
+            blob = f.read()
+        frames = asyncio.run(_parse_frames(blob))
+        assert frames, name
+        re_encoded = b"".join(_pack(h, b) for h, b in frames)
+        assert re_encoded == blob, f"{name} did not round-trip"
+
+
+def test_golden_corpus_decodes_expected_semantics():
+    def load(name):
+        with open(os.path.join(CORPUS_DIR, name), "rb") as f:
+            return asyncio.run(_parse_frames(f.read()))
+
+    [(h, b)] = load("v2_request_image.bin")
+    assert (h["op"], h["v"], h["id"]) == ("image", 2, 1)
+    assert "stream" not in h and "ring" not in h
+    [(h, b)] = load("v2_request_plane_put.bin")
+    assert h["digest"] == "aa" * 16 and len(b) == 32
+    [(h, b)] = load("v3_hello.bin")
+    assert h["op"] == "hello" and h["v"] == 3
+    assert h["rings"]["c2s"]["size"] == 33554432
+    [(h, b)] = load("v3_ring_descriptor.bin")
+    assert h["ring"] == [0, 512] and b == b""
+    # A coalesced flush is plain frame concatenation: four frames, in
+    # order, chunk seqs intact, fin carrying the status.
+    frames = load("v3_coalesced_flush.bin")
+    assert [f[0].get("seq") for f in frames] == [None, 0, 1, None]
+    assert frames[-1][0]["status"] == 200 and frames[-1][0]["fin"]
+    assert frames[1][1] + frames[2][1] == b"CHUNK-0-CHUNK-1"
+
+
+# --------------------------------------------------- mixed-version peers
+
+async def _v2_server(sock, render_body: bytes):
+    """A previous-round (v2) sidecar stand-in: single-frame responses,
+    scalar+batched plane ops, and 400 on unknown ops (hello included) —
+    exactly the degrade surface the mixed-fleet contract documents."""
+    resident = set()
+
+    async def on_conn(reader, writer):
+        try:
+            while True:
+                header, body = await _read_frame(reader)
+                op = header.get("op")
+                rid = header.get("id")
+                if op in ("image", "mask"):
+                    # v2 ignores the unknown ``stream`` key: ONE frame.
+                    out = _pack({"id": rid, "status": 200}, render_body)
+                elif op == "plane_probe":
+                    digests = header.get("digests")
+                    if isinstance(digests, list):
+                        doc = {"enabled": True,
+                               "resident": [d in resident
+                                            for d in digests]}
+                    else:
+                        doc = {"enabled": True,
+                               "resident": header.get("digest")
+                               in resident}
+                    out = _pack({"id": rid, "status": 200},
+                                json.dumps(doc).encode())
+                elif op == "plane_put":
+                    was = header["digest"] in resident
+                    resident.add(header["digest"])
+                    out = _pack({"id": rid, "status": 200},
+                                json.dumps({"digest": header["digest"],
+                                            "resident": was}).encode())
+                elif op == "ping":
+                    out = _pack({"id": rid, "status": 200},
+                                json.dumps({"ok": True}).encode())
+                else:
+                    out = _pack({"id": rid, "status": 400,
+                                 "error": f"unknown op {op!r}"})
+                writer.write(out)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    return await asyncio.start_unix_server(on_conn, path=sock)
+
+
+def test_v3_client_against_v2_server_degrades_per_feature(tmp_path):
+    """v3 client <-> v2 server: the hello answers 400 (segments are
+    destroyed, socket bodies), streamed calls degrade to the v2
+    single-frame body, plane staging still dedups — no hangs, bytes
+    identical to the v2 contract."""
+    sock = str(tmp_path / "v2.sock")
+    render_body = b"V2-RENDER-" * 400
+
+    async def scenario():
+        server = await _v2_server(sock, render_body)
+        telemetry.WIRE.reset()
+        client = SidecarClient(sock)
+        try:
+            # Unary round trip.
+            resp_header, payload = await client.call_full("image", {})
+            assert resp_header["status"] == 200
+            assert bytes(payload) == render_body
+            # The hello was declined: no ring on this connection.
+            assert telemetry.WIRE.ring_negotiated == 0
+            assert telemetry.WIRE.ring_declined >= 1
+            assert client._conn.peer_v3 is False
+            assert client._conn.recv_ring is None
+            # Streamed call: one chunk, byte-identical.
+            chunks = [c async for c in client.call_stream("image", {})]
+            assert b"".join(chunks) == render_body
+            # Bulk staging: uploads once, dedups on repeat.
+            rng = np.random.default_rng(3)
+            arrs = [rng.integers(0, 60000, size=(1, 16, 16))
+                    .astype(np.uint16) for _ in range(3)]
+            first = await client.stage_planes(arrs)
+            assert [r for _, r in first] == [False] * 3
+            again = await client.stage_planes(
+                [a.copy() for a in arrs])
+            assert [r for _, r in again] == [True] * 3
+            return True
+        finally:
+            await client.close()
+            server.close()
+            await server.wait_closed()
+
+    assert asyncio.run(scenario())
+
+
+def test_v2_client_against_v3_server_single_frame(data_dir, tmp_path):
+    """v2 client <-> v3 server: no hello, no ``stream`` key — the
+    server answers exactly one v2 frame whose body is byte-identical
+    to what a v3 client (unary AND streamed) gets from the same
+    sidecar."""
+    sock = str(tmp_path / "v3.sock")
+
+    async def body():
+        ctx = _image_ctx()
+        # Raw previous-round client: plain frames, no handshake.
+        reader, writer = await asyncio.open_unix_connection(sock)
+        try:
+            writer.write(_pack({"id": 9, "op": "image",
+                                "ctx": ctx.to_json(), "v": 2}))
+            await writer.drain()
+            header, v2_body = await _read_frame(reader)
+            assert header["status"] == 200
+            assert "fin" not in header and "ring" not in header
+        finally:
+            writer.close()
+        # v3 client, unary and streamed, against the same server.
+        client = SidecarClient(sock)
+        try:
+            resp_header, unary = await client.call_full(
+                "image", ctx.to_json())
+            assert resp_header["status"] == 200
+            chunks = [c async for c in
+                      client.call_stream("image", ctx.to_json())]
+        finally:
+            await client.close()
+        assert bytes(unary) == bytes(v2_body)
+        assert b"".join(chunks) == bytes(v2_body)
+        return True
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body))
+
+
+# ------------------------------------------------ streamed byte-exactness
+
+def test_streamed_chunks_concatenate_to_v2_body(data_dir, tmp_path):
+    """With the chunk bound forced small, a streamed render really
+    splits into multiple ``seq`` frames — and their concatenation is
+    byte-identical to the unary (v2-shaped) answer."""
+    sock = str(tmp_path / "render.sock")
+    cfg = AppConfig(data_dir=data_dir,
+                    wire=WireConfig(chunk_max_bytes=4096))
+
+    async def body():
+        ctx = _image_ctx()
+        client = SidecarClient(sock)
+        try:
+            telemetry.WIRE.reset()
+            _, unary = await client.call_full("image", ctx.to_json())
+            chunks = [c async for c in
+                      client.call_stream("image", ctx.to_json())]
+            assert len(chunks) > 1, \
+                f"body of {len(bytes(unary))} B did not chunk"
+            assert b"".join(chunks) == bytes(unary)
+            assert telemetry.WIRE.streams >= 1
+            assert telemetry.WIRE.chunks >= len(chunks)
+            return True
+        finally:
+            await client.close()
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body, config=cfg))
+
+
+def test_streamed_http_matches_combined_and_refimpl(data_dir, tmp_path):
+    """End-to-end byte exactness: the chunked HTTP response through
+    frontend -> sidecar equals the combined single-process answer AND
+    the jax-free refimpl golden render (server.degraded) — streaming
+    changed WHEN bytes leave, never WHICH bytes."""
+    sock = str(tmp_path / "render.sock")
+
+    async def split_body():
+        app = create_app(AppConfig(
+            data_dir=data_dir,
+            sidecar=SidecarConfig(socket=sock, role="frontend"),
+            wire=WireConfig(chunk_max_bytes=4096)))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(URL)
+            body = await r.read()
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "image/png"
+            m = await (await client.get("/metrics")).text()
+            assert "imageregion_wire_frames_per_flush" in m
+            assert "imageregion_wire_streams_total" in m
+            return body
+        finally:
+            await client.close()
+
+    streamed = asyncio.run(_with_sidecar(
+        data_dir, sock, split_body,
+        config=AppConfig(data_dir=data_dir,
+                         wire=WireConfig(chunk_max_bytes=4096))))
+
+    async def combined():
+        app = create_app(AppConfig(data_dir=data_dir))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(URL)
+            assert r.status == 200
+            return await r.read()
+        finally:
+            await client.close()
+
+    assert streamed == asyncio.run(combined())
+
+    # The refimpl golden: the degraded CPU handler renders the same
+    # ctx through the jax-free reference pipeline.
+    from omero_ms_image_region_tpu.server.degraded import (
+        DegradedCpuHandler)
+    golden = asyncio.run(DegradedCpuHandler(
+        AppConfig(data_dir=data_dir)).render_image_region(_image_ctx()))
+    assert streamed == golden
+
+
+def test_streaming_disabled_restores_unary_responses(data_dir,
+                                                     tmp_path):
+    """wire.streaming: false is the A/B escape hatch — plain buffered
+    responses, batcher barrier settlement, identical bytes."""
+    sock = str(tmp_path / "render.sock")
+
+    async def body():
+        app = create_app(AppConfig(
+            data_dir=data_dir,
+            sidecar=SidecarConfig(socket=sock, role="frontend"),
+            wire=WireConfig(streaming=False)))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(URL)
+            body = await r.read()
+            assert r.status == 200
+            # Buffered (non-chunked) answers carry Content-Length.
+            assert "Content-Length" in r.headers
+            return body
+        finally:
+            await client.close()
+
+    off = asyncio.run(_with_sidecar(
+        data_dir, sock, body,
+        config=AppConfig(data_dir=data_dir,
+                         wire=WireConfig(streaming=False))))
+
+    async def combined():
+        app = create_app(AppConfig(data_dir=data_dir))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await (await client.get(URL)).read()
+        finally:
+            await client.close()
+
+    assert off == asyncio.run(combined())
+
+
+# ------------------------------------------------ first-tile-out settle
+
+def test_first_tile_out_settles_before_barrier():
+    """Deterministic mechanism gate for first-tile-out: while the
+    encode tail is still running (later tiles undelivered), an earlier
+    tile's future is ALREADY resolved with its exact bytes.  The
+    smoke bench's timing numbers ride on this; a regression back to
+    barrier settlement fails here, not in a jittery latency compare."""
+    from omero_ms_image_region_tpu.server.batcher import (
+        BatchingRenderer, _Pending)
+
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        renderer = BatchingRenderer()
+        group = [_Pending(raw=None, settings=None, h=1, w=1,
+                          future=loop.create_future())
+                 for _ in range(3)]
+        cb = renderer._early_settle_cb(group)
+        assert cb is not None
+        # The encode worker thread delivers tile 0 only.
+        await asyncio.to_thread(cb, 0, b"tile-0")
+        await asyncio.wait_for(group[0].future, 2.0)
+        assert group[0].future.result() == b"tile-0"
+        assert not group[1].future.done()       # tail still encoding
+        assert not group[2].future.done()
+        # Padded batch entries past the group are ignored.
+        await asyncio.to_thread(cb, 7, b"pad")
+        # The rest lands; a final barrier settle skipping done futures
+        # (the production path) would now find 1 and 2 already here.
+        await asyncio.to_thread(cb, 1, b"tile-1")
+        await asyncio.to_thread(cb, 2, b"tile-2")
+        await asyncio.wait_for(group[2].future, 2.0)
+        assert [p.future.result() for p in group] == \
+            [b"tile-0", b"tile-1", b"tile-2"]
+        # wire.streaming: false reverts to barrier settlement.
+        renderer.first_tile_out = False
+        assert renderer._early_settle_cb(group) is None
+        # Harness-driven groups (no waiter futures) are a no-op, not
+        # a crash.
+        renderer.first_tile_out = True
+        bare = [_Pending(raw=None, settings=None, h=1, w=1)]
+        cb2 = renderer._early_settle_cb(bare)
+        cb2(0, b"ignored")
+        return True
+
+    assert asyncio.run(scenario())
+
+
+# ----------------------------------------------------------- frame fuzz
+
+def _mutate(rng, data: bytes) -> bytes:
+    b = bytearray(data)
+    for _ in range(int(rng.integers(1, 6))):
+        kind = rng.integers(0, 4)
+        if kind == 0 and len(b) > 4:
+            b[int(rng.integers(0, len(b)))] = int(rng.integers(0, 256))
+        elif kind == 1 and len(b) > 12:
+            del b[int(rng.integers(8, len(b))):]
+        elif kind == 2 and len(b) > 16:
+            i = int(rng.integers(4, len(b) - 4))
+            del b[i:i + int(rng.integers(1, 12))]
+        else:
+            i = int(rng.integers(0, len(b)))
+            b[i:i] = rng.integers(0, 256, int(rng.integers(1, 8)),
+                                  dtype=np.uint8).tobytes()
+    return bytes(b)
+
+
+def test_frame_fuzz_never_wedges_the_server(data_dir, tmp_path):
+    """scripts/fuzz_decoders.py-style mutation fuzz over the v3
+    framing, fed to a LIVE sidecar: every mutated frame either answers
+    a clean error frame or drops the connection — and after the whole
+    campaign the server still serves a fresh client.  No hangs, no
+    unhandled exceptions wedging the accept loop."""
+    sock = str(tmp_path / "render.sock")
+    seeds = []
+    for name in ("v2_request_image.bin", "v3_request_image_stream.bin",
+                 "v3_hello.bin", "v3_chunk_seq0.bin",
+                 "v3_ring_descriptor.bin", "v2_request_plane_put.bin"):
+        with open(os.path.join(CORPUS_DIR, name), "rb") as f:
+            seeds.append(f.read())
+
+    async def body():
+        rng = np.random.default_rng(1234)
+        for i in range(48):
+            blob = _mutate(rng, seeds[i % len(seeds)])
+            try:
+                reader, writer = await asyncio.open_unix_connection(
+                    sock)
+            except OSError:
+                raise AssertionError("server stopped accepting")
+            try:
+                writer.write(blob)
+                try:
+                    await writer.drain()
+                    # Half-close so a truncation-mutated frame reads
+                    # as EOF (an endlessly-open partial frame is a
+                    # slow client, not a protocol input).  Then: a
+                    # clean error frame, or the server closing — both
+                    # contract-clean; a HANG is the bug class hunted.
+                    writer.write_eof()
+                    await asyncio.wait_for(reader.read(1 << 16),
+                                           timeout=5.0)
+                except (asyncio.TimeoutError, ConnectionError,
+                        OSError):
+                    raise AssertionError(
+                        f"iter {i}: server wedged on {blob[:40]!r}...")
+            finally:
+                writer.close()
+        # The campaign over, a fresh well-formed client still renders.
+        client = SidecarClient(sock)
+        try:
+            status, _ = await client.call("ping", {})
+            assert status == 200
+            resp_header, payload = await client.call_full(
+                "image", _image_ctx().to_json())
+            assert resp_header["status"] == 200 and len(payload) > 0
+        finally:
+            await client.close()
+        return True
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body))
+
+
+def test_ring_descriptor_past_ring_is_clean_op_error(data_dir,
+                                                     tmp_path):
+    """A hostile ring descriptor (offset/length outside the live
+    window) answers a 400 op-error and drops the connection — never an
+    out-of-window read, never a wedge; the next client serves fine."""
+    sock = str(tmp_path / "render.sock")
+
+    async def body():
+        rings = (ShmRing.create(1 << 20), ShmRing.create(1 << 20))
+        reader, writer = await asyncio.open_unix_connection(sock)
+        try:
+            writer.write(_pack({
+                "id": 1, "op": "hello", "v": 3,
+                "rings": {"c2s": {"name": rings[0].name,
+                                  "size": 1 << 20},
+                          "s2c": {"name": rings[1].name,
+                                  "size": 1 << 20}}}))
+            await writer.drain()
+            header, hello_body = await _read_frame(reader)
+            assert header["status"] == 200
+            assert json.loads(bytes(hello_body).decode())["ring"]
+            # Descriptor way past anything ever written.
+            writer.write(_pack({"id": 2, "op": "plane_put", "ctx": {},
+                                "v": 3, "digest": "ee" * 16,
+                                "dtype": "uint16", "shape": [1, 4, 4],
+                                "ring": [10 ** 9, 4096]}))
+            await writer.drain()
+            header, err_body = await _read_frame(reader)
+            assert header["status"] == 400
+            assert "ring" in header.get("error", "")
+            # The server then drops the (ring-desynced) connection.
+            assert await reader.read(4) == b""
+        finally:
+            writer.close()
+            for r in rings:
+                r.close()
+        # A fresh client is unaffected.
+        client = SidecarClient(sock)
+        try:
+            status, _ = await client.call("ping", {})
+            assert status == 200
+        finally:
+            await client.close()
+        return True
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body))
+
+
+def test_alien_chunk_seq_fails_stream_cleanly(tmp_path):
+    """A v3 peer emitting reordered/alien ``seq`` chunk frames fails
+    the stream with a clean ConnectionError (never spliced bytes) and
+    the client recovers on a fresh connection."""
+    sock = str(tmp_path / "alien.sock")
+
+    async def on_conn(reader, writer):
+        try:
+            while True:
+                header, _ = await _read_frame(reader)
+                rid = header.get("id")
+                if header.get("op") == "hello":
+                    writer.write(_pack(
+                        {"id": rid, "status": 200},
+                        json.dumps({"v": 3, "ring": False}).encode()))
+                elif header.get("op") == "ping":
+                    writer.write(_pack({"id": rid, "status": 200},
+                                       b"{}"))
+                else:
+                    # Alien seq: starts at 7 instead of 0.
+                    writer.write(_pack({"id": rid, "seq": 7},
+                                       b"EVIL"))
+                    writer.write(_pack({"id": rid, "status": 200,
+                                        "fin": True}))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def scenario():
+        server = await asyncio.start_unix_server(on_conn, path=sock)
+        client = SidecarClient(sock, retry=None)
+        try:
+            with pytest.raises(ConnectionError) as ei:
+                async for _ in client.call_stream("image", {}):
+                    raise AssertionError("alien chunk must not yield")
+            assert "seq" in str(ei.value)
+            # Clean recovery on a new connection generation.
+            status, _ = await client.call("ping", {})
+            assert status == 200
+            return True
+        finally:
+            await client.close()
+            server.close()
+            await server.wait_closed()
+
+    assert asyncio.run(scenario())
+
+
+def test_ring_rides_mb_scale_bodies_end_to_end(data_dir, tmp_path):
+    """Same-host staging really crosses the ring: MB-scale plane_put
+    bodies hit the ring (descriptor frames on the socket), and the
+    plane is verified + resident exactly as on the socket path."""
+    sock = str(tmp_path / "render.sock")
+
+    async def body():
+        telemetry.WIRE.reset()
+        client = SidecarClient(sock)
+        rng = np.random.default_rng(8)
+        arr = rng.integers(0, 60000, size=(1, 512, 512)) \
+            .astype(np.uint16)
+        try:
+            digest, resident = await client.stage_plane(arr)
+            assert resident is False
+            assert telemetry.WIRE.ring_negotiated >= 1
+            assert telemetry.WIRE.ring_hits >= 1
+            assert telemetry.WIRE.ring_bytes >= arr.nbytes
+            # Same content again: digest-resident, zero new bodies.
+            hits0 = telemetry.WIRE.ring_hits
+            _, resident2 = await client.stage_plane(arr.copy())
+            assert resident2 is True
+            assert telemetry.WIRE.ring_hits == hits0
+            return True
+        finally:
+            await client.close()
+
+    assert asyncio.run(_with_sidecar(data_dir, sock, body))
+
+
+def test_ring_exhaustion_falls_back_to_socket(tmp_path):
+    """Bodies that outgrow the ring window fall back to socket frames
+    per-body (counted, never an error)."""
+    async def scenario():
+        w = _FakeWriter()
+        fw = FrameWriter(w)
+        ring = ShmRing.create(4096)
+        fw.ring = ring
+        fw.ring_min_bytes = 16
+        try:
+            telemetry.WIRE.reset()
+            await fw.send({"id": 1}, b"r" * 1000)      # rides the ring
+            await fw.send({"id": 2}, b"s" * 8000)      # too big: socket
+            assert telemetry.WIRE.ring_hits == 1
+            assert telemetry.WIRE.ring_fallbacks == 1
+            # The descriptor frame has no socket body; the fallback
+            # frame ships prefix + body buffers.
+            assert len(w.flushes[0][0]) < 100
+            flat = b"".join(b for bufs in w.flushes for b in bufs)
+            assert b"s" * 8000 in flat
+            assert b"r" * 1000 not in flat
+        finally:
+            fw.close()
+            ring.close()
+
+    asyncio.run(scenario())

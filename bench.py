@@ -956,6 +956,141 @@ async def _wire_run(tmp: str, sock: str, rng) -> dict:
             pass
 
 
+def _fleet_smoke(exec_ms: float = 150.0, grid: int = 4,
+                 tile_edge: int = 128, variants: int = 3) -> dict:
+    """Fleet-serving smoke probe: the data-parallel router over N=4
+    virtual members vs the same burst through ONE member.
+
+    Each member is a REAL serving stack — its own renderer + its own
+    ``DeviceRawCache`` shard over a shared pyramid — plus a calibrated
+    virtual device-execute occupancy (``exec_ms`` of lane time per
+    render).  On this 2-core CI host the chips' compute parallelism
+    cannot exist, so the sleep stands in for the member's device
+    service time; what the probe then honestly measures is that the
+    ROUTING layer scales — consistent-hash spread, per-member lanes,
+    stealing under skew — with zero added serialization, and that the
+    HBM tier SHARDS: after a mixed-digest burst each staged plane is
+    resident on exactly ONE member (duplicates asserted 0 in tier-1;
+    total residency ~= the working set, minus any plane whose every
+    render happened to be stolen — stealing never adopts).  The real
+    1->8 chip curve is the MULTICHIP record's job
+    (``__graft_entry__.fleet_scaling_curve``).
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.parallel.fleet import (
+        FleetImageHandler, FleetRouter, LocalMember,
+        build_local_members)
+    from omero_ms_image_region_tpu.server.admission import (
+        AdmissionController)
+    from omero_ms_image_region_tpu.server.app import build_services
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.singleflight import (
+        SingleFlight)
+    from omero_ms_image_region_tpu.utils import telemetry
+
+    rng = np.random.default_rng(13)
+    exec_s = exec_ms / 1000.0
+
+    class VirtualDeviceMember(LocalMember):
+        """A fleet member whose device-execute service time is the
+        calibrated occupancy above — the render itself (read, stage,
+        HBM cache, render kernel, encode) is entirely real."""
+
+        async def render(self, ctx, adopt_cache=True):
+            data = await super().render(ctx, adopt_cache)
+            await asyncio.sleep(exec_s)
+            return data
+
+    def urls(k_base: int):
+        out = []
+        for v in range(variants):
+            for x in range(grid):
+                for y in range(grid):
+                    w = 20000 + (k_base + v) * 700
+                    out.append({
+                        "imageId": "1", "theZ": "0", "theT": "0",
+                        "tile": f"0,{x},{y},{tile_edge},{tile_edge}",
+                        "format": "png", "m": "c",
+                        "c": f"1|0:{w}$FF0000,2|0:{w - 900}$00FF00",
+                    })
+        return out
+
+    async def run_fleet(tmp: str, n_members: int) -> dict:
+        config = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=False),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        services = build_services(config)
+        members = build_local_members(config, services, n_members)
+        members = [VirtualDeviceMember(
+            m.name, m.handler, m.services,
+            down_cooldown_s=m.down_cooldown_s,
+            byte_cache_prechecked=m.byte_cache_prechecked)
+            for m in members]
+        router = FleetRouter(members, lane_width=2,
+                             steal_min_backlog=2)
+        handler = FleetImageHandler(
+            router, single_flight=SingleFlight(),
+            admission=AdmissionController(512, renderer=router),
+            base_services=services)
+        before = telemetry.FLEET.totals()
+        try:
+            ctxs = [ImageRegionCtx.from_params(p) for p in urls(16)]
+            # Warm the compile (shared in-process jit cache) outside
+            # the window; the plane reads/staging stay in it.
+            await handler.render_image_region(
+                ImageRegionCtx.from_params(urls(900)[0]))
+            t0 = time.perf_counter()
+            out = await asyncio.gather(
+                *(handler.render_image_region(c) for c in ctxs))
+            wall = time.perf_counter() - t0
+            assert all(out)
+            after = telemetry.FLEET.totals()
+            report = router.shard_report()
+            return {
+                "tps": len(ctxs) / wall,
+                "shard": report,
+                "routed": after["routed"] - before["routed"],
+                "stolen": after["stolen"] - before["stolen"],
+            }
+        finally:
+            await router.close()
+            services.pixels_service.close()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 2, 1, grid * tile_edge,
+                                     grid * tile_edge).reshape(
+            2, 1, grid * tile_edge, grid * tile_edge)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        single = asyncio.run(run_fleet(tmp, 1))
+        fleet = asyncio.run(run_fleet(tmp, 4))
+    working_set = grid * grid
+    return {
+        "fleet_members": 4,
+        "fleet_virtual_exec_ms": exec_ms,
+        "fleet_tiles_per_sec": round(fleet["tps"], 2),
+        "fleet_single_member_tiles_per_sec": round(single["tps"], 2),
+        "fleet_speedup": round(fleet["tps"] / single["tps"], 2),
+        "fleet_working_set_planes": working_set,
+        # Sharded, not duplicated: every plane of the working set
+        # resident on exactly one member after the mixed-digest burst.
+        "fleet_resident_planes": fleet["shard"]["resident_digests"],
+        "fleet_duplicate_staged_planes":
+            fleet["shard"]["duplicate_digests"],
+        "fleet_member_planes": fleet["shard"]["members"],
+        "fleet_routed_total": fleet["routed"],
+        "fleet_stolen_total": fleet["stolen"],
+    }
+
+
 def bench_smoke(duration_s: float = 1.5):
     """Hot-path regression gate at smoke scale: CPU, small shapes, <60 s.
 
@@ -1002,6 +1137,10 @@ def bench_smoke(duration_s: float = 1.5):
     # socket — first-byte vs batch barrier, frames per vectored flush,
     # and the shm-ring vs socket upload A/B.
     wire = _wire_smoke()
+    # Fleet-serving probes: N=4 virtual members vs one member over the
+    # same mixed-digest burst — routing-layer scaling + HBM sharding
+    # (gated in tests/test_bench_smoke.py).
+    fleet = _fleet_smoke()
     # Cost-ledger liveness: the attribution layer must have recorded
     # WHERE the smoke window's time went, request by request — a
     # refactor that silently drops the ledger fails the gate here.
@@ -1029,6 +1168,9 @@ def bench_smoke(duration_s: float = 1.5):
         # Wire v3 probes (split posture, streaming + coalescing + shm
         # ring live) — gated in tests/test_bench_smoke.py.
         **wire,
+        # Fleet probes (virtual members; see _fleet_smoke) — gated in
+        # tests/test_bench_smoke.py.
+        **fleet,
         "elapsed_s": round(time.perf_counter() - t_start, 1),
     }
     print(json.dumps(out))

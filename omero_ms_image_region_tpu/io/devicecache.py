@@ -247,6 +247,15 @@ class DeviceRawCache:
         with self._lock:
             return key in self._entries
 
+    def resident_digests(self) -> Set[str]:
+        """Snapshot of every content digest currently resident (fleet
+        shard accounting: across members these sets should be pairwise
+        disjoint — a digest on two members means a plane was staged
+        twice, the duplication the consistent-hash router exists to
+        prevent)."""
+        with self._lock:
+            return set(self._keys_by_digest)
+
     @property
     def size_bytes(self) -> int:
         return self._bytes

@@ -30,6 +30,28 @@ import numpy as np
 from .mesh import Mesh, make_mesh, resolve_devices
 
 
+def _distributed_initialized() -> bool:
+    """Has this process already joined ``jax.distributed``?
+
+    ``jax.distributed.is_initialized()`` only exists on newer jax
+    releases; older ones (this image ships 0.4.x without it) expose the
+    same fact through the private runtime state's client handle.  Both
+    probes are backend-free — neither touches XLA, which is the whole
+    point of checking before ``initialize()``.
+    """
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:
+        # No known probe surface: let initialize() itself decide (it
+        # raises cleanly when already joined, which the caller treats
+        # as the standalone fallback for auto-discovered setups).
+        return False
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
@@ -45,7 +67,7 @@ def initialize(coordinator_address: Optional[str] = None,
     # jax.distributed.initialize() permanently refuses — i.e. the old
     # process_count() probe made every explicit multi-host join fail.
     # (Caught by the 2-process simulated-pod test.)
-    if jax.distributed.is_initialized():
+    if _distributed_initialized():
         return
     try:
         jax.distributed.initialize(

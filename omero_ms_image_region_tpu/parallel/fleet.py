@@ -1,0 +1,856 @@
+"""Data-parallel device fleet: sharded serving across N device sets.
+
+The reference scales horizontally by clustering verticle JVMs over
+Hazelcast (``-cluster``): every node consumes the same event-bus
+address and the cluster's consistent view decides who serves what.
+The TPU-native form here is a :class:`FleetRouter` in the frontend: N
+members — in-process device lanes (``--role combined``) or render
+sidecars each owning a device set (``--role frontend`` +
+``fleet.sockets``) — each own a *shard* of the hot HBM state.
+
+Routing is a consistent hash of the request's **plane identity**
+(:func:`plane_route_key`: image, z, t, resolution, tile/region — the
+source bytes' address, never the rendering settings), so every render
+of one plane lands on the one member whose ``DeviceRawCache`` holds
+it: the fleet's HBM tier *shards* instead of duplicating, and
+staged-once semantics ride the existing digest probes unchanged.
+Re-window/re-color traffic for a hot plane always finds its bytes
+already resident on its owner.
+
+Load skew is handled by **bounded work stealing**: each member drains
+its own queue through ``lane_width`` worker lanes, and an idle lane
+may steal the oldest queued request from the most-backlogged member —
+the stolen render runs from source bytes *without adopting cache
+ownership* (``adopt_cache=False`` rides the wire as the ``adopt``
+header), so stealing never fragments the shard map.
+
+Membership is decided by the PR-3 breaker/supervisor machinery: a
+member whose connection died through every policy retry (or whose
+breaker is open) is marked down, its shard fails over **hash-ring-
+next** (the classic consistent-hash contract: only ~1/N of the key
+space moves), and its queued work is re-assigned.  The supervisor
+brings the process back; the ring re-adopts it after the cooldown.
+
+Fleet-aware single-flight and admission live *above* the router
+(:class:`FleetImageHandler`): identical renders coalesce once
+fleet-wide, and shedding sees the fleet's total depth.  The lockstep
+``MeshRenderer`` stays behind the router for full-plane/z-projection
+jobs — those pin to the first member (the mesh lane) and are never
+stolen.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import hashlib
+import bisect
+import logging
+import time
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------ hash ring
+
+class HashRing:
+    """Consistent hash ring with virtual nodes.
+
+    Deterministic across processes and runs (BLAKE2b over the literal
+    strings — never Python's salted ``hash()``), so a frontend fleet
+    restart can never silently reshuffle which member owns which
+    plane.  ``replicas`` virtual nodes per member keep the key-space
+    split near-uniform; member join/leave moves only the keys whose
+    ring arcs changed hands (~1/N of the space — pinned by the remap
+    bound test in tier-1).
+    """
+
+    def __init__(self, members: Sequence[str], replicas: int = 64):
+        if not members:
+            raise ValueError("hash ring needs at least one member")
+        if len(set(members)) != len(members):
+            raise ValueError("duplicate fleet member names")
+        self.replicas = max(1, int(replicas))
+        self.members: Tuple[str, ...] = tuple(members)
+        self._points: List[int] = []
+        self._owners: List[str] = []
+        points = []
+        for name in self.members:
+            for v in range(self.replicas):
+                points.append((self._point(f"{name}#{v}"), name))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [o for _, o in points]
+
+    @staticmethod
+    def _point(s: str) -> int:
+        return int.from_bytes(
+            hashlib.blake2b(s.encode(), digest_size=8).digest(),
+            "big")
+
+    def chain(self, key: str) -> List[str]:
+        """Members in ring order from ``key``'s arc, deduplicated: the
+        first entry owns the key; the rest are its failover order
+        (hash-ring-next), so one member's death moves each of its keys
+        to a *deterministic* successor."""
+        if not self._points:
+            return []
+        i = bisect.bisect(self._points, self._point(key)) \
+            % len(self._points)
+        seen = []
+        for step in range(len(self._points)):
+            owner = self._owners[(i + step) % len(self._points)]
+            if owner not in seen:
+                seen.append(owner)
+                if len(seen) == len(self.members):
+                    break
+        return seen
+
+    def member(self, key: str) -> str:
+        """The key's owning member."""
+        return self.chain(key)[0]
+
+
+def plane_route_key(ctx) -> str:
+    """The request's source-plane identity — everything that pins WHICH
+    bytes are read, nothing the rendering settings touch.  All renders
+    of one plane (re-window, re-color, LUT flips, format changes) hash
+    to the same member, which is exactly what makes the fleet's HBM
+    tier shard instead of duplicate."""
+    tile = (ctx.tile.x, ctx.tile.y, ctx.tile.width, ctx.tile.height) \
+        if ctx.tile is not None else None
+    region = (ctx.region.x, ctx.region.y, ctx.region.width,
+              ctx.region.height) if ctx.region is not None else None
+    parts = (ctx.image_id, ctx.z, ctx.t, ctx.resolution, tile, region)
+    return hashlib.blake2b(repr(parts).encode(),
+                           digest_size=16).hexdigest()
+
+
+# -------------------------------------------------------------- members
+
+class MemberDownError(ConnectionError):
+    """A member's fast-fail refusal while it is ALREADY marked down.
+
+    The lane must not treat this as a fresh death observation:
+    re-marking on every routed request would push ``_down_until``
+    forward each time, so any shard seeing >= 1 request per cooldown
+    window would keep its member down forever — after the outage
+    healed.  Only a failure of a render the member actually accepted
+    (re-)marks it down."""
+
+
+class LocalMember:
+    """An in-process device lane: its own renderer + HBM cache behind
+    an ``ImageRegionHandler`` (host-side services — pixel stores, byte
+    caches, metadata, ACL memo — are shared with the other members).
+
+    Down state is a COOLDOWN, exactly like :class:`RemoteMember`'s: the
+    shared host-side services mean one transient outage (a metadata DB
+    or network pixel-store hiccup surfacing as ``ConnectionError``) can
+    mark every member down within a single failover chain, and a latch
+    with no re-admission path would leave the whole fleet dead until a
+    process restart.  A served render — or the cooldown expiring —
+    re-admits the member.
+
+    ``byte_cache_prechecked`` marks that the fleet handler above the
+    router already ran the byte-cache probe and the caller's ACL gate
+    for every dispatched ctx (``build_local_members`` sets it — the
+    combined role always fronts members with ``FleetImageHandler``),
+    so the member's own handler skips its duplicate byte-cache get.
+
+    ``services`` is kept for shard accounting (``raw_cache``) and
+    teardown; ``handler`` is duck-typed so tests can wrap it with
+    deterministic failure injectors."""
+
+    remote = False
+
+    def __init__(self, name: str, handler, services=None,
+                 down_cooldown_s: float = 5.0,
+                 byte_cache_prechecked: bool = False):
+        self.name = name
+        self.handler = handler
+        self.services = services
+        self.down_cooldown_s = down_cooldown_s
+        self.byte_cache_prechecked = byte_cache_prechecked
+        self._down_until = 0.0
+
+    @property
+    def healthy(self) -> bool:
+        return time.monotonic() >= self._down_until
+
+    def mark_down(self) -> None:
+        self._down_until = time.monotonic() + self.down_cooldown_s
+
+    def revive(self) -> None:
+        self._down_until = 0.0
+
+    async def render(self, ctx, adopt_cache: bool = True) -> bytes:
+        if not self.healthy:
+            raise MemberDownError(
+                f"fleet member {self.name} is down")
+        if self.byte_cache_prechecked:
+            data = await self.handler.render_image_region(
+                ctx, adopt_cache=adopt_cache, skip_byte_cache=True)
+        else:
+            data = await self.handler.render_image_region(
+                ctx, adopt_cache=adopt_cache)
+        self.revive()          # a served call re-admits the member
+        return data
+
+    def queue_depth(self) -> int:
+        renderer = getattr(self.services, "renderer", None)
+        return (renderer.queue_depth()
+                if hasattr(renderer, "queue_depth") else 0)
+
+    def resident_digests(self):
+        cache = getattr(self.services, "raw_cache", None)
+        if cache is None or not hasattr(cache, "resident_digests"):
+            return set()
+        return cache.resident_digests()
+
+    def resident_planes(self) -> int:
+        cache = getattr(self.services, "raw_cache", None)
+        return len(cache) if cache is not None else 0
+
+
+class RemoteMember:
+    """A render sidecar owning a device set, reached over the wire.
+
+    Health is the PR-3 machinery's verdict: the client's circuit
+    breaker open, or a connection death observed by a lane worker,
+    marks the member down for ``down_cooldown_s`` — its shard fails
+    over hash-ring-next while the supervisor restarts the process, and
+    the ring re-adopts it at the next successful call after cooldown.
+    """
+
+    remote = True
+
+    def __init__(self, name: str, client, down_cooldown_s: float = 5.0):
+        self.name = name
+        self.client = client
+        self.down_cooldown_s = down_cooldown_s
+        self._down_until = 0.0
+
+    @property
+    def healthy(self) -> bool:
+        breaker = getattr(self.client, "breaker", None)
+        if breaker is not None and breaker.state == breaker.OPEN:
+            return False
+        return time.monotonic() >= self._down_until
+
+    def mark_down(self) -> None:
+        self._down_until = time.monotonic() + self.down_cooldown_s
+
+    def revive(self) -> None:
+        self._down_until = 0.0
+
+    async def render(self, ctx, adopt_cache: bool = True) -> bytes:
+        from ..server.sidecar import _map_response
+        extra = None if adopt_cache else {"adopt": 0}
+        resp_header, payload = await self.client.call_full(
+            "image", ctx.to_json(), extra=extra)
+        self.revive()          # a served call re-admits the member
+        return _map_response(resp_header, payload)
+
+    def queue_depth(self) -> int:
+        return 0               # the sidecar's own gauge carries this
+
+    def resident_digests(self):
+        return set()
+
+    def resident_planes(self) -> int:
+        return 0
+
+
+# --------------------------------------------------------------- router
+
+class _Work:
+    __slots__ = ("ctx", "future", "owner", "stolen", "hops",
+                 "deadline", "t_enqueue")
+
+    def __init__(self, ctx, future, owner: str, deadline):
+        self.ctx = ctx
+        self.future = future
+        self.owner = owner
+        self.stolen = False
+        self.hops = 0
+        self.deadline = deadline
+        self.t_enqueue = time.perf_counter()
+
+
+class FleetRouter:
+    """Consistent-hash request router over N fleet members.
+
+    Per-member queues drained by ``lane_width`` asyncio lanes each (a
+    lane models one device lane of that member's set); an idle lane
+    steals the oldest request from the most-backlogged peer once that
+    backlog reaches ``steal_min_backlog`` — bounded, oldest-first, and
+    cache-ownership-neutral (stolen renders carry
+    ``adopt_cache=False``).  Member death (ConnectionError through the
+    retry policy / breaker) marks the member down, re-assigns its
+    queued work hash-ring-next and fails the dead call over the same
+    way, so a mid-burst kill yields zero 5xx-without-shed.
+    """
+
+    def __init__(self, members: Sequence, lane_width: int = 2,
+                 steal_min_backlog: int = 2, hash_replicas: int = 64,
+                 failover: bool = True):
+        if not members:
+            raise ValueError("fleet needs at least one member")
+        if lane_width < 1:
+            raise ValueError("fleet lane_width must be >= 1")
+        self.members: Dict[str, object] = {m.name: m for m in members}
+        if len(self.members) != len(members):
+            raise ValueError("duplicate fleet member names")
+        self.order: List[str] = [m.name for m in members]
+        self.ring = HashRing(self.order, replicas=hash_replicas)
+        self.lane_width = lane_width
+        # 0 disables stealing entirely.
+        self.steal_min_backlog = max(0, int(steal_min_backlog))
+        self.failover = failover
+        # The admission controller reads this as the fleet's service
+        # parallelism (estimated wait = depth * EWMA / lanes).
+        self.device_lanes = lane_width * len(members)
+        self._queues: Dict[str, Deque[_Work]] = {
+            name: collections.deque() for name in self.order}
+        self._inflight: Dict[str, int] = {n: 0 for n in self.order}
+        # ONE wake event for all idle lanes: stealing means any lane
+        # may be interested in any member's new work, and at fleet
+        # scale (N <= ~16 members) a broadcast wake is cheaper than a
+        # correct per-member + steal-candidate wake dance.
+        self._wake: Optional[asyncio.Event] = None
+        self._lanes: List[asyncio.Task] = []
+        self._closed = False
+
+    # ----------------------------------------------------------- routing
+
+    @staticmethod
+    def _pinned(ctx) -> bool:
+        """Full-plane and z-projection jobs pin to the mesh lane
+        (member 0) and are never stolen or ring-routed."""
+        return ctx.projection is not None or (
+            ctx.tile is None and ctx.region is None)
+
+    def owner_of(self, ctx) -> str:
+        """The healthy member owning this request's plane (hash-ring-
+        next past down members).  Full-plane and z-projection jobs pin
+        to the first member — the lane whose renderer is the lockstep
+        ``MeshRenderer`` in mesh deployments — and never shard."""
+        if self._pinned(ctx):
+            chain = list(self.order)     # member 0 first = mesh lane
+        else:
+            chain = self.ring.chain(plane_route_key(ctx))
+        if not self.failover:
+            # Contract symmetry with _fail_queue: failover=false means
+            # a dead member's shard FAILS — for queued work and new
+            # arrivals alike.  Walking past an unhealthy owner here
+            # would silently re-home its planes onto the ring
+            # successor (with adopt_cache=True and no failed_over
+            # tick), exactly the shard migration the operator
+            # disabled.
+            return chain[0]
+        for name in chain:
+            if self.members[name].healthy:
+                return name
+        # Every member down: hand the ring owner the call anyway so
+        # the failure surfaces as the ConnectionError -> 503 contract
+        # instead of an unroutable internal error.
+        return chain[0]
+
+    def queue_depth(self) -> int:
+        """Queued + executing across the whole fleet (what fleet-aware
+        admission and /readyz see)."""
+        return (sum(len(q) for q in self._queues.values())
+                + sum(self._inflight.values()))
+
+    def member_depth(self, name: str) -> int:
+        return len(self._queues[name])
+
+    def member_inflight(self, name: str) -> int:
+        return self._inflight[name]
+
+    def healthy_members(self) -> List[str]:
+        return [n for n in self.order if self.members[n].healthy]
+
+    # ---------------------------------------------------------- dispatch
+
+    def _ensure_lanes(self) -> None:
+        if self._lanes or self._closed:
+            return
+        from ..utils import transient
+        self._wake = asyncio.Event()
+        # Lanes are spawned lazily from the FIRST request's context —
+        # detach them from its deadline contextvar (create_task
+        # snapshots the context), or every render in every lane would
+        # permanently inherit that one request's budget and start
+        # 504ing fleet-wide the moment it expires.  Each unit's own
+        # budget is re-established around its render from
+        # ``work.deadline``.
+        with transient.deadline_scope(None):
+            for name in self.order:
+                for lane in range(self.lane_width):
+                    self._lanes.append(asyncio.create_task(
+                        self._lane(name), name=f"fleet-{name}-l{lane}"))
+
+    async def dispatch(self, ctx) -> bytes:
+        """Route one render to its shard owner and await the bytes.
+        Runs on the event loop; all queue bookkeeping is loop-confined
+        (no lock), like the single-flight table."""
+        from ..utils import telemetry, transient
+
+        if self._closed:
+            raise ConnectionError("fleet router is closed")
+        self._ensure_lanes()
+        owner = self.owner_of(ctx)
+        work = _Work(ctx, asyncio.get_running_loop().create_future(),
+                     owner, transient.deadline())
+        self._queues[owner].append(work)
+        telemetry.FLEET.count_routed(owner)
+        self._wake.set()
+        remaining = transient.remaining_ms()
+        if remaining is None:
+            return await work.future
+        try:
+            # The member render enforces its own budget too; this
+            # bound covers a lane wedged in an uncancellable render.
+            return await asyncio.wait_for(
+                asyncio.shield(work.future),
+                timeout=max(0.0, remaining) / 1000.0)
+        except asyncio.TimeoutError:
+            # The waiter is gone: cancel the unit so a lane popping
+            # it later skips instead of rendering bytes nobody will
+            # retrieve (and so no 'exception never retrieved' noise).
+            if not work.future.done():
+                work.future.cancel()
+            raise transient.DeadlineExceededError(
+                "deadline exceeded awaiting fleet render")
+        except asyncio.CancelledError:
+            if not work.future.done():
+                work.future.cancel()
+            raise
+
+    def _takeable(self, name: str) -> bool:
+        """Is there work this member's lanes could take right now —
+        its own backlog, or a peer backlog past the steal threshold?"""
+        if self._queues[name]:
+            return True
+        if self.steal_min_backlog <= 0 \
+                or not self.members[name].healthy:
+            return False
+        # Mirrors _pop_work's steal candidates exactly (including the
+        # pinned-head exclusion) — a backlog this lane can NEVER steal
+        # must park it on the wake event, not busy-spin it.
+        return any(
+            len(self._queues[other]) >= self.steal_min_backlog
+            and not self._pinned(self._queues[other][0].ctx)
+            for other in self.order if other != name)
+
+    def _pop_work(self, name: str) -> Optional[_Work]:
+        """This lane's next unit: own queue first; otherwise steal the
+        OLDEST request from the most-backlogged healthy-owned queue at
+        or past the steal threshold (oldest-first keeps the latency
+        tail honest — LIFO stealing would starve the convoy head)."""
+        queue = self._queues[name]
+        if queue:
+            return queue.popleft()
+        if (self.steal_min_backlog <= 0
+                or not self.members[name].healthy):
+            return None
+        victim = None
+        depth = 0
+        for other in self.order:
+            if other == name:
+                continue
+            queue_o = self._queues[other]
+            qlen = len(queue_o)
+            if (qlen >= self.steal_min_backlog and qlen > depth
+                    # A pinned (mesh-lane) job at the head is not
+                    # stealable — it exists to run on member 0's
+                    # lockstep renderer, not a single-device lane.
+                    and not self._pinned(queue_o[0].ctx)):
+                victim, depth = other, qlen
+        if victim is None:
+            return None
+        work = self._queues[victim].popleft()
+        work.stolen = True
+        from ..utils import telemetry
+        telemetry.FLEET.count_stolen(name)
+        telemetry.FLIGHT.record("fleet.steal", by=name,
+                                owner=work.owner, backlog=depth)
+        return work
+
+    def _reassign(self, dead: str) -> None:
+        """A member died: move its queued work to each item's
+        hash-ring-next healthy owner (the failover shard owner — the
+        work ADOPTS there, it is not a steal)."""
+        from ..utils import telemetry
+        queue = self._queues[dead]
+        moved = 0
+        while queue:
+            work = queue.popleft()
+            self._route_failover(work)
+            moved += 1
+        if moved:
+            telemetry.FLIGHT.record("fleet.drain", member=dead,
+                                    moved=moved)
+            self._wake.set()
+
+    def _fail_queue(self, dead: str, error: Exception) -> None:
+        """failover=False: a dead member's queued work fails with it."""
+        queue = self._queues[dead]
+        while queue:
+            work = queue.popleft()
+            if not work.future.done():
+                work.future.set_exception(ConnectionError(str(error)))
+
+    def _route_failover(self, work: _Work) -> None:
+        """Re-enqueue one unit on the first healthy ring member.  The
+        member that just failed is excluded by the health check alone
+        (it was marked down before this runs) — NOT by ``work.owner``:
+        for STOLEN work the owner is a healthy member that never
+        failed, and it is exactly where the unit should land (a dead
+        stealer's loot goes home; a 2-member fleet must not 503 a
+        request whose shard owner is alive)."""
+        from ..utils import telemetry
+        chain = (list(self.order) if self._pinned(work.ctx)
+                 else self.ring.chain(plane_route_key(work.ctx)))
+        tried = work.hops
+        for name in chain:
+            if not self.members[name].healthy:
+                continue
+            work.owner = name
+            work.hops = tried + 1
+            work.stolen = False
+            self._queues[name].append(work)
+            telemetry.FLEET.count_failed_over(name)
+            return
+        if not work.future.done():
+            work.future.set_exception(ConnectionError(
+                "no healthy fleet member for shard"))
+
+    async def _lane(self, name: str) -> None:
+        from ..utils import telemetry, transient
+
+        member = self.members[name]
+        while not self._closed:
+            work = self._pop_work(name)
+            if work is None:
+                self._wake.clear()
+                # Re-check under the cleared event for work THIS lane
+                # could take (a dispatch between pop and clear must
+                # not be lost — but peers' sub-threshold backlogs must
+                # not busy-spin a lane that cannot steal them).
+                if self._takeable(name):
+                    continue
+                await self._wake.wait()
+                continue
+            if work.future.done():
+                continue              # waiter gave up while queued
+            if work.deadline is not None \
+                    and time.monotonic() >= work.deadline:
+                telemetry.RESILIENCE.count_deadline_cancelled(1)
+                if not work.future.done():
+                    work.future.set_exception(
+                        transient.DeadlineExceededError(
+                            "deadline exceeded in fleet queue"))
+                continue
+            self._inflight[name] += 1
+            try:
+                # A stolen render executes on THIS member from source
+                # bytes without adopting cache ownership; owned (and
+                # failed-over) work adopts — the failover target IS
+                # the shard's new ring owner.  The unit's remaining
+                # budget re-enters the context here (the lane task
+                # itself is deadline-free), so the member pipeline's
+                # own check_deadline / wire deadline_ms still bite.
+                if work.deadline is not None:
+                    remaining_ms = max(
+                        1.0, (work.deadline - time.monotonic())
+                        * 1000.0)
+                    with transient.deadline_scope(remaining_ms):
+                        data = await member.render(
+                            work.ctx, adopt_cache=not work.stolen)
+                else:
+                    data = await member.render(
+                        work.ctx, adopt_cache=not work.stolen)
+            except (ConnectionError, OSError) as e:
+                if not member.remote \
+                        and not isinstance(e, ConnectionError):
+                    # A LOCAL render's OSError (missing/truncated
+                    # pyramid file, EIO) is that one request's
+                    # failure, never member death — treating it as
+                    # death would cascade a bad file into marking
+                    # every member down in failover order.
+                    if not work.future.done():
+                        work.future.set_exception(e)
+                    continue
+                if not isinstance(e, MemberDownError):
+                    # A fast-fail from an already-down member is not
+                    # a new death — re-marking would extend the
+                    # cooldown on every request and the member could
+                    # never rejoin under steady traffic.
+                    member.mark_down()
+                    telemetry.FLIGHT.record("fleet.member-down",
+                                            member=name,
+                                            error=str(e)[:120])
+                if not self.failover:
+                    # Contract: the shard fails as the member does —
+                    # queued work included, never re-homed.
+                    logger.warning("fleet member %s down (%s); "
+                                   "failover disabled, failing its "
+                                   "shard", name, e)
+                    self._fail_queue(name, e)
+                    if not work.future.done():
+                        work.future.set_exception(e)
+                    continue
+                logger.warning("fleet member %s down (%s); failing "
+                               "its shard over hash-ring-next", name, e)
+                self._reassign(name)
+                if work.hops < len(self.order) - 1:
+                    self._route_failover(work)
+                    self._wake.set()
+                elif not work.future.done():
+                    work.future.set_exception(e)
+            except asyncio.CancelledError:
+                # Router teardown mid-render: waiters sit in HTTP
+                # handlers whose ``except Exception`` must map this to
+                # a 500, never a dropped connection.
+                if not work.future.done():
+                    work.future.set_exception(
+                        RuntimeError("fleet router shut down"))
+                raise
+            except Exception as e:
+                if not work.future.done():
+                    work.future.set_exception(e)
+            else:
+                if not work.future.done():
+                    work.future.set_result(data)
+            finally:
+                self._inflight[name] -= 1
+
+    # --------------------------------------------------------- accounting
+
+    def shard_report(self) -> dict:
+        """HBM shard accounting across local members: per-member
+        resident planes, and how many content digests are resident on
+        MORE than one member (the duplicate-staging figure the fleet
+        exists to hold at ~0)."""
+        per_member = {}
+        seen: Dict[str, int] = {}
+        for name in self.order:
+            digests = self.members[name].resident_digests()
+            per_member[name] = self.members[name].resident_planes()
+            for d in digests:
+                seen[d] = seen.get(d, 0) + 1
+        return {
+            "members": per_member,
+            "resident_digests": len(seen),
+            "duplicate_digests": sum(1 for n in seen.values()
+                                     if n > 1),
+        }
+
+    async def close(self) -> None:
+        self._closed = True
+        for task in self._lanes:
+            task.cancel()
+        if self._lanes:
+            await asyncio.gather(*self._lanes, return_exceptions=True)
+        self._lanes = []
+        for queue in self._queues.values():
+            while queue:
+                work = queue.popleft()
+                if not work.future.done():
+                    work.future.set_exception(
+                        RuntimeError("fleet router shut down"))
+
+
+# ------------------------------------------------------ frontend handler
+
+class FleetImageHandler:
+    """The fleet-topology drop-in for ``ImageRegionHandler`` /
+    ``SidecarImageHandler``: byte-cache-first (combined role — hits
+    never shed), then fleet-wide single-flight, then fleet-aware
+    admission, then the router.
+
+    ``base_services`` (combined role) supplies the shared byte caches
+    and the ACL memo; proxy fleets pass None — their sidecars own
+    caches and ACL, exactly like the single-sidecar posture, and the
+    single-flight key folds the caller's session in (see below).
+
+    ``fallback`` (``server.degraded.DegradedCpuHandler``, proxy fleets
+    only) keeps tiles servable when the WHOLE fleet is unreachable —
+    same seam as ``SidecarImageHandler``; a live member's own verdict
+    (shed, 4xx, deadline) never falls back."""
+
+    def __init__(self, router: FleetRouter, single_flight=None,
+                 admission=None, base_services=None, fallback=None):
+        self.router = router
+        self.single_flight = single_flight
+        self.admission = admission
+        self.s = base_services
+        self.fallback = fallback
+
+    async def _cached(self, ctx) -> Optional[bytes]:
+        if self.s is None:
+            return None
+        from ..server.errors import NotFoundError
+        from ..server.handler import check_can_read
+        from ..utils import telemetry
+        t0 = time.perf_counter()
+        cached = await self.s.caches.image_region.get(ctx.cache_key)
+        if cached is None:
+            return None
+        if not await check_can_read(self.s, "Image", ctx.image_id,
+                                    ctx.omero_session_key):
+            raise NotFoundError(f"Cannot find Image:{ctx.image_id}")
+        telemetry.record_span("cache.hit", t0,
+                              (time.perf_counter() - t0) * 1000.0)
+        return cached
+
+    async def render_image_region(self, ctx) -> bytes:
+        from ..server.errors import NotFoundError, OverloadedError
+        from ..utils import telemetry, transient
+
+        t0 = time.perf_counter()
+        cached = await self._cached(ctx)
+        if cached is not None:
+            return cached
+        if self.s is not None:
+            # ACL gates PER CALLER before the shared render is
+            # awaited (the render_identity_key contract): a follower
+            # must never receive coalesced pixels its session cannot
+            # read.
+            from ..server.handler import check_can_read
+            if not await check_can_read(self.s, "Image", ctx.image_id,
+                                        ctx.omero_session_key):
+                raise NotFoundError(
+                    f"Cannot find Image:{ctx.image_id}")
+
+        async def produce() -> bytes:
+            admission = self.admission
+            t_admit = admission.admit() if admission is not None \
+                else None
+            completed = False
+            try:
+                transient.check_deadline("fleet render")
+                try:
+                    data = await self.router.dispatch(ctx)
+                except (ConnectionError, OverloadedError):
+                    # Degraded mode: only when NO member is left to
+                    # serve — a live member's shed/verdict stands.
+                    if (self.fallback is None
+                            or self.router.healthy_members()):
+                        raise
+                    telemetry.RESILIENCE.count_degraded_render()
+                    data = await \
+                        self.fallback.render_image_region(ctx)
+                completed = True
+                return data
+            finally:
+                if admission is not None:
+                    admission.release(t_admit, completed=completed)
+
+        if self.single_flight is None:
+            remaining = transient.remaining_ms()
+            if remaining is None:
+                return await produce()
+            try:
+                return await asyncio.wait_for(
+                    produce(), timeout=max(0.0, remaining) / 1000.0)
+            except asyncio.TimeoutError:
+                raise transient.DeadlineExceededError(
+                    "deadline exceeded awaiting fleet render")
+        from ..server.settings import render_identity_key
+        key = render_identity_key(ctx)
+        if self.s is None:
+            # Proxy fleet: this process CANNOT check ACL, so identical
+            # renders coalesce per-session only — each session's
+            # leader carries its own ctx to a sidecar whose handler
+            # runs the full ACL gate.  (Combined role checked above,
+            # so cross-session coalescing stays.)
+            key = f"{key}|{ctx.omero_session_key or ''}"
+        data, coalesced = await self.single_flight.run(key, produce)
+        if coalesced:
+            telemetry.record_span(
+                "dedup.coalesced", t0,
+                (time.perf_counter() - t0) * 1000.0)
+        return data
+
+    async def render_image_region_stream(self, ctx):
+        """Chunked-response surface parity: the fleet answer is one
+        body (each member's own first-tile-out settlement already
+        pulled its latency in); the HTTP layer keeps its one uniform
+        chunked path."""
+        yield await self.render_image_region(ctx)
+
+
+# ---------------------------------------------------------- construction
+
+def build_local_members(config, base_services, n: int
+                        ) -> List[LocalMember]:
+    """N in-process fleet members over a shared host-side service
+    stack: member 0 IS the base stack (its renderer may be the
+    lockstep ``MeshRenderer``); members 1..N-1 get their own renderer
+    + ``DeviceRawCache`` (their shard of HBM) and share everything
+    host-side — pixel stores, byte caches, metadata, ACL memo, LUTs.
+
+    One JAX process: the members shard serving state (cache, queues,
+    lanes) but all dispatch to the process's default device — this
+    topology does NOT spread compute across a multi-chip host.  Real
+    per-member device sets are the ``fleet.sockets`` topology, one
+    ``JAX_VISIBLE_DEVICES``-pinned sidecar process per member
+    (per-member device pinning here is an open roadmap item).
+
+    Member-level single-flight and admission are disabled on the extra
+    members: both concerns live fleet-wide above the router."""
+    from ..io.devicecache import DeviceRawCache
+    from ..server.batcher import BatchingRenderer
+    from ..server.handler import (ImageRegionHandler,
+                                  ImageRegionServices, Renderer)
+
+    cooldown = config.fleet.down_cooldown_s
+    members = [LocalMember("m0", ImageRegionHandler(base_services),
+                           services=base_services,
+                           down_cooldown_s=cooldown,
+                           byte_cache_prechecked=True)]
+    for i in range(1, n):
+        if config.batcher.enabled and not config.parallel.enabled:
+            renderer = BatchingRenderer(
+                max_batch=config.batcher.max_batch,
+                max_batch_limit=config.batcher.max_batch_limit,
+                linger_ms=config.batcher.linger_ms,
+                jpeg_engine=(base_services.renderer.jpeg_engine
+                             if getattr(base_services.renderer,
+                                        "jpeg_engine", None)
+                             in ("sparse", "huffman") else "sparse"),
+                pipeline_depth=config.batcher.pipeline_depth,
+                target_inflight=config.batcher.target_inflight,
+                device_lanes=config.batcher.device_lanes)
+            renderer.first_tile_out = config.wire.streaming
+        else:
+            engine = config.renderer.jpeg_engine
+            if engine == "auto":
+                engine = getattr(base_services.renderer,
+                                 "jpeg_engine", "sparse")
+            renderer = Renderer(jpeg_engine=engine,
+                                kernel=config.renderer.kernel)
+        raw_cache = (DeviceRawCache(
+            config.raw_cache.max_bytes,
+            digest_index=config.raw_cache.digest_dedup)
+            if config.raw_cache.enabled else None)
+        services = ImageRegionServices(
+            pixels_service=base_services.pixels_service,
+            metadata=base_services.metadata,
+            caches=base_services.caches,
+            can_read_memo=base_services.can_read_memo,
+            renderer=renderer,
+            lut_provider=base_services.lut_provider,
+            max_tile_length=base_services.max_tile_length,
+            raw_cache=raw_cache,
+            cpu_fallback_max_px=base_services.cpu_fallback_max_px,
+        )
+        members.append(LocalMember(
+            f"m{i}", ImageRegionHandler(services), services=services,
+            down_cooldown_s=cooldown, byte_cache_prechecked=True))
+    return members

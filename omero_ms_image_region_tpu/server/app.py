@@ -404,16 +404,21 @@ def create_app(config: Optional[AppConfig] = None,
         breach_burn_rate=config.slo.breach_burn_rate,
         on_breach=_on_slo_breach)
 
+    fleet_router = None
+    fleet_members: list = []
+    fleet_remote = (services is None and config.fleet.enabled
+                    and config.fleet.sockets
+                    and config.sidecar.role == "frontend")
     proxy_mode = (services is None and config.sidecar.socket
-                  and config.sidecar.role == "frontend")
-    if proxy_mode:
+                  and config.sidecar.role == "frontend"
+                  and not fleet_remote)
+
+    def _sidecar_client(socket_path: str):
         from ..utils.transient import CircuitBreaker, RetryPolicy
-        from .sidecar import (SidecarClient, SidecarImageHandler,
-                              SidecarMaskHandler)
-        _install_fault_injection(config)
+        from .sidecar import SidecarClient
         ft = config.fault_tolerance
-        client = SidecarClient(
-            config.sidecar.socket,
+        return SidecarClient(
+            socket_path,
             breaker=CircuitBreaker(
                 failure_threshold=ft.breaker_failure_threshold,
                 reset_after_s=ft.breaker_reset_s),
@@ -424,8 +429,56 @@ def create_app(config: Optional[AppConfig] = None,
             # Wire v3 knobs: coalescing bounds, shm-ring sizing,
             # chunk streaming (deploy/DEPLOY.md "Wire transport").
             wire=config.wire)
+
+    if fleet_remote:
+        # Data-parallel sidecar fleet (deploy/DEPLOY.md "Fleet
+        # serving"): one SidecarClient per member, consistent-hash
+        # routing of plane identities so each sidecar's HBM cache
+        # holds its shard, fleet-wide single-flight + admission above
+        # the router, hash-ring-next failover on member death.
+        from ..parallel.fleet import (FleetImageHandler, FleetRouter,
+                                      RemoteMember)
+        from .sidecar import SidecarMaskHandler
+        _install_fault_injection(config)
+        fleet_members = [
+            RemoteMember(f"m{i}", _sidecar_client(sock),
+                         down_cooldown_s=config.fleet.down_cooldown_s)
+            for i, sock in enumerate(config.fleet.sockets)]
+        fleet_router = FleetRouter(
+            fleet_members, lane_width=config.fleet.lane_width,
+            steal_min_backlog=config.fleet.steal_min_backlog,
+            hash_replicas=config.fleet.hash_replicas,
+            failover=config.fleet.failover)
+        single_flight = None
+        if config.single_flight:
+            from .singleflight import SingleFlight
+            single_flight = SingleFlight()
+        admission = None
+        if config.fault_tolerance.admission_max_queue > 0:
+            from .admission import AdmissionController
+            admission = AdmissionController(
+                config.fault_tolerance.admission_max_queue,
+                renderer=fleet_router,
+                retry_after_s=config.fault_tolerance.shed_retry_after_s)
         fallback = None
-        if ft.degraded_mode:
+        if config.fault_tolerance.degraded_mode:
+            from .degraded import DegradedCpuHandler
+            fallback = DegradedCpuHandler(config)
+        image_handler = FleetImageHandler(
+            fleet_router, single_flight=single_flight,
+            admission=admission, fallback=fallback)
+        # Masks and the merged sidecar surfaces (/metrics,
+        # /debug/*, readiness ping) ride the FIRST member — the
+        # designated member, like the multi-frontend scrape note.
+        client = fleet_members[0].client
+        mask_handler = SidecarMaskHandler(client, fallback=fallback)
+        services = None
+    elif proxy_mode:
+        from .sidecar import SidecarImageHandler, SidecarMaskHandler
+        _install_fault_injection(config)
+        client = _sidecar_client(config.sidecar.socket)
+        fallback = None
+        if config.fault_tolerance.degraded_mode:
             # Graceful degradation: while the device backend is down,
             # tiles render on this process's CPU reference path
             # (server.degraded — jax-free) at reduced rate.
@@ -436,9 +489,43 @@ def create_app(config: Optional[AppConfig] = None,
         services = None
     else:
         from .handler import ImageRegionHandler, ShapeMaskHandler
+        injected = services is not None
         if services is None:
             services = build_services(config)
-        image_handler = ImageRegionHandler(services)
+        if (config.fleet.enabled and not injected
+                and config.sidecar.role == "combined"):
+            # In-process device fleet: member 0 is the base stack
+            # (the lockstep mesh lane in mesh deployments); members
+            # 1..N-1 own their renderer + DeviceRawCache shard.
+            # Single-flight and admission MOVE above the router so
+            # identical renders coalesce once fleet-wide and shedding
+            # sees the fleet's total depth.
+            from ..parallel.fleet import (FleetImageHandler,
+                                          FleetRouter,
+                                          build_local_members)
+            fleet_members = build_local_members(
+                config, services, config.fleet.members)
+            fleet_router = FleetRouter(
+                fleet_members, lane_width=config.fleet.lane_width,
+                steal_min_backlog=config.fleet.steal_min_backlog,
+                hash_replicas=config.fleet.hash_replicas,
+                failover=config.fleet.failover)
+            single_flight = services.single_flight
+            services.single_flight = None
+            services.admission = None
+            admission = None
+            if config.fault_tolerance.admission_max_queue > 0:
+                from .admission import AdmissionController
+                admission = AdmissionController(
+                    config.fault_tolerance.admission_max_queue,
+                    renderer=fleet_router,
+                    retry_after_s=(
+                        config.fault_tolerance.shed_retry_after_s))
+            image_handler = FleetImageHandler(
+                fleet_router, single_flight=single_flight,
+                admission=admission, base_services=services)
+        else:
+            image_handler = ImageRegionHandler(services)
         mask_handler = ShapeMaskHandler(services)
     session_store = _make_session_store(config)
 
@@ -724,6 +811,14 @@ def create_app(config: Optional[AppConfig] = None,
         # hits/fallbacks, chunk streams (this process's side of the
         # socket; the sidecar merge below carries the other side).
         lines += telemetry.wire_metric_lines()
+        if fleet_router is not None:
+            # Fleet routing series: per-member depth/inflight/health,
+            # routed/stolen/failed-over counters, shard ownership —
+            # plus the fleet-wide single-flight table (it moved off
+            # services, whose emitter would otherwise carry it).
+            lines += telemetry.fleet_metric_lines(
+                fleet_router,
+                single_flight=image_handler.single_flight)
         if services is None:
             # Frontend proxy: local series plus the device process's
             # fetched over the sidecar socket (best-effort with a hard
@@ -856,6 +951,15 @@ def create_app(config: Optional[AppConfig] = None,
                 warmstate.snapshot_now)
         return web.json_response(doc)
 
+    def _fleet_note(checks: dict) -> None:
+        """The fleet membership annotation on /readyz, both roles."""
+        down = [n for n in fleet_router.order
+                if n not in fleet_router.healthy_members()]
+        if down:
+            checks["fleet"] = f"members down: {','.join(down)}"
+        else:
+            checks["fleet"] = f"{len(fleet_router.order)} members"
+
     async def _ready_state() -> tuple:
         """(ok, checks) for /readyz: sidecar reachability (proxy mode),
         prewarm completion, and batcher backlog below the configured
@@ -870,25 +974,72 @@ def create_app(config: Optional[AppConfig] = None,
                 # Fail-fast surface: the probe log says WHY requests
                 # are shedding before the ping below even times out.
                 checks["breaker"] = "open"
-            try:
-                status, body = await _asyncio.wait_for(
-                    client.call("ping", {}), timeout=2.0)
-                info = (json.loads(bytes(body).decode())
-                        if status == 200 and body else {})
+            # A fleet frontend probes EVERY currently-healthy member —
+            # health flags alone are not evidence (a member nobody has
+            # called yet reads healthy even with a dead socket), so an
+            # unanswered or garbled ping marks that member down, and
+            # readiness aggregates the answering survivors: prewarm is
+            # pending until ALL of them finished (a single warm member
+            # answering for the fleet would admit traffic whose other
+            # shards still pay cold XLA compiles), and queue pressure
+            # is the SUM of their depths.  All-sidecars-dead reads
+            # UNREADY on the very first probe, not after traffic
+            # burns through.
+            probes = ([(m, m.client) for m in fleet_members]
+                      if fleet_remote else [(None, client)])
+
+            async def _probe(member, probe_client):
+                try:
+                    status, body = await _asyncio.wait_for(
+                        probe_client.call("ping", {}), timeout=2.0)
+                    return status, (json.loads(bytes(body).decode())
+                                    if status == 200 and body else {})
+                except Exception:
+                    if member is not None:
+                        member.mark_down()
+                    return None, None
+
+            # Concurrently: probe latency must stay ~one ping RTT
+            # (worst case one 2 s timeout), not scale with fleet size
+            # — a serial walk over a few unresponsive members would
+            # outlast the LB's probe timeout and pull a servable
+            # instance (survivors cover every shard) from rotation.
+            results = await _asyncio.gather(
+                *(_probe(m, c) for m, c in probes
+                  if m is None or m.healthy))
+            infos = []
+            for status, info in results:
+                if info is None:
+                    continue
                 if status != 200 or not info.get("ok"):
                     ok = False
                     checks["sidecar"] = f"status {status}"
                 else:
-                    checks["sidecar"] = "ok"
-                prewarm_pending = bool(info.get("prewarm_pending"))
-                depth = int(info.get("queue_depth", 0))
-                if info.get("rehydrate") is not None:
+                    checks.setdefault("sidecar", "ok")
+                infos.append(info)
+            if infos:
+                prewarm_pending = any(
+                    bool(i.get("prewarm_pending")) for i in infos)
+                depth = sum(
+                    int(i.get("queue_depth", 0)) for i in infos)
+                notes = [str(i["rehydrate"]) for i in infos
+                         if i.get("rehydrate") is not None]
+                if notes:
                     # Annotation only (like the SLO line): a slow
                     # rehydrate is a cold-ish first minute, never a
                     # reason to pull the instance from rotation.
-                    checks["rehydrate"] = str(info["rehydrate"])
-            except Exception:
+                    checks["rehydrate"] = notes[0]
+                if fleet_router is not None:
+                    # Fleet backlog joins the pressure check, and the
+                    # membership annotation mirrors the combined
+                    # role's (a PARTIALLY dead fleet stays ready —
+                    # survivors serve every shard hash-ring-next).
+                    depth += fleet_router.queue_depth()
+                    _fleet_note(checks)
+            else:
                 checks["sidecar"] = "unreachable"
+                if fleet_router is not None:
+                    _fleet_note(checks)
                 if fallback is not None:
                     # Degraded mode IS servable: the CPU fallback keeps
                     # answering tiles, so a load balancer must keep
@@ -900,8 +1051,21 @@ def create_app(config: Optional[AppConfig] = None,
         else:
             prewarm_pending = telemetry.READINESS.prewarm_pending
             renderer = services.renderer
-            depth = (renderer.queue_depth()
-                     if hasattr(renderer, "queue_depth") else 0)
+            if fleet_router is not None:
+                # Fleet depth (queued + executing across members) IS
+                # the pressure check: a unit handed to member 0's
+                # batcher stays counted as router inflight until it
+                # settles, so adding renderer.queue_depth() on top
+                # would double-count member 0's backlog and pull the
+                # instance from rotation at half the configured
+                # threshold.  A half-dead fleet is an annotation, not
+                # a readiness failure — the survivors still serve
+                # every shard hash-ring-next.
+                depth = fleet_router.queue_depth()
+                _fleet_note(checks)
+            else:
+                depth = (renderer.queue_depth()
+                         if hasattr(renderer, "queue_depth") else 0)
             if services.warmstate is not None:
                 checks["rehydrate"] = \
                     telemetry.PERSIST.rehydrate_summary()
@@ -1007,11 +1171,27 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):
+        if fleet_router is not None:
+            # Stop the lane workers BEFORE the member stacks (and the
+            # shared host services) close under them.
+            await fleet_router.close()
+        if fleet_remote:
+            for member in fleet_members:
+                await member.client.close()
         if proxy_mode:
             await client.close()
         db_meta = app.get("_db_metadata")
         if db_meta is not None:
             await db_meta.close()
+        if services is not None:
+            from .batcher import BatchingRenderer as _BR
+            for member in fleet_members:
+                # Extra members' batchers (member 0's renderer is the
+                # base services' — closed below with the rest).
+                if (member.services is not None
+                        and member.services is not services
+                        and isinstance(member.services.renderer, _BR)):
+                    await member.services.renderer.close()
         if services is not None:
             if services.warmstate is not None:
                 # Stop the snapshot timer and abort any in-flight
@@ -1199,9 +1379,12 @@ def main(argv=None) -> None:
         return
     if args.role is not None:
         config.sidecar.role = args.role
-    if config.sidecar.role != "combined" and not config.sidecar.socket:
+    if config.sidecar.role != "combined" and not config.sidecar.socket \
+            and not (config.sidecar.role == "frontend"
+                     and config.fleet.enabled and config.fleet.sockets):
         parser.error(f"--role {config.sidecar.role} requires "
-                     f"--sidecar-socket")
+                     f"--sidecar-socket (or a fleet.sockets list for "
+                     f"a frontend fleet router)")
 
     configure_logging(config)
 

@@ -158,6 +158,47 @@ class WireConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Data-parallel device fleet (``parallel.fleet``) — the TPU-native
+    analogue of the reference's Hazelcast-clustered verticle fleet: N
+    members each own a shard of the hot HBM state, requests route by a
+    consistent hash of their plane identity, load skew is handled by
+    bounded work stealing, and a dead member's shard fails over
+    hash-ring-next.  See deploy/DEPLOY.md "Fleet serving"."""
+
+    enabled: bool = False
+    # Combined role: N in-process member lanes (member 0 is the base
+    # stack — the lockstep mesh lane in mesh deployments; members
+    # 1..N-1 get their own renderer + DeviceRawCache shard).  NOTE:
+    # one JAX process — members shard cache/queues but all dispatch
+    # to the process's default device; real per-member device SETS
+    # are the ``sockets`` topology (one pinned sidecar process each).
+    members: int = 2
+    # Frontend role: one render sidecar per address; each sidecar owns
+    # its own device set.  Overrides ``members``.
+    sockets: Tuple[str, ...] = ()
+    # Concurrent renders per member (models the member's device
+    # lanes); fleet admission sees lane-width x members as the
+    # service parallelism.
+    lane_width: int = 2
+    # An idle member lane steals the OLDEST queued request from the
+    # most-backlogged peer once that backlog reaches this depth; the
+    # stolen render runs from source bytes without adopting cache
+    # ownership.  0 disables stealing.
+    steal_min_backlog: int = 2
+    # Virtual nodes per member on the hash ring (higher = smoother
+    # key-space split; the golden-assignment tests pin 64).
+    hash_replicas: int = 64
+    # Fail a dead member's shard over hash-ring-next (and re-assign
+    # its queued work).  Off = its requests fail as the member does.
+    failover: bool = True
+    # How long a remote member stays out of the ring after its
+    # connection died through every policy retry (the supervisor's
+    # restart window); the first successful call re-admits it.
+    down_cooldown_s: float = 5.0
+
+
+@dataclass
 class ParallelConfig:
     """Mesh-sharded serving (≙ the reference's ``-cluster`` mode:
     Hazelcast-clustered worker verticles,
@@ -374,6 +415,7 @@ class AppConfig:
     http: HttpConfig = field(default_factory=HttpConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     sidecar: SidecarConfig = field(default_factory=SidecarConfig)
     wire: WireConfig = field(default_factory=WireConfig)
     persistence: PersistenceConfig = field(
@@ -508,9 +550,18 @@ class AppConfig:
         if cfg.sidecar.role not in ("combined", "frontend", "sidecar",
                                     "split"):
             raise ValueError(f"invalid sidecar.role {cfg.sidecar.role!r}")
-        if cfg.sidecar.role != "combined" and not cfg.sidecar.socket:
+        _fleet_raw = raw.get("fleet") or {}
+        if cfg.sidecar.role != "combined" and not cfg.sidecar.socket \
+                and not (cfg.sidecar.role == "frontend"
+                         and _fleet_raw.get("enabled")
+                         and _fleet_raw.get("sockets")):
+            # A frontend may address a FLEET of sidecars instead of
+            # one socket (fleet.enabled + fleet.sockets, parsed
+            # below) — enabled must be set too, because create_app
+            # only takes the fleet topology when it is.
             raise ValueError(f"sidecar.role {cfg.sidecar.role!r} "
-                             f"requires sidecar.socket")
+                             f"requires sidecar.socket (or an "
+                             f"enabled fleet.sockets list)")
         wi = raw.get("wire", {}) or {}
         wi_defaults = WireConfig()
         cfg.wire = WireConfig(
@@ -536,6 +587,38 @@ class AppConfig:
             raise ValueError("wire.ring-min-body-bytes must be >= 1")
         if cfg.wire.chunk_max_bytes < 4096:
             raise ValueError("wire.chunk-max-bytes must be >= 4096")
+        fl = raw.get("fleet", {}) or {}
+        fl_defaults = FleetConfig()
+        cfg.fleet = FleetConfig(
+            enabled=bool(fl.get("enabled", fl_defaults.enabled)),
+            members=int(fl.get("members", fl_defaults.members)),
+            sockets=tuple(str(s) for s in fl.get("sockets", ())
+                          or ()),
+            lane_width=int(fl.get("lane-width",
+                                  fl_defaults.lane_width)),
+            steal_min_backlog=int(fl.get(
+                "steal-min-backlog", fl_defaults.steal_min_backlog)),
+            hash_replicas=int(fl.get("hash-replicas",
+                                     fl_defaults.hash_replicas)),
+            failover=bool(fl.get("failover", fl_defaults.failover)),
+            down_cooldown_s=float(fl.get(
+                "down-cooldown-s", fl_defaults.down_cooldown_s)),
+        )
+        if cfg.fleet.enabled:
+            if not cfg.fleet.sockets and cfg.fleet.members < 2:
+                raise ValueError("fleet.enabled requires members >= 2 "
+                                 "or a fleet.sockets list")
+        if cfg.fleet.members < 1:
+            raise ValueError("fleet.members must be >= 1")
+        if cfg.fleet.lane_width < 1:
+            raise ValueError("fleet.lane-width must be >= 1")
+        if cfg.fleet.steal_min_backlog < 0:
+            raise ValueError("fleet.steal-min-backlog must be >= 0 "
+                             "(0 disables stealing)")
+        if cfg.fleet.hash_replicas < 1:
+            raise ValueError("fleet.hash-replicas must be >= 1")
+        if cfg.fleet.down_cooldown_s < 0:
+            raise ValueError("fleet.down-cooldown-s must be >= 0")
         par = raw.get("parallel", {}) or {}
         par_defaults = ParallelConfig()
         cfg.parallel = ParallelConfig(

@@ -206,82 +206,10 @@ class Renderer:
             engine=engine)[0]
 
 
-class SingleFlight:
-    """In-flight render dedup: concurrent requests for one canonical
-    render identity (``settings.render_identity_key``) coalesce onto a
-    single pending task — today every duplicate pays the full pipeline
-    (read, stage, device render, encode) because the byte cache only
-    answers AFTER the first completes.
-
-    Event-loop confined: all bookkeeping runs on the loop thread, so no
-    lock.  Followers await the leader's task through ``asyncio.shield``,
-    which pins the cancellation contract: a waiter's disconnect (aiohttp
-    cancels its handler) never cancels the shared render the other
-    waiters — or the byte-cache write-back — depend on; the task runs to
-    completion even if EVERY waiter disconnects, so the next identical
-    request hits the byte cache instead of re-rendering.
-    """
-
-    def __init__(self):
-        self._inflight: dict = {}
-        self.hits = 0
-        self.misses = 0
-
-    def inflight(self) -> int:
-        """Pending coalescable renders (the /metrics gauge)."""
-        return len(self._inflight)
-
-    async def run(self, key: str, producer):
-        """``(result, coalesced)`` — ``producer()`` runs at most once
-        per key at a time; followers share the leader's outcome
-        (result OR exception).
-
-        Deadlines: the shared task inherits the LEADER's budget — it
-        is the leader's pipeline run, and that budget is what lets
-        admission's estimated-wait shed and the batcher's dispatch-pop
-        cancellation fire on it.  Each waiter additionally enforces
-        its OWN remaining budget on the await side, so a FOLLOWER
-        whose budget dies gets its 504 without cancelling the render
-        the other waiters depend on (a follower's deadline never
-        touches the shared task; only the leader's budget — the one
-        the run was admitted under — can cancel queued work)."""
-        from ..utils import transient
-
-        task = self._inflight.get(key)
-        if (task is not None
-                and task.get_loop() is not asyncio.get_running_loop()):
-            # A stale entry from another (closed) event loop — test
-            # harnesses run one loop per call — must not strand this
-            # loop's requests behind a task that can never complete.
-            self._inflight.pop(key, None)
-            task = None
-        coalesced = task is not None
-        if task is None:
-            self.misses += 1
-            task = asyncio.ensure_future(producer())
-            self._inflight[key] = task
-
-            def _cleanup(t, key=key):
-                if self._inflight.get(key) is t:
-                    self._inflight.pop(key, None)
-                if not t.cancelled():
-                    t.exception()   # retrieved even with no waiters left
-            task.add_done_callback(_cleanup)
-        else:
-            self.hits += 1
-        remaining = transient.remaining_ms()
-        if remaining is None:
-            return await asyncio.shield(task), coalesced
-        try:
-            # wait_for cancels only the shield wrapper on timeout; the
-            # shared task (and its byte-cache write-back) runs on.
-            result = await asyncio.wait_for(
-                asyncio.shield(task), timeout=max(0.0, remaining)
-                / 1000.0)
-        except asyncio.TimeoutError:
-            raise transient.DeadlineExceededError(
-                "deadline exceeded awaiting coalesced render")
-        return result, coalesced
+from .singleflight import SingleFlight  # noqa: E402,F401  (re-export;
+# the class moved to the device-free singleflight module so frontend
+# fleet routers can coalesce without importing the JAX stack — every
+# existing ``from .handler import SingleFlight`` keeps working)
 
 
 @dataclass
@@ -374,11 +302,30 @@ class ImageRegionHandler:
 
     # ---------------------------------------------------------- entry
 
-    async def render_image_region(self, ctx: ImageRegionCtx) -> bytes:
-        """The cache-first flow (``renderImageRegion``, ``:159-249``)."""
+    async def render_image_region(self, ctx: ImageRegionCtx,
+                                  adopt_cache: bool = True,
+                                  skip_byte_cache: bool = False
+                                  ) -> bytes:
+        """The cache-first flow (``renderImageRegion``, ``:159-249``).
+
+        ``adopt_cache=False`` is the fleet's work-stealing contract
+        (``parallel.fleet``): a STOLEN render reads from source bytes
+        and never inserts into this member's HBM raw cache — the
+        plane's shard ownership stays with its hash-ring owner.  Probe
+        hits still serve (reading costs nothing in ownership), and the
+        byte-cache write-back is unaffected (the byte tier is shared
+        fleet-wide).
+
+        ``skip_byte_cache=True`` (fleet members only) skips the probe
+        of the shared byte tier: ``FleetImageHandler`` already probed
+        it — and ran the caller's ACL gate — immediately before
+        dispatching, so the member-level get would be a guaranteed
+        miss paying a wasted walk of the memory/disk tiers on the hot
+        path.  The write-back below still runs."""
         import time as _time
         t0 = _time.perf_counter()
-        cached = await self.s.caches.image_region.get(ctx.cache_key)
+        cached = (None if skip_byte_cache else
+                  await self.s.caches.image_region.get(ctx.cache_key))
         if cached is not None:
             if await self._can_read("Image", ctx.image_id,
                                     ctx.omero_session_key):
@@ -409,7 +356,8 @@ class ImageRegionHandler:
             try:
                 from ..utils.transient import check_deadline
                 check_deadline("render pipeline")
-                data = await self._get_region(ctx, pixels)
+                data = await self._get_region(ctx, pixels,
+                                              adopt_cache=adopt_cache)
                 completed = True
             finally:
                 if admission is not None:
@@ -498,8 +446,8 @@ class ImageRegionHandler:
         return await asyncio.to_thread(
             svc.get_pixel_source, image_id, candidates, pixels)
 
-    async def _get_region(self, ctx: ImageRegionCtx,
-                          pixels: Pixels) -> bytes:
+    async def _get_region(self, ctx: ImageRegionCtx, pixels: Pixels,
+                          adopt_cache: bool = True) -> bytes:
         if ctx.z < 0 or ctx.z >= pixels.size_z:
             raise BadRequestError(
                 f"Parameter 'theZ' not within bounds: {ctx.z}")
@@ -561,7 +509,10 @@ class ImageRegionHandler:
             else:
                 raw = await asyncio.to_thread(
                     self._read_region, src, ctx, region, level or 0,
-                    active, not tiny)  # tiny renders stay host-side
+                    active,
+                    # Tiny renders stay host-side; stolen fleet work
+                    # reads from source without adopting ownership.
+                    not tiny and adopt_cache)
             if (self.s.prefetcher is not None and ctx.tile is not None
                     and not tiny):   # tiny neighbors never read the cache
                 self.s.prefetcher.tile_served(

@@ -68,6 +68,12 @@ to the v2 behavior against an older peer:
   byte-identical to the v2 single-frame body.  A v2 server ignores the
   unknown ``stream`` key and answers one frame; the client treats that
   as a single-chunk stream — per-request degradation, no handshake.
+Fleet routing (``parallel.fleet``) adds one optional request key, not
+a version bump: ``adopt: 0`` on an ``image`` op marks a STOLEN render
+— the server renders from source bytes without inserting into its HBM
+raw cache, so work stealing never fragments the fleet's shard map.
+Absent (every non-fleet client), behavior is unchanged.
+
 * **Same-host shared-memory ring** — negotiated by a ``hello`` op at
   connection setup: the client creates BOTH directions' ring segments
   (``server.shmring``) and offers their names; a server that attaches
@@ -492,8 +498,20 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                         if op == "image":
                             ctx = ImageRegionCtx.from_json(
                                 header["ctx"])
-                            body = await \
-                                image_handler.render_image_region(ctx)
+                            if header.get("adopt") in (0, False):
+                                # Fleet work stealing: a stolen render
+                                # reads from source bytes and must not
+                                # adopt HBM shard ownership here
+                                # (parallel.fleet).  Only the explicit
+                                # header opts out, so v3-and-earlier
+                                # peers are untouched.
+                                body = await \
+                                    image_handler.render_image_region(
+                                        ctx, adopt_cache=False)
+                            else:
+                                body = await \
+                                    image_handler.render_image_region(
+                                        ctx)
                         else:
                             ctx = ShapeMaskCtx.from_json(header["ctx"])
                             body = await \

@@ -1,0 +1,90 @@
+"""In-flight render dedup, device-free.
+
+Lives outside ``server.handler`` so frontend-only processes (sidecar
+proxies, fleet routers — which must never import the JAX device stack)
+can coalesce identical concurrent renders too: the fleet posture moves
+single-flight ABOVE the router, so one render identity runs once
+fleet-wide no matter which member owns its shard.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class SingleFlight:
+    """In-flight render dedup: concurrent requests for one canonical
+    render identity (``settings.render_identity_key``) coalesce onto a
+    single pending task — today every duplicate pays the full pipeline
+    (read, stage, device render, encode) because the byte cache only
+    answers AFTER the first completes.
+
+    Event-loop confined: all bookkeeping runs on the loop thread, so no
+    lock.  Followers await the leader's task through ``asyncio.shield``,
+    which pins the cancellation contract: a waiter's disconnect (aiohttp
+    cancels its handler) never cancels the shared render the other
+    waiters — or the byte-cache write-back — depend on; the task runs to
+    completion even if EVERY waiter disconnects, so the next identical
+    request hits the byte cache instead of re-rendering.
+    """
+
+    def __init__(self):
+        self._inflight: dict = {}
+        self.hits = 0
+        self.misses = 0
+
+    def inflight(self) -> int:
+        """Pending coalescable renders (the /metrics gauge)."""
+        return len(self._inflight)
+
+    async def run(self, key: str, producer):
+        """``(result, coalesced)`` — ``producer()`` runs at most once
+        per key at a time; followers share the leader's outcome
+        (result OR exception).
+
+        Deadlines: the shared task inherits the LEADER's budget — it
+        is the leader's pipeline run, and that budget is what lets
+        admission's estimated-wait shed and the batcher's dispatch-pop
+        cancellation fire on it.  Each waiter additionally enforces
+        its OWN remaining budget on the await side, so a FOLLOWER
+        whose budget dies gets its 504 without cancelling the render
+        the other waiters depend on (a follower's deadline never
+        touches the shared task; only the leader's budget — the one
+        the run was admitted under — can cancel queued work)."""
+        from ..utils import transient
+
+        task = self._inflight.get(key)
+        if (task is not None
+                and task.get_loop() is not asyncio.get_running_loop()):
+            # A stale entry from another (closed) event loop — test
+            # harnesses run one loop per call — must not strand this
+            # loop's requests behind a task that can never complete.
+            self._inflight.pop(key, None)
+            task = None
+        coalesced = task is not None
+        if task is None:
+            self.misses += 1
+            task = asyncio.ensure_future(producer())
+            self._inflight[key] = task
+
+            def _cleanup(t, key=key):
+                if self._inflight.get(key) is t:
+                    self._inflight.pop(key, None)
+                if not t.cancelled():
+                    t.exception()   # retrieved even with no waiters left
+            task.add_done_callback(_cleanup)
+        else:
+            self.hits += 1
+        remaining = transient.remaining_ms()
+        if remaining is None:
+            return await asyncio.shield(task), coalesced
+        try:
+            # wait_for cancels only the shield wrapper on timeout; the
+            # shared task (and its byte-cache write-back) runs on.
+            result = await asyncio.wait_for(
+                asyncio.shield(task), timeout=max(0.0, remaining)
+                / 1000.0)
+        except asyncio.TimeoutError:
+            raise transient.DeadlineExceededError(
+                "deadline exceeded awaiting coalesced render")
+        return result, coalesced

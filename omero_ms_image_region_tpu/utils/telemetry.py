@@ -1514,6 +1514,132 @@ def wire_metric_lines(extra_labels: str = "") -> List[str]:
     return WIRE.metric_lines(extra_labels)
 
 
+# -------------------------------------------------------------------- fleet
+
+class FleetStats:
+    """Fleet-routing accounting (``parallel.fleet``): per-member
+    routed/stolen/failed-over counters.  The ``member`` label set is
+    closed by construction — member names come from config, bounded by
+    ``_MAX_MEMBERS`` as a hard cardinality guard against a buggy
+    caller minting names per request."""
+
+    _MAX_MEMBERS = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.routed: Dict[str, int] = {}
+        self.stolen: Dict[str, int] = {}
+        self.failed_over: Dict[str, int] = {}
+
+    def _bump(self, table: Dict[str, int], member: str) -> None:
+        with self._lock:
+            if member not in table and len(table) >= self._MAX_MEMBERS:
+                member = "_overflow"
+            table[member] = table.get(member, 0) + 1
+
+    def count_routed(self, member: str) -> None:
+        self._bump(self.routed, member)
+
+    def count_stolen(self, member: str) -> None:
+        """``member`` is the STEALER: the lane that rendered skewed
+        work from source bytes without adopting cache ownership."""
+        self._bump(self.stolen, member)
+
+    def count_failed_over(self, member: str) -> None:
+        """``member`` is the hash-ring-next target that ADOPTED a dead
+        member's shard work."""
+        self._bump(self.failed_over, member)
+
+    def totals(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "routed": sum(self.routed.values()),
+                "stolen": sum(self.stolen.values()),
+                "failed_over": sum(self.failed_over.values()),
+            }
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(member: str) -> str:
+            inner = f'member="{member}"' + (("," + extra) if extra
+                                            else "")
+            return "{" + inner + "}"
+
+        lines: List[str] = []
+        with self._lock:
+            for fam, table in (
+                    ("imageregion_fleet_routed_total", self.routed),
+                    ("imageregion_fleet_stolen_total", self.stolen),
+                    ("imageregion_fleet_failed_over_total",
+                     self.failed_over)):
+                for member in sorted(table):
+                    lines.append(
+                        f"{fam}{label(member)} {table[member]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.routed.clear()
+            self.stolen.clear()
+            self.failed_over.clear()
+
+
+FLEET = FleetStats()
+
+
+def fleet_metric_lines(router=None, extra_labels: str = "",
+                       single_flight=None) -> List[str]:
+    """The ``imageregion_fleet_*`` families: the process-global
+    routed/stolen/failed-over counters plus, when a live router is
+    passed, per-member depth/inflight/health gauges and the HBM
+    shard-ownership count (resident planes per local member).
+    ``router`` is duck-typed (``parallel.fleet.FleetRouter``) so this
+    module stays importable without the fleet stack.
+
+    ``single_flight`` is the FLEET-WIDE coalescing table (it moved
+    above the router, off ``services.single_flight`` — whose emitter
+    would otherwise carry these families): passing it here keeps the
+    ``imageregion_singleflight_*`` series alive in fleet postures."""
+    extra = extra_labels.lstrip(",")
+    lines = FLEET.metric_lines(extra_labels)
+    if single_flight is not None:
+        lb = ("{" + extra + "}") if extra else ""
+        lines += [
+            f"imageregion_singleflight_hits{lb} {single_flight.hits}",
+            f"imageregion_singleflight_misses{lb} "
+            f"{single_flight.misses}",
+            f"imageregion_singleflight_inflight{lb} "
+            f"{single_flight.inflight()}",
+        ]
+    if router is None:
+        return lines
+
+    def label(member: str = "") -> str:
+        parts = [p for p in
+                 ((f'member="{member}"' if member else ""), extra) if p]
+        return ("{" + ",".join(parts) + "}") if parts else ""
+
+    lines += [
+        f"imageregion_fleet_members{label()} {len(router.order)}",
+        f"imageregion_fleet_members_healthy{label()} "
+        f"{len(router.healthy_members())}",
+    ]
+    for name in router.order:
+        member = router.members[name]
+        lines += [
+            f"imageregion_fleet_member_depth{label(name)} "
+            f"{router.member_depth(name)}",
+            f"imageregion_fleet_member_inflight{label(name)} "
+            f"{router.member_inflight(name)}",
+            f"imageregion_fleet_member_healthy{label(name)} "
+            f"{1 if member.healthy else 0}",
+            f"imageregion_fleet_member_planes{label(name)} "
+            f"{member.resident_planes()}",
+        ]
+    return lines
+
+
 # ---------------------------------------------------------------- readiness
 
 class Readiness:
@@ -1639,6 +1765,18 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_execcache_misses": "counter",
     "imageregion_execcache_loaded_total": "counter",
     "imageregion_execcache_saved_total": "counter",
+    # Data-parallel device fleet (parallel.fleet): consistent-hash
+    # routing, per-member batch lanes, bounded work stealing,
+    # hash-ring-next failover, HBM shard ownership.
+    "imageregion_fleet_members": "gauge",
+    "imageregion_fleet_members_healthy": "gauge",
+    "imageregion_fleet_member_depth": "gauge",
+    "imageregion_fleet_member_inflight": "gauge",
+    "imageregion_fleet_member_healthy": "gauge",
+    "imageregion_fleet_member_planes": "gauge",
+    "imageregion_fleet_routed_total": "counter",
+    "imageregion_fleet_stolen_total": "counter",
+    "imageregion_fleet_failed_over_total": "counter",
 }
 
 # Terse HELP strings for the families whose meaning is not obvious
@@ -1680,6 +1818,13 @@ METRIC_HELP: Dict[str, str] = {
         "Disk byte-cache bytes promoted to the memory tier at boot",
     "imageregion_execcache_loaded_total":
         "Serialized render executables deserialized from disk",
+    "imageregion_fleet_member_planes":
+        "HBM-resident plane entries owned by the member (shard size)",
+    "imageregion_fleet_stolen_total":
+        "Renders the member stole from a backlogged peer (no cache "
+        "adoption)",
+    "imageregion_fleet_failed_over_total":
+        "Dead-member shard work adopted hash-ring-next by the member",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -1903,3 +2048,4 @@ def reset() -> None:
     SHAPE_COSTS.reset()
     PERSIST.reset()
     WIRE.reset()
+    FLEET.reset()

@@ -47,7 +47,14 @@ import sys
 
 DEFAULT_KEYS = ("service_tiles_per_sec", "p50_service_tile_ms_ex_rtt",
                 "raw_upload_mb_per_sec", "p50_first_tile_byte_ms")
+# --multichip: judge MULTICHIP_r*.json records on the fleet scaling
+# curve (__graft_entry__.fleet_scaling_curve prints it into the
+# driver's tail).  Rounds that predate the curve — every record that
+# only said `ok: true` — skip on null instead of failing.
+MULTICHIP_KEYS = ("fleet_tiles_per_sec_m8", "fleet_tiles_per_sec_m4",
+                  "fleet_scaling_efficiency")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+_MULTICHIP_RE = re.compile(r"^MULTICHIP_r(\d+)\.json$")
 
 
 def lower_is_better(key: str) -> bool:
@@ -105,11 +112,13 @@ def load_record(path: str) -> dict:
     return doc
 
 
-def all_records(directory: str):
-    """Every BENCH_r*.json in ``directory``, round order (ascending)."""
+def all_records(directory: str, pattern=_BENCH_RE):
+    """Every matching record in ``directory``, round order
+    (ascending).  ``pattern`` selects the record family — BENCH by
+    default, MULTICHIP under ``--multichip``."""
     rounds = []
     for name in os.listdir(directory):
-        m = _BENCH_RE.match(name)
+        m = pattern.match(name)
         if m:
             rounds.append((int(m.group(1)),
                            os.path.join(directory, name)))
@@ -117,13 +126,13 @@ def all_records(directory: str):
     return [path for _, path in rounds]
 
 
-def newest_pair(directory: str):
-    """The two highest-numbered BENCH_r*.json records in ``directory``
-    (old, new) — the pair the driver's latest round produced."""
-    rounds = all_records(directory)
+def newest_pair(directory: str, pattern=_BENCH_RE):
+    """The two highest-numbered records in ``directory`` (old, new) —
+    the pair the driver's latest round produced."""
+    rounds = all_records(directory, pattern)
     if len(rounds) < 2:
         raise ValueError(
-            f"{directory}: need at least two BENCH_r*.json records, "
+            f"{directory}: need at least two matching records, "
             f"found {len(rounds)}")
     return rounds[-2], rounds[-1]
 
@@ -208,12 +217,19 @@ def main(argv=None) -> int:
                              "records, not just the previous run "
                              "(pairwise -10%% per round compounds to "
                              "-37%% over four rounds undetected)")
+    parser.add_argument("--multichip", action="store_true",
+                        help="judge MULTICHIP_r*.json records on the "
+                             "fleet scaling-curve keys (tiles/s at "
+                             "the widest member counts + "
+                             "fleet_scaling_efficiency); rounds that "
+                             "predate the curve skip on null")
     parser.add_argument("--key", action="append", default=None,
                         help="record key(s) to judge (default "
                              "service_tiles_per_sec, "
                              "p50_service_tile_ms_ex_rtt, "
                              "raw_upload_mb_per_sec, "
-                             "p50_first_tile_byte_ms)")
+                             "p50_first_tile_byte_ms; --multichip: "
+                             "the fleet scaling keys)")
     parser.add_argument("--max-regression", type=float, default=0.10,
                         help="fail when new < old by this fraction or "
                              "more (default 0.10)")
@@ -222,11 +238,13 @@ def main(argv=None) -> int:
                              "failures")
     args = parser.parse_args(argv)
 
-    keys = tuple(args.key) if args.key else DEFAULT_KEYS
+    keys = tuple(args.key) if args.key else (
+        MULTICHIP_KEYS if args.multichip else DEFAULT_KEYS)
+    pattern = _MULTICHIP_RE if args.multichip else _BENCH_RE
     try:
         if args.watermark:
             if args.dir:
-                paths = all_records(args.dir)
+                paths = all_records(args.dir, pattern)
             else:
                 paths = list(args.paths)
             if len(paths) < 2:
@@ -245,7 +263,7 @@ def main(argv=None) -> int:
             }
         else:
             if args.dir:
-                old_path, new_path = newest_pair(args.dir)
+                old_path, new_path = newest_pair(args.dir, pattern)
             elif len(args.paths) == 2:
                 old_path, new_path = args.paths
             else:

@@ -74,6 +74,30 @@ def test_bench_smoke_hot_path(capsys):
     # Streamed responses really went out as chunk frames.
     assert out["wire_streams"] >= 1
 
+    # Fleet gates (N=4 virtual members served a mixed-digest burst
+    # through the real router + member stacks):
+    # * the routing layer scales — aggregate throughput >= 2.5x one
+    #   member (measured ~3.5x; the virtual exec occupancy makes the
+    #   ratio a property of the ROUTER, not of CI core count);
+    assert out["fleet_members"] == 4
+    assert out["fleet_speedup"] >= 2.5, \
+        f"fleet does not scale: {out['fleet_speedup']}x"
+    # * the HBM tier SHARDS: total fleet plane residency ~= 1x the
+    #   working set, every resident plane on exactly ONE member.
+    #   Slightly under is legal — a plane whose every render of the
+    #   burst was STOLEN stays unstaged (stealing is cache-neutral by
+    #   design) — but over would mean duplication, which never is.
+    ws = out["fleet_working_set_planes"]
+    assert ws - 3 <= out["fleet_resident_planes"] <= ws, \
+        f"sharded residency {out['fleet_resident_planes']}/{ws}"
+    assert out["fleet_duplicate_staged_planes"] == 0, \
+        f"HBM duplicated: {out['fleet_duplicate_staged_planes']} " \
+        f"planes staged on >1 member"
+    # * every request was routed, and membership spans the fleet.
+    assert out["fleet_routed_total"] >= \
+        out["fleet_working_set_planes"]
+    assert set(out["fleet_member_planes"]) == {"m0", "m1", "m2", "m3"}
+
     # The printed line is the machine-readable contract.
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["metric"] == "smoke_hotpath_tiles_per_sec"

@@ -239,3 +239,60 @@ def test_hot_path_knobs_parse_and_validate():
 
     with pytest.raises(ValueError, match="device-lanes"):
         AppConfig.from_dict({"batcher": {"device-lanes": 0}})
+
+
+def test_fleet_block_parses_and_validates():
+    """The `fleet:` block (data-parallel device fleet): example-file
+    defaults, both topologies (combined members / frontend sockets),
+    and every knob's validation bound."""
+    import pytest
+
+    from omero_ms_image_region_tpu.server.config import (AppConfig,
+                                                         FleetConfig)
+
+    # The example file documents the block; it loads with defaults.
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = FleetConfig()
+    assert cfg.fleet.enabled is False
+    assert cfg.fleet.members == defaults.members
+    assert cfg.fleet.lane_width == defaults.lane_width
+    assert cfg.fleet.steal_min_backlog == defaults.steal_min_backlog
+    assert cfg.fleet.hash_replicas == defaults.hash_replicas
+    assert cfg.fleet.failover is defaults.failover
+
+    # Combined-role in-process fleet.
+    cfg = AppConfig.from_dict({"fleet": {
+        "enabled": True, "members": 4, "lane-width": 3,
+        "steal-min-backlog": 0, "hash-replicas": 128,
+        "failover": False, "down-cooldown-s": 2.5}})
+    assert cfg.fleet.enabled is True
+    assert cfg.fleet.members == 4
+    assert cfg.fleet.lane_width == 3
+    assert cfg.fleet.steal_min_backlog == 0     # stealing disabled
+    assert cfg.fleet.hash_replicas == 128
+    assert cfg.fleet.failover is False
+    assert cfg.fleet.down_cooldown_s == 2.5
+
+    # Frontend-role sidecar fleet: fleet.sockets stands in for
+    # sidecar.socket.
+    cfg = AppConfig.from_dict({
+        "sidecar": {"role": "frontend"},
+        "fleet": {"enabled": True,
+                  "sockets": ["/tmp/a.sock", "/tmp/b.sock"]}})
+    assert cfg.fleet.sockets == ("/tmp/a.sock", "/tmp/b.sock")
+
+    # A frontend with neither sidecar.socket nor fleet.sockets still
+    # refuses to start.
+    with pytest.raises(ValueError, match="sidecar.socket"):
+        AppConfig.from_dict({"sidecar": {"role": "frontend"}})
+
+    with pytest.raises(ValueError, match="members"):
+        AppConfig.from_dict({"fleet": {"enabled": True, "members": 1}})
+    with pytest.raises(ValueError, match="lane-width"):
+        AppConfig.from_dict({"fleet": {"lane-width": 0}})
+    with pytest.raises(ValueError, match="steal-min-backlog"):
+        AppConfig.from_dict({"fleet": {"steal-min-backlog": -1}})
+    with pytest.raises(ValueError, match="hash-replicas"):
+        AppConfig.from_dict({"fleet": {"hash-replicas": 0}})
+    with pytest.raises(ValueError, match="down-cooldown-s"):
+        AppConfig.from_dict({"fleet": {"down-cooldown-s": -1.0}})

@@ -398,6 +398,48 @@ class TestBenchGate:
         assert gate.main(["--key", "x", old, new]) == 1
         assert gate.main([old, new]) == 0
 
+    def test_multichip_fleet_curve_gated(self, tmp_path, capsys):
+        """--multichip judges MULTICHIP_r*.json on the fleet scaling
+        keys: ok-true-only rounds (every record predating the curve)
+        skip on null, a scaling regression fails, and --watermark
+        holds the best-ever curve."""
+        gate = self._gate()
+        curve = {"fleet_tiles_per_sec_m1": 100.0,
+                 "fleet_tiles_per_sec_m4": 360.0,
+                 "fleet_tiles_per_sec_m8": 650.0,
+                 "fleet_scaling_efficiency": 0.81}
+        self._write(tmp_path, "MULTICHIP_r01.json", {"ok": True})
+        self._write(tmp_path, "MULTICHIP_r02.json",
+                    {"ok": True, **curve})
+        # r01 -> r02: the legacy record carries no curve — skip, pass.
+        assert gate.main(["--multichip", "--dir",
+                          str(tmp_path)]) == 0
+        verdict = json.loads(capsys.readouterr().out)
+        assert all(k["verdict"] == "skipped" for k in verdict["keys"])
+        # BENCH records in the same dir are ignored under --multichip.
+        self._write(tmp_path, "BENCH_r09.json",
+                    {"service_tiles_per_sec": 1.0})
+        # A fleet that stopped scaling fails the gate.
+        self._write(tmp_path, "MULTICHIP_r03.json", {
+            "ok": True, "fleet_tiles_per_sec_m1": 100.0,
+            "fleet_tiles_per_sec_m4": 200.0,
+            "fleet_tiles_per_sec_m8": 300.0,
+            "fleet_scaling_efficiency": 0.37})
+        assert gate.main(["--multichip", "--dir",
+                          str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["new"] == "MULTICHIP_r03.json"
+        assert {k["key"] for k in verdict["keys"]
+                if k["verdict"] == "regression"} == {
+            "fleet_tiles_per_sec_m8", "fleet_tiles_per_sec_m4",
+            "fleet_scaling_efficiency"}
+        # Watermark mode: r03 is judged against r02's best-ever marks.
+        assert gate.main(["--multichip", "--watermark", "--dir",
+                          str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["keys"][0]["watermark_record"] == \
+            "MULTICHIP_r02.json"
+
     def test_latency_key_gates_in_the_up_direction(self, tmp_path):
         """p50_service_tile_ms_ex_rtt is a DEFAULT key and judged
         lower-is-better: a >=10% latency INCREASE fails even when
@@ -695,6 +737,9 @@ class TestResetContract:
         telemetry.COST_TOPK.offer({"total_ms": 5.0})
         telemetry.observe_request_cost("r", {"device_ms": 1.0})
         telemetry.count_request("r", 200)
+        telemetry.FLEET.count_routed("m0")
+        telemetry.FLEET.count_stolen("m1")
+        telemetry.FLEET.count_failed_over("m2")
 
         telemetry.reset()
 
@@ -710,6 +755,9 @@ class TestResetContract:
         assert telemetry.SHAPE_COSTS.metric_lines() == []
         assert telemetry.COST_TOPK.snapshot() == []
         assert telemetry.cost_metric_lines() == []
+        assert telemetry.FLEET.totals() == {
+            "routed": 0, "stolen": 0, "failed_over": 0}
+        assert telemetry.fleet_metric_lines() == []
         assert telemetry.request_metric_lines() == [
             "imageregion_flight_events 0",
             "imageregion_flight_events_total 0",

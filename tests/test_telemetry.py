@@ -455,7 +455,7 @@ _LABEL_KEY_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)=')
 # here, mechanically, before it melts a Prometheus.
 _ALLOWED_LABEL_KEYS = frozenset({
     "route", "status", "span", "le", "cache", "tier", "op", "reason",
-    "process", "slo", "window", "shape",
+    "process", "slo", "window", "shape", "member",
 })
 
 
@@ -531,6 +531,27 @@ class TestExpositionLint:
         assert "imageregion_shape_dispatches_total" in text
         assert "imageregion_shape_device_ms_total" in text
         assert "imageregion_flight_events" in text
+
+    def test_fleet_app_metrics_parse(self, data_dir):
+        """A combined-role fleet app exposes the imageregion_fleet_*
+        families — per-member gauges under the closed ``member``
+        label, routed/stolen/failed-over counters — and the whole
+        exposition still lints (HELP/TYPE once per family)."""
+        from omero_ms_image_region_tpu.server.config import FleetConfig
+
+        cfg = _device_config(data_dir)
+        cfg.fleet = FleetConfig(enabled=True, members=2)
+        [(s1, _, _), (s2, _, body)] = _fetch(
+            cfg, ("GET", URL), ("GET", "/metrics"))
+        assert (s1, s2) == (200, 200)
+        text = body.decode()
+        _lint_exposition(text)
+        assert "imageregion_fleet_members 2" in text
+        assert "imageregion_fleet_members_healthy 2" in text
+        assert 'imageregion_fleet_member_depth{member="m0"}' in text
+        assert 'imageregion_fleet_member_depth{member="m1"}' in text
+        assert 'imageregion_fleet_member_planes{member=' in text
+        assert 'imageregion_fleet_routed_total{member=' in text
 
     def test_split_merged_metrics_parse(self, data_dir, tmp_path):
         sock = str(tmp_path / "m.sock")

@@ -1177,6 +1177,192 @@ def bench_smoke(duration_s: float = 1.5):
     return out
 
 
+def bench_overload_smoke(burst: int = 160, exec_ms: float = 40.0,
+                         members: int = 2, lane_width: int = 2):
+    """Overload-brownout gate at smoke scale (tier-1 via
+    tests/test_bench_smoke.py): a ~10x-capacity burst through a real
+    fleet handler with the PRESSURE GOVERNOR live must
+
+    * engage brownout ladder steps IN CONFIGURED ORDER (read back
+      from the flight recorder's ``pressure.step`` events);
+    * keep ZERO 5xx-without-shed (every request either serves or
+      sheds 503; nothing errors bare) with a bounded p99;
+    * release every step IN REVERSE with hysteresis once the burst
+      ends — engage/release exactly once per step, no flapping.
+
+    The members carry a calibrated virtual device occupancy
+    (``exec_ms`` of lane time per render, the `_fleet_smoke` idiom)
+    so the burst actually QUEUES on this CPU host; the governor's
+    queue signal, the ladder walk and the shed/serve accounting are
+    all the production code paths.
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.parallel.fleet import (
+        FleetImageHandler, FleetRouter, LocalMember,
+        build_local_members)
+    from omero_ms_image_region_tpu.server import pressure
+    from omero_ms_image_region_tpu.server.admission import (
+        AdmissionController)
+    from omero_ms_image_region_tpu.server.app import build_services
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.errors import OverloadedError
+    from omero_ms_image_region_tpu.server.singleflight import (
+        SingleFlight)
+    from omero_ms_image_region_tpu.utils import telemetry
+
+    t_start = time.perf_counter()
+    grid, tile_edge = 4, 64
+    exec_s = exec_ms / 1000.0
+    rng = np.random.default_rng(17)
+
+    class VirtualDeviceMember(LocalMember):
+        async def render(self, ctx, adopt_cache=True):
+            data = await super().render(ctx, adopt_cache)
+            await asyncio.sleep(exec_s)
+            return data
+
+    def urls():
+        out = []
+        variants = -(-burst // (grid * grid))
+        for v in range(variants):
+            for x in range(grid):
+                for y in range(grid):
+                    w = 21000 + v * 650
+                    out.append({
+                        "imageId": "1", "theZ": "0", "theT": "0",
+                        "tile": f"0,{x},{y},{tile_edge},{tile_edge}",
+                        "format": "png", "m": "c",
+                        "c": f"1|0:{w}$FF0000,2|0:{w - 900}$00FF00",
+                    })
+        return out[:burst]
+
+    async def run(tmp: str) -> dict:
+        config = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=False),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        services = build_services(config)
+        members = [VirtualDeviceMember(
+            m.name, m.handler, m.services,
+            down_cooldown_s=m.down_cooldown_s,
+            byte_cache_prechecked=m.byte_cache_prechecked)
+            for m in build_local_members(config, services, members_n)]
+        router = FleetRouter(members, lane_width=lane_width,
+                             steal_min_backlog=0)
+        handler = FleetImageHandler(
+            router, single_flight=SingleFlight(),
+            admission=AdmissionController(4 * burst, renderer=router),
+            base_services=services)
+        pcfg = AppConfig.from_dict({"pressure": {
+            "enabled": True, "interval-s": 0.02,
+            "queue-high": 4 * members_n * lane_width,
+            "queue-low": members_n * lane_width,
+            "critical-factor": 1.5,
+            "step-hold-ticks": 1, "release-hold-ticks": 2,
+        }}).pressure
+        governor = pressure.PressureGovernor(
+            pcfg,
+            pressure.build_actuators(pcfg, services=services),
+            {"queue": lambda: float(router.queue_depth())})
+        pressure.install(governor)
+        # The gate reads the ladder walk back from the flight ring;
+        # start it clean (and big enough that burst noise cannot
+        # push the pressure.step events off the tape).
+        telemetry.FLIGHT.reset()
+        telemetry.FLIGHT.configure(4096)
+
+        async def governor_loop():
+            while True:
+                await asyncio.sleep(pcfg.interval_s)
+                governor.tick()
+
+        gov_task = asyncio.create_task(governor_loop())
+        ctxs = [ImageRegionCtx.from_params(p) for p in urls()]
+        # One warm render outside the window (shared jit compile).
+        await handler.render_image_region(ctxs[0])
+        latencies: list = []
+        sheds = unshed = 0
+
+        async def one(ctx):
+            nonlocal sheds, unshed
+            t0 = time.perf_counter()
+            try:
+                out = await handler.render_image_region(ctx)
+                assert out
+                latencies.append(time.perf_counter() - t0)
+            except OverloadedError:
+                sheds += 1           # shed = 503 + Retry-After: legal
+            except Exception:
+                unshed += 1          # bare failure: the gate breaker
+
+        try:
+            await asyncio.gather(*(one(c) for c in ctxs))
+            # Burst over: keep ticking until the ladder fully
+            # releases (bounded — hysteresis means a few quiet ticks
+            # per step).
+            for _ in range(400):
+                if not governor.engaged_steps():
+                    break
+                await asyncio.sleep(pcfg.interval_s)
+            released = not governor.engaged_steps()
+        finally:
+            gov_task.cancel()
+            pressure.uninstall()
+            await router.close()
+            services.pixels_service.close()
+
+        steps = [e for e in telemetry.FLIGHT.snapshot()
+                 if e["kind"] == "pressure.step"]
+        engages = [e["step"] for e in steps
+                   if e["action"] == "engage"]
+        releases = [e["step"] for e in steps
+                    if e["action"] == "release"]
+        ladder = list(pcfg.ladder)
+        order_ok = engages == ladder[:len(engages)]
+        reverse_ok = releases == list(reversed(engages))[
+            :len(releases)]
+        flapping = (len(engages) != len(set(engages))
+                    or len(releases) != len(set(releases)))
+        ordered = sorted(latencies)
+        p99 = (ordered[int(0.99 * (len(ordered) - 1))] * 1000.0
+               if ordered else None)
+        return {
+            "served": len(latencies), "sheds": sheds,
+            "unshed_failures": unshed,
+            "steps_engaged": engages, "steps_released": releases,
+            "ladder_order_ok": bool(order_ok),
+            "release_reverse_ok": bool(reverse_ok),
+            "released_all": bool(released),
+            "flapping": bool(flapping),
+            "p99_ms": _opt_round(p99, 1),
+        }
+
+    members_n = members
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 2, 1, grid * tile_edge,
+                                     grid * tile_edge).reshape(
+            2, 1, grid * tile_edge, grid * tile_edge)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        doc = asyncio.run(run(tmp))
+    out = {
+        "metric": "overload_smoke",
+        "burst": burst,
+        "virtual_exec_ms": exec_ms,
+        **{f"overload_{k}": v for k, v in doc.items()},
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    }
+    print(json.dumps(out))
+    return out
+
+
 def bench_restart_smoke():
     """Warm-restart gate at smoke scale: render, "kill", restart with
     persistence on, and prove the first previously-seen tile serves
@@ -1877,11 +2063,16 @@ def main():
     # robustness gate: zero bare 5xx, bounded p99); --smoke --restart
     # runs the cold-restart scenario (render, kill, restart with
     # persistence on — the warm-state gate).
+    # --smoke --overload runs the brownout-ladder scenario (a 10x
+    # burst must engage ladder steps in configured order, keep zero
+    # 5xx-without-shed with bounded p99, and release with hysteresis).
     if "--smoke" in sys.argv[1:]:
         if "--chaos" in sys.argv[1:]:
             bench_chaos_smoke()
         elif "--restart" in sys.argv[1:]:
             bench_restart_smoke()
+        elif "--overload" in sys.argv[1:]:
+            bench_overload_smoke()
         else:
             bench_smoke()
         return

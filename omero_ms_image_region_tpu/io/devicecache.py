@@ -70,6 +70,11 @@ class DeviceRawCache:
         # that content (aliases share ONE device buffer).
         self._digests_of: Dict[Hashable, str] = {}
         self._keys_by_digest: Dict[str, Set[Hashable]] = {}
+        # Request-routing identity of each region entry (the fleet's
+        # ``plane_route_key``), recorded at staging: what lets a
+        # rolling drain hand each plane of this shard to the member
+        # that will actually SERVE its future requests.
+        self._route_of: Dict[Hashable, str] = {}
         self._bytes = 0
         self.hits = 0
         self.misses = 0
@@ -139,6 +144,7 @@ class DeviceRawCache:
         """Remove a key's accounting (lock held).  Digest aliases share
         ONE device buffer, so its bytes leave the budget only when the
         LAST key referencing that content goes."""
+        self._route_of.pop(key, None)
         digest = self._digests_of.get(key)
         self._drop_digest(key)
         if digest is None or not self._keys_by_digest.get(digest):
@@ -147,7 +153,8 @@ class DeviceRawCache:
     # ------------------------------------------------------------- loads
 
     def get_or_load(self, key: Hashable, loader: Callable,
-                    digest: Optional[str] = None):
+                    digest: Optional[str] = None,
+                    route_key: Optional[str] = None):
         with self._lock:
             arr = self._entries.get(key)
             if arr is not None:
@@ -209,6 +216,8 @@ class DeviceRawCache:
                         arr = existing
                         break
             self._entries[key] = arr
+            if route_key is not None:
+                self._route_of[key] = route_key
             # Aliases share one device buffer: its bytes enter the
             # budget once, with the digest's FIRST key — so effective
             # capacity GROWS with dedup instead of shrinking under
@@ -256,6 +265,27 @@ class DeviceRawCache:
         with self._lock:
             return set(self._keys_by_digest)
 
+    def evict_to_fraction(self, frac: float) -> int:
+        """Brownout eviction (server.pressure "evict_caches"): walk
+        LRU-first until resident bytes are at most ``frac`` of the
+        budget, returning entries dropped.  The early, chosen form of
+        the eviction that would otherwise happen per-miss at the worst
+        moment — when the cache is already over budget mid-burst."""
+        target = max(0, int(self.max_bytes * frac))
+        evicted = []
+        with self._lock:
+            while self._bytes > target and len(self._entries) > 1:
+                key, arr = self._entries.popitem(last=False)
+                self._release_bytes(key, arr)
+                self.evictions += 1
+                evicted.append((str(key)[:80], arr.nbytes))
+        if evicted:
+            from ..utils import telemetry
+            telemetry.FLIGHT.record("rawcache.pressure-evict",
+                                    entries=len(evicted),
+                                    bytes=sum(b for _, b in evicted))
+        return len(evicted)
+
     @property
     def size_bytes(self) -> int:
         return self._bytes
@@ -278,11 +308,17 @@ class DeviceRawCache:
                         or not isinstance(key[0], int)):
                     continue
                 image_id, z, t, level, region, channels = key
-                out.append({
+                entry = {
                     "key": [image_id, z, t, level, list(region),
                             list(channels)],
                     "digest": self._digests_of.get(key),
-                })
+                }
+                route = self._route_of.get(key)
+                if route is not None:
+                    # Routing identity for drain handoffs: which ring
+                    # member will serve this plane's future requests.
+                    entry["route"] = route
+                out.append(entry)
                 if limit and len(out) >= limit:
                     break
         return out

@@ -174,6 +174,11 @@ class LocalMember:
         self.down_cooldown_s = down_cooldown_s
         self.byte_cache_prechecked = byte_cache_prechecked
         self._down_until = 0.0
+        # Rolling-drain state (router.drain_member): a DRAINING member
+        # finishes its in-flight work but accepts no new routes — on
+        # purpose, distinct from down (a drain is not a death and must
+        # not look like one).
+        self.draining = False
 
     @property
     def healthy(self) -> bool:
@@ -213,6 +218,39 @@ class LocalMember:
         cache = getattr(self.services, "raw_cache", None)
         return len(cache) if cache is not None else 0
 
+    async def shard_manifest(self, limit: int = 0) -> List[dict]:
+        """This member's HBM shard as restageable region entries —
+        the drain handoff's pre-stage hint list (MRU first, so a
+        bounded pre-stage warms the hottest planes)."""
+        cache = getattr(self.services, "raw_cache", None)
+        if cache is None or not hasattr(cache, "snapshot_entries"):
+            return []
+        return cache.snapshot_entries(limit)
+
+    async def prestage_manifest(self, entries: List[dict]) -> int:
+        """Stage a handed-over shard manifest into THIS member's HBM
+        (drain handoff, successor side) through the existing staging
+        path — digest-deduped, so re-handing an already-warm entry is
+        a probe hit, never a duplicate buffer."""
+        from ..services.warmstate import restage_plane_entry
+        cache = getattr(self.services, "raw_cache", None)
+        pixels = getattr(self.services, "pixels_service", None)
+        if cache is None or pixels is None:
+            return 0
+
+        def stage_all() -> int:
+            staged = 0
+            for entry in entries:
+                try:
+                    if restage_plane_entry(cache, pixels, entry):
+                        staged += 1
+                except Exception:
+                    continue    # best-effort: a bad entry is a cold
+                    # miss later, never a failed drain
+            return staged
+
+        return await asyncio.to_thread(stage_all)
+
 
 class RemoteMember:
     """A render sidecar owning a device set, reached over the wire.
@@ -231,6 +269,7 @@ class RemoteMember:
         self.client = client
         self.down_cooldown_s = down_cooldown_s
         self._down_until = 0.0
+        self.draining = False
 
     @property
     def healthy(self) -> bool:
@@ -261,6 +300,36 @@ class RemoteMember:
 
     def resident_planes(self) -> int:
         return 0
+
+    async def shard_manifest(self, limit: int = 0) -> List[dict]:
+        """The sidecar's HBM shard over the wire (``shard_manifest``
+        op); unreachable/legacy sidecars answer an empty hint list —
+        the drain proceeds, the successor just warms lazily."""
+        import json as _json
+        try:
+            status, body = await self.client.call(
+                "shard_manifest", {}, extra={"limit": limit})
+            if status != 200 or not body:
+                return []
+            return list(_json.loads(bytes(body).decode())
+                        .get("entries") or ())
+        except Exception:
+            return []
+
+    async def prestage_manifest(self, entries: List[dict]) -> int:
+        """Hand the drained shard's hint list to this sidecar
+        (``prestage`` op): it re-reads the regions from its own pixel
+        store and stages them into its HBM shard."""
+        import json as _json
+        try:
+            status, body = await self.client.call(
+                "prestage", {}, extra={"entries": entries})
+            if status != 200 or not body:
+                return 0
+            return int(_json.loads(bytes(body).decode())
+                       .get("staged", 0))
+        except Exception:
+            return 0
 
 
 # --------------------------------------------------------------- router
@@ -328,15 +397,28 @@ class FleetRouter:
     @staticmethod
     def _pinned(ctx) -> bool:
         """Full-plane and z-projection jobs pin to the mesh lane
-        (member 0) and are never stolen or ring-routed."""
-        return ctx.projection is not None or (
-            ctx.tile is None and ctx.region is None)
+        (member 0) and are never stolen or ring-routed.  THE bulk
+        classification lives in ``server.pressure.is_bulk`` — the
+        governor's shed_bulk step and this pin must never drift apart
+        (work the ladder stops shedding must be work the fleet still
+        pins, and vice versa)."""
+        from ..server.pressure import is_bulk
+        return is_bulk(ctx)
+
+    def _routable(self, name: str) -> bool:
+        """May NEW work land on this member: alive and not draining.
+        Draining is deliberately distinct from down — a draining
+        member still finishes in-flight work and answers pre-stage
+        handoffs, it just accepts no new routes."""
+        member = self.members[name]
+        return member.healthy and not member.draining
 
     def owner_of(self, ctx) -> str:
-        """The healthy member owning this request's plane (hash-ring-
-        next past down members).  Full-plane and z-projection jobs pin
-        to the first member — the lane whose renderer is the lockstep
-        ``MeshRenderer`` in mesh deployments — and never shard."""
+        """The routable member owning this request's plane (hash-ring-
+        next past down AND draining members).  Full-plane and
+        z-projection jobs pin to the first member — the lane whose
+        renderer is the lockstep ``MeshRenderer`` in mesh deployments
+        — and never shard."""
         if self._pinned(ctx):
             chain = list(self.order)     # member 0 first = mesh lane
         else:
@@ -348,10 +430,14 @@ class FleetRouter:
             # would silently re-home its planes onto the ring
             # successor (with adopt_cache=True and no failed_over
             # tick), exactly the shard migration the operator
-            # disabled.
+            # disabled.  DRAINING is the exception: a drain is an
+            # operator-ordered handoff, so its re-home is the point.
+            for name in chain:
+                if not self.members[name].draining:
+                    return name
             return chain[0]
         for name in chain:
-            if self.members[name].healthy:
+            if self._routable(name):
                 return name
         # Every member down: hand the ring owner the call anyway so
         # the failure surfaces as the ConnectionError -> 503 contract
@@ -372,6 +458,116 @@ class FleetRouter:
 
     def healthy_members(self) -> List[str]:
         return [n for n in self.order if self.members[n].healthy]
+
+    def draining_members(self) -> List[str]:
+        return [n for n in self.order if self.members[n].draining]
+
+    # ----------------------------------------------------------- drains
+
+    async def drain_member(self, name: str, prestage: bool = True,
+                           max_planes: int = 256,
+                           settle_timeout_s: float = 30.0) -> dict:
+        """Zero-downtime rolling drain of one member.
+
+        Phases (each a flight-recorder event and a
+        ``imageregion_drain_*`` transition):
+
+        1. **draining** — the member stops accepting routes (new
+           arrivals and failovers walk past it; its lanes stop
+           stealing) and its QUEUED work re-homes hash-ring-next with
+           adoption, exactly the failover remap bound (~1/N).
+        2. **settle** — in-flight renders finish on the member (a
+           drain interrupts nothing; ``settle_timeout_s`` bounds the
+           wait, not the work).
+        3. **handoff** — the member's HBM shard manifest (MRU-first,
+           bounded by ``max_planes``) is handed to each plane's NEW
+           ring owner, which pre-stages it through the digest-deduped
+           staging path — the shard arrives WARM on the successor
+           instead of cold-missing.
+        4. **drained** — the member is safe to restart; ``undrain``
+           rejoins it with the same remap bound as a ring join.
+
+        Idempotent: draining an already-draining member just re-runs
+        the settle + handoff."""
+        import time as _time
+        from ..utils import telemetry
+
+        if name not in self.members:
+            raise KeyError(f"unknown fleet member {name!r}")
+        member = self.members[name]
+        member.draining = True
+        telemetry.DRAIN.set_state(name, "draining")
+        telemetry.FLIGHT.record("drain.phase", member=name,
+                                phase="draining",
+                                queued=len(self._queues[name]),
+                                inflight=self._inflight[name])
+        # Queued work re-homes NOW (the lanes would drain it anyway,
+        # but re-homing bounds the drain's tail latency by the
+        # in-flight work only).
+        self._reassign(name)
+        t0 = _time.monotonic()
+        while (self._inflight[name] > 0
+               and _time.monotonic() - t0 < settle_timeout_s):
+            await asyncio.sleep(0.02)
+        settled = self._inflight[name] == 0
+        manifest = await member.shard_manifest(max_planes)
+        prestaged = 0
+        if prestage and manifest:
+            telemetry.FLIGHT.record("drain.phase", member=name,
+                                    phase="handoff",
+                                    planes=len(manifest))
+            prestaged = await self._prestage_handoff(name, manifest)
+            telemetry.DRAIN.count_prestaged(prestaged)
+        telemetry.DRAIN.set_state(name, "drained")
+        telemetry.FLIGHT.record("drain.phase", member=name,
+                                phase="drained", settled=settled,
+                                planes=len(manifest),
+                                prestaged=prestaged)
+        logger.info("fleet member %s drained (settled=%s, %d shard "
+                    "planes, %d pre-staged on successors)", name,
+                    settled, len(manifest), prestaged)
+        return {"member": name, "settled": settled,
+                "planes": len(manifest), "prestaged": prestaged}
+
+    async def _prestage_handoff(self, draining: str,
+                                manifest: List[dict]) -> int:
+        """Hand each manifest plane to the member that will SERVE it:
+        its recorded routing identity walks the ring exactly like a
+        live request (the draining member is no longer routable, so
+        the walk lands on the true successor).  Entries missing a
+        route (legacy manifests, wire-pushed planes) spread by their
+        raw key — deterministic, and still warm-on-SOME-member."""
+        by_successor: Dict[str, List[dict]] = {}
+        for entry in manifest:
+            route = entry.get("route") or repr(entry.get("key"))
+            for candidate in self.ring.chain(route):
+                if candidate != draining and self._routable(candidate):
+                    by_successor.setdefault(candidate,
+                                            []).append(entry)
+                    break
+        staged = 0
+        for successor, entries in by_successor.items():
+            try:
+                staged += await self.members[successor] \
+                    .prestage_manifest(entries)
+            except Exception:
+                logger.warning("drain handoff to %s failed",
+                               successor, exc_info=True)
+        return staged
+
+    def undrain_member(self, name: str) -> None:
+        """Rejoin a drained member: routes flow back onto its ring
+        arcs at the next dispatch — the same ~1/N remap bound as a
+        ring join (the ring itself never changed)."""
+        from ..utils import telemetry
+        if name not in self.members:
+            raise KeyError(f"unknown fleet member {name!r}")
+        self.members[name].draining = False
+        telemetry.DRAIN.set_state(name, "active")
+        telemetry.FLIGHT.record("drain.phase", member=name,
+                                phase="undrained")
+        logger.info("fleet member %s undrained (rejoined the ring)",
+                    name)
 
     # ---------------------------------------------------------- dispatch
 
@@ -435,8 +631,7 @@ class FleetRouter:
         its own backlog, or a peer backlog past the steal threshold?"""
         if self._queues[name]:
             return True
-        if self.steal_min_backlog <= 0 \
-                or not self.members[name].healthy:
+        if self.steal_min_backlog <= 0 or not self._routable(name):
             return False
         # Mirrors _pop_work's steal candidates exactly (including the
         # pinned-head exclusion) — a backlog this lane can NEVER steal
@@ -454,8 +649,9 @@ class FleetRouter:
         queue = self._queues[name]
         if queue:
             return queue.popleft()
-        if (self.steal_min_backlog <= 0
-                or not self.members[name].healthy):
+        if self.steal_min_backlog <= 0 or not self._routable(name):
+            # A draining member's lanes drain their own queue (the
+            # reassign empties it) but never steal new work.
             return None
         victim = None
         depth = 0
@@ -517,7 +713,7 @@ class FleetRouter:
                  else self.ring.chain(plane_route_key(work.ctx)))
         tried = work.hops
         for name in chain:
-            if not self.members[name].healthy:
+            if not self._routable(name):
                 continue
             work.owner = name
             work.hops = tried + 1
@@ -728,6 +924,8 @@ class FleetImageHandler:
                     f"Cannot find Image:{ctx.image_id}")
 
         async def produce() -> bytes:
+            from ..server.pressure import shed_bulk_under_pressure
+            shed_bulk_under_pressure(ctx)
             admission = self.admission
             t_admit = admission.admit() if admission is not None \
                 else None

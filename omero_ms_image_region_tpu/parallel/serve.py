@@ -235,7 +235,11 @@ class MeshRenderer(BatchingRenderer):
             # the LEADER at dispatch pop, before the group rides the
             # pod announcement, so every follower replays the identical
             # post-drop group — unlike growth/retry, no host-local
-            # divergence is possible.  Chaos freeze/device-error
+            # divergence is possible.  The watchdog's stuck-group
+            # requeue (server.watchdog) is lockstep-safe for the same
+            # reason: it re-enqueues pendings on the LEADER, and the
+            # re-dispatch rides a fresh pod announcement like any
+            # other group.  Chaos freeze/device-error
             # injection, however, fires on whatever process installed
             # it and would stall or re-launch one process's lockstep
             # sequence only — config load rejects explicit multi-host

@@ -48,6 +48,19 @@ class AdmissionController:
     def _lanes(self) -> int:
         return max(1, getattr(self.renderer, "device_lanes", 1))
 
+    def effective_max_queue(self) -> int:
+        """The depth bound this instant: the configured ``max_queue``,
+        scaled down while the pressure governor's
+        ``tighten_admission`` ladder step is engaged — shedding turns
+        pressure-aware, not just depth-aware (resource pressure says
+        the queue the service can FINISH is smaller than the queue it
+        can HOLD)."""
+        from .pressure import active
+        governor = active()
+        if governor is None:
+            return self.max_queue
+        return max(1, int(self.max_queue * governor.admission_scale()))
+
     def estimated_wait_ms(self) -> float:
         """Expected queueing delay for a request admitted now."""
         if self.ewma_s is None:
@@ -57,15 +70,19 @@ class AdmissionController:
     def admit(self) -> float:
         """Claim a slot or shed.  Returns the admission timestamp the
         caller hands back to :meth:`release`."""
-        if self.inflight >= self.max_queue:
+        max_queue = self.effective_max_queue()
+        if self.inflight >= max_queue:
             self.shed_total += 1
-            telemetry.RESILIENCE.count_shed("queue-full")
+            reason = ("pressure" if max_queue < self.max_queue
+                      else "queue-full")
+            telemetry.RESILIENCE.count_shed(reason)
             telemetry.FLIGHT.record("admission.shed",
-                                    reason="queue-full",
-                                    inflight=self.inflight)
+                                    reason=reason,
+                                    inflight=self.inflight,
+                                    max_queue=max_queue)
             raise OverloadedError(
                 f"admission queue full ({self.inflight} renders "
-                f"in flight)",
+                f"in flight, bound {max_queue})",
                 retry_after_s=max(self.retry_after_s,
                                   self.estimated_wait_ms() / 1000.0))
         remaining = transient.remaining_ms()

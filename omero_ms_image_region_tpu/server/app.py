@@ -51,6 +51,8 @@ FEATURES = ["flip", "mask-color", "png-tiles"]
 
 SERVICES_KEY = web.AppKey("services", object)
 CONFIG_KEY = web.AppKey("config", object)
+FLEET_ROUTER_KEY = web.AppKey("fleet_router", object)
+_ROBUSTNESS_TASKS_KEY = web.AppKey("robustness_tasks", list)
 
 
 def _session_required(config: AppConfig) -> bool:
@@ -527,6 +529,65 @@ def create_app(config: Optional[AppConfig] = None,
         else:
             image_handler = ImageRegionHandler(services)
         mask_handler = ShapeMaskHandler(services)
+
+    # Self-preservation layer (deploy/DEPLOY.md "Overload & rolling
+    # restarts"): the pressure governor + brownout ladder and the
+    # stuck-lane/hung-wire watchdog.  Built synchronously here (the
+    # governor installs module-global so admission/handler hooks see
+    # it); their tick loops start as tasks in on_startup.
+    from . import pressure as pressure_mod
+    governor = None
+    if config.pressure.enabled:
+        _gov_ref: list = []
+        governor = pressure_mod.PressureGovernor(
+            config.pressure,
+            pressure_mod.build_actuators(config.pressure,
+                                         services=services),
+            pressure_mod.build_sources(services=services,
+                                       router=fleet_router,
+                                       governor_ref=_gov_ref))
+        _gov_ref.append(governor)
+        pressure_mod.install(governor)
+
+    watchdog = None
+    if config.watchdog.enabled:
+        from .watchdog import build_watchdog
+
+        def _escalate(event: dict) -> None:
+            # The bigger-hammer hook: in split deployments the PR 3
+            # supervisor owns restarts, so escalation here is the
+            # LOUD record that repeated smallest-scope healing did
+            # not hold — the black box + metrics carry it to the
+            # operator/orchestrator.
+            telemetry.FLIGHT.record("watchdog.escalate", **{
+                k: v for k, v in event.items() if k != "escalate"})
+            log.error("watchdog escalation: %s on %s",
+                      event.get("action"), event.get("target"))
+
+        wd_clients = ([m.client for m in fleet_members]
+                      if fleet_remote
+                      else ([client] if proxy_mode else []))
+        watchdog = build_watchdog(
+            config.watchdog,
+            renderer=(services.renderer if services is not None
+                      else None),
+            clients=wd_clients, escalate_cb=_escalate)
+        for member in fleet_members:
+            # Extra local members own their own batchers — each is a
+            # stuck-lane target of its own.
+            extra = getattr(getattr(member, "services", None),
+                            "renderer", None)
+            if (extra is not None and services is not None
+                    and extra is not services.renderer
+                    and hasattr(extra, "watchdog_scan")):
+                extra.watchdog_stall_factor = config.watchdog \
+                    .stall_factor
+                extra.watchdog_stall_min_s = config.watchdog \
+                    .stall_min_s
+                extra.watchdog_escalate_after = config.watchdog \
+                    .escalate_after
+                watchdog.add_target(extra)
+
     session_store = _make_session_store(config)
 
     async def session_key(request: web.Request) -> Optional[str]:
@@ -807,6 +868,9 @@ def create_app(config: Optional[AppConfig] = None,
         # retries, deadline cancellations, supervisor restarts.
         lines += telemetry.resilience_metric_lines(
             breaker=(client.breaker if services is None else None))
+        # Self-preservation families: pressure level/ladder, watchdog
+        # fires, drain states (both roles emit their own copy).
+        lines += telemetry.robustness_metric_lines()
         # Wire transport series: vectored-flush coalescing, shm-ring
         # hits/fallbacks, chunk streams (this process's side of the
         # socket; the sidecar merge below carries the other side).
@@ -959,6 +1023,12 @@ def create_app(config: Optional[AppConfig] = None,
             checks["fleet"] = f"members down: {','.join(down)}"
         else:
             checks["fleet"] = f"{len(fleet_router.order)} members"
+        draining = fleet_router.draining_members()
+        if draining:
+            # Annotation only: a draining member is an OPERATOR act,
+            # and the survivors serve every shard — never a reason to
+            # pull the instance from rotation.
+            checks["drain"] = f"draining: {','.join(draining)}"
 
     async def _ready_state() -> tuple:
         """(ok, checks) for /readyz: sidecar reachability (proxy mode),
@@ -1084,7 +1154,77 @@ def create_app(config: Optional[AppConfig] = None,
             # a flight-recorder dump), not a reason to pull the last
             # healthy-enough instance out of rotation.
             checks["slo"] = telemetry.SLO.summary()
+        if governor is not None:
+            # Annotation only, same posture as the SLO line: a
+            # browned-out instance is still SERVING (that is the whole
+            # point of the ladder) — pulling it from rotation would
+            # convert chosen degradation into the overload collapse
+            # the governor exists to prevent.
+            checks["pressure"] = governor.summary()
         return ok, checks
+
+    def _drain_status() -> dict:
+        return {
+            "members": {
+                name: {
+                    "healthy": fleet_router.members[name].healthy,
+                    "draining": fleet_router.members[name].draining,
+                    "depth": fleet_router.member_depth(name),
+                    "inflight": fleet_router.member_inflight(name),
+                    "planes":
+                        fleet_router.members[name].resident_planes(),
+                }
+                for name in fleet_router.order
+            },
+        }
+
+    async def admin_drain(request: web.Request) -> web.Response:
+        """Zero-downtime rolling drains (deploy/DEPLOY.md "Overload &
+        rolling restarts"): ``GET`` reports per-member drain state;
+        ``POST ?member=mN`` drains that member — it finishes in-flight
+        work, stops accepting routes, and hands its shard manifest to
+        its ring successors as a pre-stage hint list so the shard
+        arrives WARM instead of cold-missing."""
+        if fleet_router is None:
+            return web.json_response(
+                {"error": "drains require a fleet topology "
+                          "(fleet.enabled)"}, status=400)
+        if request.method == "GET":
+            return web.json_response(_drain_status())
+        member = request.query.get("member")
+        if not member or member not in fleet_router.members:
+            return web.json_response(
+                {"error": f"unknown member {member!r}",
+                 "members": list(fleet_router.order)}, status=400)
+        routable = [n for n in fleet_router.order
+                    if fleet_router._routable(n) and n != member]
+        if not routable:
+            # Draining the LAST servable member is an outage, not a
+            # rolling restart; refuse so a scripted roll that lost
+            # track cannot take the fleet to zero.
+            return web.json_response(
+                {"error": "refusing to drain the last routable "
+                          "member"}, status=409)
+        doc = await fleet_router.drain_member(
+            member, prestage=config.drain.prestage,
+            max_planes=config.drain.prestage_max_planes,
+            settle_timeout_s=config.drain.settle_timeout_s)
+        doc.update(_drain_status())
+        return web.json_response(doc)
+
+    async def admin_undrain(request: web.Request) -> web.Response:
+        """Rejoin a drained member (same remap bound as a ring join)."""
+        if fleet_router is None:
+            return web.json_response(
+                {"error": "drains require a fleet topology "
+                          "(fleet.enabled)"}, status=400)
+        member = request.query.get("member")
+        if not member or member not in fleet_router.members:
+            return web.json_response(
+                {"error": f"unknown member {member!r}",
+                 "members": list(fleet_router.order)}, status=400)
+        fleet_router.undrain_member(member)
+        return web.json_response(_drain_status())
 
     async def readyz(request: web.Request) -> web.Response:
         """Readiness: 200 only when this process can serve renders NOW
@@ -1144,6 +1284,21 @@ def create_app(config: Optional[AppConfig] = None,
                 max_workers=workers, thread_name_prefix="render-worker"))
 
     app.on_startup.append(on_startup)
+
+    async def on_startup_robustness(app):
+        """Start the governor/watchdog tick loops (they need the
+        running loop, so they cannot start in create_app)."""
+        import asyncio
+        tasks = []
+        if governor is not None:
+            tasks.append(asyncio.create_task(
+                governor.run(), name="pressure-governor"))
+        if watchdog is not None and watchdog._targets:
+            tasks.append(asyncio.create_task(
+                watchdog.run(), name="watchdog"))
+        app[_ROBUSTNESS_TASKS_KEY] = tasks
+
+    app.on_startup.append(on_startup_robustness)
     # Trailing segments are tolerated like the reference's `:theT*` /
     # `:shapeId*` patterns (ImageRegionMicroserviceVerticle.java:214-231):
     # OMERO.web emits URLs with suffixes past the last parameter.
@@ -1168,9 +1323,21 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/warmstate", debug_warmstate)
+    app.router.add_get("/admin/drain", admin_drain)
+    app.router.add_post("/admin/drain", admin_drain)
+    app.router.add_post("/admin/undrain", admin_undrain)
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):
+        import asyncio as _asyncio
+        for task in app.get(_ROBUSTNESS_TASKS_KEY, ()):
+            task.cancel()
+            try:
+                await task
+            except (_asyncio.CancelledError, Exception):
+                pass
+        if governor is not None and pressure_mod.active() is governor:
+            pressure_mod.uninstall()
         if fleet_router is not None:
             # Stop the lane workers BEFORE the member stacks (and the
             # shared host services) close under them.
@@ -1217,6 +1384,7 @@ def create_app(config: Optional[AppConfig] = None,
     app.on_cleanup.append(on_cleanup)
     app[SERVICES_KEY] = services
     app[CONFIG_KEY] = config
+    app[FLEET_ROUTER_KEY] = fleet_router
     return app
 
 
@@ -1287,7 +1455,8 @@ def run_app(app: web.Application, config: AppConfig) -> None:
         import threading as _threading
 
         from .shutdown import build_shutdown_chain
-        chain = build_shutdown_chain(config, app[SERVICES_KEY])
+        chain = build_shutdown_chain(config, app[SERVICES_KEY],
+                                     fleet_router=app[FLEET_ROUTER_KEY])
         chain_thread: list = []
 
         def _on_signal(signame: str) -> None:

@@ -130,6 +130,30 @@ class _Pending:
     # whose budget is spent is cancelled at dispatch pop, never
     # rendered for a caller that already gave up.
     deadline: float = None        # type: ignore[assignment]
+    # Times the watchdog has requeued this pending out of a stuck
+    # group; at watchdog_escalate_after the next fire escalates
+    # instead of healing again.
+    requeues: int = 0
+
+
+class _LiveGroup:
+    """One dispatched group render as the watchdog sees it: which
+    pendings, which bucket queue to requeue into, and when the worker
+    thread started.  ``fires``/``t_fire`` keep a healed-but-still-live
+    group under scan: if its requeued pendings never reach a healthy
+    slot (every slot wedged — e.g. pipeline_depth 1), the next
+    threshold interval escalates instead of leaving the waiters
+    parked forever."""
+
+    __slots__ = ("key", "group", "t_start", "fires", "t_fire")
+
+    def __init__(self, key: tuple, group: List["_Pending"],
+                 t_start: float):
+        self.key = key
+        self.group = group
+        self.t_start = t_start
+        self.fires = 0
+        self.t_fire = 0.0
 
 
 class BatchingRenderer:
@@ -231,6 +255,22 @@ class BatchingRenderer:
         # mesh-topology-bound and must stay on the pod's lockstep
         # compile path.
         self.exec_cache = None
+        # Brownout ladder "cap_lanes" (server.pressure): while nonzero,
+        # at most this many group renders run concurrently regardless
+        # of pipeline_depth — the governor's bound on device-side
+        # concurrency under resource pressure.  0 = uncapped.
+        self._lane_cap = 0
+        # Watchdog state (server.watchdog): live group renders by
+        # their inner future, and a ring of recent group durations
+        # whose p99 anchors the stuck threshold.  Knobs are attributes
+        # (not ctor args) so wiring stays config-driven and tests can
+        # tighten them directly.
+        self._live_groups: Dict[object, _LiveGroup] = {}
+        self._group_durations: Deque[float] = collections.deque(
+            maxlen=64)
+        self.watchdog_stall_factor = 8.0
+        self.watchdog_stall_min_s = 30.0
+        self.watchdog_escalate_after = 2
         # First-tile-out settlement (wire.streaming): JPEG pendings
         # resolve the moment THEIR tile's entropy-encode slice lands,
         # instead of at the whole group's barrier — the first tile of
@@ -252,9 +292,92 @@ class BatchingRenderer:
         backlog gauge and the /readyz pressure check)."""
         return sum(len(q) for q in self._queues.values())
 
+    def set_lane_cap(self, cap: int) -> None:
+        """Brownout ladder "cap_lanes" actuator: bound concurrent
+        group renders to ``cap`` (0 restores the configured
+        pipeline_depth).  Takes effect at the next dispatch — running
+        groups are never interrupted."""
+        self._lane_cap = max(0, int(cap))
+
     def inflight(self) -> int:
         """Group renders currently occupying pipeline slots."""
         return len(self._inflight)
+
+    # ----------------------------------------------------------- watchdog
+
+    def group_p99_s(self) -> float:
+        """Observed p99 of recent group-render durations (healed
+        wedges excluded); 0 with no history — the stall floor rules
+        alone then."""
+        if not self._group_durations:
+            return 0.0
+        ordered = sorted(self._group_durations)
+        return ordered[int(0.99 * (len(ordered) - 1))]
+
+    def watchdog_scan(self, now: Optional[float] = None) -> List[dict]:
+        """Scan-and-heal for stuck group renders (``server.watchdog``
+        target contract): a live group older than
+        ``max(stall_min_s, stall_factor x observed p99)`` is STUCK —
+        its worker thread cannot be interrupted, but its waiters can
+        be rescued.  The smallest heal that works: requeue the group's
+        unsettled pendings at the head of their bucket queue, so a
+        healthy pipeline slot re-renders them while the wedged thread
+        settles into already-done futures (the existing skip-done
+        contract).  A group whose pendings were already requeued
+        ``watchdog_escalate_after - 1`` times escalates instead: its
+        waiters fail with the transport-error class (503, client
+        retries through) and the event carries ``escalate=True`` for
+        the supervisor hook.  A healed group whose pendings are STILL
+        unsettled a full threshold later re-fires toward the same
+        escalation count — the requeue found no healthy slot (every
+        lane wedged), so waiting for a re-dispatch that cannot happen
+        would park the waiters forever.  Returns the fire events."""
+        now = time.monotonic() if now is None else now
+        threshold = max(self.watchdog_stall_min_s,
+                        self.watchdog_stall_factor * self.group_p99_s())
+        events: List[dict] = []
+        for live in list(self._live_groups.values()):
+            anchor = live.t_fire if live.fires else live.t_start
+            if now - anchor < threshold:
+                continue
+            pending = [p for p in live.group if not p.future.done()]
+            if not pending:
+                continue          # everyone already settled or left
+            live.fires += 1
+            live.t_fire = now
+            age = round(now - live.t_start, 3)
+            if (live.fires >= self.watchdog_escalate_after
+                    or max(p.requeues for p in pending)
+                    >= self.watchdog_escalate_after - 1):
+                for p in pending:
+                    if not p.future.done():
+                        p.future.set_exception(ConnectionError(
+                            "watchdog: device lane stuck after "
+                            "requeue; escalating"))
+                events.append({"action": "escalate",
+                               "target": f"lane:{_key_label(live.key)}",
+                               "escalate": True, "age_s": age,
+                               "tiles": len(pending)})
+                continue
+            queue = self._queues.get(live.key)
+            if queue is None:
+                continue
+            for p in reversed(pending):
+                # A re-fire (escalate_after > 2) finds the pendings
+                # still queued from the last heal — never enqueue a
+                # second copy.
+                if any(q is p for q in queue):
+                    continue
+                p.requeues += 1
+                queue.appendleft(p)
+            wakeup = self._wakeups.get(live.key)
+            if wakeup is not None:
+                wakeup.set()
+            events.append({"action": "requeue-group",
+                           "target": f"lane:{_key_label(live.key)}",
+                           "escalate": False, "age_s": age,
+                           "tiles": len(pending)})
+        return events
 
     def _record_queue_waits(self, group: List[_Pending], now: float,
                             cancelled: bool = False) -> None:
@@ -416,6 +539,14 @@ class BatchingRenderer:
                     and not lone_idle):
                 await asyncio.sleep(self.linger_ms / 1000.0)
             await slots.acquire()
+            if self._lane_cap and len(self._inflight) >= self._lane_cap:
+                # Brownout: the governor capped concurrent groups
+                # below pipeline_depth; park briefly and re-check
+                # (only ever under an engaged cap_lanes step).
+                slots.release()
+                await asyncio.sleep(
+                    max(self.linger_ms, 10.0) / 1000.0)
+                continue
             # No awaits between popping the group and handing it to its
             # task, so a close() cancellation (delivered only at the
             # loop's await points) can never orphan a popped group.
@@ -485,7 +616,7 @@ class BatchingRenderer:
             render = (self._render_group_jpeg if key[0] == "jpeg"
                       else self._render_group)
             task = asyncio.create_task(
-                self._run_group(render, group, slots))
+                self._run_group(render, group, slots, key))
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
 
@@ -510,7 +641,8 @@ class BatchingRenderer:
         return max(1, min(self.max_batch, -(-qlen // open_streams)))
 
     async def _run_group(self, render, group: List[_Pending],
-                         slots: asyncio.Semaphore) -> None:
+                         slots: asyncio.Semaphore,
+                         key: tuple = ()) -> None:
         """Render one popped group on a worker thread.
 
         Settlement (slot release + waiter resolution) happens in the
@@ -552,9 +684,18 @@ class BatchingRenderer:
                 return run_inner()
 
         inner = asyncio.ensure_future(asyncio.to_thread(run))
+        live = _LiveGroup(key, group, time.monotonic())
+        self._live_groups[inner] = live
 
         def settle(fut: asyncio.Future) -> None:
             slots.release()
+            self._live_groups.pop(fut, None)
+            if not live.fires:
+                # Healed (stuck) groups stay out of the duration
+                # history: one wedge must not stretch the p99 the
+                # stuck threshold anchors on.
+                self._group_durations.append(
+                    time.monotonic() - live.t_start)
             if fut.cancelled():
                 exc: BaseException = RuntimeError("render cancelled")
             else:

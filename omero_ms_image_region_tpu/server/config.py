@@ -264,6 +264,93 @@ class FaultToleranceConfig:
 
 
 @dataclass
+class PressureConfig:
+    """Resource-pressure governor + brownout ladder
+    (``server.pressure``): a periodic sampler folds HBM occupancy,
+    host RSS, disk-cache fill, queue depth and event-loop lag into a
+    pressure level (ok/elevated/critical, per-signal hysteresis) and
+    walks the configured degradation ladder so overload costs quality
+    before it costs availability.  See deploy/DEPLOY.md "Overload &
+    rolling restarts"."""
+
+    enabled: bool = False
+    interval_s: float = 1.0
+    # Per-signal watermarks: enter elevated at ``high``, exit only
+    # below ``low`` (the hysteresis band); a signal at
+    # ``high * critical-factor`` reads critical.  high 0 disables the
+    # signal.
+    hbm_high: float = 0.90
+    hbm_low: float = 0.75
+    host_rss_high_mb: float = 0.0      # 0 disables (set to ~80% of
+    host_rss_low_mb: float = 0.0       # the cgroup/host limit)
+    disk_high: float = 0.95
+    disk_low: float = 0.85
+    queue_high: int = 48
+    queue_low: int = 16
+    loop_lag_high_ms: float = 250.0
+    loop_lag_low_ms: float = 50.0
+    critical_factor: float = 1.25
+    # Ladder pacing: engage the next step after this many consecutive
+    # elevated ticks (critical engages one step EVERY tick); release
+    # the last step after this many consecutive ok ticks.
+    step_hold_ticks: int = 2
+    release_hold_ticks: int = 3
+    # The ordered degradation ladder (server.pressure.KNOWN_STEPS).
+    # Engages front-to-back, releases back-to-front; shed_bulk must
+    # precede tighten_admission (interactive tiles are never shed
+    # before bulk/projection work — validated at load).
+    ladder: Tuple[str, ...] = (
+        "pause_prefetch", "pause_snapshots", "evict_caches",
+        "cap_lanes", "drop_quality", "shed_bulk",
+        "tighten_admission")
+    # Step parameters.
+    quality_cap: int = 60              # drop_quality: JPEG ceiling
+    evict_to_frac: float = 0.70        # evict_caches: low-water target
+    lane_cap: int = 1                  # cap_lanes: concurrent groups
+    admission_scale: float = 0.25      # tighten_admission multiplier
+
+
+@dataclass
+class WatchdogConfig:
+    """Stuck-lane / hung-wire watchdog (``server.watchdog``): detects
+    a device lane stuck past ``stall-factor`` x its observed p99 (with
+    the ``stall-min-s`` floor) or a wire connection wedged mid-frame
+    past ``wire-hang-s``, and heals the smallest thing that works —
+    requeue the group / drop the connection — escalating to the
+    supervisor hook only on repeated fire."""
+
+    enabled: bool = True
+    interval_s: float = 2.0
+    # A group render is stuck past max(stall-min-s, stall-factor x
+    # observed p99 group duration).  The floor keeps cold compiles
+    # (tens of seconds on some backends) from reading as stalls.
+    stall_factor: float = 8.0
+    stall_min_s: float = 30.0
+    # A connection with in-flight requests and no received frame for
+    # this long is wedged mid-frame; 0 disables the wire check.
+    wire_hang_s: float = 60.0
+    # The Nth fire on the same victim escalates (supervisor restart
+    # hook) instead of re-healing.
+    escalate_after: int = 2
+
+
+@dataclass
+class DrainConfig:
+    """Zero-downtime rolling drains (``/admin/drain`` +
+    ``parallel.fleet``): a draining member finishes in-flight work,
+    stops accepting routes, snapshots its shard manifest and
+    pre-stages it WARM onto its hash-ring successors."""
+
+    # Pre-stage the drained member's shard manifest onto its ring
+    # successors (off = the successors cold-miss instead).
+    prestage: bool = True
+    prestage_max_planes: int = 256
+    # How long a drain waits for the member's in-flight work to
+    # settle before reporting (the work itself is never cancelled).
+    settle_timeout_s: float = 30.0
+
+
+@dataclass
 class PersistenceConfig:
     """Warm-state persistence tier (services.diskcache +
     services.warmstate + server.execcache): what survives a restart.
@@ -420,6 +507,9 @@ class AppConfig:
     wire: WireConfig = field(default_factory=WireConfig)
     persistence: PersistenceConfig = field(
         default_factory=PersistenceConfig)
+    pressure: PressureConfig = field(default_factory=PressureConfig)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    drain: DrainConfig = field(default_factory=DrainConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     slo: SloConfig = field(default_factory=SloConfig)
     fault_tolerance: FaultToleranceConfig = field(
@@ -674,6 +764,138 @@ class AppConfig:
                              "be >= 1")
         if cfg.persistence.snapshot_top_k < 1:
             raise ValueError("persistence.snapshot-top-k must be >= 1")
+        pr = raw.get("pressure", {}) or {}
+        pr_defaults = PressureConfig()
+        cfg.pressure = PressureConfig(
+            enabled=bool(pr.get("enabled", pr_defaults.enabled)),
+            interval_s=float(pr.get("interval-s",
+                                    pr_defaults.interval_s)),
+            hbm_high=float(pr.get("hbm-high", pr_defaults.hbm_high)),
+            hbm_low=float(pr.get("hbm-low", pr_defaults.hbm_low)),
+            host_rss_high_mb=float(pr.get(
+                "host-rss-high-mb", pr_defaults.host_rss_high_mb)),
+            host_rss_low_mb=float(pr.get(
+                "host-rss-low-mb", pr_defaults.host_rss_low_mb)),
+            disk_high=float(pr.get("disk-high",
+                                   pr_defaults.disk_high)),
+            disk_low=float(pr.get("disk-low", pr_defaults.disk_low)),
+            queue_high=int(pr.get("queue-high",
+                                  pr_defaults.queue_high)),
+            queue_low=int(pr.get("queue-low", pr_defaults.queue_low)),
+            loop_lag_high_ms=float(pr.get(
+                "loop-lag-high-ms", pr_defaults.loop_lag_high_ms)),
+            loop_lag_low_ms=float(pr.get(
+                "loop-lag-low-ms", pr_defaults.loop_lag_low_ms)),
+            critical_factor=float(pr.get(
+                "critical-factor", pr_defaults.critical_factor)),
+            step_hold_ticks=int(pr.get(
+                "step-hold-ticks", pr_defaults.step_hold_ticks)),
+            release_hold_ticks=int(pr.get(
+                "release-hold-ticks", pr_defaults.release_hold_ticks)),
+            ladder=tuple(str(s) for s in pr.get("ladder", ()) or ())
+            or pr_defaults.ladder,
+            quality_cap=int(pr.get("quality-cap",
+                                   pr_defaults.quality_cap)),
+            evict_to_frac=float(pr.get(
+                "evict-to-frac", pr_defaults.evict_to_frac)),
+            lane_cap=int(pr.get("lane-cap", pr_defaults.lane_cap)),
+            admission_scale=float(pr.get(
+                "admission-scale", pr_defaults.admission_scale)),
+        )
+        if cfg.pressure.interval_s <= 0:
+            raise ValueError("pressure.interval-s must be > 0")
+        from .pressure import KNOWN_STEPS
+        seen_steps = set()
+        for step in cfg.pressure.ladder:
+            if step not in KNOWN_STEPS:
+                raise ValueError(
+                    f"pressure.ladder step {step!r} is not one of "
+                    f"{sorted(KNOWN_STEPS)}")
+            if step in seen_steps:
+                raise ValueError(
+                    f"pressure.ladder repeats step {step!r}")
+            seen_steps.add(step)
+        if ("shed_bulk" in seen_steps
+                and "tighten_admission" in seen_steps
+                and cfg.pressure.ladder.index("shed_bulk")
+                > cfg.pressure.ladder.index("tighten_admission")):
+            # The availability-ordering invariant: interactive tiles
+            # are never shed before bulk/projection work.
+            raise ValueError(
+                "pressure.ladder must engage shed_bulk before "
+                "tighten_admission (bulk work sheds first; "
+                "interactive availability goes last)")
+        for pair in (("hbm_high", "hbm_low"),
+                     ("host_rss_high_mb", "host_rss_low_mb"),
+                     ("disk_high", "disk_low"),
+                     ("queue_high", "queue_low"),
+                     ("loop_lag_high_ms", "loop_lag_low_ms")):
+            high, low = (getattr(cfg.pressure, pair[0]),
+                         getattr(cfg.pressure, pair[1]))
+            if high > 0 and not 0 <= low < high:
+                raise ValueError(
+                    f"pressure.{pair[1].replace('_', '-')} must be in "
+                    f"[0, {pair[0].replace('_', '-')}) — the "
+                    f"hysteresis band needs low < high")
+        if cfg.pressure.critical_factor < 1.0:
+            raise ValueError("pressure.critical-factor must be >= 1")
+        if cfg.pressure.step_hold_ticks < 1 \
+                or cfg.pressure.release_hold_ticks < 1:
+            raise ValueError("pressure step/release hold ticks must "
+                             "be >= 1")
+        if not 1 <= cfg.pressure.quality_cap <= 100:
+            raise ValueError("pressure.quality-cap must be in "
+                             "[1, 100]")
+        if not 0.0 < cfg.pressure.evict_to_frac < 1.0:
+            raise ValueError("pressure.evict-to-frac must be in "
+                             "(0, 1)")
+        if cfg.pressure.lane_cap < 1:
+            raise ValueError("pressure.lane-cap must be >= 1")
+        if not 0.0 < cfg.pressure.admission_scale <= 1.0:
+            raise ValueError("pressure.admission-scale must be in "
+                             "(0, 1]")
+        wd = raw.get("watchdog", {}) or {}
+        wd_defaults = WatchdogConfig()
+        cfg.watchdog = WatchdogConfig(
+            enabled=bool(wd.get("enabled", wd_defaults.enabled)),
+            interval_s=float(wd.get("interval-s",
+                                    wd_defaults.interval_s)),
+            stall_factor=float(wd.get("stall-factor",
+                                      wd_defaults.stall_factor)),
+            stall_min_s=float(wd.get("stall-min-s",
+                                     wd_defaults.stall_min_s)),
+            wire_hang_s=float(wd.get("wire-hang-s",
+                                     wd_defaults.wire_hang_s)),
+            escalate_after=int(wd.get("escalate-after",
+                                      wd_defaults.escalate_after)),
+        )
+        if cfg.watchdog.interval_s <= 0:
+            raise ValueError("watchdog.interval-s must be > 0")
+        if cfg.watchdog.stall_factor < 1.0:
+            raise ValueError("watchdog.stall-factor must be >= 1")
+        if cfg.watchdog.stall_min_s <= 0:
+            raise ValueError("watchdog.stall-min-s must be > 0 (the "
+                             "floor keeps cold compiles from reading "
+                             "as stalls)")
+        if cfg.watchdog.wire_hang_s < 0:
+            raise ValueError("watchdog.wire-hang-s must be >= 0 "
+                             "(0 disables the wire check)")
+        if cfg.watchdog.escalate_after < 1:
+            raise ValueError("watchdog.escalate-after must be >= 1")
+        dr = raw.get("drain", {}) or {}
+        dr_defaults = DrainConfig()
+        cfg.drain = DrainConfig(
+            prestage=bool(dr.get("prestage", dr_defaults.prestage)),
+            prestage_max_planes=int(dr.get(
+                "prestage-max-planes",
+                dr_defaults.prestage_max_planes)),
+            settle_timeout_s=float(dr.get(
+                "settle-timeout-s", dr_defaults.settle_timeout_s)),
+        )
+        if cfg.drain.prestage_max_planes < 1:
+            raise ValueError("drain.prestage-max-planes must be >= 1")
+        if cfg.drain.settle_timeout_s <= 0:
+            raise ValueError("drain.settle-timeout-s must be > 0")
         tel = raw.get("telemetry", {}) or {}
         tel_defaults = TelemetryConfig()
         cfg.telemetry = TelemetryConfig(
@@ -798,6 +1020,8 @@ class AppConfig:
             freeze_rate=float(fi.get(
                 "freeze-rate", fi_defaults.freeze_rate)),
             freeze_ms=float(fi.get("freeze-ms", fi_defaults.freeze_ms)),
+            freeze_max=int(fi.get("freeze-max",
+                                  fi_defaults.freeze_max)),
             die_after_requests=int(fi.get(
                 "die-after-requests", fi_defaults.die_after_requests)),
         ).validate()   # rate/delay bounds fail at load, not mid-serving

@@ -266,6 +266,13 @@ async def check_can_read(services: ImageRegionServices, object_type: str,
     return ok
 
 
+# Brownout-ladder request hooks (device-free, shared with the fleet
+# and proxy handlers — see server.pressure for the contract).
+from .pressure import pressure_quality as _pressure_quality  # noqa: E402
+from .pressure import \
+    shed_bulk_under_pressure as _shed_bulk_under_pressure  # noqa: E402
+
+
 class ImageRegionHandler:
     """One instance per service; per-request state stays on the stack
     (the reference builds a handler per request, this one is stateless)."""
@@ -349,6 +356,7 @@ class ImageRegionHandler:
             # are nearly free and must never shed) and inside the
             # single-flight producer (a coalesced follower adds no
             # work, so only the leader's pipeline run claims a slot).
+            _shed_bulk_under_pressure(ctx)
             admission = self.s.admission
             t_admit = admission.admit() if admission is not None \
                 else None
@@ -362,7 +370,9 @@ class ImageRegionHandler:
             finally:
                 if admission is not None:
                     admission.release(t_admit, completed=completed)
-            await self.s.caches.image_region.set(ctx.cache_key, data)
+            if not getattr(ctx, "_pressure_quality_capped", False):
+                await self.s.caches.image_region.set(ctx.cache_key,
+                                                     data)
             return data
 
         if single_flight is None:
@@ -535,10 +545,11 @@ class ImageRegionHandler:
             if ctx.flip_horizontal:
                 raw = raw[:, :, ::-1]
             h, w = raw.shape[-2:]
+            quality = codecs.quality_percent(ctx.compression_quality)
+            quality = _pressure_quality(quality, ctx)
             with stopwatch("Renderer.renderAsPackedInt"):
                 return await self.s.renderer.render_jpeg(
-                    raw, settings,
-                    codecs.quality_percent(ctx.compression_quality), w, h)
+                    raw, settings, quality, w, h)
 
         with stopwatch("Renderer.renderAsPackedInt"):
             packed = await self.s.renderer.render(raw, settings)
@@ -678,7 +689,12 @@ class ImageRegionHandler:
             # of the posture that pays for every upload.
             return load()
         key = self._region_key(ctx, region, level, active)
-        return self.s.raw_cache.get_or_load(key, load_staged)
+        # The routing identity rides along so a rolling drain can hand
+        # this plane to the ring member that will serve its future
+        # requests (parallel.fleet drain handoff).
+        from ..parallel.fleet import plane_route_key
+        return self.s.raw_cache.get_or_load(
+            key, load_staged, route_key=plane_route_key(ctx))
 
     async def _project(self, ctx: ImageRegionCtx, pixels: Pixels, src,
                        active: List[int]

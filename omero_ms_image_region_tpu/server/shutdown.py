@@ -59,14 +59,36 @@ class ShutdownChain:
         return results
 
 
-def build_shutdown_chain(config, services=None) -> ShutdownChain:
-    """The standard chain: warm-state snapshot first (serving state is
-    still live), flight-recorder dump last (the black box must land
-    even if the snapshot wedged).  ``services`` None (frontend proxy)
-    has no warm state to snapshot — the chain is just the dump."""
+def build_shutdown_chain(config, services=None,
+                         fleet_router=None) -> ShutdownChain:
+    """The standard chain: fleet quiesce first (stop accepting routes
+    — flag flips only, signal-safe — so the snapshot below captures a
+    settled shard map, and the whole-process exit is at least an
+    ORDERLY one: in-flight work keeps draining while the chain runs),
+    then the
+    warm-state snapshot (serving state is still live), the
+    flight-recorder dump last (the black box must land even if the
+    snapshot wedged).  ``services`` None (frontend proxy) has no warm
+    state to snapshot — the chain is just the dump."""
     from ..utils import telemetry
 
     chain = ShutdownChain()
+    if fleet_router is not None:
+        def quiesce():
+            # Bool flips only: this runs on the signal-time chain
+            # thread, off-loop — it must not await, lock, or touch the
+            # router's loop-confined queues.  The lanes observe the
+            # flags at their next pop; the per-member drain (with its
+            # settle + warm handoff) remains the /admin/drain op's
+            # job — at whole-process SIGTERM there is no surviving
+            # member to hand TO.
+            for name in fleet_router.order:
+                fleet_router.members[name].draining = True
+                telemetry.DRAIN.set_state(name, "draining")
+            telemetry.FLIGHT.record(
+                "drain.phase", member="*", phase="quiesce-all",
+                reason="shutdown")
+        chain.add("fleet-quiesce", quiesce)
     warmstate = getattr(services, "warmstate", None)
     if warmstate is not None:
         chain.add("warmstate-snapshot", warmstate.snapshot_now)

@@ -93,7 +93,8 @@ import json
 import logging
 import os
 import struct
-from typing import Deque, Dict, Optional, Tuple
+import time
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..utils import telemetry
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
@@ -551,6 +552,10 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 # ring traffic, chunk streams.
                 lines += telemetry.wire_metric_lines(
                     ',process="sidecar"')
+                # Self-preservation families: the governor/watchdog
+                # run in this process too when enabled.
+                lines += telemetry.robustness_metric_lines(
+                    ',process="sidecar"')
                 body = ("\n".join(lines) + "\n").encode()
             elif op == "plane_probe":
                 # Digest-first residency probe: the peer only ships the
@@ -583,6 +588,49 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 body = json.dumps(doc).encode()
             elif op == "plane_put":
                 body = await _plane_put(image_handler, header, req_body)
+            elif op == "shard_manifest":
+                # Rolling drain, step 1 (remote members): this
+                # member's HBM shard as restageable region entries —
+                # the pre-stage hint list its ring successor warms
+                # from (parallel.fleet.RemoteMember.shard_manifest).
+                cache = getattr(getattr(image_handler, "s", None),
+                                "raw_cache", None)
+                entries = (cache.snapshot_entries(
+                    int(header.get("limit", 0) or 0))
+                    if cache is not None
+                    and hasattr(cache, "snapshot_entries") else [])
+                body = json.dumps({"entries": entries}).encode()
+            elif op == "prestage":
+                # Rolling drain, step 2 (remote members): stage the
+                # handed-over shard manifest into THIS member's HBM so
+                # the drained member's planes arrive WARM instead of
+                # cold-missing.  Bounded, best-effort, off-loop.
+                from ..services.warmstate import restage_plane_entry
+                handler_services = getattr(image_handler, "s", None)
+                cache = getattr(handler_services, "raw_cache", None)
+                pixels = getattr(handler_services, "pixels_service",
+                                 None)
+                entries = header.get("entries") or []
+                if not isinstance(entries, list):
+                    raise BadRequestError("prestage entries must be "
+                                          "a list")
+
+                def _prestage() -> int:
+                    staged = 0
+                    for entry in entries:
+                        try:
+                            if restage_plane_entry(cache, pixels,
+                                                   entry):
+                                staged += 1
+                        except Exception:
+                            continue   # best-effort: a bad entry is
+                            # a cold miss later, never a failed drain
+                    return staged
+
+                staged = (await asyncio.to_thread(_prestage)
+                          if cache is not None and pixels is not None
+                          else 0)
+                body = json.dumps({"staged": staged}).encode()
             elif op == "ping":
                 doc = status_fn() if status_fn is not None \
                     else {"ok": True}
@@ -819,6 +867,39 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
     image_handler = ImageRegionHandler(services)
     mask_handler = ShapeMaskHandler(services)
 
+    # Self-preservation layer for the device-owning process: the
+    # pressure governor (HBM/RSS/disk/queue/loop-lag -> brownout
+    # ladder) and the stuck-lane watchdog run HERE, where the device
+    # lanes live; the frontend's copies watch its own wire side.
+    from . import pressure as pressure_mod
+    from .watchdog import build_watchdog
+    robustness_tasks: list = []
+    governor = None
+    if config.pressure.enabled:
+        _gov_ref: list = []
+        governor = pressure_mod.PressureGovernor(
+            config.pressure,
+            pressure_mod.build_actuators(config.pressure,
+                                         services=services),
+            pressure_mod.build_sources(services=services,
+                                       governor_ref=_gov_ref))
+        _gov_ref.append(governor)
+        pressure_mod.install(governor)
+        robustness_tasks.append(asyncio.create_task(
+            governor.run(), name="pressure-governor"))
+    if config.watchdog.enabled \
+            and hasattr(services.renderer, "watchdog_scan"):
+        def _escalate(event: dict) -> None:
+            telemetry.FLIGHT.record("watchdog.escalate", **{
+                k: v for k, v in event.items() if k != "escalate"})
+            logger.error("watchdog escalation: %s on %s",
+                         event.get("action"), event.get("target"))
+        wd = build_watchdog(config.watchdog,
+                            renderer=services.renderer,
+                            escalate_cb=_escalate)
+        robustness_tasks.append(asyncio.create_task(
+            wd.run(), name="watchdog"))
+
     def status_fn() -> dict:
         """The ping op's readiness document (frontend /readyz rolls
         this into its own verdict)."""
@@ -896,6 +977,14 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
         await asyncio.Event().wait()
     finally:
         server.close()
+        for task in robustness_tasks:
+            task.cancel()
+        if robustness_tasks:
+            await asyncio.gather(*robustness_tasks,
+                                 return_exceptions=True)
+        if governor is not None \
+                and pressure_mod.active() is governor:
+            pressure_mod.uninstall()
         for task in list(conn_tasks):
             task.cancel()
         if conn_tasks:
@@ -984,6 +1073,17 @@ class _Conn:
         # this generation an await ago — must fail at registration, not
         # park a future no reader will ever resolve.
         self.dead: Optional[BaseException] = None
+        # Hung-wire watchdog stamp: bumped on every frame RECEIVED and
+        # when a request starts a fresh in-flight episode (first
+        # registration onto an empty pending map), so "in-flight
+        # requests with no activity past wire_hang_s" means the peer is
+        # wedged mid-frame — not that the connection was merely idle
+        # before this request.  Frames SENT while requests are already
+        # parked never bump it: sends to a wedged peer are not
+        # progress, and sustained request traffic would otherwise
+        # reset the hang clock forever in exactly the scenario the
+        # watchdog exists for.
+        self.last_activity = time.monotonic()
 
     def register(self, rid: int, waiter) -> None:
         """Park a waiter (future or stream sink); refuses (raising the
@@ -992,6 +1092,11 @@ class _Conn:
         if self.dead is not None:
             raise ConnectionError(str(self.dead) or
                                   "render sidecar went away")
+        if not self.pending:
+            # Episode start: the hang clock anchors at the first
+            # in-flight request, not at connection creation (an idle
+            # connection must not read as already-hung).
+            self.last_activity = time.monotonic()
         self.pending[rid] = waiter
 
     def fail_pending(self, exc: BaseException) -> None:
@@ -1046,6 +1151,14 @@ class SidecarClient:
         self._next_id = 0
         self._conn_lock = asyncio.Lock()
         self._write_lock = asyncio.Lock()
+        # Hung-wire watchdog knobs (server.watchdog wires them from
+        # WatchdogConfig): a connection with in-flight requests and no
+        # frame activity for wire_hang_s is wedged mid-frame and gets
+        # dropped (the retry policy re-issues idempotent calls on a
+        # fresh connection).  0 disables the scan.
+        self.wire_hang_s = 0.0
+        self.watchdog_escalate_after = 2
+        self._wire_fires = 0     # consecutive; a served reply resets
 
     async def _ensure_connected(self) -> _Conn:
         conn = self._conn
@@ -1146,11 +1259,12 @@ class SidecarClient:
                 r.close()
         telemetry.WIRE.count_negotiation(ring=ring_ok)
 
-    def _drop_conn(self, conn: _Conn) -> None:
+    def _drop_conn(self, conn: _Conn,
+                   reason: str = "render sidecar went away") -> None:
         """Generation-local teardown (send failure, protocol
-        corruption): fail its waiters, stop its flusher, release its
-        rings; a newer generation is untouched."""
-        conn.fail_pending(ConnectionError("render sidecar went away"))
+        corruption, watchdog hang): fail its waiters, stop its
+        flusher, release its rings; a newer generation is untouched."""
+        conn.fail_pending(ConnectionError(reason))
         if conn.frames is not None:
             conn.frames.close()
         if conn.reader_task is not None:
@@ -1160,10 +1274,44 @@ class SidecarClient:
         if self._conn is conn:
             self._conn = None
 
+    def watchdog_scan(self, now: Optional[float] = None) -> List[dict]:
+        """Hung-wire scan-and-heal (``server.watchdog`` target
+        contract): requests are parked on the connection and NO frame
+        has moved in either direction for ``wire_hang_s`` — the peer
+        is wedged mid-frame (a stalled partial response can hold a
+        ``readexactly`` forever without ever erroring).  The smallest
+        heal: drop the connection, which fails the parked waiters with
+        the ConnectionError class the retry policy already re-issues
+        idempotent ops through on a FRESH connection.  Consecutive
+        hangs without one served reply escalate (``escalate=True`` on
+        the event) — the wire itself, not one connection, is sick."""
+        if not self.wire_hang_s:
+            return []
+        now = time.monotonic() if now is None else now
+        conn = self._conn
+        if conn is None or not conn.pending:
+            return []
+        idle = now - conn.last_activity
+        if idle < self.wire_hang_s:
+            return []
+        self._wire_fires += 1
+        escalate = self._wire_fires >= self.watchdog_escalate_after
+        parked = len(conn.pending)
+        self._drop_conn(conn,
+                        reason="watchdog: sidecar wire hung mid-frame")
+        return [{
+            "action": "escalate" if escalate else "drop-connection",
+            "target": f"wire:{self.socket_path}",
+            "escalate": escalate,
+            "pending": parked,
+            "idle_s": round(idle, 3),
+        }]
+
     async def _read_loop(self, conn: _Conn) -> None:
         try:
             while True:
                 header, body = await _read_frame(conn.reader)
+                conn.last_activity = time.monotonic()
                 body = _ring_body(conn.recv_ring, header, body)
                 rid = header.get("id")
                 waiter = conn.pending.get(rid)
@@ -1312,6 +1460,7 @@ class SidecarClient:
                     # Half-open probe succeeded: the episode is over.
                     telemetry.FLIGHT.record("breaker.close", op=op)
             telemetry.RESILIENCE.observe_attempts(op, attempt + 1)
+            self._wire_fires = 0    # a served reply ends the episode
             self._graft_response(resp_header, t_call)
             return resp_header, resp_body
 
@@ -1477,6 +1626,7 @@ class SidecarClient:
                 raise
             break
         telemetry.RESILIENCE.observe_attempts(op, attempt + 1)
+        self._wire_fires = 0    # a served reply ends the hang episode
         try:
             expected_seq = 0
             final = None
@@ -1725,6 +1875,10 @@ class SidecarImageHandler:
 
     async def render_image_region(self, ctx: ImageRegionCtx) -> bytes:
         from .errors import OverloadedError
+        from .pressure import shed_bulk_under_pressure
+        # Frontend-side brownout: bulk work sheds BEFORE crossing the
+        # wire when this process's governor has shed_bulk engaged.
+        shed_bulk_under_pressure(ctx)
         try:
             resp_header, payload = await self.client.call_full(
                 "image", ctx.to_json())

@@ -1,0 +1,127 @@
+"""Stuck-lane / hung-wire watchdog with targeted self-healing.
+
+The PR 3 fault layer reacts to components that are DEAD (a connection
+that errored, a breaker that tripped).  This watchdog covers the worse
+class: components that are merely STUCK — a device lane whose group
+render has been running N x its historical p99 (a wedged XLA dispatch,
+a hung wire fetch inside the render), or a sidecar connection that
+stopped producing frames while requests are parked on it (a peer
+wedged mid-frame).  Neither errors; both hold callers hostage until
+their deadlines, and nothing before this module would ever recycle
+them.
+
+The healing ladder is SMALLEST-SCOPE-FIRST, per the reference's
+recycle-one-verticle posture:
+
+1. **requeue the group** — a stuck batcher group's unsettled waiters
+   are requeued at the head of their bucket queue and re-rendered by a
+   healthy pipeline slot; the wedged thread, when (if) it finishes,
+   settles into already-done futures (``server.batcher`` implements
+   this as its ``watchdog_scan``).
+2. **drop the connection** — a hung sidecar wire (in-flight requests,
+   no received frame past the hang bound) is dropped so the retry
+   policy re-issues idempotent calls on a fresh connection
+   (``server.sidecar.SidecarClient.watchdog_scan``).
+3. **escalate** — only a victim that was already healed
+   ``escalate-after - 1`` times escalates: the event carries
+   ``escalate=True`` and the wired callback (the PR 3 supervisor's
+   restart, an operator pager) decides the bigger hammer.
+
+Targets implement one duck-typed method::
+
+    watchdog_scan(now) -> [ {"action": str, "target": str,
+                             "escalate": bool, ...}, ... ]
+
+performing their own smallest-scope healing and RETURNING what they
+did; the watchdog is the cadence, the accounting
+(``imageregion_watchdog_fires_total``), the flight-recorder events,
+and the escalation relay.  A scan that raises is logged and never
+stops the loop — a buggy target must not kill the component that
+exists to survive bugs.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+from ..utils import telemetry
+
+log = logging.getLogger("omero_ms_image_region_tpu.watchdog")
+
+
+class Watchdog:
+    """Tick-driven scan over registered targets."""
+
+    def __init__(self, interval_s: float = 2.0,
+                 escalate_cb: Optional[Callable[[dict], None]] = None):
+        self.interval_s = max(0.05, interval_s)
+        self.escalate_cb = escalate_cb
+        self._targets: List[object] = []
+        self.fires_total = 0
+
+    def add_target(self, target) -> None:
+        if not hasattr(target, "watchdog_scan"):
+            raise TypeError(
+                f"watchdog target {target!r} has no watchdog_scan")
+        self._targets.append(target)
+
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """One scan over every target; returns all fire events (tests
+        drive this directly; the runner calls it on the interval)."""
+        now = time.monotonic() if now is None else now
+        events: List[dict] = []
+        for target in self._targets:
+            try:
+                fired = target.watchdog_scan(now) or []
+            except Exception:
+                log.warning("watchdog scan failed on %r", target,
+                            exc_info=True)
+                continue
+            events.extend(fired)
+        for event in events:
+            self.fires_total += 1
+            telemetry.WATCHDOG.count_fire(event.get("action", "?"))
+            telemetry.FLIGHT.record("watchdog.fire", **{
+                k: v for k, v in event.items() if k != "escalate"})
+            log.warning("watchdog fired: %s on %s (%s)",
+                        event.get("action"), event.get("target"),
+                        {k: v for k, v in event.items()
+                         if k not in ("action", "target")})
+            if event.get("escalate") and self.escalate_cb is not None:
+                try:
+                    self.escalate_cb(event)
+                except Exception:
+                    log.warning("watchdog escalation callback failed",
+                                exc_info=True)
+        return events
+
+    async def run(self) -> None:
+        """Asyncio cadence loop (started by ``server.app`` /
+        ``sidecar_main`` when ``watchdog.enabled``)."""
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self.interval_s)
+            self.tick()
+
+
+def build_watchdog(config, renderer=None, clients=(),
+                   escalate_cb=None) -> Watchdog:
+    """The standard wiring: the batcher (stuck device lanes) and any
+    sidecar clients (hung wires) under one cadence, with the config's
+    thresholds pushed onto each target."""
+    wd = Watchdog(interval_s=config.interval_s,
+                  escalate_cb=escalate_cb)
+    if renderer is not None and hasattr(renderer, "watchdog_scan"):
+        renderer.watchdog_stall_factor = config.stall_factor
+        renderer.watchdog_stall_min_s = config.stall_min_s
+        renderer.watchdog_escalate_after = config.escalate_after
+        wd.add_target(renderer)
+    for client in clients:
+        if hasattr(client, "watchdog_scan"):
+            client.wire_hang_s = config.wire_hang_s
+            client.watchdog_escalate_after = config.escalate_after
+            wd.add_target(client)
+    return wd

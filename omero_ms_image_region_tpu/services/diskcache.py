@@ -309,7 +309,21 @@ class DiskByteCache:
             over = self._bytes > self.max_bytes
         if not over:
             return
-        target = int(self.max_bytes * _LOW_WATER)
+        self._evict_to(int(self.max_bytes * _LOW_WATER))
+
+    def evict_to_fraction(self, frac: float) -> None:
+        """Brownout eviction (server.pressure "evict_caches"): walk the
+        tier toward ``frac`` of budget NOW, oldest-first — the chosen,
+        early form of the per-write eviction above, run while the disk
+        is merely filling instead of when a write finds it full."""
+        self._ensure_scanned()
+        target = max(0, int(self.max_bytes * frac))
+        with self._size_lock:
+            over = self._bytes > target
+        if over:
+            self._evict_to(target)
+
+    def _evict_to(self, target: int) -> None:
         for _mtime, path, size in self._entry_mtimes():
             with self._size_lock:
                 if self._bytes <= target:

@@ -33,6 +33,11 @@ class TilePrefetcher:
                  max_pending: int = 16):
         self.raw_cache = raw_cache
         self.max_pending = max_pending
+        # Brownout ladder hook (server.pressure "pause_prefetch"): a
+        # paused prefetcher schedules nothing — speculative staging is
+        # the first work to go when HBM or the link is drowning.  The
+        # foreground path is untouched (it re-reads on demand).
+        self.paused = False
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="tile-prefetch")
         self._lock = threading.Lock()
@@ -55,7 +60,7 @@ class TilePrefetcher:
         from ..server.region import (RegionDef, clamp_region_to_plane,
                                      get_region_def)
 
-        if tile is None:
+        if tile is None or self.paused:
             return
         level = resolution or 0
         for dx, dy in ((-1, 0), (1, 0), (0, -1), (0, 1)):

@@ -52,6 +52,44 @@ _DISK_PREFIXES = {"image_region": "img:", "pixels_metadata": "meta:",
                   "shape_mask": "mask:"}
 
 
+def restage_plane_entry(raw_cache, pixels_service, entry: dict) -> bool:
+    """Re-read ONE manifest plane entry from the pixel store and stage
+    it into HBM through the existing staging path (packed wire, digest
+    dedup).  Shared by the boot rehydrator and the rolling-drain
+    pre-stager (``parallel.fleet`` hands a draining member's shard
+    manifest to its ring successor through this).  Returns False on a
+    malformed entry; read errors propagate to the caller's guard."""
+    from ..io.devicecache import region_key
+    from ..server.region import RegionDef
+
+    try:
+        image_id, z, t, level, region, channels = entry["key"]
+        key = region_key(int(image_id), int(z), int(t), int(level),
+                         tuple(int(v) for v in region),
+                         tuple(int(c) for c in channels))
+    except (KeyError, TypeError, ValueError):
+        return False
+    if key in raw_cache:
+        return True
+
+    def load():
+        import numpy as np
+        src = pixels_service.get_pixel_source(key[0])
+        x, y, w, h = key[4]
+        sub = RegionDef(x, y, w, h)
+        return np.stack([
+            src.get_region(key[1], c, key[2], sub, key[3])
+            for c in key[5]
+        ])
+
+    # Carry the entry's recorded routing identity onto the receiving
+    # cache: a restaged plane that loses its route would fall back to
+    # key-repr spreading on the NEXT drain's handoff, silently handing
+    # planes to ring members that will never serve their requests.
+    raw_cache.get_or_load(key, load, route_key=entry.get("route"))
+    return True
+
+
 def _load_manifest(path: str) -> Optional[dict]:
     """Parse-or-None: a truncated, corrupt or non-JSON manifest is a
     cold boot, never an exception."""
@@ -88,6 +126,12 @@ class WarmStateManager:
         self._snapshot_lock = threading.Lock()
         self._timer_thread: Optional[threading.Thread] = None
         self._rehydrate_thread: Optional[threading.Thread] = None
+        # Brownout ladder hook (server.pressure "pause_snapshots"):
+        # while paused the periodic timer skips its snapshot — the
+        # manifest write is disk + CPU work a drowning process can
+        # defer.  Explicit snapshots (SIGTERM chain, /debug/warmstate,
+        # drains) still run: those are the moments the manifest is FOR.
+        self.paused = False
 
     # ------------------------------------------------------------ start
 
@@ -114,6 +158,8 @@ class WarmStateManager:
 
     def _timer_loop(self) -> None:
         while not self._stop.wait(self.snapshot_interval_s):
+            if self.paused:
+                continue
             try:
                 self.snapshot_now()
             except Exception:
@@ -346,31 +392,8 @@ class WarmStateManager:
             return False
 
         def restage(entry: dict) -> bool:
-            from ..io.devicecache import region_key
-            from ..server.region import RegionDef
-            try:
-                image_id, z, t, level, region, channels = entry["key"]
-                key = region_key(int(image_id), int(z), int(t),
-                                 int(level),
-                                 tuple(int(v) for v in region),
-                                 tuple(int(c) for c in channels))
-            except (KeyError, TypeError, ValueError):
-                return False
-            if key in raw_cache:
-                return True
-
-            def load():
-                import numpy as np
-                src = pixels_service.get_pixel_source(key[0])
-                x, y, w, h = key[4]
-                sub = RegionDef(x, y, w, h)
-                return np.stack([
-                    src.get_region(key[1], c, key[2], sub, key[3])
-                    for c in key[5]
-                ])
-
-            raw_cache.get_or_load(key, load)
-            return True
+            return restage_plane_entry(raw_cache, pixels_service,
+                                       entry)
 
         aborted = False
         with cf.ThreadPoolExecutor(

@@ -51,6 +51,10 @@ class FaultInjectionConfig:
     device_error_rate: float = 0.0    # transient error in group render
     freeze_rate: float = 0.0          # device lane stalls freeze_ms
     freeze_ms: float = 0.0
+    # At most this many freezes are ever injected (0 = unbounded):
+    # the watchdog drills need exactly "the first dispatch wedges, the
+    # healed requeue runs clean" — a rate alone cannot promise that.
+    freeze_max: int = 0
     die_after_requests: int = 0       # sidecar self-kill mid-call
 
     def validate(self) -> "FaultInjectionConfig":
@@ -63,6 +67,9 @@ class FaultInjectionConfig:
                                  f"[0, 1], got {v}")
         if self.wire_delay_ms < 0 or self.freeze_ms < 0:
             raise ValueError("fault-injection delays must be >= 0")
+        if self.freeze_max < 0:
+            raise ValueError("fault-injection.freeze-max must be >= 0 "
+                             "(0 = unbounded)")
         if self.die_after_requests < 0:
             raise ValueError("fault-injection.die-after-requests must "
                              "be >= 0")
@@ -115,7 +122,13 @@ class FaultInjector:
                 "injected transient fault: connection reset by peer")
 
     def freeze_s(self) -> float:
-        """Stall duration for the device-lane hook (0 = no stall)."""
+        """Stall duration for the device-lane hook (0 = no stall;
+        bounded by ``freeze_max`` total injections when set)."""
+        if self.config.freeze_max:
+            with self._lock:
+                if self.counts.get("freeze", 0) \
+                        >= self.config.freeze_max:
+                    return 0.0
         if self._roll(self.config.freeze_rate, "freeze"):
             return self.config.freeze_ms / 1000.0
         return 0.0

@@ -1588,6 +1588,213 @@ class FleetStats:
 FLEET = FleetStats()
 
 
+# -------------------------------------------------- self-preservation
+
+class PressureStats:
+    """Resource-pressure governor accounting (``server.pressure``):
+    the folded pressure level, the raw per-signal readings, and the
+    brownout ladder's engaged set + transition counters.  Label sets
+    are closed by construction — signal names come from the sampler's
+    fixed set, step names from the config-validated ladder."""
+
+    LEVELS = ("ok", "elevated", "critical")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.level = 0                       # index into LEVELS
+        self.signals: Dict[str, float] = {}
+        self.steps_engaged: Dict[str, int] = {}    # step -> 0/1
+        self.step_transitions: Dict[Tuple[str, str], int] = {}
+        self.level_transitions = 0
+
+    def set_level(self, level: int) -> None:
+        with self._lock:
+            if level != self.level:
+                self.level_transitions += 1
+            self.level = level
+
+    def set_signal(self, name: str, value: float) -> None:
+        with self._lock:
+            self.signals[name] = float(value)
+
+    def set_step(self, step: str, engaged: bool) -> None:
+        with self._lock:
+            self.steps_engaged[step] = 1 if engaged else 0
+            key = (step, "engage" if engaged else "release")
+            self.step_transitions[key] = \
+                self.step_transitions.get(key, 0) + 1
+
+    def declare_steps(self, steps) -> None:
+        """Pre-register the ladder so every step's gauge exists from
+        scrape one (a step that never engaged must read 0, not be
+        absent)."""
+        with self._lock:
+            for step in steps:
+                self.steps_engaged.setdefault(step, 0)
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            lines = [
+                f"imageregion_pressure_level{label()} {self.level}",
+                f"imageregion_pressure_level_transitions_total"
+                f"{label()} {self.level_transitions}",
+                f"imageregion_pressure_steps_engaged{label()} "
+                f"{sum(self.steps_engaged.values())}",
+            ]
+            for name in sorted(self.signals):
+                body = 'signal="%s"' % name
+                lines.append(
+                    f"imageregion_pressure_signal{label(body)} "
+                    f"{_fmt(self.signals[name])}")
+            for step in sorted(self.steps_engaged):
+                body = 'step="%s"' % step
+                lines.append(
+                    f"imageregion_pressure_step_engaged{label(body)} "
+                    f"{self.steps_engaged[step]}")
+            for (step, action) in sorted(self.step_transitions):
+                body = 'step="%s",action="%s"' % (step, action)
+                lines.append(
+                    f"imageregion_pressure_step_transitions_total"
+                    f"{label(body)} "
+                    f"{self.step_transitions[(step, action)]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.level = 0
+            self.signals.clear()
+            self.steps_engaged.clear()
+            self.step_transitions.clear()
+            self.level_transitions = 0
+
+
+PRESSURE = PressureStats()
+
+
+class WatchdogStats:
+    """Watchdog accounting (``server.watchdog``): fires by healing
+    action.  The ``action`` label set is closed — actions are the
+    watchdog's own fixed vocabulary (requeue-group, drop-connection,
+    escalate), never caller-minted."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.fires: Dict[str, int] = {}
+
+    def count_fire(self, action: str) -> None:
+        with self._lock:
+            self.fires[action] = self.fires.get(action, 0) + 1
+
+    def totals(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.fires)
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+        lines: List[str] = []
+        with self._lock:
+            for action in sorted(self.fires):
+                inner = f'action="{action}"' + (("," + extra) if extra
+                                                else "")
+                lines.append(
+                    f"imageregion_watchdog_fires_total{{{inner}}} "
+                    f"{self.fires[action]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.fires.clear()
+
+
+WATCHDOG = WatchdogStats()
+
+
+class DrainStats:
+    """Rolling-drain accounting (``parallel.fleet`` drains): per-member
+    drain state and the handoff pre-stage counter.  Member names come
+    from config (same closed set as FleetStats), bounded by the same
+    hard cardinality guard."""
+
+    _MAX_MEMBERS = 64
+    STATES = ("active", "draining", "drained")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state: Dict[str, int] = {}      # member -> STATES index
+        self.transitions: Dict[str, int] = {}
+        self.prestaged_planes = 0
+        self.drains_total = 0
+
+    def set_state(self, member: str, state: str) -> None:
+        idx = self.STATES.index(state)
+        with self._lock:
+            if member not in self.state \
+                    and len(self.state) >= self._MAX_MEMBERS:
+                member = "_overflow"
+            if self.state.get(member) != idx:
+                self.transitions[member] = \
+                    self.transitions.get(member, 0) + 1
+            self.state[member] = idx
+            if state == "drained":
+                self.drains_total += 1
+
+    def count_prestaged(self, n: int) -> None:
+        with self._lock:
+            self.prestaged_planes += n
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            lines = [
+                f"imageregion_drain_prestaged_planes_total{label()} "
+                f"{self.prestaged_planes}",
+                f"imageregion_drains_total{label()} "
+                f"{self.drains_total}",
+            ]
+            for member in sorted(self.state):
+                body = 'member="%s"' % member
+                lines.append(
+                    f"imageregion_drain_state{label(body)} "
+                    f"{self.state[member]}")
+            for member in sorted(self.transitions):
+                body = 'member="%s"' % member
+                lines.append(
+                    f"imageregion_drain_transitions_total{label(body)} "
+                    f"{self.transitions[member]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.state.clear()
+            self.transitions.clear()
+            self.prestaged_planes = 0
+            self.drains_total = 0
+
+
+DRAIN = DrainStats()
+
+
+def robustness_metric_lines(extra_labels: str = "") -> List[str]:
+    """The self-preservation families — ``imageregion_pressure_*``,
+    ``imageregion_watchdog_*``, ``imageregion_drain_*`` — emitted from
+    BOTH roles (the governor/watchdog run wherever they are wired;
+    drains live with the fleet router)."""
+    return (PRESSURE.metric_lines(extra_labels)
+            + WATCHDOG.metric_lines(extra_labels)
+            + DRAIN.metric_lines(extra_labels))
+
+
 def fleet_metric_lines(router=None, extra_labels: str = "",
                        single_flight=None) -> List[str]:
     """The ``imageregion_fleet_*`` families: the process-global
@@ -1777,6 +1984,20 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_fleet_routed_total": "counter",
     "imageregion_fleet_stolen_total": "counter",
     "imageregion_fleet_failed_over_total": "counter",
+    # Self-preservation layer (server.pressure / server.watchdog /
+    # fleet drains): brownout ladder state, watchdog fires, rolling
+    # drain phases.
+    "imageregion_pressure_level": "gauge",
+    "imageregion_pressure_level_transitions_total": "counter",
+    "imageregion_pressure_signal": "gauge",
+    "imageregion_pressure_steps_engaged": "gauge",
+    "imageregion_pressure_step_engaged": "gauge",
+    "imageregion_pressure_step_transitions_total": "counter",
+    "imageregion_watchdog_fires_total": "counter",
+    "imageregion_drain_state": "gauge",
+    "imageregion_drain_transitions_total": "counter",
+    "imageregion_drain_prestaged_planes_total": "counter",
+    "imageregion_drains_total": "counter",
 }
 
 # Terse HELP strings for the families whose meaning is not obvious
@@ -1825,6 +2046,25 @@ METRIC_HELP: Dict[str, str] = {
         "adoption)",
     "imageregion_fleet_failed_over_total":
         "Dead-member shard work adopted hash-ring-next by the member",
+    "imageregion_pressure_level":
+        "Folded resource-pressure level (0 ok, 1 elevated, 2 critical)",
+    "imageregion_pressure_signal":
+        "Raw pressure-signal reading (fraction of budget, or raw "
+        "depth/ms)",
+    "imageregion_pressure_steps_engaged":
+        "Brownout ladder steps currently engaged (prefix of the "
+        "configured ladder)",
+    "imageregion_pressure_step_engaged":
+        "1 while the named ladder step is engaged",
+    "imageregion_pressure_step_transitions_total":
+        "Ladder step engage/release transitions",
+    "imageregion_watchdog_fires_total":
+        "Watchdog healings by action (requeue-group, drop-connection, "
+        "escalate)",
+    "imageregion_drain_state":
+        "Fleet-member drain state (0 active, 1 draining, 2 drained)",
+    "imageregion_drain_prestaged_planes_total":
+        "Handoff planes pre-staged WARM onto ring successors by drains",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -2049,3 +2289,6 @@ def reset() -> None:
     PERSIST.reset()
     WIRE.reset()
     FLEET.reset()
+    PRESSURE.reset()
+    WATCHDOG.reset()
+    DRAIN.reset()

@@ -278,7 +278,12 @@ class CircuitBreaker:
 # device-cache state and its digest verification makes a duplicate
 # upload wasted wire bytes at best, so the caller decides.
 IDEMPOTENT_OPS = frozenset({"image", "mask", "ping", "metrics",
-                            "plane_probe"})
+                            "plane_probe",
+                            # Drain surfaces: the manifest is a pure
+                            # read; prestage re-stages through the
+                            # digest-deduped path, so a duplicate is a
+                            # no-op probe hit, never double state.
+                            "shard_manifest", "prestage"})
 
 
 class RetryPolicy:

@@ -88,9 +88,21 @@ def render_trace(doc) -> str:
     return "\n".join(lines)
 
 
+# Self-preservation event kinds (pressure governor, watchdog, rolling
+# drains): flagged on the timeline and rolled into a summary footer so
+# a post-incident dump answers "what did the service DO about it"
+# at a glance.
+_ROBUSTNESS_KINDS = ("pressure.level", "pressure.step",
+                     "watchdog.fire", "watchdog.escalate",
+                     "drain.phase")
+
+
 def render_flight(doc) -> str:
     """Flight-recorder dump -> event timeline (newest events last,
-    offsets in seconds before the dump instant)."""
+    offsets in seconds before the dump instant).  Self-preservation
+    events (ladder steps, watchdog fires, drain phases) are marked
+    with ``!`` and summarized under the timeline — the
+    degrade-by-choice story of the incident."""
     events = doc.get("events", ())
     t_dump = float(doc.get("ts") or (events[-1]["ts"] if events
                                      else 0.0))
@@ -99,13 +111,30 @@ def render_flight(doc) -> str:
         f"pid={doc.get('pid', '?')}  events={len(events)}",
         f"  {'t-dump':>9}  event",
     ]
+    rob_counts: dict = {}
     for e in events:
+        kind = e.get("kind", "?")
         extra = {k: v for k, v in e.items() if k not in ("ts", "kind")}
         suffix = ("  " + " ".join(f"{k}={v}" for k, v in
                                   sorted(extra.items()))
                   if extra else "")
         offset = float(e.get("ts", t_dump)) - t_dump
-        lines.append(f"  {offset:>8.2f}s  {e.get('kind', '?')}{suffix}")
+        mark = "!" if kind in _ROBUSTNESS_KINDS else " "
+        if kind in _ROBUSTNESS_KINDS:
+            label = kind
+            if kind == "pressure.step":
+                label = (f"pressure.step:{e.get('action', '?')}"
+                         f":{e.get('step', '?')}")
+            elif kind == "watchdog.fire":
+                label = f"watchdog.fire:{e.get('action', '?')}"
+            elif kind == "drain.phase":
+                label = f"drain:{e.get('phase', '?')}"
+            rob_counts[label] = rob_counts.get(label, 0) + 1
+        lines.append(f"  {offset:>8.2f}s {mark} {kind}{suffix}")
+    if rob_counts:
+        pretty = "  ".join(f"{k}={v}" for k, v in
+                           sorted(rob_counts.items()))
+        lines.append(f"  self-preservation: {pretty}")
     return "\n".join(lines)
 
 

@@ -101,3 +101,49 @@ def test_bench_smoke_hot_path(capsys):
     # The printed line is the machine-readable contract.
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["metric"] == "smoke_hotpath_tiles_per_sec"
+
+
+def test_bench_smoke_overload_brownout(capsys):
+    """The worst-hour gate (bench.py --smoke --overload): a 10x
+    capacity burst with the pressure governor live must brown out in
+    ORDER, serve-or-shed everything (zero 5xx-without-shed), keep p99
+    bounded, and recover with hysteresis — engage/release exactly once
+    per step, release in exact reverse."""
+    import bench
+    from omero_ms_image_region_tpu.server import pressure
+    from omero_ms_image_region_tpu.utils import telemetry
+
+    telemetry.reset()
+    try:
+        t0 = time.monotonic()
+        out = bench.bench_overload_smoke()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 60.0, \
+            f"overload smoke took {elapsed:.0f}s (budget 60)"
+
+        # Zero 5xx-without-shed: every request served or shed 503.
+        assert out["overload_unshed_failures"] == 0
+        assert out["overload_served"] + out["overload_sheds"] == \
+            out["burst"]
+        assert out["overload_served"] > 0
+        # The ladder actually walked (the burst is sized to make the
+        # governor work, not to tickle one step).
+        assert len(out["overload_steps_engaged"]) >= 3
+        # Ordered engage, reverse release, full recovery, no flapping.
+        assert out["overload_ladder_order_ok"] is True
+        assert out["overload_release_reverse_ok"] is True
+        assert out["overload_released_all"] is True
+        assert out["overload_flapping"] is False
+        # Bounded p99: the burst is ~1.6 s of virtual device time at
+        # full parallelism; an order of magnitude covers CI jitter —
+        # the class this catches is an UNBOUNDED tail (no shedding,
+        # no brownout: p99 -> the whole burst behind one lane).
+        assert out["overload_p99_ms"] is not None
+        assert out["overload_p99_ms"] < 20_000.0
+
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(line)["metric"] == "overload_smoke"
+        # The governor uninstalled cleanly (no cross-test leakage).
+        assert pressure.active() is None
+    finally:
+        telemetry.reset()

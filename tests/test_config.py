@@ -296,3 +296,125 @@ def test_fleet_block_parses_and_validates():
         AppConfig.from_dict({"fleet": {"hash-replicas": 0}})
     with pytest.raises(ValueError, match="down-cooldown-s"):
         AppConfig.from_dict({"fleet": {"down-cooldown-s": -1.0}})
+
+
+def test_pressure_block_parses_and_validates():
+    """The `pressure:` block (resource-pressure governor + brownout
+    ladder): example-file defaults, full parse, the ladder vocabulary,
+    the shed_bulk-before-tighten_admission ordering invariant, and the
+    hysteresis-band bounds."""
+    from omero_ms_image_region_tpu.server.config import PressureConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = PressureConfig()
+    assert cfg.pressure.enabled is False
+    assert cfg.pressure.ladder == defaults.ladder
+    assert cfg.pressure.hbm_high == defaults.hbm_high
+
+    cfg = AppConfig.from_dict({"pressure": {
+        "enabled": True, "interval-s": 0.5,
+        "hbm-high": 0.8, "hbm-low": 0.6,
+        "host-rss-high-mb": 4096, "host-rss-low-mb": 3072,
+        "queue-high": 32, "queue-low": 8,
+        "loop-lag-high-ms": 100, "loop-lag-low-ms": 20,
+        "critical-factor": 1.5,
+        "step-hold-ticks": 3, "release-hold-ticks": 5,
+        "ladder": ["pause_prefetch", "shed_bulk",
+                   "tighten_admission"],
+        "quality-cap": 50, "evict-to-frac": 0.5,
+        "lane-cap": 2, "admission-scale": 0.5}})
+    assert cfg.pressure.enabled is True
+    assert cfg.pressure.interval_s == 0.5
+    assert cfg.pressure.hbm_high == 0.8
+    assert cfg.pressure.host_rss_high_mb == 4096
+    assert cfg.pressure.ladder == ("pause_prefetch", "shed_bulk",
+                                   "tighten_admission")
+    assert cfg.pressure.quality_cap == 50
+    assert cfg.pressure.admission_scale == 0.5
+
+    with pytest.raises(ValueError, match="ladder step"):
+        AppConfig.from_dict({"pressure": {"ladder": ["no_such_step"]}})
+    with pytest.raises(ValueError, match="repeats"):
+        AppConfig.from_dict({"pressure": {
+            "ladder": ["shed_bulk", "shed_bulk"]}})
+    # The availability-ordering invariant: interactive shedding never
+    # precedes bulk shedding.
+    with pytest.raises(ValueError, match="shed_bulk before"):
+        AppConfig.from_dict({"pressure": {
+            "ladder": ["tighten_admission", "shed_bulk"]}})
+    # Hysteresis bands need low < high.
+    with pytest.raises(ValueError, match="hbm-low"):
+        AppConfig.from_dict({"pressure": {"hbm-high": 0.5,
+                                          "hbm-low": 0.6}})
+    with pytest.raises(ValueError, match="queue-low"):
+        AppConfig.from_dict({"pressure": {"queue-high": 10,
+                                          "queue-low": 10}})
+    with pytest.raises(ValueError, match="critical-factor"):
+        AppConfig.from_dict({"pressure": {"critical-factor": 0.5}})
+    with pytest.raises(ValueError, match="quality-cap"):
+        AppConfig.from_dict({"pressure": {"quality-cap": 0}})
+    with pytest.raises(ValueError, match="evict-to-frac"):
+        AppConfig.from_dict({"pressure": {"evict-to-frac": 1.5}})
+    with pytest.raises(ValueError, match="admission-scale"):
+        AppConfig.from_dict({"pressure": {"admission-scale": 0.0}})
+    with pytest.raises(ValueError, match="interval-s"):
+        AppConfig.from_dict({"pressure": {"interval-s": 0}})
+
+
+def test_watchdog_block_parses_and_validates():
+    from omero_ms_image_region_tpu.server.config import WatchdogConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = WatchdogConfig()
+    assert cfg.watchdog.enabled is defaults.enabled
+    assert cfg.watchdog.stall_factor == defaults.stall_factor
+
+    cfg = AppConfig.from_dict({"watchdog": {
+        "enabled": False, "interval-s": 1.0, "stall-factor": 4,
+        "stall-min-s": 10, "wire-hang-s": 0, "escalate-after": 3}})
+    assert cfg.watchdog.enabled is False
+    assert cfg.watchdog.stall_factor == 4
+    assert cfg.watchdog.wire_hang_s == 0     # wire check disabled
+
+    with pytest.raises(ValueError, match="stall-factor"):
+        AppConfig.from_dict({"watchdog": {"stall-factor": 0.5}})
+    with pytest.raises(ValueError, match="stall-min-s"):
+        AppConfig.from_dict({"watchdog": {"stall-min-s": 0}})
+    with pytest.raises(ValueError, match="wire-hang-s"):
+        AppConfig.from_dict({"watchdog": {"wire-hang-s": -1}})
+    with pytest.raises(ValueError, match="escalate-after"):
+        AppConfig.from_dict({"watchdog": {"escalate-after": 0}})
+    with pytest.raises(ValueError, match="interval-s"):
+        AppConfig.from_dict({"watchdog": {"interval-s": 0}})
+
+
+def test_drain_block_parses_and_validates():
+    from omero_ms_image_region_tpu.server.config import DrainConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = DrainConfig()
+    assert cfg.drain.prestage is defaults.prestage
+    assert cfg.drain.prestage_max_planes == \
+        defaults.prestage_max_planes
+
+    cfg = AppConfig.from_dict({"drain": {
+        "prestage": False, "prestage-max-planes": 64,
+        "settle-timeout-s": 5.0}})
+    assert cfg.drain.prestage is False
+    assert cfg.drain.prestage_max_planes == 64
+    assert cfg.drain.settle_timeout_s == 5.0
+
+    with pytest.raises(ValueError, match="prestage-max-planes"):
+        AppConfig.from_dict({"drain": {"prestage-max-planes": 0}})
+    with pytest.raises(ValueError, match="settle-timeout-s"):
+        AppConfig.from_dict({"drain": {"settle-timeout-s": 0}})
+
+
+def test_fault_injection_freeze_max_parses():
+    cfg = AppConfig.from_dict({"fault-injection": {
+        "seed": 1, "freeze-rate": 1.0, "freeze-ms": 100,
+        "freeze-max": 2}})
+    assert cfg.fault_injection.freeze_max == 2
+    with pytest.raises(ValueError, match="freeze-max"):
+        AppConfig.from_dict({"fault-injection": {
+            "seed": 1, "freeze-max": -1}})

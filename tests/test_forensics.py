@@ -129,6 +129,35 @@ class TestFlightRecorder:
         assert "flight recorder" in out
         assert "admission.shed" in out and "reason=queue-full" in out
 
+    def test_trace_report_renders_robustness_timeline(self, tmp_path):
+        """Pressure transitions, ladder steps, watchdog fires and
+        drain phases are marked on the flight timeline and rolled
+        into a self-preservation summary — a post-incident dump tells
+        the whole degrade-by-choice story."""
+        rec = telemetry.FlightRecorder()
+        rec.record("pressure.level", level="elevated", prev="ok",
+                   queue=52.0)
+        rec.record("pressure.step", step="pause_prefetch",
+                   action="engage", engaged=1)
+        rec.record("watchdog.fire", action="requeue-group",
+                   target="lane:2x256x256", age_s=0.42, tiles=3)
+        rec.record("drain.phase", member="m1", phase="drained",
+                   settled=True, planes=12, prestaged=12)
+        rec.record("pressure.step", step="pause_prefetch",
+                   action="release", engaged=0)
+        path = rec.dump(str(tmp_path), "incident")
+        with open(path) as f:
+            doc = json.load(f)
+        mod = _load_script("trace_report")
+        out = mod.render_doc(doc)
+        assert "pressure.level" in out
+        assert "watchdog.fire" in out and "action=requeue-group" in out
+        assert "drain.phase" in out and "phase=drained" in out
+        assert "self-preservation:" in out
+        assert "pressure.step:engage:pause_prefetch=1" in out
+        assert "watchdog.fire:requeue-group=1" in out
+        assert "drain:drained=1" in out
+
     def test_same_second_dumps_do_not_collide(self, tmp_path):
         rec = telemetry.FlightRecorder()
         rec.record("e")

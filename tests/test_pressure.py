@@ -1,0 +1,298 @@
+"""Pressure governor + brownout ladder (server.pressure).
+
+The load-bearing claim is the PROPERTY test: for ANY pressure
+trajectory, ladder steps engage in configured order, the engaged set
+is always a prefix of the ladder, steps release in exact reverse with
+hysteresis (never before ``release_hold_ticks`` consecutive ok ticks),
+and interactive-availability shedding (``tighten_admission``) is never
+engaged without bulk shedding (``shed_bulk``) already engaged.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from omero_ms_image_region_tpu.server import pressure
+from omero_ms_image_region_tpu.server.admission import (
+    AdmissionController)
+from omero_ms_image_region_tpu.server.config import AppConfig
+from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+from omero_ms_image_region_tpu.server.errors import OverloadedError
+from omero_ms_image_region_tpu.utils import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    telemetry.reset()
+    pressure.uninstall()
+    yield
+    pressure.uninstall()
+    telemetry.reset()
+
+
+def _governor(ladder=None, actuators=None, **overrides):
+    """A governor driven by ONE controllable 'queue' signal."""
+    raw = {"pressure": {"enabled": True, **overrides}}
+    if ladder is not None:
+        raw["pressure"]["ladder"] = list(ladder)
+    config = AppConfig.from_dict(raw).pressure
+    value = {"queue": 0.0}
+    gov = pressure.PressureGovernor(
+        config, actuators or {}, {"queue": lambda: value["queue"]})
+    return gov, value, config
+
+
+# Signal values that deterministically produce each level through the
+# classifier (high=48 default: ok < low=16, elevated >= 48, critical
+# >= 48 * 1.25).
+_LEVEL_VALUES = {0: 0.0, 1: 48.0, 2: 60.0}
+
+
+class TestLadderProperty:
+    def test_any_trajectory_engages_in_order_releases_in_reverse(self):
+        rng = random.Random(1234)
+        for trial in range(20):
+            telemetry.reset()
+            gov, value, config = _governor()
+            ladder = gov.ladder
+            engaged_history = [tuple()]
+            ok_streak = 0
+            for tick in range(120):
+                level = rng.choice((0, 0, 1, 1, 2))
+                value["queue"] = _LEVEL_VALUES[level]
+                gov.tick()
+                now = tuple(gov.engaged_steps())
+                prev = engaged_history[-1]
+                # Always a PREFIX of the configured ladder.
+                assert now == ladder[:len(now)]
+                if len(now) == len(prev) + 1:
+                    # Engaged exactly the next step, in order.
+                    assert now[:len(prev)] == prev
+                elif len(now) == len(prev) - 1:
+                    # Released exactly the LAST step (reverse order),
+                    # and only after the hysteresis hold of ok ticks.
+                    assert prev[:len(now)] == now
+                    assert ok_streak + 1 >= config.release_hold_ticks
+                else:
+                    # No multi-step jumps, ever.
+                    assert now == prev
+                # The availability-ordering invariant: interactive
+                # shedding never without bulk shedding.
+                if "tighten_admission" in now:
+                    assert "shed_bulk" in now
+                ok_streak = ok_streak + 1 if level == 0 else 0
+                engaged_history.append(now)
+
+    def test_sustained_critical_walks_whole_ladder_then_recovers(self):
+        gov, value, config = _governor()
+        value["queue"] = _LEVEL_VALUES[2]
+        for _ in range(len(gov.ladder) + 2):
+            gov.tick()
+        assert gov.engaged_steps() == list(gov.ladder)
+        assert gov.level == pressure.LEVEL_CRITICAL
+        value["queue"] = 0.0
+        # Release is one step per release_hold_ticks, reverse order.
+        for expect in range(len(gov.ladder) - 1, -1, -1):
+            for _ in range(config.release_hold_ticks):
+                gov.tick()
+            assert gov.engaged_steps() == list(gov.ladder[:expect])
+        assert gov.level == pressure.LEVEL_OK
+
+    def test_elevated_engages_slower_than_critical(self):
+        gov, value, config = _governor()
+        value["queue"] = _LEVEL_VALUES[1]
+        gov.tick()
+        assert gov.engaged_steps() == []     # hold not yet met
+        for _ in range(config.step_hold_ticks - 1):
+            gov.tick()
+        assert len(gov.engaged_steps()) == 1
+
+    def test_signal_hysteresis_holds_level_between_watermarks(self):
+        gov, value, _ = _governor()
+        value["queue"] = 48.0
+        gov.tick()
+        assert gov.level == pressure.LEVEL_ELEVATED
+        # Between low (16) and high (48): stays elevated.
+        value["queue"] = 30.0
+        gov.tick()
+        assert gov.level == pressure.LEVEL_ELEVATED
+        # Below low: drops to ok.
+        value["queue"] = 10.0
+        gov.tick()
+        assert gov.level == pressure.LEVEL_OK
+
+    def test_transitions_and_level_ride_telemetry(self):
+        gov, value, _ = _governor()
+        value["queue"] = _LEVEL_VALUES[2]
+        gov.tick()
+        assert telemetry.PRESSURE.level == 2
+        assert telemetry.PRESSURE.steps_engaged[gov.ladder[0]] == 1
+        kinds = [e["kind"] for e in telemetry.FLIGHT.snapshot()]
+        assert "pressure.level" in kinds
+        assert "pressure.step" in kinds
+
+
+class TestActuators:
+    def test_actuator_hooks_fire_on_engage_and_release(self):
+        calls = []
+        actuators = {
+            "pause_prefetch": pressure.StepActuator(
+                engage=lambda: calls.append("engage"),
+                release=lambda: calls.append("release"),
+                while_engaged=lambda: calls.append("held")),
+        }
+        gov, value, config = _governor(ladder=("pause_prefetch",),
+                                       actuators=actuators)
+        value["queue"] = _LEVEL_VALUES[2]
+        gov.tick()
+        assert calls == ["engage", "held"]
+        gov.tick()
+        assert calls[-1] == "held"
+        value["queue"] = 0.0
+        for _ in range(config.release_hold_ticks):
+            gov.tick()
+        assert calls[-1] == "release"
+
+    def test_failing_actuator_never_stalls_the_ladder(self):
+        def boom():
+            raise RuntimeError("actuator bug")
+        gov, value, _ = _governor(
+            ladder=("pause_prefetch", "shed_bulk"),
+            actuators={"pause_prefetch":
+                       pressure.StepActuator(engage=boom)})
+        value["queue"] = _LEVEL_VALUES[2]
+        gov.tick()
+        gov.tick()
+        assert gov.engaged_steps() == ["pause_prefetch", "shed_bulk"]
+
+    def test_build_actuators_pause_and_evict(self):
+        """The standard wiring really flips the prefetcher/warmstate
+        flags and walks the HBM cache to low water."""
+        import numpy as np
+
+        from omero_ms_image_region_tpu.io.devicecache import (
+            DeviceRawCache)
+
+        class Services:
+            pass
+
+        cache = DeviceRawCache(max_bytes=4096, digest_index=False)
+        for i in range(4):
+            cache.get_or_load(
+                ("k", i), lambda i=i: np.full((16, 16), i,
+                                              np.uint16))
+        assert cache.size_bytes > 0
+
+        class Flagged:
+            paused = False
+
+        services = Services()
+        services.prefetcher = Flagged()
+        services.warmstate = Flagged()
+        services.raw_cache = cache
+        services.caches = None
+        services.renderer = None
+        config = AppConfig.from_dict(
+            {"pressure": {"enabled": True,
+                          "evict-to-frac": 0.25}}).pressure
+        actuators = pressure.build_actuators(config,
+                                             services=services)
+        actuators["pause_prefetch"].engage()
+        actuators["pause_snapshots"].engage()
+        assert services.prefetcher.paused is True
+        assert services.warmstate.paused is True
+        before = cache.size_bytes
+        actuators["evict_caches"].engage()
+        assert cache.size_bytes <= max(1, int(4096 * 0.25)) \
+            or cache.size_bytes < before
+        actuators["pause_prefetch"].release()
+        assert services.prefetcher.paused is False
+
+
+def _tile_ctx():
+    return ImageRegionCtx.from_params({
+        "imageId": "1", "theZ": "0", "theT": "0",
+        "tile": "0,0,0,64,64", "format": "jpeg", "m": "c",
+        "c": "1|0:60000$FF0000"})
+
+
+def _bulk_ctx():
+    return ImageRegionCtx.from_params({
+        "imageId": "1", "theZ": "0", "theT": "0",
+        "format": "jpeg", "m": "c", "c": "1|0:60000$FF0000"})
+
+
+class TestConsumerHooks:
+    def _installed(self, engaged_steps):
+        gov, value, _ = _governor()
+        value["queue"] = _LEVEL_VALUES[2]
+        while len(gov.engaged_steps()) < len(engaged_steps):
+            gov.tick()
+            assert set(gov.engaged_steps()) <= set(gov.ladder)
+        assert gov.engaged_steps() == list(engaged_steps)
+        pressure.install(gov)
+        return gov
+
+    def test_admission_tightens_under_pressure(self):
+        gov = self._installed(list(
+            AppConfig().pressure.ladder))       # all steps engaged
+        admission = AdmissionController(max_queue=100)
+        assert admission.effective_max_queue() == 25   # scale 0.25
+        admission.inflight = 25
+        with pytest.raises(OverloadedError):
+            admission.admit()
+        assert telemetry.RESILIENCE.shed.get("pressure") == 1
+        pressure.uninstall()
+        assert admission.effective_max_queue() == 100
+
+    def test_bulk_sheds_before_interactive(self):
+        ladder = AppConfig().pressure.ladder
+        self._installed(list(ladder[:ladder.index("shed_bulk") + 1]))
+        with pytest.raises(OverloadedError):
+            pressure.shed_bulk_under_pressure(_bulk_ctx())
+        # Interactive tiles pass the same gate untouched.
+        pressure.shed_bulk_under_pressure(_tile_ctx())
+        assert telemetry.RESILIENCE.shed.get("pressure-bulk") == 1
+
+    def test_quality_cap_hits_interactive_tiles_only(self):
+        ladder = AppConfig().pressure.ladder
+        self._installed(list(
+            ladder[:ladder.index("drop_quality") + 1]))
+        tile = _tile_ctx()
+        assert pressure.pressure_quality(90, tile) == 60
+        assert getattr(tile, "_pressure_quality_capped") is True
+        bulk = _bulk_ctx()
+        assert pressure.pressure_quality(90, bulk) == 90
+        # Below the cap: untouched, and no cache-skip mark.
+        tile2 = _tile_ctx()
+        assert pressure.pressure_quality(50, tile2) == 50
+        assert not getattr(tile2, "_pressure_quality_capped", False)
+
+    def test_lane_cap_actuator_on_batcher(self):
+        from omero_ms_image_region_tpu.server.batcher import (
+            BatchingRenderer)
+
+        async def scenario():
+            renderer = BatchingRenderer(max_batch=2, linger_ms=0)
+            config = AppConfig.from_dict(
+                {"pressure": {"enabled": True,
+                              "lane-cap": 1}}).pressure
+
+            class Services:
+                pass
+            services = Services()
+            services.renderer = renderer
+            services.prefetcher = None
+            services.warmstate = None
+            services.raw_cache = None
+            services.caches = None
+            actuators = pressure.build_actuators(config,
+                                                 services=services)
+            actuators["cap_lanes"].engage()
+            assert renderer._lane_cap == 1
+            actuators["cap_lanes"].release()
+            assert renderer._lane_cap == 0
+            await renderer.close()
+
+        asyncio.run(scenario())

@@ -456,6 +456,11 @@ _LABEL_KEY_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)=')
 _ALLOWED_LABEL_KEYS = frozenset({
     "route", "status", "span", "le", "cache", "tier", "op", "reason",
     "process", "slo", "window", "shape", "member",
+    # Self-preservation families (closed by construction: signal
+    # names from the governor's fixed sampler set, steps from the
+    # config-validated ladder, actions from the watchdog/ladder
+    # vocabulary).
+    "signal", "step", "action",
 })
 
 
@@ -531,6 +536,40 @@ class TestExpositionLint:
         assert "imageregion_shape_dispatches_total" in text
         assert "imageregion_shape_device_ms_total" in text
         assert "imageregion_flight_events" in text
+        # Self-preservation families are present from scrape one
+        # (level 0, no steps engaged) so dashboards/alerts can bind
+        # before the first brownout.
+        assert "imageregion_pressure_level 0" in text
+        assert "imageregion_pressure_steps_engaged 0" in text
+        assert "imageregion_drains_total 0" in text
+
+    def test_robustness_families_lint_with_labels(self):
+        """Engaged ladder steps, watchdog fires and drain states emit
+        under the closed signal/step/action/member label keys and the
+        whole exposition still lints."""
+        telemetry.PRESSURE.declare_steps(("pause_prefetch",
+                                          "shed_bulk"))
+        telemetry.PRESSURE.set_level(2)
+        telemetry.PRESSURE.set_signal("hbm", 0.93)
+        telemetry.PRESSURE.set_step("pause_prefetch", True)
+        telemetry.WATCHDOG.count_fire("requeue-group")
+        telemetry.WATCHDOG.count_fire("drop-connection")
+        telemetry.DRAIN.set_state("m1", "draining")
+        telemetry.DRAIN.count_prestaged(7)
+        text = telemetry.finalize_exposition(
+            telemetry.robustness_metric_lines())
+        _lint_exposition(text)
+        assert "imageregion_pressure_level 2" in text
+        assert 'imageregion_pressure_signal{signal="hbm"} 0.93' \
+            in text
+        assert ('imageregion_pressure_step_engaged'
+                '{step="pause_prefetch"} 1') in text
+        assert ('imageregion_pressure_step_transitions_total'
+                '{step="pause_prefetch",action="engage"} 1') in text
+        assert ('imageregion_watchdog_fires_total'
+                '{action="requeue-group"} 1') in text
+        assert 'imageregion_drain_state{member="m1"} 1' in text
+        assert "imageregion_drain_prestaged_planes_total 7" in text
 
     def test_fleet_app_metrics_parse(self, data_dir):
         """A combined-role fleet app exposes the imageregion_fleet_*

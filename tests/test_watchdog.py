@@ -1,0 +1,243 @@
+"""Watchdog drills (server.watchdog): a fault-injected FROZEN device
+lane and a WEDGED mid-frame wire connection are each detected and
+healed at the smallest scope that works — the group requeued, the
+connection dropped — with the victim requests completing long before
+the wedge itself would have cleared; escalation fires only on
+repeated failure.  Both drills are seeded and deterministic (the
+chaos layer's ``freeze_max`` bounds injection to exactly the
+dispatches the drill scripts)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu.models.pixels import Pixels
+from omero_ms_image_region_tpu.models.rendering import (
+    RenderingModel, default_rendering_def)
+from omero_ms_image_region_tpu.ops.render import pack_settings
+from omero_ms_image_region_tpu.server.batcher import BatchingRenderer
+from omero_ms_image_region_tpu.server.watchdog import Watchdog
+from omero_ms_image_region_tpu.utils import faultinject, telemetry
+
+FREEZE_MS = 3000.0
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    telemetry.reset()
+    faultinject.uninstall()
+    yield
+    faultinject.uninstall()
+    telemetry.reset()
+
+
+def _settings(C=2):
+    pixels = Pixels(image_id=1, pixels_type="uint16", size_x=64,
+                    size_y=64, size_c=C)
+    rdef = default_rendering_def(pixels)
+    rdef.model = RenderingModel.RGB
+    for c, cb in enumerate(rdef.channel_bindings):
+        cb.red, cb.green, cb.blue = (255, 0, 0) if c == 0 \
+            else (0, 255, 0)
+        cb.input_start, cb.input_end = 0.0, 60000.0
+    return pack_settings(rdef)
+
+
+def _freeze_injector(freeze_max: int):
+    """Every group render wedges FREEZE_MS — but at most freeze_max
+    times, so the heal's re-dispatch runs clean (or wedges again, for
+    the escalation drill)."""
+    return faultinject.install(faultinject.FaultInjectionConfig(
+        seed=7, freeze_rate=1.0, freeze_ms=FREEZE_MS,
+        freeze_max=freeze_max))
+
+
+def _stuck_batcher():
+    renderer = BatchingRenderer(max_batch=2, linger_ms=0,
+                                pipeline_depth=4, device_lanes=2)
+    renderer.watchdog_stall_min_s = 0.3
+    renderer.watchdog_stall_factor = 8.0
+    renderer.watchdog_escalate_after = 2
+    return renderer
+
+
+class TestFrozenLane:
+    def test_stuck_group_requeued_and_victim_completes(self):
+        _freeze_injector(freeze_max=1)
+        rng = np.random.default_rng(0)
+        raw = rng.integers(0, 60000, size=(2, 40, 40)) \
+            .astype(np.float32)
+        fired = []
+
+        async def drill():
+            renderer = _stuck_batcher()
+            wd = Watchdog(interval_s=0.05)
+            wd.add_target(renderer)
+            try:
+                task = asyncio.ensure_future(
+                    renderer.render(raw, _settings()))
+                t0 = time.monotonic()
+                await asyncio.sleep(0.45)   # past the 0.3 s floor
+                fired.extend(wd.tick())
+                out = await asyncio.wait_for(task, timeout=2.0)
+                healed_in = time.monotonic() - t0
+                return out, healed_in
+            finally:
+                await renderer.close()
+
+        out, healed_in = asyncio.run(drill())
+        # The victim completed from the HEALED re-dispatch — well
+        # inside the 3 s wedge the first dispatch is still sleeping.
+        assert out.shape == (40, 40)
+        assert healed_in < FREEZE_MS / 1000.0
+        assert [e["action"] for e in fired] == ["requeue-group"]
+        assert fired[0]["escalate"] is False
+        assert telemetry.WATCHDOG.totals() == {"requeue-group": 1}
+        kinds = [e["kind"] for e in telemetry.FLIGHT.snapshot()]
+        assert "watchdog.fire" in kinds
+
+    def test_repeated_stall_escalates(self):
+        _freeze_injector(freeze_max=2)   # the healed re-dispatch
+        rng = np.random.default_rng(1)   # wedges too
+        raw = rng.integers(0, 60000, size=(2, 40, 40)) \
+            .astype(np.float32)
+        escalations = []
+
+        async def drill():
+            renderer = _stuck_batcher()
+            wd = Watchdog(interval_s=0.05,
+                          escalate_cb=escalations.append)
+            wd.add_target(renderer)
+            try:
+                task = asyncio.ensure_future(
+                    renderer.render(raw, _settings()))
+                await asyncio.sleep(0.45)
+                first = wd.tick()           # requeue
+                await asyncio.sleep(0.45)   # re-dispatch wedges too
+                second = wd.tick()          # escalate
+                with pytest.raises(ConnectionError):
+                    await asyncio.wait_for(task, timeout=2.0)
+                return first, second
+            finally:
+                await renderer.close()
+
+        first, second = asyncio.run(drill())
+        assert [e["action"] for e in first] == ["requeue-group"]
+        assert [e["action"] for e in second] == ["escalate"]
+        assert second[0]["escalate"] is True
+        assert len(escalations) == 1
+        assert telemetry.WATCHDOG.totals() == {
+            "requeue-group": 1, "escalate": 1}
+
+
+# ------------------------------------------------------ hung-wire drill
+
+def _wire_client(sock, attempts=3):
+    from omero_ms_image_region_tpu.server.config import WireConfig
+    from omero_ms_image_region_tpu.server.sidecar import SidecarClient
+    from omero_ms_image_region_tpu.utils.transient import RetryPolicy
+    client = SidecarClient(
+        sock, breaker=None,
+        retry=RetryPolicy(max_attempts=attempts,
+                          base_backoff_s=0.01, max_backoff_s=0.02),
+        wire=WireConfig(ring_bytes=0))
+    client.wire_hang_s = 0.3
+    client.watchdog_escalate_after = 2
+    return client
+
+
+async def _wedging_server(sock, wedge_connections: int):
+    """A sidecar imposter: answers the hello with 400 (v2 posture);
+    the first ``wedge_connections`` connections answer each op with a
+    PARTIAL frame then stall forever — the classic wedged-mid-frame
+    peer that never errors; later connections serve normally."""
+    from omero_ms_image_region_tpu.server.sidecar import (_pack,
+                                                          _read_frame)
+    state = {"conns": 0}
+
+    async def on_conn(reader, writer):
+        state["conns"] += 1
+        mine = state["conns"]
+        try:
+            while True:
+                header, _body = await _read_frame(reader)
+                rid = header.get("id")
+                if header.get("op") == "hello":
+                    writer.write(_pack({"id": rid, "status": 400,
+                                        "error": "unknown op"}))
+                    await writer.drain()
+                    continue
+                if mine <= wedge_connections:
+                    writer.write(b"\x00\x00")   # mid-frame, then hang
+                    await writer.drain()
+                    await asyncio.sleep(30)
+                    return
+                writer.write(_pack({"id": rid, "status": 200},
+                                   b'{"ok": true}'))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                OSError):
+            pass
+
+    return await asyncio.start_unix_server(on_conn, path=sock), state
+
+
+class TestHungWire:
+    def test_wedged_connection_dropped_and_call_retries_through(
+            self, tmp_path):
+        sock = str(tmp_path / "wedge.sock")
+
+        async def drill():
+            server, state = await _wedging_server(
+                sock, wedge_connections=1)
+            client = _wire_client(sock)
+            wd = Watchdog(interval_s=0.05)
+            wd.add_target(client)
+            wd_task = asyncio.create_task(wd.run())
+            t0 = time.monotonic()
+            try:
+                status, body = await asyncio.wait_for(
+                    client.call("ping", {}), timeout=5.0)
+                return status, time.monotonic() - t0, state["conns"]
+            finally:
+                wd_task.cancel()
+                await client.close()
+                server.close()
+
+        status, wall, conns = asyncio.run(drill())
+        # Healed by the connection drop + policy retry — NOT by the
+        # 30 s stall timing out.
+        assert status == 200
+        assert wall < 5.0
+        assert conns >= 2
+        assert telemetry.WATCHDOG.totals().get("drop-connection") == 1
+
+    def test_consecutive_hangs_escalate(self, tmp_path):
+        sock = str(tmp_path / "wedge2.sock")
+        escalations = []
+
+        async def drill():
+            server, state = await _wedging_server(
+                sock, wedge_connections=99)    # every conn wedges
+            client = _wire_client(sock, attempts=3)
+            wd = Watchdog(interval_s=0.05,
+                          escalate_cb=escalations.append)
+            wd.add_target(client)
+            wd_task = asyncio.create_task(wd.run())
+            try:
+                with pytest.raises(ConnectionError):
+                    await asyncio.wait_for(client.call("ping", {}),
+                                           timeout=10.0)
+            finally:
+                wd_task.cancel()
+                await client.close()
+                server.close()
+
+        asyncio.run(drill())
+        fires = telemetry.WATCHDOG.totals()
+        # First hang healed at connection scope; the repeat escalated.
+        assert fires.get("drop-connection", 0) >= 1
+        assert fires.get("escalate", 0) >= 1
+        assert escalations and escalations[0]["escalate"] is True

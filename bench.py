@@ -1177,6 +1177,354 @@ def bench_smoke(duration_s: float = 1.5):
     return out
 
 
+def _jain_index(shares) -> float:
+    """Jain's fairness index over per-session service shares:
+    (sum x)^2 / (n * sum x^2) — 1.0 = perfectly even, 1/n = one
+    session took everything."""
+    xs = [max(0.0, float(x)) for x in shares]
+    n = len(xs)
+    if n == 0:
+        return 1.0
+    total = sum(xs)
+    if total <= 0:
+        return 1.0
+    return (total * total) / (n * sum(x * x for x in xs))
+
+
+def _p99(samples_ms) -> float:
+    ordered = sorted(samples_ms)
+    return ordered[int(0.99 * (len(ordered) - 1))]
+
+
+def bench_sessions_smoke(viewers: int = 6, tiles_per_viewer: int = 32,
+                         warmup_tiles: int = 6, grid: int = 8,
+                         tile_edge: int = 64, exec_ms: float = 20.0,
+                         bulk_exec_ms: float = 120.0,
+                         bulk_concurrency: int = 6):
+    """Multi-user serving gate (``bench.py --smoke --sessions``,
+    tier-1 via tests/test_bench_smoke.py): "millions of users" as a
+    TESTED scenario at smoke scale.
+
+    Three deterministic legs over one fleet stack (2 members, virtual
+    device occupancy per the `_fleet_smoke` idiom — ``exec_ms`` of
+    lane time per interactive tile, ``bulk_exec_ms`` per bulk render):
+
+    * **baseline** — N panning viewer sessions, no bulk traffic: the
+      no-bulk per-session p99 floor.
+    * **qos on** — the same viewers plus ONE hostile bulk client
+      (full-plane renders, ``bulk_concurrency`` in flight, open-loop)
+      with per-session token buckets and the weighted two-class
+      dequeue live.  The gate: worst-session interactive p99 within
+      2x the baseline, Jain's fairness index over per-session device
+      time >= 0.8, and the hostile's overrun shed 503 with the
+      ``"fairness"`` reason.
+    * **qos off** — the identical hostile scenario with buckets off
+      and FIFO dequeue: the A/B leg that PROVES the mechanism (both
+      gates regress to failure — one bulk client convoys the fleet).
+
+    A fourth leg replays a deterministic single-session pan trace with
+    the predictive viewport prefetcher live (fleet-aware: predictions
+    stage into the owning member's HBM shard) and reports the
+    predictive hit rate + duplicate-staged count.
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.parallel.fleet import (
+        FleetImageHandler, FleetRouter, LocalMember,
+        build_local_members)
+    from omero_ms_image_region_tpu.server.admission import (
+        AdmissionController, SessionTokenBuckets)
+    from omero_ms_image_region_tpu.server.app import build_services
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.errors import OverloadedError
+    from omero_ms_image_region_tpu.utils import telemetry
+
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(23)
+    exec_s = exec_ms / 1000.0
+    bulk_exec_s = bulk_exec_ms / 1000.0
+
+    from omero_ms_image_region_tpu.server.pressure import is_bulk
+
+    class VirtualDeviceMember(LocalMember):
+        """Calibrated virtual device occupancy per QoS class: the
+        render itself (read, stage, HBM cache, kernel, encode) is
+        entirely real; the sleep models the device service time a
+        2-core CI host cannot exhibit."""
+
+        async def render(self, ctx, adopt_cache=True):
+            data = await super().render(ctx, adopt_cache)
+            await asyncio.sleep(bulk_exec_s if is_bulk(ctx)
+                                else exec_s)
+            return data
+
+    def tile_params(x, y, w):
+        return {
+            "imageId": "1", "theZ": "0", "theT": "0",
+            "tile": f"0,{x},{y},{tile_edge},{tile_edge}",
+            "format": "png", "m": "c",
+            "c": f"1|0:{w}$FF0000,2|0:{w - 900}$00FF00",
+        }
+
+    def bulk_params(w):
+        return {
+            "imageId": "1", "theZ": "0", "theT": "0",
+            "format": "png", "m": "c",
+            "c": f"1|0:{w}$FF0000",
+        }
+
+    def build_stack(tmp, qos_on: bool, prefetch: bool = False):
+        from omero_ms_image_region_tpu.server.config import (
+            SessionsConfig)
+        config = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=False),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=prefetch),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        if prefetch:
+            # The viewport model only builds with the session tier on
+            # (anonymous traffic would share one trajectory, so
+            # build_services gates it); the prefetch leg replays a
+            # keyed session.  Traffic legs stay sessions-off at the
+            # member layer — THIS stack's own FleetImageHandler
+            # carries the buckets under test, and default member
+            # buckets would meter the hostile even in the qos-off
+            # A/B leg.
+            config.sessions = SessionsConfig(enabled=True)
+        services = build_services(config)
+        members = [VirtualDeviceMember(
+            m.name, m.handler, m.services,
+            down_cooldown_s=m.down_cooldown_s,
+            byte_cache_prechecked=m.byte_cache_prechecked)
+            for m in build_local_members(config, services, 2)]
+        router = FleetRouter(members, lane_width=2,
+                             steal_min_backlog=0,
+                             qos_weight=4 if qos_on else 0)
+        buckets = None
+        if qos_on:
+            # Sized so the meter separates the CLASSES, not the load:
+            # a panning viewer (cost 1, ~30-50 serial tiles/s) never
+            # touches its budget, while one full-plane render costs
+            # the ENTIRE burst — the hostile is held to ~1 bulk/s, so
+            # the mesh lane's two device lanes are never both bulk-
+            # occupied and interactive head-of-line blocking is
+            # bounded by a single in-flight bulk render.
+            buckets = SessionTokenBuckets(
+                refill_per_s=100.0, burst=100.0, bulk_cost=100.0)
+        handler = FleetImageHandler(
+            router,
+            admission=AdmissionController(4096, renderer=router,
+                                          session_buckets=buckets),
+            base_services=services)
+        if prefetch and services.prefetcher is not None:
+            # The production combined-fleet wiring (server.app): one
+            # shared prefetcher, predictions staged into the OWNING
+            # member's shard.
+            services.prefetcher.cache_for_route = \
+                router.cache_for_route
+            for member in members[1:]:
+                member.services.prefetcher = services.prefetcher
+        return config, services, members, router, handler
+
+    async def run_traffic_leg(tmp, qos_on: bool,
+                              hostile: bool) -> dict:
+        _, services, members, router, handler = build_stack(
+            tmp, qos_on)
+        try:
+            # Warm both compile shapes outside every measured window.
+            await handler.render_image_region(
+                ImageRegionCtx.from_params(tile_params(0, 0, 61000)))
+            await handler.render_image_region(
+                ImageRegionCtx.from_params(bulk_params(61000)))
+
+            measuring = asyncio.Event()
+            done = asyncio.Event()
+            latencies = {v: [] for v in range(viewers)}
+            served_ms = {f"viewer-{v}": 0.0 for v in range(viewers)}
+            served_ms["bulk-hog"] = 0.0
+            # Per-session measuring window [t_first, t_last]: shares
+            # are judged as device time per wall-second of EACH
+            # session's own window, so a starved viewer (same tile
+            # count, longer wall clock) drags the fairness index —
+            # equal closed-loop totals cannot mask unfairness.
+            windows = {}
+            bulk_served = bulk_shed = 0
+
+            async def viewer(v: int):
+                # Deterministic pan trace: each session marches along
+                # its own row, distinct windows per step (no
+                # byte-cache or dedup shortcuts).
+                steps = warmup_tiles + tiles_per_viewer
+                for step in range(steps):
+                    x = step % grid
+                    y = (v + step // grid) % grid
+                    ctx = ImageRegionCtx.from_params(
+                        tile_params(x, y,
+                                    22000 + v * 2500 + step * 60))
+                    ctx.omero_session_key = f"viewer-{v}"
+                    t0 = time.perf_counter()
+                    if step == warmup_tiles:
+                        measuring.set()
+                        windows[f"viewer-{v}"] = [t0, t0]
+                    out = await handler.render_image_region(ctx)
+                    assert out
+                    if step >= warmup_tiles:
+                        t1 = time.perf_counter()
+                        latencies[v].append((t1 - t0) * 1000.0)
+                        served_ms[f"viewer-{v}"] += exec_ms
+                        windows[f"viewer-{v}"][1] = t1
+
+            async def bulk_client():
+                nonlocal bulk_served, bulk_shed
+                seq = 0
+
+                async def one():
+                    nonlocal bulk_served, bulk_shed, seq
+                    seq += 1
+                    ctx = ImageRegionCtx.from_params(
+                        bulk_params(30000 + seq * 40))
+                    ctx.omero_session_key = "bulk-hog"
+                    if measuring.is_set():
+                        window = windows.setdefault(
+                            "bulk-hog", [time.perf_counter()] * 2)
+                        window[1] = time.perf_counter()
+                    try:
+                        await handler.render_image_region(ctx)
+                        if measuring.is_set():
+                            bulk_served += 1
+                            served_ms["bulk-hog"] += bulk_exec_ms
+                            if "bulk-hog" in windows:
+                                windows["bulk-hog"][1] = \
+                                    time.perf_counter()
+                    except OverloadedError:
+                        if measuring.is_set():
+                            bulk_shed += 1
+                        # Hostile: ignores the 1 s Retry-After, but a
+                        # floor keeps the gate about QoS, not about
+                        # the 2-core CI loop drowning in shed churn
+                        # (~120 attempts/s across the 6 streams is
+                        # still a hammering client).
+                        await asyncio.sleep(0.05)
+
+                pending = set()
+                while not done.is_set():
+                    while len(pending) < bulk_concurrency:
+                        pending.add(asyncio.create_task(one()))
+                    finished, pending = await asyncio.wait(
+                        pending, timeout=0.02,
+                        return_when=asyncio.FIRST_COMPLETED)
+                for task in pending:
+                    task.cancel()
+                await asyncio.gather(*pending,
+                                     return_exceptions=True)
+
+            tasks = [asyncio.create_task(viewer(v))
+                     for v in range(viewers)]
+            hog = (asyncio.create_task(bulk_client()) if hostile
+                   else None)
+            await asyncio.gather(*tasks)
+            done.set()
+            if hog is not None:
+                await hog
+            def rate(key):
+                t0, t1 = windows.get(key, (0.0, 0.0))
+                return served_ms[key] / max(t1 - t0, 1e-6)
+
+            shares = [rate(f"viewer-{v}") for v in range(viewers)]
+            if hostile:
+                # The hog's window spans its whole measured activity
+                # (sheds included): the rate the fleet actually
+                # granted it, not just its completions.
+                shares.append(rate("bulk-hog")
+                              if "bulk-hog" in windows else 0.0)
+            return {
+                "p99_ms": max(_p99(latencies[v])
+                              for v in range(viewers)),
+                "jain": _jain_index(shares),
+                "bulk_served": bulk_served,
+                "bulk_shed": bulk_shed,
+            }
+        finally:
+            await router.close()
+            services.pixels_service.close()
+
+    async def run_prefetch_leg(tmp) -> dict:
+        _, services, members, router, handler = build_stack(
+            tmp, qos_on=True, prefetch=True)
+        prefetcher = services.prefetcher
+        try:
+            # Deterministic single-session pan: two rows, left to
+            # right, velocity (1, 0) — the viewport model should
+            # stage each next tile before its request arrives.
+            for row in range(2):
+                for x in range(grid):
+                    ctx = ImageRegionCtx.from_params(
+                        tile_params(x, row, 45000 + row * 300 + x))
+                    ctx.omero_session_key = "panner"
+                    out = await handler.render_image_region(ctx)
+                    assert out
+                    # Idle device lanes: speculative staging runs
+                    # between pan steps, as in a real viewer cadence.
+                    await asyncio.to_thread(prefetcher.flush, 2.0)
+            report = router.shard_report()
+            return {
+                "staged": prefetcher.staged,
+                "hits": prefetcher.hits,
+                "hit_rate": prefetcher.hit_rate(),
+                "duplicates": report["duplicate_digests"],
+            }
+        finally:
+            await router.close()
+            services.pixels_service.close()
+
+    shed_before = telemetry.RESILIENCE.shed.get("fairness", 0)
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 2, 1, grid * tile_edge,
+                                     grid * tile_edge).reshape(
+            2, 1, grid * tile_edge, grid * tile_edge)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        baseline = asyncio.run(run_traffic_leg(tmp, qos_on=True,
+                                               hostile=False))
+        qos_on = asyncio.run(run_traffic_leg(tmp, qos_on=True,
+                                             hostile=True))
+        qos_off = asyncio.run(run_traffic_leg(tmp, qos_on=False,
+                                              hostile=True))
+        prefetch = asyncio.run(run_prefetch_leg(tmp))
+    fairness_sheds = (telemetry.RESILIENCE.shed.get("fairness", 0)
+                      - shed_before)
+    out = {
+        "metric": "sessions_smoke",
+        "sessions_viewers": viewers,
+        "sessions_tiles_per_viewer": tiles_per_viewer,
+        "sessions_virtual_exec_ms": exec_ms,
+        "sessions_bulk_exec_ms": bulk_exec_ms,
+        # The headline pair the gate judges: hostile-bulk p99 with the
+        # QoS tier live vs the no-bulk floor.
+        "sessions_baseline_p99_ms": _opt_round(baseline["p99_ms"], 1),
+        "sessions_interactive_p99_ms": _opt_round(qos_on["p99_ms"], 1),
+        "sessions_qos_off_p99_ms": _opt_round(qos_off["p99_ms"], 1),
+        "sessions_fairness_index": _opt_round(qos_on["jain"], 3),
+        "sessions_fairness_index_off": _opt_round(qos_off["jain"], 3),
+        "sessions_bulk_served": qos_on["bulk_served"],
+        "sessions_bulk_shed": qos_on["bulk_shed"],
+        "sessions_fairness_sheds": fairness_sheds,
+        # Predictive prefetch over the deterministic pan trace.
+        "prefetch_staged_planes": prefetch["staged"],
+        "prefetch_hits": prefetch["hits"],
+        "prefetch_hit_rate": _opt_round(prefetch["hit_rate"], 3),
+        "prefetch_duplicate_staged_planes": prefetch["duplicates"],
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    }
+    print(json.dumps(out))
+    return out
+
+
 def bench_overload_smoke(burst: int = 160, exec_ms: float = 40.0,
                          members: int = 2, lane_width: int = 2):
     """Overload-brownout gate at smoke scale (tier-1 via
@@ -1266,7 +1614,7 @@ def bench_overload_smoke(burst: int = 160, exec_ms: float = 40.0,
             "queue-high": 4 * members_n * lane_width,
             "queue-low": members_n * lane_width,
             "critical-factor": 1.5,
-            "step-hold-ticks": 1, "release-hold-ticks": 2,
+            "step-hold-ticks": 2, "release-hold-ticks": 2,
         }}).pressure
         governor = pressure.PressureGovernor(
             pcfg,
@@ -1304,7 +1652,22 @@ def bench_overload_smoke(burst: int = 160, exec_ms: float = 40.0,
                 unshed += 1          # bare failure: the gate breaker
 
         try:
-            await asyncio.gather(*(one(c) for c in ctxs))
+            # Ramp through the ELEVATED band first: the continuous
+            # prefetch budget must scale down (x0.5) strictly before
+            # the binary pause_prefetch step engages — the PR 10
+            # budget-before-pause gate.  The pre-wave is sized inside
+            # the band (>= queue-high, < critical), held until the
+            # governor publishes a scaled budget.
+            pre = min(pcfg.queue_high + 4, len(ctxs))
+            tasks = [asyncio.create_task(one(c))
+                     for c in ctxs[:pre]]
+            for _ in range(12):
+                await asyncio.sleep(pcfg.interval_s)
+                if governor.prefetch_budget() < 1.0:
+                    break
+            tasks += [asyncio.create_task(one(c))
+                      for c in ctxs[pre:]]
+            await asyncio.gather(*tasks)
             # Burst over: keep ticking until the ladder fully
             # releases (bounded — hysteresis means a few quiet ticks
             # per step).
@@ -1331,6 +1694,15 @@ def bench_overload_smoke(burst: int = 160, exec_ms: float = 40.0,
             :len(releases)]
         flapping = (len(engages) != len(set(engages))
                     or len(releases) != len(set(releases)))
+        # The continuous prefetch-budget trajectory (prefetch.budget
+        # flight events): the first move must be a SCALE-DOWN in
+        # (0, 1) — the level cut the budget before the binary pause
+        # floored it — and the last must be the full restore.
+        budgets = [e["scale"] for e in telemetry.FLIGHT.snapshot()
+                   if e["kind"] == "prefetch.budget"]
+        scaled_before_pause = bool(budgets) and 0.0 < budgets[0] < 1.0
+        budget_restored = (bool(budgets) and 0.0 in budgets
+                           and budgets[-1] == 1.0)
         ordered = sorted(latencies)
         p99 = (ordered[int(0.99 * (len(ordered) - 1))] * 1000.0
                if ordered else None)
@@ -1342,6 +1714,9 @@ def bench_overload_smoke(burst: int = 160, exec_ms: float = 40.0,
             "release_reverse_ok": bool(reverse_ok),
             "released_all": bool(released),
             "flapping": bool(flapping),
+            "budget_trajectory": budgets,
+            "budget_scaled_before_pause": bool(scaled_before_pause),
+            "budget_restored": bool(budget_restored),
             "p99_ms": _opt_round(p99, 1),
         }
 
@@ -2066,6 +2441,9 @@ def main():
     # --smoke --overload runs the brownout-ladder scenario (a 10x
     # burst must engage ladder steps in configured order, keep zero
     # 5xx-without-shed with bounded p99, and release with hysteresis).
+    # --smoke --sessions runs the multi-user serving scenario (N
+    # panning viewers + one hostile bulk client: per-session p99,
+    # Jain's fairness index, predictive prefetch hit rate).
     if "--smoke" in sys.argv[1:]:
         if "--chaos" in sys.argv[1:]:
             bench_chaos_smoke()
@@ -2073,6 +2451,8 @@ def main():
             bench_restart_smoke()
         elif "--overload" in sys.argv[1:]:
             bench_overload_smoke()
+        elif "--sessions" in sys.argv[1:]:
+            bench_sessions_smoke()
         else:
             bench_smoke()
         return

@@ -336,7 +336,7 @@ class RemoteMember:
 
 class _Work:
     __slots__ = ("ctx", "future", "owner", "stolen", "hops",
-                 "deadline", "t_enqueue")
+                 "deadline", "t_enqueue", "bulk")
 
     def __init__(self, ctx, future, owner: str, deadline):
         self.ctx = ctx
@@ -346,6 +346,112 @@ class _Work:
         self.hops = 0
         self.deadline = deadline
         self.t_enqueue = time.perf_counter()
+        # QoS class, computed ONCE at enqueue: the same
+        # ``pressure.is_bulk`` verdict the ladder's shed_bulk step and
+        # the mesh-lane pin use — the three must never drift apart.
+        from ..server.pressure import is_bulk
+        self.bulk = is_bulk(ctx)
+
+
+class _MemberQueue:
+    """One member's pending work as a weighted two-class queue.
+
+    ``qos_weight`` 0 is plain FIFO (the pre-QoS behavior, bit for
+    bit).  With weight w > 0, while BOTH classes wait, up to w
+    interactive units pop per bulk unit — interactive tiles jump a
+    bulk-export backlog instead of convoying behind it, and bulk still
+    cannot starve (after the quota one bulk unit always pops).
+    Arrival order is preserved WITHIN each class.
+    """
+
+    __slots__ = ("_items", "qos_weight", "_ic_run", "_ic")
+
+    def __init__(self, qos_weight: int = 0):
+        self._items: Deque[_Work] = collections.deque()
+        self.qos_weight = max(0, int(qos_weight))
+        self._ic_run = 0
+        # Interactive-unit count, maintained O(1) on every mutation:
+        # idle lanes poll steal_depth() on every wake evaluation, and
+        # a deep bulk backlog must not turn that into a deque walk.
+        self._ic = 0
+
+    def append(self, work: _Work) -> None:
+        self._items.append(work)
+        if not work.bulk:
+            self._ic += 1
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
+
+    def __contains__(self, work) -> bool:
+        # O(n) deque scan: tests/diagnostics only — never call this
+        # per-dispatch (the _ic counter exists precisely so the hot
+        # path needs no queue walks).
+        return work in self._items
+
+    def _first_index(self, bulk: bool) -> Optional[int]:
+        for i, w in enumerate(self._items):
+            if w.bulk == bulk:
+                return i
+        return None
+
+    def _on_pop(self, work: _Work) -> _Work:
+        if not work.bulk:
+            self._ic -= 1
+        return work
+
+    def popleft(self) -> _Work:
+        """The next unit under the weighted-dequeue policy."""
+        from ..utils import telemetry
+        items = self._items
+        if self.qos_weight <= 0:
+            return self._on_pop(items.popleft())
+        if self._ic == 0 or self._ic == len(items):
+            # One class present: plain FIFO, quota resets (the mix is
+            # what the quota meters).  O(1) — the scans below only
+            # run while the classes are actually interleaved.
+            self._ic_run = 0
+            work = self._on_pop(items.popleft())
+        elif self._ic_run >= self.qos_weight:
+            # Quota spent: one bulk unit pops — no starvation.
+            i_bulk = self._first_index(True)
+            work = self._on_pop(items[i_bulk])
+            del items[i_bulk]
+            self._ic_run = 0
+        else:
+            i_ic = self._first_index(False)
+            work = self._on_pop(items[i_ic])
+            del items[i_ic]
+            self._ic_run += 1
+            if i_ic > 0:
+                # Mixed queue and the first interactive unit was not
+                # at the head: it overtook a bulk unit that arrived
+                # first — the jump the QoS tier exists for.
+                telemetry.QOS.count_jump()
+        telemetry.QOS.count_dequeued("bulk" if work.bulk
+                                     else "interactive")
+        return work
+
+    def pop_raw(self) -> _Work:
+        """Arrival-order pop, policy-free (reassign/fail/close paths)."""
+        return self._on_pop(self._items.popleft())
+
+    def steal_depth(self) -> int:
+        """Stealable units: interactive only — bulk work is pinned to
+        the mesh lane by the same is_bulk verdict, never stolen."""
+        return self._ic
+
+    def steal_pop(self) -> Optional[_Work]:
+        """The OLDEST stealable (interactive) unit, or None."""
+        if self._ic == 0:
+            return None
+        i = self._first_index(False)
+        work = self._on_pop(self._items[i])
+        del self._items[i]
+        return work
 
 
 class FleetRouter:
@@ -364,7 +470,7 @@ class FleetRouter:
 
     def __init__(self, members: Sequence, lane_width: int = 2,
                  steal_min_backlog: int = 2, hash_replicas: int = 64,
-                 failover: bool = True):
+                 failover: bool = True, qos_weight: int = 0):
         if not members:
             raise ValueError("fleet needs at least one member")
         if lane_width < 1:
@@ -378,11 +484,15 @@ class FleetRouter:
         # 0 disables stealing entirely.
         self.steal_min_backlog = max(0, int(steal_min_backlog))
         self.failover = failover
+        # Tiered QoS (config.qos): interactive units jump bulk
+        # backlogs at this weight; 0 = plain FIFO (pre-QoS behavior).
+        self.qos_weight = max(0, int(qos_weight))
         # The admission controller reads this as the fleet's service
         # parallelism (estimated wait = depth * EWMA / lanes).
         self.device_lanes = lane_width * len(members)
-        self._queues: Dict[str, Deque[_Work]] = {
-            name: collections.deque() for name in self.order}
+        self._queues: Dict[str, _MemberQueue] = {
+            name: _MemberQueue(self.qos_weight)
+            for name in self.order}
         self._inflight: Dict[str, int] = {n: 0 for n in self.order}
         # ONE wake event for all idle lanes: stealing means any lane
         # may be interested in any member's new work, and at fleet
@@ -458,6 +568,20 @@ class FleetRouter:
 
     def healthy_members(self) -> List[str]:
         return [n for n in self.order if self.members[n].healthy]
+
+    def cache_for_route(self, route_key: str):
+        """The HBM raw cache of the member that OWNS ``route_key`` —
+        the predictive prefetcher's fleet seam: a predicted plane
+        stages into the shard that will serve its future request, so
+        prefetch warms the right member and the shard map never
+        duplicates.  None for remote members (their sidecars prefetch
+        for themselves) or when the owner has no cache."""
+        for name in self.ring.chain(route_key):
+            if self._routable(name):
+                member = self.members[name]
+                return getattr(getattr(member, "services", None),
+                               "raw_cache", None)
+        return None
 
     def draining_members(self) -> List[str]:
         return [n for n in self.order if self.members[n].draining]
@@ -633,19 +757,23 @@ class FleetRouter:
             return True
         if self.steal_min_backlog <= 0 or not self._routable(name):
             return False
-        # Mirrors _pop_work's steal candidates exactly (including the
-        # pinned-head exclusion) — a backlog this lane can NEVER steal
-        # must park it on the wake event, not busy-spin it.
+        # Mirrors _pop_work's steal candidates exactly (stealable =
+        # INTERACTIVE backlog; pinned/bulk units are never stealable)
+        # — a backlog this lane can NEVER steal must park it on the
+        # wake event, not busy-spin it.
         return any(
-            len(self._queues[other]) >= self.steal_min_backlog
-            and not self._pinned(self._queues[other][0].ctx)
+            self._queues[other].steal_depth() >= self.steal_min_backlog
             for other in self.order if other != name)
 
     def _pop_work(self, name: str) -> Optional[_Work]:
-        """This lane's next unit: own queue first; otherwise steal the
-        OLDEST request from the most-backlogged healthy-owned queue at
-        or past the steal threshold (oldest-first keeps the latency
-        tail honest — LIFO stealing would starve the convoy head)."""
+        """This lane's next unit: own queue first (weighted dequeue —
+        interactive jumps bulk backlogs when QoS is on); otherwise
+        steal the OLDEST interactive request from the most-backlogged
+        healthy-owned queue at or past the steal threshold
+        (oldest-first keeps the latency tail honest — LIFO stealing
+        would starve the convoy head).  Pinned mesh-lane (bulk) jobs
+        are never stealable — they exist to run on member 0's lockstep
+        renderer, not a single-device lane."""
         queue = self._queues[name]
         if queue:
             return queue.popleft()
@@ -658,17 +786,14 @@ class FleetRouter:
         for other in self.order:
             if other == name:
                 continue
-            queue_o = self._queues[other]
-            qlen = len(queue_o)
-            if (qlen >= self.steal_min_backlog and qlen > depth
-                    # A pinned (mesh-lane) job at the head is not
-                    # stealable — it exists to run on member 0's
-                    # lockstep renderer, not a single-device lane.
-                    and not self._pinned(queue_o[0].ctx)):
+            qlen = self._queues[other].steal_depth()
+            if qlen >= self.steal_min_backlog and qlen > depth:
                 victim, depth = other, qlen
         if victim is None:
             return None
-        work = self._queues[victim].popleft()
+        work = self._queues[victim].steal_pop()
+        if work is None:
+            return None
         work.stolen = True
         from ..utils import telemetry
         telemetry.FLEET.count_stolen(name)
@@ -684,7 +809,7 @@ class FleetRouter:
         queue = self._queues[dead]
         moved = 0
         while queue:
-            work = queue.popleft()
+            work = queue.pop_raw()
             self._route_failover(work)
             moved += 1
         if moved:
@@ -696,7 +821,7 @@ class FleetRouter:
         """failover=False: a dead member's queued work fails with it."""
         queue = self._queues[dead]
         while queue:
-            work = queue.popleft()
+            work = queue.pop_raw()
             if not work.future.done():
                 work.future.set_exception(ConnectionError(str(error)))
 
@@ -855,7 +980,7 @@ class FleetRouter:
         self._lanes = []
         for queue in self._queues.values():
             while queue:
-                work = queue.popleft()
+                work = queue.pop_raw()
                 if not work.future.done():
                     work.future.set_exception(
                         RuntimeError("fleet router shut down"))
@@ -923,10 +1048,24 @@ class FleetImageHandler:
                 raise NotFoundError(
                     f"Cannot find Image:{ctx.image_id}")
 
+        admission = self.admission
+        # Per-session fairness runs PER CALLER, before coalescing —
+        # like the combined role's ACL gate above: single-flight
+        # shares the leader's outcome across sessions, so a hostile
+        # session's over-budget 503 inside the producer would
+        # propagate to coalesced followers from under-budget
+        # sessions.  Every request pays its own token
+        # (ctx.omero_session_key — the identity the session
+        # middleware resolved and the proxy single-flight key folds)
+        # and sheds only itself.
+        debit = admission.admit_session(ctx) if admission is not None \
+            else None
+
         async def produce() -> bytes:
             from ..server.pressure import shed_bulk_under_pressure
             shed_bulk_under_pressure(ctx)
-            admission = self.admission
+            # GLOBAL admission: leader-only (a coalesced follower
+            # adds no work, so only the pipeline run claims a slot).
             t_admit = admission.admit() if admission is not None \
                 else None
             completed = False
@@ -949,26 +1088,38 @@ class FleetImageHandler:
                 if admission is not None:
                     admission.release(t_admit, completed=completed)
 
-        if self.single_flight is None:
-            remaining = transient.remaining_ms()
-            if remaining is None:
-                return await produce()
-            try:
-                return await asyncio.wait_for(
-                    produce(), timeout=max(0.0, remaining) / 1000.0)
-            except asyncio.TimeoutError:
-                raise transient.DeadlineExceededError(
-                    "deadline exceeded awaiting fleet render")
-        from ..server.settings import render_identity_key
-        key = render_identity_key(ctx)
-        if self.s is None:
-            # Proxy fleet: this process CANNOT check ACL, so identical
-            # renders coalesce per-session only — each session's
-            # leader carries its own ctx to a sidecar whose handler
-            # runs the full ACL gate.  (Combined role checked above,
-            # so cross-session coalescing stays.)
-            key = f"{key}|{ctx.omero_session_key or ''}"
-        data, coalesced = await self.single_flight.run(key, produce)
+        try:
+            if self.single_flight is None:
+                remaining = transient.remaining_ms()
+                if remaining is None:
+                    return await produce()
+                try:
+                    return await asyncio.wait_for(
+                        produce(),
+                        timeout=max(0.0, remaining) / 1000.0)
+                except asyncio.TimeoutError:
+                    raise transient.DeadlineExceededError(
+                        "deadline exceeded awaiting fleet render")
+            from ..server.settings import render_identity_key
+            key = render_identity_key(ctx)
+            if self.s is None:
+                # Proxy fleet: this process CANNOT check ACL, so
+                # identical renders coalesce per-session only — each
+                # session's leader carries its own ctx to a sidecar
+                # whose handler runs the full ACL gate.  (Combined
+                # role checked above, so cross-session coalescing
+                # stays.)
+                key = f"{key}|{ctx.omero_session_key or ''}"
+            data, coalesced = await self.single_flight.run(key,
+                                                           produce)
+        except OverloadedError:
+            # Refused GLOBALLY (queue/deadline/pressure — directly or
+            # via the coalesced-onto leader) after the fairness gate
+            # debited tokens: refund them — the session never got the
+            # render.
+            if admission is not None:
+                admission.refund_session(debit)
+            raise
         if coalesced:
             telemetry.record_span(
                 "dedup.coalesced", t0,

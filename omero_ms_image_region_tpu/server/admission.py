@@ -1,4 +1,5 @@
-"""Admission control: a bounded queue in front of the render pipeline.
+"""Admission control: a bounded queue in front of the render pipeline,
+now with PER-SESSION fairness.
 
 The reference's Vert.x event loop gave it implicit backpressure — a
 bounded worker pool and bus delivery timeouts.  The TPU build's batcher
@@ -6,13 +7,26 @@ happily queues unboundedly, so under overload every request eventually
 times out instead of most requests succeeding: the classic unshed
 overload collapse.  This controller makes the service refuse work it
 cannot finish — ``503 + Retry-After`` (``server.errors.OverloadedError``)
-at ADMISSION, before any read/stage/render cost is paid — when either
+at ADMISSION, before any read/stage/render cost is paid — when any of
 
+* the request's SESSION is over its token-bucket budget
+  (:class:`SessionTokenBuckets` — the ``"fairness"`` shed, checked
+  FIRST so one hostile session is refused before the GLOBAL bound ever
+  tightens against everyone else),
 * the number of admitted-but-unfinished renders reaches ``max_queue``
   (absolute depth bound), or
 * the estimated wait (depth x EWMA service time / device lanes)
   exceeds the caller's remaining deadline budget — accepting would only
   convert this 503-now into a 504-later that still occupied a slot.
+
+Sessions are the SAME identity the rest of the stack already carries —
+``ctx.omero_session_key``, resolved once by the session middleware and
+folded into the fleet single-flight key (PR 8): there is deliberately
+no second session-resolution path here.  Sessionless traffic shares
+one anonymous bucket.  Bulk/projection work (``pressure.is_bulk``, the
+one classification shared with the ladder and the fleet pin) draws
+``bulk_cost`` tokens per request, so a bulk-export client exhausts its
+budget ``bulk_cost``x faster than a panning viewer.
 
 Event-loop confined (admit/release run on the loop thread, like the
 single-flight table), so no lock.
@@ -21,10 +35,102 @@ single-flight table), so no lock.
 from __future__ import annotations
 
 import time
-from typing import Optional
+from collections import OrderedDict
+from typing import Callable, Optional
 
 from ..utils import telemetry, transient
 from .errors import OverloadedError
+
+
+class SessionTokenBuckets:
+    """Per-session token buckets over the request ctx's session key.
+
+    Classic leaky refill: each session holds at most ``burst`` tokens,
+    refilling at ``refill_per_s``; an interactive tile costs 1 token, a
+    bulk/projection request ``bulk_cost``.  The table is a bounded LRU
+    (``max_sessions``) — an evicted session simply starts over with a
+    full burst, which errs toward admitting (fairness is a shield
+    against sustained hogs, not an accounting ledger).
+
+    The key is ``ctx.omero_session_key`` verbatim (None -> the shared
+    anonymous bucket): the identity the session store resolved at the
+    HTTP edge and the fleet single-flight already keys on — ONE session
+    identity across the stack, never a parallel resolution path.
+    """
+
+    ANONYMOUS = ""
+
+    def __init__(self, refill_per_s: float, burst: float,
+                 max_sessions: int = 4096, bulk_cost: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if refill_per_s <= 0:
+            raise ValueError("bucket refill_per_s must be > 0")
+        if burst < 1:
+            raise ValueError("bucket burst must be >= 1")
+        if max_sessions < 1:
+            raise ValueError("bucket max_sessions must be >= 1")
+        if bulk_cost < 1:
+            raise ValueError("bucket bulk_cost must be >= 1")
+        self.refill_per_s = float(refill_per_s)
+        self.burst = float(burst)
+        self.max_sessions = int(max_sessions)
+        self.bulk_cost = float(bulk_cost)
+        self.clock = clock
+        # session -> [tokens, t_last]; event-loop confined like the
+        # controller itself.
+        self._buckets: "OrderedDict[str, list]" = OrderedDict()
+        self.taken_total = 0
+        self.refused_total = 0
+
+    def __len__(self) -> int:
+        return len(self._buckets)
+
+    def _bucket(self, session_key: Optional[str]) -> list:
+        key = session_key if session_key else self.ANONYMOUS
+        bucket = self._buckets.get(key)
+        now = self.clock()
+        if bucket is None:
+            bucket = [self.burst, now]
+            self._buckets[key] = bucket
+            while len(self._buckets) > self.max_sessions:
+                self._buckets.popitem(last=False)
+        else:
+            self._buckets.move_to_end(key)
+            bucket[0] = min(
+                self.burst,
+                bucket[0] + (now - bucket[1]) * self.refill_per_s)
+            bucket[1] = now
+        return bucket
+
+    def try_take(self, session_key: Optional[str],
+                 cost: float = 1.0) -> bool:
+        """Draw ``cost`` tokens from the session's bucket; False =
+        over budget (the caller sheds with the fairness reason)."""
+        bucket = self._bucket(session_key)
+        if bucket[0] >= cost:
+            bucket[0] -= cost
+            self.taken_total += 1
+            return True
+        self.refused_total += 1
+        return False
+
+    def refund(self, session_key: Optional[str],
+               cost: float = 1.0) -> None:
+        """Return tokens the caller debited but never used — admission
+        granted by the fairness gate and then refused by the GLOBAL
+        bounds must not charge the session for a render it never got
+        (the global shed would otherwise drain well-behaved retriers
+        into misattributed \"fairness\" sheds)."""
+        bucket = self._bucket(session_key)
+        bucket[0] = min(self.burst, bucket[0] + cost)
+
+    def retry_after_s(self, session_key: Optional[str],
+                      cost: float = 1.0) -> float:
+        """Seconds until the session's bucket can cover ``cost`` — the
+        honest Retry-After for a fairness shed."""
+        bucket = self._bucket(session_key)
+        deficit = max(0.0, cost - bucket[0])
+        return deficit / self.refill_per_s
 
 
 class AdmissionController:
@@ -34,12 +140,16 @@ class AdmissionController:
     ALPHA = 0.2
 
     def __init__(self, max_queue: int, renderer=None,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0,
+                 session_buckets: Optional[SessionTokenBuckets] = None):
         if max_queue < 1:
             raise ValueError("admission max_queue must be >= 1")
         self.max_queue = max_queue
         self.renderer = renderer          # duck-typed; lanes estimate
         self.retry_after_s = retry_after_s
+        # Per-session fairness (None = sessions unmetered, the
+        # pre-session behavior).
+        self.session_buckets = session_buckets
         self.inflight = 0                 # admitted, not yet released
         self.ewma_s: Optional[float] = None
         self.admitted_total = 0
@@ -67,41 +177,105 @@ class AdmissionController:
             return 0.0
         return self.inflight * self.ewma_s * 1000.0 / self._lanes()
 
-    def admit(self) -> float:
+    def _admit_session(self, ctx):
+        """Per-session fairness gate — BEFORE the global bounds, so a
+        hostile session is refused on its own budget while everyone
+        else's admission stays untouched.  Returns the (session,
+        cost) debit for :meth:`admit` to refund if the GLOBAL bounds
+        shed after the tokens were drawn, or None when unmetered."""
+        buckets = self.session_buckets
+        if buckets is None or ctx is None:
+            return None
+        from .pressure import is_bulk
+        bulk = is_bulk(ctx)
+        cost = buckets.bulk_cost if bulk else 1.0
+        session = ctx.omero_session_key
+        if buckets.try_take(session, cost):
+            return (session, cost)
+        self.shed_total += 1
+        cls = "bulk" if bulk else "interactive"
+        telemetry.RESILIENCE.count_shed("fairness")
+        telemetry.QOS.count_shed(cls)
+        telemetry.FLIGHT.record(
+            "qos.shed", reason="fairness", cls=cls,
+            session=(session or "-")[:16], cost=cost)
+        raise OverloadedError(
+            "session over its admission budget",
+            retry_after_s=max(self.retry_after_s,
+                              buckets.retry_after_s(session, cost)))
+
+    def admit_session(self, ctx):
+        """The fairness gate ALONE, for callers that coalesce renders
+        across sessions (single-flight): it must run PER CALLER,
+        before coalescing — like the ACL gate — so one session's
+        over-budget 503 never propagates to coalesced followers from
+        other sessions, and every request pays its own token.
+        Returns an opaque debit for :meth:`refund_session` (None when
+        unmetered); raises ``OverloadedError`` on over-budget."""
+        return self._admit_session(ctx)
+
+    def refund_session(self, debit) -> None:
+        """Return a :meth:`admit_session` debit whose request was
+        later refused by the GLOBAL bounds (or by the leader it
+        coalesced onto): tokens only pay for renders actually
+        granted."""
+        if debit is not None and self.session_buckets is not None:
+            self.session_buckets.refund(*debit)
+
+    def admit(self, ctx=None) -> float:
         """Claim a slot or shed.  Returns the admission timestamp the
-        caller hands back to :meth:`release`."""
-        max_queue = self.effective_max_queue()
-        if self.inflight >= max_queue:
-            self.shed_total += 1
-            reason = ("pressure" if max_queue < self.max_queue
-                      else "queue-full")
-            telemetry.RESILIENCE.count_shed(reason)
-            telemetry.FLIGHT.record("admission.shed",
-                                    reason=reason,
-                                    inflight=self.inflight,
-                                    max_queue=max_queue)
-            raise OverloadedError(
-                f"admission queue full ({self.inflight} renders "
-                f"in flight, bound {max_queue})",
-                retry_after_s=max(self.retry_after_s,
-                                  self.estimated_wait_ms() / 1000.0))
-        remaining = transient.remaining_ms()
-        if remaining is not None:
-            est = self.estimated_wait_ms()
-            if est > remaining:
-                # Accepting would convert this shed into a guaranteed
-                # deadline miss that still held a slot the whole time.
+        caller hands back to :meth:`release`.  ``ctx`` (the parsed
+        request, when the caller has one) enables the per-session
+        fairness gate; None preserves the anonymous global-only
+        behavior.  Callers that coalesce across sessions must use
+        :meth:`admit_session` per caller + ``admit()`` in the leader
+        instead of ``admit(ctx)`` in the leader."""
+        debit = self._admit_session(ctx)
+        try:
+            max_queue = self.effective_max_queue()
+            if self.inflight >= max_queue:
                 self.shed_total += 1
-                telemetry.RESILIENCE.count_shed("deadline")
-                telemetry.FLIGHT.record(
-                    "admission.shed", reason="deadline",
-                    inflight=self.inflight,
-                    est_wait_ms=round(est, 1),
-                    remaining_ms=round(remaining, 1))
+                reason = ("pressure" if max_queue < self.max_queue
+                          else "queue-full")
+                telemetry.RESILIENCE.count_shed(reason)
+                telemetry.FLIGHT.record("admission.shed",
+                                        reason=reason,
+                                        inflight=self.inflight,
+                                        max_queue=max_queue)
                 raise OverloadedError(
-                    f"estimated wait {est:.0f} ms exceeds remaining "
-                    f"deadline budget {remaining:.0f} ms",
-                    retry_after_s=max(self.retry_after_s, est / 1000.0))
+                    f"admission queue full ({self.inflight} renders "
+                    f"in flight, bound {max_queue})",
+                    retry_after_s=max(self.retry_after_s,
+                                      self.estimated_wait_ms()
+                                      / 1000.0))
+            remaining = transient.remaining_ms()
+            if remaining is not None:
+                est = self.estimated_wait_ms()
+                if est > remaining:
+                    # Accepting would convert this shed into a
+                    # guaranteed deadline miss that still held a slot
+                    # the whole time.
+                    self.shed_total += 1
+                    telemetry.RESILIENCE.count_shed("deadline")
+                    telemetry.FLIGHT.record(
+                        "admission.shed", reason="deadline",
+                        inflight=self.inflight,
+                        est_wait_ms=round(est, 1),
+                        remaining_ms=round(remaining, 1))
+                    raise OverloadedError(
+                        f"estimated wait {est:.0f} ms exceeds "
+                        f"remaining deadline budget "
+                        f"{remaining:.0f} ms",
+                        retry_after_s=max(self.retry_after_s,
+                                          est / 1000.0))
+        except OverloadedError:
+            # A GLOBAL shed after the fairness gate debited tokens:
+            # refund them — the session never got the render, and
+            # charging it would drain a well-behaved retrier into
+            # misattributed "fairness" sheds during global overload.
+            if debit is not None:
+                self.session_buckets.refund(*debit)
+            raise
         self.inflight += 1
         self.admitted_total += 1
         return time.monotonic()

@@ -111,6 +111,22 @@ def _make_session_store(config: AppConfig) -> Optional[SessionStore]:
     return None
 
 
+def _session_buckets(config: AppConfig):
+    """Per-session fairness token buckets (None when sessions are not
+    enabled).  Keyed on ``ctx.omero_session_key`` — the identity the
+    session middleware resolves and the fleet single-flight folds;
+    deliberately NO second session-resolution path."""
+    if not config.sessions.enabled:
+        return None
+    from .admission import SessionTokenBuckets
+    return SessionTokenBuckets(
+        refill_per_s=config.sessions.bucket_refill_per_s,
+        burst=config.sessions.bucket_burst,
+        max_sessions=config.sessions.max_tracked,
+        bulk_cost=(config.qos.bulk_cost if config.qos.enabled
+                   else 1.0))
+
+
 def _install_fault_injection(config: AppConfig) -> None:
     """Arm the seeded chaos layer when the config asks for it.  Guarded
     on the seed so a default config can never clobber an injector a
@@ -292,15 +308,32 @@ def build_services(config: AppConfig) -> "ImageRegionServices":
         services.single_flight = SingleFlight()
     if config.fault_tolerance.admission_max_queue > 0:
         # Bounded admission in front of the batcher: overload sheds
-        # with 503 + Retry-After instead of queueing toward a timeout.
+        # with 503 + Retry-After instead of queueing toward a timeout;
+        # with sessions enabled, per-session token buckets shed a
+        # hostile session ("fairness") before the global bound bites.
         from .admission import AdmissionController
         services.admission = AdmissionController(
             config.fault_tolerance.admission_max_queue,
             renderer=renderer,
-            retry_after_s=config.fault_tolerance.shed_retry_after_s)
+            retry_after_s=config.fault_tolerance.shed_retry_after_s,
+            session_buckets=_session_buckets(config))
     if services.raw_cache is not None and config.raw_cache.prefetch:
         from ..services.prefetch import TilePrefetcher
-        services.prefetcher = TilePrefetcher(services.raw_cache)
+        viewport = None
+        if config.sessions.enabled:
+            # Session viewport model: per-session pan/zoom
+            # trajectories drive PREDICTED-tile prefetch (falls back
+            # to lattice neighbors for trajectory-less sessions).
+            # Gated on sessions.enabled: without the session
+            # middleware every request is anonymous, and one SHARED
+            # trajectory interleaving unrelated viewers would predict
+            # garbage while suppressing the lattice fallback.
+            from ..services.viewport import ViewportTracker
+            viewport = ViewportTracker(
+                max_sessions=config.sessions.max_tracked)
+        services.prefetcher = TilePrefetcher(
+            services.raw_cache, viewport=viewport,
+            lookahead=config.sessions.prefetch_lookahead)
     exec_cache = None
     if config.persistence.enabled:
         import os as _os
@@ -450,7 +483,9 @@ def create_app(config: Optional[AppConfig] = None,
             fleet_members, lane_width=config.fleet.lane_width,
             steal_min_backlog=config.fleet.steal_min_backlog,
             hash_replicas=config.fleet.hash_replicas,
-            failover=config.fleet.failover)
+            failover=config.fleet.failover,
+            qos_weight=(config.qos.interactive_weight
+                        if config.qos.enabled else 0))
         single_flight = None
         if config.single_flight:
             from .singleflight import SingleFlight
@@ -461,7 +496,8 @@ def create_app(config: Optional[AppConfig] = None,
             admission = AdmissionController(
                 config.fault_tolerance.admission_max_queue,
                 renderer=fleet_router,
-                retry_after_s=config.fault_tolerance.shed_retry_after_s)
+                retry_after_s=config.fault_tolerance.shed_retry_after_s,
+                session_buckets=_session_buckets(config))
         fallback = None
         if config.fault_tolerance.degraded_mode:
             from .degraded import DegradedCpuHandler
@@ -511,7 +547,9 @@ def create_app(config: Optional[AppConfig] = None,
                 fleet_members, lane_width=config.fleet.lane_width,
                 steal_min_backlog=config.fleet.steal_min_backlog,
                 hash_replicas=config.fleet.hash_replicas,
-                failover=config.fleet.failover)
+                failover=config.fleet.failover,
+                qos_weight=(config.qos.interactive_weight
+                            if config.qos.enabled else 0))
             single_flight = services.single_flight
             services.single_flight = None
             services.admission = None
@@ -522,7 +560,20 @@ def create_app(config: Optional[AppConfig] = None,
                     config.fault_tolerance.admission_max_queue,
                     renderer=fleet_router,
                     retry_after_s=(
-                        config.fault_tolerance.shed_retry_after_s))
+                        config.fault_tolerance.shed_retry_after_s),
+                    session_buckets=_session_buckets(config))
+            if services.prefetcher is not None:
+                # Fleet-aware prefetch: ONE shared prefetcher (and
+                # viewport model) across every member — predictions
+                # route by plane_route_key to the OWNING member's HBM
+                # shard, so speculative staging warms the member that
+                # will serve the request and never duplicates planes.
+                services.prefetcher.cache_for_route = \
+                    fleet_router.cache_for_route
+                for member in fleet_members[1:]:
+                    if member.services is not None:
+                        member.services.prefetcher = \
+                            services.prefetcher
             image_handler = FleetImageHandler(
                 fleet_router, single_flight=single_flight,
                 admission=admission, base_services=services)
@@ -538,6 +589,11 @@ def create_app(config: Optional[AppConfig] = None,
     from . import pressure as pressure_mod
     governor = None
     if config.pressure.enabled:
+        # Host-RSS watermarks default from the cgroup memory limit
+        # (v2 memory.max, v1 fallback) when the knob is unset — a
+        # containerized deploy gets RSS brownouts with zero config;
+        # the explicit knob still wins.
+        pressure_mod.apply_cgroup_rss_defaults(config.pressure)
         _gov_ref: list = []
         governor = pressure_mod.PressureGovernor(
             config.pressure,
@@ -1025,9 +1081,13 @@ def create_app(config: Optional[AppConfig] = None,
             checks["fleet"] = f"{len(fleet_router.order)} members"
         draining = fleet_router.draining_members()
         if draining:
-            # Annotation only: a draining member is an OPERATOR act,
-            # and the survivors serve every shard — never a reason to
-            # pull the instance from rotation.
+            # Annotation by default: a draining member is an OPERATOR
+            # act, and the survivors serve every shard — not in
+            # itself a reason to pull the instance from rotation.
+            # With ``drain.fail-readyz`` on, the drain IS surfaced to
+            # the load balancer: /readyz answers 503 while the roll is
+            # in progress, so nginx/k8s pull the instance and the
+            # restart happens with zero in-flight traffic.
             checks["drain"] = f"draining: {','.join(draining)}"
 
     async def _ready_state() -> tuple:
@@ -1161,6 +1221,13 @@ def create_app(config: Optional[AppConfig] = None,
             # convert chosen degradation into the overload collapse
             # the governor exists to prevent.
             checks["pressure"] = governor.summary()
+        if (config.drain.fail_readyz and fleet_router is not None
+                and fleet_router.draining_members()):
+            # drain.fail-readyz: surface the roll to the LB — a
+            # draining instance answers 503 so nginx/k8s pull it from
+            # rotation until /admin/undrain (the default annotation-
+            # only posture is preserved with the flag off).
+            ok = False
         return ok, checks
 
     def _drain_status() -> dict:

@@ -308,6 +308,12 @@ class PressureConfig:
     evict_to_frac: float = 0.70        # evict_caches: low-water target
     lane_cap: int = 1                  # cap_lanes: concurrent groups
     admission_scale: float = 0.25      # tighten_admission multiplier
+    # Continuous prefetch budget by level (PressureGovernor
+    # .prefetch_budget): speculative staging scales down with pressure
+    # BEFORE the binary pause_prefetch step engages (which floors the
+    # budget at 0), and restores in exact reverse on release.
+    prefetch_budget_elevated: float = 0.5
+    prefetch_budget_critical: float = 0.25
 
 
 @dataclass
@@ -348,6 +354,56 @@ class DrainConfig:
     # How long a drain waits for the member's in-flight work to
     # settle before reporting (the work itself is never cancelled).
     settle_timeout_s: float = 30.0
+    # Surface drain state to load balancers: while ANY member is
+    # draining, /readyz answers 503 so nginx/k8s pull the instance
+    # from rotation during a rolling restart.  Off (default) keeps
+    # the PR 9 annotation-only posture — the survivors serve every
+    # shard, so readiness is honest either way; this flag is for LBs
+    # that should route around the roll.
+    fail_readyz: bool = False
+
+
+@dataclass
+class SessionsConfig:
+    """Session-aware serving (services.viewport + the admission token
+    buckets): model the CLIENT, not just the request.  The session
+    identity is the one the stack already resolves —
+    ``ctx.omero_session_key`` from the session store middleware, the
+    same key the fleet single-flight folds (PR 8) — never a second
+    resolution path.  See deploy/DEPLOY.md "Sessions & QoS"."""
+
+    enabled: bool = False
+    # Per-session admission token bucket: refill rate (requests/s of
+    # steady budget) and burst (the pan-flurry allowance).  An
+    # interactive tile draws 1 token; bulk/projection work draws
+    # ``qos.bulk-cost``.  Over-budget requests shed 503 + Retry-After
+    # with the "fairness" reason BEFORE global admission tightens.
+    bucket_refill_per_s: float = 20.0
+    bucket_burst: float = 40.0
+    # Bounded LRU over live sessions (buckets AND viewport states);
+    # an evicted session restarts with a full burst.
+    max_tracked: int = 4096
+    # Viewport predictor depth: how many pan steps ahead the
+    # trajectory extrapolates (services.viewport -> prefetch).
+    prefetch_lookahead: int = 2
+
+
+@dataclass
+class QosConfig:
+    """Tiered QoS: interactive tile vs bulk export/projection
+    (classified by ``pressure.is_bulk`` — the ONE classification the
+    brownout ladder and the fleet pin already share).  With it on, the
+    fleet router dequeues through a weighted two-class queue so
+    interactive work jumps bulk backlogs, and bulk requests draw
+    ``bulk-cost`` session tokens each."""
+
+    enabled: bool = False
+    # Weighted dequeue: up to this many interactive units pop for
+    # every bulk unit while both classes wait (bulk never starves —
+    # after the quota one bulk unit always pops).
+    interactive_weight: int = 4
+    # Session-bucket token cost of one bulk/projection request.
+    bulk_cost: float = 4.0
 
 
 @dataclass
@@ -507,6 +563,8 @@ class AppConfig:
     wire: WireConfig = field(default_factory=WireConfig)
     persistence: PersistenceConfig = field(
         default_factory=PersistenceConfig)
+    sessions: SessionsConfig = field(default_factory=SessionsConfig)
+    qos: QosConfig = field(default_factory=QosConfig)
     pressure: PressureConfig = field(default_factory=PressureConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     drain: DrainConfig = field(default_factory=DrainConfig)
@@ -764,6 +822,43 @@ class AppConfig:
                              "be >= 1")
         if cfg.persistence.snapshot_top_k < 1:
             raise ValueError("persistence.snapshot-top-k must be >= 1")
+        se = raw.get("sessions", {}) or {}
+        se_defaults = SessionsConfig()
+        cfg.sessions = SessionsConfig(
+            enabled=bool(se.get("enabled", se_defaults.enabled)),
+            bucket_refill_per_s=float(se.get(
+                "bucket-refill-per-s",
+                se_defaults.bucket_refill_per_s)),
+            bucket_burst=float(se.get("bucket-burst",
+                                      se_defaults.bucket_burst)),
+            max_tracked=int(se.get("max-tracked",
+                                   se_defaults.max_tracked)),
+            prefetch_lookahead=int(se.get(
+                "prefetch-lookahead", se_defaults.prefetch_lookahead)),
+        )
+        if cfg.sessions.bucket_refill_per_s <= 0:
+            raise ValueError("sessions.bucket-refill-per-s must be "
+                             "> 0")
+        if cfg.sessions.bucket_burst < 1:
+            raise ValueError("sessions.bucket-burst must be >= 1")
+        if cfg.sessions.max_tracked < 1:
+            raise ValueError("sessions.max-tracked must be >= 1")
+        if cfg.sessions.prefetch_lookahead < 1:
+            raise ValueError("sessions.prefetch-lookahead must be "
+                             ">= 1")
+        qo = raw.get("qos", {}) or {}
+        qo_defaults = QosConfig()
+        cfg.qos = QosConfig(
+            enabled=bool(qo.get("enabled", qo_defaults.enabled)),
+            interactive_weight=int(qo.get(
+                "interactive-weight", qo_defaults.interactive_weight)),
+            bulk_cost=float(qo.get("bulk-cost",
+                                   qo_defaults.bulk_cost)),
+        )
+        if cfg.qos.interactive_weight < 1:
+            raise ValueError("qos.interactive-weight must be >= 1")
+        if cfg.qos.bulk_cost < 1:
+            raise ValueError("qos.bulk-cost must be >= 1")
         pr = raw.get("pressure", {}) or {}
         pr_defaults = PressureConfig()
         cfg.pressure = PressureConfig(
@@ -801,6 +896,12 @@ class AppConfig:
             lane_cap=int(pr.get("lane-cap", pr_defaults.lane_cap)),
             admission_scale=float(pr.get(
                 "admission-scale", pr_defaults.admission_scale)),
+            prefetch_budget_elevated=float(pr.get(
+                "prefetch-budget-elevated",
+                pr_defaults.prefetch_budget_elevated)),
+            prefetch_budget_critical=float(pr.get(
+                "prefetch-budget-critical",
+                pr_defaults.prefetch_budget_critical)),
         )
         if cfg.pressure.interval_s <= 0:
             raise ValueError("pressure.interval-s must be > 0")
@@ -854,6 +955,14 @@ class AppConfig:
         if not 0.0 < cfg.pressure.admission_scale <= 1.0:
             raise ValueError("pressure.admission-scale must be in "
                              "(0, 1]")
+        if not (0.0 < cfg.pressure.prefetch_budget_critical
+                <= cfg.pressure.prefetch_budget_elevated <= 1.0):
+            # Monotone by construction: more pressure can never mean
+            # MORE speculative staging.
+            raise ValueError(
+                "pressure prefetch budgets must satisfy 0 < "
+                "prefetch-budget-critical <= "
+                "prefetch-budget-elevated <= 1")
         wd = raw.get("watchdog", {}) or {}
         wd_defaults = WatchdogConfig()
         cfg.watchdog = WatchdogConfig(
@@ -891,6 +1000,8 @@ class AppConfig:
                 dr_defaults.prestage_max_planes)),
             settle_timeout_s=float(dr.get(
                 "settle-timeout-s", dr_defaults.settle_timeout_s)),
+            fail_readyz=bool(dr.get("fail-readyz",
+                                    dr_defaults.fail_readyz)),
         )
         if cfg.drain.prestage_max_planes < 1:
             raise ValueError("drain.prestage-max-planes must be >= 1")

@@ -48,9 +48,10 @@ DEFAULT_MAX_TILE_LENGTH = 2048  # beanRefContext.xml:63-66
 _STAGE_BAND_ROWS = 256
 
 
-from .errors import NotFoundError  # noqa: E402,F401  (re-export; the
-# exception lives in the device-free errors module so frontend proxy
-# processes can share the status contract without importing JAX)
+from .errors import (NotFoundError,  # noqa: E402,F401  (re-export;
+                     OverloadedError)
+# The exceptions live in the device-free errors module so frontend
+# proxy processes can share the status contract without importing JAX.
 
 # Projection banding: planes whose u16 storage exceeds the threshold
 # project via row bands (project_region_banded) so peak host memory is
@@ -350,14 +351,23 @@ class ImageRegionHandler:
             raise NotFoundError(f"Cannot find Image:{ctx.image_id}")
 
         single_flight = self.s.single_flight
+        admission = self.s.admission
+        # Per-session fairness runs PER CALLER, before coalescing —
+        # like the ACL gate above: single-flight shares the leader's
+        # outcome across SESSIONS, so a hostile session's over-budget
+        # 503 inside the producer would propagate to coalesced
+        # followers from under-budget sessions.  Here every request
+        # pays its own token (ctx.omero_session_key — the one session
+        # identity the middleware resolved) and sheds only itself.
+        debit = admission.admit_session(ctx) if admission is not None \
+            else None
 
         async def produce() -> bytes:
-            # Admission control sits HERE — after the byte cache (hits
+            # GLOBAL admission sits HERE — after the byte cache (hits
             # are nearly free and must never shed) and inside the
             # single-flight producer (a coalesced follower adds no
             # work, so only the leader's pipeline run claims a slot).
             _shed_bulk_under_pressure(ctx)
-            admission = self.s.admission
             t_admit = admission.admit() if admission is not None \
                 else None
             completed = False
@@ -375,30 +385,40 @@ class ImageRegionHandler:
                                                      data)
             return data
 
-        if single_flight is None:
-            # Deadline-bounded await even without coalescing: a group
-            # popped before its members' budgets died can still wedge
-            # in the device thread, and the caller must get its 504 at
-            # budget end, not hang behind the lane (the device work
-            # itself cannot be interrupted; its future settles into
-            # the void).
-            from ..utils import transient
-            remaining = transient.remaining_ms()
-            if remaining is None:
-                return await produce()
-            try:
-                return await asyncio.wait_for(
-                    produce(), timeout=max(0.0, remaining) / 1000.0)
-            except asyncio.TimeoutError:
-                raise transient.DeadlineExceededError(
-                    "deadline exceeded awaiting render")
-        # Coalesce concurrent identical requests onto one pipeline run:
-        # the leader renders and writes the byte cache back; followers
-        # settle from the same task.  ACL already ran per caller above,
-        # so sharing the bytes is exactly as safe as the byte-cache hit
-        # path.
-        data, coalesced = await single_flight.run(
-            render_identity_key(ctx), produce)
+        try:
+            if single_flight is None:
+                # Deadline-bounded await even without coalescing: a
+                # group popped before its members' budgets died can
+                # still wedge in the device thread, and the caller
+                # must get its 504 at budget end, not hang behind the
+                # lane (the device work itself cannot be interrupted;
+                # its future settles into the void).
+                from ..utils import transient
+                remaining = transient.remaining_ms()
+                if remaining is None:
+                    return await produce()
+                try:
+                    return await asyncio.wait_for(
+                        produce(),
+                        timeout=max(0.0, remaining) / 1000.0)
+                except asyncio.TimeoutError:
+                    raise transient.DeadlineExceededError(
+                        "deadline exceeded awaiting render")
+            # Coalesce concurrent identical requests onto one pipeline
+            # run: the leader renders and writes the byte cache back;
+            # followers settle from the same task.  ACL and fairness
+            # already ran per caller above, so sharing the bytes is
+            # exactly as safe as the byte-cache hit path.
+            data, coalesced = await single_flight.run(
+                render_identity_key(ctx), produce)
+        except OverloadedError:
+            # Refused GLOBALLY (queue/deadline/pressure — directly or
+            # via the leader this caller coalesced onto) after the
+            # fairness gate debited tokens: refund them — the session
+            # never got the render.
+            if admission is not None:
+                admission.refund_session(debit)
+            raise
         if coalesced:
             # Waterfall marker for the follower: its wall time was one
             # await on the leader's pipeline, not a pipeline of its own.
@@ -508,9 +528,16 @@ class ImageRegionHandler:
         if ctx.projection is not None:
             raw, region = await self._project(ctx, pixels, src, active)
         else:
-            cached = (None if tiny else
-                      self._cached_region(ctx, region, level or 0,
-                                          active))
+            cached = None
+            if not tiny and self.s.raw_cache is not None:
+                key = self._region_key(ctx, region, level or 0, active)
+                cached = self.s.raw_cache.get(key)
+                if cached is not None and self.s.prefetcher is not None:
+                    # Predictive-hit accounting: if the prefetcher
+                    # staged this plane, the pan/zoom step just paid
+                    # render + encode only — the number the sessions
+                    # bench gates on.
+                    self.s.prefetcher.note_hit(key)
             if cached is not None:
                 # HBM raw-cache hit: a dict lookup — skip the
                 # thread-pool hop (same economics as the open-source
@@ -529,7 +556,8 @@ class ImageRegionHandler:
                     src, ctx.image_id, ctx.z, ctx.t, ctx.resolution,
                     levels, ctx.tile, src.tile_size(),
                     self.s.max_tile_length, active,
-                    ctx.flip_horizontal, ctx.flip_vertical)
+                    ctx.flip_horizontal, ctx.flip_vertical,
+                    session_key=ctx.omero_session_key)
 
         if tiny:
             return await asyncio.to_thread(
@@ -599,15 +627,6 @@ class ImageRegionHandler:
         from ..io.devicecache import region_key
         return region_key(ctx.image_id, ctx.z, ctx.t, level,
                           region.as_tuple(), tuple(active))
-
-    def _cached_region(self, ctx: ImageRegionCtx, region: RegionDef,
-                       level: int, active: List[int]):
-        """HBM raw-cache probe for the read's identity; None = miss
-        (which includes caches that are disabled)."""
-        if self.s.raw_cache is None:
-            return None
-        return self.s.raw_cache.get(
-            self._region_key(ctx, region, level, active))
 
     def _read_region(self, src, ctx: ImageRegionCtx, region: RegionDef,
                      level: int, active: List[int],

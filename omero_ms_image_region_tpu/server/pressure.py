@@ -98,6 +98,65 @@ def read_rss_mb() -> Optional[float]:
     return None
 
 
+# cgroup v2 exposes the memory limit at memory.max ("max" = unlimited);
+# v1 at memory/memory.limit_in_bytes (an absurdly large number =
+# unlimited — kernels report PAGE_COUNTER_MAX there).
+_CGROUP_V2_LIMIT = "/sys/fs/cgroup/memory.max"
+_CGROUP_V1_LIMIT = "/sys/fs/cgroup/memory/memory.limit_in_bytes"
+_CGROUP_UNLIMITED_BYTES = 1 << 60
+
+
+def read_cgroup_memory_limit_mb(
+        v2_path: str = _CGROUP_V2_LIMIT,
+        v1_path: str = _CGROUP_V1_LIMIT) -> Optional[float]:
+    """The container's memory limit in MB from the cgroup filesystem
+    (v2 first, v1 fallback); None when unlimited or not in a cgroup."""
+    for path in (v2_path, v1_path):
+        try:
+            with open(path) as f:
+                raw = f.read().strip()
+        except OSError:
+            continue
+        if raw == "max":
+            return None
+        try:
+            limit = int(raw)
+        except ValueError:
+            continue
+        if limit <= 0 or limit >= _CGROUP_UNLIMITED_BYTES:
+            return None
+        return limit / (1024.0 * 1024.0)
+    return None
+
+
+# Auto-wired host-RSS watermarks as fractions of the cgroup limit:
+# enter elevated at 80% (the JVM-recycle class of bloat the reference
+# survives behind nginx — PAPER.md L0 — caught BEFORE the OOM killer),
+# release below 65%.
+_RSS_HIGH_FRAC = 0.80
+_RSS_LOW_FRAC = 0.65
+
+
+def apply_cgroup_rss_defaults(config,
+                              limit_mb: Optional[float] = None):
+    """Default the host-RSS watermarks from the cgroup memory limit
+    when the operator left them unset (``host-rss-high-mb: 0``).  The
+    explicit knob always wins; with no cgroup limit the signal simply
+    stays disabled, as before.  Returns the config for chaining."""
+    if config.host_rss_high_mb > 0:
+        return config            # explicit override: never touched
+    limit = limit_mb if limit_mb is not None \
+        else read_cgroup_memory_limit_mb()
+    if limit is None or limit <= 0:
+        return config
+    config.host_rss_high_mb = round(limit * _RSS_HIGH_FRAC, 1)
+    config.host_rss_low_mb = round(limit * _RSS_LOW_FRAC, 1)
+    log.info("pressure: host-RSS watermarks defaulted from the cgroup "
+             "limit (%.0f MB): high %.0f / low %.0f",
+             limit, config.host_rss_high_mb, config.host_rss_low_mb)
+    return config
+
+
 @dataclass
 class StepActuator:
     """What a ladder step DOES.  ``engage``/``release`` fire on the
@@ -142,7 +201,13 @@ class PressureGovernor:
         # Set by the async runner (actual vs expected tick interval);
         # read back as the loop_lag_ms signal.
         self.loop_lag_ms = 0.0
+        # Last published prefetch budget (change detection for the
+        # flight event + gauge — the budget is a pure function of
+        # level/ladder state, so publishing on transitions only keeps
+        # the tape quiet).
+        self._last_prefetch_budget = 1.0
         telemetry.PRESSURE.declare_steps(self.ladder)
+        telemetry.PREFETCH.set_budget(1.0)
 
     # ---------------------------------------------------------- signals
 
@@ -263,6 +328,20 @@ class PressureGovernor:
             actuator = self.actuators.get(self.ladder[i])
             if actuator is not None and actuator.while_engaged:
                 self._run_hook(self.ladder[i], actuator.while_engaged)
+        # Publish the continuous prefetch budget on transitions: the
+        # budget scales DOWN with the level before the binary
+        # ``pause_prefetch`` step ever engages, and restores in exact
+        # reverse on release (the pause/release pair is just the
+        # budget's floor).
+        budget = self.prefetch_budget()
+        if budget != self._last_prefetch_budget:
+            telemetry.PREFETCH.set_budget(budget)
+            telemetry.FLIGHT.record(
+                "prefetch.budget", scale=budget,
+                prev=self._last_prefetch_budget,
+                level=LEVEL_NAMES[level],
+                paused=self.step_engaged("pause_prefetch"))
+            self._last_prefetch_budget = budget
         return level
 
     # ------------------------------------------------- consumer queries
@@ -292,6 +371,34 @@ class PressureGovernor:
 
     def bulk_shed_active(self) -> bool:
         return self.step_engaged("shed_bulk")
+
+    def prefetch_budget(self) -> float:
+        """The continuous prefetch budget scale in [0, 1]: a pure
+        function of the folded level and the ``pause_prefetch`` ladder
+        state, so it is symmetric by construction — whatever path the
+        level took down, the identical path back up restores the
+        identical budgets in reverse.
+
+        * ok        -> 1.0
+        * elevated  -> ``prefetch-budget-elevated`` (default 0.5)
+        * critical  -> ``prefetch-budget-critical`` (default 0.25)
+        * ``pause_prefetch`` engaged -> 0.0 (the ladder's binary pause
+          is now the budget's floor, not a separate mechanism)
+
+        Consumers (``services.prefetch.TilePrefetcher``) multiply this
+        into their ``max_pending``, so speculative staging shrinks
+        smoothly as the service starts drowning instead of running at
+        full tilt until the ladder slams it off.
+        """
+        if self.step_engaged("pause_prefetch"):
+            return 0.0
+        if self.level >= LEVEL_CRITICAL:
+            return getattr(self.config, "prefetch_budget_critical",
+                           0.25)
+        if self.level >= LEVEL_ELEVATED:
+            return getattr(self.config, "prefetch_budget_elevated",
+                           0.5)
+        return 1.0
 
     def summary(self) -> str:
         """One-line /readyz annotation."""

@@ -1785,14 +1785,221 @@ class DrainStats:
 DRAIN = DrainStats()
 
 
+class SessionStats:
+    """Session-model accounting (``services.viewport`` +
+    ``server.admission.SessionTokenBuckets``): how many distinct
+    sessions the viewport tracker currently models, how many tile
+    observations fed it, and LRU evictions (the bound working).  No
+    per-session labels, ever — sessions are unbounded-cardinality by
+    definition, so only aggregates reach the exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tracked = 0
+        self.observations = 0
+        self.evicted = 0
+
+    def set_tracked(self, n: int) -> None:
+        with self._lock:
+            self.tracked = int(n)
+
+    def count_observation(self) -> None:
+        with self._lock:
+            self.observations += 1
+
+    def count_evicted(self) -> None:
+        with self._lock:
+            self.evicted += 1
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+        lb = ("{" + extra + "}") if extra else ""
+        with self._lock:
+            return [
+                f"imageregion_session_tracked{lb} {self.tracked}",
+                f"imageregion_session_observations_total{lb} "
+                f"{self.observations}",
+                f"imageregion_session_evictions_total{lb} "
+                f"{self.evicted}",
+            ]
+
+    def reset(self) -> None:
+        with self._lock:
+            self.tracked = 0
+            self.observations = 0
+            self.evicted = 0
+
+
+SESSIONS = SessionStats()
+
+
+class PrefetchStats:
+    """Predictive-prefetch accounting (``services.prefetch``):
+    predictions made, loads scheduled/staged, foreground hits on
+    prefetched planes, skips by reason, and the live budget scale.
+    The ``reason`` label set is closed — this module's own vocabulary
+    (budget, paused), never caller-minted."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.predicted = 0
+        self.scheduled = 0
+        self.staged = 0
+        self.hits = 0
+        self.skipped: Dict[str, int] = {}
+        self.budget_scale = 1.0
+
+    def count_predicted(self, n: int = 1) -> None:
+        with self._lock:
+            self.predicted += n
+
+    def count_scheduled(self) -> None:
+        with self._lock:
+            self.scheduled += 1
+
+    def count_staged(self) -> None:
+        with self._lock:
+            self.staged += 1
+
+    def count_hit(self) -> None:
+        with self._lock:
+            self.hits += 1
+
+    def count_skipped(self, reason: str) -> None:
+        with self._lock:
+            self.skipped[reason] = self.skipped.get(reason, 0) + 1
+
+    def set_budget(self, scale: float) -> None:
+        with self._lock:
+            self.budget_scale = float(scale)
+
+    def hit_rate(self) -> Optional[float]:
+        with self._lock:
+            if not self.staged:
+                return None
+            return self.hits / self.staged
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            lines = [
+                f"imageregion_prefetch_predicted_total{label()} "
+                f"{self.predicted}",
+                f"imageregion_prefetch_scheduled_total{label()} "
+                f"{self.scheduled}",
+                f"imageregion_prefetch_staged_total{label()} "
+                f"{self.staged}",
+                f"imageregion_prefetch_hits_total{label()} "
+                f"{self.hits}",
+                f"imageregion_prefetch_budget_scale{label()} "
+                f"{_fmt(self.budget_scale)}",
+            ]
+            for reason in sorted(self.skipped):
+                body = 'reason="%s"' % reason
+                lines.append(
+                    f"imageregion_prefetch_skipped_total{label(body)} "
+                    f"{self.skipped[reason]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.predicted = 0
+            self.scheduled = 0
+            self.staged = 0
+            self.hits = 0
+            self.skipped.clear()
+            self.budget_scale = 1.0
+
+
+PREFETCH = PrefetchStats()
+
+
+class QosStats:
+    """Tiered-QoS accounting (``server.admission`` fairness sheds +
+    the fleet router's weighted dequeue): sheds and dequeues by QoS
+    class, and how often interactive work jumped a bulk backlog.  The
+    ``class`` label is closed by construction — the two-value
+    interactive/bulk vocabulary of ``pressure.is_bulk``."""
+
+    CLASSES = ("interactive", "bulk")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.shed: Dict[str, int] = {}
+        self.dequeued: Dict[str, int] = {}
+        self.jumps = 0
+
+    def count_shed(self, cls: str) -> None:
+        with self._lock:
+            self.shed[cls] = self.shed.get(cls, 0) + 1
+
+    def count_dequeued(self, cls: str) -> None:
+        with self._lock:
+            self.dequeued[cls] = self.dequeued.get(cls, 0) + 1
+
+    def count_jump(self) -> None:
+        with self._lock:
+            self.jumps += 1
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            lines = [
+                f"imageregion_qos_interactive_jumps_total{label()} "
+                f"{self.jumps}",
+            ]
+            for cls in sorted(self.shed):
+                body = 'class="%s"' % cls
+                lines.append(
+                    f"imageregion_qos_shed_total{label(body)} "
+                    f"{self.shed[cls]}")
+            for cls in sorted(self.dequeued):
+                body = 'class="%s"' % cls
+                lines.append(
+                    f"imageregion_qos_dequeued_total{label(body)} "
+                    f"{self.dequeued[cls]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.shed.clear()
+            self.dequeued.clear()
+            self.jumps = 0
+
+
+QOS = QosStats()
+
+
+def session_metric_lines(extra_labels: str = "") -> List[str]:
+    """The session-serving families — ``imageregion_session_*``,
+    ``imageregion_prefetch_*``, ``imageregion_qos_*``."""
+    return (SESSIONS.metric_lines(extra_labels)
+            + PREFETCH.metric_lines(extra_labels)
+            + QOS.metric_lines(extra_labels))
+
+
 def robustness_metric_lines(extra_labels: str = "") -> List[str]:
     """The self-preservation families — ``imageregion_pressure_*``,
-    ``imageregion_watchdog_*``, ``imageregion_drain_*`` — emitted from
+    ``imageregion_watchdog_*``, ``imageregion_drain_*`` — plus the
+    session-serving families (``imageregion_session_*`` /
+    ``imageregion_prefetch_*`` / ``imageregion_qos_*``) — emitted from
     BOTH roles (the governor/watchdog run wherever they are wired;
-    drains live with the fleet router)."""
+    drains live with the fleet router; sessions/QoS at the admission
+    edge)."""
     return (PRESSURE.metric_lines(extra_labels)
             + WATCHDOG.metric_lines(extra_labels)
-            + DRAIN.metric_lines(extra_labels))
+            + DRAIN.metric_lines(extra_labels)
+            + session_metric_lines(extra_labels))
 
 
 def fleet_metric_lines(router=None, extra_labels: str = "",
@@ -1998,6 +2205,20 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_drain_transitions_total": "counter",
     "imageregion_drain_prestaged_planes_total": "counter",
     "imageregion_drains_total": "counter",
+    # Session-aware serving (services.viewport / services.prefetch /
+    # server.admission token buckets / fleet QoS dequeue).
+    "imageregion_session_tracked": "gauge",
+    "imageregion_session_observations_total": "counter",
+    "imageregion_session_evictions_total": "counter",
+    "imageregion_prefetch_predicted_total": "counter",
+    "imageregion_prefetch_scheduled_total": "counter",
+    "imageregion_prefetch_staged_total": "counter",
+    "imageregion_prefetch_hits_total": "counter",
+    "imageregion_prefetch_skipped_total": "counter",
+    "imageregion_prefetch_budget_scale": "gauge",
+    "imageregion_qos_shed_total": "counter",
+    "imageregion_qos_dequeued_total": "counter",
+    "imageregion_qos_interactive_jumps_total": "counter",
 }
 
 # Terse HELP strings for the families whose meaning is not obvious
@@ -2065,6 +2286,26 @@ METRIC_HELP: Dict[str, str] = {
         "Fleet-member drain state (0 active, 1 draining, 2 drained)",
     "imageregion_drain_prestaged_planes_total":
         "Handoff planes pre-staged WARM onto ring successors by drains",
+    "imageregion_session_tracked":
+        "Distinct sessions currently modeled by the viewport tracker",
+    "imageregion_session_evictions_total":
+        "Session states evicted by the viewport tracker's LRU bound",
+    "imageregion_prefetch_predicted_total":
+        "Tiles predicted from session pan/zoom trajectories",
+    "imageregion_prefetch_staged_total":
+        "Predicted planes actually staged into an HBM tier",
+    "imageregion_prefetch_hits_total":
+        "Foreground requests that found their plane prefetched",
+    "imageregion_prefetch_skipped_total":
+        "Prefetch candidates skipped (budget exhausted or paused)",
+    "imageregion_prefetch_budget_scale":
+        "Live prefetch budget scale (1 full, 0 paused by the ladder)",
+    "imageregion_qos_shed_total":
+        "Per-session fairness sheds by QoS class (503 + Retry-After)",
+    "imageregion_qos_dequeued_total":
+        "Fleet-router dequeues by QoS class (weighted two-class queue)",
+    "imageregion_qos_interactive_jumps_total":
+        "Interactive dequeues that jumped a waiting bulk backlog",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -2292,3 +2533,6 @@ def reset() -> None:
     PRESSURE.reset()
     WATCHDOG.reset()
     DRAIN.reset()
+    SESSIONS.reset()
+    PREFETCH.reset()
+    QOS.reset()

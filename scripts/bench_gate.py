@@ -53,8 +53,15 @@ DEFAULT_KEYS = ("service_tiles_per_sec", "p50_service_tile_ms_ex_rtt",
 # only said `ok: true` — skip on null instead of failing.
 MULTICHIP_KEYS = ("fleet_tiles_per_sec_m8", "fleet_tiles_per_sec_m4",
                   "fleet_scaling_efficiency")
+# --sessions: judge SESSIONS_r*.json records (bench.py --smoke
+# --sessions) on the multi-user serving keys.  Direction-aware by
+# name: the per-session p99 is a ``_ms`` key (regresses UP), the
+# fairness index and predictive hit rate regress DOWN.
+SESSIONS_KEYS = ("sessions_interactive_p99_ms",
+                 "sessions_fairness_index", "prefetch_hit_rate")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _MULTICHIP_RE = re.compile(r"^MULTICHIP_r(\d+)\.json$")
+_SESSIONS_RE = re.compile(r"^SESSIONS_r(\d+)\.json$")
 
 
 def lower_is_better(key: str) -> bool:
@@ -223,6 +230,13 @@ def main(argv=None) -> int:
                              "the widest member counts + "
                              "fleet_scaling_efficiency); rounds that "
                              "predate the curve skip on null")
+    parser.add_argument("--sessions", action="store_true",
+                        help="judge SESSIONS_r*.json records (bench "
+                             "--smoke --sessions) on the multi-user "
+                             "serving keys: interactive per-session "
+                             "p99 (regresses up), Jain's fairness "
+                             "index and predictive prefetch hit rate "
+                             "(regress down)")
     parser.add_argument("--key", action="append", default=None,
                         help="record key(s) to judge (default "
                              "service_tiles_per_sec, "
@@ -238,9 +252,16 @@ def main(argv=None) -> int:
                              "failures")
     args = parser.parse_args(argv)
 
-    keys = tuple(args.key) if args.key else (
-        MULTICHIP_KEYS if args.multichip else DEFAULT_KEYS)
-    pattern = _MULTICHIP_RE if args.multichip else _BENCH_RE
+    if args.key:
+        keys = tuple(args.key)
+    elif args.multichip:
+        keys = MULTICHIP_KEYS
+    elif args.sessions:
+        keys = SESSIONS_KEYS
+    else:
+        keys = DEFAULT_KEYS
+    pattern = (_MULTICHIP_RE if args.multichip
+               else _SESSIONS_RE if args.sessions else _BENCH_RE)
     try:
         if args.watermark:
             if args.dir:

@@ -96,6 +96,12 @@ _ROBUSTNESS_KINDS = ("pressure.level", "pressure.step",
                      "watchdog.fire", "watchdog.escalate",
                      "drain.phase")
 
+# Session-serving event kinds (per-session fairness sheds, viewport
+# predictions, pressure-scaled prefetch budget moves): marked with
+# ``*`` and rolled into their own footer so a dump answers "who was
+# shed and what did prefetch do" alongside the robustness story.
+_SESSION_KINDS = ("qos.shed", "prefetch.predict", "prefetch.budget")
+
 
 def render_flight(doc) -> str:
     """Flight-recorder dump -> event timeline (newest events last,
@@ -112,6 +118,7 @@ def render_flight(doc) -> str:
         f"  {'t-dump':>9}  event",
     ]
     rob_counts: dict = {}
+    session_counts: dict = {}
     for e in events:
         kind = e.get("kind", "?")
         extra = {k: v for k, v in e.items() if k not in ("ts", "kind")}
@@ -119,7 +126,8 @@ def render_flight(doc) -> str:
                                   sorted(extra.items()))
                   if extra else "")
         offset = float(e.get("ts", t_dump)) - t_dump
-        mark = "!" if kind in _ROBUSTNESS_KINDS else " "
+        mark = ("!" if kind in _ROBUSTNESS_KINDS
+                else "*" if kind in _SESSION_KINDS else " ")
         if kind in _ROBUSTNESS_KINDS:
             label = kind
             if kind == "pressure.step":
@@ -130,11 +138,22 @@ def render_flight(doc) -> str:
             elif kind == "drain.phase":
                 label = f"drain:{e.get('phase', '?')}"
             rob_counts[label] = rob_counts.get(label, 0) + 1
+        elif kind in _SESSION_KINDS:
+            label = kind
+            if kind == "qos.shed":
+                label = f"qos.shed:{e.get('cls', '?')}"
+            elif kind == "prefetch.budget":
+                label = f"prefetch.budget:{e.get('scale', '?')}"
+            session_counts[label] = session_counts.get(label, 0) + 1
         lines.append(f"  {offset:>8.2f}s {mark} {kind}{suffix}")
     if rob_counts:
         pretty = "  ".join(f"{k}={v}" for k, v in
                            sorted(rob_counts.items()))
         lines.append(f"  self-preservation: {pretty}")
+    if session_counts:
+        pretty = "  ".join(f"{k}={v}" for k, v in
+                           sorted(session_counts.items()))
+        lines.append(f"  session-serving: {pretty}")
     return "\n".join(lines)
 
 

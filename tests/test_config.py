@@ -397,17 +397,106 @@ def test_drain_block_parses_and_validates():
     assert cfg.drain.prestage_max_planes == \
         defaults.prestage_max_planes
 
+    # fail-readyz default: off — drains stay annotation-only unless
+    # the operator opts the load balancer in.
+    assert cfg.drain.fail_readyz is False
+
     cfg = AppConfig.from_dict({"drain": {
         "prestage": False, "prestage-max-planes": 64,
-        "settle-timeout-s": 5.0}})
+        "settle-timeout-s": 5.0, "fail-readyz": True}})
     assert cfg.drain.prestage is False
     assert cfg.drain.prestage_max_planes == 64
     assert cfg.drain.settle_timeout_s == 5.0
+    assert cfg.drain.fail_readyz is True
 
     with pytest.raises(ValueError, match="prestage-max-planes"):
         AppConfig.from_dict({"drain": {"prestage-max-planes": 0}})
     with pytest.raises(ValueError, match="settle-timeout-s"):
         AppConfig.from_dict({"drain": {"settle-timeout-s": 0}})
+
+
+def test_sessions_block_parses_and_validates():
+    """The `sessions:` block (viewport model + per-session admission
+    token buckets): example-file defaults, full parse, validation."""
+    from omero_ms_image_region_tpu.server.config import SessionsConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = SessionsConfig()
+    assert cfg.sessions.enabled is False
+    assert cfg.sessions.bucket_refill_per_s == \
+        defaults.bucket_refill_per_s
+    assert cfg.sessions.bucket_burst == defaults.bucket_burst
+    assert cfg.sessions.max_tracked == defaults.max_tracked
+    assert cfg.sessions.prefetch_lookahead == \
+        defaults.prefetch_lookahead
+
+    cfg = AppConfig.from_dict({"sessions": {
+        "enabled": True, "bucket-refill-per-s": 10.0,
+        "bucket-burst": 25.0, "max-tracked": 128,
+        "prefetch-lookahead": 3}})
+    assert cfg.sessions.enabled is True
+    assert cfg.sessions.bucket_refill_per_s == 10.0
+    assert cfg.sessions.bucket_burst == 25.0
+    assert cfg.sessions.max_tracked == 128
+    assert cfg.sessions.prefetch_lookahead == 3
+
+    with pytest.raises(ValueError, match="bucket-refill-per-s"):
+        AppConfig.from_dict({"sessions": {"bucket-refill-per-s": 0}})
+    with pytest.raises(ValueError, match="bucket-burst"):
+        AppConfig.from_dict({"sessions": {"bucket-burst": 0.5}})
+    with pytest.raises(ValueError, match="max-tracked"):
+        AppConfig.from_dict({"sessions": {"max-tracked": 0}})
+    with pytest.raises(ValueError, match="prefetch-lookahead"):
+        AppConfig.from_dict({"sessions": {"prefetch-lookahead": 0}})
+
+
+def test_qos_block_parses_and_validates():
+    """The `qos:` block (weighted two-class dequeue + bulk token
+    cost): example-file defaults, full parse, validation."""
+    from omero_ms_image_region_tpu.server.config import QosConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = QosConfig()
+    assert cfg.qos.enabled is False
+    assert cfg.qos.interactive_weight == defaults.interactive_weight
+    assert cfg.qos.bulk_cost == defaults.bulk_cost
+
+    cfg = AppConfig.from_dict({"qos": {
+        "enabled": True, "interactive-weight": 8, "bulk-cost": 16.0}})
+    assert cfg.qos.enabled is True
+    assert cfg.qos.interactive_weight == 8
+    assert cfg.qos.bulk_cost == 16.0
+
+    with pytest.raises(ValueError, match="interactive-weight"):
+        AppConfig.from_dict({"qos": {"interactive-weight": 0}})
+    with pytest.raises(ValueError, match="bulk-cost"):
+        AppConfig.from_dict({"qos": {"bulk-cost": 0.5}})
+
+
+def test_pressure_prefetch_budget_parses_and_validates():
+    """The continuous prefetch-budget knobs ride the pressure block
+    and must stay monotone: more pressure never means MORE
+    speculative staging."""
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    assert cfg.pressure.prefetch_budget_elevated == 0.5
+    assert cfg.pressure.prefetch_budget_critical == 0.25
+
+    cfg = AppConfig.from_dict({"pressure": {
+        "prefetch-budget-elevated": 0.8,
+        "prefetch-budget-critical": 0.4}})
+    assert cfg.pressure.prefetch_budget_elevated == 0.8
+    assert cfg.pressure.prefetch_budget_critical == 0.4
+
+    with pytest.raises(ValueError, match="prefetch-budget"):
+        AppConfig.from_dict({"pressure": {
+            "prefetch-budget-elevated": 0.3,
+            "prefetch-budget-critical": 0.6}})
+    with pytest.raises(ValueError, match="prefetch-budget"):
+        AppConfig.from_dict({"pressure": {
+            "prefetch-budget-elevated": 1.5}})
+    with pytest.raises(ValueError, match="prefetch-budget"):
+        AppConfig.from_dict({"pressure": {
+            "prefetch-budget-critical": 0.0}})
 
 
 def test_fault_injection_freeze_max_parses():

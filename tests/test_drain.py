@@ -217,6 +217,45 @@ class TestAdminDrainEndpoint:
         app = create_app(config)
         return TestClient(TestServer(app))
 
+    def test_fail_readyz_pulls_a_draining_instance_from_rotation(
+            self, data_dir):
+        """Satellite (PR 9 follow-on): with ``drain.fail-readyz`` on,
+        /readyz answers 503 while any member drains — nginx/k8s pull
+        the instance during a rolling restart — and recovers to 200
+        on undrain.  The default posture stays annotation-only."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.server.app import create_app
+        from omero_ms_image_region_tpu.server.config import FleetConfig
+
+        async def scenario(fail_readyz):
+            config = AppConfig(
+                data_dir=data_dir,
+                batcher=BatcherConfig(enabled=False),
+                raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+                renderer=RendererConfig(cpu_fallback_max_px=0))
+            config.fleet = FleetConfig(enabled=True, members=2)
+            config.drain.fail_readyz = fail_readyz
+            client = TestClient(TestServer(create_app(config)))
+            await client.start_server()
+            try:
+                assert (await client.get("/readyz")).status == 200
+                r = await client.post("/admin/drain?member=m1")
+                assert r.status == 200
+                r = await client.get("/readyz")
+                draining_status = r.status
+                body = await r.json()
+                # The annotation is present in BOTH postures.
+                assert "m1" in body["checks"].get("drain", "")
+                await client.post("/admin/undrain?member=m1")
+                assert (await client.get("/readyz")).status == 200
+                return draining_status
+            finally:
+                await client.close()
+
+        assert asyncio.run(scenario(True)) == 503
+        assert asyncio.run(scenario(False)) == 200
+
     def test_drain_undrain_roundtrip_and_last_member_guard(
             self, data_dir):
         async def scenario():

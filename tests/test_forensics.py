@@ -158,6 +158,33 @@ class TestFlightRecorder:
         assert "watchdog.fire:requeue-group=1" in out
         assert "drain:drained=1" in out
 
+    def test_trace_report_renders_session_serving_events(
+            self, tmp_path):
+        """PR 10's session-serving events (fairness sheds, viewport
+        predictions, prefetch budget moves) are marked on the flight
+        timeline and rolled into their own summary footer."""
+        rec = telemetry.FlightRecorder()
+        rec.record("qos.shed", reason="fairness", cls="bulk",
+                   session="abc123", cost=4.0)
+        rec.record("prefetch.predict", n=2, session="abc123",
+                   x=3, y=1)
+        rec.record("prefetch.budget", scale=0.5, prev=1.0,
+                   level="elevated", paused=False)
+        rec.record("prefetch.budget", scale=0.0, prev=0.5,
+                   level="critical", paused=True)
+        path = rec.dump(str(tmp_path), "incident")
+        with open(path) as f:
+            doc = json.load(f)
+        mod = _load_script("trace_report")
+        out = mod.render_doc(doc)
+        assert "qos.shed" in out and "reason=fairness" in out
+        assert "prefetch.predict" in out
+        assert "prefetch.budget" in out and "scale=0.5" in out
+        assert "session-serving:" in out
+        assert "qos.shed:bulk=1" in out
+        assert "prefetch.budget:0.0=1" in out
+        assert "prefetch.predict=1" in out
+
     def test_same_second_dumps_do_not_collide(self, tmp_path):
         rec = telemetry.FlightRecorder()
         rec.record("e")
@@ -426,6 +453,37 @@ class TestBenchGate:
                           {"x": 5.0, "service_tiles_per_sec": 1.0})
         assert gate.main(["--key", "x", old, new]) == 1
         assert gate.main([old, new]) == 0
+
+    def test_sessions_keys_gated_direction_aware(self, tmp_path,
+                                                 capsys):
+        """--sessions judges SESSIONS_r*.json on the multi-user
+        serving keys, direction-aware by name: the per-session p99
+        regresses UP (a ``_ms`` key), the fairness index and the
+        predictive hit rate regress DOWN."""
+        gate = self._gate()
+        good = {"sessions_interactive_p99_ms": 120.0,
+                "sessions_fairness_index": 0.95,
+                "prefetch_hit_rate": 0.9}
+        self._write(tmp_path, "SESSIONS_r01.json", good)
+        # p99 UP 50% = regression even though the other keys held.
+        self._write(tmp_path, "SESSIONS_r02.json",
+                    {**good, "sessions_interactive_p99_ms": 180.0})
+        assert gate.main(["--sessions", "--dir", str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["sessions_interactive_p99_ms"] == "regression"
+        assert by_key["sessions_fairness_index"] == "pass"
+        # Fairness index DOWN past threshold = regression.
+        self._write(tmp_path, "SESSIONS_r03.json",
+                    {**good, "sessions_fairness_index": 0.7})
+        assert gate.main(["--sessions", "--dir", str(tmp_path)]) == 1
+        # Holding every key passes; records predating the sessions
+        # bench skip on null instead of failing.
+        self._write(tmp_path, "SESSIONS_r04.json", good)
+        self._write(tmp_path, "SESSIONS_r05.json",
+                    {**good, "sessions_interactive_p99_ms": 115.0})
+        assert gate.main(["--sessions", "--dir", str(tmp_path)]) == 0
+        capsys.readouterr()
 
     def test_multichip_fleet_curve_gated(self, tmp_path, capsys):
         """--multichip judges MULTICHIP_r*.json on the fleet scaling
@@ -769,6 +827,17 @@ class TestResetContract:
         telemetry.FLEET.count_routed("m0")
         telemetry.FLEET.count_stolen("m1")
         telemetry.FLEET.count_failed_over("m2")
+        telemetry.SESSIONS.set_tracked(5)
+        telemetry.SESSIONS.count_observation()
+        telemetry.SESSIONS.count_evicted()
+        telemetry.PREFETCH.count_predicted()
+        telemetry.PREFETCH.count_staged()
+        telemetry.PREFETCH.count_hit()
+        telemetry.PREFETCH.count_skipped("budget")
+        telemetry.PREFETCH.set_budget(0.5)
+        telemetry.QOS.count_shed("interactive")
+        telemetry.QOS.count_dequeued("bulk")
+        telemetry.QOS.count_jump()
 
         telemetry.reset()
 
@@ -787,6 +856,18 @@ class TestResetContract:
         assert telemetry.FLEET.totals() == {
             "routed": 0, "stolen": 0, "failed_over": 0}
         assert telemetry.fleet_metric_lines() == []
+        assert telemetry.SESSIONS.tracked == 0
+        assert telemetry.SESSIONS.observations == 0
+        assert telemetry.SESSIONS.evicted == 0
+        assert telemetry.PREFETCH.predicted == 0
+        assert telemetry.PREFETCH.staged == 0
+        assert telemetry.PREFETCH.hits == 0
+        assert telemetry.PREFETCH.skipped == {}
+        assert telemetry.PREFETCH.budget_scale == 1.0
+        assert telemetry.PREFETCH.hit_rate() is None
+        assert telemetry.QOS.shed == {}
+        assert telemetry.QOS.dequeued == {}
+        assert telemetry.QOS.jumps == 0
         assert telemetry.request_metric_lines() == [
             "imageregion_flight_events 0",
             "imageregion_flight_events_total 0",

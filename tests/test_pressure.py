@@ -133,6 +133,170 @@ class TestLadderProperty:
         assert "pressure.step" in kinds
 
 
+class TestPrefetchBudget:
+    """The continuous prefetch budget (PR 10): a pure function of the
+    folded level and the ``pause_prefetch`` ladder state — it scales
+    DOWN with pressure before the binary pause engages, and whatever
+    path the level took down, the identical path back up restores the
+    identical budgets in reverse."""
+
+    def _expected(self, gov, config):
+        if gov.step_engaged("pause_prefetch"):
+            return 0.0
+        if gov.level >= pressure.LEVEL_CRITICAL:
+            return config.prefetch_budget_critical
+        if gov.level >= pressure.LEVEL_ELEVATED:
+            return config.prefetch_budget_elevated
+        return 1.0
+
+    def test_budget_is_a_pure_function_over_any_trajectory(self):
+        rng = random.Random(4321)
+        for trial in range(10):
+            telemetry.reset()
+            gov, value, config = _governor()
+            for tick in range(120):
+                value["queue"] = _LEVEL_VALUES[rng.choice(
+                    (0, 0, 1, 1, 2))]
+                gov.tick()
+                budget = gov.prefetch_budget()
+                assert budget == self._expected(gov, config)
+                # The binary pause is exactly the budget's floor.
+                assert (budget == 0.0) == gov.step_engaged(
+                    "pause_prefetch")
+                # Published gauge follows every transition.
+                assert telemetry.PREFETCH.budget_scale == budget
+
+    def test_budget_scales_down_before_pause_and_releases_reverse(
+            self):
+        """A rising-pressure trajectory (ok -> elevated -> critical)
+        cuts the budget via the LEVEL strictly before the ladder's
+        binary ``pause_prefetch`` floors it at 0; release walks the
+        ladder back in reverse and the budget restores with it."""
+        gov, value, config = _governor()
+        budgets = [gov.prefetch_budget()]
+
+        def tick():
+            gov.tick()
+            budgets.append(gov.prefetch_budget())
+
+        value["queue"] = _LEVEL_VALUES[1]    # elevated: holds lag
+        tick()
+        assert not gov.step_engaged("pause_prefetch")
+        assert gov.prefetch_budget() == \
+            config.prefetch_budget_elevated   # scaled BEFORE pause
+        value["queue"] = _LEVEL_VALUES[2]
+        while not gov.step_engaged("pause_prefetch"):
+            tick()
+        down_path = [b for b, prev in zip(budgets, [None] + budgets)
+                     if b != prev]
+        assert down_path[0] == 1.0
+        assert down_path[-1] == 0.0
+        # The continuous cut came strictly before the binary floor.
+        assert config.prefetch_budget_elevated in down_path[1:-1]
+        # Release: the ladder lifts pause (reverse order: it released
+        # LAST of the engaged steps) and the budget restores fully.
+        value["queue"] = 0.0
+        while gov.engaged != 0 or gov.level != pressure.LEVEL_OK:
+            tick()
+        assert not gov.step_engaged("pause_prefetch")
+        assert budgets[-1] == 1.0
+        # Budget-zero spans exactly the pause engagement: once the
+        # release walk lifted it, the budget never read 0 again.
+        lifted = len(budgets) - 1 - budgets[::-1].index(0.0)
+        assert all(b == 1.0 for b in budgets[lifted + 1:])
+
+    def test_elevated_level_halves_before_critical_quarters(self):
+        gov, value, config = _governor()
+        value["queue"] = _LEVEL_VALUES[1]
+        gov.tick()
+        assert gov.prefetch_budget() == \
+            config.prefetch_budget_elevated == 0.5
+        value["queue"] = _LEVEL_VALUES[2]
+        gov.tick()
+        # Critical level quarters even while pause is not yet engaged
+        # (step holds lag the level).
+        if not gov.step_engaged("pause_prefetch"):
+            assert gov.prefetch_budget() == \
+                config.prefetch_budget_critical == 0.25
+
+    def test_budget_transitions_ride_the_flight_recorder(self):
+        gov, value, _ = _governor()
+        value["queue"] = _LEVEL_VALUES[1]
+        gov.tick()                           # elevated, pause lags
+        events = [e for e in telemetry.FLIGHT.snapshot()
+                  if e["kind"] == "prefetch.budget"]
+        assert events and events[-1]["scale"] == 0.5
+        assert events[-1]["prev"] == 1.0
+        assert events[-1]["paused"] is False
+        value["queue"] = _LEVEL_VALUES[2]
+        while not gov.step_engaged("pause_prefetch"):
+            gov.tick()
+        events = [e for e in telemetry.FLIGHT.snapshot()
+                  if e["kind"] == "prefetch.budget"]
+        assert events[-1]["scale"] == 0.0
+        assert events[-1]["paused"] is True
+
+    def test_budget_config_validation_is_monotone(self):
+        with pytest.raises(ValueError):
+            AppConfig.from_dict({"pressure": {
+                "enabled": True,
+                "prefetch-budget-elevated": 0.2,
+                "prefetch-budget-critical": 0.6}})
+
+
+class TestCgroupRssDefaults:
+    """Satellite: host-RSS watermarks default from the cgroup memory
+    limit (v2 ``memory.max``, v1 fallback) when the knob is unset —
+    the explicit knob always wins."""
+
+    def test_v2_limit_parses_to_mb(self, tmp_path):
+        v2 = tmp_path / "memory.max"
+        v2.write_text("1073741824\n")
+        assert pressure.read_cgroup_memory_limit_mb(
+            v2_path=str(v2), v1_path=str(tmp_path / "nope")) == 1024.0
+
+    def test_v2_max_means_unlimited(self, tmp_path):
+        v2 = tmp_path / "memory.max"
+        v2.write_text("max\n")
+        assert pressure.read_cgroup_memory_limit_mb(
+            v2_path=str(v2), v1_path=str(tmp_path / "nope")) is None
+
+    def test_v1_fallback_and_absurd_limit_means_unlimited(
+            self, tmp_path):
+        v1 = tmp_path / "memory.limit_in_bytes"
+        v1.write_text("536870912\n")
+        assert pressure.read_cgroup_memory_limit_mb(
+            v2_path=str(tmp_path / "nope"), v1_path=str(v1)) == 512.0
+        v1.write_text(str(1 << 62))          # PAGE_COUNTER_MAX class
+        assert pressure.read_cgroup_memory_limit_mb(
+            v2_path=str(tmp_path / "nope"), v1_path=str(v1)) is None
+
+    def test_not_in_a_cgroup_means_none(self, tmp_path):
+        assert pressure.read_cgroup_memory_limit_mb(
+            v2_path=str(tmp_path / "a"),
+            v1_path=str(tmp_path / "b")) is None
+
+    def test_defaults_applied_only_when_knob_unset(self):
+        config = AppConfig().pressure
+        assert config.host_rss_high_mb == 0     # unset by default
+        pressure.apply_cgroup_rss_defaults(config, limit_mb=1000.0)
+        assert config.host_rss_high_mb == 800.0
+        assert config.host_rss_low_mb == 650.0
+
+    def test_explicit_knob_always_wins(self):
+        config = AppConfig.from_dict({"pressure": {
+            "enabled": True, "host-rss-high-mb": 300,
+            "host-rss-low-mb": 200}}).pressure
+        pressure.apply_cgroup_rss_defaults(config, limit_mb=1000.0)
+        assert config.host_rss_high_mb == 300
+        assert config.host_rss_low_mb == 200
+
+    def test_no_limit_leaves_the_signal_disabled(self):
+        config = AppConfig().pressure
+        pressure.apply_cgroup_rss_defaults(config, limit_mb=None)
+        assert config.host_rss_high_mb == 0
+
+
 class TestActuators:
     def test_actuator_hooks_fire_on_engage_and_release(self):
         calls = []

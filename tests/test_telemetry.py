@@ -461,6 +461,12 @@ _ALLOWED_LABEL_KEYS = frozenset({
     # config-validated ladder, actions from the watchdog/ladder
     # vocabulary).
     "signal", "step", "action",
+    # Session-aware serving (PR 10): the QoS class label is the
+    # two-value interactive/bulk vocabulary of ``pressure.is_bulk``;
+    # prefetch skip reasons are the prefetcher's own fixed set.
+    # Sessions themselves NEVER label a series (unbounded
+    # cardinality) — only aggregates reach the exposition.
+    "class",
 })
 
 
@@ -570,6 +576,45 @@ class TestExpositionLint:
                 '{action="requeue-group"} 1') in text
         assert 'imageregion_drain_state{member="m1"} 1' in text
         assert "imageregion_drain_prestaged_planes_total 7" in text
+
+    def test_session_families_lint_with_labels(self):
+        """The session-serving families (imageregion_session_* /
+        imageregion_prefetch_* / imageregion_qos_*) emit under the
+        closed class/reason label keys, ride the robustness exposition
+        from both roles, and the whole thing still lints."""
+        telemetry.SESSIONS.set_tracked(3)
+        telemetry.SESSIONS.count_observation()
+        telemetry.SESSIONS.count_evicted()
+        telemetry.PREFETCH.count_predicted(2)
+        telemetry.PREFETCH.count_scheduled()
+        telemetry.PREFETCH.count_staged()
+        telemetry.PREFETCH.count_hit()
+        telemetry.PREFETCH.count_skipped("budget")
+        telemetry.PREFETCH.count_skipped("paused")
+        telemetry.PREFETCH.set_budget(0.25)
+        telemetry.QOS.count_shed("interactive")
+        telemetry.QOS.count_shed("bulk")
+        telemetry.QOS.count_dequeued("interactive")
+        telemetry.QOS.count_jump()
+        text = telemetry.finalize_exposition(
+            telemetry.robustness_metric_lines())
+        _lint_exposition(text)
+        assert "imageregion_session_tracked 3" in text
+        assert "imageregion_session_observations_total 1" in text
+        assert "imageregion_session_evictions_total 1" in text
+        assert "imageregion_prefetch_predicted_total 2" in text
+        assert "imageregion_prefetch_hits_total 1" in text
+        assert "imageregion_prefetch_budget_scale 0.25" in text
+        assert ('imageregion_prefetch_skipped_total{reason="budget"}'
+                ' 1') in text
+        assert ('imageregion_prefetch_skipped_total{reason="paused"}'
+                ' 1') in text
+        assert 'imageregion_qos_shed_total{class="bulk"} 1' in text
+        assert ('imageregion_qos_shed_total{class="interactive"} 1'
+                ) in text
+        assert ('imageregion_qos_dequeued_total'
+                '{class="interactive"} 1') in text
+        assert "imageregion_qos_interactive_jumps_total 1" in text
 
     def test_fleet_app_metrics_parse(self, data_dir):
         """A combined-role fleet app exposes the imageregion_fleet_*

@@ -1917,6 +1917,214 @@ async def _restart_run(mkconfig, url, working_set: int):
         await client.close()
 
 
+def bench_offload_smoke(grid: int = 3, edge: int = 128,
+                        variants: int = 2):
+    """Repeat-viewer offload gate (``bench.py --smoke --offload``):
+    the edge ladder end to end over a REAL 2-sidecar remote fleet —
+    cold render, warm-local byte hit, warm-peer byte fetch (the owner
+    drains; its successor serves the owner's bytes over
+    ``byte_probe``/``byte_fetch`` instead of re-rendering), and
+    If-None-Match -> 304 revalidation.
+
+    Reported keys (one JSON line, like the other smoke gates):
+
+    * ``origin_offload_ratio`` — fraction of the repeat-viewer mix
+      served with ZERO device render work (acceptance: >= 0.8);
+    * ``p50_304_ms`` — revalidation latency (acceptance: at least 10x
+      below ``p50_service_tile_ms``, the cold render p50 measured in
+      the same run);
+    * ``peer_hit_rate`` — fraction of the re-routed working set served
+      from the draining owner's byte tier, byte-identical to the
+      origin render.
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, FleetConfig, RawCacheConfig,
+        RendererConfig, SidecarConfig)
+    from omero_ms_image_region_tpu.services.cache import CacheConfig
+
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(7)
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(
+            rng, 2, 1, grid * edge, grid * edge).reshape(
+            2, 1, grid * edge, grid * edge)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        socks = [os.path.join(tmp, f"m{i}.sock") for i in range(2)]
+
+        def member_cfg():
+            # Each sidecar owns its OWN byte-cache chain (memory LRU
+            # per process-alike stack): the peer tier is real, not an
+            # artifact of a shared cache.
+            return AppConfig(
+                data_dir=tmp,
+                caches=CacheConfig.enabled_all(),
+                batcher=BatcherConfig(enabled=False),
+                raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+                renderer=RendererConfig(cpu_fallback_max_px=0))
+
+        frontend_cfg = AppConfig(
+            data_dir=tmp,
+            sidecar=SidecarConfig(role="frontend"),
+            fleet=FleetConfig(enabled=True, sockets=tuple(socks)))
+
+        params = []
+        for v in range(variants):
+            w = 30000 + v * 900
+            for x in range(grid):
+                for y in range(grid):
+                    params.append({
+                        "imageId": "1", "theZ": "0", "theT": "0",
+                        "tile": f"0,{x},{y},{edge},{edge}",
+                        "format": "png", "m": "c",
+                        "c": f"1|0:{w}$FF0000,2|0:{w - 700}$00FF00",
+                    })
+
+        def url_of(p):
+            q = "&".join(f"{k}={p[k]}" for k in
+                         ("tile", "format", "m", "c"))
+            return (f"/webgateway/render_image_region/"
+                    f"{p['imageId']}/{p['theZ']}/{p['theT']}?{q}")
+
+        out = asyncio.run(_offload_run(member_cfg, frontend_cfg,
+                                       socks, params, url_of))
+
+    out.update({
+        "metric": "offload_smoke",
+        "unit": "invariants",
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    })
+    print(json.dumps(out))
+    return out
+
+
+async def _offload_run(member_cfg, frontend_cfg, socks, params,
+                       url_of):
+    import asyncio
+    import os
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from omero_ms_image_region_tpu.server.app import (FLEET_ROUTER_KEY,
+                                                      create_app)
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.sidecar import run_sidecar
+    from omero_ms_image_region_tpu.utils import telemetry
+    from omero_ms_image_region_tpu.utils.stopwatch import \
+        REGISTRY as SPANS
+
+    def render_spans() -> int:
+        snap = SPANS.snapshot()
+        return (snap.get("Renderer.renderAsPackedInt",
+                         {}).get("count", 0)
+                + snap.get("Renderer.renderAsPackedInt.cpu",
+                           {}).get("count", 0))
+
+    sidecars = [asyncio.create_task(run_sidecar(member_cfg(), sock))
+                for sock in socks]
+    for sock in socks:
+        for _ in range(400):
+            for task in sidecars:
+                if task.done():
+                    task.result()     # surface an early death
+            if os.path.exists(sock):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(f"sidecar socket {sock} missing")
+
+    app = create_app(frontend_cfg)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    router = app[FLEET_ROUTER_KEY]
+    try:
+        urls = [url_of(p) for p in params]
+        ctxs = [ImageRegionCtx.from_params(dict(p), None)
+                for p in params]
+
+        # ---- cold: every tile renders once on its ring owner.
+        bodies, etags, cold_ms = {}, {}, []
+        for u in urls:
+            t0 = time.perf_counter()
+            r = await client.get(u)
+            body = await r.read()
+            cold_ms.append((time.perf_counter() - t0) * 1000.0)
+            assert r.status == 200, f"cold render failed: {r.status}"
+            etags[u] = r.headers.get("ETag")
+            assert etags[u], "200 missing its ETag"
+            bodies[u] = body
+        renders_cold = render_spans()
+        assert renders_cold > 0, "cold leg rendered nothing"
+
+        warm_total = 0
+        # ---- warm-local: straight repeats hit the owner's byte tier.
+        for u in urls:
+            r = await client.get(u)
+            body = await r.read()
+            assert r.status == 200 and body == bodies[u]
+            warm_total += 1
+
+        # ---- 304: revalidation with the cold leg's ETags.
+        t304 = []
+        for u in urls:
+            t0 = time.perf_counter()
+            r = await client.get(
+                u, headers={"If-None-Match": etags[u]})
+            await r.read()
+            t304.append((time.perf_counter() - t0) * 1000.0)
+            assert r.status == 304, f"expected 304, got {r.status}"
+            assert r.headers.get("ETag") == etags[u]
+            warm_total += 1
+
+        # ---- warm-peer: drain one member; its shard re-routes to
+        # the survivor, which must serve the DRAINING owner's bytes
+        # over byte_probe/byte_fetch — zero re-renders.
+        owners = {u: router.owner_of(ctx)
+                  for u, ctx in zip(urls, ctxs)}
+        victim = next(name for name in router.order
+                      if any(o == name for o in owners.values()))
+        owned = [u for u in urls if owners[u] == victim]
+        await router.drain_member(victim, prestage=False,
+                                  settle_timeout_s=5.0)
+        fetches_before = telemetry.HTTPCACHE.peer_fetches
+        for u in owned:
+            r = await client.get(u)
+            body = await r.read()
+            assert r.status == 200, f"peer leg failed: {r.status}"
+            assert body == bodies[u], \
+                "peer bytes differ from the origin render"
+            warm_total += 1
+        peer_fetches = telemetry.HTTPCACHE.peer_fetches \
+            - fetches_before
+        router.undrain_member(victim)
+
+        renders_warm = render_spans() - renders_cold
+        offload = 1.0 - renders_warm / max(1, warm_total)
+        return {
+            "value": round(offload, 3),
+            "origin_offload_ratio": round(offload, 3),
+            "p50_service_tile_ms": round(
+                float(np.median(cold_ms)), 2),
+            "p50_304_ms": round(float(np.median(t304)), 3),
+            "peer_hit_rate": round(
+                peer_fetches / max(1, len(owned)), 3),
+            "peer_working_set": len(owned),
+            "warm_requests": warm_total,
+            "warm_renders": renders_warm,
+            "n_304": len(t304),
+        }
+    finally:
+        await client.close()
+        for task in sidecars:
+            task.cancel()
+        await asyncio.gather(*sidecars, return_exceptions=True)
+
+
 def bench_chaos_smoke(duration_s: float = 1.5, seed: int = 1234,
                       artifacts_dir: str = None):
     """Robustness gate at smoke scale: the full frontend -> sidecar ->
@@ -2444,6 +2652,9 @@ def main():
     # --smoke --sessions runs the multi-user serving scenario (N
     # panning viewers + one hostile bulk client: per-session p99,
     # Jain's fairness index, predictive prefetch hit rate).
+    # --smoke --offload runs the repeat-viewer offload scenario
+    # (cold -> warm-local -> warm-peer -> 304 over a 2-sidecar fleet:
+    # origin offload ratio, 304 latency, peer byte-fetch hit rate).
     if "--smoke" in sys.argv[1:]:
         if "--chaos" in sys.argv[1:]:
             bench_chaos_smoke()
@@ -2453,6 +2664,8 @@ def main():
             bench_overload_smoke()
         elif "--sessions" in sys.argv[1:]:
             bench_sessions_smoke()
+        elif "--offload" in sys.argv[1:]:
+            bench_offload_smoke()
         else:
             bench_smoke()
         return

@@ -227,6 +227,43 @@ class LocalMember:
             return []
         return cache.snapshot_entries(limit)
 
+    # ---- fleet-global byte tier (combined role shares ONE byte-cache
+    # chain across members, so these exist for API symmetry and tests;
+    # the router only crosses the wire for REMOTE peers).
+
+    def _byte_stack(self):
+        stack = getattr(getattr(self.services, "caches", None),
+                        "image_region", None)
+        return stack if (stack is not None
+                         and getattr(stack, "enabled", False)) else None
+
+    async def byte_probe(self, keys: List[str]) -> List[bool]:
+        stack = self._byte_stack()
+        if stack is None:
+            return [False] * len(keys)
+        return [(await stack.get(str(k))) is not None for k in keys]
+
+    async def byte_fetch(self, key: str, image_id=None,
+                         session=None) -> Optional[bytes]:
+        stack = self._byte_stack()
+        if stack is None:
+            return None
+        data = await stack.get(str(key))
+        if data is None or image_id is None:
+            return data
+        from ..server.handler import check_can_read
+        if not await check_can_read(self.services, "Image",
+                                    int(image_id), session):
+            return None
+        return data
+
+    async def byte_put(self, key: str, value: bytes) -> bool:
+        stack = self._byte_stack()
+        if stack is None:
+            return False
+        await stack.set(str(key), bytes(value))
+        return True
+
     async def prestage_manifest(self, entries: List[dict]) -> int:
         """Stage a handed-over shard manifest into THIS member's HBM
         (drain handoff, successor side) through the existing staging
@@ -290,7 +327,65 @@ class RemoteMember:
         resp_header, payload = await self.client.call_full(
             "image", ctx.to_json(), extra=extra)
         self.revive()          # a served call re-admits the member
+        if resp_header.get("quality_capped"):
+            # The sidecar's brownout ladder capped this render's JPEG
+            # quality: mirror the mark onto the FRONTEND's ctx so the
+            # byte-tier write-backs here (peer put-back, combined byte
+            # cache) keep the PR 9 contract — degraded bytes are never
+            # stored under the full-quality key.
+            ctx._pressure_quality_capped = True
         return _map_response(resp_header, payload)
+
+    # ---- fleet-global byte tier (the peer transport: the router's
+    # probe short-circuit and the thief write-back ride these three
+    # idempotent-where-safe wire ops; every failure degrades to None/
+    # False — the peer tier may only ever REMOVE work).
+
+    async def byte_probe(self, keys: List[str]) -> List[bool]:
+        import json as _json
+        try:
+            status, body = await self.client.call(
+                "byte_probe", {}, extra={"keys": [str(k)
+                                                  for k in keys]})
+            if status != 200 or not body:
+                return [False] * len(keys)
+            doc = _json.loads(bytes(body).decode())
+            present = [bool(p) for p in (doc.get("present") or ())]
+            present += [False] * (len(keys) - len(present))
+            return present[:len(keys)]
+        except Exception:
+            return [False] * len(keys)
+
+    async def byte_fetch(self, key: str, image_id=None,
+                         session=None) -> Optional[bytes]:
+        """None = authority MISS (or ACL refusal) — an honest 404;
+        transport failures RAISE so the caller can count a fallback
+        (a miss means render, a failure means the peer tier is
+        degraded — the router's telemetry keeps them distinct)."""
+        extra = {"key": str(key)}
+        if image_id is not None:
+            # The serving sidecar runs its OWN ACL gate for this
+            # session before any byte leaves it — the same
+            # contract as the `image` op.
+            extra["image_id"] = int(image_id)
+            extra["session"] = session
+        resp_header, payload = await self.client.call_full(
+            "byte_fetch", {}, extra=extra)
+        if resp_header.get("status") != 200 or payload is None:
+            return None
+        return bytes(payload)
+
+    async def byte_put(self, key: str, value: bytes) -> bool:
+        import hashlib as _hashlib
+        try:
+            digest = _hashlib.blake2b(bytes(value),
+                                      digest_size=16).hexdigest()
+            status, _body = await self.client.call(
+                "byte_put", {}, body=bytes(value),
+                extra={"key": str(key), "digest": digest})
+            return status == 200
+        except Exception:
+            return False
 
     def queue_depth(self) -> int:
         return 0               # the sidecar's own gauge carries this
@@ -470,7 +565,9 @@ class FleetRouter:
 
     def __init__(self, members: Sequence, lane_width: int = 2,
                  steal_min_backlog: int = 2, hash_replicas: int = 64,
-                 failover: bool = True, qos_weight: int = 0):
+                 failover: bool = True, qos_weight: int = 0,
+                 peer_fetch: bool = True,
+                 peer_timeout_s: float = 0.5):
         if not members:
             raise ValueError("fleet needs at least one member")
         if lane_width < 1:
@@ -501,6 +598,22 @@ class FleetRouter:
         self._wake: Optional[asyncio.Event] = None
         self._lanes: List[asyncio.Task] = []
         self._closed = False
+        # Fleet-global byte tier (deploy/DEPLOY.md "Edge caching"):
+        # probe the shard authority's byte cache before any
+        # re-render, and write a thief's render back to it.
+        self.peer_fetch = peer_fetch
+        self.peer_timeout_s = peer_timeout_s
+        # Combined-role fleets have no remote peers — every member
+        # shares ONE byte-cache chain the handler already probes — so
+        # the peer path short-circuits to a single attribute read.
+        self._has_remote_members = any(
+            getattr(m, "remote", False) for m in members)
+        self._putback_tasks: set = set()
+        # Per-member shard manifests captured at drain time, replayed
+        # BACK into the member on undrain (pre-stage-back); the last
+        # replay task is exposed so drills/operators can await it.
+        self._drain_manifests: Dict[str, List[dict]] = {}
+        self.last_undrain_prestage: Optional[asyncio.Task] = None
 
     # ----------------------------------------------------------- routing
 
@@ -635,6 +748,12 @@ class FleetRouter:
             await asyncio.sleep(0.02)
         settled = self._inflight[name] == 0
         manifest = await member.shard_manifest(max_planes)
+        # Stashed for the rejoin: undrain replays this manifest BACK
+        # through the digest-deduped staging path so the member's
+        # shard is warm before its first routed request (a restart
+        # drops the HBM cache; the manifest is what it held).
+        if manifest:
+            self._drain_manifests[name] = manifest
         prestaged = 0
         if prestage and manifest:
             telemetry.FLIGHT.record("drain.phase", member=name,
@@ -679,17 +798,57 @@ class FleetRouter:
                                successor, exc_info=True)
         return staged
 
-    def undrain_member(self, name: str) -> None:
+    def undrain_member(self, name: str,
+                       prestage_back: bool = True) -> None:
         """Rejoin a drained member: routes flow back onto its ring
         arcs at the next dispatch — the same ~1/N remap bound as a
-        ring join (the ring itself never changed)."""
+        ring join (the ring itself never changed).
+
+        **Pre-stage BACK**: the shard manifest captured when this
+        member drained replays into it through the digest-deduped
+        ``restage_plane_entry`` path, so a member that restarted with
+        a cold HBM cache rejoins WARM — its first routed request hits
+        instead of paying the cold read/stage the drain existed to
+        avoid.  Background + best-effort (the member serves either
+        way); the task is exposed as ``last_undrain_prestage`` so the
+        drill (and a scripted roll) can await completion."""
         from ..utils import telemetry
         if name not in self.members:
             raise KeyError(f"unknown fleet member {name!r}")
-        self.members[name].draining = False
+        member = self.members[name]
+        member.draining = False
         telemetry.DRAIN.set_state(name, "active")
         telemetry.FLIGHT.record("drain.phase", member=name,
                                 phase="undrained")
+        entries = self._drain_manifests.pop(name, None)
+        self.last_undrain_prestage = None
+        if prestage_back and entries:
+            async def _restage_back() -> None:
+                try:
+                    staged = await member.prestage_manifest(entries)
+                except Exception:
+                    logger.warning("undrain pre-stage-back into %s "
+                                   "failed", name, exc_info=True)
+                    return
+                telemetry.DRAIN.count_prestaged(staged)
+                telemetry.FLIGHT.record(
+                    "drain.phase", member=name, phase="prestage-back",
+                    planes=len(entries), prestaged=staged)
+                logger.info("fleet member %s pre-staged back %d/%d "
+                            "shard planes on undrain", name, staged,
+                            len(entries))
+
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                loop = None   # sync caller with no loop: serve cold
+            if loop is not None:
+                task = loop.create_task(_restage_back())
+                self.last_undrain_prestage = task
+                # Tracked with the put-back shipments so close()
+                # cancels an in-flight replay instead of leaking it.
+                self._putback_tasks.add(task)
+                task.add_done_callback(self._putback_tasks.discard)
         logger.info("fleet member %s undrained (rejoined the ring)",
                     name)
 
@@ -749,6 +908,95 @@ class FleetRouter:
             if not work.future.done():
                 work.future.cancel()
             raise
+
+    async def fetch_peer_bytes(self, ctx) -> Optional[bytes]:
+        """The offload ladder's peer rung: when routing would hand
+        this render to a member that is NOT the chain's byte
+        authority (the ring owner is draining or down and the shard
+        moved hash-ring-next), probe the authority's byte tier and
+        fetch the already-rendered bytes over the idempotent
+        ``byte_probe``/``byte_fetch`` wire ops INSTEAD of re-rendering
+        on the successor.  The authority is the first chain member
+        alive enough to answer — healthy OR draining (a draining
+        member finishes work and serves handoffs by design; its byte
+        tier is exactly where the just-rendered bytes live).
+
+        Combined-role members share ONE byte-cache chain the fleet
+        handler already probed, so only REMOTE peers are asked.  Every
+        failure (timeout, dead peer, ACL refusal, miss) returns None
+        and the render path proceeds — the peer tier can only ever
+        remove work, never add a failure mode."""
+        if not self.peer_fetch or not self._has_remote_members \
+                or self._pinned(ctx):
+            return None
+        from ..utils import telemetry
+        serving = self.owner_of(ctx)
+        for name in self.ring.chain(plane_route_key(ctx)):
+            if name == serving:
+                # The serving member probes its own tier first thing
+                # in its handler — a frontend pre-probe of the SAME
+                # tier would only double the round-trips.
+                return None
+            member = self.members[name]
+            if not member.remote \
+                    or not (member.healthy or member.draining):
+                continue
+            # ONE round-trip: byte_fetch itself is the probe (None =
+            # authority miss -> render; the batched byte_probe op
+            # exists for bulk callers).  A transport failure counts a
+            # FALLBACK — distinct from a miss, so degraded peering is
+            # visible on /metrics rather than reading as cold tiles.
+            telemetry.HTTPCACHE.count_peer_probe()
+            key = ctx.cache_key    # == settings.render_identity_key
+            try:
+                data = await asyncio.wait_for(
+                    member.byte_fetch(key, image_id=ctx.image_id,
+                                      session=ctx.omero_session_key),
+                    self.peer_timeout_s)
+            except Exception:
+                telemetry.HTTPCACHE.count_peer_fallback()
+                return None
+            if data is None:
+                # The authority has no bytes: nothing newer down the
+                # chain would (writes land authority-first) — render.
+                return None
+            telemetry.HTTPCACHE.count_peer_hit()
+            telemetry.HTTPCACHE.count_peer_fetch()
+            telemetry.FLIGHT.record("fleet.byte-peer",
+                                    authority=name,
+                                    serving=serving,
+                                    nbytes=len(data))
+            return data
+        return None
+
+    def _byte_putback(self, work: _Work, data: bytes) -> None:
+        """A thief finished another member's render: ship the bytes to
+        the shard AUTHORITY's byte tier (fire-and-forget, over the
+        state-changing ``byte_put`` op — never blind-retried, exactly
+        the plane_put contract) so the owner answers the next probe
+        itself — one member's render becomes every member's hit."""
+        if not self.peer_fetch:
+            return
+        owner = self.members.get(work.owner)
+        if owner is None or not owner.remote or not owner.healthy:
+            return
+        if getattr(work.ctx, "_pressure_quality_capped", False):
+            # Brownout-capped bytes never land under the full-quality
+            # key (the PR 9 drop_quality contract) — peers included.
+            return
+        from ..utils import telemetry
+        key = work.ctx.cache_key   # == settings.render_identity_key
+
+        async def put() -> None:
+            try:
+                if await owner.byte_put(key, data):
+                    telemetry.HTTPCACHE.count_peer_putback()
+            except Exception:
+                pass               # best-effort by contract
+
+        task = asyncio.get_running_loop().create_task(put())
+        self._putback_tasks.add(task)
+        task.add_done_callback(self._putback_tasks.discard)
 
     def _takeable(self, name: str) -> bool:
         """Is there work this member's lanes could take right now —
@@ -947,6 +1195,11 @@ class FleetRouter:
             else:
                 if not work.future.done():
                     work.future.set_result(data)
+                if work.stolen:
+                    # The thief's render lands on the shard authority's
+                    # byte tier too (fire-and-forget byte_put): one
+                    # member's render becomes every member's hit.
+                    self._byte_putback(work, data)
             finally:
                 self._inflight[name] -= 1
 
@@ -978,6 +1231,12 @@ class FleetRouter:
         if self._lanes:
             await asyncio.gather(*self._lanes, return_exceptions=True)
         self._lanes = []
+        for task in list(self._putback_tasks):
+            task.cancel()
+        if self._putback_tasks:
+            await asyncio.gather(*self._putback_tasks,
+                                 return_exceptions=True)
+        self._putback_tasks.clear()
         for queue in self._queues.values():
             while queue:
                 work = queue.pop_raw()
@@ -1047,6 +1306,27 @@ class FleetImageHandler:
                                         ctx.omero_session_key):
                 raise NotFoundError(
                     f"Cannot find Image:{ctx.image_id}")
+
+        # Fleet-global byte tier: before fairness, single-flight and
+        # admission (same footing as the byte-cache probe above —
+        # already-rendered bytes never shed and never cost a token),
+        # ask the shard AUTHORITY's byte tier when routing would land
+        # this render elsewhere.  The serving sidecar ACL-gates the
+        # fetch for this caller's session; combined role gated above.
+        # getattr: drill/test routers are duck-typed dispatchers.
+        peer_fetch = getattr(self.router, "fetch_peer_bytes", None)
+        peer = (await peer_fetch(ctx)
+                if peer_fetch is not None else None)
+        if peer is not None:
+            if self.s is not None:
+                # Local write-back: the shared byte tier answers the
+                # next repeat view without even the peer round-trip.
+                await self.s.caches.image_region.set(ctx.cache_key,
+                                                     peer)
+            telemetry.record_span(
+                "cache.peer", t0,
+                (time.perf_counter() - t0) * 1000.0)
+            return peer
 
         admission = self.admission
         # Per-session fairness runs PER CALLER, before coalescing —

@@ -485,7 +485,10 @@ def create_app(config: Optional[AppConfig] = None,
             hash_replicas=config.fleet.hash_replicas,
             failover=config.fleet.failover,
             qos_weight=(config.qos.interactive_weight
-                        if config.qos.enabled else 0))
+                        if config.qos.enabled else 0),
+            peer_fetch=(config.http_cache.enabled
+                        and config.http_cache.peer_fetch),
+            peer_timeout_s=config.http_cache.peer_timeout_ms / 1000.0)
         single_flight = None
         if config.single_flight:
             from .singleflight import SingleFlight
@@ -549,7 +552,11 @@ def create_app(config: Optional[AppConfig] = None,
                 hash_replicas=config.fleet.hash_replicas,
                 failover=config.fleet.failover,
                 qos_weight=(config.qos.interactive_weight
-                            if config.qos.enabled else 0))
+                            if config.qos.enabled else 0),
+                peer_fetch=(config.http_cache.enabled
+                            and config.http_cache.peer_fetch),
+                peer_timeout_s=(
+                    config.http_cache.peer_timeout_ms / 1000.0))
             single_flight = services.single_flight
             services.single_flight = None
             services.admission = None
@@ -714,9 +721,125 @@ def create_app(config: Optional[AppConfig] = None,
         params = dict(request.query)
         params.update(request.match_info)
         # The wildcard route's tail must not reach the ctx: cache keys
-        # hash all params, and /7/0/0 vs /7/0/0/ must share a key.
+        # hash all params, and /7/0/0 vs /7/0/0/ must share a key
+        # (and, downstream, one ETag — the edge-cache alias contract).
         params.pop("tail", None)
         return params
+
+    # ---- Conditional HTTP (server.httpcache; deploy/DEPLOY.md "Edge
+    # caching"): content-addressed ETags on every image/mask response,
+    # If-None-Match -> 304 and HEAD -> headers-only with ZERO render,
+    # admission or session-token work, honest Cache-Control/Vary so
+    # nginx/CDN edges can absorb repeat viewers safely.
+    from . import httpcache
+
+    async def _acl_gated(object_type: str, object_id: int) -> bool:
+        """Is this object PRIVATE for edge-cache purposes (not
+        anonymously readable)?  Decides ``private`` + ``Vary`` vs
+        ``public``.  Combined role probes the memoized ACL with a None
+        session; proxy/fleet frontends cannot probe and use the
+        session-enforcement posture (enforced sessions => everything
+        private).  Errs toward private on any doubt — a wrongly-public
+        header is a data leak, a wrongly-private one just a cache-hit-
+        rate loss."""
+        if not config.http_cache.vary_acl:
+            return True
+        if services is None:
+            return session_required
+        from .handler import check_can_read
+        try:
+            return not await check_can_read(services, object_type,
+                                            object_id, None)
+        except Exception:
+            return True
+
+    async def _cache_headers(headers: dict, identity: str,
+                             object_type: str,
+                             object_id: int) -> Optional[str]:
+        """Stamp ETag/Cache-Control/Vary onto ``headers``; returns the
+        ETag (None when conditional HTTP is off — the legacy static
+        cache-control-header string then applies, success-only)."""
+        hc = config.http_cache
+        if not hc.enabled:
+            if config.cache_control_header:
+                headers["Cache-Control"] = config.cache_control_header
+            return None
+        etag = httpcache.etag_for(identity, hc.epoch)
+        headers["ETag"] = etag
+        gated = await _acl_gated(object_type, object_id)
+        cc, vary = httpcache.cache_headers(hc.max_age_s, gated)
+        # An explicitly configured legacy cache-control-header string
+        # is the operator's deliberate policy: it stays the
+        # Cache-Control VALUE; the ETag/Vary layer still applies.
+        headers["Cache-Control"] = (config.cache_control_header
+                                    or cc)
+        if vary:
+            headers["Vary"] = vary
+        return etag
+
+    async def _conditional_answer(request: web.Request, headers: dict,
+                                  etag: Optional[str],
+                                  revalidate_ok) -> Optional[web.Response]:
+        """The renderless answers, checked BEFORE fairness buckets,
+        single-flight and admission ever see the request: a matching
+        ``If-None-Match`` is a 304, a ``HEAD`` is headers-only.  Both
+        carry the same ETag/Cache-Control/Vary as the 200 they stand
+        in for.  ``revalidate_ok`` is the per-caller ACL gate — a
+        session that cannot read the object falls through to the
+        render path and gets its honest 404 there."""
+        if etag is not None:
+            inm = request.headers.get("If-None-Match")
+            if inm:
+                telemetry.HTTPCACHE.count_etag_request()
+                if httpcache.if_none_match_matches(inm, etag) \
+                        and await revalidate_ok():
+                    telemetry.HTTPCACHE.count_not_modified()
+                    return web.Response(status=304, headers=headers)
+        if request.method == "HEAD" and services is not None:
+            # Headers-only when the caller could read the object (the
+            # memoized ACL check, no render); an unreadable or missing
+            # object falls through so the pipeline answers its honest
+            # 404 — aiohttp strips the body for HEAD on every path.
+            # Proxy/fleet frontends cannot probe existence locally, so
+            # their HEADs always run the pipeline: status fidelity
+            # over the renderless shortcut (a HEAD 200 for a deleted
+            # image would keep edge entries alive forever).
+            if await revalidate_ok():
+                telemetry.HTTPCACHE.count_head()
+                return web.Response(headers=headers)
+        return None
+
+    def _strip_cache_headers_if_degraded(ctx, headers: dict) -> None:
+        """Brownout-capped bytes must never be edge-cached under the
+        permanent render identity: the ETag is a pure function of
+        URL + epoch, so once an edge stored a degraded body every
+        later If-None-Match would 304-confirm it FOREVER (until an
+        epoch bump).  A capped 200 therefore drops its ETag/Vary and
+        answers ``no-store`` — the same never-under-the-full-quality-
+        key contract the byte tiers follow (server.pressure
+        drop_quality)."""
+        if getattr(ctx, "_pressure_quality_capped", False):
+            headers.pop("ETag", None)
+            headers.pop("Vary", None)
+            headers["Cache-Control"] = "no-store"
+
+    def _can_revalidate(object_type: str, object_id: int, session_key):
+        """Per-caller gate for the 304 path.  Combined role runs the
+        SAME memoized ACL check a byte-cache hit runs; proxy/fleet
+        frontends cannot check locally and answer on the ETag alone —
+        safe, because the ETag derives from the request params + epoch
+        and never from pixels, so a 304 reveals nothing the URL does
+        not (the sidecar's ACL still gates every byte that moves)."""
+        async def check() -> bool:
+            if services is None:
+                return True
+            from .handler import check_can_read
+            try:
+                return await check_can_read(services, object_type,
+                                            object_id, session_key)
+            except Exception:
+                return False
+        return check
 
     async def render_image_region(request: web.Request) -> web.Response:
         import time as _time
@@ -731,13 +854,22 @@ def create_app(config: Optional[AppConfig] = None,
         except BadRequestError as e:
             # Parse errors return the message body (the reference's 400
             # path, ImageRegionMicroserviceVerticle.java:300-305).
+            # NOTE error responses (this 400, every _status_of answer)
+            # deliberately carry NO Cache-Control/ETag: an edge must
+            # never cache a failure under a render identity.
             return web.Response(status=400, text=str(e))
         headers = {
             "Content-Type": codecs.CONTENT_TYPES.get(
                 ctx.format, "application/octet-stream"),
         }
-        if config.cache_control_header:
-            headers["Cache-Control"] = config.cache_control_header
+        etag = await _cache_headers(headers, ctx.cache_key, "Image",
+                                    ctx.image_id)
+        renderless = await _conditional_answer(
+            request, headers, etag,
+            _can_revalidate("Image", ctx.image_id,
+                            ctx.omero_session_key))
+        if renderless is not None:
+            return renderless
         stream_fn = (getattr(image_handler,
                              "render_image_region_stream", None)
                      if config.wire.streaming else None)
@@ -746,6 +878,7 @@ def create_app(config: Optional[AppConfig] = None,
                 body = await image_handler.render_image_region(ctx)
             except Exception as e:
                 return _status_of(e)
+            _strip_cache_headers_if_degraded(ctx, headers)
             return web.Response(body=body, headers=headers)
         # Progressive first-byte-out response (wire v3 leg 2): the
         # body leaves as an HTTP chunked response, each chunk written
@@ -762,6 +895,13 @@ def create_app(config: Optional[AppConfig] = None,
             first = b""
         except Exception as e:
             return _status_of(e)
+        # Combined mode settles the whole body before the first chunk
+        # yields, so the cap flag is known here; proxy streaming only
+        # learns it on the fin frame, after headers left — that path's
+        # capped bodies are protected by the sidecar never writing
+        # them to the byte tier, and streaming under brownout is the
+        # degraded exception, not the cacheable steady state.
+        _strip_cache_headers_if_degraded(ctx, headers)
         resp = web.StreamResponse(headers=headers)
         nbytes = 0
         try:
@@ -807,11 +947,27 @@ def create_app(config: Optional[AppConfig] = None,
             return web.Response(status=403)
         except BadRequestError as e:
             return web.Response(status=400, text=str(e))
+        headers = {"Content-Type": "image/png"}
+        # The mask's BYTE-cache key keeps the reference's exact
+        # id:color format; the ETag identity additionally folds the
+        # flips, which change the produced bytes but (for reference
+        # parity) never reached that key.
+        identity = (f"{ctx.cache_key()}"
+                    f":f{int(ctx.flip_horizontal)}"
+                    f"{int(ctx.flip_vertical)}")
+        etag = await _cache_headers(headers, identity, "Mask",
+                                    ctx.shape_id)
+        renderless = await _conditional_answer(
+            request, headers, etag,
+            _can_revalidate("Mask", ctx.shape_id,
+                            ctx.omero_session_key))
+        if renderless is not None:
+            return renderless
         try:
             body = await mask_handler.render_shape_mask(ctx)
         except Exception as e:
             return _status_of(e)
-        return web.Response(body=body, headers={"Content-Type": "image/png"})
+        return web.Response(body=body, headers=headers)
 
     def _finish_request(route: str, status: int, nbytes: int,
                         total_ms: float, trace) -> None:

@@ -504,6 +504,41 @@ class HttpConfig:
 
 
 @dataclass
+class HttpCacheConfig:
+    """Edge-cache-grade conditional HTTP (``server.httpcache``;
+    deploy/DEPLOY.md "Edge caching"): content-addressed ETags on
+    region/tile/mask responses, ``If-None-Match`` -> 304 with zero
+    render/admission/token work, honest ``Cache-Control``/``Vary``,
+    and the fleet's peer byte-fetch short-circuit."""
+
+    enabled: bool = True
+    # Deployment cache epoch: folded into (and visible in) every ETag.
+    # Bumping it invalidates EVERY edge-cached entry at once — the
+    # knob to turn when source data or the render pipeline changes
+    # under live URLs.  Token characters only ([A-Za-z0-9._-]).
+    epoch: str = "0"
+    # Cache-Control max-age for 200s.  0 (default) emits ``no-cache``:
+    # edges store but revalidate every serve — safe because the 304
+    # answer is free.  >0 lets edges serve without revalidation for
+    # that window (an epoch bump then takes up to max-age-s to
+    # propagate).
+    max_age_s: int = 0
+    # Emit ``Vary: <session cookie header>`` (+ ``private``) on
+    # ACL-gated images so shared caches key entries per session;
+    # public images stay ``public`` with no Vary.  Off = everything
+    # private+Vary (the conservative posture for deployments that
+    # cannot probe ACL at the edge process).
+    vary_acl: bool = True
+    # Fleet-global byte tier: on a byte miss, digest-probe the plane's
+    # ring authority and fetch the bytes over the idempotent
+    # byte_probe/byte_fetch wire ops before any re-render.
+    peer_fetch: bool = True
+    # Bound on one peer probe+fetch round-trip; past it the render
+    # path proceeds (the peer tier may only ever REMOVE work).
+    peer_timeout_ms: float = 500.0
+
+
+@dataclass
 class LoggingConfig:
     """≙ ``logback.xml.example:1-26``: console always; optional
     time-rolling file appender; per-subsystem level."""
@@ -556,6 +591,7 @@ class AppConfig:
     raw_cache: RawCacheConfig = field(default_factory=RawCacheConfig)
     renderer: RendererConfig = field(default_factory=RendererConfig)
     http: HttpConfig = field(default_factory=HttpConfig)
+    http_cache: HttpCacheConfig = field(default_factory=HttpCacheConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
@@ -619,6 +655,30 @@ class AppConfig:
                                         cfg.lut_root)
         cfg.cache_control_header = raw.get("cache-control-header",
                                            cfg.cache_control_header)
+        hc = raw.get("http-cache", {}) or {}
+        hc_defaults = HttpCacheConfig()
+        cfg.http_cache = HttpCacheConfig(
+            enabled=bool(hc.get("enabled", hc_defaults.enabled)),
+            epoch=str(hc.get("epoch", hc_defaults.epoch)),
+            max_age_s=int(hc.get("max-age-s", hc_defaults.max_age_s)),
+            vary_acl=bool(hc.get("vary-acl", hc_defaults.vary_acl)),
+            peer_fetch=bool(hc.get("peer-fetch",
+                                   hc_defaults.peer_fetch)),
+            peer_timeout_ms=float(hc.get(
+                "peer-timeout-ms", hc_defaults.peer_timeout_ms)),
+        )
+        from .httpcache import EPOCH_RE
+        if not EPOCH_RE.match(cfg.http_cache.epoch):
+            # The epoch rides inside the quoted ETag header: a stray
+            # quote/comma/space would corrupt every response header.
+            raise ValueError(
+                "http-cache.epoch must match [A-Za-z0-9._-]+, got "
+                f"{cfg.http_cache.epoch!r}")
+        if cfg.http_cache.max_age_s < 0:
+            raise ValueError("http-cache.max-age-s must be >= 0 "
+                             "(0 = no-cache, revalidate every serve)")
+        if cfg.http_cache.peer_timeout_ms <= 0:
+            raise ValueError("http-cache.peer-timeout-ms must be > 0")
         web = raw.get("omero.web", {}) or {}
         cfg.session_cookie_name = web.get("session_cookie_name",
                                           cfg.session_cookie_name)

@@ -1,0 +1,103 @@
+"""Conditional-HTTP cache semantics: content-addressed ETags, 304s,
+and honest ``Cache-Control``/``Vary`` — the L5 layer that lets
+nginx/CDN edges absorb repeat viewers without a render, an admission
+slot, or a session token.
+
+The reference leans on per-route ``Cache-Control``/content-type
+handling so OMERO.web's nginx front can cache tile responses
+(``ImageRegionMicroserviceVerticle.java:294-352``); this build goes
+one step further and makes revalidation FREE: the ETag derives from
+the render-identity key (``settings.render_identity_key`` — the PR 2
+canonical sorted-params identity the byte cache and single-flight
+already key on) plus a deployment **epoch**, so
+
+* two requests whose params differ only in ordering share one ETag
+  (the identity is SipHash over the SORTED params);
+* ``/7/0/0/`` and ``/7/0/0`` alias (the route's ``tail`` never
+  reaches the params);
+* the ETag never touches the pixels — answering ``If-None-Match``
+  with 304 requires ZERO render, admission or session-token work, and
+  a 304 leaks nothing a client could not derive from the URL itself;
+* bumping ``http-cache.epoch`` (a config string) invalidates EVERY
+  edge-cached entry at once — the one knob an operator turns when
+  source data or the render pipeline changes under live URLs
+  (deploy/DEPLOY.md "Edge caching").
+
+Device-free on purpose: frontend proxies and fleet routers evaluate
+conditionals without importing the JAX stack.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Optional, Tuple
+
+# ETag schema version: bumping the derivation below MUST bump this
+# prefix (a silently changed ETag invalidates every CDN edge at once;
+# the golden pin in tests/test_http_cache.py fails loudly instead).
+_SCHEMA = "ir1"
+
+# Epochs ride inside the quoted ETag: token characters only, so a
+# config typo can never smuggle a quote/comma into the header.
+EPOCH_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def etag_for(cache_key: str, epoch: str = "0") -> str:
+    """Strong ETag for a render identity under ``epoch``.
+
+    ``cache_key`` is the ctx's canonical identity
+    (``render_identity_key`` == ``ImageRegionCtx.cache_key``, or the
+    mask ctx's ``cache_key()``).  The digest folds the epoch, and the
+    epoch ALSO rides visibly in the tag so an operator can read which
+    generation an edge holds straight off a response header."""
+    digest = hashlib.blake2b(
+        f"{epoch}:{cache_key}".encode(), digest_size=12).hexdigest()
+    return f'"{_SCHEMA}-{epoch}-{digest}"'
+
+
+def if_none_match_matches(header: Optional[str], etag: str) -> bool:
+    """RFC 9110 ``If-None-Match`` evaluation against one strong ETag.
+
+    ``*`` matches any current representation; otherwise the header is
+    a comma-separated list of entity tags, compared WEAKLY (the
+    ``W/`` prefix is stripped — weak comparison is what 304
+    revalidation specifies, and our tags are strong anyway)."""
+    if not header:
+        return False
+    header = header.strip()
+    if header == "*":
+        return True
+    for candidate in header.split(","):
+        candidate = candidate.strip()
+        if candidate.startswith("W/"):
+            candidate = candidate[2:]
+        if candidate == etag:
+            return True
+    return False
+
+
+def cache_headers(max_age_s: int, acl_gated: bool,
+                  session_cookie: str = "Cookie"
+                  ) -> Tuple[str, Optional[str]]:
+    """(Cache-Control, Vary-or-None) for a cacheable 200/304.
+
+    Honesty rules (deploy/DEPLOY.md "Edge caching"):
+
+    * ``max_age_s == 0`` → ``no-cache`` — edges may STORE but must
+      revalidate every serve; with free 304s that is the safe default
+      posture (every repeat view costs one conditional round-trip,
+      never a render).
+    * ACL-gated images are ``private`` and vary on the session-bearing
+      header, so a shared cache can never serve one session's entry to
+      another; public images are ``public`` with NO Vary (the
+      cookie-blind entry is safe for everyone, and varying would
+      shatter the edge's hit rate per-user for no protection).
+    """
+    scope = "private" if acl_gated else "public"
+    if max_age_s <= 0:
+        cc = f"{scope}, no-cache"
+    else:
+        cc = f"{scope}, max-age={int(max_age_s)}"
+    vary = session_cookie if acl_gated else None
+    return cc, vary

@@ -457,6 +457,7 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
         rid = header.get("id")
         spans = None
         costs = None
+        quality_capped = False
         inj = faultinject.active()
         if inj is not None and inj.sidecar_should_die():
             # Supervision drill: die MID-call, the way a real crash
@@ -521,6 +522,12 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                             "sidecar.render", t0,
                             (_time.perf_counter() - t0) * 1000.0,
                             op=op)
+                        # Brownout quality cap: exported on the reply
+                        # so the FRONTEND's byte-tier write-backs
+                        # (fleet peer put-back) can honor the
+                        # never-cache-degraded-bytes contract too.
+                        quality_capped = bool(getattr(
+                            ctx, "_pressure_quality_capped", False))
                 finally:
                     # Error paths too: retire the orphan and export
                     # whatever was recorded, so a failed request still
@@ -588,6 +595,81 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 body = json.dumps(doc).encode()
             elif op == "plane_put":
                 body = await _plane_put(image_handler, header, req_body)
+            elif op == "byte_probe":
+                # Fleet-global byte tier, step 1: does THIS member's
+                # byte-cache chain (memory -> disk -> redis) hold the
+                # rendered bytes for these render identities?  Batched
+                # like plane_probe — N keys, one wire round-trip.
+                # Presence only: no ACL (the key derives from request
+                # params, never pixels), no bytes move.
+                handler_services = getattr(image_handler, "s", None)
+                stack = getattr(getattr(handler_services, "caches",
+                                        None), "image_region", None)
+                enabled = bool(stack is not None
+                               and getattr(stack, "enabled", False))
+                keys = header.get("keys")
+                if not isinstance(keys, list):
+                    keys = [header.get("key")]
+                present = []
+                for k in keys:
+                    v = (await stack.get(str(k))
+                         if enabled and k else None)
+                    present.append(v is not None)
+                body = json.dumps({"enabled": enabled,
+                                   "present": present}).encode()
+            elif op == "byte_fetch":
+                # Step 2: the bytes themselves — ONLY after this
+                # process's own ACL gate passes for the caller's
+                # session (the exact contract of the `image` op: bytes
+                # never leave a sidecar a session could not read).
+                # Misses answer 404; MB-scale bodies ride the shm ring
+                # like any response body.
+                handler_services = getattr(image_handler, "s", None)
+                stack = getattr(getattr(handler_services, "caches",
+                                        None), "image_region", None)
+                key = str(header.get("key") or "")
+                data = (await stack.get(key)
+                        if stack is not None and key else None)
+                if data is None:
+                    raise NotFoundError(f"byte tier miss for {key!r}")
+                image_id = header.get("image_id")
+                if image_id is not None \
+                        and handler_services is not None:
+                    from .handler import check_can_read
+                    if not await check_can_read(
+                            handler_services, "Image", int(image_id),
+                            header.get("session")):
+                        raise NotFoundError(
+                            f"Cannot find Image:{image_id}")
+                body = bytes(data)
+            elif op == "byte_put":
+                # Peer write-back (a thief's render landing on its
+                # shard authority).  State-changing like plane_put:
+                # NEVER auto-retried by the client, and the body is
+                # digest-verified so a corrupt frame can never poison
+                # the byte tier under a healthy key.
+                handler_services = getattr(image_handler, "s", None)
+                stack = getattr(getattr(handler_services, "caches",
+                                        None), "image_region", None)
+                key = str(header.get("key") or "")
+                if not key:
+                    raise BadRequestError("byte_put requires a key")
+                value = bytes(req_body)
+                claimed = str(header.get("digest") or "")
+                if claimed:
+                    import hashlib as _hashlib
+                    actual = _hashlib.blake2b(
+                        value, digest_size=16).hexdigest()
+                    if actual != claimed:
+                        raise BadRequestError(
+                            f"byte_put digest mismatch: claimed "
+                            f"{claimed}, body is {actual}")
+                stored = False
+                if stack is not None \
+                        and getattr(stack, "enabled", False):
+                    await stack.set(key, value)
+                    stored = True
+                body = json.dumps({"stored": stored}).encode()
             elif op == "shard_manifest":
                 # Rolling drain, step 1 (remote members): this
                 # member's HBM shard as restageable region entries —
@@ -710,6 +792,8 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
             out["spans"] = spans
         if costs:
             out["costs"] = costs
+        if quality_capped:
+            out["quality_capped"] = 1
         if out["status"] >= 400:
             # Black box: failed sidecar ops are forensic events (the
             # routine 200 stream would only launder the ring).
@@ -1887,6 +1971,12 @@ class SidecarImageHandler:
                 raise
             telemetry.RESILIENCE.count_degraded_render()
             return await self.fallback.render_image_region(ctx)
+        if resp_header.get("quality_capped"):
+            # Mirror the sidecar's brownout mark onto the frontend ctx
+            # so the HTTP layer strips the cache headers — a degraded
+            # body must never be edge-cached under the full-quality
+            # ETag (the PR 9 drop_quality contract at L5).
+            ctx._pressure_quality_capped = True
         return _map_response(resp_header, payload)
 
     async def render_image_region_stream(self, ctx: ImageRegionCtx):

@@ -1980,6 +1980,102 @@ class QosStats:
 QOS = QosStats()
 
 
+class HttpCacheStats:
+    """Conditional-HTTP + peer-byte-tier accounting
+    (``server.httpcache`` / ``parallel.fleet`` peer fetch): how much
+    repeat-viewer traffic the edge ladder answered WITHOUT a render —
+    If-None-Match arrivals, 304s and renderless HEADs at L5; probe /
+    hit / fetch / fallback / put-back counters for the fleet-global
+    byte tier.  No labels — the families are closed scalars."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.etag_requests = 0     # requests arriving with If-None-Match
+        self.not_modified = 0      # 304s served (zero-work revalidation)
+        self.head = 0              # HEADs served renderless
+        self.peer_probes = 0       # authority byte-probe round-trips
+        self.peer_hits = 0         # probes answered resident=true
+        self.peer_fetches = 0      # peer bodies actually served
+        self.peer_fallbacks = 0    # probe/fetch failed -> render path
+        self.peer_putbacks = 0     # stolen-render write-backs shipped
+
+    def count_etag_request(self) -> None:
+        with self._lock:
+            self.etag_requests += 1
+
+    def count_not_modified(self) -> None:
+        with self._lock:
+            self.not_modified += 1
+
+    def count_head(self) -> None:
+        with self._lock:
+            self.head += 1
+
+    def count_peer_probe(self) -> None:
+        with self._lock:
+            self.peer_probes += 1
+
+    def count_peer_hit(self) -> None:
+        with self._lock:
+            self.peer_hits += 1
+
+    def count_peer_fetch(self) -> None:
+        with self._lock:
+            self.peer_fetches += 1
+
+    def count_peer_fallback(self) -> None:
+        with self._lock:
+            self.peer_fallbacks += 1
+
+    def count_peer_putback(self) -> None:
+        with self._lock:
+            self.peer_putbacks += 1
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+        lb = ("{" + extra + "}") if extra else ""
+        with self._lock:
+            if not (self.etag_requests or self.not_modified
+                    or self.head or self.peer_probes
+                    or self.peer_fetches or self.peer_fallbacks
+                    or self.peer_putbacks):
+                # Quiet until the ladder has seen traffic (the same
+                # emit-when-live posture as the fleet totals, and what
+                # keeps the reset()-contract exposition exact).
+                return []
+            return [
+                f"imageregion_httpcache_etag_requests_total{lb} "
+                f"{self.etag_requests}",
+                f"imageregion_httpcache_304_total{lb} "
+                f"{self.not_modified}",
+                f"imageregion_httpcache_head_total{lb} {self.head}",
+                f"imageregion_httpcache_peer_probes_total{lb} "
+                f"{self.peer_probes}",
+                f"imageregion_httpcache_peer_hits_total{lb} "
+                f"{self.peer_hits}",
+                f"imageregion_httpcache_peer_fetches_total{lb} "
+                f"{self.peer_fetches}",
+                f"imageregion_httpcache_peer_fallbacks_total{lb} "
+                f"{self.peer_fallbacks}",
+                f"imageregion_httpcache_peer_putbacks_total{lb} "
+                f"{self.peer_putbacks}",
+            ]
+
+    def reset(self) -> None:
+        with self._lock:
+            self.etag_requests = 0
+            self.not_modified = 0
+            self.head = 0
+            self.peer_probes = 0
+            self.peer_hits = 0
+            self.peer_fetches = 0
+            self.peer_fallbacks = 0
+            self.peer_putbacks = 0
+
+
+HTTPCACHE = HttpCacheStats()
+
+
 def session_metric_lines(extra_labels: str = "") -> List[str]:
     """The session-serving families — ``imageregion_session_*``,
     ``imageregion_prefetch_*``, ``imageregion_qos_*``."""
@@ -2219,6 +2315,16 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_qos_shed_total": "counter",
     "imageregion_qos_dequeued_total": "counter",
     "imageregion_qos_interactive_jumps_total": "counter",
+    # Conditional HTTP + fleet-global byte tier (server.httpcache /
+    # parallel.fleet peer fetch): the edge offload ladder's counters.
+    "imageregion_httpcache_etag_requests_total": "counter",
+    "imageregion_httpcache_304_total": "counter",
+    "imageregion_httpcache_head_total": "counter",
+    "imageregion_httpcache_peer_probes_total": "counter",
+    "imageregion_httpcache_peer_hits_total": "counter",
+    "imageregion_httpcache_peer_fetches_total": "counter",
+    "imageregion_httpcache_peer_fallbacks_total": "counter",
+    "imageregion_httpcache_peer_putbacks_total": "counter",
 }
 
 # Terse HELP strings for the families whose meaning is not obvious
@@ -2306,6 +2412,19 @@ METRIC_HELP: Dict[str, str] = {
         "Fleet-router dequeues by QoS class (weighted two-class queue)",
     "imageregion_qos_interactive_jumps_total":
         "Interactive dequeues that jumped a waiting bulk backlog",
+    "imageregion_httpcache_304_total":
+        "If-None-Match revalidations answered 304 with zero render/"
+        "admission/token work",
+    "imageregion_httpcache_head_total":
+        "HEAD requests answered headers-only without a render",
+    "imageregion_httpcache_peer_hits_total":
+        "Authority byte-probes answered resident (peer has the bytes)",
+    "imageregion_httpcache_peer_fetches_total":
+        "Renders avoided by fetching bytes from a fleet peer's tier",
+    "imageregion_httpcache_peer_fallbacks_total":
+        "Peer probe/fetch failures that fell back to the render path",
+    "imageregion_httpcache_peer_putbacks_total":
+        "Stolen-render bytes written back to the shard authority",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -2365,6 +2484,7 @@ def request_metric_lines() -> List[str]:
         lines.append(f'imageregion_requests_total{{route="{route}",'
                      f'status="{status}"}} {n}')
     lines += cost_metric_lines()
+    lines += HTTPCACHE.metric_lines()
     lines += SLO.metric_lines()
     lines += [
         f"imageregion_flight_events {len(FLIGHT)}",
@@ -2536,3 +2656,4 @@ def reset() -> None:
     SESSIONS.reset()
     PREFETCH.reset()
     QOS.reset()
+    HTTPCACHE.reset()

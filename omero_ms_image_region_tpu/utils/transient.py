@@ -283,7 +283,14 @@ IDEMPOTENT_OPS = frozenset({"image", "mask", "ping", "metrics",
                             # read; prestage re-stages through the
                             # digest-deduped path, so a duplicate is a
                             # no-op probe hit, never double state.
-                            "shard_manifest", "prestage"})
+                            "shard_manifest", "prestage",
+                            # Fleet-global byte tier: presence probe
+                            # and byte read are pure reads.  byte_put
+                            # (the peer write-back) is NOT here — like
+                            # plane_put it mutates cache state, and a
+                            # blind re-send is wasted wire bytes at
+                            # best; the caller decides.
+                            "byte_probe", "byte_fetch"})
 
 
 class RetryPolicy:

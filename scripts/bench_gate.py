@@ -59,9 +59,17 @@ MULTICHIP_KEYS = ("fleet_tiles_per_sec_m8", "fleet_tiles_per_sec_m4",
 # fairness index and predictive hit rate regress DOWN.
 SESSIONS_KEYS = ("sessions_interactive_p99_ms",
                  "sessions_fairness_index", "prefetch_hit_rate")
+# --offload: judge OFFLOAD_r*.json records (bench.py --smoke
+# --offload) on the repeat-viewer offload keys.  Direction-aware by
+# name: the offload ratio and peer hit rate regress DOWNWARD (less
+# traffic absorbed off the origin), the 304 latency is a ``_ms`` key
+# and regresses UPWARD.
+OFFLOAD_KEYS = ("origin_offload_ratio", "peer_hit_rate",
+                "p50_304_ms")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _MULTICHIP_RE = re.compile(r"^MULTICHIP_r(\d+)\.json$")
 _SESSIONS_RE = re.compile(r"^SESSIONS_r(\d+)\.json$")
+_OFFLOAD_RE = re.compile(r"^OFFLOAD_r(\d+)\.json$")
 
 
 def lower_is_better(key: str) -> bool:
@@ -237,6 +245,12 @@ def main(argv=None) -> int:
                              "p99 (regresses up), Jain's fairness "
                              "index and predictive prefetch hit rate "
                              "(regress down)")
+    parser.add_argument("--offload", action="store_true",
+                        help="judge OFFLOAD_r*.json records (bench "
+                             "--smoke --offload) on the repeat-viewer "
+                             "offload keys: origin offload ratio and "
+                             "peer byte-fetch hit rate (regress "
+                             "down), 304 latency (regresses up)")
     parser.add_argument("--key", action="append", default=None,
                         help="record key(s) to judge (default "
                              "service_tiles_per_sec, "
@@ -258,10 +272,13 @@ def main(argv=None) -> int:
         keys = MULTICHIP_KEYS
     elif args.sessions:
         keys = SESSIONS_KEYS
+    elif args.offload:
+        keys = OFFLOAD_KEYS
     else:
         keys = DEFAULT_KEYS
     pattern = (_MULTICHIP_RE if args.multichip
-               else _SESSIONS_RE if args.sessions else _BENCH_RE)
+               else _SESSIONS_RE if args.sessions
+               else _OFFLOAD_RE if args.offload else _BENCH_RE)
     try:
         if args.watermark:
             if args.dir:

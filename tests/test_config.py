@@ -507,3 +507,39 @@ def test_fault_injection_freeze_max_parses():
     with pytest.raises(ValueError, match="freeze-max"):
         AppConfig.from_dict({"fault-injection": {
             "seed": 1, "freeze-max": -1}})
+
+
+def test_http_cache_block_parses_and_validates():
+    """The `http-cache:` block (conditional HTTP + fleet peer byte
+    tier): example-file defaults, full parse, validation — the epoch
+    rides inside the quoted ETag header, so its charset is closed."""
+    from omero_ms_image_region_tpu.server.config import HttpCacheConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = HttpCacheConfig()
+    assert cfg.http_cache.enabled is defaults.enabled
+    assert cfg.http_cache.epoch == defaults.epoch
+    assert cfg.http_cache.max_age_s == defaults.max_age_s
+    assert cfg.http_cache.vary_acl is defaults.vary_acl
+    assert cfg.http_cache.peer_fetch is defaults.peer_fetch
+    assert cfg.http_cache.peer_timeout_ms == defaults.peer_timeout_ms
+
+    cfg = AppConfig.from_dict({"http-cache": {
+        "enabled": True, "epoch": "2026-08.r2", "max-age-s": 86400,
+        "vary-acl": False, "peer-fetch": False,
+        "peer-timeout-ms": 250.0}})
+    assert cfg.http_cache.enabled is True
+    assert cfg.http_cache.epoch == "2026-08.r2"
+    assert cfg.http_cache.max_age_s == 86400
+    assert cfg.http_cache.vary_acl is False
+    assert cfg.http_cache.peer_fetch is False
+    assert cfg.http_cache.peer_timeout_ms == 250.0
+
+    with pytest.raises(ValueError, match="epoch"):
+        AppConfig.from_dict({"http-cache": {"epoch": 'x"y'}})
+    with pytest.raises(ValueError, match="epoch"):
+        AppConfig.from_dict({"http-cache": {"epoch": ""}})
+    with pytest.raises(ValueError, match="max-age-s"):
+        AppConfig.from_dict({"http-cache": {"max-age-s": -1}})
+    with pytest.raises(ValueError, match="peer-timeout-ms"):
+        AppConfig.from_dict({"http-cache": {"peer-timeout-ms": 0}})

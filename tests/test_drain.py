@@ -160,6 +160,21 @@ class TestRollingRestartDrill:
                         member.services.raw_cache.max_bytes)
                     router.undrain_member(name)
                     assert name not in router.draining_members()
+                    # Pre-stage BACK (the PR 9 follow-on): the drain
+                    # manifest replays into the rejoined member, so
+                    # its shard is HBM-resident again BEFORE its
+                    # first routed request — a rolling restart ends
+                    # with a warm fleet, not a cold rejoiner.
+                    if shard_digests:
+                        task = router.last_undrain_prestage
+                        assert task is not None, \
+                            f"{name}: no pre-stage-back scheduled"
+                        await task
+                        back = set(member.resident_digests())
+                        assert shard_digests <= back, \
+                            f"{name}: rejoined cold " \
+                            f"({len(back)}/{len(shard_digests)} " \
+                            f"planes back)"
             finally:
                 stop.set()
                 await loader

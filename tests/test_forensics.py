@@ -485,6 +485,38 @@ class TestBenchGate:
         assert gate.main(["--sessions", "--dir", str(tmp_path)]) == 0
         capsys.readouterr()
 
+    def test_offload_keys_gated_direction_aware(self, tmp_path,
+                                                capsys):
+        """--offload judges OFFLOAD_r*.json on the repeat-viewer
+        offload keys, direction-aware by name: the offload ratio and
+        peer hit rate regress DOWN (less traffic absorbed off the
+        origin), the 304 latency is a ``_ms`` key and regresses UP."""
+        gate = self._gate()
+        good = {"origin_offload_ratio": 1.0, "peer_hit_rate": 1.0,
+                "p50_304_ms": 1.6}
+        self._write(tmp_path, "OFFLOAD_r01.json", good)
+        # Offload ratio DOWN 20% = regression.
+        self._write(tmp_path, "OFFLOAD_r02.json",
+                    {**good, "origin_offload_ratio": 0.8})
+        assert gate.main(["--offload", "--dir", str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["origin_offload_ratio"] == "regression"
+        assert by_key["p50_304_ms"] == "pass"
+        # 304 latency UP 10x = regression even with the ratios flat.
+        self._write(tmp_path, "OFFLOAD_r03.json",
+                    {**good, "p50_304_ms": 16.0})
+        assert gate.main(["--offload", "--dir", str(tmp_path)]) == 1
+        capsys.readouterr()
+        # Holding (or improving) every key passes.
+        self._write(tmp_path, "OFFLOAD_r04.json", good)
+        self._write(tmp_path, "OFFLOAD_r05.json",
+                    {**good, "p50_304_ms": 1.2})
+        assert gate.main(["--offload", "--dir", str(tmp_path)]) == 0
+        # BENCH records in the same dir are ignored under --offload.
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["new"] == "OFFLOAD_r05.json"
+
     def test_multichip_fleet_curve_gated(self, tmp_path, capsys):
         """--multichip judges MULTICHIP_r*.json on the fleet scaling
         keys: ok-true-only rounds (every record predating the curve)
@@ -838,6 +870,14 @@ class TestResetContract:
         telemetry.QOS.count_shed("interactive")
         telemetry.QOS.count_dequeued("bulk")
         telemetry.QOS.count_jump()
+        telemetry.HTTPCACHE.count_etag_request()
+        telemetry.HTTPCACHE.count_not_modified()
+        telemetry.HTTPCACHE.count_head()
+        telemetry.HTTPCACHE.count_peer_probe()
+        telemetry.HTTPCACHE.count_peer_hit()
+        telemetry.HTTPCACHE.count_peer_fetch()
+        telemetry.HTTPCACHE.count_peer_fallback()
+        telemetry.HTTPCACHE.count_peer_putback()
 
         telemetry.reset()
 
@@ -868,6 +908,15 @@ class TestResetContract:
         assert telemetry.QOS.shed == {}
         assert telemetry.QOS.dequeued == {}
         assert telemetry.QOS.jumps == 0
+        assert telemetry.HTTPCACHE.not_modified == 0
+        assert telemetry.HTTPCACHE.etag_requests == 0
+        assert telemetry.HTTPCACHE.head == 0
+        assert telemetry.HTTPCACHE.peer_probes == 0
+        assert telemetry.HTTPCACHE.peer_hits == 0
+        assert telemetry.HTTPCACHE.peer_fetches == 0
+        assert telemetry.HTTPCACHE.peer_fallbacks == 0
+        assert telemetry.HTTPCACHE.peer_putbacks == 0
+        assert telemetry.HTTPCACHE.metric_lines() == []
         assert telemetry.request_metric_lines() == [
             "imageregion_flight_events 0",
             "imageregion_flight_events_total 0",

@@ -1,0 +1,571 @@
+"""Edge-cache-grade conditional HTTP + the fleet-global byte tier.
+
+Three contracts under test:
+
+* **Golden ETag pin** — the ETag derivation is frozen byte-for-byte
+  for a corpus of canonical requests.  A changed ETag silently
+  invalidates every CDN edge at once, so derivation drift must fail
+  THIS test loudly, never ship silently.
+* **304/HEAD are free** — an ``If-None-Match`` hit answers 304 with
+  ZERO render work, zero admission debit and zero session-token
+  debit, asserted by counter deltas; error responses never carry the
+  cache headers.
+* **Peer byte tier** — the ``byte_probe``/``byte_fetch``/``byte_put``
+  wire ops move already-rendered bytes between fleet members (ACL
+  gated, digest verified), and the fleet drill proves a re-routed
+  viewer is served the draining owner's bytes byte-identically with
+  zero device work on the serving member.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from omero_ms_image_region_tpu.io.store import build_pyramid
+from omero_ms_image_region_tpu.server import httpcache
+from omero_ms_image_region_tpu.server.app import (SERVICES_KEY,
+                                                  create_app)
+from omero_ms_image_region_tpu.server.config import (
+    AppConfig, BatcherConfig, FleetConfig, RawCacheConfig,
+    RendererConfig, SessionsConfig, SidecarConfig)
+from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+from omero_ms_image_region_tpu.services.cache import CacheConfig
+from omero_ms_image_region_tpu.utils import telemetry
+from omero_ms_image_region_tpu.utils.stopwatch import \
+    REGISTRY as SPAN_REG
+
+IMG = 1
+H = W = 64
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    telemetry.reset()
+    SPAN_REG.reset()
+    yield
+    telemetry.reset()
+    SPAN_REG.reset()
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    rng = np.random.default_rng(5)
+    planes = rng.integers(0, 60000,
+                          size=(2, 1, H, W)).astype(np.uint16)
+    build_pyramid(planes, str(tmp_path / str(IMG)), chunk=(32, 32),
+                  n_levels=1)
+    return str(tmp_path)
+
+
+def _config(data_dir, **kw):
+    return AppConfig(
+        data_dir=data_dir,
+        batcher=BatcherConfig(enabled=False),
+        raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+        renderer=RendererConfig(cpu_fallback_max_px=0), **kw)
+
+
+URL = (f"/webgateway/render_image_region/{IMG}/0/0"
+       f"?c=1|0:60000$FF0000&m=g&format=png")
+
+
+def _renders() -> int:
+    snap = SPAN_REG.snapshot()
+    return (snap.get("Renderer.renderAsPackedInt", {}).get("count", 0)
+            + snap.get("Renderer.renderAsPackedInt.cpu",
+                       {}).get("count", 0))
+
+
+# --------------------------------------------------------- golden pin
+
+class TestGoldenEtagPin:
+    """The derivation contract, frozen.  Every expected string below
+    was computed once at introduction; a mismatch means the schema
+    changed and EVERY deployed CDN edge would silently invalidate —
+    bump the ``ir1`` schema prefix AND this corpus deliberately, never
+    accidentally."""
+
+    CORPUS = [
+        # (params, expected ETag under epoch "0")
+        ({"imageId": "1", "theZ": "0", "theT": "0",
+          "tile": "0,0,0,256,256", "format": "png", "m": "c",
+          "c": "1|0:60000$FF0000"},
+         '"ir1-0-4f9e21d1808ee49b6e7bf962"'),
+        # Identical params in a DIFFERENT insertion order: the
+        # identity sorts params, so the ETag is the same.
+        ({"c": "1|0:60000$FF0000", "m": "c", "format": "png",
+          "tile": "0,0,0,256,256", "theT": "0", "theZ": "0",
+          "imageId": "1"},
+         '"ir1-0-4f9e21d1808ee49b6e7bf962"'),
+        # Default-elision is a DISTINCT identity (the reference's key
+        # hashes the raw params): format omitted != format=jpeg.
+        # Pinned so the aliasing posture cannot drift silently.
+        ({"imageId": "1", "theZ": "0", "theT": "0",
+          "tile": "0,0,0,256,256", "m": "c",
+          "c": "1|0:60000$FF0000"},
+         '"ir1-0-1c5ffb3398d2b9ab7bbe690c"'),
+        ({"imageId": "1", "theZ": "0", "theT": "0",
+          "tile": "0,0,0,256,256", "format": "jpeg", "m": "c",
+          "c": "1|0:60000$FF0000"},
+         '"ir1-0-b5086cd2b74f1ef360cbdff4"'),
+        ({"imageId": "7", "theZ": "3", "theT": "1",
+          "region": "0,0,512,512", "q": "0.9",
+          "c": "1|100:50000$00FF00,-2"},
+         '"ir1-0-82d6b8e197630c9a14433631"'),
+        ({"imageId": "2", "theZ": "0", "theT": "0", "p": "intmax|0:5",
+          "c": "1|0:60000$FF0000", "m": "g"},
+         '"ir1-0-6c69376b4a42213e77bffeec"'),
+    ]
+
+    def test_corpus_pinned(self):
+        for params, expected in self.CORPUS:
+            ctx = ImageRegionCtx.from_params(dict(params), None)
+            assert httpcache.etag_for(ctx.cache_key, "0") == expected, \
+                f"ETag derivation drifted for {params}"
+
+    def test_epoch_rides_visibly_and_changes_the_tag(self):
+        ctx = ImageRegionCtx.from_params(dict(self.CORPUS[0][0]), None)
+        tagged = httpcache.etag_for(ctx.cache_key, "e9")
+        assert tagged == '"ir1-e9-a9fa1176a832c5c518311691"'
+        assert tagged != httpcache.etag_for(ctx.cache_key, "0")
+
+    def test_trailing_slash_aliases_through_the_route(self, data_dir):
+        """``/7/0/0/`` vs ``/7/0/0``: the wildcard tail never reaches
+        the params, so both URLs carry ONE ETag — an edge caching by
+        URL still revalidates either against the other's tag."""
+        async def scenario():
+            client = TestClient(TestServer(create_app(
+                _config(data_dir))))
+            await client.start_server()
+            try:
+                r1 = await client.get(URL)
+                await r1.read()
+                r2 = await client.get(URL.replace(
+                    f"/{IMG}/0/0?", f"/{IMG}/0/0/?"))
+                await r2.read()
+                assert r1.status == r2.status == 200
+                assert r1.headers["ETag"] == r2.headers["ETag"]
+                return r1.headers["ETag"]
+            finally:
+                await client.close()
+
+        etag = asyncio.run(scenario())
+        assert etag.startswith('"ir1-0-')
+
+    def test_if_none_match_grammar(self):
+        etag = '"ir1-0-abc"'
+        assert httpcache.if_none_match_matches(etag, etag)
+        assert httpcache.if_none_match_matches("*", etag)
+        assert httpcache.if_none_match_matches(
+            f'"zzz", W/{etag} , "yyy"', etag)
+        assert not httpcache.if_none_match_matches('"zzz"', etag)
+        assert not httpcache.if_none_match_matches(None, etag)
+        assert not httpcache.if_none_match_matches("", etag)
+
+
+# ------------------------------------------------- 304 / HEAD are free
+
+class TestConditionalAnswers:
+    def test_304_zero_render_zero_admission_zero_tokens(self,
+                                                        data_dir):
+        """THE acceptance criterion: an If-None-Match hit answers 304
+        with zero render work, zero admission debit and zero
+        session-token debit — by counter delta, not by vibes."""
+        config = _config(
+            data_dir,
+            sessions=SessionsConfig(enabled=True),
+            session_store_type="static")
+
+        async def scenario():
+            app = create_app(config)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                services = app[SERVICES_KEY]
+                admission = services.admission
+                buckets = admission.session_buckets
+                cookies = {"sessionid": "s1"}
+                r = await client.get(URL, cookies=cookies)
+                body = await r.read()
+                assert r.status == 200 and body
+                etag = r.headers["ETag"]
+                renders = _renders()
+                admitted = admission.admitted_total
+                taken = buckets.taken_total
+                r = await client.get(
+                    URL, headers={"If-None-Match": etag},
+                    cookies=cookies)
+                body = await r.read()
+                assert r.status == 304
+                assert body == b""
+                assert r.headers["ETag"] == etag
+                # Zero work, by delta: no render span, no admission
+                # slot, no fairness token.
+                assert _renders() == renders
+                assert admission.admitted_total == admitted
+                assert buckets.taken_total == taken
+                assert telemetry.HTTPCACHE.not_modified == 1
+                # The family is on /metrics.
+                m = await client.get("/metrics")
+                text = await m.text()
+                assert "imageregion_httpcache_304_total 1" in text
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+    def test_streaming_path_carries_and_revalidates_same_etag(
+            self, data_dir):
+        """The chunked path (wire.streaming on) emits the SAME ETag as
+        the unary path and revalidates to the same 304."""
+        config = _config(data_dir)
+        assert config.wire.streaming   # default-on; the test rides it
+
+        async def scenario():
+            client = TestClient(TestServer(create_app(config)))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                body = await r.read()
+                assert r.status == 200 and body
+                etag = r.headers["ETag"]
+                r = await client.get(
+                    URL, headers={"If-None-Match": etag})
+                await r.read()
+                assert r.status == 304
+                return etag
+            finally:
+                await client.close()
+
+        etag = asyncio.run(scenario())
+        ctx = ImageRegionCtx.from_params({
+            "imageId": str(IMG), "theZ": "0", "theT": "0",
+            "c": "1|0:60000$FF0000", "m": "g", "format": "png"}, None)
+        # The streamed response's tag IS the derivation's tag.
+        assert etag == httpcache.etag_for(ctx.cache_key, "0")
+
+    def test_head_is_renderless_and_matches_get_headers(self,
+                                                        data_dir):
+        async def scenario():
+            client = TestClient(TestServer(create_app(
+                _config(data_dir))))
+            await client.start_server()
+            try:
+                r = await client.head(URL)
+                assert r.status == 200
+                assert await r.read() == b""
+                assert r.headers["ETag"].startswith('"ir1-')
+                assert "Cache-Control" in r.headers
+                assert _renders() == 0          # never rendered
+                assert telemetry.HTTPCACHE.head == 1
+                # HEAD + If-None-Match revalidates like GET.
+                r2 = await client.head(URL, headers={
+                    "If-None-Match": r.headers["ETag"]})
+                assert r2.status == 304
+                # HEAD on a MISSING image keeps status fidelity: the
+                # renderless answer is gated on the ACL/exists check.
+                r3 = await client.head(
+                    URL.replace(f"/{IMG}/0/0", "/999/0/0"))
+                assert r3.status == 404
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+    def test_errors_carry_no_cache_headers(self, data_dir):
+        """The satellite audit, locked in: 4xx/5xx responses carry
+        neither Cache-Control nor ETag — an edge must never cache a
+        failure under a render identity."""
+        async def scenario():
+            client = TestClient(TestServer(create_app(
+                _config(data_dir))))
+            await client.start_server()
+            try:
+                # 400 (malformed tile), 404 (missing image), and a
+                # parse-level 400 (bad channel) — none cacheable.
+                for path in (
+                        f"/webgateway/render_image_region/{IMG}/0/0"
+                        f"?tile=nope",
+                        "/webgateway/render_image_region/999/0/0",
+                        f"/webgateway/render_image_region/{IMG}/0/0"
+                        f"?c=zz|",
+                ):
+                    r = await client.get(path)
+                    await r.read()
+                    assert r.status in (400, 404), path
+                    assert "Cache-Control" not in r.headers, path
+                    assert "ETag" not in r.headers, path
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+    def test_vary_posture_tracks_acl(self, data_dir):
+        """Public images are ``public`` with NO Vary (a cookie-blind
+        edge entry is safe for everyone); ACL-gated images are
+        ``private`` + ``Vary: Cookie`` so a shared cache keys entries
+        per session."""
+        acl_path = os.path.join(data_dir, str(IMG), "acl.json")
+
+        async def fetch_headers(session=None):
+            client = TestClient(TestServer(create_app(_config(
+                data_dir, session_store_type="static"))))
+            await client.start_server()
+            try:
+                cookies = ({"sessionid": session} if session else None)
+                r = await client.get(URL, cookies=cookies)
+                await r.read()
+                return r.status, dict(r.headers)
+            finally:
+                await client.close()
+
+        status, headers = asyncio.run(fetch_headers())
+        assert status == 200
+        assert headers["Cache-Control"].startswith("public")
+        assert "Vary" not in headers
+
+        with open(acl_path, "w") as f:
+            json.dump({"public": False, "sessions": ["s1"]}, f)
+        try:
+            status, headers = asyncio.run(fetch_headers(session="s1"))
+            assert status == 200
+            assert headers["Cache-Control"].startswith("private")
+            assert headers["Vary"] == "Cookie"
+        finally:
+            os.unlink(acl_path)
+
+    def test_quality_capped_response_is_never_cacheable(
+            self, data_dir, monkeypatch):
+        """A brownout-capped render must not be edge-cached under the
+        permanent render identity: the ETag is URL-pure, so a cached
+        degraded body would be 304-confirmed forever.  A capped 200
+        drops ETag/Vary and answers no-store."""
+        from omero_ms_image_region_tpu.server.handler import \
+            ImageRegionHandler
+
+        orig = ImageRegionHandler.render_image_region
+
+        async def capped(self, ctx, **kw):
+            data = await orig(self, ctx, **kw)
+            ctx._pressure_quality_capped = True   # the ladder's mark
+            return data
+
+        monkeypatch.setattr(ImageRegionHandler, "render_image_region",
+                            capped)
+
+        async def scenario():
+            client = TestClient(TestServer(create_app(
+                _config(data_dir))))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                body = await r.read()
+                assert r.status == 200 and body
+                assert "ETag" not in r.headers
+                assert "Vary" not in r.headers
+                assert r.headers["Cache-Control"] == "no-store"
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+    def test_legacy_cache_control_header_still_wins(self, data_dir):
+        """An explicitly configured cache-control-header string stays
+        the Cache-Control VALUE (operator policy); the ETag layer
+        still applies on top."""
+        async def scenario():
+            client = TestClient(TestServer(create_app(_config(
+                data_dir, cache_control_header="private, max-age=9"))))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                await r.read()
+                assert r.headers["Cache-Control"] == \
+                    "private, max-age=9"
+                assert "ETag" in r.headers
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+
+# ---------------------------------------------------- peer byte tier
+
+async def _wait_socket(sock, task):
+    for _ in range(400):
+        if task.done():
+            raise AssertionError(
+                f"sidecar died at startup: {task.exception()!r}")
+        if os.path.exists(sock):
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("sidecar socket never appeared")
+
+
+class TestPeerByteTier:
+    def _member_cfg(self, data_dir):
+        return AppConfig(
+            data_dir=data_dir,
+            caches=CacheConfig.enabled_all(),
+            batcher=BatcherConfig(enabled=False),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+
+    def test_byte_ops_roundtrip_acl_and_digest(self, data_dir,
+                                               tmp_path):
+        """The wire ops themselves: probe misses then hits, fetch is
+        ACL-gated per session and 404s on a miss, put is digest-
+        verified (a corrupt body can never poison the tier)."""
+        import hashlib
+
+        from omero_ms_image_region_tpu.server.sidecar import (
+            SidecarClient, run_sidecar)
+
+        sock = str(tmp_path / "peer.sock")
+        acl_path = os.path.join(data_dir, str(IMG), "acl.json")
+        with open(acl_path, "w") as f:
+            json.dump({"public": False, "sessions": ["alice"]}, f)
+
+        async def scenario():
+            task = asyncio.create_task(
+                run_sidecar(self._member_cfg(data_dir), sock))
+            await _wait_socket(sock, task)
+            client = SidecarClient(sock)
+            try:
+                value = b"rendered-bytes"
+                digest = hashlib.blake2b(
+                    value, digest_size=16).hexdigest()
+                status, body = await client.call(
+                    "byte_probe", {}, extra={"keys": ["k1", "k2"]})
+                assert status == 200
+                doc = json.loads(bytes(body).decode())
+                assert doc == {"enabled": True,
+                               "present": [False, False]}
+                # put with a WRONG digest is refused (400), never
+                # stored.
+                status, err = await client.call(
+                    "byte_put", {}, body=value,
+                    extra={"key": "k1", "digest": "0" * 32})
+                assert status == 400 and "digest" in str(err)
+                # honest put stores; probe flips.
+                status, body = await client.call(
+                    "byte_put", {}, body=value,
+                    extra={"key": "k1", "digest": digest})
+                assert status == 200
+                status, body = await client.call(
+                    "byte_probe", {}, extra={"keys": ["k1", "k2"]})
+                assert json.loads(bytes(body).decode())["present"] \
+                    == [True, False]
+                # fetch without ACL context returns the bytes.
+                status, body = await client.call(
+                    "byte_fetch", {}, extra={"key": "k1"})
+                assert status == 200 and bytes(body) == value
+                # ACL-gated fetch: the serving sidecar runs ITS gate
+                # for the caller's session — alice reads, bob 404s.
+                status, body = await client.call(
+                    "byte_fetch", {},
+                    extra={"key": "k1", "image_id": IMG,
+                           "session": "alice"})
+                assert status == 200 and bytes(body) == value
+                status, _ = await client.call(
+                    "byte_fetch", {},
+                    extra={"key": "k1", "image_id": IMG,
+                           "session": "bob"})
+                assert status == 404
+                # miss is 404, not an error.
+                status, _ = await client.call(
+                    "byte_fetch", {}, extra={"key": "nope"})
+                assert status == 404
+            finally:
+                await client.close()
+                task.cancel()
+                await asyncio.gather(task, return_exceptions=True)
+
+        try:
+            asyncio.run(scenario())
+        finally:
+            if os.path.exists(acl_path):
+                os.unlink(acl_path)
+
+    def test_fleet_drill_peer_serves_drained_owners_bytes(
+            self, data_dir, tmp_path):
+        """THE fleet acceptance drill: render on the ring owner, drain
+        it, request again — the surviving member serves bytes
+        BYTE-IDENTICAL to the origin render with zero device work
+        (peer fetch, not re-render), and the owner's tier answers the
+        probes."""
+        from omero_ms_image_region_tpu.server.app import \
+            FLEET_ROUTER_KEY
+        from omero_ms_image_region_tpu.server.sidecar import \
+            run_sidecar
+
+        socks = [str(tmp_path / f"m{i}.sock") for i in range(2)]
+        frontend_cfg = AppConfig(
+            data_dir=data_dir,
+            sidecar=SidecarConfig(role="frontend"),
+            fleet=FleetConfig(enabled=True, sockets=tuple(socks)))
+
+        params = [{
+            "imageId": str(IMG), "theZ": "0", "theT": "0",
+            "tile": f"0,{x},{y},32,32", "format": "png", "m": "g",
+            "c": "1|0:60000$FF0000"} for x in range(2)
+            for y in range(2)]
+
+        def url_of(p):
+            return (f"/webgateway/render_image_region/{IMG}/0/0"
+                    f"?tile={p['tile']}&format=png&m=g"
+                    f"&c=1|0:60000$FF0000")
+
+        async def scenario():
+            tasks = [asyncio.create_task(
+                run_sidecar(self._member_cfg(data_dir), sock))
+                for sock in socks]
+            for sock, task in zip(socks, tasks):
+                await _wait_socket(sock, task)
+            app = create_app(frontend_cfg)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            router = app[FLEET_ROUTER_KEY]
+            try:
+                ctxs = [ImageRegionCtx.from_params(dict(p), None)
+                        for p in params]
+                bodies = {}
+                for p in params:
+                    r = await client.get(url_of(p))
+                    body = await r.read()
+                    assert r.status == 200
+                    bodies[p["tile"]] = body
+                owners = {p["tile"]: router.owner_of(c)
+                          for p, c in zip(params, ctxs)}
+                victim = next(iter(set(owners.values())))
+                owned = [p for p in params
+                         if owners[p["tile"]] == victim]
+                assert owned, "victim owns nothing at this grid size"
+                await router.drain_member(victim, prestage=False,
+                                          settle_timeout_s=5.0)
+                renders = _renders()
+                hits0 = telemetry.HTTPCACHE.peer_hits
+                for p in owned:
+                    r = await client.get(url_of(p))
+                    body = await r.read()
+                    assert r.status == 200
+                    # Byte-identical to the origin render.
+                    assert body == bodies[p["tile"]]
+                # Zero device work anywhere: every re-routed request
+                # was a peer byte fetch, not a re-render.
+                assert _renders() == renders
+                assert telemetry.HTTPCACHE.peer_hits - hits0 \
+                    == len(owned)
+                assert telemetry.HTTPCACHE.peer_fetches >= len(owned)
+                router.undrain_member(victim)
+            finally:
+                await client.close()
+                for task in tasks:
+                    task.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+        asyncio.run(scenario())

@@ -108,6 +108,57 @@ def test_idempotent_ops_retry_plane_put_does_not(tmp_path):
     asyncio.run(scenario())
 
 
+def test_byte_tier_ops_retry_contract(tmp_path):
+    """The fleet-global byte tier's wire ops inherit the op-aware
+    retry contract: ``byte_probe``/``byte_fetch`` are pure reads and
+    retry through a dropped connection; ``byte_put`` — the peer
+    write-back — is NEVER blind-retried (the plane_put contract,
+    extended: a state-changing store the dead peer may or may not
+    have executed must surface, not silently re-run)."""
+    sock = str(tmp_path / "fake-bytes.sock")
+
+    async def scenario():
+        received = []
+
+        async def on_conn(reader, writer):
+            try:
+                while True:
+                    header, _body = await _read_frame(reader)
+                    received.append(header["op"])
+                    if received.count(header["op"]) == 1:
+                        writer.close()   # die under the first sight
+                        return
+                    writer.write(_pack({"id": header["id"],
+                                        "status": 200}, b"ok"))
+                    await writer.drain()
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                pass
+
+        server = await asyncio.start_unix_server(on_conn, path=sock)
+        client = SidecarClient(
+            sock, retry=RetryPolicy(max_attempts=3,
+                                    base_backoff_s=0.005, jitter=0.0))
+        try:
+            status, payload = await client.call(
+                "byte_fetch", {}, extra={"key": "k"})
+            assert status == 200 and bytes(payload) == b"ok"
+            assert received.count("byte_fetch") == 2    # one retry
+            status, payload = await client.call(
+                "byte_probe", {}, extra={"keys": ["k"]})
+            assert status == 200
+            assert received.count("byte_probe") == 2    # one retry
+            with pytest.raises(ConnectionError):
+                await client.call("byte_put", {}, body=b"\x00",
+                                  extra={"key": "k", "digest": "d"})
+            assert received.count("byte_put") == 1      # NO auto-retry
+        finally:
+            await client.close()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(scenario())
+
+
 # ------------------------------------------------------- circuit breaker
 
 def test_breaker_fails_fast_and_recovers(tmp_path):

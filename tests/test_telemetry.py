@@ -616,6 +616,30 @@ class TestExpositionLint:
                 '{class="interactive"} 1') in text
         assert "imageregion_qos_interactive_jumps_total 1" in text
 
+    def test_httpcache_family_lints_and_resets(self):
+        """The imageregion_httpcache_* families (304s / renderless
+        HEADs / peer probe-fetch-fallback-putback) lint under the
+        closed (label-free) schema, ride request_metric_lines, stay
+        quiet until traffic, and clear on reset()."""
+        assert telemetry.HTTPCACHE.metric_lines() == []
+        telemetry.HTTPCACHE.count_etag_request()
+        telemetry.HTTPCACHE.count_not_modified()
+        telemetry.HTTPCACHE.count_head()
+        telemetry.HTTPCACHE.count_peer_probe()
+        telemetry.HTTPCACHE.count_peer_hit()
+        telemetry.HTTPCACHE.count_peer_fetch()
+        telemetry.HTTPCACHE.count_peer_fallback()
+        telemetry.HTTPCACHE.count_peer_putback()
+        text = telemetry.finalize_exposition(
+            telemetry.request_metric_lines())
+        _lint_exposition(text)
+        for family in ("etag_requests", "304", "head", "peer_probes",
+                       "peer_hits", "peer_fetches", "peer_fallbacks",
+                       "peer_putbacks"):
+            assert f"imageregion_httpcache_{family}_total 1" in text
+        telemetry.reset()
+        assert telemetry.HTTPCACHE.metric_lines() == []
+
     def test_fleet_app_metrics_parse(self, data_dir):
         """A combined-role fleet app exposes the imageregion_fleet_*
         families — per-member gauges under the closed ``member``

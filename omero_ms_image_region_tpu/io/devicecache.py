@@ -265,6 +265,14 @@ class DeviceRawCache:
         with self._lock:
             return set(self._keys_by_digest)
 
+    def resident_route(self, route_key: str) -> bool:
+        """Residency by ROUTING identity (``plane_route_key``), no LRU
+        bump: the explain plane's "is this plane warm on its owner"
+        probe.  O(resident entries) over the recorded routes —
+        operator-surface economics, never on the serving path."""
+        with self._lock:
+            return route_key in self._route_of.values()
+
     def evict_to_fraction(self, frac: float) -> int:
         """Brownout eviction (server.pressure "evict_caches"): walk
         LRU-first until resident bytes are at most ``frac`` of the

@@ -264,6 +264,17 @@ class LocalMember:
         await stack.set(str(key), bytes(value))
         return True
 
+    async def explain_residency(self, key: str, route: str) -> dict:
+        """Dry-run residency report for the explain plane: does this
+        member hold the rendered bytes (and in which tier) and/or the
+        source plane in HBM?  Read-only — no render, no staging.  ONE
+        shared implementation (``server.explain.residency_doc``) so
+        combined, fleet-local and remote members cannot drift."""
+        from ..server.explain import residency_doc
+        return await residency_doc(
+            self._byte_stack(),
+            getattr(self.services, "raw_cache", None), key, route)
+
     async def prestage_manifest(self, entries: List[dict]) -> int:
         """Stage a handed-over shard manifest into THIS member's HBM
         (drain handoff, successor side) through the existing staging
@@ -304,6 +315,13 @@ class RemoteMember:
     def __init__(self, name: str, client, down_cooldown_s: float = 5.0):
         self.name = name
         self.client = client
+        # Stitching dimension: spans the client grafts from this
+        # member's process carry its fleet name, so a stolen or
+        # failed-over render reads as a multi-member tree.
+        try:
+            client.member_label = name
+        except AttributeError:      # duck-typed test clients
+            pass
         self.down_cooldown_s = down_cooldown_s
         self._down_until = 0.0
         self.draining = False
@@ -323,10 +341,12 @@ class RemoteMember:
 
     async def render(self, ctx, adopt_cache: bool = True) -> bytes:
         from ..server.sidecar import _map_response
+        from ..utils import provenance
         extra = None if adopt_cache else {"adopt": 0}
         resp_header, payload = await self.client.call_full(
             "image", ctx.to_json(), extra=extra)
         self.revive()          # a served call re-admits the member
+        provenance.merge_wire(ctx, resp_header.get("prov"))
         if resp_header.get("quality_capped"):
             # The sidecar's brownout ladder capped this render's JPEG
             # quality: mirror the mark onto the FRONTEND's ctx so the
@@ -411,6 +431,19 @@ class RemoteMember:
         except Exception:
             return []
 
+    async def explain_residency(self, key: str, route: str) -> dict:
+        """Residency report over the read-only ``explain`` wire op;
+        unreachable/legacy sidecars answer an honest unknown."""
+        import json as _json
+        try:
+            status, body = await self.client.call(
+                "explain", {}, extra={"key": key, "route": route})
+            if status != 200 or not body:
+                return {"error": f"explain op status {status}"}
+            return dict(_json.loads(bytes(body).decode()))
+        except Exception as e:
+            return {"error": str(e)[:120]}
+
     async def prestage_manifest(self, entries: List[dict]) -> int:
         """Hand the drained shard's hint list to this sidecar
         (``prestage`` op): it re-reads the regions from its own pixel
@@ -431,7 +464,8 @@ class RemoteMember:
 
 class _Work:
     __slots__ = ("ctx", "future", "owner", "stolen", "hops",
-                 "deadline", "t_enqueue", "bulk")
+                 "deadline", "t_enqueue", "bulk", "trace_ids",
+                 "route_key")
 
     def __init__(self, ctx, future, owner: str, deadline):
         self.ctx = ctx
@@ -441,11 +475,26 @@ class _Work:
         self.hops = 0
         self.deadline = deadline
         self.t_enqueue = time.perf_counter()
+        # The requester's trace id(s), captured at enqueue: the lane
+        # tasks run OUTSIDE any request context (they must — a lane is
+        # long-lived), so every hop span and the member render itself
+        # re-adopt these explicitly.  Without this, every lane span
+        # would attach to whichever request's context happened to
+        # spawn the lanes (the classic contextvars-snapshot leak).
+        from ..utils import telemetry
+        self.trace_ids = telemetry.current_trace_ids()
         # QoS class, computed ONCE at enqueue: the same
         # ``pressure.is_bulk`` verdict the ladder's shed_bulk step and
         # the mesh-lane pin use — the three must never drift apart.
         from ..server.pressure import is_bulk
         self.bulk = is_bulk(ctx)
+        # Routed plane identity (short hash) for hop-span forensics;
+        # pinned/bulk work carries the literal "pinned".  Only hashed
+        # when a trace is listening (pay-for-what-you-use: untraced
+        # internal dispatches skip the digest).
+        self.route_key = ("pinned" if self.bulk
+                          else plane_route_key(ctx)[:12]
+                          if self.trace_ids else "")
 
 
 class _MemberQueue:
@@ -741,7 +790,7 @@ class FleetRouter:
         # Queued work re-homes NOW (the lanes would drain it anyway,
         # but re-homing bounds the drain's tail latency by the
         # in-flight work only).
-        self._reassign(name)
+        self._reassign(name, reason="drain")
         t0 = _time.monotonic()
         while (self._inflight[name] > 0
                and _time.monotonic() - t0 < settle_timeout_s):
@@ -884,6 +933,15 @@ class FleetRouter:
         owner = self.owner_of(ctx)
         work = _Work(ctx, asyncio.get_running_loop().create_future(),
                      owner, transient.deadline())
+        if work.trace_ids:
+            # Hop 1 of the stitched waterfall: the ROUTE decision —
+            # which member's shard this plane hashed to.  Zero-width
+            # span at enqueue time; the render hop below shows where
+            # the work actually ran (steal/failover may move it).
+            telemetry.record_span(
+                "fleet.hop", work.t_enqueue, 0.0,
+                trace_ids=work.trace_ids, member=owner, hop="route",
+                plane=work.route_key)
         self._queues[owner].append(work)
         telemetry.FLEET.count_routed(owner)
         self._wake.set()
@@ -948,6 +1006,7 @@ class FleetRouter:
             # visible on /metrics rather than reading as cold tiles.
             telemetry.HTTPCACHE.count_peer_probe()
             key = ctx.cache_key    # == settings.render_identity_key
+            t0 = time.perf_counter()
             try:
                 data = await asyncio.wait_for(
                     member.byte_fetch(key, image_id=ctx.image_id,
@@ -962,6 +1021,15 @@ class FleetRouter:
                 return None
             telemetry.HTTPCACHE.count_peer_hit()
             telemetry.HTTPCACHE.count_peer_fetch()
+            # Hop span (request context — fetch runs in the handler)
+            # + provenance: the bytes came from a PEER's tier.
+            telemetry.record_span(
+                "fleet.hop", t0,
+                (time.perf_counter() - t0) * 1000.0,
+                member=name, hop="byte_fetch",
+                plane=plane_route_key(ctx)[:12])
+            from ..utils import provenance
+            provenance.mark(ctx, tier="peer", member=name)
             telemetry.FLIGHT.record("fleet.byte-peer",
                                     authority=name,
                                     serving=serving,
@@ -986,6 +1054,15 @@ class FleetRouter:
             return
         from ..utils import telemetry
         key = work.ctx.cache_key   # == settings.render_identity_key
+        if work.trace_ids:
+            # Hop: the write-back SHIP (recorded synchronously, before
+            # the requester's trace finishes — the put itself is
+            # fire-and-forget and lands after the response; its
+            # completion is the peer_putbacks counter + flight event).
+            telemetry.record_span(
+                "fleet.hop", time.perf_counter(), 0.0,
+                trace_ids=work.trace_ids, member=work.owner,
+                hop="byte_put", plane=work.route_key)
 
         async def put() -> None:
             try:
@@ -1047,18 +1124,27 @@ class FleetRouter:
         telemetry.FLEET.count_stolen(name)
         telemetry.FLIGHT.record("fleet.steal", by=name,
                                 owner=work.owner, backlog=depth)
+        if work.trace_ids:
+            # Hop: the steal decision — this unit leaves its owner's
+            # queue for the thief's lane (cache-ownership-neutral).
+            telemetry.record_span(
+                "fleet.hop", time.perf_counter(), 0.0,
+                trace_ids=work.trace_ids, member=name, hop="steal",
+                plane=work.route_key)
         return work
 
-    def _reassign(self, dead: str) -> None:
-        """A member died: move its queued work to each item's
-        hash-ring-next healthy owner (the failover shard owner — the
-        work ADOPTS there, it is not a steal)."""
+    def _reassign(self, dead: str, reason: str = "failover") -> None:
+        """A member died (or is draining): move its queued work to
+        each item's hash-ring-next healthy owner (the failover shard
+        owner — the work ADOPTS there, it is not a steal).  ``reason``
+        distinguishes the death remap from the operator-ordered drain
+        re-home on the hop spans and provenance flags."""
         from ..utils import telemetry
         queue = self._queues[dead]
         moved = 0
         while queue:
             work = queue.pop_raw()
-            self._route_failover(work)
+            self._route_failover(work, reason=reason)
             moved += 1
         if moved:
             telemetry.FLIGHT.record("fleet.drain", member=dead,
@@ -1073,7 +1159,8 @@ class FleetRouter:
             if not work.future.done():
                 work.future.set_exception(ConnectionError(str(error)))
 
-    def _route_failover(self, work: _Work) -> None:
+    def _route_failover(self, work: _Work,
+                        reason: str = "failover") -> None:
         """Re-enqueue one unit on the first healthy ring member.  The
         member that just failed is excluded by the health check alone
         (it was marked down before this runs) — NOT by ``work.owner``:
@@ -1081,7 +1168,7 @@ class FleetRouter:
         failed, and it is exactly where the unit should land (a dead
         stealer's loot goes home; a 2-member fleet must not 503 a
         request whose shard owner is alive)."""
-        from ..utils import telemetry
+        from ..utils import provenance, telemetry
         chain = (list(self.order) if self._pinned(work.ctx)
                  else self.ring.chain(plane_route_key(work.ctx)))
         tried = work.hops
@@ -1093,14 +1180,31 @@ class FleetRouter:
             work.stolen = False
             self._queues[name].append(work)
             telemetry.FLEET.count_failed_over(name)
+            if work.trace_ids:
+                # Hop: the re-home — "drain" when an operator ordered
+                # it, "failover" when a death did.
+                telemetry.record_span(
+                    "fleet.hop", time.perf_counter(), 0.0,
+                    trace_ids=work.trace_ids, member=name, hop=reason,
+                    plane=work.route_key)
+            provenance.mark(
+                work.ctx,
+                **{("drain_rehomed" if reason == "drain"
+                    else "failed_over"): True})
             return
         if not work.future.done():
             work.future.set_exception(ConnectionError(
                 "no healthy fleet member for shard"))
 
     async def _lane(self, name: str) -> None:
-        from ..utils import telemetry, transient
+        from ..utils import provenance, telemetry, transient
 
+        # Lanes are long-lived tasks spawned from the FIRST request's
+        # context; detach from its trace ids or every span any render
+        # ever records here would graft onto that one request's
+        # waterfall (each unit re-adopts its own ids around its
+        # render below).
+        telemetry.clear_context()
         member = self.members[name]
         while not self._closed:
             work = self._pop_work(name)
@@ -1125,6 +1229,13 @@ class FleetRouter:
                             "deadline exceeded in fleet queue"))
                 continue
             self._inflight[name] += 1
+            # Provenance: the member actually serving, and how the
+            # unit got there (marked before the render so a failing
+            # member still leaves an attributable record).
+            provenance.mark(work.ctx, member=name,
+                            **({"stolen": True} if work.stolen
+                               else {}))
+            t_render = time.perf_counter()
             try:
                 # A stolen render executes on THIS member from source
                 # bytes without adopting cache ownership; owned (and
@@ -1133,16 +1244,21 @@ class FleetRouter:
                 # budget re-enters the context here (the lane task
                 # itself is deadline-free), so the member pipeline's
                 # own check_deadline / wire deadline_ms still bite.
-                if work.deadline is not None:
-                    remaining_ms = max(
-                        1.0, (work.deadline - time.monotonic())
-                        * 1000.0)
-                    with transient.deadline_scope(remaining_ms):
+                # The unit's OWN trace ids re-enter too (group_trace):
+                # member-side spans — and, for remote members, the
+                # trace id riding the wire — attach to the requester's
+                # waterfall, not to whatever context spawned the lane.
+                with telemetry.group_trace(work.trace_ids):
+                    if work.deadline is not None:
+                        remaining_ms = max(
+                            1.0, (work.deadline - time.monotonic())
+                            * 1000.0)
+                        with transient.deadline_scope(remaining_ms):
+                            data = await member.render(
+                                work.ctx, adopt_cache=not work.stolen)
+                    else:
                         data = await member.render(
                             work.ctx, adopt_cache=not work.stolen)
-                else:
-                    data = await member.render(
-                        work.ctx, adopt_cache=not work.stolen)
             except (ConnectionError, OSError) as e:
                 if not member.remote \
                         and not isinstance(e, ConnectionError):
@@ -1193,6 +1309,17 @@ class FleetRouter:
                 if not work.future.done():
                     work.future.set_exception(e)
             else:
+                if work.trace_ids:
+                    # The render hop itself: which member executed,
+                    # and under what acquisition (owned / stolen /
+                    # failed-over) — the widest lane of the stitched
+                    # waterfall.
+                    telemetry.record_span(
+                        "fleet.hop", t_render,
+                        (time.perf_counter() - t_render) * 1000.0,
+                        trace_ids=work.trace_ids, member=name,
+                        hop="render", plane=work.route_key,
+                        **({"stolen": 1} if work.stolen else {}))
                 if not work.future.done():
                     work.future.set_result(data)
                 if work.stolen:
@@ -1276,9 +1403,11 @@ class FleetImageHandler:
             return None
         from ..server.errors import NotFoundError
         from ..server.handler import check_can_read
-        from ..utils import telemetry
+        from ..services.cache import get_with_tier
+        from ..utils import provenance, telemetry
         t0 = time.perf_counter()
-        cached = await self.s.caches.image_region.get(ctx.cache_key)
+        cached, tier_label = await get_with_tier(
+            self.s.caches.image_region, ctx.cache_key)
         if cached is None:
             return None
         if not await check_can_read(self.s, "Image", ctx.image_id,
@@ -1286,6 +1415,8 @@ class FleetImageHandler:
             raise NotFoundError(f"Cannot find Image:{ctx.image_id}")
         telemetry.record_span("cache.hit", t0,
                               (time.perf_counter() - t0) * 1000.0)
+        provenance.mark(ctx, tier=("disk" if tier_label == "disk"
+                                   else "byte_cache"))
         return cached
 
     async def render_image_region(self, ctx) -> bytes:
@@ -1340,6 +1471,9 @@ class FleetImageHandler:
         # and sheds only itself.
         debit = admission.admit_session(ctx) if admission is not None \
             else None
+        if debit is not None:
+            from ..utils import provenance
+            provenance.mark(ctx, tokens=debit[1])
 
         async def produce() -> bytes:
             from ..server.pressure import shed_bulk_under_pressure
@@ -1360,6 +1494,8 @@ class FleetImageHandler:
                             or self.router.healthy_members()):
                         raise
                     telemetry.RESILIENCE.count_degraded_render()
+                    from ..utils import provenance
+                    provenance.mark(ctx, tier="degraded")
                     data = await \
                         self.fallback.render_image_region(ctx)
                 completed = True
@@ -1404,6 +1540,8 @@ class FleetImageHandler:
             telemetry.record_span(
                 "dedup.coalesced", t0,
                 (time.perf_counter() - t0) * 1000.0)
+            from ..utils import provenance
+            provenance.mark(ctx, coalesced=True)
         return data
 
     async def render_image_region_stream(self, ctx):

@@ -33,7 +33,7 @@ from ..services.cache import Caches
 from ..services.metadata import CanReadMemo, LocalMetadataService
 from ..services.sessions import (DjangoRedisSessionStore, SessionStore,
                                  StaticSessionStore, resolve_session_key)
-from ..utils import telemetry
+from ..utils import provenance, telemetry
 from .config import AppConfig
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
 from .errors import NotFoundError
@@ -448,6 +448,33 @@ def create_app(config: Optional[AppConfig] = None,
                   and config.sidecar.role == "frontend"
                   and not fleet_remote)
 
+    if config.http_cache.enabled \
+            and config.http_cache.epoch == "auto":
+        # ``http-cache.epoch: auto``: derive the deployment epoch
+        # from the data tree's ingest/source mtimes ONCE at startup
+        # (re-ingesting any image bumps it on the next boot/roll); an
+        # explicit operator value skips this entirely.  A derivation
+        # that found NOTHING on a device-free frontend is a config
+        # error, not a silent "0": the frontend is exactly where the
+        # ETags are emitted, and a never-bumping auto epoch would
+        # keep edge caches 304-confirming stale renders forever —
+        # the failure the knob exists to prevent.
+        from . import httpcache as _hc
+        derived = _hc.derive_epoch(config.data_dir)
+        if derived == "0" and (fleet_remote or proxy_mode):
+            raise ValueError(
+                "http-cache.epoch: auto found no ingest stamps under "
+                f"data-dir {config.data_dir!r} — device-free "
+                "frontends have no local source tree; set an "
+                "explicit epoch (or mount the data tree read-only)")
+        if derived == "0":
+            log.warning("http-cache.epoch: auto derived '0' (no "
+                        "ingest stamps under %r) — epoch bumps will "
+                        "not happen until images exist",
+                        config.data_dir)
+        config.http_cache.epoch = derived
+        log.info("http-cache.epoch: auto -> %r", derived)
+
     def _sidecar_client(socket_path: str):
         from ..utils.transient import CircuitBreaker, RetryPolicy
         from .sidecar import SidecarClient
@@ -777,24 +804,68 @@ def create_app(config: Optional[AppConfig] = None,
             headers["Vary"] = vary
         return etag
 
+    async def _source_mtime(object_type: str,
+                            object_id: int) -> Optional[float]:
+        """The object's ingest/source mtime for Last-Modified, via
+        the metadata path (combined role only — proxy/fleet frontends
+        have no local source tree; their sidecars' ETags still give
+        clients free revalidation).  Images only: the mask metadata
+        path has no ingest stamp worth lying about."""
+        if (services is None or object_type != "Image"
+                or not config.http_cache.enabled):
+            return None
+        mtime_fn = getattr(services.metadata, "source_mtime", None)
+        if mtime_fn is None:
+            return None
+        peek = getattr(services.metadata, "source_mtime_cached", None)
+        if peek is not None:
+            # Inline memo fast path: within the TTL this is a lock +
+            # dict hit — the thread-pool hop would cost more than the
+            # lookup (the handler.py fast-path economics).
+            hit, value = peek(object_id)
+            if hit:
+                return value
+        import asyncio as _asyncio
+        try:
+            return await _asyncio.to_thread(mtime_fn, object_id)
+        except Exception:
+            return None
+
     async def _conditional_answer(request: web.Request, headers: dict,
                                   etag: Optional[str],
-                                  revalidate_ok) -> Optional[web.Response]:
+                                  revalidate_ok,
+                                  mtime: Optional[float] = None
+                                  ) -> Optional[web.Response]:
         """The renderless answers, checked BEFORE fairness buckets,
         single-flight and admission ever see the request: a matching
-        ``If-None-Match`` is a 304, a ``HEAD`` is headers-only.  Both
-        carry the same ETag/Cache-Control/Vary as the 200 they stand
-        in for.  ``revalidate_ok`` is the per-caller ACL gate — a
-        session that cannot read the object falls through to the
-        render path and gets its honest 404 there."""
-        if etag is not None:
-            inm = request.headers.get("If-None-Match")
-            if inm:
-                telemetry.HTTPCACHE.count_etag_request()
-                if httpcache.if_none_match_matches(inm, etag) \
-                        and await revalidate_ok():
-                    telemetry.HTTPCACHE.count_not_modified()
-                    return web.Response(status=304, headers=headers)
+        ``If-None-Match`` is a 304, an ``If-Modified-Since``-only
+        request against a fresh source mtime is a 304 (ETag WINS when
+        both are present — RFC 9110 says evaluate If-None-Match and
+        ignore If-Modified-Since then), a ``HEAD`` is headers-only.
+        All carry the same ETag/Cache-Control/Vary (+ Last-Modified)
+        as the 200 they stand in for.  ``revalidate_ok`` is the
+        per-caller ACL gate — a session that cannot read the object
+        falls through to the render path and gets its honest 404
+        there."""
+        inm = request.headers.get("If-None-Match")
+        if etag is not None and inm:
+            telemetry.HTTPCACHE.count_etag_request()
+            if httpcache.if_none_match_matches(inm, etag) \
+                    and await revalidate_ok():
+                telemetry.HTTPCACHE.count_not_modified()
+                return web.Response(status=304, headers=headers)
+        elif not inm and mtime is not None \
+                and request.headers.get("If-Modified-Since"):
+            # The If-Modified-Since-only client (no ETag stored):
+            # same zero-work contract as If-None-Match — answered
+            # before fairness/single-flight/admission, ACL-gated per
+            # caller.
+            telemetry.HTTPCACHE.count_ims_request()
+            if httpcache.not_modified_since(
+                    request.headers.get("If-Modified-Since"), mtime) \
+                    and await revalidate_ok():
+                telemetry.HTTPCACHE.count_not_modified()
+                return web.Response(status=304, headers=headers)
         if request.method == "HEAD" and services is not None:
             # Headers-only when the caller could read the object (the
             # memoized ACL check, no render); an unreadable or missing
@@ -822,6 +893,19 @@ def create_app(config: Optional[AppConfig] = None,
             headers.pop("ETag", None)
             headers.pop("Vary", None)
             headers["Cache-Control"] = "no-store"
+
+    def _stamp_provenance(ctx, headers: dict) -> None:
+        """Opt-in debug header (telemetry.provenance-header): the
+        response's provenance record, compact.  Success paths ONLY —
+        every error/status mapping skips this, so a failure can never
+        carry (or cache) a provenance claim."""
+        if not config.telemetry.provenance_header:
+            return
+        record = provenance.assemble(
+            ctx, 200, telemetry.current_trace_id())
+        value = provenance.header_value(record)
+        if value:
+            headers["X-Image-Region-Provenance"] = value
 
     def _can_revalidate(object_type: str, object_id: int, session_key):
         """Per-caller gate for the 304 path.  Combined role runs the
@@ -858,17 +942,34 @@ def create_app(config: Optional[AppConfig] = None,
             # deliberately carry NO Cache-Control/ETag: an edge must
             # never cache a failure under a render identity.
             return web.Response(status=400, text=str(e))
+        request["prov_ctx"] = ctx
         headers = {
             "Content-Type": codecs.CONTENT_TYPES.get(
                 ctx.format, "application/octet-stream"),
         }
         etag = await _cache_headers(headers, ctx.cache_key, "Image",
                                     ctx.image_id)
+        # The Last-Modified basis folds the cache EPOCH with the
+        # source mtime (httpcache.last_modified_basis): an epoch bump
+        # must stale IMS-only clients exactly like it stales ETags —
+        # un-ordered operator epochs disarm this leg entirely.
+        mtime = httpcache.last_modified_basis(
+            await _source_mtime("Image", ctx.image_id),
+            config.http_cache.epoch)
+        if mtime is not None:
+            # Last-Modified on every cacheable answer (200 and the
+            # 304s below): If-Modified-Since-only clients get free
+            # revalidation; conditional caches store an honest stamp.
+            headers["Last-Modified"] = httpcache.http_date(mtime)
         renderless = await _conditional_answer(
             request, headers, etag,
             _can_revalidate("Image", ctx.image_id,
-                            ctx.omero_session_key))
+                            ctx.omero_session_key), mtime=mtime)
         if renderless is not None:
+            # Renderless HEADs share the 304 provenance tier: the
+            # zero-byte conditional class (actual 304s override by
+            # status anyway).
+            provenance.mark(ctx, tier="304")
             return renderless
         stream_fn = (getattr(image_handler,
                              "render_image_region_stream", None)
@@ -879,6 +980,7 @@ def create_app(config: Optional[AppConfig] = None,
             except Exception as e:
                 return _status_of(e)
             _strip_cache_headers_if_degraded(ctx, headers)
+            _stamp_provenance(ctx, headers)
             return web.Response(body=body, headers=headers)
         # Progressive first-byte-out response (wire v3 leg 2): the
         # body leaves as an HTTP chunked response, each chunk written
@@ -902,6 +1004,15 @@ def create_app(config: Optional[AppConfig] = None,
         # them to the byte tier, and streaming under brownout is the
         # degraded exception, not the cacheable steady state.
         _strip_cache_headers_if_degraded(ctx, headers)
+        if not proxy_mode:
+            # Combined/fleet streams settle the whole body before the
+            # first chunk yields, so the marks are complete here.  A
+            # PLAIN PROXY stream only learns the sidecar's marks on
+            # the fin frame — after headers left — so it skips the
+            # header rather than echo a half-assembled record (the
+            # access log and counters, computed post-fin, stay
+            # complete and authoritative for that posture).
+            _stamp_provenance(ctx, headers)
         resp = web.StreamResponse(headers=headers)
         nbytes = 0
         try:
@@ -947,6 +1058,7 @@ def create_app(config: Optional[AppConfig] = None,
             return web.Response(status=403)
         except BadRequestError as e:
             return web.Response(status=400, text=str(e))
+        request["prov_ctx"] = ctx
         headers = {"Content-Type": "image/png"}
         # The mask's BYTE-cache key keeps the reference's exact
         # id:color format; the ETag identity additionally folds the
@@ -962,19 +1074,43 @@ def create_app(config: Optional[AppConfig] = None,
             _can_revalidate("Mask", ctx.shape_id,
                             ctx.omero_session_key))
         if renderless is not None:
+            provenance.mark(ctx, tier="304")
             return renderless
         try:
             body = await mask_handler.render_shape_mask(ctx)
         except Exception as e:
             return _status_of(e)
+        _stamp_provenance(ctx, headers)
         return web.Response(body=body, headers=headers)
 
     def _finish_request(route: str, status: int, nbytes: int,
-                        total_ms: float, trace) -> None:
-        """Post-response accounting: request histogram + totals, the
+                        total_ms: float, trace,
+                        prov_ctx=None) -> None:
+        """Post-response accounting: request histogram + totals (with
+        a trace-id + provenance-tier EXEMPLAR per latency bucket), the
         SLO windows, the cost ledger (histograms + top-K), the
-        structured access line, and the slow-request waterfall dump."""
-        telemetry.REQUEST_HIST.observe(route, total_ms)
+        provenance record (counters + access line), and the
+        slow-request waterfall dump."""
+        record = None
+        if prov_ctx is not None and status < 400:
+            # The response's provenance record: errors stay out of the
+            # tier counters (their tier claim would be a guess), the
+            # 499 abort path never reaches here.
+            record = provenance.assemble(
+                prov_ctx, status,
+                trace.trace_id if trace is not None else None)
+            telemetry.PROVENANCE.count(record)
+        exemplar = None
+        if trace is not None and record is not None:
+            # Bucket exemplar: this trace id (+ its provenance tier)
+            # becomes the bucket's pullable example — the p99 bucket
+            # then NAMES a waterfall (closing the metrics->trace
+            # loop).  Success-only, like the record itself: an error
+            # response must not land in a bucket slot wearing a
+            # fabricated tier.
+            exemplar = (trace.trace_id, record["tier"])
+        telemetry.REQUEST_HIST.observe(route, total_ms,
+                                       exemplar=exemplar)
         telemetry.count_request(route, status)
         telemetry.SLO.record(status, total_ms)
         if status >= 500:
@@ -1003,7 +1139,7 @@ def create_app(config: Optional[AppConfig] = None,
                 render_ms = max(0.0, render_ms - queue_ms)
             encode_ms = trace.span_ms("encodeImage",
                                       "jfif.encodeBatch")
-            access_log.info("%s", json.dumps({
+            line = {
                 "ts": round(trace.wall_ts, 3),
                 "trace": trace.trace_id,
                 "route": route,
@@ -1015,12 +1151,20 @@ def create_app(config: Optional[AppConfig] = None,
                 "encode_ms": encode_ms,
                 "cache": cache_class,
                 "cost": ledger,
-            }))
+            }
+            if record is not None:
+                # The provenance record, verbatim: tier, member,
+                # flags, QoS class, ladder prefix, tokens charged.
+                line["prov"] = {k: v for k, v in record.items()
+                                if k != "trace"}
+            access_log.info("%s", json.dumps(line))
         if (config.telemetry.slow_request_ms > 0
                 and total_ms >= config.telemetry.slow_request_ms):
             path = telemetry.dump_slow_trace(
                 trace, total_ms, status,
-                config.telemetry.slow_request_dir)
+                config.telemetry.slow_request_dir,
+                extra=({"prov": record} if record is not None
+                       else None))
             if path:
                 log.warning("slow request %s (%.0f ms) on %s: "
                             "waterfall dumped to %s", trace.trace_id,
@@ -1060,7 +1204,8 @@ def create_app(config: Optional[AppConfig] = None,
                 body = getattr(resp, "body", None)
                 nbytes = len(body) if body else 0
             _finish_request(route, resp.status, nbytes,
-                            total_ms, trace)
+                            total_ms, trace,
+                            prov_ctx=request.get("prov_ctx"))
             return resp
 
         return wrapper
@@ -1074,7 +1219,14 @@ def create_app(config: Optional[AppConfig] = None,
         once per family by the shared finalizer."""
         from ..utils.stopwatch import span_lines
 
-        lines = telemetry.request_metric_lines()
+        # Exemplars are OpenMetrics syntax; the classic text/plain
+        # parser rejects them (one tail would fail the whole scrape),
+        # so they ride ONLY a scrape that negotiated the OpenMetrics
+        # exposition.  /debug/exemplars serves the same data as JSON
+        # for everything else.
+        openmetrics = ("application/openmetrics-text"
+                       in request.headers.get("Accept", ""))
+        lines = telemetry.request_metric_lines(exemplars=openmetrics)
         lines += span_lines()
         # Fault-tolerance series: breaker state (proxy mode), sheds,
         # retries, deadline cancellations, supervisor restarts.
@@ -1113,8 +1265,19 @@ def create_app(config: Optional[AppConfig] = None,
                 lines.append("# sidecar metrics unavailable")
         else:
             lines += telemetry.device_metric_lines(services)
-        return web.Response(text=telemetry.finalize_exposition(lines),
-                            content_type="text/plain")
+        if openmetrics:
+            # The OpenMetrics exposition is grammar-strict (the
+            # finalizer drops free-form comments and maps the legacy
+            # type/naming cases), EOF-terminated, and served under
+            # its own media type.
+            text = telemetry.finalize_exposition(lines,
+                                                 openmetrics=True)
+            return web.Response(
+                text=text + "# EOF\n",
+                content_type="application/openmetrics-text")
+        return web.Response(
+            text=telemetry.finalize_exposition(lines),
+            content_type="text/plain")
 
     async def healthz(request: web.Request) -> web.Response:
         """Liveness: the process answers HTTP.  Deeper state belongs to
@@ -1135,8 +1298,11 @@ def create_app(config: Optional[AppConfig] = None,
     async def debug_flightrecorder(request: web.Request) -> web.Response:
         """The black-box ring as JSON; ``?dump=1`` also snapshots it to
         the configured spool directory (the same artifact a SIGTERM or
-        SLO breach writes).  Proxy mode merges the sidecar's ring so
-        one read shows both processes' last seconds."""
+        SLO breach writes).  Proxy mode merges the sidecar's ring; a
+        FLEET frontend fetches EVERY member's ring, stamps each event
+        with its member identity, and returns ONE causally-merged
+        fleet ring (``ring``, sorted by wall timestamp) — plus the
+        per-member raw rings for anyone who wants them unmixed."""
         doc = {
             "events": telemetry.FLIGHT.snapshot(),
             "events_total": telemetry.FLIGHT.events_total,
@@ -1144,17 +1310,57 @@ def create_app(config: Optional[AppConfig] = None,
         }
         if services is None:
             import asyncio as _asyncio
-            try:
-                status, body = await _asyncio.wait_for(
-                    client.call("flightrecorder", {}), timeout=2.0)
-                doc["sidecar"] = (json.loads(bytes(body).decode())
-                                  if status == 200 and body else None)
-            except Exception:
-                doc["sidecar"] = None
+
+            async def _fetch_ring(probe_client):
+                try:
+                    status, body = await _asyncio.wait_for(
+                        probe_client.call("flightrecorder", {}),
+                        timeout=2.0)
+                    return (json.loads(bytes(body).decode())
+                            if status == 200 and body else None)
+                except Exception:
+                    return None
+
+            if fleet_remote:
+                names = [m.name for m in fleet_members]
+                rings = await _asyncio.gather(
+                    *(_fetch_ring(m.client) for m in fleet_members))
+                merged = [dict(e, member="frontend")
+                          if "member" not in e else dict(e)
+                          for e in doc["events"]]
+                members_doc = {}
+                for name, ring in zip(names, rings):
+                    members_doc[name] = ring
+                    for event in (ring or {}).get("events", ()):
+                        stamped = dict(event)
+                        # The member identity the satellite fix is
+                        # about: frontend-side stamp (the sidecar
+                        # does not know its fleet name), events that
+                        # already name a member keep their own.
+                        stamped.setdefault("member", name)
+                        merged.append(stamped)
+                merged.sort(key=lambda e: e.get("ts", 0.0))
+                doc["members"] = members_doc
+                doc["ring"] = merged
+                # Back-compat: the designated member's ring where the
+                # old single-sidecar field pointed.
+                doc["sidecar"] = members_doc.get(names[0]) \
+                    if names else None
+            else:
+                doc["sidecar"] = await _fetch_ring(client)
         if request.query.get("dump"):
             doc["dumped_to"] = telemetry.FLIGHT.dump(
                 config.telemetry.flight_recorder_dir, "manual")
         return web.json_response(doc)
+
+    async def debug_exemplars(request: web.Request) -> web.Response:
+        """The request-duration histogram's live exemplars as JSON:
+        per route, each latency bucket's most recent trace id +
+        provenance tier — the JSON twin of the OpenMetrics exemplars
+        on /metrics (pull the named trace's waterfall from the
+        slow-request spool, or correlate with the access log)."""
+        return web.json_response(
+            {"request_duration_ms": telemetry.exemplars_snapshot()})
 
     async def debug_profile(request: web.Request) -> web.Response:
         """On-demand device profiling: wrap ``jax.profiler`` around
@@ -1546,6 +1752,18 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/warmstate", debug_warmstate)
+    app.router.add_get("/debug/exemplars", debug_exemplars)
+    # The dry-run explain plane: resolve a render URL — identity,
+    # ETag, ring owner/chain, per-member residency, admission posture
+    # — with ZERO render work (server.explain).
+    from .explain import build_explain_handler
+    app.router.add_get("/debug/explain", build_explain_handler(
+        config, services=services, fleet_router=fleet_router,
+        fleet_members=fleet_members,
+        admission=(getattr(image_handler, "admission", None)
+                   or (services.admission if services is not None
+                       else None)),
+        proxy_client=(client if proxy_mode else None)))
     app.router.add_get("/admin/drain", admin_drain)
     app.router.add_post("/admin/drain", admin_drain)
     app.router.add_post("/admin/undrain", admin_undrain)

@@ -467,6 +467,12 @@ class TelemetryConfig:
     # requests are clamped to profile_max_ms.
     profile_dir: str = "./profiles"
     profile_max_ms: float = 10000.0
+    # Echo each successful response's provenance record (serving
+    # member, byte-source tier, steal/failover/drain flags, QoS class,
+    # engaged ladder prefix, tokens charged) as an
+    # ``X-Image-Region-Provenance`` debug header.  Off by default
+    # (operator debugging surface); NEVER emitted on errors.
+    provenance_header: bool = False
 
 
 @dataclass
@@ -516,6 +522,10 @@ class HttpCacheConfig:
     # Bumping it invalidates EVERY edge-cached entry at once — the
     # knob to turn when source data or the render pipeline changes
     # under live URLs.  Token characters only ([A-Za-z0-9._-]).
+    # The literal "auto" derives the epoch from the data tree's
+    # ingest/source mtimes at startup (httpcache.derive_epoch) —
+    # re-ingesting any image then bumps the deployment epoch
+    # mechanically; an explicit value stays the operator override.
     epoch: str = "0"
     # Cache-Control max-age for 200s.  0 (default) emits ``no-cache``:
     # edges store but revalidate every serve — safe because the 304
@@ -1089,6 +1099,9 @@ class AppConfig:
                                     tel_defaults.profile_dir)),
             profile_max_ms=float(tel.get(
                 "profile-max-ms", tel_defaults.profile_max_ms)),
+            provenance_header=bool(tel.get(
+                "provenance-header",
+                tel_defaults.provenance_header)),
         )
         if cfg.telemetry.slow_request_ms < 0:
             raise ValueError("telemetry.slow-request-ms must be >= 0")

@@ -1,0 +1,210 @@
+"""The dry-run explain plane: ``GET /debug/explain?path=<render URL>``.
+
+Resolves everything the serving stack WOULD decide for a render URL —
+canonical render-identity key, ETag, ring owner + failover chain,
+per-member / per-tier residency (byte cache, fleet byte authority, HBM
+routing identity), and the live admission/fairness/pressure posture —
+without rendering, staging, or charging anything.  One curl answers
+"why was this tile slow / which member owns it / is it warm".
+
+Fleet-wide merge: combined-role members are probed in place; remote
+members answer over the read-only ``explain`` sidecar op
+(``server.sidecar``), concurrently, like the /readyz fleet probe.
+Device-free on import — frontends and fleet routers serve it without
+the JAX stack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from typing import Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+from .ctx import BadRequestError, ImageRegionCtx
+
+# The served render routes (app.py registers the same shapes); the
+# trailing tail aliases exactly like the real router's ``{tail:.*}``.
+_ROUTE_RE = re.compile(
+    r"^/(?:webgateway|webclient)/"
+    r"(?:render_image_region|render_image)/"
+    r"(?P<imageId>\d+)/(?P<theZ>\d+)/(?P<theT>\d+)(?:/.*)?$")
+
+_EXPLAIN_TIMEOUT_S = 2.0
+
+
+def parse_render_path(path: str) -> Dict[str, str]:
+    """Render URL (path + query string) -> the params dict the real
+    route handler would build (tail never reaches it — the edge-cache
+    alias contract).  Raises BadRequestError on anything that is not
+    a render route."""
+    if not path or not path.startswith("/"):
+        raise BadRequestError(
+            "path must be a server-relative render URL")
+    split = urlsplit(path)
+    m = _ROUTE_RE.match(split.path)
+    if m is None:
+        raise BadRequestError(
+            f"not a render route: {split.path!r} (expected "
+            f"/webgateway|webclient/render_image[_region]/"
+            f"<id>/<z>/<t>)")
+    params = dict(parse_qsl(split.query, keep_blank_values=True))
+    params.pop("tail", None)
+    params.update(m.groupdict())
+    return params
+
+
+async def residency_doc(stack, raw_cache, key: str,
+                        route: str) -> dict:
+    """ONE member's dry-run residency report — THE shared
+    implementation behind the combined probe, ``LocalMember
+    .explain_residency`` and the sidecar ``explain`` op, so the three
+    postures can never drift on what "warm" means.  Read-only by
+    contract: non-mutating byte-tier probe (no back-fill, no LRU
+    bump), HBM residency by routing identity."""
+    byte_tier = None
+    if stack is not None and key \
+            and getattr(stack, "enabled", True):
+        from ..services.cache import probe_with_tier
+        byte_tier = await probe_with_tier(stack, key)
+    hbm = bool(raw_cache is not None and route
+               and hasattr(raw_cache, "resident_route")
+               and raw_cache.resident_route(route))
+    return {"byte": byte_tier is not None,
+            "byte_tier": byte_tier, "hbm": hbm,
+            "planes": (len(raw_cache) if raw_cache is not None
+                       else 0)}
+
+
+async def _probe_member(member, key: str, route: str) -> dict:
+    try:
+        doc = await asyncio.wait_for(
+            member.explain_residency(key, route), _EXPLAIN_TIMEOUT_S)
+    except Exception as e:
+        doc = {"error": str(e)[:120]}
+    doc["healthy"] = member.healthy
+    doc["draining"] = member.draining
+    return doc
+
+
+async def explain(path: str, config, services=None, fleet_router=None,
+                  fleet_members=(), admission=None,
+                  proxy_client=None) -> dict:
+    """Assemble the explain document for one render URL.  Read-only
+    end to end: cache probes and wire ``explain`` ops only — the
+    renderer-span counters must not move (pinned by the acceptance
+    drill in tests/test_provenance.py)."""
+    from ..parallel.fleet import plane_route_key
+    from . import httpcache, pressure as pressure_mod
+
+    params = parse_render_path(path)
+    ctx = ImageRegionCtx.from_params(params, None)
+    route_key = plane_route_key(ctx)
+    pinned = pressure_mod.is_bulk(ctx)
+    doc: dict = {
+        "path": path,
+        "identity": ctx.cache_key,
+        "plane_route_key": route_key,
+        "qos": "bulk" if pinned else "interactive",
+        "dry_run": True,
+    }
+    hc = config.http_cache
+    if hc.enabled:
+        doc["etag"] = httpcache.etag_for(ctx.cache_key, hc.epoch)
+        doc["epoch"] = hc.epoch
+
+    # ---- ring topology: owner, failover chain, who serves TODAY.
+    if fleet_router is not None:
+        chain = (list(fleet_router.order) if pinned
+                 else fleet_router.ring.chain(route_key))
+        doc["ring"] = {
+            "owner": chain[0] if chain else None,
+            "chain": chain,
+            "serving": fleet_router.owner_of(ctx),
+            "draining": fleet_router.draining_members(),
+        }
+
+    # ---- per-member residency (merged fleet-wide, concurrent).
+    if fleet_members:
+        names = [m.name for m in fleet_members]
+        results = await asyncio.gather(
+            *(_probe_member(m, ctx.cache_key, route_key)
+              for m in fleet_members))
+        doc["members"] = dict(zip(names, results))
+    elif services is not None:
+        # Single combined stack: probe in place.
+        doc["residency"] = await residency_doc(
+            getattr(getattr(services, "caches", None),
+                    "image_region", None),
+            getattr(services, "raw_cache", None),
+            ctx.cache_key, route_key)
+    elif proxy_client is not None:
+        # Plain proxy: the one sidecar answers over the explain op.
+        import json as _json
+        try:
+            status, body = await asyncio.wait_for(
+                proxy_client.call("explain", {},
+                                  extra={"key": ctx.cache_key,
+                                         "route": route_key}),
+                _EXPLAIN_TIMEOUT_S)
+            doc["residency"] = (dict(_json.loads(bytes(body).decode()))
+                                if status == 200 and body
+                                else {"error": f"status {status}"})
+        except Exception as e:
+            doc["residency"] = {"error": str(e)[:120]}
+
+    # ---- admission / fairness / pressure posture, live.
+    if admission is not None:
+        adm = {
+            "inflight": admission.inflight,
+            "max_queue": admission.max_queue,
+            "effective_max_queue": admission.effective_max_queue(),
+            "estimated_wait_ms": round(
+                admission.estimated_wait_ms(), 1),
+        }
+        buckets = getattr(admission, "session_buckets", None)
+        if buckets is not None:
+            adm["session_buckets"] = {
+                "tracked": len(buckets),
+                "taken_total": buckets.taken_total,
+                "refused_total": buckets.refused_total,
+                "bulk_cost": buckets.bulk_cost,
+            }
+        doc["admission"] = adm
+    governor = pressure_mod.active()
+    if governor is not None:
+        doc["pressure"] = {
+            "summary": governor.summary(),
+            "engaged": governor.engaged_steps(),
+        }
+    return doc
+
+
+def build_explain_handler(config, services=None, fleet_router=None,
+                          fleet_members=(), admission=None,
+                          proxy_client=None):
+    """The aiohttp handler factory app.py wires at /debug/explain."""
+    from aiohttp import web
+
+    async def debug_explain(request: "web.Request") -> "web.Response":
+        path = request.query.get("path")
+        if not path:
+            return web.json_response(
+                {"error": "pass ?path=<render URL> (path + query, "
+                          "server-relative)"}, status=400)
+        try:
+            doc = await explain(
+                path, config, services=services,
+                fleet_router=fleet_router,
+                fleet_members=fleet_members, admission=admission,
+                proxy_client=proxy_client)
+        except BadRequestError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception("explain failed")
+            return web.json_response(
+                {"error": "explain failed"}, status=500)
+        return web.json_response(doc)
+
+    return debug_explain

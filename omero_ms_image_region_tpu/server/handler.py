@@ -331,9 +331,14 @@ class ImageRegionHandler:
         miss paying a wasted walk of the memory/disk tiers on the hot
         path.  The write-back below still runs."""
         import time as _time
+
+        from ..services.cache import get_with_tier
+        from ..utils import provenance
         t0 = _time.perf_counter()
-        cached = (None if skip_byte_cache else
-                  await self.s.caches.image_region.get(ctx.cache_key))
+        cached, cache_tier = ((None, None) if skip_byte_cache else
+                              await get_with_tier(
+                                  self.s.caches.image_region,
+                                  ctx.cache_key))
         if cached is not None:
             if await self._can_read("Image", ctx.image_id,
                                     ctx.omero_session_key):
@@ -342,6 +347,9 @@ class ImageRegionHandler:
                 telemetry.record_span(
                     "cache.hit", t0,
                     (_time.perf_counter() - t0) * 1000.0)
+                provenance.mark(
+                    ctx, tier=("disk" if cache_tier == "disk"
+                               else "byte_cache"))
                 return cached
             raise NotFoundError(f"Cannot find Image:{ctx.image_id}")
 
@@ -361,6 +369,8 @@ class ImageRegionHandler:
         # identity the middleware resolved) and sheds only itself.
         debit = admission.admit_session(ctx) if admission is not None \
             else None
+        if debit is not None:
+            provenance.mark(ctx, tokens=debit[1])
 
         async def produce() -> bytes:
             # GLOBAL admission sits HERE — after the byte cache (hits
@@ -425,6 +435,7 @@ class ImageRegionHandler:
             telemetry.record_span(
                 "dedup.coalesced", t0,
                 (_time.perf_counter() - t0) * 1000.0)
+            provenance.mark(ctx, coalesced=True)
         return data
 
     async def render_image_region_stream(self, ctx: ImageRegionCtx):
@@ -538,12 +549,15 @@ class ImageRegionHandler:
                     # render + encode only — the number the sessions
                     # bench gates on.
                     self.s.prefetcher.note_hit(key)
+            from ..utils import provenance
             if cached is not None:
                 # HBM raw-cache hit: a dict lookup — skip the
                 # thread-pool hop (same economics as the open-source
                 # fast path above).
                 raw = cached
+                provenance.mark(ctx, tier="hbm_warm")
             else:
+                provenance.mark(ctx, tier="render_cold")
                 raw = await asyncio.to_thread(
                     self._read_region, src, ctx, region, level or 0,
                     active,
@@ -812,12 +826,18 @@ class ShapeMaskHandler:
 
     async def render_shape_mask(self, ctx: ShapeMaskCtx) -> bytes:
         import time as _time
+
+        from ..services.cache import get_with_tier
+        from ..utils import provenance
         t0 = _time.perf_counter()
-        cached = await self.s.caches.shape_mask.get(ctx.cache_key())
+        cached, cache_tier = await get_with_tier(
+            self.s.caches.shape_mask, ctx.cache_key())
         readable = await self._can_read(ctx)
         if cached is not None and readable:
             telemetry.record_span(
                 "cache.hit", t0, (_time.perf_counter() - t0) * 1000.0)
+            provenance.mark(ctx, tier=("disk" if cache_tier == "disk"
+                                       else "byte_cache"))
             return cached
         if not readable:
             raise NotFoundError(f"Cannot find Shape:{ctx.shape_id}")

@@ -410,8 +410,25 @@ def _server_hello(header: dict, frames: FrameWriter, wire) -> tuple:
             frames.ring = None
             logger.info("shm ring negotiation failed (%s); "
                         "socket bodies", e)
+    member = header.get("member")
+    if isinstance(member, str) and member:
+        # The frontend's fleet name for THIS sidecar (RemoteMember
+        # stamps its client): from here on the process's own flight
+        # events — and its SIGTERM/breach dumps — carry the member
+        # identity, so a raw per-process ring stays attributable
+        # without the frontend's merge.  Positional per config, so
+        # agreeing frontends agree on the name; re-stamped per hello.
+        telemetry.FLIGHT.set_member(member[:32])
     telemetry.WIRE.count_negotiation(ring=ring_ok)
-    body = json.dumps({"v": WIRE_VERSION, "ring": ring_ok}).encode()
+    # ``clock``: this process's monotonic clock at hello time.  The
+    # client derives a per-connection offset from it, so exported span
+    # anchors (``t_anchor`` on responses) map onto the CLIENT's
+    # timeline and a multi-member waterfall stays causally ordered —
+    # re-anchored on every reconnect, so clock drift is bounded by a
+    # connection's life, never accumulated.  Extra key: v2 clients
+    # ignore it (no version bump).
+    body = json.dumps({"v": WIRE_VERSION, "ring": ring_ok,
+                       "clock": time.perf_counter()}).encode()
     return body, recv_ring, attached
 
 
@@ -457,6 +474,8 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
         rid = header.get("id")
         spans = None
         costs = None
+        anchor = None
+        prov = None
         quality_capped = False
         inj = faultinject.active()
         if inj is not None and inj.sidecar_should_die():
@@ -493,6 +512,7 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 trace_id = header.get("trace")
                 shared = bool(trace_id
                               and telemetry.TRACES.is_active(trace_id))
+                ctx = None
                 try:
                     with telemetry.adopt_trace(trace_id):
                         import time as _time
@@ -539,6 +559,18 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                         if trace is not None:
                             spans = trace.export_spans()
                             costs = trace.export_costs()
+                            # Span anchor on THIS process's monotonic
+                            # clock: with the hello clock offset the
+                            # client maps the spans onto its own
+                            # timeline instead of guessing from send
+                            # time (the stitched-waterfall contract).
+                            anchor = trace.t0
+                    if ctx is not None:
+                        # Provenance marks made in this process (byte
+                        # tier / HBM / cold) ride the reply so the
+                        # frontend's record names what REALLY served.
+                        from ..utils import provenance
+                        prov = provenance.marks(ctx) or None
             elif op == "metrics":
                 # Device-process series (spans, caches, batcher gauges,
                 # compile events, link health); frontends merge these
@@ -713,6 +745,23 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                           if cache is not None and pixels is not None
                           else 0)
                 body = json.dumps({"staged": staged}).encode()
+            elif op == "explain":
+                # Dry-run residency probe (the /debug/explain plane):
+                # READ-ONLY by contract — no render, no admission, no
+                # staging.  The one shared implementation lives in
+                # server.explain.residency_doc (combined, fleet-local
+                # and remote members must never drift on "warm").
+                from .explain import residency_doc
+                handler_services = getattr(image_handler, "s", None)
+                doc = await residency_doc(
+                    getattr(getattr(handler_services, "caches",
+                                    None), "image_region", None),
+                    getattr(handler_services, "raw_cache", None),
+                    str(header.get("key") or ""),
+                    str(header.get("route") or ""))
+                doc["prewarm_pending"] = \
+                    telemetry.READINESS.prewarm_pending
+                body = json.dumps(doc).encode()
             elif op == "ping":
                 doc = status_fn() if status_fn is not None \
                     else {"ok": True}
@@ -790,8 +839,12 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
             out = {"id": rid, "status": 200}
         if spans:
             out["spans"] = spans
+            if anchor is not None:
+                out["t_anchor"] = anchor
         if costs:
             out["costs"] = costs
+        if prov:
+            out["prov"] = prov
         if quality_capped:
             out["quality_capped"] = 1
         if out["status"] >= 400:
@@ -1157,6 +1210,14 @@ class _Conn:
         # this generation an await ago — must fail at registration, not
         # park a future no reader will ever resolve.
         self.dead: Optional[BaseException] = None
+        # Per-connection clock mapping (hello negotiation): the
+        # server's perf_counter at hello plus our send/receive window
+        # midpoint yield ``clock_offset`` — server_time + offset ≈
+        # client_time.  Exported span anchors (``t_anchor``) then land
+        # on OUR timeline; None (v2 peer) keeps the send-time
+        # anchoring.  Re-derived on every reconnect, so drift never
+        # outlives a connection.
+        self.clock_offset: Optional[float] = None
         # Hung-wire watchdog stamp: bumped on every frame RECEIVED and
         # when a request starts a fresh in-flight episode (first
         # registration onto an empty pending map), so "in-flight
@@ -1243,6 +1304,11 @@ class SidecarClient:
         self.wire_hang_s = 0.0
         self.watchdog_escalate_after = 2
         self._wire_fires = 0     # consecutive; a served reply resets
+        # Fleet identity of the member this client reaches (set by
+        # ``parallel.fleet.RemoteMember``): grafted spans carry it as
+        # their ``member`` dimension so a multi-member waterfall stays
+        # attributable.  None (plain proxy) adds nothing.
+        self.member_label: Optional[str] = None
 
     async def _ensure_connected(self) -> _Conn:
         conn = self._conn
@@ -1294,6 +1360,11 @@ class SidecarClient:
         rid = self._next_id
         fut = asyncio.get_running_loop().create_future()
         header = {"id": rid, "op": "hello", "v": WIRE_VERSION}
+        if self.member_label:
+            # Tell the sidecar which fleet member it IS (it cannot
+            # know otherwise): its own flight events then carry the
+            # identity.  Extra key — older peers ignore it.
+            header["member"] = self.member_label
         if rings:
             header["rings"] = {
                 "c2s": {"name": rings[0].name,
@@ -1301,6 +1372,7 @@ class SidecarClient:
                 "s2c": {"name": rings[1].name,
                         "size": self.wire.ring_bytes},
             }
+        t_hello = time.perf_counter()
         try:
             conn.register(rid, fut)
             await conn.frames.send(header)
@@ -1329,6 +1401,15 @@ class SidecarClient:
                 doc = json.loads(bytes(resp_body).decode())
             except (ValueError, AttributeError):
                 doc = {}
+        server_clock = doc.get("clock")
+        if isinstance(server_clock, (int, float)):
+            # Symmetric estimate: the server read its clock somewhere
+            # inside our send->receive window; the midpoint bounds the
+            # error by half the hello RTT.  Span-graft anchoring also
+            # clamps to the request's own send time, so even a bad
+            # estimate can never reorder a parent under its child.
+            mid = (t_hello + time.perf_counter()) / 2.0
+            conn.clock_offset = mid - float(server_clock)
         ring_ok = bool(rings and doc.get("ring")
                        and int(doc.get("v", 2)) >= 3)
         conn.peer_v3 = int(doc.get("v", 2)) >= 3 \
@@ -1545,7 +1626,7 @@ class SidecarClient:
                     telemetry.FLIGHT.record("breaker.close", op=op)
             telemetry.RESILIENCE.observe_attempts(op, attempt + 1)
             self._wire_fires = 0    # a served reply ends the episode
-            self._graft_response(resp_header, t_call)
+            self._graft_response(resp_header, t_call, conn)
             return resp_header, resp_body
 
     async def _retry_step(self, op: str, conn: Optional[_Conn],
@@ -1594,23 +1675,43 @@ class SidecarClient:
             await asyncio.sleep(backoff)
         return attempt
 
-    def _graft_response(self, resp_header: dict, t_call: float) -> None:
+    def _graft_response(self, resp_header: dict, t_call: float,
+                        conn: Optional[_Conn] = None) -> None:
         """Join the device process's exported spans/costs onto the
-        requesting trace (shared by the unary and streaming paths)."""
+        requesting trace (shared by the unary and streaming paths).
+
+        Anchoring: span offsets are relative to the sidecar's request
+        arrival.  When the response carries ``t_anchor`` (the server's
+        monotonic arrival stamp) AND the connection negotiated a clock
+        offset at hello, the anchor maps onto OUR clock — accurate to
+        half the hello RTT instead of a full request hop.  Either way
+        the anchor is CLAMPED into [send time, now]: a drifted peer
+        clock can shift a child span, but it can never open a child
+        before its parent or after the response that contains it."""
         trace_id = telemetry.current_trace_id()
         if trace_id and resp_header.get("spans"):
-            # Graft the device process's spans onto our waterfall.
-            # Their offsets are relative to the sidecar's request
-            # arrival; anchoring at our send time puts them at most
-            # one wire hop early — invisible at waterfall scale.
+            anchor = t_call
+            offset = getattr(conn, "clock_offset", None)
+            t_anchor = resp_header.get("t_anchor")
+            if offset is not None \
+                    and isinstance(t_anchor, (int, float)):
+                anchor = min(max(t_call, float(t_anchor) + offset),
+                             time.perf_counter())
+            member = getattr(self, "member_label", None)
             for s in resp_header["spans"]:
                 try:
                     meta = {k: v for k, v in s.items()
                             if k not in ("name", "start_ms",
                                          "dur_ms")}
+                    if member is not None:
+                        # The fleet stitches by member: every grafted
+                        # span names the member whose process ran it
+                        # (its own meta wins — drain/steal events
+                        # already carry one).
+                        meta.setdefault("member", member)
                     telemetry.record_span(
                         s["name"],
-                        t_call + s["start_ms"] / 1000.0,
+                        anchor + s["start_ms"] / 1000.0,
                         s["dur_ms"], trace_ids=(trace_id,), **meta)
                 except (KeyError, TypeError):
                     pass    # malformed span: drop it, keep serving
@@ -1620,14 +1721,18 @@ class SidecarClient:
             telemetry.merge_costs(trace_id, resp_header["costs"])
 
     async def call_stream(self, op: str, ctx_json: dict,
-                          extra: Optional[dict] = None):
+                          extra: Optional[dict] = None,
+                          final_out: Optional[dict] = None):
         """Progressive call (protocol v3 leg 2): an async generator
         yielding body chunks as their frames arrive; the final frame's
         status maps through the same exception contract as
         :meth:`call_full` (raised before the first yield when the
         request failed outright).  A v2 peer — or a server that chose
         not to stream this answer — degrades to one yield of the whole
-        body.
+        body.  ``final_out`` (when given) receives the fin frame's
+        header fields — the caller's window onto the response's
+        exported provenance/quality marks, which a generator cannot
+        return.
 
         Retry policy: identical to :meth:`call_full` UP TO the first
         chunk — a connection that dies under the request before any
@@ -1746,7 +1851,9 @@ class SidecarClient:
                 self.breaker.record_success()
                 if not was_closed:
                     telemetry.FLIGHT.record("breaker.close", op=op)
-            self._graft_response(final, t_call)
+            self._graft_response(final, t_call, conn)
+            if final_out is not None:
+                final_out.update(final)
             status = final.get("status")
             if status != 200:
                 if expected_seq:
@@ -1958,6 +2065,7 @@ class SidecarImageHandler:
         self.fallback = fallback
 
     async def render_image_region(self, ctx: ImageRegionCtx) -> bytes:
+        from ..utils import provenance
         from .errors import OverloadedError
         from .pressure import shed_bulk_under_pressure
         # Frontend-side brownout: bulk work sheds BEFORE crossing the
@@ -1970,7 +2078,9 @@ class SidecarImageHandler:
             if self.fallback is None:
                 raise
             telemetry.RESILIENCE.count_degraded_render()
+            provenance.mark(ctx, tier="degraded")
             return await self.fallback.render_image_region(ctx)
+        provenance.merge_wire(ctx, resp_header.get("prov"))
         if resp_header.get("quality_capped"):
             # Mirror the sidecar's brownout mark onto the frontend ctx
             # so the HTTP layer strips the cache headers — a degraded
@@ -1989,13 +2099,18 @@ class SidecarImageHandler:
         error surface the unary wire would have served through.  A
         mid-stream death propagates (bytes are already on the HTTP
         wire — the frontend truncates)."""
+        from ..utils import provenance
         from .errors import OverloadedError
         offset = 0
+        final_out: dict = {}
         try:
             async for chunk in self.client.call_stream(
-                    "image", ctx.to_json()):
+                    "image", ctx.to_json(), final_out=final_out):
                 offset += len(chunk)
                 yield chunk
+            provenance.merge_wire(ctx, final_out.get("prov"))
+            if final_out.get("quality_capped"):
+                ctx._pressure_quality_capped = True
             return
         except (ConnectionError, OverloadedError):
             if offset == 0 and self.fallback is not None:
@@ -2004,6 +2119,8 @@ class SidecarImageHandler:
                 # re-running it through call_full would only double
                 # the backoff ladder in front of the CPU render.
                 telemetry.RESILIENCE.count_degraded_render()
+                from ..utils import provenance
+                provenance.mark(ctx, tier="degraded")
                 yield await self.fallback.render_image_region(ctx)
                 return
         if offset == 0:
@@ -2035,6 +2152,7 @@ class SidecarMaskHandler:
         self.fallback = fallback
 
     async def render_shape_mask(self, ctx: ShapeMaskCtx) -> bytes:
+        from ..utils import provenance
         from .errors import OverloadedError
         try:
             resp_header, payload = await self.client.call_full(
@@ -2043,7 +2161,9 @@ class SidecarMaskHandler:
             if self.fallback is None:
                 raise
             telemetry.RESILIENCE.count_degraded_render()
+            provenance.mark(ctx, tier="degraded")
             return await self.fallback.render_shape_mask(ctx)
+        provenance.merge_wire(ctx, resp_header.get("prov"))
         return _map_response(resp_header, payload)
 
 

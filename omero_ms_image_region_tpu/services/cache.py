@@ -21,7 +21,7 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Protocol
+from typing import Dict, List, Optional, Protocol, Tuple
 
 log = logging.getLogger("omero_ms_image_region_tpu.cache")
 
@@ -80,6 +80,13 @@ class MemoryLRUCache:
             keys = list(reversed(self._data.keys()))
         return keys[:limit] if limit else keys
 
+    async def contains(self, key: str) -> bool:
+        """Residency probe WITHOUT an LRU bump or a hit/miss count —
+        the explain plane's dry-run contract (a probe must observe,
+        never reorder the working set)."""
+        with self._lock:
+            return key in self._data
+
     async def get(self, key: str) -> Optional[bytes]:
         return self.get_sync(key)
 
@@ -117,12 +124,53 @@ class RedisCache:
     async def set(self, key: str, value: bytes) -> None:
         await self._client.set(key, value)
 
+    async def contains(self, key: str) -> bool:
+        """EXISTS probe — no value transfer (explain-plane dry run)."""
+        return bool(await self._client.exists(key))
+
     async def set_ttl(self, key: str, value: bytes,
                       ttl_seconds: float) -> None:
         await self._client.set(key, value, px=max(1, int(ttl_seconds * 1000)))
 
     async def close(self) -> None:
         await self._client.aclose()
+
+
+async def get_with_tier(stack, key: str
+                        ) -> "Tuple[Optional[bytes], Optional[str]]":
+    """``(value, tier_label)`` for any stack-shaped object: a real
+    :class:`CacheStack` answers via :meth:`CacheStack.get_tiered`;
+    duck-typed test doubles that only implement ``get`` degrade to a
+    label-less hit (provenance then reads ``byte_cache``)."""
+    fn = getattr(stack, "get_tiered", None)
+    if fn is not None:
+        return await fn(key)
+    return await stack.get(key), None
+
+
+async def probe_with_tier(stack, key: str) -> Optional[str]:
+    """Dry-run twin of :func:`get_with_tier` for the explain plane:
+    the holding tier's label (None = not resident), via the stack's
+    non-mutating :meth:`CacheStack.probe_tiered` when present; duck-
+    typed doubles that only implement ``get`` degrade to a bare get
+    labelled "memory"."""
+    fn = getattr(stack, "probe_tiered", None)
+    if fn is not None:
+        return await fn(key)
+    return "memory" if (await stack.get(key)) is not None else None
+
+
+def tier_label(tier) -> str:
+    """Short stable label for one cache tier ("memory" / "disk" /
+    "redis") — the vocabulary :meth:`CacheStack.get_tiered` reports
+    and the explain plane surfaces.  An explicit ``tier_label``
+    attribute wins (the namespaced disk views set "disk"); otherwise
+    the class name decides, defaulting to "memory" (the native and
+    pure-Python LRUs)."""
+    explicit = getattr(tier, "tier_label", None)
+    if explicit:
+        return str(explicit)
+    return "redis" if "Redis" in type(tier).__name__ else "memory"
 
 
 class CacheStack:
@@ -148,8 +196,42 @@ class CacheStack:
                         i, type(self.tiers[i]).__name__, op, e)
 
     async def get(self, key: str) -> Optional[bytes]:
+        value, _tier = await self.get_tiered(key)
+        return value
+
+    async def probe_tiered(self, key: str) -> Optional[str]:
+        """DRY-RUN residency probe: the first tier holding ``key``
+        (its label), with NO back-fill, NO LRU bump and no value
+        fetch where the tier supports a ``contains`` check — the
+        explain plane must observe the caches, never promote cold
+        payloads into the memory tier or reorder the working set.
+        Tiers without ``contains`` degrade to a bare ``get`` (still
+        no back-fill)."""
         if not self.enabled:
             return None
+        for i, tier in enumerate(self.tiers):
+            try:
+                probe = getattr(tier, "contains", None)
+                if probe is not None:
+                    present = await probe(key)
+                else:
+                    present = (await tier.get(key)) is not None
+            except Exception as e:
+                self._warn_tier(i, "probe", e)
+                continue
+            if present:
+                return tier_label(tier)
+        return None
+
+    async def get_tiered(self, key: str
+                         ) -> "Tuple[Optional[bytes], Optional[str]]":
+        """``(value, tier_label)`` — which tier answered ("memory" /
+        "disk" / "redis"; None on a miss).  The provenance layer maps
+        the label onto its closed byte-source vocabulary; the explain
+        plane reports it verbatim.  Same read-through back-fill as
+        :meth:`get` (it delegates here)."""
+        if not self.enabled:
+            return None, None
         for i, tier in enumerate(self.tiers):
             try:
                 value = await tier.get(key)
@@ -162,8 +244,8 @@ class CacheStack:
                         await upper.set(key, value)
                     except Exception as e:
                         self._warn_tier(self.tiers.index(upper), "set", e)
-                return value
-        return None
+                return value, tier_label(tier)
+        return None, None
 
     async def set(self, key: str, value: bytes) -> None:
         if not self.enabled:
@@ -235,15 +317,28 @@ class NamespacedTier:
     attributes delegate, so the generic per-tier /metrics export still
     sees the shared tier's accounting."""
 
-    def __init__(self, inner, prefix: str):
+    def __init__(self, inner, prefix: str,
+                 tier_label: str = "disk"):
         self.inner = inner
         self.prefix = prefix
+        # Provenance/explain vocabulary (services.cache.tier_label):
+        # the shared durable tier reads as "disk" wherever it answers.
+        self.tier_label = tier_label
 
     async def get(self, key: str) -> Optional[bytes]:
         return await self.inner.get(self.prefix + key)
 
     async def set(self, key: str, value: bytes) -> None:
         await self.inner.set(self.prefix + key, value)
+
+    async def contains(self, key: str) -> bool:
+        """Dry-run probe (explain plane): delegate a ``contains``
+        when the shared tier has one, else fall back to a bare get
+        (no back-fill either way — this is a leaf tier)."""
+        probe = getattr(self.inner, "contains", None)
+        if probe is not None:
+            return await probe(self.prefix + key)
+        return (await self.inner.get(self.prefix + key)) is not None
 
     @property
     def hits(self):

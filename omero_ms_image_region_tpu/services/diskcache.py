@@ -397,6 +397,15 @@ class DiskByteCache:
     async def get(self, key: str) -> Optional[bytes]:
         return await asyncio.to_thread(self.get_sync, key)
 
+    async def contains(self, key: str) -> bool:
+        """Existence probe — one stat, no read/verify/mtime effect
+        (the explain plane's dry-run view).  A corrupt entry may read
+        present here and still degrade to an honest MISS on the real
+        ``get``; a residency HINT may be optimistic, a byte path may
+        not."""
+        return await asyncio.to_thread(os.path.exists,
+                                       self._path_of(key))
+
     async def set(self, key: str, value: bytes) -> None:
         if self.sync_writes:
             await asyncio.to_thread(self.set_sync, key, value)

@@ -111,11 +111,20 @@ class LocalMetadataService:
     pixels, ``<data_dir>/masks/<shape_id>.json`` (+ ``.bin`` packed bits)
     for masks."""
 
+    # Source-mtime memo TTL: Last-Modified headers tolerate seconds of
+    # staleness (HTTP-dates have second precision anyway), and the memo
+    # keeps the per-request cost to one dict hit instead of a listdir.
+    _MTIME_TTL_S = 5.0
+
     def __init__(self, data_dir: str):
         self.data_dir = data_dir
         # (path, mtime_ns)-validated Pixels memo for TIFF-backed images
         # (the chunked path's meta.json read is cheap enough bare).
         self._tiff_pixels: Dict[int, tuple] = {}
+        # image_id -> (expires_monotonic, mtime-or-None) memo for
+        # source_mtime (the Last-Modified path).
+        self._mtime_memo: Dict[int, Tuple[float, Optional[float]]] = {}
+        self._mtime_lock = threading.Lock()
 
     def _image_dir(self, image_id: int) -> str:
         return os.path.join(self.data_dir, str(image_id))
@@ -209,6 +218,60 @@ class LocalMetadataService:
             )
         finally:
             src.close()
+
+    def source_mtime_cached(self, image_id: int
+                            ) -> Tuple[bool, Optional[float]]:
+        """Memo peek: ``(hit, mtime)`` without any filesystem work —
+        the hot path's inline fast path (a thread-pool hop per
+        request just to reach a dict hit would cost more than the
+        lookup; only a memo MISS pays the off-loop stat walk)."""
+        now = time.monotonic()
+        with self._mtime_lock:
+            hit = self._mtime_memo.get(image_id)
+            if hit is not None and hit[0] > now:
+                return True, hit[1]
+        return False, None
+
+    def source_mtime(self, image_id: int) -> Optional[float]:
+        """The image's ingest/source mtime (unix seconds) — the
+        Last-Modified stamp for conditional HTTP.  Newest of the
+        metadata files an ingest touches (meta.json, the NGFF group's
+        geometry stamp, the TIFF itself) and the image directory;
+        None when the image does not exist.  Memoized for a few
+        seconds (``_MTIME_TTL_S``) so the hot path pays a dict hit,
+        not a listdir, per request."""
+        now = time.monotonic()
+        with self._mtime_lock:
+            hit = self._mtime_memo.get(image_id)
+            if hit is not None and hit[0] > now:
+                return hit[1]
+        mtime: Optional[float] = None
+        image_dir = self._image_dir(image_id)
+        candidates = []
+        try:
+            candidates.append(os.stat(image_dir).st_mtime)
+            meta = os.path.join(image_dir, "meta.json")
+            if os.path.exists(meta):
+                candidates.append(os.stat(meta).st_mtime)
+            from ..io.ngff import find_ngff
+            ngff = find_ngff(image_dir)
+            if ngff is not None:
+                candidates.append(_ngff_meta_mtime(ngff) / 1e9)
+            else:
+                from ..io.ometiff import find_tiff
+                tiff = find_tiff(image_dir)
+                if tiff is not None:
+                    candidates.append(os.stat(tiff).st_mtime)
+        except OSError:
+            pass
+        if candidates:
+            mtime = max(candidates)
+        with self._mtime_lock:
+            self._mtime_memo[image_id] = (now + self._MTIME_TTL_S,
+                                          mtime)
+            if len(self._mtime_memo) > 4096:    # bounded, coarse
+                self._mtime_memo.clear()
+        return mtime
 
     async def can_read(self, object_type: str, object_id: int,
                        session_key: Optional[str]) -> bool:

@@ -1,0 +1,133 @@
+"""Response provenance: where each byte came from, per request.
+
+PRs 8-11 turned one render into a distributed outcome — a request may
+be answered by a 304, the byte cache's memory or disk tier, a fleet
+peer's byte tier, a warm HBM plane, a cold render (possibly STOLEN by
+another member, or failed over after a death, or re-homed by a rolling
+drain), or the degraded CPU path.  The access log and /metrics could
+not say which.  This module is the one vocabulary for that answer:
+
+* a **provenance record** is a small dict assembled per finished
+  request from marks the serving layers left on the request ctx
+  (``mark``) — serving member, byte-source tier, steal/failover/drain
+  flags, QoS class, the engaged pressure-ladder prefix, and the
+  session tokens the fairness gate charged;
+* the record lands on the JSON access line (``prov``), feeds the
+  ``imageregion_provenance_*`` counters (closed label sets — TIERS and
+  FLAGS below are the entire vocabulary), and can be echoed as the
+  opt-in ``X-Image-Region-Provenance`` debug header
+  (``telemetry.provenance-header``, never on errors).
+
+Marks cross the sidecar wire as the optional ``prov`` response key
+(``server.sidecar``), so a fleet frontend's record names the REMOTE
+member that actually did the work.  Device-free on import.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# The byte-source tiers, cheapest first.  CLOSED: these seven strings
+# are the entire ``tier`` label vocabulary on /metrics — a new tier is
+# a deliberate schema change here, never an ad-hoc string at a call
+# site (the exposition lint + scripts/metrics_lint.py budget hold it).
+TIERS = ("304", "byte_cache", "peer", "disk", "hbm_warm",
+         "render_cold", "degraded")
+
+# Routing/serving flags a request may carry (each 0/1): CLOSED, the
+# ``flag`` label vocabulary.
+FLAGS = ("stolen", "failed_over", "drain_rehomed", "coalesced",
+         "quality_capped")
+
+_ATTR = "_provenance"
+
+
+def mark(ctx, **fields) -> None:
+    """Merge provenance fields onto the request ctx (lazily created
+    dict — requests that never hit a marking layer pay one getattr).
+    Later marks win for scalar fields; use :func:`merge_wire` for the
+    sidecar import, which must NOT clobber frontend-side marks."""
+    prov = getattr(ctx, _ATTR, None)
+    if prov is None:
+        prov = {}
+        setattr(ctx, _ATTR, prov)
+    prov.update(fields)
+
+
+def marks(ctx) -> Dict:
+    """The ctx's accumulated marks (read-only view; {} when none)."""
+    return getattr(ctx, _ATTR, None) or {}
+
+
+def merge_wire(ctx, wire_prov) -> None:
+    """Graft a sidecar-exported ``prov`` dict onto the frontend ctx.
+    Frontend-side marks take precedence (the router knows WHICH member
+    it dispatched to; the sidecar only knows what it did locally)."""
+    if not isinstance(wire_prov, dict):
+        return
+    prov = getattr(ctx, _ATTR, None)
+    if prov is None:
+        prov = {}
+        setattr(ctx, _ATTR, prov)
+    for key, value in wire_prov.items():
+        prov.setdefault(str(key), value)
+
+
+def assemble(ctx, status: int,
+             trace_id: Optional[str] = None) -> Dict:
+    """The finished request's provenance record.
+
+    Pure function of the ctx marks + status: the tier defaults to
+    ``render_cold`` (a request no cheaper layer claimed paid the full
+    pipeline), 304s override everything (no byte moved at all), and
+    the degraded CPU path overrides the tier a failed attempt may have
+    marked first.  The live pressure-ladder prefix and QoS class are
+    read here, once, at finish time."""
+    m = marks(ctx)
+    if status == 304:
+        tier = "304"
+    else:
+        tier = m.get("tier") or "render_cold"
+        if tier not in TIERS:          # a drifted call site: stay
+            tier = "render_cold"       # inside the closed vocabulary
+    record: Dict = {"tier": tier, "member": m.get("member") or "-"}
+    for flag in FLAGS:
+        if m.get(flag):
+            record[flag] = 1
+    if m.get("quality_capped") is None \
+            and getattr(ctx, "_pressure_quality_capped", False):
+        record["quality_capped"] = 1
+    # QoS class: the ONE classification the ladder/fleet pin share.
+    # The narrow except covers exactly the mask-ctx case (no
+    # tile/region/projection attributes); the governor read runs
+    # OUTSIDE it so a mask request still reports the engaged ladder.
+    from ..server.pressure import active, is_bulk
+    try:
+        bulk = is_bulk(ctx)
+    except AttributeError:             # mask ctxs have no tile/proj
+        bulk = False
+    record["qos"] = "bulk" if bulk else "interactive"
+    governor = active()
+    if governor is not None and governor.engaged_steps():
+        record["ladder"] = ",".join(governor.engaged_steps())
+    tokens = m.get("tokens")
+    if tokens:
+        record["tokens"] = round(float(tokens), 3)
+    if trace_id:
+        record["trace"] = trace_id
+    return record
+
+
+def header_value(record: Dict) -> str:
+    """Compact ``k=v; k=v`` form for the debug header (header-safe:
+    values are this module's own closed vocabulary, member names from
+    config, and numbers — never client input)."""
+    parts = []
+    for key in ("tier", "member", "qos", "ladder", "tokens", "trace"):
+        value = record.get(key)
+        if value not in (None, "", "-"):
+            parts.append(f"{key}={value}")
+    flags = [f for f in FLAGS if record.get(f)]
+    if flags:
+        parts.append("flags=" + ",".join(flags))
+    return "; ".join(parts)

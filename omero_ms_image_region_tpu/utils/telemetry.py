@@ -61,23 +61,42 @@ def _fmt(v: float) -> str:
 
 class Histogram:
     """Cumulative log-bucket histogram (not thread-safe on its own;
-    callers hold their registry lock around ``add``)."""
+    callers hold their registry lock around ``add``).
 
-    __slots__ = ("bounds", "counts", "sum", "count")
+    With ``exemplars=True`` each bucket also keeps its most recent
+    observation's exemplar — ``(trace_id, tier)`` from the caller —
+    written as ONE list-slot assignment (GIL-atomic, lock-light: the
+    hot path pays a tuple build and an index store), exposed in
+    OpenMetrics exemplar syntax by :meth:`series`.  The p99 bucket
+    then NAMES a trace id an operator can pull a waterfall for."""
 
-    def __init__(self, bounds: Tuple[float, ...] = BUCKET_BOUNDS_MS):
+    __slots__ = ("bounds", "counts", "sum", "count", "exemplars")
+
+    def __init__(self, bounds: Tuple[float, ...] = BUCKET_BOUNDS_MS,
+                 exemplars: bool = False):
         self.bounds = bounds
         self.counts = [0] * (len(bounds) + 1)     # last = +Inf overflow
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (trace_id, tier, value, wall_ts) or None.
+        self.exemplars = ([None] * (len(bounds) + 1) if exemplars
+                          else None)
 
-    def add(self, value: float) -> None:
+    def add(self, value: float,
+            exemplar: Optional[Tuple[str, str]] = None) -> None:
         self.sum += value
         self.count += 1
         # bisect, not a linear bucket scan: add() sits on the span hot
         # path (every stage of every request lands here), and the scan
         # walked up to 18 bounds per observation.
-        self.counts[bisect_left(self.bounds, value)] += 1
+        idx = bisect_left(self.bounds, value)
+        self.counts[idx] += 1
+        if self.exemplars is not None and exemplar is not None:
+            # Slot write is a single GIL-atomic list assignment:
+            # last-writer-wins is exactly the "most recent trace in
+            # this bucket" semantics, so no lock is needed.
+            self.exemplars[idx] = (exemplar[0], exemplar[1], value,
+                                   time.time())
 
     def cumulative(self) -> List[int]:
         out, acc = [], 0
@@ -101,45 +120,93 @@ class Histogram:
                         else self.bounds[-1] * 2)
         return self.bounds[-1] * 2
 
-    def series(self, name: str, labels: str = "") -> List[str]:
+    def _exemplar_suffix(self, idx: int, enabled: bool) -> str:
+        """OpenMetrics exemplar tail for one bucket line (empty when
+        the bucket has none or the caller did not negotiate the
+        OpenMetrics exposition): ``# {trace_id=..,tier=..} v ts``."""
+        if not enabled or self.exemplars is None:
+            return ""
+        ex = self.exemplars[idx]
+        if ex is None:
+            return ""
+        trace_id, tier, value, ts = ex
+        return (f' # {{trace_id="{trace_id}",tier="{tier}"}} '
+                f"{round(value, 3)} {round(ts, 3)}")
+
+    def series(self, name: str, labels: str = "",
+               exemplars: bool = False) -> List[str]:
         """Exposition lines.  ``labels`` is the inner label body without
-        braces (e.g. ``route="x"``); ``le`` composes after it."""
+        braces (e.g. ``route="x"``); ``le`` composes after it.
+        ``exemplars`` opts the bucket lines into OpenMetrics exemplar
+        tails — callers must pass True ONLY on a scrape that
+        negotiated ``application/openmetrics-text`` (the classic
+        text/plain parser rejects exemplar syntax, and one tail would
+        fail the whole scrape)."""
         sep = "," if labels else ""
         lines = []
         cum = self.cumulative()
-        for b, c in zip(self.bounds, cum):
+        for i, (b, c) in enumerate(zip(self.bounds, cum)):
             lines.append(f'{name}_bucket{{{labels}{sep}le="{_fmt(b)}"}}'
-                         f" {c}")
+                         f" {c}{self._exemplar_suffix(i, exemplars)}")
         lines.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} '
-                     f"{cum[-1]}")
+                     f"{cum[-1]}"
+                     f"{self._exemplar_suffix(len(self.bounds), exemplars)}")
         suffix = f"{{{labels}}}" if labels else ""
         lines.append(f"{name}_sum{suffix} {round(self.sum, 3)}")
         lines.append(f"{name}_count{suffix} {self.count}")
         return lines
 
+    def exemplar_docs(self) -> List[dict]:
+        """The live exemplars as JSON-able docs (the /debug/exemplars
+        view: bucket upper bound -> most recent trace + tier)."""
+        if self.exemplars is None:
+            return []
+        docs = []
+        for i, ex in enumerate(list(self.exemplars)):
+            if ex is None:
+                continue
+            le = (_fmt(self.bounds[i]) if i < len(self.bounds)
+                  else "+Inf")
+            docs.append({"le": le, "trace": ex[0], "tier": ex[1],
+                         "value_ms": round(ex[2], 3),
+                         "ts": round(ex[3], 3)})
+        return docs
+
 
 class HistogramVec:
     """Thread-safe histogram family keyed by one label value."""
 
-    def __init__(self, label: str):
+    def __init__(self, label: str, exemplars: bool = False):
         self.label = label
+        self.exemplars = exemplars
         self._lock = threading.Lock()
         self._hists: Dict[str, Histogram] = {}
 
-    def observe(self, label_value: str, value: float) -> None:
+    def observe(self, label_value: str, value: float,
+                exemplar: Optional[Tuple[str, str]] = None) -> None:
         with self._lock:
             h = self._hists.get(label_value)
             if h is None:
-                h = self._hists[label_value] = Histogram()
-            h.add(value)
+                h = self._hists[label_value] = Histogram(
+                    exemplars=self.exemplars)
+            h.add(value, exemplar=exemplar)
 
-    def series(self, name: str) -> List[str]:
+    def series(self, name: str,
+               exemplars: bool = False) -> List[str]:
         with self._lock:
             items = sorted(self._hists.items())
             lines = []
             for lv, h in items:
-                lines += h.series(name, f'{self.label}="{lv}"')
+                lines += h.series(name, f'{self.label}="{lv}"',
+                                  exemplars=exemplars)
             return lines
+
+    def exemplar_docs(self) -> Dict[str, List[dict]]:
+        """{label_value: [bucket exemplar docs]} — /debug/exemplars."""
+        with self._lock:
+            items = sorted(self._hists.items())
+        return {lv: docs for lv, h in items
+                if (docs := h.exemplar_docs())}
 
     def reset(self) -> None:
         with self._lock:
@@ -147,7 +214,10 @@ class HistogramVec:
 
 
 # End-to-end request latency by route — the acceptance-criteria series.
-REQUEST_HIST = HistogramVec("route")
+# Exemplared: each bucket names the most recent trace id + provenance
+# tier that landed in it, so the p99 bucket points at a pullable
+# waterfall (the metrics -> trace loop).
+REQUEST_HIST = HistogramVec("route", exemplars=True)
 _REQ_LOCK = threading.Lock()
 _REQ_TOTALS: Dict[tuple, int] = {}
 
@@ -668,14 +738,27 @@ class FlightRecorder:
         self._ring = deque(maxlen=maxlen)
         self.events_total = 0
         self.dumps_written = 0
+        # Fleet identity stamp: when set (a process that knows which
+        # member it is), every recorded event carries it, so merged
+        # fleet rings stay attributable (events that already name a
+        # member — drain phases, steals — keep their own).
+        self.member: Optional[str] = None
 
-    def configure(self, maxlen: int) -> None:
+    def configure(self, maxlen: int,
+                  member: Optional[str] = None) -> None:
         from collections import deque
         if maxlen != self._ring.maxlen:
             self._ring = deque(self._ring, maxlen=max(16, maxlen))
+        if member is not None:
+            self.member = member
+
+    def set_member(self, member: Optional[str]) -> None:
+        self.member = member
 
     def record(self, kind: str, **fields) -> None:
         event = {"ts": round(time.time(), 3), "kind": kind}
+        if self.member is not None and "member" not in fields:
+            event["member"] = self.member
         if fields:
             event.update(fields)
         self._ring.append(event)
@@ -735,6 +818,7 @@ class FlightRecorder:
         self._ring.clear()
         self.events_total = 0
         self.dumps_written = 0
+        self.member = None
 
 
 FLIGHT = FlightRecorder()
@@ -1991,6 +2075,7 @@ class HttpCacheStats:
     def __init__(self):
         self._lock = threading.Lock()
         self.etag_requests = 0     # requests arriving with If-None-Match
+        self.ims_requests = 0      # If-Modified-Since-only arrivals
         self.not_modified = 0      # 304s served (zero-work revalidation)
         self.head = 0              # HEADs served renderless
         self.peer_probes = 0       # authority byte-probe round-trips
@@ -2002,6 +2087,10 @@ class HttpCacheStats:
     def count_etag_request(self) -> None:
         with self._lock:
             self.etag_requests += 1
+
+    def count_ims_request(self) -> None:
+        with self._lock:
+            self.ims_requests += 1
 
     def count_not_modified(self) -> None:
         with self._lock:
@@ -2035,7 +2124,8 @@ class HttpCacheStats:
         extra = extra_labels.lstrip(",")
         lb = ("{" + extra + "}") if extra else ""
         with self._lock:
-            if not (self.etag_requests or self.not_modified
+            if not (self.etag_requests or self.ims_requests
+                    or self.not_modified
                     or self.head or self.peer_probes
                     or self.peer_fetches or self.peer_fallbacks
                     or self.peer_putbacks):
@@ -2046,6 +2136,8 @@ class HttpCacheStats:
             return [
                 f"imageregion_httpcache_etag_requests_total{lb} "
                 f"{self.etag_requests}",
+                f"imageregion_httpcache_ims_requests_total{lb} "
+                f"{self.ims_requests}",
                 f"imageregion_httpcache_304_total{lb} "
                 f"{self.not_modified}",
                 f"imageregion_httpcache_head_total{lb} {self.head}",
@@ -2064,6 +2156,7 @@ class HttpCacheStats:
     def reset(self) -> None:
         with self._lock:
             self.etag_requests = 0
+            self.ims_requests = 0
             self.not_modified = 0
             self.head = 0
             self.peer_probes = 0
@@ -2074,6 +2167,90 @@ class HttpCacheStats:
 
 
 HTTPCACHE = HttpCacheStats()
+
+
+class ProvenanceStats:
+    """Response-provenance accounting (``utils.provenance``): how many
+    responses each byte-source tier answered, per serving member, plus
+    the routing-flag counters.  BOTH label sets are closed: ``tier``
+    is ``provenance.TIERS`` verbatim (a drifted tier string is dropped
+    to ``render_cold`` before it gets here), ``member`` is the
+    config-named fleet set bounded like FleetStats, and ``flag`` is
+    ``provenance.FLAGS``.  Thread-safe (the access-log finisher runs
+    on the event loop, smoke benches read concurrently)."""
+
+    _MAX_MEMBERS = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.by_tier_member: Dict[Tuple[str, str], int] = {}
+        self.flags: Dict[str, int] = {}
+        # Maintained member set: count() runs in the per-request
+        # finisher, so the overflow guard must be a set hit, not a
+        # key-walk per response.
+        self._members: set = set()
+
+    def count(self, record: Mapping) -> None:
+        from .provenance import FLAGS, TIERS
+        tier = record.get("tier")
+        if tier not in TIERS:
+            tier = "render_cold"
+        member = str(record.get("member") or "-")
+        with self._lock:
+            if member not in self._members:
+                if len(self._members) >= self._MAX_MEMBERS:
+                    member = "_overflow"
+                self._members.add(member)
+            key = (tier, member)
+            self.by_tier_member[key] = \
+                self.by_tier_member.get(key, 0) + 1
+            for flag in FLAGS:
+                if record.get(flag):
+                    self.flags[flag] = self.flags.get(flag, 0) + 1
+
+    def totals(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for (tier, _member), n in self.by_tier_member.items():
+                out[tier] = out.get(tier, 0) + n
+            return out
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str) -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return "{" + inner + "}"
+
+        with self._lock:
+            lines = []
+            for (tier, member) in sorted(self.by_tier_member):
+                body = f'tier="{tier}",member="{member}"'
+                lines.append(
+                    f"imageregion_provenance_total{label(body)} "
+                    f"{self.by_tier_member[(tier, member)]}")
+            for flag in sorted(self.flags):
+                body = f'flag="{flag}"'
+                lines.append(
+                    f"imageregion_provenance_flags_total{label(body)} "
+                    f"{self.flags[flag]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.by_tier_member.clear()
+            self.flags.clear()
+            self._members.clear()
+
+
+PROVENANCE = ProvenanceStats()
+
+
+def exemplars_snapshot() -> Dict[str, List[dict]]:
+    """The request-duration histogram's live exemplars, per route —
+    the /debug/exemplars JSON view (each entry names the most recent
+    trace id + provenance tier to land in that latency bucket)."""
+    return REQUEST_HIST.exemplar_docs()
 
 
 def session_metric_lines(extra_labels: str = "") -> List[str]:
@@ -2168,15 +2345,21 @@ READINESS = Readiness()
 # -------------------------------------------------------------- slow dumps
 
 def dump_slow_trace(trace: Trace, total_ms: float, status: int,
-                    directory: str) -> Optional[str]:
+                    directory: str,
+                    extra: Optional[dict] = None) -> Optional[str]:
     """Write the waterfall JSON for a slow request; never raises (a
-    full disk must not fail the request that just succeeded)."""
+    full disk must not fail the request that just succeeded).
+    ``extra`` merges top-level fields into the document (the app
+    attaches the provenance record so a dumped waterfall carries its
+    where-did-the-bytes-come-from verdict)."""
     try:
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"{trace.trace_id}.json")
+        doc = trace.to_json(total_ms=total_ms, status=status)
+        if extra:
+            doc.update(extra)
         with open(path, "w") as f:
-            json.dump(trace.to_json(total_ms=total_ms, status=status),
-                      f, indent=1)
+            json.dump(doc, f, indent=1)
         return path
     except OSError:
         log.warning("slow-trace dump to %s failed", directory,
@@ -2315,6 +2498,22 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_qos_shed_total": "counter",
     "imageregion_qos_dequeued_total": "counter",
     "imageregion_qos_interactive_jumps_total": "counter",
+    # Wire transport (protocol v3, WireStats): vectored-flush
+    # coalescing, shm-ring traffic, chunk streaming.  Registered here
+    # so the families carry real TYPE headers and pass the committed
+    # cardinality budget (scripts/metrics_lint.py) — they were
+    # exposition-only ("untyped") before the budget existed.
+    "imageregion_wire_flushes_total": "counter",
+    "imageregion_wire_frames_total": "counter",
+    "imageregion_wire_flush_bytes_total": "counter",
+    "imageregion_wire_frames_per_flush": "gauge",
+    "imageregion_wire_ring_hits_total": "counter",
+    "imageregion_wire_ring_fallbacks_total": "counter",
+    "imageregion_wire_ring_bytes_total": "counter",
+    "imageregion_wire_ring_negotiated_total": "counter",
+    "imageregion_wire_ring_declined_total": "counter",
+    "imageregion_wire_streams_total": "counter",
+    "imageregion_wire_chunks_total": "counter",
     # Conditional HTTP + fleet-global byte tier (server.httpcache /
     # parallel.fleet peer fetch): the edge offload ladder's counters.
     "imageregion_httpcache_etag_requests_total": "counter",
@@ -2325,6 +2524,13 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_httpcache_peer_fetches_total": "counter",
     "imageregion_httpcache_peer_fallbacks_total": "counter",
     "imageregion_httpcache_peer_putbacks_total": "counter",
+    # Response provenance (utils.provenance): which byte-source tier
+    # answered, per serving member, plus routing flags.
+    "imageregion_provenance_total": "counter",
+    "imageregion_provenance_flags_total": "counter",
+    # Conditional HTTP, Last-Modified leg: If-Modified-Since-only
+    # revalidations (the ETag path keeps its own counters).
+    "imageregion_httpcache_ims_requests_total": "counter",
 }
 
 # Terse HELP strings for the families whose meaning is not obvious
@@ -2425,6 +2631,14 @@ METRIC_HELP: Dict[str, str] = {
         "Peer probe/fetch failures that fell back to the render path",
     "imageregion_httpcache_peer_putbacks_total":
         "Stolen-render bytes written back to the shard authority",
+    "imageregion_provenance_total":
+        "Responses by byte-source tier and serving member "
+        "(utils.provenance closed vocabulary)",
+    "imageregion_provenance_flags_total":
+        "Responses carrying a routing flag (stolen / failed_over / "
+        "drain_rehomed / coalesced / quality_capped)",
+    "imageregion_httpcache_ims_requests_total":
+        "If-Modified-Since-only revalidation arrivals (ETag absent)",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -2440,11 +2654,22 @@ def _family_of(line: str) -> str:
     return name
 
 
-def finalize_exposition(lines: List[str]) -> str:
+def finalize_exposition(lines: List[str],
+                        openmetrics: bool = False) -> str:
     """Order series by family (first-seen), emit one ``# TYPE`` header
     per family, pass comments through.  The single formatter shared by
     the app's ``/metrics`` and the sidecar merge path, so TYPE headers
-    can never duplicate across the process boundary."""
+    can never duplicate across the process boundary.
+
+    ``openmetrics=True`` produces a body a STRICT OpenMetrics parser
+    accepts (the negotiated exposition that carries exemplars — one
+    illegal line would fail the whole scrape): free-form comments are
+    dropped (only HELP/TYPE/UNIT/EOF may follow ``#``), ``untyped``
+    maps to OM's ``unknown``, and counter metadata follows the OM
+    naming rule — families ending ``_total`` declare HELP/TYPE under
+    the suffix-less name, counters NOT ending ``_total`` (legacy
+    names) degrade to ``unknown`` rather than violate the grammar.
+    The caller appends the ``# EOF`` terminator."""
     families: Dict[str, List[str]] = {}
     order: List[str] = []
     comments: List[str] = []
@@ -2463,21 +2688,44 @@ def finalize_exposition(lines: List[str]) -> str:
             families[fam] = []
             order.append(fam)
         families[fam].append(line)
+    present = set(order)
     out: List[str] = []
     for fam in order:
-        out.append(f"# HELP {fam} "
-                   f"{METRIC_HELP.get(fam, fam.replace('_', ' '))}")
-        out.append(f"# TYPE {fam} {METRIC_TYPES.get(fam, 'untyped')}")
+        mtype = METRIC_TYPES.get(fam, "untyped")
+        help_text = METRIC_HELP.get(fam, fam.replace("_", " "))
+        meta_name = fam
+        if openmetrics:
+            if mtype == "counter":
+                base = fam[: -len("_total")] \
+                    if fam.endswith("_total") else None
+                if base and base not in present:
+                    meta_name = base
+                else:
+                    # Legacy counter name (no _total suffix), or the
+                    # suffix-less name is ITSELF a present family
+                    # (imageregion_flight_events_total vs the
+                    # ..._events gauge): duplicate metadata would
+                    # fail the strict parser — degrade to unknown.
+                    mtype = "unknown"
+            elif mtype == "untyped":
+                mtype = "unknown"
+        out.append(f"# HELP {meta_name} {help_text}")
+        out.append(f"# TYPE {meta_name} {mtype}")
         out += families[fam]
-    out += comments
+    if not openmetrics:
+        out += comments
     return "\n".join(out) + "\n"
 
 
-def request_metric_lines() -> List[str]:
+def request_metric_lines(exemplars: bool = False) -> List[str]:
     """The frontend-local request series (histogram + totals), the
     cost-ledger histograms, the SLO burn gauges and the local
-    flight-recorder ring state."""
-    lines = REQUEST_HIST.series("imageregion_request_duration_ms")
+    flight-recorder ring state.  ``exemplars=True`` adds the
+    OpenMetrics exemplar tails to the request-duration buckets — ONLY
+    for scrapes that negotiated ``application/openmetrics-text`` (the
+    classic text parser rejects the syntax)."""
+    lines = REQUEST_HIST.series("imageregion_request_duration_ms",
+                                exemplars=exemplars)
     with _REQ_LOCK:
         totals = sorted(_REQ_TOTALS.items())
     for (route, status), n in totals:
@@ -2485,6 +2733,7 @@ def request_metric_lines() -> List[str]:
                      f'status="{status}"}} {n}')
     lines += cost_metric_lines()
     lines += HTTPCACHE.metric_lines()
+    lines += PROVENANCE.metric_lines()
     lines += SLO.metric_lines()
     lines += [
         f"imageregion_flight_events {len(FLIGHT)}",
@@ -2657,3 +2906,4 @@ def reset() -> None:
     PREFETCH.reset()
     QOS.reset()
     HTTPCACHE.reset()
+    PROVENANCE.reset()

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Metric-cardinality budget check (standalone + tier-1-tested).
+
+The exposition lint in tests/test_telemetry.py holds the CLOSED set of
+label KEYS; this tool holds the other half of the cardinality
+contract: which families may use which labels, how many series each
+family may produce (label-value bounds multiplied out), and what the
+whole exposition may add up to — against a COMMITTED budget file
+(conf/metrics_budget.json).  A new family that smuggles an unbounded
+label, or a label-value explosion that multiplies past its budget,
+fails here mechanically before it melts a Prometheus.
+
+Two modes::
+
+    python scripts/metrics_lint.py                 # registry check
+    python scripts/metrics_lint.py metrics.txt ... # + exposition lint
+
+* **Registry mode** validates the budget against the live
+  ``telemetry.METRIC_TYPES`` registry: every budgeted family exists,
+  every referenced label key has a committed value bound, every
+  family's label product fits its ``max_series`` (histograms get the
+  bucket multiplier), and the fleet-wide total fits
+  ``max_total_series``.
+* **Exposition mode** additionally parses scraped text: every series'
+  family must be registered, its label keys must be a subset of the
+  family's budgeted labels (plus ``le`` on histograms and the
+  sidecar-merge ``process`` dimension), and the distinct-series count
+  must fit the total budget.  OpenMetrics exemplar tails are stripped
+  before parsing.
+
+Exit status 0 = clean; 1 = findings (printed one per line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Dict, List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BUDGET = os.path.join(REPO_ROOT, "conf", "metrics_budget.json")
+
+_SERIES_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s")
+_LABEL_KEY_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)=')
+
+# Labels every family may carry without declaring them: ``le`` on
+# histogram series, ``process`` from the sidecar /metrics merge.
+_IMPLICIT_HIST = ("le",)
+_IMPLICIT_ALL = ("process",)
+
+
+def load_budget(path: str = DEFAULT_BUDGET) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _metric_types() -> Dict[str, str]:
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    from omero_ms_image_region_tpu.utils.telemetry import METRIC_TYPES
+    return METRIC_TYPES
+
+
+def _family_of(name: str, types: Dict[str, str]) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if types.get(base) == "histogram":
+                return base
+    return name
+
+
+def _family_budget(budget: dict, family: str) -> dict:
+    return budget.get("families", {}).get(family, {"labels": []})
+
+
+def lint_registry(budget: dict) -> List[str]:
+    """Budget <-> registry consistency + the multiplied-out bounds."""
+    findings: List[str] = []
+    types = _metric_types()
+    bounds = budget.get("label_bounds", {})
+    default_max = int(budget.get("default_max_series", 64))
+    total = 0
+    for family, spec in sorted(budget.get("families", {}).items()):
+        if family not in types:
+            findings.append(
+                f"budget names unknown family {family!r} (stale "
+                f"entry? METRIC_TYPES has no such family)")
+            continue
+        product = 1
+        for key in spec.get("labels", []):
+            if key not in bounds:
+                findings.append(
+                    f"{family}: label {key!r} has no committed value "
+                    f"bound in label_bounds")
+                continue
+            product *= int(bounds[key])
+        allowed = int(spec.get("max_series", default_max))
+        if product > allowed:
+            findings.append(
+                f"{family}: label product {product} exceeds its "
+                f"max_series {allowed} — either shrink a label's "
+                f"bound or raise the family budget DELIBERATELY")
+        total += product * ((int(bounds.get("le", 20)) + 3)
+                            if types.get(family) == "histogram"
+                            else 1)
+    # Unlabeled registry families each contribute one series.
+    total += sum(1 for f in types if f not in
+                 budget.get("families", {}))
+    max_total = int(budget.get("max_total_series", 0))
+    if max_total and total > max_total:
+        findings.append(
+            f"estimated fleet-wide series total {total} exceeds "
+            f"max_total_series {max_total}")
+    return findings
+
+
+def lint_exposition(text: str, budget: dict) -> List[str]:
+    """Scraped exposition text vs the budget: label keys per family,
+    unknown families, distinct-series total."""
+    findings: List[str] = []
+    types = _metric_types()
+    seen_series = set()
+    flagged = set()
+    for line in text.rstrip("\n").split("\n"):
+        if not line or line.startswith("#"):
+            continue
+        # Strip an OpenMetrics exemplar tail before parsing.
+        line = line.split(" # ", 1)[0] + " "
+        m = _SERIES_RE.match(line)
+        if m is None:
+            findings.append(f"unparseable series line: {line!r}")
+            continue
+        name, labels = m.group(1), m.group(3) or ""
+        family = _family_of(name, types)
+        if family not in types:
+            if family not in flagged:
+                flagged.add(family)
+                findings.append(
+                    f"family {family!r} is not registered in "
+                    f"METRIC_TYPES (register it + budget it)")
+            continue
+        spec = _family_budget(budget, family)
+        allowed = set(spec.get("labels", [])) | set(_IMPLICIT_ALL)
+        if types.get(family) == "histogram":
+            allowed |= set(_IMPLICIT_HIST)
+        for key in _LABEL_KEY_RE.findall(labels):
+            if key not in allowed and (family, key) not in flagged:
+                flagged.add((family, key))
+                findings.append(
+                    f"{family}: label {key!r} is not in its budgeted "
+                    f"label set {sorted(allowed)} — a new label is a "
+                    f"deliberate budget change, never a drive-by")
+        seen_series.add((name, labels))
+    max_total = int(budget.get("max_total_series", 0))
+    if max_total and len(seen_series) > max_total:
+        findings.append(
+            f"exposition carries {len(seen_series)} distinct series, "
+            f"over max_total_series {max_total}")
+    return findings
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Metric-cardinality budget check (registry "
+                    "consistency + optional exposition lint)")
+    parser.add_argument("expositions", nargs="*",
+                        help="scraped /metrics text files to lint")
+    parser.add_argument("--budget", default=DEFAULT_BUDGET,
+                        help="budget JSON (default: "
+                             "conf/metrics_budget.json)")
+    args = parser.parse_args(argv)
+    budget = load_budget(args.budget)
+    findings = lint_registry(budget)
+    for path in args.expositions:
+        with open(path) as f:
+            for finding in lint_exposition(f.read(), budget):
+                findings.append(f"{path}: {finding}")
+    for finding in findings:
+        print(f"METRICS-LINT: {finding}")
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("metrics budget: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,11 @@ def load_traces(paths, limit):
 
 
 def render_trace(doc) -> str:
+    """One request's waterfall.  Multi-member traces (fleet hops /
+    sidecar spans carrying a ``member`` dimension) gain a per-hop
+    LANE column: every span line names the member whose process ran
+    it, fleet hops print as ``hop:member`` markers, and a footer sums
+    per-member time — the stitched cross-member story at a glance."""
     total = float(doc.get("total_ms") or max(
         (s["start_ms"] + s["dur_ms"] for s in doc.get("spans", ())),
         default=1.0))
@@ -62,29 +67,58 @@ def render_trace(doc) -> str:
     ts = doc.get("ts")
     when = (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
             if ts else "?")
+    spans = sorted(doc.get("spans", ()), key=lambda s: s["start_ms"])
+    members = []
+    for s in spans:
+        m = s.get("member")
+        if m and m not in members:
+            members.append(m)
+    lane_w = max([len(m) for m in members] + [4]) if members else 0
+    head = (f"trace {doc.get('trace_id', '?')}  route="
+            f"{doc.get('route', '?')}  status={doc.get('status', '?')}"
+            f"  total={total:.1f} ms  at {when}")
+    if members:
+        head += f"  members={','.join(members)}"
+    lane_head = f"{'lane':<{lane_w}}  " if members else ""
     lines = [
-        f"trace {doc.get('trace_id', '?')}  route="
-        f"{doc.get('route', '?')}  status={doc.get('status', '?')}  "
-        f"total={total:.1f} ms  at {when}",
-        f"  {'start':>9}  {'dur':>9}  "
+        head,
+        f"  {'start':>9}  {'dur':>9}  {lane_head}"
         f"{'waterfall':<{BAR_WIDTH}}  span",
     ]
-    for s in sorted(doc.get("spans", ()), key=lambda s: s["start_ms"]):
+    member_ms = {}
+    for s in spans:
         x0 = int(BAR_WIDTH * max(s["start_ms"], 0.0) / total)
         x1 = int(BAR_WIDTH * min(s["start_ms"] + s["dur_ms"], total)
                  / total)
         x0 = min(x0, BAR_WIDTH - 1)
         bar = (" " * x0 + "#" * max(x1 - x0, 1)).ljust(BAR_WIDTH)
         extra = {k: v for k, v in s.items()
-                 if k not in ("name", "start_ms", "dur_ms")}
+                 if k not in ("name", "start_ms", "dur_ms", "member")}
+        name = s["name"]
+        member = s.get("member", "")
+        if name == "fleet.hop":
+            # Hop markers read as their own vocabulary: hop:member.
+            name = f"hop:{extra.pop('hop', '?')}"
+        if member:
+            member_ms[member] = member_ms.get(member, 0.0) \
+                + float(s["dur_ms"])
         suffix = f"  {extra}" if extra else ""
+        lane = f"{member:<{lane_w}}  " if members else ""
         lines.append(f"  {s['start_ms']:>8.1f}m {s['dur_ms']:>8.1f}m  "
-                     f"{bar}  {s['name']}{suffix}")
+                     f"{lane}{bar}  {name}{suffix}")
+    if len(members) > 1:
+        pretty = "  ".join(f"{m}={member_ms.get(m, 0.0):.1f}ms"
+                           for m in members)
+        lines.append(f"  members: {pretty}")
     cost = doc.get("cost")
     if cost:
         pretty = "  ".join(
             f"{k}={cost[k]:g}" for k in sorted(cost))
         lines.append(f"  cost: {pretty}")
+    prov = doc.get("prov")
+    if prov:
+        pretty = "  ".join(f"{k}={prov[k]}" for k in sorted(prov))
+        lines.append(f"  provenance: {pretty}")
     return "\n".join(lines)
 
 
@@ -119,8 +153,12 @@ def render_flight(doc) -> str:
     ]
     rob_counts: dict = {}
     session_counts: dict = {}
+    member_counts: dict = {}
     for e in events:
         kind = e.get("kind", "?")
+        if e.get("member"):
+            member_counts[e["member"]] = \
+                member_counts.get(e["member"], 0) + 1
         extra = {k: v for k, v in e.items() if k not in ("ts", "kind")}
         suffix = ("  " + " ".join(f"{k}={v}" for k, v in
                                   sorted(extra.items()))
@@ -154,6 +192,13 @@ def render_flight(doc) -> str:
         pretty = "  ".join(f"{k}={v}" for k, v in
                            sorted(session_counts.items()))
         lines.append(f"  session-serving: {pretty}")
+    if member_counts:
+        # Fleet identity footer: a merged fleet ring (or a member-
+        # stamped process ring) sums its events per member, so a
+        # post-incident dump answers "whose last seconds are these".
+        pretty = "  ".join(f"{k}={v}" for k, v in
+                           sorted(member_counts.items()))
+        lines.append(f"  members: {pretty}")
     return "\n".join(lines)
 
 

@@ -543,3 +543,21 @@ def test_http_cache_block_parses_and_validates():
         AppConfig.from_dict({"http-cache": {"max-age-s": -1}})
     with pytest.raises(ValueError, match="peer-timeout-ms"):
         AppConfig.from_dict({"http-cache": {"peer-timeout-ms": 0}})
+
+
+def test_provenance_header_knob_parses():
+    """telemetry.provenance-header: the opt-in debug header, default
+    OFF (an operator surface, never ambient)."""
+    assert AppConfig().telemetry.provenance_header is False
+    cfg = AppConfig.from_dict({})
+    assert cfg.telemetry.provenance_header is False
+    cfg = AppConfig.from_dict(
+        {"telemetry": {"provenance-header": True}})
+    assert cfg.telemetry.provenance_header is True
+
+
+def test_http_cache_epoch_auto_accepted():
+    """"auto" is a valid epoch value (resolved to a derived stamp at
+    create_app time); explicit values stay verbatim overrides."""
+    cfg = AppConfig.from_dict({"http-cache": {"epoch": "auto"}})
+    assert cfg.http_cache.epoch == "auto"

@@ -569,3 +569,266 @@ class TestPeerByteTier:
                 await asyncio.gather(*tasks, return_exceptions=True)
 
         asyncio.run(scenario())
+
+
+# ----------------------------------- Last-Modified / If-Modified-Since
+
+class TestLastModified:
+    """PR 11 follow-on: 200s carry Last-Modified (ingest/source mtime
+    via the metadata path) and If-Modified-Since-only clients get the
+    same zero-work 304 contract as If-None-Match — with the ETag
+    winning whenever both are present (RFC 9110)."""
+
+    def test_200_carries_last_modified_and_ims_304_is_renderless(
+            self, data_dir):
+        async def scenario():
+            app = create_app(_config(data_dir))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                assert r.status == 200
+                await r.read()
+                lm = r.headers.get("Last-Modified")
+                assert lm, "200 must carry Last-Modified"
+                # And it parses back to the source mtime class.
+                assert httpcache.parse_http_date(lm) is not None
+
+                renders = _renders()
+                ims0 = telemetry.HTTPCACHE.ims_requests
+                nm0 = telemetry.HTTPCACHE.not_modified
+                r = await client.get(
+                    URL, headers={"If-Modified-Since": lm})
+                assert r.status == 304
+                assert r.headers.get("Last-Modified") == lm
+                assert r.headers.get("ETag")
+                assert _renders() == renders, \
+                    "IMS revalidation must be render-free"
+                assert telemetry.HTTPCACHE.ims_requests == ims0 + 1
+                assert telemetry.HTTPCACHE.not_modified == nm0 + 1
+
+                # A stale IMS (source newer) renders the full 200.
+                r = await client.get(URL, headers={
+                    "If-Modified-Since":
+                        "Thu, 01 Jan 1970 00:00:00 GMT"})
+                assert r.status == 200
+                await r.read()
+
+                # Garbage IMS degrades to the full 200, never a 500.
+                r = await client.get(
+                    URL, headers={"If-Modified-Since": "not-a-date"})
+                assert r.status == 200
+                await r.read()
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+    def test_etag_wins_when_both_present(self, data_dir):
+        async def scenario():
+            app = create_app(_config(data_dir))
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                assert r.status == 200
+                await r.read()
+                etag = r.headers["ETag"]
+                lm = r.headers["Last-Modified"]
+                # Non-matching ETag + fresh IMS: the ETag verdict
+                # (modified) WINS — full 200, the IMS freshness is
+                # ignored per RFC 9110.
+                r = await client.get(URL, headers={
+                    "If-None-Match": '"ir1-0-000000000000000000000000"',
+                    "If-Modified-Since": lm})
+                assert r.status == 200
+                await r.read()
+                # Matching ETag + stale IMS: the ETag verdict
+                # (unchanged) WINS — 304.
+                r = await client.get(URL, headers={
+                    "If-None-Match": etag,
+                    "If-Modified-Since":
+                        "Thu, 01 Jan 1970 00:00:00 GMT"})
+                assert r.status == 304
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+    def test_proxy_frontends_skip_last_modified(self):
+        # Device-free check of the helper contract: no services =>
+        # no local source tree => no Last-Modified (the ETag still
+        # gives those deployments free revalidation).
+        from omero_ms_image_region_tpu.services.metadata import \
+            LocalMetadataService
+        svc = LocalMetadataService("/nonexistent-data-dir")
+        assert svc.source_mtime(12345) is None
+
+
+# ---------------------------------------------- http-cache.epoch: auto
+
+class TestEpochAuto:
+    GOLDEN_EPOCH = "m1700000000"
+    GOLDEN_ETAG = '"ir1-m1700000000-9a40de0244ee35d685234ef0"'
+
+    def _pin_tree(self, data_dir):
+        for root, dirs, files in os.walk(data_dir, topdown=False):
+            for name in files + dirs:
+                os.utime(os.path.join(root, name),
+                         (1700000000, 1700000000))
+        os.utime(data_dir, (1700000000, 1700000000))
+
+    def test_derivation_pinned(self, data_dir):
+        """The golden derivation: a tree whose stamps all read
+        1700000000 derives exactly this epoch — and the resulting
+        ETag joins the golden corpus (drift fails loudly)."""
+        self._pin_tree(data_dir)
+        assert httpcache.derive_epoch(data_dir) == self.GOLDEN_EPOCH
+        key = ImageRegionCtx.create_cache_key(
+            {"imageId": "1", "theZ": "0", "theT": "0",
+             "tile": "0,0,0,256,256", "format": "png", "m": "c",
+             "c": "1|0:60000$FF0000"})
+        assert httpcache.etag_for(key, self.GOLDEN_EPOCH) \
+            == self.GOLDEN_ETAG
+
+    def test_reingest_bumps_the_epoch(self, data_dir):
+        self._pin_tree(data_dir)
+        before = httpcache.derive_epoch(data_dir)
+        os.utime(os.path.join(data_dir, str(IMG)),
+                 (1800000000, 1800000000))
+        after = httpcache.derive_epoch(data_dir)
+        assert after != before
+        assert after == "m1800000000"
+
+    def test_missing_tree_derives_default(self, tmp_path):
+        assert httpcache.derive_epoch(
+            str(tmp_path / "nope")) == "0"
+
+    def test_app_resolves_auto_and_serves_it(self, data_dir):
+        from omero_ms_image_region_tpu.server.config import \
+            HttpCacheConfig
+        self._pin_tree(data_dir)
+        cfg = _config(data_dir,
+                      http_cache=HttpCacheConfig(epoch="auto"))
+
+        async def scenario():
+            app = create_app(cfg)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                assert r.status == 200
+                await r.read()
+                assert f"-{self.GOLDEN_EPOCH}-" in r.headers["ETag"]
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+        assert cfg.http_cache.epoch == self.GOLDEN_EPOCH
+
+    def test_yaml_accepts_auto_and_explicit_override_wins(self):
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        cfg = AppConfig.from_dict({"http-cache": {"epoch": "auto"}})
+        assert cfg.http_cache.epoch == "auto"
+        cfg = AppConfig.from_dict({"http-cache": {"epoch": "v7"}})
+        assert cfg.http_cache.epoch == "v7"
+
+    def test_auto_refused_on_deviceless_frontends(self, tmp_path):
+        """A proxy/fleet frontend has no local source tree: epoch
+        'auto' deriving '0' there would mean edge caches NEVER see an
+        epoch bump — refused loudly at create_app."""
+        from omero_ms_image_region_tpu.server.config import \
+            HttpCacheConfig
+        cfg = AppConfig(
+            data_dir=str(tmp_path / "nothing-here"),
+            sidecar=SidecarConfig(role="frontend",
+                                  socket=str(tmp_path / "x.sock")),
+            http_cache=HttpCacheConfig(epoch="auto"))
+        with pytest.raises(ValueError, match="auto"):
+            create_app(cfg)
+
+
+class TestEpochFoldsIntoLastModified:
+    """Bumping the epoch must stale If-Modified-Since-only clients
+    exactly like it stales ETags — otherwise an IMS 304 against a
+    pre-bump Last-Modified revives the very entries the bump killed."""
+
+    def test_basis_vocabulary(self):
+        basis = httpcache.last_modified_basis
+        assert basis(100.0, "0") == 100.0
+        assert basis(100.0, "m500") == 500.0     # bump moves LM fwd
+        assert basis(900.0, "m500") == 900.0
+        assert basis(100.0, "2026-08.r2") is None  # un-ordered epoch
+        assert basis(None, "0") is None
+
+    def test_operator_epoch_disarms_ims_leg(self, data_dir):
+        from omero_ms_image_region_tpu.server.config import \
+            HttpCacheConfig
+        cfg = _config(data_dir,
+                      http_cache=HttpCacheConfig(epoch="v2"))
+
+        async def scenario():
+            app = create_app(cfg)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                assert r.status == 200
+                await r.read()
+                # No Last-Modified: an operator epoch cannot be
+                # ordered against mtimes, so the IMS channel closes.
+                assert "Last-Modified" not in r.headers
+                r = await client.get(URL, headers={
+                    "If-Modified-Since":
+                        "Fri, 01 Jan 2100 00:00:00 GMT"})
+                assert r.status == 200   # never a 304 on IMS alone
+                await r.read()
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+
+    def test_derived_epoch_bump_stales_stored_ims_dates(
+            self, data_dir):
+        from omero_ms_image_region_tpu.server.config import \
+            HttpCacheConfig
+
+        async def last_modified(epoch):
+            cfg = _config(data_dir,
+                          http_cache=HttpCacheConfig(epoch=epoch))
+            app = create_app(cfg)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(URL)
+                assert r.status == 200
+                await r.read()
+                return r.headers["Last-Modified"]
+            finally:
+                await client.close()
+
+        async def scenario():
+            lm_old = await last_modified("m1")
+            # A derived-epoch bump FAR past the source mtime moves
+            # Last-Modified forward, so a client that stored lm_old
+            # revalidates to a fresh 200, not a stale 304.
+            lm_new = await last_modified("m4000000000")
+            assert httpcache.parse_http_date(lm_new) \
+                > httpcache.parse_http_date(lm_old)
+            cfg = _config(data_dir, http_cache=HttpCacheConfig(
+                epoch="m4000000000"))
+            app = create_app(cfg)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get(
+                    URL, headers={"If-Modified-Since": lm_old})
+                assert r.status == 200   # pre-bump date is stale
+                await r.read()
+                r = await client.get(
+                    URL, headers={"If-Modified-Since": lm_new})
+                assert r.status == 304   # post-bump date is fresh
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())

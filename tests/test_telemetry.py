@@ -445,9 +445,19 @@ _SERIES_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
     r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
     r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
-    r" -?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?$")
+    r" -?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?"
+    # Optional OpenMetrics exemplar tail (``_bucket`` lines only —
+    # enforced below): `` # {k="v",...} value [timestamp]``.
+    r'(?P<exemplar> # \{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}'
+    r" -?[0-9]+(\.[0-9]+)?( [0-9]+(\.[0-9]+)?)?)?$")
 
 _LABEL_KEY_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)=')
+
+# Exemplar label keys are their own closed set: a trace id is
+# unbounded AS A LABEL but fine as an exemplar (exemplars are
+# per-bucket slots, not series — cardinality stays fixed).
+_EXEMPLAR_LABEL_KEYS = frozenset({"trace_id", "tier"})
 
 # Every label key any family may legally use.  The closed set is the
 # cardinality guard: a per-request label (trace id, image id, client
@@ -467,6 +477,10 @@ _ALLOWED_LABEL_KEYS = frozenset({
     # Sessions themselves NEVER label a series (unbounded
     # cardinality) — only aggregates reach the exposition.
     "class",
+    # Response provenance (PR 12): ``tier`` is utils.provenance.TIERS
+    # verbatim, ``flag`` is utils.provenance.FLAGS — both closed by
+    # construction (ProvenanceStats clamps drifted strings).
+    "flag",
 })
 
 
@@ -501,6 +515,14 @@ def _lint_exposition(text):
         name = m.group(1)
         assert re.fullmatch(r"[a-z0-9_]+", name), \
             f"metric name not snake_case: {line!r}"
+        exemplar = m.group("exemplar") or ""
+        if exemplar:
+            assert name.endswith("_bucket"), \
+                f"exemplar outside a _bucket series: {line!r}"
+            for label_key in _LABEL_KEY_RE.findall(exemplar):
+                assert label_key in _EXEMPLAR_LABEL_KEYS, \
+                    f"unexpected exemplar label {label_key!r}: " \
+                    f"{line!r}"
         family = name
         for suffix in ("_bucket", "_sum", "_count"):
             if name.endswith(suffix) and name[:-len(suffix)] in typed:
@@ -639,6 +661,105 @@ class TestExpositionLint:
             assert f"imageregion_httpcache_{family}_total 1" in text
         telemetry.reset()
         assert telemetry.HTTPCACHE.metric_lines() == []
+
+    def test_provenance_families_lint_and_reset(self):
+        """imageregion_provenance_total{tier,member} +
+        imageregion_provenance_flags_total{flag}: closed label sets
+        (drifted tiers clamp, member overflow guarded), ride
+        request_metric_lines, clear on reset()."""
+        telemetry.PROVENANCE.count(
+            {"tier": "render_cold", "member": "m1", "stolen": 1,
+             "coalesced": 1})
+        telemetry.PROVENANCE.count({"tier": "peer", "member": "m0"})
+        telemetry.PROVENANCE.count({"tier": "304"})
+        text = telemetry.finalize_exposition(
+            telemetry.request_metric_lines())
+        _lint_exposition(text)
+        assert ('imageregion_provenance_total{tier="render_cold",'
+                'member="m1"} 1') in text
+        assert ('imageregion_provenance_total{tier="304",'
+                'member="-"} 1') in text
+        assert ('imageregion_provenance_flags_total{flag="stolen"} 1'
+                in text)
+        assert telemetry.PROVENANCE.totals() == {
+            "render_cold": 1, "peer": 1, "304": 1}
+        # Member overflow guard: a buggy caller minting member names
+        # lands in _overflow, never unbounded label values.
+        for i in range(80):
+            telemetry.PROVENANCE.count(
+                {"tier": "byte_cache", "member": f"x{i}"})
+        members = {m for _, m in
+                   telemetry.PROVENANCE.by_tier_member}
+        assert "_overflow" in members
+        assert len(members) <= 66
+        telemetry.reset()
+        assert telemetry.PROVENANCE.metric_lines() == []
+
+    def test_exemplars_ride_request_exposition_and_lint(self):
+        """OpenMetrics exemplars on the request-duration histogram:
+        one per bucket (most recent wins), linted, reset-clean — and
+        STRICTLY opt-in: the classic text exposition must stay free
+        of exemplar tails (the text/plain parser rejects them, and
+        one tail would fail the whole scrape)."""
+        telemetry.REQUEST_HIST.observe(
+            "render_image_region", 41.0,
+            exemplar=("0123456789abcdef", "byte_cache"))
+        plain = telemetry.finalize_exposition(
+            telemetry.request_metric_lines())
+        _lint_exposition(plain)
+        assert " # {" not in plain, \
+            "exemplars must not leak into the classic exposition"
+        text = telemetry.finalize_exposition(
+            telemetry.request_metric_lines(exemplars=True))
+        _lint_exposition(text)
+        assert 'trace_id="0123456789abcdef"' in text
+        assert 'tier="byte_cache"' in text
+        snap = telemetry.exemplars_snapshot()
+        assert snap["render_image_region"][0]["trace"] \
+            == "0123456789abcdef"
+        telemetry.reset()
+        assert telemetry.exemplars_snapshot() == {}
+
+    def test_openmetrics_mode_is_grammar_strict(self):
+        """finalize_exposition(openmetrics=True) — the negotiated
+        exposition that carries exemplars — must satisfy the STRICT
+        OpenMetrics grammar: no free-form comments, no 'untyped',
+        counters declared under their _total-less name (degrading to
+        'unknown' when the suffix-less name collides with another
+        family or the legacy name has no suffix)."""
+        telemetry.count_request("render_image_region", 200)
+        telemetry.FLIGHT.record("drill")
+        lines = telemetry.request_metric_lines()
+        lines.append("# sidecar metrics unavailable")
+        lines.append("made_up_metric 1")
+        classic = telemetry.finalize_exposition(lines)
+        assert "# sidecar metrics unavailable" in classic
+        assert "untyped" in classic           # made_up_metric
+        om = telemetry.finalize_exposition(lines, openmetrics=True)
+        assert "# sidecar metrics unavailable" not in om
+        assert "untyped" not in om
+        assert "# TYPE made_up_metric unknown" in om
+        assert "# TYPE imageregion_requests counter" in om
+        # The flight gauge/counter pair: stripping _total would
+        # collide with the gauge family — the counter degrades.
+        assert "# TYPE imageregion_flight_events gauge" in om
+        assert "# TYPE imageregion_flight_events_total unknown" in om
+        for line in om.rstrip("\n").split("\n"):
+            if line.startswith("#"):
+                assert line.startswith(("# HELP ", "# TYPE ")), line
+
+    def test_flight_recorder_member_stamp(self):
+        """A process that knows its fleet identity stamps every
+        recorded event; events naming their own member keep it; the
+        stamp clears on reset()."""
+        telemetry.FLIGHT.set_member("m2")
+        telemetry.FLIGHT.record("xla.compile", ms=1.0)
+        telemetry.FLIGHT.record("fleet.steal", member="m0")
+        events = telemetry.FLIGHT.snapshot()
+        assert events[-2]["member"] == "m2"
+        assert events[-1]["member"] == "m0"
+        telemetry.reset()
+        assert telemetry.FLIGHT.member is None
 
     def test_fleet_app_metrics_parse(self, data_dir):
         """A combined-role fleet app exposes the imageregion_fleet_*

@@ -1738,6 +1738,244 @@ def bench_overload_smoke(burst: int = 160, exec_ms: float = 40.0,
     return out
 
 
+def bench_capacity_smoke(exec_ms: float = 60.0, grid: int = 4,
+                         tile_edge: int = 64,
+                         fleet_sizes=(1, 2, 4), lane_width: int = 2,
+                         slo_ms: float = 360.0,
+                         shed_limit: float = 0.05,
+                         window_s: float = 1.0,
+                         load_factors=(0.45, 0.9, 1.5, 2.25),
+                         viewers: int = 64):
+    """Capacity-knee measurement (``bench.py --smoke --capacity``,
+    tier-1 via tests/test_bench_smoke.py): the latency-vs-OFFERED-load
+    curve of a real in-process fleet under an OPEN-loop arrival
+    process, per fleet size.
+
+    Every other bench leg is closed-loop (workers that wait), which
+    structurally cannot see queueing collapse — when the service slows
+    the offered load slows with it.  Here the ``services.loadmodel``
+    generator replays a seeded viewer population (heavy-tailed think
+    times and session lengths, per-session pan trajectories)
+    time-compressed to each target offered rate, and arrivals fire ON
+    SCHEDULE regardless of completions:
+
+    * per fleet size m1/m2/m4 (virtual device occupancy per the
+      ``_fleet_smoke`` idiom — ``exec_ms`` of lane time per render),
+      sweep offered load across ``load_factors`` x the size's nominal
+      capacity and extract the CAPACITY KNEE: the highest offered
+      load whose p99 still meets ``slo_ms`` and whose shed rate stays
+      under ``shed_limit``;
+    * the knee must SCALE with fleet size (the figure the autoscaler's
+      floor/ceiling sizing reads — deploy/DEPLOY.md "Capacity &
+      autoscaling");
+    * **open-loop honesty A/B**: the first past-knee point's arrival
+      list replays CLOSED-loop on the same stack — the closed p99
+      must come out LOWER (flattering), which is the regression test
+      that keeps future bench legs from quietly reverting to
+      closed-loop arrivals and reporting a collapse-free curve.
+
+    Emits ONE JSON line (the ``CAPACITY_r*.json`` record family)
+    judged direction-aware by ``scripts/bench_gate.py --capacity``
+    (knee regresses DOWN, ``_ms`` keys UP).
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.parallel.fleet import (
+        FleetImageHandler, FleetRouter, LocalMember,
+        build_local_members)
+    from omero_ms_image_region_tpu.server.admission import (
+        AdmissionController)
+    from omero_ms_image_region_tpu.server.app import build_services
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.singleflight import (
+        SingleFlight)
+    from omero_ms_image_region_tpu.services.loadmodel import (
+        LoadModel, find_knee, run_closed_loop, run_open_loop)
+    from omero_ms_image_region_tpu.utils import telemetry
+
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(29)
+    exec_s = exec_ms / 1000.0
+    telemetry.LOADMODEL.reset()
+
+    class VirtualDeviceMember(LocalMember):
+        """Calibrated virtual device occupancy (the `_fleet_smoke`
+        idiom): the render is entirely real; the sleep models the
+        device service time a small CI host cannot exhibit — which
+        makes the measured knee a property of the QUEUEING STRUCTURE
+        (lanes x members x service time), not of CI core count."""
+
+        async def render(self, ctx, adopt_cache=True):
+            data = await super().render(ctx, adopt_cache)
+            await asyncio.sleep(exec_s)
+            return data
+
+    # The simulated population comes from the validated `loadmodel:`
+    # config block (operators tune think/session tails there; a
+    # driver round can point this at a real config).  The sweep pins
+    # the STRUCTURAL knobs: seeded small population time-compressed
+    # per offered rate, FLAT arrivals (diurnal 0 — the knee wants a
+    # stationary offered rate; the diurnal ramp is the elasticity
+    # drill's input), interactive-only classes (bulk pins to m0 and
+    # would muddy the per-size comparison; masks need mask fixtures).
+    lm_config = AppConfig.from_dict({"loadmodel": {
+        "seed": 31, "viewers": viewers, "diurnal-amplitude": 0.0,
+        "bulk-fraction": 0.0, "mask-fraction": 0.0,
+        "zoom-fraction": 0.0}}).loadmodel
+    model = LoadModel.from_config(lm_config, duration_s=60.0,
+                                  grid=grid)
+    natural_events = model.events()
+
+    def params_for(arrival):
+        sid = int(arrival.session.rsplit("-", 1)[1])
+        w = 21000 + (sid * 131 + arrival.step * 37) % 18000
+        return {
+            "imageId": "1", "theZ": "0", "theT": "0",
+            "tile": f"0,{arrival.x},{arrival.y},{tile_edge},"
+                    f"{tile_edge}",
+            "format": "png", "m": "c",
+            "c": f"1|0:{w}$FF0000,2|0:{w - 900}$00FF00",
+        }
+
+    def nominal_tps(n_members: int) -> float:
+        return n_members * lane_width * 1000.0 / exec_ms
+
+    async def run_size(tmp: str, n_members: int) -> tuple:
+        config = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=False),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        services = build_services(config)
+        members = [VirtualDeviceMember(
+            m.name, m.handler, m.services,
+            down_cooldown_s=m.down_cooldown_s,
+            byte_cache_prechecked=m.byte_cache_prechecked)
+            for m in build_local_members(config, services, n_members)]
+        router = FleetRouter(members, lane_width=lane_width,
+                             steal_min_backlog=0)
+        handler = FleetImageHandler(
+            router, single_flight=SingleFlight(),
+            admission=AdmissionController(4096, renderer=router),
+            base_services=services)
+
+        async def submit(arrival):
+            ctx = ImageRegionCtx.from_params(params_for(arrival))
+            ctx.omero_session_key = arrival.session
+            out = await handler.render_image_region(ctx)
+            assert out
+
+        try:
+            # One warm render outside every measured window (shared
+            # jit compile across stacks of one process).
+            first = natural_events[0]
+            await submit(first)
+            points = []
+            past_knee_arrivals = None
+            for factor in load_factors:
+                offered = factor * nominal_tps(n_members)
+                # Steady-state slice of the simulated day, rescaled
+                # to this offered rate (LoadModel.window — the
+                # compressed day's thin edges must not under-offer).
+                sched = model.window(offered, window_s,
+                                     natural_events)
+                report = await run_open_loop(
+                    submit, sched,
+                    offered_tps=len(sched) / window_s)
+                assert not report.errors, \
+                    f"open-loop leg failed bare: {report.errors[:3]}"
+                points.append(report.as_point())
+            knee, p99_at_knee, censored = find_knee(
+                points, slo_ms, shed_limit)
+            ab = None
+            if n_members == 1 and knee is not None:
+                # Open-loop honesty A/B on the SAME stack: replay the
+                # first past-knee point's arrival list closed-loop —
+                # workers that wait self-throttle to the service rate,
+                # so the flattering p99 must come out LOWER than the
+                # open-loop p99 the sweep just measured.
+                past = next((p for p in points
+                             if p["offered_tps"] > knee), None)
+                if past is not None:
+                    past_knee_arrivals = model.window(
+                        past["offered_tps"], window_s,
+                        natural_events)
+                    closed = await run_closed_loop(
+                        submit, past_knee_arrivals,
+                        concurrency=lane_width * n_members)
+                    ab = {
+                        "offered_tps": past["offered_tps"],
+                        "openloop_p99_ms": past["p99_ms"],
+                        "closedloop_p99_ms": _opt_round(
+                            closed.p99_ms(), 1),
+                    }
+            return points, knee, p99_at_knee, censored, ab
+        finally:
+            await router.close()
+            services.pixels_service.close()
+
+    curve = {}
+    knees = {}
+    p99s = {}
+    censored_any = False
+    honesty = None
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 2, 1, grid * tile_edge,
+                                     grid * tile_edge).reshape(
+            2, 1, grid * tile_edge, grid * tile_edge)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        for n in fleet_sizes:
+            points, knee, p99_at_knee, censored, ab = asyncio.run(
+                run_size(tmp, n))
+            curve[f"m{n}"] = points
+            knees[f"m{n}"] = knee
+            p99s[f"m{n}"] = p99_at_knee
+            censored_any = censored_any or censored
+            if ab is not None:
+                honesty = ab
+    widest = f"m{max(fleet_sizes)}"
+    knee_1 = knees.get(f"m{min(fleet_sizes)}")
+    knee_w = knees.get(widest)
+    out = {
+        "metric": "capacity_smoke",
+        "capacity_slo_ms": slo_ms,
+        "capacity_shed_limit": shed_limit,
+        "capacity_virtual_exec_ms": exec_ms,
+        "capacity_window_s": window_s,
+        "capacity_viewers": viewers,
+        "capacity_fleet_sizes": list(fleet_sizes),
+        "capacity_curve": curve,
+        **{f"capacity_knee_offered_tps_{k}": _opt_round(v, 1)
+           for k, v in knees.items()},
+        # The headline pair the gate judges: the widest fleet's knee
+        # (regresses DOWN) and its p99 at the knee (regresses UP).
+        "capacity_knee_offered_tps": _opt_round(knee_w, 1),
+        "p99_at_knee_ms": _opt_round(p99s.get(widest), 1),
+        "capacity_knee_censored": bool(censored_any),
+        "capacity_scaling_efficiency": _opt_round(
+            (knee_w / (knee_1 * max(fleet_sizes) / min(fleet_sizes)))
+            if knee_w and knee_1 else None, 3),
+        # The open-loop honesty A/B (m1): closed must flatter.
+        "openloop_p99_past_knee_ms": (honesty or {}).get(
+            "openloop_p99_ms"),
+        "closedloop_p99_past_knee_ms": (honesty or {}).get(
+            "closedloop_p99_ms"),
+        "capacity_ab_offered_tps": (honesty or {}).get("offered_tps"),
+        # Open-loop integrity: arrivals the generator fired behind
+        # its own schedule (counted, never hidden).
+        "loadmodel_late_fires": telemetry.LOADMODEL.late,
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    }
+    print(json.dumps(out))
+    return out
+
+
 def bench_restart_smoke():
     """Warm-restart gate at smoke scale: render, "kill", restart with
     persistence on, and prove the first previously-seen tile serves
@@ -2655,6 +2893,10 @@ def main():
     # --smoke --offload runs the repeat-viewer offload scenario
     # (cold -> warm-local -> warm-peer -> 304 over a 2-sidecar fleet:
     # origin offload ratio, 304 latency, peer byte-fetch hit rate).
+    # --smoke --capacity runs the open-loop capacity sweep (the
+    # services.loadmodel arrival process against m1/m2/m4 fleets:
+    # latency-vs-offered-load curve, capacity knee per size, and the
+    # closed-vs-open honesty A/B) — the CAPACITY record family.
     if "--smoke" in sys.argv[1:]:
         if "--chaos" in sys.argv[1:]:
             bench_chaos_smoke()
@@ -2666,6 +2908,8 @@ def main():
             bench_sessions_smoke()
         elif "--offload" in sys.argv[1:]:
             bench_offload_smoke()
+        elif "--capacity" in sys.argv[1:]:
+            bench_capacity_smoke()
         else:
             bench_smoke()
         return

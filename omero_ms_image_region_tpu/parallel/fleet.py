@@ -177,8 +177,13 @@ class LocalMember:
         # Rolling-drain state (router.drain_member): a DRAINING member
         # finishes its in-flight work but accepts no new routes — on
         # purpose, distinct from down (a drain is not a death and must
-        # not look like one).
+        # not look like one).  ``drain_intent`` says WHO drained it:
+        # "operator" (/admin/drain — the rolling-restart posture the
+        # drain.fail-readyz flag surfaces to LBs) or "autoscale" (a
+        # routine scale-down that must NOT read as the instance
+        # leaving rotation).
         self.draining = False
+        self.drain_intent: Optional[str] = None
 
     @property
     def healthy(self) -> bool:
@@ -325,6 +330,7 @@ class RemoteMember:
         self.down_cooldown_s = down_cooldown_s
         self._down_until = 0.0
         self.draining = False
+        self.drain_intent: Optional[str] = None
 
     @property
     def healthy(self) -> bool:
@@ -745,14 +751,24 @@ class FleetRouter:
                                "raw_cache", None)
         return None
 
-    def draining_members(self) -> List[str]:
-        return [n for n in self.order if self.members[n].draining]
+    def draining_members(self, intent: Optional[str] = None
+                         ) -> List[str]:
+        """Draining member names; ``intent`` filters to one drain
+        flavor ("operator" / "autoscale") — the /readyz fail posture
+        only counts operator drains, so a routine autoscale
+        scale-down never pulls the instance from LB rotation."""
+        return [n for n in self.order
+                if self.members[n].draining
+                and (intent is None
+                     or getattr(self.members[n], "drain_intent",
+                                None) == intent)]
 
     # ----------------------------------------------------------- drains
 
     async def drain_member(self, name: str, prestage: bool = True,
                            max_planes: int = 256,
-                           settle_timeout_s: float = 30.0) -> dict:
+                           settle_timeout_s: float = 30.0,
+                           intent: str = "operator") -> dict:
         """Zero-downtime rolling drain of one member.
 
         Phases (each a flight-recorder event and a
@@ -782,9 +798,13 @@ class FleetRouter:
             raise KeyError(f"unknown fleet member {name!r}")
         member = self.members[name]
         member.draining = True
+        # The drain FLAVOR: "operator" (rolling restart — what
+        # drain.fail-readyz surfaces to LBs) vs "autoscale" (routine
+        # scale-down — annotation only, /readyz stays 200).
+        member.drain_intent = intent
         telemetry.DRAIN.set_state(name, "draining")
         telemetry.FLIGHT.record("drain.phase", member=name,
-                                phase="draining",
+                                phase="draining", intent=intent,
                                 queued=len(self._queues[name]),
                                 inflight=self._inflight[name])
         # Queued work re-homes NOW (the lanes would drain it anyway,
@@ -818,7 +838,7 @@ class FleetRouter:
         logger.info("fleet member %s drained (settled=%s, %d shard "
                     "planes, %d pre-staged on successors)", name,
                     settled, len(manifest), prestaged)
-        return {"member": name, "settled": settled,
+        return {"member": name, "settled": settled, "intent": intent,
                 "planes": len(manifest), "prestaged": prestaged}
 
     async def _prestage_handoff(self, draining: str,
@@ -866,6 +886,7 @@ class FleetRouter:
             raise KeyError(f"unknown fleet member {name!r}")
         member = self.members[name]
         member.draining = False
+        member.drain_intent = None
         telemetry.DRAIN.set_state(name, "active")
         telemetry.FLIGHT.record("drain.phase", member=name,
                                 phase="undrained")

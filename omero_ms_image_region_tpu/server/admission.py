@@ -28,6 +28,13 @@ one classification shared with the ladder and the fleet pin) draws
 ``bulk_cost`` tokens per request, so a bulk-export client exhausts its
 budget ``bulk_cost``x faster than a panning viewer.
 
+Shape-mask requests join the same meter: ``render_shape_mask`` calls
+:meth:`AdmissionController.admit_session` with its ``ShapeMaskCtx``
+(QoS-classed interactive by ``is_bulk``, cost 1), so a hostile
+mask-scraping session drains ITS bucket and sheds with the same
+``"fairness"`` 503 a tile scraper gets — the mask route used to
+bypass fairness entirely.
+
 Event-loop confined (admit/release run on the loop thread, like the
 single-flight table), so no lock.
 """

@@ -678,6 +678,33 @@ def create_app(config: Optional[AppConfig] = None,
                     .escalate_after
                 watchdog.add_target(extra)
 
+    # Elastic autoscaler (deploy/DEPLOY.md "Capacity & autoscaling"):
+    # the controller that closes the loop between measured pressure /
+    # predicted demand and fleet size — scale-down drains with warm
+    # shard handoff (intent=autoscale, so /readyz never reads a
+    # routine scale-down as an operator roll), scale-up undrains with
+    # pre-stage-back.  Config validation already required a fleet.
+    autoscaler = None
+    if config.autoscaler.enabled and fleet_router is not None:
+        from .autoscaler import Autoscaler
+
+        demand_source = None
+        if config.autoscaler.lane_capacity_tps > 0 \
+                and config.sessions.enabled:
+            # The session model's predicted demand: viewport-tracked
+            # live sessions x the calibrated per-session steady rate.
+            demand_source = (
+                lambda: telemetry.SESSIONS.tracked
+                * config.autoscaler.session_tps)
+        autoscaler = Autoscaler(
+            config.autoscaler, fleet_router, governor=governor,
+            demand_source=demand_source,
+            drain_kwargs={
+                "prestage": config.drain.prestage,
+                "max_planes": config.drain.prestage_max_planes,
+                "settle_timeout_s": config.drain.settle_timeout_s,
+            })
+
     session_store = _make_session_store(config)
 
     async def session_key(request: web.Request) -> Optional[str]:
@@ -1076,9 +1103,53 @@ def create_app(config: Optional[AppConfig] = None,
         if renderless is not None:
             provenance.mark(ctx, tier="304")
             return renderless
+        # Masks join the session model (the PR 10 follow-on): the
+        # request debits its session's fairness tokens, QoS-classed
+        # INTERACTIVE (pressure.is_bulk knows mask ctxs), so a
+        # hostile mask-scraping session sheds on ITS budget with the
+        # same "fairness" 503 the tile route gives — it used to
+        # bypass the meter entirely.  Conditional 304s stay free,
+        # exactly like the image route (zero-work contract).
+        # ...and its session reads as LIVE to the demand model: the
+        # viewport tracker keeps the session in its LRU (no lattice
+        # pollution — a mask has no tile coordinates to vote with).
+        tracker = (getattr(services.prefetcher, "viewport", None)
+                   if services is not None
+                   and services.prefetcher is not None else None)
+        if tracker is not None and ctx.omero_session_key:
+            tracker.observe_activity(ctx.omero_session_key)
+        # Byte-cache hits BEFORE the fairness gate — the tile route's
+        # footing exactly: already-rendered bytes never cost a token
+        # and never shed (the probe runs the per-caller ACL itself).
+        cache_probe = getattr(mask_handler, "cached_shape_mask", None)
+        if cache_probe is not None:
+            try:
+                cached_mask = await cache_probe(ctx)
+            except Exception as e:
+                return _status_of(e)
+            if cached_mask is not None:
+                _stamp_provenance(ctx, headers)
+                return web.Response(body=cached_mask, headers=headers)
+        mask_admission = (getattr(image_handler, "admission", None)
+                          or (services.admission
+                              if services is not None else None))
+        debit = None
+        if mask_admission is not None:
+            try:
+                debit = mask_admission.admit_session(ctx)
+            except Exception as e:
+                return _status_of(e)
+        if debit is not None:
+            provenance.mark(ctx, tokens=debit[1])
         try:
             body = await mask_handler.render_shape_mask(ctx)
         except Exception as e:
+            # Tokens pay for the ATTEMPT, exactly like the image
+            # route: a request-level failure (404/400) keeps its
+            # debit — refunding it would let a hostile session scrape
+            # nonexistent shape ids unmetered, the loophole this gate
+            # exists to close.  (Masks have no GLOBAL admission leg,
+            # so there is no shed-class refund here at all.)
             return _status_of(e)
         _stamp_provenance(ctx, headers)
         return web.Response(body=body, headers=headers)
@@ -1450,7 +1521,17 @@ def create_app(config: Optional[AppConfig] = None,
             # the load balancer: /readyz answers 503 while the roll is
             # in progress, so nginx/k8s pull the instance and the
             # restart happens with zero in-flight traffic.
-            checks["drain"] = f"draining: {','.join(draining)}"
+            # Autoscale-parked members annotate with their intent —
+            # and (below) never trip the fail-readyz posture: a
+            # routine scale-down must not read identically to a node
+            # being pulled from rotation.
+            parts = [
+                n + ("(autoscale)"
+                     if getattr(fleet_router.members[n],
+                                "drain_intent", None) == "autoscale"
+                     else "")
+                for n in draining]
+            checks["drain"] = f"draining: {','.join(parts)}"
 
     async def _ready_state() -> tuple:
         """(ok, checks) for /readyz: sidecar reachability (proxy mode),
@@ -1584,12 +1665,24 @@ def create_app(config: Optional[AppConfig] = None,
             # the governor exists to prevent.
             checks["pressure"] = governor.summary()
         if (config.drain.fail_readyz and fleet_router is not None
-                and fleet_router.draining_members()):
+                and [n for n in fleet_router.draining_members()
+                     if getattr(fleet_router.members[n],
+                                "drain_intent", None) != "autoscale"]):
             # drain.fail-readyz: surface the roll to the LB — a
             # draining instance answers 503 so nginx/k8s pull it from
             # rotation until /admin/undrain (the default annotation-
             # only posture is preserved with the flag off).
+            # Everything EXCEPT autoscale drains: an autoscaler
+            # scale-down is a routine in-instance act (survivors
+            # serve every shard, the controller undrains on demand)
+            # so it annotates instead of pulling the instance — but
+            # operator drains AND the SIGTERM quiesce (which flips
+            # draining with no intent) must keep pulling it.
             ok = False
+        if autoscaler is not None:
+            # Annotation only, like the pressure line: fleet size is
+            # the controller's business, readiness is the instance's.
+            checks["autoscaler"] = autoscaler.summary()
         return ok, checks
 
     def _drain_status() -> dict:
@@ -1598,6 +1691,8 @@ def create_app(config: Optional[AppConfig] = None,
                 name: {
                     "healthy": fleet_router.members[name].healthy,
                     "draining": fleet_router.members[name].draining,
+                    "intent": getattr(fleet_router.members[name],
+                                      "drain_intent", None),
                     "depth": fleet_router.member_depth(name),
                     "inflight": fleet_router.member_inflight(name),
                     "planes":
@@ -1640,6 +1735,18 @@ def create_app(config: Optional[AppConfig] = None,
             settle_timeout_s=config.drain.settle_timeout_s)
         doc.update(_drain_status())
         return web.json_response(doc)
+
+    async def admin_autoscaler(request: web.Request) -> web.Response:
+        """Elastic-autoscaler status (deploy/DEPLOY.md "Capacity &
+        autoscaling"): active/routable members, the floor/ceiling
+        band, cooldown state, the last refused decision, recent
+        transitions and the live signals the policy read."""
+        if autoscaler is None:
+            return web.json_response(
+                {"enabled": False,
+                 "error": "autoscaler requires autoscaler.enabled "
+                          "and a fleet topology"}, status=400)
+        return web.json_response(autoscaler.status())
 
     async def admin_undrain(request: web.Request) -> web.Response:
         """Rejoin a drained member (same remap bound as a ring join)."""
@@ -1725,6 +1832,9 @@ def create_app(config: Optional[AppConfig] = None,
         if watchdog is not None and watchdog._targets:
             tasks.append(asyncio.create_task(
                 watchdog.run(), name="watchdog"))
+        if autoscaler is not None:
+            tasks.append(asyncio.create_task(
+                autoscaler.run(), name="autoscaler"))
         app[_ROBUSTNESS_TASKS_KEY] = tasks
 
     app.on_startup.append(on_startup_robustness)
@@ -1767,6 +1877,7 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_get("/admin/drain", admin_drain)
     app.router.add_post("/admin/drain", admin_drain)
     app.router.add_post("/admin/undrain", admin_undrain)
+    app.router.add_get("/admin/autoscaler", admin_autoscaler)
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):
@@ -1779,6 +1890,16 @@ def create_app(config: Optional[AppConfig] = None,
                 pass
         if governor is not None and pressure_mod.active() is governor:
             pressure_mod.uninstall()
+        if autoscaler is not None and autoscaler._op is not None \
+                and not autoscaler._op.done():
+            # An in-flight scale-down (mid-settle/handoff) must not
+            # outlive the router it drains — cancel it BEFORE the
+            # lanes and member stacks close under it.
+            autoscaler._op.cancel()
+            try:
+                await autoscaler._op
+            except (_asyncio.CancelledError, Exception):
+                pass
         if fleet_router is not None:
             # Stop the lane workers BEFORE the member stacks (and the
             # shared host services) close under them.

@@ -364,6 +364,75 @@ class DrainConfig:
 
 
 @dataclass
+class LoadModelConfig:
+    """Open-loop load model (``services.loadmodel``): the simulated
+    viewer population ``bench.py --smoke --capacity`` replays against
+    a real in-process fleet to measure the latency-vs-offered-load
+    curve and the capacity knee.  Deterministic by seed — same seed,
+    same event stream.  See deploy/DEPLOY.md "Capacity &
+    autoscaling"."""
+
+    seed: int = 1234
+    # Simulated viewer sessions per generated window (10^4..10^6 at
+    # measurement scale; the smoke sweep uses a small population
+    # time-compressed to each offered rate).
+    viewers: int = 10000
+    # Heavy-tailed per-viewer think time between requests (lognormal:
+    # median + sigma; sigma ~1 gives the long-pause tail real viewers
+    # have).
+    think_time_median_ms: float = 350.0
+    think_time_sigma: float = 1.0
+    # Heavy-tailed session length in requests (lognormal).
+    session_length_median: float = 24.0
+    session_length_sigma: float = 1.2
+    # Diurnal intensity: session starts bunch toward the peak of a
+    # half-sine "day" (0 = flat arrivals, toward 1 = sharp peak).
+    diurnal_amplitude: float = 0.6
+    # Request-class mix (remainder is interactive tiles).
+    bulk_fraction: float = 0.02
+    mask_fraction: float = 0.0
+    # Fraction of pan steps that change zoom level.
+    zoom_fraction: float = 0.05
+
+
+@dataclass
+class AutoscalerConfig:
+    """Elastic fleet autoscaler (``server.autoscaler``): closes the
+    loop between measured pressure / predicted demand and fleet size,
+    using the drain/undrain machinery (scale-down = warm shard
+    handoff, scale-up = pre-stage-back).  Requires a fleet topology.
+    See deploy/DEPLOY.md "Capacity & autoscaling"."""
+
+    enabled: bool = False
+    interval_s: float = 2.0
+    # The member-count band the controller may move within.  floor is
+    # a hard serving invariant (property-tested: concurrent ticks +
+    # member deaths can never shrink past it); ceiling 0 = every
+    # configured member.
+    floor: int = 1
+    ceiling: int = 0
+    # Queue-depth watermarks, per active lane (fleet depth / (lanes x
+    # routable members)): sustained >= high scales up, sustained <=
+    # low scales down — the hysteresis band.
+    queue_high_per_lane: float = 3.0
+    queue_low_per_lane: float = 0.5
+    # Consecutive over/under ticks before acting, and the minimum
+    # spacing between transitions (the flapping bound the elasticity
+    # drill asserts).
+    hold_ticks: int = 2
+    cooldown_s: float = 30.0
+    # Measured per-lane service capacity in requests/s — read it off
+    # the newest CAPACITY record (knee / total lanes).  > 0 arms the
+    # predicted-demand signal: scale up when the session model's
+    # predicted offered load exceeds the routable capacity, refuse to
+    # scale down below it.  0 = queue/pressure signals only.
+    lane_capacity_tps: float = 0.0
+    # Predicted per-session steady request rate (requests/s) used to
+    # turn viewport-tracked sessions into predicted demand.
+    session_tps: float = 2.0
+
+
+@dataclass
 class SessionsConfig:
     """Session-aware serving (services.viewport + the admission token
     buckets): model the CLIENT, not just the request.  The session
@@ -610,6 +679,10 @@ class AppConfig:
     persistence: PersistenceConfig = field(
         default_factory=PersistenceConfig)
     sessions: SessionsConfig = field(default_factory=SessionsConfig)
+    loadmodel: LoadModelConfig = field(
+        default_factory=LoadModelConfig)
+    autoscaler: AutoscalerConfig = field(
+        default_factory=AutoscalerConfig)
     qos: QosConfig = field(default_factory=QosConfig)
     pressure: PressureConfig = field(default_factory=PressureConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
@@ -916,6 +989,118 @@ class AppConfig:
         if cfg.sessions.prefetch_lookahead < 1:
             raise ValueError("sessions.prefetch-lookahead must be "
                              ">= 1")
+        lm = raw.get("loadmodel", {}) or {}
+        lm_defaults = LoadModelConfig()
+        cfg.loadmodel = LoadModelConfig(
+            seed=int(lm.get("seed", lm_defaults.seed)),
+            viewers=int(lm.get("viewers", lm_defaults.viewers)),
+            think_time_median_ms=float(lm.get(
+                "think-time-median-ms",
+                lm_defaults.think_time_median_ms)),
+            think_time_sigma=float(lm.get(
+                "think-time-sigma", lm_defaults.think_time_sigma)),
+            session_length_median=float(lm.get(
+                "session-length-median",
+                lm_defaults.session_length_median)),
+            session_length_sigma=float(lm.get(
+                "session-length-sigma",
+                lm_defaults.session_length_sigma)),
+            diurnal_amplitude=float(lm.get(
+                "diurnal-amplitude", lm_defaults.diurnal_amplitude)),
+            bulk_fraction=float(lm.get(
+                "bulk-fraction", lm_defaults.bulk_fraction)),
+            mask_fraction=float(lm.get(
+                "mask-fraction", lm_defaults.mask_fraction)),
+            zoom_fraction=float(lm.get(
+                "zoom-fraction", lm_defaults.zoom_fraction)),
+        )
+        # The generator itself re-validates at construction; failing
+        # at config load keeps a bad block out of a bench round.
+        if cfg.loadmodel.viewers < 1:
+            raise ValueError("loadmodel.viewers must be >= 1")
+        if cfg.loadmodel.think_time_median_ms <= 0 \
+                or cfg.loadmodel.session_length_median <= 0:
+            raise ValueError("loadmodel medians must be > 0")
+        if cfg.loadmodel.think_time_sigma < 0 \
+                or cfg.loadmodel.session_length_sigma < 0:
+            raise ValueError("loadmodel sigmas must be >= 0")
+        if not 0.0 <= cfg.loadmodel.diurnal_amplitude < 1.0:
+            raise ValueError("loadmodel.diurnal-amplitude must be in "
+                             "[0, 1)")
+        for name in ("bulk_fraction", "mask_fraction",
+                     "zoom_fraction"):
+            v = getattr(cfg.loadmodel, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(
+                    f"loadmodel.{name.replace('_', '-')} must be in "
+                    f"[0, 1]")
+        if (cfg.loadmodel.bulk_fraction
+                + cfg.loadmodel.mask_fraction) > 1.0:
+            raise ValueError("loadmodel bulk-fraction + mask-fraction "
+                             "must be <= 1")
+        au = raw.get("autoscaler", {}) or {}
+        au_defaults = AutoscalerConfig()
+        cfg.autoscaler = AutoscalerConfig(
+            enabled=bool(au.get("enabled", au_defaults.enabled)),
+            interval_s=float(au.get("interval-s",
+                                    au_defaults.interval_s)),
+            floor=int(au.get("floor", au_defaults.floor)),
+            ceiling=int(au.get("ceiling", au_defaults.ceiling)),
+            queue_high_per_lane=float(au.get(
+                "queue-high-per-lane",
+                au_defaults.queue_high_per_lane)),
+            queue_low_per_lane=float(au.get(
+                "queue-low-per-lane", au_defaults.queue_low_per_lane)),
+            hold_ticks=int(au.get("hold-ticks",
+                                  au_defaults.hold_ticks)),
+            cooldown_s=float(au.get("cooldown-s",
+                                    au_defaults.cooldown_s)),
+            lane_capacity_tps=float(au.get(
+                "lane-capacity-tps", au_defaults.lane_capacity_tps)),
+            session_tps=float(au.get("session-tps",
+                                     au_defaults.session_tps)),
+        )
+        if cfg.autoscaler.interval_s <= 0:
+            raise ValueError("autoscaler.interval-s must be > 0")
+        if cfg.autoscaler.floor < 1:
+            raise ValueError("autoscaler.floor must be >= 1 (the "
+                             "fleet must always keep a servable "
+                             "member)")
+        if cfg.autoscaler.ceiling != 0 \
+                and cfg.autoscaler.ceiling < cfg.autoscaler.floor:
+            raise ValueError("autoscaler.ceiling must be 0 (all "
+                             "members) or >= autoscaler.floor")
+        if not 0 <= cfg.autoscaler.queue_low_per_lane \
+                < cfg.autoscaler.queue_high_per_lane:
+            raise ValueError(
+                "autoscaler.queue-low-per-lane must be in [0, "
+                "queue-high-per-lane) — the hysteresis band needs "
+                "low < high")
+        if cfg.autoscaler.hold_ticks < 1:
+            raise ValueError("autoscaler.hold-ticks must be >= 1")
+        if cfg.autoscaler.cooldown_s < 0:
+            raise ValueError("autoscaler.cooldown-s must be >= 0")
+        if cfg.autoscaler.lane_capacity_tps < 0:
+            raise ValueError("autoscaler.lane-capacity-tps must be "
+                             ">= 0 (0 disables the demand signal)")
+        if cfg.autoscaler.session_tps <= 0:
+            raise ValueError("autoscaler.session-tps must be > 0")
+        if cfg.autoscaler.enabled and not cfg.fleet.enabled:
+            raise ValueError(
+                "autoscaler.enabled requires a fleet topology "
+                "(fleet.enabled) — there is nothing to scale "
+                "without members")
+        if cfg.autoscaler.enabled:
+            provisioned = (len(cfg.fleet.sockets)
+                           or cfg.fleet.members)
+            if cfg.autoscaler.floor > provisioned:
+                # An unachievable floor would block every scale-down
+                # forever (blocked:floor) — the bad-block-fails-at-
+                # load contract, not a silent mid-serving no-op.
+                raise ValueError(
+                    f"autoscaler.floor ({cfg.autoscaler.floor}) "
+                    f"exceeds the provisioned fleet size "
+                    f"({provisioned} members)")
         qo = raw.get("qos", {}) or {}
         qo_defaults = QosConfig()
         cfg.qos = QosConfig(

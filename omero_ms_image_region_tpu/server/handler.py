@@ -824,7 +824,13 @@ class ShapeMaskHandler:
     def __init__(self, services: ImageRegionServices):
         self.s = services
 
-    async def render_shape_mask(self, ctx: ShapeMaskCtx) -> bytes:
+    async def cached_shape_mask(self, ctx: ShapeMaskCtx
+                                ) -> Optional[bytes]:
+        """Byte-cache probe + per-caller ACL — the hit branch alone,
+        exposed so the app's fairness gate can put mask cache hits on
+        the tile route's footing (already-rendered bytes never cost a
+        session token and never shed).  None = miss or unreadable
+        (the render path then decides 404 vs render)."""
         import time as _time
 
         from ..services.cache import get_with_tier
@@ -832,14 +838,19 @@ class ShapeMaskHandler:
         t0 = _time.perf_counter()
         cached, cache_tier = await get_with_tier(
             self.s.caches.shape_mask, ctx.cache_key())
-        readable = await self._can_read(ctx)
-        if cached is not None and readable:
-            telemetry.record_span(
-                "cache.hit", t0, (_time.perf_counter() - t0) * 1000.0)
-            provenance.mark(ctx, tier=("disk" if cache_tier == "disk"
-                                       else "byte_cache"))
+        if cached is None or not await self._can_read(ctx):
+            return None
+        telemetry.record_span(
+            "cache.hit", t0, (_time.perf_counter() - t0) * 1000.0)
+        provenance.mark(ctx, tier=("disk" if cache_tier == "disk"
+                                   else "byte_cache"))
+        return cached
+
+    async def render_shape_mask(self, ctx: ShapeMaskCtx) -> bytes:
+        cached = await self.cached_shape_mask(ctx)
+        if cached is not None:
             return cached
-        if not readable:
+        if not await self._can_read(ctx):
             raise NotFoundError(f"Cannot find Shape:{ctx.shape_id}")
 
         with stopwatch("getMask"):

@@ -429,7 +429,16 @@ class PressureGovernor:
 def is_bulk(ctx) -> bool:
     """Bulk/projection classification for ``shed_bulk``: z-projection
     jobs and full-plane (no tile, no region) renders — the work class
-    the ladder sheds FIRST, before any interactive degradation."""
+    the ladder sheds FIRST, before any interactive degradation.
+
+    Shape-mask requests (``ShapeMaskCtx``, identified by their
+    ``shape_id``) are QoS-classed INTERACTIVE: a mask overlay is part
+    of the viewer's pan loop, and it draws 1 fairness token like a
+    tile — the mask-scraping loophole (no tile, no region used to
+    read as bulk-or-crash here) closed with the session-model
+    satellite of the autoscaler PR."""
+    if getattr(ctx, "shape_id", None) is not None:
+        return False
     return ctx.projection is not None or (
         ctx.tile is None and ctx.region is None)
 

@@ -113,6 +113,22 @@ class ViewportTracker:
     def _key(session_key: Optional[str]) -> str:
         return session_key if session_key else ViewportTracker.ANONYMOUS
 
+    def _touch(self, key: str) -> _SessionState:
+        """Get-or-create the session's state at the LRU head, with
+        eviction + gauge bookkeeping.  Caller holds the lock."""
+        state = self._sessions.get(key)
+        if state is None:
+            state = _SessionState(self.history)
+            self._sessions[key] = state
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+                self.evictions += 1
+                telemetry.SESSIONS.count_evicted()
+        else:
+            self._sessions.move_to_end(key)
+        telemetry.SESSIONS.set_tracked(len(self._sessions))
+        return state
+
     def observe(self, session_key: Optional[str], image_id: int,
                 z: int, t: int, resolution: Optional[int],
                 x: int, y: int) -> None:
@@ -120,20 +136,22 @@ class ViewportTracker:
         key = self._key(session_key)
         now = self.clock()
         with self._lock:
-            state = self._sessions.get(key)
-            if state is None:
-                state = _SessionState(self.history)
-                self._sessions[key] = state
-                while len(self._sessions) > self.max_sessions:
-                    self._sessions.popitem(last=False)
-                    self.evictions += 1
-                    telemetry.SESSIONS.count_evicted()
-            else:
-                self._sessions.move_to_end(key)
+            state = self._touch(key)
             state.history.append(
                 _Obs(now, image_id, z, t, resolution, x, y))
+            # Counted here, not in _touch: observations_total is the
+            # VIEWPORT-lattice feed (what the predictor reads) — mask
+            # activity keeps the session live but never counts as one.
             telemetry.SESSIONS.count_observation()
-            telemetry.SESSIONS.set_tracked(len(self._sessions))
+
+    def observe_activity(self, session_key: Optional[str]) -> None:
+        """Record NON-TILE session activity (shape-mask requests):
+        keeps the session live in the LRU and counted in the tracked
+        gauge — the demand figure the autoscaler reads — without
+        polluting the pan/zoom trajectory (a mask request has no
+        lattice coordinates to vote with)."""
+        with self._lock:
+            self._touch(self._key(session_key))
 
     # ------------------------------------------------------- prediction
 

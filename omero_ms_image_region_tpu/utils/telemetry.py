@@ -1869,6 +1869,153 @@ class DrainStats:
 DRAIN = DrainStats()
 
 
+class AutoscalerStats:
+    """Elastic-autoscaler accounting (``server.autoscaler``): the
+    active-member gauge and floor/ceiling bounds, transitions by
+    direction, and refused decisions by reason.  Both label sets are
+    closed by construction — ``action`` is up/down, ``reason`` is
+    ``autoscaler.BLOCKED_REASONS`` verbatim."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.active = 0
+        self.floor = 0
+        self.ceiling = 0
+        self.transitions: Dict[str, int] = {}
+        self.blocked: Dict[str, int] = {}
+
+    def set_active(self, n: int) -> None:
+        with self._lock:
+            self.active = int(n)
+
+    def set_bounds(self, floor: int, ceiling: int) -> None:
+        with self._lock:
+            self.floor = int(floor)
+            self.ceiling = int(ceiling)
+
+    def count_transition(self, action: str) -> None:
+        with self._lock:
+            self.transitions[action] = \
+                self.transitions.get(action, 0) + 1
+
+    def count_blocked(self, reason: str) -> None:
+        with self._lock:
+            self.blocked[reason] = self.blocked.get(reason, 0) + 1
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            if not (self.active or self.transitions or self.blocked):
+                # Quiet until an autoscaler is live (emit-when-live,
+                # the httpcache posture — keeps non-fleet expositions
+                # and the reset() contract exact).
+                return []
+            lines = [
+                f"imageregion_autoscaler_active_members{label()} "
+                f"{self.active}",
+                f"imageregion_autoscaler_floor{label()} {self.floor}",
+                f"imageregion_autoscaler_ceiling{label()} "
+                f"{self.ceiling}",
+            ]
+            for action in sorted(self.transitions):
+                body = 'action="%s"' % action
+                lines.append(
+                    f"imageregion_autoscaler_transitions_total"
+                    f"{label(body)} {self.transitions[action]}")
+            for reason in sorted(self.blocked):
+                body = 'reason="%s"' % reason
+                lines.append(
+                    f"imageregion_autoscaler_blocked_total"
+                    f"{label(body)} {self.blocked[reason]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.active = 0
+            self.floor = 0
+            self.ceiling = 0
+            self.transitions.clear()
+            self.blocked.clear()
+
+
+AUTOSCALER = AutoscalerStats()
+
+
+class LoadModelStats:
+    """Open-loop load-model accounting (``services.loadmodel``): how
+    many arrivals the generator offered/completed per request class,
+    sheds observed, and arrivals that fired behind schedule (the
+    open-loop integrity counter — a generator that cannot keep its
+    own schedule is measuring itself, not the service).  ``class`` is
+    the closed ``loadmodel.CLASSES`` vocabulary."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.offered: Dict[str, int] = {}
+        self.completed: Dict[str, int] = {}
+        self.sheds = 0
+        self.late = 0
+
+    def count_offered(self, cls: str) -> None:
+        with self._lock:
+            self.offered[cls] = self.offered.get(cls, 0) + 1
+
+    def count_completed(self, cls: str) -> None:
+        with self._lock:
+            self.completed[cls] = self.completed.get(cls, 0) + 1
+
+    def count_shed(self) -> None:
+        with self._lock:
+            self.sheds += 1
+
+    def count_late(self) -> None:
+        with self._lock:
+            self.late += 1
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            if not (self.offered or self.sheds or self.late):
+                return []        # emit-when-live (bench-side family)
+            lines = [
+                f"imageregion_loadmodel_shed_total{label()} "
+                f"{self.sheds}",
+                f"imageregion_loadmodel_late_fires_total{label()} "
+                f"{self.late}",
+            ]
+            for cls in sorted(self.offered):
+                body = 'class="%s"' % cls
+                lines.append(
+                    f"imageregion_loadmodel_offered_total"
+                    f"{label(body)} {self.offered[cls]}")
+            for cls in sorted(self.completed):
+                body = 'class="%s"' % cls
+                lines.append(
+                    f"imageregion_loadmodel_completed_total"
+                    f"{label(body)} {self.completed[cls]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.offered.clear()
+            self.completed.clear()
+            self.sheds = 0
+            self.late = 0
+
+
+LOADMODEL = LoadModelStats()
+
+
 class SessionStats:
     """Session-model accounting (``services.viewport`` +
     ``server.admission.SessionTokenBuckets``): how many distinct
@@ -2255,10 +2402,13 @@ def exemplars_snapshot() -> Dict[str, List[dict]]:
 
 def session_metric_lines(extra_labels: str = "") -> List[str]:
     """The session-serving families — ``imageregion_session_*``,
-    ``imageregion_prefetch_*``, ``imageregion_qos_*``."""
+    ``imageregion_prefetch_*``, ``imageregion_qos_*`` — plus the
+    open-loop load model's counters (emit-when-live: only a process
+    actually replaying arrivals carries them)."""
     return (SESSIONS.metric_lines(extra_labels)
             + PREFETCH.metric_lines(extra_labels)
-            + QOS.metric_lines(extra_labels))
+            + QOS.metric_lines(extra_labels)
+            + LOADMODEL.metric_lines(extra_labels))
 
 
 def robustness_metric_lines(extra_labels: str = "") -> List[str]:
@@ -2272,6 +2422,7 @@ def robustness_metric_lines(extra_labels: str = "") -> List[str]:
     return (PRESSURE.metric_lines(extra_labels)
             + WATCHDOG.metric_lines(extra_labels)
             + DRAIN.metric_lines(extra_labels)
+            + AUTOSCALER.metric_lines(extra_labels)
             + session_metric_lines(extra_labels))
 
 
@@ -2484,6 +2635,20 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_drain_transitions_total": "counter",
     "imageregion_drain_prestaged_planes_total": "counter",
     "imageregion_drains_total": "counter",
+    # Elastic autoscaler (server.autoscaler): fleet-size controller
+    # over the drain/undrain machinery.
+    "imageregion_autoscaler_active_members": "gauge",
+    "imageregion_autoscaler_floor": "gauge",
+    "imageregion_autoscaler_ceiling": "gauge",
+    "imageregion_autoscaler_transitions_total": "counter",
+    "imageregion_autoscaler_blocked_total": "counter",
+    # Open-loop load model (services.loadmodel): the bench-side
+    # arrival generator's integrity counters (offered vs completed vs
+    # shed, behind-schedule fires).
+    "imageregion_loadmodel_offered_total": "counter",
+    "imageregion_loadmodel_completed_total": "counter",
+    "imageregion_loadmodel_shed_total": "counter",
+    "imageregion_loadmodel_late_fires_total": "counter",
     # Session-aware serving (services.viewport / services.prefetch /
     # server.admission token buckets / fleet QoS dequeue).
     "imageregion_session_tracked": "gauge",
@@ -2639,6 +2804,27 @@ METRIC_HELP: Dict[str, str] = {
         "drain_rehomed / coalesced / quality_capped)",
     "imageregion_httpcache_ims_requests_total":
         "If-Modified-Since-only revalidation arrivals (ETag absent)",
+    "imageregion_autoscaler_active_members":
+        "Fleet members currently accepting routes (not draining)",
+    "imageregion_autoscaler_floor":
+        "Autoscaler hard minimum of non-draining members",
+    "imageregion_autoscaler_ceiling":
+        "Autoscaler maximum of active members (pre-provisioned set)",
+    "imageregion_autoscaler_transitions_total":
+        "Autoscaler scale transitions by direction (up = undrain "
+        "with pre-stage-back, down = drain with warm handoff)",
+    "imageregion_autoscaler_blocked_total":
+        "Autoscaler decisions refused by reason (cooldown, floor, "
+        "ceiling, busy, no-member)",
+    "imageregion_loadmodel_offered_total":
+        "Open-loop arrivals fired on schedule, by request class",
+    "imageregion_loadmodel_completed_total":
+        "Open-loop arrivals served, by request class",
+    "imageregion_loadmodel_shed_total":
+        "Open-loop arrivals refused with 503 + Retry-After",
+    "imageregion_loadmodel_late_fires_total":
+        "Arrivals fired behind schedule (open-loop integrity: the "
+        "generator, not the service, fell behind)",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -2902,6 +3088,8 @@ def reset() -> None:
     PRESSURE.reset()
     WATCHDOG.reset()
     DRAIN.reset()
+    AUTOSCALER.reset()
+    LOADMODEL.reset()
     SESSIONS.reset()
     PREFETCH.reset()
     QOS.reset()

@@ -66,10 +66,21 @@ SESSIONS_KEYS = ("sessions_interactive_p99_ms",
 # and regresses UPWARD.
 OFFLOAD_KEYS = ("origin_offload_ratio", "peer_hit_rate",
                 "p50_304_ms")
+# --capacity: judge CAPACITY_r*.json records (bench.py --smoke
+# --capacity — the open-loop offered-load sweep) on the capacity
+# knee.  Direction-aware by name: the knee (offered tps where p99
+# crosses the SLO or shed crosses 5%) and the fleet-size scaling
+# efficiency regress DOWNWARD; the p99 AT the knee is a ``_ms`` key
+# and regresses UPWARD.  ``--watermark`` covers the family like every
+# other: the newest round is judged against the best knee any round
+# ever measured.
+CAPACITY_KEYS = ("capacity_knee_offered_tps", "p99_at_knee_ms",
+                 "capacity_scaling_efficiency")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _MULTICHIP_RE = re.compile(r"^MULTICHIP_r(\d+)\.json$")
 _SESSIONS_RE = re.compile(r"^SESSIONS_r(\d+)\.json$")
 _OFFLOAD_RE = re.compile(r"^OFFLOAD_r(\d+)\.json$")
+_CAPACITY_RE = re.compile(r"^CAPACITY_r(\d+)\.json$")
 
 
 def lower_is_better(key: str) -> bool:
@@ -251,6 +262,13 @@ def main(argv=None) -> int:
                              "offload keys: origin offload ratio and "
                              "peer byte-fetch hit rate (regress "
                              "down), 304 latency (regresses up)")
+    parser.add_argument("--capacity", action="store_true",
+                        help="judge CAPACITY_r*.json records (bench "
+                             "--smoke --capacity, the open-loop "
+                             "offered-load sweep) on the capacity "
+                             "knee: knee offered tps and scaling "
+                             "efficiency regress down, p99-at-knee "
+                             "regresses up")
     parser.add_argument("--key", action="append", default=None,
                         help="record key(s) to judge (default "
                              "service_tiles_per_sec, "
@@ -274,11 +292,14 @@ def main(argv=None) -> int:
         keys = SESSIONS_KEYS
     elif args.offload:
         keys = OFFLOAD_KEYS
+    elif args.capacity:
+        keys = CAPACITY_KEYS
     else:
         keys = DEFAULT_KEYS
     pattern = (_MULTICHIP_RE if args.multichip
                else _SESSIONS_RE if args.sessions
-               else _OFFLOAD_RE if args.offload else _BENCH_RE)
+               else _OFFLOAD_RE if args.offload
+               else _CAPACITY_RE if args.capacity else _BENCH_RE)
     try:
         if args.watermark:
             if args.dir:

@@ -128,7 +128,8 @@ def render_trace(doc) -> str:
 # at a glance.
 _ROBUSTNESS_KINDS = ("pressure.level", "pressure.step",
                      "watchdog.fire", "watchdog.escalate",
-                     "drain.phase")
+                     "drain.phase", "autoscale.up", "autoscale.down",
+                     "autoscale.blocked")
 
 # Session-serving event kinds (per-session fairness sheds, viewport
 # predictions, pressure-scaled prefetch budget moves): marked with
@@ -175,6 +176,10 @@ def render_flight(doc) -> str:
                 label = f"watchdog.fire:{e.get('action', '?')}"
             elif kind == "drain.phase":
                 label = f"drain:{e.get('phase', '?')}"
+            elif kind == "autoscale.blocked":
+                label = f"autoscale.blocked:{e.get('reason', '?')}"
+            elif kind in ("autoscale.up", "autoscale.down"):
+                label = f"{kind}:{e.get('member', '?')}"
             rob_counts[label] = rob_counts.get(label, 0) + 1
         elif kind in _SESSION_KINDS:
             label = kind

@@ -210,6 +210,60 @@ def test_bench_smoke_overload_brownout(capsys):
         telemetry.reset()
 
 
+def test_bench_smoke_capacity(capsys):
+    """The capacity-knee gate (bench.py --smoke --capacity): an
+    OPEN-loop arrival process (services.loadmodel) swept across
+    offered loads and fleet sizes must find a knee per size, the knee
+    must scale with the fleet, and the closed-loop A/B on the same
+    past-knee arrivals must report a LOWER (flattering) p99 — the
+    regression test that keeps future bench legs from quietly
+    reverting to closed-loop arrivals."""
+    import bench
+    from omero_ms_image_region_tpu.utils import telemetry
+
+    telemetry.reset()
+    try:
+        t0 = time.monotonic()
+        out = bench.bench_capacity_smoke()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 90.0, \
+            f"capacity smoke took {elapsed:.0f}s (budget 90)"
+
+        # A knee exists per fleet size, inside the measured sweep
+        # (not censored: the top load factor must violate the SLO).
+        for size in out["capacity_fleet_sizes"]:
+            knee = out[f"capacity_knee_offered_tps_m{size}"]
+            assert knee is not None and knee > 0, out
+            points = out["capacity_curve"][f"m{size}"]
+            assert len(points) >= 3
+            offered = [p["offered_tps"] for p in points]
+            assert offered == sorted(offered)
+        assert out["capacity_knee_censored"] is False
+        # The knee at the headline (widest) fleet, and its p99 meets
+        # the SLO by construction.
+        assert out["capacity_knee_offered_tps"] == \
+            out["capacity_knee_offered_tps_m4"]
+        assert out["p99_at_knee_ms"] <= out["capacity_slo_ms"]
+        # Capacity SCALES with fleet size (the curve the autoscaler's
+        # floor/ceiling sizing reads).  The bound is loose for small
+        # CI hosts — the class it catches is a router that stopped
+        # scaling at all.
+        assert out["capacity_knee_offered_tps_m4"] >= \
+            1.5 * out["capacity_knee_offered_tps_m1"], out
+        # Open-loop honesty: the SAME past-knee offered load replayed
+        # closed-loop must flatter (workers that wait self-throttle
+        # to the service rate and never see the queueing collapse).
+        assert out["openloop_p99_past_knee_ms"] is not None
+        assert out["closedloop_p99_past_knee_ms"] is not None
+        assert out["openloop_p99_past_knee_ms"] > \
+            1.5 * out["closedloop_p99_past_knee_ms"], out
+
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(line)["metric"] == "capacity_smoke"
+    finally:
+        telemetry.reset()
+
+
 def test_bench_smoke_offload(capsys):
     """The repeat-viewer offload gate (bench.py --smoke --offload):
     over a real 2-sidecar remote fleet, the edge ladder (warm-local

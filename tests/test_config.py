@@ -561,3 +561,103 @@ def test_http_cache_epoch_auto_accepted():
     create_app time); explicit values stay verbatim overrides."""
     cfg = AppConfig.from_dict({"http-cache": {"epoch": "auto"}})
     assert cfg.http_cache.epoch == "auto"
+
+
+def test_loadmodel_block_parses_and_validates():
+    """The `loadmodel:` block (open-loop arrival generator): example-
+    file defaults, full parse, validation — a bad block must fail at
+    config load, not mid-bench-round."""
+    from omero_ms_image_region_tpu.server.config import LoadModelConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = LoadModelConfig()
+    assert cfg.loadmodel.seed == defaults.seed
+    assert cfg.loadmodel.viewers == defaults.viewers
+    assert cfg.loadmodel.diurnal_amplitude == \
+        defaults.diurnal_amplitude
+
+    cfg = AppConfig.from_dict({"loadmodel": {
+        "seed": 7, "viewers": 100000,
+        "think-time-median-ms": 500.0, "think-time-sigma": 1.5,
+        "session-length-median": 40.0, "session-length-sigma": 0.8,
+        "diurnal-amplitude": 0.9, "bulk-fraction": 0.05,
+        "mask-fraction": 0.02, "zoom-fraction": 0.1}})
+    assert cfg.loadmodel.seed == 7
+    assert cfg.loadmodel.viewers == 100000
+    assert cfg.loadmodel.think_time_median_ms == 500.0
+    assert cfg.loadmodel.session_length_sigma == 0.8
+    assert cfg.loadmodel.diurnal_amplitude == 0.9
+    assert cfg.loadmodel.bulk_fraction == 0.05
+    assert cfg.loadmodel.mask_fraction == 0.02
+    assert cfg.loadmodel.zoom_fraction == 0.1
+
+    with pytest.raises(ValueError, match="viewers"):
+        AppConfig.from_dict({"loadmodel": {"viewers": 0}})
+    with pytest.raises(ValueError, match="medians"):
+        AppConfig.from_dict({"loadmodel": {
+            "think-time-median-ms": 0}})
+    with pytest.raises(ValueError, match="diurnal-amplitude"):
+        AppConfig.from_dict({"loadmodel": {"diurnal-amplitude": 1.0}})
+    with pytest.raises(ValueError, match="mask-fraction"):
+        AppConfig.from_dict({"loadmodel": {"mask-fraction": 1.2}})
+    with pytest.raises(ValueError, match="bulk-fraction"):
+        AppConfig.from_dict({"loadmodel": {
+            "bulk-fraction": 0.7, "mask-fraction": 0.6}})
+
+
+def test_autoscaler_block_parses_and_validates():
+    """The `autoscaler:` block (elastic fleet controller): example-
+    file defaults, full parse, validation — floor/ceiling ordering,
+    the hysteresis band, and the requires-a-fleet invariant."""
+    from omero_ms_image_region_tpu.server.config import (
+        AutoscalerConfig)
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = AutoscalerConfig()
+    assert cfg.autoscaler.enabled is False
+    assert cfg.autoscaler.floor == defaults.floor
+    assert cfg.autoscaler.cooldown_s == defaults.cooldown_s
+
+    cfg = AppConfig.from_dict({
+        "fleet": {"enabled": True, "members": 4},
+        "autoscaler": {
+            "enabled": True, "interval-s": 1.0, "floor": 2,
+            "ceiling": 4, "queue-high-per-lane": 5.0,
+            "queue-low-per-lane": 1.0, "hold-ticks": 3,
+            "cooldown-s": 10.0, "lane-capacity-tps": 40.0,
+            "session-tps": 1.5}})
+    assert cfg.autoscaler.enabled is True
+    assert cfg.autoscaler.floor == 2
+    assert cfg.autoscaler.ceiling == 4
+    assert cfg.autoscaler.queue_high_per_lane == 5.0
+    assert cfg.autoscaler.hold_ticks == 3
+    assert cfg.autoscaler.cooldown_s == 10.0
+    assert cfg.autoscaler.lane_capacity_tps == 40.0
+    assert cfg.autoscaler.session_tps == 1.5
+
+    with pytest.raises(ValueError, match="floor"):
+        AppConfig.from_dict({"autoscaler": {"floor": 0}})
+    with pytest.raises(ValueError, match="ceiling"):
+        AppConfig.from_dict({"autoscaler": {"floor": 3,
+                                            "ceiling": 2}})
+    with pytest.raises(ValueError, match="hysteresis"):
+        AppConfig.from_dict({"autoscaler": {
+            "queue-high-per-lane": 1.0, "queue-low-per-lane": 2.0}})
+    with pytest.raises(ValueError, match="hold-ticks"):
+        AppConfig.from_dict({"autoscaler": {"hold-ticks": 0}})
+    with pytest.raises(ValueError, match="cooldown-s"):
+        AppConfig.from_dict({"autoscaler": {"cooldown-s": -1}})
+    with pytest.raises(ValueError, match="lane-capacity-tps"):
+        AppConfig.from_dict({"autoscaler": {
+            "lane-capacity-tps": -1}})
+    # The controller needs something to scale: a fleetless config
+    # must refuse at load.
+    with pytest.raises(ValueError, match="fleet"):
+        AppConfig.from_dict({"autoscaler": {"enabled": True}})
+    # An unachievable floor (> the provisioned member count) would
+    # block every scale-down forever: refuse at load.
+    with pytest.raises(ValueError, match="provisioned"):
+        AppConfig.from_dict({
+            "fleet": {"enabled": True, "members": 2},
+            "autoscaler": {"enabled": True, "floor": 3,
+                           "ceiling": 3}})

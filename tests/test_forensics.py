@@ -158,6 +158,27 @@ class TestFlightRecorder:
         assert "watchdog.fire:requeue-group=1" in out
         assert "drain:drained=1" in out
 
+    def test_trace_report_renders_autoscale_events(self, tmp_path):
+        """Autoscaler transitions and refusals join the
+        self-preservation footer: a post-incident dump says when the
+        fleet grew/shrank and why a wanted move was refused."""
+        rec = telemetry.FlightRecorder()
+        rec.record("autoscale.down", member="m2", active=2, queue=0)
+        rec.record("autoscale.up", member="m2", active=3, queue=31)
+        rec.record("autoscale.blocked", reason="cooldown",
+                   want="down")
+        rec.record("autoscale.blocked", reason="floor", want="down")
+        path = rec.dump(str(tmp_path), "elastic")
+        with open(path) as f:
+            doc = json.load(f)
+        mod = _load_script("trace_report")
+        out = mod.render_doc(doc)
+        assert "self-preservation:" in out
+        assert "autoscale.down:m2=1" in out
+        assert "autoscale.up:m2=1" in out
+        assert "autoscale.blocked:cooldown=1" in out
+        assert "autoscale.blocked:floor=1" in out
+
     def test_trace_report_renders_session_serving_events(
             self, tmp_path):
         """PR 10's session-serving events (fairness sheds, viewport
@@ -516,6 +537,54 @@ class TestBenchGate:
         # BENCH records in the same dir are ignored under --offload.
         verdict = json.loads(capsys.readouterr().out)
         assert verdict["new"] == "OFFLOAD_r05.json"
+
+    def test_capacity_keys_gated_direction_aware(self, tmp_path,
+                                                 capsys):
+        """--capacity judges CAPACITY_r*.json (bench --smoke
+        --capacity, the open-loop offered-load sweep) direction-aware
+        by name: the knee and the scaling efficiency regress DOWN
+        (less capacity before the SLO breaks), the p99 AT the knee is
+        a ``_ms`` key and regresses UP."""
+        gate = self._gate()
+        good = {"capacity_knee_offered_tps": 120.0,
+                "p99_at_knee_ms": 80.0,
+                "capacity_scaling_efficiency": 0.5}
+        self._write(tmp_path, "CAPACITY_r01.json", good)
+        # Knee DOWN 25% = regression (the service hits collapse at
+        # lower offered load) even with the p99 flat.
+        self._write(tmp_path, "CAPACITY_r02.json",
+                    {**good, "capacity_knee_offered_tps": 90.0})
+        assert gate.main(["--capacity", "--dir", str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["capacity_knee_offered_tps"] == "regression"
+        assert by_key["p99_at_knee_ms"] == "pass"
+        # p99-at-knee UP 50% = regression even with the knee flat.
+        self._write(tmp_path, "CAPACITY_r03.json",
+                    {**good, "p99_at_knee_ms": 120.0})
+        assert gate.main(["--capacity", "--dir", str(tmp_path)]) == 1
+        capsys.readouterr()
+        # Holding or improving every key passes; --watermark covers
+        # the family (the newest round judged against the best knee
+        # ever measured — r01's 120, not r03's).
+        self._write(tmp_path, "CAPACITY_r04.json",
+                    {**good, "capacity_knee_offered_tps": 130.0})
+        assert gate.main(["--capacity", "--dir", str(tmp_path)]) == 0
+        capsys.readouterr()
+        assert gate.main(["--capacity", "--watermark", "--dir",
+                          str(tmp_path)]) == 0
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["mode"] == "watermark"
+        by_key = {v["key"]: v for v in verdict["keys"]}
+        assert by_key["capacity_knee_offered_tps"][
+            "watermark_record"] == "CAPACITY_r01.json"
+        # A new round under the best-ever knee by >10% fails the
+        # watermark even if it passes pairwise against a sagged r04.
+        self._write(tmp_path, "CAPACITY_r05.json",
+                    {**good, "capacity_knee_offered_tps": 100.0})
+        assert gate.main(["--capacity", "--watermark", "--dir",
+                          str(tmp_path)]) == 1
+        capsys.readouterr()
 
     def test_multichip_fleet_curve_gated(self, tmp_path, capsys):
         """--multichip judges MULTICHIP_r*.json on the fleet scaling

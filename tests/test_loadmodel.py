@@ -1,0 +1,292 @@
+"""Open-loop load model (services.loadmodel).
+
+The load-bearing properties: the event stream is DETERMINISTIC by
+seed (a capacity record must be reproducible), heavy-tailed where the
+config says so, diurnal where the config says so, and the open-loop
+runner fires on schedule REGARDLESS of completions — the closed-loop
+runner on the same arrivals must report a flattering p99 on a
+saturated service (the honesty property ``bench --smoke --capacity``
+gates end to end)."""
+
+import asyncio
+import statistics
+
+import pytest
+
+from omero_ms_image_region_tpu.server.errors import OverloadedError
+from omero_ms_image_region_tpu.services.loadmodel import (
+    CLASSES, Arrival, LoadModel, find_knee, run_closed_loop,
+    run_open_loop)
+from omero_ms_image_region_tpu.utils import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _model(**kw):
+    defaults = dict(viewers=120, seed=42, duration_s=30.0, grid=8,
+                    bulk_fraction=0.05, mask_fraction=0.03)
+    defaults.update(kw)
+    return LoadModel(**defaults)
+
+
+class TestGeneration:
+    def test_same_seed_same_stream(self):
+        assert _model().events() == _model().events()
+
+    def test_different_seed_different_stream(self):
+        assert _model(seed=43).events() != _model().events()
+
+    def test_time_ordered_and_clipped(self):
+        events = _model().events()
+        ts = [a.t for a in events]
+        assert ts == sorted(ts)
+        assert all(0.0 <= t < 30.0 for t in ts)
+
+    def test_classes_follow_the_configured_mix(self):
+        events = _model().events()
+        counts = {c: 0 for c in CLASSES}
+        for a in events:
+            counts[a.cls] += 1
+        n = len(events)
+        assert counts["interactive"] > 0.8 * n
+        # Loose band: the mix is a per-step draw, not a quota.
+        assert 0.02 * n < counts["bulk"] < 0.10 * n
+        assert 0.01 * n < counts["mask"] < 0.07 * n
+
+    def test_think_times_are_heavy_tailed(self):
+        """Lognormal sigma 1: the p99 inter-request gap within one
+        session dwarfs the median — the pause tail real viewers have
+        (a closed-loop constant-think model has ratio ~1)."""
+        model = _model(viewers=40, bulk_fraction=0.0,
+                       mask_fraction=0.0, duration_s=300.0)
+        gaps = []
+        for i in range(model.viewers):
+            stream = list(model._session_stream(i))
+            gaps += [b.t - a.t
+                     for a, b in zip(stream, stream[1:])]
+        assert len(gaps) > 200
+        ordered = sorted(gaps)
+        p99 = ordered[int(0.99 * (len(ordered) - 1))]
+        med = statistics.median(ordered)
+        assert p99 / med > 5.0
+
+    def test_session_lengths_are_heavy_tailed(self):
+        model = _model(duration_s=10000.0)
+        lengths = [sum(1 for _ in model._session_stream(i))
+                   for i in range(model.viewers)]
+        assert max(lengths) > 4 * statistics.median(lengths)
+
+    def test_diurnal_amplitude_bunches_the_middle(self):
+        """The diurnal warp concentrates session starts toward the
+        half-sine peak: the warped interquartile range shrinks
+        against the flat day's (deterministic — the warp is a pure
+        inverse-CDF, no sampling noise to fight)."""
+        flat = _model(diurnal_amplitude=0.0)
+        bunched = _model(diurnal_amplitude=0.9)
+        flat_iqr = flat._warp(0.75) - flat._warp(0.25)
+        bunched_iqr = bunched._warp(0.75) - bunched._warp(0.25)
+        assert flat_iqr == pytest.approx(15.0, abs=0.01)
+        assert bunched_iqr < flat_iqr * 0.92
+        # Symmetric day: the median start stays at mid-window.
+        assert bunched._warp(0.5) == pytest.approx(15.0, abs=0.01)
+
+    def test_trajectories_pan_on_the_lattice(self):
+        """Consecutive interactive steps move by at most one lattice
+        step per axis (modulo grid wrap) — the trajectory shape the
+        viewport predictor extrapolates."""
+        model = _model(bulk_fraction=0.0, mask_fraction=0.0,
+                       zoom_fraction=0.0)
+        stream = list(model._session_stream(3))
+        for a, b in zip(stream, stream[1:]):
+            dx = min(abs(b.x - a.x), model.grid - abs(b.x - a.x))
+            dy = min(abs(b.y - a.y), model.grid - abs(b.y - a.y))
+            assert dx <= 1 and dy <= 1
+
+    def test_ten_thousand_sessions_stream_lazily(self):
+        """10^4 viewers: the merged stream yields promptly and in
+        order without materializing the tape (the 10^6 posture is the
+        same heap-merge, one pending arrival per session)."""
+        model = LoadModel(viewers=10_000, seed=9, duration_s=600.0)
+        it = model.iter_events()
+        head = [next(it) for _ in range(2000)]
+        ts = [a.t for a in head]
+        assert ts == sorted(ts)
+        # The head interleaves many early sessions (heavy-tailed
+        # think times keep each session's stream sparse).
+        assert len({a.session for a in head}) > 100
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            LoadModel(viewers=0)
+        with pytest.raises(ValueError):
+            LoadModel(diurnal_amplitude=1.0)
+        with pytest.raises(ValueError):
+            LoadModel(bulk_fraction=0.8, mask_fraction=0.4)
+        with pytest.raises(ValueError):
+            LoadModel(think_time_median_ms=0)
+
+
+class TestScheduling:
+    def test_schedule_hits_the_target_rate(self):
+        model = _model()
+        events = model.events()
+        sched = model.schedule(50.0, events)
+        rate = len(sched) / sched[-1].t
+        assert abs(rate - 50.0) / 50.0 < 0.05
+        # Same mix and count — only the clock changed.
+        assert len(sched) == len(events)
+        assert [a.session for a in sched] == \
+            [a.session for a in events]
+
+    def test_window_offers_exactly_the_asked_rate(self):
+        model = _model()
+        events = model.events()
+        for offered in (20.0, 80.0, 300.0):
+            window = model.window(offered, 1.5, events)
+            assert len(window) == int(-(-offered * 1.5 // 1))
+            assert window[0].t == 0.0
+            assert window[-1].t == pytest.approx(1.5)
+
+    def test_window_refuses_an_underpowered_model(self):
+        model = _model(viewers=4, duration_s=5.0)
+        with pytest.raises(ValueError, match="raise viewers"):
+            model.window(10_000.0, 10.0)
+
+
+class TestRunners:
+    def test_open_loop_fires_on_schedule_despite_a_slow_service(self):
+        """20 arrivals spaced 5 ms against a 150 ms service: the open
+        loop fires them all within ~the schedule span (completions
+        never gate arrivals), so total wall ~ schedule + one service
+        time — NOT 20 x 150 ms."""
+        arrivals = [Arrival(t=i * 0.005, session="s", cls="interactive",
+                            step=i) for i in range(20)]
+
+        async def submit(_):
+            await asyncio.sleep(0.15)
+
+        async def main():
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            report = await run_open_loop(submit, arrivals)
+            return loop.time() - t0, report
+
+        wall, report = asyncio.run(main())
+        assert report.served == 20
+        assert wall < 1.0          # closed-loop serial would be ~3 s
+
+    def test_closed_loop_flatters_past_the_knee(self):
+        """A capacity-1 service at 4x its capacity: the open loop
+        queues (p99 grows with the backlog), the closed loop
+        self-throttles to the service rate and reports ~the bare
+        service time — the flattering lie the capacity A/B pins."""
+        arrivals = [Arrival(t=i * 0.005, session="s",
+                            cls="interactive", step=i)
+                    for i in range(40)]
+        gate = None
+
+        async def submit(_):
+            async with gate:
+                await asyncio.sleep(0.02)
+
+        async def main():
+            nonlocal gate
+            gate = asyncio.Semaphore(1)
+            open_report = await run_open_loop(submit, arrivals)
+            closed_report = await run_closed_loop(submit, arrivals,
+                                                  concurrency=1)
+            return open_report, closed_report
+
+        open_report, closed_report = asyncio.run(main())
+        assert open_report.p99_ms() > 2.0 * closed_report.p99_ms()
+
+    def test_sheds_count_as_sheds_not_errors(self):
+        arrivals = [Arrival(t=0.0, session="s", cls="interactive",
+                            step=i) for i in range(6)]
+
+        async def submit(a):
+            if a.step % 2:
+                raise OverloadedError("shed", retry_after_s=1.0)
+
+        report = asyncio.run(run_open_loop(submit, arrivals))
+        assert report.served == 3
+        assert report.sheds == 3
+        assert report.errors == []
+        assert report.shed_rate() == pytest.approx(0.5)
+
+    def test_bare_failures_are_reported(self):
+        arrivals = [Arrival(t=0.0, session="s", cls="interactive",
+                            step=0)]
+
+        async def submit(_):
+            raise RuntimeError("boom")
+
+        report = asyncio.run(run_open_loop(submit, arrivals))
+        assert report.served == 0 and report.sheds == 0
+        assert len(report.errors) == 1
+
+    def test_telemetry_counters_ride_the_run(self):
+        arrivals = [Arrival(t=0.0, session="s", cls=cls, step=i)
+                    for i, cls in enumerate(
+                        ("interactive", "interactive", "bulk"))]
+
+        async def submit(_):
+            return None
+
+        asyncio.run(run_open_loop(submit, arrivals))
+        assert telemetry.LOADMODEL.offered == {"interactive": 2,
+                                               "bulk": 1}
+        assert telemetry.LOADMODEL.completed == {"interactive": 2,
+                                                 "bulk": 1}
+        lines = telemetry.LOADMODEL.metric_lines()
+        assert any("imageregion_loadmodel_offered_total"
+                   '{class="interactive"} 2' in ln for ln in lines)
+        telemetry.LOADMODEL.reset()
+        assert telemetry.LOADMODEL.metric_lines() == []
+
+
+class TestKnee:
+    def test_knee_is_the_last_passing_point(self):
+        points = [
+            {"offered_tps": 10, "p99_ms": 40, "shed_rate": 0.0},
+            {"offered_tps": 20, "p99_ms": 120, "shed_rate": 0.0},
+            {"offered_tps": 40, "p99_ms": 900, "shed_rate": 0.0},
+        ]
+        knee, p99, censored = find_knee(points, slo_ms=240.0)
+        assert (knee, p99, censored) == (20.0, 120.0, False)
+
+    def test_shed_rate_crossing_is_a_knee_too(self):
+        points = [
+            {"offered_tps": 10, "p99_ms": 40, "shed_rate": 0.0},
+            {"offered_tps": 20, "p99_ms": 50, "shed_rate": 0.2},
+        ]
+        knee, _, censored = find_knee(points, slo_ms=240.0,
+                                      max_shed_rate=0.05)
+        assert knee == 10.0 and censored is False
+
+    def test_all_passing_is_censored(self):
+        points = [{"offered_tps": 10, "p99_ms": 40, "shed_rate": 0.0}]
+        knee, _, censored = find_knee(points, slo_ms=240.0)
+        assert knee == 10.0 and censored is True
+
+    def test_all_failing_has_no_knee(self):
+        points = [{"offered_tps": 10, "p99_ms": 999,
+                   "shed_rate": 0.0}]
+        knee, p99, censored = find_knee(points, slo_ms=240.0)
+        assert knee is None and p99 is None and censored is False
+
+    def test_recovery_after_violation_never_moves_the_knee(self):
+        """A later 'passing' point past the first violation (noise)
+        must not resurrect a higher knee."""
+        points = [
+            {"offered_tps": 10, "p99_ms": 40, "shed_rate": 0.0},
+            {"offered_tps": 20, "p99_ms": 900, "shed_rate": 0.0},
+            {"offered_tps": 40, "p99_ms": 50, "shed_rate": 0.0},
+        ]
+        knee, _, _ = find_knee(points, slo_ms=240.0)
+        assert knee == 10.0

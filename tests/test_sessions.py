@@ -732,3 +732,195 @@ class TestPredictivePrefetch:
             assert len(local_cache) == 0
         finally:
             prefetcher.close()
+
+
+class TestMaskFairness:
+    """Masks join the session model (the PR 10 follow-on closed by
+    the autoscaler PR): ``render_shape_mask`` debits session fairness
+    tokens, QoS-classed INTERACTIVE — a hostile mask-scraping session
+    used to bypass the meter entirely."""
+
+    @staticmethod
+    def _mask_ctx(session, shape_id=5):
+        from omero_ms_image_region_tpu.server.ctx import ShapeMaskCtx
+        return ShapeMaskCtx.from_params(
+            {"shapeId": str(shape_id), "color": "FF0000"}, session)
+
+    def test_mask_ctx_is_qos_classed_interactive(self):
+        ctx = self._mask_ctx("viewer")
+        assert pressure.is_bulk(ctx) is False
+        # ...including shape id 0 (a falsy id is still a mask).
+        assert pressure.is_bulk(self._mask_ctx("v", 0)) is False
+
+    def test_mask_scraper_sheds_on_its_own_budget(self):
+        clock = FakeClock()
+        buckets = SessionTokenBuckets(refill_per_s=1.0, burst=2.0,
+                                      clock=clock)
+        adm = AdmissionController(max_queue=100,
+                                  session_buckets=buckets)
+        adm.refund_session(None)
+        assert adm.admit_session(self._mask_ctx("scraper"))
+        assert adm.admit_session(self._mask_ctx("scraper"))
+        with pytest.raises(OverloadedError):
+            adm.admit_session(self._mask_ctx("scraper"))
+        assert telemetry.QOS.shed.get("interactive") == 1
+        # Another session's masks — and tiles — stay admitted.
+        assert adm.admit_session(self._mask_ctx("calm"))
+        assert adm.admit_session(_tile_ctx("calm2"))
+
+    def test_masks_and_tiles_share_one_session_budget(self):
+        """One meter per session, not per route: tiles spend the same
+        bucket the masks do."""
+        clock = FakeClock()
+        buckets = SessionTokenBuckets(refill_per_s=1.0, burst=2.0,
+                                      clock=clock)
+        adm = AdmissionController(max_queue=100,
+                                  session_buckets=buckets)
+        assert adm.admit_session(_tile_ctx("mixed"))
+        assert adm.admit_session(self._mask_ctx("mixed"))
+        with pytest.raises(OverloadedError):
+            adm.admit_session(self._mask_ctx("mixed"))
+
+    def test_viewport_activity_keeps_the_session_without_a_vote(self):
+        """observe_activity keeps a mask-only session live in the LRU
+        (the demand figure the autoscaler reads) without polluting
+        the pan trajectory."""
+        clock = FakeClock()
+        tracker = ViewportTracker(max_sessions=4, clock=clock)
+        tracker.observe_activity("masker")
+        assert len(tracker) == 1
+        assert tracker.predict("masker") == []
+        assert tracker.velocity("masker") is None
+        # A panning session's trajectory is untouched by interleaved
+        # mask activity.
+        for x in range(4):
+            tracker.observe("panner", 1, 0, 0, 0, x, 2)
+            tracker.observe_activity("panner")
+        assert tracker.velocity("panner") == (1, 0)
+
+    def test_mask_route_sheds_503_with_fairness_and_refunds(
+            self, tmp_path):
+        """End to end: a mask-scraping session exhausts ITS bucket and
+        gets the fairness 503 + Retry-After on the mask ROUTE; a calm
+        session keeps rendering; a failed mask refunds the token."""
+        import numpy as np
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.io.store import build_pyramid
+        from omero_ms_image_region_tpu.models.mask import Mask
+        from omero_ms_image_region_tpu.server.app import create_app
+        from omero_ms_image_region_tpu.services.metadata import (
+            write_mask)
+
+        root = tmp_path / "data"
+        root.mkdir()
+        rng = np.random.default_rng(5)
+        planes = rng.integers(0, 60000,
+                              size=(1, 1, 64, 64)).astype("uint16")
+        build_pyramid(planes, str(root / "1"), n_levels=1)
+        grid = np.zeros(64 * 64, np.uint8)
+        grid[:64] = 1
+        write_mask(str(root), Mask(shape_id=5, width=64, height=64,
+                                   bytes_=np.packbits(grid)
+                                   .tobytes()))
+        config = AppConfig.from_dict({
+            "data-dir": str(root),
+            "batcher": {"enabled": False},
+            "session-store": {"type": "static", "required": False},
+            "sessions": {"enabled": True, "bucket-refill-per-s": 0.5,
+                         "bucket-burst": 2},
+        })
+
+        async def scenario():
+            client = TestClient(TestServer(create_app(config)))
+            await client.start_server()
+            try:
+                url = "/webgateway/render_shape_mask/5?color=FF0000"
+                scraper = {"sessionid": "scraper"}
+                statuses = []
+                for i in range(4):
+                    r = await client.get(
+                        url + f"&_v={i}", cookies=scraper)
+                    statuses.append(r.status)
+                    retry_after = r.headers.get("Retry-After")
+                assert statuses[:2] == [200, 200]
+                assert 503 in statuses[2:]
+                assert retry_after is not None
+                # The calm session is untouched by the scraper's shed.
+                r = await client.get(url,
+                                     cookies={"sessionid": "calm"})
+                assert r.status == 200
+                # 404 scraping is METERED too: tokens pay for the
+                # attempt (the image route's contract — refunding
+                # request-level failures would let a hostile session
+                # scrape nonexistent shape ids unmetered forever).
+                misses = {"sessionid": "misser"}
+                for _ in range(2):
+                    r = await client.get(
+                        "/webgateway/render_shape_mask/999",
+                        cookies=misses)
+                    assert r.status == 404
+                statuses = []
+                for _ in range(2):
+                    r = await client.get(
+                        "/webgateway/render_shape_mask/999",
+                        cookies=misses)
+                    statuses.append(r.status)
+                assert 503 in statuses
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+        assert telemetry.RESILIENCE.shed.get("fairness", 0) >= 1
+
+    def test_cached_masks_cost_no_tokens(self, tmp_path):
+        """Tile-route footing for masks: with the shape-mask byte
+        cache on, repeat views of a cached mask serve PAST the
+        session's burst — already-rendered bytes never cost a token
+        and never shed."""
+        import numpy as np
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from omero_ms_image_region_tpu.io.store import build_pyramid
+        from omero_ms_image_region_tpu.models.mask import Mask
+        from omero_ms_image_region_tpu.server.app import create_app
+        from omero_ms_image_region_tpu.services.metadata import (
+            write_mask)
+
+        root = tmp_path / "data"
+        root.mkdir()
+        rng = np.random.default_rng(6)
+        planes = rng.integers(0, 60000,
+                              size=(1, 1, 64, 64)).astype("uint16")
+        build_pyramid(planes, str(root / "1"), n_levels=1)
+        grid = np.zeros(64 * 64, np.uint8)
+        grid[:64] = 1
+        write_mask(str(root), Mask(shape_id=5, width=64, height=64,
+                                   bytes_=np.packbits(grid)
+                                   .tobytes()))
+        config = AppConfig.from_dict({
+            "data-dir": str(root),
+            "batcher": {"enabled": False},
+            "shape-mask-cache": {"enabled": True},
+            "session-store": {"type": "static", "required": False},
+            "sessions": {"enabled": True, "bucket-refill-per-s": 0.5,
+                         "bucket-burst": 2},
+        })
+
+        async def scenario():
+            client = TestClient(TestServer(create_app(config)))
+            await client.start_server()
+            try:
+                url = "/webgateway/render_shape_mask/5?color=FF0000"
+                viewer = {"sessionid": "repeat-viewer"}
+                # 8 repeat views on a burst-2 budget: the first
+                # renders (1 token), every repeat is a byte-cache hit
+                # BEFORE the fairness gate — all 200, zero sheds.
+                for _ in range(8):
+                    r = await client.get(url, cookies=viewer)
+                    assert r.status == 200
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+        assert telemetry.RESILIENCE.shed.get("fairness", 0) == 0

@@ -1738,6 +1738,33 @@ def bench_overload_smoke(burst: int = 160, exec_ms: float = 40.0,
     return out
 
 
+# The committed synthetic shape-mask fixtures (tests/data/masks):
+# mask-class load-model arrivals render these through the real mask
+# endpoint during the capacity sweep.
+_MASK_FIXTURE_IDS = (9001, 9002, 9003)
+
+
+def _copy_mask_fixtures(data_dir: str) -> int:
+    """Copy the committed mask fixtures into a bench data tree
+    (LocalMetadataService reads ``<data_dir>/masks/<id>.{json,bin}``).
+    Returns fixtures copied; 0 if the fixture tree is absent."""
+    import os
+    import shutil
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tests", "data", "masks")
+    if not os.path.isdir(src):
+        return 0
+    dst = os.path.join(data_dir, "masks")
+    os.makedirs(dst, exist_ok=True)
+    n = 0
+    for name in os.listdir(src):
+        if name.endswith((".json", ".bin")):
+            shutil.copy(os.path.join(src, name),
+                        os.path.join(dst, name))
+            n += name.endswith(".json")
+    return n
+
+
 def bench_capacity_smoke(exec_ms: float = 60.0, grid: int = 4,
                          tile_edge: int = 64,
                          fleet_sizes=(1, 2, 4), lane_width: int = 2,
@@ -1745,7 +1772,8 @@ def bench_capacity_smoke(exec_ms: float = 60.0, grid: int = 4,
                          shed_limit: float = 0.05,
                          window_s: float = 1.0,
                          load_factors=(0.45, 0.9, 1.5, 2.25),
-                         viewers: int = 64):
+                         viewers: int = 64,
+                         mask_fraction: float = 0.1):
     """Capacity-knee measurement (``bench.py --smoke --capacity``,
     tier-1 via tests/test_bench_smoke.py): the latency-vs-OFFERED-load
     curve of a real in-process fleet under an OPEN-loop arrival
@@ -1822,11 +1850,13 @@ def bench_capacity_smoke(exec_ms: float = 60.0, grid: int = 4,
     # the STRUCTURAL knobs: seeded small population time-compressed
     # per offered rate, FLAT arrivals (diurnal 0 — the knee wants a
     # stationary offered rate; the diurnal ramp is the elasticity
-    # drill's input), interactive-only classes (bulk pins to m0 and
-    # would muddy the per-size comparison; masks need mask fixtures).
+    # drill's input), no bulk (bulk pins to m0 and would muddy the
+    # per-size comparison).  Mask-class arrivals DO run — against the
+    # committed synthetic fixtures under tests/data/masks — so the
+    # measured knee carries the real served mix's mask tax.
     lm_config = AppConfig.from_dict({"loadmodel": {
         "seed": 31, "viewers": viewers, "diurnal-amplitude": 0.0,
-        "bulk-fraction": 0.0, "mask-fraction": 0.0,
+        "bulk-fraction": 0.0, "mask-fraction": float(mask_fraction),
         "zoom-fraction": 0.0}}).loadmodel
     model = LoadModel.from_config(lm_config, duration_s=60.0,
                                   grid=grid)
@@ -1847,6 +1877,9 @@ def bench_capacity_smoke(exec_ms: float = 60.0, grid: int = 4,
         return n_members * lane_width * 1000.0 / exec_ms
 
     async def run_size(tmp: str, n_members: int) -> tuple:
+        from omero_ms_image_region_tpu.server.ctx import ShapeMaskCtx
+        from omero_ms_image_region_tpu.server.handler import (
+            ShapeMaskHandler)
         config = AppConfig(
             data_dir=tmp,
             batcher=BatcherConfig(enabled=False),
@@ -1864,8 +1897,24 @@ def bench_capacity_smoke(exec_ms: float = 60.0, grid: int = 4,
             router, single_flight=SingleFlight(),
             admission=AdmissionController(4096, renderer=router),
             base_services=services)
+        mask_handler = ShapeMaskHandler(services)
 
         async def submit(arrival):
+            if arrival.cls == "mask":
+                # Mask-class arrivals serve the committed synthetic
+                # fixtures (tests/data/masks, copied into the bench
+                # data tree) — the real mask endpoint, request-color
+                # rotated so the explicit-color cache rule is in the
+                # measured mix too.
+                sid = _MASK_FIXTURE_IDS[
+                    arrival.step % len(_MASK_FIXTURE_IDS)]
+                ctx = ShapeMaskCtx(
+                    shape_id=sid,
+                    color=("FF8800" if arrival.step % 2 else None),
+                    omero_session_key=arrival.session)
+                out = await mask_handler.render_shape_mask(ctx)
+                assert out
+                return
             ctx = ImageRegionCtx.from_params(params_for(arrival))
             ctx.omero_session_key = arrival.session
             out = await handler.render_image_region(ctx)
@@ -1930,6 +1979,10 @@ def bench_capacity_smoke(exec_ms: float = 60.0, grid: int = 4,
                                      grid * tile_edge).reshape(
             2, 1, grid * tile_edge, grid * tile_edge)
         build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        if mask_fraction > 0 and not _copy_mask_fixtures(tmp):
+            raise RuntimeError(
+                "mask fixtures missing under tests/data/masks — "
+                "run with mask_fraction=0 or restore the fixtures")
         for n in fleet_sizes:
             points, knee, p99_at_knee, censored, ab = asyncio.run(
                 run_size(tmp, n))
@@ -1967,12 +2020,193 @@ def bench_capacity_smoke(exec_ms: float = 60.0, grid: int = 4,
         "closedloop_p99_past_knee_ms": (honesty or {}).get(
             "closedloop_p99_ms"),
         "capacity_ab_offered_tps": (honesty or {}).get("offered_tps"),
+        # Mask-class arrivals in the measured mix (the committed
+        # tests/data/masks fixtures through the real mask endpoint):
+        # offered vs completed per the LOADMODEL accumulator — a
+        # mask error surfaces as completed < offered, never silently.
+        "capacity_mask_fraction": float(mask_fraction),
+        "capacity_mask_offered":
+            telemetry.LOADMODEL.offered.get("mask", 0),
+        "capacity_mask_completed":
+            telemetry.LOADMODEL.completed.get("mask", 0),
         # Open-loop integrity: arrivals the generator fired behind
         # its own schedule (counted, never hidden).
         "loadmodel_late_fires": telemetry.LOADMODEL.late,
         "elapsed_s": round(time.perf_counter() - t_start, 1),
     }
     print(json.dumps(out))
+    return out
+
+
+def bench_federation_smoke(grid: int = 3, tile_edge: int = 32,
+                           burst: int = 24, emit: bool = True):
+    """Multi-PROCESS federated fleet smoke (``bench.py --smoke
+    --federation``): this process runs host A of a federated combined
+    fleet (one local device-pinned member) and SPAWNS a real sidecar
+    process as host B's member, behind one agreed manifest.
+
+    Measured (the MULTICHIP record family grew these keys; rounds
+    that predate them skip on null in ``bench_gate --multichip``):
+
+    * **agreement** — the manifest digest agrees and the spawned
+      process's OWN ring math assigns every golden probe key to the
+      same owner this process computes (``fed_manifest_agreed``);
+    * **process scaling** — a closed-loop distinct-tile burst through
+      1 process vs 2 (``fed_tiles_per_sec_p1/p2``,
+      ``fed_process_scaling_efficiency``);
+    * **cross-host warm handoff** — draining the LOCAL member ships
+      its HBM shard bytes over the ``shard_transfer`` wire op, and
+      the remote process answers the digests resident
+      (``fed_drain_prestaged`` / ``fed_remote_resident``).
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    import yaml
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.parallel import federation
+    from omero_ms_image_region_tpu.parallel.fleet import (
+        FleetImageHandler, FleetRouter)
+    from omero_ms_image_region_tpu.server.app import build_services
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.sidecar import (
+        SidecarClient, spawn_sidecar)
+    from omero_ms_image_region_tpu.server.singleflight import (
+        SingleFlight)
+
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(53)
+
+    def params_for(i: int, leg: str):
+        x, y = i % grid, (i // grid) % grid
+        w = 20000 + 700 * i + (0 if leg == "p1" else 11)
+        return {
+            "imageId": "1", "theZ": "0", "theT": "0",
+            "tile": f"0,{x},{y},{tile_edge},{tile_edge}",
+            "format": "png", "m": "c",
+            "c": f"1|0:{w}$FF0000",
+        }
+
+    async def run(tmp: str, sock: str) -> dict:
+        config = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=False),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        services = build_services(config)
+        manifest = federation.FleetManifest(
+            [federation.MemberSpec("a0", "hostA"),
+             federation.MemberSpec("b0", "hostB", sock)],
+            version=1, ring_seed="bench-fed")
+        federation.install(manifest)
+        members = federation.build_federated_members(
+            config, services, manifest, SidecarClient, "hostA")
+        router = FleetRouter(members, lane_width=2,
+                             steal_min_backlog=0,
+                             ring_seed=manifest.ring_seed,
+                             wire_handoff=True)
+        handler = FleetImageHandler(
+            router, single_flight=SingleFlight(),
+            base_services=services)
+        coord = federation.FederationCoordinator(manifest, "hostA",
+                                                 router)
+        out: dict = {}
+        try:
+            verdicts = await coord.agree(strict=True)
+            out["fed_manifest_agreed"] = all(
+                v == "agreed" for v in verdicts.values())
+
+            async def measure(leg: str) -> float:
+                ctxs = [ImageRegionCtx.from_params(
+                    params_for(i, leg)) for i in range(burst)]
+                t0 = time.perf_counter()
+                done = await asyncio.gather(
+                    *(handler.render_image_region(c) for c in ctxs))
+                assert all(done)
+                return burst / (time.perf_counter() - t0)
+
+            # p1: host B parked (draining — no routes land there),
+            # p2: both processes serve.
+            await measure("warm")          # shared compile warm-up
+            router.members["b0"].draining = True
+            p1 = await measure("p1")
+            router.members["b0"].draining = False
+            p2 = await measure("p2")
+            out["fed_tiles_per_sec_p1"] = round(p1, 2)
+            out["fed_tiles_per_sec_p2"] = round(p2, 2)
+            out["fed_process_scaling_efficiency"] = round(
+                p2 / (2.0 * p1), 3)
+
+            # Cross-host warm handoff: the LOCAL member's HBM shard
+            # ships over shard_transfer when it drains; the remote
+            # process must answer the digests resident.
+            local = router.members["a0"]
+            digests = sorted(local.resident_digests())
+            doc = await router.drain_member("a0",
+                                            settle_timeout_s=5.0)
+            out["fed_drain_planes"] = doc["planes"]
+            out["fed_drain_prestaged"] = doc["prestaged"]
+            resident = 0
+            if digests:
+                import json as _json
+                status, body = await members[1].client.call(
+                    "plane_probe", {}, extra={"digests": digests})
+                if status == 200 and body:
+                    resident = sum(
+                        bool(r) for r in _json.loads(
+                            bytes(body).decode()).get("resident", ()))
+            out["fed_remote_resident"] = resident
+            router.undrain_member("a0")
+            return out
+        finally:
+            await router.close()
+            for member in members:
+                if getattr(member, "remote", False):
+                    await member.client.close()
+            federation.uninstall()
+            services.pixels_service.close()
+
+    out = {"metric": "federation_smoke"}
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 2, 1, grid * tile_edge,
+                                     grid * tile_edge).reshape(
+            2, 1, grid * tile_edge, grid * tile_edge)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        sock = os.path.join(tmp, "fed-b0.sock")
+        sidecar_cfg = {
+            "data-dir": tmp,
+            "batcher": {"enabled": False},
+            "raw-cache": {"enabled": True, "prefetch": False,
+                          "digest-dedup": True},
+            "renderer": {"cpu-fallback-max-px": 0},
+            "federation": {
+                "enabled": True, "host": "hostB", "shard-epoch": 1,
+                "ring-seed": "bench-fed",
+                "members": [
+                    {"name": "a0", "host": "hostA"},
+                    {"name": "b0", "host": "hostB", "address": sock},
+                ]},
+        }
+        cfg_path = os.path.join(tmp, "sidecar.yaml")
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(sidecar_cfg, f)
+        proc = spawn_sidecar(cfg_path, sock)
+        try:
+            out.update(asyncio.run(run(tmp, sock)))
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except Exception:
+                proc.kill()
+    out["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    if emit:
+        print(json.dumps(out))
     return out
 
 
@@ -2910,6 +3144,12 @@ def main():
             bench_offload_smoke()
         elif "--capacity" in sys.argv[1:]:
             bench_capacity_smoke()
+        elif "--federation" in sys.argv[1:]:
+            # Multi-process federated fleet: manifest agreement
+            # against a REAL spawned sidecar process, 1-vs-2-process
+            # scaling, cross-host warm shard handoff over the wire —
+            # the MULTICHIP family's multi-process keys.
+            bench_federation_smoke()
         else:
             bench_smoke()
         return

@@ -26,6 +26,7 @@ the TPU-offload architecture viable on thin links.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 
@@ -119,6 +120,22 @@ def _regular_shape(shape) -> bool:
     for s in shape[:-2]:
         lead *= s
     return h % 64 == 0 and w % 256 == 0 and lead <= 64
+
+
+@contextlib.contextmanager
+def pin_scope(device):
+    """Run the enclosed dispatches on ``device`` — per-member device
+    pinning for the combined federated role (``parallel.federation``
+    partitions ``jax.local_devices()`` across a host's members, so
+    each member's staging and render executes on ITS device set).
+    ``None`` yields straight through: the process default device, the
+    pre-federation behavior, at zero cost."""
+    if device is None:
+        yield
+        return
+    import jax
+    with jax.default_device(device):
+        yield
 
 
 def stage(arr: np.ndarray, min_ratio: float = 1.1):

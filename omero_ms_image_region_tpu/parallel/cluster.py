@@ -81,6 +81,21 @@ def initialize(coordinator_address: Optional[str] = None,
         # No cluster environment: standalone single-process service.
 
 
+def host_identity() -> str:
+    """A stable identity for THIS host, for ``federation.host``
+    defaults and diagnostics: the JAX distributed process index when a
+    cluster is joined (``procN`` — stable across the slice by
+    construction), else the OS hostname.  Backend-free unless a
+    cluster was already joined (the :func:`initialize` discipline)."""
+    if _distributed_initialized():
+        try:
+            return f"proc{jax.process_index()}"
+        except Exception:
+            pass
+    import socket
+    return socket.gethostname()
+
+
 def global_mesh(chan_parallel: int = 1,
                 n_devices: Optional[int] = None) -> Mesh:
     """A ``(data, chan)`` mesh over every device in the (multi-host) slice.

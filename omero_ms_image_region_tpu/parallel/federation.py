@@ -1,0 +1,704 @@
+"""Cross-host fleet federation: the Hazelcast analogue at rack scale.
+
+The reference clusters its verticle fleet across JVMs/hosts with the
+Vert.x event bus + Hazelcast (``-cluster``): every node joins one
+cluster, the cluster's consistent view decides who consumes what, and
+a joining node either agrees with that view or does not join
+(PAPER.md L0/L5).  PR 8's :class:`~.fleet.FleetRouter` built the
+single-host version — members, a consistent-hash shard map, drains and
+failover — but membership lived in one process's config.  This module
+makes the fleet span ``cluster.initialize()`` process/host boundaries:
+
+* **Versioned fleet manifest** (:class:`FleetManifest`): the agreed
+  membership document — member names, which HOST each lives on, the
+  hash-ring seed and replica count, and a monotonically bumped
+  ``shard epoch`` (version).  Its BLAKE2b digest over canonical JSON
+  is the agreement token: two processes whose manifests share a digest
+  compute IDENTICAL ring assignments for every ``plane_route_key``,
+  fleet-wide, forever — the property the multihost smoke test pins
+  against each peer's OWN ring math, not a local copy of it.
+* **Join-time agreement** (``manifest_hello`` wire op): a process
+  joining the federation sends its manifest to every remote member;
+  digest match = agreed; a DIFFERENT shard epoch on either side is an
+  ordered rollout in flight — the lower-epoch process records the
+  newer manifest as PENDING (surfaced on /admin/federation and
+  /readyz; its router keeps routing the epoch it was BUILT with until
+  the operator rolls it — swapping the ring under a live router would
+  silently diverge what we advertise from what we route, the exact
+  split-brain this subsystem exists to prevent) — and same-epoch
+  digest mismatch is a refused join (:class:`FederationError`).
+* **Membership gossip** (``member_gossip`` wire op): hosts
+  periodically swap member-health views (healthy / draining, newest
+  timestamp wins) so cross-host drains and deaths propagate in one
+  gossip interval instead of one failed request per shard.
+* **Cross-host warm handoff** (``shard_transfer`` wire op): a drain
+  whose successor lives on ANOTHER host ships the warm HBM bytes
+  themselves over the v3 wire (ring-eligible bodies) — the successor
+  cannot re-read this host's pixel store, so the hint-list prestage
+  of the single-host drain would arrive cold.
+* **Per-member device pinning** (:func:`partition_local_devices`): the
+  combined role partitions ``jax.local_devices()`` across its local
+  members, so the fleet's members own real device sets per host —
+  previously only ``fleet.sockets`` sidecar topologies did.
+* **Shard-aware prefetch** rides
+  :meth:`~.fleet.FleetRouter.remote_prestage_for_route`: a predicted
+  plane whose ring owner is remote stages on its OWNER's host.
+
+Topology: each host runs the combined role with a ``federation:``
+block naming every member fleet-wide; members whose ``host`` matches
+this process's are built in-process (device-pinned lanes), the rest
+are :class:`~.fleet.RemoteMember` handles over sidecar sockets.  All
+hosts order members identically (manifest order), so bulk/mesh
+pinning, drain victims and the ring agree everywhere.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .fleet import HashRing
+
+logger = logging.getLogger(__name__)
+
+# Probe keys every agreement exchange verifies against the peer's own
+# ring math: deterministic, spread across the key space.  Golden
+# assignments holding on these is the fleet-wide shard-map contract.
+PROBE_KEYS = tuple(f"fed-probe-{i:03d}" for i in range(16))
+
+
+class FederationError(RuntimeError):
+    """A refused join: same shard epoch, different manifest digest —
+    serving with a split-brain shard map would double-stage every
+    plane and undo the fleet's whole point."""
+
+
+@dataclass(frozen=True)
+class MemberSpec:
+    """One fleet member's identity in the manifest: its fleet name,
+    the host that owns its devices, and — for members reached from
+    OTHER hosts — the sidecar address (unix path or host:port)."""
+
+    name: str
+    host: str
+    address: str = ""
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "host": self.host,
+                "address": self.address}
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "MemberSpec":
+        return cls(name=str(doc["name"]), host=str(doc["host"]),
+                   address=str(doc.get("address") or ""))
+
+
+class FleetManifest:
+    """The versioned, digest-agreed membership document.
+
+    ``version`` is the SHARD EPOCH: any membership/ring change bumps
+    it, and agreement compares epochs before digests — a peer carrying
+    a higher epoch wins (ordered rollout), equal epochs must match
+    exactly.  The digest is BLAKE2b over canonical (sorted-key,
+    compact) JSON, so agreement is byte-math, never trust.
+    """
+
+    def __init__(self, members: Sequence[MemberSpec], version: int = 1,
+                 ring_seed: str = "", replicas: int = 64):
+        members = tuple(members)
+        if not members:
+            raise ValueError("federation manifest needs >= 1 member")
+        names = [m.name for m in members]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate member names in federation "
+                             "manifest")
+        if int(version) < 1:
+            raise ValueError("federation shard epoch (version) must "
+                             "be >= 1")
+        self.members: Tuple[MemberSpec, ...] = members
+        self.version = int(version)
+        self.ring_seed = str(ring_seed)
+        self.replicas = max(1, int(replicas))
+
+    # ------------------------------------------------------------ identity
+
+    def canonical_json(self) -> str:
+        return json.dumps({
+            "version": self.version,
+            "ring_seed": self.ring_seed,
+            "replicas": self.replicas,
+            "members": [m.to_json() for m in self.members],
+        }, sort_keys=True, separators=(",", ":"))
+
+    def digest(self) -> str:
+        return hashlib.blake2b(self.canonical_json().encode(),
+                               digest_size=16).hexdigest()
+
+    def to_json(self) -> dict:
+        return json.loads(self.canonical_json())
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "FleetManifest":
+        return cls(
+            members=[MemberSpec.from_json(m)
+                     for m in (doc.get("members") or ())],
+            version=int(doc.get("version", 1)),
+            ring_seed=str(doc.get("ring_seed") or ""),
+            replicas=int(doc.get("replicas", 64)))
+
+    @classmethod
+    def from_config(cls, fed) -> "FleetManifest":
+        """Build from a validated ``federation:`` config block
+        (``server.config.FederationConfig``)."""
+        return cls(
+            members=[MemberSpec(name=m["name"], host=m["host"],
+                                address=m.get("address", ""))
+                     for m in fed.members],
+            version=fed.shard_epoch,
+            ring_seed=fed.ring_seed,
+            replicas=fed.hash_replicas)
+
+    # -------------------------------------------------------------- lookup
+
+    def names(self) -> List[str]:
+        return [m.name for m in self.members]
+
+    def local_members(self, host: str) -> List[MemberSpec]:
+        return [m for m in self.members if m.host == host]
+
+    def remote_members(self, host: str) -> List[MemberSpec]:
+        return [m for m in self.members if m.host != host]
+
+    def ring(self) -> HashRing:
+        """THE fleet-wide ring: every process with an agreeing manifest
+        constructs this identically — golden ``plane_route_key``
+        assignments hold across hosts by construction."""
+        return HashRing(self.names(), replicas=self.replicas,
+                        seed=self.ring_seed)
+
+    def owners(self, keys: Sequence[str]) -> List[str]:
+        ring = self.ring()
+        return [ring.member(k) for k in keys]
+
+
+# ------------------------------------------------- module-global install
+
+# The process's ACTIVE manifest (the ``pressure.install`` idiom): the
+# sidecar wire ops answer from here, so a sidecar process and its
+# frontends share one source of truth per process.  The active
+# manifest is immutable for the process life — the router, the
+# prefetch routing and every staged plane's ownership were built from
+# it; a newer epoch learned from a peer lands in ``_PENDING`` (loud on
+# every status surface) and activates on the next process roll.
+_MANIFEST: Optional[FleetManifest] = None
+_PENDING: Optional[FleetManifest] = None
+
+
+def install(manifest: FleetManifest) -> None:
+    global _MANIFEST
+    _MANIFEST = manifest
+    from ..utils import telemetry
+    telemetry.FEDERATION.set_manifest(manifest.version,
+                                      len(manifest.members))
+    logger.info("federation manifest installed: epoch %d, %d members, "
+                "digest %s", manifest.version, len(manifest.members),
+                manifest.digest())
+
+
+def current() -> Optional[FleetManifest]:
+    return _MANIFEST
+
+
+def set_pending(manifest: FleetManifest) -> None:
+    """Record a NEWER epoch learned from a peer.  Never activates in
+    place: the live router routes the manifest it was built from, and
+    agreement answers must keep describing what this process actually
+    routes — the pending epoch is the operator's signal to roll."""
+    global _PENDING
+    if _PENDING is None or manifest.version > _PENDING.version:
+        _PENDING = manifest
+        logger.warning(
+            "federation manifest epoch %d is pending (active epoch "
+            "%s) — roll this process to activate it",
+            manifest.version,
+            _MANIFEST.version if _MANIFEST else None)
+
+
+def pending() -> Optional[FleetManifest]:
+    return _PENDING
+
+
+def uninstall() -> None:
+    global _MANIFEST, _PENDING
+    _MANIFEST = None
+    _PENDING = None
+
+
+# ------------------------------------------------------ wire-op handlers
+
+def handle_manifest_hello(header: dict) -> dict:
+    """Server side of the ``manifest_hello`` op (runs in the sidecar's
+    request handler).  Compares the joiner's manifest against this
+    process's installed one and answers the agreement verdict plus —
+    when probe keys rode along — this process's OWN ring owner for
+    each (the cross-process golden-assignment check).
+
+    No manifest installed = a legacy / un-federated process: answers
+    ``{"enabled": false}`` and the coordinator degrades (counts
+    ``legacy``, serves without federation features on that peer)."""
+    from ..utils import telemetry
+    mine = _MANIFEST
+    if mine is None:
+        return {"enabled": False}
+    doc: dict = {
+        "enabled": True,
+        "version": mine.version,
+        "digest": mine.digest(),
+    }
+    theirs_doc = header.get("manifest")
+    if isinstance(theirs_doc, dict):
+        try:
+            theirs = FleetManifest.from_json(theirs_doc)
+        except (KeyError, TypeError, ValueError):
+            theirs = None
+        if theirs is None:
+            doc["agreed"] = False
+            doc["reason"] = "malformed"
+            telemetry.FEDERATION.count_agreement("split-brain")
+        elif theirs.digest() == mine.digest():
+            doc["agreed"] = True
+            telemetry.FEDERATION.count_agreement("agreed")
+        elif theirs.version > mine.version:
+            # The joiner carries a NEWER shard epoch: a rolling config
+            # update reached it first.  Record it PENDING — this
+            # process keeps routing the epoch its router was built
+            # from until the operator rolls it; answering "agreed" to
+            # a map we are not routing would be the silent split-brain
+            # this op exists to refuse.
+            set_pending(theirs)
+            doc["agreed"] = False
+            doc["reason"] = "pending"
+            doc["pending_version"] = theirs.version
+            telemetry.FEDERATION.count_agreement("pending")
+        elif theirs.version < mine.version:
+            # The joiner is behind: send ours so IT records the
+            # pending epoch and its operator rolls it.
+            doc["agreed"] = False
+            doc["reason"] = "stale-epoch"
+            doc["manifest"] = mine.to_json()
+            telemetry.FEDERATION.count_agreement("stale")
+        else:
+            doc["agreed"] = False
+            doc["reason"] = "split-brain"
+            telemetry.FEDERATION.count_agreement("split-brain")
+    probe_keys = header.get("probe_keys")
+    if isinstance(probe_keys, list) and probe_keys:
+        doc["owners"] = mine.owners([str(k) for k in probe_keys[:64]])
+    return doc
+
+
+# Gossip view: member name -> {"healthy": bool, "draining": bool,
+# "ts": float} — wall-clock stamped, newest observation wins on merge.
+_GOSSIP_VIEW: Dict[str, dict] = {}
+
+
+def local_view(router, self_host: str = "") -> Dict[str, dict]:
+    """This process's authoritative member observations: LOCAL members'
+    health/drain state straight from the router (a host knows its own
+    members best), stamped now."""
+    mine = _MANIFEST
+    view: Dict[str, dict] = {}
+    if router is None or mine is None:
+        return view
+    now = time.time()
+    local = {m.name for m in mine.local_members(self_host)} \
+        if self_host else set(router.order)
+    for name in router.order:
+        if name not in local:
+            continue
+        member = router.members.get(name)
+        if member is None:
+            continue
+        view[name] = {"healthy": bool(member.healthy),
+                      "draining": bool(member.draining),
+                      "ts": now}
+    return view
+
+
+def merge_view(view: dict) -> Dict[str, dict]:
+    """Fold a peer's view into the process gossip state (newest ``ts``
+    per member wins) and return the merged state.
+
+    Names are validated against the ACTIVE manifest (the socket is
+    unauthenticated-by-design like every sidecar op, and the merged
+    view is re-broadcast in every gossip answer — an unvalidated name
+    would live in this module-global forever and propagate
+    fleet-wide), so the view is bounded by the membership.  With no
+    manifest installed (bare tests), a hard cap stands in."""
+    mine = _MANIFEST
+    known = set(mine.names()) if mine is not None else None
+    if isinstance(view, dict):
+        for name, obs in view.items():
+            if not isinstance(obs, dict):
+                continue
+            # Store and look up under the SAME (bounded) key, or an
+            # over-long name would bypass the newest-ts merge.
+            name = str(name)[:64]
+            if known is not None:
+                if name not in known:
+                    continue
+            elif name not in _GOSSIP_VIEW \
+                    and len(_GOSSIP_VIEW) >= 256:
+                continue
+            held = _GOSSIP_VIEW.get(name)
+            if held is None or float(obs.get("ts", 0)) \
+                    > float(held.get("ts", 0)):
+                _GOSSIP_VIEW[name] = {
+                    "healthy": bool(obs.get("healthy", True)),
+                    "draining": bool(obs.get("draining", False)),
+                    "ts": float(obs.get("ts", 0)),
+                }
+    return dict(_GOSSIP_VIEW)
+
+
+def handle_member_gossip(header: dict) -> dict:
+    """Server side of ``member_gossip``: merge the sender's view, answer
+    ours + the manifest identity (drift between gossiping peers is a
+    mismatch the coordinator surfaces)."""
+    mine = _MANIFEST
+    merged = merge_view(header.get("view") or {})
+    doc = {"enabled": mine is not None, "view": merged}
+    if mine is not None:
+        doc["version"] = mine.version
+        doc["digest"] = mine.digest()
+    return doc
+
+
+def reset_gossip() -> None:
+    """Test isolation."""
+    _GOSSIP_VIEW.clear()
+
+
+# ------------------------------------------------------- device pinning
+
+def partition_local_devices(n_members: int,
+                            devices: Optional[Sequence] = None
+                            ) -> List[list]:
+    """Partition this process's devices across ``n_members`` local
+    members — contiguous, deterministic, remainder to the earliest
+    members (so member 0, the mesh/bulk lane, is never the short one).
+    Fewer devices than members leaves the tail members unpinned
+    (process default device) rather than oversubscribing one chip with
+    two members' pins."""
+    if n_members < 1:
+        raise ValueError("partition needs >= 1 member")
+    if devices is None:
+        import jax
+        devices = jax.local_devices()
+    devices = list(devices)
+    n_dev = len(devices)
+    if n_dev == 0:
+        return [[] for _ in range(n_members)]
+    base, extra = divmod(n_dev, n_members)
+    out: List[list] = []
+    i = 0
+    for m in range(n_members):
+        take = base + (1 if m < extra else 0)
+        out.append(devices[i:i + take])
+        i += take
+    return out
+
+
+# --------------------------------------------------------- construction
+
+def build_federated_members(config, base_services, manifest,
+                            client_factory, self_host: str):
+    """The federated member list, in MANIFEST order: members on THIS
+    host are in-process device-pinned lanes (the combined role), the
+    rest are :class:`~.fleet.RemoteMember` handles over their sidecar
+    addresses.  Every host building from an agreeing manifest produces
+    the same order, so ring arcs, bulk pinning (order[0]) and drain
+    victims agree fleet-wide.
+
+    The FIRST local member wraps the base service stack (its renderer
+    may be the lockstep ``MeshRenderer`` — ``parallel.serve`` marks it
+    ``lockstep = True`` and it must stay a single lane, so device
+    partitioning pins but never splits it)."""
+    from .fleet import RemoteMember, build_local_members
+
+    local_specs = manifest.local_members(self_host)
+    if not local_specs:
+        raise ValueError(
+            f"federation.host {self_host!r} owns no manifest member — "
+            f"a combined process must serve at least one")
+    for spec in manifest.remote_members(self_host):
+        if not spec.address:
+            raise ValueError(
+                f"federation member {spec.name!r} on host "
+                f"{spec.host!r} has no address — this host "
+                f"({self_host!r}) cannot reach it")
+    if getattr(base_services.renderer, "lockstep", False) \
+            and manifest.members[0].host != self_host:
+        # The lockstep MeshRenderer lives HERE, but bulk/mesh work
+        # pins to the fleet's first member (manifest order[0]) — on
+        # another host.  Legal (that host serves bulk), but almost
+        # certainly a mis-ordered manifest: the mesh host should come
+        # first so full-plane jobs run on the mesh.
+        logger.warning(
+            "this host (%s) runs the lockstep mesh renderer but "
+            "manifest member 0 (%s) lives on host %s — bulk/mesh "
+            "work will pin there; list the mesh host's members first",
+            self_host, manifest.members[0].name,
+            manifest.members[0].host)
+    device_sets = partition_local_devices(len(local_specs))
+    locals_built = build_local_members(
+        config, base_services, len(local_specs),
+        device_sets=device_sets)
+    by_name = {}
+    for spec, built in zip(local_specs, locals_built):
+        built.name = spec.name
+        by_name[spec.name] = built
+    members = []
+    for spec in manifest.members:
+        if spec.name in by_name:
+            members.append(by_name[spec.name])
+        else:
+            members.append(RemoteMember(
+                spec.name, client_factory(spec.address),
+                down_cooldown_s=config.fleet.down_cooldown_s))
+    return members
+
+
+# ---------------------------------------------------------- coordinator
+
+class FederationCoordinator:
+    """The join/gossip driver for one process's federated router.
+
+    ``agree()`` runs once at startup (and on demand): exchanges
+    manifests with every remote member, verifies golden probe-key
+    owners against each peer's own ring math, adopts newer epochs, and
+    raises :class:`FederationError` on split-brain.  ``run()`` is the
+    gossip tick loop — cross-host drain/death propagation plus
+    manifest-drift detection."""
+
+    def __init__(self, manifest: FleetManifest, self_host: str,
+                 router=None, gossip_interval_s: float = 5.0):
+        self.manifest = manifest
+        self.self_host = self_host
+        self.router = router
+        self.gossip_interval_s = max(0.2, float(gossip_interval_s))
+        # name -> verdict of the last agreement exchange.
+        self.agreement: Dict[str, str] = {}
+        self.last_gossip: Dict[str, str] = {}
+
+    def _remote_handles(self) -> List:
+        if self.router is None:
+            return []
+        return [self.router.members[n] for n in self.router.order
+                if getattr(self.router.members[n], "remote", False)]
+
+    async def agree(self, strict: bool = True) -> Dict[str, str]:
+        """One agreement round with every remote member.  Returns the
+        per-member verdict map; ``strict`` raises on split-brain only
+        — every rolling-rollout verdict is tolerated and LOUD:
+
+        * ``agreed`` — digest match, probe owners verified against
+          the peer's own ring math;
+        * ``pending`` — the peer is on an OLDER epoch and recorded
+          ours as pending (its operator rolls it; a mid-roll fleet
+          serves with both maps, each process honest about its own);
+        * ``stale`` — WE are on the older epoch: the peer's newer
+          manifest is recorded pending here, /readyz and
+          /admin/federation say so until this process is rolled;
+        * ``unreachable`` / ``legacy`` — tolerated (a dead or
+          un-federated host must not block the survivors' boot);
+        * ``split-brain`` — same epoch, different membership (or a
+          peer whose ring math disagrees with its own digest): a
+          refused join under ``strict``."""
+        from ..utils import telemetry
+        doc = self.manifest.to_json()
+        my_owners = self.manifest.owners(list(PROBE_KEYS))
+        verdicts: Dict[str, str] = {}
+        for member in self._remote_handles():
+            resp = await member.manifest_hello(
+                doc, probe_keys=list(PROBE_KEYS))
+            if resp is None:
+                verdicts[member.name] = "unreachable"
+                telemetry.FEDERATION.count_agreement("unreachable")
+                continue
+            if not resp.get("enabled"):
+                verdicts[member.name] = "legacy"
+                telemetry.FEDERATION.count_agreement("legacy")
+                continue
+            if resp.get("agreed"):
+                # Digest agreement is necessary; the probe owners are
+                # the sufficiency check — the peer's OWN ring hashed
+                # every probe key to the member we did.
+                owners = resp.get("owners")
+                if owners is not None and owners != my_owners:
+                    verdicts[member.name] = "split-brain"
+                    telemetry.FEDERATION.count_agreement("split-brain")
+                    continue
+                verdicts[member.name] = "agreed"
+                telemetry.FEDERATION.count_agreement("agreed")
+                continue
+            reason = resp.get("reason")
+            if reason == "pending":
+                # The peer (older epoch) recorded OUR manifest as its
+                # pending epoch — a rollout in flight, its side.
+                verdicts[member.name] = "pending"
+                telemetry.FEDERATION.count_agreement("pending")
+                continue
+            if reason == "stale-epoch" \
+                    and isinstance(resp.get("manifest"), dict):
+                # WE are the older epoch: record the newer manifest
+                # pending and keep serving the map this router was
+                # BUILT with — activating mid-flight would diverge
+                # what we advertise from what we route.
+                try:
+                    newer = FleetManifest.from_json(resp["manifest"])
+                except (KeyError, TypeError, ValueError):
+                    verdicts[member.name] = "split-brain"
+                    telemetry.FEDERATION.count_agreement("split-brain")
+                    continue
+                if newer.version > self.manifest.version:
+                    set_pending(newer)
+                    verdicts[member.name] = "stale"
+                    telemetry.FEDERATION.count_agreement("stale")
+                    continue
+                verdicts[member.name] = "split-brain"
+                telemetry.FEDERATION.count_agreement("split-brain")
+            else:
+                verdicts[member.name] = "split-brain"
+                telemetry.FEDERATION.count_agreement("split-brain")
+        self.agreement = verdicts
+        split = [n for n, v in verdicts.items() if v == "split-brain"]
+        if split and strict:
+            raise FederationError(
+                f"federation manifest split-brain with {split}: same "
+                f"shard epoch, different membership — refusing to "
+                f"serve a forked shard map (bump federation.shard-"
+                f"epoch with the corrected member list)")
+        return verdicts
+
+    async def gossip_once(self) -> Dict[str, str]:
+        """One gossip round: push our local-member view to every
+        remote member, merge their answers, and reflect what their
+        hosts report about THEIR members onto our router handles —
+        a drain ordered on host B walks routing off B's members here
+        within one interval, before any request fails over."""
+        from ..utils import telemetry
+        view = local_view(self.router, self.self_host)
+        merge_view(view)
+        outcome: Dict[str, str] = {}
+        my_digest = self.manifest.digest()
+        for member in self._remote_handles():
+            resp = await member.member_gossip(view)
+            if resp is None or not resp.get("enabled", True):
+                outcome[member.name] = "unreachable"
+                telemetry.FEDERATION.count_gossip("unreachable")
+                continue
+            their_digest = resp.get("digest")
+            pend = pending()
+            if their_digest not in (None, my_digest):
+                if pend is not None \
+                        and their_digest == pend.digest():
+                    # Known rollout in flight: the peer already runs
+                    # the epoch we hold PENDING — not drift, just the
+                    # roll this process is still waiting for.
+                    pass
+                else:
+                    outcome[member.name] = "mismatch"
+                    telemetry.FEDERATION.count_gossip("mismatch")
+                    logger.warning(
+                        "federation manifest drift detected gossiping "
+                        "with %s (their digest %s != ours %s)",
+                        member.name, their_digest, my_digest)
+                    continue
+            merged = merge_view(resp.get("view") or {})
+            self._apply_remote_view(merged)
+            outcome[member.name] = "ok"
+            telemetry.FEDERATION.count_gossip("ok")
+        self.last_gossip = outcome
+        return outcome
+
+    def _apply_remote_view(self, merged: Dict[str, dict]) -> None:
+        """Reflect peers' authoritative observations of THEIR OWN
+        members onto our remote handles: drain state propagates both
+        ways (set and cleared) UNDER the ``gossip`` intent only —
+        drains THIS process ordered (operator ``/admin/drain``, an
+        autoscaler scale-down holding the member in ``_scaled_down``)
+        are this router's own decisions and must never be reverted by
+        a peer that simply was not told about them.  Down-ness only
+        marks (re-admission stays with the served-call/cooldown
+        machinery — gossip must not revive a member its own host no
+        longer vouches for)."""
+        if self.router is None:
+            return
+        local = {m.name for m in
+                 self.manifest.local_members(self.self_host)}
+        for name, obs in merged.items():
+            if name in local or name not in self.router.members:
+                continue
+            member = self.router.members[name]
+            intent = getattr(member, "drain_intent", None)
+            if member.draining and intent not in (None, "gossip"):
+                # Our own drain (operator/autoscale): gossip is not
+                # allowed to undo it — host B reporting "b1 not
+                # draining" just means B was never told.
+                continue
+            draining = bool(obs.get("draining"))
+            if member.draining != draining:
+                member.draining = draining
+                member.drain_intent = "gossip" if draining else None
+                from ..utils import telemetry
+                telemetry.FLIGHT.record("federation.gossip-drain",
+                                        member=name,
+                                        draining=draining)
+            if not obs.get("healthy", True) and member.healthy:
+                member.mark_down()
+
+    def status(self) -> dict:
+        """The /admin/federation + /readyz annotation document."""
+        doc = {
+            "host": self.self_host,
+            "epoch": self.manifest.version,
+            "digest": self.manifest.digest(),
+            "members": [m.to_json() for m in self.manifest.members],
+            "agreement": dict(self.agreement),
+            "gossip": dict(self.last_gossip),
+            "view": dict(_GOSSIP_VIEW),
+        }
+        pend = pending()
+        if pend is not None and pend.version > self.manifest.version:
+            # The operator's roll signal: a newer epoch exists in the
+            # fleet and activates here on the next process restart.
+            doc["pending_epoch"] = pend.version
+            doc["pending_digest"] = pend.digest()
+        return doc
+
+    def summary(self) -> str:
+        agreed = sum(1 for v in self.agreement.values()
+                     if v == "agreed")
+        line = (f"epoch {self.manifest.version}, "
+                f"{agreed}/{max(1, len(self.agreement))} peers agreed")
+        pend = pending()
+        if pend is not None and pend.version > self.manifest.version:
+            line += f" (epoch {pend.version} pending roll)"
+        return line
+
+    async def run(self) -> None:
+        """Gossip tick loop (the governor idiom; the app's robustness
+        startup hook owns the task)."""
+        while True:
+            await asyncio.sleep(self.gossip_interval_s)
+            try:
+                await self.gossip_once()
+            except Exception:
+                logger.warning("federation gossip round failed",
+                               exc_info=True)

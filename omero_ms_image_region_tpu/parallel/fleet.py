@@ -66,19 +66,27 @@ class HashRing:
     bound test in tier-1).
     """
 
-    def __init__(self, members: Sequence[str], replicas: int = 64):
+    def __init__(self, members: Sequence[str], replicas: int = 64,
+                 seed: str = ""):
         if not members:
             raise ValueError("hash ring needs at least one member")
         if len(set(members)) != len(members):
             raise ValueError("duplicate fleet member names")
         self.replicas = max(1, int(replicas))
+        # Federation namespace (``federation.ring-seed``): folded into
+        # every point hash so two federations sharing member NAMES can
+        # never silently share a key space.  The empty default keeps
+        # every pre-federation ring's golden assignments bit-exact.
+        self.seed = str(seed)
         self.members: Tuple[str, ...] = tuple(members)
         self._points: List[int] = []
         self._owners: List[str] = []
+        prefix = f"{self.seed}|" if self.seed else ""
         points = []
         for name in self.members:
             for v in range(self.replicas):
-                points.append((self._point(f"{name}#{v}"), name))
+                points.append((self._point(f"{prefix}{name}#{v}"),
+                               name))
         points.sort()
         self._points = [p for p, _ in points]
         self._owners = [o for _, o in points]
@@ -89,6 +97,9 @@ class HashRing:
             hashlib.blake2b(s.encode(), digest_size=8).digest(),
             "big")
 
+    def _key_point(self, key: str) -> int:
+        return self._point(f"{self.seed}|{key}" if self.seed else key)
+
     def chain(self, key: str) -> List[str]:
         """Members in ring order from ``key``'s arc, deduplicated: the
         first entry owns the key; the rest are its failover order
@@ -96,7 +107,7 @@ class HashRing:
         to a *deterministic* successor."""
         if not self._points:
             return []
-        i = bisect.bisect(self._points, self._point(key)) \
+        i = bisect.bisect(self._points, self._key_point(key)) \
             % len(self._points)
         seen = []
         for step in range(len(self._points)):
@@ -125,6 +136,19 @@ def plane_route_key(ctx) -> str:
     parts = (ctx.image_id, ctx.z, ctx.t, ctx.resolution, tile, region)
     return hashlib.blake2b(repr(parts).encode(),
                            digest_size=16).hexdigest()
+
+
+def _entry_key(entry: dict) -> tuple:
+    """Canonical identity of a restageable manifest entry (the region
+    key as a hashable tuple) — matches exported bytes back to their
+    hint entries across JSON round-trips (lists vs tuples)."""
+    try:
+        image_id, z, t, level, region, channels = entry["key"]
+        return (int(image_id), int(z), int(t), int(level),
+                tuple(int(v) for v in region),
+                tuple(int(c) for c in channels))
+    except (KeyError, TypeError, ValueError):
+        return (id(entry),)
 
 
 # -------------------------------------------------------------- members
@@ -167,12 +191,20 @@ class LocalMember:
 
     def __init__(self, name: str, handler, services=None,
                  down_cooldown_s: float = 5.0,
-                 byte_cache_prechecked: bool = False):
+                 byte_cache_prechecked: bool = False,
+                 devices: Optional[Sequence] = None):
         self.name = name
         self.handler = handler
         self.services = services
         self.down_cooldown_s = down_cooldown_s
         self.byte_cache_prechecked = byte_cache_prechecked
+        # Per-member device set (cross-host federation: the combined
+        # role owns REAL devices per member when the host has several
+        # — ``federation.partition_local_devices``).  The first device
+        # is the member's dispatch pin (``services.pin_device``); an
+        # empty set means the process default device, the pre-pinning
+        # behavior.
+        self.devices: Tuple = tuple(devices or ())
         self._down_until = 0.0
         # Rolling-drain state (router.drain_member): a DRAINING member
         # finishes its in-flight work but accepts no new routes — on
@@ -300,6 +332,78 @@ class LocalMember:
                 except Exception:
                     continue    # best-effort: a bad entry is a cold
                     # miss later, never a failed drain
+            return staged
+
+        return await asyncio.to_thread(stage_all)
+
+    async def shard_export(self, limit: int = 0) -> List[dict]:
+        """This member's HBM shard as entries WITH the plane bytes —
+        the cross-host drain handoff's payload (``shard_transfer``):
+        a successor on ANOTHER host cannot re-read this host's pixel
+        store, so the warm bytes themselves ride the wire.  MRU-first
+        like :meth:`shard_manifest`; entries whose buffer is already
+        gone (eviction race) are skipped."""
+        import numpy as np
+        from ..io.devicecache import region_key
+        cache = getattr(self.services, "raw_cache", None)
+        if cache is None or not hasattr(cache, "snapshot_entries"):
+            return []
+        entries = cache.snapshot_entries(limit)
+
+        def export() -> List[dict]:
+            out = []
+            for entry in entries:
+                try:
+                    image_id, z, t, level, region, channels = \
+                        entry["key"]
+                    key = region_key(
+                        int(image_id), int(z), int(t), int(level),
+                        tuple(int(v) for v in region),
+                        tuple(int(c) for c in channels))
+                except (KeyError, TypeError, ValueError):
+                    continue
+                arr = cache.get(key)
+                if arr is None:
+                    continue
+                host = np.asarray(arr)
+                out.append({**entry, "dtype": str(host.dtype),
+                            "shape": list(host.shape),
+                            "bytes": host.tobytes()})
+            return out
+
+        return await asyncio.to_thread(export)
+
+    async def shard_transfer(self, entries: List[dict]) -> int:
+        """Stage handed-over plane BYTES into this member's HBM
+        (cross-host handoff, successor side — the in-process mirror of
+        the ``shard_transfer`` wire op, so the router's handoff code
+        is member-kind-agnostic).  Digest-deduped like every staging
+        path: re-handing a resident plane aliases, never duplicates."""
+        import numpy as np
+        from ..io.devicecache import region_key
+        cache = getattr(self.services, "raw_cache", None)
+        if cache is None:
+            return 0
+
+        def stage_all() -> int:
+            staged = 0
+            for entry in entries:
+                try:
+                    image_id, z, t, level, region, channels = \
+                        entry["key"]
+                    key = region_key(
+                        int(image_id), int(z), int(t), int(level),
+                        tuple(int(v) for v in region),
+                        tuple(int(c) for c in channels))
+                    arr = np.frombuffer(
+                        entry["bytes"], dtype=entry["dtype"]).reshape(
+                        tuple(entry["shape"]))
+                except (KeyError, TypeError, ValueError):
+                    continue
+                cache.get_or_load(key, lambda a=arr: a,
+                                  digest=entry.get("digest"),
+                                  route_key=entry.get("route"))
+                staged += 1
             return staged
 
         return await asyncio.to_thread(stage_all)
@@ -465,6 +569,79 @@ class RemoteMember:
         except Exception:
             return 0
 
+    # ---- cross-host federation (parallel.federation): manifest
+    # agreement at join, membership gossip, and warm shard transfer —
+    # the three new v3-wire ops.  manifest_hello / member_gossip are
+    # idempotent reads (retried); shard_transfer ships state and is
+    # never blind-retried, exactly the plane_put contract.
+
+    async def manifest_hello(self, doc: dict,
+                             probe_keys: Optional[List[str]] = None
+                             ) -> Optional[dict]:
+        """Exchange fleet manifests with this member's process: send
+        ours, learn whether the peer's agrees (digest match), and —
+        when ``probe_keys`` ride along — the peer's ring owner for
+        each, so golden assignments are verified AGAINST THE PEER'S
+        OWN MATH, not our copy of it.  None = unreachable/legacy."""
+        import json as _json
+        extra = {"manifest": doc}
+        if probe_keys:
+            extra["probe_keys"] = list(probe_keys)
+        try:
+            status, body = await self.client.call(
+                "manifest_hello", {}, extra=extra)
+            if status != 200 or not body:
+                return None
+            return dict(_json.loads(bytes(body).decode()))
+        except Exception:
+            return None
+
+    async def member_gossip(self, view: dict) -> Optional[dict]:
+        """Swap membership views (name -> health/draining + a
+        timestamp) and the manifest (version, digest) — the rack-scale
+        liveness channel that propagates drains and deaths between
+        hosts faster than per-request failures would."""
+        import json as _json
+        try:
+            status, body = await self.client.call(
+                "member_gossip", {}, extra={"view": view})
+            if status != 200 or not body:
+                return None
+            return dict(_json.loads(bytes(body).decode()))
+        except Exception:
+            return None
+
+    async def shard_transfer(self, entries: List[dict]) -> int:
+        """Ship warm plane BYTES into this member's HBM over the wire
+        (cross-host drain handoff): one frame per plane — the body is
+        the raw buffer (shm-ring eligible), the header carries the
+        restage identity (key/digest/route/dtype/shape).  Best-effort
+        per entry; a failed ship is a cold miss later, never a failed
+        drain."""
+        import json as _json
+        staged = 0
+        for entry in entries:
+            payload = entry.get("bytes")
+            if payload is None:
+                continue
+            meta = {k: entry.get(k) for k in
+                    ("key", "digest", "route", "dtype", "shape")}
+            try:
+                status, body = await self.client.call(
+                    "shard_transfer", {}, body=bytes(payload),
+                    extra={"entry": meta})
+                if status == 200 and body and _json.loads(
+                        bytes(body).decode()).get("staged"):
+                    staged += 1
+                    # Counted HERE, per ship that actually landed —
+                    # the bytes of failed entries never reach the
+                    # transfer gauge.
+                    from ..utils import telemetry
+                    telemetry.FEDERATION.count_transfer(len(payload))
+            except Exception:
+                continue
+        return staged
+
 
 # --------------------------------------------------------------- router
 
@@ -622,7 +799,9 @@ class FleetRouter:
                  steal_min_backlog: int = 2, hash_replicas: int = 64,
                  failover: bool = True, qos_weight: int = 0,
                  peer_fetch: bool = True,
-                 peer_timeout_s: float = 0.5):
+                 peer_timeout_s: float = 0.5,
+                 ring_seed: str = "",
+                 wire_handoff: bool = False):
         if not members:
             raise ValueError("fleet needs at least one member")
         if lane_width < 1:
@@ -631,7 +810,14 @@ class FleetRouter:
         if len(self.members) != len(members):
             raise ValueError("duplicate fleet member names")
         self.order: List[str] = [m.name for m in members]
-        self.ring = HashRing(self.order, replicas=hash_replicas)
+        self.ring = HashRing(self.order, replicas=hash_replicas,
+                             seed=ring_seed)
+        # Cross-host drains (parallel.federation): when the draining
+        # member is LOCAL and its successor is REMOTE, hand the warm
+        # bytes themselves over the shard_transfer op — a successor on
+        # another host cannot re-read this host's pixel store, so a
+        # hint-list prestage would arrive cold.
+        self.wire_handoff = bool(wire_handoff)
         self.lane_width = lane_width
         # 0 disables stealing entirely.
         self.steal_min_backlog = max(0, int(steal_min_backlog))
@@ -751,6 +937,40 @@ class FleetRouter:
                                "raw_cache", None)
         return None
 
+    def remote_prestage_for_route(self, route_key: str,
+                                  entry: dict) -> bool:
+        """Shard-aware prefetch, cross-host seam: a PREDICTED plane
+        whose ring owner is a REMOTE member stages on ITS owner's
+        host — a fire-and-forget ``prestage`` hint (the owner re-reads
+        the region from its own pixel store through the digest-deduped
+        staging path), so speculation warms the member that will serve
+        the request instead of this host's wrong shard.  False when
+        the owner is local (``cache_for_route`` handles it in-process)
+        or unroutable."""
+        for name in self.ring.chain(route_key):
+            if not self._routable(name):
+                continue
+            member = self.members[name]
+            if not getattr(member, "remote", False):
+                return False
+            from ..utils import telemetry
+
+            async def hint() -> None:
+                try:
+                    await member.prestage_manifest([entry])
+                except Exception:
+                    pass           # speculation only removes work
+
+            try:
+                task = asyncio.get_running_loop().create_task(hint())
+            except RuntimeError:
+                return False       # no loop: prefetch pool thread
+            telemetry.FEDERATION.count_remote_prestage()
+            self._putback_tasks.add(task)
+            task.add_done_callback(self._putback_tasks.discard)
+            return True
+        return False
+
     def draining_members(self, intent: Optional[str] = None
                          ) -> List[str]:
         """Draining member names; ``intent`` filters to one drain
@@ -858,10 +1078,40 @@ class FleetRouter:
                                             []).append(entry)
                     break
         staged = 0
-        for successor, entries in by_successor.items():
+        draining_member = self.members[draining]
+        # Cross-host warm handoff: a LOCAL drainer's HBM bytes ship
+        # over the wire to REMOTE successors (their host cannot
+        # re-read this host's pixel store).  Exported once, bounded by
+        # the manifest the drain already capped; any export/ship
+        # failure degrades to the hint-list prestage below.
+        exported: Dict[tuple, dict] = {}
+        if self.wire_handoff and not draining_member.remote and any(
+                self.members[s].remote for s in by_successor):
             try:
-                staged += await self.members[successor] \
-                    .prestage_manifest(entries)
+                for entry in await draining_member.shard_export(
+                        len(manifest)):
+                    exported[_entry_key(entry)] = entry
+            except Exception:
+                logger.warning("shard export from %s failed; "
+                               "hint-list handoff", draining,
+                               exc_info=True)
+        for successor, entries in by_successor.items():
+            member = self.members[successor]
+            try:
+                if exported and member.remote:
+                    with_bytes = [exported[_entry_key(e)]
+                                  for e in entries
+                                  if _entry_key(e) in exported]
+                    # Ship the warm bytes (shard_transfer counts each
+                    # landed entry's bytes itself); entries whose
+                    # buffer was already evicted fall back to hints.
+                    staged += await member.shard_transfer(with_bytes)
+                    rest = [e for e in entries
+                            if _entry_key(e) not in exported]
+                    if rest:
+                        staged += await member.prestage_manifest(rest)
+                else:
+                    staged += await member.prestage_manifest(entries)
             except Exception:
                 logger.warning("drain handoff to %s failed",
                                successor, exc_info=True)
@@ -1575,7 +1825,8 @@ class FleetImageHandler:
 
 # ---------------------------------------------------------- construction
 
-def build_local_members(config, base_services, n: int
+def build_local_members(config, base_services, n: int,
+                        device_sets: Optional[Sequence] = None
                         ) -> List[LocalMember]:
     """N in-process fleet members over a shared host-side service
     stack: member 0 IS the base stack (its renderer may be the
@@ -1597,11 +1848,28 @@ def build_local_members(config, base_services, n: int
     from ..server.handler import (ImageRegionHandler,
                                   ImageRegionServices, Renderer)
 
+    def devices_for(i: int) -> tuple:
+        if not device_sets or i >= len(device_sets):
+            return ()
+        return tuple(device_sets[i] or ())
+
     cooldown = config.fleet.down_cooldown_s
-    members = [LocalMember("m0", ImageRegionHandler(base_services),
-                           services=base_services,
-                           down_cooldown_s=cooldown,
-                           byte_cache_prechecked=True)]
+    # The lockstep MeshRenderer is mesh-topology-bound: it already
+    # spans its whole device set and must NEVER be pinned narrower
+    # (parallel.serve marks it ``lockstep``) — member 0 then keeps
+    # the process default dispatch.
+    lockstep = getattr(base_services.renderer, "lockstep", False)
+    base_services.pin_device = (devices_for(0)[0]
+                                if devices_for(0) and not lockstep
+                                else None)
+    if base_services.pin_device is not None \
+            and hasattr(base_services.renderer, "device"):
+        base_services.renderer.device = base_services.pin_device
+    members = [LocalMember(
+        "m0",
+        ImageRegionHandler(base_services), services=base_services,
+        down_cooldown_s=cooldown, byte_cache_prechecked=True,
+        devices=devices_for(0))]
     for i in range(1, n):
         if config.batcher.enabled and not config.parallel.enabled:
             renderer = BatchingRenderer(
@@ -1623,6 +1891,8 @@ def build_local_members(config, base_services, n: int
                                  "jpeg_engine", "sparse")
             renderer = Renderer(jpeg_engine=engine,
                                 kernel=config.renderer.kernel)
+        if devices_for(i):
+            renderer.device = devices_for(i)[0]
         raw_cache = (DeviceRawCache(
             config.raw_cache.max_bytes,
             digest_index=config.raw_cache.digest_dedup)
@@ -1637,8 +1907,12 @@ def build_local_members(config, base_services, n: int
             max_tile_length=base_services.max_tile_length,
             raw_cache=raw_cache,
             cpu_fallback_max_px=base_services.cpu_fallback_max_px,
+            pin_device=(devices_for(i)[0] if devices_for(i)
+                        else None),
         )
         members.append(LocalMember(
-            f"m{i}", ImageRegionHandler(services), services=services,
-            down_cooldown_s=cooldown, byte_cache_prechecked=True))
+            f"m{i}",
+            ImageRegionHandler(services), services=services,
+            down_cooldown_s=cooldown, byte_cache_prechecked=True,
+            devices=devices_for(i)))
     return members

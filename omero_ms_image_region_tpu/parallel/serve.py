@@ -185,6 +185,15 @@ class _PodChannel:
 class MeshRenderer(BatchingRenderer):
     """Drop-in renderer serving every group through the sharded steps."""
 
+    # Mesh-sharded programs are topology-bound and run in SPMD
+    # lockstep across the whole mesh: this renderer must be its
+    # process's FIRST fleet member (the mesh/bulk lane), is never
+    # device-pinned narrower than its mesh, and federated builds
+    # (parallel.federation.build_federated_members) warn when the
+    # manifest order would pin fleet-wide bulk work to another host
+    # while this one holds the mesh.
+    lockstep = True
+
     def __init__(self, mesh: Mesh, max_batch: int | None = None,
                  linger_ms: float = 2.0, buckets=None,
                  jpeg_engine: str = "sparse", pipeline_depth: int = 4,

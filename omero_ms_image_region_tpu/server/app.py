@@ -441,6 +441,8 @@ def create_app(config: Optional[AppConfig] = None,
 
     fleet_router = None
     fleet_members: list = []
+    federation_coord = None
+    unit_lifecycle = None
     fleet_remote = (services is None and config.fleet.enabled
                     and config.fleet.sockets
                     and config.sidecar.role == "frontend")
@@ -560,7 +562,8 @@ def create_app(config: Optional[AppConfig] = None,
         injected = services is not None
         if services is None:
             services = build_services(config)
-        if (config.fleet.enabled and not injected
+        if ((config.fleet.enabled or config.federation.enabled)
+                and not injected
                 and config.sidecar.role == "combined"):
             # In-process device fleet: member 0 is the base stack
             # (the lockstep mesh lane in mesh deployments); members
@@ -571,19 +574,50 @@ def create_app(config: Optional[AppConfig] = None,
             from ..parallel.fleet import (FleetImageHandler,
                                           FleetRouter,
                                           build_local_members)
-            fleet_members = build_local_members(
-                config, services, config.fleet.members)
+            ring_seed = ""
+            wire_handoff = False
+            if config.federation.enabled:
+                # Cross-host federation (deploy/DEPLOY.md "Multi-host
+                # federation"): the member list comes from the agreed
+                # MANIFEST — members on this host build in-process
+                # with per-member device pinning, the rest are
+                # RemoteMember handles over their sidecar addresses.
+                # The ring seed/replicas ride the manifest, so every
+                # agreeing host computes identical shard assignments.
+                from ..parallel import federation as federation_mod
+                fed_manifest = federation_mod.FleetManifest \
+                    .from_config(config.federation)
+                federation_mod.install(fed_manifest)
+                fleet_members = federation_mod.build_federated_members(
+                    config, services, fed_manifest, _sidecar_client,
+                    config.federation.host)
+                ring_seed = fed_manifest.ring_seed
+                wire_handoff = True
+            else:
+                fed_manifest = None
+                fleet_members = build_local_members(
+                    config, services, config.fleet.members)
             fleet_router = FleetRouter(
                 fleet_members, lane_width=config.fleet.lane_width,
                 steal_min_backlog=config.fleet.steal_min_backlog,
-                hash_replicas=config.fleet.hash_replicas,
+                hash_replicas=(config.federation.hash_replicas
+                               if fed_manifest is not None
+                               else config.fleet.hash_replicas),
                 failover=config.fleet.failover,
                 qos_weight=(config.qos.interactive_weight
                             if config.qos.enabled else 0),
                 peer_fetch=(config.http_cache.enabled
                             and config.http_cache.peer_fetch),
                 peer_timeout_s=(
-                    config.http_cache.peer_timeout_ms / 1000.0))
+                    config.http_cache.peer_timeout_ms / 1000.0),
+                ring_seed=ring_seed, wire_handoff=wire_handoff)
+            if fed_manifest is not None:
+                from ..parallel.federation import FederationCoordinator
+                federation_coord = FederationCoordinator(
+                    fed_manifest, config.federation.host,
+                    fleet_router,
+                    gossip_interval_s=(
+                        config.federation.gossip_interval_s))
             single_flight = services.single_flight
             services.single_flight = None
             services.admission = None
@@ -604,8 +638,16 @@ def create_app(config: Optional[AppConfig] = None,
                 # will serve the request and never duplicates planes.
                 services.prefetcher.cache_for_route = \
                     fleet_router.cache_for_route
+                if federation_coord is not None:
+                    # Shard-aware prefetch, cross-host seam: a
+                    # predicted plane owned by a REMOTE member stages
+                    # on ITS owner's host (a prestage hint over the
+                    # wire) instead of this host's wrong shard.
+                    services.prefetcher.remote_prestage = \
+                        fleet_router.remote_prestage_for_route
                 for member in fleet_members[1:]:
-                    if member.services is not None:
+                    if getattr(member, "services", None) is not None \
+                            and member.services is not services:
                         member.services.prefetcher = \
                             services.prefetcher
             image_handler = FleetImageHandler(
@@ -685,20 +727,50 @@ def create_app(config: Optional[AppConfig] = None,
     # routine scale-down as an operator roll), scale-up undrains with
     # pre-stage-back.  Config validation already required a fleet.
     autoscaler = None
+    diurnal_estimator = None
     if config.autoscaler.enabled and fleet_router is not None:
         from .autoscaler import Autoscaler
 
         demand_source = None
         if config.autoscaler.lane_capacity_tps > 0 \
                 and config.sessions.enabled:
+            if config.autoscaler.diurnal_period_s > 0:
+                # Diurnal-phase demand prediction: a harmonic fit
+                # over OBSERVED request arrivals (fed by
+                # _finish_request below) scales the session-model
+                # demand by where "now + horizon" sits in the fitted
+                # day — the controller provisions for the demand a
+                # scale op completes INTO, not the demand at tick
+                # time.  Unfit (cold boot, flat day) multiplies by 1.
+                from ..services.loadmodel import DiurnalEstimator
+                diurnal_estimator = DiurnalEstimator(
+                    period_s=config.autoscaler.diurnal_period_s)
+
             # The session model's predicted demand: viewport-tracked
-            # live sessions x the calibrated per-session steady rate.
-            demand_source = (
-                lambda: telemetry.SESSIONS.tracked
-                * config.autoscaler.session_tps)
+            # live sessions x the calibrated per-session steady rate,
+            # diurnal-scaled when the estimator has a fit.
+            def demand_source() -> float:
+                demand = (telemetry.SESSIONS.tracked
+                          * config.autoscaler.session_tps)
+                if diurnal_estimator is not None:
+                    demand *= diurnal_estimator.multiplier(
+                        horizon_s=config.autoscaler.diurnal_horizon_s)
+                return demand
+        if config.autoscaler.unit_config and fleet_remote:
+            # Sidecar-unit process lifecycle: the autoscaler actually
+            # STOPS a parked member's process and RESTARTS it on
+            # scale-up, instead of parking warm pre-provisioned
+            # members (PR 13 follow-on).  Units spawn in the startup
+            # hook; /readyz holds traffic until their sockets accept.
+            from .sidecar import SidecarUnitLifecycle
+            unit_lifecycle = SidecarUnitLifecycle.for_config(
+                config.autoscaler.unit_config,
+                {m.name: sock for m, sock in
+                 zip(fleet_members, config.fleet.sockets)})
         autoscaler = Autoscaler(
             config.autoscaler, fleet_router, governor=governor,
             demand_source=demand_source,
+            lifecycle=unit_lifecycle,
             drain_kwargs={
                 "prestage": config.drain.prestage,
                 "max_planes": config.drain.prestage_max_planes,
@@ -1184,6 +1256,12 @@ def create_app(config: Optional[AppConfig] = None,
                                        exemplar=exemplar)
         telemetry.count_request(route, status)
         telemetry.SLO.record(status, total_ms)
+        if diurnal_estimator is not None:
+            # One observation per finished request: the arrival stream
+            # the diurnal demand fit regresses over (ns-scale bin
+            # bump; pay-for-what-you-use — None when prediction is
+            # off).
+            diurnal_estimator.observe()
         if status >= 500:
             telemetry.FLIGHT.record(
                 "request.error", route=route, status=status,
@@ -1664,10 +1742,16 @@ def create_app(config: Optional[AppConfig] = None,
             # convert chosen degradation into the overload collapse
             # the governor exists to prevent.
             checks["pressure"] = governor.summary()
+        if federation_coord is not None:
+            # Annotation only: disagreement with a peer host is loud
+            # on /admin/federation and the agreement counters; this
+            # process still serves its own shard either way.
+            checks["federation"] = federation_coord.summary()
         if (config.drain.fail_readyz and fleet_router is not None
                 and [n for n in fleet_router.draining_members()
                      if getattr(fleet_router.members[n],
-                                "drain_intent", None) != "autoscale"]):
+                                "drain_intent", None)
+                     not in ("autoscale", "gossip")]):
             # drain.fail-readyz: surface the roll to the LB — a
             # draining instance answers 503 so nginx/k8s pull it from
             # rotation until /admin/undrain (the default annotation-
@@ -1677,7 +1761,9 @@ def create_app(config: Optional[AppConfig] = None,
             # serve every shard, the controller undrains on demand)
             # so it annotates instead of pulling the instance — but
             # operator drains AND the SIGTERM quiesce (which flips
-            # draining with no intent) must keep pulling it.
+            # draining with no intent) must keep pulling it.  A
+            # "gossip" drain is ANOTHER host's roll reflected here:
+            # this instance still serves and must stay in rotation.
             ok = False
         if autoscaler is not None:
             # Annotation only, like the pressure line: fleet size is
@@ -1747,6 +1833,23 @@ def create_app(config: Optional[AppConfig] = None,
                  "error": "autoscaler requires autoscaler.enabled "
                           "and a fleet topology"}, status=400)
         return web.json_response(autoscaler.status())
+
+    async def admin_federation(request: web.Request) -> web.Response:
+        """Cross-host federation status (deploy/DEPLOY.md "Multi-host
+        federation"): the agreed manifest (epoch/digest/members), the
+        last agreement verdict per remote member, the last gossip
+        round's outcomes and the merged membership view.
+        ``?agree=1`` re-runs a (non-strict) agreement round first —
+        the operator's "did the fleet converge after my epoch bump"
+        probe."""
+        if federation_coord is None:
+            return web.json_response(
+                {"enabled": False,
+                 "error": "federation requires federation.enabled "
+                          "in the combined role"}, status=400)
+        if request.query.get("agree"):
+            await federation_coord.agree(strict=False)
+        return web.json_response(federation_coord.status())
 
     async def admin_undrain(request: web.Request) -> web.Response:
         """Rejoin a drained member (same remap bound as a ring join)."""
@@ -1826,6 +1929,11 @@ def create_app(config: Optional[AppConfig] = None,
         running loop, so they cannot start in create_app)."""
         import asyncio
         tasks = []
+        if unit_lifecycle is not None:
+            # Spawn every member's sidecar unit (blocking per unit
+            # until its socket accepts — off-loop); /readyz holds
+            # external traffic until the members answer their pings.
+            await asyncio.to_thread(unit_lifecycle.start_all)
         if governor is not None:
             tasks.append(asyncio.create_task(
                 governor.run(), name="pressure-governor"))
@@ -1835,6 +1943,14 @@ def create_app(config: Optional[AppConfig] = None,
         if autoscaler is not None:
             tasks.append(asyncio.create_task(
                 autoscaler.run(), name="autoscaler"))
+        if federation_coord is not None:
+            # Join the federation: one agreement round with every
+            # remote member (split-brain REFUSES the join — serving a
+            # forked shard map is the failure this subsystem exists
+            # to prevent), then the periodic gossip loop.
+            await federation_coord.agree(strict=True)
+            tasks.append(asyncio.create_task(
+                federation_coord.run(), name="federation-gossip"))
         app[_ROBUSTNESS_TASKS_KEY] = tasks
 
     app.on_startup.append(on_startup_robustness)
@@ -1878,6 +1994,7 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_post("/admin/drain", admin_drain)
     app.router.add_post("/admin/undrain", admin_undrain)
     app.router.add_get("/admin/autoscaler", admin_autoscaler)
+    app.router.add_get("/admin/federation", admin_federation)
     app.router.add_route("OPTIONS", "/{tail:.*}", details)
 
     async def on_cleanup(app):
@@ -1907,6 +2024,19 @@ def create_app(config: Optional[AppConfig] = None,
         if fleet_remote:
             for member in fleet_members:
                 await member.client.close()
+        elif federation_coord is not None:
+            # Federated combined role: the manifest's remote members
+            # carry their own wire clients.
+            from ..parallel import federation as federation_mod
+            for member in fleet_members:
+                if getattr(member, "remote", False):
+                    await member.client.close()
+            if federation_mod.current() is federation_coord.manifest:
+                federation_mod.uninstall()
+        if unit_lifecycle is not None:
+            # The frontend owns the unit processes it spawned: stop
+            # them on the deliberate shutdown path (no restart).
+            await _asyncio.to_thread(unit_lifecycle.stop_all)
         if proxy_mode:
             await client.close()
         db_meta = app.get("_db_metadata")
@@ -1917,10 +2047,12 @@ def create_app(config: Optional[AppConfig] = None,
             for member in fleet_members:
                 # Extra members' batchers (member 0's renderer is the
                 # base services' — closed below with the rest).
-                if (member.services is not None
-                        and member.services is not services
-                        and isinstance(member.services.renderer, _BR)):
-                    await member.services.renderer.close()
+                # Federated fleets mix in RemoteMembers: no services.
+                member_services = getattr(member, "services", None)
+                if (member_services is not None
+                        and member_services is not services
+                        and isinstance(member_services.renderer, _BR)):
+                    await member_services.renderer.close()
         if services is not None:
             if services.warmstate is not None:
                 # Stop the snapshot timer and abort any in-flight

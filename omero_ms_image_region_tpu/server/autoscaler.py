@@ -78,12 +78,23 @@ class Autoscaler:
                  demand_source: Optional[Callable[[], Optional[float]]]
                  = None,
                  drain_kwargs: Optional[dict] = None,
+                 lifecycle=None,
                  clock: Callable[[], float] = time.monotonic):
         self.config = config
         self.router = router
         self.governor = governor
         self.demand_source = demand_source
         self.drain_kwargs = dict(drain_kwargs or {})
+        # Sidecar-unit process lifecycle (server.sidecar
+        # SidecarUnitLifecycle duck type: sync ``stop(name)`` /
+        # ``start(name)``, both idempotent): with it, a scale-down
+        # actually STOPS the parked member's process once its drain
+        # settles (the shard handoff must finish first — the bytes
+        # live in that process), and a scale-up RESTARTS the unit
+        # (blocking until its socket accepts) BEFORE undraining, so
+        # routes never land on a dead socket.  None = the
+        # pre-provisioned posture (park/rejoin warm processes).
+        self.lifecycle = lifecycle
         self.clock = clock
         self._up_streak = 0
         self._down_streak = 0
@@ -258,6 +269,48 @@ class Autoscaler:
         else:
             return self._blocked("no-member", "up")
         name = self._scaled_down.pop()
+        if self.lifecycle is not None:
+            # Unit-managed member: restart its process FIRST (blocking
+            # spawn + socket wait, off-loop), undrain only once the
+            # socket accepts.  The reservation (popped above) and the
+            # transition record are taken synchronously on this tick,
+            # so concurrent ticks see the op in flight (blocked:busy).
+            async def _up() -> None:
+                try:
+                    await asyncio.to_thread(self.lifecycle.start, name)
+                except Exception:
+                    # Spawn failed: re-park the member for the next
+                    # attempt; it is still draining, still ours.
+                    log.warning("autoscale unit start of %s failed; "
+                                "re-parked", name, exc_info=True)
+                    self._scaled_down.append(name)
+                    return
+                member = self.router.members.get(name)
+                if member is not None and hasattr(member, "revive"):
+                    member.revive()
+                self.router.undrain_member(name)
+
+            if self._has_loop():
+                self._op = asyncio.get_running_loop().create_task(_up())
+            else:
+                # Sync caller with no loop: do the start + undrain
+                # INLINE (blocking is the sync caller's bargain) —
+                # discarding the coroutine would leak the member:
+                # stopped process, still draining, no longer parked.
+                self._op = None
+                try:
+                    self.lifecycle.start(name)
+                except Exception:
+                    log.warning("autoscale unit start of %s failed; "
+                                "re-parked", name, exc_info=True)
+                    self._scaled_down.append(name)
+                    return self._blocked("no-member", "up")
+                member = self.router.members.get(name)
+                if member is not None and hasattr(member, "revive"):
+                    member.revive()
+                self.router.undrain_member(name)
+            self._record("up", name, now, sig)
+            return "up"
         # undrain is synchronous (the pre-stage-back replay rides it
         # as a background task the router tracks).
         self.router.undrain_member(name)
@@ -301,6 +354,19 @@ class Autoscaler:
             except Exception:
                 log.warning("autoscale drain of %s failed", victim,
                             exc_info=True)
+                return
+            if self.lifecycle is not None:
+                # Drain settled and the shard handed off: stop the
+                # parked member's PROCESS — elasticity that releases
+                # real memory/devices, not a warm park.  Strictly
+                # after the handoff (the warm bytes live in that
+                # process until it finishes).
+                try:
+                    await asyncio.to_thread(self.lifecycle.stop,
+                                            victim)
+                except Exception:
+                    log.warning("autoscale unit stop of %s failed",
+                                victim, exc_info=True)
 
         if self._has_loop():
             self._op = asyncio.get_running_loop().create_task(_drain())

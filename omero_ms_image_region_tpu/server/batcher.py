@@ -255,6 +255,10 @@ class BatchingRenderer:
         # mesh-topology-bound and must stay on the pod's lockstep
         # compile path.
         self.exec_cache = None
+        # Per-member device pin (cross-host federation): group renders
+        # dispatch on this device when set (io.staging.pin_scope);
+        # None = the process default device.
+        self.device = None
         # Brownout ladder "cap_lanes" (server.pressure): while nonzero,
         # at most this many group renders run concurrently regardless
         # of pipeline_depth — the governor's bound on device-side
@@ -790,7 +794,8 @@ class BatchingRenderer:
         loaded_fn = (self.exec_cache.lookup("render_tile_batch_packed",
                                             args)
                      if self.exec_cache is not None else None)
-        with self._device_gate:
+        from ..io.staging import pin_scope
+        with self._device_gate, pin_scope(self.device):
             t0 = time.perf_counter()
             with stopwatch("Renderer.renderAsPackedInt.batch"):
                 if loaded_fn is not None:
@@ -865,7 +870,8 @@ class BatchingRenderer:
         raw, stack = self._stage_group(group)
         s0 = group[0].settings
         shape = _shape_label(raw.shape, jpeg=True)
-        with self._device_gate:
+        from ..io.staging import pin_scope
+        with self._device_gate, pin_scope(self.device):
             t0 = time.perf_counter()
             with stopwatch("Renderer.renderAsPackedInt.batch"):
                 jpegs = render_batch_to_jpeg(

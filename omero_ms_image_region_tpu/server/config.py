@@ -199,6 +199,41 @@ class FleetConfig:
 
 
 @dataclass
+class FederationConfig:
+    """Cross-host fleet federation (``parallel.federation``) — the
+    rack-scale Hazelcast analogue: the fleet's membership becomes a
+    VERSIONED MANIFEST every host carries identically (member names,
+    hosts, addresses, ring seed, shard epoch), agreed by digest at
+    join time over the ``manifest_hello`` wire op, gossiped for
+    cross-host drain/death propagation, with cross-host drains handing
+    warm HBM bytes over ``shard_transfer``.  See deploy/DEPLOY.md
+    "Multi-host federation"."""
+
+    enabled: bool = False
+    # This process's host identity — must name the ``host`` of at
+    # least one manifest member (those build in-process; the rest are
+    # reached over their addresses).
+    host: str = ""
+    # The SHARD EPOCH: bump it with every membership/ring change.
+    # Agreement is epoch-ordered — a peer carrying a higher epoch
+    # wins; equal epochs must match digest-exactly (split-brain is a
+    # refused join).
+    shard_epoch: int = 1
+    # Folded into every hash-ring point so two federations sharing
+    # member names can never share a key space.  "" keeps the
+    # single-host golden assignments bit-exact.
+    ring_seed: str = ""
+    # Virtual ring nodes per member (part of the agreed manifest).
+    hash_replicas: int = 64
+    # Seconds between membership gossip rounds.
+    gossip_interval_s: float = 5.0
+    # The full fleet-wide member list, in ring order: dicts of
+    # {name, host, address?} — address required for members other
+    # hosts must reach (unix socket path or host:port TCP).
+    members: Tuple[dict, ...] = ()
+
+
+@dataclass
 class ParallelConfig:
     """Mesh-sharded serving (≙ the reference's ``-cluster`` mode:
     Hazelcast-clustered worker verticles,
@@ -430,6 +465,25 @@ class AutoscalerConfig:
     # Predicted per-session steady request rate (requests/s) used to
     # turn viewport-tracked sessions into predicted demand.
     session_tps: float = 2.0
+    # Diurnal demand prediction (services.loadmodel.DiurnalEstimator):
+    # a single-tone harmonic fit over observed request arrivals scales
+    # the predicted demand by where "now + horizon" sits in the fitted
+    # day.  period-s 0 disables (flat prediction, the pre-PR-15
+    # behavior); horizon-s is how far ahead the multiplier looks —
+    # scale for the demand a drain/undrain completes INTO, not the
+    # demand at tick time.
+    diurnal_period_s: float = 86400.0
+    diurnal_horizon_s: float = 300.0
+    # Sidecar-unit process lifecycle (server.sidecar
+    # SidecarUnitLifecycle): with a config path here and a
+    # fleet.sockets topology, the FRONTEND spawns every member's
+    # sidecar unit itself at startup, and the autoscaler actually
+    # STOPS a parked member's process after its drain settles and
+    # RESTARTS it (waiting for its socket) before undraining on
+    # scale-up — elasticity that releases real memory/devices instead
+    # of parking warm processes.  "" = pre-provisioned members
+    # (operator-owned processes), the default.
+    unit_config: str = ""
 
 
 @dataclass
@@ -674,6 +728,8 @@ class AppConfig:
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    federation: FederationConfig = field(
+        default_factory=FederationConfig)
     sidecar: SidecarConfig = field(default_factory=SidecarConfig)
     wire: WireConfig = field(default_factory=WireConfig)
     persistence: PersistenceConfig = field(
@@ -910,6 +966,73 @@ class AppConfig:
             raise ValueError("fleet.hash-replicas must be >= 1")
         if cfg.fleet.down_cooldown_s < 0:
             raise ValueError("fleet.down-cooldown-s must be >= 0")
+        fe = raw.get("federation", {}) or {}
+        fe_defaults = FederationConfig()
+        members_raw = fe.get("members", ()) or ()
+        if not isinstance(members_raw, (list, tuple)):
+            raise ValueError("federation.members must be a list of "
+                             "{name, host, address?} entries")
+        fed_members = []
+        for i, m in enumerate(members_raw):
+            if not isinstance(m, dict) or not m.get("name") \
+                    or not m.get("host"):
+                raise ValueError(
+                    f"federation.members[{i}] must be a mapping with "
+                    f"at least name and host")
+            fed_members.append({
+                "name": str(m["name"]), "host": str(m["host"]),
+                "address": str(m.get("address") or "")})
+        cfg.federation = FederationConfig(
+            enabled=bool(fe.get("enabled", fe_defaults.enabled)),
+            host=str(fe.get("host", fe_defaults.host) or ""),
+            shard_epoch=int(fe.get("shard-epoch",
+                                   fe_defaults.shard_epoch)),
+            ring_seed=str(fe.get("ring-seed",
+                                 fe_defaults.ring_seed) or ""),
+            hash_replicas=int(fe.get("hash-replicas",
+                                     fe_defaults.hash_replicas)),
+            gossip_interval_s=float(fe.get(
+                "gossip-interval-s", fe_defaults.gossip_interval_s)),
+            members=tuple(fed_members),
+        )
+        if cfg.federation.shard_epoch < 1:
+            raise ValueError("federation.shard-epoch must be >= 1 "
+                             "(bump it with every membership change)")
+        if cfg.federation.hash_replicas < 1:
+            raise ValueError("federation.hash-replicas must be >= 1")
+        if cfg.federation.gossip_interval_s <= 0:
+            raise ValueError("federation.gossip-interval-s must be "
+                             "> 0")
+        if cfg.federation.enabled:
+            if len(cfg.federation.members) < 2:
+                raise ValueError("federation.enabled requires >= 2 "
+                                 "members (one host needs no "
+                                 "federation — use fleet.members)")
+            names = [m["name"] for m in cfg.federation.members]
+            if len(set(names)) != len(names):
+                raise ValueError("federation.members names must be "
+                                 "unique fleet-wide")
+            if not cfg.federation.host:
+                raise ValueError("federation.enabled requires "
+                                 "federation.host (this process's "
+                                 "host identity)")
+            hosts = {m["host"] for m in cfg.federation.members}
+            if cfg.federation.host not in hosts:
+                raise ValueError(
+                    f"federation.host {cfg.federation.host!r} owns no "
+                    f"manifest member (hosts: {sorted(hosts)})")
+            # NOTE: remote members' addresses are validated where the
+            # topology is actually built (build_federated_members —
+            # only a process that ROUTES needs to reach them; a
+            # passive sidecar member answering manifest_hello does
+            # not), so a member-process config may legally omit
+            # addresses it never dials.
+            if cfg.fleet.sockets:
+                raise ValueError(
+                    "federation.enabled and fleet.sockets are "
+                    "mutually exclusive — the manifest IS the "
+                    "membership; list remote members with addresses "
+                    "in federation.members instead")
         par = raw.get("parallel", {}) or {}
         par_defaults = ParallelConfig()
         cfg.parallel = ParallelConfig(
@@ -1059,6 +1182,12 @@ class AppConfig:
                 "lane-capacity-tps", au_defaults.lane_capacity_tps)),
             session_tps=float(au.get("session-tps",
                                      au_defaults.session_tps)),
+            diurnal_period_s=float(au.get(
+                "diurnal-period-s", au_defaults.diurnal_period_s)),
+            diurnal_horizon_s=float(au.get(
+                "diurnal-horizon-s", au_defaults.diurnal_horizon_s)),
+            unit_config=str(au.get("unit-config",
+                                   au_defaults.unit_config) or ""),
         )
         if cfg.autoscaler.interval_s <= 0:
             raise ValueError("autoscaler.interval-s must be > 0")
@@ -1085,14 +1214,27 @@ class AppConfig:
                              ">= 0 (0 disables the demand signal)")
         if cfg.autoscaler.session_tps <= 0:
             raise ValueError("autoscaler.session-tps must be > 0")
-        if cfg.autoscaler.enabled and not cfg.fleet.enabled:
+        if cfg.autoscaler.diurnal_period_s < 0:
+            raise ValueError("autoscaler.diurnal-period-s must be "
+                             ">= 0 (0 disables diurnal prediction)")
+        if cfg.autoscaler.diurnal_horizon_s < 0:
+            raise ValueError("autoscaler.diurnal-horizon-s must be "
+                             ">= 0")
+        if cfg.autoscaler.unit_config and not cfg.fleet.sockets:
+            raise ValueError(
+                "autoscaler.unit-config manages sidecar unit "
+                "processes — it requires the fleet.sockets topology")
+        if cfg.autoscaler.enabled and not (cfg.fleet.enabled
+                                           or cfg.federation.enabled):
             raise ValueError(
                 "autoscaler.enabled requires a fleet topology "
-                "(fleet.enabled) — there is nothing to scale "
-                "without members")
+                "(fleet.enabled or federation.enabled) — there is "
+                "nothing to scale without members")
         if cfg.autoscaler.enabled:
-            provisioned = (len(cfg.fleet.sockets)
-                           or cfg.fleet.members)
+            provisioned = (len(cfg.federation.members)
+                           if cfg.federation.enabled
+                           else (len(cfg.fleet.sockets)
+                                 or cfg.fleet.members))
             if cfg.autoscaler.floor > provisioned:
                 # An unachievable floor would block every scale-down
                 # forever (blocked:floor) — the bad-block-fails-at-

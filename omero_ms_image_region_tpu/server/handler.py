@@ -87,6 +87,10 @@ class Renderer:
                 f"unknown render kernel {kernel!r} ('xla' or 'pallas')")
         self.jpeg_engine = jpeg_engine
         self.kernel = kernel
+        # Per-member device pin (cross-host federation): when a fleet
+        # member owns a device set, its renders dispatch there instead
+        # of the process default device.  None = default device.
+        self.device = None
         # Compile guard for the pallas option: flips False forever on
         # the first compile/runtime failure (Mosaic layout limits vary
         # by backend generation), so the option can only remove work —
@@ -106,7 +110,16 @@ class Renderer:
 
     async def render(self, raw: np.ndarray, settings: dict) -> np.ndarray:
         """f32[C, H, W] + packed settings -> u32[H, W] packed RGBA."""
-        return await asyncio.to_thread(self._render_sync, raw, settings)
+        return await asyncio.to_thread(self._pinned, self._render_sync,
+                                       raw, settings)
+
+    def _pinned(self, fn, *args):
+        """Run one sync render under this member's device pin (the
+        worker thread's dispatches land on ``self.device``; None is a
+        straight call)."""
+        from ..io.staging import pin_scope
+        with pin_scope(self.device):
+            return fn(*args)
 
     def _pallas_eligible(self, settings: dict) -> bool:
         """Route to the pallas kernel?  Ramp-weight renders only (LUT
@@ -160,7 +173,8 @@ class Renderer:
         the SOF0 crop are handled here.
         """
         return await asyncio.to_thread(
-            self._render_jpeg_sync, raw, settings, quality, width, height)
+            self._pinned, self._render_jpeg_sync, raw, settings,
+            quality, width, height)
 
     def _render_jpeg_sync(self, raw, settings, quality, width, height):
         from ..flagship import batched_args
@@ -242,6 +256,11 @@ class ImageRegionServices:
     # served default comes from server.config.RendererConfig (256x256,
     # the measured break-even).
     cpu_fallback_max_px: int = 256 * 256
+    # This member's dispatch device (cross-host federation: the
+    # combined role partitions the host's devices across its members —
+    # parallel.federation.partition_local_devices).  None = the
+    # process default device, the pre-federation behavior.
+    pin_device: object = None
 
 
 from ..models.rendering import restrict_to_active \

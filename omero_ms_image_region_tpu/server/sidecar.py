@@ -374,6 +374,65 @@ async def _plane_put(image_handler, header: dict,
                        "resident": was_resident}).encode()
 
 
+async def _shard_transfer(image_handler, header: dict,
+                          req_body: bytes) -> bytes:
+    """Stage a cross-host drain handoff plane into THIS member's HBM
+    (``parallel.federation``): like :func:`_plane_put` — unauthenticated
+    socket, so the digest is VERIFIED before anything reaches the
+    cache — but the entry carries its full REGION identity and routing
+    key, so the plane lands restageable and drain-able exactly as if
+    this member had read it from its own store."""
+    import numpy as np
+
+    from ..io.devicecache import plane_digest, region_key
+
+    cache = getattr(getattr(image_handler, "s", None), "raw_cache",
+                    None)
+    if cache is None:
+        raise BadRequestError(
+            "device plane cache is disabled on this sidecar "
+            "(raw-cache.enabled)")
+    entry = header.get("entry")
+    if not isinstance(entry, dict):
+        raise BadRequestError("shard_transfer requires an entry doc")
+    digest = str(entry.get("digest") or "")
+    try:
+        image_id, z, t, level, region, channels = entry["key"]
+        key = region_key(int(image_id), int(z), int(t), int(level),
+                         tuple(int(v) for v in region),
+                         tuple(int(c) for c in channels))
+        dtype = np.dtype(str(entry["dtype"]))
+        shape = tuple(int(s) for s in entry["shape"])
+        if dtype.kind not in "uif":
+            raise ValueError(f"non-numeric dtype {dtype}")
+    except (KeyError, TypeError, ValueError) as e:
+        raise BadRequestError(f"malformed shard_transfer entry: {e}")
+    if not shape or any(s <= 0 for s in shape):
+        raise BadRequestError(f"shard_transfer shape {list(shape)} "
+                              f"must be all-positive")
+    expected = int(np.prod(shape)) * dtype.itemsize
+    if expected != len(req_body):
+        raise BadRequestError(
+            f"shard_transfer body is {len(req_body)} bytes, "
+            f"shape/dtype say {expected}")
+    arr = np.frombuffer(req_body, dtype).reshape(shape)
+    route = entry.get("route")
+
+    def stage_verified() -> str:
+        actual = plane_digest(arr)
+        if digest and digest != actual:
+            raise BadRequestError(
+                f"shard_transfer digest mismatch: claimed {digest}, "
+                f"content is {actual}")
+        cache.get_or_load(key, lambda: arr, digest=actual,
+                          route_key=(str(route) if route else None))
+        return actual
+
+    actual = await asyncio.to_thread(stage_verified)
+    telemetry.FEDERATION.count_transfer(len(req_body))
+    return json.dumps({"staged": True, "digest": actual}).encode()
+
+
 def _server_hello(header: dict, frames: FrameWriter, wire) -> tuple:
     """Negotiate the ``hello`` op server-side: attach the client's ring
     segments when offered (and enabled), answer the feature document.
@@ -745,6 +804,29 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                           if cache is not None and pixels is not None
                           else 0)
                 body = json.dumps({"staged": staged}).encode()
+            elif op == "manifest_hello":
+                # Cross-host federation, join time: compare the
+                # joiner's fleet manifest against this process's
+                # installed one (digest agreement, epoch-ordered
+                # adoption) and answer OUR ring owner for any probe
+                # keys — the cross-process golden-assignment check.
+                from ..parallel import federation
+                body = json.dumps(
+                    federation.handle_manifest_hello(header)).encode()
+            elif op == "member_gossip":
+                # Membership gossip: merge the sender's health view
+                # (newest observation per member wins), answer ours +
+                # the manifest identity so drift surfaces.
+                from ..parallel import federation
+                body = json.dumps(
+                    federation.handle_member_gossip(header)).encode()
+            elif op == "shard_transfer":
+                # Cross-host drain handoff: warm HBM plane BYTES from
+                # another host's draining member, staged here with
+                # their full region + routing identity.  State-changing
+                # like plane_put: digest-verified, never blind-retried.
+                body = await _shard_transfer(image_handler, header,
+                                             req_body)
             elif op == "explain":
                 # Dry-run residency probe (the /debug/explain plane):
                 # READ-ONLY by contract — no render, no admission, no
@@ -991,6 +1073,14 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
     services = build_services(config)
     if services_out is not None:
         services_out["services"] = services
+    if getattr(config, "federation", None) is not None \
+            and config.federation.enabled:
+        # Federated member process: install the manifest so the
+        # manifest_hello / member_gossip ops answer from this
+        # process's own copy of the agreed membership.
+        from ..parallel import federation
+        federation.install(
+            federation.FleetManifest.from_config(config.federation))
     db_metadata = None
     if config.metadata_backend == "postgres":
         from ..services.db_metadata import PostgresMetadataService
@@ -2426,3 +2516,120 @@ class SidecarSupervisor:
                 proc.wait(timeout=timeout_s)
             except Exception:
                 proc.kill()
+
+
+class SidecarUnit:
+    """One fleet member's sidecar PROCESS as a start/stoppable unit
+    (the autoscaler's process-lifecycle seam, PR 13 follow-on): where
+    the pre-provisioned posture parks a warm process, a unit-managed
+    member's scale-down terminates it — releasing its devices and
+    memory — and scale-up respawns it, blocking until the socket
+    accepts (the same readmission gate as the supervisor).
+
+    ``spawn_fn`` is injectable (the supervisor idiom) so the drill
+    supervises a cheap fake instead of a full device process.  Both
+    transitions are idempotent: stopping a stopped unit and starting
+    a live one are no-ops, so a retried scale op never double-spawns.
+    """
+
+    def __init__(self, name: str, spawn_fn):
+        self.name = name
+        self._spawn_fn = spawn_fn
+        self.proc = None
+        self.starts = 0
+        self.stops = 0
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def start(self) -> None:
+        """Spawn the unit (blocking until its socket accepts — the
+        spawn_fn's contract); no-op while the process lives."""
+        if self.alive():
+            return
+        self.proc = self._spawn_fn()
+        self.starts += 1
+        telemetry.FLIGHT.record("autoscale.unit-start",
+                                member=self.name)
+        logger.info("sidecar unit %s started (pid %s)", self.name,
+                    getattr(self.proc, "pid", None))
+
+    def stop(self, timeout_s: float = 15.0) -> None:
+        """Terminate the unit's process (SIGTERM — the sidecar's
+        shutdown chain snapshots warm state — escalating to kill past
+        ``timeout_s``); no-op when already stopped."""
+        proc = self.proc
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout_s)
+        except Exception:
+            proc.kill()
+        self.stops += 1
+        telemetry.FLIGHT.record("autoscale.unit-stop",
+                                member=self.name)
+        logger.info("sidecar unit %s stopped", self.name)
+
+
+class SidecarUnitLifecycle:
+    """The autoscaler's member-name -> :class:`SidecarUnit` map.
+
+    ``start(name)`` / ``stop(name)`` are the duck-typed hooks
+    ``server.autoscaler.Autoscaler(lifecycle=...)`` drives: stop runs
+    strictly AFTER the member's drain settled (its shard handoff needs
+    the live process), start runs strictly BEFORE the undrain (routes
+    must never land on a dead socket).  Unknown member names are
+    no-ops — operators may unit-manage only part of a fleet."""
+
+    def __init__(self, units: Dict[str, SidecarUnit]):
+        self.units = dict(units)
+
+    @classmethod
+    def for_config(cls, config_path: str,
+                   sockets_by_member: Dict[str, str]
+                   ) -> "SidecarUnitLifecycle":
+        """One unit per fleet member, all spawned from one sidecar
+        config (``autoscaler.unit-config``) with the member's socket
+        as ``--sidecar-socket`` — the frontend owns the unit
+        processes instead of an operator pre-provisioning them."""
+        return cls({
+            name: SidecarUnit(
+                name, lambda sock=sock: spawn_sidecar(config_path,
+                                                      sock))
+            for name, sock in sockets_by_member.items()})
+
+    def start(self, name: str) -> None:
+        unit = self.units.get(name)
+        if unit is not None:
+            unit.start()
+
+    def stop(self, name: str) -> None:
+        unit = self.units.get(name)
+        if unit is not None:
+            unit.stop()
+
+    def start_all(self) -> None:
+        """Spawn every unit CONCURRENTLY: each start() blocks until
+        its socket accepts (device init is tens of seconds), and the
+        units are independent processes — serially an 8-member fleet
+        would pay 8x one boot before /readyz could pass."""
+        import concurrent.futures as cf
+        units = list(self.units.values())
+        if len(units) <= 1:
+            for unit in units:
+                unit.start()
+            return
+        with cf.ThreadPoolExecutor(
+                max_workers=len(units),
+                thread_name_prefix="unit-start") as pool:
+            for fut in [pool.submit(u.start) for u in units]:
+                fut.result()
+
+    def stop_all(self) -> None:
+        for unit in self.units.values():
+            unit.stop()
+
+    def alive(self, name: str) -> bool:
+        unit = self.units.get(name)
+        return unit is not None and unit.alive()

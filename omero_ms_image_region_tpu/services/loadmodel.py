@@ -498,3 +498,183 @@ def find_knee(points: Sequence[dict], slo_ms: float,
         elif not ok:
             violated = True
     return knee, p99_at_knee, not violated
+
+
+# ----------------------------------------------------- diurnal estimate
+
+class DiurnalEstimator:
+    """Diurnal-phase demand estimate fitted from OBSERVED arrivals.
+
+    The autoscaler's demand signal (PR 13) was flat: viewport-tracked
+    sessions x a steady per-session rate — blind to WHERE in the day
+    the fleet sits, so a scale decision at the morning ramp provisions
+    for the quiet minute it was measured in.  This estimator closes
+    that follow-on: :meth:`observe` bins arrival timestamps (O(1) per
+    request, bounded ring of bins), and :meth:`fit` runs a single-tone
+    harmonic regression
+
+        ``rate(t) ~= a + b*sin(w t) + c*cos(w t)``,  ``w = 2*pi/T``
+
+    over the held bins — the closed-form least squares of the model's
+    own half-sine day (``LoadModel`` intensity ``1 + A sin(pi t/T)``
+    is exactly one half-period of a tone with period ``2T``, so the
+    fit recovers the generator's amplitude/phase; property-tested in
+    tests/test_loadmodel.py).  :meth:`multiplier` then answers
+    ``rate(now + horizon) / mean_rate`` clamped to a sane band — the
+    factor the autoscaler multiplies its session-demand estimate by,
+    so shrink decisions inside a rising ramp see the demand the
+    shrink completes INTO.
+
+    Deliberately conservative: unfit (too few bins, too little time
+    span, or a fitted amplitude within noise) multiplies by exactly
+    1.0 — the estimator can only ever ADD phase awareness, never
+    subtract the flat signal's floor.
+    """
+
+    #: Clamp band for the multiplier: a fit can at most quarter or
+    #: quadruple the flat demand estimate (a wild fit from a sparse
+    #: tape must not park the fleet or slam it to the ceiling).
+    MIN_MULT, MAX_MULT = 0.25, 4.0
+
+    def __init__(self, period_s: float = 86400.0,
+                 bin_s: Optional[float] = None,
+                 min_bins: int = 8,
+                 min_span_fraction: float = 0.25,
+                 clock: Callable[[], float] = time.time):
+        if period_s <= 0:
+            raise ValueError("diurnal period_s must be > 0")
+        self.period_s = float(period_s)
+        self.bin_s = float(bin_s) if bin_s else self.period_s / 48.0
+        if self.bin_s <= 0:
+            raise ValueError("diurnal bin_s must be > 0")
+        # Hold up to two periods of bins: enough span for a stable
+        # tone fit, bounded forever.
+        self.max_bins = max(int(min_bins),
+                            int(2 * self.period_s / self.bin_s) + 1)
+        self.min_bins = int(min_bins)
+        self.min_span_fraction = float(min_span_fraction)
+        self.clock = clock
+        # bin index -> count; insertion-ordered so eviction drops the
+        # oldest observation window first.
+        self._bins: "Dict[int, int]" = {}
+        self._fit: Optional[Tuple[float, float, float]] = None
+        self._fit_at_bin: Optional[int] = None
+
+    # ------------------------------------------------------- observation
+
+    def observe(self, t: Optional[float] = None) -> None:
+        """Record one arrival (ns-scale: one dict bump)."""
+        t = self.clock() if t is None else float(t)
+        b = int(t // self.bin_s)
+        if b in self._bins:
+            self._bins[b] += 1
+            return
+        self._bins[b] = 1
+        while len(self._bins) > self.max_bins:
+            self._bins.pop(next(iter(self._bins)))
+
+    # -------------------------------------------------------------- fit
+
+    def fit(self) -> Optional[Tuple[float, float, float]]:
+        """(a, b, c) of the harmonic regression over CLOSED bins (the
+        live bin is still filling — including it would read every
+        fresh bin as a demand cliff), or None when the tape is too
+        short.  Closed form via the 3x3 normal equations — no numpy,
+        this module stays import-light."""
+        now_bin = int(self.clock() // self.bin_s)
+        observed = [(b, n) for b, n in self._bins.items()
+                    if b < now_bin]
+        if len(observed) < self.min_bins:
+            return None
+        # The regression must see the TROUGH too: a bin inside the
+        # observed span that received no arrivals is a true zero-rate
+        # point, not a missing one — leaving it out regresses only
+        # over the busy phase and systematically flattens the fitted
+        # amplitude (the overnight blind spot this estimator exists
+        # to close).  Zero-filled across [oldest, newest] observed
+        # closed bins, bounded to the ring's own two periods.
+        last = min(max(b for b, _ in observed) + 1, now_bin)
+        first = max(min(b for b, _ in observed),
+                    last - self.max_bins)
+        closed = [(b, self._bins.get(b, 0))
+                  for b in range(first, last)]
+        span = len(closed) * self.bin_s
+        if span < self.min_span_fraction * self.period_s:
+            return None          # a flat sliver fits anything
+        w = 2.0 * math.pi / self.period_s
+        # Normal equations for y ~ a + b sin + c cos.
+        s = [[0.0] * 3 for _ in range(3)]
+        v = [0.0, 0.0, 0.0]
+        for b, n in closed:
+            t = (b + 0.5) * self.bin_s
+            row = (1.0, math.sin(w * t), math.cos(w * t))
+            y = n / self.bin_s          # rate, not count
+            for i in range(3):
+                v[i] += row[i] * y
+                for j in range(3):
+                    s[i][j] += row[i] * row[j]
+        # Gaussian elimination with partial pivoting (3x3).
+        m = [s[i] + [v[i]] for i in range(3)]
+        for col in range(3):
+            piv = max(range(col, 3), key=lambda r: abs(m[r][col]))
+            if abs(m[piv][col]) < 1e-12:
+                return None             # degenerate design (all bins
+                # at one phase): no tone is identifiable
+            m[col], m[piv] = m[piv], m[col]
+            for r in range(3):
+                if r == col:
+                    continue
+                f = m[r][col] / m[col][col]
+                for c in range(col, 4):
+                    m[r][c] -= f * m[col][c]
+        a, bb, cc = (m[i][3] / m[i][i] for i in range(3))
+        if a <= 0:
+            return None
+        self._fit = (a, bb, cc)
+        self._fit_at_bin = now_bin
+        return self._fit
+
+    @property
+    def amplitude(self) -> Optional[float]:
+        """Fitted relative amplitude sqrt(b^2+c^2)/a — comparable to
+        the LoadModel's ``diurnal_amplitude`` on a matching period."""
+        if self._fit is None:
+            return None
+        a, b, c = self._fit
+        return math.hypot(b, c) / a
+
+    @property
+    def phase_s(self) -> Optional[float]:
+        """Fitted phase offset in seconds: where the tone's upward
+        zero-crossing sits relative to t=0 of the clock."""
+        if self._fit is None:
+            return None
+        a, b, c = self._fit
+        w = 2.0 * math.pi / self.period_s
+        return math.atan2(c, b) / w
+
+    # -------------------------------------------------------- prediction
+
+    def multiplier(self, at: Optional[float] = None,
+                   horizon_s: float = 0.0) -> float:
+        """``rate(at + horizon) / mean_rate`` under the current fit,
+        clamped to [MIN_MULT, MAX_MULT]; exactly 1.0 while unfit.  The
+        fit is refreshed lazily at most once per closed bin."""
+        now_bin = int(self.clock() // self.bin_s)
+        if self._fit is None or self._fit_at_bin != now_bin:
+            self.fit()
+        if self._fit is None:
+            return 1.0
+        a, b, c = self._fit
+        t = (self.clock() if at is None else float(at)) \
+            + float(horizon_s)
+        w = 2.0 * math.pi / self.period_s
+        rate = a + b * math.sin(w * t) + c * math.cos(w * t)
+        if rate <= 0:
+            return self.MIN_MULT
+        return max(self.MIN_MULT, min(self.MAX_MULT, rate / a))
+
+    def reset(self) -> None:
+        self._bins.clear()
+        self._fit = None
+        self._fit_at_bin = None

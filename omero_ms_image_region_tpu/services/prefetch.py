@@ -89,6 +89,14 @@ class TilePrefetcher:
         # (None return = stage locally).  Installed by create_app for
         # combined fleets; absent everywhere else.
         self.cache_for_route = cache_for_route
+        # Cross-host seam (parallel.federation): when the predicted
+        # plane's ring owner is a REMOTE member,
+        # ``remote_prestage(route, entry) -> bool`` hints the owner's
+        # host to stage it from ITS pixel store (fire-and-forget wire
+        # op) — speculation warms the member that will serve the
+        # request, never this host's wrong shard.  Installed by
+        # create_app for federated fleets; absent everywhere else.
+        self.remote_prestage = None
         self.lookahead = max(1, int(lookahead))
         # Local budget scale in [0, 1]; multiplied with the pressure
         # governor's prefetch_budget().  The brownout ladder's
@@ -245,6 +253,19 @@ class TilePrefetcher:
                 routed = self.cache_for_route(route)
                 if routed is not None:
                     cache = routed
+                elif self.remote_prestage is not None:
+                    # No local cache owns this route: its ring owner
+                    # lives on another host — hand IT the prediction
+                    # (a prestage hint; the owner reads the region
+                    # from its own store through the digest-deduped
+                    # staging path) and spend nothing here.
+                    entry = {"key": [image_id, nz, nt, level,
+                                     list(region.as_tuple()),
+                                     list(active)],
+                             "route": route}
+                    if self.remote_prestage(route, entry):
+                        self.predicted += 1
+                        continue
             if cache is None or key in cache:
                 continue   # already resident: no pool churn
             with self._lock:

@@ -2016,6 +2016,109 @@ class LoadModelStats:
 LOADMODEL = LoadModelStats()
 
 
+class FederationStats:
+    """Cross-host federation accounting (``parallel.federation``): the
+    agreed manifest's version + member count, join-time agreement
+    outcomes, gossip-round outcomes, cross-host warm shard transfers
+    (the ``shard_transfer`` wire op, both directions counted where
+    they ship) and remote prestage hints fired by the shard-aware
+    prefetcher.  Both label sets reuse the closed ``reason``
+    vocabulary — :data:`AGREEMENT_REASONS` / :data:`GOSSIP_REASONS`
+    here, never caller-minted strings."""
+
+    AGREEMENT_REASONS = ("agreed", "pending", "stale", "split-brain",
+                         "unreachable", "legacy")
+    GOSSIP_REASONS = ("ok", "mismatch", "unreachable")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.manifest_version = 0
+        self.members = 0
+        self.agreements: Dict[str, int] = {}
+        self.gossip: Dict[str, int] = {}
+        self.shard_transfers = 0
+        self.transfer_bytes = 0
+        self.remote_prestage = 0
+
+    def set_manifest(self, version: int, members: int) -> None:
+        with self._lock:
+            self.manifest_version = int(version)
+            self.members = int(members)
+
+    def count_agreement(self, reason: str) -> None:
+        if reason not in self.AGREEMENT_REASONS:
+            reason = "unreachable"
+        with self._lock:
+            self.agreements[reason] = self.agreements.get(reason, 0) + 1
+
+    def count_gossip(self, reason: str) -> None:
+        if reason not in self.GOSSIP_REASONS:
+            reason = "unreachable"
+        with self._lock:
+            self.gossip[reason] = self.gossip.get(reason, 0) + 1
+
+    def count_transfer(self, nbytes: int) -> None:
+        with self._lock:
+            self.shard_transfers += 1
+            self.transfer_bytes += int(nbytes)
+
+    def count_remote_prestage(self, n: int = 1) -> None:
+        with self._lock:
+            self.remote_prestage += n
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            if not (self.manifest_version or self.agreements
+                    or self.gossip or self.shard_transfers
+                    or self.remote_prestage):
+                # Emit-when-live (the autoscaler posture): non-federated
+                # deployments keep their expositions — and the reset()
+                # contract — exact.
+                return []
+            lines = [
+                f"imageregion_federation_manifest_version{label()} "
+                f"{self.manifest_version}",
+                f"imageregion_federation_members{label()} "
+                f"{self.members}",
+                f"imageregion_federation_shard_transfers_total"
+                f"{label()} {self.shard_transfers}",
+                f"imageregion_federation_transfer_bytes_total"
+                f"{label()} {self.transfer_bytes}",
+                f"imageregion_federation_remote_prestage_total"
+                f"{label()} {self.remote_prestage}",
+            ]
+            for reason in sorted(self.agreements):
+                body = 'reason="%s"' % reason
+                lines.append(
+                    f"imageregion_federation_agreements_total"
+                    f"{label(body)} {self.agreements[reason]}")
+            for reason in sorted(self.gossip):
+                body = 'reason="%s"' % reason
+                lines.append(
+                    f"imageregion_federation_gossip_total"
+                    f"{label(body)} {self.gossip[reason]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.manifest_version = 0
+            self.members = 0
+            self.agreements.clear()
+            self.gossip.clear()
+            self.shard_transfers = 0
+            self.transfer_bytes = 0
+            self.remote_prestage = 0
+
+
+FEDERATION = FederationStats()
+
+
 class SessionStats:
     """Session-model accounting (``services.viewport`` +
     ``server.admission.SessionTokenBuckets``): how many distinct
@@ -2423,6 +2526,7 @@ def robustness_metric_lines(extra_labels: str = "") -> List[str]:
             + WATCHDOG.metric_lines(extra_labels)
             + DRAIN.metric_lines(extra_labels)
             + AUTOSCALER.metric_lines(extra_labels)
+            + FEDERATION.metric_lines(extra_labels)
             + session_metric_lines(extra_labels))
 
 
@@ -2649,6 +2753,16 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_loadmodel_completed_total": "counter",
     "imageregion_loadmodel_shed_total": "counter",
     "imageregion_loadmodel_late_fires_total": "counter",
+    # Cross-host fleet federation (parallel.federation): agreed
+    # manifest state, join-time agreement outcomes, gossip rounds,
+    # warm shard transfers over the wire, remote prestage hints.
+    "imageregion_federation_manifest_version": "gauge",
+    "imageregion_federation_members": "gauge",
+    "imageregion_federation_agreements_total": "counter",
+    "imageregion_federation_gossip_total": "counter",
+    "imageregion_federation_shard_transfers_total": "counter",
+    "imageregion_federation_transfer_bytes_total": "counter",
+    "imageregion_federation_remote_prestage_total": "counter",
     # Session-aware serving (services.viewport / services.prefetch /
     # server.admission token buckets / fleet QoS dequeue).
     "imageregion_session_tracked": "gauge",
@@ -2702,6 +2816,16 @@ METRIC_TYPES: Dict[str, str] = {
 # from the name; every family gets a HELP line (fallback text) so the
 # exposition lint can hold "HELP exactly once per family" everywhere.
 METRIC_HELP: Dict[str, str] = {
+    "imageregion_federation_manifest_version":
+        "Shard epoch of the agreed fleet manifest",
+    "imageregion_federation_agreements_total":
+        "Join-time manifest agreement outcomes by reason",
+    "imageregion_federation_gossip_total":
+        "Membership gossip round outcomes by reason",
+    "imageregion_federation_shard_transfers_total":
+        "Warm HBM planes shipped cross-host over shard_transfer",
+    "imageregion_federation_remote_prestage_total":
+        "Predicted-plane prestage hints sent to remote owners",
     "imageregion_request_cost_device_ms":
         "Per-request device-execute ms (pro-rata from batch group)",
     "imageregion_request_cost_read_ms":
@@ -3090,6 +3214,7 @@ def reset() -> None:
     DRAIN.reset()
     AUTOSCALER.reset()
     LOADMODEL.reset()
+    FEDERATION.reset()
     SESSIONS.reset()
     PREFETCH.reset()
     QOS.reset()

@@ -290,7 +290,14 @@ IDEMPOTENT_OPS = frozenset({"image", "mask", "ping", "metrics",
                             # plane_put it mutates cache state, and a
                             # blind re-send is wasted wire bytes at
                             # best; the caller decides.
-                            "byte_probe", "byte_fetch"})
+                            "byte_probe", "byte_fetch",
+                            # Cross-host federation: the manifest
+                            # exchange and the gossip swap are pure
+                            # state reads on both ends (merge is
+                            # newest-ts idempotent).  shard_transfer
+                            # is NOT here — it ships cache state, the
+                            # plane_put posture.
+                            "manifest_hello", "member_gossip"})
 
 
 class RetryPolicy:

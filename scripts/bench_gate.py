@@ -50,9 +50,15 @@ DEFAULT_KEYS = ("service_tiles_per_sec", "p50_service_tile_ms_ex_rtt",
 # --multichip: judge MULTICHIP_r*.json records on the fleet scaling
 # curve (__graft_entry__.fleet_scaling_curve prints it into the
 # driver's tail).  Rounds that predate the curve — every record that
-# only said `ok: true` — skip on null instead of failing.
+# only said `ok: true` — skip on null instead of failing.  The
+# multi-PROCESS federated keys (bench.py --smoke --federation: real
+# spawned sidecar processes behind an agreed manifest) joined the
+# family in PR 15 — rounds that predate them skip on null the same
+# way, so in-process-only history keeps judging.
 MULTICHIP_KEYS = ("fleet_tiles_per_sec_m8", "fleet_tiles_per_sec_m4",
-                  "fleet_scaling_efficiency")
+                  "fleet_scaling_efficiency",
+                  "fed_tiles_per_sec_p2",
+                  "fed_process_scaling_efficiency")
 # --sessions: judge SESSIONS_r*.json records (bench.py --smoke
 # --sessions) on the multi-user serving keys.  Direction-aware by
 # name: the per-session p99 is a ``_ms`` key (regresses UP), the

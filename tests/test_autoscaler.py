@@ -812,3 +812,152 @@ class TestQuiesceReadyzPosture:
                 await client.close()
 
         asyncio.run(scenario())
+
+
+# ------------------------------------------------ unit lifecycle drill
+
+class _FakeProc:
+    def __init__(self):
+        self.alive = True
+        self.terminated = 0
+        self.pid = 4242
+
+    def poll(self):
+        return None if self.alive else 0
+
+    def terminate(self):
+        self.terminated += 1
+        self.alive = False
+
+    def wait(self, timeout=None):
+        return 0
+
+    def kill(self):
+        self.alive = False
+
+
+class TestUnitLifecycle:
+    """PR 13 follow-on: the supervisor actually STOPS parked sidecar
+    units (after their drain settles — the warm handoff needs the
+    live process) and RESTARTS them before undrain on scale-up,
+    instead of parking pre-provisioned warm processes."""
+
+    def _lifecycle(self, names):
+        from omero_ms_image_region_tpu.server.sidecar import (
+            SidecarUnit, SidecarUnitLifecycle)
+        spawned = []
+
+        def spawn_fn():
+            proc = _FakeProc()
+            spawned.append(proc)
+            return proc
+
+        lc = SidecarUnitLifecycle(
+            {n: SidecarUnit(n, spawn_fn) for n in names})
+        return lc, spawned
+
+    def test_unit_start_stop_idempotent(self):
+        lc, spawned = self._lifecycle(["m0"])
+        lc.start("m0")
+        lc.start("m0")                      # no double spawn
+        assert len(spawned) == 1 and lc.alive("m0")
+        lc.stop("m0")
+        lc.stop("m0")                       # no double terminate
+        assert spawned[0].terminated == 1 and not lc.alive("m0")
+        lc.start("m0")                      # restart spawns fresh
+        assert len(spawned) == 2
+        lc.stop("unknown")                  # unknown member: no-op
+        assert telemetry.FLIGHT is not None
+
+    def test_drill_scale_down_stops_unit_scale_up_restarts_first(self):
+        """THE drill: park a member -> its drain completes -> its
+        PROCESS stops; demand returns -> the unit respawns and only
+        then does the member undrain (routes never land on a dead
+        socket).  Order is asserted through an event tape."""
+        async def main():
+            clock = _FakeClock()
+            router = _FakeRouter(3)
+            lc, spawned = self._lifecycle(router.order)
+            lc.start_all()
+            assert all(lc.alive(n) for n in router.order)
+            tape = []
+
+            real_drain = router.drain_member
+
+            async def drain_spy(name, **kw):
+                tape.append(("drain", name))
+                return await real_drain(name, **kw)
+
+            router.drain_member = drain_spy
+            real_undrain = router.undrain_member
+            router.undrain_member = \
+                lambda name: (tape.append(("undrain", name)),
+                              real_undrain(name))[1]
+
+            unit = lc.units["m2"]
+            real_stop, real_start = unit.stop, unit.start
+            unit.stop = lambda *a, **k: (tape.append(("stop", "m2")),
+                                         real_stop(*a, **k))[1]
+            unit.start = lambda: (tape.append(("start", "m2")),
+                                  real_start())[1]
+
+            scaler = Autoscaler(_config(), router, lifecycle=lc,
+                                clock=clock)
+            verdicts = await _ticks(scaler, 2)
+            assert verdicts[-1] == "down"
+            # The parked member's PROCESS is gone; the others live.
+            assert not lc.alive("m2")
+            assert lc.alive("m0") and lc.alive("m1")
+            assert tape == [("drain", "m2"), ("stop", "m2")]
+
+            clock.advance(31)
+            router.depth = 100              # lanes saturate: want up
+            verdict = (await _ticks(scaler, 2))[-1]
+            assert verdict == "up"
+            assert lc.alive("m2")           # respawned
+            assert not router.members["m2"].draining
+            # Start STRICTLY before undrain.
+            assert tape == [("drain", "m2"), ("stop", "m2"),
+                            ("start", "m2"), ("undrain", "m2")]
+            kinds = [e["kind"] for e in telemetry.FLIGHT.snapshot()]
+            assert "autoscale.unit-stop" in kinds
+            assert "autoscale.unit-start" in kinds
+
+        asyncio.run(main())
+
+    def test_failed_respawn_reparks_the_member_for_retry(self):
+        async def main():
+            clock = _FakeClock()
+            router = _FakeRouter(2)
+
+            from omero_ms_image_region_tpu.server.sidecar import (
+                SidecarUnit, SidecarUnitLifecycle)
+            attempts = []
+
+            def flaky_spawn():
+                attempts.append(1)
+                if len(attempts) < 3:
+                    raise RuntimeError("socket never appeared")
+                return _FakeProc()
+
+            lc = SidecarUnitLifecycle(
+                {"m1": SidecarUnit("m1", flaky_spawn)})
+            scaler = Autoscaler(_config(floor=1), router,
+                                lifecycle=lc, clock=clock)
+            assert (await _ticks(scaler, 2))[-1] == "down"
+            clock.advance(31)
+            router.depth = 100
+            # First up attempt: spawn fails, the member stays parked
+            # (draining, autoscale intent) and is retried later.
+            assert (await _ticks(scaler, 2))[-1] == "up"
+            assert router.members["m1"].draining
+            assert scaler._scaled_down == ["m1"]
+            clock.advance(31)
+            assert (await _ticks(scaler, 2))[-1] == "up"
+            assert router.members["m1"].draining          # failed again
+            clock.advance(31)
+            assert (await _ticks(scaler, 2))[-1] == "up"
+            assert not router.members["m1"].draining      # third's a charm
+            assert lc.alive("m1")
+
+        asyncio.run(main())

@@ -257,6 +257,15 @@ def test_bench_smoke_capacity(capsys):
         assert out["closedloop_p99_past_knee_ms"] is not None
         assert out["openloop_p99_past_knee_ms"] > \
             1.5 * out["closedloop_p99_past_knee_ms"], out
+        # Mask-class arrivals really ran (the committed synthetic
+        # fixtures under tests/data/masks through the real mask
+        # endpoint) and every offered mask completed — a broken
+        # fixture or mask path fails loudly here, never by silently
+        # thinning the measured mix.
+        assert out["capacity_mask_fraction"] > 0
+        assert out["capacity_mask_offered"] > 0, out
+        assert out["capacity_mask_completed"] == \
+            out["capacity_mask_offered"], out
 
         line = capsys.readouterr().out.strip().splitlines()[-1]
         assert json.loads(line)["metric"] == "capacity_smoke"

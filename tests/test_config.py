@@ -661,3 +661,114 @@ def test_autoscaler_block_parses_and_validates():
             "fleet": {"enabled": True, "members": 2},
             "autoscaler": {"enabled": True, "floor": 3,
                            "ceiling": 3}})
+
+
+def test_federation_block_parses_and_validates():
+    """The `federation:` block (cross-host fleet federation):
+    example-file defaults, full parse, and the manifest invariants —
+    unique names, a host that owns members, epoch >= 1, and mutual
+    exclusion with fleet.sockets (the manifest IS the membership)."""
+    from omero_ms_image_region_tpu.server.config import (
+        FederationConfig)
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = FederationConfig()
+    assert cfg.federation.enabled is False
+    assert cfg.federation.shard_epoch == defaults.shard_epoch
+    assert cfg.federation.gossip_interval_s \
+        == defaults.gossip_interval_s
+    # The example documents a full 2-host manifest.
+    assert len(cfg.federation.members) == 4
+
+    cfg = AppConfig.from_dict({"federation": {
+        "enabled": True, "host": "hostA", "shard-epoch": 7,
+        "ring-seed": "prod", "hash-replicas": 32,
+        "gossip-interval-s": 2.5,
+        "members": [
+            {"name": "a0", "host": "hostA"},
+            {"name": "b0", "host": "hostB", "address": "h:1"}]}})
+    assert cfg.federation.enabled is True
+    assert cfg.federation.shard_epoch == 7
+    assert cfg.federation.ring_seed == "prod"
+    assert cfg.federation.hash_replicas == 32
+    assert cfg.federation.gossip_interval_s == 2.5
+    assert cfg.federation.members[1]["address"] == "h:1"
+
+    with pytest.raises(ValueError, match="shard-epoch"):
+        AppConfig.from_dict({"federation": {"shard-epoch": 0}})
+    with pytest.raises(ValueError, match="gossip-interval-s"):
+        AppConfig.from_dict({"federation": {"gossip-interval-s": 0}})
+    with pytest.raises(ValueError, match=">= 2 members"):
+        AppConfig.from_dict({"federation": {
+            "enabled": True, "host": "h",
+            "members": [{"name": "a", "host": "h"}]}})
+    with pytest.raises(ValueError, match="unique"):
+        AppConfig.from_dict({"federation": {
+            "enabled": True, "host": "h",
+            "members": [{"name": "a", "host": "h"},
+                        {"name": "a", "host": "h2"}]}})
+    with pytest.raises(ValueError, match="federation.host"):
+        AppConfig.from_dict({"federation": {
+            "enabled": True,
+            "members": [{"name": "a", "host": "h"},
+                        {"name": "b", "host": "h2"}]}})
+    with pytest.raises(ValueError, match="owns no manifest member"):
+        AppConfig.from_dict({"federation": {
+            "enabled": True, "host": "elsewhere",
+            "members": [{"name": "a", "host": "h"},
+                        {"name": "b", "host": "h2"}]}})
+    with pytest.raises(ValueError, match="name and host"):
+        AppConfig.from_dict({"federation": {
+            "members": [{"name": "a"}]}})
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        AppConfig.from_dict({
+            "sidecar": {"role": "frontend"},
+            "fleet": {"enabled": True, "sockets": ["s0", "s1"]},
+            "federation": {
+                "enabled": True, "host": "h",
+                "members": [{"name": "a", "host": "h"},
+                            {"name": "b", "host": "h2",
+                             "address": "x:1"}]}})
+    # Federation counts as a fleet topology for the autoscaler, and
+    # its member list is the provisioned count the floor checks.
+    cfg = AppConfig.from_dict({
+        "federation": {
+            "enabled": True, "host": "h",
+            "members": [{"name": "a", "host": "h"},
+                        {"name": "b", "host": "h2",
+                         "address": "x:1"}]},
+        "autoscaler": {"enabled": True, "floor": 2, "ceiling": 2}})
+    assert cfg.autoscaler.enabled
+    with pytest.raises(ValueError, match="provisioned"):
+        AppConfig.from_dict({
+            "federation": {
+                "enabled": True, "host": "h",
+                "members": [{"name": "a", "host": "h"},
+                            {"name": "b", "host": "h2",
+                             "address": "x:1"}]},
+            "autoscaler": {"enabled": True, "floor": 3,
+                           "ceiling": 3}})
+
+
+def test_autoscaler_lifecycle_and_diurnal_knobs():
+    """PR 15 knobs: diurnal prediction bounds and the unit-config /
+    fleet.sockets coupling."""
+    cfg = AppConfig.from_dict({
+        "sidecar": {"role": "frontend"},
+        "fleet": {"enabled": True, "sockets": ["s0", "s1"]},
+        "autoscaler": {"enabled": True, "floor": 1,
+                       "diurnal-period-s": 3600.0,
+                       "diurnal-horizon-s": 120.0,
+                       "unit-config": "/etc/sidecar.yaml"}})
+    assert cfg.autoscaler.diurnal_period_s == 3600.0
+    assert cfg.autoscaler.diurnal_horizon_s == 120.0
+    assert cfg.autoscaler.unit_config == "/etc/sidecar.yaml"
+    with pytest.raises(ValueError, match="diurnal-period-s"):
+        AppConfig.from_dict({"autoscaler": {"diurnal-period-s": -1}})
+    with pytest.raises(ValueError, match="diurnal-horizon-s"):
+        AppConfig.from_dict({"autoscaler": {"diurnal-horizon-s": -1}})
+    with pytest.raises(ValueError, match="unit-config"):
+        AppConfig.from_dict({
+            "fleet": {"enabled": True, "members": 2},
+            "autoscaler": {"enabled": True,
+                           "unit-config": "/etc/sidecar.yaml"}})

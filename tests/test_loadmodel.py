@@ -290,3 +290,137 @@ class TestKnee:
         ]
         knee, _, _ = find_knee(points, slo_ms=240.0)
         assert knee == 10.0
+
+
+# ---------------------------------------------------- diurnal estimate
+
+class TestDiurnalEstimator:
+    """PR 13 follow-on: the autoscaler's demand prediction fed by a
+    diurnal-phase estimate fitted from observed arrivals —
+    property-tested against the load model's OWN half-sine day (the
+    intensity ``1 + A sin(pi t/T)`` is one half-period of a tone with
+    period 2T, so a correct harmonic fit must recover the generator's
+    amplitude and phase)."""
+
+    def _fit(self, amplitude, seed, viewers=2000, T=60.0,
+             starts_only=True):
+        from omero_ms_image_region_tpu.services.loadmodel import (
+            DiurnalEstimator, LoadModel)
+        model = LoadModel(viewers=viewers, seed=seed, duration_s=T,
+                          diurnal_amplitude=amplitude)
+        # Session STARTS follow the analytic half-sine exactly; the
+        # full request stream is that intensity CONVOLVED with session
+        # lifetimes (the estimator's production diet) — both are
+        # "observed arrivals", the starts leg is the clean analytic
+        # property, the full leg the monotonicity property.
+        ts = [a.t for a in model.iter_events()
+              if (a.step == 0 if starts_only else True)]
+        # Clock parked past the window so every bin is CLOSED.
+        est = DiurnalEstimator(period_s=2 * T, bin_s=T / 24.0,
+                               clock=lambda: 10 * T)
+        for t in ts:
+            est.observe(t)
+        assert est.fit() is not None, \
+            f"{len(ts)} arrivals must be fittable"
+        return est
+
+    def test_recovers_the_generators_amplitude_and_phase(self):
+        """Across seeds, fitting the model's session starts recovers
+        the configured diurnal amplitude and a phase near zero (the
+        model's day starts at the tone's upward zero-crossing)."""
+        for seed in (11, 29, 47):
+            est = self._fit(0.6, seed)
+            assert est.amplitude == pytest.approx(0.6, abs=0.2), \
+                (seed, est.amplitude)
+            # Phase within ~5% of the full period of t=0.
+            assert abs(est.phase_s) < 0.05 * est.period_s, \
+                (seed, est.phase_s)
+
+    def test_multiplier_tracks_the_true_intensity(self):
+        """The prediction the autoscaler multiplies by: at the diurnal
+        peak (t = T/2) the multiplier approximates (1+A)/1; in the
+        thin edges it sits near-or-below 1, and peak > edge."""
+        T = 60.0
+        est = self._fit(0.6, 31, T=T)
+        peak = est.multiplier(at=T / 2.0)
+        edge = est.multiplier(at=0.02 * T)
+        assert peak == pytest.approx(1.6, rel=0.2), peak
+        assert edge < 1.15
+        assert peak > edge
+
+    def test_full_request_stream_keeps_the_phase_ordering(self):
+        """On the FULL arrival stream (starts convolved with session
+        lifetimes — what production observes) the analytic amplitude
+        is no longer exact, but the properties the autoscaler relies
+        on must hold: a diurnal day fits a larger tone than a flat
+        one, and the peak multiplier exceeds the early edge's."""
+        diurnal = self._fit(0.6, 11, starts_only=False)
+        flat = self._fit(0.0, 11, starts_only=False)
+        assert diurnal.amplitude > flat.amplitude
+        assert diurnal.multiplier(at=30.0) > \
+            diurnal.multiplier(at=3.0)
+
+    def test_flat_arrivals_multiply_by_about_one(self):
+        est = self._fit(0.0, 13)
+        for t in (0.0, 20.0, 40.0, 55.0):
+            assert est.multiplier(at=t) == pytest.approx(1.0,
+                                                         abs=0.15)
+
+    def test_unfit_is_exactly_one(self):
+        from omero_ms_image_region_tpu.services.loadmodel import (
+            DiurnalEstimator)
+        est = DiurnalEstimator(period_s=120.0, bin_s=5.0,
+                               clock=lambda: 1000.0)
+        assert est.multiplier() == 1.0          # nothing observed
+        est.observe(10.0)
+        est.observe(12.0)
+        assert est.multiplier() == 1.0          # too few bins
+
+    def test_multiplier_is_clamped(self):
+        """A pathological tape (nearly all mass in one bin cluster)
+        cannot push the multiplier outside the safety band."""
+        from omero_ms_image_region_tpu.services.loadmodel import (
+            DiurnalEstimator)
+        est = DiurnalEstimator(period_s=100.0, bin_s=2.0,
+                               min_span_fraction=0.1,
+                               clock=lambda: 500.0)
+        for i in range(200):
+            est.observe(40.0 + (i % 5) * 2.0)   # spike bins
+        for t in (0.0, 10.0, 25.0, 44.0, 90.0):
+            m = est.multiplier(at=t)
+            assert est.MIN_MULT <= m <= est.MAX_MULT, (t, m)
+
+    def test_bounded_memory(self):
+        from omero_ms_image_region_tpu.services.loadmodel import (
+            DiurnalEstimator)
+        est = DiurnalEstimator(period_s=100.0, bin_s=1.0,
+                               clock=lambda: 0.0)
+        for t in range(10000):
+            est.observe(float(t))
+        assert len(est._bins) <= est.max_bins
+
+    def test_zero_traffic_bins_count_as_trough_points(self):
+        """A closed bin with NO arrivals inside the observed span is
+        a true zero-rate observation: leaving it out would regress
+        only over the busy phase and flatten the fitted amplitude
+        (the overnight blind spot).  Half the day busy, half silent
+        must fit a strong tone, not a near-flat one."""
+        from omero_ms_image_region_tpu.services.loadmodel import (
+            DiurnalEstimator)
+        period = 100.0
+        est = DiurnalEstimator(period_s=period, bin_s=2.0,
+                               clock=lambda: period)
+        # Arrivals only in the first half-period (the "day"); the
+        # second half is silent — no observe() calls at all.
+        import math as _math
+        for i in range(2000):
+            t = (i / 2000.0) * (period / 2.0)
+            est.observe(t)
+        # One observation near the end anchors the observed span so
+        # the silent gap is INTERIOR.
+        est.observe(period - 1.0)
+        assert est.fit() is not None
+        day = est.multiplier(at=period * 0.25)
+        night = est.multiplier(at=period * 0.75)
+        assert day > 1.3, (day, night)
+        assert night < 0.7, (day, night)

@@ -2057,7 +2057,18 @@ def bench_federation_smoke(grid: int = 3, tile_edge: int = 32,
     * **cross-host warm handoff** — draining the LOCAL member ships
       its HBM shard bytes over the ``shard_transfer`` wire op, and
       the remote process answers the digests resident
-      (``fed_drain_prestaged`` / ``fed_remote_resident``).
+      (``fed_drain_prestaged`` / ``fed_remote_resident``);
+    * **stitched control-plane forensics** — the gossip round and the
+      drain run inside ONE trace, producing a two-process waterfall
+      whose ``fed.hop`` spans are causally ordered and whose remote
+      stage grafts sit INSIDE their wire exchange's window after
+      per-host clock anchoring (``fed_trace_stitched``); an
+      autoscaler ticks against the live router until its ledger
+      verdicts carry MEASURED outcomes, and the local + remote
+      decision rings merge into one host-attributed timeline
+      (``decision_records`` / ``fed_decision_hosts``) — with a
+      renderer-span delta of ZERO across all forensics reads
+      (``forensics_render_delta``).
     """
     import asyncio
     import os
@@ -2103,7 +2114,7 @@ def bench_federation_smoke(grid: int = 3, tile_edge: int = 32,
             [federation.MemberSpec("a0", "hostA"),
              federation.MemberSpec("b0", "hostB", sock)],
             version=1, ring_seed="bench-fed")
-        federation.install(manifest)
+        federation.install(manifest, self_host="hostA")
         members = federation.build_federated_members(
             config, services, manifest, SidecarClient, "hostA")
         router = FleetRouter(members, lane_width=2,
@@ -2144,11 +2155,19 @@ def bench_federation_smoke(grid: int = 3, tile_edge: int = 32,
 
             # Cross-host warm handoff: the LOCAL member's HBM shard
             # ships over shard_transfer when it drains; the remote
-            # process must answer the digests resident.
+            # process must answer the digests resident.  The gossip
+            # round and the drain run inside ONE trace so the
+            # cross-host control plane leaves a stitched waterfall.
+            from omero_ms_image_region_tpu.utils import (
+                decisions, telemetry)
             local = router.members["a0"]
             digests = sorted(local.resident_digests())
-            doc = await router.drain_member("a0",
-                                            settle_timeout_s=5.0)
+            with telemetry.trace_scope("bench-fed-forensics") as trace:
+                await coord.gossip_once()
+                doc = await router.drain_member("a0",
+                                                settle_timeout_s=5.0)
+            spans = trace.export_spans()
+            telemetry.TRACES.finish("bench-fed-forensics")
             out["fed_drain_planes"] = doc["planes"]
             out["fed_drain_prestaged"] = doc["prestaged"]
             resident = 0
@@ -2162,6 +2181,94 @@ def bench_federation_smoke(grid: int = 3, tile_edge: int = 32,
                             bytes(body).decode()).get("resident", ()))
             out["fed_remote_resident"] = resident
             router.undrain_member("a0")
+
+            # --- stitched two-process waterfall: >=1 fed.hop span,
+            # host B's clock anchored, spans causally ordered, and
+            # every remote stage graft INSIDE its wire exchange's
+            # [send, recv] window (the clock-anchoring contract).
+            hops = sorted((s for s in spans if s["name"] == "fed.hop"),
+                          key=lambda s: s["start_ms"])
+            anchored = federation.host_clock_offset("hostB") is not None
+            eps = 0.5    # float rounding on exported ms offsets
+            # Causal: no hop starts before the trace began (a
+            # mis-anchored clock would fling a graft negative) and
+            # none has negative extent.
+            ordered = bool(hops) and all(
+                s["start_ms"] >= -eps and s["dur_ms"] >= 0.0
+                for s in hops)
+            wrappers = [s for s in hops
+                        if s.get("kind") == "shard_transfer"]
+            grafts = [s for s in hops if s.get("kind") == "stage"]
+            contained = all(any(
+                w["start_ms"] - eps <= g["start_ms"]
+                and (g["start_ms"] + g["dur_ms"]
+                     <= w["start_ms"] + w["dur_ms"] + eps)
+                for w in wrappers) for g in grafts)
+            out["fed_hop_spans"] = len(hops)
+            out["fed_hop_grafts"] = len(grafts)
+            out["fed_trace_stitched"] = int(
+                bool(hops) and anchored and ordered and contained)
+
+            # --- decision ledger: an autoscaler ticks against the
+            # live router (floor == active members, so the quiet
+            # queue wants "down" and the floor refuses it — one
+            # "blocked" verdict) until the outcome horizon attaches
+            # the MEASURED queue/member deltas; then the local and
+            # remote rings merge into one host-attributed timeline,
+            # with a renderer-span delta of ZERO for all of it.
+            from omero_ms_image_region_tpu.server.autoscaler import (
+                Autoscaler)
+            from omero_ms_image_region_tpu.server.config import (
+                AutoscalerConfig)
+            from omero_ms_image_region_tpu.utils.stopwatch import (
+                REGISTRY as span_reg)
+
+            def _renders() -> int:
+                snap = span_reg.snapshot()
+                return sum(snap.get(n, {}).get("count", 0) for n in
+                           ("Renderer.renderAsPackedInt",
+                            "Renderer.renderAsPackedInt.cpu",
+                            "Renderer.renderAsPackedInt.batch"))
+
+            renders_before = _renders()
+            fake_now = [0.0]
+            scaler = Autoscaler(
+                AutoscalerConfig(enabled=True, floor=2,
+                                 hold_ticks=1, cooldown_s=0.0),
+                router, clock=lambda: fake_now[0])
+            horizon = decisions.LEDGER.outcome_horizon_ticks
+            for _ in range(horizon + 2):
+                fake_now[0] += 1.0
+                scaler.tick()
+            local_ring = decisions.LEDGER.snapshot()
+            remote_ring = []
+            import json as _json
+            status, body = await members[1].client.call(
+                "decisions", {})
+            if status == 200 and body:
+                remote_ring = list(_json.loads(
+                    bytes(body).decode()).get("ring") or ())
+            merged = ([dict(r, host=r.get("host") or "hostA")
+                       for r in local_ring]
+                      + [dict(r, host=r.get("host") or "hostB")
+                         for r in remote_ring])
+            merged.sort(key=lambda r: r.get("ts", 0.0))
+            out["decision_records"] = sum(
+                1 for r in merged if r["kind"] == "autoscaler"
+                and "outcome" in r)
+            out["fed_decision_hosts"] = len(
+                {r["host"] for r in merged})
+            out["forensics_render_delta"] = _renders() - renders_before
+            assert out["fed_trace_stitched"] == 1, \
+                "cross-host waterfall failed to stitch: " \
+                f"hops={len(hops)} anchored={anchored} " \
+                f"ordered={ordered} contained={contained}"
+            assert out["decision_records"] >= 1, \
+                "no autoscaler decision carried a measured outcome"
+            assert out["fed_decision_hosts"] >= 2, \
+                "merged decision timeline is missing a host"
+            assert out["forensics_render_delta"] == 0, \
+                "forensics reads performed render work"
             return out
         finally:
             await router.close()

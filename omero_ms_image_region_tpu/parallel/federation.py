@@ -168,6 +168,15 @@ class FleetManifest:
     def names(self) -> List[str]:
         return [m.name for m in self.members]
 
+    def host_of(self, name: str) -> str:
+        """The host that owns the named member ("" when unknown) —
+        the ``host`` dimension on ``fed.hop`` spans and decision
+        records."""
+        for m in self.members:
+            if m.name == name:
+                return m.host
+        return ""
+
     def local_members(self, host: str) -> List[MemberSpec]:
         return [m for m in self.members if m.host == host]
 
@@ -197,14 +206,27 @@ class FleetManifest:
 # every status surface) and activates on the next process roll.
 _MANIFEST: Optional[FleetManifest] = None
 _PENDING: Optional[FleetManifest] = None
+# This process's federation host identity (``federation.host``):
+# stamped on hello/gossip answers so peers label clocks, spans and
+# decision records without a reverse manifest lookup.
+_SELF_HOST: str = ""
 
 
-def install(manifest: FleetManifest) -> None:
-    global _MANIFEST
+def install(manifest: FleetManifest,
+            self_host: Optional[str] = None) -> None:
+    global _MANIFEST, _SELF_HOST
     _MANIFEST = manifest
-    from ..utils import telemetry
+    from ..utils import decisions, telemetry
+    if self_host is not None:
+        _SELF_HOST = self_host
+        # Decision records from this process are now attributable in
+        # a merged fleet timeline.
+        decisions.LEDGER.configure(host=self_host)
     telemetry.FEDERATION.set_manifest(manifest.version,
                                       len(manifest.members))
+    decisions.record("epoch", "installed", detail={
+        "epoch": manifest.version, "digest": manifest.digest(),
+        "members": len(manifest.members)})
     logger.info("federation manifest installed: epoch %d, %d members, "
                 "digest %s", manifest.version, len(manifest.members),
                 manifest.digest())
@@ -212,6 +234,25 @@ def install(manifest: FleetManifest) -> None:
 
 def current() -> Optional[FleetManifest]:
     return _MANIFEST
+
+
+def self_host() -> str:
+    return _SELF_HOST
+
+
+def remote_host_of(name: str) -> str:
+    """The federation host of member ``name`` when it lives on a
+    DIFFERENT host than this process — "" for same-host members,
+    unknown names, or when no manifest is installed.  The gate the
+    router's ``fed.hop`` spans key on: a federation hop is cross-host
+    by definition, and single-host fleets must not pay for (or fake)
+    one."""
+    if _MANIFEST is None:
+        return ""
+    host = _MANIFEST.host_of(name)
+    if not host or host == _SELF_HOST:
+        return ""
+    return host
 
 
 def set_pending(manifest: FleetManifest) -> None:
@@ -222,6 +263,11 @@ def set_pending(manifest: FleetManifest) -> None:
     global _PENDING
     if _PENDING is None or manifest.version > _PENDING.version:
         _PENDING = manifest
+        from ..utils import decisions
+        decisions.record("epoch", "pending", detail={
+            "pending_epoch": manifest.version,
+            "pending_digest": manifest.digest(),
+            "active_epoch": _MANIFEST.version if _MANIFEST else None})
         logger.warning(
             "federation manifest epoch %d is pending (active epoch "
             "%s) — roll this process to activate it",
@@ -234,9 +280,78 @@ def pending() -> Optional[FleetManifest]:
 
 
 def uninstall() -> None:
-    global _MANIFEST, _PENDING
+    global _MANIFEST, _PENDING, _SELF_HOST
     _MANIFEST = None
     _PENDING = None
+    _SELF_HOST = ""
+    _HOST_CLOCKS.clear()
+
+
+# ----------------------------------------------------- cross-host clocks
+
+# host -> {"offset": local_perf - remote_perf, "rtt_ms", "ts"}.  The
+# same midpoint anchoring the sidecar ``hello`` does per connection,
+# lifted to per-HOST: every ``manifest_hello`` / ``member_gossip``
+# answer carries the peer's ``time.perf_counter()``, the caller takes
+# the send/recv midpoint as the instant that clock was read, and the
+# difference maps remote span anchors into this process's timeline.
+# Re-derived on every exchange, so drift is bounded by the gossip
+# interval.
+_HOST_CLOCKS: Dict[str, dict] = {}
+
+
+def record_host_clock(host: str, t_send: float, t_recv: float,
+                      remote_clock) -> Optional[float]:
+    """Derive and store the per-host clock offset from one exchange.
+    Returns the offset, or None when the peer answered without the
+    anchor field (an older build — callers degrade to unanchored
+    spans, never error)."""
+    if not host or remote_clock is None:
+        return None
+    try:
+        remote = float(remote_clock)
+    except (TypeError, ValueError):
+        return None
+    offset = (t_send + t_recv) / 2.0 - remote
+    _HOST_CLOCKS[str(host)[:64]] = {
+        "offset": offset,
+        "rtt_ms": round((t_recv - t_send) * 1000.0, 3),
+        "ts": time.time(),
+    }
+    return offset
+
+
+def host_clock_offset(host: str) -> Optional[float]:
+    doc = _HOST_CLOCKS.get(host)
+    return doc["offset"] if doc else None
+
+
+def host_clocks() -> Dict[str, dict]:
+    return {k: dict(v) for k, v in _HOST_CLOCKS.items()}
+
+
+def anchor_remote_time(host: str, remote_t,
+                       window: Tuple[float, float]) -> Optional[float]:
+    """Map a remote ``perf_counter`` instant into this process's
+    timeline, CLAMPED into ``window`` (the local [send, recv] bracket
+    of the exchange that carried it) — the sidecar ``_graft_response``
+    contract: a skewed or stale offset may place the child oddly
+    WITHIN its parent's window, never outside it.  None when the host
+    has no derived offset (unanchored degrade)."""
+    off = host_clock_offset(host)
+    if off is None or remote_t is None:
+        return None
+    try:
+        t = float(remote_t) + off
+    except (TypeError, ValueError):
+        return None
+    lo, hi = window
+    return min(max(t, lo), hi)
+
+
+def reset_clocks() -> None:
+    """Test isolation."""
+    _HOST_CLOCKS.clear()
 
 
 # ------------------------------------------------------ wire-op handlers
@@ -251,7 +366,7 @@ def handle_manifest_hello(header: dict) -> dict:
     No manifest installed = a legacy / un-federated process: answers
     ``{"enabled": false}`` and the coordinator degrades (counts
     ``legacy``, serves without federation features on that peer)."""
-    from ..utils import telemetry
+    from ..utils import decisions, telemetry
     mine = _MANIFEST
     if mine is None:
         return {"enabled": False}
@@ -259,6 +374,11 @@ def handle_manifest_hello(header: dict) -> dict:
         "enabled": True,
         "version": mine.version,
         "digest": mine.digest(),
+        # Clock anchor (the sidecar ``hello`` idiom, per HOST): the
+        # caller midpoints its send/recv around this read and derives
+        # the offset that grafts our spans onto its waterfalls.
+        "clock": time.perf_counter(),
+        "host": _SELF_HOST,
     }
     theirs_doc = header.get("manifest")
     if isinstance(theirs_doc, dict):
@@ -270,9 +390,13 @@ def handle_manifest_hello(header: dict) -> dict:
             doc["agreed"] = False
             doc["reason"] = "malformed"
             telemetry.FEDERATION.count_agreement("split-brain")
+            decisions.record("manifest", "split-brain",
+                             detail={"reason": "malformed"})
         elif theirs.digest() == mine.digest():
             doc["agreed"] = True
             telemetry.FEDERATION.count_agreement("agreed")
+            decisions.record("manifest", "agreed",
+                             detail={"epoch": mine.version})
         elif theirs.version > mine.version:
             # The joiner carries a NEWER shard epoch: a rolling config
             # update reached it first.  Record it PENDING — this
@@ -285,6 +409,9 @@ def handle_manifest_hello(header: dict) -> dict:
             doc["reason"] = "pending"
             doc["pending_version"] = theirs.version
             telemetry.FEDERATION.count_agreement("pending")
+            decisions.record("manifest", "pending", detail={
+                "epoch": mine.version,
+                "pending_epoch": theirs.version})
         elif theirs.version < mine.version:
             # The joiner is behind: send ours so IT records the
             # pending epoch and its operator rolls it.
@@ -292,10 +419,15 @@ def handle_manifest_hello(header: dict) -> dict:
             doc["reason"] = "stale-epoch"
             doc["manifest"] = mine.to_json()
             telemetry.FEDERATION.count_agreement("stale")
+            decisions.record("manifest", "stale", detail={
+                "epoch": mine.version,
+                "joiner_epoch": theirs.version})
         else:
             doc["agreed"] = False
             doc["reason"] = "split-brain"
             telemetry.FEDERATION.count_agreement("split-brain")
+            decisions.record("manifest", "split-brain",
+                             detail={"epoch": mine.version})
     probe_keys = header.get("probe_keys")
     if isinstance(probe_keys, list) and probe_keys:
         doc["owners"] = mine.owners([str(k) for k in probe_keys[:64]])
@@ -369,13 +501,23 @@ def merge_view(view: dict) -> Dict[str, dict]:
 def handle_member_gossip(header: dict) -> dict:
     """Server side of ``member_gossip``: merge the sender's view, answer
     ours + the manifest identity (drift between gossiping peers is a
-    mismatch the coordinator surfaces)."""
+    mismatch the coordinator surfaces).  The answer also carries this
+    host's clock anchor (re-derived offset every round — reconnect
+    recovery for free) and its ``SloEngine`` window buckets, so the
+    gossip wire doubles as the fleet-SLO export path with no extra
+    round trips."""
+    from ..utils import telemetry
     mine = _MANIFEST
     merged = merge_view(header.get("view") or {})
-    doc = {"enabled": mine is not None, "view": merged}
+    doc: dict = {"enabled": mine is not None, "view": merged}
     if mine is not None:
         doc["version"] = mine.version
         doc["digest"] = mine.digest()
+        doc["clock"] = time.perf_counter()
+        doc["host"] = _SELF_HOST
+        slo = telemetry.SLO.export_buckets()
+        if slo:
+            doc["slo"] = slo
     return doc
 
 
@@ -525,8 +667,21 @@ class FederationCoordinator:
         my_owners = self.manifest.owners(list(PROBE_KEYS))
         verdicts: Dict[str, str] = {}
         for member in self._remote_handles():
+            host = self.manifest.host_of(member.name)
+            t_send = time.perf_counter()
             resp = await member.manifest_hello(
                 doc, probe_keys=list(PROBE_KEYS))
+            t_recv = time.perf_counter()
+            telemetry.record_span(
+                "fed.hop", t_send, (t_recv - t_send) * 1000.0,
+                host=host, member=member.name, kind="hello")
+            if isinstance(resp, dict):
+                # Per-host clock anchor from the send/recv midpoint —
+                # the sidecar hello idiom.  A peer without the field
+                # (older build) simply derives no offset: its spans
+                # stay unanchored, nothing errors.
+                record_host_clock(resp.get("host") or host,
+                                  t_send, t_recv, resp.get("clock"))
             if resp is None:
                 verdicts[member.name] = "unreachable"
                 telemetry.FEDERATION.count_agreement("unreachable")
@@ -576,6 +731,11 @@ class FederationCoordinator:
             else:
                 verdicts[member.name] = "split-brain"
                 telemetry.FEDERATION.count_agreement("split-brain")
+        from ..utils import decisions
+        for name, verdict in verdicts.items():
+            decisions.record("manifest", verdict, member=name, detail={
+                "host": self.manifest.host_of(name),
+                "epoch": self.manifest.version})
         self.agreement = verdicts
         split = [n for n, v in verdicts.items() if v == "split-brain"]
         if split and strict:
@@ -597,8 +757,25 @@ class FederationCoordinator:
         merge_view(view)
         outcome: Dict[str, str] = {}
         my_digest = self.manifest.digest()
+        # Our own host's window buckets join the fleet aggregate the
+        # same way every peer's do — one ingest path, no special case.
+        telemetry.FED_SLO.ingest(self.self_host,
+                                 telemetry.SLO.export_buckets())
         for member in self._remote_handles():
+            host = self.manifest.host_of(member.name)
+            t_send = time.perf_counter()
             resp = await member.member_gossip(view)
+            t_recv = time.perf_counter()
+            telemetry.record_span(
+                "fed.hop", t_send, (t_recv - t_send) * 1000.0,
+                host=host, member=member.name, kind="gossip")
+            if isinstance(resp, dict):
+                # Re-derive the per-host clock anchor every round:
+                # reconnects and drift heal within one interval.
+                record_host_clock(resp.get("host") or host,
+                                  t_send, t_recv, resp.get("clock"))
+                telemetry.FED_SLO.ingest(resp.get("host") or host,
+                                         resp.get("slo"))
             if resp is None or not resp.get("enabled", True):
                 outcome[member.name] = "unreachable"
                 telemetry.FEDERATION.count_gossip("unreachable")
@@ -624,6 +801,16 @@ class FederationCoordinator:
             self._apply_remote_view(merged)
             outcome[member.name] = "ok"
             telemetry.FEDERATION.count_gossip("ok")
+        from ..utils import decisions
+        for name, verdict in outcome.items():
+            if self.last_gossip.get(name) != verdict:
+                # Convergence TRANSITIONS only (the flight-ring
+                # posture): a steady fleet gossips every few seconds
+                # and must not churn the ledger ring with "still ok".
+                decisions.record("gossip", verdict, member=name,
+                                 detail={
+                                     "host": self.manifest.host_of(
+                                         name)})
         self.last_gossip = outcome
         return outcome
 
@@ -673,6 +860,7 @@ class FederationCoordinator:
             "agreement": dict(self.agreement),
             "gossip": dict(self.last_gossip),
             "view": dict(_GOSSIP_VIEW),
+            "clocks": host_clocks(),
         }
         pend = pending()
         if pend is not None and pend.version > self.manifest.version:

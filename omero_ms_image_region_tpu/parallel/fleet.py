@@ -449,6 +449,23 @@ class RemoteMember:
     def revive(self) -> None:
         self._down_until = 0.0
 
+    def _fed_span(self, kind: str, t0: float, t1: float,
+                  **meta) -> None:
+        """One ``fed.hop`` span per cross-HOST wire exchange: {host,
+        member, kind} names where the hop landed and why.  Gated on
+        ``federation.remote_host_of`` — same-host members (and
+        un-federated fleets) record nothing — and ``record_span`` is
+        a no-op outside a trace context, so production gossip/drain
+        loops pay nothing for it."""
+        from . import federation
+        from ..utils import telemetry
+        host = federation.remote_host_of(self.name)
+        if not host:
+            return
+        telemetry.record_span("fed.hop", t0, (t1 - t0) * 1000.0,
+                              host=host, member=self.name,
+                              kind=kind, **meta)
+
     async def render(self, ctx, adopt_cache: bool = True) -> bytes:
         from ..server.sidecar import _map_response
         from ..utils import provenance
@@ -499,8 +516,12 @@ class RemoteMember:
             # contract as the `image` op.
             extra["image_id"] = int(image_id)
             extra["session"] = session
+        t0 = time.perf_counter()
         resp_header, payload = await self.client.call_full(
             "byte_fetch", {}, extra=extra)
+        self._fed_span("byte_fetch", t0, time.perf_counter(),
+                       hit=int(resp_header.get("status") == 200
+                               and payload is not None))
         if resp_header.get("status") != 200 or payload is None:
             return None
         return bytes(payload)
@@ -510,9 +531,12 @@ class RemoteMember:
         try:
             digest = _hashlib.blake2b(bytes(value),
                                       digest_size=16).hexdigest()
+            t0 = time.perf_counter()
             status, _body = await self.client.call(
                 "byte_put", {}, body=bytes(value),
                 extra={"key": str(key), "digest": digest})
+            self._fed_span("byte_put", t0, time.perf_counter(),
+                           bytes=len(value))
             return status == 200
         except Exception:
             return False
@@ -560,8 +584,11 @@ class RemoteMember:
         store and stages them into its HBM shard."""
         import json as _json
         try:
+            t0 = time.perf_counter()
             status, body = await self.client.call(
                 "prestage", {}, extra={"entries": entries})
+            self._fed_span("remote_prestage", t0, time.perf_counter(),
+                           entries=len(entries))
             if status != 200 or not body:
                 return 0
             return int(_json.loads(bytes(body).decode())
@@ -619,6 +646,7 @@ class RemoteMember:
         per entry; a failed ship is a cold miss later, never a failed
         drain."""
         import json as _json
+        from . import federation
         staged = 0
         for entry in entries:
             payload = entry.get("bytes")
@@ -627,17 +655,43 @@ class RemoteMember:
             meta = {k: entry.get(k) for k in
                     ("key", "digest", "route", "dtype", "shape")}
             try:
+                t_send = time.perf_counter()
                 status, body = await self.client.call(
                     "shard_transfer", {}, body=bytes(payload),
                     extra={"entry": meta})
-                if status == 200 and body and _json.loads(
-                        bytes(body).decode()).get("staged"):
+                t_recv = time.perf_counter()
+                doc = (_json.loads(bytes(body).decode())
+                       if status == 200 and body else {})
+                self._fed_span("shard_transfer", t_send, t_recv,
+                               bytes=len(payload),
+                               staged=int(bool(doc.get("staged"))))
+                if doc.get("staged"):
                     staged += 1
                     # Counted HERE, per ship that actually landed —
                     # the bytes of failed entries never reach the
                     # transfer gauge.
                     from ..utils import telemetry
                     telemetry.FEDERATION.count_transfer(len(payload))
+                    # Remote-side graft: the serving sidecar anchors
+                    # its stage work (t_anchor on ITS perf clock, ms)
+                    # and the per-host offset from the hello/gossip
+                    # exchanges maps it into OUR timeline, clamped
+                    # into this call's [send, recv] bracket.  Peers
+                    # answering without the anchor fields (older
+                    # builds, no derived offset yet) degrade to the
+                    # wrapper span alone — never an error.
+                    host = federation.remote_host_of(self.name)
+                    anchored = federation.anchor_remote_time(
+                        doc.get("host") or host, doc.get("t_anchor"),
+                        (t_send, t_recv)) if host else None
+                    if anchored is not None:
+                        dur = max(0.0, min(
+                            float(doc.get("ms") or 0.0),
+                            (t_recv - anchored) * 1000.0))
+                        telemetry.record_span(
+                            "fed.hop", anchored, dur,
+                            host=doc.get("host") or host,
+                            member=self.name, kind="stage")
             except Exception:
                 continue
         return staged
@@ -1012,7 +1066,7 @@ class FleetRouter:
         Idempotent: draining an already-draining member just re-runs
         the settle + handoff."""
         import time as _time
-        from ..utils import telemetry
+        from ..utils import decisions, telemetry
 
         if name not in self.members:
             raise KeyError(f"unknown fleet member {name!r}")
@@ -1058,6 +1112,14 @@ class FleetRouter:
         logger.info("fleet member %s drained (settled=%s, %d shard "
                     "planes, %d pre-staged on successors)", name,
                     settled, len(manifest), prestaged)
+        # Ledger verdict: "failed" means the settle window expired
+        # with work still in flight — the drain completed anyway, but
+        # the controller's intent (interrupt nothing) did not hold.
+        decisions.record("drain", "done" if settled else "failed",
+                         member=name, detail={
+                             "intent": intent, "settled": settled,
+                             "planes": len(manifest),
+                             "prestaged": prestaged})
         return {"member": name, "settled": settled, "intent": intent,
                 "planes": len(manifest), "prestaged": prestaged}
 
@@ -1077,7 +1139,9 @@ class FleetRouter:
                     by_successor.setdefault(candidate,
                                             []).append(entry)
                     break
+        from ..utils import decisions
         staged = 0
+        failed = 0
         draining_member = self.members[draining]
         # Cross-host warm handoff: a LOCAL drainer's HBM bytes ship
         # over the wire to REMOTE successors (their host cannot
@@ -1113,8 +1177,14 @@ class FleetRouter:
                 else:
                     staged += await member.prestage_manifest(entries)
             except Exception:
+                failed += 1
                 logger.warning("drain handoff to %s failed",
                                successor, exc_info=True)
+        decisions.record("handoff", "failed" if failed else "done",
+                         member=draining, detail={
+                             "planes": len(manifest), "staged": staged,
+                             "successors": len(by_successor),
+                             "failed_successors": failed})
         return staged
 
     def undrain_member(self, name: str,
@@ -1131,7 +1201,7 @@ class FleetRouter:
         avoid.  Background + best-effort (the member serves either
         way); the task is exposed as ``last_undrain_prestage`` so the
         drill (and a scripted roll) can await completion."""
-        from ..utils import telemetry
+        from ..utils import decisions, telemetry
         if name not in self.members:
             raise KeyError(f"unknown fleet member {name!r}")
         member = self.members[name]
@@ -1140,6 +1210,9 @@ class FleetRouter:
         telemetry.DRAIN.set_state(name, "active")
         telemetry.FLIGHT.record("drain.phase", member=name,
                                 phase="undrained")
+        decisions.record("undrain", "done", member=name, detail={
+            "prestage_back": bool(prestage_back
+                                  and self._drain_manifests.get(name))})
         entries = self._drain_manifests.pop(name, None)
         self.last_undrain_prestage = None
         if prestage_back and entries:

@@ -439,6 +439,16 @@ def create_app(config: Optional[AppConfig] = None,
         breach_burn_rate=config.slo.breach_burn_rate,
         on_breach=_on_slo_breach)
 
+    # Control-plane decision ledger (``decisions:`` config block):
+    # autoscaler verdicts, epoch rolls, manifest agreement, gossip
+    # convergence and drain lifecycle land in one bounded ring
+    # (/debug/decisions) + optional JSONL spool.
+    from ..utils import decisions as decisions_mod
+    decisions_mod.LEDGER.configure(
+        ring_size=config.decisions.ring_size,
+        spool_dir=config.decisions.spool_dir or None,
+        outcome_horizon_ticks=config.decisions.outcome_horizon_ticks)
+
     fleet_router = None
     fleet_members: list = []
     federation_coord = None
@@ -587,7 +597,8 @@ def create_app(config: Optional[AppConfig] = None,
                 from ..parallel import federation as federation_mod
                 fed_manifest = federation_mod.FleetManifest \
                     .from_config(config.federation)
-                federation_mod.install(fed_manifest)
+                federation_mod.install(fed_manifest,
+                                       self_host=config.federation.host)
                 fleet_members = federation_mod.build_federated_members(
                     config, services, fed_manifest, _sidecar_client,
                     config.federation.host)
@@ -1502,6 +1513,65 @@ def create_app(config: Optional[AppConfig] = None,
                 config.telemetry.flight_recorder_dir, "manual")
         return web.json_response(doc)
 
+    async def debug_decisions(request: web.Request) -> web.Response:
+        """The control-plane decision ledger as JSON — why the fleet
+        scaled/rolled/forked, with measured outcomes.  A FLEET
+        frontend fetches EVERY member's ring over the ``decisions``
+        wire op, stamps member (and host, from the federation
+        manifest) on each record, and returns ONE ts-sorted merged
+        timeline (``ledger``) — the flight-ring merge's exact shape —
+        plus the per-member raw rings."""
+        local = decisions_mod.LEDGER.snapshot()
+        doc: dict = {
+            "records": local,
+            "status": decisions_mod.LEDGER.status(),
+        }
+        if services is None and fleet_remote:
+            import asyncio as _asyncio
+            from ..parallel import federation as _federation
+
+            async def _fetch_ring(probe_client):
+                try:
+                    status, body = await _asyncio.wait_for(
+                        probe_client.call("decisions", {}),
+                        timeout=2.0)
+                    return (json.loads(bytes(body).decode())
+                            if status == 200 and body else None)
+                except Exception:
+                    return None
+
+            names = [m.name for m in fleet_members]
+            rings = await _asyncio.gather(
+                *(_fetch_ring(m.client) for m in fleet_members))
+            self_host = _federation.self_host()
+            merged = []
+            for rec in local:
+                stamped = dict(rec, member="frontend") \
+                    if "member" not in rec else dict(rec)
+                if self_host:
+                    stamped.setdefault("host", self_host)
+                merged.append(stamped)
+            members_doc = {}
+            manifest = _federation.current()
+            for name, ring in zip(names, rings):
+                members_doc[name] = ring
+                host = manifest.host_of(name) if manifest else ""
+                for rec in (ring or {}).get("ring", ()):
+                    stamped = dict(rec)
+                    # Frontend-side identity stamp (the member's own
+                    # host/member fields win when present — a record
+                    # that already names its subject keeps it).
+                    stamped.setdefault("member", name)
+                    if host:
+                        stamped.setdefault("host", host)
+                    merged.append(stamped)
+            merged.sort(key=lambda r: r.get("ts", 0.0))
+            doc["members"] = members_doc
+            doc["ledger"] = merged
+        else:
+            doc["ledger"] = local
+        return web.json_response(doc)
+
     async def debug_exemplars(request: web.Request) -> web.Response:
         """The request-duration histogram's live exemplars as JSON:
         per route, each latency bucket's most recent trace id +
@@ -1976,6 +2046,7 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_get("/readyz", readyz)
     app.router.add_get("/debug/costs", debug_costs)
     app.router.add_get("/debug/flightrecorder", debug_flightrecorder)
+    app.router.add_get("/debug/decisions", debug_decisions)
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/warmstate", debug_warmstate)
     app.router.add_get("/debug/exemplars", debug_exemplars)
@@ -1989,7 +2060,8 @@ def create_app(config: Optional[AppConfig] = None,
         admission=(getattr(image_handler, "admission", None)
                    or (services.admission if services is not None
                        else None)),
-        proxy_client=(client if proxy_mode else None)))
+        proxy_client=(client if proxy_mode else None),
+        federation_coord=federation_coord))
     app.router.add_get("/admin/drain", admin_drain)
     app.router.add_post("/admin/drain", admin_drain)
     app.router.add_post("/admin/undrain", admin_undrain)

@@ -54,7 +54,7 @@ import logging
 import time
 from typing import Callable, List, Optional
 
-from ..utils import telemetry
+from ..utils import decisions, telemetry
 
 log = logging.getLogger("omero_ms_image_region_tpu.autoscaler")
 
@@ -107,6 +107,13 @@ class Autoscaler:
         self._scaled_down: List[str] = []
         self.transitions: List[dict] = []
         self.last_blocked: Optional[str] = None
+        # Decision-ledger state: monotonically counted ticks key the
+        # measured-outcome probes (N ticks after a verdict, did the
+        # queue actually move?), and the steady flag makes "steady" a
+        # TRANSITION record, not a per-tick drumbeat.
+        self._tick_no = 0
+        self._outcome_probes: List[dict] = []
+        self._steady = False
         telemetry.AUTOSCALER.set_bounds(self.config.floor,
                                         self.ceiling())
 
@@ -174,19 +181,76 @@ class Autoscaler:
             down = demand <= after
         return "down" if down else None
 
+    # ---------------------------------------------------- decision ledger
+
+    @staticmethod
+    def _snap(sig: dict) -> dict:
+        """The signal snapshot a decision record carries: everything
+        the policy read this tick, so the ledger answers "why" without
+        a second source."""
+        return {
+            "queue_depth": sig["queue_depth"],
+            "queue_per_lane": round(sig["queue_per_lane"], 4),
+            "pressure_level": sig["pressure_level"],
+            "demand_tps": sig["demand_tps"],
+            "capacity_tps": sig["capacity_tps"],
+        }
+
+    def _decide(self, verdict: str, sig: dict, member: str = "",
+                **detail) -> None:
+        """One ledger record for this tick's verdict, plus an outcome
+        probe that measures the queue ``outcome-horizon-ticks`` ticks
+        from now — the record says what the controller believed, the
+        outcome says whether the fleet agreed."""
+        doc = dict(detail)
+        doc["signals"] = self._snap(sig)
+        seq = decisions.record("autoscaler", verdict, member=member,
+                               detail=doc)
+        if seq >= 0:
+            self._outcome_probes.append({
+                "seq": seq, "tick": self._tick_no,
+                "queue_depth": sig["queue_depth"],
+                "active": len(self.active_members()),
+            })
+
+    def _resolve_outcomes(self, sig: dict) -> None:
+        """Attach measured outcomes to verdicts whose horizon has
+        elapsed (ring + spool via ``decisions.resolve``)."""
+        horizon = max(1, decisions.LEDGER.outcome_horizon_ticks)
+        due = [p for p in self._outcome_probes
+               if self._tick_no - p["tick"] >= horizon]
+        if not due:
+            return
+        self._outcome_probes = [p for p in self._outcome_probes
+                                if self._tick_no - p["tick"] < horizon]
+        active = len(self.active_members())
+        for probe in due:
+            decisions.resolve(probe["seq"], {
+                "ticks": self._tick_no - probe["tick"],
+                "queue_depth": sig["queue_depth"],
+                "queue_depth_delta":
+                    sig["queue_depth"] - probe["queue_depth"],
+                "active": active,
+                "active_delta": active - probe["active"],
+            })
+
     # ------------------------------------------------------------ policy
 
-    def _blocked(self, reason: str, want: str) -> str:
+    def _blocked(self, reason: str, want: str, sig: dict) -> str:
         telemetry.AUTOSCALER.count_blocked(reason)
         if reason != self.last_blocked:
             # Tape hygiene: a fleet parked at its floor refuses the
             # same want every tick — the counter carries the rate,
             # the flight ring records the TRANSITION (a steady
             # blocked:floor at 3 ticks/s would evict every useful
-            # event from the black box within minutes).
+            # event from the black box within minutes).  The decision
+            # ledger shares the transition gate: one "blocked" record
+            # per posture change, with the signals that forced it.
             telemetry.FLIGHT.record("autoscale.blocked",
                                     reason=reason, want=want)
+            self._decide("blocked", sig, reason=reason, want=want)
         self.last_blocked = reason
+        self._steady = False
         return f"blocked:{reason}"
 
     def _publish(self) -> None:
@@ -201,6 +265,8 @@ class Autoscaler:
         read this verdict directly."""
         now = self.clock()
         sig = self.signals()
+        self._tick_no += 1
+        self._resolve_outcomes(sig)
         want = self._wants(sig)
         if want == "up":
             self._up_streak += 1
@@ -213,17 +279,25 @@ class Autoscaler:
             self._down_streak = 0
         try:
             if want is None:
+                if not self._steady:
+                    # "steady" is a transition record too: the tick
+                    # the controller STOPPED wanting anything closes
+                    # the previous episode in the ledger.
+                    self._decide("steady", sig)
+                    self._steady = True
                 return None
             hold = self.config.hold_ticks
             if (want == "up" and self._up_streak < hold) \
                     or (want == "down" and self._down_streak < hold):
+                # Held by hysteresis: not yet a decision — the ledger
+                # records verdicts, not the debounce.
                 return None
             if self._op is not None and not self._op.done():
-                return self._blocked("busy", want)
+                return self._blocked("busy", want, sig)
             if (self._last_transition is not None
                     and now - self._last_transition
                     < self.config.cooldown_s):
-                return self._blocked("cooldown", want)
+                return self._blocked("cooldown", want, sig)
             if want == "up":
                 return self._scale_up(now, sig)
             return self._scale_down(now, sig)
@@ -236,6 +310,8 @@ class Autoscaler:
         self._up_streak = 0
         self._down_streak = 0
         self.last_blocked = None
+        self._steady = False
+        self._decide(action, sig, member=member)
         doc = {"action": action, "member": member, "t": now,
                "active": len(self.active_members()),
                "queue_depth": sig["queue_depth"]}
@@ -255,7 +331,7 @@ class Autoscaler:
 
     def _scale_up(self, now: float, sig: dict) -> str:
         if len(self.active_members()) + 1 > self.ceiling():
-            return self._blocked("ceiling", "up")
+            return self._blocked("ceiling", "up", sig)
         # Only members THIS controller parked are candidates: an
         # operator's drain is an operator's decision.
         while self._scaled_down:
@@ -267,7 +343,7 @@ class Autoscaler:
                 break
             self._scaled_down.pop()      # operator took it over
         else:
-            return self._blocked("no-member", "up")
+            return self._blocked("no-member", "up", sig)
         name = self._scaled_down.pop()
         if self.lifecycle is not None:
             # Unit-managed member: restart its process FIRST (blocking
@@ -304,7 +380,7 @@ class Autoscaler:
                     log.warning("autoscale unit start of %s failed; "
                                 "re-parked", name, exc_info=True)
                     self._scaled_down.append(name)
-                    return self._blocked("no-member", "up")
+                    return self._blocked("no-member", "up", sig)
                 member = self.router.members.get(name)
                 if member is not None and hasattr(member, "revive"):
                     member.revive()
@@ -325,7 +401,7 @@ class Autoscaler:
             # (a dead-but-undrained member still owes the floor its
             # comeback), and either bound alone could be gamed by the
             # other's race.
-            return self._blocked("floor", "down")
+            return self._blocked("floor", "down", sig)
         # The LAST routable member in stack order (never member 0 —
         # the mesh/bulk lane — while anything else can go).
         routable_set = set(routable)
@@ -337,7 +413,7 @@ class Autoscaler:
                 victim = name
                 break
         if victim is None:
-            return self._blocked("no-member", "down")
+            return self._blocked("no-member", "down", sig)
         member = self.router.members[victim]
         # SYNCHRONOUS reservation on this loop step: the member stops
         # being active/routable NOW, so a concurrent tick (or a

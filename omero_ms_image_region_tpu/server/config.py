@@ -622,6 +622,24 @@ class SloConfig:
 
 
 @dataclass
+class DecisionsConfig:
+    """Control-plane decision ledger (utils.decisions.LEDGER): every
+    autoscaler verdict, epoch roll, manifest agreement, gossip
+    convergence transition and drain lifecycle move lands in one
+    bounded ring surfaced on /debug/decisions (federated frontends
+    merge every host's into one timeline)."""
+
+    # In-memory ring size (records); clamped to >= 16.
+    ring_size: int = 256
+    # JSONL spool directory (decisions.jsonl, one-file rotation);
+    # "" disables spooling — the ring alone carries the story.
+    spool_dir: str = ""
+    # Autoscaler verdicts get their MEASURED outcome (queue delta,
+    # active-member delta) attached this many ticks later.
+    outcome_horizon_ticks: int = 3
+
+
+@dataclass
 class HttpConfig:
     """Request parse limits (≙ ``config.yaml:5-12`` — the Vert.x
     ``HttpServerOptions`` line/header limits, mapped onto aiohttp's
@@ -745,6 +763,8 @@ class AppConfig:
     drain: DrainConfig = field(default_factory=DrainConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     slo: SloConfig = field(default_factory=SloConfig)
+    decisions: DecisionsConfig = field(
+        default_factory=DecisionsConfig)
     fault_tolerance: FaultToleranceConfig = field(
         default_factory=FaultToleranceConfig)
     # Seeded chaos layer (utils.faultinject); seed absent = disabled.
@@ -1470,6 +1490,22 @@ class AppConfig:
             raise ValueError("slo windows must be > 0 seconds")
         if cfg.slo.breach_burn_rate <= 0:
             raise ValueError("slo.breach-burn-rate must be > 0")
+        dec = raw.get("decisions", {}) or {}
+        dec_defaults = DecisionsConfig()
+        cfg.decisions = DecisionsConfig(
+            ring_size=int(dec.get("ring-size",
+                                  dec_defaults.ring_size)),
+            spool_dir=str(dec.get("spool-dir",
+                                  dec_defaults.spool_dir) or ""),
+            outcome_horizon_ticks=int(dec.get(
+                "outcome-horizon-ticks",
+                dec_defaults.outcome_horizon_ticks)),
+        )
+        if cfg.decisions.ring_size < 16:
+            raise ValueError("decisions.ring-size must be >= 16")
+        if cfg.decisions.outcome_horizon_ticks < 1:
+            raise ValueError(
+                "decisions.outcome-horizon-ticks must be >= 1")
         ft = raw.get("fault-tolerance", {}) or {}
         ft_defaults = FaultToleranceConfig()
         cfg.fault_tolerance = FaultToleranceConfig(

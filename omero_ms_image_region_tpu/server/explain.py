@@ -89,7 +89,7 @@ async def _probe_member(member, key: str, route: str) -> dict:
 
 async def explain(path: str, config, services=None, fleet_router=None,
                   fleet_members=(), admission=None,
-                  proxy_client=None) -> dict:
+                  proxy_client=None, federation_coord=None) -> dict:
     """Assemble the explain document for one render URL.  Read-only
     end to end: cache probes and wire ``explain`` ops only — the
     renderer-span counters must not move (pinned by the acceptance
@@ -124,13 +124,47 @@ async def explain(path: str, config, services=None, fleet_router=None,
             "draining": fleet_router.draining_members(),
         }
 
+    # ---- federation posture: epoch, agreement, fork status.  The
+    # explain answer must say which manifest the fleet is ROUTING
+    # (and whether a newer epoch is pending or a peer forked) before
+    # anyone reasons about residency across hosts.
+    from ..parallel import federation as federation_mod
+    manifest = federation_mod.current()
+    if manifest is not None:
+        fed: dict = {
+            "epoch": manifest.version,
+            "digest": manifest.digest(),
+            "self_host": federation_mod.self_host() or None,
+        }
+        pend = federation_mod.pending()
+        if pend is not None:
+            fed["pending_epoch"] = pend.version
+            fed["pending_digest"] = pend.digest()
+        if federation_coord is not None:
+            agreement = dict(getattr(federation_coord, "agreement",
+                                     None) or {})
+            if agreement:
+                fed["agreement"] = agreement
+                fed["forked"] = sorted(
+                    n for n, v in agreement.items()
+                    if v in ("stale", "split-brain"))
+        doc["federation"] = fed
+
     # ---- per-member residency (merged fleet-wide, concurrent).
     if fleet_members:
         names = [m.name for m in fleet_members]
         results = await asyncio.gather(
             *(_probe_member(m, ctx.cache_key, route_key)
               for m in fleet_members))
-        doc["members"] = dict(zip(names, results))
+        members_doc = dict(zip(names, results))
+        if manifest is not None:
+            # The host column: remote residency is only legible once
+            # each member names the host that owns its devices.
+            for name, member_doc in members_doc.items():
+                host = manifest.host_of(name)
+                if host:
+                    member_doc.setdefault("host", host)
+        doc["members"] = members_doc
     elif services is not None:
         # Single combined stack: probe in place.
         doc["residency"] = await residency_doc(
@@ -182,7 +216,7 @@ async def explain(path: str, config, services=None, fleet_router=None,
 
 def build_explain_handler(config, services=None, fleet_router=None,
                           fleet_members=(), admission=None,
-                          proxy_client=None):
+                          proxy_client=None, federation_coord=None):
     """The aiohttp handler factory app.py wires at /debug/explain."""
     from aiohttp import web
 
@@ -197,7 +231,8 @@ def build_explain_handler(config, services=None, fleet_router=None,
                 path, config, services=services,
                 fleet_router=fleet_router,
                 fleet_members=fleet_members, admission=admission,
-                proxy_client=proxy_client)
+                proxy_client=proxy_client,
+                federation_coord=federation_coord)
         except BadRequestError as e:
             return web.json_response({"error": str(e)}, status=400)
         except Exception:

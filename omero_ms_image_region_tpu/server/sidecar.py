@@ -428,9 +428,19 @@ async def _shard_transfer(image_handler, header: dict,
                           route_key=(str(route) if route else None))
         return actual
 
+    t_anchor = time.perf_counter()
     actual = await asyncio.to_thread(stage_verified)
+    stage_ms = (time.perf_counter() - t_anchor) * 1000.0
     telemetry.FEDERATION.count_transfer(len(req_body))
-    return json.dumps({"staged": True, "digest": actual}).encode()
+    # Anchor fields: OUR perf-clock instant the stage started, its
+    # duration, and our federation host identity — the shipping side
+    # grafts the stage as a clock-anchored child span in ITS trace
+    # (``federation.anchor_remote_time``).  Old callers ignore them.
+    from ..parallel import federation
+    return json.dumps({"staged": True, "digest": actual,
+                       "t_anchor": t_anchor,
+                       "ms": round(stage_ms, 3),
+                       "host": federation.self_host()}).encode()
 
 
 def _server_hello(header: dict, frames: FrameWriter, wire) -> tuple:
@@ -856,6 +866,16 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                     "events_total": telemetry.FLIGHT.events_total,
                     "dumps_written": telemetry.FLIGHT.dumps_written,
                 }).encode()
+            elif op == "decisions":
+                # This process's decision-ledger ring; the frontend
+                # merges every member's into ONE ts-sorted fleet
+                # timeline on /debug/decisions.
+                from ..utils import decisions as _decisions
+                body = json.dumps({
+                    "ring": _decisions.LEDGER.snapshot(
+                        int(header.get("limit", 0) or 0)),
+                    "status": _decisions.LEDGER.status(),
+                }).encode()
             elif op == "warmstate":
                 # Proxy-mode rehydrate/snapshot surface: the warm
                 # state lives with the device process; frontends
@@ -1080,7 +1100,8 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
         # process's own copy of the agreed membership.
         from ..parallel import federation
         federation.install(
-            federation.FleetManifest.from_config(config.federation))
+            federation.FleetManifest.from_config(config.federation),
+            self_host=config.federation.host)
     db_metadata = None
     if config.metadata_backend == "postgres":
         from ..services.db_metadata import PostgresMetadataService

@@ -1,0 +1,207 @@
+"""Control-plane decision ledger: what the fleet DECIDED, and why.
+
+The forensics plane (traces, flight ring, /debug/costs, SLO burn)
+answers "what happened to this request"; this module answers "why did
+the fleet do X at 14:02" — the question an epoch roll, a gossip fork,
+or a 3 a.m. scale-down raises and nothing else records.  Every
+control-plane action lands here as one closed-vocabulary record:
+
+* ``autoscaler`` — one record per tick VERDICT transition (signal
+  snapshot -> diurnal demand prediction -> want -> ``up`` / ``down`` /
+  ``blocked`` / ``steady``), with the MEASURED outcome attached
+  ``outcome-horizon-ticks`` ticks later (did the queue actually fall?);
+* ``epoch`` — manifest install / pending-roll phases
+  (``parallel.federation.install`` / ``set_pending``);
+* ``manifest`` — per-member digest agreement verdicts (the
+  ``FederationStats.AGREEMENT_REASONS`` vocabulary);
+* ``gossip`` — per-peer convergence transitions (``ok`` /
+  ``mismatch`` / ``unreachable``);
+* ``drain`` / ``undrain`` / ``handoff`` — member lifecycle moves and
+  cross-host shard handoffs.
+
+Both vocabularies are owned by ``telemetry.DecisionStats`` (KINDS /
+VERDICTS) so the cardinality budget bounds the
+``imageregion_decision_total{kind,verdict}`` family mechanically.
+Each record also fires a ``decision.<kind>`` flight event — the black
+box and the ledger tell one story.
+
+Storage is the flight-recorder shape: an append-only bounded ring
+(``/debug/decisions`` snapshots it; the federated frontend merges
+every host's ring ts-sorted) plus an optional JSONL spool
+(``decisions.jsonl``, one-file rotation) for post-mortems that outlive
+the ring.  Recording must never fail the control plane: bad vocab is
+dropped with a warning, spool errors are counted and swallowed.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import telemetry
+
+log = logging.getLogger("omero_ms_image_region_tpu.decisions")
+
+KINDS = telemetry.DecisionStats.KINDS
+VERDICTS = telemetry.DecisionStats.VERDICTS
+
+# One rotation (decisions.jsonl -> decisions.jsonl.1) keeps the spool
+# bounded without a compaction thread.
+_SPOOL_MAX_BYTES = 4 * 1024 * 1024
+_SPOOL_NAME = "decisions.jsonl"
+
+
+class DecisionLedger:
+    """Bounded ring + JSONL spool of control-plane decision records."""
+
+    def __init__(self, ring_size: int = 256, spool_dir: str = "",
+                 outcome_horizon_ticks: int = 3, host: str = ""):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(16, int(ring_size)))
+        self._seq = 0
+        self.records_total = 0
+        self.spool_dir = spool_dir
+        self.spool_errors = 0
+        self.outcome_horizon_ticks = max(1, int(outcome_horizon_ticks))
+        self.host = host
+
+    def configure(self, ring_size: Optional[int] = None,
+                  spool_dir: Optional[str] = None,
+                  outcome_horizon_ticks: Optional[int] = None,
+                  host: Optional[str] = None) -> None:
+        """App-startup wiring (``decisions:`` config block).  Ring
+        contents survive a re-size (tail-truncated to the new bound)
+        so a mid-life reconfigure never erases the recent story."""
+        with self._lock:
+            if ring_size is not None:
+                self._ring = collections.deque(
+                    self._ring, maxlen=max(16, int(ring_size)))
+            if spool_dir is not None:
+                self.spool_dir = spool_dir
+            if outcome_horizon_ticks is not None:
+                self.outcome_horizon_ticks = max(
+                    1, int(outcome_horizon_ticks))
+            if host is not None:
+                self.host = host
+
+    # ------------------------------------------------------------ record
+
+    def record(self, kind: str, verdict: str, member: str = "",
+               detail: Optional[dict] = None) -> int:
+        """Append one decision record; returns its ``seq`` (the handle
+        ``resolve`` attaches the measured outcome to), or -1 when the
+        vocabulary rejected it.  Never raises."""
+        if kind not in KINDS or verdict not in VERDICTS:
+            log.warning("decision dropped: kind=%r verdict=%r not in "
+                        "the closed vocabulary", kind, verdict)
+            return -1
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            rec: Dict[str, object] = {
+                "seq": seq, "ts": time.time(),
+                "kind": kind, "verdict": verdict,
+            }
+            if self.host:
+                rec["host"] = self.host
+            if member:
+                rec["member"] = member
+            if detail:
+                rec["detail"] = dict(detail)
+            self._ring.append(rec)
+            self.records_total += 1
+        telemetry.DECISIONS.count(kind, verdict)
+        fields = {"verdict": verdict, "seq": seq}
+        if member:
+            # Only stamp when we have one: an empty member would mask
+            # the flight recorder's own process-identity stamp.
+            fields["member"] = member
+        telemetry.FLIGHT.record(f"decision.{kind}", **fields)
+        self._spool(rec)
+        return seq
+
+    def resolve(self, seq: int, outcome: dict) -> bool:
+        """Attach the measured outcome to a prior record (autoscaler
+        verdicts, N ticks later).  True when the record was still in
+        the ring; the spool gets its own outcome line either way, so a
+        post-mortem can join them even after the ring moved on."""
+        found = False
+        with self._lock:
+            for rec in reversed(self._ring):
+                if rec.get("seq") == seq:
+                    rec["outcome"] = dict(outcome)
+                    found = True
+                    break
+        self._spool({"outcome_for": seq, "ts": time.time(),
+                     "outcome": dict(outcome)})
+        return found
+
+    # ---------------------------------------------------------- surfaces
+
+    def snapshot(self, limit: int = 0) -> List[dict]:
+        """Ring contents oldest-first (copies — callers mutate/merge
+        freely, e.g. the federated ``/debug/decisions`` host stamp)."""
+        with self._lock:
+            out = [dict(rec) for rec in self._ring]
+        return out[-limit:] if limit > 0 else out
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "records_total": self.records_total,
+                "ring": len(self._ring),
+                "ring_size": self._ring.maxlen,
+                "outcome_horizon_ticks": self.outcome_horizon_ticks,
+                "spool_dir": self.spool_dir or None,
+                "spool_errors": self.spool_errors,
+                "host": self.host or None,
+            }
+
+    # ------------------------------------------------------------- spool
+
+    def _spool(self, doc: dict) -> None:
+        spool_dir = self.spool_dir
+        if not spool_dir:
+            return
+        try:
+            os.makedirs(spool_dir, exist_ok=True)
+            path = os.path.join(spool_dir, _SPOOL_NAME)
+            try:
+                if os.path.getsize(path) >= _SPOOL_MAX_BYTES:
+                    os.replace(path, path + ".1")
+            except OSError:
+                pass                     # no file yet
+            with open(path, "a") as f:
+                f.write(json.dumps(doc, sort_keys=True) + "\n")
+        except (OSError, ValueError, TypeError):
+            with self._lock:
+                self.spool_errors += 1
+
+    def reset(self) -> None:
+        """Test isolation (rides ``telemetry.reset()``)."""
+        with self._lock:
+            self._ring = collections.deque(maxlen=256)
+            self._seq = 0
+            self.records_total = 0
+            self.spool_dir = ""
+            self.spool_errors = 0
+            self.outcome_horizon_ticks = 3
+            self.host = ""
+
+
+LEDGER = DecisionLedger()
+
+
+def record(kind: str, verdict: str, member: str = "",
+           detail: Optional[dict] = None) -> int:
+    return LEDGER.record(kind, verdict, member=member, detail=detail)
+
+
+def resolve(seq: int, outcome: dict) -> bool:
+    return LEDGER.resolve(seq, outcome)

@@ -1004,6 +1004,34 @@ class SloEngine:
         lines.append(f"imageregion_slo_breaches_total {breaches}")
         return lines
 
+    def export_buckets(self) -> dict:
+        """Wire-portable window state for fleet-level aggregation
+        (``FleetSloStats``).  Bucket indices key off this process's
+        monotonic clock, which means nothing on another host — so
+        buckets cross the wire as AGES (seconds before this export),
+        and the ingesting side re-anchors them against its own clock
+        at ingest time.  Disabled engines export ``{}`` (the
+        emit-when-live posture: a host with no objectives contributes
+        nothing to the fleet burn)."""
+        with self._lock:
+            if not self.enabled:
+                return {}
+            now = self._clock()
+            buckets = [
+                [round(now - idx * self.BUCKET_S, 3),
+                 b["ok"], b["err"], b["fast"], b["slow"]]
+                for idx, b in sorted(self._buckets.items())
+            ]
+            return {
+                "bucket_s": self.BUCKET_S,
+                "availability_target": self.availability_target,
+                "latency_ms": self.latency_ms,
+                "latency_target": self.latency_target,
+                "fast_window_s": self.fast_window_s,
+                "slow_window_s": self.slow_window_s,
+                "buckets": buckets,
+            }
+
     def reset(self) -> None:
         with self._lock:
             self.enabled = False
@@ -2119,6 +2147,222 @@ class FederationStats:
 FEDERATION = FederationStats()
 
 
+class DecisionStats:
+    """Exposition half of the control-plane decision ledger
+    (``utils.decisions`` owns the ring + spool): counts per
+    (kind, verdict) as ``imageregion_decision_total``.  BOTH label
+    vocabularies are closed and owned HERE so the cardinality budget
+    can bound them mechanically — the ledger imports them, callers
+    never mint either string."""
+
+    KINDS = ("autoscaler", "epoch", "manifest", "gossip",
+             "drain", "undrain", "handoff")
+    VERDICTS = ("up", "down", "blocked", "steady",
+                "installed", "pending", "promoted",
+                "agreed", "stale", "split-brain", "unreachable",
+                "legacy", "ok", "mismatch", "done", "failed")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts: Dict[Tuple[str, str], int] = {}
+
+    def count(self, kind: str, verdict: str) -> None:
+        if kind not in self.KINDS or verdict not in self.VERDICTS:
+            return                       # ledger already warned
+        with self._lock:
+            key = (kind, verdict)
+            self.counts[key] = self.counts.get(key, 0) + 1
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            if not self.counts:
+                return []                # emit-when-live
+            return [
+                f"imageregion_decision_total"
+                f"{label('kind=%s,verdict=%s' % (json.dumps(k), json.dumps(v)))}"
+                f" {n}"
+                for (k, v), n in sorted(self.counts.items())
+            ]
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counts.clear()
+
+
+DECISIONS = DecisionStats()
+
+
+class FleetSloStats:
+    """Fleet-level SLO burn: every federated host exports its
+    ``SloEngine`` window buckets over the gossip wire
+    (``SloEngine.export_buckets`` — age-keyed, since bucket indices
+    are process-local monotonic) and the frontend re-anchors them here
+    against its own clock, so one host's error budget burning is
+    visible on the aggregating host's exposition as
+    ``imageregion_fleet_slo_*`` even while the fleet-wide mean looks
+    healthy.  The ``host`` label is bounded by ``_MAX_HOSTS``:
+    ingests for new hosts beyond the bound are dropped (and counted)
+    rather than growing the exposition — the overflow guard the
+    cardinality budget relies on.  Objectives are assumed homogeneous
+    across the fleet (one config rolled everywhere); the strictest
+    target seen wins when they drift."""
+
+    _MAX_HOSTS = 16
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._clock = time.monotonic
+        # host -> {"t": ingest instant, "export": SloEngine export doc}
+        self.hosts: Dict[str, dict] = {}
+        self.dropped_hosts = 0
+
+    def configure(self, clock=time.monotonic) -> None:
+        with self._lock:
+            self._clock = clock
+
+    def ingest(self, host: str, export) -> bool:
+        if not host or not isinstance(export, dict) \
+                or not export.get("buckets"):
+            return False
+        with self._lock:
+            if host not in self.hosts \
+                    and len(self.hosts) >= self._MAX_HOSTS:
+                self.dropped_hosts += 1
+                return False
+            self.hosts[host] = {"t": self._clock(),
+                                "export": dict(export)}
+        return True
+
+    @staticmethod
+    def _window_counts(export: dict, elapsed: float,
+                       window_s: float) -> Dict[str, int]:
+        out = {"ok": 0, "err": 0, "fast": 0, "slow": 0}
+        bucket_s = float(export.get("bucket_s", 5.0))
+        for row in export.get("buckets", ()):
+            try:
+                age, ok, err, fast, slow = row
+            except (TypeError, ValueError):
+                continue
+            # ``age`` dates the bucket START at export; a bucket still
+            # counts while any part of it overlaps the window.
+            if float(age) + elapsed - bucket_s <= window_s:
+                out["ok"] += int(ok)
+                out["err"] += int(err)
+                out["fast"] += int(fast)
+                out["slow"] += int(slow)
+        return out
+
+    def _burns_locked(self) -> dict:
+        """{"hosts": {host: {objective: {window: burn}}},
+        "fleet": {objective: {window: burn}}} over live exports."""
+        now = self._clock()
+
+        def burn(bad: int, total: int, target: float) -> float:
+            if total == 0 or not target:
+                return 0.0
+            return (bad / total) / max(1e-9, 1.0 - target)
+
+        per_host: Dict[str, dict] = {}
+        fleet_counts: Dict[Tuple[str, str], Dict[str, int]] = {}
+        targets = {"availability": 0.0, "latency": 0.0}
+        for host, entry in self.hosts.items():
+            export = entry["export"]
+            elapsed = max(0.0, now - entry["t"])
+            targets["availability"] = max(
+                targets["availability"],
+                float(export.get("availability_target", 0.0)))
+            targets["latency"] = max(
+                targets["latency"],
+                float(export.get("latency_target", 0.0))
+                if export.get("latency_ms") else 0.0)
+            host_doc: Dict[str, dict] = {}
+            for window, window_s in (
+                    ("fast", float(export.get("fast_window_s", 60.0))),
+                    ("slow", float(export.get("slow_window_s",
+                                              600.0)))):
+                c = self._window_counts(export, elapsed, window_s)
+                agg = fleet_counts.setdefault(
+                    (window, ""), {"ok": 0, "err": 0,
+                                   "fast": 0, "slow": 0})
+                for k in c:
+                    agg[k] += c[k]
+                if export.get("availability_target"):
+                    host_doc.setdefault("availability", {})[window] = \
+                        burn(c["err"], c["ok"] + c["err"],
+                             float(export["availability_target"]))
+                if export.get("latency_ms"):
+                    host_doc.setdefault("latency", {})[window] = \
+                        burn(c["slow"], c["fast"] + c["slow"],
+                             float(export.get("latency_target", 0.99)))
+            per_host[host] = host_doc
+        fleet: Dict[str, dict] = {}
+        for (window, _), c in fleet_counts.items():
+            if targets["availability"]:
+                fleet.setdefault("availability", {})[window] = burn(
+                    c["err"], c["ok"] + c["err"],
+                    targets["availability"])
+            if targets["latency"]:
+                fleet.setdefault("latency", {})[window] = burn(
+                    c["slow"], c["fast"] + c["slow"],
+                    targets["latency"])
+        return {"hosts": per_host, "fleet": fleet}
+
+    def burns(self) -> dict:
+        with self._lock:
+            return self._burns_locked()
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            if not self.hosts and not self.dropped_hosts:
+                return []                # emit-when-live
+            doc = self._burns_locked()
+            lines = [f"imageregion_fleet_slo_hosts{label()} "
+                     f"{len(self.hosts)}"]
+            if self.dropped_hosts:
+                lines.append(
+                    f"imageregion_fleet_slo_dropped_hosts_total"
+                    f"{label()} {self.dropped_hosts}")
+            for objective in sorted(doc["fleet"]):
+                for window in sorted(doc["fleet"][objective]):
+                    body = ('slo="%s",window="%s"'
+                            % (objective, window))
+                    lines.append(
+                        f"imageregion_fleet_slo_burn_rate"
+                        f"{label(body)} "
+                        f"{round(doc['fleet'][objective][window], 4)}")
+            for host in sorted(doc["hosts"]):
+                for objective in sorted(doc["hosts"][host]):
+                    for window in sorted(doc["hosts"][host][objective]):
+                        body = ('host="%s",slo="%s",window="%s"'
+                                % (host, objective, window))
+                        rate = doc["hosts"][host][objective][window]
+                        lines.append(
+                            f"imageregion_fleet_slo_host_burn_rate"
+                            f"{label(body)} {round(rate, 4)}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self._clock = time.monotonic
+            self.hosts.clear()
+            self.dropped_hosts = 0
+
+
+FED_SLO = FleetSloStats()
+
+
 class SessionStats:
     """Session-model accounting (``services.viewport`` +
     ``server.admission.SessionTokenBuckets``): how many distinct
@@ -2527,6 +2771,8 @@ def robustness_metric_lines(extra_labels: str = "") -> List[str]:
             + DRAIN.metric_lines(extra_labels)
             + AUTOSCALER.metric_lines(extra_labels)
             + FEDERATION.metric_lines(extra_labels)
+            + DECISIONS.metric_lines(extra_labels)
+            + FED_SLO.metric_lines(extra_labels)
             + session_metric_lines(extra_labels))
 
 
@@ -2763,6 +3009,16 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_federation_shard_transfers_total": "counter",
     "imageregion_federation_transfer_bytes_total": "counter",
     "imageregion_federation_remote_prestage_total": "counter",
+    # Control-plane decision ledger (utils.decisions): every
+    # autoscaler / epoch / gossip / drain action as a closed
+    # (kind, verdict) pair.
+    "imageregion_decision_total": "counter",
+    # Fleet-level SLO burn (FleetSloStats): per-host SloEngine window
+    # buckets aggregated on the federation frontend.
+    "imageregion_fleet_slo_hosts": "gauge",
+    "imageregion_fleet_slo_dropped_hosts_total": "counter",
+    "imageregion_fleet_slo_burn_rate": "gauge",
+    "imageregion_fleet_slo_host_burn_rate": "gauge",
     # Session-aware serving (services.viewport / services.prefetch /
     # server.admission token buckets / fleet QoS dequeue).
     "imageregion_session_tracked": "gauge",
@@ -2826,6 +3082,17 @@ METRIC_HELP: Dict[str, str] = {
         "Warm HBM planes shipped cross-host over shard_transfer",
     "imageregion_federation_remote_prestage_total":
         "Predicted-plane prestage hints sent to remote owners",
+    "imageregion_decision_total":
+        "Control-plane decision-ledger records by kind and verdict",
+    "imageregion_fleet_slo_hosts":
+        "Hosts currently contributing SLO window buckets to the "
+        "fleet burn",
+    "imageregion_fleet_slo_dropped_hosts_total":
+        "SLO bucket ingests dropped by the host-cardinality bound",
+    "imageregion_fleet_slo_burn_rate":
+        "Fleet-aggregated error-budget burn per objective and window",
+    "imageregion_fleet_slo_host_burn_rate":
+        "Per-host error-budget burn per objective and window",
     "imageregion_request_cost_device_ms":
         "Per-request device-execute ms (pro-rata from batch group)",
     "imageregion_request_cost_read_ms":
@@ -3215,8 +3482,15 @@ def reset() -> None:
     AUTOSCALER.reset()
     LOADMODEL.reset()
     FEDERATION.reset()
+    DECISIONS.reset()
+    FED_SLO.reset()
     SESSIONS.reset()
     PREFETCH.reset()
     QOS.reset()
     HTTPCACHE.reset()
     PROVENANCE.reset()
+    # The decision ledger lives in utils.decisions (which imports this
+    # module); reset it from here so ONE reset() call keeps the whole
+    # forensics plane test-isolated.  Lazy import breaks the cycle.
+    from . import decisions as _decisions
+    _decisions.LEDGER.reset()

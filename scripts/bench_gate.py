@@ -54,11 +54,17 @@ DEFAULT_KEYS = ("service_tiles_per_sec", "p50_service_tile_ms_ex_rtt",
 # multi-PROCESS federated keys (bench.py --smoke --federation: real
 # spawned sidecar processes behind an agreed manifest) joined the
 # family in PR 15 — rounds that predate them skip on null the same
-# way, so in-process-only history keeps judging.
+# way, so in-process-only history keeps judging.  PR 16 added the
+# control-plane forensics keys (``fed_trace_stitched`` — the
+# two-process waterfall stitched with per-host clock anchoring —
+# and ``decision_records`` — autoscaler ledger verdicts carrying
+# measured outcomes); both skip on null for older rounds too.
 MULTICHIP_KEYS = ("fleet_tiles_per_sec_m8", "fleet_tiles_per_sec_m4",
                   "fleet_scaling_efficiency",
                   "fed_tiles_per_sec_p2",
-                  "fed_process_scaling_efficiency")
+                  "fed_process_scaling_efficiency",
+                  "fed_trace_stitched",
+                  "decision_records")
 # --sessions: judge SESSIONS_r*.json records (bench.py --smoke
 # --sessions) on the multi-user serving keys.  Direction-aware by
 # name: the per-session p99 is a ``_ms`` key (regresses UP), the

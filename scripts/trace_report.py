@@ -69,10 +69,14 @@ def render_trace(doc) -> str:
             if ts else "?")
     spans = sorted(doc.get("spans", ()), key=lambda s: s["start_ms"])
     members = []
+    hosts = []
     for s in spans:
         m = s.get("member")
         if m and m not in members:
             members.append(m)
+        h = s.get("host")
+        if h and h not in hosts:
+            hosts.append(h)
     lane_w = max([len(m) for m in members] + [4]) if members else 0
     head = (f"trace {doc.get('trace_id', '?')}  route="
             f"{doc.get('route', '?')}  status={doc.get('status', '?')}"
@@ -86,6 +90,7 @@ def render_trace(doc) -> str:
         f"{'waterfall':<{BAR_WIDTH}}  span",
     ]
     member_ms = {}
+    host_ms = {}
     for s in spans:
         x0 = int(BAR_WIDTH * max(s["start_ms"], 0.0) / total)
         x1 = int(BAR_WIDTH * min(s["start_ms"] + s["dur_ms"], total)
@@ -96,11 +101,21 @@ def render_trace(doc) -> str:
                  if k not in ("name", "start_ms", "dur_ms", "member")}
         name = s["name"]
         member = s.get("member", "")
+        host = s.get("host", "")
         if name == "fleet.hop":
             # Hop markers read as their own vocabulary: hop:member.
             name = f"hop:{extra.pop('hop', '?')}"
+        elif name == "fed.hop":
+            # Cross-host federation hops: fed:kind@host — the wire
+            # exchange (and its clock-anchored remote graft) named by
+            # what crossed and which host it landed on.
+            extra.pop("host", None)
+            name = f"fed:{extra.pop('kind', '?')}@{host or '?'}"
         if member:
             member_ms[member] = member_ms.get(member, 0.0) \
+                + float(s["dur_ms"])
+        if host:
+            host_ms[host] = host_ms.get(host, 0.0) \
                 + float(s["dur_ms"])
         suffix = f"  {extra}" if extra else ""
         lane = f"{member:<{lane_w}}  " if members else ""
@@ -110,6 +125,13 @@ def render_trace(doc) -> str:
         pretty = "  ".join(f"{m}={member_ms.get(m, 0.0):.1f}ms"
                            for m in members)
         lines.append(f"  members: {pretty}")
+    if hosts:
+        # Per-HOST time footer (the multi-host stitched story): every
+        # span carrying a ``host`` dimension — fed.hop exchanges and
+        # remote-anchored grafts — summed by the host it names.
+        pretty = "  ".join(f"{h}={host_ms.get(h, 0.0):.1f}ms"
+                           for h in hosts)
+        lines.append(f"  hosts: {pretty}")
     cost = doc.get("cost")
     if cost:
         pretty = "  ".join(
@@ -137,6 +159,12 @@ _ROBUSTNESS_KINDS = ("pressure.level", "pressure.step",
 # shed and what did prefetch do" alongside the robustness story.
 _SESSION_KINDS = ("qos.shed", "prefetch.predict", "prefetch.budget")
 
+# Control-plane decision records (utils.decisions): every ledger
+# append mirrors onto the flight ring as ``decision.<kind>`` — flagged
+# and summed separately so a dump answers "what did the control plane
+# DECIDE" next to what the data plane did about it.
+_DECISION_PREFIX = "decision."
+
 
 def render_flight(doc) -> str:
     """Flight-recorder dump -> event timeline (newest events last,
@@ -154,6 +182,7 @@ def render_flight(doc) -> str:
     ]
     rob_counts: dict = {}
     session_counts: dict = {}
+    decision_counts: dict = {}
     member_counts: dict = {}
     for e in events:
         kind = e.get("kind", "?")
@@ -166,7 +195,9 @@ def render_flight(doc) -> str:
                   if extra else "")
         offset = float(e.get("ts", t_dump)) - t_dump
         mark = ("!" if kind in _ROBUSTNESS_KINDS
-                else "*" if kind in _SESSION_KINDS else " ")
+                else "*" if kind in _SESSION_KINDS
+                else "+" if kind.startswith(_DECISION_PREFIX)
+                else " ")
         if kind in _ROBUSTNESS_KINDS:
             label = kind
             if kind == "pressure.step":
@@ -188,6 +219,9 @@ def render_flight(doc) -> str:
             elif kind == "prefetch.budget":
                 label = f"prefetch.budget:{e.get('scale', '?')}"
             session_counts[label] = session_counts.get(label, 0) + 1
+        elif kind.startswith(_DECISION_PREFIX):
+            label = f"{kind}:{e.get('verdict', '?')}"
+            decision_counts[label] = decision_counts.get(label, 0) + 1
         lines.append(f"  {offset:>8.2f}s {mark} {kind}{suffix}")
     if rob_counts:
         pretty = "  ".join(f"{k}={v}" for k, v in
@@ -197,6 +231,10 @@ def render_flight(doc) -> str:
         pretty = "  ".join(f"{k}={v}" for k, v in
                            sorted(session_counts.items()))
         lines.append(f"  session-serving: {pretty}")
+    if decision_counts:
+        pretty = "  ".join(f"{k}={v}" for k, v in
+                           sorted(decision_counts.items()))
+        lines.append(f"  control-plane: {pretty}")
     if member_counts:
         # Fleet identity footer: a merged fleet ring (or a member-
         # stamped process ring) sums its events per member, so a

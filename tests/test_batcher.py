@@ -84,9 +84,12 @@ class TestBatchingRenderer:
                 outs = await asyncio.gather(
                     batcher.render_jpeg(raw_a, settings, 85, 28, 20),
                     batcher.render_jpeg(raw_b, settings, 85, 32, 32))
-                return outs, batcher.batches_dispatched
             finally:
+                # close() awaits the in-flight group, so the dispatch
+                # counter read below cannot race the group tail when
+                # first-tile-out settles the waiters early.
                 await batcher.close()
+            return outs, batcher.batches_dispatched
 
         (a, b), dispatched = run(main())
         assert dispatched == 1

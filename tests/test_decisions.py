@@ -1,0 +1,371 @@
+"""Control-plane flight data: the decision ledger (ring + spool +
+closed vocabulary), per-HOST clock anchoring for cross-host trace
+stitching, and fleet-level SLO burn aggregation."""
+
+import json
+import threading
+
+import pytest
+
+from omero_ms_image_region_tpu.parallel import federation
+from omero_ms_image_region_tpu.utils import decisions, telemetry
+from omero_ms_image_region_tpu.utils.decisions import DecisionLedger
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    telemetry.reset()
+    federation.uninstall()
+    yield
+    telemetry.reset()
+    federation.uninstall()
+
+
+# ------------------------------------------------------- decision ledger
+
+class TestDecisionLedger:
+    def test_record_returns_seq_and_rings(self):
+        led = DecisionLedger(ring_size=16)
+        s1 = led.record("autoscaler", "up", member="m0",
+                        detail={"signals": {"queue_depth": 3}})
+        s2 = led.record("gossip", "ok")
+        assert (s1, s2) == (1, 2)
+        ring = led.snapshot()
+        assert [r["seq"] for r in ring] == [1, 2]
+        assert ring[0]["member"] == "m0"
+        assert ring[0]["detail"]["signals"]["queue_depth"] == 3
+        # No member/host/detail -> the keys are absent, not empty.
+        assert "member" not in ring[1] and "host" not in ring[1]
+
+    def test_closed_vocabulary_rejects_without_raising(self):
+        led = DecisionLedger()
+        assert led.record("autoscaler", "sideways") == -1
+        assert led.record("weather", "ok") == -1
+        assert led.snapshot() == []
+        assert led.status()["records_total"] == 0
+        # The exposition side is equally closed: nothing counted.
+        assert telemetry.DECISIONS.counts == {}
+
+    def test_every_kind_verdict_pair_in_vocab_is_recordable(self):
+        led = DecisionLedger(ring_size=1024)
+        for kind in decisions.KINDS:
+            for verdict in decisions.VERDICTS:
+                assert led.record(kind, verdict) > 0
+
+    def test_ring_bound_evicts_oldest(self):
+        led = DecisionLedger(ring_size=16)
+        for i in range(40):
+            led.record("gossip", "ok", detail={"i": i})
+        ring = led.snapshot()
+        assert len(ring) == 16
+        assert ring[0]["detail"]["i"] == 24        # oldest evicted
+        assert led.status()["records_total"] == 40  # lifetime survives
+
+    def test_snapshot_limit_and_isolation(self):
+        led = DecisionLedger()
+        for _ in range(5):
+            led.record("drain", "done")
+        tail = led.snapshot(limit=2)
+        assert [r["seq"] for r in tail] == [4, 5]
+        tail[0]["seq"] = 999                       # copies, not views
+        assert led.snapshot()[3]["seq"] == 4
+
+    def test_resolve_attaches_outcome_in_ring(self):
+        led = DecisionLedger()
+        seq = led.record("autoscaler", "down", member="m3")
+        assert led.resolve(seq, {"ticks": 3, "queue_depth_delta": -2})
+        [rec] = led.snapshot()
+        assert rec["outcome"]["queue_depth_delta"] == -2
+
+    def test_resolve_after_eviction_reports_miss(self):
+        led = DecisionLedger(ring_size=16)
+        seq = led.record("autoscaler", "up")
+        for _ in range(20):
+            led.record("gossip", "ok")
+        assert not led.resolve(seq, {"ticks": 3})
+
+    def test_spool_writes_jsonl_and_outcome_line(self, tmp_path):
+        led = DecisionLedger(spool_dir=str(tmp_path))
+        seq = led.record("epoch", "installed", detail={"epoch": 4})
+        led.resolve(seq, {"ticks": 1})
+        lines = [json.loads(l) for l in
+                 (tmp_path / "decisions.jsonl").read_text().splitlines()]
+        assert lines[0]["kind"] == "epoch"
+        assert lines[0]["detail"]["epoch"] == 4
+        # The outcome spools as its OWN line keyed by seq, so a
+        # post-mortem can join them even after the ring moved on.
+        assert lines[1]["outcome_for"] == seq
+        assert led.status()["spool_errors"] == 0
+
+    def test_spool_rotates_once_at_bound(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(decisions, "_SPOOL_MAX_BYTES", 256)
+        led = DecisionLedger(spool_dir=str(tmp_path))
+        for i in range(32):
+            led.record("gossip", "ok", detail={"pad": "x" * 32, "i": i})
+        assert (tmp_path / "decisions.jsonl").exists()
+        assert (tmp_path / "decisions.jsonl.1").exists()
+        assert not (tmp_path / "decisions.jsonl.2").exists()
+        assert (tmp_path / "decisions.jsonl").stat().st_size < 512
+
+    def test_spool_errors_counted_not_raised(self, tmp_path):
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("")
+        led = DecisionLedger(spool_dir=str(blocker / "sub"))
+        assert led.record("drain", "failed") == 1   # still ringed
+        assert led.status()["spool_errors"] == 1
+
+    def test_configure_preserves_ring_contents(self):
+        led = DecisionLedger(ring_size=64)
+        for _ in range(20):
+            led.record("gossip", "ok")
+        led.configure(ring_size=16, outcome_horizon_ticks=5,
+                      host="hostA")
+        ring = led.snapshot()
+        assert len(ring) == 16                      # tail-truncated
+        assert ring[-1]["seq"] == 20                # newest survive
+        st = led.status()
+        assert st["ring_size"] == 16
+        assert st["outcome_horizon_ticks"] == 5
+        assert st["host"] == "hostA"
+
+    def test_configure_floors_pathological_values(self):
+        led = DecisionLedger()
+        led.configure(ring_size=1, outcome_horizon_ticks=0)
+        assert led.status()["ring_size"] == 16
+        assert led.outcome_horizon_ticks == 1
+
+    def test_host_stamp_rides_every_record(self):
+        led = DecisionLedger(host="hostB")
+        led.record("manifest", "agreed", member="b0")
+        [rec] = led.snapshot()
+        assert rec["host"] == "hostB"
+
+    def test_record_counts_metric_and_fires_flight_event(self):
+        decisions.record("autoscaler", "blocked", member="m1",
+                         detail={"reason": "floor"})
+        decisions.record("gossip", "mismatch")
+        lines = telemetry.robustness_metric_lines()
+        assert ('imageregion_decision_total{kind="autoscaler",'
+                'verdict="blocked"} 1') in lines
+        events = [e for e in telemetry.FLIGHT.snapshot()
+                  if e["kind"].startswith("decision.")]
+        assert [e["kind"] for e in events] == [
+            "decision.autoscaler", "decision.gossip"]
+        assert events[0]["verdict"] == "blocked"
+        assert events[0]["member"] == "m1"
+        # Empty member must not mask the flight ring's own
+        # process-identity stamp.
+        assert "member" not in events[1]
+
+    def test_concurrent_records_never_lose_or_dupe_seqs(self):
+        led = DecisionLedger(ring_size=4096)
+
+        def burst():
+            for _ in range(100):
+                led.record("gossip", "ok")
+
+        threads = [threading.Thread(target=burst) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        seqs = [r["seq"] for r in led.snapshot()]
+        assert sorted(seqs) == list(range(1, 801))
+
+
+# --------------------------------------- cross-host clock anchoring
+
+class TestHostClockAnchoring:
+    """The manifest_hello clock exchange, pinned in isolation — the
+    sidecar hello's midpoint anchoring lifted to per-HOST, with the
+    same clamp contract: skew may place a graft oddly WITHIN its
+    exchange window, never outside it."""
+
+    def test_midpoint_offset_derivation(self):
+        off = federation.record_host_clock("hostB", 10.0, 10.2, 500.0)
+        assert off == pytest.approx(10.1 - 500.0)
+        assert federation.host_clock_offset("hostB") == off
+        clocks = federation.host_clocks()
+        assert clocks["hostB"]["rtt_ms"] == pytest.approx(200.0)
+
+    def test_anchor_maps_remote_instant_into_local_window(self):
+        # Remote clock runs 1000 s AHEAD: offset maps it back, and an
+        # anchor taken mid-exchange lands mid-window.
+        federation.record_host_clock("hostB", 10.0, 10.2, 1010.1)
+        t = federation.anchor_remote_time("hostB", 1010.15,
+                                          (10.0, 10.2))
+        assert t == pytest.approx(10.15)
+
+    def test_negative_offset_skew_clamps_into_window(self):
+        # Remote clock far BEHIND local (large positive offset): a
+        # stale offset flings the mapped anchor past recv — clamped to
+        # the window's hi edge, never after it.
+        federation.record_host_clock("hostB", 10.0, 10.2, 5.0)
+        late = federation.anchor_remote_time("hostB", 9.0,
+                                             (10.0, 10.2))
+        assert late == 10.2
+        # And a skew throwing it BEFORE send clamps to the lo edge.
+        early = federation.anchor_remote_time("hostB", 1.0,
+                                              (10.0, 10.2))
+        assert early == 10.0
+
+    def test_no_offset_degrades_to_none(self):
+        # A peer answering hello WITHOUT the anchor field (an older
+        # build): record_host_clock declines, anchoring degrades to
+        # None, and callers skip the graft instead of erroring.
+        assert federation.record_host_clock("hostB", 1.0, 1.1,
+                                            None) is None
+        assert federation.host_clock_offset("hostB") is None
+        assert federation.anchor_remote_time("hostB", 5.0,
+                                             (1.0, 1.1)) is None
+
+    def test_garbage_anchor_fields_degrade_to_none(self):
+        assert federation.record_host_clock("hostB", 1.0, 1.1,
+                                            "soon") is None
+        assert federation.record_host_clock("", 1.0, 1.1, 5.0) is None
+        federation.record_host_clock("hostB", 1.0, 1.1, 5.0)
+        assert federation.anchor_remote_time("hostB", "soon",
+                                             (1.0, 1.1)) is None
+
+    def test_reexchange_overwrites_offset(self):
+        # Offsets re-derive on every exchange, bounding drift by the
+        # gossip interval: the newest exchange wins.
+        federation.record_host_clock("hostB", 10.0, 10.2, 500.0)
+        federation.record_host_clock("hostB", 20.0, 20.2, 600.0)
+        assert federation.host_clock_offset("hostB") == \
+            pytest.approx(20.1 - 600.0)
+
+    def test_hello_handler_answers_clock_and_host(self):
+        manifest = federation.FleetManifest(
+            [federation.MemberSpec(name="a0", host="hostA")],
+            version=1, ring_seed="s")
+        federation.install(manifest, self_host="hostA")
+        resp = federation.handle_manifest_hello(
+            {"manifest_version": 1, "digest": manifest.digest()})
+        assert resp["host"] == "hostA"
+        assert isinstance(resp["clock"], float)
+
+    def test_remote_host_of_gates_on_cross_host(self):
+        manifest = federation.FleetManifest(
+            [federation.MemberSpec(name="a0", host="hostA"),
+             federation.MemberSpec(name="b0", host="hostB",
+                                   address="/tmp/b0.sock")],
+            version=1, ring_seed="s")
+        federation.install(manifest, self_host="hostA")
+        assert federation.remote_host_of("b0") == "hostB"
+        assert federation.remote_host_of("a0") == ""   # same host
+        assert federation.remote_host_of("zz") == ""   # unknown
+        federation.uninstall()
+        assert federation.remote_host_of("b0") == ""   # no manifest
+
+    def test_uninstall_clears_clocks(self):
+        federation.record_host_clock("hostB", 1.0, 1.2, 50.0)
+        federation.uninstall()
+        assert federation.host_clocks() == {}
+
+
+# ------------------------------------------------ fleet-level SLO burn
+
+def _export(err=0, ok=10, slow=0, fast=10, age=1.0,
+            availability_target=0.999, latency_ms=100.0):
+    return {
+        "bucket_s": 5.0,
+        "availability_target": availability_target,
+        "latency_ms": latency_ms,
+        "latency_target": 0.99,
+        "fast_window_s": 60.0,
+        "slow_window_s": 600.0,
+        "buckets": [[age, ok, err, fast, slow]],
+    }
+
+
+class TestFleetSloStats:
+    def test_ingest_rejects_empty_or_disabled_exports(self):
+        fed = telemetry.FleetSloStats()
+        assert not fed.ingest("hostB", {})           # disabled engine
+        assert not fed.ingest("hostB", {"buckets": []})
+        assert not fed.ingest("", _export())
+        assert not fed.ingest("hostB", "nope")
+        assert fed.hosts == {}
+
+    def test_host_bound_drops_and_counts_overflow(self):
+        fed = telemetry.FleetSloStats()
+        for i in range(fed._MAX_HOSTS):
+            assert fed.ingest(f"h{i:02d}", _export())
+        assert not fed.ingest("h-overflow", _export())
+        assert fed.dropped_hosts == 1
+        # A KNOWN host always re-ingests (updates, not growth).
+        assert fed.ingest("h00", _export(err=3))
+        assert len(fed.hosts) == fed._MAX_HOSTS
+
+    def test_burns_expose_the_one_burning_host(self):
+        fed = telemetry.FleetSloStats()
+        now = [100.0]
+        fed.configure(clock=lambda: now[0])
+        fed.ingest("hostA", _export(err=0, ok=100, slow=0, fast=100))
+        fed.ingest("hostB", _export(err=50, ok=50, slow=80, fast=20))
+        doc = fed.burns()
+        assert doc["hosts"]["hostA"]["availability"]["fast"] == 0.0
+        # hostB burns half its requests against a 99.9% target.
+        assert doc["hosts"]["hostB"]["availability"]["fast"] > 100.0
+        # The fleet-wide burn sits between the two, well above zero.
+        fleet = doc["fleet"]["availability"]["fast"]
+        assert 0.0 < fleet < \
+            doc["hosts"]["hostB"]["availability"]["fast"]
+        assert doc["fleet"]["latency"]["fast"] > 0.0
+
+    def test_aged_buckets_fall_out_of_the_fast_window(self):
+        fed = telemetry.FleetSloStats()
+        now = [0.0]
+        fed.configure(clock=lambda: now[0])
+        fed.ingest("hostB", _export(err=10, ok=0, age=1.0))
+        assert fed.burns()["hosts"]["hostB"][
+            "availability"]["fast"] > 0.0
+        now[0] += 120.0                 # past the 60 s fast window
+        doc = fed.burns()
+        assert doc["hosts"]["hostB"]["availability"]["fast"] == 0.0
+        assert doc["hosts"]["hostB"]["availability"]["slow"] > 0.0
+
+    def test_metric_lines_shape_and_emit_when_live(self):
+        fed = telemetry.FleetSloStats()
+        assert fed.metric_lines() == []              # emit-when-live
+        fed.ingest("hostB", _export(err=5, ok=5))
+        lines = fed.metric_lines()
+        assert any(l.startswith("imageregion_fleet_slo_hosts") and
+                   l.endswith(" 1") for l in lines)
+        assert any('imageregion_fleet_slo_burn_rate{slo="availability"'
+                   in l for l in lines)
+        assert any('imageregion_fleet_slo_host_burn_rate{host="hostB"'
+                   in l for l in lines)
+
+    def test_fed_slo_rides_robustness_exposition(self):
+        telemetry.FED_SLO.ingest("hostB", _export(err=5, ok=5))
+        lines = telemetry.robustness_metric_lines()
+        assert any("imageregion_fleet_slo_burn_rate" in l
+                   for l in lines)
+
+
+# ------------------------------------------------------- reset contract
+
+class TestControlPlaneResetContract:
+    def test_reset_clears_decisions_fed_slo_and_ledger(self):
+        decisions.LEDGER.configure(ring_size=64, spool_dir="/tmp/x",
+                                   outcome_horizon_ticks=7,
+                                   host="hostZ")
+        decisions.record("autoscaler", "up", member="m0")
+        telemetry.FED_SLO.ingest("hostB", _export(err=5, ok=5))
+
+        telemetry.reset()
+
+        assert telemetry.DECISIONS.counts == {}
+        assert telemetry.FED_SLO.hosts == {}
+        assert telemetry.FED_SLO.dropped_hosts == 0
+        assert decisions.LEDGER.snapshot() == []
+        st = decisions.LEDGER.status()
+        assert st["records_total"] == 0
+        assert st["spool_dir"] is None
+        assert st["host"] is None
+        assert st["outcome_horizon_ticks"] == 3
+        lines = telemetry.robustness_metric_lines()
+        assert not any("imageregion_decision_total" in l or
+                       "imageregion_fleet_slo" in l for l in lines)

@@ -628,6 +628,40 @@ class TestBenchGate:
         assert verdict["keys"][0]["watermark_record"] == \
             "MULTICHIP_r02.json"
 
+    def test_multichip_forensics_keys_gated_skip_on_null(
+            self, tmp_path, capsys):
+        """--multichip also judges the control-plane forensics
+        acceptance keys: fed_trace_stitched (the stitched cross-host
+        waterfall verdict, 1 or 0) and decision_records (outcome-
+        carrying autoscaler records in the merged ledger).  Records
+        predating the forensics bench skip on null instead of
+        failing; losing the stitch (1 -> 0) fails the gate."""
+        gate = self._gate()
+        curve = {"fleet_tiles_per_sec_m8": 650.0,
+                 "fleet_scaling_efficiency": 0.81}
+        self._write(tmp_path, "MULTICHIP_r01.json",
+                    {"ok": True, **curve})
+        self._write(tmp_path, "MULTICHIP_r02.json",
+                    {"ok": True, **curve,
+                     "fed_trace_stitched": 1, "decision_records": 3})
+        # r01 predates the forensics bench: both new keys skip.
+        assert gate.main(["--multichip", "--dir",
+                          str(tmp_path)]) == 0
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {k["key"]: k["verdict"] for k in verdict["keys"]}
+        assert by_key["fed_trace_stitched"] == "skipped"
+        assert by_key["decision_records"] == "skipped"
+        # A round that lost the stitch regresses 1 -> 0.
+        self._write(tmp_path, "MULTICHIP_r03.json",
+                    {"ok": True, **curve,
+                     "fed_trace_stitched": 0, "decision_records": 3})
+        assert gate.main(["--multichip", "--dir",
+                          str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {k["key"]: k["verdict"] for k in verdict["keys"]}
+        assert by_key["fed_trace_stitched"] == "regression"
+        assert by_key["decision_records"] == "pass"
+
     def test_latency_key_gates_in_the_up_direction(self, tmp_path):
         """p50_service_tile_ms_ex_rtt is a DEFAULT key and judged
         lower-is-better: a >=10% latency INCREASE fails even when
@@ -776,6 +810,10 @@ def _device_config(data_dir, tmp_path=None):
     from omero_ms_image_region_tpu.server.config import AppConfig
     cfg = AppConfig(data_dir=data_dir)
     cfg.renderer.cpu_fallback_max_px = 0   # exercise the batched path
+    # Barrier settlement so device-cost attribution lands before the
+    # request finishes (first-tile-out races it on slow hosts); the
+    # streaming path is gated deterministically in test_wire_v3.
+    cfg.wire.streaming = False
     if tmp_path is not None:
         cfg.telemetry.profile_dir = str(tmp_path / "profiles")
         cfg.telemetry.flight_recorder_dir = str(tmp_path / "flight")
@@ -1048,3 +1086,74 @@ class TestWaterfallTailBreakdown:
         legacy = {"x": {"count": 1, "total_ms": 1.0, "mean_ms": 1.0,
                         "p50_ms": 1.0}}
         assert "x" in mod.render_doc(legacy)
+
+
+# ----------------------------------- cross-host waterfall rendering
+
+class TestFederatedTraceRendering:
+    def test_fed_hop_spans_render_kind_at_host_with_footer(self):
+        """fed.hop spans render as fed:kind@host and the report gains
+        a per-HOST ms footer — the stitched multi-host story the
+        Control-plane forensics runbook documents."""
+        mod = _load_script("trace_report")
+        doc = {
+            "trace_id": "t-fed", "route": "region", "status": 200,
+            "total_ms": 20.0,
+            "spans": [
+                {"name": "service.total", "start_ms": 0.0,
+                 "dur_ms": 20.0},
+                {"name": "fed.hop", "start_ms": 2.0, "dur_ms": 6.0,
+                 "host": "hostB", "member": "b0",
+                 "kind": "shard_transfer", "bytes": 4096},
+                {"name": "fed.hop", "start_ms": 3.0, "dur_ms": 2.0,
+                 "host": "hostB", "member": "b0", "kind": "stage"},
+                {"name": "fed.hop", "start_ms": 10.0, "dur_ms": 1.0,
+                 "host": "hostC", "member": "c0", "kind": "gossip"},
+            ],
+        }
+        out = mod.render_trace(doc)
+        assert "fed:shard_transfer@hostB" in out
+        assert "fed:stage@hostB" in out
+        assert "fed:gossip@hostC" in out
+        # kind/host fold into the marker, not the extras suffix.
+        assert "'kind'" not in out and "'host'" not in out
+        assert "'bytes': 4096" in out
+        # Per-host footer sums each host's span time.
+        assert "hosts: hostB=8.0ms  hostC=1.0ms" in out
+        # The member lane column still works alongside.
+        assert "members=b0,c0" in out
+
+    def test_single_host_trace_has_no_hosts_footer(self):
+        mod = _load_script("trace_report")
+        doc = {"spans": [{"name": "render", "start_ms": 0.0,
+                          "dur_ms": 5.0}]}
+        assert "hosts:" not in mod.render_trace(doc)
+
+    def test_decision_events_marked_and_summed_in_flight_render(self):
+        """decision.<kind> flight events get the ``+`` mark and a
+        control-plane footer keyed kind:verdict."""
+        mod = _load_script("trace_report")
+        doc = {
+            "reason": "test", "pid": 1, "ts": 100.0,
+            "events": [
+                {"ts": 98.0, "kind": "decision.autoscaler",
+                 "verdict": "blocked", "seq": 1, "member": "m0"},
+                {"ts": 99.0, "kind": "decision.gossip",
+                 "verdict": "mismatch", "seq": 2},
+                {"ts": 99.5, "kind": "decision.gossip",
+                 "verdict": "mismatch", "seq": 3},
+                {"ts": 99.9, "kind": "request.shed"},
+            ],
+        }
+        out = mod.render_flight(doc)
+        assert "+ decision.autoscaler" in out
+        assert ("control-plane: decision.autoscaler:blocked=1  "
+                "decision.gossip:mismatch=2") in out
+        # Non-decision events keep their unmarked rendering.
+        assert "+ request.shed" not in out
+
+    def test_flight_render_without_decisions_has_no_footer(self):
+        mod = _load_script("trace_report")
+        doc = {"reason": "r", "pid": 1, "ts": 1.0,
+               "events": [{"ts": 0.5, "kind": "request.shed"}]}
+        assert "control-plane:" not in mod.render_flight(doc)

@@ -44,6 +44,13 @@ def _device_config(data_dir, **kw):
     # Tiny test tiles must exercise the batched device path the traces
     # thread through, not the host-kernel fallback.
     cfg.renderer.cpu_fallback_max_px = 0
+    # Barrier settlement: first-tile-out resolves request futures from
+    # inside the encode, racing the group tail (batch span close,
+    # device_ms attribution) against the request's access line — which
+    # loses on slow hosts.  These tests assert that accounting, so they
+    # run the A/B barrier path; streaming has its own deterministic
+    # gate in test_wire_v3.
+    cfg.wire.streaming = False
     return cfg
 
 
@@ -193,7 +200,11 @@ class TestTracePropagation:
         sock = str(tmp_path / "x.sock")
         conf = tmp_path / "sidecar.yaml"
         conf.write_text(f"data-dir: {json.dumps(data_dir)}\n"
-                        "renderer:\n    cpu-fallback-max-px: 0\n")
+                        "renderer:\n    cpu-fallback-max-px: 0\n"
+                        # Barrier settlement in the device process too:
+                        # the grafted batch span must exist on the wire
+                        # reply, not race the early-settled response.
+                        "wire:\n    streaming: false\n")
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)

@@ -331,6 +331,29 @@ class DeviceRawCache:
                     break
         return out
 
+    def entries_for_route(self, route_key: str):
+        """The restageable entries of ONE routing identity — the
+        hot-key replica staging manifest (``FleetRouter
+        ._stage_replicas`` ships exactly the promoted plane, not the
+        whole shard).  Same entry shape as :meth:`snapshot_entries`,
+        MRU first, no LRU bump."""
+        out = []
+        with self._lock:
+            for key in reversed(self._entries.keys()):   # MRU first
+                if self._route_of.get(key) != route_key:
+                    continue
+                if (not isinstance(key, tuple) or len(key) != 6
+                        or not isinstance(key[0], int)):
+                    continue
+                image_id, z, t, level, region, channels = key
+                out.append({
+                    "key": [image_id, z, t, level, list(region),
+                            list(channels)],
+                    "digest": self._digests_of.get(key),
+                    "route": route_key,
+                })
+        return out
+
 
 def region_key(image_id: int, z: int, t: int, level: int,
                region: Tuple[int, int, int, int],

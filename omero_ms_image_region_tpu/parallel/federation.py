@@ -456,9 +456,22 @@ def local_view(router, self_host: str = "") -> Dict[str, dict]:
         member = router.members.get(name)
         if member is None:
             continue
-        view[name] = {"healthy": bool(member.healthy),
-                      "draining": bool(member.draining),
-                      "ts": now}
+        obs = {"healthy": bool(member.healthy),
+               "draining": bool(member.draining),
+               "ts": now}
+        # Hot-key posture rides the gossip wire: how many promoted
+        # routes this member serves replicas for (duck-typed — drill
+        # routers may predate the hot tier), so peers can see a storm
+        # concentrating on one host before its queues say so.
+        hot_fn = getattr(router, "hot_owned", None)
+        if hot_fn is not None:
+            try:
+                hot = int(hot_fn(name))
+            except Exception:
+                hot = 0
+            if hot:
+                obs["hot"] = hot
+        view[name] = obs
     return view
 
 
@@ -490,11 +503,18 @@ def merge_view(view: dict) -> Dict[str, dict]:
             held = _GOSSIP_VIEW.get(name)
             if held is None or float(obs.get("ts", 0)) \
                     > float(held.get("ts", 0)):
-                _GOSSIP_VIEW[name] = {
+                stored = {
                     "healthy": bool(obs.get("healthy", True)),
                     "draining": bool(obs.get("draining", False)),
                     "ts": float(obs.get("ts", 0)),
                 }
+                try:
+                    hot = int(obs.get("hot", 0))
+                except (TypeError, ValueError):
+                    hot = 0
+                if hot > 0:
+                    stored["hot"] = min(hot, 1 << 20)
+                _GOSSIP_VIEW[name] = stored
     return dict(_GOSSIP_VIEW)
 
 

@@ -46,6 +46,7 @@ import collections
 import hashlib
 import bisect
 import logging
+import math
 import time
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -149,6 +150,77 @@ def _entry_key(entry: dict) -> tuple:
                 tuple(int(c) for c in channels))
     except (KeyError, TypeError, ValueError):
         return (id(entry),)
+
+
+# ------------------------------------------------------------- hot keys
+
+class HeatTracker:
+    """Decayed per-route request-rate tracker (the hot-key detector).
+
+    Each :func:`plane_route_key` observation adds one unit of heat;
+    heat decays exponentially with time constant ``decay_s`` (lazy —
+    applied on read, no timer).  Under a sustained rate of ``r``
+    requests/s a route's heat converges to ``r * decay_s``, so
+    ``threshold`` reads as "this many seconds' worth of one member's
+    demand concentrated on one plane".
+
+    Cardinality is bounded at ``top_k`` routes: a new route may enter
+    a full table only by evicting a COLDER one (its decayed heat below
+    the newcomer's single unit), so the hot set can never be churned
+    out by a long tail of one-hit routes — the same guarantee
+    space-saving top-K sketches give, in the degenerate form that
+    suffices when ``top_k`` is orders of magnitude above the number of
+    simultaneously-hot planes.
+
+    ``clock`` is injectable for deterministic trajectory tests.
+    """
+
+    def __init__(self, threshold: float, decay_s: float,
+                 top_k: int = 128, clock=time.monotonic):
+        self.threshold = float(threshold)
+        self.decay_s = max(1e-3, float(decay_s))
+        self.top_k = max(1, int(top_k))
+        self.clock = clock
+        self._heat: Dict[str, Tuple[float, float]] = {}
+
+    def _decayed(self, heat: float, last: float, now: float) -> float:
+        if now <= last:
+            return heat
+        return heat * math.exp(-(now - last) / self.decay_s)
+
+    def observe(self, route: str) -> float:
+        """Count one request for ``route``; returns its decayed heat
+        including this observation."""
+        now = self.clock()
+        held = self._heat.get(route)
+        if held is None:
+            if len(self._heat) >= self.top_k:
+                coldest = min(
+                    self._heat,
+                    key=lambda r: self._decayed(*self._heat[r], now))
+                if self._decayed(*self._heat[coldest], now) > 1.0:
+                    # Table full of hotter routes: the observation is
+                    # real but untracked — bounded cardinality wins.
+                    return 1.0
+                del self._heat[coldest]
+            heat = 1.0
+        else:
+            heat = self._decayed(held[0], held[1], now) + 1.0
+        self._heat[route] = (heat, now)
+        return heat
+
+    def heat(self, route: str) -> float:
+        """Decayed heat without counting a request (sweeps, explain)."""
+        held = self._heat.get(route)
+        if held is None:
+            return 0.0
+        return self._decayed(held[0], held[1], self.clock())
+
+    def tracked(self) -> int:
+        return len(self._heat)
+
+    def forget(self, route: str) -> None:
+        self._heat.pop(route, None)
 
 
 # -------------------------------------------------------------- members
@@ -264,38 +336,59 @@ class LocalMember:
             return []
         return cache.snapshot_entries(limit)
 
+    async def route_manifest(self, route: str) -> List[dict]:
+        """ONE route's restageable entries (hot-plane replication:
+        the promotion stager hands exactly the hot plane's shard slice
+        to its replicas, not the member's whole manifest)."""
+        cache = getattr(self.services, "raw_cache", None)
+        if cache is None:
+            return []
+        if hasattr(cache, "entries_for_route"):
+            return cache.entries_for_route(route)
+        if not hasattr(cache, "snapshot_entries"):
+            return []
+        return [e for e in cache.snapshot_entries(0)
+                if e.get("route") == route]
+
     # ---- fleet-global byte tier (combined role shares ONE byte-cache
     # chain across members, so these exist for API symmetry and tests;
-    # the router only crosses the wire for REMOTE peers).
+    # the router only crosses the wire for REMOTE peers).  ``tier``
+    # picks the byte namespace: "region" (rendered tiles, the PR 11
+    # identity) or "mask" (ShapeMask PNGs under their cache_key).
 
-    def _byte_stack(self):
-        stack = getattr(getattr(self.services, "caches", None),
-                        "image_region", None)
+    def _byte_stack(self, tier: str = "region"):
+        caches = getattr(self.services, "caches", None)
+        stack = getattr(caches,
+                        "shape_mask" if tier == "mask"
+                        else "image_region", None)
         return stack if (stack is not None
                          and getattr(stack, "enabled", False)) else None
 
-    async def byte_probe(self, keys: List[str]) -> List[bool]:
-        stack = self._byte_stack()
+    async def byte_probe(self, keys: List[str],
+                         tier: str = "region") -> List[bool]:
+        stack = self._byte_stack(tier)
         if stack is None:
             return [False] * len(keys)
         return [(await stack.get(str(k))) is not None for k in keys]
 
     async def byte_fetch(self, key: str, image_id=None,
-                         session=None) -> Optional[bytes]:
-        stack = self._byte_stack()
+                         session=None, tier: str = "region",
+                         obj: str = "Image") -> Optional[bytes]:
+        stack = self._byte_stack(tier)
         if stack is None:
             return None
         data = await stack.get(str(key))
         if data is None or image_id is None:
             return data
         from ..server.handler import check_can_read
-        if not await check_can_read(self.services, "Image",
+        if not await check_can_read(self.services, obj,
                                     int(image_id), session):
             return None
         return data
 
-    async def byte_put(self, key: str, value: bytes) -> bool:
-        stack = self._byte_stack()
+    async def byte_put(self, key: str, value: bytes,
+                       tier: str = "region") -> bool:
+        stack = self._byte_stack(tier)
         if stack is None:
             return False
         await stack.set(str(key), bytes(value))
@@ -488,12 +581,15 @@ class RemoteMember:
     # idempotent-where-safe wire ops; every failure degrades to None/
     # False — the peer tier may only ever REMOVE work).
 
-    async def byte_probe(self, keys: List[str]) -> List[bool]:
+    async def byte_probe(self, keys: List[str],
+                         tier: str = "region") -> List[bool]:
         import json as _json
         try:
+            extra = {"keys": [str(k) for k in keys]}
+            if tier != "region":
+                extra["tier"] = tier
             status, body = await self.client.call(
-                "byte_probe", {}, extra={"keys": [str(k)
-                                                  for k in keys]})
+                "byte_probe", {}, extra=extra)
             if status != 200 or not body:
                 return [False] * len(keys)
             doc = _json.loads(bytes(body).decode())
@@ -504,18 +600,27 @@ class RemoteMember:
             return [False] * len(keys)
 
     async def byte_fetch(self, key: str, image_id=None,
-                         session=None) -> Optional[bytes]:
+                         session=None, tier: str = "region",
+                         obj: str = "Image") -> Optional[bytes]:
         """None = authority MISS (or ACL refusal) — an honest 404;
         transport failures RAISE so the caller can count a fallback
         (a miss means render, a failure means the peer tier is
         degraded — the router's telemetry keeps them distinct)."""
         extra = {"key": str(key)}
+        if tier != "region":
+            # Tier rides the wire only when non-default: a legacy
+            # sidecar ignoring it would serve the WRONG namespace, but
+            # mask keys ("<shape>:<color>...") never collide with
+            # render identity keys, so the worst case is a miss.
+            extra["tier"] = tier
         if image_id is not None:
             # The serving sidecar runs its OWN ACL gate for this
             # session before any byte leaves it — the same
             # contract as the `image` op.
             extra["image_id"] = int(image_id)
             extra["session"] = session
+            if obj != "Image":
+                extra["obj"] = obj
         t0 = time.perf_counter()
         resp_header, payload = await self.client.call_full(
             "byte_fetch", {}, extra=extra)
@@ -526,15 +631,19 @@ class RemoteMember:
             return None
         return bytes(payload)
 
-    async def byte_put(self, key: str, value: bytes) -> bool:
+    async def byte_put(self, key: str, value: bytes,
+                       tier: str = "region") -> bool:
         import hashlib as _hashlib
         try:
             digest = _hashlib.blake2b(bytes(value),
                                       digest_size=16).hexdigest()
+            extra = {"key": str(key), "digest": digest}
+            if tier != "region":
+                extra["tier"] = tier
             t0 = time.perf_counter()
             status, _body = await self.client.call(
                 "byte_put", {}, body=bytes(value),
-                extra={"key": str(key), "digest": digest})
+                extra=extra)
             self._fed_span("byte_put", t0, time.perf_counter(),
                            bytes=len(value))
             return status == 200
@@ -855,7 +964,8 @@ class FleetRouter:
                  peer_fetch: bool = True,
                  peer_timeout_s: float = 0.5,
                  ring_seed: str = "",
-                 wire_handoff: bool = False):
+                 wire_handoff: bool = False,
+                 hotkey=None):
         if not members:
             raise ValueError("fleet needs at least one member")
         if lane_width < 1:
@@ -909,6 +1019,34 @@ class FleetRouter:
         # replay task is exposed so drills/operators can await it.
         self._drain_manifests: Dict[str, List[dict]] = {}
         self.last_undrain_prestage: Optional[asyncio.Task] = None
+        # Hot-plane replication (popularity-aware placement): a
+        # decayed heat tracker over the dispatch stream promotes
+        # past-threshold routes to an R>1 replica set — a
+        # DETERMINISTIC prefix of the ring chain, so every federated
+        # host computes the same set — and reads balance least-queued
+        # across the live replicas.  Writes and byte-tier authority
+        # stay with the ring owner (chain[0]); ``hotkey=None`` or
+        # ``enabled=False`` keeps every pre-replication behavior
+        # bit-exact.
+        self.hotkey = (hotkey if hotkey is not None
+                       and getattr(hotkey, "enabled", False)
+                       and len(self.order) > 1 else None)
+        self._heat: Optional[HeatTracker] = None
+        if self.hotkey is not None:
+            self._heat = HeatTracker(
+                threshold=getattr(self.hotkey, "threshold", 12.0),
+                decay_s=getattr(self.hotkey, "decay_s", 20.0),
+                top_k=getattr(self.hotkey, "top_k", 128))
+        # route -> replica member names (chain prefix; [0] is the ring
+        # owner / write authority).  All bookkeeping is loop-confined
+        # like the queues.
+        self._replica_sets: Dict[str, List[str]] = {}
+        # route -> member names already staged THIS promotion epoch
+        # (cleared on demote): the never-double-stage guard.
+        self._replica_staged: Dict[str, set] = {}
+        # Every route ever promoted (bounded): shard accounting
+        # separates deliberate replication from duplicate staging.
+        self._hot_ever: set = set()
 
     # ----------------------------------------------------------- routing
 
@@ -932,15 +1070,40 @@ class FleetRouter:
         return member.healthy and not member.draining
 
     def owner_of(self, ctx) -> str:
-        """The routable member owning this request's plane (hash-ring-
-        next past down AND draining members).  Full-plane and
-        z-projection jobs pin to the first member — the lane whose
+        """The routable member SERVING this request's plane (hash-
+        ring-next past down AND draining members; least-queued among
+        the live replica set for a promoted hot route).  Full-plane
+        and z-projection jobs pin to the first member — the lane whose
         renderer is the lockstep ``MeshRenderer`` in mesh deployments
         — and never shard."""
         if self._pinned(ctx):
-            chain = list(self.order)     # member 0 first = mesh lane
-        else:
-            chain = self.ring.chain(plane_route_key(ctx))
+            return self._walk_chain(list(self.order))  # 0 = mesh lane
+        return self._serving_member(plane_route_key(ctx))
+
+    def _serving_member(self, route: str, record: bool = False) -> str:
+        """Replica-balanced read routing: a promoted route picks the
+        least-queued of its LIVE replicas (ties break in chain order,
+        so the ring owner wins an idle fleet); drained/dead replicas
+        drop out via the same ``_routable`` verdict as everything
+        else, and a fully-unroutable replica set falls back to the
+        plain chain walk — deaths behave exactly like today."""
+        replicas = self._replica_sets.get(route) \
+            if self._replica_sets else None
+        if replicas:
+            live = [n for n in replicas if self._routable(n)]
+            if live:
+                target = min(
+                    live,
+                    key=lambda n: (len(self._queues[n])
+                                   + self._inflight[n],
+                                   replicas.index(n)))
+                if record and target != replicas[0]:
+                    from ..utils import telemetry
+                    telemetry.HOTKEY.count_balanced(target)
+                return target
+        return self._walk_chain(self.ring.chain(route))
+
+    def _walk_chain(self, chain: List[str]) -> str:
         if not self.failover:
             # Contract symmetry with _fail_queue: failover=false means
             # a dead member's shard FAILS — for queued work and new
@@ -961,6 +1124,197 @@ class FleetRouter:
         # the failure surfaces as the ConnectionError -> 503 contract
         # instead of an unroutable internal error.
         return chain[0]
+
+    # ----------------------------------------------- hot-plane replication
+
+    def _observe_heat(self, route: str) -> None:
+        """One dispatch observation: bump the route's heat, promote it
+        past the threshold, and sweep cooled promotions back down.
+        Loop-confined (dispatch only), like all queue bookkeeping."""
+        heat = self._heat.observe(route)
+        if heat >= self._heat.threshold \
+                and route not in self._replica_sets:
+            self._promote_route(route, heat)
+        self._sweep_hot_routes()
+
+    def _promote_route(self, route: str, heat: float) -> None:
+        """Give a hot route an R>1 replica set: a deterministic PREFIX
+        of its ring chain (chain[0] stays the write / byte-tier
+        authority), then stage the owner's warm slice onto the new
+        replicas through the digest-deduped staging path —
+        fire-and-forget, never blocking the hot dispatch itself."""
+        from ..utils import telemetry
+        chain = self.ring.chain(route)
+        r = min(max(2, int(getattr(self.hotkey, "max_replicas", 2))),
+                len(chain))
+        replicas = chain[:r]
+        self._replica_sets[route] = replicas
+        self._hot_ever.add(route)
+        while len(self._hot_ever) > 4096:
+            self._hot_ever.pop()
+        telemetry.HOTKEY.count_promoted()
+        telemetry.HOTKEY.set_hot_routes(len(self._replica_sets))
+        telemetry.FLIGHT.record("hotkey.promote", route=route[:12],
+                                heat=round(heat, 1),
+                                replicas=",".join(replicas))
+        from ..utils import decisions
+        decisions.record("hotkey", "promoted",
+                         detail={"route": route[:16],
+                                 "heat": round(heat, 2),
+                                 "replicas": list(replicas)})
+        try:
+            task = asyncio.get_running_loop().create_task(
+                self._stage_replicas(route, replicas))
+        except RuntimeError:
+            return                 # no loop (sync tests): lazy warm
+        self._putback_tasks.add(task)
+        task.add_done_callback(self._putback_tasks.discard)
+
+    async def _stage_replicas(self, route: str,
+                              replicas: List[str]) -> int:
+        """Stage the hot route's owner slice onto its replicas.  Each
+        (route, replica) pair stages at most once per promotion epoch
+        (``_replica_staged``), and the staging path itself digest-
+        dedups, so re-promotion after a demote is a residency probe
+        hit — never a duplicate HBM buffer."""
+        from ..utils import telemetry
+        owner = self.members.get(replicas[0])
+        if owner is None:
+            return 0
+        route_fn = getattr(owner, "route_manifest", None)
+        try:
+            if route_fn is not None:
+                entries = await route_fn(route)
+            else:
+                entries = [e for e in await owner.shard_manifest(0)
+                           if e.get("route") == route]
+        except Exception:
+            entries = []
+        staged_members = self._replica_staged.setdefault(route, set())
+        total = 0
+        for name in replicas[1:]:
+            if name in staged_members:
+                # The never-double-stage guard: a second stage of the
+                # same (route, replica) pair in one epoch would be a
+                # bookkeeping bug — counted, visible, asserted == 0.
+                telemetry.HOTKEY.count_duplicate_staged()
+                continue
+            member = self.members.get(name)
+            if member is None or not member.healthy:
+                continue
+            staged_members.add(name)
+            if not entries:
+                # Nothing warm to hand over yet: the replica warms
+                # through its own balanced renders (the same
+                # digest-deduped staging path) — no work to ship.
+                continue
+            try:
+                n = await member.prestage_manifest(entries)
+            except Exception:
+                staged_members.discard(name)
+                continue
+            total += n
+            telemetry.HOTKEY.count_staged(n)
+            telemetry.FLIGHT.record("hotkey.stage", route=route[:12],
+                                    member=name, entries=n)
+        return total
+
+    def _sweep_hot_routes(self) -> None:
+        """Demote promoted routes whose decayed heat fell under the
+        demote fraction of the threshold (hysteresis: promotion at
+        ``threshold``, demotion below ``threshold * demote_fraction``
+        — no flapping at the boundary).  Replica HBM entries are NOT
+        evicted here: reclaim is deferred to the cache-pressure ladder
+        (``evict_to_fraction`` takes cold entries LRU-first), so a
+        re-heating route finds its replicas still warm."""
+        if not self._replica_sets:
+            return
+        demote_at = (self._heat.threshold
+                     * float(getattr(self.hotkey, "demote_fraction",
+                                     0.5)))
+        for route in list(self._replica_sets):
+            if self._heat.heat(route) <= demote_at:
+                self._demote_route(route)
+
+    def _demote_route(self, route: str) -> None:
+        from ..utils import telemetry
+        self._replica_sets.pop(route, None)
+        self._replica_staged.pop(route, None)
+        telemetry.HOTKEY.count_demoted()
+        telemetry.HOTKEY.set_hot_routes(len(self._replica_sets))
+        telemetry.FLIGHT.record("hotkey.demote", route=route[:12])
+        from ..utils import decisions
+        decisions.record("hotkey", "demoted",
+                         detail={"route": route[:16]})
+
+    def shed_replicas(self) -> int:
+        """Demote EVERY promoted route (the cache-pressure ladder's
+        evict step calls this before ``evict_to_fraction``): replicas
+        are pure duplicates, so under memory pressure they are the
+        first HBM the fleet can afford to lose."""
+        routes = list(self._replica_sets)
+        for route in routes:
+            self._demote_route(route)
+        return len(routes)
+
+    def replica_set(self, route: str) -> List[str]:
+        """The route's CURRENT replica set ([owner] when not
+        promoted) — /debug/explain's replica-set line."""
+        replicas = self._replica_sets.get(route)
+        if replicas:
+            return list(replicas)
+        chain = self.ring.chain(route)
+        return chain[:1]
+
+    def route_heat(self, route: str) -> float:
+        return self._heat.heat(route) if self._heat is not None else 0.0
+
+    def is_hot_route(self, route: str) -> bool:
+        return route in self._replica_sets
+
+    def hot_route_count(self) -> int:
+        return len(self._replica_sets)
+
+    def hot_owned(self, name: str) -> int:
+        """Promoted routes whose replica set includes ``name`` (the
+        gossip view's per-member hot figure)."""
+        return sum(1 for reps in self._replica_sets.values()
+                   if name in reps)
+
+    def replica_pressure(self) -> float:
+        """Sustained hot-route demand in units of the promotion
+        threshold: max over promoted routes of heat / threshold.  >= 1
+        while a promoted route is still at promotion heat; grows with
+        demand concentration — the autoscaler's scale-up signal for
+        'one plane is outrunning one member', distinct from plain
+        queue depth."""
+        if self._heat is None or not self._replica_sets:
+            from ..utils import telemetry
+            telemetry.HOTKEY.set_pressure(0.0)
+            return 0.0
+        pressure = max((self._heat.heat(r) / self._heat.threshold
+                        for r in self._replica_sets), default=0.0)
+        from ..utils import telemetry
+        telemetry.HOTKEY.set_pressure(pressure)
+        return pressure
+
+    def local_replica_caches(self, route: str) -> List:
+        """The HBM caches of the LOCAL replicas of a promoted route,
+        balanced-read order (the prefetcher stages a hot route's
+        predicted tiles into every balanced reader, not just the ring
+        owner).  Empty for unpromoted routes."""
+        out = []
+        for name in self._replica_sets.get(route, ()):
+            if not self._routable(name):
+                continue
+            member = self.members[name]
+            if getattr(member, "remote", False):
+                continue
+            cache = getattr(getattr(member, "services", None),
+                            "raw_cache", None)
+            if cache is not None:
+                out.append(cache)
+        return out
 
     def queue_depth(self) -> int:
         """Queued + executing across the whole fleet (what fleet-aware
@@ -1274,7 +1628,15 @@ class FleetRouter:
         if self._closed:
             raise ConnectionError("fleet router is closed")
         self._ensure_lanes()
-        owner = self.owner_of(ctx)
+        if self._heat is not None and not self._pinned(ctx):
+            # Hot-key tier: every dispatched (non-pinned) request
+            # feeds the heat tracker; a promoted route's reads then
+            # balance least-queued across its live replicas.
+            route = plane_route_key(ctx)
+            self._observe_heat(route)
+            owner = self._serving_member(route, record=True)
+        else:
+            owner = self.owner_of(ctx)
         work = _Work(ctx, asyncio.get_running_loop().create_future(),
                      owner, transient.deadline())
         if work.trace_ids:
@@ -1380,6 +1742,86 @@ class FleetRouter:
                                     nbytes=len(data))
             return data
         return None
+
+    @staticmethod
+    def _mask_route(ctx) -> str:
+        """Ring route for a mask's byte authority: its byte-cache key
+        (the storage identity the PR 11 ETag folds), namespaced so a
+        mask and a render identity can never share an arc owner by
+        accident."""
+        return f"mask|{ctx.cache_key()}"
+
+    async def fetch_peer_mask(self, ctx) -> Optional[bytes]:
+        """Federated byte tier for ShapeMask PNGs: probe the mask's
+        ring-authority host over the same idempotent ``byte_fetch``
+        wire op as tiles (``tier=mask``) so a mask rendered on one
+        host is every host's hit.  Only explicit-color masks are
+        byte-cached (the reference's staleness rule), so only those
+        are asked for; local members share THIS host's already-probed
+        ``shape_mask`` stack and are skipped.  None on miss, ACL
+        refusal or any transport failure — the peer tier only ever
+        removes work."""
+        if not self.peer_fetch or not self._has_remote_members \
+                or getattr(ctx, "color", None) is None:
+            return None
+        from ..utils import provenance, telemetry
+        key = str(ctx.cache_key())
+        for name in self.ring.chain(self._mask_route(ctx)):
+            member = self.members[name]
+            if not getattr(member, "remote", False) \
+                    or not (member.healthy or member.draining):
+                continue
+            telemetry.HTTPCACHE.count_peer_probe()
+            try:
+                data = await asyncio.wait_for(
+                    member.byte_fetch(
+                        key, image_id=ctx.shape_id,
+                        session=ctx.omero_session_key,
+                        tier="mask", obj="Mask"),
+                    self.peer_timeout_s)
+            except Exception:
+                telemetry.HTTPCACHE.count_peer_fallback()
+                return None
+            if data is None:
+                return None
+            telemetry.HTTPCACHE.count_peer_hit()
+            telemetry.HTTPCACHE.count_peer_fetch()
+            provenance.mark(ctx, tier="peer", member=name)
+            telemetry.FLIGHT.record("fleet.mask-peer", authority=name,
+                                    nbytes=len(data))
+            return data
+        return None
+
+    def put_peer_mask(self, ctx, data: bytes) -> None:
+        """Ship a just-rendered explicit-color mask PNG to its ring
+        authority's mask byte tier (fire-and-forget ``byte_put``,
+        never blind-retried) — the write-back half of the federated
+        mask tier.  A local authority needs nothing: the render path
+        already wrote this host's shared ``shape_mask`` stack."""
+        if not self.peer_fetch or not self._has_remote_members \
+                or getattr(ctx, "color", None) is None:
+            return
+        from ..utils import telemetry
+        key = str(ctx.cache_key())
+        for name in self.ring.chain(self._mask_route(ctx)):
+            member = self.members[name]
+            if not (member.healthy or member.draining):
+                continue
+            if not getattr(member, "remote", False):
+                return            # local authority: already stored
+            async def put() -> None:
+                try:
+                    if await member.byte_put(key, data, tier="mask"):
+                        telemetry.HTTPCACHE.count_peer_putback()
+                except Exception:
+                    pass           # best-effort by contract
+            try:
+                task = asyncio.get_running_loop().create_task(put())
+            except RuntimeError:
+                return
+            self._putback_tasks.add(task)
+            task.add_done_callback(self._putback_tasks.discard)
+            return
 
     def _byte_putback(self, work: _Work, data: bytes) -> None:
         """A thief finished another member's render: ship the bytes to
@@ -1680,7 +2122,10 @@ class FleetRouter:
         """HBM shard accounting across local members: per-member
         resident planes, and how many content digests are resident on
         MORE than one member (the duplicate-staging figure the fleet
-        exists to hold at ~0)."""
+        exists to hold at ~0).  Digests whose route was DELIBERATELY
+        replicated by the hot-key tier are reported separately
+        (``replicated_digests``) — replication must never masquerade
+        as, nor mask, a duplicate-staging bug."""
         per_member = {}
         seen: Dict[str, int] = {}
         for name in self.order:
@@ -1688,12 +2133,38 @@ class FleetRouter:
             per_member[name] = self.members[name].resident_planes()
             for d in digests:
                 seen[d] = seen.get(d, 0) + 1
+        duplicates = replicated = 0
+        if any(n > 1 for n in seen.values()):
+            routes = (self._local_digest_routes()
+                      if self._hot_ever else {})
+            for d, n in seen.items():
+                if n <= 1:
+                    continue
+                if routes.get(d) in self._hot_ever:
+                    replicated += 1
+                else:
+                    duplicates += 1
         return {
             "members": per_member,
             "resident_digests": len(seen),
-            "duplicate_digests": sum(1 for n in seen.values()
-                                     if n > 1),
+            "duplicate_digests": duplicates,
+            "replicated_digests": replicated,
         }
+
+    def _local_digest_routes(self) -> Dict[str, str]:
+        """digest -> route over every local member's resident entries
+        (accounting only — one locked snapshot per member)."""
+        out: Dict[str, str] = {}
+        for name in self.order:
+            cache = getattr(getattr(self.members[name], "services",
+                                    None), "raw_cache", None)
+            if cache is None or not hasattr(cache, "snapshot_entries"):
+                continue
+            for entry in cache.snapshot_entries(0):
+                digest = entry.get("digest")
+                if digest:
+                    out[digest] = entry.get("route")
+        return out
 
     async def close(self) -> None:
         self._closed = True

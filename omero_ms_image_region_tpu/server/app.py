@@ -527,7 +527,8 @@ def create_app(config: Optional[AppConfig] = None,
                         if config.qos.enabled else 0),
             peer_fetch=(config.http_cache.enabled
                         and config.http_cache.peer_fetch),
-            peer_timeout_s=config.http_cache.peer_timeout_ms / 1000.0)
+            peer_timeout_s=config.http_cache.peer_timeout_ms / 1000.0,
+            hotkey=config.hotkey)
         single_flight = None
         if config.single_flight:
             from .singleflight import SingleFlight
@@ -621,7 +622,8 @@ def create_app(config: Optional[AppConfig] = None,
                             and config.http_cache.peer_fetch),
                 peer_timeout_s=(
                     config.http_cache.peer_timeout_ms / 1000.0),
-                ring_seed=ring_seed, wire_handoff=wire_handoff)
+                ring_seed=ring_seed, wire_handoff=wire_handoff,
+                hotkey=config.hotkey)
             if fed_manifest is not None:
                 from ..parallel.federation import FederationCoordinator
                 federation_coord = FederationCoordinator(
@@ -656,6 +658,11 @@ def create_app(config: Optional[AppConfig] = None,
                     # wire) instead of this host's wrong shard.
                     services.prefetcher.remote_prestage = \
                         fleet_router.remote_prestage_for_route
+                # Hot-route predictions warm every LOCAL replica
+                # shard, not just the ring owner's — a balanced read
+                # on a cold replica would re-read from disk.
+                services.prefetcher.replica_caches = \
+                    fleet_router.local_replica_caches
                 for member in fleet_members[1:]:
                     if getattr(member, "services", None) is not None \
                             and member.services is not services:
@@ -685,7 +692,8 @@ def create_app(config: Optional[AppConfig] = None,
         governor = pressure_mod.PressureGovernor(
             config.pressure,
             pressure_mod.build_actuators(config.pressure,
-                                         services=services),
+                                         services=services,
+                                         router=fleet_router),
             pressure_mod.build_sources(services=services,
                                        router=fleet_router,
                                        governor_ref=_gov_ref))
@@ -1213,6 +1221,21 @@ def create_app(config: Optional[AppConfig] = None,
             if cached_mask is not None:
                 _stamp_provenance(ctx, headers)
                 return web.Response(body=cached_mask, headers=headers)
+        # Federated mask byte tier (PR 11 contract, mask leg): on a
+        # local miss, ask the mask identity's ring OWNER for its
+        # cached PNG before paying the rasterize — the owner's ACL
+        # gate runs on its host, and a miss/timeout just falls
+        # through to the local render.
+        peer_mask = (getattr(fleet_router, "fetch_peer_mask", None)
+                     if fleet_router is not None else None)
+        if peer_mask is not None:
+            try:
+                peer_png = await peer_mask(ctx)
+            except Exception:
+                peer_png = None
+            if peer_png is not None:
+                _stamp_provenance(ctx, headers)
+                return web.Response(body=peer_png, headers=headers)
         mask_admission = (getattr(image_handler, "admission", None)
                           or (services.admission
                               if services is not None else None))
@@ -1234,6 +1257,16 @@ def create_app(config: Optional[AppConfig] = None,
             # exists to close.  (Masks have no GLOBAL admission leg,
             # so there is no shed-class refund here at all.)
             return _status_of(e)
+        # Write-back to the mask identity's byte-tier authority
+        # (fire-and-forget; only explicit-color masks are cacheable —
+        # the same rule ShapeMaskHandler applies locally).
+        put_mask = (getattr(fleet_router, "put_peer_mask", None)
+                    if fleet_router is not None else None)
+        if put_mask is not None:
+            try:
+                put_mask(ctx, body)
+            except Exception:
+                log.debug("peer mask put failed", exc_info=True)
         _stamp_provenance(ctx, headers)
         return web.Response(body=body, headers=headers)
 

@@ -149,18 +149,47 @@ class Autoscaler:
         level = self.governor.level if self.governor is not None else 0
         capacity_tps = (len(routable) * self.router.lane_width
                         * self.config.lane_capacity_tps)
+        # Hot-key replica pressure (parallel.fleet): the hottest
+        # promoted route's heat in promotion-threshold units —
+        # "one plane is outrunning one member", a reason to grow that
+        # plain queue depth can miss while balancing absorbs the skew.
+        replica_fn = getattr(self.router, "replica_pressure", None)
+        replica_pressure = 0.0
+        if replica_fn is not None:
+            try:
+                replica_pressure = float(replica_fn() or 0.0)
+            except Exception:
+                replica_pressure = 0.0
         return {
             "queue_depth": depth,
             "queue_per_lane": depth / lanes,
             "pressure_level": level,
             "demand_tps": demand,
             "capacity_tps": capacity_tps,
+            "replica_pressure": replica_pressure,
         }
+
+    def _hot_scale_factor(self) -> float:
+        """The replica-pressure scale-up trigger (``hotkey.scale-
+        factor`` off the router's config; 0 disables)."""
+        hotkey = getattr(self.router, "hotkey", None)
+        try:
+            return float(getattr(hotkey, "scale_factor", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
 
     def _wants(self, sig: dict) -> Optional[str]:
         c = self.config
         up = sig["queue_per_lane"] >= c.queue_high_per_lane
         if sig["pressure_level"] >= 2:       # critical: grow early
+            up = True
+        hot_factor = self._hot_scale_factor()
+        if hot_factor > 0 \
+                and sig.get("replica_pressure", 0.0) >= hot_factor:
+            # Sustained demand on one plane is holding multiples of
+            # the promotion threshold: replicas are absorbing it for
+            # now, but the set is bounded — grow the fleet so the
+            # chain prefix has more members to spread over.
             up = True
         demand = sig["demand_tps"]
         if (demand is not None and c.lane_capacity_tps > 0
@@ -194,6 +223,8 @@ class Autoscaler:
             "pressure_level": sig["pressure_level"],
             "demand_tps": sig["demand_tps"],
             "capacity_tps": sig["capacity_tps"],
+            "replica_pressure": round(
+                sig.get("replica_pressure", 0.0), 4),
         }
 
     def _decide(self, verdict: str, sig: dict, member: str = "",

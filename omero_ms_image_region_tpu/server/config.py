@@ -199,6 +199,38 @@ class FleetConfig:
 
 
 @dataclass
+class HotkeyConfig:
+    """Hot-plane replication (``parallel.fleet`` popularity tier) —
+    survive the viral image: routes whose decayed request heat passes
+    ``threshold`` get an R>1 replica set drawn deterministically from
+    the ring chain, reads balance least-queued across live replicas,
+    and heat decay demotes back to R=1 (replica HBM reclaimed by the
+    cache-pressure ladder, not eagerly).  See deploy/DEPLOY.md
+    "Hot objects"."""
+
+    enabled: bool = False
+    # Promotion threshold in units of decayed requests: under a
+    # sustained rate of r req/s a route's heat converges to
+    # r * decay_s, so the default promotes a plane holding more than
+    # ~12/decay_s req/s of one member's demand.
+    threshold: float = 12.0
+    # Heat decay time constant (seconds): how fast popularity ages
+    # out.  Demotion happens below threshold * demote_fraction.
+    decay_s: float = 20.0
+    # Replica-set size for promoted routes (chain prefix, owner
+    # included): 2 = owner + one replica.  Capped by fleet size.
+    max_replicas: int = 2
+    # Bounded heat-table cardinality (top-K routes tracked).
+    top_k: int = 128
+    # Hysteresis: demote when heat falls below threshold * this.
+    demote_fraction: float = 0.5
+    # Autoscaler coupling: replica pressure (hottest route's heat /
+    # threshold) at or past this factor wants a scale-up, distinct
+    # from queue depth.  0 disables the signal.
+    scale_factor: float = 2.0
+
+
+@dataclass
 class FederationConfig:
     """Cross-host fleet federation (``parallel.federation``) — the
     rack-scale Hazelcast analogue: the fleet's membership becomes a
@@ -428,6 +460,12 @@ class LoadModelConfig:
     mask_fraction: float = 0.0
     # Fraction of pan steps that change zoom level.
     zoom_fraction: float = 0.05
+    # Trending-traffic skew: each session picks its image from a
+    # zipf(s=skew) rank-frequency law over ``image_population`` ranks
+    # (rank 0 hottest).  0 (or a population of 1) keeps every session
+    # on image rank 0 — the pre-skew stream, bit-exact.
+    skew: float = 0.0
+    image_population: int = 1
 
 
 @dataclass
@@ -746,6 +784,7 @@ class AppConfig:
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    hotkey: HotkeyConfig = field(default_factory=HotkeyConfig)
     federation: FederationConfig = field(
         default_factory=FederationConfig)
     sidecar: SidecarConfig = field(default_factory=SidecarConfig)
@@ -986,6 +1025,37 @@ class AppConfig:
             raise ValueError("fleet.hash-replicas must be >= 1")
         if cfg.fleet.down_cooldown_s < 0:
             raise ValueError("fleet.down-cooldown-s must be >= 0")
+        hk = raw.get("hotkey", {}) or {}
+        hk_defaults = HotkeyConfig()
+        cfg.hotkey = HotkeyConfig(
+            enabled=bool(hk.get("enabled", hk_defaults.enabled)),
+            threshold=float(hk.get("threshold",
+                                   hk_defaults.threshold)),
+            decay_s=float(hk.get("decay-s", hk_defaults.decay_s)),
+            max_replicas=int(hk.get("max-replicas",
+                                    hk_defaults.max_replicas)),
+            top_k=int(hk.get("top-k", hk_defaults.top_k)),
+            demote_fraction=float(hk.get(
+                "demote-fraction", hk_defaults.demote_fraction)),
+            scale_factor=float(hk.get("scale-factor",
+                                      hk_defaults.scale_factor)),
+        )
+        if cfg.hotkey.threshold <= 0:
+            raise ValueError("hotkey.threshold must be > 0")
+        if cfg.hotkey.decay_s <= 0:
+            raise ValueError("hotkey.decay-s must be > 0")
+        if cfg.hotkey.max_replicas < 2:
+            raise ValueError("hotkey.max-replicas must be >= 2 "
+                             "(R=1 is the unreplicated ring)")
+        if cfg.hotkey.top_k < 1:
+            raise ValueError("hotkey.top-k must be >= 1")
+        if not 0.0 < cfg.hotkey.demote_fraction < 1.0:
+            raise ValueError("hotkey.demote-fraction must be in "
+                             "(0, 1) — the promotion/demotion "
+                             "hysteresis band")
+        if cfg.hotkey.scale_factor < 0:
+            raise ValueError("hotkey.scale-factor must be >= 0 "
+                             "(0 disables the autoscaler signal)")
         fe = raw.get("federation", {}) or {}
         fe_defaults = FederationConfig()
         members_raw = fe.get("members", ()) or ()
@@ -1033,14 +1103,22 @@ class AppConfig:
                 raise ValueError("federation.members names must be "
                                  "unique fleet-wide")
             if not cfg.federation.host:
-                raise ValueError("federation.enabled requires "
-                                 "federation.host (this process's "
-                                 "host identity)")
+                # Default this process's identity from the cluster
+                # layer (``procN`` when jax.distributed is joined,
+                # else the OS hostname) — multi-host manifests stop
+                # needing an explicit host string per process.  It
+                # must still name a manifest member; the check below
+                # catches a hostname the manifest never heard of.
+                from ..parallel.cluster import host_identity
+                cfg.federation.host = host_identity()
             hosts = {m["host"] for m in cfg.federation.members}
             if cfg.federation.host not in hosts:
                 raise ValueError(
                     f"federation.host {cfg.federation.host!r} owns no "
-                    f"manifest member (hosts: {sorted(hosts)})")
+                    f"manifest member (hosts: {sorted(hosts)}); set "
+                    f"federation.host explicitly, or name manifest "
+                    f"hosts by cluster.host_identity() — the default "
+                    f"when the key is omitted")
             # NOTE: remote members' addresses are validated where the
             # topology is actually built (build_federated_members —
             # only a process that ROUTES needs to reach them; a
@@ -1156,6 +1234,9 @@ class AppConfig:
                 "mask-fraction", lm_defaults.mask_fraction)),
             zoom_fraction=float(lm.get(
                 "zoom-fraction", lm_defaults.zoom_fraction)),
+            skew=float(lm.get("skew", lm_defaults.skew)),
+            image_population=int(lm.get(
+                "image-population", lm_defaults.image_population)),
         )
         # The generator itself re-validates at construction; failing
         # at config load keeps a bad block out of a bench round.
@@ -1181,6 +1262,12 @@ class AppConfig:
                 + cfg.loadmodel.mask_fraction) > 1.0:
             raise ValueError("loadmodel bulk-fraction + mask-fraction "
                              "must be <= 1")
+        if cfg.loadmodel.skew < 0:
+            raise ValueError("loadmodel.skew must be >= 0 "
+                             "(0 = every session on one image)")
+        if cfg.loadmodel.image_population < 1:
+            raise ValueError("loadmodel.image-population must be "
+                             ">= 1")
         au = raw.get("autoscaler", {}) or {}
         au_defaults = AutoscalerConfig()
         cfg.autoscaler = AutoscalerConfig(

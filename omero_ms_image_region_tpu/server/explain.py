@@ -123,6 +123,17 @@ async def explain(path: str, config, services=None, fleet_router=None,
             "serving": fleet_router.owner_of(ctx),
             "draining": fleet_router.draining_members(),
         }
+        # Hot-key tier (duck-typed: drill routers may predate it):
+        # the route's CURRENT replica set and decayed heat — the storm
+        # triage line ("is this plane promoted, and onto whom?").
+        replica_fn = getattr(fleet_router, "replica_set", None)
+        if replica_fn is not None and not pinned:
+            replicas = replica_fn(route_key)
+            doc["ring"]["replicas"] = replicas
+            doc["ring"]["hot"] = len(replicas) > 1
+            heat_fn = getattr(fleet_router, "route_heat", None)
+            if heat_fn is not None:
+                doc["ring"]["heat"] = round(heat_fn(route_key), 2)
 
     # ---- federation posture: epoch, agreement, fork status.  The
     # explain answer must say which manifest the fleet is ROUTING

@@ -524,11 +524,14 @@ def build_sources(services=None, renderer=None, router=None,
     }
 
 
-def build_actuators(config, services=None, renderer=None
+def build_actuators(config, services=None, renderer=None, router=None
                     ) -> Dict[str, StepActuator]:
     """The standard actuator set.  Flag-only steps (``drop_quality``,
     ``shed_bulk``, ``tighten_admission``) carry no actuator — their
-    consumers query the governor directly."""
+    consumers query the governor directly.  ``router`` (a FleetRouter)
+    lets the evict step demote hot-route replica sets first: replica
+    HBM is the cheapest thing to give back under pressure (the ring
+    owner still holds the plane)."""
     prefetcher = getattr(services, "prefetcher", None)
     warmstate = getattr(services, "warmstate", None)
     raw_cache = getattr(services, "raw_cache", None)
@@ -553,6 +556,16 @@ def build_actuators(config, services=None, renderer=None
             engage=_ws(True), release=_ws(False))
 
     def evict():
+        # Replica demotion FIRST: hot-route replica planes are
+        # redundant by construction (the ring owner keeps its copy),
+        # so shedding them turns the subsequent LRU pass into the one
+        # that reclaims them — the "eviction deferred to cache
+        # pressure" half of the hot-key lifecycle.
+        if router is not None and hasattr(router, "shed_replicas"):
+            try:
+                router.shed_replicas()
+            except Exception:
+                log.debug("replica shed failed", exc_info=True)
         frac = config.evict_to_frac
         if raw_cache is not None and hasattr(raw_cache,
                                              "evict_to_fraction"):
@@ -560,7 +573,7 @@ def build_actuators(config, services=None, renderer=None
         if disk is not None and hasattr(disk, "evict_to_fraction"):
             disk.evict_to_fraction(frac)
 
-    if raw_cache is not None or disk is not None:
+    if raw_cache is not None or disk is not None or router is not None:
         actuators["evict_caches"] = StepActuator(
             engage=evict, while_engaged=evict)
 

@@ -315,6 +315,20 @@ class FrameWriter:
 
 # ---------------------------------------------------------------- server
 
+def _byte_stack(image_handler, header: dict):
+    """Resolve the byte-cache chain a byte op addresses.  The default
+    (and the only tier a pre-mask-federation peer ever sends) is the
+    render byte tier; ``tier: "mask"`` addresses the shape-mask PNG
+    chain — mask keys derive from ``ShapeMaskCtx.cache_key()`` and can
+    never collide with render identities, so a legacy sidecar that
+    ignores the tier answers a harmless miss, never wrong bytes."""
+    handler_services = getattr(image_handler, "s", None)
+    caches = getattr(handler_services, "caches", None)
+    name = ("shape_mask" if str(header.get("tier") or "region")
+            == "mask" else "image_region")
+    return handler_services, getattr(caches, name, None)
+
+
 async def _plane_put(image_handler, header: dict,
                      req_body: bytes) -> bytes:
     """Stage a wire-pushed plane into the device cache (protocol v2).
@@ -703,9 +717,8 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 # like plane_probe — N keys, one wire round-trip.
                 # Presence only: no ACL (the key derives from request
                 # params, never pixels), no bytes move.
-                handler_services = getattr(image_handler, "s", None)
-                stack = getattr(getattr(handler_services, "caches",
-                                        None), "image_region", None)
+                handler_services, stack = _byte_stack(image_handler,
+                                                      header)
                 enabled = bool(stack is not None
                                and getattr(stack, "enabled", False))
                 keys = header.get("keys")
@@ -725,9 +738,8 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 # never leave a sidecar a session could not read).
                 # Misses answer 404; MB-scale bodies ride the shm ring
                 # like any response body.
-                handler_services = getattr(image_handler, "s", None)
-                stack = getattr(getattr(handler_services, "caches",
-                                        None), "image_region", None)
+                handler_services, stack = _byte_stack(image_handler,
+                                                      header)
                 key = str(header.get("key") or "")
                 data = (await stack.get(key)
                         if stack is not None and key else None)
@@ -736,12 +748,19 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 image_id = header.get("image_id")
                 if image_id is not None \
                         and handler_services is not None:
+                    # The ACL object type follows the tier: mask
+                    # fetches gate on the Mask's own readability (the
+                    # exact check ShapeMaskHandler applies locally).
+                    obj = str(header.get("obj") or "Image")
+                    if obj not in ("Image", "Mask"):
+                        raise BadRequestError(
+                            f"byte_fetch obj {obj!r} unsupported")
                     from .handler import check_can_read
                     if not await check_can_read(
-                            handler_services, "Image", int(image_id),
+                            handler_services, obj, int(image_id),
                             header.get("session")):
                         raise NotFoundError(
-                            f"Cannot find Image:{image_id}")
+                            f"Cannot find {obj}:{image_id}")
                 body = bytes(data)
             elif op == "byte_put":
                 # Peer write-back (a thief's render landing on its
@@ -749,9 +768,8 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 # NEVER auto-retried by the client, and the body is
                 # digest-verified so a corrupt frame can never poison
                 # the byte tier under a healthy key.
-                handler_services = getattr(image_handler, "s", None)
-                stack = getattr(getattr(handler_services, "caches",
-                                        None), "image_region", None)
+                handler_services, stack = _byte_stack(image_handler,
+                                                      header)
                 key = str(header.get("key") or "")
                 if not key:
                     raise BadRequestError("byte_put requires a key")

@@ -44,6 +44,7 @@ imports device code.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import heapq
 import math
 import random
@@ -73,7 +74,11 @@ class Arrival:
     model's NATURAL timeline; :meth:`LoadModel.schedule` rescales it
     to a target offered rate.  ``x``/``y``/``level`` address the tile
     lattice for interactive arrivals (bulk renders the full plane;
-    masks address ``shape_id = step``-derived ids)."""
+    masks address ``shape_id = step``-derived ids).  ``image`` is the
+    POPULARITY RANK of the image the session browses (0 = hottest),
+    drawn once per session from the model's zipf skew — the hot-key
+    storm input (``bench.py --smoke --hotkey``); 0 for every arrival
+    when the model is unskewed (the pre-skew single-image stream)."""
 
     t: float
     session: str
@@ -82,6 +87,7 @@ class Arrival:
     x: int = 0
     y: int = 0
     level: int = 0
+    image: int = 0
 
 
 class LoadModel:
@@ -97,7 +103,9 @@ class LoadModel:
                  bulk_fraction: float = 0.0,
                  mask_fraction: float = 0.0,
                  zoom_fraction: float = 0.05,
-                 max_level: int = 0):
+                 max_level: int = 0,
+                 skew: float = 0.0,
+                 image_population: int = 1):
         if viewers < 1:
             raise ValueError("loadmodel viewers must be >= 1")
         if duration_s <= 0:
@@ -119,6 +127,10 @@ class LoadModel:
         if bulk_fraction + mask_fraction > 1.0:
             raise ValueError("loadmodel bulk_fraction + mask_fraction "
                              "must be <= 1")
+        if skew < 0:
+            raise ValueError("loadmodel skew must be >= 0")
+        if image_population < 1:
+            raise ValueError("loadmodel image_population must be >= 1")
         self.viewers = int(viewers)
         self.seed = int(seed)
         self.duration_s = float(duration_s)
@@ -132,6 +144,22 @@ class LoadModel:
         self.mask_fraction = float(mask_fraction)
         self.zoom_fraction = float(zoom_fraction)
         self.max_level = int(max_level)
+        self.skew = float(skew)
+        self.image_population = int(image_population)
+        # Popularity CDF over image ranks: zipf weights 1/(k+1)^s
+        # (rank 0 hottest; s=0 degenerates to uniform).  Precomputed
+        # once — a million sessions bisect the same table.
+        self._image_cdf: Optional[List[float]] = None
+        if self.image_population > 1:
+            weights = [1.0 / (k + 1) ** self.skew
+                       for k in range(self.image_population)]
+            total = sum(weights)
+            acc, cdf = 0.0, []
+            for w in weights:
+                acc += w / total
+                cdf.append(acc)
+            cdf[-1] = 1.0
+            self._image_cdf = cdf
 
     @classmethod
     def from_config(cls, config, **structural) -> "LoadModel":
@@ -150,7 +178,9 @@ class LoadModel:
             diurnal_amplitude=config.diurnal_amplitude,
             bulk_fraction=config.bulk_fraction,
             mask_fraction=config.mask_fraction,
-            zoom_fraction=config.zoom_fraction)
+            zoom_fraction=config.zoom_fraction,
+            skew=config.skew,
+            image_population=config.image_population)
         kwargs.update(structural)
         return cls(**kwargs)
 
@@ -191,6 +221,15 @@ class LoadModel:
         other sessions are interleaved around it."""
         rng = random.Random((self.seed << 20) ^ i)
         session = f"sim-{i}"
+        # The session's image rank comes from a SEPARATE derived RNG:
+        # turning the skew knob must not shift the trajectory/timing
+        # stream (pinned: same seed -> byte-identical arrivals modulo
+        # the ``image`` field), and population==1 consumes no draw at
+        # all so the pre-skew stream stays bit-exact.
+        image = 0
+        if self._image_cdf is not None:
+            u = random.Random(f"img|{self.seed}|{i}").random()
+            image = bisect.bisect_left(self._image_cdf, u)
         t = self._warp(rng.random())
         n = max(1, int(rng.lognormvariate(
             math.log(self.session_length_median),
@@ -209,7 +248,7 @@ class LoadModel:
             else:
                 cls = "interactive"
             yield Arrival(t=t, session=session, cls=cls, step=step,
-                          x=x, y=y, level=level)
+                          x=x, y=y, level=level, image=image)
             # Advance the viewport: constant-velocity pan runs with
             # occasional turns (the trajectory shape the PR 10
             # predictor reads), rare zoom level changes.
@@ -265,7 +304,8 @@ class LoadModel:
             return []
         scale = natural / offered_tps
         return [Arrival(t=a.t * scale, session=a.session, cls=a.cls,
-                        step=a.step, x=a.x, y=a.y, level=a.level)
+                        step=a.step, x=a.x, y=a.y, level=a.level,
+                        image=a.image)
                 for a in evs]
 
     def window(self, offered_tps: float, window_s: float,
@@ -313,11 +353,12 @@ class LoadModel:
             return [Arrival(t=0.0, session=take[0].session,
                             cls=take[0].cls, step=take[0].step,
                             x=take[0].x, y=take[0].y,
-                            level=take[0].level)]
+                            level=take[0].level,
+                            image=take[0].image)]
         scale = window_s / max(take[-1].t - t_lo, 1e-9)
         return [Arrival(t=(a.t - t_lo) * scale, session=a.session,
                         cls=a.cls, step=a.step, x=a.x, y=a.y,
-                        level=a.level)
+                        level=a.level, image=a.image)
                 for a in take]
 
 

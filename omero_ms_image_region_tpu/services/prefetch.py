@@ -97,6 +97,13 @@ class TilePrefetcher:
         # request, never this host's wrong shard.  Installed by
         # create_app for federated fleets; absent everywhere else.
         self.remote_prestage = None
+        # Hot-key seam (``FleetRouter.local_replica_caches``): a
+        # promoted route is read-balanced across an R>1 replica set,
+        # so its predictions must warm EVERY local replica shard — a
+        # balanced read landing on a cold replica re-reads from disk
+        # and the promotion buys nothing.  Empty/None for unpromoted
+        # routes and non-fleet deployments.
+        self.replica_caches: Optional[Callable] = None
         self.lookahead = max(1, int(lookahead))
         # Local budget scale in [0, 1]; multiplied with the pressure
         # governor's prefetch_budget().  The brownout ladder's
@@ -266,35 +273,52 @@ class TilePrefetcher:
                     if self.remote_prestage(route, entry):
                         self.predicted += 1
                         continue
-            if cache is None or key in cache:
-                continue   # already resident: no pool churn
-            with self._lock:
-                if key in self._pending:
-                    # Already in flight: dedupe, not a budget signal
-                    # — counting it as one would read as exhaustion
-                    # on dashboards while slots sit free.
-                    continue
-                if len(self._pending) >= budget:
-                    telemetry.PREFETCH.count_skipped("budget")
-                    continue
-                self._pending.add(key)
-            try:
-                future = self._pool.submit(self._load, src, cache, key,
-                                           route, nz, nt, level, region,
-                                           active)
-            except RuntimeError:   # pool shut down mid-request
+            # Hot-route replication: when the router promoted this
+            # route, stage the prediction into every LOCAL replica
+            # shard, not just the routed owner's.
+            targets = [cache]
+            if self.replica_caches is not None:
+                try:
+                    reps = list(self.replica_caches(route) or ())
+                except Exception:
+                    reps = []
+                targets += [c for c in reps if c is not cache]
+            for tcache in targets:
+                # Replica stagings carry a per-cache token so two
+                # shards can hold the same key in flight at once.
+                token = key if tcache is cache else (id(tcache), key)
+                if tcache is None or key in tcache:
+                    continue   # already resident: no pool churn
                 with self._lock:
-                    self._pending.discard(key)
-                return
-            self.scheduled += 1
-            telemetry.PREFETCH.count_scheduled()
-            with self._lock:
-                self._futures.add(future)
-            future.add_done_callback(
-                lambda f: self._futures.discard(f))
+                    if token in self._pending:
+                        # Already in flight: dedupe, not a budget
+                        # signal — counting it as one would read as
+                        # exhaustion on dashboards while slots sit
+                        # free.
+                        continue
+                    if len(self._pending) >= budget:
+                        telemetry.PREFETCH.count_skipped("budget")
+                        continue
+                    self._pending.add(token)
+                try:
+                    future = self._pool.submit(
+                        self._load, src, tcache, key, route, nz, nt,
+                        level, region, active, token)
+                except RuntimeError:   # pool shut down mid-request
+                    with self._lock:
+                        self._pending.discard(token)
+                    return
+                self.scheduled += 1
+                telemetry.PREFETCH.count_scheduled()
+                with self._lock:
+                    self._futures.add(future)
+                future.add_done_callback(
+                    lambda f: self._futures.discard(f))
 
     def _load(self, src, cache, key, route, z: int, t: int, level: int,
-              region, active: Sequence[int]) -> None:
+              region, active: Sequence[int], token=None) -> None:
+        if token is None:
+            token = key
         try:
             # Budget changes bind QUEUED work too: an item whose turn
             # comes after the budget hit zero exits without touching
@@ -321,7 +345,7 @@ class TilePrefetcher:
             logger.debug("prefetch failed for %s: %r", key, e)
         finally:
             with self._lock:
-                self._pending.discard(key)
+                self._pending.discard(token)
 
     def flush(self, timeout: float = 10.0) -> None:
         """Wait for in-flight prefetches (tests/shutdown).  Paused

@@ -1700,6 +1700,118 @@ class FleetStats:
 FLEET = FleetStats()
 
 
+class HotkeyStats:
+    """Hot-plane replication accounting (``parallel.fleet``'s
+    popularity tier): promotion/demotion lifecycle counters, replica
+    staging volume, the never-double-stage violation counter (held at
+    0 by the bench gate), per-member balanced-read counters (closed
+    label set like :class:`FleetStats`), and the hot-route /
+    replica-pressure gauges the autoscaler and runbook read."""
+
+    _MAX_MEMBERS = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.promoted = 0
+        self.demoted = 0
+        self.staged = 0
+        self.duplicate_staged = 0
+        self.balanced: Dict[str, int] = {}
+        self.hot_routes = 0
+        self.replica_pressure = 0.0
+
+    def count_promoted(self) -> None:
+        with self._lock:
+            self.promoted += 1
+
+    def count_demoted(self) -> None:
+        with self._lock:
+            self.demoted += 1
+
+    def count_staged(self, n: int = 1) -> None:
+        with self._lock:
+            self.staged += int(n)
+
+    def count_duplicate_staged(self) -> None:
+        with self._lock:
+            self.duplicate_staged += 1
+
+    def count_balanced(self, member: str) -> None:
+        """``member`` is a NON-OWNER replica that served a balanced
+        read (owner-served reads are plain routed traffic)."""
+        with self._lock:
+            if member not in self.balanced \
+                    and len(self.balanced) >= self._MAX_MEMBERS:
+                member = "_overflow"
+            self.balanced[member] = self.balanced.get(member, 0) + 1
+
+    def set_hot_routes(self, n: int) -> None:
+        with self._lock:
+            self.hot_routes = int(n)
+
+    def set_pressure(self, value: float) -> None:
+        with self._lock:
+            self.replica_pressure = float(value)
+
+    def totals(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "promoted": self.promoted,
+                "demoted": self.demoted,
+                "staged": self.staged,
+                "duplicate_staged": self.duplicate_staged,
+                "balanced": sum(self.balanced.values()),
+                "hot_routes": self.hot_routes,
+                "replica_pressure": self.replica_pressure,
+            }
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+        suffix = ("{" + extra + "}") if extra else ""
+
+        def label(member: str) -> str:
+            inner = f'member="{member}"' + (("," + extra) if extra
+                                            else "")
+            return "{" + inner + "}"
+
+        lines: List[str] = []
+        with self._lock:
+            if not (self.promoted or self.demoted or self.staged
+                    or self.duplicate_staged or self.balanced
+                    or self.hot_routes or self.replica_pressure):
+                return lines       # tier never engaged: no series
+            lines.append("imageregion_hotkey_promotions_total"
+                         f"{suffix} {self.promoted}")
+            lines.append("imageregion_hotkey_demotions_total"
+                         f"{suffix} {self.demoted}")
+            lines.append("imageregion_hotkey_replica_staged_total"
+                         f"{suffix} {self.staged}")
+            lines.append("imageregion_hotkey_duplicate_staged_total"
+                         f"{suffix} {self.duplicate_staged}")
+            lines.append(f"imageregion_hotkey_hot_routes{suffix} "
+                         f"{self.hot_routes}")
+            lines.append("imageregion_hotkey_replica_pressure"
+                         f"{suffix} {self.replica_pressure:.3f}")
+            for member in sorted(self.balanced):
+                lines.append("imageregion_hotkey_balanced_total"
+                             f"{label(member)} "
+                             f"{self.balanced[member]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.promoted = 0
+            self.demoted = 0
+            self.staged = 0
+            self.duplicate_staged = 0
+            self.balanced.clear()
+            self.hot_routes = 0
+            self.replica_pressure = 0.0
+
+
+HOTKEY = HotkeyStats()
+
+
 # -------------------------------------------------- self-preservation
 
 class PressureStats:
@@ -2156,9 +2268,9 @@ class DecisionStats:
     never mint either string."""
 
     KINDS = ("autoscaler", "epoch", "manifest", "gossip",
-             "drain", "undrain", "handoff")
+             "drain", "undrain", "handoff", "hotkey")
     VERDICTS = ("up", "down", "blocked", "steady",
-                "installed", "pending", "promoted",
+                "installed", "pending", "promoted", "demoted",
                 "agreed", "stale", "split-brain", "unreachable",
                 "legacy", "ok", "mismatch", "done", "failed")
 
@@ -2791,6 +2903,7 @@ def fleet_metric_lines(router=None, extra_labels: str = "",
     ``imageregion_singleflight_*`` series alive in fleet postures."""
     extra = extra_labels.lstrip(",")
     lines = FLEET.metric_lines(extra_labels)
+    lines += HOTKEY.metric_lines(extra_labels)
     if single_flight is not None:
         lb = ("{" + extra + "}") if extra else ""
         lines += [
@@ -2971,6 +3084,16 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_fleet_routed_total": "counter",
     "imageregion_fleet_stolen_total": "counter",
     "imageregion_fleet_failed_over_total": "counter",
+    # Hot-plane replication (parallel.fleet popularity tier):
+    # promotion lifecycle, replica staging, balanced reads, and the
+    # replica-pressure gauge the autoscaler consumes.
+    "imageregion_hotkey_promotions_total": "counter",
+    "imageregion_hotkey_demotions_total": "counter",
+    "imageregion_hotkey_replica_staged_total": "counter",
+    "imageregion_hotkey_duplicate_staged_total": "counter",
+    "imageregion_hotkey_balanced_total": "counter",
+    "imageregion_hotkey_hot_routes": "gauge",
+    "imageregion_hotkey_replica_pressure": "gauge",
     # Self-preservation layer (server.pressure / server.watchdog /
     # fleet drains): brownout ladder state, watchdog fires, rolling
     # drain phases.
@@ -3216,6 +3339,24 @@ METRIC_HELP: Dict[str, str] = {
     "imageregion_loadmodel_late_fires_total":
         "Arrivals fired behind schedule (open-loop integrity: the "
         "generator, not the service, fell behind)",
+    "imageregion_hotkey_promotions_total":
+        "Routes promoted to an R>1 replica set (heat past threshold)",
+    "imageregion_hotkey_demotions_total":
+        "Promoted routes demoted back to R=1 (heat decayed)",
+    "imageregion_hotkey_replica_staged_total":
+        "Plane entries staged onto replicas at promotion "
+        "(digest-deduped; residency probe hits count too)",
+    "imageregion_hotkey_duplicate_staged_total":
+        "Replica stagings that would have double-staged one "
+        "(route, replica) pair in one epoch — a bug counter, held 0",
+    "imageregion_hotkey_balanced_total":
+        "Reads served by a NON-OWNER replica via least-queued "
+        "balancing, by member",
+    "imageregion_hotkey_hot_routes":
+        "Routes currently holding an R>1 replica set",
+    "imageregion_hotkey_replica_pressure":
+        "Hottest promoted route's heat over the promotion threshold "
+        "(>= 1: one plane is outrunning one member — scale-up signal)",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -3476,6 +3617,7 @@ def reset() -> None:
     PERSIST.reset()
     WIRE.reset()
     FLEET.reset()
+    HOTKEY.reset()
     PRESSURE.reset()
     WATCHDOG.reset()
     DRAIN.reset()

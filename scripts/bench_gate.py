@@ -88,11 +88,23 @@ OFFLOAD_KEYS = ("origin_offload_ratio", "peer_hit_rate",
 # ever measured.
 CAPACITY_KEYS = ("capacity_knee_offered_tps", "p99_at_knee_ms",
                  "capacity_scaling_efficiency")
+# --hotkey: judge HOTKEY_r*.json records (bench.py --smoke --hotkey —
+# the hot-plane replication drill) on the viral-image keys.
+# Direction-aware by name: the storm's throughput retention vs the
+# uniform mix and the replication gain over the disabled A/B both
+# regress DOWNWARD (a gain falling toward 1.0 means the tier stopped
+# earning its keep); storm throughput itself regresses DOWNWARD too.
+# ``hotkey_duplicate_staged`` is judged separately below: any value
+# above zero fails outright — duplicate staging is a correctness
+# bug, not a trend.  Rounds that predate the family skip on null.
+HOTKEY_KEYS = ("hotkey_storm_ratio", "hotkey_replication_gain",
+               "hotkey_storm_tps")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _MULTICHIP_RE = re.compile(r"^MULTICHIP_r(\d+)\.json$")
 _SESSIONS_RE = re.compile(r"^SESSIONS_r(\d+)\.json$")
 _OFFLOAD_RE = re.compile(r"^OFFLOAD_r(\d+)\.json$")
 _CAPACITY_RE = re.compile(r"^CAPACITY_r(\d+)\.json$")
+_HOTKEY_RE = re.compile(r"^HOTKEY_r(\d+)\.json$")
 
 
 def lower_is_better(key: str) -> bool:
@@ -281,6 +293,15 @@ def main(argv=None) -> int:
                              "knee: knee offered tps and scaling "
                              "efficiency regress down, p99-at-knee "
                              "regresses up")
+    parser.add_argument("--hotkey", action="store_true",
+                        help="judge HOTKEY_r*.json records (bench "
+                             "--smoke --hotkey, the hot-plane "
+                             "replication drill) on the viral-image "
+                             "keys: storm/uniform throughput ratio, "
+                             "replication gain over the disabled A/B "
+                             "and storm throughput (all regress "
+                             "down); any duplicate-staged count "
+                             "above zero fails outright")
     parser.add_argument("--key", action="append", default=None,
                         help="record key(s) to judge (default "
                              "service_tiles_per_sec, "
@@ -306,12 +327,15 @@ def main(argv=None) -> int:
         keys = OFFLOAD_KEYS
     elif args.capacity:
         keys = CAPACITY_KEYS
+    elif args.hotkey:
+        keys = HOTKEY_KEYS
     else:
         keys = DEFAULT_KEYS
     pattern = (_MULTICHIP_RE if args.multichip
                else _SESSIONS_RE if args.sessions
                else _OFFLOAD_RE if args.offload
-               else _CAPACITY_RE if args.capacity else _BENCH_RE)
+               else _CAPACITY_RE if args.capacity
+               else _HOTKEY_RE if args.hotkey else _BENCH_RE)
     try:
         if args.watermark:
             if args.dir:
@@ -323,8 +347,9 @@ def main(argv=None) -> int:
                     "watermark mode needs at least two records "
                     f"(got {len(paths)})")
             records = [load_record(p) for p in paths]
+            new_record = records[-1]
             verdicts = judge_watermark(
-                records[:-1], paths[:-1], records[-1],
+                records[:-1], paths[:-1], new_record,
                 keys, args.max_regression)
             doc = {
                 "gate": "bench", "mode": "watermark",
@@ -340,6 +365,7 @@ def main(argv=None) -> int:
             else:
                 parser.error("give exactly two record paths, or --dir")
             old, new = load_record(old_path), load_record(new_path)
+            new_record = new
             verdicts = judge(old, new, keys, args.max_regression)
             doc = {
                 "gate": "bench", "mode": "pairwise",
@@ -350,6 +376,22 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(json.dumps({"gate": "bench", "error": str(e)}))
         return 2
+
+    if args.hotkey:
+        # Correctness rider, judged on the NEW record alone (no trend,
+        # no threshold): a single duplicate-staged plane means the
+        # digest-dedup staging contract broke.  Absent/null skips like
+        # every other key (rounds that predate the family).
+        dup = new_record.get("hotkey_duplicate_staged")
+        if not isinstance(dup, (int, float)):
+            verdicts.append({"key": "hotkey_duplicate_staged",
+                             "verdict": "skipped", "old": None,
+                             "new": dup})
+        else:
+            verdicts.append({"key": "hotkey_duplicate_staged",
+                             "verdict": ("regression" if dup > 0
+                                         else "pass"),
+                             "old": 0, "new": int(dup)})
 
     regressed = [v for v in verdicts if v["verdict"] == "regression"]
     skipped = [v for v in verdicts if v["verdict"] == "skipped"]

@@ -273,6 +273,63 @@ def test_bench_smoke_capacity(capsys):
         telemetry.reset()
 
 
+def test_bench_smoke_hotkey(capsys):
+    """The hot-plane replication gate (bench.py --smoke --hotkey):
+    a zipf storm on a 2-member fleet must retain >= 0.7x the uniform
+    mix's throughput WITH replication, the replication-disabled A/B
+    must measure LESS, replica staging must never duplicate-stage,
+    and heat decay must demote the viral route back to R=1 — all
+    read from live counters, not from the bench's own claims."""
+    import bench
+    from omero_ms_image_region_tpu.utils import decisions, telemetry
+
+    telemetry.reset()
+    decisions.LEDGER.reset()
+    try:
+        t0 = time.monotonic()
+        out = bench.bench_hotkey_smoke()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 60.0, \
+            f"hotkey smoke took {elapsed:.0f}s (budget 60)"
+
+        # The storm survived: throughput under the viral-plane skew
+        # held >= 0.7x the uniform mix on the SAME fleet.
+        assert out["hotkey_storm_ratio"] >= 0.7, out
+        # The replication-disabled A/B measured LESS — the honesty
+        # leg that proves the tier earns its complexity (a storm a
+        # plain ring absorbs equally means the drill measured
+        # nothing).
+        assert out["hotkey_disabled_tps"] < out["hotkey_storm_tps"], \
+            out
+        assert out["hotkey_replication_gain"] > 1.0, out
+        # The lifecycle actually ran, from live counters: promotion,
+        # balanced reads off the ring owner, replica staging with
+        # ZERO duplicate stagings, and the shard report classifying
+        # the hot plane as replicated — never duplicate.
+        assert out["hotkey_promotions"] >= 1, out
+        assert out["hotkey_balanced_reads"] >= 1, out
+        assert out["hotkey_duplicate_staged"] == 0, out
+        assert out["hotkey_shard_duplicates"] == 0, out
+        # Decay demoted the viral route back to R=1 after the storm
+        # (swept on the live dispatch path, not by the bench).
+        assert out["hotkey_demoted_after_decay"] is True, out
+        assert out["hotkey_hot_routes_after_decay"] == 0, out
+        assert out["hotkey_demotions"] >= 1, out
+        # The autoscaler read replica pressure as a scale signal: at
+        # the fleet ceiling the want-up it forces is refused, and
+        # that decision record carries the signal (the ledger line an
+        # operator reads during a real storm).
+        assert out["hotkey_autoscaler_signal"] is True, out
+        assert out["hotkey_ledger_promotions"] >= 1, out
+        assert out["hotkey_peak_replica_pressure"] > 0, out
+
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(line)["metric"] == "hotkey_smoke"
+    finally:
+        decisions.LEDGER.reset()
+        telemetry.reset()
+
+
 def test_bench_smoke_offload(capsys):
     """The repeat-viewer offload gate (bench.py --smoke --offload):
     over a real 2-sidecar remote fleet, the edge ladder (warm-local

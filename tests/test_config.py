@@ -750,6 +750,32 @@ def test_federation_block_parses_and_validates():
                            "ceiling": 3}})
 
 
+def test_federation_host_defaults_to_cluster_identity(monkeypatch):
+    """An enabled federation block with NO host: key takes this
+    process's identity from the cluster layer (``procN`` when
+    jax.distributed is joined, else the OS hostname) — multi-host
+    manifests are written once and shipped verbatim to every host."""
+    from omero_ms_image_region_tpu.parallel import cluster
+
+    members = [{"name": "a0", "host": "hostA", "address": "x:1"},
+               {"name": "b0", "host": "hostB", "address": "y:1"}]
+    monkeypatch.setattr(cluster, "host_identity", lambda: "hostB")
+    cfg = AppConfig.from_dict({"federation": {
+        "enabled": True, "members": members}})
+    assert cfg.federation.host == "hostB"
+    # An explicit host: key still wins over the cluster identity.
+    cfg = AppConfig.from_dict({"federation": {
+        "enabled": True, "host": "hostA", "members": members}})
+    assert cfg.federation.host == "hostA"
+    # An identity the manifest never heard of fails loudly, and the
+    # message teaches the default rule.
+    monkeypatch.setattr(cluster, "host_identity", lambda: "rogue")
+    with pytest.raises(ValueError,
+                       match=r"cluster\.host_identity"):
+        AppConfig.from_dict({"federation": {
+            "enabled": True, "members": members}})
+
+
 def test_autoscaler_lifecycle_and_diurnal_knobs():
     """PR 15 knobs: diurnal prediction bounds and the unit-config /
     fleet.sockets coupling."""

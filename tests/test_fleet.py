@@ -15,8 +15,9 @@ import pytest
 from omero_ms_image_region_tpu.parallel.fleet import (
     FleetImageHandler, FleetRouter, HashRing, LocalMember,
     plane_route_key)
+from omero_ms_image_region_tpu.server.config import HotkeyConfig
 from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
-from omero_ms_image_region_tpu.utils import telemetry
+from omero_ms_image_region_tpu.utils import decisions, telemetry
 
 
 def _ctx(image_id="1", z="0", t="0", tile="0,0,0,128,128", **extra):
@@ -834,6 +835,164 @@ class TestFleetImageHandler:
                 out = await fleet_handler.render_image_region(_ctx())
                 assert out != b"degraded-bytes"
                 assert fallback.calls == 1
+            finally:
+                await router.close()
+
+        asyncio.run(main())
+
+
+# ------------------------------------------- hot-plane replication
+
+class TestHotPlaneReplication:
+    """Lifecycle property drill for popularity-aware placement: a
+    route promoted past the heat threshold gets a DETERMINISTIC ring-
+    chain prefix as its replica set, demotion is hysteretic and driven
+    by the live dispatch path, re-promotion reuses the identical
+    prefix, and the per-epoch staging guard never double-stages.  The
+    ring goldens above stay the authority on WHERE the prefix points —
+    these tests only consume ``chain()``, never re-derive it."""
+
+    def setup_method(self):
+        telemetry.reset()
+        decisions.LEDGER.reset()
+
+    def teardown_method(self):
+        decisions.LEDGER.reset()
+
+    def _hot_fleet(self, n=4, threshold=5.0, decay_s=10.0, **kw):
+        handlers = [_FakeHandler(f"m{i}") for i in range(n)]
+        members = [LocalMember(f"m{i}", handlers[i])
+                   for i in range(n)]
+        clk = {"t": 0.0}
+        router = FleetRouter(
+            members, lane_width=1, steal_min_backlog=0,
+            hotkey=HotkeyConfig(enabled=True, threshold=threshold,
+                                decay_s=decay_s, max_replicas=2,
+                                **kw))
+        # Injectable heat clock: the whole thermal trajectory —
+        # promotion, hysteresis, re-promotion — is deterministic.
+        router._heat.clock = lambda: clk["t"]
+        return router, handlers, clk
+
+    def test_promote_demote_repromote_deterministic(self):
+        async def main():
+            router, handlers, clk = self._hot_fleet()
+            try:
+                hot = _ctx()
+                cool = _ctx(tile="0,2,2,128,128")
+                route = plane_route_key(hot)
+                chain = router.ring.chain(route)
+                # Below threshold: nothing promotes.
+                for _ in range(4):
+                    await router.dispatch(hot)
+                assert not router.is_hot_route(route)
+                assert router.replica_set(route) == chain[:1]
+                # The 5th observation crosses threshold=5: the route
+                # gets exactly the 2-member chain prefix, owner first.
+                await router.dispatch(hot)
+                assert router.is_hot_route(route)
+                first = router.replica_set(route)
+                assert first == chain[:2]
+                assert router.replica_pressure() >= 1.0
+                await asyncio.gather(          # let the stage task run
+                    *list(router._putback_tasks),
+                    return_exceptions=True)
+                # Hysteresis: at demote_fraction=0.5 the route stays
+                # promoted while heat > 2.5 (5 * e^-0.5 ~ 3.03)...
+                clk["t"] = 5.0
+                await router.dispatch(cool)
+                assert router.is_hot_route(route)
+                # ...and the LIVE dispatch path demotes it once decay
+                # crosses under (5 * e^-0.8 ~ 2.25 at t=8).
+                clk["t"] = 8.0
+                await router.dispatch(cool)
+                assert not router.is_hot_route(route)
+                assert router.replica_set(route) == chain[:1]
+                # Re-promotion from the residual heat rebuilds the
+                # IDENTICAL prefix — replicas never wander.
+                for _ in range(3):
+                    await router.dispatch(hot)
+                assert router.is_hot_route(route)
+                assert router.replica_set(route) == first
+                await asyncio.gather(*list(router._putback_tasks),
+                                     return_exceptions=True)
+                totals = telemetry.HOTKEY.totals()
+                assert totals["promoted"] == 2
+                assert totals["demoted"] == 1
+                # The full promote/demote/re-promote cycle never
+                # double-stages a (route, replica) pair...
+                assert totals["duplicate_staged"] == 0
+                # ...and a forced second stage inside one epoch trips
+                # the guard instead of re-shipping the slice.
+                await router._stage_replicas(route, first)
+                assert telemetry.HOTKEY.totals()[
+                    "duplicate_staged"] == len(first) - 1
+                # Both transitions are on the decision ledger.
+                ledger = decisions.LEDGER.snapshot()
+                verdicts = [r["verdict"] for r in ledger
+                            if r["kind"] == "hotkey"]
+                assert verdicts.count("promoted") == 2
+                assert verdicts.count("demoted") == 1
+            finally:
+                await router.close()
+
+        asyncio.run(main())
+
+    def test_unroutable_replicas_drop_within_one_transition(self):
+        """Drains and deaths fall out of the balanced read set on the
+        very NEXT routing decision — no grace window in which reads
+        keep landing on a member that can no longer serve them."""
+        async def main():
+            router, handlers, clk = self._hot_fleet()
+            try:
+                hot = _ctx()
+                route = plane_route_key(hot)
+                for _ in range(5):
+                    await router.dispatch(hot)
+                owner, replica = router.replica_set(route)
+                # Idle fleet: ties break in chain order, owner wins.
+                assert router._serving_member(route) == owner
+                # Draining replica: immediately out of the read set.
+                router.members[replica].draining = True
+                assert router._serving_member(route) == owner
+                router.members[replica].draining = False
+                # Dead owner: the surviving replica serves reads.
+                router.members[owner].mark_down()
+                assert router._serving_member(route) == replica
+                # Whole replica set unroutable: plain chain walk, so
+                # deaths degrade exactly like an unpromoted route.
+                router.members[replica].mark_down()
+                assert router._serving_member(route) \
+                    == router.ring.chain(route)[2]
+                # Promotion state itself is untouched by the outage.
+                assert router.is_hot_route(route)
+            finally:
+                await router.close()
+
+        asyncio.run(main())
+
+    def test_shed_replicas_demotes_everything(self):
+        """The cache-pressure ladder's hook: one call returns the
+        fleet to R=1 everywhere (HBM reclaim itself is the eviction
+        ladder's job — shedding only removes the routing protection)."""
+        async def main():
+            router, handlers, clk = self._hot_fleet()
+            try:
+                a, b = _ctx(), _ctx(z="3")
+                for _ in range(5):
+                    await router.dispatch(a)
+                    await router.dispatch(b)
+                assert router.hot_route_count() == 2
+                assert router.shed_replicas() == 2
+                assert router.hot_route_count() == 0
+                assert router.replica_set(plane_route_key(a)) \
+                    == router.ring.chain(plane_route_key(a))[:1]
+                # Re-heating re-promotes cleanly after a shed.
+                for _ in range(5):
+                    await router.dispatch(a)
+                assert router.is_hot_route(plane_route_key(a))
+                assert telemetry.HOTKEY.totals()[
+                    "duplicate_staged"] == 0
             finally:
                 await router.close()
 

@@ -586,6 +586,74 @@ class TestBenchGate:
                           str(tmp_path)]) == 1
         capsys.readouterr()
 
+    def test_hotkey_keys_gated_direction_aware(self, tmp_path,
+                                               capsys):
+        """--hotkey judges HOTKEY_r*.json (bench --smoke --hotkey,
+        the viral-image storm) direction-aware by name: the storm
+        throughput ratio, the replication gain and the absolute storm
+        throughput all regress DOWN.  ``hotkey_duplicate_staged`` is a
+        correctness rider judged on the new record alone — any value
+        above zero is an outright regression regardless of trend."""
+        gate = self._gate()
+        good = {"hotkey_storm_ratio": 0.95,
+                "hotkey_replication_gain": 1.6,
+                "hotkey_storm_tps": 100.0,
+                "hotkey_duplicate_staged": 0}
+        self._write(tmp_path, "HOTKEY_r01.json", good)
+        # Storm ratio DOWN 30% = regression (the hot member melts
+        # again) even with the raw throughput flat.
+        self._write(tmp_path, "HOTKEY_r02.json",
+                    {**good, "hotkey_storm_ratio": 0.65})
+        assert gate.main(["--hotkey", "--dir", str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["hotkey_storm_ratio"] == "regression"
+        assert by_key["hotkey_replication_gain"] == "pass"
+        assert by_key["hotkey_duplicate_staged"] == "pass"
+        # Replication gain collapsing toward 1.0 = regression (the
+        # A/B says replication no longer buys anything).
+        self._write(tmp_path, "HOTKEY_r03.json",
+                    {**good, "hotkey_replication_gain": 1.05})
+        assert gate.main(["--hotkey", "--dir", str(tmp_path)]) == 1
+        capsys.readouterr()
+        # A single duplicate-staged plane fails outright even with
+        # every trend key flat or improving.
+        self._write(tmp_path, "HOTKEY_r04.json", good)
+        self._write(tmp_path, "HOTKEY_r05.json",
+                    {**good, "hotkey_storm_tps": 110.0,
+                     "hotkey_duplicate_staged": 1})
+        assert gate.main(["--hotkey", "--dir", str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["hotkey_duplicate_staged"] == "regression"
+        assert by_key["hotkey_storm_tps"] == "pass"
+        # Holding every key passes; records predating the hotkey
+        # bench skip on null instead of failing.
+        self._write(tmp_path, "HOTKEY_r06.json", good)
+        self._write(tmp_path, "HOTKEY_r07.json",
+                    {**good, "hotkey_storm_tps": 104.0})
+        assert gate.main(["--hotkey", "--dir", str(tmp_path)]) == 0
+        capsys.readouterr()
+        self._write(tmp_path, "HOTKEY_r08.json", {"ok": True})
+        assert gate.main(["--hotkey", "--dir", str(tmp_path)]) == 0
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["hotkey_storm_ratio"] == "skipped"
+        assert by_key["hotkey_duplicate_staged"] == "skipped"
+        # --watermark holds the best storm throughput ever measured.
+        assert gate.main(["--hotkey", "--watermark", "--dir",
+                          str(tmp_path)]) == 0
+        capsys.readouterr()
+        self._write(tmp_path, "HOTKEY_r09.json",
+                    {**good, "hotkey_storm_tps": 80.0})
+        assert gate.main(["--hotkey", "--watermark", "--dir",
+                          str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v for v in verdict["keys"]}
+        assert by_key["hotkey_storm_tps"][
+            "watermark_record"] == "HOTKEY_r05.json"
+        capsys.readouterr()
+
     def test_multichip_fleet_curve_gated(self, tmp_path, capsys):
         """--multichip judges MULTICHIP_r*.json on the fleet scaling
         keys: ok-true-only rounds (every record predating the curve)

@@ -570,6 +570,159 @@ class TestPeerByteTier:
 
         asyncio.run(scenario())
 
+    def test_mask_tier_is_namespaced_and_acl_gated(self, data_dir,
+                                                   tmp_path):
+        """The federated MASK byte tier rides the same wire ops with
+        ``tier: "mask"``: keys are ``ShapeMaskCtx.cache_key()`` (the
+        PR 11 ETag's storage identity), the shape-mask stack is
+        namespaced from the render tier (same key, two stacks, no
+        crosstalk), fetch gates on the Mask's OWN ACL (``obj:
+        "Mask"``) and an unknown ACL object type is refused."""
+        import hashlib
+
+        from omero_ms_image_region_tpu.models.mask import Mask
+        from omero_ms_image_region_tpu.server.ctx import ShapeMaskCtx
+        from omero_ms_image_region_tpu.server.sidecar import (
+            SidecarClient, run_sidecar)
+        from omero_ms_image_region_tpu.services.metadata import \
+            write_mask
+
+        mask_id = 5
+        bits = np.zeros(H * W, np.uint8)
+        bits[: H * W // 2] = 1
+        write_mask(data_dir, Mask(
+            shape_id=mask_id, width=W, height=H,
+            bytes_=np.packbits(bits).tobytes(), fill_color=None))
+        with open(os.path.join(data_dir, "masks",
+                               f"{mask_id}.acl.json"), "w") as f:
+            json.dump({"public": False, "sessions": ["alice"]}, f)
+        sock = str(tmp_path / "peer.sock")
+
+        async def scenario():
+            task = asyncio.create_task(
+                run_sidecar(self._member_cfg(data_dir), sock))
+            await _wait_socket(sock, task)
+            client = SidecarClient(sock)
+            try:
+                ctx = ShapeMaskCtx.from_params(
+                    {"shapeId": str(mask_id), "color": "FF0000"})
+                key = ctx.cache_key()
+                assert key == f"ome.model.roi.Mask:{mask_id}:FF0000"
+                png = b"\x89PNG-mask-bytes"
+                digest = hashlib.blake2b(
+                    png, digest_size=16).hexdigest()
+                status, body = await client.call(
+                    "byte_probe", {},
+                    extra={"keys": [key], "tier": "mask"})
+                assert status == 200
+                assert json.loads(bytes(body).decode()) == {
+                    "enabled": True, "present": [False]}
+                status, _ = await client.call(
+                    "byte_put", {}, body=png,
+                    extra={"key": key, "digest": digest,
+                           "tier": "mask"})
+                assert status == 200
+                # The put flips the MASK probe, never the render
+                # tier's view of the same key.
+                status, body = await client.call(
+                    "byte_probe", {},
+                    extra={"keys": [key], "tier": "mask"})
+                assert json.loads(
+                    bytes(body).decode())["present"] == [True]
+                status, body = await client.call(
+                    "byte_probe", {}, extra={"keys": [key]})
+                assert json.loads(
+                    bytes(body).decode())["present"] == [False]
+                # Fetch runs the MASK's own ACL for the caller.
+                status, body = await client.call(
+                    "byte_fetch", {},
+                    extra={"key": key, "tier": "mask",
+                           "image_id": mask_id, "obj": "Mask",
+                           "session": "alice"})
+                assert status == 200 and bytes(body) == png
+                status, body = await client.call(
+                    "byte_fetch", {},
+                    extra={"key": key, "tier": "mask",
+                           "image_id": mask_id, "obj": "Mask",
+                           "session": "bob"})
+                assert status == 404
+                status, body = await client.call(
+                    "byte_fetch", {},
+                    extra={"key": key, "tier": "mask",
+                           "image_id": mask_id, "obj": "Roi"})
+                assert status == 400
+            finally:
+                await client.close()
+                task.cancel()
+                await asyncio.gather(task, return_exceptions=True)
+
+        asyncio.run(scenario())
+
+    def test_fleet_drill_mask_rasterizes_once_fleet_wide(
+            self, data_dir, tmp_path):
+        """The mask drill: host A rasterizes an explicit-color mask
+        and ships the PNG to its ring authority (fire-and-forget
+        write-back); host B's local miss is then served the SAME
+        bytes from the authority's mask tier — no second
+        rasterize."""
+        from omero_ms_image_region_tpu.models.mask import Mask
+        from omero_ms_image_region_tpu.server.app import \
+            FLEET_ROUTER_KEY
+        from omero_ms_image_region_tpu.server.sidecar import \
+            run_sidecar
+        from omero_ms_image_region_tpu.services.metadata import \
+            write_mask
+
+        mask_id = 6
+        bits = np.zeros(H * W, np.uint8)
+        bits[: H * W // 3] = 1
+        write_mask(data_dir, Mask(
+            shape_id=mask_id, width=W, height=H,
+            bytes_=np.packbits(bits).tobytes(), fill_color=None))
+        socks = [str(tmp_path / f"m{i}.sock") for i in range(2)]
+        url = (f"/webgateway/render_shape_mask/{mask_id}"
+               f"?color=FF0000")
+
+        def frontend_cfg():
+            return AppConfig(
+                data_dir=data_dir,
+                sidecar=SidecarConfig(role="frontend"),
+                fleet=FleetConfig(enabled=True,
+                                  sockets=tuple(socks)))
+
+        async def scenario():
+            tasks = [asyncio.create_task(
+                run_sidecar(self._member_cfg(data_dir), sock))
+                for sock in socks]
+            for sock, task in zip(socks, tasks):
+                await _wait_socket(sock, task)
+            host_a = TestClient(TestServer(create_app(frontend_cfg())))
+            host_b = TestClient(TestServer(create_app(frontend_cfg())))
+            await host_a.start_server()
+            await host_b.start_server()
+            try:
+                r = await host_a.get(url)
+                assert r.status == 200
+                origin = await r.read()
+                # Let the fire-and-forget write-back land on the
+                # authority before the second host asks.
+                router_a = host_a.app[FLEET_ROUTER_KEY]
+                await asyncio.gather(*list(router_a._putback_tasks),
+                                     return_exceptions=True)
+                hits0 = telemetry.HTTPCACHE.peer_hits
+                r = await host_b.get(url)
+                assert r.status == 200
+                assert await r.read() == origin
+                assert telemetry.HTTPCACHE.peer_hits == hits0 + 1
+            finally:
+                await host_a.close()
+                await host_b.close()
+                for task in tasks:
+                    task.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+        asyncio.run(scenario())
+
 
 # ----------------------------------- Last-Modified / If-Modified-Since
 

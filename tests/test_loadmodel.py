@@ -131,6 +131,84 @@ class TestGeneration:
             LoadModel(think_time_median_ms=0)
 
 
+class TestSkew:
+    """Popularity skew (the viral-image knob, ``bench --smoke
+    --hotkey``'s storm input): each session draws one image RANK from
+    a zipf CDF using a SEPARATE seed-derived stream, so turning the
+    knob never shifts the timing/trajectory stream the pinned tests
+    above froze."""
+
+    def test_unskewed_stream_is_rank_zero_everywhere(self):
+        assert all(a.image == 0 for a in _model().events())
+
+    def test_skew_never_moves_timing_or_trajectories(self):
+        """The whole pre-skew stream is bit-exact modulo the image
+        field: same arrival times, sessions, classes and lattice
+        walks — the capacity records stay comparable across the
+        knob."""
+        base = _model().events()
+        skewed = _model(skew=1.5, image_population=16).events()
+        assert len(base) == len(skewed)
+        for a, b in zip(base, skewed):
+            assert (a.t, a.session, a.cls, a.step, a.x, a.y,
+                    a.level) == (b.t, b.session, b.cls, b.step,
+                                 b.x, b.y, b.level)
+        assert any(b.image > 0 for b in skewed)
+
+    def test_rank_is_per_session_and_deterministic(self):
+        model = _model(skew=2.0, image_population=12)
+        by_session = {}
+        for a in model.events():
+            by_session.setdefault(a.session, set()).add(a.image)
+        # One image per session: a viewer browses one acquisition.
+        assert all(len(s) == 1 for s in by_session.values())
+        again = _model(skew=2.0, image_population=12).events()
+        assert [a.image for a in model.events()] \
+            == [a.image for a in again]
+        other = _model(seed=43, skew=2.0, image_population=12)
+        assert [a.image for a in model.events()] \
+            != [a.image for a in other.events()]
+
+    def test_zipf_concentrates_on_rank_zero(self):
+        """s=2 over 12 ranks puts ~2/3 of the mass on rank 0 — the
+        one-plane storm the hot-key tier exists for; s=0 degenerates
+        to uniform."""
+        counts = {}
+        for a in _model(skew=2.0, image_population=12,
+                        duration_s=120.0).events():
+            counts[a.image] = counts.get(a.image, 0) + 1
+        total = sum(counts.values())
+        assert counts[0] == max(counts.values())
+        assert counts[0] > 0.4 * total
+        flat = {}
+        for a in _model(skew=0.0, image_population=12,
+                        duration_s=120.0).events():
+            flat[a.image] = flat.get(a.image, 0) + 1
+        assert max(flat.values()) < 0.3 * sum(flat.values())
+        assert len(flat) == 12
+
+    def test_ranks_stay_inside_the_population(self):
+        events = _model(skew=0.5, image_population=5).events()
+        assert set(a.image for a in events) <= set(range(5))
+
+    def test_from_config_threads_the_knobs(self):
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        config = AppConfig.from_dict(
+            {"loadmodel": {"seed": 7, "viewers": 30, "skew": 1.3,
+                           "image-population": 9}})
+        model = LoadModel.from_config(config.loadmodel,
+                                      duration_s=20.0, grid=4)
+        assert model.skew == 1.3
+        assert model.image_population == 9
+        assert model.grid == 4
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            LoadModel(skew=-0.1)
+        with pytest.raises(ValueError):
+            LoadModel(image_population=0)
+
+
 class TestScheduling:
     def test_schedule_hits_the_target_rate(self):
         model = _model()

@@ -88,6 +88,8 @@ class TestExpositionBudget:
             {"tier": "render_cold", "member": "m1", "stolen": 1})
         telemetry.PROVENANCE.count({"tier": "byte_cache"})
         telemetry.FLEET.count_routed("m0")
+        telemetry.HOTKEY.count_promoted()
+        telemetry.HOTKEY.count_balanced("m0")
         telemetry.PRESSURE.set_signal("hbm_frac", 0.5)
         telemetry.QOS.count_shed("bulk")
         telemetry.RESILIENCE.count_retry("image")

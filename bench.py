@@ -2564,6 +2564,363 @@ def bench_federation_smoke(grid: int = 3, tile_edge: int = 32,
     return out
 
 
+def bench_partition_smoke(grid: int = 3, tile_edge: int = 32,
+                          emit: bool = True):
+    """Netsplit chaos drill (``bench.py --smoke --partition``): a
+    3-host federated fleet (this process = host A's router + local
+    member; two REAL spawned sidecar processes = hosts B and C, each
+    running quorum tracking and its own gossip loop) driven through a
+    full partition -> fence -> heal -> rejoin cycle UNDER SUSTAINED
+    LOAD, with a two-phase epoch roll committed mid-partition.
+
+    The drill cuts every link to host C at the sidecar wire layer
+    (``utils.faultinject.PARTITIONS`` locally + the ``partition``
+    control op remotely — that op is partition-exempt so the drill
+    can always heal what it broke) and gates, on one record:
+
+    * **majority availability** — the A+B majority serves the whole
+      load loop with ZERO failures that are not counted shed
+      (``part_majority_5xx`` == 0; breaker fail-fasts count as shed);
+    * **minority fencing** — C loses quorum within the suspect
+      window (``part_fence_ms``), REFUSES state-changing ops
+      gracefully while still answering (``part_minority_refusals``
+      from byte_put/prestage probes), and restores within
+      ``part_restore_ms`` of heal;
+    * **mid-partition epoch roll** — the coordinator rolls the fleet
+      to epoch 2 while C is dark: strict-majority acks commit it
+      (``part_roll_committed``/``part_roll_acks``), and the healed
+      minority converges to the committed epoch through gossip
+      anti-entropy with NO operator action (``part_rejoin_epoch``);
+    * **no split-brain** — after heal every host agrees on the
+      epoch-2 digest AND assigns every golden probe key with its OWN
+      ring math (``part_postheal_agree``); C's byte tier accepts and
+      returns byte-identical content again (``part_byte_agree``); and
+      C's decision ledger holds the kind=``quorum`` fenced/restored
+      pair (``part_quorum_ledger``).
+
+    Judged by ``scripts/bench_gate.py --partition`` on the PARTITION
+    record family.
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    import yaml
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.parallel import federation
+    from omero_ms_image_region_tpu.parallel.fleet import (
+        FleetImageHandler, FleetRouter)
+    from omero_ms_image_region_tpu.server.app import build_services
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.errors import OverloadedError
+    from omero_ms_image_region_tpu.server.sidecar import (
+        SidecarClient, spawn_sidecar)
+    from omero_ms_image_region_tpu.server.singleflight import (
+        SingleFlight)
+    from omero_ms_image_region_tpu.utils import faultinject
+
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(59)
+    suspect_s = 1.2
+
+    def params_for(i: int):
+        x, y = i % grid, (i // grid) % grid
+        w = 21000 + 600 * i
+        return {
+            "imageId": "1", "theZ": "0", "theT": "0",
+            "tile": f"0,{x},{y},{tile_edge},{tile_edge}",
+            "format": "png", "m": "c",
+            "c": f"1|0:{w}$FF0000",
+        }
+
+    async def _poll(client: SidecarClient, timeout_s: float, pred):
+        """Poll host C's partition-exempt control op until ``pred``
+        accepts the reply doc; returns (doc, waited_ms)."""
+        t0 = time.perf_counter()
+        doc = None
+        while time.perf_counter() - t0 < timeout_s:
+            status, body = await client.call(
+                "partition", {}, extra={"action": "show"})
+            if status == 200 and body:
+                doc = json.loads(bytes(body).decode())
+                if pred(doc):
+                    return doc, (time.perf_counter() - t0) * 1000.0
+            await asyncio.sleep(0.06)
+        return doc, (time.perf_counter() - t0) * 1000.0
+
+    async def run(tmp: str, sock_b: str, sock_c: str) -> dict:
+        config = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=False),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        services = build_services(config)
+        specs = [federation.MemberSpec("a0", "hostA"),
+                 federation.MemberSpec("b0", "hostB", sock_b),
+                 federation.MemberSpec("c0", "hostC", sock_c)]
+        manifest = federation.FleetManifest(
+            list(specs), version=1, ring_seed="bench-part")
+        federation.install(manifest, self_host="hostA")
+        federation.install_quorum(federation.QuorumTracker(
+            manifest, "hostA", suspect_after_s=suspect_s))
+        members = federation.build_federated_members(
+            config, services, manifest, SidecarClient, "hostA")
+        router = FleetRouter(members, lane_width=2,
+                             steal_min_backlog=0,
+                             ring_seed=manifest.ring_seed,
+                             wire_handoff=True)
+        federation.set_roll_hook(router.apply_manifest)
+        handler = FleetImageHandler(
+            router, single_flight=SingleFlight(),
+            base_services=services)
+        coord = federation.FederationCoordinator(
+            manifest, "hostA", router, gossip_interval_s=0.25)
+        # Control channel to C: a raw client with no peer_host stamp
+        # is partition-exempt by construction — the drill's scalpel
+        # must keep working while the fleet's own links are dark.
+        ctl_c = SidecarClient(sock_c, wire=config.wire)
+        ctl_b = SidecarClient(sock_b, wire=config.wire)
+        load = {"n": 0, "shed": 0, "hard": 0}
+        stop_load = asyncio.Event()
+
+        async def load_loop() -> None:
+            i = 0
+            while not stop_load.is_set():
+                ctxs = [ImageRegionCtx.from_params(params_for(j))
+                        for j in range(i % 5, i % 5 + 4)]
+                done = await asyncio.gather(
+                    *(handler.render_image_region(c) for c in ctxs),
+                    return_exceptions=True)
+                for r in done:
+                    load["n"] += 1
+                    if isinstance(r, OverloadedError):
+                        load["shed"] += 1
+                    elif isinstance(r, BaseException):
+                        load["hard"] += 1
+                i += 1
+                await asyncio.sleep(0.02)
+
+        out: dict = {}
+        gossip_task = None
+        load_task = None
+        try:
+            verdicts = await coord.agree(strict=True)
+            out["part_manifest_agreed"] = int(all(
+                v == "agreed" for v in verdicts.values()))
+            gossip_task = asyncio.create_task(coord.run())
+            # Warm-up: compile every process's render program before
+            # the clock-sensitive phases (first-compile stalls would
+            # smear the fence/restore latencies).
+            warm = [ImageRegionCtx.from_params(params_for(i))
+                    for i in range(grid * grid)]
+            await asyncio.gather(
+                *(handler.render_image_region(c) for c in warm))
+            load_task = asyncio.create_task(load_loop())
+            await asyncio.sleep(0.4)
+
+            # --- partition: cut every link to/from host C.  A's
+            # outbound edge is process-local; B's and C's outbound
+            # edges go over the exempt control op.
+            faultinject.PARTITIONS.add("hostA", "hostC")
+            await ctl_b.call("partition", {}, extra={
+                "action": "add", "src": "hostB", "dst": "hostC"})
+            await ctl_c.call("partition", {}, extra={
+                "action": "add", "src": "hostC", "dst": "hostA"})
+            await ctl_c.call("partition", {}, extra={
+                "action": "add", "src": "hostC", "dst": "hostB"})
+            doc, waited = await _poll(
+                ctl_c, timeout_s=suspect_s * 6 + 5.0,
+                pred=lambda d: (d.get("quorum") or {}).get("fenced"))
+            assert doc and (doc.get("quorum") or {}).get("fenced"), \
+                f"host C never fenced: {doc}"
+            out["part_fence_ms"] = round(waited, 1)
+
+            # --- fenced refusals: state-changing ops answer
+            # gracefully (200 + fenced flag), and each one counts.
+            payload = b"partition-drill-bytes"
+            import hashlib as _hashlib
+            digest = _hashlib.blake2b(
+                payload, digest_size=16).hexdigest()
+            status, body = await ctl_c.call(
+                "byte_put", {}, body=payload,
+                extra={"key": "bench:part:byte", "digest": digest})
+            assert status == 200, f"fenced byte_put errored: {body}"
+            assert json.loads(bytes(body).decode()).get("fenced"), \
+                "fenced minority accepted byte-tier write authority"
+            status, body = await ctl_c.call(
+                "prestage", {}, extra={"entries": []})
+            assert status == 200 and json.loads(
+                bytes(body).decode()).get("fenced"), \
+                "fenced minority accepted inbound shard staging"
+            refusals = ((doc.get("quorum") or {}).get("refusals")
+                        or {})
+            status, body = await ctl_c.call(
+                "partition", {}, extra={"action": "show"})
+            if status == 200 and body:
+                refusals = (json.loads(bytes(body).decode())
+                            .get("quorum") or {}).get("refusals") or {}
+            out["part_minority_refusals"] = int(
+                sum(refusals.values()))
+
+            # --- mid-partition epoch roll: strict majority (A + B)
+            # acks; dark C is "unreachable" and must not block it.
+            rolled = federation.FleetManifest(
+                list(specs), version=2, ring_seed="bench-part-v2")
+            roll = await coord.roll_epoch(rolled)
+            out["part_roll_committed"] = int(bool(roll["committed"]))
+            out["part_roll_acks"] = roll["acks"]
+            assert roll["committed"], f"majority roll aborted: {roll}"
+            await asyncio.sleep(0.5)       # roll rides under load
+
+            # --- heal: clear every rule, then watch C restore and
+            # converge to the committed epoch via anti-entropy.
+            faultinject.PARTITIONS.clear()
+            await ctl_b.call("partition", {},
+                             extra={"action": "clear"})
+            await ctl_c.call("partition", {},
+                             extra={"action": "clear"})
+            doc, waited = await _poll(
+                ctl_c, timeout_s=suspect_s * 6 + 5.0,
+                pred=lambda d: not (d.get("quorum")
+                                    or {}).get("fenced", True))
+            assert doc and not (doc.get("quorum") or {}).get(
+                "fenced", True), f"host C never restored: {doc}"
+            out["part_restore_ms"] = round(waited, 1)
+            doc, _ = await _poll(
+                ctl_c, timeout_s=10.0,
+                pred=lambda d: d.get("epoch") == 2)
+            out["part_rejoin_epoch"] = int(doc.get("epoch") or 0) \
+                if doc else 0
+            assert out["part_rejoin_epoch"] == 2, \
+                f"healed minority never converged to epoch 2: {doc}"
+
+            # --- post-heal agreement: every host answers the epoch-2
+            # digest AND its own ring math assigns the golden probe
+            # keys identically (the split-brain gate).  The breaker on
+            # A's c0 link may still be half-open — give it a few
+            # rounds to prove the link again.
+            agree_deadline = time.perf_counter() + 8.0
+            agreed = {}
+            while time.perf_counter() < agree_deadline:
+                agreed = await coord.agree(strict=False)
+                if agreed and all(v == "agreed"
+                                  for v in agreed.values()):
+                    break
+                await asyncio.sleep(0.25)
+            out["part_postheal_agree"] = int(bool(agreed) and all(
+                v == "agreed" for v in agreed.values()))
+            assert out["part_postheal_agree"] == 1, \
+                f"post-heal agreement incomplete: {agreed}"
+
+            # --- byte-tier rejoin: the restored C accepts write
+            # authority again and answers the bytes back verbatim.
+            status, body = await ctl_c.call(
+                "byte_put", {}, body=payload,
+                extra={"key": "bench:part:byte", "digest": digest})
+            stored = (status == 200 and json.loads(
+                bytes(body).decode()).get("stored"))
+            status, body = await ctl_c.call(
+                "byte_fetch", {}, extra={"key": "bench:part:byte"})
+            out["part_byte_agree"] = int(
+                bool(stored) and status == 200
+                and bytes(body) == payload)
+
+            # --- C's own ledger holds the fence/restore pair.
+            ledger = 0
+            status, body = await ctl_c.call("decisions", {})
+            if status == 200 and body:
+                ring = json.loads(
+                    bytes(body).decode()).get("ring") or ()
+                ledger = sum(1 for r in ring
+                             if r.get("kind") == "quorum")
+            out["part_quorum_ledger"] = ledger
+
+            stop_load.set()
+            await load_task
+            load_task = None
+            out["part_load_requests"] = load["n"]
+            out["part_majority_shed"] = load["shed"]
+            out["part_majority_5xx"] = load["hard"]
+            assert load["n"] > 0, "load loop never ran"
+            assert load["hard"] == 0, \
+                f"majority side failed {load['hard']} requests " \
+                f"without shedding (of {load['n']})"
+            return out
+        finally:
+            stop_load.set()
+            for task in (load_task, gossip_task):
+                if task is not None:
+                    task.cancel()
+                    try:
+                        await task
+                    except (asyncio.CancelledError, Exception):
+                        pass
+            faultinject.PARTITIONS.clear()
+            await ctl_c.close()
+            await ctl_b.close()
+            await router.close()
+            for member in members:
+                if getattr(member, "remote", False):
+                    await member.client.close()
+            federation.uninstall()
+            services.pixels_service.close()
+
+    out = {"metric": "partition_smoke"}
+    with tempfile.TemporaryDirectory() as tmp:
+        planes = synthetic_wsi_tiles(rng, 2, 1, grid * tile_edge,
+                                     grid * tile_edge).reshape(
+            2, 1, grid * tile_edge, grid * tile_edge)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        sock_b = os.path.join(tmp, "part-b0.sock")
+        sock_c = os.path.join(tmp, "part-c0.sock")
+        members_doc = [
+            {"name": "a0", "host": "hostA"},
+            {"name": "b0", "host": "hostB", "address": sock_b},
+            {"name": "c0", "host": "hostC", "address": sock_c},
+        ]
+        procs = []
+        try:
+            for host, sock in (("hostB", sock_b), ("hostC", sock_c)):
+                sidecar_cfg = {
+                    "data-dir": tmp,
+                    "batcher": {"enabled": False},
+                    "raw-cache": {"enabled": True, "prefetch": False,
+                                  "digest-dedup": True},
+                    "renderer": {"cpu-fallback-max-px": 0},
+                    "image-region-cache": {"enabled": True},
+                    "federation": {
+                        "enabled": True, "host": host,
+                        "shard-epoch": 1, "ring-seed": "bench-part",
+                        "quorum": True,
+                        "suspect-after-s": suspect_s,
+                        "gossip-interval-s": 0.3,
+                        "members": members_doc,
+                    },
+                }
+                cfg_path = os.path.join(
+                    tmp, f"sidecar-{host}.yaml")
+                with open(cfg_path, "w") as f:
+                    yaml.safe_dump(sidecar_cfg, f)
+                procs.append(spawn_sidecar(cfg_path, sock))
+            out.update(asyncio.run(run(tmp, sock_b, sock_c)))
+        finally:
+            for proc in procs:
+                proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=15)
+                except Exception:
+                    proc.kill()
+    out["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+    if emit:
+        print(json.dumps(out))
+    return out
+
+
 def bench_restart_smoke():
     """Warm-restart gate at smoke scale: render, "kill", restart with
     persistence on, and prove the first previously-seen tile serves
@@ -3488,6 +3845,9 @@ def main():
     # --smoke --hotkey runs the hot-plane replication drill (zipf
     # storm vs uniform mix, replication-disabled A/B, promotion →
     # staging → balanced reads → decay demotion) — the HOTKEY family.
+    # --smoke --partition runs the netsplit chaos drill (3-process
+    # fleet under load: partition → fence → heal → rejoin, plus a
+    # mid-partition epoch roll) — the PARTITION record family.
     if "--smoke" in sys.argv[1:]:
         if "--chaos" in sys.argv[1:]:
             bench_chaos_smoke()
@@ -3513,6 +3873,12 @@ def main():
             # scaling, cross-host warm shard handoff over the wire —
             # the MULTICHIP family's multi-process keys.
             bench_federation_smoke()
+        elif "--partition" in sys.argv[1:]:
+            # Netsplit chaos drill: a 3-process fleet under sustained
+            # load through partition -> fence -> heal -> rejoin with
+            # a mid-partition two-phase epoch roll — the PARTITION
+            # record family.
+            bench_partition_smoke()
         else:
             bench_smoke()
         return

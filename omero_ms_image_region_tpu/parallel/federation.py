@@ -28,9 +28,24 @@ makes the fleet span ``cluster.initialize()`` process/host boundaries:
   split-brain this subsystem exists to prevent) — and same-epoch
   digest mismatch is a refused join (:class:`FederationError`).
 * **Membership gossip** (``member_gossip`` wire op): hosts
-  periodically swap member-health views (healthy / draining, newest
-  timestamp wins) so cross-host drains and deaths propagate in one
-  gossip interval instead of one failed request per shard.
+  periodically swap member-health views (healthy / draining,
+  ``(incarnation, seq)``-versioned per observation — a host's fresh
+  state about its OWN members always supersedes stale claims, and
+  wall-clock skew can never resurrect a ghost) so cross-host drains
+  and deaths propagate in one gossip interval instead of one failed
+  request per shard.
+* **Quorum membership** (:class:`QuorumTracker`): a host's view is
+  QUORATE while it exchanges gossip with a strict majority of
+  manifest hosts within ``federation.suspect-after-s``; a minority
+  island FENCES — it keeps serving reads it can prove from its own
+  shards/byte tier but refuses shard adoption, byte-tier write-back
+  authority changes, hot-key promotions and autoscaler membership
+  transitions until the partition heals.
+* **Orchestrated epoch rolls** (``epoch_propose`` / ``epoch_commit``
+  wire ops): a coordinator proposes the next manifest to every host,
+  collects a strict majority of acks, then commits — idempotent and
+  crash-resumable from the pending-manifest state; routers swap rings
+  only at commit, never mid-flight.
 * **Cross-host warm handoff** (``shard_transfer`` wire op): a drain
   whose successor lives on ANOTHER host ships the warm HBM bytes
   themselves over the v3 wire (ring-eligible bodies) — the successor
@@ -210,12 +225,33 @@ _PENDING: Optional[FleetManifest] = None
 # stamped on hello/gossip answers so peers label clocks, spans and
 # decision records without a reverse manifest lookup.
 _SELF_HOST: str = ""
+# This process's gossip INCARNATION (the SWIM idiom): bumped past
+# wall-clock seconds at install so a restarted host's fresh state
+# versions ABOVE its pre-crash ghost, and bumped past any stale
+# higher-versioned claim a peer holds about our own members
+# (self-refutation in local_view).  ``_LOCAL_SEQ`` bumps on every
+# local member state change; observations carry ``(inc, seq)`` and
+# merges compare those, never wall clocks.
+_INCARNATION: int = 0
+_LOCAL_SEQ: int = 0
+# member name -> last (healthy, draining) this process published, so
+# local_view knows when to bump _LOCAL_SEQ.
+_LOCAL_LAST: Dict[str, tuple] = {}
+# The router swap hook (set by the serving layer): called with the
+# newly-activated manifest at epoch COMMIT — the only instant a live
+# ring may change.
+_ROLL_HOOK = None
+_QUORUM: Optional["QuorumTracker"] = None
 
 
 def install(manifest: FleetManifest,
             self_host: Optional[str] = None) -> None:
-    global _MANIFEST, _SELF_HOST
+    global _MANIFEST, _SELF_HOST, _INCARNATION
     _MANIFEST = manifest
+    # Strictly increasing across restarts AND within a process (the
+    # max() arm covers frozen/mocked clocks): a rejoining host's first
+    # gossip supersedes every pre-crash observation of its members.
+    _INCARNATION = max(_INCARNATION + 1, int(time.time()))
     from ..utils import decisions, telemetry
     if self_host is not None:
         _SELF_HOST = self_host
@@ -227,6 +263,8 @@ def install(manifest: FleetManifest,
     decisions.record("epoch", "installed", detail={
         "epoch": manifest.version, "digest": manifest.digest(),
         "members": len(manifest.members)})
+    if _QUORUM is not None:
+        _QUORUM.set_manifest(manifest)
     logger.info("federation manifest installed: epoch %d, %d members, "
                 "digest %s", manifest.version, len(manifest.members),
                 manifest.digest())
@@ -280,11 +318,244 @@ def pending() -> Optional[FleetManifest]:
 
 
 def uninstall() -> None:
-    global _MANIFEST, _PENDING, _SELF_HOST
+    global _MANIFEST, _PENDING, _SELF_HOST, _ROLL_HOOK, _QUORUM
+    global _LOCAL_SEQ
     _MANIFEST = None
     _PENDING = None
     _SELF_HOST = ""
+    _ROLL_HOOK = None
+    _QUORUM = None
+    _LOCAL_SEQ = 0
+    _LOCAL_LAST.clear()
     _HOST_CLOCKS.clear()
+
+
+# ----------------------------------------------------- quorum membership
+
+class QuorumTracker:
+    """Strict-majority membership over the manifest's DISTINCT hosts.
+
+    A host is *heard* while its last successful gossip/hello exchange
+    (either direction) is younger than ``suspect_after_s``; the view
+    is QUORATE while ``heard hosts (self included)`` is a strict
+    majority of manifest hosts.  Losing quorum FENCES this process:
+    :meth:`allow` refuses (and counts) every state-changing action in
+    :data:`ACTIONS` — reads this host can prove from its own shards
+    keep serving — and regaining quorum restores.  Transitions land in
+    the decision ledger (kind=``quorum``, verdicts
+    ``fenced``/``restored``) and on the flight ring
+    (``quorum.fence``/``quorum.restore``); /readyz and
+    /admin/federation annotate from :meth:`status`.
+
+    Liveness is tracked on ``time.monotonic()`` — the whole point is
+    immunity to wall clocks.  Remote hosts start as heard-now
+    (innocent until ``suspect_after_s`` of silence): fencing a booting
+    majority host for the crime of not having gossiped yet would turn
+    every cold start into an outage.  Single-host manifests are
+    always quorate (majority of 1)."""
+
+    ACTIONS = ("adoption", "write_authority", "promotion",
+               "autoscaler", "transfer", "roll")
+
+    def __init__(self, manifest: FleetManifest, self_host: str,
+                 suspect_after_s: float = 10.0,
+                 clock=time.monotonic):
+        self.self_host = str(self_host)
+        self.suspect_after_s = max(0.1, float(suspect_after_s))
+        self.clock = clock
+        self.fenced = False
+        self.fence_t: Optional[float] = None
+        self.restore_t: Optional[float] = None
+        self.refusals: Dict[str, int] = {}
+        self._hosts: set = set()
+        self._heard: Dict[str, float] = {}
+        self.set_manifest(manifest)
+
+    def set_manifest(self, manifest: FleetManifest) -> None:
+        """Adopt a (possibly rolled) manifest's host set; hosts new to
+        the membership start heard-now, departed hosts drop out of the
+        denominator."""
+        self._hosts = {m.host for m in manifest.members}
+        now = self.clock()
+        for host in self._hosts:
+            if host != self.self_host:
+                self._heard.setdefault(host, now)
+        for host in list(self._heard):
+            if host not in self._hosts:
+                del self._heard[host]
+
+    def observe(self, host: str) -> None:
+        """One successful exchange with ``host`` (either direction —
+        an inbound hello/gossip proves the link exactly as well as an
+        answered outbound one)."""
+        host = str(host or "")
+        if host and host != self.self_host and host in self._hosts:
+            self._heard[host] = self.clock()
+
+    def reachable_hosts(self) -> List[str]:
+        now = self.clock()
+        return sorted(
+            h for h, t in self._heard.items()
+            if now - t <= self.suspect_after_s)
+
+    def quorate(self) -> bool:
+        return (1 + len(self.reachable_hosts())) * 2 > \
+            max(1, len(self._hosts))
+
+    def evaluate(self) -> bool:
+        """Recompute the verdict and record fence/restore transitions.
+        Cheap enough for per-dispatch callers (a set scan over <=
+        manifest-host-count entries)."""
+        from ..utils import decisions, telemetry
+        reachable = self.reachable_hosts()
+        quorate = (1 + len(reachable)) * 2 > max(1, len(self._hosts))
+        telemetry.QUORUM.set_quorum(quorate, 1 + len(reachable),
+                                    len(self._hosts))
+        if quorate and self.fenced:
+            self.fenced = False
+            self.restore_t = self.clock()
+            telemetry.QUORUM.count_transition("restored")
+            telemetry.FLIGHT.record(
+                "quorum.restore", host=self.self_host,
+                reachable=1 + len(reachable),
+                hosts=len(self._hosts))
+            decisions.record("quorum", "restored", detail={
+                "reachable": [self.self_host] + reachable,
+                "hosts": sorted(self._hosts),
+                "fenced_s": (round(self.restore_t - self.fence_t, 3)
+                             if self.fence_t is not None else None),
+                "refusals": dict(self.refusals)})
+            logger.warning(
+                "quorum restored: %d/%d hosts reachable (refused "
+                "while fenced: %s)", 1 + len(reachable),
+                len(self._hosts), dict(self.refusals) or "nothing")
+        elif not quorate and not self.fenced:
+            self.fenced = True
+            self.fence_t = self.clock()
+            telemetry.QUORUM.count_transition("fenced")
+            telemetry.FLIGHT.record(
+                "quorum.fence", host=self.self_host,
+                reachable=1 + len(reachable),
+                hosts=len(self._hosts))
+            decisions.record("quorum", "fenced", detail={
+                "reachable": [self.self_host] + reachable,
+                "hosts": sorted(self._hosts),
+                "suspect_after_s": self.suspect_after_s})
+            logger.warning(
+                "quorum LOST: only %d/%d hosts reachable — fencing "
+                "(own-shard reads keep serving; adoption, write-backs,"
+                " promotions, autoscaling and rolls refuse)",
+                1 + len(reachable), len(self._hosts))
+        return quorate
+
+    def allow(self, action: str) -> bool:
+        """May this state-changing ``action`` proceed?  False counts a
+        refusal (telemetry + the restore record's tally) — callers
+        skip/fail the action, they never raise from here."""
+        if self.evaluate():
+            return True
+        if action in self.ACTIONS:
+            from ..utils import telemetry
+            telemetry.QUORUM.count_refusal(action)
+            self.refusals[action] = self.refusals.get(action, 0) + 1
+        return False
+
+    def status(self) -> dict:
+        """The /admin/federation ``quorum`` section (and the /readyz
+        annotation material)."""
+        self.evaluate()
+        return {
+            "quorate": not self.fenced,
+            "fenced": self.fenced,
+            "hosts": sorted(self._hosts),
+            "reachable": [self.self_host] + self.reachable_hosts(),
+            "suspect_after_s": self.suspect_after_s,
+            "refusals": dict(self.refusals),
+        }
+
+
+def install_quorum(tracker: Optional[QuorumTracker]) -> None:
+    global _QUORUM
+    _QUORUM = tracker
+
+
+def quorum_tracker() -> Optional[QuorumTracker]:
+    return _QUORUM
+
+
+def observe_host(host) -> None:
+    """Feed one successful cross-host exchange into the quorum
+    tracker (no-op when quorum is off)."""
+    if _QUORUM is not None and host:
+        _QUORUM.observe(str(host))
+
+
+def is_fenced() -> bool:
+    """Is this process a fenced minority island right now?  False
+    when quorum tracking is off — every pre-quorum behavior is then
+    bit-exact."""
+    return _QUORUM is not None and not _QUORUM.evaluate()
+
+
+def quorum_allow(action: str) -> bool:
+    """Gate a state-changing action on quorum (True when tracking is
+    off).  The fence sites: ring adoption / failover re-homes
+    (``adoption``), byte-tier write-backs (``write_authority``),
+    hot-key promotions (``promotion``), autoscaler transitions
+    (``autoscaler``), inbound shard staging (``transfer``) and epoch
+    rolls (``roll``)."""
+    if _QUORUM is None:
+        return True
+    return _QUORUM.allow(action)
+
+
+def quorum_status() -> Optional[dict]:
+    return _QUORUM.status() if _QUORUM is not None else None
+
+
+# -------------------------------------------------- orchestrated rolls
+
+def set_roll_hook(hook) -> None:
+    """Register the serving layer's ring-swap callback: called with
+    the newly-activated :class:`FleetManifest` at epoch COMMIT (the
+    only instant a live ring may change)."""
+    global _ROLL_HOOK
+    _ROLL_HOOK = hook
+
+
+def activate_manifest(manifest: FleetManifest) -> bool:
+    """Activate a committed epoch: swap the process-global manifest,
+    clear a pending copy it supersedes, and invoke the roll hook so
+    the live router swaps rings atomically.  Idempotent — activating
+    the already-active (or an older) epoch is a no-op returning
+    False."""
+    global _MANIFEST, _PENDING
+    mine = _MANIFEST
+    if mine is not None and manifest.version <= mine.version:
+        return False
+    from ..utils import decisions, telemetry
+    _MANIFEST = manifest
+    if _PENDING is not None \
+            and _PENDING.version <= manifest.version:
+        _PENDING = None
+    telemetry.FEDERATION.set_manifest(manifest.version,
+                                      len(manifest.members))
+    if _QUORUM is not None:
+        _QUORUM.set_manifest(manifest)
+    decisions.record("epoch", "installed", detail={
+        "epoch": manifest.version, "digest": manifest.digest(),
+        "members": len(manifest.members), "roll": True})
+    hook = _ROLL_HOOK
+    if hook is not None:
+        try:
+            hook(manifest)
+        except Exception:
+            logger.exception("epoch roll hook failed (epoch %d) — "
+                             "manifest activated, ring swap did not "
+                             "complete", manifest.version)
+    logger.info("epoch %d activated by orchestrated roll (digest %s)",
+                manifest.version, manifest.digest())
+    return True
 
 
 # ----------------------------------------------------- cross-host clocks
@@ -370,6 +641,9 @@ def handle_manifest_hello(header: dict) -> dict:
     mine = _MANIFEST
     if mine is None:
         return {"enabled": False}
+    # An inbound hello proves the sender's host is reachable exactly
+    # as well as an answered outbound exchange would.
+    observe_host(header.get("from_host"))
     doc: dict = {
         "enabled": True,
         "version": mine.version,
@@ -435,14 +709,45 @@ def handle_manifest_hello(header: dict) -> dict:
 
 
 # Gossip view: member name -> {"healthy": bool, "draining": bool,
-# "ts": float} — wall-clock stamped, newest observation wins on merge.
+# "inc": int, "seq": int, "ts": float}.  The HIGHEST ``(inc, seq)``
+# observation wins on merge — logical versions, never wall clocks (a
+# skewed-ahead peer could otherwise pin a stale verdict forever).
+# ``ts`` survives for display only.  Legacy observations without
+# ``inc`` compare as ``(0, ts)``: among themselves they keep the old
+# newest-ts behavior, and ANY versioned observation supersedes them.
 _GOSSIP_VIEW: Dict[str, dict] = {}
+
+
+def _obs_version(obs: dict) -> tuple:
+    """An observation's logical version for merge ordering."""
+    try:
+        inc = int(obs.get("inc", 0))
+    except (TypeError, ValueError):
+        inc = 0
+    if inc > 0:
+        try:
+            return (inc, float(obs.get("seq", 0)))
+        except (TypeError, ValueError):
+            return (inc, 0.0)
+    try:
+        return (0, float(obs.get("ts", 0)))
+    except (TypeError, ValueError):
+        return (0, 0.0)
 
 
 def local_view(router, self_host: str = "") -> Dict[str, dict]:
     """This process's authoritative member observations: LOCAL members'
     health/drain state straight from the router (a host knows its own
-    members best), stamped now."""
+    members best), stamped with this process's ``(incarnation, seq)``
+    — seq bumps on every state change, so a changed truth always
+    versions above the last one we published.
+
+    Self-refutation (the SWIM rejoin rule): if the merged view holds a
+    HIGHER-versioned observation about one of our own members that
+    disagrees with the live router state — a pre-restart ghost of
+    ourselves, or a peer's stale relay — bump our incarnation above it
+    so the fresh truth supersedes fleet-wide."""
+    global _INCARNATION, _LOCAL_SEQ
     mine = _MANIFEST
     view: Dict[str, dict] = {}
     if router is None or mine is None:
@@ -456,8 +761,21 @@ def local_view(router, self_host: str = "") -> Dict[str, dict]:
         member = router.members.get(name)
         if member is None:
             continue
-        obs = {"healthy": bool(member.healthy),
-               "draining": bool(member.draining),
+        state = (bool(member.healthy), bool(member.draining))
+        if _LOCAL_LAST.get(name) != state:
+            _LOCAL_LAST[name] = state
+            _LOCAL_SEQ += 1
+        held = _GOSSIP_VIEW.get(name)
+        if held is not None \
+                and _obs_version(held) > (_INCARNATION, _LOCAL_SEQ) \
+                and (bool(held.get("healthy", True)),
+                     bool(held.get("draining", False))) != state:
+            _INCARNATION = max(_INCARNATION,
+                               _obs_version(held)[0]) + 1
+        obs = {"healthy": state[0],
+               "draining": state[1],
+               "inc": _INCARNATION,
+               "seq": _LOCAL_SEQ,
                "ts": now}
         # Hot-key posture rides the gossip wire: how many promoted
         # routes this member serves replicas for (duck-typed — drill
@@ -476,8 +794,9 @@ def local_view(router, self_host: str = "") -> Dict[str, dict]:
 
 
 def merge_view(view: dict) -> Dict[str, dict]:
-    """Fold a peer's view into the process gossip state (newest ``ts``
-    per member wins) and return the merged state.
+    """Fold a peer's view into the process gossip state (highest
+    ``(incarnation, seq)`` per member wins — see ``_obs_version``)
+    and return the merged state.
 
     Names are validated against the ACTIVE manifest (the socket is
     unauthenticated-by-design like every sidecar op, and the merged
@@ -492,7 +811,7 @@ def merge_view(view: dict) -> Dict[str, dict]:
             if not isinstance(obs, dict):
                 continue
             # Store and look up under the SAME (bounded) key, or an
-            # over-long name would bypass the newest-ts merge.
+            # over-long name would bypass the versioned merge.
             name = str(name)[:64]
             if known is not None:
                 if name not in known:
@@ -501,13 +820,16 @@ def merge_view(view: dict) -> Dict[str, dict]:
                     and len(_GOSSIP_VIEW) >= 256:
                 continue
             held = _GOSSIP_VIEW.get(name)
-            if held is None or float(obs.get("ts", 0)) \
-                    > float(held.get("ts", 0)):
+            if held is None or _obs_version(obs) > _obs_version(held):
                 stored = {
                     "healthy": bool(obs.get("healthy", True)),
                     "draining": bool(obs.get("draining", False)),
                     "ts": float(obs.get("ts", 0)),
                 }
+                version = _obs_version(obs)
+                if version[0] > 0:
+                    stored["inc"] = version[0]
+                    stored["seq"] = int(version[1])
                 try:
                     hot = int(obs.get("hot", 0))
                 except (TypeError, ValueError):
@@ -528,6 +850,7 @@ def handle_member_gossip(header: dict) -> dict:
     round trips."""
     from ..utils import telemetry
     mine = _MANIFEST
+    observe_host(header.get("from_host"))
     merged = merge_view(header.get("view") or {})
     doc: dict = {"enabled": mine is not None, "view": merged}
     if mine is not None:
@@ -541,9 +864,103 @@ def handle_member_gossip(header: dict) -> dict:
     return doc
 
 
+def handle_epoch_propose(header: dict) -> dict:
+    """Server side of ``epoch_propose`` (two-phase roll, phase 1):
+    validate the proposed manifest, record it PENDING, and ack.
+    Nothing activates here — the live router keeps routing the epoch
+    it was built with until the commit.  Idempotent: re-proposing the
+    version already pending (a coordinator that died mid-propose and
+    resumed) acks again; proposing at-or-below the active epoch
+    refuses ``stale`` unless it IS the active manifest
+    (``already-active`` — a crash-resumed roll finding its work done).
+    A fenced minority host refuses — it cannot know whether the
+    majority already rolled past this proposal."""
+    from ..utils import telemetry
+    mine = _MANIFEST
+    if mine is None:
+        return {"enabled": False}
+    observe_host(header.get("from_host"))
+    doc: dict = {"enabled": True, "host": _SELF_HOST,
+                 "clock": time.perf_counter()}
+    try:
+        proposed = FleetManifest.from_json(header.get("manifest") or {})
+    except (KeyError, TypeError, ValueError):
+        doc.update(ack=False, reason="malformed")
+        return doc
+    if is_fenced():
+        quorum_allow("roll")         # count the refusal
+        doc.update(ack=False, reason="fenced")
+        return doc
+    if proposed.version <= mine.version:
+        if proposed.digest() == mine.digest():
+            doc.update(ack=True, reason="already-active")
+        else:
+            doc.update(ack=False, reason="stale",
+                       active_version=mine.version)
+        return doc
+    set_pending(proposed)
+    telemetry.FLIGHT.record("epoch.propose", epoch=proposed.version,
+                            digest=proposed.digest()[:12],
+                            by=str(header.get("from_host") or "?"))
+    doc.update(ack=True, reason="pending",
+               pending_version=proposed.version)
+    return doc
+
+
+def handle_epoch_commit(header: dict) -> dict:
+    """Server side of ``epoch_commit`` (two-phase roll, phase 2):
+    digest-verify the committed manifest and ACTIVATE it — the one
+    instant the ring swaps (via the registered roll hook).  Idempotent:
+    committing the already-active epoch answers ``already-active``; an
+    older epoch answers ``stale`` (a superseded roll's late commit
+    must not regress the fleet).  The commit carries the FULL
+    manifest, so a host that never saw the propose (rebooted between
+    phases, or healed from a partition after the roll) still converges
+    — this is also the anti-entropy catch-up the gossip loop pushes to
+    stale peers."""
+    from ..utils import telemetry
+    mine = _MANIFEST
+    if mine is None:
+        return {"enabled": False}
+    observe_host(header.get("from_host"))
+    doc: dict = {"enabled": True, "host": _SELF_HOST,
+                 "clock": time.perf_counter()}
+    try:
+        committed = FleetManifest.from_json(
+            header.get("manifest") or {})
+    except (KeyError, TypeError, ValueError):
+        doc.update(ack=False, reason="malformed")
+        return doc
+    claimed = header.get("digest")
+    if claimed is not None and str(claimed) != committed.digest():
+        # The unauthenticated-socket posture: the doc must be
+        # byte-exactly what the coordinator committed fleet-wide.
+        doc.update(ack=False, reason="digest-mismatch")
+        return doc
+    if committed.version < mine.version:
+        doc.update(ack=False, reason="stale",
+                   active_version=mine.version)
+        return doc
+    if committed.version == mine.version:
+        ok = committed.digest() == mine.digest()
+        doc.update(ack=ok, reason="already-active" if ok
+                   else "split-brain")
+        return doc
+    activate_manifest(committed)
+    telemetry.FLIGHT.record("epoch.commit", epoch=committed.version,
+                            digest=committed.digest()[:12],
+                            by=str(header.get("from_host") or "?"))
+    doc.update(ack=True, reason="installed",
+               active_version=committed.version)
+    return doc
+
+
 def reset_gossip() -> None:
     """Test isolation."""
+    global _LOCAL_SEQ
     _GOSSIP_VIEW.clear()
+    _LOCAL_LAST.clear()
+    _LOCAL_SEQ = 0
 
 
 # ------------------------------------------------------- device pinning
@@ -630,8 +1047,17 @@ def build_federated_members(config, base_services, manifest,
         if spec.name in by_name:
             members.append(by_name[spec.name])
         else:
+            client = client_factory(spec.address)
+            # Stamp the destination HOST on the wire client: the
+            # link-partition hook (utils.faultinject.partitioned)
+            # keys on (self_host, peer_host), and un-stamped clients
+            # — the front-door/proxy path — never match a rule.
+            try:
+                client.peer_host = spec.host
+            except AttributeError:
+                pass               # duck-typed drill clients
             members.append(RemoteMember(
-                spec.name, client_factory(spec.address),
+                spec.name, client,
                 down_cooldown_s=config.fleet.down_cooldown_s))
     return members
 
@@ -649,20 +1075,53 @@ class FederationCoordinator:
     manifest-drift detection."""
 
     def __init__(self, manifest: FleetManifest, self_host: str,
-                 router=None, gossip_interval_s: float = 5.0):
+                 router=None, gossip_interval_s: float = 5.0,
+                 handles: Optional[List] = None):
         self.manifest = manifest
         self.self_host = self_host
         self.router = router
+        # Router-less gossipers (sidecar member processes): explicit
+        # remote handles instead — every host must gossip ACTIVELY or
+        # two non-routing hosts would never prove their link to each
+        # other and a partition of the one router would fence them.
+        self.handles = list(handles) if handles is not None else None
         self.gossip_interval_s = max(0.2, float(gossip_interval_s))
+        # Deterministic per-host tick jitter (seeded: reproducible
+        # schedules, like every chaos knob): +/-20% keeps an N-host
+        # fleet's gossip bursts from synchronizing into a thundering
+        # herd on one member.
+        import random
+        self._jitter_rng = random.Random(
+            f"{self_host}:{manifest.ring_seed}:gossip-jitter")
         # name -> verdict of the last agreement exchange.
         self.agreement: Dict[str, str] = {}
         self.last_gossip: Dict[str, str] = {}
 
     def _remote_handles(self) -> List:
         if self.router is None:
-            return []
+            return list(self.handles) if self.handles else []
         return [self.router.members[n] for n in self.router.order
                 if getattr(self.router.members[n], "remote", False)]
+
+    def next_interval_s(self) -> float:
+        """The next gossip sleep: the configured interval jittered
+        uniformly within +/-20% (seeded per host, so tests can pin
+        the schedule)."""
+        return self.gossip_interval_s \
+            * (0.8 + 0.4 * self._jitter_rng.random())
+
+    def _refresh_manifest(self) -> None:
+        """Adopt the process-global ACTIVE manifest when an epoch
+        commit landed wire-side (handle_epoch_commit / a peer's
+        anti-entropy push) and outran this coordinator's copy.
+        Activation already swapped the ring at commit time, so the
+        identity this coordinator gossips/agrees with must follow —
+        otherwise a healed host keeps advertising the pre-roll digest
+        forever and every round logs phantom drift."""
+        active = current()
+        if active is not None \
+                and active.version > self.manifest.version:
+            self.manifest = active
 
     async def agree(self, strict: bool = True) -> Dict[str, str]:
         """One agreement round with every remote member.  Returns the
@@ -683,6 +1142,7 @@ class FederationCoordinator:
           peer whose ring math disagrees with its own digest): a
           refused join under ``strict``."""
         from ..utils import telemetry
+        self._refresh_manifest()
         doc = self.manifest.to_json()
         my_owners = self.manifest.owners(list(PROBE_KEYS))
         verdicts: Dict[str, str] = {}
@@ -702,6 +1162,7 @@ class FederationCoordinator:
                 # stay unanchored, nothing errors.
                 record_host_clock(resp.get("host") or host,
                                   t_send, t_recv, resp.get("clock"))
+                observe_host(resp.get("host") or host)
             if resp is None:
                 verdicts[member.name] = "unreachable"
                 telemetry.FEDERATION.count_agreement("unreachable")
@@ -773,6 +1234,7 @@ class FederationCoordinator:
         a drain ordered on host B walks routing off B's members here
         within one interval, before any request fails over."""
         from ..utils import telemetry
+        self._refresh_manifest()
         view = local_view(self.router, self.self_host)
         merge_view(view)
         outcome: Dict[str, str] = {}
@@ -794,12 +1256,23 @@ class FederationCoordinator:
                 # reconnects and drift heal within one interval.
                 record_host_clock(resp.get("host") or host,
                                   t_send, t_recv, resp.get("clock"))
+                observe_host(resp.get("host") or host)
                 telemetry.FED_SLO.ingest(resp.get("host") or host,
                                          resp.get("slo"))
             if resp is None or not resp.get("enabled", True):
                 outcome[member.name] = "unreachable"
                 telemetry.FEDERATION.count_gossip("unreachable")
                 continue
+            their_version = resp.get("version")
+            if isinstance(their_version, int) \
+                    and their_version < self.manifest.version \
+                    and not is_fenced():
+                # Anti-entropy catch-up: the peer runs an OLDER epoch
+                # than the one this quorate host committed (it healed
+                # from a partition, or rebooted between roll phases).
+                # Re-push the commit — idempotent on the receiver —
+                # so the fleet converges without operator action.
+                await self._catchup(member, host)
             their_digest = resp.get("digest")
             pend = pending()
             if their_digest not in (None, my_digest):
@@ -832,7 +1305,130 @@ class FederationCoordinator:
                                      "host": self.manifest.host_of(
                                          name)})
         self.last_gossip = outcome
+        q = quorum_tracker()
+        if q is not None:
+            # The round's reachability verdict — fences and restores
+            # transition HERE (and lazily at any gated action), within
+            # one gossip interval of the link change.
+            q.evaluate()
         return outcome
+
+    async def _catchup(self, member, host: str) -> None:
+        """Push our committed epoch to a stale peer (anti-entropy;
+        best-effort — the next round retries)."""
+        commit_fn = getattr(member, "epoch_commit", None)
+        if commit_fn is None:
+            return                   # duck-typed drill stubs
+        try:
+            resp = await commit_fn(self.manifest.to_json(),
+                                    digest=self.manifest.digest())
+        except Exception:
+            return
+        if isinstance(resp, dict) and resp.get("ack"):
+            logger.info("anti-entropy: pushed epoch %d to %s (%s)",
+                        self.manifest.version, member.name,
+                        resp.get("reason"))
+
+    async def roll_epoch(self, new_manifest: FleetManifest) -> dict:
+        """Coordinator-driven two-phase epoch roll.
+
+        Phase 1 (``epoch_propose``): offer the new manifest to one
+        member per remote HOST; each validating host records it
+        PENDING and acks.  A strict majority of manifest hosts (self
+        counts) must ack, or the roll aborts with nothing activated
+        anywhere — a minority can never advance the epoch.
+
+        Phase 2 (``epoch_commit``): push the full manifest to every
+        remote host (idempotent receivers), then activate locally (the
+        registered roll hook swaps the live ring — the ONLY mid-flight
+        ring change the router ever performs).  A coordinator that
+        dies between phases leaves peers holding a pending manifest:
+        re-running the same roll re-proposes idempotently, and a
+        SUPERSEDING roll (higher version) simply outversions it.
+        Hosts the commit missed converge through the gossip loop's
+        anti-entropy push.
+
+        Returns ``{"committed": bool, "acks": int, "hosts": int,
+        "verdicts": {host: reason}}``."""
+        from ..utils import decisions, telemetry
+        if new_manifest.version <= self.manifest.version:
+            raise ValueError(
+                f"epoch roll must raise the version (active "
+                f"{self.manifest.version}, proposed "
+                f"{new_manifest.version})")
+        if not quorum_allow("roll"):
+            decisions.record("epoch", "failed", detail={
+                "epoch": new_manifest.version, "reason": "fenced"})
+            return {"committed": False, "acks": 0,
+                    "hosts": 0, "verdicts": {},
+                    "reason": "fenced"}
+        doc = new_manifest.to_json()
+        digest = new_manifest.digest()
+        hosts = {m.host for m in self.manifest.members}
+        # One propose per remote HOST (the manifest is process-global
+        # on the receiver; a host's members share one process there).
+        by_host: Dict[str, object] = {}
+        for member in self._remote_handles():
+            host = self.manifest.host_of(member.name)
+            if host and host != self.self_host:
+                by_host.setdefault(host, member)
+        telemetry.FLIGHT.record("epoch.propose", epoch=doc["version"],
+                                digest=digest[:12], by=self.self_host)
+        decisions.record("epoch", "pending", detail={
+            "pending_epoch": new_manifest.version,
+            "pending_digest": digest, "roll": True,
+            "phase": "propose"})
+        verdicts: Dict[str, str] = {}
+        acks = 1                      # self: the coordinator agrees
+        for host, member in by_host.items():
+            propose_fn = getattr(member, "epoch_propose", None)
+            if propose_fn is None:
+                verdicts[host] = "legacy"
+                continue
+            try:
+                resp = await propose_fn(doc)
+            except Exception:
+                resp = None
+            if not isinstance(resp, dict):
+                verdicts[host] = "unreachable"
+                continue
+            observe_host(resp.get("host") or host)
+            verdicts[host] = str(resp.get("reason") or (
+                "ack" if resp.get("ack") else "refused"))
+            if resp.get("ack"):
+                acks += 1
+        if acks * 2 <= len(hosts):
+            decisions.record("epoch", "failed", detail={
+                "epoch": new_manifest.version, "acks": acks,
+                "hosts": len(hosts), "verdicts": verdicts})
+            logger.warning(
+                "epoch roll %d aborted: %d/%d host acks is not a "
+                "strict majority (%s)", new_manifest.version, acks,
+                len(hosts), verdicts)
+            return {"committed": False, "acks": acks,
+                    "hosts": len(hosts), "verdicts": verdicts}
+        for host, member in by_host.items():
+            commit_fn = getattr(member, "epoch_commit", None)
+            if commit_fn is None:
+                continue
+            try:
+                resp = await commit_fn(doc, digest=digest)
+            except Exception:
+                resp = None
+            if isinstance(resp, dict):
+                verdicts[host] = str(resp.get("reason")
+                                     or verdicts.get(host, "?"))
+        activate_manifest(new_manifest)
+        self.manifest = new_manifest
+        telemetry.FLIGHT.record("epoch.commit", epoch=doc["version"],
+                                digest=digest[:12], by=self.self_host)
+        decisions.record("epoch", "done", detail={
+            "epoch": new_manifest.version, "acks": acks,
+            "hosts": len(hosts), "verdicts": verdicts})
+        logger.info("epoch roll %d committed (%d/%d host acks)",
+                    new_manifest.version, acks, len(hosts))
+        return {"committed": True, "acks": acks,
+                "hosts": len(hosts), "verdicts": verdicts}
 
     def _apply_remote_view(self, merged: Dict[str, dict]) -> None:
         """Reflect peers' authoritative observations of THEIR OWN
@@ -885,9 +1481,13 @@ class FederationCoordinator:
         pend = pending()
         if pend is not None and pend.version > self.manifest.version:
             # The operator's roll signal: a newer epoch exists in the
-            # fleet and activates here on the next process restart.
+            # fleet and activates here on the next process restart
+            # (or the next orchestrated roll's commit).
             doc["pending_epoch"] = pend.version
             doc["pending_digest"] = pend.digest()
+        q = quorum_status()
+        if q is not None:
+            doc["quorum"] = q
         return doc
 
     def summary(self) -> str:
@@ -898,13 +1498,21 @@ class FederationCoordinator:
         pend = pending()
         if pend is not None and pend.version > self.manifest.version:
             line += f" (epoch {pend.version} pending roll)"
+        q = quorum_status()
+        if q is not None:
+            line += (" — FENCED minority partition (own-shard reads "
+                     "only)" if q["fenced"]
+                     else f" — quorate "
+                          f"{len(q['reachable'])}/{len(q['hosts'])}")
         return line
 
     async def run(self) -> None:
         """Gossip tick loop (the governor idiom; the app's robustness
-        startup hook owns the task)."""
+        startup hook owns the task).  Each sleep is jittered +/-20%
+        (seeded) so N hosts sharing an interval never synchronize
+        their gossip bursts into a thundering herd on one member."""
         while True:
-            await asyncio.sleep(self.gossip_interval_s)
+            await asyncio.sleep(self.next_interval_s())
             try:
                 await self.gossip_once()
             except Exception:

@@ -720,7 +720,12 @@ class RemoteMember:
         each, so golden assignments are verified AGAINST THE PEER'S
         OWN MATH, not our copy of it.  None = unreachable/legacy."""
         import json as _json
-        extra = {"manifest": doc}
+
+        from . import federation
+        extra = {"manifest": doc,
+                 # The sender's host identity: an inbound hello feeds
+                 # the receiver's quorum tracker (heard-from proof).
+                 "from_host": federation.self_host()}
         if probe_keys:
             extra["probe_keys"] = list(probe_keys)
         try:
@@ -733,14 +738,60 @@ class RemoteMember:
             return None
 
     async def member_gossip(self, view: dict) -> Optional[dict]:
-        """Swap membership views (name -> health/draining + a
-        timestamp) and the manifest (version, digest) — the rack-scale
-        liveness channel that propagates drains and deaths between
-        hosts faster than per-request failures would."""
+        """Swap membership views (name -> health/draining, versioned
+        ``(incarnation, seq)``) and the manifest (version, digest) —
+        the rack-scale liveness channel that propagates drains and
+        deaths between hosts faster than per-request failures would."""
         import json as _json
+
+        from . import federation
         try:
             status, body = await self.client.call(
-                "member_gossip", {}, extra={"view": view})
+                "member_gossip", {},
+                extra={"view": view,
+                       "from_host": federation.self_host()})
+            if status != 200 or not body:
+                return None
+            return dict(_json.loads(bytes(body).decode()))
+        except Exception:
+            return None
+
+    async def epoch_propose(self, doc: dict) -> Optional[dict]:
+        """Two-phase epoch roll, phase 1: offer the next manifest to
+        this member's process (it records PENDING and acks — nothing
+        activates).  Idempotent by contract, so the retry policy may
+        re-issue it.  None = unreachable."""
+        import json as _json
+
+        from . import federation
+        try:
+            status, body = await self.client.call(
+                "epoch_propose", {},
+                extra={"manifest": doc,
+                       "from_host": federation.self_host()})
+            if status != 200 or not body:
+                return None
+            return dict(_json.loads(bytes(body).decode()))
+        except Exception:
+            return None
+
+    async def epoch_commit(self, doc: dict,
+                           digest: str = "") -> Optional[dict]:
+        """Two-phase epoch roll, phase 2: commit the agreed manifest
+        — the receiver digest-verifies, activates, and swaps its ring.
+        Idempotent on the receiver (already-active answers ack), so
+        safe to re-push (the gossip loop's anti-entropy catch-up does
+        exactly that)."""
+        import json as _json
+
+        from . import federation
+        extra = {"manifest": doc,
+                 "from_host": federation.self_host()}
+        if digest:
+            extra["digest"] = digest
+        try:
+            status, body = await self.client.call(
+                "epoch_commit", {}, extra=extra)
             if status != 200 or not body:
                 return None
             return dict(_json.loads(bytes(body).decode()))
@@ -1104,7 +1155,9 @@ class FleetRouter:
         return self._walk_chain(self.ring.chain(route))
 
     def _walk_chain(self, chain: List[str]) -> str:
-        if not self.failover:
+        from . import federation
+        fenced = self.failover and federation.is_fenced()
+        if not self.failover or fenced:
             # Contract symmetry with _fail_queue: failover=false means
             # a dead member's shard FAILS — for queued work and new
             # arrivals alike.  Walking past an unhealthy owner here
@@ -1113,8 +1166,14 @@ class FleetRouter:
             # tick), exactly the shard migration the operator
             # disabled.  DRAINING is the exception: a drain is an
             # operator-ordered handoff, so its re-home is the point.
+            # A FENCED minority island takes the same no-re-home walk:
+            # adopting a silent peer's shard during a netsplit is how
+            # split brains write — the owner's call fails over the
+            # 503-with-shed contract instead, counted as a refusal.
             for name in chain:
                 if not self.members[name].draining:
+                    if fenced and not self._routable(name):
+                        federation.quorum_allow("adoption")
                     return name
             return chain[0]
         for name in chain:
@@ -1134,7 +1193,12 @@ class FleetRouter:
         heat = self._heat.observe(route)
         if heat >= self._heat.threshold \
                 and route not in self._replica_sets:
-            self._promote_route(route, heat)
+            from . import federation
+            if federation.quorum_allow("promotion"):
+                self._promote_route(route, heat)
+            # Fenced: promotion would stage bytes onto replicas this
+            # island cannot prove it owns — refused (counted); the
+            # route re-promotes on first hot dispatch after restore.
         self._sweep_hot_routes()
 
     def _promote_route(self, route: str, heat: float) -> None:
@@ -1256,6 +1320,36 @@ class FleetRouter:
         for route in routes:
             self._demote_route(route)
         return len(routes)
+
+    def apply_manifest(self, manifest) -> bool:
+        """Swap the routing ring to ``manifest``'s geometry at an
+        epoch COMMIT — the ONLY moment a live router's ring ever
+        changes (a propose leaves routing untouched; in-flight work
+        finishes on the old owners, the next dispatch routes on the
+        new ring).  Same-membership rolls (seed / replica-count /
+        epoch bumps) are the supported surface: a membership change
+        needs member construction this router cannot do and raises.
+        Promoted hot routes are shed first — their replica sets are
+        chain prefixes of the OLD ring and would pin stale owners
+        across the swap (re-heating routes re-promote on the new
+        ring's chains)."""
+        names = set(manifest.names())
+        if names != set(self.order):
+            raise ValueError(
+                "epoch roll changed fleet membership "
+                f"({sorted(names ^ set(self.order))}); a live router "
+                "only swaps ring geometry — membership changes need "
+                "a restart")
+        shed = self.shed_replicas()
+        self.ring = HashRing(self.order, replicas=manifest.replicas,
+                             seed=manifest.ring_seed)
+        from ..utils import telemetry
+        telemetry.FLIGHT.record("fleet.ring-swap",
+                                epoch=manifest.version,
+                                seed=str(manifest.ring_seed)[:16],
+                                replicas=manifest.replicas,
+                                shed_hot=shed)
+        return True
 
     def replica_set(self, route: str) -> List[str]:
         """The route's CURRENT replica set ([owner] when not
@@ -1809,6 +1903,9 @@ class FleetRouter:
                 continue
             if not getattr(member, "remote", False):
                 return            # local authority: already stored
+            from . import federation
+            if not federation.quorum_allow("write_authority"):
+                return        # fenced: no cross-split mask write-back
             async def put() -> None:
                 try:
                     if await member.byte_put(key, data, tier="mask"):
@@ -1833,6 +1930,13 @@ class FleetRouter:
             return
         owner = self.members.get(work.owner)
         if owner is None or not owner.remote or not owner.healthy:
+            return
+        from . import federation
+        if not federation.quorum_allow("write_authority"):
+            # Fenced minority: the byte-tier authority may have moved
+            # on the majority side — writing back across the split
+            # would be split-brain state.  Drop the ship (counted);
+            # the owner re-renders or re-probes after restore.
             return
         if getattr(work.ctx, "_pressure_quality_capped", False):
             # Brownout-capped bytes never land under the full-quality
@@ -1954,7 +2058,20 @@ class FleetRouter:
         failed, and it is exactly where the unit should land (a dead
         stealer's loot goes home; a 2-member fleet must not 503 a
         request whose shard owner is alive)."""
+        from . import federation
         from ..utils import provenance, telemetry
+        if reason == "failover" and not federation.quorum_allow(
+                "adoption"):
+            # Fenced minority: a death re-home is a shard ADOPTION —
+            # refused during a partition (the dead member may be alive
+            # and serving on the majority side).  The unit fails over
+            # the same ConnectionError -> 503-with-shed contract as an
+            # all-down fleet; operator drains stay allowed.
+            if not work.future.done():
+                work.future.set_exception(ConnectionError(
+                    "fenced minority partition: shard adoption "
+                    "refused"))
+            return
         chain = (list(self.order) if self._pinned(work.ctx)
                  else self.ring.chain(plane_route_key(work.ctx)))
         tried = work.hops

@@ -625,7 +625,23 @@ def create_app(config: Optional[AppConfig] = None,
                 ring_seed=ring_seed, wire_handoff=wire_handoff,
                 hotkey=config.hotkey)
             if fed_manifest is not None:
+                from ..parallel import federation as federation_mod
                 from ..parallel.federation import FederationCoordinator
+                if config.federation.quorum:
+                    # Quorum membership: this host's OWN failure
+                    # detector over the manifest hosts — a minority
+                    # island fences itself (deploy/DEPLOY.md
+                    # "Partitions & quorum").
+                    federation_mod.install_quorum(
+                        federation_mod.QuorumTracker(
+                            fed_manifest,
+                            self_host=config.federation.host,
+                            suspect_after_s=(
+                                config.federation.suspect_after_s)))
+                # Orchestrated epoch rolls: the router swaps its ring
+                # ONLY at commit (activate_manifest), never mid-flight.
+                federation_mod.set_roll_hook(
+                    fleet_router.apply_manifest)
                 federation_coord = FederationCoordinator(
                     fed_manifest, config.federation.host,
                     fleet_router,

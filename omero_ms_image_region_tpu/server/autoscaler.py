@@ -60,7 +60,8 @@ log = logging.getLogger("omero_ms_image_region_tpu.autoscaler")
 
 # Closed blocked-reason vocabulary (the ``reason`` label on
 # imageregion_autoscaler_blocked_total — never caller-minted).
-BLOCKED_REASONS = ("busy", "cooldown", "floor", "ceiling", "no-member")
+BLOCKED_REASONS = ("busy", "cooldown", "floor", "ceiling", "no-member",
+                   "quorum")
 
 
 class Autoscaler:
@@ -329,6 +330,12 @@ class Autoscaler:
                     and now - self._last_transition
                     < self.config.cooldown_s):
                 return self._blocked("cooldown", want, sig)
+            from ..parallel import federation
+            if not federation.quorum_allow("autoscaler"):
+                # Fenced minority: a membership transition is exactly
+                # the ring change a partition forbids — the majority
+                # side may be scaling the SAME units right now.
+                return self._blocked("quorum", want, sig)
             if want == "up":
                 return self._scale_up(now, sig)
             return self._scale_down(now, sig)

@@ -257,8 +257,24 @@ class FederationConfig:
     ring_seed: str = ""
     # Virtual ring nodes per member (part of the agreed manifest).
     hash_replicas: int = 64
-    # Seconds between membership gossip rounds.
+    # Seconds between membership gossip rounds (each process jitters
+    # its ticks ±20%, seeded, so fleets never herd their bursts).
     gossip_interval_s: float = 5.0
+    # Quorum membership (deploy/DEPLOY.md "Partitions & quorum"):
+    # when on, a host that cannot exchange gossip with a strict
+    # MAJORITY of manifest hosts within ``suspect_after_s`` FENCES —
+    # it keeps serving reads it can prove from its own shards/byte
+    # tier but refuses shard adoption, byte-tier write authority,
+    # hot-key promotions, autoscaler transitions and epoch rolls
+    # until the partition heals.  Off keeps the trusting PR 15
+    # behavior bit-exact.
+    quorum: bool = False
+    # Silence window before a manifest host is counted unreachable
+    # for the quorum verdict (monotonic clock; gossip and any inbound
+    # federation op from the host both refresh it).
+    suspect_after_s: float = 10.0
+    # Per-host ack wait during the two-phase roll's propose leg.
+    roll_ack_timeout_s: float = 5.0
     # The full fleet-wide member list, in ring order: dicts of
     # {name, host, address?} — address required for members other
     # hosts must reach (unix socket path or host:port TCP).
@@ -1083,6 +1099,12 @@ class AppConfig:
                                      fe_defaults.hash_replicas)),
             gossip_interval_s=float(fe.get(
                 "gossip-interval-s", fe_defaults.gossip_interval_s)),
+            quorum=bool(fe.get("quorum", fe_defaults.quorum)),
+            suspect_after_s=float(fe.get(
+                "suspect-after-s", fe_defaults.suspect_after_s)),
+            roll_ack_timeout_s=float(fe.get(
+                "roll-ack-timeout-s",
+                fe_defaults.roll_ack_timeout_s)),
             members=tuple(fed_members),
         )
         if cfg.federation.shard_epoch < 1:
@@ -1093,6 +1115,15 @@ class AppConfig:
         if cfg.federation.gossip_interval_s <= 0:
             raise ValueError("federation.gossip-interval-s must be "
                              "> 0")
+        if cfg.federation.suspect_after_s <= 0:
+            raise ValueError("federation.suspect-after-s must be > 0")
+        if cfg.federation.roll_ack_timeout_s <= 0:
+            raise ValueError("federation.roll-ack-timeout-s must be "
+                             "> 0")
+        if cfg.federation.quorum and not cfg.federation.enabled:
+            raise ValueError("federation.quorum requires "
+                             "federation.enabled (quorum is a verdict "
+                             "over manifest hosts)")
         if cfg.federation.enabled:
             if len(cfg.federation.members) < 2:
                 raise ValueError("federation.enabled requires >= 2 "

@@ -783,12 +783,20 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                         raise BadRequestError(
                             f"byte_put digest mismatch: claimed "
                             f"{claimed}, body is {actual}")
+                from ..parallel import federation as _fed
+                fenced = not _fed.quorum_allow("write_authority")
                 stored = False
-                if stack is not None \
+                if not fenced and stack is not None \
                         and getattr(stack, "enabled", False):
                     await stack.set(key, value)
                     stored = True
-                body = json.dumps({"stored": stored}).encode()
+                # A fenced minority refuses byte-tier write authority
+                # (counted) but answers gracefully — the sender's
+                # put is fire-and-forget best-effort by contract.
+                doc = {"stored": stored}
+                if fenced:
+                    doc["fenced"] = True
+                body = json.dumps(doc).encode()
             elif op == "shard_manifest":
                 # Rolling drain, step 1 (remote members): this
                 # member's HBM shard as restageable region entries —
@@ -828,10 +836,17 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                             # a cold miss later, never a failed drain
                     return staged
 
-                staged = (await asyncio.to_thread(_prestage)
-                          if cache is not None and pixels is not None
-                          else 0)
-                body = json.dumps({"staged": staged}).encode()
+                from ..parallel import federation as _fed
+                if not _fed.quorum_allow("transfer"):
+                    # Fenced: inbound staging is shard adoption by
+                    # another name — refused (counted), gracefully.
+                    body = json.dumps({"staged": 0,
+                                       "fenced": True}).encode()
+                else:
+                    staged = (await asyncio.to_thread(_prestage)
+                              if cache is not None
+                              and pixels is not None else 0)
+                    body = json.dumps({"staged": staged}).encode()
             elif op == "manifest_hello":
                 # Cross-host federation, join time: compare the
                 # joiner's fleet manifest against this process's
@@ -853,8 +868,68 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 # another host's draining member, staged here with
                 # their full region + routing identity.  State-changing
                 # like plane_put: digest-verified, never blind-retried.
-                body = await _shard_transfer(image_handler, header,
-                                             req_body)
+                from ..parallel import federation as _fed
+                if not _fed.quorum_allow("transfer"):
+                    # Fenced minority: accepting another host's shard
+                    # bytes IS the adoption a partition forbids.
+                    body = json.dumps({"staged": False,
+                                       "fenced": True}).encode()
+                else:
+                    body = await _shard_transfer(image_handler,
+                                                 header, req_body)
+            elif op == "epoch_propose":
+                # Orchestrated roll, phase 1: hold the proposed
+                # manifest PENDING (digest-checked, crash-resumable)
+                # and ack — routing is untouched until commit.
+                from ..parallel import federation
+                body = json.dumps(
+                    federation.handle_epoch_propose(header)).encode()
+            elif op == "epoch_commit":
+                # Orchestrated roll, phase 2: activate the pending (or
+                # carried) manifest if it is newer than the active
+                # epoch — idempotent, so coordinators retry freely.
+                from ..parallel import federation
+                body = json.dumps(
+                    federation.handle_epoch_commit(header)).encode()
+            elif op == "partition":
+                # Netsplit drill control: edit THIS process's OUTBOUND
+                # link-partition table (utils.faultinject.PARTITIONS).
+                # The op itself is exempt from partition checks —
+                # drills must always be able to heal what they broke.
+                from ..parallel import federation
+                from ..utils import faultinject
+                action = str(header.get("action") or "show")
+                try:
+                    if action == "add":
+                        faultinject.PARTITIONS.add(
+                            str(header.get("src") or ""),
+                            str(header.get("dst") or ""),
+                            mode=str(header.get("mode") or "drop"),
+                            bidirectional=bool(
+                                header.get("bidirectional")))
+                    elif action == "remove":
+                        faultinject.PARTITIONS.remove(
+                            str(header.get("src") or ""),
+                            str(header.get("dst") or ""),
+                            bidirectional=bool(
+                                header.get("bidirectional")))
+                    elif action == "clear":
+                        faultinject.PARTITIONS.clear()
+                    elif action != "show":
+                        raise BadRequestError(
+                            f"partition action {action!r} must be "
+                            f"add/remove/clear/show")
+                except ValueError as e:
+                    raise BadRequestError(str(e))
+                active = federation.current()
+                body = json.dumps({
+                    "rules": faultinject.PARTITIONS.snapshot(),
+                    "quorum": federation.quorum_status(),
+                    # Active epoch rides along so a drill can watch a
+                    # healed minority converge over this exempt op.
+                    "epoch": (active.version
+                              if active is not None else None),
+                }).encode()
             elif op == "explain":
                 # Dry-run residency probe (the /debug/explain plane):
                 # READ-ONLY by contract — no render, no admission, no
@@ -1111,15 +1186,21 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
     services = build_services(config)
     if services_out is not None:
         services_out["services"] = services
+    fed_manifest = None
     if getattr(config, "federation", None) is not None \
             and config.federation.enabled:
         # Federated member process: install the manifest so the
-        # manifest_hello / member_gossip ops answer from this
-        # process's own copy of the agreed membership.
+        # manifest_hello / member_gossip / epoch_* ops answer from
+        # this process's own copy of the agreed membership.
         from ..parallel import federation
-        federation.install(
-            federation.FleetManifest.from_config(config.federation),
-            self_host=config.federation.host)
+        fed_manifest = federation.FleetManifest.from_config(
+            config.federation)
+        federation.install(fed_manifest,
+                           self_host=config.federation.host)
+        if getattr(config.federation, "quorum", False):
+            federation.install_quorum(federation.QuorumTracker(
+                fed_manifest, self_host=config.federation.host,
+                suspect_after_s=config.federation.suspect_after_s))
     db_metadata = None
     if config.metadata_backend == "postgres":
         from ..services.db_metadata import PostgresMetadataService
@@ -1165,6 +1246,36 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
                             escalate_cb=_escalate)
         robustness_tasks.append(asyncio.create_task(
             wd.run(), name="watchdog"))
+    if fed_manifest is not None \
+            and config.federation.gossip_interval_s > 0:
+        # Host-level gossip loop: a device-owning member process runs
+        # its OWN failure detector against the other manifest HOSTS
+        # (one handle per remote host, deduped) so its quorum verdict
+        # — and therefore its fence — is local knowledge, not
+        # something a frontend must push to it.  No router: the
+        # coordinator only gossips and answers rolls.
+        from ..parallel import federation
+        from ..parallel.fleet import RemoteMember
+        gossip_handles = []
+        seen_hosts: set = set()
+        for spec in fed_manifest.remote_members(
+                config.federation.host):
+            if spec.host in seen_hosts or not spec.address:
+                continue
+            seen_hosts.add(spec.host)
+            peer_client = SidecarClient(spec.address,
+                                        wire=config.wire)
+            peer_client.peer_host = spec.host
+            gossip_handles.append(RemoteMember(spec.name,
+                                               peer_client))
+        if gossip_handles:
+            fed_coord = federation.FederationCoordinator(
+                fed_manifest, self_host=config.federation.host,
+                gossip_interval_s=(
+                    config.federation.gossip_interval_s),
+                handles=gossip_handles)
+            robustness_tasks.append(asyncio.create_task(
+                fed_coord.run(), name="federation-gossip"))
 
     def status_fn() -> dict:
         """The ping op's readiness document (frontend /readyz rolls
@@ -1181,6 +1292,12 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
             # /readyz annotation material: how far the boot
             # rehydrator has replayed the warm-state manifest.
             doc["rehydrate"] = telemetry.PERSIST.rehydrate_summary()
+        from ..parallel import federation as _fed
+        quorum = _fed.quorum_status()
+        if quorum is not None:
+            # Fencing is an ANNOTATION, not unreadiness: a fenced
+            # minority keeps answering for its own shards.
+            doc["quorum"] = quorum
         return doc
 
     def profile_fn(ms: float) -> dict:
@@ -1438,6 +1555,12 @@ class SidecarClient:
         # their ``member`` dimension so a multi-member waterfall stays
         # attributable.  None (plain proxy) adds nothing.
         self.member_label: Optional[str] = None
+        # Federation host this client reaches (set by
+        # ``parallel.federation.build_federated_members`` for
+        # cross-host members): the netsplit drill's partition table
+        # matches on (self_host, peer_host) links — an unstamped
+        # client (same-host proxy) can never be partitioned.
+        self.peer_host: str = ""
 
     async def _ensure_connected(self) -> _Conn:
         conn = self._conn
@@ -1689,6 +1812,7 @@ class SidecarClient:
             fut: Optional[asyncio.Future] = None
             rid = 0
             try:
+                self._check_partition(op)
                 conn = await self._ensure_connected()
                 self._next_id += 1
                 rid = self._next_id
@@ -1757,6 +1881,25 @@ class SidecarClient:
             self._wire_fires = 0    # a served reply ends the episode
             self._graft_response(resp_header, t_call, conn)
             return resp_header, resp_body
+
+    def _check_partition(self, op: str) -> None:
+        """Netsplit drill hook: when a link partition blocks traffic
+        from THIS host to ``peer_host``, the frame never leaves — the
+        call dies with the same ``ConnectionError`` a dead wire
+        raises, so it feeds the normal retry / breaker / mark-down
+        ladder (= 503-with-shed at the edge, never a bare 5xx).  The
+        ``partition`` control op is exempt: a drill must always be
+        able to heal what it broke."""
+        if op == "partition" or not self.peer_host:
+            return
+        from ..parallel import federation
+        from ..utils import faultinject
+        src = federation.self_host()
+        mode = faultinject.partitioned(src, self.peer_host)
+        if mode is not None:
+            raise ConnectionError(
+                f"link partitioned ({mode}): {src} -> "
+                f"{self.peer_host}")
 
     async def _retry_step(self, op: str, conn: Optional[_Conn],
                           rid: int, attempt: int, attempts: int,
@@ -1902,6 +2045,7 @@ class SidecarClient:
             rid = 0
             sink = _StreamSink()
             try:
+                self._check_partition(op)
                 conn = await self._ensure_connected()
                 self._next_id += 1
                 rid = self._next_id

@@ -175,3 +175,99 @@ def uninstall() -> None:
 
 def active() -> Optional[FaultInjector]:
     return _INSTALLED
+
+
+# ------------------------------------------------- link-level partitions
+
+class PartitionTable:
+    """Asymmetric link-level partitions for the netsplit drill.
+
+    Rules are ``(src_host, dst_host) -> mode`` — DIRECTIONAL, applied
+    client-side at the sidecar wire layer of the ``src_host`` process
+    (``SidecarClient.call_full`` / ``call_stream`` consult
+    :func:`partitioned` before a frame leaves the host).  ``mode``:
+
+    * ``"drop"`` — the link black-holes: the call surfaces as the
+      dead-wire ``ConnectionError`` the resilience ladder (retries,
+      breaker, mark-down) already handles, after its normal retries;
+    * ``"deny"`` — same error surface, counted separately (an
+      administratively-refused link vs a silently lossy one).
+
+    Deliberately SEPARATE from the seeded :class:`FaultInjector`:
+    partitions are topology state the drill flips on and off (via the
+    ``partition`` sidecar wire op), not a random schedule — no seed
+    gates them, and they stack with any installed injector.  Rules
+    where ``src_host`` is not this process's federation host simply
+    never match here (every process carries only its own outbound
+    view, exactly like real split routing tables)."""
+
+    MODES = ("drop", "deny")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: Dict[tuple, str] = {}
+
+    def add(self, src: str, dst: str, mode: str = "drop",
+            bidirectional: bool = False) -> None:
+        if mode not in self.MODES:
+            raise ValueError(f"partition mode must be one of "
+                             f"{self.MODES}, got {mode!r}")
+        src, dst = str(src), str(dst)
+        if not src or not dst or src == dst:
+            raise ValueError("partition rule needs distinct non-empty "
+                             "src and dst hosts")
+        with self._lock:
+            self._rules[(src, dst)] = mode
+            if bidirectional:
+                self._rules[(dst, src)] = mode
+        self._publish()
+
+    def remove(self, src: str, dst: str,
+               bidirectional: bool = False) -> None:
+        with self._lock:
+            self._rules.pop((str(src), str(dst)), None)
+            if bidirectional:
+                self._rules.pop((str(dst), str(src)), None)
+        self._publish()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules.clear()
+        self._publish()
+
+    def check(self, src: str, dst: str) -> Optional[str]:
+        """The blocking mode for src->dst traffic, or None (open
+        link).  Unknown/empty hosts are never partitioned — an
+        un-federated client (no ``peer_host`` stamp) cannot match."""
+        if not src or not dst:
+            return None
+        with self._lock:
+            return self._rules.get((src, dst))
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return [{"src": s, "dst": d, "mode": m}
+                    for (s, d), m in sorted(self._rules.items())]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rules)
+
+    def _publish(self) -> None:
+        from . import telemetry
+        telemetry.QUORUM.set_partition_rules(len(self))
+
+
+PARTITIONS = PartitionTable()
+
+
+def partitioned(src: str, dst: str) -> Optional[str]:
+    """Is src->dst traffic blocked right now?  Returns the rule mode
+    (counted on ``imageregion_partition_blocked_total``) or None.
+    The sidecar client's per-call hook — one dict probe when the
+    table is empty."""
+    mode = PARTITIONS.check(src, dst)
+    if mode is not None:
+        from . import telemetry
+        telemetry.QUORUM.count_partition_blocked(mode)
+    return mode

@@ -2268,11 +2268,12 @@ class DecisionStats:
     never mint either string."""
 
     KINDS = ("autoscaler", "epoch", "manifest", "gossip",
-             "drain", "undrain", "handoff", "hotkey")
+             "drain", "undrain", "handoff", "hotkey", "quorum")
     VERDICTS = ("up", "down", "blocked", "steady",
                 "installed", "pending", "promoted", "demoted",
                 "agreed", "stale", "split-brain", "unreachable",
-                "legacy", "ok", "mismatch", "done", "failed")
+                "legacy", "ok", "mismatch", "done", "failed",
+                "fenced", "restored")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -2473,6 +2474,116 @@ class FleetSloStats:
 
 
 FED_SLO = FleetSloStats()
+
+
+class QuorumStats:
+    """Partition-tolerance accounting: the quorum tracker's verdict
+    (``parallel.federation.QuorumTracker``) and the link-partition
+    fault injector (``utils.faultinject``).  Two families —
+    ``imageregion_federation_quorum_*`` (am I in the majority, what
+    have I refused while fenced) and ``imageregion_partition_*`` (the
+    netsplit drill's injected link rules and the calls they blocked).
+    Labels are closed vocabularies owned HERE: fence/restore
+    transitions reuse the decision ledger's verdict strings, refusal
+    actions are :data:`ACTIONS`, partition modes :data:`MODES`."""
+
+    ACTIONS = ("adoption", "write_authority", "promotion",
+               "autoscaler", "transfer", "roll")
+    MODES = ("drop", "deny")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # None = no tracker installed (un-federated / quorum off):
+        # emit-when-live keeps those expositions exact.
+        self.quorate: Optional[bool] = None
+        self.reachable_hosts = 0
+        self.total_hosts = 0
+        self.transitions: Dict[str, int] = {}
+        self.refusals: Dict[str, int] = {}
+        self.partition_rules = 0
+        self.partition_blocked: Dict[str, int] = {}
+
+    def set_quorum(self, quorate: bool, reachable: int,
+                   total: int) -> None:
+        with self._lock:
+            self.quorate = bool(quorate)
+            self.reachable_hosts = int(reachable)
+            self.total_hosts = int(total)
+
+    def count_transition(self, verdict: str) -> None:
+        if verdict not in ("fenced", "restored"):
+            return
+        with self._lock:
+            self.transitions[verdict] = \
+                self.transitions.get(verdict, 0) + 1
+
+    def count_refusal(self, action: str) -> None:
+        if action not in self.ACTIONS:
+            return
+        with self._lock:
+            self.refusals[action] = self.refusals.get(action, 0) + 1
+
+    def set_partition_rules(self, n: int) -> None:
+        with self._lock:
+            self.partition_rules = int(n)
+
+    def count_partition_blocked(self, mode: str) -> None:
+        if mode not in self.MODES:
+            mode = "drop"
+        with self._lock:
+            self.partition_blocked[mode] = \
+                self.partition_blocked.get(mode, 0) + 1
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            lines: List[str] = []
+            if self.quorate is not None:
+                lines += [
+                    f"imageregion_federation_quorum_quorate{label()} "
+                    f"{int(self.quorate)}",
+                    f"imageregion_federation_quorum_reachable_hosts"
+                    f"{label()} {self.reachable_hosts}",
+                    f"imageregion_federation_quorum_hosts{label()} "
+                    f"{self.total_hosts}",
+                ]
+            for verdict in sorted(self.transitions):
+                body = 'verdict="%s"' % verdict
+                lines.append(
+                    f"imageregion_federation_quorum_transitions_total"
+                    f"{label(body)} {self.transitions[verdict]}")
+            for action in sorted(self.refusals):
+                body = 'action="%s"' % action
+                lines.append(
+                    f"imageregion_federation_quorum_refusals_total"
+                    f"{label(body)} {self.refusals[action]}")
+            if self.partition_rules or self.partition_blocked:
+                lines.append(f"imageregion_partition_rules{label()} "
+                             f"{self.partition_rules}")
+            for mode in sorted(self.partition_blocked):
+                body = 'mode="%s"' % mode
+                lines.append(
+                    f"imageregion_partition_blocked_total"
+                    f"{label(body)} {self.partition_blocked[mode]}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.quorate = None
+            self.reachable_hosts = 0
+            self.total_hosts = 0
+            self.transitions.clear()
+            self.refusals.clear()
+            self.partition_rules = 0
+            self.partition_blocked.clear()
+
+
+QUORUM = QuorumStats()
 
 
 class SessionStats:
@@ -2883,6 +2994,7 @@ def robustness_metric_lines(extra_labels: str = "") -> List[str]:
             + DRAIN.metric_lines(extra_labels)
             + AUTOSCALER.metric_lines(extra_labels)
             + FEDERATION.metric_lines(extra_labels)
+            + QUORUM.metric_lines(extra_labels)
             + DECISIONS.metric_lines(extra_labels)
             + FED_SLO.metric_lines(extra_labels)
             + session_metric_lines(extra_labels))
@@ -3132,6 +3244,15 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_federation_shard_transfers_total": "counter",
     "imageregion_federation_transfer_bytes_total": "counter",
     "imageregion_federation_remote_prestage_total": "counter",
+    # Partition tolerance (QuorumStats): quorum membership verdicts,
+    # fence refusals, and the netsplit drill's injected link rules.
+    "imageregion_federation_quorum_quorate": "gauge",
+    "imageregion_federation_quorum_reachable_hosts": "gauge",
+    "imageregion_federation_quorum_hosts": "gauge",
+    "imageregion_federation_quorum_transitions_total": "counter",
+    "imageregion_federation_quorum_refusals_total": "counter",
+    "imageregion_partition_rules": "gauge",
+    "imageregion_partition_blocked_total": "counter",
     # Control-plane decision ledger (utils.decisions): every
     # autoscaler / epoch / gossip / drain action as a closed
     # (kind, verdict) pair.
@@ -3205,6 +3326,20 @@ METRIC_HELP: Dict[str, str] = {
         "Warm HBM planes shipped cross-host over shard_transfer",
     "imageregion_federation_remote_prestage_total":
         "Predicted-plane prestage hints sent to remote owners",
+    "imageregion_federation_quorum_quorate":
+        "1 while this host can gossip with a strict majority of "
+        "manifest hosts, 0 while fenced",
+    "imageregion_federation_quorum_reachable_hosts":
+        "Manifest hosts (self included) heard from within "
+        "suspect-after-s",
+    "imageregion_federation_quorum_transitions_total":
+        "Quorum fence/restore transitions by verdict",
+    "imageregion_federation_quorum_refusals_total":
+        "State-changing actions refused while fenced, by action",
+    "imageregion_partition_rules":
+        "Injected link-partition rules active in this process",
+    "imageregion_partition_blocked_total":
+        "Sidecar calls blocked by an injected link partition, by mode",
     "imageregion_decision_total":
         "Control-plane decision-ledger records by kind and verdict",
     "imageregion_fleet_slo_hosts":
@@ -3624,6 +3759,7 @@ def reset() -> None:
     AUTOSCALER.reset()
     LOADMODEL.reset()
     FEDERATION.reset()
+    QUORUM.reset()
     DECISIONS.reset()
     FED_SLO.reset()
     SESSIONS.reset()

@@ -297,7 +297,18 @@ IDEMPOTENT_OPS = frozenset({"image", "mask", "ping", "metrics",
                             # newest-ts idempotent).  shard_transfer
                             # is NOT here — it ships cache state, the
                             # plane_put posture.
-                            "manifest_hello", "member_gossip"})
+                            "manifest_hello", "member_gossip",
+                            # Two-phase epoch rolls are idempotent BY
+                            # CONTRACT (a re-propose re-acks the same
+                            # pending manifest; a re-commit of the
+                            # active epoch answers already-active), so
+                            # a coordinator may retry them across a
+                            # flaky link without double-rolling.  The
+                            # partition op sets/clears absolute rules
+                            # — a duplicate is a no-op, and the HEAL
+                            # call must survive a lossy drill link.
+                            "epoch_propose", "epoch_commit",
+                            "partition"})
 
 
 class RetryPolicy:

@@ -99,12 +99,24 @@ CAPACITY_KEYS = ("capacity_knee_offered_tps", "p99_at_knee_ms",
 # bug, not a trend.  Rounds that predate the family skip on null.
 HOTKEY_KEYS = ("hotkey_storm_ratio", "hotkey_replication_gain",
                "hotkey_storm_tps")
+# --partition: judge PARTITION_r*.json records (bench.py --smoke
+# --partition — the netsplit chaos drill) on the partition-tolerance
+# latencies: how long the minority takes to FENCE after the links go
+# dark, and to RESTORE after heal (both ``_ms`` keys, regress UP).
+# The drill's availability and split-brain guarantees are judged
+# separately below as correctness riders on the NEW record alone:
+# any majority-side failure that was not counted shed, a post-heal
+# agreement/byte round-trip that is not bit-exact, an aborted
+# majority roll, or a fenced minority that refused NOTHING all fail
+# outright — they are contracts, not trends.
+PARTITION_KEYS = ("part_fence_ms", "part_restore_ms")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _MULTICHIP_RE = re.compile(r"^MULTICHIP_r(\d+)\.json$")
 _SESSIONS_RE = re.compile(r"^SESSIONS_r(\d+)\.json$")
 _OFFLOAD_RE = re.compile(r"^OFFLOAD_r(\d+)\.json$")
 _CAPACITY_RE = re.compile(r"^CAPACITY_r(\d+)\.json$")
 _HOTKEY_RE = re.compile(r"^HOTKEY_r(\d+)\.json$")
+_PARTITION_RE = re.compile(r"^PARTITION_r(\d+)\.json$")
 
 
 def lower_is_better(key: str) -> bool:
@@ -302,6 +314,14 @@ def main(argv=None) -> int:
                              "and storm throughput (all regress "
                              "down); any duplicate-staged count "
                              "above zero fails outright")
+    parser.add_argument("--partition", action="store_true",
+                        help="judge PARTITION_r*.json records (bench "
+                             "--smoke --partition, the netsplit chaos "
+                             "drill) on fence/restore latency (regress "
+                             "up); majority 5xx-without-shed, aborted "
+                             "rolls, failed post-heal agreement/byte "
+                             "round-trips and a refusal-free fence "
+                             "all fail outright")
     parser.add_argument("--key", action="append", default=None,
                         help="record key(s) to judge (default "
                              "service_tiles_per_sec, "
@@ -309,13 +329,22 @@ def main(argv=None) -> int:
                              "raw_upload_mb_per_sec, "
                              "p50_first_tile_byte_ms; --multichip: "
                              "the fleet scaling keys)")
-    parser.add_argument("--max-regression", type=float, default=0.10,
+    parser.add_argument("--max-regression", type=float, default=None,
                         help="fail when new < old by this fraction or "
-                             "more (default 0.10)")
+                             "more (default 0.10; --partition "
+                             "defaults to 0.50 — fence/restore are "
+                             "quantized by the gossip tick)")
     parser.add_argument("--strict", action="store_true",
                         help="treat skipped (absent/null) keys as "
                              "failures")
     args = parser.parse_args(argv)
+    if args.max_regression is None:
+        # Partition fence/restore latency is quantized by the gossip
+        # tick (~0.3 s of honest jitter on a ~1.2 s measurement): a
+        # 10% relative bar fails identical code about half the time,
+        # so the family bar is a tick-sized 50%.  Real regressions
+        # (a lost tick loop, a widened suspect window) move 2-3x.
+        args.max_regression = 0.50 if args.partition else 0.10
 
     if args.key:
         keys = tuple(args.key)
@@ -329,13 +358,16 @@ def main(argv=None) -> int:
         keys = CAPACITY_KEYS
     elif args.hotkey:
         keys = HOTKEY_KEYS
+    elif args.partition:
+        keys = PARTITION_KEYS
     else:
         keys = DEFAULT_KEYS
     pattern = (_MULTICHIP_RE if args.multichip
                else _SESSIONS_RE if args.sessions
                else _OFFLOAD_RE if args.offload
                else _CAPACITY_RE if args.capacity
-               else _HOTKEY_RE if args.hotkey else _BENCH_RE)
+               else _HOTKEY_RE if args.hotkey
+               else _PARTITION_RE if args.partition else _BENCH_RE)
     try:
         if args.watermark:
             if args.dir:
@@ -392,6 +424,34 @@ def main(argv=None) -> int:
                              "verdict": ("regression" if dup > 0
                                          else "pass"),
                              "old": 0, "new": int(dup)})
+
+    if args.partition:
+        # Correctness riders, judged on the NEW record alone (no
+        # trend, no threshold) — each is a partition-tolerance
+        # CONTRACT: the majority must never fail a request without
+        # counting it shed, the quorate side's roll must commit, the
+        # healed fleet must agree bit-exactly (manifest digest + probe
+        # owners + byte round-trip), and a fenced minority that
+        # refused nothing means the fence gates never engaged.
+        # Absent/null skips (rounds that predate the family).
+        riders = (
+            ("part_majority_5xx", lambda v: v == 0, 0),
+            ("part_roll_committed", lambda v: v == 1, 1),
+            ("part_rejoin_epoch", lambda v: v >= 2, 2),
+            ("part_postheal_agree", lambda v: v == 1, 1),
+            ("part_byte_agree", lambda v: v == 1, 1),
+            ("part_minority_refusals", lambda v: v >= 1, 1),
+        )
+        for key, ok, want in riders:
+            val = new_record.get(key)
+            if not isinstance(val, (int, float)):
+                verdicts.append({"key": key, "verdict": "skipped",
+                                 "old": None, "new": val})
+            else:
+                verdicts.append({"key": key,
+                                 "verdict": ("pass" if ok(val)
+                                             else "regression"),
+                                 "old": want, "new": val})
 
     regressed = [v for v in verdicts if v["verdict"] == "regression"]
     skipped = [v for v in verdicts if v["verdict"] == "skipped"]

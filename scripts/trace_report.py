@@ -151,7 +151,13 @@ def render_trace(doc) -> str:
 _ROBUSTNESS_KINDS = ("pressure.level", "pressure.step",
                      "watchdog.fire", "watchdog.escalate",
                      "drain.phase", "autoscale.up", "autoscale.down",
-                     "autoscale.blocked")
+                     "autoscale.blocked",
+                     # Partition-tolerant control plane: quorum
+                     # fence/restore transitions and the two-phase
+                     # epoch roll — the netsplit half of the
+                     # degrade-by-choice story.
+                     "quorum.fence", "quorum.restore",
+                     "epoch.propose", "epoch.commit")
 
 # Session-serving event kinds (per-session fairness sheds, viewport
 # predictions, pressure-scaled prefetch budget moves): marked with
@@ -211,6 +217,11 @@ def render_flight(doc) -> str:
                 label = f"autoscale.blocked:{e.get('reason', '?')}"
             elif kind in ("autoscale.up", "autoscale.down"):
                 label = f"{kind}:{e.get('member', '?')}"
+            elif kind in ("quorum.fence", "quorum.restore"):
+                label = (f"{kind}:{e.get('reachable', '?')}"
+                         f"/{e.get('hosts', '?')}")
+            elif kind in ("epoch.propose", "epoch.commit"):
+                label = f"{kind}:v{e.get('epoch', '?')}"
             rob_counts[label] = rob_counts.get(label, 0) + 1
         elif kind in _SESSION_KINDS:
             label = kind

@@ -330,6 +330,57 @@ def test_bench_smoke_hotkey(capsys):
         telemetry.reset()
 
 
+def test_bench_smoke_partition(capsys):
+    """The netsplit chaos gate (bench.py --smoke --partition): a
+    3-host fleet (two REAL sidecar processes) driven through
+    partition -> fence -> heal -> rejoin under sustained load, with a
+    two-phase epoch roll committed mid-partition.  The majority side
+    must fail NOTHING without counting it shed; the minority must
+    fence (with counted refusals), restore, converge to the committed
+    epoch with no operator action, and agree bit-exactly after heal."""
+    import bench
+    from omero_ms_image_region_tpu.utils import decisions, telemetry
+
+    telemetry.reset()
+    decisions.LEDGER.reset()
+    try:
+        t0 = time.monotonic()
+        out = bench.bench_partition_smoke()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 120.0, \
+            f"partition smoke took {elapsed:.0f}s (budget 120)"
+
+        # Join-time manifest agreement (digest + the peers' OWN ring
+        # math on the golden probe keys) before any chaos.
+        assert out["part_manifest_agreed"] == 1, out
+        # Majority availability: the load loop never saw a failure
+        # that was not counted shed — the drill's headline contract.
+        assert out["part_load_requests"] > 0, out
+        assert out["part_majority_5xx"] == 0, out
+        # The minority fenced within the drill's polling budget and
+        # refused state-changing ops while dark (each one counted).
+        assert out["part_fence_ms"] > 0, out
+        assert out["part_minority_refusals"] >= 2, out
+        # The mid-partition roll committed on strict-majority acks
+        # (A + B of 3 hosts) — a dark minority cannot block an epoch.
+        assert out["part_roll_committed"] == 1, out
+        assert out["part_roll_acks"] == 2, out
+        # Heal: restore, anti-entropy convergence to epoch 2, full
+        # digest + probe-owner agreement, byte-identical round-trip,
+        # and the fenced/restored pair in C's own decision ledger.
+        assert out["part_restore_ms"] > 0, out
+        assert out["part_rejoin_epoch"] == 2, out
+        assert out["part_postheal_agree"] == 1, out
+        assert out["part_byte_agree"] == 1, out
+        assert out["part_quorum_ledger"] >= 2, out
+
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(line)["metric"] == "partition_smoke"
+    finally:
+        decisions.LEDGER.reset()
+        telemetry.reset()
+
+
 def test_bench_smoke_offload(capsys):
     """The repeat-viewer offload gate (bench.py --smoke --offload):
     over a real 2-sidecar remote fleet, the edge ladder (warm-local

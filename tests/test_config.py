@@ -776,6 +776,44 @@ def test_federation_host_defaults_to_cluster_identity(monkeypatch):
             "enabled": True, "members": members}})
 
 
+def test_federation_quorum_knobs_parse_and_validate():
+    """PR 18 knobs (deploy/DEPLOY.md "Partitions & quorum"): quorum
+    membership off by default, liveness window and roll-ack timeout
+    strictly positive, and `quorum: true` meaningless without an
+    enabled federation — a verdict over manifest hosts needs a
+    manifest."""
+    from omero_ms_image_region_tpu.server.config import (
+        FederationConfig)
+
+    defaults = FederationConfig()
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    assert cfg.federation.quorum is False
+    assert cfg.federation.suspect_after_s \
+        == defaults.suspect_after_s
+    assert cfg.federation.roll_ack_timeout_s \
+        == defaults.roll_ack_timeout_s
+
+    members = [{"name": "a0", "host": "hostA"},
+               {"name": "b0", "host": "hostB", "address": "h:1"}]
+    cfg = AppConfig.from_dict({"federation": {
+        "enabled": True, "host": "hostA", "quorum": True,
+        "suspect-after-s": 2.5, "roll-ack-timeout-s": 1.5,
+        "members": members}})
+    assert cfg.federation.quorum is True
+    assert cfg.federation.suspect_after_s == 2.5
+    assert cfg.federation.roll_ack_timeout_s == 1.5
+
+    with pytest.raises(ValueError, match="suspect-after-s"):
+        AppConfig.from_dict({"federation": {
+            "suspect-after-s": 0}})
+    with pytest.raises(ValueError, match="roll-ack-timeout-s"):
+        AppConfig.from_dict({"federation": {
+            "roll-ack-timeout-s": -1}})
+    with pytest.raises(ValueError,
+                       match="quorum requires"):
+        AppConfig.from_dict({"federation": {"quorum": True}})
+
+
 def test_autoscaler_lifecycle_and_diurnal_knobs():
     """PR 15 knobs: diurnal prediction bounds and the unit-config /
     fleet.sockets coupling."""

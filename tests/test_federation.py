@@ -367,6 +367,67 @@ class TestWireOps:
 
         asyncio.run(scenario())
 
+    def test_shard_transfer_retry_after_lost_ack_never_double_stages(
+            self, data_dir, tmp_path):
+        """The mid-transfer kill drill: the receiver stages the bytes
+        but the CONNECTION dies before the ack reaches the sender (a
+        killed process, a dropped link — the sender cannot tell).  The
+        sender's retry re-ships the identical entry over a fresh
+        connection; digest-dedup on the receiver makes the retry an
+        idempotent success — exactly ONE staged copy, never two."""
+        from omero_ms_image_region_tpu.io.devicecache import (
+            plane_digest)
+        from omero_ms_image_region_tpu.server.sidecar import (
+            SidecarClient, run_sidecar)
+
+        sock = str(tmp_path / "fed3.sock")
+        arr = np.arange(2 * 8 * 8, dtype=np.uint16).reshape(2, 8, 8)
+        digest = plane_digest(arr)
+        entry = {"key": [IMG, 0, 0, 0, [0, 0, 8, 8], [1, 2]],
+                 "digest": digest, "route": "route-kill",
+                 "dtype": "uint16", "shape": [2, 8, 8],
+                 "bytes": arr.tobytes()}
+
+        async def scenario():
+            task = asyncio.create_task(
+                run_sidecar(_member_cfg(data_dir), sock))
+            await _wait_socket(sock, task)
+            client = SidecarClient(sock)
+            try:
+                # Leg 1: the bytes land and stage — then the link
+                # dies before the sender consumes the ack.
+                assert await RemoteMember(
+                    "b0", client).shard_transfer([entry]) == 1
+            finally:
+                await client.close()
+            retry_client = SidecarClient(sock)
+            try:
+                # Leg 2: the retry, byte-identical, fresh connection.
+                # Idempotent success (the sender's ledger closes), not
+                # a refusal and not a second copy.
+                assert await RemoteMember(
+                    "b0", retry_client).shard_transfer([entry]) == 1
+                status, body = await retry_client.call(
+                    "plane_probe", {}, extra={"digests": [digest]})
+                assert status == 200
+                assert json.loads(bytes(body).decode())["resident"] \
+                    == [True]
+                # The receiver's shard manifest holds exactly ONE
+                # entry for the digest — the dedup contract.
+                status, body = await retry_client.call(
+                    "shard_manifest", {}, extra={})
+                assert status == 200
+                entries = json.loads(
+                    bytes(body).decode())["entries"]
+                assert sum(1 for e in entries
+                           if e.get("digest") == digest) == 1
+            finally:
+                await retry_client.close()
+                task.cancel()
+                await asyncio.gather(task, return_exceptions=True)
+
+        asyncio.run(scenario())
+
 
 # ----------------------------------------------------------- coordinator
 
@@ -993,3 +1054,350 @@ class TestGossipDrainOwnership:
             "b0": {"healthy": True, "ts": 1.0},
             "intruder": {"healthy": False, "ts": 2.0}})
         assert "b0" in merged and "intruder" not in merged
+
+
+# ------------------------------------------- versioned gossip & jitter
+
+def _local_member(name):
+    m = type("L", (), {"remote": False, "healthy": True,
+                       "draining": False, "drain_intent": None})()
+    m.name = name
+    return m
+
+
+class TestVersionedGossip:
+    def test_skewed_ahead_peer_cannot_pin_a_stale_down_verdict(self):
+        """THE clock-skew regression (the bug versioning replaced):
+        under newest-ts-wins, a peer whose wall clock ran years ahead
+        could relay a stale ``down`` observation stamped in the future
+        and no honest update would ever outrank it.  Versioned merges
+        order on ``(incarnation, seq)`` — a legacy ts-only observation
+        compares as ``(0, ts)`` and ANY versioned truth beats it, no
+        matter the timestamp."""
+        import time as _time
+        federation.install(_manifest(), self_host="hostA")
+        router = _StubRouterFor([_local_member("a0"),
+                                 _local_member("a1")])
+        # The skewed-ahead ghost: a0 "down", stamped 3 years ahead.
+        federation.merge_view({"a0": {
+            "healthy": False, "ts": _time.time() + 1e8}})
+        view = federation.local_view(router, "hostA")
+        merged = federation.merge_view(view)
+        assert merged["a0"]["healthy"] is True, \
+            "a future-stamped stale observation outranked the live " \
+            "router state — the newest-ts-wins bug is back"
+
+    def test_self_refutation_outranks_a_versioned_ghost(self):
+        """The SWIM rejoin rule: a HIGHER-versioned observation about
+        one of our own members that disagrees with the live router
+        (a pre-restart ghost of ourselves, relayed back) forces an
+        incarnation bump past it — the fresh truth supersedes
+        fleet-wide instead of losing the version race."""
+        federation.install(_manifest(), self_host="hostA")
+        router = _StubRouterFor([_local_member("a0"),
+                                 _local_member("a1")])
+        inc0 = federation.local_view(router, "hostA")["a0"]["inc"]
+        federation.merge_view({"a0": {
+            "healthy": False, "inc": inc0 + 50, "seq": 99, "ts": 0}})
+        view = federation.local_view(router, "hostA")
+        assert view["a0"]["inc"] > inc0 + 50
+        merged = federation.merge_view(view)
+        assert merged["a0"]["healthy"] is True
+
+    def test_gossip_tick_jitter_is_seeded_and_spread(self):
+        """The tick interval jitters within +/-20% so an N-host
+        fleet's gossip bursts cannot synchronize into a thundering
+        herd — and the jitter is SEEDED per (host, ring seed), so a
+        drill's schedule replays bit-exactly."""
+        manifest = _manifest()
+        coord = FederationCoordinator(manifest, "hostA", router=None,
+                                      gossip_interval_s=1.0,
+                                      handles=[])
+        samples = [coord.next_interval_s() for _ in range(64)]
+        assert all(0.8 <= s <= 1.2 for s in samples), samples
+        assert max(samples) - min(samples) > 0.05, \
+            "jitter collapsed — gossip ticks would synchronize"
+        # Seeded: the same (host, ring seed) replays the schedule.
+        again = FederationCoordinator(manifest, "hostA", router=None,
+                                      gossip_interval_s=1.0,
+                                      handles=[])
+        assert [again.next_interval_s() for _ in range(64)] == samples
+        # Different hosts de-phase from each other.
+        other = FederationCoordinator(manifest, "hostB", router=None,
+                                      gossip_interval_s=1.0,
+                                      handles=[])
+        assert [other.next_interval_s()
+                for _ in range(64)] != samples
+
+
+# ------------------------------------------------------ quorum fencing
+
+def _manifest3(version=1, seed="fed-test"):
+    return FleetManifest(
+        [MemberSpec("a0", "hostA"),
+         MemberSpec("b0", "hostB", "10.0.0.2:8476"),
+         MemberSpec("c0", "hostC", "10.0.0.3:8476")],
+        version=version, ring_seed=seed)
+
+
+class TestQuorumFencing:
+    def test_gates_default_open_without_a_tracker(self):
+        """Quorum off (the default) is bit-exact pre-quorum behavior:
+        every gate answers True, nothing is fenced, status is None."""
+        assert federation.quorum_tracker() is None
+        assert federation.is_fenced() is False
+        assert federation.quorum_allow("adoption") is True
+        assert federation.quorum_status() is None
+
+    def test_fence_restore_transitions_ledger_and_refusals(self):
+        """Losing a strict majority FENCES (one ledger record, one
+        flight event, refusals counted per action); regaining it
+        RESTORES with the refusal tally on the restore record.
+        Liveness runs on an injected monotonic clock — wall time
+        never participates."""
+        from omero_ms_image_region_tpu.utils import decisions
+        decisions.LEDGER.reset()
+        now = [100.0]
+        tracker = federation.QuorumTracker(
+            _manifest3(), "hostA", suspect_after_s=5.0,
+            clock=lambda: now[0])
+        federation.install_quorum(tracker)
+        # Boot grace: remote hosts start heard-now — no fence at boot.
+        assert federation.is_fenced() is False
+        # Silence past the suspect window from BOTH peers: 1/3 is a
+        # minority island.
+        now[0] += 6.0
+        assert federation.is_fenced() is True
+        assert federation.quorum_allow("adoption") is False
+        assert federation.quorum_allow("write_authority") is False
+        status = federation.quorum_status()
+        assert status["fenced"] is True
+        assert status["refusals"] == {"adoption": 1,
+                                      "write_authority": 1}
+        # One heard host restores the majority (2/3).
+        federation.observe_host("hostB")
+        assert federation.is_fenced() is False
+        kinds = [(r["kind"], r["verdict"])
+                 for r in decisions.LEDGER.snapshot()]
+        assert ("quorum", "fenced") in kinds
+        assert ("quorum", "restored") in kinds
+        restored = [r for r in decisions.LEDGER.snapshot()
+                    if r["verdict"] == "restored"][-1]
+        assert restored["detail"]["refusals"] == {
+            "adoption": 1, "write_authority": 1}
+        assert restored["detail"]["fenced_s"] == 0.0
+        flight = [e["kind"] for e in telemetry.FLIGHT.snapshot()]
+        assert "quorum.fence" in flight
+        assert "quorum.restore" in flight
+
+    def test_single_host_manifest_is_always_quorate(self):
+        now = [0.0]
+        tracker = federation.QuorumTracker(
+            FleetManifest([MemberSpec("a0", "hostA")], version=1),
+            "hostA", suspect_after_s=1.0, clock=lambda: now[0])
+        now[0] += 100.0
+        assert tracker.evaluate() is True
+
+    def test_two_of_three_hosts_is_quorate(self):
+        now = [0.0]
+        tracker = federation.QuorumTracker(
+            _manifest3(), "hostA", suspect_after_s=5.0,
+            clock=lambda: now[0])
+        now[0] += 6.0
+        tracker.observe("hostB")       # heard one of two peers
+        assert tracker.evaluate() is True
+        assert tracker.reachable_hosts() == ["hostB"]
+
+    def test_rolled_manifest_reshapes_the_host_set(self):
+        """set_manifest on an epoch roll: departed hosts leave the
+        denominator (a 3-host fleet rolled to 2 hosts must not fence
+        because the removed host is silent forever)."""
+        now = [0.0]
+        tracker = federation.QuorumTracker(
+            _manifest3(), "hostA", suspect_after_s=5.0,
+            clock=lambda: now[0])
+        two_hosts = FleetManifest(
+            [MemberSpec("a0", "hostA"),
+             MemberSpec("b0", "hostB", "10.0.0.2:8476")],
+            version=2, ring_seed="fed-test")
+        tracker.set_manifest(two_hosts)
+        now[0] += 6.0
+        tracker.observe("hostB")
+        assert tracker.evaluate() is True
+        assert "hostC" not in tracker.reachable_hosts()
+
+
+# ------------------------------------------------- orchestrated rolls
+
+class _RollStub(_StubRemote):
+    """_StubRemote + the two-phase roll wire methods."""
+
+    def __init__(self, name, propose=None, commit=None, **kw):
+        super().__init__(name, **kw)
+        self._propose = propose
+        self._commit = commit
+        self.proposed = []
+        self.committed = []
+
+    async def epoch_propose(self, doc):
+        self.proposed.append(doc)
+        return self._propose(doc) if callable(self._propose) \
+            else self._propose
+
+    async def epoch_commit(self, doc, digest=""):
+        self.committed.append((doc, digest))
+        return self._commit(doc) if callable(self._commit) \
+            else self._commit
+
+
+class TestEpochRoll:
+    def _coord(self, manifest, *stubs):
+        router = _StubRouterFor([_local_member("a0"), *stubs])
+        return FederationCoordinator(manifest, "hostA", router)
+
+    def test_roll_commits_on_strict_majority(self):
+        """Two-phase roll with one host dark: propose acks from A
+        (self) + B beat 3 hosts' majority bar, commit activates
+        everywhere reachable, the roll hook swaps the live ring at
+        COMMIT (the only mid-flight ring change), and the flight ring
+        carries the propose/commit pair."""
+        manifest = _manifest3()
+        federation.install(manifest, self_host="hostA")
+        swapped = []
+        federation.set_roll_hook(swapped.append)
+        b0 = _RollStub("b0",
+                       propose={"ack": True, "reason": "pending",
+                                "host": "hostB"},
+                       commit={"ack": True, "reason": "installed",
+                               "host": "hostB"})
+        c0 = _RollStub("c0", propose=None, commit=None)
+        coord = self._coord(manifest, b0, c0)
+        rolled = _manifest3(version=2, seed="fed-test-v2")
+        out = asyncio.run(coord.roll_epoch(rolled))
+        assert out["committed"] is True
+        assert out["acks"] == 2 and out["hosts"] == 3
+        assert out["verdicts"]["hostB"] == "installed"
+        assert out["verdicts"]["hostC"] == "unreachable"
+        # Commit went to every reachable host, with the digest pinned.
+        assert b0.committed[0][1] == rolled.digest()
+        # Activated locally + the serving-layer hook fired once.
+        assert federation.current().version == 2
+        assert coord.manifest.version == 2
+        assert [m.version for m in swapped] == [2]
+        flight = [e["kind"] for e in telemetry.FLIGHT.snapshot()]
+        assert "epoch.propose" in flight
+        assert "epoch.commit" in flight
+
+    def test_roll_aborts_without_strict_majority(self):
+        """Both remote hosts dark: 1/3 acks is not a strict majority
+        — NOTHING activates anywhere (a minority can never advance
+        the epoch)."""
+        manifest = _manifest3()
+        federation.install(manifest, self_host="hostA")
+        swapped = []
+        federation.set_roll_hook(swapped.append)
+        b0 = _RollStub("b0", propose=None, commit=None)
+        c0 = _RollStub("c0", propose=None, commit=None)
+        coord = self._coord(manifest, b0, c0)
+        out = asyncio.run(coord.roll_epoch(_manifest3(version=2)))
+        assert out["committed"] is False and out["acks"] == 1
+        assert federation.current().version == 1
+        assert coord.manifest.version == 1
+        assert swapped == []
+        assert b0.committed == [] and c0.committed == []
+
+    def test_fenced_coordinator_refuses_to_roll(self):
+        """A fenced minority cannot know whether the majority already
+        rolled past it — originating an epoch from the island is the
+        split-brain the fence exists to prevent."""
+        manifest = _manifest3()
+        federation.install(manifest, self_host="hostA")
+        now = [0.0]
+        federation.install_quorum(federation.QuorumTracker(
+            manifest, "hostA", suspect_after_s=1.0,
+            clock=lambda: now[0]))
+        now[0] += 5.0                  # both peers silent: fenced
+        coord = self._coord(manifest, _RollStub(
+            "b0", propose={"ack": True}, commit={"ack": True}))
+        out = asyncio.run(coord.roll_epoch(_manifest3(version=2)))
+        assert out["committed"] is False
+        assert out.get("reason") == "fenced"
+        assert federation.current().version == 1
+
+    def test_roll_must_raise_the_version(self):
+        manifest = _manifest3(version=3)
+        federation.install(manifest, self_host="hostA")
+        coord = self._coord(manifest, _RollStub("b0"))
+        with pytest.raises(ValueError):
+            asyncio.run(coord.roll_epoch(_manifest3(version=3)))
+
+    def test_crash_resumed_roll_is_idempotent_wire_side(self):
+        """The receiver contract that makes coordinator crash-resume
+        safe: re-propose of the pending epoch acks again; commit
+        activates once; re-commit and late re-propose of the
+        now-active epoch ack ``already-active``; a superseded (older)
+        commit refuses ``stale``; a forged commit digest refuses."""
+        federation.install(_manifest3(), self_host="hostB")
+        v2 = _manifest3(version=2)
+        doc = v2.to_json()
+        first = federation.handle_epoch_propose({"manifest": doc})
+        again = federation.handle_epoch_propose({"manifest": doc})
+        assert first["ack"] and again["ack"]
+        assert again["reason"] == "pending"
+        assert federation.current().version == 1      # nothing active
+        forged = federation.handle_epoch_commit(
+            {"manifest": doc, "digest": "0" * 32})
+        assert forged["ack"] is False
+        assert forged["reason"] == "digest-mismatch"
+        committed = federation.handle_epoch_commit(
+            {"manifest": doc, "digest": v2.digest()})
+        assert committed["ack"] and committed["reason"] == "installed"
+        assert federation.current().version == 2
+        assert federation.pending() is None           # superseded
+        re_commit = federation.handle_epoch_commit({"manifest": doc})
+        assert re_commit["ack"]
+        assert re_commit["reason"] == "already-active"
+        late = federation.handle_epoch_propose({"manifest": doc})
+        assert late["ack"] and late["reason"] == "already-active"
+        stale = federation.handle_epoch_commit(
+            {"manifest": _manifest3(version=1).to_json()})
+        assert stale["ack"] is False and stale["reason"] == "stale"
+        assert federation.current().version == 2
+
+    def test_fenced_receiver_refuses_propose(self):
+        manifest = _manifest3()
+        federation.install(manifest, self_host="hostC")
+        now = [0.0]
+        federation.install_quorum(federation.QuorumTracker(
+            manifest, "hostC", suspect_after_s=1.0,
+            clock=lambda: now[0]))
+        now[0] += 5.0
+        out = federation.handle_epoch_propose(
+            {"manifest": _manifest3(version=2).to_json()})
+        assert out["ack"] is False and out["reason"] == "fenced"
+        # The commit still lands: it is the anti-entropy path a
+        # healed (restored) host converges through.
+        federation.observe_host("hostA")
+        v2 = _manifest3(version=2)
+        out = federation.handle_epoch_commit(
+            {"manifest": v2.to_json(), "digest": v2.digest()})
+        assert out["ack"] and federation.current().version == 2
+
+    def test_coordinator_adopts_a_wire_committed_epoch(self):
+        """A sidecar's coordinator whose manifest a wire-side commit
+        outran (handle_epoch_commit swapped the process-global) must
+        gossip the COMMITTED identity from the next round on — not
+        advertise the pre-roll digest forever."""
+        manifest = _manifest3()
+        federation.install(manifest, self_host="hostA")
+        v2 = _manifest3(version=2)
+        b0 = _RollStub("b0", gossip=lambda view: {
+            "enabled": True, "version": 2, "digest": v2.digest(),
+            "view": {}})
+        coord = self._coord(manifest, b0)
+        assert coord.manifest.version == 1
+        federation.handle_epoch_commit(
+            {"manifest": v2.to_json(), "digest": v2.digest()})
+        outcome = asyncio.run(coord.gossip_once())
+        assert coord.manifest.version == 2
+        assert outcome["b0"] == "ok"       # no phantom drift

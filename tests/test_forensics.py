@@ -179,6 +179,33 @@ class TestFlightRecorder:
         assert "autoscale.blocked:cooldown=1" in out
         assert "autoscale.blocked:floor=1" in out
 
+    def test_trace_report_renders_quorum_epoch_events(self, tmp_path):
+        """PR 18's partition-tolerance events (quorum fence/restore,
+        two-phase epoch propose/commit) are marked on the flight
+        timeline and rolled into the self-preservation footer — a
+        netsplit post-mortem reads when each island fenced, with what
+        reachability, and which epoch the majority rolled."""
+        rec = telemetry.FlightRecorder()
+        rec.record("quorum.fence", host="hostC", reachable=1, hosts=3)
+        rec.record("epoch.propose", epoch=2, digest="201e036bb714",
+                   by="hostA")
+        rec.record("epoch.commit", epoch=2, digest="201e036bb714",
+                   by="hostA")
+        rec.record("quorum.restore", host="hostC", reachable=3,
+                   hosts=3)
+        path = rec.dump(str(tmp_path), "netsplit")
+        with open(path) as f:
+            doc = json.load(f)
+        mod = _load_script("trace_report")
+        out = mod.render_doc(doc)
+        assert "quorum.fence" in out and "host=hostC" in out
+        assert "epoch.commit" in out and "epoch=2" in out
+        assert "self-preservation:" in out
+        assert "quorum.fence:1/3=1" in out
+        assert "quorum.restore:3/3=1" in out
+        assert "epoch.propose:v2=1" in out
+        assert "epoch.commit:v2=1" in out
+
     def test_trace_report_renders_session_serving_events(
             self, tmp_path):
         """PR 10's session-serving events (fairness sheds, viewport
@@ -652,6 +679,76 @@ class TestBenchGate:
         by_key = {v["key"]: v for v in verdict["keys"]}
         assert by_key["hotkey_storm_tps"][
             "watermark_record"] == "HOTKEY_r05.json"
+        capsys.readouterr()
+
+    def test_partition_keys_gated_direction_aware(self, tmp_path,
+                                                  capsys):
+        """--partition judges PARTITION_r*.json (bench --smoke
+        --partition, the netsplit chaos drill): fence/restore latency
+        are ``_ms`` keys and regress UP; the availability and
+        split-brain contracts (majority 5xx-without-shed, roll
+        commit, rejoin epoch, post-heal agreement, byte round-trip,
+        counted refusals) are correctness riders judged on the new
+        record alone."""
+        gate = self._gate()
+        good = {"part_fence_ms": 1200.0, "part_restore_ms": 1400.0,
+                "part_majority_5xx": 0, "part_roll_committed": 1,
+                "part_rejoin_epoch": 2, "part_postheal_agree": 1,
+                "part_byte_agree": 1, "part_minority_refusals": 2}
+        self._write(tmp_path, "PARTITION_r01.json", good)
+        # Fence latency UP 3x = regression (the minority served
+        # un-fenced — potentially split-brain — for 3x longer).
+        self._write(tmp_path, "PARTITION_r02.json",
+                    {**good, "part_fence_ms": 3600.0})
+        assert gate.main(["--partition", "--dir",
+                          str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["part_fence_ms"] == "regression"
+        assert by_key["part_restore_ms"] == "pass"
+        assert by_key["part_majority_5xx"] == "pass"
+        # One majority-side failure that was not counted shed fails
+        # outright, with every trend key flat.
+        self._write(tmp_path, "PARTITION_r03.json", good)
+        self._write(tmp_path, "PARTITION_r04.json",
+                    {**good, "part_majority_5xx": 1})
+        assert gate.main(["--partition", "--dir",
+                          str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["part_majority_5xx"] == "regression"
+        assert by_key["part_fence_ms"] == "pass"
+        # An aborted roll, a minority that refused nothing, or a
+        # post-heal disagreement each fail the same way.
+        self._write(tmp_path, "PARTITION_r05.json",
+                    {**good, "part_roll_committed": 0,
+                     "part_minority_refusals": 0,
+                     "part_postheal_agree": 0})
+        assert gate.main(["--partition", "--dir",
+                          str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["part_roll_committed"] == "regression"
+        assert by_key["part_minority_refusals"] == "regression"
+        assert by_key["part_postheal_agree"] == "regression"
+        # Holding every contract passes — including a one-gossip-tick
+        # restore wobble (+29%): fence/restore are tick-quantized, so
+        # the family's default bar is 0.50, not the 0.10 that would
+        # fail identical code on honest jitter.  Records that predate
+        # the family skip on null instead of failing.
+        self._write(tmp_path, "PARTITION_r06.json", good)
+        self._write(tmp_path, "PARTITION_r07.json",
+                    {**good, "part_restore_ms": 1800.0})
+        assert gate.main(["--partition", "--dir",
+                          str(tmp_path)]) == 0
+        capsys.readouterr()
+        self._write(tmp_path, "PARTITION_r08.json", {"ok": True})
+        assert gate.main(["--partition", "--dir",
+                          str(tmp_path)]) == 0
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["part_fence_ms"] == "skipped"
+        assert by_key["part_majority_5xx"] == "skipped"
         capsys.readouterr()
 
     def test_multichip_fleet_curve_gated(self, tmp_path, capsys):

@@ -722,6 +722,12 @@ def _overhead_table(n: int = 2000) -> dict:
                 pass
 
         out["write_behind"] = per_op(write_behind)
+    # The perf sentinel's per-request tax: one bounded-vocabulary key
+    # probe + one sketch insert (bisect over ~350 bucket bounds).
+    from omero_ms_image_region_tpu.server.sentinel import SentinelEngine
+    eng = SentinelEngine(member="bench", bundle_dir="")
+    out["sentinel"] = per_op(
+        lambda: eng.observe("render_image_region", 65536, 12.5))
     return out
 
 
@@ -1164,7 +1170,11 @@ def bench_smoke(duration_s: float = 1.5):
         # ledger flush, deadline check, admission admit+release, disk
         # write-behind enqueue.  Gated in tests/test_bench_smoke.py so
         # the feature layers stay pay-for-what-you-use.
-        "overhead_ns_per_op": _overhead_table(),
+        "overhead_ns_per_op": (_overheads := _overhead_table()),
+        # The perf sentinel's per-request tax, named at top level for
+        # the record diff (same number as overhead_ns_per_op.sentinel;
+        # the <100µs/op budget gate lives in tests/test_bench_smoke.py).
+        "sentinel_overhead_ns_per_op": _overheads.get("sentinel"),
         # Wire v3 probes (split posture, streaming + coalescing + shm
         # ring live) — gated in tests/test_bench_smoke.py.
         **wire,
@@ -2278,6 +2288,244 @@ def bench_hotkey_smoke(exec_ms: float = 30.0, grid: int = 4,
         "hotkey_autoscaler_signal": bool(autoscaler_signal),
         "hotkey_ledger_promotions": int(ledger_promotions),
         "loadmodel_late_fires": telemetry.LOADMODEL.late,
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    }
+    if emit:
+        print(json.dumps(out))
+    return out
+
+
+def bench_sentinel_smoke(emit: bool = True):
+    """Induced-drift sentinel drill (``bench.py --smoke --sentinel``,
+    tier-1 via tests/test_bench_smoke.py): the full confirm → capture
+    → recover cycle, deterministically, on a virtual clock.
+
+    A REAL 2-member fleet (the ``_fleet_smoke`` virtual-occupancy
+    members) serves a small burst each phase so the forensic
+    artifacts a bundle snapshots — flight ring, top-K cost ledgers,
+    request exemplars — hold live content; each member runs its OWN
+    ``SentinelEngine`` fed a deterministic per-request latency
+    (window jitter included, so the sketches are non-degenerate):
+
+    * **warmup** — both members at ~10 ms until their baselines
+      learn;
+    * **step** — member m1's latency steps to 4x while m0 holds: m1
+      must confirm EXACTLY ONE drift after ``confirm_ticks``
+      breaching windows, capture EXACTLY ONE complete bundle
+      (manifest listing profile + flight + costs + sketch_diff +
+      exemplars) and write ONE ``kind=sentinel`` ledger record,
+      while m0 stays quiet;
+    * **recover** — m1 returns to baseline and ``recover_ticks``
+      clean windows must clear the verdict.
+
+    Both members' summaries are ingested into ``telemetry.SENTINEL``
+    exactly as the gossip path does, so the asserted merged view is
+    the /debug/sentinel shape.  Emits ONE JSON line.
+    """
+    import asyncio
+    import os
+    import tempfile
+
+    from omero_ms_image_region_tpu.flagship import synthetic_wsi_tiles
+    from omero_ms_image_region_tpu.io.store import build_pyramid
+    from omero_ms_image_region_tpu.parallel.fleet import (
+        FleetImageHandler, FleetRouter, build_local_members)
+    from omero_ms_image_region_tpu.server.admission import (
+        AdmissionController)
+    from omero_ms_image_region_tpu.server.app import build_services
+    from omero_ms_image_region_tpu.server.config import (
+        AppConfig, BatcherConfig, RawCacheConfig, RendererConfig)
+    from omero_ms_image_region_tpu.server.ctx import ImageRegionCtx
+    from omero_ms_image_region_tpu.server.sentinel import SentinelEngine
+    from omero_ms_image_region_tpu.server.singleflight import (
+        SingleFlight)
+    from omero_ms_image_region_tpu.utils import decisions, telemetry
+
+    t_start = time.perf_counter()
+    rng = np.random.default_rng(41)
+    grid, tile_edge = 2, 32
+    route = "render_image_region"
+    base_ms, step_ms = 10.0, 40.0
+    min_samples, warmup, confirm, recover = 16, 2, 2, 2
+
+    clk = [0.0]
+
+    def stub_profile(directory: str, ms: float) -> dict:
+        # The drill stands in for telemetry.capture_profile (a real
+        # jax.profiler capture needs a device window and wall time);
+        # the app path keeps the real single-flight capture.
+        sub = os.path.join(directory, "profile")
+        os.makedirs(sub, exist_ok=True)
+        with open(os.path.join(sub, "capture.stub"), "w") as f:
+            f.write("drill\n")
+        return {"dir": sub, "ms": 0.0, "requested_ms": ms,
+                "files": 1, "bytes": 6}
+
+    def make_engine(member: str, bundle_dir: str) -> SentinelEngine:
+        return SentinelEngine(
+            member=member, tick_interval_s=5.0,
+            confirm_ticks=confirm, recover_ticks=recover,
+            min_samples=min_samples, warmup_ticks=warmup,
+            drift_ratio=1.5, baseline_alpha=0.2,
+            bundle_dir=bundle_dir, profile_ms=50.0,
+            # Real watermark SHAPE, drill-scaled values: the latency
+            # floor sits under the induced step (so the breach is
+            # above it) and the throughput mark is tiny (this drill
+            # induces a latency drift, not a starvation).
+            watermarks={"bench": {
+                "p50_service_tile_ms_ex_rtt": {"value": 5.0},
+                "service_tiles_per_sec": {"value": 0.001},
+            }},
+            clock=lambda: clk[0],
+            profile_fn=stub_profile)
+
+    def feed(engine: SentinelEngine, center_ms: float) -> None:
+        # One window's worth of deterministic observations: a fixed
+        # sawtooth around the center so quantiles interpolate over
+        # several sketch buckets instead of collapsing into one.
+        for i in range(max(min_samples, 24)):
+            engine.observe(route, 64 * 1024,
+                           center_ms * (1.0 + 0.04 * (i % 5)))
+
+    async def serve_burst(handler, n: int = 4) -> None:
+        # Live fleet traffic so the bundle's flight/cost/exemplar
+        # snapshots hold real content (durations the ENGINES judge
+        # stay the deterministic feed above).
+        for i in range(n):
+            ctx = ImageRegionCtx.from_params({
+                "imageId": "1", "theZ": "0", "theT": "0",
+                "tile": f"0,{i % grid},{(i // grid) % grid},"
+                        f"{tile_edge},{tile_edge}",
+                "format": "png", "m": "c", "c": "1|0:39000$FF0000",
+            })
+            out = await handler.render_image_region(ctx)
+            assert out
+
+    async def run_drill(tmp: str, bundle_dir: str) -> dict:
+        config = AppConfig(
+            data_dir=tmp,
+            batcher=BatcherConfig(enabled=False),
+            raw_cache=RawCacheConfig(enabled=True, prefetch=False),
+            renderer=RendererConfig(cpu_fallback_max_px=0))
+        services = build_services(config)
+        members = build_local_members(config, services, 2)
+        router = FleetRouter(members, lane_width=2,
+                             steal_min_backlog=0)
+        handler = FleetImageHandler(
+            router, single_flight=SingleFlight(),
+            admission=AdmissionController(256, renderer=router),
+            base_services=services)
+        engines = {
+            "m0": make_engine("m0", ""),
+            "m1": make_engine("m1", bundle_dir),
+        }
+
+        def tick_all() -> dict:
+            clk[0] += 5.0
+            summaries = {}
+            for name, eng in engines.items():
+                summaries[name] = eng.tick()
+                # The gossip ingest path, verbatim: per-member
+                # summaries join the fleet merge.
+                telemetry.SENTINEL.ingest(name, summaries[name])
+            return summaries
+
+        try:
+            # Warmup: both members learn "normal".
+            for _ in range(warmup + 1):
+                await serve_burst(handler)
+                for eng in engines.values():
+                    feed(eng, base_ms)
+                tick_all()
+            assert engines["m1"].verdict == "ok"
+
+            # Latency step on m1 only: confirm_ticks breaching
+            # windows -> ONE confirmed drift + ONE bundle.
+            for _ in range(confirm):
+                await serve_burst(handler)
+                feed(engines["m0"], base_ms)
+                feed(engines["m1"], step_ms)
+                summaries = tick_all()
+            drift_summary = summaries["m1"]
+            merged_at_drift = telemetry.SENTINEL.merged()
+
+            # Recovery: clean windows clear the verdict.
+            for _ in range(recover):
+                await serve_burst(handler)
+                for eng in engines.values():
+                    feed(eng, base_ms)
+                summaries = tick_all()
+            return {"drift": drift_summary,
+                    "merged": merged_at_drift,
+                    "final": summaries}
+        finally:
+            await router.close()
+            services.pixels_service.close()
+
+    telemetry.SENTINEL.reset()
+    decisions.LEDGER.reset()
+    with tempfile.TemporaryDirectory() as tmp, \
+            tempfile.TemporaryDirectory() as bundle_dir:
+        planes = synthetic_wsi_tiles(rng, 1, 1, grid * tile_edge,
+                                     grid * tile_edge).reshape(
+            1, 1, grid * tile_edge, grid * tile_edge)
+        build_pyramid(planes, os.path.join(tmp, "1"), n_levels=1)
+        phases = asyncio.run(run_drill(tmp, bundle_dir))
+
+        # -- exactly one confirmed drift, on m1, never m0 ------------
+        drift = phases["drift"]
+        assert drift["verdict"] == "drifting", drift
+        assert drift["drifting"], drift
+        sentinel_records = [
+            r for r in decisions.LEDGER.snapshot()
+            if r.get("kind") == "sentinel"]
+        drift_records = [r for r in sentinel_records
+                         if r.get("verdict") == "drift"]
+        assert len(drift_records) == 1, sentinel_records
+        assert drift_records[0].get("member") == "m1", drift_records
+
+        # -- exactly one COMPLETE bundle ------------------------------
+        bundles = sorted(
+            n for n in os.listdir(bundle_dir)
+            if n.startswith("sentinel-"))
+        assert len(bundles) == 1, bundles
+        bundle_path = os.path.join(bundle_dir, bundles[0])
+        with open(os.path.join(bundle_path, "manifest.json")) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+        missing = [k for k in ("profile", "flight", "costs",
+                               "sketch_diff", "exemplars")
+                   if not files.get(k)]
+        assert not missing, f"incomplete bundle: missing {missing}"
+        for fname in files.values():
+            assert os.path.exists(os.path.join(bundle_path, fname))
+        with open(os.path.join(bundle_path, files["flight"])) as f:
+            flight_doc = json.load(f)
+        assert flight_doc.get("events"), "flight dump empty"
+
+        # -- the merged fleet view saw both members + the drift -------
+        merged = phases["merged"]
+        assert set(merged["members"]) >= {"m0", "m1"}, merged
+        assert merged["verdict"] == "drifting", merged
+        assert merged["drifting_members"] == ["m1"], merged
+
+        # -- recovery clears the verdict ------------------------------
+        final = phases["final"]
+        assert final["m1"]["verdict"] == "ok", final["m1"]
+        recovered_records = [r for r in decisions.LEDGER.snapshot()
+                             if r.get("kind") == "sentinel"
+                             and r.get("verdict") == "recovered"]
+        assert len(recovered_records) == 1, recovered_records
+
+    out = {
+        "metric": "sentinel_smoke",
+        "sentinel_drift_confirms": len(drift_records),
+        "sentinel_drifting_member": "m1",
+        "sentinel_bundles": len(bundles),
+        "sentinel_bundle_files": sorted(files),
+        "sentinel_recovered": True,
+        "sentinel_merged_members": sorted(merged["members"]),
+        "sentinel_drift_keys": list(drift["drifting"]),
         "elapsed_s": round(time.perf_counter() - t_start, 1),
     }
     if emit:
@@ -3879,6 +4127,12 @@ def main():
             # a mid-partition two-phase epoch roll — the PARTITION
             # record family.
             bench_partition_smoke()
+        elif "--sentinel" in sys.argv[1:]:
+            # Induced-drift sentinel drill: deterministic latency
+            # step on a virtual clock through a 2-member fleet ->
+            # one confirmed drift -> one complete incident bundle ->
+            # recovery clears the verdict.
+            bench_sentinel_smoke()
         else:
             bench_smoke()
         return

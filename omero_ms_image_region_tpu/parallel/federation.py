@@ -861,6 +861,13 @@ def handle_member_gossip(header: dict) -> dict:
         slo = telemetry.SLO.export_buckets()
         if slo:
             doc["slo"] = slo
+        # Perf-sentinel piggyback, same posture as the SLO buckets:
+        # this host's last tick summary rides the gossip answer so
+        # every peer's /debug/sentinel sees the fleet drift picture
+        # with no extra round trips.
+        sen = telemetry.SENTINEL.export()
+        if sen:
+            doc["sentinel"] = sen
     return doc
 
 
@@ -1243,6 +1250,8 @@ class FederationCoordinator:
         # same way every peer's do — one ingest path, no special case.
         telemetry.FED_SLO.ingest(self.self_host,
                                  telemetry.SLO.export_buckets())
+        telemetry.SENTINEL.ingest(self.self_host,
+                                  telemetry.SENTINEL.export())
         for member in self._remote_handles():
             host = self.manifest.host_of(member.name)
             t_send = time.perf_counter()
@@ -1259,6 +1268,8 @@ class FederationCoordinator:
                 observe_host(resp.get("host") or host)
                 telemetry.FED_SLO.ingest(resp.get("host") or host,
                                          resp.get("slo"))
+                telemetry.SENTINEL.ingest(resp.get("host") or host,
+                                          resp.get("sentinel"))
             if resp is None or not resp.get("enabled", True):
                 outcome[member.name] = "unreachable"
                 telemetry.FEDERATION.count_gossip("unreachable")

@@ -716,6 +716,34 @@ def create_app(config: Optional[AppConfig] = None,
         _gov_ref.append(governor)
         pressure_mod.install(governor)
 
+    # Live perf-regression sentinel (deploy/DEPLOY.md "Perf
+    # sentinel"): always-on quantile baselines + watermark floors +
+    # automatic incident bundles.  Installed module-global (the
+    # governor idiom) so _finish_request pays one probe when it is
+    # off; the tick loop starts in on_startup.
+    from . import sentinel as sentinel_mod
+    sentinel_engine = None
+    if config.sentinel.enabled:
+        def _sentinel_flight():
+            # The process flight ring IS the fleet view for local
+            # members (every member stamps its events into it);
+            # remote members' rings stay reachable via
+            # /debug/flightrecorder and are named here for the
+            # investigator.
+            return {
+                "member": getattr(config.federation, "host", "")
+                or "local",
+                "fleet_members": [m.name for m in fleet_members],
+                "events": telemetry.FLIGHT.snapshot(),
+            }
+
+        sentinel_engine = sentinel_mod.engine_from_config(
+            config.sentinel,
+            member=(getattr(config.federation, "host", "")
+                    or "local"),
+            flight_fn=_sentinel_flight)
+        sentinel_mod.install(sentinel_engine)
+
     watchdog = None
     if config.watchdog.enabled:
         from .watchdog import build_watchdog
@@ -1316,6 +1344,15 @@ def create_app(config: Optional[AppConfig] = None,
                                        exemplar=exemplar)
         telemetry.count_request(route, status)
         telemetry.SLO.record(status, total_ms)
+        sentinel_engine = sentinel_mod.active()
+        if sentinel_engine is not None and status < 400:
+            # Perf-sentinel quantile sketch: one bounded-vocabulary
+            # key probe + one sketch insert (errors stay out — their
+            # latency describes the failure path, not the serving
+            # regression the sentinel hunts).
+            sentinel_engine.observe(
+                route, nbytes, total_ms,
+                trace.trace_id if trace is not None else None)
         if diurnal_estimator is not None:
             # One observation per finished request: the arrival stream
             # the diurnal demand fit regresses over (ns-scale bin
@@ -1630,6 +1667,59 @@ def create_app(config: Optional[AppConfig] = None,
         return web.json_response(
             {"request_duration_ms": telemetry.exemplars_snapshot()})
 
+    async def debug_sentinel(request: web.Request) -> web.Response:
+        """The perf sentinel's merged fleet view: this process's
+        engine (live, not the last tick), every gossiped/ingested
+        member summary, and — on fleet frontends — each remote
+        member's own view fetched over the ``sentinel`` wire op and
+        stamped with its member name (the flight-ring merge's exact
+        shape)."""
+        doc = telemetry.SENTINEL.merged()
+        engine = sentinel_mod.active()
+        if engine is not None:
+            local = engine.summary()
+            doc["members"][str(local.get("member") or "local")] = {
+                "age_s": 0.0, "summary": local}
+            if (local.get("verdict") == "drifting"
+                    and doc["verdict"] != "drifting"):
+                doc["verdict"] = "drifting"
+        if services is None:
+            import asyncio as _asyncio
+
+            async def _fetch_view(probe_client):
+                try:
+                    status, body = await _asyncio.wait_for(
+                        probe_client.call("sentinel", {}),
+                        timeout=2.0)
+                    return (json.loads(bytes(body).decode())
+                            if status == 200 and body else None)
+                except Exception:
+                    return None
+
+            members = (fleet_members if fleet_remote else [])
+            views = await _asyncio.gather(
+                *(_fetch_view(m.client) for m in members))
+            if not fleet_remote and client is not None:
+                views = [await _fetch_view(client)]
+                members_names = ["sidecar"]
+            else:
+                members_names = [m.name for m in members]
+            for name, view in zip(members_names, views):
+                if not isinstance(view, dict):
+                    continue
+                summary = view.get("local") or {}
+                if summary:
+                    doc["members"].setdefault(
+                        name, {"age_s": 0.0, "summary": summary})
+                    if summary.get("verdict") == "drifting":
+                        doc["verdict"] = "drifting"
+                        if name not in doc["drifting_members"]:
+                            doc["drifting_members"].append(name)
+        doc["drifting_members"] = sorted(set(
+            name for name, row in doc["members"].items()
+            if row.get("summary", {}).get("verdict") == "drifting"))
+        return web.json_response(doc)
+
     async def debug_profile(request: web.Request) -> web.Response:
         """On-demand device profiling: wrap ``jax.profiler`` around
         whatever the batcher lanes are doing for ``?ms=N`` and return
@@ -1854,6 +1944,15 @@ def create_app(config: Optional[AppConfig] = None,
             # a flight-recorder dump), not a reason to pull the last
             # healthy-enough instance out of rotation.
             checks["slo"] = telemetry.SLO.summary()
+        _sentinel = sentinel_mod.active()
+        if _sentinel is not None:
+            # Annotation only, same posture as the SLO line: a
+            # drifting instance is slower than its own baseline, not
+            # unhealthy — pulling it from rotation would shift its
+            # load onto peers and widen the regression.  The page
+            # comes from sentinel.drift / the incident bundle.
+            checks["sentinel"] = (
+                "drifting" if _sentinel.verdict == "drifting" else "ok")
         if governor is not None:
             # Annotation only, same posture as the SLO line: a
             # browned-out instance is still SERVING (that is the whole
@@ -2070,6 +2169,9 @@ def create_app(config: Optional[AppConfig] = None,
             await federation_coord.agree(strict=True)
             tasks.append(asyncio.create_task(
                 federation_coord.run(), name="federation-gossip"))
+        if sentinel_engine is not None:
+            tasks.append(asyncio.create_task(
+                sentinel_engine.run(), name="perf-sentinel"))
         app[_ROBUSTNESS_TASKS_KEY] = tasks
 
     app.on_startup.append(on_startup_robustness)
@@ -2099,6 +2201,7 @@ def create_app(config: Optional[AppConfig] = None,
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/warmstate", debug_warmstate)
     app.router.add_get("/debug/exemplars", debug_exemplars)
+    app.router.add_get("/debug/sentinel", debug_sentinel)
     # The dry-run explain plane: resolve a render URL — identity,
     # ETag, ring owner/chain, per-member residency, admission posture
     # — with ZERO render work (server.explain).
@@ -2128,6 +2231,10 @@ def create_app(config: Optional[AppConfig] = None,
                 pass
         if governor is not None and pressure_mod.active() is governor:
             pressure_mod.uninstall()
+        if sentinel_engine is not None:
+            sentinel_engine.close()
+            if sentinel_mod.active() is sentinel_engine:
+                sentinel_mod.uninstall()
         if autoscaler is not None and autoscaler._op is not None \
                 and not autoscaler._op.done():
             # An in-flight scale-down (mid-settle/handoff) must not

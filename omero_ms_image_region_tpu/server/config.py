@@ -694,6 +694,47 @@ class DecisionsConfig:
 
 
 @dataclass
+class SentinelConfig:
+    """Live perf-regression sentinel (``server.sentinel``): always-on
+    per-route/per-shape quantile sketches, a tick-driven drift engine
+    judging live p50/p99 and served-tiles/s against BOTH a
+    self-learned rolling baseline (persisted through the warm-state
+    manifest) and the committed bench watermarks, and an automatic
+    forensic incident bundle on confirmed drift.  Annotation-only on
+    /readyz; never fails a request."""
+
+    enabled: bool = True
+    # Drift evaluation cadence; each tick closes one quantile window.
+    tick_interval_s: float = 5.0
+    # Multi-window confirmation: a breach must hold this many
+    # consecutive ticks before the drift verdict fires (one slow
+    # request — or one slow window — never pages anyone).
+    confirm_ticks: int = 3
+    # Clean consecutive ticks that clear a confirmed verdict.
+    recover_ticks: int = 3
+    # A window with fewer observations than this gives no verdict
+    # either way and teaches the baseline nothing.
+    min_samples: int = 32
+    # Baseline windows to learn before drift can be judged at all.
+    warmup_ticks: int = 3
+    # Live p99 above baseline-p99 x ratio = one breached window.
+    drift_ratio: float = 1.5
+    # EWMA step for the rolling baseline (non-breaching windows only).
+    baseline_alpha: float = 0.2
+    # Served-tiles/s under watermark x ratio (with real traffic) is
+    # throughput drift even when the learned baseline sagged with it.
+    throughput_floor_ratio: float = 0.5
+    # Incident bundles: directory ("" disables capture — verdicts and
+    # events still fire), retention cap, device-profile duration.
+    bundle_dir: str = ""
+    max_bundles: int = 8
+    profile_ms: int = 200
+    # Where the committed BENCH_r*/OFFLOAD_r* records (and
+    # scripts/bench_gate.py) live; "" skips the watermark floors.
+    records_dir: str = "."
+
+
+@dataclass
 class HttpConfig:
     """Request parse limits (≙ ``config.yaml:5-12`` — the Vert.x
     ``HttpServerOptions`` line/header limits, mapped onto aiohttp's
@@ -820,6 +861,8 @@ class AppConfig:
     slo: SloConfig = field(default_factory=SloConfig)
     decisions: DecisionsConfig = field(
         default_factory=DecisionsConfig)
+    sentinel: SentinelConfig = field(
+        default_factory=SentinelConfig)
     fault_tolerance: FaultToleranceConfig = field(
         default_factory=FaultToleranceConfig)
     # Seeded chaos layer (utils.faultinject); seed absent = disabled.
@@ -1624,6 +1667,62 @@ class AppConfig:
         if cfg.decisions.outcome_horizon_ticks < 1:
             raise ValueError(
                 "decisions.outcome-horizon-ticks must be >= 1")
+        sen = raw.get("sentinel", {}) or {}
+        sen_defaults = SentinelConfig()
+        cfg.sentinel = SentinelConfig(
+            enabled=bool(sen.get("enabled", sen_defaults.enabled)),
+            tick_interval_s=float(sen.get(
+                "tick-interval-s", sen_defaults.tick_interval_s)),
+            confirm_ticks=int(sen.get(
+                "confirm-ticks", sen_defaults.confirm_ticks)),
+            recover_ticks=int(sen.get(
+                "recover-ticks", sen_defaults.recover_ticks)),
+            min_samples=int(sen.get(
+                "min-samples", sen_defaults.min_samples)),
+            warmup_ticks=int(sen.get(
+                "warmup-ticks", sen_defaults.warmup_ticks)),
+            drift_ratio=float(sen.get(
+                "drift-ratio", sen_defaults.drift_ratio)),
+            baseline_alpha=float(sen.get(
+                "baseline-alpha", sen_defaults.baseline_alpha)),
+            throughput_floor_ratio=float(sen.get(
+                "throughput-floor-ratio",
+                sen_defaults.throughput_floor_ratio)),
+            bundle_dir=str(sen.get(
+                "bundle-dir", sen_defaults.bundle_dir) or ""),
+            max_bundles=int(sen.get(
+                "max-bundles", sen_defaults.max_bundles)),
+            profile_ms=int(sen.get(
+                "profile-ms", sen_defaults.profile_ms)),
+            records_dir=str(sen.get(
+                "records-dir", sen_defaults.records_dir) or ""),
+        )
+        if cfg.sentinel.tick_interval_s <= 0:
+            raise ValueError("sentinel.tick-interval-s must be > 0")
+        if cfg.sentinel.confirm_ticks < 1:
+            raise ValueError("sentinel.confirm-ticks must be >= 1 "
+                             "(a zero-confirmation sentinel would "
+                             "page on one slow window)")
+        if cfg.sentinel.recover_ticks < 1:
+            raise ValueError("sentinel.recover-ticks must be >= 1")
+        if cfg.sentinel.min_samples < 1:
+            raise ValueError("sentinel.min-samples must be >= 1")
+        if cfg.sentinel.warmup_ticks < 1:
+            raise ValueError("sentinel.warmup-ticks must be >= 1")
+        if cfg.sentinel.drift_ratio <= 1.0:
+            raise ValueError(
+                "sentinel.drift-ratio must be > 1.0 (a ratio at or "
+                "under 1.0 calls steady state a drift)")
+        if not 0.0 < cfg.sentinel.baseline_alpha <= 1.0:
+            raise ValueError(
+                "sentinel.baseline-alpha must be in (0, 1]")
+        if not 0.0 < cfg.sentinel.throughput_floor_ratio <= 1.0:
+            raise ValueError(
+                "sentinel.throughput-floor-ratio must be in (0, 1]")
+        if cfg.sentinel.max_bundles < 1:
+            raise ValueError("sentinel.max-bundles must be >= 1")
+        if cfg.sentinel.profile_ms < 0:
+            raise ValueError("sentinel.profile-ms must be >= 0")
         ft = raw.get("fault-tolerance", {}) or {}
         ft_defaults = FaultToleranceConfig()
         cfg.fault_tolerance = FaultToleranceConfig(

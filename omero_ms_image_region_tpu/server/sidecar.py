@@ -97,6 +97,7 @@ import time
 from typing import Deque, Dict, List, Optional, Tuple
 
 from ..utils import telemetry
+from . import sentinel as sentinel_mod
 from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
 from .errors import NotFoundError
 from .shmring import RingError, ShmRing
@@ -621,10 +622,21 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                             ctx = ShapeMaskCtx.from_json(header["ctx"])
                             body = await \
                                 mask_handler.render_shape_mask(ctx)
+                        _elapsed_ms = \
+                            (_time.perf_counter() - t0) * 1000.0
                         telemetry.record_span(
-                            "sidecar.render", t0,
-                            (_time.perf_counter() - t0) * 1000.0,
-                            op=op)
+                            "sidecar.render", t0, _elapsed_ms, op=op)
+                        # Perf-sentinel sketch insert: the sidecar
+                        # watches its OWN render latency (the frontend
+                        # watches wire-inclusive time) — one probe
+                        # when the sentinel is off.
+                        _sentinel = sentinel_mod.active()
+                        if _sentinel is not None:
+                            _sentinel.observe(
+                                "render_image_region"
+                                if op == "image" else "shape_mask",
+                                len(body), _elapsed_ms,
+                                trace_id)
                         # Brownout quality cap: exported on the reply
                         # so the FRONTEND's byte-tier write-backs
                         # (fleet peer put-back) can honor the
@@ -677,6 +689,11 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 # Self-preservation families: the governor/watchdog
                 # run in this process too when enabled.
                 lines += telemetry.robustness_metric_lines(
+                    ',process="sidecar"')
+                # This process's own perf-sentinel view (verdict,
+                # live-vs-baseline p99) — the frontend's merge makes
+                # the fleet drift picture.
+                lines += telemetry.SENTINEL.metric_lines(
                     ',process="sidecar"')
                 body = ("\n".join(lines) + "\n").encode()
             elif op == "plane_probe":
@@ -980,6 +997,16 @@ async def _serve_connection(image_handler, mask_handler, reader, writer,
                 doc = await asyncio.to_thread(
                     warmstate_fn, bool(header.get("snapshot")))
                 body = json.dumps(doc).encode()
+            elif op == "sentinel":
+                # This process's perf-sentinel view: the engine's
+                # LIVE summary (no tick advance) plus anything it
+                # ingested over gossip; the frontend folds it into
+                # its /debug/sentinel fleet merge.
+                engine = sentinel_mod.active()
+                doc = dict(telemetry.SENTINEL.merged())
+                doc["local"] = (engine.summary()
+                                if engine is not None else None)
+                body = json.dumps(doc).encode()
             elif op == "profile":
                 # On-demand jax.profiler capture around the live
                 # batcher lanes of THIS device-owning process.
@@ -1277,6 +1304,21 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
             robustness_tasks.append(asyncio.create_task(
                 fed_coord.run(), name="federation-gossip"))
 
+    # The device process runs its OWN perf sentinel (its render
+    # latency is the signal the frontend's wire-inclusive clock
+    # muddies); the summary rides gossip replies and the ``sentinel``
+    # wire op into the frontend's fleet merge.
+    sentinel_engine = None
+    if getattr(config, "sentinel", None) is not None \
+            and config.sentinel.enabled:
+        sentinel_engine = sentinel_mod.engine_from_config(
+            config.sentinel,
+            member=(getattr(getattr(config, "federation", None),
+                            "host", "") or "sidecar"))
+        sentinel_mod.install(sentinel_engine)
+        robustness_tasks.append(asyncio.create_task(
+            sentinel_engine.run(), name="perf-sentinel"))
+
     def status_fn() -> dict:
         """The ping op's readiness document (frontend /readyz rolls
         this into its own verdict)."""
@@ -1368,6 +1410,10 @@ async def run_sidecar(config, socket_path: Optional[str] = None,
         if governor is not None \
                 and pressure_mod.active() is governor:
             pressure_mod.uninstall()
+        if sentinel_engine is not None:
+            sentinel_engine.close()
+            if sentinel_mod.active() is sentinel_engine:
+                sentinel_mod.uninstall()
         for task in list(conn_tasks):
             task.cancel()
         if conn_tasks:

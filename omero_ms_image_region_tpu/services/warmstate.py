@@ -211,6 +211,17 @@ class WarmStateManager:
         if exec_cache is not None:
             doc["fingerprint"] = exec_cache.fingerprint()
             doc["executables"] = exec_cache.stored_keys()
+        # The perf sentinel's learned latency baselines ride the same
+        # manifest: a restart must not re-learn "normal" from scratch
+        # (a regression deployed WITH the restart would become the new
+        # baseline before the sentinel could see it).  Lazy import —
+        # services must not import server at module scope.
+        from ..server import sentinel as sentinel_mod
+        engine = sentinel_mod.active()
+        if engine is not None:
+            baselines = engine.export_baseline()
+            if baselines.get("baselines"):
+                doc["sentinel"] = baselines
         return doc
 
     def snapshot_now(self) -> Optional[str]:
@@ -293,6 +304,21 @@ class WarmStateManager:
             log.info("warm-state manifest fingerprint differs; "
                      "skipping executable rehydrate")
             exec_keys = []
+        # Sentinel baseline rehydrate first — it is a dict copy, not
+        # I/O, and the engine should know "normal" before the first
+        # post-boot windows close.  Best-effort like everything here.
+        sentinel_doc = doc.get("sentinel")
+        if sentinel_doc:
+            try:
+                from ..server import sentinel as sentinel_mod
+                engine = sentinel_mod.active()
+                if engine is not None:
+                    n = engine.load_baseline(sentinel_doc)
+                    if n:
+                        log.info("restored %d sentinel baselines", n)
+            except Exception:
+                log.warning("sentinel baseline rehydrate failed",
+                            exc_info=True)
         byte_items = [(name, key)
                       for name in _CACHE_NAMES
                       for key in (doc.get("byte_keys") or {}).get(name,

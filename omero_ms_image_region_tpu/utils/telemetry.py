@@ -2268,12 +2268,13 @@ class DecisionStats:
     never mint either string."""
 
     KINDS = ("autoscaler", "epoch", "manifest", "gossip",
-             "drain", "undrain", "handoff", "hotkey", "quorum")
+             "drain", "undrain", "handoff", "hotkey", "quorum",
+             "sentinel")
     VERDICTS = ("up", "down", "blocked", "steady",
                 "installed", "pending", "promoted", "demoted",
                 "agreed", "stale", "split-brain", "unreachable",
                 "legacy", "ok", "mismatch", "done", "failed",
-                "fenced", "restored")
+                "fenced", "restored", "drift", "recovered")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -2474,6 +2475,185 @@ class FleetSloStats:
 
 
 FED_SLO = FleetSloStats()
+
+
+class SentinelStats:
+    """Exposition + fleet-merge half of the live perf-regression
+    sentinel (``server.sentinel`` owns the sketches and the drift
+    engine; this accumulator stays importable without the server
+    stack).  Each member's engine pushes its per-tick summary here
+    (``set_local``), gossip carries peer summaries in (``ingest`` —
+    the ``FleetSloStats`` idiom, same ``_MAX_MEMBERS`` overflow guard
+    the cardinality budget relies on), and ``merged`` answers
+    ``GET /debug/sentinel`` with ONE fleet view instead of N
+    incomparable ones."""
+
+    _MAX_MEMBERS = 16
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._clock = time.monotonic
+        # Freshness bound for the merged verdict: a member whose last
+        # summary predates this is reported but not counted drifting
+        # (a dead member must not pin the fleet red forever).
+        self.stale_after_s = 120.0
+        self.local: Optional[dict] = None
+        # member -> {"t": ingest instant, "summary": tick summary doc}
+        self.members: Dict[str, dict] = {}
+        self.dropped_members = 0
+        self.drifts = 0
+        self.recoveries = 0
+        self.bundles = 0
+        self.bundle_errors = 0
+
+    def configure(self, clock=time.monotonic) -> None:
+        with self._lock:
+            self._clock = clock
+
+    # ------------------------------------------------- engine inputs
+
+    def set_local(self, summary) -> None:
+        """The local engine's latest tick summary (the doc gossip
+        exports and ``merged`` folds in as this process's row)."""
+        if isinstance(summary, dict):
+            with self._lock:
+                self.local = dict(summary)
+
+    def count_drift(self) -> None:
+        with self._lock:
+            self.drifts += 1
+
+    def count_recovery(self) -> None:
+        with self._lock:
+            self.recoveries += 1
+
+    def count_bundle(self, error: bool = False) -> None:
+        with self._lock:
+            if error:
+                self.bundle_errors += 1
+            else:
+                self.bundles += 1
+
+    # --------------------------------------------------- fleet merge
+
+    def export(self) -> Optional[dict]:
+        """The local summary for the gossip wire (None while the
+        engine has not ticked — peers skip on null)."""
+        with self._lock:
+            return dict(self.local) if self.local else None
+
+    def ingest(self, member: str, summary) -> bool:
+        if not member or not isinstance(summary, dict) \
+                or not summary.get("verdict"):
+            return False
+        with self._lock:
+            if member not in self.members \
+                    and len(self.members) >= self._MAX_MEMBERS:
+                self.dropped_members += 1
+                return False
+            self.members[member] = {"t": self._clock(),
+                                    "summary": dict(summary)}
+        return True
+
+    def merged(self) -> dict:
+        """Per-member rows + one fleet verdict: ``drifting`` while any
+        FRESH member reports a confirmed drift."""
+        with self._lock:
+            now = self._clock()
+            rows: Dict[str, dict] = {}
+            if self.local:
+                name = str(self.local.get("member") or "local")
+                rows[name] = {"age_s": 0.0,
+                              "summary": dict(self.local)}
+            for member, entry in self.members.items():
+                if member in rows:
+                    continue
+                rows[member] = {
+                    "age_s": round(max(0.0, now - entry["t"]), 1),
+                    "summary": dict(entry["summary"])}
+            drifting = sorted(
+                name for name, row in rows.items()
+                if row["summary"].get("verdict") == "drifting"
+                and row["age_s"] <= self.stale_after_s)
+            return {
+                "verdict": "drifting" if drifting else "ok",
+                "drifting_members": drifting,
+                "members": rows,
+                "dropped_members": self.dropped_members,
+                "drifts": self.drifts,
+                "recoveries": self.recoveries,
+                "bundles": self.bundles,
+                "bundle_errors": self.bundle_errors,
+            }
+
+    # ----------------------------------------------------- exposition
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            if self.local is None and not self.members:
+                return []                # emit-when-live
+            local = self.local or {}
+            drifting = 1 if local.get("verdict") == "drifting" else 0
+            lines = [
+                f"imageregion_sentinel_drift{label()} {drifting}",
+                f"imageregion_sentinel_keys{label()} "
+                f"{len(local.get('routes') or {})}",
+                f"imageregion_sentinel_ticks_total{label()} "
+                f"{int(local.get('ticks') or 0)}",
+                f"imageregion_sentinel_observations_total{label()} "
+                f"{int(local.get('observations') or 0)}",
+                f"imageregion_sentinel_drifts_total{label()} "
+                f"{self.drifts}",
+                f"imageregion_sentinel_recoveries_total{label()} "
+                f"{self.recoveries}",
+                f"imageregion_sentinel_bundles_total{label()} "
+                f"{self.bundles}",
+                f"imageregion_sentinel_bundle_errors_total{label()} "
+                f"{self.bundle_errors}",
+            ]
+            for route in sorted(local.get("routes") or {}):
+                doc = local["routes"][route] or {}
+                body = 'route="%s"' % route
+                for key, family in (
+                        ("p99_ms", "imageregion_sentinel_live_p99_ms"),
+                        ("baseline_p99_ms",
+                         "imageregion_sentinel_baseline_p99_ms")):
+                    v = doc.get(key)
+                    if isinstance(v, (int, float)):
+                        lines.append(f"{family}{label(body)} "
+                                     f"{round(float(v), 3)}")
+            now = self._clock()
+            for member in sorted(self.members):
+                entry = self.members[member]
+                if now - entry["t"] > self.stale_after_s:
+                    continue
+                v = (1 if entry["summary"].get("verdict") == "drifting"
+                     else 0)
+                lines.append(
+                    f"imageregion_sentinel_member_drift"
+                    f"{label('member=%s' % json.dumps(member))} {v}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self._clock = time.monotonic
+            self.stale_after_s = 120.0
+            self.local = None
+            self.members.clear()
+            self.dropped_members = 0
+            self.drifts = 0
+            self.recoveries = 0
+            self.bundles = 0
+            self.bundle_errors = 0
+
+
+SENTINEL = SentinelStats()
 
 
 class QuorumStats:
@@ -3263,6 +3443,20 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_fleet_slo_dropped_hosts_total": "counter",
     "imageregion_fleet_slo_burn_rate": "gauge",
     "imageregion_fleet_slo_host_burn_rate": "gauge",
+    # Live perf-regression sentinel (server.sentinel / SentinelStats):
+    # drift verdicts, per-route live-vs-baseline p99, incident-bundle
+    # captures, per-member fleet verdicts off the gossip merge.
+    "imageregion_sentinel_drift": "gauge",
+    "imageregion_sentinel_keys": "gauge",
+    "imageregion_sentinel_ticks_total": "counter",
+    "imageregion_sentinel_observations_total": "counter",
+    "imageregion_sentinel_drifts_total": "counter",
+    "imageregion_sentinel_recoveries_total": "counter",
+    "imageregion_sentinel_bundles_total": "counter",
+    "imageregion_sentinel_bundle_errors_total": "counter",
+    "imageregion_sentinel_live_p99_ms": "gauge",
+    "imageregion_sentinel_baseline_p99_ms": "gauge",
+    "imageregion_sentinel_member_drift": "gauge",
     # Session-aware serving (services.viewport / services.prefetch /
     # server.admission token buckets / fleet QoS dequeue).
     "imageregion_session_tracked": "gauge",
@@ -3351,6 +3545,31 @@ METRIC_HELP: Dict[str, str] = {
         "Fleet-aggregated error-budget burn per objective and window",
     "imageregion_fleet_slo_host_burn_rate":
         "Per-host error-budget burn per objective and window",
+    "imageregion_sentinel_drift":
+        "1 while the local perf sentinel holds a confirmed drift "
+        "verdict",
+    "imageregion_sentinel_keys":
+        "Route classes the sentinel currently tracks quantiles for",
+    "imageregion_sentinel_ticks_total":
+        "Drift-evaluation windows the local sentinel has closed",
+    "imageregion_sentinel_observations_total":
+        "Requests the local sentinel has sketched",
+    "imageregion_sentinel_drifts_total":
+        "Per-key drift confirmations (confirm-ticks consecutive "
+        "breaching windows)",
+    "imageregion_sentinel_recoveries_total":
+        "Per-key drift recoveries (recover-ticks consecutive clean "
+        "windows)",
+    "imageregion_sentinel_live_p99_ms":
+        "Live windowed p99 latency per route class (sketch estimate)",
+    "imageregion_sentinel_baseline_p99_ms":
+        "Self-learned rolling-baseline p99 per route class",
+    "imageregion_sentinel_member_drift":
+        "Per-member drift verdict off the gossip merge (1 = drifting)",
+    "imageregion_sentinel_bundles_total":
+        "Forensic incident bundles written on confirmed drift",
+    "imageregion_sentinel_bundle_errors_total":
+        "Incident-bundle captures that failed (drift verdict stands)",
     "imageregion_request_cost_device_ms":
         "Per-request device-execute ms (pro-rata from batch group)",
     "imageregion_request_cost_read_ms":
@@ -3588,6 +3807,7 @@ def request_metric_lines(exemplars: bool = False) -> List[str]:
     lines += HTTPCACHE.metric_lines()
     lines += PROVENANCE.metric_lines()
     lines += SLO.metric_lines()
+    lines += SENTINEL.metric_lines()
     lines += [
         f"imageregion_flight_events {len(FLIGHT)}",
         f"imageregion_flight_events_total {FLIGHT.events_total}",
@@ -3762,6 +3982,7 @@ def reset() -> None:
     QUORUM.reset()
     DECISIONS.reset()
     FED_SLO.reset()
+    SENTINEL.reset()
     SESSIONS.reset()
     PREFETCH.reset()
     QOS.reset()

@@ -118,6 +118,21 @@ _CAPACITY_RE = re.compile(r"^CAPACITY_r(\d+)\.json$")
 _HOTKEY_RE = re.compile(r"^HOTKEY_r(\d+)\.json$")
 _PARTITION_RE = re.compile(r"^PARTITION_r(\d+)\.json$")
 
+# Every committed record family in one table: (name, filename
+# pattern, trend keys, pairwise/watermark threshold).  ``--all``
+# iterates it, and ``load_watermarks`` (the importable parser the
+# live perf sentinel shares) walks the same table so a family added
+# here is automatically judged by CI AND learned by the sentinel.
+FAMILIES = (
+    ("bench", _BENCH_RE, DEFAULT_KEYS, 0.10),
+    ("multichip", _MULTICHIP_RE, MULTICHIP_KEYS, 0.10),
+    ("offload", _OFFLOAD_RE, OFFLOAD_KEYS, 0.10),
+    ("sessions", _SESSIONS_RE, SESSIONS_KEYS, 0.10),
+    ("capacity", _CAPACITY_RE, CAPACITY_KEYS, 0.10),
+    ("hotkey", _HOTKEY_RE, HOTKEY_KEYS, 0.10),
+    ("partition", _PARTITION_RE, PARTITION_KEYS, 0.50),
+)
+
 
 def lower_is_better(key: str) -> bool:
     """Latency keys regress UPWARD — without direction awareness a
@@ -262,6 +277,145 @@ def judge_watermark(records, names, new, keys,
     return verdicts
 
 
+def load_watermarks(root: str = "."):
+    """Best-ever marks across EVERY committed record family in
+    ``root``: ``{family: {key: {"value": v, "record": basename}}}``.
+
+    The importable half of the watermark gate — the live perf
+    sentinel (``server.sentinel``) calls this at startup so the marks
+    a human would check with ``--watermark`` become drift floors the
+    serving fleet enforces continuously.  Strictly best-effort:
+    absent families, unreadable records and null keys are skipped,
+    never raised — a cold repo yields ``{}`` and the sentinel learns
+    from live traffic alone."""
+    marks_by_family = {}
+    for name, pattern, keys, _ in FAMILIES:
+        try:
+            paths = all_records(root, pattern)
+        except OSError:
+            continue
+        records, names = [], []
+        for p in paths:
+            try:
+                records.append(load_record(p))
+                names.append(os.path.basename(p))
+            except (OSError, ValueError):
+                continue
+        if not records:
+            continue
+        marks = watermark(records, keys)
+        if marks:
+            marks_by_family[name] = {
+                key: {"value": value, "record": names[idx]}
+                for key, (value, idx) in marks.items()}
+    return marks_by_family
+
+
+def hotkey_riders(new_record: dict):
+    """Correctness rider, judged on the NEW record alone (no trend,
+    no threshold): a single duplicate-staged plane means the
+    digest-dedup staging contract broke.  Absent/null skips like
+    every other key (rounds that predate the family)."""
+    dup = new_record.get("hotkey_duplicate_staged")
+    if not isinstance(dup, (int, float)):
+        return [{"key": "hotkey_duplicate_staged",
+                 "verdict": "skipped", "old": None, "new": dup}]
+    return [{"key": "hotkey_duplicate_staged",
+             "verdict": "regression" if dup > 0 else "pass",
+             "old": 0, "new": int(dup)}]
+
+
+def partition_riders(new_record: dict):
+    """Correctness riders, judged on the NEW record alone (no trend,
+    no threshold) — each is a partition-tolerance CONTRACT: the
+    majority must never fail a request without counting it shed, the
+    quorate side's roll must commit, the healed fleet must agree
+    bit-exactly (manifest digest + probe owners + byte round-trip),
+    and a fenced minority that refused nothing means the fence gates
+    never engaged.  Absent/null skips (rounds that predate the
+    family)."""
+    riders = (
+        ("part_majority_5xx", lambda v: v == 0, 0),
+        ("part_roll_committed", lambda v: v == 1, 1),
+        ("part_rejoin_epoch", lambda v: v >= 2, 2),
+        ("part_postheal_agree", lambda v: v == 1, 1),
+        ("part_byte_agree", lambda v: v == 1, 1),
+        ("part_minority_refusals", lambda v: v >= 1, 1),
+    )
+    out = []
+    for key, ok, want in riders:
+        val = new_record.get(key)
+        if not isinstance(val, (int, float)):
+            out.append({"key": key, "verdict": "skipped",
+                        "old": None, "new": val})
+        else:
+            out.append({"key": key,
+                        "verdict": "pass" if ok(val)
+                        else "regression",
+                        "old": want, "new": val})
+    return out
+
+
+_RIDERS = {"hotkey": hotkey_riders, "partition": partition_riders}
+
+
+def judge_all(directory: str, strict: bool = False) -> int:
+    """``--all``: one invocation over every record family — newest
+    pair judged pairwise AND newest-vs-best watermark, riders
+    included — printing one verdict row per family plus a combined
+    JSON summary line.  Families with fewer than two committed
+    records print ``skipped`` (that is data absence, not a
+    regression); the combined exit code is 1 when ANY family
+    regressed (or, under ``--strict``, skipped)."""
+    rows = []
+    any_fail = False
+    any_skip = False
+    for name, pattern, keys, max_regression in FAMILIES:
+        paths = all_records(directory, pattern)
+        if len(paths) < 2:
+            rows.append((name, "skipped",
+                         f"{len(paths)} record(s)"))
+            any_skip = True
+            continue
+        try:
+            records = [load_record(p) for p in paths]
+        except (OSError, ValueError) as e:
+            rows.append((name, "error", str(e)))
+            any_fail = True
+            continue
+        new_record = records[-1]
+        verdicts = judge(records[-2], new_record, keys,
+                         max_regression)
+        verdicts += judge_watermark(records[:-1], paths[:-1],
+                                    new_record, keys, max_regression)
+        rider = _RIDERS.get(name)
+        if rider:
+            verdicts += rider(new_record)
+        regressed = [v["key"] for v in verdicts
+                     if v["verdict"] == "regression"]
+        if regressed:
+            any_fail = True
+            rows.append((name, "fail", ",".join(sorted(
+                set(regressed)))))
+        else:
+            rows.append((name, "pass",
+                         f"{len(verdicts)} key verdicts, "
+                         f"new={os.path.basename(paths[-1])}"))
+    width = max(len(name) for name, _, _ in rows)
+    for name, verdict, detail in rows:
+        print(f"{name:<{width}}  {verdict:<7}  {detail}",
+              file=sys.stderr)
+    failed = any_fail or (strict and any_skip)
+    print(json.dumps({
+        "gate": "bench", "mode": "all",
+        "verdict": "fail" if failed else "pass",
+        "families": [{"family": name, "verdict": verdict,
+                      "detail": detail}
+                     for name, verdict, detail in rows],
+    }))
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Fail on a bench-record service-rate regression")
@@ -322,6 +476,15 @@ def main(argv=None) -> int:
                              "rolls, failed post-heal agreement/byte "
                              "round-trips and a refusal-free fence "
                              "all fail outright")
+    parser.add_argument("--all", action="store_true",
+                        help="judge EVERY committed record family "
+                             "(BENCH/MULTICHIP/OFFLOAD/SESSIONS/"
+                             "CAPACITY/HOTKEY/PARTITION) in --dir "
+                             "(default .) pairwise AND against its "
+                             "watermark, riders included; prints one "
+                             "verdict row per family and exits "
+                             "non-zero if any family regressed — the "
+                             "single CI entrypoint")
     parser.add_argument("--key", action="append", default=None,
                         help="record key(s) to judge (default "
                              "service_tiles_per_sec, "
@@ -345,6 +508,13 @@ def main(argv=None) -> int:
         # so the family bar is a tick-sized 50%.  Real regressions
         # (a lost tick loop, a widened suspect window) move 2-3x.
         args.max_regression = 0.50 if args.partition else 0.10
+
+    if args.all:
+        try:
+            return judge_all(args.dir or ".", strict=args.strict)
+        except OSError as e:
+            print(json.dumps({"gate": "bench", "error": str(e)}))
+            return 2
 
     if args.key:
         keys = tuple(args.key)
@@ -410,48 +580,10 @@ def main(argv=None) -> int:
         return 2
 
     if args.hotkey:
-        # Correctness rider, judged on the NEW record alone (no trend,
-        # no threshold): a single duplicate-staged plane means the
-        # digest-dedup staging contract broke.  Absent/null skips like
-        # every other key (rounds that predate the family).
-        dup = new_record.get("hotkey_duplicate_staged")
-        if not isinstance(dup, (int, float)):
-            verdicts.append({"key": "hotkey_duplicate_staged",
-                             "verdict": "skipped", "old": None,
-                             "new": dup})
-        else:
-            verdicts.append({"key": "hotkey_duplicate_staged",
-                             "verdict": ("regression" if dup > 0
-                                         else "pass"),
-                             "old": 0, "new": int(dup)})
+        verdicts += hotkey_riders(new_record)
 
     if args.partition:
-        # Correctness riders, judged on the NEW record alone (no
-        # trend, no threshold) — each is a partition-tolerance
-        # CONTRACT: the majority must never fail a request without
-        # counting it shed, the quorate side's roll must commit, the
-        # healed fleet must agree bit-exactly (manifest digest + probe
-        # owners + byte round-trip), and a fenced minority that
-        # refused nothing means the fence gates never engaged.
-        # Absent/null skips (rounds that predate the family).
-        riders = (
-            ("part_majority_5xx", lambda v: v == 0, 0),
-            ("part_roll_committed", lambda v: v == 1, 1),
-            ("part_rejoin_epoch", lambda v: v >= 2, 2),
-            ("part_postheal_agree", lambda v: v == 1, 1),
-            ("part_byte_agree", lambda v: v == 1, 1),
-            ("part_minority_refusals", lambda v: v >= 1, 1),
-        )
-        for key, ok, want in riders:
-            val = new_record.get(key)
-            if not isinstance(val, (int, float)):
-                verdicts.append({"key": key, "verdict": "skipped",
-                                 "old": None, "new": val})
-            else:
-                verdicts.append({"key": key,
-                                 "verdict": ("pass" if ok(val)
-                                             else "regression"),
-                                 "old": want, "new": val})
+        verdicts += partition_riders(new_record)
 
     regressed = [v for v in verdicts if v["verdict"] == "regression"]
     skipped = [v for v in verdicts if v["verdict"] == "skipped"]

@@ -35,16 +35,47 @@ import time
 BAR_WIDTH = 40
 
 
+def _load_bundle(path):
+    """A sentinel incident bundle directory -> one renderable doc:
+    the manifest plus whichever artifacts parse (best-effort — the
+    manifest's presence IS the bundle-complete signal, individual
+    artifacts may be null)."""
+    try:
+        with open(os.path.join(path, "manifest.json")) as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if manifest.get("kind") != "sentinel_incident":
+        return None
+    doc = {"sentinel_bundle": True, "path": path,
+           "manifest": manifest}
+    for key in ("sketch_diff", "flight", "costs"):
+        fname = (manifest.get("files") or {}).get(key)
+        if not fname:
+            continue
+        try:
+            with open(os.path.join(path, fname)) as fh:
+                doc[key] = json.load(fh)
+        except (OSError, ValueError):
+            pass
+    return doc
+
+
 def load_traces(paths, limit):
     files = []
+    bundles = []
     for p in paths:
         if os.path.isdir(p):
+            bundle = _load_bundle(p)
+            if bundle is not None:
+                bundles.append(bundle)
+                continue
             files += [os.path.join(p, f) for f in os.listdir(p)
                       if f.endswith(".json")]
         else:
             files.append(p)
     files.sort(key=lambda f: os.path.getmtime(f), reverse=True)
-    docs = []
+    docs = list(bundles)
     for f in files[:limit]:
         try:
             with open(f) as fh:
@@ -157,7 +188,13 @@ _ROBUSTNESS_KINDS = ("pressure.level", "pressure.step",
                      # epoch roll — the netsplit half of the
                      # degrade-by-choice story.
                      "quorum.fence", "quorum.restore",
-                     "epoch.propose", "epoch.commit")
+                     "epoch.propose", "epoch.commit",
+                     # Perf sentinel: confirmed drift, its forensic
+                     # capture, and the all-clear — the live
+                     # regression story on the same timeline the
+                     # incident's other events tell theirs.
+                     "sentinel.drift", "sentinel.capture",
+                     "sentinel.recovered")
 
 # Session-serving event kinds (per-session fairness sheds, viewport
 # predictions, pressure-scaled prefetch budget moves): marked with
@@ -222,6 +259,13 @@ def render_flight(doc) -> str:
                          f"/{e.get('hosts', '?')}")
             elif kind in ("epoch.propose", "epoch.commit"):
                 label = f"{kind}:v{e.get('epoch', '?')}"
+            elif kind == "sentinel.drift":
+                keys = e.get("keys")
+                label = (f"sentinel.drift:{','.join(keys)}"
+                         if isinstance(keys, list) and keys
+                         else "sentinel.drift")
+            elif kind == "sentinel.capture":
+                label = f"sentinel.capture:{e.get('dir', '?')}"
             rob_counts[label] = rob_counts.get(label, 0) + 1
         elif kind in _SESSION_KINDS:
             label = kind
@@ -301,7 +345,71 @@ def render_stats(doc) -> str:
     return "\n".join(lines)
 
 
+def render_bundle(doc) -> str:
+    """Sentinel incident bundle -> the drifted-quantile summary
+    (live vs baseline per key, worst first) above the bundle's own
+    flight timeline — "how far off normal, and what was the service
+    doing" in one read."""
+    manifest = doc.get("manifest", {})
+    lines = [
+        f"sentinel incident  member={manifest.get('member', '?')}  "
+        f"at={time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(manifest.get('ts', 0)))}"
+        f"  dir={doc.get('path', '?')}",
+    ]
+    drifting = manifest.get("drifting") or []
+    if drifting:
+        lines.append(f"  drifting: {', '.join(drifting)}")
+    if manifest.get("throughput_drift"):
+        lines.append(
+            f"  throughput: {manifest.get('tiles_per_s', '?')} "
+            f"tiles/s against watermark "
+            f"{manifest.get('watermark_tiles_per_s', '?')}")
+    keys = (doc.get("sketch_diff") or {}).get("keys") or {}
+    if keys:
+        lines.append(
+            f"  {'key':<34} {'n':>6} {'p50':>9} {'p99':>9} "
+            f"{'base p50':>9} {'base p99':>9}  drift")
+
+        def _ratio(state):
+            p99 = state.get("p99_ms")
+            base = state.get("baseline_p99_ms")
+            if isinstance(p99, (int, float)) \
+                    and isinstance(base, (int, float)) and base > 0:
+                return p99 / base
+            return 0.0
+
+        def col(v):
+            return (f"{v:>8.1f}m"
+                    if isinstance(v, (int, float)) else f"{'-':>9}")
+
+        for key in sorted(
+                keys, key=lambda k: -_ratio(keys[k].get("state")
+                                            or {})):
+            st = keys[key].get("state") or {}
+            ratio = _ratio(st)
+            tail = (f"{ratio:.2f}x" if ratio else "-") \
+                + ("  <-- DRIFTING" if st.get("drifting") else "")
+            lines.append(
+                f"  {key:<34} {st.get('n', 0):>6} "
+                f"{col(st.get('p50_ms'))} {col(st.get('p99_ms'))} "
+                f"{col(st.get('baseline_p50_ms'))} "
+                f"{col(st.get('baseline_p99_ms'))}  {tail}")
+    files = manifest.get("files") or {}
+    present = sorted(k for k, v in files.items() if v)
+    absent = sorted(k for k, v in files.items() if not v)
+    lines.append(f"  artifacts: {', '.join(present) or 'none'}"
+                 + (f"  (absent: {', '.join(absent)})" if absent
+                    else ""))
+    flight = doc.get("flight")
+    if isinstance(flight, dict) and flight.get("events"):
+        lines.append("")
+        lines.append(render_flight(flight))
+    return "\n".join(lines)
+
+
 def render_doc(doc) -> str:
+    if doc.get("sentinel_bundle"):
+        return render_bundle(doc)
     if doc.get("flight_recorder"):
         return render_flight(doc)
     if _is_stats_table(doc):

@@ -47,10 +47,14 @@ def test_bench_smoke_hot_path(capsys):
     # JSON encode (100x-1000x moves), not a 2x wobble.
     overhead = out["overhead_ns_per_op"]
     assert set(overhead) == {"trace", "ledger", "deadline",
-                             "admission", "write_behind"}
+                             "admission", "write_behind", "sentinel"}
     for name, ns in overhead.items():
         assert ns < 100_000, \
             f"hot-path overhead {name} = {ns:.0f} ns/op (budget 100µs)"
+    # The perf sentinel's named top-level copy (the record-diff key)
+    # matches the table and meets the per-op budget on its own.
+    assert out["sentinel_overhead_ns_per_op"] == overhead["sentinel"]
+    assert out["sentinel_overhead_ns_per_op"] < 100_000
 
     # Wire v3 gates (the probes ran the real split posture over a unix
     # socket with streaming + coalescing + shm ring live):
@@ -376,6 +380,45 @@ def test_bench_smoke_partition(capsys):
 
         line = capsys.readouterr().out.strip().splitlines()[-1]
         assert json.loads(line)["metric"] == "partition_smoke"
+    finally:
+        decisions.LEDGER.reset()
+        telemetry.reset()
+
+
+def test_bench_smoke_sentinel(capsys):
+    """The induced-drift sentinel gate (bench.py --smoke --sentinel):
+    a deterministic latency step on a virtual clock through a real
+    2-member fleet must yield EXACTLY ONE confirmed drift (on the
+    stepped member, never its healthy peer), EXACTLY ONE complete
+    incident bundle (manifest listing profile + flight + costs +
+    sketch diff + exemplars), one kind=sentinel ledger record, and a
+    recovery that clears the verdict — the whole confirm/capture/
+    recover cycle, with the strong assertions living inside the
+    drill itself."""
+    import bench
+    from omero_ms_image_region_tpu.utils import decisions, telemetry
+
+    telemetry.reset()
+    decisions.LEDGER.reset()
+    try:
+        t0 = time.monotonic()
+        out = bench.bench_sentinel_smoke()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 60.0, \
+            f"sentinel smoke took {elapsed:.0f}s (budget 60)"
+
+        assert out["sentinel_drift_confirms"] == 1, out
+        assert out["sentinel_drifting_member"] == "m1", out
+        assert out["sentinel_bundles"] == 1, out
+        assert set(out["sentinel_bundle_files"]) == {
+            "profile", "flight", "costs", "sketch_diff",
+            "exemplars"}, out
+        assert out["sentinel_recovered"] is True, out
+        assert out["sentinel_merged_members"] == ["m0", "m1"], out
+        assert out["sentinel_drift_keys"], out
+
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(line)["metric"] == "sentinel_smoke"
     finally:
         decisions.LEDGER.reset()
         telemetry.reset()

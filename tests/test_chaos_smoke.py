@@ -65,9 +65,16 @@ def test_chaos_smoke_invariants(capsys, tmp_path):
     assert "reason=slo-availability" in timeline
     slow_dir = os.path.join(str(tmp_path), "slow")
     slow_files = sorted(os.listdir(slow_dir))
-    with open(os.path.join(slow_dir, slow_files[0])) as f:
-        table = trace_report.render_doc(json.load(f))
-    assert "trace " in table and "#" in table
+    # Any slow dump renders as a trace header; at least ONE carries
+    # span bars.  (A request shed at admission dumps with an empty
+    # waterfall — which dump sorts first is scheduling noise, so the
+    # span-bar assertion must not pin slow_files[0].)
+    tables = []
+    for name in slow_files:
+        with open(os.path.join(slow_dir, name)) as f:
+            tables.append(trace_report.render_doc(json.load(f)))
+    assert all("trace " in t for t in tables)
+    assert any("#" in t for t in tables), tables
 
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line)["metric"] == "chaos_smoke"

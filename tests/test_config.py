@@ -836,3 +836,66 @@ def test_autoscaler_lifecycle_and_diurnal_knobs():
             "fleet": {"enabled": True, "members": 2},
             "autoscaler": {"enabled": True,
                            "unit-config": "/etc/sidecar.yaml"}})
+
+
+def test_sentinel_block_parses_and_validates():
+    """The `sentinel:` block (live perf-regression sentinel):
+    example-file values, full kebab-case parse, defaults, and every
+    validation bound — window sizes, the confirm/recover streaks,
+    the drift ratio's >1 floor, and the (0,1] fractions."""
+    from omero_ms_image_region_tpu.server.config import SentinelConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = SentinelConfig()
+    assert cfg.sentinel.enabled is True
+    assert cfg.sentinel.tick_interval_s == defaults.tick_interval_s
+    assert cfg.sentinel.confirm_ticks == defaults.confirm_ticks
+    assert cfg.sentinel.drift_ratio == defaults.drift_ratio
+    assert cfg.sentinel.bundle_dir == ""
+
+    cfg = AppConfig.from_dict({"sentinel": {
+        "enabled": True, "tick-interval-s": 2.5,
+        "confirm-ticks": 4, "recover-ticks": 2,
+        "min-samples": 16, "warmup-ticks": 5,
+        "drift-ratio": 2.0, "baseline-alpha": 0.5,
+        "throughput-floor-ratio": 0.25,
+        "bundle-dir": "/var/lib/ms/bundles", "max-bundles": 3,
+        "profile-ms": 100, "records-dir": "/srv/records"}})
+    assert cfg.sentinel.enabled is True
+    assert cfg.sentinel.tick_interval_s == 2.5
+    assert cfg.sentinel.confirm_ticks == 4
+    assert cfg.sentinel.recover_ticks == 2
+    assert cfg.sentinel.min_samples == 16
+    assert cfg.sentinel.warmup_ticks == 5
+    assert cfg.sentinel.drift_ratio == 2.0
+    assert cfg.sentinel.baseline_alpha == 0.5
+    assert cfg.sentinel.throughput_floor_ratio == 0.25
+    assert cfg.sentinel.bundle_dir == "/var/lib/ms/bundles"
+    assert cfg.sentinel.max_bundles == 3
+    assert cfg.sentinel.profile_ms == 100
+    assert cfg.sentinel.records_dir == "/srv/records"
+
+    with pytest.raises(ValueError, match="tick-interval-s"):
+        AppConfig.from_dict({"sentinel": {"tick-interval-s": 0}})
+    with pytest.raises(ValueError, match="confirm-ticks"):
+        AppConfig.from_dict({"sentinel": {"confirm-ticks": 0}})
+    with pytest.raises(ValueError, match="recover-ticks"):
+        AppConfig.from_dict({"sentinel": {"recover-ticks": 0}})
+    with pytest.raises(ValueError, match="min-samples"):
+        AppConfig.from_dict({"sentinel": {"min-samples": 0}})
+    with pytest.raises(ValueError, match="warmup-ticks"):
+        AppConfig.from_dict({"sentinel": {"warmup-ticks": 0}})
+    # A ratio at or under 1.0 calls steady state a drift.
+    with pytest.raises(ValueError, match="drift-ratio"):
+        AppConfig.from_dict({"sentinel": {"drift-ratio": 1.0}})
+    with pytest.raises(ValueError, match="baseline-alpha"):
+        AppConfig.from_dict({"sentinel": {"baseline-alpha": 0.0}})
+    with pytest.raises(ValueError, match="baseline-alpha"):
+        AppConfig.from_dict({"sentinel": {"baseline-alpha": 1.5}})
+    with pytest.raises(ValueError, match="throughput-floor-ratio"):
+        AppConfig.from_dict({"sentinel": {
+            "throughput-floor-ratio": 0.0}})
+    with pytest.raises(ValueError, match="max-bundles"):
+        AppConfig.from_dict({"sentinel": {"max-bundles": 0}})
+    with pytest.raises(ValueError, match="profile-ms"):
+        AppConfig.from_dict({"sentinel": {"profile-ms": -1}})

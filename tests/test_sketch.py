@@ -1,0 +1,190 @@
+"""utils/sketch.py — the sentinel's streaming rank sketch.
+
+Two load-bearing contracts:
+
+* bounded RELATIVE rank error — a reported quantile is within the
+  ladder's geometric-midpoint error (sqrt(ratio) - 1, ~3.6% at 32
+  buckets/decade) of the exact order statistic, across distributions
+  that actually look like latency (uniform, lognormal, exponential,
+  bimodal);
+* merge associativity — shard-then-merge in ANY grouping equals one
+  sketch fed everything, which is what makes the fleet-merged
+  ``/debug/sentinel`` view meaningful.
+"""
+
+import math
+import random
+
+import pytest
+
+from omero_ms_image_region_tpu.utils.sketch import RankSketch
+
+# Worst-case relative error of the default ladder (32 buckets/decade)
+# plus slack for rank interpolation at the sample sizes we test.
+REL_TOL = 0.06
+
+
+def _exact_quantile(values, q):
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, round(q * (len(s) - 1))))
+    return s[idx]
+
+
+def _distributions():
+    rng = random.Random(0xC0FFEE)
+    return {
+        "uniform": [rng.uniform(1.0, 400.0) for _ in range(5000)],
+        "lognormal": [rng.lognormvariate(3.0, 0.8)
+                      for _ in range(5000)],
+        "exponential": [rng.expovariate(1.0 / 25.0) + 0.5
+                        for _ in range(5000)],
+        # The shape drift actually takes: a fast mode and a slow tail
+        # mode — p50 lands in the fast mode, p90/p99 in the slow one
+        # (the 80/20 split keeps every tested rank INSIDE a mode; a
+        # rank sitting exactly on the mode boundary is a knife-edge
+        # where neighbouring order statistics differ by 10x and no
+        # quantile estimator has a meaningful relative error).
+        "bimodal": ([rng.gauss(8.0, 1.0) for _ in range(4000)]
+                    + [rng.gauss(120.0, 10.0) for _ in range(1000)]),
+    }
+
+
+class TestRankError:
+    @pytest.mark.parametrize("name", ["uniform", "lognormal",
+                                      "exponential", "bimodal"])
+    @pytest.mark.parametrize("q", [0.5, 0.9, 0.99])
+    def test_quantile_within_relative_error(self, name, q):
+        values = _distributions()[name]
+        sk = RankSketch()
+        for v in values:
+            sk.add(v)
+        got = sk.quantile(q)
+        want = _exact_quantile(values, q)
+        assert got is not None
+        # Relative bound, with absolute slack near the ladder floor
+        # where a bucket spans more of the value than REL_TOL allows.
+        assert abs(got - want) <= max(REL_TOL * want, 2.0 * sk.lo), \
+            f"{name} q={q}: sketch {got} vs exact {want}"
+
+    def test_monotone_in_q(self):
+        values = _distributions()["lognormal"]
+        sk = RankSketch()
+        for v in values:
+            sk.add(v)
+        qs = [sk.quantile(q) for q in (0.1, 0.5, 0.9, 0.99, 1.0)]
+        assert qs == sorted(qs)
+
+    def test_empty_sketch_answers_none(self):
+        sk = RankSketch()
+        assert sk.quantile(0.5) is None
+        assert sk.n == 0
+
+    def test_edge_clamping(self):
+        sk = RankSketch(lo=1.0, hi=100.0)
+        for v in (0.0001, 0.5, 1e9, 1e12):
+            sk.add(v)
+        # Underflow reports the floor, overflow the ceiling — never a
+        # value outside the ladder.
+        assert sk.quantile(0.0) == sk.lo
+        assert sk.quantile(1.0) == sk.hi
+
+
+class TestMerge:
+    def _shards(self, n_shards=3):
+        rng = random.Random(42)
+        shards = []
+        for _ in range(n_shards):
+            sk = RankSketch()
+            for _ in range(1000):
+                sk.add(rng.lognormvariate(2.5, 1.0))
+            shards.append(sk)
+        return shards
+
+    def test_merge_associative_and_commutative(self):
+        a, b, c = self._shards()
+        left = a.copy().merge(b.copy()).merge(c.copy())
+        right = a.copy().merge(b.copy().merge(c.copy()))
+        swapped = c.copy().merge(a.copy()).merge(b.copy())
+        assert left.counts == right.counts == swapped.counts
+
+    def test_merge_equals_single_feed(self):
+        rng = random.Random(7)
+        values = [rng.expovariate(0.1) for _ in range(3000)]
+        whole = RankSketch()
+        parts = [RankSketch() for _ in range(4)]
+        for i, v in enumerate(values):
+            whole.add(v)
+            parts[i % 4].add(v)
+        merged = parts[0]
+        for p in parts[1:]:
+            merged.merge(p)
+        assert merged.counts == whole.counts
+        assert merged.n == len(values)
+
+    def test_incompatible_ladder_raises(self):
+        with pytest.raises(ValueError):
+            RankSketch().merge(RankSketch(buckets_per_decade=16))
+
+    def test_ladder_is_shared(self):
+        # One tuple per parameter set — the merge contract and the
+        # per-instance memory bound both hang on this.
+        assert RankSketch().bounds is RankSketch().bounds
+
+
+class TestWire:
+    def test_doc_round_trip(self):
+        rng = random.Random(3)
+        sk = RankSketch()
+        for _ in range(500):
+            sk.add(rng.uniform(0.5, 5000.0))
+        back = RankSketch.from_doc(sk.to_doc())
+        assert back is not None
+        assert back.counts == sk.counts
+        assert back.quantile(0.99) == sk.quantile(0.99)
+
+    def test_doc_is_sparse(self):
+        sk = RankSketch()
+        sk.add(10.0)
+        doc = sk.to_doc()
+        assert len(doc["counts"]) == 1
+
+    @pytest.mark.parametrize("garbage", [
+        None, "x", 17, {"v": 2}, {"v": 1},
+        {"v": 1, "lo": "nope", "hi": 1.0, "b": 32},
+        {"v": 1, "lo": 0.01, "hi": 1e6, "b": 32,
+         "counts": {"zzz": 1}},
+    ])
+    def test_foreign_doc_parses_to_none(self, garbage):
+        assert RankSketch.from_doc(garbage) is None
+
+    def test_doc_out_of_range_buckets_dropped(self):
+        sk = RankSketch()
+        sk.add(5.0)
+        doc = sk.to_doc()
+        doc["counts"]["999999"] = 7    # truncated/foreign ladder tail
+        back = RankSketch.from_doc(doc)
+        assert back is not None
+        assert back.n == 1
+
+
+class TestValidation:
+    def test_bad_bounds_raise(self):
+        with pytest.raises(ValueError):
+            RankSketch(lo=5.0, hi=1.0)
+        with pytest.raises(ValueError):
+            RankSketch(lo=0.0, hi=1.0)
+        with pytest.raises(ValueError):
+            RankSketch(buckets_per_decade=0)
+
+    def test_reset_empties(self):
+        sk = RankSketch()
+        sk.add(1.0)
+        sk.reset()
+        assert sk.n == 0 and sk.quantile(0.5) is None
+
+    def test_relative_error_bound_matches_ladder(self):
+        # The documented bound: geometric midpoint error is
+        # sqrt(ratio) - 1 for the configured buckets/decade.
+        sk = RankSketch()
+        ratio = 10.0 ** (1.0 / sk.buckets_per_decade)
+        assert math.sqrt(ratio) - 1.0 < REL_TOL

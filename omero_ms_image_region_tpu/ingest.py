@@ -126,6 +126,32 @@ def cmd_to_ngff(args) -> int:
     return 0
 
 
+def cmd_pyramid(args) -> int:
+    """Build an unpyramided source's multiscale NGFF levels through
+    the SAME crash-safe job path the server's ``POST /pyramid`` runs
+    (``server.jobs.PyramidJobManager``): device downsample, atomic
+    per-level commits, resume-after-kill."""
+    from .server.jobs import PyramidJobManager
+
+    manager = PyramidJobManager(
+        chunk=(args.tile, args.tile), min_level_size=args.min_level,
+        compressor=(None if args.compression == "none"
+                    else args.compression))
+    try:
+        job = manager.submit(args.src)
+    except FileNotFoundError as e:
+        print(f"error: no such source: {e}", file=sys.stderr)
+        return 2
+    try:
+        manager.run_job_sync(job)
+    except Exception as e:
+        print(f"error: pyramid build failed: {e}", file=sys.stderr)
+        return 1
+    print(f"built {job.levels_done}/{job.levels_total} levels at "
+          f"{job.dest}" + (" (resumed)" if job.resumed else ""))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m omero_ms_image_region_tpu.ingest",
@@ -163,6 +189,16 @@ def main(argv=None) -> int:
     p.add_argument("--compression", choices=["none", "zlib", "gzip"],
                    default="zlib")
     p.set_defaults(fn=cmd_to_ngff)
+
+    p = sub.add_parser("pyramid",
+                       help="build multiscale NGFF levels in place "
+                            "(the server's POST /pyramid job path)")
+    p.add_argument("src")
+    p.add_argument("--tile", type=int, default=256)
+    p.add_argument("--min-level", type=int, default=256)
+    p.add_argument("--compression", choices=["none", "zlib", "gzip"],
+                   default="zlib")
+    p.set_defaults(fn=cmd_pyramid)
 
     args = parser.parse_args(argv)
     return args.fn(args)

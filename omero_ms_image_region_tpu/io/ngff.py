@@ -319,7 +319,6 @@ def write_ngff(planes: np.ndarray, root: str,
     if compressor not in _SUPPORTED_COMPRESSORS:
         raise ValueError(f"unsupported compressor {compressor!r}")
     T, C, Z, H, W = planes.shape
-    cw, ch = chunk
 
     levels = [planes]
     while True:
@@ -341,6 +340,20 @@ def write_ngff(planes: np.ndarray, root: str,
         ]))
 
     os.makedirs(root, exist_ok=True)
+    write_ngff_group_meta(root, len(levels))
+    for n, lv in enumerate(levels):
+        write_ngff_level_dir(os.path.join(root, str(n)), lv, chunk,
+                             compressor, dimension_separator)
+    return NgffZarrSource(root)
+
+
+def write_ngff_group_meta(root: str, n_levels: int) -> None:
+    """Write the group markers (``.zgroup`` + multiscales ``.zattrs``).
+
+    Split out of :func:`write_ngff` so the crash-safe pyramid job
+    (``server.jobs``) can write it LAST: :class:`NgffZarrSource` (and
+    ``find_ngff``) refuse a root without these markers, which makes the
+    ``.zattrs`` write the commit point of an incremental build."""
     with open(os.path.join(root, ".zgroup"), "w") as f:
         json.dump({"zarr_format": 2}, f)
     attrs = {
@@ -360,55 +373,70 @@ def write_ngff(planes: np.ndarray, root: str,
                      {"type": "scale",
                       "scale": [1.0, 1.0, 1.0,
                                 float(2 ** n), float(2 ** n)]}]}
-                for n in range(len(levels))
+                for n in range(n_levels)
             ],
         }]
     }
     with open(os.path.join(root, ".zattrs"), "w") as f:
         json.dump(attrs, f)
 
-    for n, lv in enumerate(levels):
-        adir = os.path.join(root, str(n))
-        os.makedirs(adir, exist_ok=True)
-        h, w = lv.shape[-2:]
-        zmeta = {
-            "zarr_format": 2,
-            "shape": [T, C, Z, h, w],
-            "chunks": [1, 1, 1, ch, cw],
-            "dtype": lv.dtype.str,
-            "compressor": ({"id": compressor} if compressor else None),
-            "order": "C",
-            "filters": None,
-            "fill_value": 0,
-            "dimension_separator": dimension_separator,
-        }
-        with open(os.path.join(adir, ".zarray"), "w") as f:
-            json.dump(zmeta, f)
-        gy, gx = -(-h // ch), -(-w // cw)
-        for t in range(T):
-            for c in range(C):
-                for z in range(Z):
-                    for y in range(gy):
-                        for x in range(gx):
-                            full = np.zeros((1, 1, 1, ch, cw), lv.dtype)
-                            part = lv[t, c, z, y * ch:(y + 1) * ch,
-                                      x * cw:(x + 1) * cw]
-                            full[0, 0, 0, :part.shape[0],
-                                 :part.shape[1]] = part
-                            raw = full.tobytes()
-                            if compressor == "zlib":
-                                raw = zlib.compress(raw, 1)
-                            elif compressor == "gzip":
-                                raw = gzip.compress(raw, 1)
-                            name = dimension_separator.join(
-                                map(str, (t, c, z, y, x)))
-                            path = os.path.join(adir, name)
-                            if dimension_separator == "/":
-                                os.makedirs(os.path.dirname(path),
-                                            exist_ok=True)
-                            with open(path, "wb") as f:
-                                f.write(raw)
-    return NgffZarrSource(root)
+
+def write_ngff_level_dir(adir: str, lv: np.ndarray,
+                         chunk: Tuple[int, int] = (256, 256),
+                         compressor: Optional[str] = "zlib",
+                         dimension_separator: str = ".") -> None:
+    """Write ONE level array ([T, C, Z, h, w]) as a zarr-v2 array dir.
+
+    Deterministic output (fixed chunk grid, zlib/gzip level 1), so two
+    writes of the same array produce identical bytes — what lets a
+    resumed pyramid build be byte-stable against its killed
+    predecessor.  The caller picks ``adir``: :func:`write_ngff` writes
+    in place, the pyramid job writes a ``.tmp`` sibling and
+    ``os.replace``s it in as the level's atomic commit."""
+    if lv.ndim != 5:
+        raise ValueError("level must be [T, C, Z, h, w]")
+    if compressor not in _SUPPORTED_COMPRESSORS:
+        raise ValueError(f"unsupported compressor {compressor!r}")
+    T, C, Z, h, w = lv.shape
+    cw, ch = chunk
+    os.makedirs(adir, exist_ok=True)
+    zmeta = {
+        "zarr_format": 2,
+        "shape": [T, C, Z, h, w],
+        "chunks": [1, 1, 1, ch, cw],
+        "dtype": lv.dtype.str,
+        "compressor": ({"id": compressor} if compressor else None),
+        "order": "C",
+        "filters": None,
+        "fill_value": 0,
+        "dimension_separator": dimension_separator,
+    }
+    with open(os.path.join(adir, ".zarray"), "w") as f:
+        json.dump(zmeta, f)
+    gy, gx = -(-h // ch), -(-w // cw)
+    for t in range(T):
+        for c in range(C):
+            for z in range(Z):
+                for y in range(gy):
+                    for x in range(gx):
+                        full = np.zeros((1, 1, 1, ch, cw), lv.dtype)
+                        part = lv[t, c, z, y * ch:(y + 1) * ch,
+                                  x * cw:(x + 1) * cw]
+                        full[0, 0, 0, :part.shape[0],
+                             :part.shape[1]] = part
+                        raw = full.tobytes()
+                        if compressor == "zlib":
+                            raw = zlib.compress(raw, 1)
+                        elif compressor == "gzip":
+                            raw = gzip.compress(raw, 1)
+                        name = dimension_separator.join(
+                            map(str, (t, c, z, y, x)))
+                        path = os.path.join(adir, name)
+                        if dimension_separator == "/":
+                            os.makedirs(os.path.dirname(path),
+                                        exist_ok=True)
+                        with open(path, "wb") as f:
+                            f.write(raw)
 
 
 def find_ngff(d: str) -> Optional[str]:

@@ -231,6 +231,18 @@ class PixelsService:
             self._gc_and_drain()
         return src
 
+    def invalidate(self, image_id: int) -> None:
+        """Drop a cached open handle so the next request re-sniffs the
+        image directory.  The pyramid job calls this after committing
+        an NGFF group: the sniff order prefers it, but an LRU-resident
+        pre-build source would otherwise keep serving unpyramided."""
+        with self._lock:
+            src = self._open.pop(image_id, None)
+            if src is not None:
+                # Deferred close — a concurrent reader may be mid-read.
+                self._evicted.append(src)
+            self._drain_evicted_locked()
+
     def close(self) -> None:
         with self._lock:
             for src in self._open.values():

@@ -16,6 +16,10 @@ always operate on the unpacked bit grid, which is what the un-aligned path
 
 from __future__ import annotations
 
+import functools
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..models.mask import Mask
@@ -103,3 +107,115 @@ def overlay_masks_batch(base_rgba: np.ndarray,
     out[..., :3] = ((base[..., :3] * ia + fill_rgb * a + 127)
                     // 255).astype(np.uint8)
     return out
+
+
+# --------------------------------------------------------------- device path
+#
+# Batched device rasterization (the PR 20 workloads plane).  The contract
+# is BYTE IDENTITY with the host path above: the device kernel produces
+# the exact 0/1 grid ``rasterize_mask`` produces (same MSB-first unpack,
+# same flip semantics), and the caller feeds it to the identical
+# ``codecs.encode_mask_png`` tail — so the served PNG bytes cannot
+# diverge between paths.  Integer-only ops throughout; nothing here can
+# drift with accelerator float semantics.
+
+def packed_nbytes(width: int, height: int) -> int:
+    """Packed payload bytes one mask needs (bits continuous across rows)."""
+    return (width * height + 7) // 8
+
+
+def pack_mask_payload(data: bytes, width: int, height: int) -> np.ndarray:
+    """Validate + normalize one packed payload to exactly ``packed_nbytes``
+    (the host path's size check; over-long payloads carry unused trailing
+    bits the unpack slices off anyway)."""
+    need = packed_nbytes(width, height)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if buf.size * 8 < width * height:
+        raise ValueError(
+            f"Mask payload too small: {buf.size * 8} bits "
+            f"< {width}x{height}")
+    return buf[:need]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("width", "height", "flip_horizontal",
+                              "flip_vertical"))
+def _rasterize_batch_jit(packed, width: int, height: int,
+                         flip_horizontal: bool, flip_vertical: bool):
+    """u8[B, nbytes] packed -> u8[B, H, W] 0/1 grids, on device.
+
+    MSB-first unpack (``jnp.unpackbits`` default) matches
+    ``np.unpackbits`` bit-for-bit; flips are static so each (shape,
+    flips) group compiles once, the ``ops.flip`` idiom."""
+    bits = jnp.unpackbits(packed, axis=-1)
+    grids = bits[:, : width * height].reshape(-1, height, width)
+    axes = []
+    if flip_vertical:
+        axes.append(1)
+    if flip_horizontal:
+        axes.append(2)
+    if axes:
+        grids = jnp.flip(grids, axis=tuple(axes))
+    return grids
+
+
+def rasterize_packed_batch(packed: np.ndarray, width: int, height: int,
+                           flip_horizontal: bool = False,
+                           flip_vertical: bool = False) -> np.ndarray:
+    """Rasterize a stacked batch of same-shape packed masks on device.
+
+    Args:
+      packed: u8[B, packed_nbytes(width, height)] (see
+        ``pack_mask_payload``)
+    Returns u8[B, H, W] 0/1 grids, host-resident, byte-identical to
+    running ``unpack_mask_bits`` + ``flip_mask`` per member.
+    """
+    if (flip_horizontal or flip_vertical) and (width == 0 or height == 0):
+        raise ValueError("Attempted to flip image with 0 size")
+    out = _rasterize_batch_jit(np.ascontiguousarray(packed), width,
+                               height, flip_horizontal, flip_vertical)
+    return np.asarray(out)
+
+
+def rasterize_mask_device(mask: Mask, color=None,
+                          flip_horizontal: bool = False,
+                          flip_vertical: bool = False) -> tuple:
+    """Device twin of ``rasterize_mask`` — same (grid, palette) contract,
+    one-mask batch.  Exists for the parity tests and the non-batched
+    callers; the serving path batches through
+    ``server.batcher.BatchingRenderer.rasterize_mask``."""
+    fill = mask.resolved_fill_color(color)
+    packed = pack_mask_payload(mask.bytes_, mask.width, mask.height)
+    grid = rasterize_packed_batch(packed[None, :], mask.width,
+                                  mask.height, flip_horizontal,
+                                  flip_vertical)[0]
+    palette = np.array([(0, 0, 0, 0), fill], dtype=np.uint8)
+    return grid.astype(np.uint8), palette
+
+
+@jax.jit
+def _overlay_batch_jit(base_rgba, mask_grids, fills):
+    """The ``overlay_masks_batch`` integer blend, verbatim, in jnp:
+    ``(base*(255-a) + fill*a + 127) // 255`` with
+    ``a = (mask != 0) * fill_alpha`` — uint32 throughout, so the device
+    result is bit-equal to the host/native kernels."""
+    a = ((mask_grids != 0).astype(jnp.uint32)
+         * fills[:, None, None, 3].astype(jnp.uint32))[..., None]
+    ia = 255 - a
+    base = base_rgba.astype(jnp.uint32)
+    fill_rgb = fills[:, None, None, :3].astype(jnp.uint32)
+    rgb = ((base[..., :3] * ia + fill_rgb * a + 127) // 255) \
+        .astype(jnp.uint8)
+    return jnp.concatenate([rgb, base_rgba[..., 3:]], axis=-1)
+
+
+def overlay_masks_device(base_rgba: np.ndarray,
+                         mask_grids: np.ndarray,
+                         fills: np.ndarray) -> np.ndarray:
+    """Device twin of ``overlay_masks_batch`` (same shapes, bit-equal
+    output) — the overlay endpoint's one-dispatch composite."""
+    out = _overlay_batch_jit(
+        np.ascontiguousarray(base_rgba, dtype=np.uint8),
+        np.ascontiguousarray(mask_grids, dtype=np.uint8),
+        np.ascontiguousarray(fills, dtype=np.uint8))
+    return np.asarray(out)

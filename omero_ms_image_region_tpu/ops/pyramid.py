@@ -1,0 +1,72 @@
+"""Batched device downsampling for on-TPU pyramid builds (PR 20).
+
+The serving stack's host reduction is ``io.store._downsample2``:
+mean-pool by 2 in float64, ``np.round`` for integer dtypes, cast back.
+The device kernel here reproduces it BIT-FOR-BIT for the storage dtypes
+the pyramid job handles (integer, itemsize <= 2): a 2x2 sum of uint16
+values is <= 4 * 65535 = 262140 < 2^24, so the int32 accumulate is
+exact, the divide-by-4 is a power-of-two float32 scale (exact), and
+``jnp.round`` is round-half-to-even exactly like ``np.round``.  Wider
+or floating dtypes fall back to the host formula — correctness over
+residency for the long tail.
+
+That exactness is the crash-safety contract's foundation: a killed and
+resumed build re-derives byte-identical levels because every reduction
+is deterministic integer math, never accelerator float accumulation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _mean2_int_jit(v):
+    """int32[N, 2h, 2w] -> f32[N, h, w] rounded 2x2 means (exact for
+    sums < 2^24; see module docstring)."""
+    s = (v[:, 0::2, 0::2].astype(jnp.int32)
+         + v[:, 0::2, 1::2] + v[:, 1::2, 0::2] + v[:, 1::2, 1::2])
+    return jnp.round(s.astype(jnp.float32) / 4.0)
+
+
+def _device_exact(dtype: np.dtype) -> bool:
+    return np.issubdtype(dtype, np.integer) and dtype.itemsize <= 2
+
+
+def downsample2_batch(planes: np.ndarray) -> np.ndarray:
+    """Mean-pool a stack of planes by 2: [..., H, W] -> [..., H//2, W//2].
+
+    Matches ``io.store._downsample2`` bit-for-bit per plane (including
+    its tiny-plane guard: a dimension that cannot halve collapses the
+    plane to [..., 1, 1]).  Integer dtypes up to 16 bits take ONE
+    batched device dispatch; everything else computes the host formula
+    vectorized over the batch.
+    """
+    *lead, H, W = planes.shape
+    h, w = H // 2, W // 2
+    if h < 1 or w < 1:
+        return np.ascontiguousarray(planes[..., :1, :1])
+    v = planes.reshape(-1, H, W)[:, : h * 2, : w * 2]
+    if _device_exact(planes.dtype):
+        out = np.asarray(_mean2_int_jit(v.astype(np.int32)))
+        out = out.astype(planes.dtype)
+    else:
+        m = v.astype(np.float64).reshape(-1, h, 2, w, 2).mean(axis=(2, 4))
+        if np.issubdtype(planes.dtype, np.integer):
+            m = np.round(m)
+        out = m.astype(planes.dtype)
+    return out.reshape(*lead, h, w)
+
+
+def n_pyramid_levels(height: int, width: int,
+                     min_level_size: int = 256) -> int:
+    """How many levels a full build yields — the ``io.ngff.write_ngff``
+    halving rule (halve while ``min(h//2, w//2) >= min_level_size``),
+    so job plans and the writer can never disagree on level count."""
+    n, h, w = 1, height, width
+    while min(h // 2, w // 2) >= min_level_size:
+        h, w = h // 2, w // 2
+        n += 1
+    return n

@@ -689,7 +689,33 @@ def create_app(config: Optional[AppConfig] = None,
                 admission=admission, base_services=services)
         else:
             image_handler = ImageRegionHandler(services)
-        mask_handler = ShapeMaskHandler(services)
+        mask_handler = ShapeMaskHandler(
+            services, device_masks=config.workloads.device_masks)
+
+    # Device workloads plane (deploy/DEPLOY.md "Device workloads"):
+    # overlay composites + animation strips compose the SAME image
+    # handler the plain routes run, and the pyramid job subsystem
+    # builds NGFF levels in the background over the bulk QoS class.
+    # Combined role only — a proxy frontend's sidecars own the device.
+    workloads_handler = None
+    jobs_manager = None
+    if services is not None:
+        if config.workloads.overlay_enabled \
+                or config.workloads.animation_enabled:
+            from .handler import WorkloadsHandler
+            workloads_handler = WorkloadsHandler(
+                image_handler, services,
+                max_frames=config.workloads.animation_max_frames)
+        if config.pyramid.enabled:
+            from .jobs import PyramidJobManager
+            jobs_manager = PyramidJobManager(
+                pixels_service=services.pixels_service,
+                chunk=(config.pyramid.chunk, config.pyramid.chunk),
+                min_level_size=config.pyramid.min_level_size,
+                compressor=(None
+                            if config.pyramid.compressor == "none"
+                            else config.pyramid.compressor),
+                defer_poll_s=config.pyramid.defer_poll_s)
 
     # Self-preservation layer (deploy/DEPLOY.md "Overload & rolling
     # restarts"): the pressure governor + brownout ladder and the
@@ -1313,6 +1339,187 @@ def create_app(config: Optional[AppConfig] = None,
                 log.debug("peer mask put failed", exc_info=True)
         _stamp_provenance(ctx, headers)
         return web.Response(body=body, headers=headers)
+
+    async def render_overlay(request: web.Request) -> web.Response:
+        """Region pixels + ROI mask composite in ONE device pass
+        (deploy/DEPLOY.md "Device workloads").  ``?shapes=<id,id,...>``
+        names the masks (request order = paint order), ``?color=``
+        overrides fills; the base render is FORCED lossless (png) so
+        the composite never bakes JPEG artifacts under the mask.  The
+        ETag identity folds the base render's cache key with the shape
+        list + color override — edge caching works exactly like the
+        plain routes."""
+        if workloads_handler is None \
+                or not config.workloads.overlay_enabled:
+            return web.Response(status=404)
+        params = _params_of(request)
+        shapes_raw = params.pop("shapes", "")
+        color = params.pop("color", None)
+        params["format"] = "png"
+        try:
+            shape_ids = [int(s) for s in shapes_raw.split(",") if s]
+        except ValueError:
+            return web.Response(
+                status=400,
+                text=f"Incorrect format for shapes '{shapes_raw}'")
+        if not shape_ids:
+            return web.Response(
+                status=400, text="overlay needs ?shapes=<id,id,...>")
+        try:
+            ctx = ImageRegionCtx.from_params(
+                params, await require_session_key(request))
+        except _NoSession:
+            return web.Response(status=403)
+        except BadRequestError as e:
+            return web.Response(status=400, text=str(e))
+        request["prov_ctx"] = ctx
+        headers = {"Content-Type": "image/png"}
+        identity = (f"{ctx.cache_key}:ov:"
+                    + ",".join(str(s) for s in shape_ids)
+                    + f":{color or ''}")
+        etag = await _cache_headers(headers, identity, "Image",
+                                    ctx.image_id)
+        renderless = await _conditional_answer(
+            request, headers, etag,
+            _can_revalidate("Image", ctx.image_id,
+                            ctx.omero_session_key))
+        if renderless is not None:
+            provenance.mark(ctx, tier="304")
+            return renderless
+        try:
+            body = await workloads_handler.render_overlay(
+                ctx, shape_ids, color=color)
+        except Exception as e:
+            return _status_of(e)
+        _strip_cache_headers_if_degraded(ctx, headers)
+        _stamp_provenance(ctx, headers)
+        return web.Response(body=body, headers=headers)
+
+    async def render_animation(request: web.Request) -> web.Response:
+        """A z/t frame range rendered as ONE batched device job and
+        streamed in order: ``FRME`` + u32be length + frame bytes per
+        frame over chunked transport.  ``?axis=z|t`` picks the scrub
+        axis, ``?frames=N`` the strip length starting at the URL's
+        theZ/theT.  The FIRST frame is awaited before headers leave,
+        so every pre-body failure keeps the unary status contract; a
+        client disconnect mid-stream closes the generator, which
+        cancels every frame still queued on the device."""
+        if workloads_handler is None \
+                or not config.workloads.animation_enabled:
+            return web.Response(status=404)
+        params = _params_of(request)
+        axis = (params.pop("axis", "t") or "t").lower()
+        if axis not in ("z", "t"):
+            return web.Response(
+                status=400,
+                text=f"Incorrect format for axis '{axis}'")
+        frames_raw = params.pop("frames", "2")
+        try:
+            n_frames = int(frames_raw)
+        except ValueError:
+            return web.Response(
+                status=400,
+                text=f"Incorrect format for frames '{frames_raw}'")
+        if n_frames < 1:
+            return web.Response(status=400,
+                                text="frames must be >= 1")
+        axis_key = "theZ" if axis == "z" else "theT"
+        try:
+            # Per-frame ctxs re-parse the SAME params with only the
+            # scrub coordinate changed, so each frame shares identity
+            # (cache key, byte tiers, single-flight) with the plain
+            # tile route serving that plane.
+            skey = await require_session_key(request)
+            start = int(params.get(axis_key) or 0)
+            frame_ctxs = []
+            for i in range(n_frames):
+                fparams = dict(params)
+                fparams[axis_key] = str(start + i)
+                frame_ctxs.append(
+                    ImageRegionCtx.from_params(fparams, skey))
+        except _NoSession:
+            return web.Response(status=403)
+        except BadRequestError as e:
+            return web.Response(status=400, text=str(e))
+        request["prov_ctx"] = frame_ctxs[0]
+        # A stream of frames has no single stable body: never
+        # edge-cached (each FRAME's bytes stay cacheable through the
+        # plain route's identity).
+        headers = {
+            "Content-Type": "application/x-image-region-animation",
+            "Cache-Control": "no-store",
+        }
+        agen = workloads_handler.render_animation_stream(frame_ctxs)
+        try:
+            first = await agen.__anext__()
+        except StopAsyncIteration:
+            first = b""
+        except Exception as e:
+            return _status_of(e)
+        resp = web.StreamResponse(headers=headers)
+        nbytes = 0
+        try:
+            await resp.prepare(request)
+            if first:
+                await resp.write(first)
+                nbytes += len(first)
+            async for chunk in agen:
+                await resp.write(chunk)
+                nbytes += len(chunk)
+            await resp.write_eof()
+        except ConnectionResetError:
+            # The viewer left mid-animation: stop writing; closing
+            # the generator (finally below) cancels the frames still
+            # queued on the device.
+            request["streamed_nbytes"] = nbytes
+            log.debug("animation client disconnected mid-stream")
+            return resp
+        except Exception:
+            request["streamed_nbytes"] = nbytes
+            log.warning("animation stream truncated mid-body",
+                        exc_info=True)
+            raise
+        finally:
+            await agen.aclose()
+        request["streamed_nbytes"] = nbytes
+        return resp
+
+    async def pyramid_submit(request: web.Request) -> web.Response:
+        """``POST /pyramid`` ``{"imageId": N}`` (or ``{"path": dir}``):
+        queue a background on-device pyramid build.  Idempotent — an
+        unfinished job for the same destination is returned as-is.
+        Answers 202 + the job document; poll ``GET /pyramid/{jobId}``."""
+        if jobs_manager is None:
+            return web.Response(status=404)
+        try:
+            doc = await request.json()
+        except Exception:
+            return web.Response(status=400, text="body must be JSON")
+        if not isinstance(doc, dict) \
+                or (doc.get("imageId") is None and not doc.get("path")):
+            return web.Response(
+                status=400,
+                text='body needs {"imageId": N} or {"path": dir}')
+        try:
+            if doc.get("imageId") is not None:
+                job = jobs_manager.submit_image(int(doc["imageId"]))
+            else:
+                job = jobs_manager.submit(str(doc["path"]))
+        except FileNotFoundError:
+            return web.Response(status=404)
+        except (ValueError, TypeError) as e:
+            return web.Response(status=400, text=str(e))
+        return web.json_response(job.to_doc(), status=202)
+
+    async def pyramid_status(request: web.Request) -> web.Response:
+        """Job-state read: memory first, then the crash-safe sidecar
+        (a restarted server still answers for jobs it ran before)."""
+        if jobs_manager is None:
+            return web.Response(status=404)
+        job = jobs_manager.get(request.match_info["jobId"])
+        if job is None:
+            return web.Response(status=404)
+        return web.json_response(job.to_doc())
 
     def _finish_request(route: str, status: int, nbytes: int,
                         total_ms: float, trace,
@@ -2172,6 +2379,9 @@ def create_app(config: Optional[AppConfig] = None,
         if sentinel_engine is not None:
             tasks.append(asyncio.create_task(
                 sentinel_engine.run(), name="perf-sentinel"))
+        if jobs_manager is not None:
+            tasks.append(asyncio.create_task(
+                jobs_manager.run(), name="pyramid-jobs"))
         app[_ROBUSTNESS_TASKS_KEY] = tasks
 
     app.on_startup.append(on_startup_robustness)
@@ -2192,6 +2402,20 @@ def create_app(config: Optional[AppConfig] = None,
                        traced_mask)
     app.router.add_get("/webgateway/render_shape_mask/{shapeId}/{tail:.*}",
                        traced_mask)
+    # Device-workloads routes (registered unconditionally — a disabled
+    # or proxy deployment answers 404 from the handler, so the route
+    # table never depends on config).
+    traced_overlay = _observed("render_overlay", render_overlay)
+    traced_animation = _observed("render_animation", render_animation)
+    overlay_base = "/webgateway/render_overlay/{imageId}/{theZ}/{theT}"
+    app.router.add_get(overlay_base, traced_overlay)
+    app.router.add_get(overlay_base + "/{tail:.*}", traced_overlay)
+    anim_base = "/webgateway/render_animation/{imageId}/{theZ}/{theT}"
+    app.router.add_get(anim_base, traced_animation)
+    app.router.add_get(anim_base + "/{tail:.*}", traced_animation)
+    app.router.add_post("/pyramid",
+                        _observed("pyramid_submit", pyramid_submit))
+    app.router.add_get("/pyramid/{jobId}", pyramid_status)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", readyz)
@@ -2213,7 +2437,7 @@ def create_app(config: Optional[AppConfig] = None,
                    or (services.admission if services is not None
                        else None)),
         proxy_client=(client if proxy_mode else None),
-        federation_coord=federation_coord))
+        federation_coord=federation_coord, jobs=jobs_manager))
     app.router.add_get("/admin/drain", admin_drain)
     app.router.add_post("/admin/drain", admin_drain)
     app.router.add_post("/admin/undrain", admin_undrain)

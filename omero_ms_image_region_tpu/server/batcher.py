@@ -69,6 +69,8 @@ def _key_label(key: tuple) -> str:
     prefix only (channels x bucket), never the settings scalars."""
     if key and key[0] == "jpeg":
         return "jpeg:" + "x".join(str(v) for v in key[1:4])
+    if key and key[0] == "mask":
+        return "mask:" + "x".join(str(v) for v in key[1:3])
     return "x".join(str(v) for v in key[:3])
 
 
@@ -471,6 +473,31 @@ class BatchingRenderer:
                            deadline=_deadline())
         return await self._enqueue(key, pending)
 
+    async def rasterize_mask(self, packed: np.ndarray, width: int,
+                             height: int, flip_horizontal: bool,
+                             flip_vertical: bool) -> np.ndarray:
+        """Batched device mask rasterization (PR 20 leg 1): u8[nbytes]
+        packed mask bits -> u8[H, W] 0/1 grid, byte-identical to the
+        host ``ops.maskops`` unpack+flip (the PNG tail is shared, so
+        the served bytes cannot diverge).
+
+        Same-shape masks coalesce into one device dispatch through the
+        ordinary group path — the (shape, flips) key bounds the compile
+        set exactly like the spatial buckets bound the tile kernels.
+        ``packed`` must be normalized to ``maskops.packed_nbytes``
+        (``maskops.pack_mask_payload``) so group members stack."""
+        key = ("mask", width, height,
+               bool(flip_horizontal), bool(flip_vertical))
+        from ..utils.transient import deadline as _deadline
+        pending = _Pending(raw=packed,
+                           settings={"fh": bool(flip_horizontal),
+                                     "fv": bool(flip_vertical)},
+                           h=height, w=width,
+                           future=asyncio.get_running_loop().create_future(),
+                           trace_id=telemetry.current_trace_id(),
+                           deadline=_deadline())
+        return await self._enqueue(key, pending)
+
     async def _enqueue(self, key: tuple, pending: _Pending):
         pending.t_enqueue = time.perf_counter()
         queue = self._queues.get(key)
@@ -617,8 +644,12 @@ class BatchingRenderer:
             telemetry.FLIGHT.record(
                 "batch.formed", key=_key_label(key), tiles=len(group),
                 queued=len(queue), inflight=len(self._inflight))
-            render = (self._render_group_jpeg if key[0] == "jpeg"
-                      else self._render_group)
+            if key[0] == "jpeg":
+                render = self._render_group_jpeg
+            elif key[0] == "mask":
+                render = self._render_group_mask
+            else:
+                render = self._render_group
             task = asyncio.create_task(
                 self._run_group(render, group, slots, key))
             self._inflight.add(task)
@@ -775,6 +806,40 @@ class BatchingRenderer:
             fields["staged_bytes"] = staged_bytes / n
         telemetry.add_costs(fields)
         return raw, stack
+
+    def _render_group_mask(self, group: List[_Pending]
+                           ) -> List[np.ndarray]:
+        """One batched device dispatch for a (shape, flips) mask group.
+
+        The batch pads to a power of two (repeating the last member)
+        exactly like the tile groups, so the compile set stays bounded
+        by (shape, flips, pow2-batch) — and the kernel output is the
+        identical 0/1 grid the host rasterizer produces, member for
+        member."""
+        from ..ops.maskops import rasterize_packed_batch
+        n = len(group)
+        B = _pad_batch_size(n, self.max_batch)
+        padded = group + [group[-1]] * (B - n)
+        packed = np.stack([p.raw for p in padded])
+        _, width, height, fh, fv = self._mask_key_of(group)
+        from ..io.staging import pin_scope
+        with self._device_gate, pin_scope(self.device):
+            t0 = time.perf_counter()
+            with stopwatch("Renderer.rasterizeMask.batch"):
+                grids = rasterize_packed_batch(packed, width, height,
+                                               fh, fv)
+            exec_ms = (time.perf_counter() - t0) * 1000.0
+        telemetry.add_cost("device_ms", exec_ms / max(1, n))
+        self._count_batch(n)
+        return [grids[i] for i in range(n)]
+
+    def _mask_key_of(self, group: List[_Pending]) -> tuple:
+        p = group[0]
+        # h/w carry the mask shape; flips are re-derived from nothing —
+        # the dispatcher hands the key to the render fn only via the
+        # group, so stash flips on settings at enqueue instead.
+        return ("mask", p.w, p.h, bool(p.settings.get("fh")),
+                bool(p.settings.get("fv")))
 
     def _render_group(self, group: List[_Pending]) -> List[np.ndarray]:
         n = len(group)

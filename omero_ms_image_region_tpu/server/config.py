@@ -471,9 +471,13 @@ class LoadModelConfig:
     # Diurnal intensity: session starts bunch toward the peak of a
     # half-sine "day" (0 = flat arrivals, toward 1 = sharp peak).
     diurnal_amplitude: float = 0.6
-    # Request-class mix (remainder is interactive tiles).
+    # Request-class mix (remainder is interactive tiles).  pyramid =
+    # a build-job submission (bulk, rare); animation = a z/t strip
+    # stream (PR 20 workload classes).
     bulk_fraction: float = 0.02
     mask_fraction: float = 0.0
+    pyramid_fraction: float = 0.0
+    animation_fraction: float = 0.0
     # Fraction of pan steps that change zoom level.
     zoom_fraction: float = 0.05
     # Trending-traffic skew: each session picks its image from a
@@ -482,6 +486,49 @@ class LoadModelConfig:
     # on image rank 0 — the pre-skew stream, bit-exact.
     skew: float = 0.0
     image_population: int = 1
+
+
+@dataclass
+class WorkloadsConfig:
+    """Device-workloads plane (PR 20): the batched mask rasterizer,
+    the overlay-composite endpoint, and the z/t animation streamer.
+    See deploy/DEPLOY.md "Device workloads"."""
+
+    # Route mask rasterization through the renderer's batched device
+    # group path when the wired renderer has one (byte-identical to
+    # the host rasterizer by contract; off = host path everywhere).
+    device_masks: bool = True
+    # Serve GET /webgateway/render_overlay (region + ROI mask
+    # composite in one device pass).
+    overlay_enabled: bool = True
+    # Serve GET /webgateway/render_animation (z/t strip streamed as
+    # ordered length-prefixed frames over chunked transport).
+    animation_enabled: bool = True
+    # Hard cap on frames per animation request (each frame is a full
+    # region render; the cap bounds what one URL can pin).
+    animation_max_frames: int = 64
+
+
+@dataclass
+class PyramidConfig:
+    """Crash-safe background pyramid builds (``server.jobs``): POST
+    /pyramid queues a device-downsampled NGFF build for an unpyramided
+    source; ``ingest.py pyramid`` drives the same code path from the
+    CLI.  See deploy/DEPLOY.md "Device workloads"."""
+
+    # Serve POST /pyramid + GET /pyramid/{jobId} and run the
+    # background job runner.
+    enabled: bool = True
+    # NGFF chunk edge (pixels) for written levels.
+    chunk: int = 256
+    # Stop halving when the next level's min dimension would fall
+    # below this (the store/ngff writers' shared rule).
+    min_level_size: int = 256
+    # Chunk codec for written levels: zlib | gzip | none.
+    compressor: str = "zlib"
+    # Poll cadence while a build is parked behind the shed_bulk
+    # pressure step (bulk class never starves interactive).
+    defer_poll_s: float = 0.25
 
 
 @dataclass
@@ -851,6 +898,10 @@ class AppConfig:
     sessions: SessionsConfig = field(default_factory=SessionsConfig)
     loadmodel: LoadModelConfig = field(
         default_factory=LoadModelConfig)
+    workloads: WorkloadsConfig = field(
+        default_factory=WorkloadsConfig)
+    pyramid: PyramidConfig = field(
+        default_factory=PyramidConfig)
     autoscaler: AutoscalerConfig = field(
         default_factory=AutoscalerConfig)
     qos: QosConfig = field(default_factory=QosConfig)
@@ -1306,6 +1357,10 @@ class AppConfig:
                 "bulk-fraction", lm_defaults.bulk_fraction)),
             mask_fraction=float(lm.get(
                 "mask-fraction", lm_defaults.mask_fraction)),
+            pyramid_fraction=float(lm.get(
+                "pyramid-fraction", lm_defaults.pyramid_fraction)),
+            animation_fraction=float(lm.get(
+                "animation-fraction", lm_defaults.animation_fraction)),
             zoom_fraction=float(lm.get(
                 "zoom-fraction", lm_defaults.zoom_fraction)),
             skew=float(lm.get("skew", lm_defaults.skew)),
@@ -1326,6 +1381,7 @@ class AppConfig:
             raise ValueError("loadmodel.diurnal-amplitude must be in "
                              "[0, 1)")
         for name in ("bulk_fraction", "mask_fraction",
+                     "pyramid_fraction", "animation_fraction",
                      "zoom_fraction"):
             v = getattr(cfg.loadmodel, name)
             if not 0.0 <= v <= 1.0:
@@ -1333,15 +1389,55 @@ class AppConfig:
                     f"loadmodel.{name.replace('_', '-')} must be in "
                     f"[0, 1]")
         if (cfg.loadmodel.bulk_fraction
-                + cfg.loadmodel.mask_fraction) > 1.0:
+                + cfg.loadmodel.mask_fraction
+                + cfg.loadmodel.pyramid_fraction
+                + cfg.loadmodel.animation_fraction) > 1.0:
             raise ValueError("loadmodel bulk-fraction + mask-fraction "
-                             "must be <= 1")
+                             "+ pyramid-fraction + animation-fraction "
+                             "must sum to <= 1")
         if cfg.loadmodel.skew < 0:
             raise ValueError("loadmodel.skew must be >= 0 "
                              "(0 = every session on one image)")
         if cfg.loadmodel.image_population < 1:
             raise ValueError("loadmodel.image-population must be "
                              ">= 1")
+        wl = raw.get("workloads", {}) or {}
+        wl_defaults = WorkloadsConfig()
+        cfg.workloads = WorkloadsConfig(
+            device_masks=bool(wl.get("device-masks",
+                                     wl_defaults.device_masks)),
+            overlay_enabled=bool(wl.get("overlay-enabled",
+                                        wl_defaults.overlay_enabled)),
+            animation_enabled=bool(wl.get(
+                "animation-enabled", wl_defaults.animation_enabled)),
+            animation_max_frames=int(wl.get(
+                "animation-max-frames",
+                wl_defaults.animation_max_frames)),
+        )
+        if cfg.workloads.animation_max_frames < 1:
+            raise ValueError("workloads.animation-max-frames must be "
+                             ">= 1")
+        py = raw.get("pyramid", {}) or {}
+        py_defaults = PyramidConfig()
+        cfg.pyramid = PyramidConfig(
+            enabled=bool(py.get("enabled", py_defaults.enabled)),
+            chunk=int(py.get("chunk", py_defaults.chunk)),
+            min_level_size=int(py.get("min-level-size",
+                                      py_defaults.min_level_size)),
+            compressor=str(py.get("compressor",
+                                  py_defaults.compressor)),
+            defer_poll_s=float(py.get("defer-poll-s",
+                                      py_defaults.defer_poll_s)),
+        )
+        if cfg.pyramid.chunk < 16:
+            raise ValueError("pyramid.chunk must be >= 16")
+        if cfg.pyramid.min_level_size < 1:
+            raise ValueError("pyramid.min-level-size must be >= 1")
+        if cfg.pyramid.compressor not in ("zlib", "gzip", "none"):
+            raise ValueError("pyramid.compressor must be zlib, gzip, "
+                             "or none")
+        if cfg.pyramid.defer_poll_s <= 0:
+            raise ValueError("pyramid.defer-poll-s must be > 0")
         au = raw.get("autoscaler", {}) or {}
         au_defaults = AutoscalerConfig()
         cfg.autoscaler = AutoscalerConfig(

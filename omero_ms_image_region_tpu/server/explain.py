@@ -21,13 +21,24 @@ import re
 from typing import Dict, Optional
 from urllib.parse import parse_qsl, urlsplit
 
-from .ctx import BadRequestError, ImageRegionCtx
+from .ctx import BadRequestError, ImageRegionCtx, ShapeMaskCtx
 
 # The served render routes (app.py registers the same shapes); the
 # trailing tail aliases exactly like the real router's ``{tail:.*}``.
 _ROUTE_RE = re.compile(
     r"^/(?:webgateway|webclient)/"
     r"(?:render_image_region|render_image)/"
+    r"(?P<imageId>\d+)/(?P<theZ>\d+)/(?P<theT>\d+)(?:/.*)?$")
+
+# The PR 20 device-workloads routes + the mask route: the explain
+# plane must resolve every URL the server actually renders.
+_MASK_ROUTE_RE = re.compile(
+    r"^/webgateway/render_shape_mask/(?P<shapeId>\d+)(?:/.*)?$")
+_OVERLAY_ROUTE_RE = re.compile(
+    r"^/webgateway/render_overlay/"
+    r"(?P<imageId>\d+)/(?P<theZ>\d+)/(?P<theT>\d+)(?:/.*)?$")
+_ANIMATION_ROUTE_RE = re.compile(
+    r"^/webgateway/render_animation/"
     r"(?P<imageId>\d+)/(?P<theZ>\d+)/(?P<theT>\d+)(?:/.*)?$")
 
 _EXPLAIN_TIMEOUT_S = 2.0
@@ -52,6 +63,32 @@ def parse_render_path(path: str) -> Dict[str, str]:
     params.pop("tail", None)
     params.update(m.groupdict())
     return params
+
+
+def classify_render_path(path: str):
+    """``(kind, params)`` for ANY served render route: ``image``,
+    ``mask``, ``overlay`` or ``animation``.  ``parse_render_path``
+    keeps its image-only contract (callers pin it); this is the
+    explain plane's full-route front door."""
+    if not path or not path.startswith("/"):
+        raise BadRequestError(
+            "path must be a server-relative render URL")
+    split = urlsplit(path)
+    for kind, rx in (("image", _ROUTE_RE),
+                     ("mask", _MASK_ROUTE_RE),
+                     ("overlay", _OVERLAY_ROUTE_RE),
+                     ("animation", _ANIMATION_ROUTE_RE)):
+        m = rx.match(split.path)
+        if m is not None:
+            params = dict(parse_qsl(split.query,
+                                    keep_blank_values=True))
+            params.pop("tail", None)
+            params.update(m.groupdict())
+            return kind, params
+    raise BadRequestError(
+        f"not a render route: {split.path!r} (expected render_image"
+        f"[_region], render_shape_mask, render_overlay or "
+        f"render_animation)")
 
 
 async def residency_doc(stack, raw_cache, key: str,
@@ -87,9 +124,169 @@ async def _probe_member(member, key: str, route: str) -> dict:
     return doc
 
 
+def _pyramid_job_doc(jobs, services, image_id: int) -> Optional[dict]:
+    """The image's pyramid build state (queued/running/deferred/done,
+    levels committed) — memory first, crash-safe sidecar fallback —
+    or None when no job subsystem / no job touched the image."""
+    if jobs is None:
+        return None
+    pixels = (getattr(services, "pixels_service", None)
+              if services is not None
+              else getattr(jobs, "pixels_service", None))
+    if pixels is None:
+        return None
+    try:
+        return jobs.job_for_source(pixels.image_dir(image_id))
+    except Exception:
+        return None
+
+
+def _ring_doc(fleet_router, route_key: str) -> Optional[dict]:
+    """Owner + failover chain for one ring key (the compact section
+    the workload kinds reuse)."""
+    if fleet_router is None:
+        return None
+    chain = fleet_router.ring.chain(route_key)
+    return {"owner": chain[0] if chain else None, "chain": chain}
+
+
+def _explain_mask(path, params, config, fleet_router) -> dict:
+    """The mask route's dry-run: byte-cache key (reference format),
+    ETag identity (folds the flips), QoS class, device-batched
+    posture, mask byte-tier ring authority."""
+    from . import httpcache
+
+    mctx = ShapeMaskCtx.from_params(params, None)
+    identity = (f"{mctx.cache_key()}"
+                f":f{int(mctx.flip_horizontal)}"
+                f"{int(mctx.flip_vertical)}")
+    doc: dict = {
+        "path": path,
+        "kind": "mask",
+        "identity": identity,
+        "byte_cache_key": mctx.cache_key(),
+        "qos": "interactive",
+        "device_batched": bool(config.workloads.device_masks),
+        "dry_run": True,
+    }
+    hc = config.http_cache
+    if hc.enabled:
+        doc["etag"] = httpcache.etag_for(identity, hc.epoch)
+        doc["epoch"] = hc.epoch
+    ring = _ring_doc(fleet_router, f"mask|{mctx.cache_key()}")
+    if ring is not None:
+        doc["ring"] = ring
+    return doc
+
+
+def _explain_overlay(path, params, config, fleet_router,
+                     services, jobs) -> dict:
+    """The overlay route's dry-run: the app handler's exact identity
+    derivation (base render key + shape list + color override) plus
+    the base plane's route key and ring owner."""
+    from ..parallel.fleet import plane_route_key
+    from . import httpcache
+
+    shapes_raw = params.pop("shapes", "")
+    color = params.pop("color", None)
+    params["format"] = "png"
+    try:
+        shape_ids = [int(s) for s in shapes_raw.split(",") if s]
+    except ValueError:
+        raise BadRequestError(
+            f"Incorrect format for shapes '{shapes_raw}'")
+    ctx = ImageRegionCtx.from_params(params, None)
+    route_key = plane_route_key(ctx)
+    identity = (f"{ctx.cache_key}:ov:"
+                + ",".join(str(s) for s in shape_ids)
+                + f":{color or ''}")
+    doc: dict = {
+        "path": path,
+        "kind": "overlay",
+        "identity": identity,
+        "base_identity": ctx.cache_key,
+        "shapes": shape_ids,
+        "plane_route_key": route_key,
+        "qos": "interactive",
+        "dry_run": True,
+    }
+    hc = config.http_cache
+    if hc.enabled:
+        doc["etag"] = httpcache.etag_for(identity, hc.epoch)
+        doc["epoch"] = hc.epoch
+    ring = _ring_doc(fleet_router, route_key)
+    if ring is not None:
+        doc["ring"] = ring
+    job_doc = _pyramid_job_doc(jobs, services, ctx.image_id)
+    if job_doc is not None:
+        doc["pyramid_job"] = job_doc
+    return doc
+
+
+def _explain_animation(path, params, config, fleet_router,
+                       services, jobs) -> dict:
+    """The animation route's dry-run: per-frame identities and plane
+    route keys (each frame shares the plain tile route's identity),
+    the ring owner of EVERY distinct frame key, stream posture."""
+    from ..parallel.fleet import plane_route_key
+    from . import httpcache
+
+    axis = (params.pop("axis", "t") or "t").lower()
+    if axis not in ("z", "t"):
+        raise BadRequestError(f"Incorrect format for axis '{axis}'")
+    frames_raw = params.pop("frames", "2")
+    try:
+        n_frames = int(frames_raw)
+    except ValueError:
+        raise BadRequestError(
+            f"Incorrect format for frames '{frames_raw}'")
+    cap = config.workloads.animation_max_frames
+    if not 1 <= n_frames <= cap:
+        raise BadRequestError(
+            f"frames must be in [1, {cap}]")
+    axis_key = "theZ" if axis == "z" else "theT"
+    start = int(params.get(axis_key) or 0)
+    identities, route_keys = [], []
+    image_id = None
+    for i in range(n_frames):
+        fparams = dict(params)
+        fparams[axis_key] = str(start + i)
+        fctx = ImageRegionCtx.from_params(fparams, None)
+        image_id = fctx.image_id
+        identities.append(fctx.cache_key)
+        route_keys.append(plane_route_key(fctx))
+    doc: dict = {
+        "path": path,
+        "kind": "animation",
+        "axis": axis,
+        "frames": n_frames,
+        "identities": identities,
+        "plane_route_keys": route_keys,
+        "qos": "interactive",
+        "streamed": True,
+        "dry_run": True,
+    }
+    hc = config.http_cache
+    if hc.enabled:
+        # Per-frame ETags: the stream itself is no-store, but every
+        # frame's bytes revalidate through the plain tile route.
+        doc["frame_etags"] = [httpcache.etag_for(k, hc.epoch)
+                              for k in identities]
+        doc["epoch"] = hc.epoch
+    if fleet_router is not None:
+        doc["ring"] = {"owners": {
+            rk: (fleet_router.ring.chain(rk) or [None])[0]
+            for rk in dict.fromkeys(route_keys)}}
+    job_doc = _pyramid_job_doc(jobs, services, image_id)
+    if job_doc is not None:
+        doc["pyramid_job"] = job_doc
+    return doc
+
+
 async def explain(path: str, config, services=None, fleet_router=None,
                   fleet_members=(), admission=None,
-                  proxy_client=None, federation_coord=None) -> dict:
+                  proxy_client=None, federation_coord=None,
+                  jobs=None) -> dict:
     """Assemble the explain document for one render URL.  Read-only
     end to end: cache probes and wire ``explain`` ops only — the
     renderer-span counters must not move (pinned by the acceptance
@@ -97,17 +294,29 @@ async def explain(path: str, config, services=None, fleet_router=None,
     from ..parallel.fleet import plane_route_key
     from . import httpcache, pressure as pressure_mod
 
-    params = parse_render_path(path)
+    kind, params = classify_render_path(path)
+    if kind == "mask":
+        return _explain_mask(path, params, config, fleet_router)
+    if kind == "overlay":
+        return _explain_overlay(path, params, config, fleet_router,
+                                services, jobs)
+    if kind == "animation":
+        return _explain_animation(path, params, config, fleet_router,
+                                  services, jobs)
     ctx = ImageRegionCtx.from_params(params, None)
     route_key = plane_route_key(ctx)
     pinned = pressure_mod.is_bulk(ctx)
     doc: dict = {
         "path": path,
+        "kind": "image",
         "identity": ctx.cache_key,
         "plane_route_key": route_key,
         "qos": "bulk" if pinned else "interactive",
         "dry_run": True,
     }
+    job_doc = _pyramid_job_doc(jobs, services, ctx.image_id)
+    if job_doc is not None:
+        doc["pyramid_job"] = job_doc
     hc = config.http_cache
     if hc.enabled:
         doc["etag"] = httpcache.etag_for(ctx.cache_key, hc.epoch)
@@ -227,7 +436,8 @@ async def explain(path: str, config, services=None, fleet_router=None,
 
 def build_explain_handler(config, services=None, fleet_router=None,
                           fleet_members=(), admission=None,
-                          proxy_client=None, federation_coord=None):
+                          proxy_client=None, federation_coord=None,
+                          jobs=None):
     """The aiohttp handler factory app.py wires at /debug/explain."""
     from aiohttp import web
 
@@ -243,7 +453,7 @@ def build_explain_handler(config, services=None, fleet_router=None,
                 fleet_router=fleet_router,
                 fleet_members=fleet_members, admission=admission,
                 proxy_client=proxy_client,
-                federation_coord=federation_coord)
+                federation_coord=federation_coord, jobs=jobs)
         except BadRequestError as e:
             return web.json_response({"error": str(e)}, status=400)
         except Exception:

@@ -838,10 +838,21 @@ def _default_rdef(pixels: Pixels) -> RenderingDef:
 
 class ShapeMaskHandler:
     """Mask pipeline (``ShapeMaskVerticle.java:67-155`` +
-    ``ShapeMaskRequestHandler.java``)."""
+    ``ShapeMaskRequestHandler.java``).
 
-    def __init__(self, services: ImageRegionServices):
+    ``device_masks=True`` routes rasterization through the renderer's
+    batched mask group path (``BatchingRenderer.rasterize_mask``) when
+    the wired renderer has one — same-shape masks coalesce into one
+    device dispatch.  The PNG tail is shared with the host path, and
+    the device kernel reproduces the host unpack/flip bit-for-bit, so
+    the served bytes are IDENTICAL either way (the PR 20 parity
+    contract); a renderer without the group path (plain ``Renderer``,
+    fleet router) silently keeps the host rasterizer."""
+
+    def __init__(self, services: ImageRegionServices,
+                 device_masks: bool = False):
         self.s = services
+        self.device_masks = device_masks
 
     async def cached_shape_mask(self, ctx: ShapeMaskCtx
                                 ) -> Optional[bytes]:
@@ -885,7 +896,16 @@ class ShapeMaskHandler:
                 raise BadRequestError(f"Invalid color '{ctx.color}'")
 
         with stopwatch("renderShapeMask"):
-            png = await asyncio.to_thread(self._render, mask, color, ctx)
+            rasterize = (getattr(self.s.renderer, "rasterize_mask", None)
+                         if self.device_masks else None)
+            if rasterize is not None:
+                png = await self._render_device(mask, color, ctx,
+                                                rasterize)
+                telemetry.WORKLOADS.count_request("mask_device")
+            else:
+                png = await asyncio.to_thread(self._render, mask, color,
+                                              ctx)
+                telemetry.WORKLOADS.count_request("mask_host")
 
         # Cached only under an explicit color, as the reference: a cached
         # default-color PNG would mask later changes to the stored fill
@@ -903,3 +923,166 @@ class ShapeMaskHandler:
         grid, palette = rasterize_mask(
             mask, color, ctx.flip_horizontal, ctx.flip_vertical)
         return codecs.encode_mask_png(grid, tuple(palette[1]))
+
+    async def _render_device(self, mask, color, ctx: ShapeMaskCtx,
+                             rasterize) -> bytes:
+        """Batched device rasterization: validate + normalize the packed
+        payload on host (the host path's exact checks), one awaited
+        group dispatch for the grid, then the IDENTICAL PNG tail."""
+        from ..ops.maskops import pack_mask_payload
+        fill = mask.resolved_fill_color(color)
+        packed = pack_mask_payload(mask.bytes_, mask.width, mask.height)
+        grid = await rasterize(packed, mask.width, mask.height,
+                               ctx.flip_horizontal, ctx.flip_vertical)
+        return await asyncio.to_thread(
+            codecs.encode_mask_png, grid, tuple(fill))
+
+
+# Animation wire framing: each frame leaves as a tiny length-prefixed
+# record inside the HTTP chunked body, so a scrubbing client can carve
+# frame boundaries without guessing at encoder byte counts.
+ANIMATION_FRAME_MAGIC = b"FRME"
+
+
+def frame_record(body: bytes) -> bytes:
+    """``FRME`` + u32be length + encoded frame bytes."""
+    return (ANIMATION_FRAME_MAGIC
+            + len(body).to_bytes(4, "big") + body)
+
+
+class WorkloadsHandler:
+    """The PR 20 device-workloads endpoints that compose the image and
+    mask pipelines: overlay composites (region render + device mask
+    blend in one pass) and z/t animation strips (a frame range rendered
+    as ONE batched device job, streamed in order).
+
+    Owns no pixels/caches of its own — it drives the SAME handlers the
+    plain routes use, so every identity, ACL, provenance, and QoS rule
+    those paths enforce holds here too."""
+
+    def __init__(self, image_handler, services: ImageRegionServices,
+                 max_frames: int = 64):
+        self.image_handler = image_handler
+        self.s = services
+        self.max_frames = max_frames
+
+    # ------------------------------------------------------------ overlay
+
+    async def render_overlay(self, ctx: ImageRegionCtx,
+                             shape_ids: Sequence[int],
+                             color: Optional[str] = None) -> bytes:
+        """Region pixels + ROI mask(s) composited on device -> PNG.
+
+        ``ctx`` must already carry ``format="png"`` (the app forces it:
+        the base render must be lossless or the composite would bake
+        JPEG artifacts under the mask).  Masks must match the rendered
+        region's size — the endpoint serves same-geometry ROI planes,
+        not a general transform engine.  The composite is the exact
+        ``ops.maskops.overlay_masks_batch`` integer blend, computed on
+        device (``overlay_masks_device``), masks applied in request
+        order — the refimpl-golden contract."""
+        from ..ops.maskops import (overlay_masks_device,
+                                   pack_mask_payload,
+                                   rasterize_packed_batch)
+        if not shape_ids:
+            raise BadRequestError("overlay needs at least one shapeId")
+        fill_override = None
+        if color is not None:
+            fill_override = split_html_color(color)
+            if fill_override is None:
+                raise BadRequestError(f"Invalid color '{color}'")
+
+        masks = []
+        for sid in shape_ids:
+            if not await check_can_read(self.s, "Mask", sid,
+                                        ctx.omero_session_key):
+                raise NotFoundError(f"Cannot find Shape:{sid}")
+            with stopwatch("getMask"):
+                mask = await self.s.metadata.get_mask(
+                    sid, ctx.omero_session_key)
+            if mask is None:
+                raise NotFoundError(f"Cannot find Shape:{sid}")
+            masks.append(mask)
+
+        base_png = await self.image_handler.render_image_region(ctx)
+        base = await asyncio.to_thread(codecs.decode_to_rgba, base_png)
+
+        def composite() -> bytes:
+            out = base
+            for mask in masks:
+                if (mask.height, mask.width) != out.shape[:2]:
+                    raise BadRequestError(
+                        f"Shape:{mask.shape_id} is "
+                        f"{mask.width}x{mask.height}, region is "
+                        f"{out.shape[1]}x{out.shape[0]}")
+                packed = pack_mask_payload(mask.bytes_, mask.width,
+                                           mask.height)
+                grid = rasterize_packed_batch(
+                    packed[None, :], mask.width, mask.height,
+                    ctx.flip_horizontal, ctx.flip_vertical)[0]
+                fill = np.array(
+                    [mask.resolved_fill_color(fill_override)],
+                    dtype=np.uint8)
+                out = overlay_masks_device(out[None], grid[None],
+                                           fill)[0]
+            return codecs.encode_rgba(out, "png")
+
+        with stopwatch("renderOverlay"):
+            body = await asyncio.to_thread(composite)
+        telemetry.WORKLOADS.count_request("overlay")
+        return body
+
+    # ---------------------------------------------------------- animation
+
+    async def render_animation_stream(self, frame_ctxs:
+                                      Sequence[ImageRegionCtx]):
+        """Async generator: render a z/t frame range as one batched
+        device job, yield length-prefixed frames IN ORDER.
+
+        Every frame's render task starts up front, so the batcher's
+        linger window coalesces the strip into grouped device
+        dispatches while the client is still reading frame 0 — the
+        first frame's latency stays a single-group render, the rest
+        hide behind the wire.  Closing the generator (client
+        disconnect, deadline) cancels every not-yet-settled frame task:
+        remaining device work is abandoned at the dispatch queue, never
+        rendered for a viewer that left."""
+        if not frame_ctxs:
+            raise BadRequestError("animation needs at least one frame")
+        if len(frame_ctxs) > self.max_frames:
+            raise BadRequestError(
+                f"animation of {len(frame_ctxs)} frames exceeds the "
+                f"configured cap of {self.max_frames}")
+        import time as _time
+        t0 = _time.perf_counter()
+        telemetry.WORKLOADS.count_stream()
+        telemetry.FLIGHT.record(
+            "animation.stream", image=frame_ctxs[0].image_id,
+            frames=len(frame_ctxs))
+        tasks = [asyncio.ensure_future(
+            self.image_handler.render_image_region(fctx))
+            for fctx in frame_ctxs]
+        served = 0
+        try:
+            for task in tasks:
+                body = await task
+                if served == 0:
+                    telemetry.WORKLOADS.observe_first_frame_ms(
+                        (_time.perf_counter() - t0) * 1000.0)
+                served += 1
+                telemetry.WORKLOADS.count_frames()
+                yield frame_record(body)
+        finally:
+            remaining = [t for t in tasks if not t.done()]
+            for t in remaining:
+                t.cancel()
+            if remaining:
+                telemetry.WORKLOADS.count_stream_cancelled()
+                telemetry.FLIGHT.record(
+                    "animation.cancelled",
+                    image=frame_ctxs[0].image_id, served=served,
+                    cancelled=len(remaining))
+                # Settle the cancellations so no "exception was never
+                # retrieved" noise outlives the stream.
+                await asyncio.gather(*remaining,
+                                     return_exceptions=True)

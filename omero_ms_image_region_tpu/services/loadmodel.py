@@ -59,7 +59,7 @@ from ..utils import telemetry
 # serves (pressure.is_bulk: interactive tile vs bulk full-plane), plus
 # the mask endpoint (QoS-classed interactive, but its own route and
 # fairness surface — the PR 10 follow-on this PR closes).
-CLASSES = ("interactive", "bulk", "mask")
+CLASSES = ("interactive", "bulk", "mask", "pyramid", "animation")
 
 # Pan velocities a viewer trajectory may run with (same lattice steps
 # the viewport predictor extrapolates).
@@ -102,6 +102,8 @@ class LoadModel:
                  diurnal_amplitude: float = 0.6,
                  bulk_fraction: float = 0.0,
                  mask_fraction: float = 0.0,
+                 pyramid_fraction: float = 0.0,
+                 animation_fraction: float = 0.0,
                  zoom_fraction: float = 0.05,
                  max_level: int = 0,
                  skew: float = 0.0,
@@ -121,12 +123,15 @@ class LoadModel:
                 "loadmodel diurnal_amplitude must be in [0, 1)")
         for name, frac in (("bulk_fraction", bulk_fraction),
                            ("mask_fraction", mask_fraction),
+                           ("pyramid_fraction", pyramid_fraction),
+                           ("animation_fraction", animation_fraction),
                            ("zoom_fraction", zoom_fraction)):
             if not 0.0 <= frac <= 1.0:
                 raise ValueError(f"loadmodel {name} must be in [0, 1]")
-        if bulk_fraction + mask_fraction > 1.0:
-            raise ValueError("loadmodel bulk_fraction + mask_fraction "
-                             "must be <= 1")
+        if (bulk_fraction + mask_fraction + pyramid_fraction
+                + animation_fraction) > 1.0:
+            raise ValueError("loadmodel class fractions (bulk + mask + "
+                             "pyramid + animation) must sum to <= 1")
         if skew < 0:
             raise ValueError("loadmodel skew must be >= 0")
         if image_population < 1:
@@ -142,6 +147,8 @@ class LoadModel:
         self.diurnal_amplitude = float(diurnal_amplitude)
         self.bulk_fraction = float(bulk_fraction)
         self.mask_fraction = float(mask_fraction)
+        self.pyramid_fraction = float(pyramid_fraction)
+        self.animation_fraction = float(animation_fraction)
         self.zoom_fraction = float(zoom_fraction)
         self.max_level = int(max_level)
         self.skew = float(skew)
@@ -178,6 +185,8 @@ class LoadModel:
             diurnal_amplitude=config.diurnal_amplitude,
             bulk_fraction=config.bulk_fraction,
             mask_fraction=config.mask_fraction,
+            pyramid_fraction=config.pyramid_fraction,
+            animation_fraction=config.animation_fraction,
             zoom_fraction=config.zoom_fraction,
             skew=config.skew,
             image_population=config.image_population)
@@ -241,10 +250,18 @@ class LoadModel:
         run_left = rng.randrange(3, 9)
         for step in range(n):
             draw = rng.random()
-            if draw < self.bulk_fraction:
+            b = self.bulk_fraction
+            m = b + self.mask_fraction
+            p = m + self.pyramid_fraction
+            a = p + self.animation_fraction
+            if draw < b:
                 cls = "bulk"
-            elif draw < self.bulk_fraction + self.mask_fraction:
+            elif draw < m:
                 cls = "mask"
+            elif draw < p:
+                cls = "pyramid"
+            elif draw < a:
+                cls = "animation"
             else:
                 cls = "interactive"
             yield Arrival(t=t, session=session, cls=cls, step=step,

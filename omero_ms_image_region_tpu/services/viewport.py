@@ -194,6 +194,39 @@ class ViewportTracker:
             return None
         return _median_int(dxs), _median_int(dys)
 
+    def scrub_velocity(self, session_key: Optional[str]
+                       ) -> Optional[Tuple[int, int]]:
+        """The session's per-step plane velocity ``(dz, dt)`` — the
+        median of consecutive z/t deltas over the fresh history while
+        the viewport itself holds still (same image/level/tile) — or
+        None when no scrub trajectory is in flight.  This is the focus/
+        time SCRUB a viewer drives with the z/t sliders: the lattice
+        velocity estimate deliberately excludes those pairs (z/t change
+        disqualifies a pan vote), so without this reader a scrubbing
+        session looks stationary to the prefetcher."""
+        history = self._recent(session_key)
+        if len(history) < 2:
+            return None
+        last = history[-1]
+        now = self.clock()
+        dzs: List[int] = []
+        dts: List[int] = []
+        for prev, cur in zip(history, history[1:]):
+            if (cur.image_id != last.image_id
+                    or prev.image_id != last.image_id
+                    or cur.resolution != last.resolution
+                    or prev.resolution != last.resolution
+                    or cur.x != prev.x or cur.y != prev.y
+                    or (cur.z == prev.z and cur.t == prev.t)
+                    or now - cur.ts > _STALE_S
+                    or cur.ts - prev.ts > _STALE_S):
+                continue
+            dzs.append(cur.z - prev.z)
+            dts.append(cur.t - prev.t)
+        if not dzs:
+            return None
+        return _median_int(dzs), _median_int(dts)
+
     def zoom_direction(self, session_key: Optional[str]) -> int:
         """-1 zooming IN (toward finer levels — resolution indexes are
         largest-first, so the index DECREASES), +1 zooming out, 0 no
@@ -215,6 +248,8 @@ class ViewportTracker:
         """Predicted next tiles for the session, most imminent first.
 
         * Pan in flight: extrapolate the velocity ``lookahead`` steps.
+        * z/t scrub in flight: the same tile on the planes the slider
+          is heading to, ``lookahead`` steps of the median z/t delta.
         * Zoom in flight: the last tile's center re-expressed at the
           next level in the zoom direction (children when zooming in,
           the parent when zooming out).
@@ -240,6 +275,19 @@ class ViewportTracker:
                 out.append(TilePrediction(
                     last.image_id, last.z, last.t, last.resolution,
                     nx, ny, step=i))
+        scrub = self.scrub_velocity(session_key)
+        if scrub is not None and scrub != (0, 0):
+            # z/t scrub in flight: the same tile at the planes the
+            # slider is heading to (sliders clamp at the stack edge,
+            # so negative targets are simply not predicted).
+            dz, dt = scrub
+            for i in range(1, max(1, lookahead) + 1):
+                nz, nt = last.z + dz * i, last.t + dt * i
+                if nz < 0 or nt < 0:
+                    break
+                out.append(TilePrediction(
+                    last.image_id, nz, nt, last.resolution,
+                    last.x, last.y, step=i))
         zoom = self.zoom_direction(session_key)
         if zoom != 0 and last.resolution is not None:
             target = last.resolution + zoom

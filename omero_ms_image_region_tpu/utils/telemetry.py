@@ -2156,6 +2156,120 @@ class LoadModelStats:
 LOADMODEL = LoadModelStats()
 
 
+class WorkloadStats:
+    """Device-workloads plane accounting (PR 20): the batched
+    mask/overlay rasterizer (``kind`` is the closed request vocabulary
+    — which path served it), the crash-safe pyramid job subsystem
+    (``action`` is the closed lifecycle vocabulary), and the z/t
+    animation streamer (streams/frames/cancels plus the last stream's
+    first-frame latency — the bounded-latency contract's live gauge)."""
+
+    REQUEST_KINDS = ("mask_device", "mask_host", "overlay", "animation")
+    JOB_ACTIONS = ("submitted", "resumed", "completed", "failed",
+                   "cancelled", "deferred")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests: Dict[str, int] = {}
+        self.jobs: Dict[str, int] = {}
+        self.jobs_active = 0
+        self.levels_committed = 0
+        self.streams = 0
+        self.frames = 0
+        self.stream_cancels = 0
+        self.first_frame_ms: Optional[float] = None
+
+    def count_request(self, kind: str) -> None:
+        with self._lock:
+            self.requests[kind] = self.requests.get(kind, 0) + 1
+
+    def count_job(self, action: str) -> None:
+        with self._lock:
+            self.jobs[action] = self.jobs.get(action, 0) + 1
+
+    def job_started(self) -> None:
+        with self._lock:
+            self.jobs_active += 1
+
+    def job_finished(self) -> None:
+        with self._lock:
+            self.jobs_active = max(0, self.jobs_active - 1)
+
+    def count_level_committed(self) -> None:
+        with self._lock:
+            self.levels_committed += 1
+
+    def count_stream(self) -> None:
+        with self._lock:
+            self.streams += 1
+
+    def count_frames(self, n: int = 1) -> None:
+        with self._lock:
+            self.frames += n
+
+    def count_stream_cancelled(self) -> None:
+        with self._lock:
+            self.stream_cancels += 1
+
+    def observe_first_frame_ms(self, ms: float) -> None:
+        with self._lock:
+            self.first_frame_ms = ms
+
+    def metric_lines(self, extra_labels: str = "") -> List[str]:
+        extra = extra_labels.lstrip(",")
+
+        def label(body: str = "") -> str:
+            inner = ",".join(p for p in (body, extra) if p)
+            return ("{" + inner + "}") if inner else ""
+
+        with self._lock:
+            if not (self.requests or self.jobs or self.jobs_active
+                    or self.levels_committed or self.streams):
+                return []        # emit-when-live (workloads-plane only)
+            lines = []
+            for kind in sorted(self.requests):
+                body = 'kind="%s"' % kind
+                lines.append(
+                    f"imageregion_workload_requests_total"
+                    f"{label(body)} {self.requests[kind]}")
+            for action in sorted(self.jobs):
+                body = 'action="%s"' % action
+                lines.append(
+                    f"imageregion_pyramid_jobs_total"
+                    f"{label(body)} {self.jobs[action]}")
+            lines += [
+                f"imageregion_pyramid_jobs_active{label()} "
+                f"{self.jobs_active}",
+                f"imageregion_pyramid_levels_committed_total{label()} "
+                f"{self.levels_committed}",
+                f"imageregion_animation_streams_total{label()} "
+                f"{self.streams}",
+                f"imageregion_animation_frames_total{label()} "
+                f"{self.frames}",
+                f"imageregion_animation_cancelled_total{label()} "
+                f"{self.stream_cancels}",
+            ]
+            if self.first_frame_ms is not None:
+                lines.append(
+                    f"imageregion_animation_first_frame_ms{label()} "
+                    f"{_fmt(self.first_frame_ms)}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.requests.clear()
+            self.jobs.clear()
+            self.jobs_active = 0
+            self.levels_committed = 0
+            self.streams = 0
+            self.frames = 0
+            self.stream_cancels = 0
+            self.first_frame_ms = None
+
+
+WORKLOADS = WorkloadStats()
+
+
 class FederationStats:
     """Cross-host federation accounting (``parallel.federation``): the
     agreed manifest's version + member count, join-time agreement
@@ -3158,7 +3272,8 @@ def session_metric_lines(extra_labels: str = "") -> List[str]:
     return (SESSIONS.metric_lines(extra_labels)
             + PREFETCH.metric_lines(extra_labels)
             + QOS.metric_lines(extra_labels)
-            + LOADMODEL.metric_lines(extra_labels))
+            + LOADMODEL.metric_lines(extra_labels)
+            + WORKLOADS.metric_lines(extra_labels))
 
 
 def robustness_metric_lines(extra_labels: str = "") -> List[str]:
@@ -3414,6 +3529,17 @@ METRIC_TYPES: Dict[str, str] = {
     "imageregion_loadmodel_completed_total": "counter",
     "imageregion_loadmodel_shed_total": "counter",
     "imageregion_loadmodel_late_fires_total": "counter",
+    # Device workloads plane (PR 20): batched mask/overlay
+    # rasterization path counters, crash-safe pyramid build jobs,
+    # z/t animation streams.
+    "imageregion_workload_requests_total": "counter",
+    "imageregion_pyramid_jobs_total": "counter",
+    "imageregion_pyramid_jobs_active": "gauge",
+    "imageregion_pyramid_levels_committed_total": "counter",
+    "imageregion_animation_streams_total": "counter",
+    "imageregion_animation_frames_total": "counter",
+    "imageregion_animation_cancelled_total": "counter",
+    "imageregion_animation_first_frame_ms": "gauge",
     # Cross-host fleet federation (parallel.federation): agreed
     # manifest state, join-time agreement outcomes, gossip rounds,
     # warm shard transfers over the wire, remote prestage hints.
@@ -3693,6 +3819,26 @@ METRIC_HELP: Dict[str, str] = {
     "imageregion_loadmodel_late_fires_total":
         "Arrivals fired behind schedule (open-loop integrity: the "
         "generator, not the service, fell behind)",
+    "imageregion_workload_requests_total":
+        "Device-workloads requests by kind (mask_device/mask_host = "
+        "which rasterizer served the mask; overlay; animation)",
+    "imageregion_pyramid_jobs_total":
+        "Pyramid build job lifecycle transitions by action "
+        "(submitted, resumed, completed, failed, cancelled, deferred)",
+    "imageregion_pyramid_jobs_active":
+        "Pyramid build jobs currently running or deferred",
+    "imageregion_pyramid_levels_committed_total":
+        "Pyramid levels atomically committed (tmp-dir os.replace)",
+    "imageregion_animation_streams_total":
+        "z/t animation streams started",
+    "imageregion_animation_frames_total":
+        "Animation frames written to clients",
+    "imageregion_animation_cancelled_total":
+        "Animation streams cancelled mid-flight (client disconnect "
+        "or deadline) with remaining device work cancelled",
+    "imageregion_animation_first_frame_ms":
+        "Last animation stream's first-frame latency (the bounded "
+        "first-frame-out contract's live gauge)",
     "imageregion_hotkey_promotions_total":
         "Routes promoted to an R>1 replica set (heat past threshold)",
     "imageregion_hotkey_demotions_total":
@@ -3978,6 +4124,7 @@ def reset() -> None:
     DRAIN.reset()
     AUTOSCALER.reset()
     LOADMODEL.reset()
+    WORKLOADS.reset()
     FEDERATION.reset()
     QUORUM.reset()
     DECISIONS.reset()

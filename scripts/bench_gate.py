@@ -110,6 +110,14 @@ HOTKEY_KEYS = ("hotkey_storm_ratio", "hotkey_replication_gain",
 # majority roll, or a fenced minority that refused NOTHING all fail
 # outright — they are contracts, not trends.
 PARTITION_KEYS = ("part_fence_ms", "part_restore_ms")
+
+# Device-workloads drill (``bench.py --smoke --workloads``), PR 20:
+# the batched mask/overlay/animation latencies and the pyramid build
+# are ``_ms`` keys (regress UP); mask renders in the parity mix
+# regress DOWN (fewer exercised = a shrunken drill, not a win).
+WORKLOADS_KEYS = ("mask_device_ms", "overlay_device_ms",
+                  "pyramid_build_ms", "anim_first_frame_ms",
+                  "anim_total_ms", "mask_renders")
 _BENCH_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _MULTICHIP_RE = re.compile(r"^MULTICHIP_r(\d+)\.json$")
 _SESSIONS_RE = re.compile(r"^SESSIONS_r(\d+)\.json$")
@@ -117,6 +125,7 @@ _OFFLOAD_RE = re.compile(r"^OFFLOAD_r(\d+)\.json$")
 _CAPACITY_RE = re.compile(r"^CAPACITY_r(\d+)\.json$")
 _HOTKEY_RE = re.compile(r"^HOTKEY_r(\d+)\.json$")
 _PARTITION_RE = re.compile(r"^PARTITION_r(\d+)\.json$")
+_WORKLOADS_RE = re.compile(r"^WORKLOADS_r(\d+)\.json$")
 
 # Every committed record family in one table: (name, filename
 # pattern, trend keys, pairwise/watermark threshold).  ``--all``
@@ -131,6 +140,7 @@ FAMILIES = (
     ("capacity", _CAPACITY_RE, CAPACITY_KEYS, 0.10),
     ("hotkey", _HOTKEY_RE, HOTKEY_KEYS, 0.10),
     ("partition", _PARTITION_RE, PARTITION_KEYS, 0.50),
+    ("workloads", _WORKLOADS_RE, WORKLOADS_KEYS, 0.50),
 )
 
 
@@ -476,10 +486,17 @@ def main(argv=None) -> int:
                              "rolls, failed post-heal agreement/byte "
                              "round-trips and a refusal-free fence "
                              "all fail outright")
+    parser.add_argument("--workloads", action="store_true",
+                        help="judge WORKLOADS_r*.json records (bench "
+                             "--smoke --workloads, the device mask/"
+                             "overlay/pyramid/animation drill) on the "
+                             "batched-latency keys (regress up) and "
+                             "the parity-mix size (regresses down)")
     parser.add_argument("--all", action="store_true",
                         help="judge EVERY committed record family "
                              "(BENCH/MULTICHIP/OFFLOAD/SESSIONS/"
-                             "CAPACITY/HOTKEY/PARTITION) in --dir "
+                             "CAPACITY/HOTKEY/PARTITION/WORKLOADS) "
+                             "in --dir "
                              "(default .) pairwise AND against its "
                              "watermark, riders included; prints one "
                              "verdict row per family and exits "
@@ -507,7 +524,10 @@ def main(argv=None) -> int:
         # 10% relative bar fails identical code about half the time,
         # so the family bar is a tick-sized 50%.  Real regressions
         # (a lost tick loop, a widened suspect window) move 2-3x.
-        args.max_regression = 0.50 if args.partition else 0.10
+        # Workloads shares the wide bar: smoke-scale batched renders
+        # are a few ms, so scheduler jitter dwarfs a 10% band.
+        args.max_regression = (0.50 if args.partition or args.workloads
+                               else 0.10)
 
     if args.all:
         try:
@@ -530,6 +550,8 @@ def main(argv=None) -> int:
         keys = HOTKEY_KEYS
     elif args.partition:
         keys = PARTITION_KEYS
+    elif args.workloads:
+        keys = WORKLOADS_KEYS
     else:
         keys = DEFAULT_KEYS
     pattern = (_MULTICHIP_RE if args.multichip
@@ -537,7 +559,8 @@ def main(argv=None) -> int:
                else _OFFLOAD_RE if args.offload
                else _CAPACITY_RE if args.capacity
                else _HOTKEY_RE if args.hotkey
-               else _PARTITION_RE if args.partition else _BENCH_RE)
+               else _PARTITION_RE if args.partition
+               else _WORKLOADS_RE if args.workloads else _BENCH_RE)
     try:
         if args.watermark:
             if args.dir:

@@ -202,6 +202,14 @@ _ROBUSTNESS_KINDS = ("pressure.level", "pressure.step",
 # shed and what did prefetch do" alongside the robustness story.
 _SESSION_KINDS = ("qos.shed", "prefetch.predict", "prefetch.budget")
 
+# Device-workload event kinds (background pyramid job lifecycle,
+# animation streams): marked with ``~`` and summed into their own
+# footer so a dump answers "what batch/stream work was in flight"
+# next to the interactive-serving story.
+_WORKLOAD_KINDS = ("pyramid.submit", "pyramid.level",
+                   "pyramid.deferred", "pyramid.done",
+                   "animation.stream", "animation.cancelled")
+
 # Control-plane decision records (utils.decisions): every ledger
 # append mirrors onto the flight ring as ``decision.<kind>`` — flagged
 # and summed separately so a dump answers "what did the control plane
@@ -225,6 +233,7 @@ def render_flight(doc) -> str:
     ]
     rob_counts: dict = {}
     session_counts: dict = {}
+    workload_counts: dict = {}
     decision_counts: dict = {}
     member_counts: dict = {}
     for e in events:
@@ -239,6 +248,7 @@ def render_flight(doc) -> str:
         offset = float(e.get("ts", t_dump)) - t_dump
         mark = ("!" if kind in _ROBUSTNESS_KINDS
                 else "*" if kind in _SESSION_KINDS
+                else "~" if kind in _WORKLOAD_KINDS
                 else "+" if kind.startswith(_DECISION_PREFIX)
                 else " ")
         if kind in _ROBUSTNESS_KINDS:
@@ -274,6 +284,19 @@ def render_flight(doc) -> str:
             elif kind == "prefetch.budget":
                 label = f"prefetch.budget:{e.get('scale', '?')}"
             session_counts[label] = session_counts.get(label, 0) + 1
+        elif kind in _WORKLOAD_KINDS:
+            label = kind
+            if kind == "pyramid.level":
+                label = (f"pyramid.level:{e.get('level', '?')}"
+                         f"/{e.get('of', '?')}")
+            elif kind == "pyramid.done":
+                label = f"pyramid.done:{e.get('levels', '?')}lvl"
+            elif kind == "animation.stream":
+                label = f"animation.stream:{e.get('frames', '?')}f"
+            elif kind == "animation.cancelled":
+                label = (f"animation.cancelled:{e.get('served', '?')}"
+                         f"/{e.get('cancelled', '?')}")
+            workload_counts[label] = workload_counts.get(label, 0) + 1
         elif kind.startswith(_DECISION_PREFIX):
             label = f"{kind}:{e.get('verdict', '?')}"
             decision_counts[label] = decision_counts.get(label, 0) + 1
@@ -286,6 +309,10 @@ def render_flight(doc) -> str:
         pretty = "  ".join(f"{k}={v}" for k, v in
                            sorted(session_counts.items()))
         lines.append(f"  session-serving: {pretty}")
+    if workload_counts:
+        pretty = "  ".join(f"{k}={v}" for k, v in
+                           sorted(workload_counts.items()))
+        lines.append(f"  device-workloads: {pretty}")
     if decision_counts:
         pretty = "  ".join(f"{k}={v}" for k, v in
                            sorted(decision_counts.items()))

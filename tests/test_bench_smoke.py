@@ -457,3 +457,41 @@ def test_bench_smoke_offload(capsys):
     doc = json.loads(line)
     assert doc["metric"] == "offload_smoke"
     assert doc["origin_offload_ratio"] == out["origin_offload_ratio"]
+
+
+def test_bench_smoke_workloads(capsys):
+    """The device-workloads gate (bench.py --smoke --workloads): the
+    batched device mask path serves bytes IDENTICAL to the host
+    rasterizer across the committed fixtures and flip lanes, the
+    overlay composite matches the refimpl golden, the pyramid job
+    commits a readable NGFF group, and the animation strip streams
+    every frame in order then cancels cleanly on a mid-stream close
+    — all asserted inside the run; the keys feed the WORKLOADS
+    record family."""
+    import bench
+    from omero_ms_image_region_tpu.utils import telemetry
+
+    telemetry.reset()
+    try:
+        t0 = time.monotonic()
+        out = bench.bench_workloads_smoke()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 60.0, \
+            f"workloads bench took {elapsed:.0f}s (budget 60)"
+
+        assert out["mask_parity_ok"] is True
+        assert out["mask_renders"] >= 12, out
+        assert out["overlay_parity_ok"] is True
+        assert out["pyramid_levels"] >= 2, out
+        assert out["pyramid_readable_levels"] == \
+            out["pyramid_levels"], out
+        assert out["anim_frames"] >= 8, out
+        assert out["anim_first_frame_ms"] <= out["anim_total_ms"], out
+        assert out["anim_cancel_ok"] is True
+
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        doc = json.loads(line)
+        assert doc["metric"] == "workloads_smoke"
+        assert doc["mask_renders"] == out["mask_renders"]
+    finally:
+        telemetry.reset()

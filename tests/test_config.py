@@ -899,3 +899,86 @@ def test_sentinel_block_parses_and_validates():
         AppConfig.from_dict({"sentinel": {"max-bundles": 0}})
     with pytest.raises(ValueError, match="profile-ms"):
         AppConfig.from_dict({"sentinel": {"profile-ms": -1}})
+
+
+def test_workloads_block_parses_and_validates():
+    """The `workloads:` block (device workloads plane: batched masks,
+    overlay composites, animation streams): example-file defaults,
+    full kebab-case parse, and the frame-cap bound."""
+    from omero_ms_image_region_tpu.server.config import WorkloadsConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = WorkloadsConfig()
+    assert cfg.workloads.device_masks is defaults.device_masks
+    assert cfg.workloads.overlay_enabled is defaults.overlay_enabled
+    assert cfg.workloads.animation_enabled is \
+        defaults.animation_enabled
+    assert cfg.workloads.animation_max_frames == \
+        defaults.animation_max_frames
+
+    cfg = AppConfig.from_dict({"workloads": {
+        "device-masks": False, "overlay-enabled": False,
+        "animation-enabled": True, "animation-max-frames": 16}})
+    assert cfg.workloads.device_masks is False
+    assert cfg.workloads.overlay_enabled is False
+    assert cfg.workloads.animation_enabled is True
+    assert cfg.workloads.animation_max_frames == 16
+
+    with pytest.raises(ValueError, match="animation-max-frames"):
+        AppConfig.from_dict({"workloads": {"animation-max-frames": 0}})
+
+
+def test_pyramid_block_parses_and_validates():
+    """The `pyramid:` block (crash-safe background builds): example-
+    file defaults, full parse, and every validation bound — the chunk
+    floor, the level-size floor, the codec whitelist, and the
+    deferred-poll cadence."""
+    from omero_ms_image_region_tpu.server.config import PyramidConfig
+
+    cfg = AppConfig.from_yaml(EXAMPLE)
+    defaults = PyramidConfig()
+    assert cfg.pyramid.enabled is defaults.enabled
+    assert cfg.pyramid.chunk == defaults.chunk
+    assert cfg.pyramid.min_level_size == defaults.min_level_size
+    assert cfg.pyramid.compressor == defaults.compressor
+    assert cfg.pyramid.defer_poll_s == defaults.defer_poll_s
+
+    cfg = AppConfig.from_dict({"pyramid": {
+        "enabled": False, "chunk": 128, "min-level-size": 64,
+        "compressor": "none", "defer-poll-s": 1.5}})
+    assert cfg.pyramid.enabled is False
+    assert cfg.pyramid.chunk == 128
+    assert cfg.pyramid.min_level_size == 64
+    assert cfg.pyramid.compressor == "none"
+    assert cfg.pyramid.defer_poll_s == 1.5
+
+    with pytest.raises(ValueError, match="pyramid.chunk"):
+        AppConfig.from_dict({"pyramid": {"chunk": 8}})
+    with pytest.raises(ValueError, match="min-level-size"):
+        AppConfig.from_dict({"pyramid": {"min-level-size": 0}})
+    with pytest.raises(ValueError, match="compressor"):
+        AppConfig.from_dict({"pyramid": {"compressor": "lz4"}})
+    with pytest.raises(ValueError, match="defer-poll-s"):
+        AppConfig.from_dict({"pyramid": {"defer-poll-s": 0}})
+
+
+def test_loadmodel_workload_fractions_parse_and_validate():
+    """The workload-class mix knobs (`pyramid-fraction` /
+    `animation-fraction`): parse, per-knob [0,1] bound, and the
+    four-class sum cap — an over-committed mix fails at config load,
+    not mid-bench-round."""
+    cfg = AppConfig.from_dict({"loadmodel": {
+        "bulk-fraction": 0.1, "mask-fraction": 0.05,
+        "pyramid-fraction": 0.02, "animation-fraction": 0.03}})
+    assert cfg.loadmodel.pyramid_fraction == 0.02
+    assert cfg.loadmodel.animation_fraction == 0.03
+
+    with pytest.raises(ValueError, match="pyramid-fraction"):
+        AppConfig.from_dict({"loadmodel": {"pyramid-fraction": 1.2}})
+    with pytest.raises(ValueError, match="animation-fraction"):
+        AppConfig.from_dict({"loadmodel": {
+            "animation-fraction": -0.1}})
+    with pytest.raises(ValueError, match="sum to"):
+        AppConfig.from_dict({"loadmodel": {
+            "bulk-fraction": 0.4, "mask-fraction": 0.3,
+            "pyramid-fraction": 0.2, "animation-fraction": 0.2}})

@@ -751,6 +751,61 @@ class TestBenchGate:
         assert by_key["part_majority_5xx"] == "skipped"
         capsys.readouterr()
 
+    def test_workloads_keys_gated_direction_aware(self, tmp_path,
+                                                  capsys):
+        """--workloads judges WORKLOADS_r*.json (bench --smoke
+        --workloads, the device mask/overlay/pyramid/animation drill)
+        direction-aware by name: the batched latencies and the
+        pyramid build are ``_ms`` keys and regress UP; the parity-mix
+        size (``mask_renders``) regresses DOWN — fewer masks
+        exercised is a shrunken drill, not a win."""
+        gate = self._gate()
+        good = {"mask_device_ms": 12.0, "overlay_device_ms": 8.0,
+                "pyramid_build_ms": 150.0, "anim_first_frame_ms": 9.0,
+                "anim_total_ms": 40.0, "mask_renders": 12}
+        self._write(tmp_path, "WORKLOADS_r01.json", good)
+        # First-frame latency UP 3x = regression (the stream promise
+        # is "first frame fast"), with every other key flat.
+        self._write(tmp_path, "WORKLOADS_r02.json",
+                    {**good, "anim_first_frame_ms": 27.0})
+        assert gate.main(["--workloads", "--dir",
+                          str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["anim_first_frame_ms"] == "regression"
+        assert by_key["mask_device_ms"] == "pass"
+        assert by_key["mask_renders"] == "pass"
+        # The parity mix shrinking is judged DOWNWARD: 12 -> 4 masks
+        # rendered means the drill stopped proving what it claims.
+        self._write(tmp_path, "WORKLOADS_r03.json", good)
+        self._write(tmp_path, "WORKLOADS_r04.json",
+                    {**good, "mask_renders": 4})
+        assert gate.main(["--workloads", "--dir",
+                          str(tmp_path)]) == 1
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["mask_renders"] == "regression"
+        assert by_key["pyramid_build_ms"] == "pass"
+        # Smoke-scale batched renders are a few ms, so the family bar
+        # is the wide 0.50, not 0.10: a +40% wobble on the overlay
+        # latency passes; a faster round obviously passes too.
+        self._write(tmp_path, "WORKLOADS_r05.json", good)
+        self._write(tmp_path, "WORKLOADS_r06.json",
+                    {**good, "overlay_device_ms": 11.2,
+                     "anim_total_ms": 30.0})
+        assert gate.main(["--workloads", "--dir",
+                          str(tmp_path)]) == 0
+        capsys.readouterr()
+        # Records that predate the workloads bench skip on null.
+        self._write(tmp_path, "WORKLOADS_r07.json", {"ok": True})
+        assert gate.main(["--workloads", "--dir",
+                          str(tmp_path)]) == 0
+        verdict = json.loads(capsys.readouterr().out)
+        by_key = {v["key"]: v["verdict"] for v in verdict["keys"]}
+        assert by_key["mask_device_ms"] == "skipped"
+        assert by_key["mask_renders"] == "skipped"
+        capsys.readouterr()
+
     def test_multichip_fleet_curve_gated(self, tmp_path, capsys):
         """--multichip judges MULTICHIP_r*.json on the fleet scaling
         keys: ok-true-only rounds (every record predating the curve)

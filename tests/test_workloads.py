@@ -1,0 +1,721 @@
+"""Device workloads plane (PR 20): batched device mask rasterization
+pinned byte-identical to the host path, the overlay composite against
+the refimpl golden, crash-safe pyramid jobs (kill/resume byte
+stability, serving-path pickup, bulk-shed deferral), ordered animation
+streaming with cancel-on-disconnect, z/t scrub prediction, and the
+explain plane's answers for every new route."""
+
+import asyncio
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from omero_ms_image_region_tpu import codecs
+from omero_ms_image_region_tpu.io.ngff import NgffZarrSource, find_ngff
+from omero_ms_image_region_tpu.io.service import PixelsService
+from omero_ms_image_region_tpu.io.store import build_pyramid
+from omero_ms_image_region_tpu.ops.lut import LutProvider
+from omero_ms_image_region_tpu.server.batcher import BatchingRenderer
+from omero_ms_image_region_tpu.server.ctx import (
+    BadRequestError, ImageRegionCtx, ShapeMaskCtx,
+)
+from omero_ms_image_region_tpu.server.handler import (
+    ImageRegionHandler, ImageRegionServices, NotFoundError, Renderer,
+    ShapeMaskHandler, WorkloadsHandler, frame_record,
+)
+from omero_ms_image_region_tpu.server.jobs import PyramidJobManager
+from omero_ms_image_region_tpu.services.cache import CacheConfig, Caches
+from omero_ms_image_region_tpu.services.metadata import (
+    CanReadMemo, LocalMetadataService,
+)
+from omero_ms_image_region_tpu.utils import telemetry
+
+IMG = 7
+W = H = 64
+Z = 4
+MASK_IDS = (9001, 9002, 9003)
+_FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "data", "masks")
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("workloads")
+    rng = np.random.default_rng(20)
+    planes = rng.integers(0, 60000, size=(2, Z, H, W)).astype(np.uint16)
+    build_pyramid(planes, str(root / str(IMG)), chunk=(32, 32),
+                  n_levels=2)
+    os.makedirs(root / "masks", exist_ok=True)
+    for name in os.listdir(_FIXTURES):
+        shutil.copy(os.path.join(_FIXTURES, name),
+                    root / "masks" / name)
+    return str(root)
+
+
+def _services(data_dir, renderer=None, pixels=None):
+    return ImageRegionServices(
+        pixels_service=pixels or PixelsService(data_dir),
+        metadata=LocalMetadataService(data_dir),
+        caches=Caches.from_config(CacheConfig.enabled_all()),
+        can_read_memo=CanReadMemo(),
+        renderer=renderer or Renderer(),
+        lut_provider=LutProvider(),
+        cpu_fallback_max_px=0,
+    )
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _ctx(**params):
+    base = {"imageId": str(IMG), "theZ": "0", "theT": "0",
+            "format": "png"}
+    base.update(params)
+    return ImageRegionCtx.from_params(base)
+
+
+def _mask_ctx(shape_id, **params):
+    base = {"shapeId": str(shape_id)}
+    base.update(params)
+    return ShapeMaskCtx.from_params(base)
+
+
+# ------------------------------------------------- mask byte identity
+
+class TestMaskParity:
+    def test_device_bytes_identical_to_host(self, data_dir):
+        """Every committed fixture, every flip combination: the
+        batched device rasterizer serves the EXACT bytes the host
+        path serves (default stored fill — the uncached branch, so
+        both passes really render)."""
+        host_services = _services(data_dir)
+        host = ShapeMaskHandler(host_services, device_masks=False)
+
+        async def main():
+            device_services = _services(data_dir,
+                                        renderer=BatchingRenderer())
+            handler = ShapeMaskHandler(device_services,
+                                       device_masks=True)
+            before = dict(telemetry.WORKLOADS.requests)
+            try:
+                for sid in MASK_IDS:
+                    for fh in (False, True):
+                        for fv in (False, True):
+                            ctx = _mask_ctx(
+                                sid,
+                                flip=("hv" if fh and fv else
+                                      "h" if fh else
+                                      "v" if fv else None))
+                            dev = await handler.render_shape_mask(ctx)
+                            hst = await host.render_shape_mask(ctx)
+                            assert dev == hst, (sid, fh, fv)
+            finally:
+                await device_services.renderer.close()
+            delta = (telemetry.WORKLOADS.requests.get("mask_device", 0)
+                     - before.get("mask_device", 0))
+            assert delta == len(MASK_IDS) * 4
+
+        run(main())
+
+    def test_concurrent_masks_coalesce_and_match(self, data_dir):
+        """Same-geometry masks submitted together ride one batched
+        dispatch; each comes back as ITS OWN bytes."""
+        host_services = _services(data_dir)
+        host = ShapeMaskHandler(host_services, device_masks=False)
+
+        async def main():
+            device_services = _services(data_dir,
+                                        renderer=BatchingRenderer(
+                                            linger_ms=5.0))
+            handler = ShapeMaskHandler(device_services,
+                                       device_masks=True)
+            try:
+                ctxs = [_mask_ctx(sid) for sid in MASK_IDS]
+                dev = await asyncio.gather(
+                    *[handler.render_shape_mask(c) for c in ctxs])
+                hst = [await host.render_shape_mask(c) for c in ctxs]
+                assert dev == hst
+                assert len(set(dev)) == len(MASK_IDS)
+            finally:
+                await device_services.renderer.close()
+
+        run(main())
+
+    def test_plain_renderer_falls_back_to_host(self, data_dir):
+        """device_masks=True with a renderer that has no batched mask
+        path (plain Renderer) silently serves the host rasterizer —
+        no error, counted as a host render."""
+        services = _services(data_dir)
+        handler = ShapeMaskHandler(services, device_masks=True)
+        before = telemetry.WORKLOADS.requests.get("mask_host", 0)
+        png = run(handler.render_shape_mask(_mask_ctx(9001)))
+        rgba = codecs.decode_to_rgba(png)
+        assert rgba.shape == (H, W, 4)
+        assert telemetry.WORKLOADS.requests.get("mask_host", 0) == \
+            before + 1
+
+
+# ------------------------------------------------- overlay composites
+
+class TestOverlay:
+    def _refimpl(self, services, image_handler, ctx, shape_ids,
+                 color=None):
+        """The golden: host rasterize + the exact
+        ``overlay_masks_batch`` integer blend + the shared PNG tail."""
+        from omero_ms_image_region_tpu.ops.maskops import (
+            overlay_masks_batch, rasterize_mask,
+        )
+        from omero_ms_image_region_tpu.utils.color import \
+            split_html_color
+
+        async def main():
+            base_png = await image_handler.render_image_region(ctx)
+            base = codecs.decode_to_rgba(base_png)
+            override = (split_html_color(color)
+                        if color is not None else None)
+            out = base
+            for sid in shape_ids:
+                mask = await services.metadata.get_mask(sid, None)
+                grid, _ = rasterize_mask(mask, override,
+                                         ctx.flip_horizontal,
+                                         ctx.flip_vertical)
+                fill = np.array([mask.resolved_fill_color(override)],
+                                dtype=np.uint8)
+                out = overlay_masks_batch(out[None], grid[None],
+                                          fill)[0]
+            return codecs.encode_rgba(out, "png")
+
+        return run(main())
+
+    def test_overlay_matches_refimpl_golden(self, data_dir):
+        services = _services(data_dir)
+        image_handler = ImageRegionHandler(services)
+        workloads = WorkloadsHandler(image_handler, services)
+        ctx = _ctx(region=f"0,0,{W},{H}")
+        got = run(workloads.render_overlay(ctx, list(MASK_IDS)))
+        want = self._refimpl(services, image_handler, ctx,
+                             list(MASK_IDS))
+        assert got == want
+
+    def test_overlay_color_override_matches_refimpl(self, data_dir):
+        services = _services(data_dir)
+        image_handler = ImageRegionHandler(services)
+        workloads = WorkloadsHandler(image_handler, services)
+        ctx = _ctx(region=f"0,0,{W},{H}")
+        got = run(workloads.render_overlay(ctx, [9001],
+                                           color="00FF00"))
+        want = self._refimpl(services, image_handler, ctx, [9001],
+                             color="00FF00")
+        assert got == want
+        # And the override genuinely changes the composite.
+        plain = run(workloads.render_overlay(ctx, [9001]))
+        assert got != plain
+
+    def test_overlay_validation(self, data_dir):
+        services = _services(data_dir)
+        workloads = WorkloadsHandler(ImageRegionHandler(services),
+                                     services)
+        ctx = _ctx(region=f"0,0,{W},{H}")
+        with pytest.raises(BadRequestError):
+            run(workloads.render_overlay(ctx, []))
+        with pytest.raises(NotFoundError):
+            run(workloads.render_overlay(ctx, [4242]))
+        with pytest.raises(BadRequestError):
+            run(workloads.render_overlay(ctx, [9001],
+                                         color="not-a-color"))
+        # Region geometry must match the mask's plane.
+        small = _ctx(region="0,0,32,32")
+        with pytest.raises(BadRequestError):
+            run(workloads.render_overlay(small, [9001]))
+
+
+# ------------------------------------------------ downsample parity
+
+class TestDownsampleParity:
+    @pytest.mark.parametrize("dtype", ["uint8", "uint16", "float32"])
+    def test_device_downsample_matches_host(self, dtype):
+        """``ops.pyramid.downsample2_batch`` vs the store writers'
+        host mean-pool: identical output for every storage dtype the
+        pyramid path writes."""
+        from omero_ms_image_region_tpu.io.store import _downsample2
+        from omero_ms_image_region_tpu.ops.pyramid import \
+            downsample2_batch
+
+        rng = np.random.default_rng(4)
+        planes = rng.integers(0, 255, size=(1, 2, 3, 64, 48)).astype(
+            dtype)
+        dev = downsample2_batch(planes)
+        host = np.empty_like(dev)
+        for t in range(1):
+            for c in range(2):
+                for z in range(3):
+                    host[t, c, z] = _downsample2(
+                        planes[t, c, z]).astype(dtype)
+        np.testing.assert_array_equal(dev, host)
+
+
+# ---------------------------------------------------- pyramid jobs
+
+def _tree_bytes(root):
+    out = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            p = os.path.join(dirpath, fn)
+            with open(p, "rb") as f:
+                out[os.path.relpath(p, root)] = f.read()
+    return out
+
+
+class TestPyramidJobs:
+    def _planes(self):
+        rng = np.random.default_rng(9)
+        return rng.integers(0, 60000, size=(1, 1, 64, 64)).astype(
+            np.uint16)
+
+    def test_submit_missing_source_raises(self, tmp_path):
+        jobs = PyramidJobManager()
+        with pytest.raises(FileNotFoundError):
+            jobs.submit(str(tmp_path / "nope"))
+
+    def test_sync_build_commits_readable_levels(self, tmp_path):
+        build_pyramid(self._planes(), str(tmp_path / "img"),
+                      chunk=(32, 32), n_levels=1)
+        jobs = PyramidJobManager(chunk=(32, 32), min_level_size=16)
+        job = jobs.submit(str(tmp_path / "img"))
+        jobs.run_job_sync(job)
+        assert job.state == "done"
+        assert job.levels_done == job.levels_total == 3  # 64, 32, 16
+        root = find_ngff(str(tmp_path / "img"))
+        assert root is not None
+        reader = NgffZarrSource(root)
+        try:
+            assert reader.resolution_levels() == 3
+        finally:
+            reader.close()
+        # Idempotent re-submit: a fresh build over a committed pyramid
+        # resumes and leaves the bytes untouched.
+        before = _tree_bytes(job.dest)
+        job2 = PyramidJobManager(chunk=(32, 32),
+                                 min_level_size=16).submit(
+            str(tmp_path / "img"))
+        PyramidJobManager(chunk=(32, 32),
+                          min_level_size=16).run_job_sync(job2)
+        assert job2.resumed is True
+        assert _tree_bytes(job2.dest) == before
+
+    def test_kill_resume_is_byte_stable(self, tmp_path):
+        """A build killed mid-level resumes to EXACTLY the bytes an
+        uninterrupted build writes — committed levels are skipped, tmp
+        debris is cleared, the group markers land last."""
+        planes = self._planes()
+        build_pyramid(planes, str(tmp_path / "a"), chunk=(32, 32),
+                      n_levels=1)
+        build_pyramid(planes, str(tmp_path / "b"), chunk=(32, 32),
+                      n_levels=1)
+
+        ref_mgr = PyramidJobManager(chunk=(32, 32), min_level_size=16)
+        ref = ref_mgr.submit(str(tmp_path / "a"))
+        ref_mgr.run_job_sync(ref)
+
+        # "Kill" after level 0: run the real prepare + first level
+        # step, then abandon — no group markers, plus tmp debris the
+        # next run must sweep.
+        killed = PyramidJobManager(chunk=(32, 32), min_level_size=16)
+        job = killed.submit(str(tmp_path / "b"))
+        cur, n_levels = killed._prepare(job)
+        killed._level_step(job, cur, 0, n_levels)
+        debris = os.path.join(job.dest, ".lvl-1.tmp")
+        os.makedirs(debris, exist_ok=True)
+        with open(os.path.join(debris, "junk"), "w") as f:
+            f.write("killed mid-write")
+        assert find_ngff(str(tmp_path / "b")) is None  # invisible
+
+        resumed_mgr = PyramidJobManager(chunk=(32, 32),
+                                        min_level_size=16)
+        job2 = resumed_mgr.submit(str(tmp_path / "b"))
+        resumed_mgr.run_job_sync(job2)
+        assert job2.resumed is True
+        assert not os.path.exists(debris)
+        assert _tree_bytes(job2.dest) == _tree_bytes(ref.dest)
+
+    def test_serving_path_picks_up_committed_pyramid(self, tmp_path):
+        """A TIFF-backed image gains NGFF levels through the job; the
+        NORMAL serving path (PixelsService sniff + handler render)
+        serves them with no special reader."""
+        from omero_ms_image_region_tpu.io.tiffwrite import \
+            write_ome_tiff
+
+        planes = self._planes()
+        img_dir = str(tmp_path / "8")
+        os.makedirs(img_dir)
+        write_ome_tiff(planes, os.path.join(img_dir, "img.ome.tiff"),
+                       tile=(32, 32), n_levels=1)
+        pixels = PixelsService(str(tmp_path))
+        src = pixels.get_pixel_source(8)
+        assert len(src.resolution_descriptions()) == 1
+
+        jobs = PyramidJobManager(pixels_service=pixels,
+                                 chunk=(32, 32), min_level_size=16)
+        job = jobs.submit_image(8)
+        jobs.run_job_sync(job)
+        assert job.state == "done"
+
+        # _commit invalidated the cached handle: the next open
+        # re-sniffs and prefers the committed NGFF group.
+        src = pixels.get_pixel_source(8)
+        assert isinstance(src, NgffZarrSource)
+        assert src.resolution_levels() == 3
+
+        services = _services(str(tmp_path), pixels=pixels)
+        handler = ImageRegionHandler(services)
+        tile = run(handler.render_image_region(
+            ImageRegionCtx.from_params({
+                "imageId": "8", "theZ": "0", "theT": "0",
+                "format": "png", "tile": "1,0,0,32,32"})))
+        assert codecs.decode_to_rgba(tile).shape == (32, 32, 4)
+        pixels.close()
+
+    def test_bulk_shed_defers_then_resumes(self, tmp_path,
+                                           monkeypatch):
+        """While the pressure ladder's shed_bulk step is engaged the
+        job parks in ``deferred`` between levels; release lets it
+        finish.  Bulk never starves interactive."""
+        from omero_ms_image_region_tpu.server import pressure
+
+        class FakeGov:
+            shedding = True
+
+            def bulk_shed_active(self):
+                return self.shedding
+
+        gov = FakeGov()
+        monkeypatch.setattr(pressure, "active", lambda: gov)
+        build_pyramid(self._planes(), str(tmp_path / "img"),
+                      chunk=(32, 32), n_levels=1)
+        jobs = PyramidJobManager(chunk=(32, 32), min_level_size=16,
+                                 defer_poll_s=0.01)
+        job = jobs.submit(str(tmp_path / "img"))
+
+        async def main():
+            task = asyncio.ensure_future(jobs._execute(job))
+            for _ in range(500):
+                if job.state == "deferred":
+                    break
+                await asyncio.sleep(0.01)
+            assert job.state == "deferred"
+            gov.shedding = False
+            await asyncio.wait_for(task, 30)
+
+        run(main())
+        assert job.state == "done"
+        assert telemetry.WORKLOADS.jobs.get("deferred", 0) >= 1
+
+    def test_cancel_mid_build(self, tmp_path):
+        build_pyramid(self._planes(), str(tmp_path / "img"),
+                      chunk=(32, 32), n_levels=1)
+        jobs = PyramidJobManager(chunk=(32, 32), min_level_size=16)
+        job = jobs.submit(str(tmp_path / "img"))
+        assert jobs.cancel(job.job_id) is True
+        run(jobs._execute(job))
+        assert job.state == "cancelled"
+        # Never committed: the serving path still sees no pyramid.
+        assert find_ngff(str(tmp_path / "img")) is None
+
+    def test_sidecar_answers_after_restart(self, tmp_path):
+        """``job_for_source`` reads the on-disk sidecar when the
+        in-memory ledger is gone — a restarted frontend still explains
+        a previous process's build."""
+        build_pyramid(self._planes(), str(tmp_path / "img"),
+                      chunk=(32, 32), n_levels=1)
+        jobs = PyramidJobManager(chunk=(32, 32), min_level_size=16)
+        job = jobs.submit(str(tmp_path / "img"))
+        jobs.run_job_sync(job)
+        doc = jobs.job_for_source(str(tmp_path / "img"))
+        assert doc["state"] == "done"
+        fresh = PyramidJobManager()
+        doc2 = fresh.job_for_source(str(tmp_path / "img"))
+        assert doc2 is not None and doc2["jobId"] == job.job_id
+
+    def test_duplicate_submit_dedups(self, tmp_path):
+        build_pyramid(self._planes(), str(tmp_path / "img"),
+                      chunk=(32, 32), n_levels=1)
+        jobs = PyramidJobManager()
+        a = jobs.submit(str(tmp_path / "img"))
+        b = jobs.submit(str(tmp_path / "img"))
+        assert a is b
+
+
+# ----------------------------------------------- animation streaming
+
+class _StaggeredHandler:
+    """Wraps the real image handler with a per-call growing delay so a
+    mid-stream close deterministically finds later frames pending."""
+
+    def __init__(self, inner, step_s=0.05):
+        self.inner = inner
+        self.step_s = step_s
+        self.calls = 0
+
+    async def render_image_region(self, ctx):
+        self.calls += 1
+        await asyncio.sleep(self.step_s * self.calls)
+        return await self.inner.render_image_region(ctx)
+
+
+class TestAnimationStream:
+    def _frame_ctxs(self, n):
+        return [_ctx(theZ=str(z)) for z in range(n)]
+
+    def test_frame_record_framing(self):
+        rec = frame_record(b"abc")
+        assert rec[:4] == b"FRME"
+        assert int.from_bytes(rec[4:8], "big") == 3
+        assert rec[8:] == b"abc"
+
+    def test_frames_stream_in_order_byte_identical(self, data_dir):
+        services = _services(data_dir)
+        image_handler = ImageRegionHandler(services)
+        workloads = WorkloadsHandler(image_handler, services,
+                                     max_frames=8)
+        ctxs = self._frame_ctxs(Z)
+
+        async def main():
+            frames = []
+            async for rec in workloads.render_animation_stream(ctxs):
+                assert rec[:4] == b"FRME"
+                n = int.from_bytes(rec[4:8], "big")
+                assert len(rec) == 8 + n
+                frames.append(rec[8:])
+            return frames
+
+        frames = run(main())
+        assert len(frames) == Z
+        # Frame i is EXACTLY the plain route's bytes for plane z=i —
+        # order preserved, identity shared.
+        for i, body in enumerate(frames):
+            direct = run(image_handler.render_image_region(
+                _ctx(theZ=str(i))))
+            assert body == direct
+        assert len({bytes(f) for f in frames}) == Z
+
+    def test_frame_cap_and_empty_rejected(self, data_dir):
+        services = _services(data_dir)
+        workloads = WorkloadsHandler(ImageRegionHandler(services),
+                                     services, max_frames=2)
+
+        async def drain(ctxs):
+            async for _ in workloads.render_animation_stream(ctxs):
+                pass
+
+        with pytest.raises(BadRequestError):
+            run(drain(self._frame_ctxs(3)))
+        with pytest.raises(BadRequestError):
+            run(drain([]))
+
+    def test_disconnect_cancels_remaining_frames(self, data_dir):
+        """Closing the generator after the first frame (the client
+        vanished) cancels every not-yet-settled render task and counts
+        one cancelled stream."""
+        services = _services(data_dir)
+        stag = _StaggeredHandler(ImageRegionHandler(services))
+        workloads = WorkloadsHandler(stag, services, max_frames=8)
+        before = telemetry.WORKLOADS.stream_cancels
+
+        async def main():
+            agen = workloads.render_animation_stream(
+                self._frame_ctxs(Z))
+            first = await agen.__anext__()
+            assert first[:4] == b"FRME"
+            await agen.aclose()
+
+        run(main())
+        assert telemetry.WORKLOADS.stream_cancels == before + 1
+        kinds = [e["kind"] for e in telemetry.FLIGHT.snapshot()]
+        assert "animation.cancelled" in kinds
+
+
+# ------------------------------------------------- scrub prediction
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestScrubPrediction:
+    def _tracker(self):
+        from omero_ms_image_region_tpu.services.viewport import \
+            ViewportTracker
+        return ViewportTracker(clock=_Clock())
+
+    def test_scrub_velocity_median_of_plane_deltas(self):
+        tracker = self._tracker()
+        for t in (0, 1, 2, 3):
+            tracker.observe("s", 1, 0, t, 0, 5, 5)
+        assert tracker.scrub_velocity("s") == (0, 1)
+        # z-scrub the other way.
+        for z in (6, 4, 2):
+            tracker.observe("s2", 1, z, 0, 0, 5, 5)
+        assert tracker.scrub_velocity("s2") == (-2, 0)
+
+    def test_pan_does_not_vote_as_scrub(self):
+        tracker = self._tracker()
+        for x in range(4):
+            tracker.observe("s", 1, 0, 0, 0, x, 0)
+        assert tracker.scrub_velocity("s") is None
+
+    def test_predict_extends_scrub_to_future_planes(self):
+        tracker = self._tracker()
+        for t in (0, 1, 2):
+            tracker.observe("s", 1, 0, t, 0, 5, 5)
+        preds = tracker.predict("s", lookahead=2)
+        planes = [(p.z, p.t, p.x, p.y) for p in preds]
+        assert (0, 3, 5, 5) in planes
+        assert (0, 4, 5, 5) in planes
+        # Sliders clamp at zero: a backwards scrub never predicts a
+        # negative plane.
+        for t in (2, 1, 0):
+            tracker.observe("back", 1, 0, t, 0, 5, 5)
+        assert all(p.t >= 0 and p.z >= 0
+                   for p in tracker.predict("back", lookahead=4))
+
+
+# ------------------------------------------------------ explain plane
+
+class TestExplainWorkloadRoutes:
+    def _config(self):
+        from omero_ms_image_region_tpu.server.config import AppConfig
+        return AppConfig.from_dict({})
+
+    def _explain(self, path, **kw):
+        from omero_ms_image_region_tpu.server.explain import explain
+        return run(explain(path, self._config(), **kw))
+
+    def test_classify_covers_every_render_route(self):
+        from omero_ms_image_region_tpu.server.explain import (
+            classify_render_path, parse_render_path,
+        )
+        cases = {
+            "/webgateway/render_image_region/1/0/0/?tile=0,0,0":
+                "image",
+            "/webgateway/render_shape_mask/9001/?color=FF0000":
+                "mask",
+            "/webgateway/render_overlay/1/0/0/?shapes=9001": "overlay",
+            "/webgateway/render_animation/1/0/0/?axis=z&frames=3":
+                "animation",
+        }
+        for path, want in cases.items():
+            kind, params = classify_render_path(path)
+            assert kind == want, path
+        with pytest.raises(BadRequestError):
+            classify_render_path("/webgateway/render_overlay/x")
+        # The image-only parser keeps its pinned contract.
+        with pytest.raises(BadRequestError):
+            parse_render_path("/webgateway/render_shape_mask/1")
+
+    def test_mask_explain_identity_and_posture(self):
+        doc = self._explain(
+            "/webgateway/render_shape_mask/9001/?color=FF0000&flip=h")
+        assert doc["kind"] == "mask"
+        assert doc["qos"] == "interactive"
+        assert doc["device_batched"] is True
+        assert doc["identity"].endswith(":f10")
+        assert doc["dry_run"] is True
+
+    def test_overlay_explain_shares_base_identity(self):
+        doc = self._explain(
+            "/webgateway/render_overlay/1/0/0/"
+            "?shapes=9001,9002&color=FF0000")
+        assert doc["kind"] == "overlay"
+        assert doc["shapes"] == [9001, 9002]
+        assert doc["identity"].startswith(doc["base_identity"])
+        assert ":ov:9001,9002:FF0000" in doc["identity"]
+        assert doc["plane_route_key"]
+
+    def test_animation_explain_per_frame_identities(self):
+        doc = self._explain(
+            "/webgateway/render_animation/1/0/2/?axis=t&frames=3")
+        assert doc["kind"] == "animation"
+        assert doc["frames"] == 3 and doc["axis"] == "t"
+        assert len(doc["identities"]) == 3
+        assert len(set(doc["identities"])) == 3
+        assert len(doc["plane_route_keys"]) == 3
+        assert doc["streamed"] is True
+        from omero_ms_image_region_tpu.server.explain import explain
+        with pytest.raises(BadRequestError):
+            run(explain("/webgateway/render_animation/1/0/0/"
+                        "?frames=100000", self._config()))
+
+    def test_explain_reports_pyramid_job_state(self, tmp_path):
+        rng = np.random.default_rng(9)
+        planes = rng.integers(0, 60000, size=(1, 1, 64, 64)).astype(
+            np.uint16)
+        build_pyramid(planes, str(tmp_path / "1"), chunk=(32, 32),
+                      n_levels=1)
+        pixels = PixelsService(str(tmp_path))
+        jobs = PyramidJobManager(pixels_service=pixels,
+                                 chunk=(32, 32), min_level_size=16)
+        job = jobs.submit_image(1)
+        jobs.run_job_sync(job)
+        doc = self._explain(
+            "/webgateway/render_overlay/1/0/0/?shapes=9001",
+            jobs=jobs)
+        assert doc["pyramid_job"]["state"] == "done"
+        assert doc["pyramid_job"]["jobId"] == job.job_id
+        pixels.close()
+
+
+# -------------------------------------------------- telemetry plane
+
+class TestWorkloadTelemetry:
+    def _lint_module(self):
+        import importlib.util
+        scripts = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts")
+        spec = importlib.util.spec_from_file_location(
+            "metrics_lint", os.path.join(scripts, "metrics_lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_families_lint_clean_and_reset(self):
+        """The workload families expose under the closed
+        kind/action label keys, lint clean against the committed
+        budget, and reset() returns them to emit-when-live silence."""
+        telemetry.reset()
+        telemetry.WORKLOADS.count_request("mask_device")
+        telemetry.WORKLOADS.count_job("submitted")
+        telemetry.WORKLOADS.job_started()
+        telemetry.WORKLOADS.count_level_committed()
+        telemetry.WORKLOADS.count_stream()
+        telemetry.WORKLOADS.count_frames(3)
+        telemetry.WORKLOADS.count_stream_cancelled()
+        telemetry.WORKLOADS.observe_first_frame_ms(12.5)
+        text = telemetry.finalize_exposition(
+            telemetry.session_metric_lines())
+        assert ('imageregion_workload_requests_total'
+                '{kind="mask_device"} 1') in text
+        assert ('imageregion_pyramid_jobs_total'
+                '{action="submitted"} 1') in text
+        assert "imageregion_pyramid_jobs_active 1" in text
+        assert "imageregion_pyramid_levels_committed_total 1" in text
+        assert "imageregion_animation_streams_total 1" in text
+        assert "imageregion_animation_frames_total 3" in text
+        assert "imageregion_animation_cancelled_total 1" in text
+        assert "imageregion_animation_first_frame_ms 12.5" in text
+        lint = self._lint_module()
+        assert lint.lint_exposition(text, lint.load_budget()) == []
+        telemetry.reset()
+        after = telemetry.finalize_exposition(
+            telemetry.session_metric_lines())
+        assert "imageregion_workload_" not in after
+        assert "imageregion_animation_" not in after
